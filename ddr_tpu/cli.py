@@ -1,0 +1,41 @@
+"""``ddr`` command-line dispatcher.
+
+Mirrors the reference CLI surface (/root/reference/src/ddr/cli.py:19-72): subcommands
+map to script modules' ``main()``. Script modules are filled in as they land; unknown
+or not-yet-implemented subcommands exit with a clear message rather than a traceback.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+_COMMANDS = {
+    "train": "ddr_tpu.scripts.train",
+    "test": "ddr_tpu.scripts.test",
+    "route": "ddr_tpu.scripts.router",
+    "train-and-test": "ddr_tpu.scripts.train_and_test",
+    "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
+    "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in {"-h", "--help"}:
+        print("usage: ddr {" + ",".join(_COMMANDS) + "} [config overrides...]")
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd not in _COMMANDS:
+        print(f"ddr: unknown command {cmd!r}; choose from {sorted(_COMMANDS)}", file=sys.stderr)
+        return 2
+    try:
+        mod = importlib.import_module(_COMMANDS[cmd])
+    except ModuleNotFoundError as e:
+        print(f"ddr: command {cmd!r} is not available yet ({e})", file=sys.stderr)
+        return 2
+    return mod.main(rest) or 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

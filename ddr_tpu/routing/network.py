@@ -1,0 +1,159 @@
+"""River-network topology as a static, jit-friendly structure.
+
+The reference encodes the network as a torch sparse CSR adjacency and re-probes its
+sparsity pattern at runtime with ``PatternMapper`` (/root/reference/src/ddr/routing/utils.py:25-129).
+On TPU the topology is static per compiled program, so we precompute everything offline
+(NumPy) once: the edge list, and a *level schedule* — reaches grouped by longest-path
+depth from the headwaters — which turns the lower-triangular solve into a
+wavefront of fully-vectorized scatter-adds (one per level) instead of a sequential
+forward substitution.
+
+An edge (src -> tgt) means reach ``src`` drains into reach ``tgt``; the adjacency is
+strictly lower-triangular in topological order (A[tgt, src] = 1 with src < tgt), matching
+the binsparse COO convention (/root/reference/docs/engine/binsparse.md:33-47).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["RiverNetwork", "compute_levels", "build_network"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RiverNetwork:
+    """Static river topology carried through jit.
+
+    Attributes
+    ----------
+    edge_src, edge_tgt:
+        Flat edge list, ``(E,)`` int32. ``src`` drains into ``tgt``.
+    lvl_src, lvl_tgt:
+        The same edges grouped by the longest-path level of their target and padded to
+        a rectangle ``(D, E_max)``. Padding slots hold ``n`` (out-of-bounds), which JAX
+        scatters silently drop (``mode="drop"``).
+    n, depth, n_edges:
+        Static metadata (not traced).
+    """
+
+    edge_src: jnp.ndarray
+    edge_tgt: jnp.ndarray
+    lvl_src: jnp.ndarray
+    lvl_tgt: jnp.ndarray
+    n: int = dataclasses.field(metadata={"static": True})
+    depth: int = dataclasses.field(metadata={"static": True})
+    n_edges: int = dataclasses.field(metadata={"static": True})
+
+    def upstream_sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Sparse mat-vec ``N @ x``: sum of upstream values per reach.
+
+        Equivalent of the reference's per-timestep SpMV
+        (``i_t = network @ discharge``, /root/reference/src/ddr/routing/mmc.py:535),
+        expressed as a segment-sum over the edge list — the TPU-friendly form.
+        """
+        return jax.ops.segment_sum(x[self.edge_src], self.edge_tgt, num_segments=self.n)
+
+
+def _ranges(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """Vectorized ``concatenate([arange(s, e) for s, e in zip(starts, ends)])``.
+
+    All ranges must be non-empty.
+    """
+    counts = ends - starts
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    out = np.ones(total, dtype=np.int64)
+    out[0] = starts[0]
+    boundaries = np.cumsum(counts)[:-1]
+    out[boundaries] = starts[1:] - ends[:-1] + 1
+    return np.cumsum(out)
+
+
+def compute_levels(rows: np.ndarray, cols: np.ndarray, n: int) -> np.ndarray:
+    """Longest-path level per node (headwaters = 0) via vectorized Kahn layering.
+
+    A node's level is the length of the longest upstream path ending at it. Each round
+    peels every node whose upstream count has dropped to zero; a node's round index is
+    exactly its longest-path level (its last-finishing predecessor was peeled the round
+    before). O(depth) vectorized rounds — no per-node Python loop, so it scales to the
+    ~2.9M-reach global MERIT graph (/root/reference/scripts/geometry_predictor.py:80).
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if rows.size and (rows.min() < 0 or rows.max() >= n or cols.min() < 0 or cols.max() >= n):
+        raise ValueError(f"edge indices out of range for n={n}")
+    level = np.zeros(n, dtype=np.int32)
+    assigned = np.zeros(n, dtype=bool)
+    remaining = np.bincount(rows, minlength=n).astype(np.int64)
+
+    order = np.argsort(cols, kind="stable")
+    e_src = cols[order]
+    e_tgt = rows[order]
+    src_starts = np.searchsorted(e_src, np.arange(n + 1))
+
+    frontier = np.flatnonzero(remaining == 0)
+    lvl = 0
+    n_done = 0
+    while frontier.size:
+        level[frontier] = lvl
+        assigned[frontier] = True
+        n_done += frontier.size
+        starts = src_starts[frontier]
+        ends = src_starts[frontier + 1]
+        nz = ends > starts
+        flat = _ranges(starts[nz], ends[nz])
+        if flat.size:
+            remaining -= np.bincount(e_tgt[flat], minlength=n)
+        frontier = np.flatnonzero((remaining == 0) & ~assigned)
+        lvl += 1
+    if n_done < n:
+        raise ValueError(f"adjacency contains a cycle: {n - n_done} nodes unreachable")
+    return level
+
+
+def build_network(rows: np.ndarray, cols: np.ndarray, n: int) -> RiverNetwork:
+    """Build the jit-ready :class:`RiverNetwork` from a COO adjacency.
+
+    ``rows`` are downstream (target) indices, ``cols`` upstream (source) — the
+    binsparse ``indices_0/indices_1`` arrays of the reference's zarr stores
+    (/root/reference/engine/src/ddr_engine/core/zarr_io.py:87-392).
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    level = compute_levels(rows, cols, n)
+    depth = int(level.max()) if n else 0
+
+    if rows.size == 0 or depth == 0:
+        lvl_src = np.zeros((0, 1), dtype=np.int64)
+        lvl_tgt = np.zeros((0, 1), dtype=np.int64)
+        depth = 0
+    else:
+        tgt_level = level[rows]  # every edge's target has level >= 1
+        order = np.argsort(tgt_level, kind="stable")
+        s_src = cols[order]
+        s_tgt = rows[order]
+        counts = np.bincount(tgt_level[order], minlength=depth + 1)[1:]  # levels 1..depth
+        e_max = int(counts.max())
+        lvl_src = np.full((depth, e_max), n, dtype=np.int64)
+        lvl_tgt = np.full((depth, e_max), n, dtype=np.int64)
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        col_pos = _ranges(np.zeros(depth, dtype=np.int64), counts.astype(np.int64))
+        row_pos = np.repeat(np.arange(depth), counts)
+        lvl_src[row_pos, col_pos] = s_src
+        lvl_tgt[row_pos, col_pos] = s_tgt
+
+    return RiverNetwork(
+        edge_src=jnp.asarray(cols, dtype=jnp.int32),
+        edge_tgt=jnp.asarray(rows, dtype=jnp.int32),
+        lvl_src=jnp.asarray(lvl_src, dtype=jnp.int32),
+        lvl_tgt=jnp.asarray(lvl_tgt, dtype=jnp.int32),
+        n=int(n),
+        depth=depth,
+        n_edges=int(rows.size),
+    )

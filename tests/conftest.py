@@ -1,0 +1,58 @@
+"""Test harness configuration.
+
+Forces JAX onto an 8-virtual-device CPU platform so multi-chip sharding tests run
+without TPU hardware (the analog of the reference's CPU-only CI,
+/root/reference/.github/workflows/test_and_lint.yaml:1-56). Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def _linear_chain_coo(n: int):
+    """Lower-triangular adjacency of a linear chain: reach i-1 drains into reach i."""
+    rows = np.arange(1, n, dtype=np.int64)
+    cols = np.arange(0, n - 1, dtype=np.int64)
+    return rows, cols
+
+
+def _binary_tree_coo(depth: int):
+    """A balanced binary confluence tree, topologically ordered leaves-first.
+
+    Nodes 0..2^depth-1 are headwaters; each later node has two upstreams.
+    Returns (rows, cols, n).
+    """
+    rows_l, cols_l = [], []
+    level_nodes = list(range(2**depth))
+    next_id = 2**depth
+    while len(level_nodes) > 1:
+        new_level = []
+        for a, b in zip(level_nodes[0::2], level_nodes[1::2]):
+            rows_l += [next_id, next_id]
+            cols_l += [a, b]
+            new_level.append(next_id)
+            next_id += 1
+        level_nodes = new_level
+    return np.array(rows_l), np.array(cols_l), next_id
+
+
+@pytest.fixture
+def chain_coo():
+    return _linear_chain_coo
+
+
+@pytest.fixture
+def tree_coo():
+    return _binary_tree_coo
