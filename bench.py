@@ -1,0 +1,125 @@
+"""Benchmark: reach-timesteps/sec/chip for the Muskingum-Cunge routing forward pass.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md), so ``vs_baseline`` is
+measured against an in-process re-creation of the reference's CPU execution path
+(torch + scipy spsolve_triangular per timestep, the same algorithm as
+/root/reference/src/ddr/routing/mmc.py:415-441 + utils.py:535-627) on the same
+synthetic network, extrapolated per reach-timestep. Run on the TPU chip when present.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _synthetic(n: int, t_hours: int, seed: int = 0):
+    from ddr_tpu.geodatazoo.synthetic import make_basin
+
+    basin = make_basin(n_segments=n, n_gauges=8, n_days=max(2, t_hours // 24), seed=seed)
+    return basin
+
+
+def bench_tpu(n: int = 8192, t_hours: int = 720) -> float:
+    """Returns reach-timesteps/sec for the jitted forward route."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.routing.mc import route
+    from ddr_tpu.routing.model import prepare_batch
+    from ddr_tpu.validation.configs import Config
+
+    cfg = Config(name="bench", geodataset="synthetic", mode="routing", kan={"input_var_names": ["a"]})
+    basin = _synthetic(n, t_hours)
+    network, channels, gauges = prepare_batch(
+        basin.routing_data, cfg.params.attribute_minimums["slope"]
+    )
+    params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+    q_prime = jnp.asarray(basin.q_prime[:t_hours])
+
+    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
+    fn(q_prime).block_until_ready()  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn(q_prime).block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    return n * t_hours / dt
+
+
+def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
+    """Reference-equivalent CPU path: torch elementwise physics + scipy triangular
+    solve per timestep (float64, like /root/reference/src/ddr/routing/utils.py:590-596)."""
+    import scipy.sparse as sp
+    import torch
+    from scipy.sparse.linalg import spsolve_triangular
+
+    basin = _synthetic(n, t_hours, seed=1)
+    rd = basin.routing_data
+    rows, cols = rd.adjacency_rows, rd.adjacency_cols
+    N_mat = sp.coo_matrix((np.ones(len(rows)), (rows, cols)), shape=(n, n)).tocsr()
+    eye = sp.eye(n, format="csr")
+
+    length = torch.tensor(rd.length)
+    slope = torch.tensor(np.maximum(rd.slope, 1e-3))
+    x = torch.tensor(rd.x)
+    n_mann = torch.tensor(basin.true_params["n"])
+    q_sp = torch.tensor(basin.true_params["q_spatial"])
+    p_sp = torch.tensor(basin.true_params["p_spatial"])
+    q_prime = torch.tensor(basin.q_prime[:t_hours].astype(np.float64))
+
+    def step(q_t):
+        qe = q_sp + 1e-6
+        depth = torch.clamp(
+            ((q_t * n_mann * (qe + 1)) / (p_sp * slope**0.5 + 1e-8)) ** (3.0 / (5.0 + 3.0 * qe)),
+            min=0.01,
+        )
+        tw = p_sp * depth**qe
+        ss = torch.clamp(tw * qe / (2 * depth), 0.5, 50.0)
+        bw = torch.clamp(tw - 2 * ss * depth, min=0.01)
+        area = (tw + bw) * depth / 2
+        wp = bw + 2 * depth * torch.sqrt(1 + ss**2)
+        v = (1 / n_mann) * (area / wp) ** (2 / 3) * slope**0.5
+        c = torch.clamp(v, 0.01, 15.0) * 5 / 3
+        k = length / c
+        denom = 2 * k * (1 - x) + 3600.0
+        c1 = (3600.0 - 2 * k * x) / denom
+        c2 = (3600.0 + 2 * k * x) / denom
+        c3 = (2 * k * (1 - x) - 3600.0) / denom
+        c4 = 2 * 3600.0 / denom
+        i_t = torch.tensor(N_mat @ q_t.numpy())
+        b = c2 * i_t + c3 * q_t + c4 * torch.clamp(q_prime[0], min=1e-4)
+        A = eye - sp.diags(c1.numpy()) @ N_mat
+        sol = spsolve_triangular(A.tocsr(), b.numpy(), lower=True)
+        return torch.clamp(torch.tensor(sol), min=1e-4)
+
+    q_t = torch.clamp(torch.tensor(np.linalg.norm(basin.q_prime[0]) * np.ones(n)), min=1e-4)
+    step(q_t)  # warm
+    t0 = time.perf_counter()
+    for _ in range(t_hours):
+        q_t = step(q_t)
+    dt = time.perf_counter() - t0
+    return n * t_hours / dt
+
+
+def main() -> None:
+    tpu_rts = bench_tpu()
+    ref_rts = bench_reference_cpu()
+    print(
+        json.dumps(
+            {
+                "metric": "reach-timesteps/sec/chip (synthetic 8192-reach network, 720h forward route)",
+                "value": round(tpu_rts, 1),
+                "unit": "reach-timesteps/s",
+                "vs_baseline": round(tpu_rts / ref_rts, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,9 @@ def main(argv: list[str] | None = None) -> int:
     try:
         mod = importlib.import_module(_COMMANDS[cmd])
     except ModuleNotFoundError as e:
-        print(f"ddr: command {cmd!r} is not available yet ({e})", file=sys.stderr)
+        if e.name != _COMMANDS[cmd]:
+            raise  # an implemented command with a genuinely missing dependency
+        print(f"ddr: command {cmd!r} is not available yet", file=sys.stderr)
         return 2
     return mod.main(rest) or 0
 

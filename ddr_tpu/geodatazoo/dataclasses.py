@@ -1,0 +1,159 @@
+"""Batch contracts between the data layer and the routing engine.
+
+NumPy/pandas equivalents of the reference's torch dataclasses
+(/root/reference/src/ddr/geodatazoo/dataclasses.py:19-266): pydantic gauge records,
+the ``Dates`` time machinery, and ``RoutingData`` — the single batch contract handed
+to the engine. Host-side arrays stay NumPy; the engine converts to jnp at the jit
+boundary (device placement is XLA's job, not the dataclass's).
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+from datetime import datetime
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+import pandas as pd
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+__all__ = ["Gauge", "MERITGauge", "GaugeSet", "Dates", "RoutingData", "validate_gages"]
+
+DAILY_FORMAT = "%Y/%m/%d"
+ORIGIN_START_DATE = "1980/01/01"  # epoch of the streamflow stores (reference dataclasses.py:74)
+
+
+class Gauge(BaseModel):
+    """One USGS gauge row (reference dataclasses.py:19-42)."""
+
+    model_config = ConfigDict(extra="allow", str_strip_whitespace=True)
+
+    STAID: str
+    STANAME: str = ""
+    DRAIN_SQKM: float = Field(gt=0)
+    LAT_GAGE: float | None = None
+    LNG_GAGE: float | None = None
+
+    @field_validator("STAID", mode="before")
+    @classmethod
+    def _pad_staid(cls, v: Any) -> str:
+        return str(v).zfill(8)
+
+
+class MERITGauge(Gauge):
+    """MERIT gauge: adds the COMID join key."""
+
+    COMID: int
+
+
+class GaugeSet(BaseModel):
+    gauges: list[Gauge]
+
+
+def validate_gages(file_path: Path, gauge_type: type[Gauge] = Gauge) -> GaugeSet:
+    """CSV -> validated GaugeSet (reference dataclasses.py:44-66)."""
+    with Path(file_path).open() as f:
+        return GaugeSet(gauges=[gauge_type.model_validate(row) for row in csv.DictReader(f)])
+
+
+class Dates(BaseModel):
+    """Time-window machinery for training/inference batches
+    (reference dataclasses.py:69-187).
+
+    ``daily_time_range`` spans the configured experiment period; a *batch* window is
+    either a random ``rho``-day slice (training, :meth:`calculate_time_period`) or an
+    explicit chunk (sequential inference, :meth:`set_date_range`). ``numerical_time_range``
+    holds day offsets from the 1980/01/01 store origin; ``hourly_indices`` index the
+    batch's hours inside the full hourly range.
+    """
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    start_time: str
+    end_time: str
+    rho: int | None = None
+
+    daily_time_range: Any = None
+    hourly_time_range: Any = None
+    batch_daily_time_range: Any = None
+    batch_hourly_time_range: Any = None
+    daily_indices: Any = None
+    hourly_indices: Any = None
+    numerical_time_range: Any = None
+
+    def model_post_init(self, __context: Any) -> None:
+        self.daily_time_range = pd.date_range(
+            datetime.strptime(self.start_time, DAILY_FORMAT),
+            datetime.strptime(self.end_time, DAILY_FORMAT),
+            freq="D",
+            inclusive="both",
+        )
+        if self.rho is not None and self.rho > len(self.daily_time_range):
+            raise ValueError("rho must be smaller than the routed period between start and end times")
+        self.hourly_time_range = pd.date_range(
+            start=self.daily_time_range[0], end=self.daily_time_range[-1], freq="h", inclusive="left"
+        )
+        self.set_batch_time(self.daily_time_range)
+
+    def set_batch_time(self, daily_time_range: pd.DatetimeIndex) -> None:
+        self.batch_daily_time_range = daily_time_range
+        self.batch_hourly_time_range = pd.date_range(
+            start=daily_time_range[0], end=daily_time_range[-1], freq="h", inclusive="left"
+        )
+        origin = datetime.strptime(ORIGIN_START_DATE, DAILY_FORMAT)
+        d0 = int((daily_time_range[0].to_pydatetime() - origin).total_seconds() // 86400)
+        d1 = int((daily_time_range[-1].to_pydatetime() - origin).total_seconds() // 86400)
+        self.numerical_time_range = np.arange(d0, d1 + 1)
+        self.daily_indices = self.daily_time_range.get_indexer(self.batch_daily_time_range)
+        self.daily_indices = self.daily_indices[self.daily_indices >= 0]
+        self.hourly_indices = self.hourly_time_range.get_indexer(self.batch_hourly_time_range)
+        self.hourly_indices = self.hourly_indices[self.hourly_indices >= 0]
+
+    def calculate_time_period(self, rng: np.random.Generator | None = None) -> None:
+        """Pick a random rho-day batch window (training; reference dataclasses.py:160-167)."""
+        if self.rho is None:
+            return
+        rng = rng or np.random.default_rng()
+        start = int(rng.integers(0, len(self.daily_time_range) - self.rho))
+        self.set_batch_time(self.daily_time_range[start : start + self.rho])
+
+    def set_date_range(self, chunk: np.ndarray) -> None:
+        """Select an explicit daily chunk (sequential inference; reference :169-178)."""
+        self.set_batch_time(self.daily_time_range[chunk])
+
+    def create_time_windows(self) -> np.ndarray:
+        """Sequential rho-sized day-index windows for chunked inference (reference :180-187)."""
+        if self.rho is None:
+            raise ValueError("rho must be set to create time windows")
+        num = len(self.daily_time_range) // self.rho
+        return np.arange(num * self.rho).reshape(num, self.rho)
+
+
+@dataclasses.dataclass
+class RoutingData:
+    """One routing problem: the contract between data layer and engine
+    (reference ``RoutingDataclass``, dataclasses.py:190-266).
+
+    ``adjacency_rows/cols`` replace the torch sparse CSR matrix with the raw COO arrays
+    (the engine builds its static level schedule from them); everything else matches the
+    reference field-for-field. N = active segments in this batch's compressed subgraph.
+    """
+
+    n_segments: int = 0
+    adjacency_rows: np.ndarray | None = None  # (E,) downstream index per edge
+    adjacency_cols: np.ndarray | None = None  # (E,) upstream index per edge
+    spatial_attributes: np.ndarray | None = None  # (num_attrs, N) raw
+    normalized_spatial_attributes: np.ndarray | None = None  # (N, num_attrs) KAN input
+    length: np.ndarray | None = None  # (N,) meters
+    slope: np.ndarray | None = None  # (N,) m/m
+    side_slope: np.ndarray | None = None  # (N,) observed z, or None (MERIT)
+    top_width: np.ndarray | None = None  # (N,) observed bankfull width, or None
+    x: np.ndarray | None = None  # (N,) Muskingum storage weight
+    dates: Dates | None = None
+    observations: Any = None  # ObservationSet (io.obs) or None
+    divide_ids: np.ndarray | None = None  # (N,) dataset ids in compressed order
+    outflow_idx: list[np.ndarray] | None = None  # ragged per-gage inflow columns
+    gage_catchment: list[str] | None = None  # matched gage STAIDs
+    flow_scale: np.ndarray | None = None  # (N,) partial-drainage-area correction

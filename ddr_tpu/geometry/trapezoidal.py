@@ -1,0 +1,67 @@
+"""Trapezoidal channel geometry as a pure JAX function.
+
+Same physics as the reference's ``compute_trapezoidal_geometry``
+(/root/reference/src/ddr/geometry/trapezoidal.py:14-108): invert Manning's equation for
+depth given Leopold & Maddock width parameters, then derive the full cross-section.
+Written jnp-elementwise so XLA fuses it straight into the routing scan body.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["trapezoidal_geometry"]
+
+
+def trapezoidal_geometry(
+    n: jnp.ndarray,
+    p_spatial: jnp.ndarray,
+    q_spatial: jnp.ndarray,
+    discharge: jnp.ndarray,
+    slope: jnp.ndarray,
+    depth_lb: float = 0.01,
+    bottom_width_lb: float = 0.01,
+) -> dict[str, jnp.ndarray]:
+    """Derive trapezoidal cross-section properties from learned channel parameters.
+
+    Parameters are per-reach ``(N,)`` arrays: Manning's roughness ``n``, Leopold &
+    Maddock width coefficient ``p`` and width-depth exponent ``q`` (0 = rectangular,
+    1 = triangular), representative ``discharge`` (m^3/s) and bed ``slope`` (m/m).
+
+    Returns a dict with ``depth``, ``top_width``, ``bottom_width``, ``side_slope``,
+    ``cross_sectional_area``, ``wetted_perimeter``, ``hydraulic_radius``, ``velocity``.
+    """
+    q_eps = q_spatial + 1e-6
+
+    # Manning's equation inverted for depth of a wide trapezoid:
+    # Q = (1/n) A R^(2/3) S^(1/2) with the power-law width closure.
+    numerator = discharge * n * (q_eps + 1.0)
+    denominator = p_spatial * jnp.sqrt(slope)
+    depth = jnp.maximum(
+        jnp.power(numerator / (denominator + 1e-8), 3.0 / (5.0 + 3.0 * q_eps)),
+        depth_lb,
+    )
+
+    # Leopold & Maddock power law: top width = p * depth^q.
+    top_width = p_spatial * jnp.power(depth, q_eps)
+
+    # Side slope z (horizontal:vertical), kept in a physically plausible band.
+    side_slope = jnp.clip(top_width * q_eps / (2.0 * depth), 0.5, 50.0)
+
+    bottom_width = jnp.maximum(top_width - 2.0 * side_slope * depth, bottom_width_lb)
+
+    area = (top_width + bottom_width) * depth / 2.0
+    wetted_perimeter = bottom_width + 2.0 * depth * jnp.sqrt(1.0 + side_slope**2)
+    hydraulic_radius = area / wetted_perimeter
+    velocity = (1.0 / n) * jnp.power(hydraulic_radius, 2.0 / 3.0) * jnp.sqrt(slope)
+
+    return {
+        "depth": depth,
+        "top_width": top_width,
+        "bottom_width": bottom_width,
+        "side_slope": side_slope,
+        "cross_sectional_area": area,
+        "wetted_perimeter": wetted_perimeter,
+        "hydraulic_radius": hydraulic_radius,
+        "velocity": velocity,
+    }

@@ -1,0 +1,20 @@
+"""Small tensor utilities (reference /root/reference/src/ddr/io/functions.py:7-23)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["downsample"]
+
+
+def downsample(data: jnp.ndarray, rho: int) -> jnp.ndarray:
+    """Downsample hourly series (G, T) to ``rho`` bins by block mean.
+
+    The reference uses ``F.interpolate(mode="area")``; for T divisible by rho (the only
+    case the pipeline produces — trims always leave whole days) area interpolation is
+    exactly the per-block mean, which is what XLA fuses best.
+    """
+    g, t = data.shape
+    if t % rho != 0:
+        raise ValueError(f"series length {t} not divisible into {rho} bins")
+    return data.reshape(g, rho, t // rho).mean(axis=-1)

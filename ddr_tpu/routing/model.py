@@ -1,0 +1,159 @@
+"""High-level routing model wrapper (the reference's ``dmc`` nn.Module facade,
+/root/reference/src/ddr/routing/torch_mc.py:18-339, re-thought functionally).
+
+The wrapper owns nothing learnable: it converts a host-side :class:`RoutingData` batch
+into the static/jit-ready pieces (network, channel state, gauge index), denormalizes
+KAN outputs to physical parameters, runs the jitted scan, and carries discharge state
+across sequential batches. All numerics live in :mod:`ddr_tpu.routing.mc`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.geodatazoo.dataclasses import RoutingData
+from ddr_tpu.routing.mc import (
+    Bounds,
+    ChannelState,
+    GaugeIndex,
+    RouteResult,
+    denormalize,
+    route,
+)
+from ddr_tpu.routing.network import RiverNetwork, build_network
+
+__all__ = ["dmc", "prepare_batch", "denormalize_spatial_parameters"]
+
+
+def prepare_batch(
+    rd: RoutingData, slope_min: float
+) -> tuple[RiverNetwork, ChannelState, GaugeIndex | None]:
+    """RoutingData -> (static network, channel state, gauge aggregation).
+
+    Mirrors ``MuskingumCunge._set_network_context``
+    (/root/reference/src/ddr/routing/mmc.py:271-304): slope clamped to its minimum,
+    observed top-width/side-slope carried for data override when present.
+    """
+    network = build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
+
+    def _opt(a):
+        if a is None or np.asarray(a).size == 0:
+            return None
+        return jnp.asarray(a, jnp.float32)
+
+    channels = ChannelState(
+        length=jnp.asarray(rd.length, jnp.float32),
+        slope=jnp.maximum(jnp.asarray(rd.slope, jnp.float32), slope_min),
+        x_storage=jnp.asarray(rd.x, jnp.float32),
+        top_width_data=_opt(rd.top_width),
+        side_slope_data=_opt(rd.side_slope),
+    )
+    gauges = None
+    if rd.outflow_idx is not None and len(rd.outflow_idx) != rd.n_segments:
+        gauges = GaugeIndex.from_ragged(rd.outflow_idx)
+    return network, channels, gauges
+
+
+def denormalize_spatial_parameters(
+    raw: dict[str, jnp.ndarray],
+    parameter_ranges: dict[str, list[float]],
+    log_space_parameters: list[str],
+    defaults: dict[str, float],
+    n_segments: int,
+) -> dict[str, jnp.ndarray]:
+    """Sigmoid [0,1] KAN outputs -> physical parameters
+    (/root/reference/src/ddr/routing/mmc.py:306-328). ``p_spatial`` falls back to its
+    config default when not learned."""
+    out = {
+        "n": denormalize(raw["n"], tuple(parameter_ranges["n"]), "n" in log_space_parameters),
+        "q_spatial": denormalize(
+            raw["q_spatial"],
+            tuple(parameter_ranges["q_spatial"]),
+            "q_spatial" in log_space_parameters,
+        ),
+    }
+    if "p_spatial" in raw and "p_spatial" in parameter_ranges:
+        out["p_spatial"] = denormalize(
+            raw["p_spatial"],
+            tuple(parameter_ranges["p_spatial"]),
+            "p_spatial" in log_space_parameters,
+        )
+    else:
+        out["p_spatial"] = jnp.full((n_segments,), float(defaults["p_spatial"]), jnp.float32)
+    return out
+
+
+class dmc:
+    """Routing model facade with reference-compatible call semantics.
+
+    ``forward(routing_dataclass, streamflow, spatial_parameters, carry_state)`` returns
+    ``{"runoff": (G, T)}`` like the reference wrapper
+    (/root/reference/src/ddr/routing/torch_mc.py:144-223), carrying ``_discharge_t``
+    between sequential batches when ``carry_state=True``.
+    """
+
+    def __init__(self, cfg: Any, device: str | None = None) -> None:
+        self.cfg = cfg
+        self.device = device or getattr(cfg, "device", "tpu")
+        mins = cfg.params.attribute_minimums
+        self.bounds = Bounds.from_config(mins)
+        self.parameter_ranges = cfg.params.parameter_ranges
+        self.log_space_parameters = cfg.params.log_space_parameters
+        self.defaults = cfg.params.defaults
+        self._discharge_t: jnp.ndarray | None = None
+        self.epoch = 0
+        self.mini_batch = 0
+        # Populated by forward() for diagnostics/logging parity (train.py:120-133).
+        self.n: jnp.ndarray | None = None
+        self.q_spatial: jnp.ndarray | None = None
+        self.p_spatial: jnp.ndarray | None = None
+
+    def set_progress_info(self, epoch: int, mini_batch: int) -> None:
+        self.epoch = epoch
+        self.mini_batch = mini_batch
+
+    def forward(
+        self,
+        routing_dataclass: RoutingData,
+        streamflow: jnp.ndarray,
+        spatial_parameters: dict[str, jnp.ndarray],
+        carry_state: bool = False,
+    ) -> dict[str, jnp.ndarray]:
+        rd = routing_dataclass
+        network, channels, gauges = prepare_batch(
+            rd, slope_min=self.cfg.params.attribute_minimums["slope"]
+        )
+        params = denormalize_spatial_parameters(
+            spatial_parameters,
+            self.parameter_ranges,
+            self.log_space_parameters,
+            self.defaults,
+            rd.n_segments,
+        )
+        self.n, self.q_spatial, self.p_spatial = params["n"], params["q_spatial"], params["p_spatial"]
+
+        if isinstance(streamflow, np.ndarray) and np.isnan(streamflow).any():
+            # Host-side guard mirroring the reference's q_prime NaN assert
+            # (/root/reference/src/ddr/routing/mmc.py:335).
+            raise ValueError("q_prime has NaN flows")
+        q_prime = jnp.asarray(streamflow, jnp.float32)
+        if rd.flow_scale is not None:
+            q_prime = q_prime * jnp.asarray(rd.flow_scale, jnp.float32)[None, :]
+
+        q_init = self._discharge_t if (carry_state and self._discharge_t is not None) else None
+        result: RouteResult = route(
+            network,
+            channels,
+            params,
+            q_prime,
+            q_init=q_init,
+            gauges=gauges,
+            bounds=self.bounds,
+        )
+        self._discharge_t = result.final_discharge
+        return {"runoff": result.runoff.T}
+
+    __call__ = forward

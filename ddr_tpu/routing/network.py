@@ -108,9 +108,13 @@ def compute_levels(rows: np.ndarray, cols: np.ndarray, n: int) -> np.ndarray:
         ends = src_starts[frontier + 1]
         nz = ends > starts
         flat = _ranges(starts[nz], ends[nz])
-        if flat.size:
-            remaining -= np.bincount(e_tgt[flat], minlength=n)
-        frontier = np.flatnonzero((remaining == 0) & ~assigned)
+        if flat.size == 0:
+            break
+        # Only nodes decremented this round can become ready: O(E) total across all
+        # rounds instead of O(n * depth).
+        np.subtract.at(remaining, e_tgt[flat], 1)
+        cand = np.unique(e_tgt[flat])
+        frontier = cand[(remaining[cand] == 0) & ~assigned[cand]]
         lvl += 1
     if n_done < n:
         raise ValueError(f"adjacency contains a cycle: {n - n_done} nodes unreachable")

@@ -1,0 +1,44 @@
+"""Shared script utilities (reference /root/reference/src/ddr/scripts_utils.py).
+
+``compute_daily_runoff`` applies the tau-dependent boundary trim
+(/root/reference/src/ddr/scripts_utils.py:18-42): start ``13 + tau`` hours (spin-up +
+timezone offset), end ``-11 + tau``. For a D-day hourly window this leaves exactly
+``24 * (D - 1)`` hours, so the daily means align with observation days ``1..D-1``
+(the reference's adaptive-area interpolation reduces to an exact block mean here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddr_tpu.io.functions import downsample
+
+__all__ = ["compute_daily_runoff", "resolve_learning_rate", "safe_percentile", "safe_mean"]
+
+
+def compute_daily_runoff(hourly_predictions, tau: int) -> np.ndarray:
+    """(G, T_hours) hourly discharge -> (G, num_days) daily, tau-trimmed."""
+    sliced = hourly_predictions[:, (13 + tau) : (-11 + tau)]
+    num_days = sliced.shape[1] // 24
+    sliced = sliced[:, : num_days * 24]
+    return np.asarray(downsample(sliced, rho=num_days))
+
+
+def resolve_learning_rate(schedule: dict[int, float], epoch: int) -> float:
+    """Latest scheduled LR at or before ``epoch``
+    (/root/reference/src/ddr/scripts_utils.py:76-97)."""
+    applicable = [e for e in schedule if e <= epoch]
+    if not applicable:
+        return schedule[min(schedule)]
+    return schedule[max(applicable)]
+
+
+def safe_percentile(values: np.ndarray, q: float) -> float:
+    """NaN-safe percentile; NaN when empty (/root/reference/src/ddr/scripts_utils.py:100-137)."""
+    finite = np.asarray(values)[np.isfinite(np.asarray(values))]
+    return float(np.percentile(finite, q)) if finite.size else float("nan")
+
+
+def safe_mean(values: np.ndarray) -> float:
+    finite = np.asarray(values)[np.isfinite(np.asarray(values))]
+    return float(finite.mean()) if finite.size else float("nan")

@@ -1,0 +1,32 @@
+"""Mode / geodataset enums and the dataset factory
+(reference: /root/reference/src/ddr/validation/enums.py:9-32)."""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class Mode(str, Enum):
+    training = "training"
+    testing = "testing"
+    routing = "routing"
+
+
+class GeoDataset(str, Enum):
+    merit = "merit"
+    lynker_hydrofabric = "lynker_hydrofabric"
+    synthetic = "synthetic"  # in-memory fixture dataset, no external data needed
+
+    def get_dataset_class(self, cfg):
+        """Factory mapping enum -> dataset class (lazy imports keep deps optional)."""
+        if self is GeoDataset.merit:
+            from ddr_tpu.geodatazoo.merit import Merit
+
+            return Merit(cfg)
+        if self is GeoDataset.lynker_hydrofabric:
+            from ddr_tpu.geodatazoo.lynker import LynkerHydrofabric
+
+            return LynkerHydrofabric(cfg)
+        from ddr_tpu.geodatazoo.synthetic import Synthetic
+
+        return Synthetic(cfg)

@@ -1,0 +1,135 @@
+"""Evaluation metrics battery.
+
+Same metric set and definitions as the reference
+(/root/reference/src/ddr/validation/metrics.py:11-256): bias, MAE, RMSE, ubRMSE,
+FDC-RMSE, Pearson/Spearman correlation, R^2, NSE, FLV/FHV (% bias over the sorted
+bottom-30% / top-2% flows), PBias (+mid), KGE and KGE', and low/mid/high RMSE splits.
+Computed per gauge over the time axis with NaN-aware masking; NaN predictions raise
+(gradient-chain guard, reference metrics.py:113-122).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+from scipy import stats
+
+__all__ = ["Metrics"]
+
+
+def _rmse(pred, target, axis=1):
+    return np.sqrt(np.nanmean((pred - target) ** 2, axis=axis))
+
+
+def _p_bias(pred, target):
+    denom = np.sum(target)
+    if denom == 0:
+        return np.nan
+    return np.sum(pred - target) / denom * 100.0
+
+
+@dataclasses.dataclass
+class Metrics:
+    """Per-gauge metrics over (n_gauges, n_time) prediction/target arrays."""
+
+    pred: np.ndarray
+    target: np.ndarray
+
+    def __post_init__(self) -> None:
+        self.pred = np.atleast_2d(np.asarray(self.pred, dtype=np.float64))
+        self.target = np.atleast_2d(np.asarray(self.target, dtype=np.float64))
+        if np.isnan(self.pred).any():
+            raise ValueError("pred contains NaN, check your gradient chain")
+        if self.pred.shape != self.target.shape:
+            raise ValueError(f"shape mismatch {self.pred.shape} vs {self.target.shape}")
+        self._compute()
+
+    @property
+    def ngrid(self) -> int:
+        return self.pred.shape[0]
+
+    @property
+    def nt(self) -> int:
+        return self.pred.shape[1]
+
+    def _fdc(self, data: np.ndarray) -> np.ndarray:
+        """100-point flow duration curve per gauge (exceedance-sorted)."""
+        out = np.full((self.ngrid, 100), np.nan)
+        for i in range(self.ngrid):
+            valid = data[i][~np.isnan(data[i])]
+            if valid.size == 0:
+                valid = np.zeros(self.nt)
+            srt = np.sort(valid)[::-1]
+            idx = (np.arange(100) / 100 * valid.size).astype(int)
+            out[i] = srt[idx]
+        return out
+
+    def _compute(self) -> None:
+        g = self.ngrid
+        self.bias = np.nanmean(self.pred - self.target, axis=1)
+        self.rmse = _rmse(self.pred, self.target)
+        self.mae = np.nanmean(np.abs(self.pred - self.target), axis=1)
+
+        pred_anom = self.pred - np.nanmean(self.pred, axis=1, keepdims=True)
+        target_anom = self.target - np.nanmean(self.target, axis=1, keepdims=True)
+        self.ub_rmse = _rmse(pred_anom, target_anom)
+        self.fdc_rmse = _rmse(self._fdc(self.pred), self._fdc(self.target))
+
+        names = (
+            "corr corr_spearman r2 nse flv fhv pbias pbias_mid kge kge_12 "
+            "rmse_low rmse_high rmse_mid"
+        ).split()
+        for nm in names:
+            setattr(self, nm, np.full(g, np.nan))
+
+        for i in range(g):
+            mask = ~np.isnan(self.pred[i]) & ~np.isnan(self.target[i])
+            if not mask.any():
+                continue
+            pred = self.pred[i][mask]
+            target = self.target[i][mask]
+
+            ps, ts = np.sort(pred), np.sort(target)
+            i_lo = round(0.3 * ps.size)
+            i_hi = round(0.98 * ps.size)
+            self.pbias[i] = _p_bias(pred, target)
+            self.flv[i] = _p_bias(ps[:i_lo], ts[:i_lo])
+            self.fhv[i] = _p_bias(ps[i_hi:], ts[i_hi:])
+            self.pbias_mid[i] = _p_bias(ps[i_lo:i_hi], ts[i_lo:i_hi])
+            self.rmse_low[i] = _rmse(ps[:i_lo], ts[:i_lo], axis=0)
+            self.rmse_high[i] = _rmse(ps[i_hi:], ts[i_hi:], axis=0)
+            self.rmse_mid[i] = _rmse(ps[i_lo:i_hi], ts[i_lo:i_hi], axis=0)
+
+            if mask.sum() > 1:
+                self.corr[i] = stats.pearsonr(pred, target)[0]
+                self.corr_spearman[i] = stats.spearmanr(pred, target)[0]
+                pm, tm = pred.mean(), target.mean()
+                psd, tsd = pred.std(), target.std()
+                r = self.corr[i]
+                if tsd > 0 and tm != 0:
+                    self.kge[i] = 1 - np.sqrt(
+                        (r - 1) ** 2 + (psd / tsd - 1) ** 2 + (pm / tm - 1) ** 2
+                    )
+                    if pm != 0:
+                        self.kge_12[i] = 1 - np.sqrt(
+                            (r - 1) ** 2
+                            + ((psd * tm) / (tsd * pm) - 1) ** 2
+                            + (pm / tm - 1) ** 2
+                        )
+                sst = np.sum((target - tm) ** 2)
+                ssres = np.sum((target - pred) ** 2)
+                if sst > 0:
+                    self.nse[i] = 1 - ssres / sst
+                    self.r2[i] = self.nse[i]
+
+    def model_dump_json(self, indent: int | None = None) -> str:
+        """Serialize all metric arrays (not pred/target) to JSON."""
+        skip = {"pred", "target"}
+        payload = {
+            k: np.asarray(v).tolist()
+            for k, v in vars(self).items()
+            if k not in skip and isinstance(v, np.ndarray)
+        }
+        return json.dumps(payload, indent=indent)

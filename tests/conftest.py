@@ -7,10 +7,17 @@ without TPU hardware (the analog of the reference's CPU-only CI,
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize imports jax and exports JAX_PLATFORMS=axon (the TPU
+# tunnel) at interpreter startup, so env vars alone are too late; the backend is
+# still uninitialized here, so jax.config.update takes effect.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

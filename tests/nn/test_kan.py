@@ -1,0 +1,82 @@
+"""KAN network contract tests (I/O shape, [0,1] range, gradients, spline math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.nn.kan import Kan, KANLayer, bspline_basis
+
+
+def _make(n_attrs=10, n_params=2, **kw):
+    model = Kan(
+        input_var_names=tuple(f"a{i}" for i in range(n_attrs)),
+        learnable_parameters=("n", "q_spatial")[:n_params],
+        **kw,
+    )
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(100, n_attrs)), jnp.float32)
+    params = model.init(jax.random.key(0), x)
+    return model, params, x
+
+
+class TestBSpline:
+    def test_partition_of_unity(self):
+        """Inside the base interval, order-k B-splines sum to 1."""
+        k, g = 3, 5
+        h = 2.0 / g
+        knots = jnp.arange(-k, g + k + 1, dtype=jnp.float32) * h - 1.0
+        x = jnp.linspace(-0.99, 0.98, 50)[:, None]
+        basis = bspline_basis(x, knots, k)
+        np.testing.assert_allclose(np.asarray(basis.sum(-1)), np.ones((50, 1)), rtol=1e-5)
+
+    def test_locality(self):
+        """Each basis function is nonzero on at most k+1 knot intervals."""
+        k, g = 3, 5
+        h = 2.0 / g
+        knots = jnp.arange(-k, g + k + 1, dtype=jnp.float32) * h - 1.0
+        basis = bspline_basis(jnp.array([[-0.95]]), knots, k)
+        assert (np.asarray(basis) > 1e-8).sum() <= k + 1
+
+
+class TestKan:
+    def test_output_contract(self):
+        model, params, x = _make()
+        out = model.apply(params, x)
+        assert set(out) == {"n", "q_spatial"}
+        for v in out.values():
+            assert v.shape == (100,)
+            a = np.asarray(v)
+            assert (a >= 0).all() and (a <= 1).all()
+
+    def test_deterministic_seeding(self):
+        model, _, x = _make()
+        p1 = model.init(jax.random.key(7), x)
+        p2 = model.init(jax.random.key(7), x)
+        chex_equal = jax.tree_util.tree_all(
+            jax.tree_util.tree_map(lambda a, b: bool(jnp.array_equal(a, b)), p1, p2)
+        )
+        assert chex_equal
+
+    def test_gradients_reach_all_params(self):
+        model, params, x = _make(num_hidden_layers=2)
+
+        def loss(p):
+            out = model.apply(p, x)
+            return jnp.mean(out["n"] ** 2) + jnp.mean(out["q_spatial"])
+
+        g = jax.grad(loss)(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves
+        assert all(np.isfinite(np.asarray(leaf)).all() for leaf in leaves)
+        nonzero = [float(jnp.abs(leaf).sum()) > 0 for leaf in leaves]
+        assert all(nonzero), "some parameter received no gradient"
+
+    def test_spline_actually_contributes(self):
+        layer = KANLayer(features=4)
+        x = jnp.asarray(np.random.default_rng(1).uniform(-0.9, 0.9, (20, 3)), jnp.float32)
+        p = layer.init(jax.random.key(0), x)
+        full = layer.apply(p, x)
+        p_zero = jax.tree_util.tree_map(lambda a: a, p)
+        p_zero = {"params": dict(p_zero["params"])}
+        p_zero["params"]["spline_coef"] = jnp.zeros_like(p["params"]["spline_coef"])
+        base_only = layer.apply(p_zero, x)
+        assert float(jnp.abs(full - base_only).max()) > 1e-4
