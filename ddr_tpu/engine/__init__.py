@@ -1,0 +1,28 @@
+"""ddr-engine equivalent: offline preprocessing that builds the binsparse zarr stores
+(reference workspace package ``ddr-engine``, /root/reference/engine/)."""
+
+from ddr_tpu.engine.core import (
+    LynkerOrderConverter,
+    MeritOrderConverter,
+    OrderConverter,
+    coo_from_zarr,
+    coo_from_zarr_group,
+    coo_to_zarr,
+    coo_to_zarr_group,
+    get_converter,
+    list_geodatasets,
+    register_converter,
+)
+
+__all__ = [
+    "LynkerOrderConverter",
+    "MeritOrderConverter",
+    "OrderConverter",
+    "coo_from_zarr",
+    "coo_from_zarr_group",
+    "coo_to_zarr",
+    "coo_to_zarr_group",
+    "get_converter",
+    "list_geodatasets",
+    "register_converter",
+]

@@ -1,0 +1,117 @@
+"""Binsparse COO engine-core tests (reference tests/engine parity)."""
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from ddr_tpu.engine.core import (
+    LynkerOrderConverter,
+    MeritOrderConverter,
+    coo_from_zarr,
+    coo_from_zarr_group,
+    coo_to_zarr,
+    coo_to_zarr_group,
+    get_converter,
+    list_geodatasets,
+    register_converter,
+)
+from ddr_tpu.io import zarrlite
+
+
+def _chain_coo(n=5):
+    rows = np.arange(1, n)
+    cols = np.arange(0, n - 1)
+    return sparse.coo_matrix((np.ones(n - 1, dtype=np.uint8), (rows, cols)), shape=(n, n))
+
+
+def test_merit_roundtrip(tmp_path):
+    coo = _chain_coo()
+    comids = [71000001, 71000002, 71000003, 71000004, 71000005]
+    coo_to_zarr(coo, comids, tmp_path / "adj.zarr", "merit")
+    coo2, order = coo_from_zarr(tmp_path / "adj.zarr")
+    assert order == comids
+    np.testing.assert_array_equal(coo2.toarray(), coo.toarray())
+
+
+def test_lynker_roundtrip(tmp_path):
+    coo = _chain_coo(3)
+    wb = ["wb-10", "wb-22", "wb-31"]
+    coo_to_zarr(coo, wb, tmp_path / "adj.zarr", "lynker")
+    coo2, order = coo_from_zarr(tmp_path / "adj.zarr")
+    assert order == wb
+    np.testing.assert_array_equal(coo2.row, coo.row)
+
+
+def test_hydrofabric_alias():
+    assert isinstance(get_converter("hydrofabric_v2.2"), LynkerOrderConverter)
+    assert isinstance(get_converter("merit"), MeritOrderConverter)
+    assert "lynker" in list_geodatasets()
+
+
+def test_lynker_converter_rejects_bad_ids():
+    with pytest.raises(ValueError):
+        LynkerOrderConverter().to_zarr(["no_dash_id"])
+
+
+def test_unknown_geodataset_raises():
+    with pytest.raises(ValueError, match="unknown geodataset"):
+        get_converter("nope")
+
+
+def test_register_converter():
+    class Custom:
+        def to_zarr(self, ids):
+            return np.asarray(ids, dtype=np.int32) * 2
+
+        def from_zarr(self, order):
+            return [int(v) // 2 for v in order]
+
+    register_converter("custom_test", Custom())
+    conv = get_converter("custom_test")
+    assert conv.from_zarr(conv.to_zarr([1, 2])) == [1, 2]
+
+
+def test_gauge_subset_groups(tmp_path):
+    root = zarrlite.create_group(tmp_path / "gages.zarr")
+    coo = _chain_coo(4)
+    coo_to_zarr_group(
+        root, "01234567", coo, [5, 6, 7, 8], "merit", gage_catchment=8, gage_idx=42
+    )
+    root2 = zarrlite.open_group(tmp_path / "gages.zarr")
+    sub = root2["01234567"]
+    assert sub.attrs["gage_catchment"] == 8
+    assert sub.attrs["gage_idx"] == 42
+    coo2, order = coo_from_zarr_group(sub)
+    assert order == [5, 6, 7, 8]
+    assert coo2.shape == (4, 4)
+    assert sub.attrs["format"] == "COO"
+    assert sub.attrs["data_types"]["values"] == "uint8"
+
+
+def test_missing_geodataset_metadata_raises(tmp_path):
+    root = zarrlite.create_group(tmp_path / "x.zarr")
+    coo = _chain_coo(3)
+    root.create_array("indices_0", coo.row.astype(np.int32))
+    root.create_array("indices_1", coo.col.astype(np.int32))
+    root.create_array("values", coo.data.astype(np.uint8))
+    root.create_array("order", np.arange(3, dtype=np.int32))
+    root.attrs.update({"format": "COO", "shape": [3, 3]})
+    with pytest.raises(ValueError, match="geodataset"):
+        coo_from_zarr(tmp_path / "x.zarr")
+
+
+def test_lynker_converter_ghost_and_float_ids():
+    """Reference accepts 'ghost-N' terminals and float-formatted ids (converters.py:61-117)."""
+    conv = LynkerOrderConverter()
+    np.testing.assert_array_equal(
+        conv.to_zarr(["wb-123", "ghost-0", "wb-45.0"]), np.array([123, 0, 45], dtype=np.int32)
+    )
+    assert conv.from_zarr(np.array([123, 0], dtype=np.int32)) == ["wb-123", "wb-0"]
+
+
+def test_empty_adjacency_roundtrip(tmp_path):
+    """A headwater-only subset (no edges) must round-trip."""
+    coo = sparse.coo_matrix((1, 1), dtype=np.uint8)
+    coo_to_zarr(coo, [42], tmp_path / "e.zarr", "merit")
+    coo2, order = coo_from_zarr(tmp_path / "e.zarr")
+    assert order == [42] and coo2.nnz == 0 and coo2.shape == (1, 1)

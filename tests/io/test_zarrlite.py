@@ -1,0 +1,111 @@
+"""zarrlite round-trip + zarr v3 on-disk format conformance tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.io import zarrlite
+
+
+def test_array_roundtrip_dtypes(tmp_path):
+    g = zarrlite.create_group(tmp_path / "store.zarr")
+    rng = np.random.default_rng(0)
+    cases = {
+        "i32": rng.integers(-1000, 1000, 257).astype(np.int32),
+        "i64": rng.integers(-(2**40), 2**40, 64),
+        "u8": rng.integers(0, 255, 100).astype(np.uint8),
+        "f32": rng.normal(size=(33, 7)).astype(np.float32),
+        "f64": rng.normal(size=500),
+        "bool": rng.random(77) > 0.5,
+    }
+    for name, data in cases.items():
+        g.create_array(name, data)
+    g2 = zarrlite.open_group(tmp_path / "store.zarr")
+    for name, data in cases.items():
+        out = g2[name].read()
+        assert out.dtype == data.dtype
+        np.testing.assert_array_equal(out, data)
+
+
+def test_multichunk_and_edge_chunks(tmp_path):
+    g = zarrlite.create_group(tmp_path / "s")
+    data = np.arange(1000, dtype=np.float32).reshape(50, 20)
+    g.create_array("x", data, chunks=(7, 9))
+    out = zarrlite.open_group(tmp_path / "s")["x"].read()
+    np.testing.assert_array_equal(out, data)
+
+
+def test_uncompressed_and_nan_fill(tmp_path):
+    g = zarrlite.create_group(tmp_path / "s")
+    data = np.array([1.0, np.nan, np.inf, -np.inf])
+    g.create_array("x", data, compress=False, fill_value=np.nan)
+    arr = zarrlite.open_group(tmp_path / "s")["x"]
+    assert np.isnan(arr.fill_value)
+    out = arr.read()
+    assert out[0] == 1.0 and np.isnan(out[1]) and np.isposinf(out[2]) and np.isneginf(out[3])
+
+
+def test_attrs_persist_and_nested_groups(tmp_path):
+    g = zarrlite.create_group(tmp_path / "s")
+    g.attrs["format"] = "COO"
+    g.attrs.update({"shape": [5, 5]})
+    sub = g.create_group("gauge_01")
+    sub.create_array("values", np.ones(3, dtype=np.uint8))
+    sub.attrs["gage_idx"] = 4
+
+    g2 = zarrlite.open_group(tmp_path / "s")
+    assert g2.attrs["format"] == "COO"
+    assert g2.attrs["shape"] == [5, 5]
+    assert "gauge_01" in g2
+    assert g2["gauge_01"].attrs["gage_idx"] == 4
+    assert dict(g2["gauge_01"].arrays())["values"].read().sum() == 3
+    assert [k for k, _ in g2.groups()] == ["gauge_01"]
+
+
+def test_on_disk_layout_is_zarr_v3(tmp_path):
+    """The written metadata documents must be valid zarr v3 core spec."""
+    g = zarrlite.create_group(tmp_path / "s")
+    g.create_array("x", np.arange(10, dtype=np.int32))
+    root_meta = json.loads((tmp_path / "s" / "zarr.json").read_text())
+    assert root_meta == {"zarr_format": 3, "node_type": "group", "attributes": {}}
+    arr_meta = json.loads((tmp_path / "s" / "x" / "zarr.json").read_text())
+    assert arr_meta["zarr_format"] == 3
+    assert arr_meta["node_type"] == "array"
+    assert arr_meta["data_type"] == "int32"
+    assert arr_meta["chunk_grid"]["name"] == "regular"
+    assert arr_meta["codecs"][0]["name"] == "bytes"
+    assert (tmp_path / "s" / "x" / "c" / "0").exists()
+
+
+def test_scalar_array(tmp_path):
+    g = zarrlite.create_group(tmp_path / "s")
+    g.create_array("v", np.float64(3.5))
+    assert zarrlite.open_group(tmp_path / "s")["v"].read() == 3.5
+
+
+def test_open_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        zarrlite.open_group(tmp_path / "nope")
+    g = zarrlite.create_group(tmp_path / "s")
+    with pytest.raises(KeyError):
+        g["missing"]
+
+
+def test_zero_length_array(tmp_path):
+    """Single-catchment gauge subsets have zero-nnz adjacencies (empty index arrays)."""
+    g = zarrlite.create_group(tmp_path / "s")
+    g.create_array("empty", np.array([], dtype=np.int32))
+    out = zarrlite.open_group(tmp_path / "s")["empty"].read()
+    assert out.shape == (0,) and out.dtype == np.int32
+
+
+def test_attrs_delete_and_pop_persist(tmp_path):
+    g = zarrlite.create_group(tmp_path / "s")
+    g.attrs["x"] = 1
+    g.attrs["y"] = 2
+    del g.attrs["x"]
+    assert g.attrs.pop("y") == 2
+    g.attrs.setdefault("z", 3)
+    g2 = zarrlite.open_group(tmp_path / "s")
+    assert dict(g2.attrs) == {"z": 3}
