@@ -152,13 +152,20 @@ def coo_from_zarr(zarr_path: Path | str) -> tuple[sparse.coo_matrix, list]:
     return coo, converter.from_zarr(order)
 
 
-def _read_coo(group: zarrlite.ZarrGroup) -> tuple[sparse.coo_matrix, np.ndarray]:
+def read_coo_arrays(group: zarrlite.ZarrGroup) -> tuple[sparse.coo_matrix, np.ndarray]:
+    """Assemble the COO matrix + raw ``order`` array from one binsparse group.
+
+    The single definition of the binsparse read convention — io.readers delegates
+    here so the on-disk format has exactly one reader and one writer."""
     shape = tuple(group.attrs["shape"])
     coo = sparse.coo_matrix(
         (group["values"].read(), (group["indices_0"].read(), group["indices_1"].read())),
         shape=shape,
     )
     return coo, group["order"].read()
+
+
+_read_coo = read_coo_arrays
 
 
 def coo_to_zarr_group(

@@ -1,0 +1,61 @@
+"""Attribute-normalization statistics with a JSON cache
+(reference /root/reference/src/ddr/io/statistics.py:14-58).
+
+``set_statistics`` takes a mapping ``{attribute_name: (N,) values}`` (the xr.Dataset
+stand-in) and computes per-attribute min/max/mean/std/p10/p90, cached to
+``{geodataset}_attribute_statistics_{store_name}.json`` under the configured
+statistics dir so repeated runs skip the store scan.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+import pandas as pd
+
+log = logging.getLogger(__name__)
+
+__all__ = ["set_statistics", "compute_statistics"]
+
+
+def compute_statistics(attrs: Mapping[str, np.ndarray]) -> dict[str, dict[str, float]]:
+    out: dict[str, dict[str, float]] = {}
+    for name, data in attrs.items():
+        data = np.asarray(data, dtype=np.float64)
+        out[name] = {
+            "min": float(np.nanmin(data)),
+            "max": float(np.nanmax(data)),
+            "mean": float(np.nanmean(data)),
+            "std": float(np.nanstd(data)),
+            "p10": float(np.nanpercentile(data, 10)),
+            "p90": float(np.nanpercentile(data, 90)),
+        }
+    return out
+
+
+def set_statistics(cfg: Any, attrs: Mapping[str, np.ndarray]) -> pd.DataFrame:
+    """Compute-or-load the per-attribute statistics table.
+
+    The cache key matches the reference (geodataset value + attributes store name),
+    so statistics computed once for a store are reused across runs and scripts.
+    """
+    attributes_name = Path(str(cfg.data_sources.attributes)).name
+    statistics_path = Path(cfg.data_sources.statistics)
+    statistics_path.mkdir(parents=True, exist_ok=True)
+    geodataset = getattr(cfg.geodataset, "value", str(cfg.geodataset))
+    stats_file = statistics_path / f"{geodataset}_attribute_statistics_{attributes_name}.json"
+
+    if stats_file.exists():
+        log.info(f"Reading Attribute Statistics from file: {stats_file.name}")
+        with open(stats_file) as f:
+            payload = json.load(f)
+    else:
+        log.info(f"Reading {geodataset} attributes to construct statistics")
+        payload = compute_statistics(attrs)
+        with open(stats_file, "w") as f:
+            json.dump(payload, f, indent=2)
+    return pd.DataFrame(payload)

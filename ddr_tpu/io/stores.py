@@ -1,0 +1,111 @@
+"""Hydrologic time-series stores on zarrlite (the icechunk/xarray replacement).
+
+The reference reads lateral inflows, observations, and attributes from icechunk/xarray
+datasets (/root/reference/src/ddr/io/readers.py:413-443,446-560). Neither library is
+available here, so this module defines the equivalent on-disk convention as plain zarr
+v3 groups (via :mod:`ddr_tpu.io.zarrlite`) and a tiny dataset façade:
+
+Group layout
+------------
+- attrs: ``start_date`` ("YYYY/MM/DD"), ``freq`` ("D" daily | "h" hourly),
+  ``ids`` (JSON list of divide/gage IDs — zarr v3 has no vlen-string arrays, and ID
+  lists are small relative to the data), optional ``id_dim`` name ("divide_id" /
+  "gage_id") and per-variable ``units``.
+- one array per data variable, shaped ``(n_ids, n_time)`` — e.g. ``Qr`` for lateral
+  inflow (m^3/s), ``streamflow`` for USGS observations (m^3/s).
+
+``s3://`` URIs are rejected with a clear error (this environment has no egress; the
+reference's anonymous-S3 path, readers.py:427-436, is out of scope by design).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from ddr_tpu.io import zarrlite
+
+__all__ = ["HydroStore", "open_hydro_store", "write_hydro_store"]
+
+ORIGIN = pd.Timestamp("1980/01/01")  # store epoch (reference dataclasses.py:74)
+
+
+class HydroStore:
+    """Read façade over one time-series group: id lookup + time alignment."""
+
+    def __init__(self, group: zarrlite.ZarrGroup) -> None:
+        self.group = group
+        self.start_date = pd.Timestamp(group.attrs["start_date"])
+        self.freq = group.attrs.get("freq", "D")
+        self.ids: list = list(group.attrs["ids"])
+        self.id_to_index = {i: k for k, i in enumerate(self.ids)}
+
+    @property
+    def is_hourly(self) -> bool:
+        return self.freq in ("h", "H")
+
+    @property
+    def time_offset_days(self) -> int:
+        """Days between the 1980/01/01 origin and the store's first record."""
+        return int((self.start_date - ORIGIN).days)
+
+    def n_time(self, var: str = "Qr") -> int:
+        return self[var].shape[1]
+
+    def __getitem__(self, var: str) -> zarrlite.ZarrArray:
+        arr = self.group[var]
+        if not isinstance(arr, zarrlite.ZarrArray):
+            raise KeyError(f"{var} is not an array variable")
+        return arr
+
+    def __contains__(self, var: str) -> bool:
+        return var in self.group
+
+    def select(self, var: str, id_rows: np.ndarray, time_cols: np.ndarray) -> np.ndarray:
+        """Fancy-select ``(rows, cols)`` out of a variable; reads then slices
+        (stores here are modest; chunk-pruned reads are a later optimization)."""
+        data = self[var].read()
+        return data[np.asarray(id_rows)[:, None], np.asarray(time_cols)[None, :]]
+
+
+def open_hydro_store(store: str | Path) -> HydroStore:
+    """Open a local hydro store. The reference accepts ``s3://`` icechunk URIs
+    (readers.py:413-443); zero-egress environments must materialize stores locally
+    first, so S3 URIs fail fast with a clear message."""
+    store = str(store)
+    if store.startswith("s3://"):
+        raise ValueError(
+            f"S3 stores are not reachable from this environment (no egress): {store}. "
+            "Materialize the store locally and point the config at the local path."
+        )
+    return HydroStore(zarrlite.open_group(store))
+
+
+def write_hydro_store(
+    path: str | Path,
+    ids: list,
+    start_date: str,
+    freq: str,
+    variables: dict[str, np.ndarray],
+    id_dim: str = "divide_id",
+    units: dict[str, str] | None = None,
+) -> HydroStore:
+    """Create a hydro store; each variable is ``(len(ids), n_time)``."""
+    group = zarrlite.create_group(path)
+    group.attrs.update(
+        {
+            "start_date": str(pd.Timestamp(start_date).strftime("%Y/%m/%d")),
+            "freq": freq,
+            "ids": list(ids),
+            "id_dim": id_dim,
+            "units": units or {},
+        }
+    )
+    for name, data in variables.items():
+        data = np.asarray(data)
+        if data.ndim != 2 or data.shape[0] != len(ids):
+            raise ValueError(f"{name}: expected ({len(ids)}, T), got {data.shape}")
+        group.create_array(name, data.astype(np.float32))
+    return HydroStore(group)

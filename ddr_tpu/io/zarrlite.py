@@ -20,6 +20,7 @@ from __future__ import annotations
 import gzip
 import json
 import math
+import shutil
 from pathlib import Path
 from typing import Any, Iterator
 
@@ -310,7 +311,27 @@ def _open_node(path: Path) -> "ZarrArray | ZarrGroup | None":
 
 
 def create_group(path: str | Path, attributes: dict | None = None) -> ZarrGroup:
+    """Create a FRESH group at ``path``.
+
+    If a zarr node already exists there, its children are removed first — rebuilding a
+    store in place must not leave stale arrays/subgroups resolvable (e.g. a dropped
+    gauge subset surviving a preprocessing re-run). A non-empty directory that is
+    *not* a zarr node is refused rather than wiped.
+    """
     path = Path(path)
+    if path.exists():
+        if (path / "zarr.json").exists():
+            for child in path.iterdir():
+                if child == path / "zarr.json":
+                    continue
+                if child.is_dir():
+                    shutil.rmtree(child)
+                else:
+                    child.unlink()
+        elif any(path.iterdir()):
+            raise FileExistsError(
+                f"{path} exists, is non-empty, and is not a zarr store; refusing to overwrite"
+            )
     path.mkdir(parents=True, exist_ok=True)
     meta = {"zarr_format": 3, "node_type": "group", "attributes": attributes or {}}
     (path / "zarr.json").write_text(json.dumps(meta, indent=2))

@@ -1,0 +1,112 @@
+"""io.builders + io.statistics tests."""
+
+import json
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from ddr_tpu.engine.core import coo_to_zarr_group
+from ddr_tpu.geodatazoo.dataclasses import Dates
+from ddr_tpu.io import zarrlite
+from ddr_tpu.io.builders import (
+    construct_network_matrix,
+    create_hydrofabric_observations,
+    upstream_closure,
+)
+from ddr_tpu.io.readers import ObservationSet
+from ddr_tpu.io.statistics import compute_statistics, set_statistics
+
+
+def _subsets(tmp_path):
+    """Two gauges over a 6-node CONUS matrix with overlapping subsets."""
+    root = zarrlite.create_group(tmp_path / "gages.zarr")
+    a = sparse.coo_matrix((np.ones(2), ([2, 4], [0, 2])), shape=(6, 6))
+    b = sparse.coo_matrix((np.ones(2), ([2, 5], [0, 2])), shape=(6, 6))
+    coo_to_zarr_group(root, "A", a, [1, 2, 3, 4, 5, 6], "merit", gage_catchment=4, gage_idx=4)
+    coo_to_zarr_group(root, "B", b, [1, 2, 3, 4, 5, 6], "merit", gage_catchment=5, gage_idx=5)
+    return zarrlite.open_group(tmp_path / "gages.zarr")
+
+
+class TestConstructNetworkMatrix:
+    def test_union_dedupes(self, tmp_path):
+        subsets = _subsets(tmp_path)
+        coo, idx, wb = construct_network_matrix(["A", "B"], subsets)
+        assert coo.shape == (6, 6)
+        edges = set(zip(coo.row.tolist(), coo.col.tolist()))
+        assert edges == {(2, 0), (4, 2), (5, 2)}  # (2,0) deduped across A and B
+        assert idx == [4, 5] and wb == [4, 5]
+
+    def test_missing_gauge_skipped(self, tmp_path):
+        subsets = _subsets(tmp_path)
+        coo, idx, _ = construct_network_matrix(["A", "ZZZ"], subsets)
+        assert idx == [4]
+
+    def test_all_missing_raises(self, tmp_path):
+        subsets = _subsets(tmp_path)
+        with pytest.raises(KeyError):
+            construct_network_matrix(["Y", "Z"], subsets)
+
+
+def test_create_hydrofabric_observations():
+    dates = Dates(start_time="1981/02/01", end_time="1981/02/10")
+    dates.set_date_range(np.arange(2, 5))
+    full = ObservationSet(
+        ["g1", "g2"], dates.daily_time_range, np.arange(20.0).reshape(2, 10)
+    )
+    out = create_hydrofabric_observations(dates, np.array(["g2"]), full)
+    np.testing.assert_allclose(out.streamflow, [[12.0, 13.0, 14.0]])
+
+
+class TestUpstreamClosure:
+    def test_y_network(self):
+        # 0,1 -> 2 -> 3; 4 isolated
+        rows = np.array([2, 2, 3])
+        cols = np.array([0, 1, 2])
+        np.testing.assert_array_equal(upstream_closure(rows, cols, 5, [3]), [0, 1, 2, 3])
+        np.testing.assert_array_equal(upstream_closure(rows, cols, 5, [2]), [0, 1, 2])
+        np.testing.assert_array_equal(upstream_closure(rows, cols, 5, [4]), [4])
+
+    def test_no_edges(self):
+        out = upstream_closure(np.array([]), np.array([]), 3, [1])
+        np.testing.assert_array_equal(out, [1])
+
+
+class _Cfg:
+    class _DS:
+        def __init__(self, attributes, statistics):
+            self.attributes = attributes
+            self.statistics = statistics
+
+    def __init__(self, attributes, statistics):
+        self.data_sources = self._DS(attributes, statistics)
+        self.geodataset = "merit"
+
+
+class TestStatistics:
+    def test_compute(self):
+        stats = compute_statistics({"a": np.array([1.0, np.nan, 3.0])})
+        assert stats["a"]["min"] == 1.0 and stats["a"]["max"] == 3.0
+        assert stats["a"]["mean"] == 2.0
+
+    def test_cache_roundtrip(self, tmp_path):
+        cfg = _Cfg(attributes="/fake/path/attrs.zarr", statistics=tmp_path)
+        attrs = {"slope": np.array([0.1, 0.2, 0.3]), "area": np.array([5.0, 10.0, 15.0])}
+        df1 = set_statistics(cfg, attrs)
+        cache = tmp_path / "merit_attribute_statistics_attrs.zarr.json"
+        assert cache.exists()
+        # Second call must read the cache, not recompute: poison the input.
+        df2 = set_statistics(cfg, {"slope": np.array([999.0]), "area": np.array([999.0])})
+        assert df1["slope"]["mean"] == df2["slope"]["mean"]
+        payload = json.loads(cache.read_text())
+        assert set(payload["slope"]) == {"min", "max", "mean", "std", "p10", "p90"}
+
+
+def test_construct_network_matrix_partial_attrs_stay_aligned(tmp_path):
+    """A subset missing gage_catchment must not shift the idx/catchment pairing."""
+    root = zarrlite.create_group(tmp_path / "g.zarr")
+    a = sparse.coo_matrix((np.ones(1), ([1], [0])), shape=(4, 4))
+    coo_to_zarr_group(root, "X", a, [1, 2, 3, 4], "merit", gage_idx=1)  # no catchment
+    coo_to_zarr_group(root, "Y", a, [1, 2, 3, 4], "merit", gage_catchment=9, gage_idx=2)
+    _, idx, wb = construct_network_matrix(["X", "Y"], zarrlite.open_group(tmp_path / "g.zarr"))
+    assert idx == [2] and wb == [9]

@@ -1,0 +1,259 @@
+"""io.readers tests (reference tests/io coverage: gage CSV, filters, flow scaling,
+streamflow/observation readers over stores built in tmp dirs)."""
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from ddr_tpu.engine.core import coo_to_zarr_group
+from ddr_tpu.geodatazoo.dataclasses import Dates
+from ddr_tpu.io import zarrlite
+from ddr_tpu.io.readers import (
+    ObservationSet,
+    StreamflowReader,
+    USGSObservationReader,
+    build_flow_scale_tensor,
+    compute_flow_scale_factor,
+    convert_ft3_s_to_m3_s,
+    fill_nans,
+    filter_gages_by_area_threshold,
+    filter_gages_by_da_valid,
+    filter_headwater_gages,
+    naninfmean,
+    read_coo,
+    read_gage_info,
+    read_zarr,
+)
+from ddr_tpu.io.stores import write_hydro_store
+
+
+@pytest.fixture
+def gage_csv(tmp_path):
+    p = tmp_path / "gages.csv"
+    p.write_text(
+        "STAID,STANAME,DRAIN_SQKM,LAT_GAGE,LNG_GAGE,ABS_DIFF,DA_VALID,COMID\n"
+        "1013500,STATION A,2252.7,47.23,-68.58,10.0,True,7100001\n"
+        "01014000,STATION B,3186.8,47.11,-68.64,80.0,False,7100002\n"
+        "01015800,STATION C,773.0,46.52,-68.37,5.0,True,7100003\n"
+    )
+    return p
+
+
+class TestGageInfo:
+    def test_read_pads_staid(self, gage_csv):
+        d = read_gage_info(gage_csv)
+        assert d["STAID"] == ["01013500", "01014000", "01015800"]
+        assert d["DRAIN_SQKM"][0] == 2252.7
+        assert d["COMID"] == [7100001, 7100002, 7100003]
+
+    def test_missing_required_column_raises(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("STAID,STANAME\n123,x\n")
+        with pytest.raises(KeyError, match="missing"):
+            read_gage_info(p)
+
+    def test_staname_backfilled_from_staid(self, tmp_path):
+        p = tmp_path / "g.csv"
+        p.write_text("STAID,DRAIN_SQKM,LAT_GAGE,LNG_GAGE\n99,1.0,0.0,0.0\n")
+        d = read_gage_info(p)
+        # Backfill happens before STAID padding (reference readers.py:125-131).
+        assert d["STANAME"] == ["99"]
+        assert d["STAID"] == ["00000099"]
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_gage_info(tmp_path / "nope.csv")
+
+
+class TestFilters:
+    def test_area_threshold(self, gage_csv):
+        d = read_gage_info(gage_csv)
+        ids = np.array(d["STAID"])
+        kept, removed = filter_gages_by_area_threshold(ids, d, threshold=50.0)
+        assert list(kept) == ["01013500", "01015800"] and removed == 1
+        with pytest.raises(KeyError):
+            filter_gages_by_area_threshold(ids, {"STAID": []}, 50.0)
+
+    def test_da_valid(self, gage_csv):
+        d = read_gage_info(gage_csv)
+        ids = np.array(d["STAID"])
+        kept, removed = filter_gages_by_da_valid(ids, d)
+        assert list(kept) == ["01013500", "01015800"] and removed == 1
+
+    def test_headwater(self, tmp_path):
+        root = zarrlite.create_group(tmp_path / "gages.zarr")
+        chain = sparse.coo_matrix(
+            (np.ones(2, dtype=np.uint8), ([1, 2], [0, 1])), shape=(3, 3)
+        )
+        empty = sparse.coo_matrix((1, 1), dtype=np.uint8)
+        coo_to_zarr_group(root, "A", chain, [1, 2, 3], "merit")
+        coo_to_zarr_group(root, "B", empty, [9], "merit")
+        ids = np.array(["A", "B", "C"])
+        kept, removed = filter_headwater_gages(ids, zarrlite.open_group(tmp_path / "gages.zarr"))
+        assert list(kept) == ["A"] and removed == 2
+
+
+class TestFlowScale:
+    def test_factor_cases(self):
+        assert compute_flow_scale_factor(100.0, 80.0, 50.0) == 1.0  # gage >= comid
+        assert compute_flow_scale_factor(np.nan, 80.0, 50.0) == 1.0
+        assert compute_flow_scale_factor(100.0, 120.0, 0.0) == 1.0
+        assert compute_flow_scale_factor(100.0, 200.0, 50.0) == 1.0  # diff >= unit area
+        np.testing.assert_allclose(compute_flow_scale_factor(100.0, 120.0, 50.0), 30.0 / 50.0)
+
+    def test_tensor_fast_path_and_fallback(self):
+        gd = {
+            "STAID": ["00000001", "00000002"],
+            "DRAIN_SQKM": [100.0, 100.0],
+            "FLOW_SCALE": [0.25, np.nan],
+        }
+        fs = build_flow_scale_tensor(["1", "2"], gd, [0, 3], 5)
+        np.testing.assert_allclose(fs, [0.25, 1, 1, 1, 1])
+
+        gd2 = {
+            "STAID": ["00000001"],
+            "DRAIN_SQKM": [100.0],
+            "COMID_DRAIN_SQKM": [120.0],
+            "COMID_UNITAREA_SQKM": [50.0],
+        }
+        fs2 = build_flow_scale_tensor(["1"], gd2, [2], 4)
+        np.testing.assert_allclose(fs2, [1, 1, 0.6, 1])
+
+    def test_tensor_graceful_skip(self):
+        fs = build_flow_scale_tensor(["1"], {"STAID": ["00000001"]}, [0], 2)
+        np.testing.assert_allclose(fs, [1, 1])
+
+
+class TestNaNUtils:
+    def test_naninfmean(self):
+        assert naninfmean(np.array([1.0, np.nan, np.inf, 3.0])) == 2.0
+        assert np.isnan(naninfmean(np.array([np.nan, np.inf])))
+
+    def test_fill_nans_global_and_rowwise(self):
+        a = np.array([[1.0, np.nan], [3.0, 5.0]])
+        np.testing.assert_allclose(fill_nans(a), [[1.0, 3.0], [3.0, 5.0]])
+        np.testing.assert_allclose(
+            fill_nans(a, row_means=np.array([10.0, 20.0])), [[1.0, 10.0], [3.0, 5.0]]
+        )
+
+    def test_units(self):
+        np.testing.assert_allclose(convert_ft3_s_to_m3_s(np.array([1.0])), [0.0283168])
+
+
+class _Cfg:
+    """Minimal config stand-in for reader construction."""
+
+    class _DS:
+        def __init__(self, streamflow=None, observations=None, gages=None, is_hourly=False):
+            self.streamflow = streamflow
+            self.observations = observations
+            self.gages = gages
+            self.is_hourly = is_hourly
+
+    def __init__(self, **kw):
+        self.data_sources = self._DS(**kw)
+
+
+class TestStreamflowReader:
+    def _dates(self):
+        return Dates(start_time="1981/02/01", end_time="1981/02/04")
+
+    def test_daily_store_repeats_24(self, tmp_path):
+        qr = np.arange(20.0).reshape(2, 10)  # 2 divides x 10 days from 1981/02/01
+        write_hydro_store(tmp_path / "qr.zarr", ids=[101, 202], start_date="1981/02/01",
+                          freq="D", variables={"Qr": qr})
+        reader = StreamflowReader(_Cfg(streamflow=tmp_path / "qr.zarr"))
+
+        class RD:
+            divide_ids = [101, 202]
+            dates = self._dates()
+
+        out = reader(routing_dataclass=RD())
+        assert out.shape == (len(RD.dates.batch_hourly_time_range), 2)
+        np.testing.assert_allclose(out[:24, 0], 0.0)  # day 0 value repeated
+        np.testing.assert_allclose(out[24:48, 0], 1.0)
+
+    def test_missing_divide_filled(self, tmp_path):
+        write_hydro_store(tmp_path / "qr.zarr", ids=[101], start_date="1981/02/01",
+                          freq="D", variables={"Qr": np.ones((1, 10))})
+        reader = StreamflowReader(_Cfg(streamflow=tmp_path / "qr.zarr"))
+
+        class RD:
+            divide_ids = [101, 999]
+            dates = self._dates()
+
+        out = reader(routing_dataclass=RD())
+        np.testing.assert_allclose(out[:, 1], 0.001)
+        np.testing.assert_allclose(out[:, 0], 1.0)
+
+    def test_hourly_store_direct(self, tmp_path):
+        T = 10 * 24
+        qr = np.tile(np.arange(T, dtype=float), (1, 1))
+        write_hydro_store(tmp_path / "qr.zarr", ids=[7], start_date="1981/02/01",
+                          freq="h", variables={"Qr": qr})
+        reader = StreamflowReader(_Cfg(streamflow=tmp_path / "qr.zarr"))
+
+        class RD:
+            divide_ids = [7]
+            dates = self._dates()
+
+        out = reader(routing_dataclass=RD())
+        np.testing.assert_allclose(out[:, 0], np.arange(len(RD.dates.batch_hourly_time_range)))
+
+    def test_out_of_coverage_asserts(self, tmp_path):
+        write_hydro_store(tmp_path / "qr.zarr", ids=[101], start_date="1981/03/01",
+                          freq="D", variables={"Qr": np.ones((1, 5))})
+        reader = StreamflowReader(_Cfg(streamflow=tmp_path / "qr.zarr"))
+
+        class RD:
+            divide_ids = [101]
+            dates = self._dates()  # starts 1981/02/01, before store start
+
+        with pytest.raises(AssertionError, match="negative"):
+            reader(routing_dataclass=RD())
+
+
+class TestUSGSObservationReader:
+    def test_read_data(self, tmp_path, gage_csv):
+        ids = ["01013500", "01014000", "01015800"]
+        flow = np.arange(30.0).reshape(3, 10)
+        write_hydro_store(tmp_path / "obs.zarr", ids=ids, start_date="1981/02/01",
+                          freq="D", variables={"streamflow": flow}, id_dim="gage_id")
+        cfg = _Cfg(observations=tmp_path / "obs.zarr", gages=gage_csv)
+        reader = USGSObservationReader(cfg)
+        dates = Dates(start_time="1981/02/02", end_time="1981/02/05")
+        obs = reader.read_data(dates)
+        assert isinstance(obs, ObservationSet)
+        assert obs.streamflow.shape == (3, 4)
+        np.testing.assert_allclose(obs.streamflow[0], [1, 2, 3, 4])
+
+    def test_requires_gages(self, tmp_path):
+        write_hydro_store(tmp_path / "obs.zarr", ids=["x"], start_date="1981/02/01",
+                          freq="D", variables={"streamflow": np.ones((1, 3))})
+        with pytest.raises(ValueError, match="gages"):
+            USGSObservationReader(_Cfg(observations=tmp_path / "obs.zarr"))
+
+
+def test_read_coo_and_read_zarr(tmp_path):
+    root = zarrlite.create_group(tmp_path / "g.zarr")
+    coo = sparse.coo_matrix((np.ones(1, dtype=np.uint8), ([1], [0])), shape=(2, 2))
+    coo_to_zarr_group(root, "01", coo, [5, 6], "merit", gage_idx=0)
+    loaded, grp = read_coo(tmp_path / "g.zarr", "01")
+    np.testing.assert_array_equal(loaded.toarray(), coo.toarray())
+    assert grp.attrs["gage_idx"] == 0
+    with pytest.raises(KeyError, match="Cannot find key"):
+        read_coo(tmp_path / "g.zarr", "nope")
+    with pytest.raises(FileNotFoundError):
+        read_zarr(tmp_path / "missing.zarr")
+    assert "01" in read_zarr(tmp_path / "g.zarr")
+
+
+def test_observation_reader_out_of_coverage_asserts(tmp_path, gage_csv):
+    ids = ["01013500", "01014000", "01015800"]
+    write_hydro_store(tmp_path / "obs.zarr", ids=ids, start_date="1981/02/03",
+                      freq="D", variables={"streamflow": np.ones((3, 5))}, id_dim="gage_id")
+    reader = USGSObservationReader(_Cfg(observations=tmp_path / "obs.zarr", gages=gage_csv))
+    with pytest.raises(AssertionError, match="negative"):
+        reader.read_data(Dates(start_time="1981/02/01", end_time="1981/02/04"))
+    with pytest.raises(AssertionError, match="exceeds"):
+        reader.read_data(Dates(start_time="1981/02/05", end_time="1981/02/12"))

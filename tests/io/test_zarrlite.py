@@ -109,3 +109,22 @@ def test_attrs_delete_and_pop_persist(tmp_path):
     g.attrs.setdefault("z", 3)
     g2 = zarrlite.open_group(tmp_path / "s")
     assert dict(g2.attrs) == {"z": 3}
+
+
+def test_create_group_wipes_stale_children(tmp_path):
+    """Rebuilding a store in place must not leave removed members resolvable."""
+    g = zarrlite.create_group(tmp_path / "s")
+    g.create_group("old_gauge").create_array("values", np.ones(2, dtype=np.uint8))
+    g.create_array("old_array", np.ones(3))
+    g2 = zarrlite.create_group(tmp_path / "s")
+    assert "old_gauge" not in g2 and "old_array" not in g2
+    assert list(g2.keys()) == []
+
+
+def test_create_group_refuses_non_store_dir(tmp_path):
+    d = tmp_path / "notastore"
+    d.mkdir()
+    (d / "data.txt").write_text("hello")
+    with pytest.raises(FileExistsError):
+        zarrlite.create_group(d)
+    assert (d / "data.txt").exists()
