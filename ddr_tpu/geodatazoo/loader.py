@@ -1,0 +1,62 @@
+"""Minimal batching loader — the torch ``DataLoader(RandomSampler, collate_fn)``
+replacement (reference /root/reference/scripts/train.py:41-52).
+
+Data prep is host-side NumPy and single-threaded by design (the reference also runs
+``num_workers=0``); the loader's one extra feature is deterministic, checkpointable
+shuffling: the sampling RNG is an explicit ``np.random.Generator`` whose state can be
+saved/restored for mid-epoch resume (reference validation/utils.py:12-78 saves the
+DataLoader generator state for the same reason).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import numpy as np
+
+__all__ = ["DataLoader"]
+
+
+class DataLoader:
+    """Iterate ``dataset.collate_fn`` over index batches.
+
+    Parameters mirror the reference loader: ``shuffle`` for training sampling,
+    ``batch_size`` items per step. ``rng`` drives shuffling; pass the dataset's
+    generator (or a seeded one) for reproducible epochs.
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        rng: np.random.Generator | None = None,
+        drop_last: bool = False,
+    ) -> None:
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.rng = rng or np.random.default_rng()
+        self.drop_last = drop_last
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        order = self.rng.permutation(n) if self.shuffle else np.arange(n)
+        for start in range(0, n, self.batch_size):
+            idxs = order[start : start + self.batch_size]
+            if self.drop_last and len(idxs) < self.batch_size:
+                return
+            yield self.dataset.collate_fn([self.dataset[int(i)] for i in idxs])
+
+    def state(self) -> dict:
+        """RNG state blob for mid-epoch-resumable checkpoints."""
+        return {"bit_generator": self.rng.bit_generator.state}
+
+    def set_state(self, state: dict) -> None:
+        self.rng.bit_generator.state = state["bit_generator"]

@@ -1,0 +1,35 @@
+"""MERIT-Hydro geodataset (reference /root/reference/src/ddr/geodatazoo/merit.py:37-513).
+
+MERIT conventions: integer COMID divide ids; flowpath properties are ``length_m`` and
+``slope`` written into the conus adjacency store by the engine builder; Muskingum
+``x`` is the constant 0.3; channel geometry (top width / side slope) comes from the
+learned Leopold & Maddock power laws rather than observed data, so those fields stay
+``None``. All shared batching/compression logic lives in :class:`BaseGeoDataset`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ddr_tpu.geodatazoo.base import BaseGeoDataset
+
+__all__ = ["Merit"]
+
+
+class Merit(BaseGeoDataset):
+    flowpath_vars = {
+        "length": "length_m",
+        "slope": "slope",
+        "top_width": None,
+        "side_slope": None,
+        "x": None,  # constant 0.3 (reference merit.py:313-315)
+    }
+    default_x = 0.3
+
+    def _attribute_key(self, divide_id: Any) -> int:
+        return int(divide_id)
+
+    def _make_divide_ids(self, order_ids: np.ndarray) -> np.ndarray:
+        return np.asarray(order_ids)

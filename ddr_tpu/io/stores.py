@@ -27,7 +27,14 @@ import pandas as pd
 
 from ddr_tpu.io import zarrlite
 
-__all__ = ["HydroStore", "open_hydro_store", "write_hydro_store"]
+__all__ = [
+    "HydroStore",
+    "open_hydro_store",
+    "write_hydro_store",
+    "AttributeStore",
+    "open_attribute_store",
+    "write_attribute_store",
+]
 
 ORIGIN = pd.Timestamp("1980/01/01")  # store epoch (reference dataclasses.py:74)
 
@@ -109,3 +116,56 @@ def write_hydro_store(
             raise ValueError(f"{name}: expected ({len(ids)}, T), got {data.shape}")
         group.create_array(name, data.astype(np.float32))
     return HydroStore(group)
+
+
+class AttributeStore:
+    """Static per-catchment attribute store (the xr attribute-Dataset stand-in).
+
+    The reference loads catchment attributes from NetCDF multifile datasets (MERIT,
+    /root/reference/src/ddr/geodatazoo/merit.py:88-90) or icechunk repos (Lynker,
+    lynker_hydrofabric.py:101-103). The equivalent on-disk convention here: a zarr
+    group whose attrs hold ``ids`` (divide/COMID list) and whose arrays are one
+    ``(n_ids,)`` vector per attribute name.
+    """
+
+    def __init__(self, group: zarrlite.ZarrGroup) -> None:
+        self.group = group
+        self.ids: list = list(group.attrs["ids"])
+        self.id_to_index = {i: k for k, i in enumerate(self.ids)}
+
+    @property
+    def attribute_names(self) -> list[str]:
+        return [k for k in self.group.keys() if isinstance(self.group[k], zarrlite.ZarrArray)]
+
+    def matrix(self, names: list[str]) -> np.ndarray:
+        """Stack the named attributes into ``(len(names), n_ids)`` float32."""
+        return np.stack(
+            [np.asarray(self.group[n].read(), dtype=np.float32) for n in names], axis=0
+        )
+
+    def as_mapping(self) -> dict[str, np.ndarray]:
+        """{name: (n_ids,)} view for the statistics machinery."""
+        return {n: self.group[n].read() for n in self.attribute_names}
+
+
+def open_attribute_store(path: str | Path) -> AttributeStore:
+    path = str(path)
+    if path.startswith("s3://"):
+        raise ValueError(
+            f"S3 attribute stores are not reachable from this environment (no egress): {path}"
+        )
+    return AttributeStore(zarrlite.open_group(path))
+
+
+def write_attribute_store(
+    path: str | Path, ids: list, attributes: dict[str, np.ndarray]
+) -> AttributeStore:
+    """Create an attribute store; each attribute is ``(len(ids),)``."""
+    group = zarrlite.create_group(path)
+    group.attrs.update({"ids": list(ids)})
+    for name, data in attributes.items():
+        data = np.asarray(data, dtype=np.float32)
+        if data.shape != (len(ids),):
+            raise ValueError(f"{name}: expected ({len(ids)},), got {data.shape}")
+        group.create_array(name, data)
+    return AttributeStore(group)

@@ -1,0 +1,138 @@
+"""On-disk fixtures for the geodataset layer: a hand-built 10-reach MERIT-style
+hydrofabric persisted through the real engine writers (the reference tests the same
+way — tiny fixtures through the true build->zarr->load pipeline,
+/root/reference/tests/conftest.py:28-338, tests/benchmarks/conftest.py:44-98).
+
+Network (reach index: downstream id), COMIDs are 100+idx:
+
+    0 -> 2, 1 -> 2, 2 -> 4, 3 -> 4, 4 -> 6, 5 -> 6, 6 -> 8, 7 -> 8, 8 -> 9
+
+Gauges: 11111111 at reach 4 (upstream closure 0-4), 22222222 at reach 8
+(closure 0-8), 33333333 at headwater reach 5 (no upstream — filtered).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from ddr_tpu.engine.core import coo_to_zarr, coo_to_zarr_group
+from ddr_tpu.io import zarrlite
+from ddr_tpu.io.stores import write_attribute_store, write_hydro_store
+
+N_REACH = 10
+COMIDS = [100 + i for i in range(N_REACH)]
+EDGES = [(2, 0), (2, 1), (4, 2), (4, 3), (6, 4), (6, 5), (8, 6), (8, 7), (9, 8)]
+GAGE_SEGMENTS = {"11111111": 4, "22222222": 8, "33333333": 5}
+ATTR_NAMES = [f"attr{i}" for i in range(4)]
+START, END = "1981/10/01", "1981/10/20"  # 20 days
+N_DAYS_STORE = 40
+
+
+@pytest.fixture(scope="session")
+def fabric_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("fabric")
+    rng = np.random.default_rng(7)
+
+    rows = np.array([e[0] for e in EDGES])
+    cols = np.array([e[1] for e in EDGES])
+    coo = sparse.coo_matrix(
+        (np.ones(len(EDGES), dtype=np.uint8), (rows, cols)), shape=(N_REACH, N_REACH)
+    )
+
+    # conus adjacency + flowpath attribute arrays (as the engine builder writes them)
+    conus = root / "conus_adjacency.zarr"
+    coo_to_zarr(coo, COMIDS, conus, "merit")
+    g = zarrlite.open_group(conus)
+    length = rng.uniform(1000, 5000, N_REACH)
+    slope = rng.uniform(1e-3, 0.02, N_REACH)
+    length[3] = np.nan  # exercise the NaN -> store-mean fill
+    g.create_array("length_m", length)
+    g.create_array("slope", slope)
+
+    # per-gauge subsets (conus index space), with gage_idx/gage_catchment attrs
+    gages = root / "gages_adjacency.zarr"
+    sub_root = zarrlite.create_group(gages)
+    for staid, seg in GAGE_SEGMENTS.items():
+        keep = _upstream_edges(seg)
+        sub = sparse.coo_matrix(
+            (np.ones(len(keep), dtype=np.uint8), ([e[0] for e in keep], [e[1] for e in keep])),
+            shape=(N_REACH, N_REACH),
+        )
+        coo_to_zarr_group(
+            sub_root, staid, sub, COMIDS, "merit", gage_catchment=staid, gage_idx=seg
+        )
+
+    # attribute store over the COMIDs (one COMID deliberately missing)
+    attrs = {name: rng.normal(size=N_REACH).astype(np.float32) for name in ATTR_NAMES}
+    write_attribute_store(root / "attributes.zarr", COMIDS, attrs)
+
+    # daily lateral-inflow store + observation store, origin-aligned
+    q = rng.uniform(0.1, 2.0, size=(N_REACH, N_DAYS_STORE)).astype(np.float32)
+    write_hydro_store(
+        root / "streamflow.zarr", COMIDS, "1981/09/25", "D", {"Qr": q}, id_dim="divide_id"
+    )
+    obs = rng.uniform(1.0, 30.0, size=(3, N_DAYS_STORE)).astype(np.float32)
+    obs[0, 5] = np.nan  # observation gap
+    write_hydro_store(
+        root / "observations.zarr",
+        list(GAGE_SEGMENTS),
+        "1981/09/25",
+        "D",
+        {"streamflow": obs},
+        id_dim="gage_id",
+    )
+
+    # gauge CSV
+    csv = root / "gages.csv"
+    csv.write_text(
+        "STAID,STANAME,DRAIN_SQKM,LAT_GAGE,LNG_GAGE,COMID,DA_VALID\n"
+        + "\n".join(
+            f"{staid},site {staid},{100.0 * (i + 1)},40.0,-75.0,{COMIDS[seg]},True"
+            for i, (staid, seg) in enumerate(GAGE_SEGMENTS.items())
+        )
+        + "\n"
+    )
+    return root
+
+
+def _upstream_edges(seg: int) -> list[tuple[int, int]]:
+    keep, frontier = [], {seg}
+    while frontier:
+        new = set()
+        for r, c in EDGES:
+            if r in frontier and (r, c) not in keep:
+                keep.append((r, c))
+                new.add(c)
+        frontier = new
+    return keep
+
+
+@pytest.fixture()
+def merit_cfg(fabric_dir, tmp_path):
+    from ddr_tpu.validation.configs import Config
+
+    return Config(
+        name="merit_test",
+        geodataset="merit",
+        mode="training",
+        kan={"input_var_names": ATTR_NAMES},
+        experiment={
+            "start_time": START,
+            "end_time": END,
+            "rho": 8,
+            "batch_size": 2,
+            "warmup": 1,
+        },
+        data_sources={
+            "attributes": str(fabric_dir / "attributes.zarr"),
+            "conus_adjacency": str(fabric_dir / "conus_adjacency.zarr"),
+            "streamflow": str(fabric_dir / "streamflow.zarr"),
+            "observations": str(fabric_dir / "observations.zarr"),
+            "gages": str(fabric_dir / "gages.csv"),
+            "gages_adjacency": str(fabric_dir / "gages_adjacency.zarr"),
+            "statistics": str(tmp_path / "stats"),
+        },
+        params={"save_path": str(tmp_path)},
+    )

@@ -1,0 +1,145 @@
+"""LynkerHydrofabric dataset behavior: string divide ids, observed channel geometry,
+toid consistency assertion (reference lynker_hydrofabric tests)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from ddr_tpu.engine.core import coo_to_zarr, coo_to_zarr_group
+from ddr_tpu.geodatazoo.lynker import LynkerHydrofabric
+from ddr_tpu.io import zarrlite
+from ddr_tpu.io.stores import write_attribute_store, write_hydro_store
+from tests.geodatazoo.conftest import EDGES, GAGE_SEGMENTS, N_REACH, START, END, _upstream_edges
+
+WBIDS = [1000 + i for i in range(N_REACH)]
+WB_ORDER = [f"wb-{w}" for w in WBIDS]
+ATTRS = ["mean_elevation", "impervious_frac", "forest_frac"]
+
+
+@pytest.fixture(scope="session")
+def lynker_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lynker_fabric")
+    rng = np.random.default_rng(11)
+
+    rows = np.array([e[0] for e in EDGES])
+    cols = np.array([e[1] for e in EDGES])
+    coo = sparse.coo_matrix(
+        (np.ones(len(EDGES), dtype=np.uint8), (rows, cols)), shape=(N_REACH, N_REACH)
+    )
+    conus = root / "conus_adjacency.zarr"
+    coo_to_zarr(coo, WB_ORDER, conus, "lynker")
+    g = zarrlite.open_group(conus)
+    g.create_array("length_m", rng.uniform(1000, 5000, N_REACH))
+    g.create_array("slope", rng.uniform(1e-3, 0.02, N_REACH))
+    g.create_array("top_width", rng.uniform(2, 40, N_REACH))
+    g.create_array("side_slope", rng.uniform(0.5, 4.0, N_REACH))
+    g.create_array("muskingum_x", rng.uniform(0.1, 0.45, N_REACH))
+    # toid: numeric part of the downstream waterbody (terminal reaches -> ghost 0)
+    downstream = {c: r for r, c in EDGES}
+    toid = np.array(
+        [WBIDS[downstream[i]] if i in downstream else 0 for i in range(N_REACH)],
+        dtype=np.int32,
+    )
+    g.create_array("toid", toid)
+
+    gages = root / "gages_adjacency.zarr"
+    sub_root = zarrlite.create_group(gages)
+    for staid, seg in GAGE_SEGMENTS.items():
+        keep = _upstream_edges(seg)
+        sub = sparse.coo_matrix(
+            (np.ones(len(keep), dtype=np.uint8), ([e[0] for e in keep], [e[1] for e in keep])),
+            shape=(N_REACH, N_REACH),
+        )
+        coo_to_zarr_group(
+            sub_root, staid, sub, WB_ORDER, "lynker",
+            gage_catchment=f"wb-{WBIDS[seg]}", gage_idx=seg,
+        )
+
+    cat_ids = [f"cat-{w}" for w in WBIDS]
+    write_attribute_store(
+        root / "attributes.zarr",
+        cat_ids,
+        {name: rng.normal(size=N_REACH).astype(np.float32) for name in ATTRS},
+    )
+    q = rng.uniform(0.1, 2.0, size=(N_REACH, 40)).astype(np.float32)
+    write_hydro_store(root / "streamflow.zarr", cat_ids, "1981/09/25", "D", {"Qr": q})
+    obs = rng.uniform(1.0, 30.0, size=(3, 40)).astype(np.float32)
+    write_hydro_store(
+        root / "observations.zarr", list(GAGE_SEGMENTS), "1981/09/25", "D",
+        {"streamflow": obs}, id_dim="gage_id",
+    )
+    csv = root / "gages.csv"
+    csv.write_text(
+        "STAID,STANAME,DRAIN_SQKM,LAT_GAGE,LNG_GAGE\n"
+        + "\n".join(
+            f"{staid},site {staid},{100.0 * (i + 1)},40.0,-75.0"
+            for i, staid in enumerate(GAGE_SEGMENTS)
+        )
+        + "\n"
+    )
+    return root
+
+
+@pytest.fixture()
+def lynker_cfg(lynker_dir, tmp_path):
+    from ddr_tpu.validation.configs import Config
+
+    return Config(
+        name="lynker_test",
+        geodataset="lynker_hydrofabric",
+        mode="training",
+        kan={"input_var_names": ATTRS},
+        experiment={
+            "start_time": START,
+            "end_time": END,
+            "rho": 8,
+            "max_area_diff_sqkm": None,
+        },
+        data_sources={
+            "attributes": str(lynker_dir / "attributes.zarr"),
+            "conus_adjacency": str(lynker_dir / "conus_adjacency.zarr"),
+            "streamflow": str(lynker_dir / "streamflow.zarr"),
+            "observations": str(lynker_dir / "observations.zarr"),
+            "gages": str(lynker_dir / "gages.csv"),
+            "gages_adjacency": str(lynker_dir / "gages_adjacency.zarr"),
+            "statistics": str(tmp_path / "stats"),
+        },
+        params={"save_path": str(tmp_path)},
+    )
+
+
+class TestLynker:
+    def test_divide_ids_are_cat_strings(self, lynker_cfg):
+        ds = LynkerHydrofabric(lynker_cfg)
+        rd = ds.collate_fn(["11111111"])
+        assert all(str(d).startswith("cat-") for d in rd.divide_ids)
+
+    def test_real_channel_geometry_carried(self, lynker_cfg):
+        ds = LynkerHydrofabric(lynker_cfg)
+        rd = ds.collate_fn(["11111111"])
+        assert rd.top_width is not None and rd.top_width.shape == (5,)
+        assert rd.side_slope is not None
+        assert rd.x is not None and not np.allclose(rd.x, 0.3)
+
+    def test_toid_validation_passes_on_consistent_fabric(self, lynker_cfg):
+        ds = LynkerHydrofabric(lynker_cfg)
+        rd = ds.collate_fn(["11111111", "22222222"])
+        assert rd.n_segments == 9
+
+    def test_toid_validation_catches_mismatch(self, lynker_cfg):
+        ds = LynkerHydrofabric(lynker_cfg)
+        toid = ds._toid().copy()
+        toid[2] = 9999  # reach 2 drains into gauge reach 4; corrupt its toid
+        ds._toid_cache = toid
+        with pytest.raises(AssertionError, match="Gage WB"):
+            ds.collate_fn(["11111111"])
+
+    def test_streamflow_reader_with_cat_ids(self, lynker_cfg):
+        from ddr_tpu.io.readers import StreamflowReader
+
+        ds = LynkerHydrofabric(lynker_cfg)
+        rd = ds.collate_fn(["11111111"])
+        q = StreamflowReader(lynker_cfg)(routing_dataclass=rd)
+        assert q.shape == (len(rd.dates.batch_hourly_time_range), 5)

@@ -1,0 +1,128 @@
+"""Merit dataset behavior: filtering chain, subgraph compression, collate contract,
+inference modes (modeled on the reference's dataset tests; the network fixture is in
+conftest.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.dataclasses import RoutingData
+from ddr_tpu.geodatazoo.loader import DataLoader
+from ddr_tpu.geodatazoo.merit import Merit
+from tests.geodatazoo.conftest import ATTR_NAMES, COMIDS, GAGE_SEGMENTS, N_REACH
+
+
+@pytest.fixture()
+def merit_train(merit_cfg):
+    return Merit(merit_cfg)
+
+
+class TestTraining:
+    def test_headwater_gage_filtered(self, merit_train):
+        # 33333333 sits on a headwater reach (empty subset) and must be dropped.
+        assert sorted(merit_train.gage_ids) == ["11111111", "22222222"]
+
+    def test_len_is_n_gages(self, merit_train):
+        assert len(merit_train) == 2
+
+    def test_collate_builds_compressed_subgraph(self, merit_train):
+        rd = merit_train.collate_fn(["11111111"])
+        assert isinstance(rd, RoutingData)
+        # Upstream closure of reach 4 = reaches {0,1,2,3,4}.
+        assert rd.n_segments == 5
+        assert sorted(rd.divide_ids.tolist()) == [COMIDS[i] for i in range(5)]
+        # Edges stay lower-triangular in compressed space (src < tgt).
+        assert (rd.adjacency_cols < rd.adjacency_rows).all()
+        # Gauge inflow columns: reaches draining into reach 4 are {2, 3}.
+        assert len(rd.outflow_idx) == 1
+        got = sorted(rd.divide_ids[rd.outflow_idx[0]].tolist())
+        assert got == [COMIDS[2], COMIDS[3]]
+
+    def test_collate_union_of_two_gages(self, merit_train):
+        rd = merit_train.collate_fn(["11111111", "22222222"])
+        assert rd.n_segments == 9  # union closure of reach 8: reaches 0-8
+        assert len(rd.outflow_idx) == 2
+        assert rd.gage_catchment == ["11111111", "22222222"]
+        assert rd.flow_scale.shape == (9,)
+        np.testing.assert_allclose(rd.flow_scale, 1.0)
+
+    def test_collate_randomizes_window(self, merit_train):
+        merit_train.collate_fn(["11111111"])
+        w1 = merit_train.dates.batch_daily_time_range
+        for _ in range(10):
+            merit_train.collate_fn(["11111111"])
+            if not w1.equals(merit_train.dates.batch_daily_time_range):
+                break
+        else:
+            pytest.fail("rho window never re-randomized")
+        assert len(merit_train.dates.batch_daily_time_range) == 8
+
+    def test_attributes_normalized_shape(self, merit_train):
+        rd = merit_train.collate_fn(["11111111"])
+        assert rd.spatial_attributes.shape == (len(ATTR_NAMES), 5)
+        assert rd.normalized_spatial_attributes.shape == (5, len(ATTR_NAMES))
+        assert np.isfinite(rd.normalized_spatial_attributes).all()
+
+    def test_nan_length_filled(self, merit_train):
+        rd = merit_train.collate_fn(["11111111"])  # reach 3 has NaN length in store
+        assert np.isfinite(rd.length).all()
+        assert rd.x.shape == (5,)
+        np.testing.assert_allclose(rd.x, 0.3)
+        assert rd.top_width is None and rd.side_slope is None
+
+    def test_observations_subset(self, merit_train):
+        rd = merit_train.collate_fn(["11111111", "22222222"])
+        assert rd.observations.streamflow.shape == (2, 8)
+
+    def test_loader_epoch(self, merit_train):
+        loader = DataLoader(merit_train, batch_size=2, shuffle=True, rng=np.random.default_rng(0))
+        batches = list(loader)
+        assert len(batches) == 1
+        assert batches[0].n_segments == 9
+
+
+class TestInference:
+    def test_all_segments_mode(self, merit_cfg):
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "testing"
+        cfg.data_sources.gages = None
+        cfg.data_sources.gages_adjacency = None
+        ds = Merit(cfg)
+        rd = ds.routing_data
+        assert rd.n_segments == N_REACH
+        assert rd.outflow_idx is None
+        assert len(ds) == len(ds.dates.daily_time_range)
+
+    def test_gages_mode(self, merit_cfg):
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "testing"
+        ds = Merit(cfg)
+        assert ds.routing_data.n_segments == 9
+        assert len(ds.routing_data.outflow_idx) == 2
+
+    def test_target_catchments_mode(self, merit_cfg):
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "routing"
+        cfg.data_sources.target_catchments = [str(COMIDS[4])]
+        ds = Merit(cfg)
+        rd = ds.routing_data
+        assert rd.n_segments == 5  # closure of reach 4
+        # every active segment is its own output
+        assert len(rd.outflow_idx) == 5
+
+    def test_inference_collate_prepends_previous_day(self, merit_cfg):
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "testing"
+        ds = Merit(cfg)
+        ds.collate_fn([3, 4, 5])
+        assert ds.dates.batch_daily_time_range[0] == ds.dates.daily_time_range[2]
+
+    def test_streamflow_reader_integration(self, merit_cfg, merit_train):
+        from ddr_tpu.io.readers import StreamflowReader
+
+        rd = merit_train.collate_fn(["11111111"])
+        flow = StreamflowReader(merit_cfg)
+        q = flow(routing_dataclass=rd)
+        assert q.shape == (len(rd.dates.batch_hourly_time_range), rd.n_segments)
+        assert (q > 0).all()
