@@ -7,6 +7,9 @@ generalizes that idea into a parameterized generator: a random dendritic network
 plausible channel properties, catchment attributes statistically linked to "true"
 Manning/Leopold parameters, storm-driven lateral inflows, and observations produced by
 routing with the true parameters — so training must recover them (a twin experiment).
+
+``Synthetic`` implements the full dataset protocol (training batching over gauges,
+sequential inference over days) so every script runs end-to-end with no external data.
 """
 
 from __future__ import annotations
@@ -16,6 +19,8 @@ import dataclasses
 import numpy as np
 
 from ddr_tpu.geodatazoo.dataclasses import Dates, RoutingData
+from ddr_tpu.io.readers import ObservationSet
+from ddr_tpu.validation.enums import Mode
 
 __all__ = ["SyntheticBasin", "make_basin", "Synthetic"]
 
@@ -27,7 +32,7 @@ class SyntheticBasin:
     """Everything needed to run/train on a synthetic basin."""
 
     routing_data: RoutingData
-    q_prime: np.ndarray  # (T, N) hourly lateral inflow
+    q_prime: np.ndarray  # (T, N) hourly lateral inflow over the FULL period
     true_params: dict[str, np.ndarray]  # physical-space truth
     obs_daily: np.ndarray | None = None  # (D-1, G) filled by observe()
     gauge_segments: np.ndarray | None = None
@@ -118,7 +123,13 @@ def make_basin(
 
 
 def observe(basin: SyntheticBasin, cfg) -> SyntheticBasin:
-    """Generate 'observations' by routing with the true parameters (twin experiment)."""
+    """Generate 'observations' by routing with the true parameters (twin experiment).
+
+    Produces both ``basin.obs_daily`` (D-1, G) for direct loss targets and an
+    :class:`ObservationSet` on the routing data (a full (G, D) table with day 0 NaN,
+    mirroring how real observation stores align to the window) so scripts treat the
+    synthetic dataset exactly like Merit/Lynker.
+    """
     import jax.numpy as jnp
 
     from ddr_tpu.routing.mc import route
@@ -132,27 +143,84 @@ def observe(basin: SyntheticBasin, cfg) -> SyntheticBasin:
     res = route(network, channels, params, jnp.asarray(basin.q_prime), gauges=gauges)
     daily = compute_daily_runoff(np.asarray(res.runoff).T, tau=cfg.params.tau)  # (G, D-1)
     basin.obs_daily = daily.T  # (D-1, G)
+
+    rd = basin.routing_data
+    n_days = len(rd.dates.daily_time_range)
+    full = np.full((daily.shape[0], n_days), np.nan, dtype=np.float32)
+    full[:, 1 : 1 + daily.shape[1]] = daily
+    rd.observations = ObservationSet(
+        gage_ids=list(rd.gage_catchment),
+        time=np.asarray(rd.dates.daily_time_range),
+        streamflow=full,
+    )
     return basin
 
 
 class Synthetic:
-    """Minimal dataset-protocol wrapper so ``GeoDataset.synthetic`` works in scripts."""
+    """Full dataset-protocol implementation over one generated basin.
+
+    Training mode iterates gauges and re-randomizes the rho-day window per batch
+    (like BaseGeoDataset); inference iterates days over the prebuilt full-domain
+    RoutingData. ``streamflow`` plays the StreamflowReader role by slicing the
+    generated hourly forcing to the batch window.
+    """
 
     def __init__(self, cfg) -> None:
         self.cfg = cfg
+        n_days = len(
+            Dates(
+                start_time=cfg.experiment.start_time, end_time=cfg.experiment.end_time
+            ).daily_time_range
+        )
+        n_segments = int(getattr(cfg, "synthetic_segments", 0) or 64)
         self.basin = observe(
             make_basin(
-                n_segments=64,
+                n_segments=n_segments,
                 n_gauges=4,
-                n_days=(cfg.experiment.rho or 8),
+                n_days=n_days,
                 seed=cfg.np_seed,
+                start_time=cfg.experiment.start_time,
             ),
             cfg,
         )
-        self.dates = self.basin.routing_data.dates
+        self.routing_data = self.basin.routing_data
+        self.dates = Dates(
+            start_time=cfg.experiment.start_time,
+            end_time=cfg.experiment.end_time,
+            rho=cfg.experiment.rho,
+        )
+        self.routing_data.dates = self.dates
+        self.gage_ids = np.asarray(self.routing_data.gage_catchment)
+        self._rng = np.random.default_rng(cfg.np_seed)
+        self._full_obs = self.routing_data.observations
 
     def __len__(self) -> int:
-        return len(self.basin.routing_data.outflow_idx)
+        if self.cfg.mode == Mode.training:
+            return len(self.gage_ids)
+        return len(self.dates.daily_time_range)
 
-    def collate_fn(self, batch) -> RoutingData:
-        return self.basin.routing_data
+    def __getitem__(self, idx: int):
+        if self.cfg.mode == Mode.training:
+            return str(self.gage_ids[idx])
+        return idx
+
+    def collate_fn(self, batch: list) -> RoutingData:
+        if self.cfg.mode == Mode.training:
+            self.dates.calculate_time_period(self._rng)
+        else:
+            indices = list(batch)
+            if 0 not in indices:
+                indices.insert(0, indices[0] - 1)
+            self.dates.set_date_range(np.asarray(indices))
+        # Observations re-windowed to the batch's daily range.
+        self.routing_data.observations = ObservationSet(
+            gage_ids=list(self._full_obs.gage_ids),
+            time=np.asarray(self.dates.batch_daily_time_range),
+            streamflow=self._full_obs.streamflow[:, self.dates.daily_indices],
+        )
+        return self.routing_data
+
+    def streamflow(self, **kwargs) -> np.ndarray:
+        """(T_batch, N) hourly lateral inflow for the current batch window."""
+        rd = kwargs["routing_dataclass"]
+        return self.basin.q_prime[rd.dates.hourly_indices]

@@ -1,0 +1,104 @@
+"""Shared script scaffolding: argv -> Config, model construction, observation
+alignment (the role hydra.main + per-script boilerplate plays in the reference,
+/root/reference/scripts/train.py:164-203)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from ddr_tpu.io.readers import StreamflowReader
+from ddr_tpu.nn.kan import Kan
+from ddr_tpu.validation.configs import Config, load_config
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "parse_cli",
+    "setup_run",
+    "build_kan",
+    "get_flow_fn",
+    "daily_observation_targets",
+    "timed",
+]
+
+
+def parse_cli(argv: list[str] | None, mode: str) -> Config:
+    """``[config.yaml] [a.b=c ...]`` -> validated Config with ``mode`` forced and the
+    run directories created."""
+    argv = list(argv or [])
+    path = None
+    overrides = []
+    for a in argv:
+        if "=" in a:
+            overrides.append(a)
+        elif path is None:
+            path = a
+        else:
+            raise SystemExit(f"unexpected argument {a!r}")
+    overrides.append(f"mode={mode}")
+    cfg = load_config(path, overrides)
+    return setup_run(cfg)
+
+
+def setup_run(cfg: Config) -> Config:
+    save = Path(cfg.params.save_path)
+    (save / "plots").mkdir(parents=True, exist_ok=True)
+    (save / "saved_models").mkdir(parents=True, exist_ok=True)
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    return cfg
+
+
+def build_kan(cfg: Config) -> tuple[Kan, Any]:
+    """KAN module + fresh params (reference scripts/train.py:176-185)."""
+    model = Kan(
+        input_var_names=tuple(cfg.kan.input_var_names),
+        learnable_parameters=tuple(cfg.kan.learnable_parameters),
+        hidden_size=cfg.kan.hidden_size,
+        num_hidden_layers=cfg.kan.num_hidden_layers,
+        grid=cfg.kan.grid,
+        k=cfg.kan.k,
+    )
+    dummy = np.zeros((1, len(cfg.kan.input_var_names)), dtype=np.float32)
+    params = model.init(jax.random.key(cfg.seed), dummy)
+    return model, params
+
+
+def get_flow_fn(cfg: Config, dataset: Any) -> Callable[..., np.ndarray]:
+    """The lateral-inflow source: the dataset's own generator (synthetic) or a
+    StreamflowReader over the configured store."""
+    if hasattr(dataset, "streamflow"):
+        return dataset.streamflow
+    return StreamflowReader(cfg)
+
+
+def daily_observation_targets(rd: Any) -> tuple[np.ndarray, np.ndarray]:
+    """Batch observations -> ``(obs_daily, mask)`` both ``(D-2, G)``.
+
+    A D-day batch window spans ``(D-1)*24`` hourly steps (reference Dates convention,
+    dataclasses.py:95-139: left-inclusive hourly range), so the tau-trimmed daily
+    prediction covers observation days ``1..D-2`` — the reference's ``[:, 1:-1]`` cut
+    (train.py:84-92). NaN gaps become masked zeros so the jitted loss sees static
+    shapes (the reference instead drops whole gauges with any NaN; masking keeps
+    partial records)."""
+    obs = np.asarray(rd.observations.streamflow, dtype=np.float32)  # (G, D)
+    target = obs[:, 1:-1].T  # (D-2, G)
+    mask = np.isfinite(target)
+    return np.where(mask, target, 0.0).astype(np.float32), mask
+
+
+@contextmanager
+def timed(label: str):
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        log.info(f"{label}: {(time.perf_counter() - start) / 60:.3f} minutes elapsed")

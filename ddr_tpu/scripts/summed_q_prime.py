@@ -1,0 +1,140 @@
+"""``ddr summed-q-prime`` — the un-routed baseline: predicted gauge flow is the plain
+sum of lateral inflows over each gauge's upstream divide set, no routing physics
+(reference /root/reference/scripts/summed_q_prime.py:29-334; the dHBV2.0UH-era parity
+product). The accumulation runs as one ``jnp.nansum`` per gauge on the accelerator
+(the reference uses CuPy, summed_q_prime.py:243-260).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ddr_tpu.geodatazoo.dataclasses import Dates
+from ddr_tpu.io import zarrlite
+from ddr_tpu.io.readers import USGSObservationReader, read_zarr
+from ddr_tpu.io.stores import open_hydro_store
+from ddr_tpu.scripts_utils import safe_mean, safe_percentile
+from ddr_tpu.scripts.common import parse_cli, timed
+from ddr_tpu.validation.configs import Config
+from ddr_tpu.validation.metrics import Metrics
+from ddr_tpu.validation.utils import log_metrics
+
+log = logging.getLogger(__name__)
+
+
+def print_metrics_summary(metrics: Metrics, gage_ids: list[str], save_dir: Path) -> dict:
+    """Summary table -> console + JSON + per-gage CSV
+    (reference summed_q_prime.py:29-152)."""
+    summary = {
+        name: {
+            "median": safe_percentile(getattr(metrics, name), 50),
+            "mean": safe_mean(getattr(metrics, name)),
+        }
+        for name in ("nse", "kge", "rmse", "corr", "pbias")
+    }
+    print("=" * 56)
+    print("Summed Q' baseline (no routing)")
+    print("=" * 56)
+    for name, row in summary.items():
+        print(f"  {name:>6}: median {row['median']:8.3f}  mean {row['mean']:8.3f}")
+    print("=" * 56)
+
+    save_dir.mkdir(parents=True, exist_ok=True)
+    (save_dir / "summed_q_prime_summary.json").write_text(json.dumps(summary, indent=2))
+    pd.DataFrame(
+        {
+            "gage_id": gage_ids,
+            "nse": metrics.nse,
+            "kge": metrics.kge,
+            "rmse": metrics.rmse,
+            "corr": metrics.corr,
+            "pbias": metrics.pbias,
+        }
+    ).to_csv(save_dir / "summed_q_prime_metrics.csv", index=False)
+    return summary
+
+
+def eval_q_prime(cfg: Config) -> Metrics:
+    store = open_hydro_store(cfg.data_sources.streamflow)
+    obs_reader = USGSObservationReader(cfg)
+    dates = Dates(start_time=cfg.experiment.start_time, end_time=cfg.experiment.end_time)
+    observations = obs_reader.read_data(dates=dates)
+    gages_adjacency = read_zarr(Path(cfg.data_sources.gages_adjacency))
+
+    available = [g for g in observations.gage_ids if g in gages_adjacency]
+    if not available:
+        raise ValueError("no gauges overlap between observations and gages_adjacency")
+
+    n_days = len(dates.daily_time_range)
+    preds = np.zeros((len(available), n_days), dtype=np.float32)
+    for i, gid in enumerate(available):
+        sub = gages_adjacency[gid]
+        assert isinstance(sub, zarrlite.ZarrGroup)
+        rows_idx = sub["indices_0"].read()
+        cols_idx = sub["indices_1"].read()
+        order = sub["order"].read()
+        active = np.unique(
+            np.concatenate([rows_idx, cols_idx, [int(sub.attrs.get("gage_idx", 0))]])
+        ).astype(np.int64)
+        divide_ids = order[active]
+
+        store_rows = []
+        for divide in divide_ids:
+            for key in (divide, int(divide), str(divide), f"cat-{divide}"):
+                row = store.id_to_index.get(key)
+                if row is not None:
+                    store_rows.append(row)
+                    break
+        if not store_rows:
+            log.warning(f"gage {gid}: no upstream divides found in the streamflow store")
+            continue
+
+        if store.is_hourly:
+            hours = (
+                (dates.batch_hourly_time_range - store.start_date).total_seconds() // 3600
+            ).astype(int)
+            q = store.select("Qr", np.asarray(store_rows), np.asarray(hours))
+            q_daily = q.reshape(len(store_rows), n_days, 24).mean(axis=2)
+        else:
+            time_idx = dates.numerical_time_range - store.time_offset_days
+            q_daily = store.select("Qr", np.asarray(store_rows), time_idx)
+        preds[i] = np.asarray(jnp.nansum(jnp.asarray(q_daily), axis=0))
+
+    obs = observations.sel_gages(available).streamflow[:, :n_days]
+    metrics = Metrics(pred=preds, target=obs)
+    log_metrics(metrics, header="Summed Q' baseline")
+    save_dir = Path(cfg.params.save_path)
+    print_metrics_summary(metrics, available, save_dir)
+
+    root = zarrlite.create_group(save_dir / "summed_q_prime.zarr")
+    root.create_array("predictions", preds)
+    root.create_array("observations", obs.astype(np.float32))
+    root.attrs.update(
+        {
+            "gage_ids": list(available),
+            "start_time": cfg.experiment.start_time,
+            "end_time": cfg.experiment.end_time,
+            "description": "Summed lateral inflow baseline (no routing)",
+        }
+    )
+    return metrics
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = parse_cli(argv, mode="testing")
+    with timed("summed-q-prime"):
+        try:
+            eval_q_prime(cfg)
+        except KeyboardInterrupt:
+            log.info("Keyboard interrupt received")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

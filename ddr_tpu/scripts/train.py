@@ -1,0 +1,157 @@
+"""``ddr train`` — KAN + routing training loop
+(reference /root/reference/scripts/train.py:21-203, re-based on the jitted
+``make_batch_train_step``: forward, backward through the custom-VJP solver, grad clip,
+and Adam update are one compiled XLA program per network shape).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.geodatazoo.loader import DataLoader
+from ddr_tpu.routing.mc import Bounds
+from ddr_tpu.routing.model import prepare_batch
+from ddr_tpu.scripts_utils import resolve_learning_rate
+from ddr_tpu.scripts.common import (
+    build_kan,
+    daily_observation_targets,
+    get_flow_fn,
+    parse_cli,
+    timed,
+)
+from ddr_tpu.training import (
+    load_state,
+    make_batch_train_step,
+    make_optimizer,
+    save_state,
+    set_learning_rate,
+)
+from ddr_tpu.validation.configs import Config
+from ddr_tpu.validation.metrics import Metrics
+from ddr_tpu.validation.plots import plot_time_series
+from ddr_tpu.validation.utils import log_metrics
+
+log = logging.getLogger(__name__)
+
+
+def train(cfg: Config, dataset=None, max_batches: int | None = None):
+    """Run the training loop; returns (params, opt_state) for composition
+    (train-and-test)."""
+    dataset = dataset or cfg.geodataset.get_dataset_class(cfg)
+    flow = get_flow_fn(cfg, dataset)
+    kan_model, params = build_kan(cfg)
+
+    rng = np.random.default_rng(cfg.seed)
+    loader = DataLoader(
+        dataset,
+        batch_size=cfg.experiment.batch_size,
+        shuffle=cfg.experiment.shuffle,
+        rng=rng,
+        drop_last=True,
+    )
+
+    start_epoch, start_mini_batch, blob = 1, 0, None
+    if cfg.experiment.checkpoint:
+        blob = load_state(cfg.experiment.checkpoint)
+        params = blob["params"]
+        start_epoch = blob["epoch"]
+        start_mini_batch = 0 if blob["mini_batch"] == 0 else blob["mini_batch"] + 1
+        if blob.get("rng_state"):
+            loader.set_state(blob["rng_state"])
+        log.info(f"Resuming from {cfg.experiment.checkpoint} at epoch {start_epoch}")
+    else:
+        log.info("Creating new spatial model")
+
+    lr = resolve_learning_rate(cfg.experiment.learning_rate, start_epoch)
+    optimizer = make_optimizer(lr)
+    opt_state = blob["opt_state"] if blob and blob.get("opt_state") is not None else optimizer.init(params)
+
+    step = make_batch_train_step(
+        kan_model,
+        Bounds.from_config(cfg.params.attribute_minimums),
+        cfg.params.parameter_ranges,
+        cfg.params.log_space_parameters,
+        cfg.params.defaults,
+        tau=cfg.params.tau,
+        warmup=cfg.experiment.warmup,
+        optimizer=optimizer,
+    )
+    slope_min = cfg.params.attribute_minimums["slope"]
+    n_done = 0
+
+    for epoch in range(start_epoch, cfg.experiment.epochs + 1):
+        if epoch in cfg.experiment.learning_rate:
+            log.info(f"Setting learning rate: {cfg.experiment.learning_rate[epoch]}")
+            opt_state = set_learning_rate(opt_state, cfg.experiment.learning_rate[epoch])
+
+        for i, rd in enumerate(loader):
+            if epoch == start_epoch and i < start_mini_batch:
+                log.info(f"Skipping mini-batch {i}. Resuming at {start_mini_batch}")
+                continue
+
+            q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+            if rd.flow_scale is not None:
+                q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
+            network, channels, gauges = prepare_batch(rd, slope_min)
+            attrs = jnp.asarray(rd.normalized_spatial_attributes)
+            obs_daily, obs_mask = daily_observation_targets(rd)
+
+            params, opt_state, loss, daily = step(
+                params,
+                opt_state,
+                network,
+                channels,
+                gauges,
+                attrs,
+                jnp.asarray(q_prime),
+                jnp.asarray(obs_daily),
+                jnp.asarray(obs_mask),
+            )
+            loss = float(loss)
+            daily = np.asarray(daily)  # (D-1, G)
+            log.info(f"epoch {epoch} mini-batch {i}: loss={loss:.5f}")
+
+            target = np.where(obs_mask, obs_daily, np.nan)
+            metrics = Metrics(pred=daily.T, target=target.T)
+            log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
+
+            gage_ids = rd.observations.gage_ids
+            plot_time_series(
+                daily[:, -1],
+                target[:, -1],
+                rd.dates.batch_daily_time_range[1:-1],
+                gage_ids[-1],
+                cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
+                name=cfg.name,
+                warmup=cfg.experiment.warmup,
+            )
+            save_state(
+                cfg.params.save_path / "saved_models",
+                cfg.name,
+                epoch,
+                i,
+                params,
+                opt_state,
+                rng_state=loader.state(),
+            )
+            n_done += 1
+            if max_batches is not None and n_done >= max_batches:
+                return params, opt_state
+    return params, opt_state
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = parse_cli(argv, mode="training")
+    with timed("training"):
+        try:
+            train(cfg)
+        except KeyboardInterrupt:
+            log.info("Keyboard interrupt received")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,47 @@
+"""``ddr train-and-test`` — training followed by evaluation on a held-out period with
+the freshest checkpoint (reference /root/reference/scripts/train_and_test.py:36-229).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ddr_tpu.scripts.common import parse_cli, timed
+from ddr_tpu.scripts.test import test as _test
+from ddr_tpu.scripts.train import train as _train
+from ddr_tpu.training import latest_checkpoint
+from ddr_tpu.validation.configs import Config
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TEST_PERIOD = ("1995/10/01", "2010/09/30")  # reference train_and_test.py:190-199
+
+
+def train_and_test(cfg: Config) -> None:
+    _train(cfg)
+
+    ckpt = latest_checkpoint(cfg.params.save_path / "saved_models")
+    if ckpt is None:
+        raise FileNotFoundError("training produced no checkpoint to evaluate")
+    log.info(f"Evaluating checkpoint {ckpt}")
+
+    test_cfg = cfg.model_copy(deep=True)
+    test_cfg.mode = "testing"
+    test_cfg.experiment.checkpoint = ckpt
+    test_cfg.experiment.start_time = cfg.experiment.test_start_time or DEFAULT_TEST_PERIOD[0]
+    test_cfg.experiment.end_time = cfg.experiment.test_end_time or DEFAULT_TEST_PERIOD[1]
+    _test(test_cfg)
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = parse_cli(argv, mode="training")
+    with timed("train-and-test"):
+        try:
+            train_and_test(cfg)
+        except KeyboardInterrupt:
+            log.info("Keyboard interrupt received")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

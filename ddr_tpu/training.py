@@ -32,6 +32,7 @@ __all__ = [
     "make_optimizer",
     "set_learning_rate",
     "make_train_step",
+    "make_batch_train_step",
     "save_state",
     "load_state",
 ]
@@ -102,6 +103,49 @@ def make_train_step(
     def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
         (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, attrs, q_prime, obs_daily, obs_mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, daily
+
+    return step
+
+
+def make_batch_train_step(
+    kan_model,
+    bounds: Bounds,
+    parameter_ranges: dict[str, list[float]],
+    log_space_parameters: list[str],
+    defaults: dict[str, float],
+    tau: int,
+    warmup: int,
+    optimizer: optax.GradientTransformation,
+):
+    """Like :func:`make_train_step` but with the network/channels/gauges as call-time
+    arguments, so one jitted function serves every training batch.
+
+    ``jax.jit`` caches compilations by the pytrees' shapes and static fields
+    (``RiverNetwork.n/depth/n_edges``, ``GaugeIndex.n_gauges``): repeated gauge
+    subsets across epochs — the common case, since the sampler cycles a fixed gauge
+    list — hit the compile cache instead of re-tracing (the recompilation-churn
+    mitigation from SURVEY.md §7 hard-parts (e))."""
+
+    def loss_fn(params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
+        raw = kan_model.apply(params, attrs)
+        spatial = denormalize_spatial_parameters(
+            raw, parameter_ranges, log_space_parameters, defaults, channels.length.shape[0]
+        )
+        result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
+        daily = daily_from_hourly(result.runoff, tau)  # (D-1, G)
+        mask = obs_mask.at[:warmup].set(False)
+        err = jnp.where(mask, jnp.abs(daily - jnp.where(mask, obs_daily, 0.0)), 0.0)
+        loss = err.sum() / jnp.maximum(mask.sum(), 1)
+        return loss, daily
+
+    @jax.jit
+    def step(params, opt_state, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
+        (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

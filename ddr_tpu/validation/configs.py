@@ -99,6 +99,12 @@ class ExperimentConfig(BaseModel):
     shuffle: bool = True
     warmup: int = Field(default=3, description="Days excluded from the loss while routing spins up")
     max_area_diff_sqkm: float | None = 50
+    test_start_time: str | None = Field(
+        default=None, description="Evaluation period start for train-and-test (default 1995/10/01)"
+    )
+    test_end_time: str | None = Field(
+        default=None, description="Evaluation period end for train-and-test"
+    )
 
     @field_validator("learning_rate", mode="before")
     @classmethod

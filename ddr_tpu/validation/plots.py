@@ -1,0 +1,169 @@
+"""Diagnostic plots (reference /root/reference/src/ddr/validation/plots.py:18-798).
+
+Same plot inventory as the reference — hydrograph time series, metric CDFs, box
+figures, drainage-area-binned boxplots, gauge maps, routing hydrographs — rendered
+with bare matplotlib (no cartopy/geopandas in this environment; the gauge map is a
+lat/lng scatter). All functions save to a path and return it, and use the Agg backend
+so they run headless.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+__all__ = [
+    "plot_time_series",
+    "plot_cdf",
+    "plot_box_fig",
+    "plot_drainage_area_boxplots",
+    "plot_gauge_map",
+    "plot_routing_hydrograph",
+]
+
+
+def _finish(fig, path: str | Path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def plot_time_series(
+    prediction: np.ndarray,
+    observation: np.ndarray,
+    time: Any,
+    gage_id: str,
+    path: str | Path,
+    name: str = "",
+    warmup: int = 0,
+) -> Path:
+    """Predicted vs observed hydrograph for one gauge (reference plots.py:18-108)."""
+    fig, ax = plt.subplots(figsize=(10, 4))
+    t = np.arange(len(prediction)) if time is None else np.asarray(time)
+    ax.plot(t, np.asarray(observation), label="observed", color="black", lw=1.0)
+    ax.plot(t, np.asarray(prediction), label="predicted", color="tab:blue", lw=1.0)
+    if warmup:
+        ax.axvspan(t[0], t[min(warmup, len(t) - 1)], alpha=0.15, color="gray", label="warmup")
+    ax.set_xlabel("time")
+    ax.set_ylabel("discharge (m³/s)")
+    ax.set_title(f"{name} gauge {gage_id}")
+    ax.legend(loc="upper right")
+    return _finish(fig, path)
+
+
+def plot_cdf(
+    metric_sets: dict[str, np.ndarray],
+    path: str | Path,
+    metric_name: str = "NSE",
+    xlim: tuple[float, float] = (-1.0, 1.0),
+) -> Path:
+    """Empirical CDFs of a per-gauge metric for one or more runs
+    (reference plots.py:111-227)."""
+    fig, ax = plt.subplots(figsize=(6, 5))
+    for label, values in metric_sets.items():
+        v = np.sort(np.asarray(values)[np.isfinite(values)])
+        if v.size == 0:
+            continue
+        cdf = np.arange(1, v.size + 1) / v.size
+        med = float(np.median(v))
+        ax.plot(v, cdf, label=f"{label} (median {med:.3f})")
+    ax.set_xlim(*xlim)
+    ax.set_xlabel(metric_name)
+    ax.set_ylabel("CDF")
+    ax.grid(alpha=0.3)
+    ax.legend(loc="upper left")
+    return _finish(fig, path)
+
+
+def plot_box_fig(
+    data: Sequence[np.ndarray],
+    labels: Sequence[str],
+    path: str | Path,
+    ylabel: str = "NSE",
+    title: str = "",
+) -> Path:
+    """Side-by-side boxplots of metric distributions (reference plots.py:230-373)."""
+    fig, ax = plt.subplots(figsize=(1.5 * max(4, len(labels)), 5))
+    clean = [np.asarray(d)[np.isfinite(d)] for d in data]
+    ax.boxplot(clean, tick_labels=list(labels), showfliers=False)
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    ax.grid(alpha=0.3, axis="y")
+    return _finish(fig, path)
+
+
+def plot_drainage_area_boxplots(
+    metric_values: np.ndarray,
+    drainage_areas: np.ndarray,
+    path: str | Path,
+    metric_name: str = "NSE",
+    bins: Sequence[float] = (0, 500, 1000, 5000, 10000, np.inf),
+) -> Path:
+    """Metric distribution binned by gauge drainage area (reference plots.py:376-587)."""
+    metric_values = np.asarray(metric_values, dtype=float)
+    drainage_areas = np.asarray(drainage_areas, dtype=float)
+    groups, labels = [], []
+    for lo, hi in zip(bins[:-1], bins[1:]):
+        mask = (drainage_areas >= lo) & (drainage_areas < hi) & np.isfinite(metric_values)
+        groups.append(metric_values[mask])
+        hi_label = "∞" if np.isinf(hi) else f"{hi:g}"
+        labels.append(f"{lo:g}-{hi_label}\n(n={int(mask.sum())})")
+    fig, ax = plt.subplots(figsize=(1.6 * len(groups), 5))
+    ax.boxplot([g if g.size else np.array([np.nan]) for g in groups], tick_labels=labels, showfliers=False)
+    ax.set_xlabel("drainage area (km²)")
+    ax.set_ylabel(metric_name)
+    ax.grid(alpha=0.3, axis="y")
+    return _finish(fig, path)
+
+
+def plot_gauge_map(
+    lats: np.ndarray,
+    lngs: np.ndarray,
+    values: np.ndarray,
+    path: str | Path,
+    metric_name: str = "NSE",
+    vmin: float = -1.0,
+    vmax: float = 1.0,
+) -> Path:
+    """Gauge locations colored by metric (reference plots.py:590-738; plain lat/lng
+    scatter — no basemap libraries in this environment)."""
+    fig, ax = plt.subplots(figsize=(9, 6))
+    sc = ax.scatter(
+        np.asarray(lngs), np.asarray(lats), c=np.asarray(values), cmap="RdYlBu",
+        vmin=vmin, vmax=vmax, s=18, edgecolors="k", linewidths=0.2,
+    )
+    fig.colorbar(sc, ax=ax, label=metric_name)
+    ax.set_xlabel("longitude")
+    ax.set_ylabel("latitude")
+    ax.set_title(f"gauge {metric_name}")
+    return _finish(fig, path)
+
+
+def plot_routing_hydrograph(
+    discharge: np.ndarray,
+    time: Any,
+    segment_ids: Sequence[Any],
+    path: str | Path,
+    title: str = "routed discharge",
+) -> Path:
+    """Hydrographs for selected segments of a routing run (reference plots.py:741-798)."""
+    discharge = np.atleast_2d(np.asarray(discharge))
+    t = np.arange(discharge.shape[1]) if time is None else np.asarray(time)
+    fig, ax = plt.subplots(figsize=(10, 4))
+    for i, seg in enumerate(segment_ids):
+        ax.plot(t, discharge[i], lw=1.0, label=str(seg))
+    ax.set_xlabel("time")
+    ax.set_ylabel("discharge (m³/s)")
+    ax.set_title(title)
+    if len(segment_ids) <= 12:
+        ax.legend(loc="upper right", fontsize=8)
+    return _finish(fig, path)

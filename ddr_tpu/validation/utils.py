@@ -1,0 +1,45 @@
+"""Validation-side utilities: metric summary logging
+(reference /root/reference/src/ddr/validation/utils.py:81-113; checkpointing lives in
+:mod:`ddr_tpu.training` since JAX params/opt-state are the things being saved).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import numpy as np
+
+from ddr_tpu.scripts_utils import safe_mean, safe_percentile
+
+__all__ = ["log_metrics", "metrics_summary"]
+
+log = logging.getLogger(__name__)
+
+
+def metrics_summary(metrics: Any) -> dict[str, dict[str, float]]:
+    """Median/mean/p25/p75 for the headline metrics."""
+    out: dict[str, dict[str, float]] = {}
+    for name in ("nse", "rmse", "kge", "corr", "pbias", "fhv", "flv"):
+        values = np.asarray(getattr(metrics, name))
+        out[name] = {
+            "median": safe_percentile(values, 50),
+            "mean": safe_mean(values),
+            "p25": safe_percentile(values, 25),
+            "p75": safe_percentile(values, 75),
+        }
+    return out
+
+
+def log_metrics(metrics: Any, header: str = "") -> None:
+    """Log the formatted metric table (reference validation/utils.py:81-113)."""
+    summary = metrics_summary(metrics)
+    lines = [header or "Evaluation metrics:"]
+    lines.append(f"{'metric':>8} | {'median':>8} | {'mean':>8} | {'p25':>8} | {'p75':>8}")
+    lines.append("-" * 50)
+    for name, row in summary.items():
+        lines.append(
+            f"{name:>8} | {row['median']:8.3f} | {row['mean']:8.3f} | "
+            f"{row['p25']:8.3f} | {row['p75']:8.3f}"
+        )
+    log.info("\n".join(lines))

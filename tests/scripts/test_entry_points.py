@@ -1,0 +1,187 @@
+"""End-to-end script tests on the tiny on-disk MERIT fabric and the synthetic basin —
+the whole train/test/route/summed-q-prime surface without external data (the
+reference's strategy, tests/benchmarks/conftest.py:44-98)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+import yaml
+
+from ddr_tpu.geodatazoo.merit import Merit
+from ddr_tpu.io import zarrlite
+
+
+def _synthetic_cfg_dict(tmp_path, **exp):
+    return {
+        "name": "synthetic_run",
+        "geodataset": "synthetic",
+        "mode": "training",
+        "kan": {"input_var_names": [f"a{i}" for i in range(10)]},
+        "experiment": {
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/20",
+            "rho": 8,
+            "batch_size": 2,
+            "epochs": 1,
+            "warmup": 1,
+            "learning_rate": {1: 0.01},
+            **exp,
+        },
+        "params": {"save_path": str(tmp_path)},
+    }
+
+
+class TestTrainScript:
+    def test_train_on_synthetic(self, tmp_path):
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.validation.configs import Config
+
+        cfg = Config(**_synthetic_cfg_dict(tmp_path))
+        params, opt_state = train(cfg, max_batches=2)
+        assert params is not None
+        ckpts = list((tmp_path / "saved_models").glob("*.pkl"))
+        assert ckpts, "no checkpoint written"
+        plots = list((tmp_path / "plots").glob("*.png"))
+        assert plots, "no validation plot written"
+
+    def test_train_on_merit_fixture(self, merit_cfg):
+        from ddr_tpu.scripts.train import train
+
+        dataset = Merit(merit_cfg)
+        params, _ = train(merit_cfg, dataset=dataset, max_batches=1)
+        assert params is not None
+
+    def test_train_resume_skips_minibatches(self, tmp_path):
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.training import latest_checkpoint, load_state
+        from ddr_tpu.validation.configs import Config
+
+        cfg = Config(**_synthetic_cfg_dict(tmp_path))
+        train(cfg, max_batches=1)
+        ckpt = latest_checkpoint(tmp_path / "saved_models")
+        blob = load_state(ckpt)
+        assert blob["epoch"] == 1 and blob["mini_batch"] == 0
+        cfg2 = Config(**_synthetic_cfg_dict(tmp_path))
+        cfg2.experiment.checkpoint = ckpt
+        params, _ = train(cfg2, max_batches=1)
+        assert params is not None
+
+
+class TestTestScript:
+    def test_test_on_merit_fixture(self, merit_cfg, tmp_path):
+        from ddr_tpu.scripts.test import test as run_test
+
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "testing"
+        cfg.experiment.rho = None
+        cfg.experiment.batch_size = 8  # days per chunk
+        cfg.params.save_path = tmp_path
+        metrics = run_test(cfg)
+        out = zarrlite.open_group(tmp_path / "model_test.zarr")
+        pred = out["predictions"].read()
+        assert pred.shape[0] == 2  # two non-headwater gauges
+        assert np.isfinite(pred).all()
+        assert len(metrics.nse) == 2
+
+    def test_carry_state_continuity(self, merit_cfg, tmp_path):
+        """Chunked sequential inference must match one-shot inference."""
+        from ddr_tpu.scripts.test import test as run_test
+
+        base = merit_cfg.model_copy(deep=True)
+        base.mode = "testing"
+        base.experiment.rho = None
+        base.params.save_path = tmp_path / "oneshot"
+        base.experiment.batch_size = 50  # single chunk covers all 20 days
+        run_test(base)
+
+        chunked = merit_cfg.model_copy(deep=True)
+        chunked.mode = "testing"
+        chunked.experiment.rho = None
+        chunked.params.save_path = tmp_path / "chunked"
+        chunked.experiment.batch_size = 5
+        run_test(chunked)
+
+        a = zarrlite.open_group(tmp_path / "oneshot" / "model_test.zarr")["predictions"].read()
+        b = zarrlite.open_group(tmp_path / "chunked" / "model_test.zarr")["predictions"].read()
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-4)
+
+
+class TestRouterScript:
+    def test_route_all_segments(self, merit_cfg, tmp_path):
+        from ddr_tpu.scripts.router import route_domain
+
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "routing"
+        cfg.experiment.rho = None
+        cfg.experiment.batch_size = 10
+        cfg.data_sources.gages = None
+        cfg.data_sources.gages_adjacency = None
+        cfg.params.save_path = tmp_path
+        discharge = route_domain(cfg)
+        assert discharge.shape[0] == 10  # full domain
+        assert np.isfinite(discharge).all()
+        out = zarrlite.open_group(tmp_path / "chrout.zarr")
+        assert out["discharge"].read().shape == discharge.shape
+
+    def test_route_target_catchments(self, merit_cfg, tmp_path):
+        from ddr_tpu.scripts.router import route_domain
+        from tests.geodatazoo.conftest import COMIDS
+
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "routing"
+        cfg.experiment.rho = None
+        cfg.experiment.batch_size = 10
+        cfg.data_sources.target_catchments = [str(COMIDS[4])]
+        cfg.params.save_path = tmp_path
+        discharge = route_domain(cfg)
+        assert discharge.shape[0] == 5  # closure of reach 4
+
+
+class TestSummedQPrime:
+    def test_baseline(self, merit_cfg, tmp_path):
+        from ddr_tpu.scripts.summed_q_prime import eval_q_prime
+
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.params.save_path = tmp_path
+        metrics = eval_q_prime(cfg)
+        assert (tmp_path / "summed_q_prime_summary.json").exists()
+        assert (tmp_path / "summed_q_prime_metrics.csv").exists()
+        out = zarrlite.open_group(tmp_path / "summed_q_prime.zarr")
+        assert out["predictions"].read().shape[0] == len(metrics.nse)
+
+
+class TestTrainAndTest:
+    def test_synthetic_train_and_test(self, tmp_path):
+        from ddr_tpu.scripts.train_and_test import train_and_test
+        from ddr_tpu.validation.configs import Config
+
+        d = _synthetic_cfg_dict(
+            tmp_path,
+            epochs=1,
+            test_start_time="1981/10/01",
+            test_end_time="1981/10/20",
+            batch_size=4,
+        )
+        cfg = Config(**d)
+        train_and_test(cfg)
+        assert (tmp_path / "model_test.zarr").exists()
+
+
+class TestCli:
+    def test_dispatch_and_help(self, capsys):
+        from ddr_tpu.cli import main
+
+        assert main([]) == 0
+        assert "train" in capsys.readouterr().out
+        assert main(["bogus"]) == 2
+
+    def test_cli_train_synthetic(self, tmp_path):
+        from ddr_tpu.cli import main
+
+        d = _synthetic_cfg_dict(tmp_path, epochs=1, batch_size=4)
+        cfg_path = tmp_path / "config.yaml"
+        cfg_path.write_text(yaml.safe_dump(d))
+        assert main(["train", str(cfg_path)]) == 0

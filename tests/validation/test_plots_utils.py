@@ -1,0 +1,52 @@
+"""Smoke tests for the plot inventory + metric summary logging (reference plots are
+smoke-tested the same way, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.validation import plots
+from ddr_tpu.validation.metrics import Metrics
+from ddr_tpu.validation.utils import log_metrics, metrics_summary
+
+
+@pytest.fixture()
+def metric_fixture():
+    rng = np.random.default_rng(0)
+    target = rng.uniform(1, 10, size=(6, 50))
+    pred = target + rng.normal(scale=0.5, size=target.shape)
+    return Metrics(pred=pred, target=target)
+
+
+def test_metrics_summary_and_log(metric_fixture, caplog):
+    summary = metrics_summary(metric_fixture)
+    assert set(summary) >= {"nse", "rmse", "kge"}
+    assert summary["nse"]["median"] > 0.5
+    with caplog.at_level("INFO"):
+        log_metrics(metric_fixture, header="test")
+    assert "nse" in caplog.text
+
+
+def test_all_plots_render(tmp_path, metric_fixture):
+    rng = np.random.default_rng(1)
+    t = np.arange(40)
+    p = plots.plot_time_series(
+        rng.uniform(0, 5, 40), rng.uniform(0, 5, 40), t, "01234567",
+        tmp_path / "ts.png", warmup=3,
+    )
+    assert p.exists()
+    assert plots.plot_cdf({"run_a": metric_fixture.nse}, tmp_path / "cdf.png").exists()
+    assert plots.plot_box_fig(
+        [metric_fixture.nse, metric_fixture.kge], ["nse", "kge"], tmp_path / "box.png"
+    ).exists()
+    assert plots.plot_drainage_area_boxplots(
+        metric_fixture.nse, rng.uniform(10, 20000, 6), tmp_path / "da.png"
+    ).exists()
+    assert plots.plot_gauge_map(
+        rng.uniform(30, 45, 6), rng.uniform(-120, -70, 6), metric_fixture.nse,
+        tmp_path / "map.png",
+    ).exists()
+    assert plots.plot_routing_hydrograph(
+        rng.uniform(0, 5, (3, 40)), t, ["a", "b", "c"], tmp_path / "hydro.png"
+    ).exists()
