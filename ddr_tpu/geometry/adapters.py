@@ -1,0 +1,121 @@
+"""Attribute adapters: map external attribute sources (HydroATLAS) onto the 10
+canonical MERIT attribute names the trained KAN expects
+(reference /root/reference/src/ddr/geometry/adapters.py:22-168).
+
+Datasets here are plain ``{name: (N,) ndarray}`` mappings (the AttributeStore view) —
+no xarray in this stack; the conversion math (scale, offset, log10 for upstream area)
+is identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "MERIT_ATTRIBUTE_NAMES",
+    "AttributeMapping",
+    "HYDROATLAS_TO_MERIT",
+    "detect_source",
+    "adapt_attributes",
+]
+
+# The KAN's native input format (reference adapters.py:22-33).
+MERIT_ATTRIBUTE_NAMES = (
+    "SoilGrids1km_clay",
+    "aridity",
+    "meanelevation",
+    "meanP",
+    "NDVI",
+    "meanslope",
+    "log10_uparea",
+    "SoilGrids1km_sand",
+    "ETPOT_Hargr",
+    "Porosity",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AttributeMapping:
+    """One external->MERIT conversion: ``merit = f(scale * src + offset)`` with an
+    optional log10 (used for upstream area)."""
+
+    merit_name: str
+    scale: float = 1.0
+    offset: float = 0.0
+    log_transform: bool = False
+
+
+# HydroATLAS long-term sub-basin averages -> MERIT names (reference adapters.py:61-72).
+HYDROATLAS_TO_MERIT: dict[str, AttributeMapping] = {
+    "cly_pc_sav": AttributeMapping(merit_name="SoilGrids1km_clay"),
+    "ari_ix_sav": AttributeMapping(merit_name="aridity"),
+    "ele_mt_sav": AttributeMapping(merit_name="meanelevation"),
+    "pre_mm_syr": AttributeMapping(merit_name="meanP"),
+    "ndv_ix_sav": AttributeMapping(merit_name="NDVI"),
+    "slp_dg_sav": AttributeMapping(merit_name="meanslope"),
+    "upa_sk_smx": AttributeMapping(merit_name="log10_uparea", log_transform=True),
+    "snd_pc_sav": AttributeMapping(merit_name="SoilGrids1km_sand"),
+    "pet_mm_syr": AttributeMapping(merit_name="ETPOT_Hargr"),
+    "por_pc_sav": AttributeMapping(merit_name="Porosity"),
+}
+
+_KNOWN_SOURCES: dict[str, dict[str, AttributeMapping]] = {
+    "hydroatlas": HYDROATLAS_TO_MERIT,
+}
+
+
+def detect_source(attrs: Mapping[str, np.ndarray]) -> str | None:
+    """Detect the attribute source from variable names; None when ambiguous."""
+    names = set(attrs)
+    if names >= set(MERIT_ATTRIBUTE_NAMES):
+        return "merit"
+    for source_name, mapping in _KNOWN_SOURCES.items():
+        if names >= set(mapping):
+            return source_name
+    return None
+
+
+def adapt_attributes(
+    attrs: Mapping[str, np.ndarray], source: str = "auto"
+) -> dict[str, np.ndarray]:
+    """Convert external attributes to MERIT names/units, ordered canonically."""
+    if source == "auto":
+        detected = detect_source(attrs)
+        if detected is None:
+            raise ValueError(
+                f"Cannot auto-detect attribute source from variables: {sorted(attrs)}. "
+                f"Expected MERIT names {MERIT_ATTRIBUTE_NAMES} or HydroATLAS names "
+                f"{sorted(HYDROATLAS_TO_MERIT)}. Specify source='merit' or "
+                f"source='hydroatlas'."
+            )
+        source = detected
+
+    if source == "merit":
+        missing = set(MERIT_ATTRIBUTE_NAMES) - set(attrs)
+        if missing:
+            raise ValueError(f"Missing MERIT attributes: {sorted(missing)}")
+        return {name: np.asarray(attrs[name]) for name in MERIT_ATTRIBUTE_NAMES}
+
+    mapping = _KNOWN_SOURCES.get(source)
+    if mapping is None:
+        raise ValueError(
+            f"Unknown attribute source: {source!r}. Known sources: {sorted(_KNOWN_SOURCES)}"
+        )
+    missing = set(mapping) - set(attrs)
+    if missing:
+        raise ValueError(f"Missing {source} attributes: {sorted(missing)}")
+
+    converted: dict[str, np.ndarray] = {}
+    for src_name, m in mapping.items():
+        values = np.asarray(attrs[src_name], dtype=np.float64) * m.scale + m.offset
+        if m.log_transform:
+            values = np.log10(np.clip(values, 1e-6, None))
+        converted[m.merit_name] = values
+    log.info(f"Converted {len(converted)} attributes from {source} to MERIT format")
+    return {name: converted[name] for name in MERIT_ATTRIBUTE_NAMES}

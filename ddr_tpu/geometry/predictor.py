@@ -1,0 +1,214 @@
+"""Standalone geometry predictor over a trained KAN checkpoint
+(reference /root/reference/src/ddr/geometry/predictor.py:41-414).
+
+Decouples spatial-parameter prediction + trapezoidal geometry from the routing
+pipeline: attributes in, full channel cross-section out. Attribute datasets are
+``{name: (N,) ndarray}`` mappings; inference is one jitted KAN forward.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.geometry.adapters import adapt_attributes
+from ddr_tpu.geometry.trapezoidal import trapezoidal_geometry
+from ddr_tpu.routing.mc import denormalize
+from ddr_tpu.routing.model import denormalize_spatial_parameters
+from ddr_tpu.training import load_state
+from ddr_tpu.validation.configs import Config, load_config
+
+log = logging.getLogger(__name__)
+
+__all__ = ["GeometryPredictor"]
+
+
+class GeometryPredictor:
+    """Predict trapezoidal channel geometry from catchment attributes + discharge."""
+
+    def __init__(
+        self,
+        kan_model: Any,
+        kan_params: Any,
+        attribute_names: list[str],
+        means: np.ndarray,
+        stds: np.ndarray,
+        parameter_ranges: dict[str, list[float]],
+        log_space_parameters: list[str],
+        defaults: dict[str, float],
+        attribute_minimums: dict[str, float],
+        stats_ranges: dict[str, dict[str, float]] | None = None,
+    ) -> None:
+        self._kan = kan_model
+        self._params = kan_params
+        self._attribute_names = attribute_names
+        self._means = np.asarray(means, dtype=np.float32)
+        self._stds = np.asarray(stds, dtype=np.float32)
+        self._parameter_ranges = parameter_ranges
+        self._log_space_parameters = log_space_parameters
+        self._defaults = defaults
+        self._attribute_minimums = attribute_minimums
+        self._stats_ranges = stats_ranges
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint_path: str | Path,
+        config_path: str | Path,
+        stats_path: str | Path | None = None,
+    ) -> "GeometryPredictor":
+        """Rebuild the KAN from its training config + checkpoint and load the saved
+        normalization statistics (reference predictor.py:98-162)."""
+        from ddr_tpu.scripts.common import build_kan
+
+        cfg = load_config(config_path, overrides=["mode=routing"], save_config=False)
+        kan_model, _ = build_kan(cfg)
+        params = load_state(checkpoint_path)["params"]
+        attribute_names = list(cfg.kan.input_var_names)
+        means, stds, stats_ranges = cls._load_normalization_stats(
+            cfg, attribute_names, stats_path
+        )
+        return cls(
+            kan_model=kan_model,
+            kan_params=params,
+            attribute_names=attribute_names,
+            means=means,
+            stds=stds,
+            parameter_ranges=cfg.params.parameter_ranges,
+            log_space_parameters=cfg.params.log_space_parameters,
+            defaults=cfg.params.defaults,
+            attribute_minimums=cfg.params.attribute_minimums,
+            stats_ranges=stats_ranges,
+        )
+
+    def predict(
+        self,
+        attributes: Mapping[str, np.ndarray],
+        discharge: np.ndarray,
+        slope: np.ndarray,
+        source: str = "auto",
+    ) -> dict[str, np.ndarray]:
+        """Full geometry + learned parameters per reach
+        (reference predictor.py:164-239). Returns ``top_width``, ``depth``,
+        ``bottom_width``, ``side_slope``, ``cross_sectional_area``,
+        ``wetted_perimeter``, ``hydraulic_radius``, ``velocity``, ``n``,
+        ``p_spatial``, ``q_spatial``."""
+        adapted = adapt_attributes(attributes, source=source)
+        self._check_distribution(adapted)
+        attr = self._prepare_attributes(adapted)  # (N, A) normalized
+
+        n, p_spatial, q_spatial = self._predict_parameters(attr)
+
+        mins = self._attribute_minimums
+        q = jnp.maximum(jnp.asarray(discharge, jnp.float32), mins.get("discharge", 0.0001))
+        s = jnp.maximum(jnp.asarray(slope, jnp.float32), mins.get("slope", 0.001))
+        geometry = trapezoidal_geometry(
+            n=n,
+            p_spatial=p_spatial,
+            q_spatial=q_spatial,
+            discharge=q,
+            slope=s,
+            depth_lb=mins.get("depth", 0.01),
+            bottom_width_lb=mins.get("bottom_width", 0.01),
+        )
+        out = {k: np.asarray(v) for k, v in geometry.items()}
+        out["n"] = np.asarray(n)
+        out["p_spatial"] = np.asarray(p_spatial)
+        out["q_spatial"] = np.asarray(q_spatial)
+        return out
+
+    def predict_parameters(self, normalized_attributes: np.ndarray) -> dict[str, jnp.ndarray]:
+        """Physical parameters from already-normalized ``(N, A)`` attributes (the
+        batched path used by the geometry_predictor script over millions of reaches)."""
+        raw = self._kan.apply(self._params, jnp.asarray(normalized_attributes))
+        return denormalize_spatial_parameters(
+            raw,
+            self._parameter_ranges,
+            self._log_space_parameters,
+            self._defaults,
+            normalized_attributes.shape[0],
+        )
+
+    def _prepare_attributes(self, adapted: Mapping[str, np.ndarray]) -> jnp.ndarray:
+        arrays = []
+        for i, name in enumerate(self._attribute_names):
+            arr = np.asarray(adapted[name], dtype=np.float32)
+            nan_mask = np.isnan(arr)
+            if nan_mask.any():
+                arr = np.where(nan_mask, self._means[i], arr)
+                log.info(
+                    f"Attribute {name}: filled {int(nan_mask.sum())} NaN values with training mean"
+                )
+            arrays.append(arr)
+        raw = np.stack(arrays, axis=0)  # (A, N)
+        normalized = (raw - self._means[:, None]) / self._stds[:, None]
+        return jnp.asarray(normalized.T)
+
+    def _predict_parameters(self, attr: jnp.ndarray):
+        raw = self._kan.apply(self._params, attr)
+        ls = self._log_space_parameters
+        n = denormalize(raw["n"], tuple(self._parameter_ranges["n"]), "n" in ls)
+        q_spatial = denormalize(
+            raw["q_spatial"], tuple(self._parameter_ranges["q_spatial"]), "q_spatial" in ls
+        )
+        if "p_spatial" in raw and "p_spatial" in self._parameter_ranges:
+            p_spatial = denormalize(
+                raw["p_spatial"], tuple(self._parameter_ranges["p_spatial"]), "p_spatial" in ls
+            )
+        else:
+            default_p = self._defaults.get("p_spatial", 21.0)
+            p_spatial = jnp.full_like(n, default_p)
+            log.info(f"p_spatial not learned; using default value {default_p:.1f}")
+        return n, p_spatial, q_spatial
+
+    def _check_distribution(self, adapted: Mapping[str, np.ndarray]) -> None:
+        """Warn on attributes outside the training p10/p90 band
+        (reference predictor.py:320-350)."""
+        if self._stats_ranges is None:
+            return
+        for name in self._attribute_names:
+            if name not in self._stats_ranges:
+                continue
+            p10 = self._stats_ranges[name]["p10"]
+            p90 = self._stats_ranges[name]["p90"]
+            values = np.asarray(adapted[name])
+            below = int(np.sum(values < p10))
+            above = int(np.sum(values > p90))
+            if below or above:
+                log.warning(
+                    f"Attribute {name}: {below}/{values.size} values below training p10 "
+                    f"({p10:.3f}), {above}/{values.size} above training p90 ({p90:.3f})"
+                )
+
+    @staticmethod
+    def _load_normalization_stats(
+        cfg: Config, attribute_names: list[str], stats_path: str | Path | None
+    ) -> tuple[np.ndarray, np.ndarray, dict[str, dict[str, float]]]:
+        if stats_path is not None:
+            json_path = Path(stats_path)
+        else:
+            stats_dir = Path(cfg.data_sources.statistics)
+            attr_source = Path(str(cfg.data_sources.attributes)).name
+            json_path = (
+                stats_dir / f"{cfg.geodataset.value}_attribute_statistics_{attr_source}.json"
+            )
+        if not json_path.exists():
+            raise FileNotFoundError(
+                f"Attribute statistics file not found: {json_path}. Provide stats_path "
+                "explicitly or run training first to generate statistics."
+            )
+        log.info(f"Loading normalization statistics from {json_path}")
+        stats = json.loads(json_path.read_text())
+        means, stds, ranges = [], [], {}
+        for attr in attribute_names:
+            if attr not in stats:
+                raise KeyError(f"Attribute {attr!r} not found in statistics file {json_path}")
+            means.append(float(stats[attr]["mean"]))
+            stds.append(float(stats[attr]["std"]))
+            ranges[attr] = {"p10": float(stats[attr]["p10"]), "p90": float(stats[attr]["p90"])}
+        return np.asarray(means, np.float32), np.asarray(stds, np.float32), ranges

@@ -1,0 +1,53 @@
+"""Per-reach temporal geometry statistics over daily accumulated discharge
+(reference /root/reference/src/ddr/geometry/statistics.py:20-83).
+
+The reference loops Python-per-day; here the geometry is computed for all days at
+once — ``trapezoidal_geometry`` is elementwise, so broadcasting the ``(n_days, N)``
+discharge against the ``(N,)`` parameters is a single fused XLA kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.geometry.trapezoidal import trapezoidal_geometry
+
+__all__ = ["compute_geometry_statistics", "GEOMETRY_VARS"]
+
+GEOMETRY_VARS = ("depth", "top_width", "bottom_width", "side_slope", "hydraulic_radius")
+
+
+def compute_geometry_statistics(
+    n: jnp.ndarray,
+    p_spatial: jnp.ndarray,
+    q_spatial: jnp.ndarray,
+    slope: jnp.ndarray,
+    daily_accumulated_discharge: np.ndarray,
+    attribute_minimums: dict[str, float] | None = None,
+) -> dict[str, np.ndarray]:
+    """min/max/median/mean per reach for each geometry variable + discharge.
+
+    ``daily_accumulated_discharge``: ``(n_days, N)`` m^3/s. Returns
+    ``{var}_{min,max,median,mean}`` arrays of shape ``(N,)``.
+    """
+    mins = attribute_minimums or {}
+    geo = trapezoidal_geometry(
+        n=jnp.asarray(n)[None, :],
+        p_spatial=jnp.asarray(p_spatial)[None, :],
+        q_spatial=jnp.asarray(q_spatial)[None, :],
+        discharge=jnp.asarray(daily_accumulated_discharge, jnp.float32),
+        slope=jnp.asarray(slope)[None, :],
+        depth_lb=mins.get("depth", 0.01),
+        bottom_width_lb=mins.get("bottom_width", 0.01),
+    )
+
+    result: dict[str, np.ndarray] = {}
+    series = {var: np.asarray(geo[var]) for var in GEOMETRY_VARS}
+    series["discharge"] = np.asarray(daily_accumulated_discharge)
+    for var, arr in series.items():
+        result[f"{var}_min"] = np.nanmin(arr, axis=0).astype(np.float32)
+        result[f"{var}_max"] = np.nanmax(arr, axis=0).astype(np.float32)
+        result[f"{var}_median"] = np.nanmedian(arr, axis=0).astype(np.float32)
+        result[f"{var}_mean"] = np.nanmean(arr, axis=0).astype(np.float32)
+    return result

@@ -1,0 +1,116 @@
+"""``ddr geometry-predictor`` — domain-wide channel-geometry product
+(reference /root/reference/scripts/geometry_predictor.py:45-309): run the trained KAN
+over every reach (chunked, 50k at a time), accumulate daily discharge with the
+hotstart solve ``(I - N) Q = q'`` for each day (vmapped over days — one XLA program,
+not a Python per-day loop), and write per-reach geometry statistics.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.geometry.statistics import compute_geometry_statistics
+from ddr_tpu.io import zarrlite
+from ddr_tpu.routing.model import prepare_batch
+from ddr_tpu.routing.solver import solve_lower_triangular
+from ddr_tpu.scripts.common import build_kan, get_flow_fn, parse_cli, timed
+from ddr_tpu.routing.model import denormalize_spatial_parameters
+from ddr_tpu.training import load_state
+from ddr_tpu.validation.configs import Config
+
+log = logging.getLogger(__name__)
+
+KAN_BATCH = 50_000  # reference geometry_predictor.py:83-106
+
+
+def _predict_kan_params(cfg: Config, kan_model, params, normalized_attrs: np.ndarray):
+    """Chunked KAN inference over all reaches (reference :45-115)."""
+    n = normalized_attrs.shape[0]
+    outs: dict[str, list[np.ndarray]] = {}
+    for start in range(0, n, KAN_BATCH):
+        chunk = jnp.asarray(normalized_attrs[start : start + KAN_BATCH])
+        raw = kan_model.apply(params, chunk)
+        spatial = denormalize_spatial_parameters(
+            raw,
+            cfg.params.parameter_ranges,
+            cfg.params.log_space_parameters,
+            cfg.params.defaults,
+            chunk.shape[0],
+        )
+        for k, v in spatial.items():
+            outs.setdefault(k, []).append(np.asarray(v))
+    return {k: np.concatenate(v) for k, v in outs.items()}
+
+
+def generate_geometry_dataset(cfg: Config, dataset=None) -> Path:
+    dataset = dataset or cfg.geodataset.get_dataset_class(cfg)
+    rd = dataset.routing_data
+    assert rd is not None, "geometry predictor requires an inference-mode dataset"
+
+    kan_model, fresh = build_kan(cfg)
+    params = (
+        load_state(cfg.experiment.checkpoint)["params"] if cfg.experiment.checkpoint else fresh
+    )
+    if not cfg.experiment.checkpoint:
+        log.warning("No checkpoint configured; using untrained KAN parameters")
+
+    spatial = _predict_kan_params(cfg, kan_model, params, rd.normalized_spatial_attributes)
+
+    # Daily accumulated discharge: (I - N) Q = q'_day for every day at once
+    # (reference :193-213 loops days; vmap turns it into one program).
+    network, channels, _ = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    flow = get_flow_fn(cfg, dataset)
+    q_hourly = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+    q_daily_lateral = q_hourly[::24]  # one sample per day (daily stores repeat x24)
+    ones = jnp.ones(network.n, dtype=jnp.float32)
+    accumulate = jax.jit(jax.vmap(lambda b: solve_lower_triangular(network, ones, b)))
+    q_acc = np.asarray(accumulate(jnp.asarray(q_daily_lateral)))
+    q_acc = np.maximum(q_acc, cfg.params.attribute_minimums["discharge"])
+
+    stats = compute_geometry_statistics(
+        n=spatial["n"],
+        p_spatial=spatial["p_spatial"],
+        q_spatial=spatial["q_spatial"],
+        slope=np.asarray(channels.slope),
+        daily_accumulated_discharge=q_acc,
+        attribute_minimums=cfg.params.attribute_minimums,
+    )
+
+    out_path = Path(cfg.params.save_path) / "geometry_statistics.zarr"
+    root = zarrlite.create_group(out_path)
+    for k, v in stats.items():
+        root.create_array(k, v)
+    for k in ("n", "p_spatial", "q_spatial"):
+        root.create_array(k, spatial[k].astype(np.float32))
+    root.attrs.update(
+        {
+            "description": "Per-reach channel geometry statistics",
+            "divide_ids": [str(d) for d in np.asarray(rd.divide_ids)],
+            "start_time": cfg.experiment.start_time,
+            "end_time": cfg.experiment.end_time,
+            "version": os.environ.get("DDR_VERSION", "dev"),
+            "model": str(cfg.experiment.checkpoint or "No Trained Model"),
+        }
+    )
+    log.info(f"Geometry statistics written to {out_path}")
+    return out_path
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = parse_cli(argv, mode="routing")
+    with timed("geometry-predictor"):
+        try:
+            generate_geometry_dataset(cfg)
+        except KeyboardInterrupt:
+            log.info("Keyboard interrupt received")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

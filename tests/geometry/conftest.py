@@ -1,0 +1,1 @@
+from tests.geodatazoo.conftest import fabric_dir, merit_cfg  # noqa: F401  (shared fixtures)

@@ -1,0 +1,205 @@
+"""Geometry subsystem tests: adapters, statistics, predictor round-trip through a real
+training checkpoint (reference tests/geometry/*)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.geometry.adapters import (
+    HYDROATLAS_TO_MERIT,
+    MERIT_ATTRIBUTE_NAMES,
+    adapt_attributes,
+    detect_source,
+)
+from ddr_tpu.geometry.statistics import compute_geometry_statistics
+
+
+class TestAdapters:
+    def _merit(self, n=5):
+        rng = np.random.default_rng(0)
+        return {name: rng.uniform(1, 10, n) for name in MERIT_ATTRIBUTE_NAMES}
+
+    def _hydroatlas(self, n=5):
+        rng = np.random.default_rng(0)
+        return {name: rng.uniform(1, 10, n) for name in HYDROATLAS_TO_MERIT}
+
+    def test_detect_merit(self):
+        assert detect_source(self._merit()) == "merit"
+
+    def test_detect_hydroatlas(self):
+        assert detect_source(self._hydroatlas()) == "hydroatlas"
+
+    def test_detect_unknown(self):
+        assert detect_source({"foo": np.zeros(3)}) is None
+
+    def test_adapt_merit_noop_ordered(self):
+        out = adapt_attributes(self._merit())
+        assert list(out) == list(MERIT_ATTRIBUTE_NAMES)
+
+    def test_adapt_hydroatlas_log_transform(self):
+        src = self._hydroatlas()
+        src["upa_sk_smx"] = np.array([1.0, 10.0, 100.0, 1000.0, 10000.0])
+        out = adapt_attributes(src)
+        np.testing.assert_allclose(out["log10_uparea"], [0, 1, 2, 3, 4], atol=1e-9)
+        np.testing.assert_allclose(out["SoilGrids1km_clay"], src["cly_pc_sav"])
+
+    def test_adapt_missing_raises(self):
+        src = self._hydroatlas()
+        del src["cly_pc_sav"]
+        with pytest.raises(ValueError, match="Cannot auto-detect"):
+            adapt_attributes(src)
+        with pytest.raises(ValueError, match="Missing hydroatlas"):
+            adapt_attributes(src, source="hydroatlas")
+
+    def test_unknown_source_raises(self):
+        with pytest.raises(ValueError, match="Unknown attribute source"):
+            adapt_attributes(self._merit(), source="nonsense")
+
+
+class TestStatistics:
+    def test_shapes_and_monotonicity(self):
+        rng = np.random.default_rng(1)
+        n_reach, n_days = 12, 30
+        q = rng.uniform(0.5, 50, size=(n_days, n_reach))
+        stats = compute_geometry_statistics(
+            n=np.full(n_reach, 0.05),
+            p_spatial=np.full(n_reach, 21.0),
+            q_spatial=np.full(n_reach, 0.4),
+            slope=rng.uniform(1e-3, 0.02, n_reach),
+            daily_accumulated_discharge=q,
+        )
+        assert stats["depth_min"].shape == (n_reach,)
+        assert (stats["depth_min"] <= stats["depth_median"]).all()
+        assert (stats["depth_median"] <= stats["depth_max"]).all()
+        assert (stats["top_width_min"] > 0).all()
+        np.testing.assert_allclose(stats["discharge_mean"], q.mean(0), rtol=1e-6)
+
+    def test_more_discharge_more_depth(self):
+        n_reach = 4
+        base = dict(
+            n=np.full(n_reach, 0.05),
+            p_spatial=np.full(n_reach, 21.0),
+            q_spatial=np.full(n_reach, 0.4),
+            slope=np.full(n_reach, 0.005),
+        )
+        lo = compute_geometry_statistics(
+            **base, daily_accumulated_discharge=np.full((5, n_reach), 1.0)
+        )
+        hi = compute_geometry_statistics(
+            **base, daily_accumulated_discharge=np.full((5, n_reach), 100.0)
+        )
+        assert (hi["depth_mean"] > lo["depth_mean"]).all()
+        assert (hi["top_width_mean"] > lo["top_width_mean"]).all()
+
+
+class TestPredictor:
+    @pytest.fixture()
+    def trained_run(self, tmp_path):
+        """Train one synthetic mini-batch so a real checkpoint + stats JSON exist."""
+        import json
+
+        import yaml
+
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.training import latest_checkpoint
+        from ddr_tpu.validation.configs import Config
+
+        cfg_dict = {
+            "name": "geom_test",
+            "geodataset": "synthetic",
+            "mode": "training",
+            "kan": {"input_var_names": list(MERIT_ATTRIBUTE_NAMES)},
+            "experiment": {
+                "start_time": "1981/10/01",
+                "end_time": "1981/10/15",
+                "rho": 6,
+                "batch_size": 4,
+                "epochs": 1,
+                "learning_rate": {1: 0.01},
+                "warmup": 1,
+            },
+            "params": {"save_path": str(tmp_path)},
+            "data_sources": {
+                "attributes": "synthetic_attrs",
+                "statistics": str(tmp_path / "stats"),
+            },
+        }
+        cfg = Config(**cfg_dict)
+        train(cfg, max_batches=1)
+        ckpt = latest_checkpoint(tmp_path / "saved_models")
+
+        # Stats JSON in the cache location the predictor auto-detects.
+        stats_dir = tmp_path / "stats"
+        stats_dir.mkdir(exist_ok=True)
+        rng = np.random.default_rng(2)
+        stats = {
+            name: {
+                "min": 0.0, "max": 10.0, "mean": 5.0, "std": 2.0, "p10": 1.0, "p90": 9.0,
+            }
+            for name in MERIT_ATTRIBUTE_NAMES
+        }
+        (stats_dir / "synthetic_attribute_statistics_synthetic_attrs.json").write_text(
+            json.dumps(stats)
+        )
+        cfg_path = tmp_path / "config.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg_dict))
+        return cfg_path, ckpt
+
+    def test_from_checkpoint_and_predict(self, trained_run, caplog):
+        from ddr_tpu.geometry.predictor import GeometryPredictor
+
+        cfg_path, ckpt = trained_run
+        predictor = GeometryPredictor.from_checkpoint(ckpt, cfg_path)
+        rng = np.random.default_rng(3)
+        n = 20
+        attrs = {name: rng.uniform(2, 8, n) for name in MERIT_ATTRIBUTE_NAMES}
+        result = predictor.predict(attrs, discharge=rng.uniform(1, 50, n), slope=rng.uniform(1e-3, 0.02, n))
+        for key in ("top_width", "depth", "velocity", "n", "p_spatial", "q_spatial"):
+            assert result[key].shape == (n,)
+            assert np.isfinite(result[key]).all()
+        lo, hi = predictor._parameter_ranges["n"]
+        assert (result["n"] >= lo - 1e-6).all() and (result["n"] <= hi + 1e-6).all()
+
+    def test_ood_warning(self, trained_run, caplog):
+        from ddr_tpu.geometry.predictor import GeometryPredictor
+
+        cfg_path, ckpt = trained_run
+        predictor = GeometryPredictor.from_checkpoint(ckpt, cfg_path)
+        n = 10
+        attrs = {name: np.full(n, 100.0) for name in MERIT_ATTRIBUTE_NAMES}  # way above p90
+        with caplog.at_level("WARNING"):
+            predictor.predict(attrs, discharge=np.ones(n), slope=np.full(n, 0.01))
+        assert "above training p90" in caplog.text
+
+    def test_nan_filled_with_training_mean(self, trained_run, caplog):
+        from ddr_tpu.geometry.predictor import GeometryPredictor
+
+        cfg_path, ckpt = trained_run
+        predictor = GeometryPredictor.from_checkpoint(ckpt, cfg_path)
+        n = 10
+        attrs = {name: np.full(n, 5.0) for name in MERIT_ATTRIBUTE_NAMES}
+        attrs["aridity"][3] = np.nan
+        result = predictor.predict(attrs, discharge=np.ones(n), slope=np.full(n, 0.01))
+        assert np.isfinite(result["n"]).all()
+
+
+class TestGeometryScript:
+    def test_script_on_merit_fixture(self, merit_cfg, tmp_path):
+        from ddr_tpu.io import zarrlite
+        from ddr_tpu.scripts.geometry_predictor import generate_geometry_dataset
+
+        cfg = merit_cfg.model_copy(deep=True)
+        cfg.mode = "routing"
+        cfg.experiment.rho = None
+        cfg.data_sources.gages = None
+        cfg.data_sources.gages_adjacency = None
+        cfg.params.save_path = tmp_path
+        out = generate_geometry_dataset(cfg)
+        root = zarrlite.open_group(out)
+        depth_med = root["depth_median"].read()
+        assert depth_med.shape == (10,)
+        assert np.isfinite(depth_med).all()
+        # Downstream-most reaches accumulate more discharge.
+        q_mean = root["discharge_mean"].read()
+        assert q_mean[9] > q_mean[0]
