@@ -1,0 +1,195 @@
+"""Graph preprocessing API over the native C++ core (ctypes) with NumPy fallbacks.
+
+The reference engine leans on rustworkx (Rust) for topological sorts, cycle
+detection, and ancestor queries (/root/reference/engine/src/ddr_engine/merit/graph.py,
+io/builders.py:7). Here the same operations are served by the in-repo C++ library
+(``native/graph.cpp``), compiled on first use with the system ``g++`` and loaded via
+ctypes — no pybind11 needed. If no compiler is available the NumPy implementations
+take over; both paths break ties by smallest node index, so results are identical.
+
+All functions operate on ``(src, dst)`` edge arrays — src drains into dst — over
+nodes ``0..n-1``; id<->index mapping is the caller's concern (the builders keep it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import heapq
+import logging
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "topological_sort",
+    "longest_path_levels",
+    "cycle_nodes",
+    "ancestors_mask",
+    "native_available",
+]
+
+_NATIVE: ctypes.CDLL | None = None
+_NATIVE_TRIED = False
+_SRC = Path(__file__).parent / "native" / "graph.cpp"
+_LIB = Path(__file__).parent / "native" / "_graph.so"
+
+
+def _load_native() -> ctypes.CDLL | None:
+    global _NATIVE, _NATIVE_TRIED
+    if _NATIVE_TRIED:
+        return _NATIVE
+    _NATIVE_TRIED = True
+    try:
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            with tempfile.NamedTemporaryFile(suffix=".so", dir=_LIB.parent, delete=False) as tmp:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", tmp.name],
+                    check=True,
+                    capture_output=True,
+                )
+                Path(tmp.name).replace(_LIB)
+        lib = ctypes.CDLL(str(_LIB))
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.ddr_topo_sort.restype = ctypes.c_int64
+        lib.ddr_topo_sort.argtypes = [ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p]
+        lib.ddr_levels.restype = ctypes.c_int64
+        lib.ddr_levels.argtypes = [ctypes.c_int64, ctypes.c_int64, i64p, i64p, i32p]
+        lib.ddr_cycle_nodes.restype = ctypes.c_int64
+        lib.ddr_cycle_nodes.argtypes = [ctypes.c_int64, ctypes.c_int64, i64p, i64p, u8p]
+        lib.ddr_ancestors.restype = ctypes.c_int64
+        lib.ddr_ancestors.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, i64p, i64p, ctypes.c_int64, i64p, u8p,
+        ]
+        _NATIVE = lib
+        log.debug("native graph core loaded")
+    except Exception as e:  # pragma: no cover - depends on toolchain
+        log.warning(f"native graph core unavailable ({e}); using NumPy fallback")
+        _NATIVE = None
+    return _NATIVE
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def _as_edges(src: np.ndarray, dst: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    return (
+        np.ascontiguousarray(src, dtype=np.int64),
+        np.ascontiguousarray(dst, dtype=np.int64),
+    )
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def topological_sort(src: np.ndarray, dst: np.ndarray, n: int) -> np.ndarray:
+    """Deterministic (smallest-index-first) topological order of all ``n`` nodes.
+
+    Raises ``ValueError`` when the graph has a cycle (mirrors rustworkx
+    ``DAGHasCycle``, reference merit/build.py:50-53).
+    """
+    src, dst = _as_edges(src, dst)
+    lib = _load_native()
+    if lib is not None:
+        out = np.empty(n, dtype=np.int64)
+        count = lib.ddr_topo_sort(
+            n, len(src), _ptr(src, ctypes.c_int64), _ptr(dst, ctypes.c_int64),
+            _ptr(out, ctypes.c_int64),
+        )
+        if count < n:
+            raise ValueError(f"graph has a cycle: only {count}/{n} nodes sortable")
+        return out
+    # NumPy/heapq fallback — identical tie-breaking.
+    indeg = np.bincount(dst, minlength=n)
+    succ: list[list[int]] = [[] for _ in range(n)]
+    for s, d in zip(src.tolist(), dst.tolist()):
+        succ[s].append(d)
+    ready = [i for i in range(n) if indeg[i] == 0]
+    heapq.heapify(ready)
+    order = []
+    while ready:
+        u = heapq.heappop(ready)
+        order.append(u)
+        for v in succ[u]:
+            indeg[v] -= 1
+            if indeg[v] == 0:
+                heapq.heappush(ready, v)
+    if len(order) < n:
+        raise ValueError(f"graph has a cycle: only {len(order)}/{n} nodes sortable")
+    return np.asarray(order, dtype=np.int64)
+
+
+def longest_path_levels(src: np.ndarray, dst: np.ndarray, n: int) -> np.ndarray:
+    """Longest-path level per node (headwaters = 0); raises on cycles."""
+    src, dst = _as_edges(src, dst)
+    lib = _load_native()
+    if lib is not None:
+        out = np.empty(n, dtype=np.int32)
+        depth = lib.ddr_levels(
+            n, len(src), _ptr(src, ctypes.c_int64), _ptr(dst, ctypes.c_int64),
+            _ptr(out, ctypes.c_int32),
+        )
+        if depth < 0:
+            raise ValueError("adjacency contains a cycle")
+        return out
+    from ddr_tpu.routing.network import compute_levels
+
+    return compute_levels(dst, src, n)  # compute_levels takes (rows=down, cols=up)
+
+
+def cycle_nodes(src: np.ndarray, dst: np.ndarray, n: int) -> np.ndarray:
+    """Indices of nodes lying on at least one directed cycle (the removal set for
+    the reference's cycle repair, merit/build.py:53-73)."""
+    src, dst = _as_edges(src, dst)
+    lib = _load_native()
+    if lib is not None:
+        mask = np.empty(n, dtype=np.uint8)
+        lib.ddr_cycle_nodes(
+            n, len(src), _ptr(src, ctypes.c_int64), _ptr(dst, ctypes.c_int64),
+            _ptr(mask, ctypes.c_uint8),
+        )
+        return np.flatnonzero(mask)
+    # Fallback: iteratively peel nodes with zero in- or out-degree.
+    indeg = np.bincount(dst, minlength=n)
+    outdeg = np.bincount(src, minlength=n)
+    alive = np.ones(n, dtype=bool)
+    changed = True
+    while changed:
+        peel = alive & ((indeg == 0) | (outdeg == 0))
+        changed = bool(peel.any())
+        if not changed:
+            break
+        alive &= ~peel
+        keep = alive[src] & alive[dst]
+        indeg = np.bincount(dst[keep], minlength=n)
+        outdeg = np.bincount(src[keep], minlength=n)
+    return np.flatnonzero(alive)
+
+
+def ancestors_mask(
+    src: np.ndarray, dst: np.ndarray, n: int, targets: np.ndarray
+) -> np.ndarray:
+    """Boolean mask of every node with a path to any target (targets included) —
+    the rustworkx ``ancestors`` closure."""
+    src, dst = _as_edges(src, dst)
+    targets = np.ascontiguousarray(targets, dtype=np.int64)
+    lib = _load_native()
+    if lib is not None:
+        mask = np.empty(n, dtype=np.uint8)
+        lib.ddr_ancestors(
+            n, len(src), _ptr(src, ctypes.c_int64), _ptr(dst, ctypes.c_int64),
+            len(targets), _ptr(targets, ctypes.c_int64), _ptr(mask, ctypes.c_uint8),
+        )
+        return mask.astype(bool)
+    from ddr_tpu.io.builders import upstream_closure
+
+    out = np.zeros(n, dtype=bool)
+    out[upstream_closure(dst, src, n, targets)] = True
+    return out

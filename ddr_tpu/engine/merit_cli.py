@@ -1,0 +1,39 @@
+"""CLI for building MERIT adjacency matrices
+(reference python -m ddr_engine.merit, /root/reference/engine/src/ddr_engine/merit/__main__.py:15-54).
+
+Usage::
+
+    python -m ddr_tpu.engine.merit_cli <flowpaths.csv|.parquet> [--path PATH] [--gages CSV]
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from ddr_tpu.engine.merit import _load_fp, build_gauge_adjacencies, build_merit_adjacency
+from ddr_tpu.geodatazoo.dataclasses import MERITGauge, validate_gages
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Create lower triangular adjacency matrices from MERIT hydrofabric data."
+    )
+    parser.add_argument("flowpaths", type=Path, help="Flowpath table (CSV or parquet)")
+    parser.add_argument("--path", type=Path, default=Path("data/"), help="Output directory")
+    parser.add_argument("--gages", type=Path, default=None, help="Gauge CSV (STAID, COMID, ...)")
+    args = parser.parse_args(argv)
+
+    fp = _load_fp(args.flowpaths)
+    out_path = args.path / "merit_conus_adjacency.zarr"
+    build_merit_adjacency(fp, out_path)
+    if args.gages is not None:
+        gauge_set = validate_gages(args.gages, gauge_type=MERITGauge)
+        build_gauge_adjacencies(
+            fp, out_path, gauge_set, args.path / "merit_gages_conus_adjacency.zarr"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,13 +76,9 @@ def eval_q_prime(cfg: Config) -> Metrics:
     for i, gid in enumerate(available):
         sub = gages_adjacency[gid]
         assert isinstance(sub, zarrlite.ZarrGroup)
-        rows_idx = sub["indices_0"].read()
-        cols_idx = sub["indices_1"].read()
-        order = sub["order"].read()
-        active = np.unique(
-            np.concatenate([rows_idx, cols_idx, [int(sub.attrs.get("gage_idx", 0))]])
-        ).astype(np.int64)
-        divide_ids = order[active]
+        # The subset group's ``order`` IS the gauge's upstream divide set
+        # (reference summed_q_prime.py:192-206; binsparse subset convention).
+        divide_ids = sub["order"].read()
 
         store_rows = []
         for divide in divide_ids:

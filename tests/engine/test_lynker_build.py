@@ -1,0 +1,160 @@
+"""Lynker engine build pipeline: network collapse, origin lookup, matrix build,
+sqlite (GeoPackage) attribute extraction, per-gauge subsets, determinism
+(reference tests/engine/lynker_hydrofabric/*)."""
+
+from __future__ import annotations
+
+import sqlite3
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ddr_tpu.engine.core import coo_from_zarr
+from ddr_tpu.engine.lynker import (
+    build_gauge_adjacencies,
+    build_lynker_hydrofabric_adjacency,
+    create_matrix,
+    find_origin,
+    preprocess_river_network,
+    subset,
+    write_flowpath_attributes,
+)
+from ddr_tpu.geodatazoo.dataclasses import Gauge, GaugeSet
+from ddr_tpu.io import zarrlite
+
+# wb-1, wb-2 -> nex-10 -> wb-3; wb-3, wb-4 -> nex-11 -> wb-5; wb-5 -> nex-12 (terminal)
+FLOWPATHS = pd.DataFrame(
+    {
+        "id": ["wb-1", "wb-2", "wb-3", "wb-4", "wb-5"],
+        "toid": ["nex-10", "nex-10", "nex-11", "nex-11", "nex-12"],
+        "tot_drainage_areasqkm": [10.0, 12.0, 30.0, 8.0, 55.0],
+    }
+)
+NETWORK = pd.DataFrame(
+    {
+        "id": ["wb-1", "wb-2", "wb-3", "wb-4", "wb-5", "nex-10", "nex-11", "nex-12"],
+        "toid": ["nex-10", "nex-10", "nex-11", "nex-11", "nex-12", "wb-3", "wb-5", None],
+        "hl_uri": [None, None, "gages-11111111", None, "gages-22222222", None, None, None],
+    }
+)
+
+
+class TestNetworkCollapse:
+    def test_wb_to_wb_collapse(self):
+        d = preprocess_river_network(NETWORK)
+        assert d["wb-3"] == ["wb-1", "wb-2"]
+        assert d["wb-5"] == ["wb-3", "wb-4"]
+
+    def test_subset_traversal(self):
+        d = preprocess_river_network(NETWORK)
+        conns = subset("wb-5", d)
+        assert ("wb-5", "wb-3") in conns and ("wb-3", "wb-1") in conns
+        assert len(conns) == 4
+        assert subset("wb-1", d) == []  # headwater
+
+
+class TestFindOrigin:
+    def test_simple_match(self):
+        g = Gauge(STAID="22222222", STANAME="x", DRAIN_SQKM=50.0)
+        assert find_origin(g, FLOWPATHS, NETWORK) == "wb-5"
+
+    def test_no_match_raises(self):
+        g = Gauge(STAID="99999999", STANAME="x", DRAIN_SQKM=50.0)
+        with pytest.raises(ValueError):
+            find_origin(g, FLOWPATHS, NETWORK)
+
+    def test_tie_break_on_drainage_area(self):
+        network = NETWORK.copy()
+        network.loc[network["id"] == "wb-4", "hl_uri"] = "gages-33333333"
+        network.loc[network["id"] == "wb-3", "hl_uri"] = "gages-33333333"
+        g = Gauge(STAID="33333333", STANAME="x", DRAIN_SQKM=9.0)
+        assert find_origin(g, FLOWPATHS, network) == "wb-4"  # |8-9| < |30-9|
+
+
+class TestCreateMatrix:
+    def test_lower_triangular_dendritic(self):
+        coo, order = create_matrix(FLOWPATHS, NETWORK)
+        assert len(order) == 5
+        assert (coo.row > coo.col).all()
+        assert coo.nnz == 4
+        pos = {w: i for i, w in enumerate(order)}
+        assert pos["wb-1"] < pos["wb-3"] < pos["wb-5"]
+
+    def test_ghost_nodes(self):
+        coo, order = create_matrix(FLOWPATHS, NETWORK, ghost=True)
+        assert any(w.startswith("ghost-") for w in order)
+        assert coo.nnz == 5  # wb-5 -> ghost edge added
+
+    def test_non_dendritic_raises(self):
+        fp = pd.concat(
+            [FLOWPATHS, pd.DataFrame({"id": ["wb-1"], "toid": ["nex-11"], "tot_drainage_areasqkm": [1.0]})]
+        )
+        with pytest.raises(AssertionError, match="not dendritic"):
+            create_matrix(fp, NETWORK)
+
+
+class TestStoresAndAttributes:
+    @pytest.fixture()
+    def gpkg(self, tmp_path):
+        """GeoPackage-style sqlite with flowpaths + flowpath-attributes-ml tables."""
+        path = tmp_path / "hydrofabric.gpkg"
+        with sqlite3.connect(path) as conn:
+            FLOWPATHS[["id", "toid"]].to_sql("flowpaths", conn, index=False)
+            NETWORK[["id", "toid"]].to_sql("network", conn, index=False)
+            pd.DataFrame(
+                {
+                    "id": ["wb-1", "wb-2", "wb-3", "wb-4", "wb-5"],
+                    "Length_m": [1000.0, 1500.0, 2000.0, 900.0, 3000.0],
+                    "So": [0.01, 0.012, 0.007, 0.02, 0.004],
+                    "TopWdth": [5.0, 6.0, 12.0, 4.0, 20.0],
+                    "ChSlp": [1.0, 1.2, 2.0, 0.8, 2.5],
+                    "MusX": [0.25, 0.3, 0.28, 0.22, 0.35],
+                }
+            ).to_sql("flowpath-attributes-ml", conn, index=False)
+        return path
+
+    def test_build_with_gpkg_attributes(self, gpkg, tmp_path):
+        out = build_lynker_hydrofabric_adjacency(
+            FLOWPATHS, NETWORK, tmp_path / "conus.zarr", attributes=gpkg
+        )
+        coo, order = coo_from_zarr(out)
+        assert order == [o for o in order]  # wb strings round-trip
+        g = zarrlite.open_group(out)
+        tw = g["top_width"].read()
+        idx5 = order.index("wb-5")
+        assert tw[idx5] == pytest.approx(20.0)
+        assert g["muskingum_x"].read()[idx5] == pytest.approx(0.35)
+        # toid stores the numeric downstream wb (wb-3 drains to wb-5)
+        assert g["toid"].read()[order.index("wb-3")] == 5
+
+    def test_gauge_subsets(self, gpkg, tmp_path):
+        conus = build_lynker_hydrofabric_adjacency(
+            FLOWPATHS, NETWORK, tmp_path / "conus.zarr", attributes=gpkg
+        )
+        gauges = GaugeSet(
+            gauges=[
+                Gauge(STAID="11111111", STANAME="a", DRAIN_SQKM=30.0),
+                Gauge(STAID="22222222", STANAME="b", DRAIN_SQKM=55.0),
+            ]
+        )
+        out = build_gauge_adjacencies(
+            FLOWPATHS, NETWORK, conus, gauges, tmp_path / "gages.zarr"
+        )
+        root = zarrlite.open_group(out)
+        sub = root["22222222"]
+        # closure of wb-5 = all five reaches
+        assert len(sub["order"].read()) == 5
+        assert sub.attrs["gage_catchment"] == "wb-5"
+        assert (sub["indices_0"].read() > sub["indices_1"].read()).all()
+        sub1 = root["11111111"]
+        assert len(sub1["order"].read()) == 3  # wb-3 closure: {1, 2, 3}
+
+    def test_determinism(self, tmp_path):
+        a = build_lynker_hydrofabric_adjacency(FLOWPATHS, NETWORK, tmp_path / "a.zarr")
+        b = build_lynker_hydrofabric_adjacency(FLOWPATHS, NETWORK, tmp_path / "b.zarr")
+        ca, oa = coo_from_zarr(a)
+        cb, ob = coo_from_zarr(b)
+        assert oa == ob
+        np.testing.assert_array_equal(ca.row, cb.row)
+        np.testing.assert_array_equal(ca.col, cb.col)

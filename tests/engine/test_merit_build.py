@@ -1,0 +1,209 @@
+"""MERIT engine build pipeline: flowpath table -> zarr stores -> dataset -> routing
+(the reference's engine integration strategy, tests/engine/merit/test_integration.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ddr_tpu.engine.core import coo_from_zarr
+from ddr_tpu.engine.merit import (
+    build_gauge_adjacencies,
+    build_merit_adjacency,
+    build_upstream_dict,
+    create_adjacency_matrix,
+)
+from ddr_tpu.geodatazoo.dataclasses import GaugeSet, MERITGauge
+from ddr_tpu.io import zarrlite
+
+
+def _merit_table() -> pd.DataFrame:
+    """11-reach dendritic basin + 1 isolated reach.
+
+    Topology (COMID -> NextDownID): two 3-reach branches joining at 107, a side
+    branch at 108, trunk 107 -> 108 -> 109 -> 110 (outlet). 199 is isolated.
+    """
+    rows = [
+        # COMID, NextDownID, up1..up4, lengthkm, slope
+        (101, 103, 0, 0, 0, 0, 1.2, 0.010),
+        (102, 103, 0, 0, 0, 0, 2.0, 0.012),
+        (103, 107, 101, 102, 0, 0, 1.8, 0.008),
+        (104, 106, 0, 0, 0, 0, 1.1, 0.015),
+        (105, 106, 0, 0, 0, 0, 0.9, 0.014),
+        (106, 107, 104, 105, 0, 0, 2.2, 0.007),
+        (107, 108, 103, 106, 0, 0, 3.0, 0.005),
+        (108, 109, 107, 111, 0, 0, 2.5, 0.004),
+        (109, 110, 108, 0, 0, 0, 4.0, 0.003),
+        (110, 0, 109, 0, 0, 0, 5.0, 0.002),
+        (111, 108, 0, 0, 0, 0, 1.5, 0.02),
+        (199, 0, 0, 0, 0, 0, 0.7, 0.03),  # isolated
+    ]
+    return pd.DataFrame(
+        rows, columns=["COMID", "NextDownID", "up1", "up2", "up3", "up4", "lengthkm", "slope"]
+    )
+
+
+class TestMeritBuild:
+    def test_upstream_dict(self):
+        d = build_upstream_dict(_merit_table())
+        assert d[103] == [101, 102]
+        assert d[108] == [107, 111]
+        assert 199 not in d
+
+    def test_adjacency_lower_triangular_and_complete(self):
+        coo, order = create_adjacency_matrix(_merit_table())
+        assert len(order) == 12  # 11 connected + isolated appended
+        assert order[-1] == 199
+        assert (coo.row > coo.col).all()
+        pos = {c: i for i, c in enumerate(order)}
+        # each edge upstream index < downstream index in topo order
+        for r, c in zip(coo.row, coo.col):
+            assert pos[order[c]] < pos[order[r]]
+        assert coo.nnz == 10  # 11 connected reaches in a tree -> 10 edges
+
+    def test_cycle_removed_and_rebuilt(self):
+        fp = _merit_table()
+        # introduce a cycle: 110 -> 104 (via up columns on 104)
+        fp.loc[fp["COMID"] == 104, "up1"] = 110
+        coo, order = create_adjacency_matrix(fp)
+        # the whole trunk 104..110 participates in the cycle and is removed
+        assert 199 in order
+        assert (coo.row > coo.col).all() if coo.nnz else True
+
+    def test_full_store_roundtrip(self, tmp_path):
+        out = build_merit_adjacency(_merit_table(), tmp_path / "conus.zarr")
+        coo, order = coo_from_zarr(out)
+        assert len(order) == 12
+        g = zarrlite.open_group(out)
+        length_m = g["length_m"].read()
+        assert length_m.shape == (12,)
+        # aligned: outlet 110 has 5.0 km
+        assert length_m[order.index(110)] == pytest.approx(5000.0)
+        assert g["slope"].read()[order.index(110)] == pytest.approx(0.002, abs=1e-6)
+
+    def test_existing_store_raises(self, tmp_path):
+        build_merit_adjacency(_merit_table(), tmp_path / "conus.zarr")
+        with pytest.raises(FileExistsError):
+            build_merit_adjacency(_merit_table(), tmp_path / "conus.zarr")
+
+
+class TestGaugeAdjacencies:
+    @pytest.fixture()
+    def stores(self, tmp_path):
+        fp = _merit_table()
+        conus = build_merit_adjacency(fp, tmp_path / "conus.zarr")
+        gauges = GaugeSet(
+            gauges=[
+                MERITGauge(STAID="1", STANAME="a", DRAIN_SQKM=10, COMID=107),
+                MERITGauge(STAID="2", STANAME="b", DRAIN_SQKM=50, COMID=110),
+                MERITGauge(STAID="3", STANAME="c", DRAIN_SQKM=5, COMID=199),  # isolated
+                MERITGauge(STAID="4", STANAME="d", DRAIN_SQKM=5, COMID=999),  # absent
+            ]
+        )
+        gages = build_gauge_adjacencies(fp, conus, gauges, tmp_path / "gages.zarr")
+        return conus, gages
+
+    def test_subset_contents(self, stores):
+        conus, gages = stores
+        root = zarrlite.open_group(gages)
+        sub = root["00000001"]
+        order = sub["order"].read().tolist()
+        # closure of 107: {101..107}
+        assert sorted(order) == [101, 102, 103, 104, 105, 106, 107]
+        assert sub.attrs["gage_catchment"] == 107
+        conus_order = zarrlite.open_group(conus)["order"].read().tolist()
+        assert sub.attrs["gage_idx"] == conus_order.index(107)
+        # edges are conus-indexed, lower triangular
+        assert (sub["indices_0"].read() > sub["indices_1"].read()).all()
+
+    def test_headwater_subset_is_empty_matrix(self, stores):
+        _, gages = stores
+        root = zarrlite.open_group(gages)
+        sub = root["00000003"]
+        assert sub["indices_0"].shape[0] == 0
+        assert sub["order"].read().tolist() == [199]
+
+    def test_absent_comid_skipped(self, stores):
+        _, gages = stores
+        assert "00000004" not in zarrlite.open_group(gages)
+
+    def test_determinism(self, stores, tmp_path):
+        fp = _merit_table()
+        conus2 = build_merit_adjacency(fp, tmp_path / "conus2.zarr")
+        gauges = GaugeSet(
+            gauges=[MERITGauge(STAID="1", STANAME="a", DRAIN_SQKM=10, COMID=107)]
+        )
+        gages2 = build_gauge_adjacencies(fp, conus2, gauges, tmp_path / "gages2.zarr")
+        a = zarrlite.open_group(stores[1])["00000001"]
+        b = zarrlite.open_group(gages2)["00000001"]
+        np.testing.assert_array_equal(a["order"].read(), b["order"].read())
+        np.testing.assert_array_equal(
+            np.sort(a["indices_0"].read()), np.sort(b["indices_0"].read())
+        )
+
+
+class TestEndToEnd:
+    def test_built_stores_drive_dataset_and_routing(self, tmp_path):
+        """Engine output -> Merit dataset -> routed discharge, no hand-built zarr."""
+        from ddr_tpu.geodatazoo.merit import Merit
+        from ddr_tpu.io.stores import write_attribute_store, write_hydro_store
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.validation.configs import Config
+
+        fp = _merit_table()
+        conus = build_merit_adjacency(fp, tmp_path / "conus.zarr")
+        gauges = GaugeSet(
+            gauges=[
+                MERITGauge(STAID="11111111", STANAME="a", DRAIN_SQKM=100, COMID=107),
+                MERITGauge(STAID="22222222", STANAME="b", DRAIN_SQKM=400, COMID=110),
+            ]
+        )
+        gages = build_gauge_adjacencies(fp, conus, gauges, tmp_path / "gages.zarr")
+
+        rng = np.random.default_rng(0)
+        comids = fp["COMID"].tolist()
+        attr_names = [f"a{i}" for i in range(4)]
+        write_attribute_store(
+            tmp_path / "attrs.zarr",
+            comids,
+            {n: rng.normal(size=len(comids)).astype(np.float32) for n in attr_names},
+        )
+        write_hydro_store(
+            tmp_path / "flow.zarr", comids, "1981/09/25", "D",
+            {"Qr": rng.uniform(0.1, 2.0, (len(comids), 40)).astype(np.float32)},
+        )
+        write_hydro_store(
+            tmp_path / "obs.zarr", ["11111111", "22222222"], "1981/09/25", "D",
+            {"streamflow": rng.uniform(1, 20, (2, 40)).astype(np.float32)},
+            id_dim="gage_id",
+        )
+        (tmp_path / "gages.csv").write_text(
+            "STAID,STANAME,DRAIN_SQKM,LAT_GAGE,LNG_GAGE,COMID,DA_VALID\n"
+            "11111111,a,100,40,-75,107,True\n22222222,b,400,40,-75,110,True\n"
+        )
+
+        cfg = Config(
+            name="engine_e2e",
+            geodataset="merit",
+            mode="training",
+            kan={"input_var_names": attr_names},
+            experiment={
+                "start_time": "1981/10/01", "end_time": "1981/10/20",
+                "rho": 8, "batch_size": 2, "epochs": 1, "learning_rate": {1: 0.01},
+                "warmup": 1,
+            },
+            data_sources={
+                "attributes": str(tmp_path / "attrs.zarr"),
+                "conus_adjacency": str(conus),
+                "streamflow": str(tmp_path / "flow.zarr"),
+                "observations": str(tmp_path / "obs.zarr"),
+                "gages": str(tmp_path / "gages.csv"),
+                "gages_adjacency": str(gages),
+                "statistics": str(tmp_path / "stats"),
+            },
+            params={"save_path": str(tmp_path)},
+        )
+        dataset = Merit(cfg)
+        params, _ = train(cfg, dataset=dataset, max_batches=1)
+        assert params is not None

@@ -51,7 +51,9 @@ def fabric_dir(tmp_path_factory):
     g.create_array("length_m", length)
     g.create_array("slope", slope)
 
-    # per-gauge subsets (conus index space), with gage_idx/gage_catchment attrs
+    # per-gauge subsets (conus index space) with the binsparse subset convention:
+    # ``order`` holds ONLY the subset's ids, ``gage_catchment`` the origin id
+    # (reference core/zarr_io.py coo_to_zarr_group_generic).
     gages = root / "gages_adjacency.zarr"
     sub_root = zarrlite.create_group(gages)
     for staid, seg in GAGE_SEGMENTS.items():
@@ -60,8 +62,10 @@ def fabric_dir(tmp_path_factory):
             (np.ones(len(keep), dtype=np.uint8), ([e[0] for e in keep], [e[1] for e in keep])),
             shape=(N_REACH, N_REACH),
         )
+        members = sorted({seg} | {i for e in keep for i in e})
         coo_to_zarr_group(
-            sub_root, staid, sub, COMIDS, "merit", gage_catchment=staid, gage_idx=seg
+            sub_root, staid, sub, [COMIDS[i] for i in members], "merit",
+            gage_catchment=COMIDS[seg], gage_idx=seg,
         )
 
     # attribute store over the COMIDs (one COMID deliberately missing)

@@ -52,8 +52,9 @@ def lynker_dir(tmp_path_factory):
             (np.ones(len(keep), dtype=np.uint8), ([e[0] for e in keep], [e[1] for e in keep])),
             shape=(N_REACH, N_REACH),
         )
+        members = sorted({seg} | {i for e in keep for i in e})
         coo_to_zarr_group(
-            sub_root, staid, sub, WB_ORDER, "lynker",
+            sub_root, staid, sub, [WB_ORDER[i] for i in members], "lynker",
             gage_catchment=f"wb-{WBIDS[seg]}", gage_idx=seg,
         )
 

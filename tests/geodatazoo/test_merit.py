@@ -43,7 +43,7 @@ class TestTraining:
         rd = merit_train.collate_fn(["11111111", "22222222"])
         assert rd.n_segments == 9  # union closure of reach 8: reaches 0-8
         assert len(rd.outflow_idx) == 2
-        assert rd.gage_catchment == ["11111111", "22222222"]
+        assert rd.gage_catchment == [COMIDS[4], COMIDS[8]]
         assert rd.flow_scale.shape == (9,)
         np.testing.assert_allclose(rd.flow_scale, 1.0)
 
