@@ -1,0 +1,28 @@
+"""Parallelism layer: reach-dimension SPMD over a device mesh + topological-range
+partitioning (first-class components with no reference counterpart, SURVEY.md §2.11)."""
+
+from ddr_tpu.parallel.partition import (
+    ReachPartition,
+    permute_routing_data,
+    topological_range_partition,
+)
+from ddr_tpu.parallel.sharding import (
+    make_mesh,
+    reach_sharding,
+    replicated,
+    shard_channels,
+    shard_network,
+    sharded_route,
+)
+
+__all__ = [
+    "ReachPartition",
+    "permute_routing_data",
+    "topological_range_partition",
+    "make_mesh",
+    "reach_sharding",
+    "replicated",
+    "shard_channels",
+    "shard_network",
+    "sharded_route",
+]

@@ -1,0 +1,92 @@
+"""Topological-range partitioning of the reach dimension.
+
+The adjacency is lower-triangular in topological order, so if each shard owns a
+*contiguous topological range* of reaches, every cross-shard edge points from a
+lower shard to a higher shard — communication during the wavefront solve is a
+one-directional pipeline (shard k sends boundary discharge to shards > k), never an
+exchange (SURVEY.md §2.11/§5 design constraint). This module computes the reach
+permutation that makes that true and rewrites batches into the partitioned order.
+
+The permutation sorts reaches by (longest-path level, original index) — itself a
+valid topological order — then chunks it into equal contiguous ranges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ddr_tpu.geodatazoo.dataclasses import RoutingData
+
+__all__ = ["ReachPartition", "topological_range_partition", "permute_routing_data"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReachPartition:
+    """``perm[new_idx] = old_idx``; ``inv[old_idx] = new_idx``; ``bounds`` holds the
+    shard range boundaries (len n_shards+1)."""
+
+    perm: np.ndarray
+    inv: np.ndarray
+    bounds: np.ndarray
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.bounds) - 1
+
+    def shard_of(self, new_idx: np.ndarray) -> np.ndarray:
+        return np.searchsorted(self.bounds, new_idx, side="right") - 1
+
+
+def topological_range_partition(
+    rows: np.ndarray, cols: np.ndarray, n: int, n_shards: int
+) -> ReachPartition:
+    """Partition ``n`` reaches into ``n_shards`` contiguous topological ranges.
+
+    Returns the permutation into partitioned order. In the new order every edge
+    satisfies ``new_src < new_tgt`` (the adjacency stays lower-triangular) and
+    cross-shard edges only go to higher shards.
+    """
+    from ddr_tpu.routing.network import compute_levels
+
+    level = compute_levels(rows, cols, n)
+    perm = np.lexsort((np.arange(n), level))  # stable: (level, original index)
+    inv = np.empty(n, dtype=np.int64)
+    inv[perm] = np.arange(n)
+    bounds = np.linspace(0, n, n_shards + 1).astype(np.int64)
+    return ReachPartition(perm=perm, inv=inv, bounds=bounds)
+
+
+def permute_routing_data(rd: RoutingData, part: ReachPartition) -> RoutingData:
+    """Rewrite a batch into partitioned reach order (host-side, collate-time)."""
+    inv = part.inv
+    perm = part.perm
+
+    def _p(a):
+        return None if a is None else np.asarray(a)[perm]
+
+    return RoutingData(
+        n_segments=rd.n_segments,
+        adjacency_rows=inv[np.asarray(rd.adjacency_rows)],
+        adjacency_cols=inv[np.asarray(rd.adjacency_cols)],
+        spatial_attributes=(
+            None if rd.spatial_attributes is None else np.asarray(rd.spatial_attributes)[:, perm]
+        ),
+        normalized_spatial_attributes=_p(rd.normalized_spatial_attributes),
+        length=_p(rd.length),
+        slope=_p(rd.slope),
+        side_slope=_p(rd.side_slope),
+        top_width=_p(rd.top_width),
+        x=_p(rd.x),
+        dates=rd.dates,
+        observations=rd.observations,
+        divide_ids=_p(rd.divide_ids),
+        outflow_idx=(
+            None
+            if rd.outflow_idx is None
+            else [inv[np.asarray(i)] for i in rd.outflow_idx]
+        ),
+        gage_catchment=rd.gage_catchment,
+        flow_scale=_p(rd.flow_scale),
+    )

@@ -1,0 +1,118 @@
+"""Device-mesh sharding for the routing engine (SPMD over the reach dimension).
+
+The scaling axis is reaches (2.9M at CONUS/global scale), not time: per-reach arrays
+(attributes, channel properties, lateral inflows, discharge state inside the scan)
+are sharded over a 1-D ``Mesh`` with ``PartitionSpec("reach")``; KAN parameters and
+per-gauge outputs are replicated/gathered. The routing computation itself is the
+SAME jitted function as single-chip — XLA GSPMD inserts the collectives at the
+cross-shard river edges (gathers for the level-scheduled scatter-adds, psum for
+gauge segment-sums), riding ICI on a real slice. Combine with
+:mod:`ddr_tpu.parallel.partition` so those collectives are one-directional.
+
+This is the role the reference never needed (single device, no distributed backend —
+SURVEY.md §2.11); multi-host extension is ``jax.distributed.initialize`` + the same
+code over a DCN-spanning mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddr_tpu.routing.mc import Bounds, ChannelState, GaugeIndex, RouteResult, route
+from ddr_tpu.routing.network import RiverNetwork
+
+__all__ = [
+    "make_mesh",
+    "reach_sharding",
+    "replicated",
+    "shard_channels",
+    "shard_network",
+    "sharded_route",
+]
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = "reach") -> Mesh:
+    """1-D device mesh over the reach axis (all visible devices by default)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def reach_sharding(mesh: Mesh, rank_1_axis: int = 0, ndim: int = 1) -> NamedSharding:
+    """NamedSharding placing the reach axis of an ndim-array on the mesh."""
+    spec = [None] * ndim
+    spec[rank_1_axis] = "reach"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_channels(mesh: Mesh, channels: ChannelState) -> ChannelState:
+    """Place per-reach channel arrays with reach sharding."""
+    s1 = reach_sharding(mesh)
+
+    def put(a):
+        return None if a is None else jax.device_put(a, s1)
+
+    return ChannelState(
+        length=put(channels.length),
+        slope=put(channels.slope),
+        x_storage=put(channels.x_storage),
+        top_width_data=put(channels.top_width_data),
+        side_slope_data=put(channels.side_slope_data),
+    )
+
+
+def shard_network(mesh: Mesh, network: RiverNetwork) -> RiverNetwork:
+    """Edge lists are replicated (they index the global reach space); the level
+    schedule rows stay replicated too — the scatter targets are what's sharded."""
+    rep = replicated(mesh)
+    return RiverNetwork(
+        edge_src=jax.device_put(network.edge_src, rep),
+        edge_tgt=jax.device_put(network.edge_tgt, rep),
+        lvl_src=jax.device_put(network.lvl_src, rep),
+        lvl_tgt=jax.device_put(network.lvl_tgt, rep),
+        n=network.n,
+        depth=network.depth,
+        n_edges=network.n_edges,
+    )
+
+
+def sharded_route(
+    mesh: Mesh,
+    network: RiverNetwork,
+    channels: ChannelState,
+    spatial_params: dict[str, Any],
+    q_prime,
+    q_init=None,
+    gauges: GaugeIndex | None = None,
+    bounds: Bounds = Bounds(),
+) -> RouteResult:
+    """Run :func:`ddr_tpu.routing.mc.route` with reach-sharded inputs.
+
+    ``q_prime`` (T, N) is sharded over N; spatial parameter vectors over their only
+    axis. Results: gauge-aggregated runoff is replicated, final discharge stays
+    sharded (it is the carry for the next sequential chunk).
+    """
+    s1 = reach_sharding(mesh)
+    s2 = reach_sharding(mesh, rank_1_axis=1, ndim=2)
+    network = shard_network(mesh, network)
+    channels = shard_channels(mesh, channels)
+    spatial_params = {k: jax.device_put(v, s1) for k, v in spatial_params.items()}
+    q_prime = jax.device_put(q_prime, s2)
+    if q_init is not None:
+        q_init = jax.device_put(q_init, s1)
+    with mesh:
+        return route(
+            network, channels, spatial_params, q_prime,
+            q_init=q_init, gauges=gauges, bounds=bounds,
+        )

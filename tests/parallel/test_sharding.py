@@ -1,0 +1,178 @@
+"""Multi-device tests on the 8-virtual-CPU mesh (tests/conftest.py): partition
+invariants, sharded-vs-single-device numerical equivalence, sharded training step."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+from ddr_tpu.parallel import (
+    make_mesh,
+    permute_routing_data,
+    sharded_route,
+    topological_range_partition,
+)
+from ddr_tpu.routing.mc import Bounds, route
+from ddr_tpu.routing.model import prepare_batch
+from ddr_tpu.validation.configs import Config
+
+
+@pytest.fixture(scope="module")
+def basin_cfg():
+    cfg = Config(
+        name="parallel_test",
+        geodataset="synthetic",
+        mode="training",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={"start_time": "1981/10/01", "end_time": "1981/10/08", "rho": 6, "warmup": 1},
+        params={"save_path": "/tmp"},
+    )
+    basin = make_basin(n_segments=96, n_gauges=4, n_days=8, seed=3)
+    return basin, cfg
+
+
+class TestPartition:
+    def test_partition_invariants(self, basin_cfg):
+        basin, _ = basin_cfg
+        rd = basin.routing_data
+        part = topological_range_partition(
+            rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, 8
+        )
+        n = rd.n_segments
+        # permutation is a bijection
+        assert sorted(part.perm.tolist()) == list(range(n))
+        # still lower-triangular: every edge src < tgt in new order
+        new_rows = part.inv[rd.adjacency_rows]
+        new_cols = part.inv[rd.adjacency_cols]
+        assert (new_cols < new_rows).all()
+        # cross-shard edges only point to higher shards
+        shard_src = part.shard_of(new_cols)
+        shard_tgt = part.shard_of(new_rows)
+        assert (shard_src <= shard_tgt).all()
+        # balanced ranges
+        sizes = np.diff(part.bounds)
+        assert sizes.max() - sizes.min() <= 1
+
+    def test_permuted_route_equivalent(self, basin_cfg):
+        basin, cfg = basin_cfg
+        rd = basin.routing_data
+        slope_min = cfg.params.attribute_minimums["slope"]
+        params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+
+        network, channels, gauges = prepare_batch(rd, slope_min)
+        base = route(network, channels, params, jnp.asarray(basin.q_prime), gauges=gauges)
+
+        part = topological_range_partition(
+            rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, 8
+        )
+        rd_p = permute_routing_data(rd, part)
+        network_p, channels_p, gauges_p = prepare_batch(rd_p, slope_min)
+        params_p = {k: v[part.perm] for k, v in params.items()}
+        q_prime_p = jnp.asarray(basin.q_prime[:, part.perm])
+        out_p = route(network_p, channels_p, params_p, q_prime_p, gauges=gauges_p)
+
+        np.testing.assert_allclose(
+            np.asarray(base.runoff), np.asarray(out_p.runoff), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestShardedRoute:
+    def test_matches_single_device(self, basin_cfg):
+        basin, cfg = basin_cfg
+        rd = basin.routing_data
+        slope_min = cfg.params.attribute_minimums["slope"]
+        params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+        network, channels, gauges = prepare_batch(rd, slope_min)
+        q_prime = jnp.asarray(basin.q_prime)
+
+        base = route(network, channels, params, q_prime, gauges=gauges)
+
+        mesh = make_mesh(8)
+        out = sharded_route(mesh, network, channels, params, q_prime, gauges=gauges)
+        np.testing.assert_allclose(
+            np.asarray(base.runoff), np.asarray(out.runoff), rtol=1e-5, atol=1e-5
+        )
+        # carry state stays reach-sharded for sequential chunking
+        assert out.final_discharge.shape == (rd.n_segments,)
+
+    def test_carry_state_across_sharded_chunks(self, basin_cfg):
+        basin, cfg = basin_cfg
+        rd = basin.routing_data
+        params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+        network, channels, gauges = prepare_batch(
+            rd, cfg.params.attribute_minimums["slope"]
+        )
+        q_prime = jnp.asarray(basin.q_prime)
+        mesh = make_mesh(8)
+
+        full = sharded_route(mesh, network, channels, params, q_prime, gauges=gauges)
+        T = q_prime.shape[0]
+        a = sharded_route(mesh, network, channels, params, q_prime[: T // 2], gauges=gauges)
+        b = sharded_route(
+            mesh, network, channels, params, q_prime[T // 2 - 1 :],
+            q_init=a.final_discharge, gauges=gauges,
+        )
+        stitched = np.concatenate([np.asarray(a.runoff), np.asarray(b.runoff)[1:]], axis=0)
+        np.testing.assert_allclose(
+            np.asarray(full.runoff), stitched, rtol=1e-4, atol=1e-4
+        )
+
+
+class TestShardedTraining:
+    def test_sharded_train_step_matches_loss(self, basin_cfg):
+        from ddr_tpu.nn.kan import Kan
+        from ddr_tpu.training import make_batch_train_step, make_optimizer
+
+        basin, cfg = basin_cfg
+        basin = observe(basin, cfg)
+        rd = basin.routing_data
+        network, channels, gauges = prepare_batch(
+            rd, cfg.params.attribute_minimums["slope"]
+        )
+        kan_model = Kan(
+            input_var_names=tuple(cfg.kan.input_var_names),
+            learnable_parameters=tuple(cfg.kan.learnable_parameters),
+            hidden_size=cfg.kan.hidden_size,
+            num_hidden_layers=cfg.kan.num_hidden_layers,
+            grid=cfg.kan.grid,
+            k=cfg.kan.k,
+        )
+        attrs = jnp.asarray(rd.normalized_spatial_attributes)
+        params = kan_model.init(jax.random.key(0), attrs)
+        optimizer = make_optimizer(1e-3)
+        opt_state = optimizer.init(params)
+        step = make_batch_train_step(
+            kan_model,
+            Bounds.from_config(cfg.params.attribute_minimums),
+            cfg.params.parameter_ranges,
+            cfg.params.log_space_parameters,
+            cfg.params.defaults,
+            tau=cfg.params.tau,
+            warmup=1,
+            optimizer=optimizer,
+        )
+        obs = jnp.asarray(basin.obs_daily)
+        mask = jnp.ones_like(obs, dtype=bool)
+        q_prime = jnp.asarray(basin.q_prime)
+
+        _, _, loss_single, _ = step(
+            params, opt_state, network, channels, gauges, attrs, q_prime, obs, mask
+        )
+
+        from ddr_tpu.parallel import make_mesh, reach_sharding, shard_channels, shard_network
+
+        mesh = make_mesh(8)
+        s1 = reach_sharding(mesh)
+        s2 = reach_sharding(mesh, rank_1_axis=1, ndim=2)
+        attrs_sh = jax.device_put(attrs, reach_sharding(mesh, 0, 2))
+        q_sh = jax.device_put(q_prime, s2)
+        with mesh:
+            _, _, loss_sharded, _ = step(
+                params, opt_state,
+                shard_network(mesh, network), shard_channels(mesh, channels), gauges,
+                attrs_sh, q_sh, obs, mask,
+            )
+        np.testing.assert_allclose(float(loss_single), float(loss_sharded), rtol=1e-4)
