@@ -1,0 +1,6 @@
+"""BMI v2.0 serving layer (reference /root/reference/src/ddr/bmi/)."""
+
+from ddr_tpu.bmi.config import BmiInitConfig
+from ddr_tpu.bmi.ddr_bmi import DdrBmi
+
+__all__ = ["BmiInitConfig", "DdrBmi"]

@@ -1,0 +1,46 @@
+"""BMI initialization config (reference /root/reference/src/ddr/bmi/config.py:14-50).
+
+A small YAML schema separate from the main framework config: it points at a trained
+KAN checkpoint and the framework config to route with, plus the coupling knobs ngen
+needs (sub-step size, inflow interpolation).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Literal
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+
+class BmiInitConfig(BaseModel):
+    """Schema of the YAML file handed to ``DdrBmi.initialize``."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    ddr_config: Path = Field(description="Framework config YAML to route with")
+    kan_checkpoint: Path | None = Field(
+        default=None,
+        description="Trained KAN checkpoint (.pkl from ddr_tpu.training.save_state); "
+        "None routes with randomly-initialized parameters (testing only)",
+    )
+    hydrofabric_gpkg: Path | None = Field(
+        default=None, description="Override data_sources.geospatial_fabric_gpkg"
+    )
+    conus_adjacency: Path | None = Field(
+        default=None, description="Override data_sources.conus_adjacency"
+    )
+    device: str = Field(default="tpu", description='"tpu" or "cpu"')
+    timestep_seconds: float = Field(default=3600.0, gt=0.0)
+    interpolation: Literal["constant", "linear"] = Field(
+        default="constant",
+        description="How lateral inflows are spread across routing sub-steps within "
+        "one ngen coupling interval",
+    )
+
+    @field_validator("ddr_config")
+    @classmethod
+    def _config_exists(cls, v: Path) -> Path:
+        if not Path(v).exists():
+            raise ValueError(f"ddr_config does not exist: {v}")
+        return v

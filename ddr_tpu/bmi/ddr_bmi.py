@@ -1,0 +1,487 @@
+"""BMI v2.0 serving wrapper: drop-in t-route replacement for NOAA NextGen (ngen).
+
+Re-design of the reference BMI layer (/root/reference/src/ddr/bmi/ddr_bmi.py:81-630)
+around the functional TPU engine. Same CSDMS Standard Names as t-route
+(/root/reference/src/ddr/bmi/ddr_bmi.py:47-78), same coupling semantics
+(``update_until`` sub-steps ``timestep_seconds`` against ngen's coupling interval with
+constant or linear inflow interpolation, lazy cold-start on the first real inflow),
+but where the reference re-enters a mutable torch engine under ``no_grad`` per
+sub-step, here ``initialize()`` jit-compiles ONE fused XLA program
+
+    step(q_t, q_prime) -> (q_t1, velocity, depth)
+
+— Muskingum-Cunge routing plus the output diagnostics the reference re-derives on the
+host afterwards (/root/reference/src/ddr/bmi/ddr_bmi.py:577-630) — and every coupling
+interval replays that compiled program. Output arrays are persistent numpy buffers
+mutated in place so ``get_value_ptr`` stays stable across the simulation, per the
+NGWPC/lstm BMI pattern the reference follows.
+
+``bmipy`` is not in this image; the class implements the full BMI v2.0 method surface
+directly (ngen duck-types it) and registers with ``bmipy.Bmi`` when available.
+"""
+
+from __future__ import annotations
+
+import logging
+import sqlite3
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+import yaml
+
+from ddr_tpu.bmi.config import BmiInitConfig
+
+log = logging.getLogger(__name__)
+
+# CSDMS Standard Names, matching t-route for drop-in ngen compatibility
+# (/root/reference/src/ddr/bmi/ddr_bmi.py:47-78).
+_INPUT_VAR_NAMES = (
+    "land_surface_water_source__id",
+    "land_surface_water_source__volume_flow_rate",
+    "ngen_dt",
+)
+_OUTPUT_VAR_NAMES = (
+    "channel_water__id",
+    "channel_exit_water_x-section__volume_flow_rate",
+    "channel_water_flow__speed",
+    "channel_water__mean_depth",
+)
+_VAR_UNITS = {
+    "land_surface_water_source__id": "-",
+    "land_surface_water_source__volume_flow_rate": "m3 s-1",
+    "ngen_dt": "s",
+    "channel_water__id": "-",
+    "channel_exit_water_x-section__volume_flow_rate": "m3 s-1",
+    "channel_water_flow__speed": "m s-1",
+    "channel_water__mean_depth": "m",
+}
+_VAR_TYPES = {
+    "land_surface_water_source__id": "int32",
+    "land_surface_water_source__volume_flow_rate": "float64",
+    "ngen_dt": "int32",
+    "channel_water__id": "int64",
+    "channel_exit_water_x-section__volume_flow_rate": "float32",
+    "channel_water_flow__speed": "float32",
+    "channel_water__mean_depth": "float32",
+}
+
+
+def _strip_id(divide_id: object) -> int:
+    """``cat-{id}`` / ``wb-{id}`` strings (or bare ints) -> integer segment id."""
+    return int(str(divide_id).replace("cat-", "").replace("wb-", ""))
+
+
+class DdrBmi:
+    """BMI v2.0 wrapper serving the differentiable Muskingum-Cunge router to ngen.
+
+    Routes the FULL network per step via the level-scheduled sparse solve (not
+    per-catchment). The KAN runs exactly once during ``initialize()`` to produce
+    static physical parameters; coupling-time work is inference-only replays of the
+    pre-compiled step program.
+    """
+
+    def __init__(self) -> None:
+        self._initialized = False
+        self._cold_started = False
+
+        self._bmi_cfg: BmiInitConfig | None = None
+        self._cfg: Any = None
+        self._timestep: float = 3600.0
+        self._interpolation: str = "constant"
+        self._ngen_dt: int = 3600
+
+        # Compiled engine pieces (filled by initialize)
+        self._step_fn: Any = None  # jitted (q_t, q_prime) -> (q_t1, velocity, depth)
+        self._hotstart_fn: Any = None  # jitted (q_prime,) -> q0
+        self._q_t: Any = None  # (N,) device array, current discharge state
+        self._n_edges: int = 0
+        self._num_segments: int = 0
+
+        # nexus → segment index mapping
+        self._nexus_to_seg_idx: dict[int, int] = {}
+        self._segment_ids: np.ndarray = np.empty(0, dtype=np.int64)
+
+        # Per-coupling-interval state
+        self._lateral_inflow: np.ndarray = np.empty(0, dtype=np.float64)
+        self._prev_lateral_inflow: np.ndarray = np.empty(0, dtype=np.float64)
+        self._has_prev_inflow = False
+        self._nexus_ids: np.ndarray = np.empty(0, dtype=np.int32)
+        self._current_time = 0.0
+
+        # Persistent output buffers (in-place updates: get_value_ptr stability)
+        self._discharge: np.ndarray = np.empty(0, dtype=np.float32)
+        self._velocity: np.ndarray = np.empty(0, dtype=np.float32)
+        self._depth: np.ndarray = np.empty(0, dtype=np.float32)
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def initialize(self, config_file: str) -> None:
+        """Build the network, run the KAN once, and compile the routing step."""
+        import jax
+        import jax.numpy as jnp
+
+        from ddr_tpu.geometry.trapezoidal import trapezoidal_geometry
+        from ddr_tpu.routing.mc import Bounds, hotstart_discharge, route_step
+        from ddr_tpu.routing.model import denormalize_spatial_parameters, prepare_batch
+        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.training import load_state
+        from ddr_tpu.validation.configs import load_config
+
+        raw = yaml.safe_load(Path(config_file).read_text())
+        self._bmi_cfg = BmiInitConfig(**raw)
+        self._timestep = float(self._bmi_cfg.timestep_seconds)
+        self._interpolation = self._bmi_cfg.interpolation
+
+        overrides = [f"device={self._bmi_cfg.device}", "mode=routing"]
+        if self._bmi_cfg.hydrofabric_gpkg is not None:
+            overrides.append(
+                f"data_sources.geospatial_fabric_gpkg={self._bmi_cfg.hydrofabric_gpkg}"
+            )
+        if self._bmi_cfg.conus_adjacency is not None:
+            overrides.append(f"data_sources.conus_adjacency={self._bmi_cfg.conus_adjacency}")
+        self._cfg = load_config(self._bmi_cfg.ddr_config, overrides, save_config=False)
+
+        dataset = self._cfg.geodataset.get_dataset_class(self._cfg)
+        rd = dataset.routing_data
+        if rd is None or rd.adjacency_rows is None:
+            raise RuntimeError("Failed to build routing data from the hydrofabric")
+        self._num_segments = rd.n_segments
+        self._n_edges = len(rd.adjacency_rows)
+
+        if rd.divide_ids is not None:
+            self._segment_ids = np.array(
+                [_strip_id(s) for s in rd.divide_ids], dtype=np.int64
+            )
+        else:
+            self._segment_ids = np.arange(self._num_segments, dtype=np.int64)
+
+        gpkg = self._cfg.data_sources.geospatial_fabric_gpkg
+        self._nexus_to_seg_idx = self._build_nexus_mapping(gpkg)
+
+        # KAN inference, exactly once — static spatial parameters for the whole run.
+        kan_model, params = build_kan(self._cfg)
+        attrs = jnp.asarray(rd.normalized_spatial_attributes, jnp.float32)
+        if self._bmi_cfg.kan_checkpoint is not None:
+            params = jax.tree.map(
+                jnp.asarray, load_state(self._bmi_cfg.kan_checkpoint)["params"]
+            )
+        else:
+            log.warning("No kan_checkpoint given: routing with randomly-initialized KAN")
+        raw_params = kan_model.apply(params, attrs)
+        spatial = denormalize_spatial_parameters(
+            raw_params,
+            self._cfg.params.parameter_ranges,
+            self._cfg.params.log_space_parameters,
+            self._cfg.params.defaults,
+            self._num_segments,
+        )
+        spatial = jax.tree.map(jax.device_get, spatial)  # drop the KAN graph
+        spatial = {k: jnp.asarray(v, jnp.float32) for k, v in spatial.items()}
+
+        network, channels, _ = prepare_batch(rd, self._cfg.params.attribute_minimums["slope"])
+        bounds = Bounds.from_config(self._cfg.params.attribute_minimums)
+        dt = self._timestep
+        depth_lb = float(self._cfg.params.attribute_minimums.get("depth", 0.01))
+        bw_lb = float(self._cfg.params.attribute_minimums.get("bottom_width", 0.01))
+
+        def _step(q_t, q_prime):
+            q_prime_clamp = jnp.maximum(q_prime, bounds.discharge)
+            q_t1 = route_step(
+                network,
+                channels,
+                spatial["n"],
+                spatial["p_spatial"],
+                spatial["q_spatial"],
+                q_t,
+                q_prime_clamp,
+                bounds,
+                dt,
+            )
+            # Output diagnostics, fused into the same XLA program (the reference
+            # re-derives these on host, /root/reference/src/ddr/bmi/ddr_bmi.py:577-630).
+            geom = trapezoidal_geometry(
+                n=spatial["n"],
+                p_spatial=spatial["p_spatial"],
+                q_spatial=spatial["q_spatial"],
+                discharge=q_t1,
+                slope=channels.slope,
+                depth_lb=depth_lb,
+                bottom_width_lb=bw_lb,
+            )
+            velocity = jnp.clip(geom["velocity"], 0.0, 15.0)
+            return q_t1, velocity, geom["depth"]
+
+        self._step_fn = jax.jit(_step)
+        self._hotstart_fn = jax.jit(
+            lambda qp: hotstart_discharge(network, qp, bounds.discharge)
+        )
+        self._q_t = jnp.full((self._num_segments,), bounds.discharge, jnp.float32)
+
+        self._lateral_inflow = np.zeros(self._num_segments, dtype=np.float64)
+        self._prev_lateral_inflow = np.zeros(self._num_segments, dtype=np.float64)
+        self._has_prev_inflow = False
+        self._nexus_ids = np.empty(0, dtype=np.int32)
+        self._discharge = np.zeros(self._num_segments, dtype=np.float32)
+        self._velocity = np.zeros(self._num_segments, dtype=np.float32)
+        self._depth = np.zeros(self._num_segments, dtype=np.float32)
+        self._current_time = 0.0
+        self._cold_started = False
+        self._initialized = True
+        log.info(
+            "DdrBmi initialized: %d segments, %d nexus mappings, dt=%.0fs, interpolation=%s",
+            self._num_segments,
+            len(self._nexus_to_seg_idx),
+            self._timestep,
+            self._interpolation,
+        )
+
+    def update(self) -> None:
+        self.update_until(self._current_time + self._timestep)
+
+    def update_until(self, time: float) -> None:
+        """Advance to ``time`` in ``timestep_seconds`` sub-steps.
+
+        ``interpolation="constant"`` holds the coupling interval's inflow for every
+        sub-step; ``"linear"`` ramps from the previous interval's inflow to the
+        current one (falls back to constant on the first interval). Matches the
+        reference semantics (/root/reference/src/ddr/bmi/ddr_bmi.py:246-318).
+        """
+        import jax.numpy as jnp
+
+        if not self._initialized:
+            raise RuntimeError("Model not initialized. Call initialize() first.")
+        remaining = time - self._current_time
+        if remaining <= 0.0:
+            return  # no-op: state and queued inflows untouched
+        n_steps = max(1, round(remaining / self._timestep))
+        use_linear = self._interpolation == "linear" and self._has_prev_inflow and n_steps > 1
+
+        velocity, depth = self._velocity, self._depth  # unchanged if no sub-step runs
+        for step in range(n_steps):
+            if self._current_time >= time - 1e-6:
+                break
+            if use_linear:
+                alpha = (step + 1) / n_steps
+                inflow = (1.0 - alpha) * self._prev_lateral_inflow + alpha * self._lateral_inflow
+            else:
+                inflow = self._lateral_inflow
+            q_prime = jnp.asarray(inflow, jnp.float32)
+
+            if not self._cold_started:
+                # Lazy cold-start: topological accumulation of the first real inflow
+                # (/root/reference/src/ddr/bmi/ddr_bmi.py:284-291).
+                self._q_t = self._hotstart_fn(q_prime)
+                self._cold_started = True
+
+            self._q_t, velocity, depth = self._step_fn(self._q_t, q_prime)
+            self._current_time += self._timestep
+
+        self._discharge[:] = np.asarray(self._q_t, dtype=np.float32)
+        self._velocity[:] = np.asarray(velocity, dtype=np.float32)
+        self._depth[:] = np.asarray(depth, dtype=np.float32)
+
+        self._prev_lateral_inflow[:] = self._lateral_inflow
+        self._has_prev_inflow = True
+        self._lateral_inflow[:] = 0.0  # ngen re-sends inflows every coupling step
+
+    def finalize(self) -> None:
+        self._step_fn = None
+        self._hotstart_fn = None
+        self._q_t = None
+        self._initialized = False
+        log.info("DdrBmi finalized")
+
+    # ------------------------------------------------------------- variable info
+
+    def get_component_name(self) -> str:
+        return "DDR-TPU-MuskingumCunge"
+
+    def get_input_item_count(self) -> int:
+        return len(_INPUT_VAR_NAMES)
+
+    def get_output_item_count(self) -> int:
+        return len(_OUTPUT_VAR_NAMES)
+
+    def get_input_var_names(self) -> tuple[str, ...]:
+        return _INPUT_VAR_NAMES
+
+    def get_output_var_names(self) -> tuple[str, ...]:
+        return _OUTPUT_VAR_NAMES
+
+    def get_var_grid(self, name: str) -> int:
+        return 0
+
+    def get_var_type(self, name: str) -> str:
+        return _VAR_TYPES.get(name, "float64")
+
+    def get_var_units(self, name: str) -> str:
+        return _VAR_UNITS.get(name, "-")
+
+    def get_var_itemsize(self, name: str) -> int:
+        return int(np.dtype(self.get_var_type(name)).itemsize)
+
+    def get_var_nbytes(self, name: str) -> int:
+        if name in _OUTPUT_VAR_NAMES:
+            return self.get_var_itemsize(name) * self._num_segments
+        raise NotImplementedError(f"nbytes undefined for input variable {name}")
+
+    def get_var_location(self, name: str) -> str:
+        return "node"
+
+    # --------------------------------------------------------------------- time
+
+    def get_current_time(self) -> float:
+        return self._current_time
+
+    def get_start_time(self) -> float:
+        return 0.0
+
+    def get_end_time(self) -> float:
+        return float("inf")  # ngen owns the simulation horizon
+
+    def get_time_units(self) -> str:
+        return "s"
+
+    def get_time_step(self) -> float:
+        return self._timestep
+
+    # --------------------------------------------------------- getters / setters
+
+    def get_value(self, name: str, dest: np.ndarray) -> np.ndarray:
+        dest[:] = self.get_value_ptr(name)[: len(dest)]
+        return dest
+
+    def get_value_ptr(self, name: str) -> np.ndarray:
+        if name == "channel_exit_water_x-section__volume_flow_rate":
+            return self._discharge
+        if name == "channel_water__id":
+            return self._segment_ids
+        if name == "channel_water_flow__speed":
+            return self._velocity
+        if name == "channel_water__mean_depth":
+            return self._depth
+        raise ValueError(f"Unknown output variable: {name}")
+
+    def get_value_at_indices(
+        self, name: str, dest: np.ndarray, inds: np.ndarray
+    ) -> np.ndarray:
+        dest[:] = self.get_value_ptr(name)[inds]
+        return dest
+
+    def set_value(self, name: str, src: np.ndarray) -> None:
+        if name == "land_surface_water_source__volume_flow_rate":
+            src = np.asarray(src)
+            if len(self._nexus_ids) > 0 and src.size > 0:
+                flows = src.flat[: len(self._nexus_ids)]
+                for i, nex_id in enumerate(self._nexus_ids):
+                    seg_idx = self._nexus_to_seg_idx.get(int(nex_id))
+                    if seg_idx is not None:
+                        self._lateral_inflow[seg_idx] = flows[i]
+            else:
+                n = min(src.size, self._num_segments)
+                self._lateral_inflow[:n] = src.flat[:n]
+        elif name == "land_surface_water_source__id":
+            self._nexus_ids = np.asarray(src).astype(np.int32).flatten()
+        elif name == "ngen_dt":
+            self._ngen_dt = int(np.asarray(src).flat[0])
+        else:
+            log.debug("Unknown input variable ignored: %s", name)  # BMI: don't crash
+
+    def set_value_at_indices(self, name: str, inds: np.ndarray, src: np.ndarray) -> None:
+        if name == "land_surface_water_source__volume_flow_rate":
+            for i, idx in enumerate(inds):
+                if idx < self._num_segments:
+                    self._lateral_inflow[idx] = src[i]
+        else:
+            log.debug("set_value_at_indices not supported for: %s", name)
+
+    # ------------------------------------------------- grid (unstructured network)
+
+    def get_grid_rank(self, grid: int) -> int:
+        return 1
+
+    def get_grid_size(self, grid: int) -> int:
+        return self._num_segments
+
+    def get_grid_type(self, grid: int) -> str:
+        return "unstructured"
+
+    def get_grid_shape(self, grid: int, shape: np.ndarray) -> np.ndarray:
+        shape[0] = self._num_segments
+        return shape
+
+    def get_grid_spacing(self, grid: int, spacing: np.ndarray) -> np.ndarray:
+        raise NotImplementedError("Spacing not defined for unstructured grid")
+
+    def get_grid_origin(self, grid: int, origin: np.ndarray) -> np.ndarray:
+        raise NotImplementedError("Origin not defined for unstructured grid")
+
+    def get_grid_x(self, grid: int, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError("Grid coordinates not available")
+
+    def get_grid_y(self, grid: int, y: np.ndarray) -> np.ndarray:
+        raise NotImplementedError("Grid coordinates not available")
+
+    def get_grid_z(self, grid: int, z: np.ndarray) -> np.ndarray:
+        raise NotImplementedError("Grid coordinates not available")
+
+    def get_grid_node_count(self, grid: int) -> int:
+        return self._num_segments
+
+    def get_grid_edge_count(self, grid: int) -> int:
+        return self._n_edges
+
+    def get_grid_face_count(self, grid: int) -> int:
+        return 0
+
+    def get_grid_edge_nodes(self, grid: int, edge_nodes: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_grid_face_edges(self, grid: int, face_edges: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_grid_face_nodes(self, grid: int, face_nodes: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_grid_nodes_per_face(self, grid: int, nodes_per_face: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ internals
+
+    def _build_nexus_mapping(self, gpkg_path: Path | None) -> dict[int, int]:
+        """nexus-id → segment-index from the hydrofabric GeoPackage ``flowpaths``
+        table (id, toid), via stdlib sqlite3; identity fallback when unavailable
+        (/root/reference/src/ddr/bmi/ddr_bmi.py:508-575)."""
+        seg_id_to_idx = {int(sid): idx for idx, sid in enumerate(self._segment_ids)}
+
+        if gpkg_path is None or not Path(gpkg_path).exists():
+            return seg_id_to_idx
+
+        nexus_to_seg: dict[int, int] = {}
+        try:
+            con = sqlite3.connect(str(gpkg_path))
+            rows = con.execute(
+                "SELECT id, toid FROM flowpaths WHERE toid LIKE 'nex-%'"
+            ).fetchall()
+            con.close()
+            for fp_id, nex_id in rows:
+                fp_str, nex_str = str(fp_id), str(nex_id)
+                if not fp_str.startswith(("wb-", "cat-")):
+                    continue
+                seg_idx = seg_id_to_idx.get(_strip_id(fp_str))
+                if seg_idx is not None:
+                    nexus_to_seg[int(nex_str.replace("nex-", ""))] = seg_idx
+            log.info("Built nexus mapping: %d entries from %s", len(nexus_to_seg), gpkg_path)
+        except (sqlite3.OperationalError, sqlite3.DatabaseError):
+            log.warning("Could not read flowpaths from %s; identity mapping", gpkg_path)
+            nexus_to_seg = seg_id_to_idx
+        return nexus_to_seg
+
+
+try:  # register as a bmipy.Bmi virtual subclass when bmipy is installed
+    from bmipy import Bmi as _Bmi
+
+    _Bmi.register(DdrBmi)
+except Exception:
+    pass
