@@ -1,0 +1,18 @@
+"""Benchmark harness: MC routing vs LTI-IRF comparator vs summed-Q-prime baseline
+(reference /root/reference/benchmarks/src/ddr_benchmarks/)."""
+
+from ddr_tpu.benchmarks.benchmark import benchmark, build_headwater_mask, load_summed_q_prime
+from ddr_tpu.benchmarks.configs import BenchmarkConfig, LTIRouteConfig, validate_benchmark_config
+from ddr_tpu.benchmarks.irf import IRF_FAMILIES, irf_kernels, route_lti
+
+__all__ = [
+    "BenchmarkConfig",
+    "IRF_FAMILIES",
+    "LTIRouteConfig",
+    "benchmark",
+    "build_headwater_mask",
+    "irf_kernels",
+    "load_summed_q_prime",
+    "route_lti",
+    "validate_benchmark_config",
+]

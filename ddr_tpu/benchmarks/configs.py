@@ -1,0 +1,74 @@
+"""Benchmark configuration (reference /root/reference/benchmarks/src/ddr_benchmarks/
+validation/benchmark.py + validation/diffroute.py).
+
+``BenchmarkConfig`` wraps the core framework :class:`~ddr_tpu.validation.configs.Config`
+under ``ddr`` and adds the LTI-comparator section (``lti``, schema-compatible with the
+reference's ``diffroute`` section) plus the optional pre-computed ΣQ' baseline path.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+from ddr_tpu.benchmarks.irf import IRF_FAMILIES
+from ddr_tpu.validation.configs import Config, _set_seed
+
+
+class LTIRouteConfig(BaseModel):
+    """Linear-IRF comparator config (reference ``DiffRouteConfig``,
+    /root/reference/benchmarks/src/ddr_benchmarks/validation/diffroute.py)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = True
+    irf_fn: str = Field(default="muskingum", description=f"One of {IRF_FAMILIES}")
+    max_delay: int = Field(default=100, description="Kernel length in timesteps")
+    dt: float = Field(default=1.0 / 24.0, description="Timestep in days (hourly)")
+    k: float | None = Field(
+        default=None,
+        description="Wave travel time in days; None = 0.1042 (9000 s, RAPID default)",
+    )
+    x: float = Field(default=0.3, ge=0.0, lt=0.5)
+    nash_n: int = Field(default=3, ge=1, description="Reservoirs for nash_cascade")
+    pad_steps: int | None = Field(
+        default=None, description="FFT zero-pad length; None = 8 * max_delay"
+    )
+
+    @field_validator("irf_fn")
+    @classmethod
+    def _known_family(cls, v: str) -> str:
+        if v not in IRF_FAMILIES:
+            raise ValueError(f"irf_fn {v!r} not in {IRF_FAMILIES}")
+        return v
+
+
+class BenchmarkConfig(BaseModel):
+    """Core config + comparator sections."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    ddr: Config
+    lti: LTIRouteConfig = Field(default_factory=LTIRouteConfig)
+    summed_q_prime: Path | None = Field(
+        default=None, description="ΣQ' zarr store from `ddr summed-q-prime`"
+    )
+
+
+def validate_benchmark_config(raw: dict[str, Any]) -> BenchmarkConfig:
+    """Flat-dict layout parity with the reference: the ``lti`` (or legacy
+    ``diffroute``) and ``summed_q_prime`` keys are split out, everything else is the
+    core config."""
+    raw = dict(raw)
+    lti = raw.pop("lti", raw.pop("diffroute", {}))
+    summed_q_prime = raw.pop("summed_q_prime", None)
+    ddr = raw["ddr"] if set(raw) == {"ddr"} else raw
+    cfg = BenchmarkConfig(
+        ddr=Config(**ddr) if not isinstance(ddr, Config) else ddr,
+        lti=LTIRouteConfig(**lti),
+        summed_q_prime=summed_q_prime,
+    )
+    _set_seed(cfg.ddr)
+    return cfg

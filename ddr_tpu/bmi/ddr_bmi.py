@@ -254,7 +254,15 @@ class DdrBmi:
         remaining = time - self._current_time
         if remaining <= 0.0:
             return  # no-op: state and queued inflows untouched
-        n_steps = max(1, round(remaining / self._timestep))
+        n_steps = round(remaining / self._timestep)
+        if n_steps == 0:
+            # Requested time is less than half a routing step ahead: advancing a full
+            # step would overshoot and desynchronize from ngen's clock. Leave the
+            # queued inflows for the next coupling interval instead.
+            log.debug(
+                "update_until(%.0f) below half a timestep (%.0fs); deferring", time, remaining
+            )
+            return
         use_linear = self._interpolation == "linear" and self._has_prev_inflow and n_steps > 1
 
         velocity, depth = self._velocity, self._depth  # unchanged if no sub-step runs
@@ -373,9 +381,10 @@ class DdrBmi:
         if name == "land_surface_water_source__volume_flow_rate":
             src = np.asarray(src)
             if len(self._nexus_ids) > 0 and src.size > 0:
-                flows = src.flat[: len(self._nexus_ids)]
-                for i, nex_id in enumerate(self._nexus_ids):
-                    seg_idx = self._nexus_to_seg_idx.get(int(nex_id))
+                n_flows = min(src.size, len(self._nexus_ids))
+                flows = src.flat[:n_flows]
+                for i in range(n_flows):
+                    seg_idx = self._nexus_to_seg_idx.get(int(self._nexus_ids[i]))
                     if seg_idx is not None:
                         self._lateral_inflow[seg_idx] = flows[i]
             else:

@@ -25,6 +25,7 @@ __all__ = [
     "build_kan",
     "get_flow_fn",
     "daily_observation_targets",
+    "evaluate_hourly",
     "timed",
 ]
 
@@ -78,6 +79,36 @@ def get_flow_fn(cfg: Config, dataset: Any) -> Callable[..., np.ndarray]:
     if hasattr(dataset, "streamflow"):
         return dataset.streamflow
     return StreamflowReader(cfg)
+
+
+def evaluate_hourly(
+    cfg: Config,
+    dataset: Any,
+    flow: Callable[..., np.ndarray],
+    kan_model: Kan,
+    params: Any,
+    routing_model: Any = None,
+) -> np.ndarray:
+    """Sequential chunked inference with carried discharge state -> hourly gauge
+    predictions ``(G, T_hourly)`` (the eval loop shared by ``ddr test`` and the
+    benchmark harness; reference scripts/test.py:25-115 / benchmarks benchmark.py:748)."""
+    import jax.numpy as jnp
+
+    from ddr_tpu.geodatazoo.loader import DataLoader
+    from ddr_tpu.routing.model import dmc
+
+    routing_model = routing_model or dmc(cfg)
+    loader = DataLoader(dataset, batch_size=cfg.experiment.batch_size, shuffle=False)
+    n_gauges = len(dataset.routing_data.observations.gage_ids)
+    predictions = np.zeros(
+        (n_gauges, len(dataset.dates.hourly_time_range)), dtype=np.float32
+    )
+    for i, rd in enumerate(loader):
+        q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+        raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
+        out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
+        predictions[:, rd.dates.hourly_indices] = np.asarray(out["runoff"])
+    return predictions
 
 
 def daily_observation_targets(rd: Any) -> tuple[np.ndarray, np.ndarray]:

@@ -12,11 +12,9 @@ from pathlib import Path
 import jax.numpy as jnp
 import numpy as np
 
-from ddr_tpu.geodatazoo.loader import DataLoader
 from ddr_tpu.io import zarrlite
-from ddr_tpu.routing.model import dmc
 from ddr_tpu.scripts_utils import compute_daily_runoff
-from ddr_tpu.scripts.common import build_kan, get_flow_fn, parse_cli, timed
+from ddr_tpu.scripts.common import build_kan, evaluate_hourly, get_flow_fn, parse_cli, timed
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.configs import Config
 from ddr_tpu.validation.metrics import Metrics
@@ -37,9 +35,6 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
             log.warning("Creating new spatial model for evaluation.")
             params = fresh
 
-    routing_model = dmc(cfg)
-    loader = DataLoader(dataset, batch_size=cfg.experiment.batch_size, shuffle=False)
-
     rd0 = dataset.routing_data
     assert rd0 is not None, "Routing dataclass not defined in dataset"
     assert rd0.observations is not None, "Observations not defined in dataset"
@@ -48,12 +43,7 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
     observations = np.array(rd0.observations.streamflow, copy=True)
     gage_ids = list(rd0.observations.gage_ids)
 
-    predictions = np.zeros((len(gage_ids), len(dataset.dates.hourly_time_range)), dtype=np.float32)
-    for i, rd in enumerate(loader):
-        q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
-        raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
-        out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
-        predictions[:, rd.dates.hourly_indices] = np.asarray(out["runoff"])
+    predictions = evaluate_hourly(cfg, dataset, flow, kan_model, params)
 
     daily_runoff = compute_daily_runoff(predictions, cfg.params.tau)  # (G, D-1)
     daily_obs = observations[:, 1 : 1 + daily_runoff.shape[1]]

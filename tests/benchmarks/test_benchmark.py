@@ -1,0 +1,143 @@
+"""Benchmark harness tests: config layouts, headwater masking, ΣQ' alignment, and the
+end-to-end two-phase run on the synthetic dataset (the reference exercises the true
+build→route pipeline on the RAPID Sandbox the same way,
+/root/reference/tests/benchmarks/)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.benchmarks import (
+    BenchmarkConfig,
+    benchmark,
+    build_headwater_mask,
+    load_summed_q_prime,
+    validate_benchmark_config,
+)
+from ddr_tpu.geodatazoo.synthetic import make_basin
+from ddr_tpu.io import zarrlite
+
+N_ATTRS = 10
+
+
+def _raw_cfg(tmp_path, **extra):
+    raw = {
+        "name": "bench_test",
+        "geodataset": "synthetic",
+        "mode": "testing",
+        "kan": {"input_var_names": [f"a{i}" for i in range(N_ATTRS)]},
+        "experiment": {"start_time": "1981/10/01", "end_time": "1981/10/10", "warmup": 1},
+        "params": {"save_path": str(tmp_path)},
+    }
+    raw.update(extra)
+    return raw
+
+
+class TestConfig:
+    def test_flat_layout(self, tmp_path):
+        cfg = validate_benchmark_config(
+            _raw_cfg(tmp_path, lti={"irf_fn": "hayami", "max_delay": 50})
+        )
+        assert isinstance(cfg, BenchmarkConfig)
+        assert cfg.ddr.name == "bench_test"
+        assert cfg.lti.irf_fn == "hayami"
+        assert cfg.lti.max_delay == 50
+
+    def test_legacy_diffroute_key(self, tmp_path):
+        cfg = validate_benchmark_config(
+            _raw_cfg(tmp_path, diffroute={"irf_fn": "pure_lag"})
+        )
+        assert cfg.lti.irf_fn == "pure_lag"
+
+    def test_bad_irf_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="irf_fn"):
+            validate_benchmark_config(_raw_cfg(tmp_path, lti={"irf_fn": "quantum"}))
+
+    def test_summed_q_prime_path(self, tmp_path):
+        cfg = validate_benchmark_config(
+            _raw_cfg(tmp_path, summed_q_prime=str(tmp_path / "sqp.zarr"))
+        )
+        assert cfg.summed_q_prime is not None
+
+
+class TestHeadwaterMask:
+    def test_synthetic_gauges_have_upstream(self):
+        basin = make_basin(n_segments=32, n_gauges=4, n_days=3, seed=0)
+        mask = build_headwater_mask(basin.routing_data)
+        assert mask.shape == (4,)
+        assert mask.any()
+
+    def test_headwater_gauge_masked(self):
+        basin = make_basin(n_segments=32, n_gauges=2, n_days=3, seed=0)
+        rd = basin.routing_data
+        # Point one gauge's outflow at segment 0: a source reach with no upstream.
+        rd.outflow_idx = [rd.outflow_idx[0], np.array([0])]
+        mask = build_headwater_mask(rd)
+        assert mask[0] and not mask[1]
+
+
+class TestSummedQPrime:
+    def _store(self, tmp_path, gage_ids, preds):
+        root = zarrlite.create_group(tmp_path / "sqp.zarr")
+        root.create_array("predictions", preds.astype(np.float32))
+        root.attrs.update({"gage_ids": [str(g) for g in gage_ids]})
+        return tmp_path / "sqp.zarr"
+
+    def test_alignment_and_metrics(self, tmp_path, rng):
+        preds = rng.uniform(1, 5, (3, 20)).astype(np.float32)
+        path = self._store(tmp_path, ["0001", "0002", "0003"], preds)
+        daily_obs = rng.uniform(1, 5, (2, 20))
+        out = load_summed_q_prime(path, np.array(["0003", "0001"]), daily_obs, warmup=2)
+        assert out is not None
+        metrics, aligned, common = out
+        assert common.all()
+        np.testing.assert_allclose(aligned, preds[[2, 0]])
+        assert np.asarray(metrics.nse).shape == (2,)
+
+    def test_missing_store_returns_none(self, tmp_path):
+        assert (
+            load_summed_q_prime(tmp_path / "nope.zarr", np.array(["1"]), np.ones((1, 5)), 0)
+            is None
+        )
+
+    def test_disjoint_gauges_returns_none(self, tmp_path, rng):
+        path = self._store(tmp_path, ["0009"], rng.uniform(1, 2, (1, 5)))
+        assert load_summed_q_prime(path, np.array(["0001"]), np.ones((1, 5)), 0) is None
+
+
+class TestEndToEnd:
+    def test_two_phase_benchmark_on_synthetic(self, tmp_path):
+        bench_cfg = validate_benchmark_config(
+            _raw_cfg(tmp_path, lti={"irf_fn": "muskingum", "max_delay": 48})
+        )
+        results = benchmark(bench_cfg)
+        assert set(results) == {"mc", "lti"}
+        for m in results.values():
+            nse = np.asarray(m.nse)
+            assert np.isfinite(nse).any()
+        # Observations are MC-generated (twin experiment): both routers track the
+        # inflow-dominated signal, but they are distinct models, not copies.
+        mc_nse, lti_nse = np.asarray(results["mc"].nse), np.asarray(results["lti"].nse)
+        assert np.nanmedian(mc_nse) > 0.9
+        assert not np.allclose(mc_nse, lti_nse)
+        out = zarrlite.open_group(tmp_path / "benchmark_results.zarr")
+        assert out["mc_predictions"][:].shape == out["observations"][:].shape
+        assert (tmp_path / "plots" / "benchmark_nse_cdf.png").exists()
+        assert (tmp_path / "plots" / "benchmark_nse_box.png").exists()
+
+    def test_lti_disabled(self, tmp_path):
+        bench_cfg = validate_benchmark_config(_raw_cfg(tmp_path, lti={"enabled": False}))
+        results = benchmark(bench_cfg)
+        assert set(results) == {"mc"}
+
+    def test_cli_nested_layout(self, tmp_path):
+        import yaml
+
+        from ddr_tpu.benchmarks.benchmark import main
+
+        ddr = _raw_cfg(tmp_path)
+        del ddr["mode"]  # main() must default mode inside the nested section
+        cfg_path = tmp_path / "nested.yaml"
+        cfg_path.write_text(yaml.safe_dump({"ddr": ddr, "lti": {"enabled": False}}))
+        assert main([str(cfg_path)]) == 0

@@ -1,0 +1,123 @@
+"""LTI IRF router tests: kernel families + frequency-domain routing vs a plain
+time-domain convolution oracle (the role the reference's DiffRoute adapter round-trip
+tests play, /root/reference/tests/benchmarks/test_diffroute_adapter.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.benchmarks.irf import IRF_FAMILIES, irf_kernels, route_lti
+from ddr_tpu.routing.network import build_network
+
+L = 96
+DT = 1.0 / 24.0  # hourly, in days
+
+
+@pytest.mark.parametrize("family", IRF_FAMILIES)
+class TestKernels:
+    def test_unit_mass_and_shape(self, family):
+        k = np.array([0.05, 0.1042, 0.5])
+        x = np.array([0.0, 0.3, 0.45])
+        h = irf_kernels(family, k, x, DT, L)
+        assert h.shape == (3, L)
+        np.testing.assert_allclose(h.sum(axis=1), 1.0, atol=1e-6)
+        assert np.isfinite(h).all()
+
+    def test_longer_k_delays_mass(self, family):
+        h = irf_kernels(family, np.array([0.05, 0.5]), np.array([0.2, 0.2]), DT, L)
+        t = np.arange(L)
+        # First temporal moment increases with travel time for every family.
+        assert (h[1] * t).sum() > (h[0] * t).sum()
+
+
+class TestKernelSpecifics:
+    def test_pure_lag_is_spike_at_k(self):
+        h = irf_kernels("pure_lag", np.array([0.25]), np.array([0.3]), DT, L)
+        assert h[0, 6] == 1.0  # 0.25 d = 6 h
+        assert h[0].sum() == 1.0
+
+    def test_linear_storage_monotone_decay(self):
+        h = irf_kernels("linear_storage", np.array([0.2]), np.array([0.3]), DT, L)
+        assert (np.diff(h[0]) < 0).all()
+
+    def test_nash_cascade_mean_near_k(self):
+        k = 0.3
+        h = irf_kernels("nash_cascade", np.array([k]), np.array([0.3]), DT, 400)
+        t = (np.arange(400) + 0.5) * DT
+        assert (h[0] * t).sum() == pytest.approx(k, rel=0.05)
+
+    def test_muskingum_initial_dip_for_slow_reaches(self):
+        # Bin 0 nets the -x/(1-x) spike against the exponential's first-bin mass
+        # (1-e^{-dt/K(1-x)})/(1-x): negative (the classic Muskingum dip) when the
+        # reach is slow vs dt, positive when fast (all mass lands in bin 0).
+        h_slow = irf_kernels("muskingum", np.array([0.2]), np.array([0.3]), DT, L)
+        h_fast = irf_kernels("muskingum", np.array([0.002]), np.array([0.3]), DT, L)
+        assert h_slow[0, 0] < 0
+        assert h_fast[0, 0] == pytest.approx(1.0, abs=1e-6)
+        assert abs(h_fast[0, 1:]).max() < 1e-9
+
+    def test_hayami_peak_near_k_for_low_dispersion(self):
+        # Inverse-Gaussian mode -> mean as x -> 0 (pure translation limit).
+        h = irf_kernels("hayami", np.array([0.25]), np.array([0.01]), DT, L)
+        assert abs(int(h[0].argmax()) - 6) <= 1  # 0.25 d = bin ~6
+
+    @pytest.mark.parametrize("family", IRF_FAMILIES)
+    def test_degenerate_fast_reach_becomes_spike(self, family):
+        # k << dt must never yield a zero (flow-annihilating) kernel.
+        h = irf_kernels(family, np.array([1e-4]), np.array([0.3]), DT, L)
+        np.testing.assert_allclose(h.sum(axis=1), 1.0, atol=1e-6)
+        assert h[0, 0] == pytest.approx(1.0)
+
+    def test_unknown_family_rejected(self):
+        with pytest.raises(ValueError, match="irf_fn"):
+            irf_kernels("spectral", np.ones(1), np.zeros(1), DT, L)
+
+
+def _oracle(rows, cols, n, kernels, q_prime):
+    """Time-domain reference: topological sweep of truncated-kernel convolutions."""
+    T = q_prime.shape[0]
+    q = np.zeros((T, n))
+    for i in range(n):  # nodes are topologically sorted
+        inflow = q_prime[:, i].astype(np.float64).copy()
+        for e in range(len(rows)):
+            if rows[e] == i:
+                inflow += q[:, cols[e]]
+        q[:, i] = np.convolve(inflow, kernels[i].astype(np.float64))[:T]
+    return q
+
+
+class TestRouteLti:
+    @pytest.mark.parametrize("family", ["muskingum", "linear_storage", "pure_lag"])
+    def test_matches_time_domain_oracle(self, family, rng):
+        # Y-network plus a chain: 0,1 -> 2 -> 3 -> 4
+        rows = np.array([2, 2, 3, 4])
+        cols = np.array([0, 1, 2, 3])
+        n, T = 5, 240
+        network = build_network(rows, cols, n)
+        k = rng.uniform(0.05, 0.3, n)
+        x = rng.uniform(0.05, 0.4, n)
+        kernels = irf_kernels(family, k, x, DT, L)
+        q_prime = rng.uniform(0.0, 2.0, (T, n)).astype(np.float32)
+
+        got = np.asarray(route_lti(network, kernels, jnp.asarray(q_prime)))
+        want = _oracle(rows, cols, n, kernels, q_prime)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+    def test_mass_conservation_at_outlet(self, rng):
+        # Chain of 4; impulse inflow only at the head; all mass must exit reach 3.
+        rows, cols = np.array([1, 2, 3]), np.array([0, 1, 2])
+        network = build_network(rows, cols, 4)
+        kernels = irf_kernels("linear_storage", np.full(4, 0.05), np.full(4, 0.3), DT, L)
+        T = 2048  # long window so the composed response fully decays
+        q_prime = np.zeros((T, 4), np.float32)
+        q_prime[0, 0] = 1.0
+        q = np.asarray(route_lti(network, kernels, jnp.asarray(q_prime)))
+        assert q[:, 3].sum() == pytest.approx(1.0, abs=1e-4)
+
+    def test_shape_validation(self):
+        network = build_network(np.array([1]), np.array([0]), 2)
+        kernels = irf_kernels("linear_storage", np.ones(2), np.zeros(2), DT, L)
+        with pytest.raises(ValueError, match="reaches"):
+            route_lti(network, kernels, jnp.zeros((10, 3)))

@@ -172,6 +172,18 @@ class TestTime:
         fresh_bmi.update_until(t - 3600.0)
         assert fresh_bmi.get_current_time() == t
 
+    def test_update_until_below_half_step_defers(self, fresh_bmi):
+        # Advancing a whole 3600 s step for a 900 s request would overshoot and
+        # desynchronize from ngen's clock; the model defers until enough time
+        # accumulates, keeping queued inflows.
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 1.0))
+        fresh_bmi.update_until(900.0)
+        assert fresh_bmi.get_current_time() == 0.0
+        assert fresh_bmi._lateral_inflow.sum() > 0  # inflows not consumed
+        fresh_bmi.update_until(3600.0)
+        assert fresh_bmi.get_current_time() == 3600.0
+
 
 class TestGrid:
     def test_grid_shape(self, bmi):
@@ -220,6 +232,16 @@ class TestValues:
 
     def test_set_unknown_variable_does_not_crash(self, fresh_bmi):
         fresh_bmi.set_value("not_a_variable", np.zeros(3))
+
+    def test_set_value_shorter_than_nexus_ids(self, fresh_bmi):
+        fresh_bmi.set_value(
+            "land_surface_water_source__id", np.array([1, 2, 3, 4, 5], dtype=np.int32)
+        )
+        fresh_bmi.set_value(
+            "land_surface_water_source__volume_flow_rate", np.array([1.0, 2.0, 3.0])
+        )
+        assert fresh_bmi._lateral_inflow[3] == 3.0  # identity map: nexus 3 -> seg 3
+        assert fresh_bmi._lateral_inflow[4] == 0.0  # unsent entries untouched
 
     def test_set_ngen_dt(self, fresh_bmi):
         fresh_bmi.set_value("ngen_dt", np.array([900], dtype=np.int32))
