@@ -95,6 +95,7 @@ def evaluate_hourly(
     import jax.numpy as jnp
 
     from ddr_tpu.geodatazoo.loader import DataLoader
+    from ddr_tpu.profiling import Throughput
     from ddr_tpu.routing.model import dmc
 
     routing_model = routing_model or dmc(cfg)
@@ -103,11 +104,15 @@ def evaluate_hourly(
     predictions = np.zeros(
         (n_gauges, len(dataset.dates.hourly_time_range)), dtype=np.float32
     )
+    throughput = Throughput(label="evaluate")
     for i, rd in enumerate(loader):
         q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
-        raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
-        out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
-        predictions[:, rd.dates.hourly_indices] = np.asarray(out["runoff"])
+        with throughput.batch(rd.n_segments, q_prime.shape[0]):
+            raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
+            out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
+            chunk = np.asarray(out["runoff"])  # device sync
+        predictions[:, rd.dates.hourly_indices] = chunk
+    throughput.log_summary()
     return predictions
 
 

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ddr_tpu.geodatazoo.loader import DataLoader
+from ddr_tpu.profiling import Throughput, trace
 from ddr_tpu.routing.mc import Bounds
 from ddr_tpu.routing.model import prepare_batch
 from ddr_tpu.scripts_utils import resolve_learning_rate
@@ -81,71 +82,81 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     )
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
+    throughput = Throughput(label="train")
 
-    for epoch in range(start_epoch, cfg.experiment.epochs + 1):
-        if epoch in cfg.experiment.learning_rate:
-            log.info(f"Setting learning rate: {cfg.experiment.learning_rate[epoch]}")
-            opt_state = set_learning_rate(opt_state, cfg.experiment.learning_rate[epoch])
+    # try/finally so the aggregate summary survives every exit path, including the
+    # KeyboardInterrupt that main() treats as a normal way to end a long run.
+    try:
+        for epoch in range(start_epoch, cfg.experiment.epochs + 1):
+            if epoch in cfg.experiment.learning_rate:
+                log.info(f"Setting learning rate: {cfg.experiment.learning_rate[epoch]}")
+                opt_state = set_learning_rate(opt_state, cfg.experiment.learning_rate[epoch])
 
-        for i, rd in enumerate(loader):
-            if epoch == start_epoch and i < start_mini_batch:
-                log.info(f"Skipping mini-batch {i}. Resuming at {start_mini_batch}")
-                continue
+            for i, rd in enumerate(loader):
+                if epoch == start_epoch and i < start_mini_batch:
+                    log.info(f"Skipping mini-batch {i}. Resuming at {start_mini_batch}")
+                    continue
 
-            q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
-            if rd.flow_scale is not None:
-                q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
-            network, channels, gauges = prepare_batch(rd, slope_min)
-            attrs = jnp.asarray(rd.normalized_spatial_attributes)
-            obs_daily, obs_mask = daily_observation_targets(rd)
+                q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+                if rd.flow_scale is not None:
+                    q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
+                network, channels, gauges = prepare_batch(rd, slope_min)
+                attrs = jnp.asarray(rd.normalized_spatial_attributes)
+                obs_daily, obs_mask = daily_observation_targets(rd)
 
-            params, opt_state, loss, daily = step(
-                params,
-                opt_state,
-                network,
-                channels,
-                gauges,
-                attrs,
-                jnp.asarray(q_prime),
-                jnp.asarray(obs_daily),
-                jnp.asarray(obs_mask),
-            )
-            loss = float(loss)
-            daily = np.asarray(daily)  # (D-1, G)
-            log.info(f"epoch {epoch} mini-batch {i}: loss={loss:.5f}")
+                with throughput.batch(rd.n_segments, q_prime.shape[0]):
+                    params, opt_state, loss, daily = step(
+                        params,
+                        opt_state,
+                        network,
+                        channels,
+                        gauges,
+                        attrs,
+                        jnp.asarray(q_prime),
+                        jnp.asarray(obs_daily),
+                        jnp.asarray(obs_mask),
+                    )
+                    loss = float(loss)  # device sync: the timing covers the whole step
+                daily = np.asarray(daily)  # (D-1, G)
+                log.info(
+                    f"epoch {epoch} mini-batch {i}: loss={loss:.5f} "
+                    f"({throughput.last_rate:,.0f} reach-timesteps/s)"
+                )
 
-            target = np.where(obs_mask, obs_daily, np.nan)
-            metrics = Metrics(pred=daily.T, target=target.T)
-            log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
+                target = np.where(obs_mask, obs_daily, np.nan)
+                metrics = Metrics(pred=daily.T, target=target.T)
+                log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
 
-            gage_ids = rd.observations.gage_ids
-            plot_time_series(
-                daily[:, -1],
-                target[:, -1],
-                rd.dates.batch_daily_time_range[1:-1],
-                gage_ids[-1],
-                cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
-                name=cfg.name,
-                warmup=cfg.experiment.warmup,
-            )
-            save_state(
-                cfg.params.save_path / "saved_models",
-                cfg.name,
-                epoch,
-                i,
-                params,
-                opt_state,
-                rng_state=loader.state(),
-            )
-            n_done += 1
-            if max_batches is not None and n_done >= max_batches:
-                return params, opt_state
-    return params, opt_state
+                gage_ids = rd.observations.gage_ids
+                plot_time_series(
+                    daily[:, -1],
+                    target[:, -1],
+                    rd.dates.batch_daily_time_range[1:-1],
+                    gage_ids[-1],
+                    cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
+                    name=cfg.name,
+                    warmup=cfg.experiment.warmup,
+                )
+                save_state(
+                    cfg.params.save_path / "saved_models",
+                    cfg.name,
+                    epoch,
+                    i,
+                    params,
+                    opt_state,
+                    rng_state=loader.state(),
+                )
+                n_done += 1
+                if max_batches is not None and n_done >= max_batches:
+                    return params, opt_state
+        return params, opt_state
+    finally:
+        throughput.log_summary()
 
 
 def main(argv: list[str] | None = None) -> int:
     cfg = parse_cli(argv, mode="training")
-    with timed("training"):
+    with timed("training"), trace():
         try:
             train(cfg)
         except KeyboardInterrupt:

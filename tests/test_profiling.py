@@ -1,0 +1,58 @@
+"""Throughput counter + profiler trace context tests (SURVEY.md §5: the aux
+observability subsystem the reference lacks; its analog is wall-clock brackets,
+/root/reference/scripts/train.py:174,196-197)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ddr_tpu.profiling import Throughput, profile_dir_from_env, trace
+
+
+class TestThroughput:
+    def test_record_math(self):
+        tp = Throughput()
+        rate = tp.record(n_reaches=100, n_timesteps=24, seconds=2.0)
+        assert rate == pytest.approx(1200.0)
+        tp.record(n_reaches=100, n_timesteps=24, seconds=1.0)
+        assert tp.last_rate == pytest.approx(2400.0)
+        assert tp.rate == pytest.approx(4800 / 3.0)
+        assert tp.batches == 2
+
+    def test_batch_context_times_body(self):
+        tp = Throughput()
+        with tp.batch(n_reaches=10, n_timesteps=10):
+            time.sleep(0.01)
+        assert tp.batches == 1
+        assert 0 < tp.last_rate < 100 / 0.009
+
+    def test_empty_counter_is_quiet(self):
+        tp = Throughput()
+        assert tp.rate == 0.0
+        tp.log_summary()  # no batches: no-op, no division by zero
+
+    def test_format_mentions_unit(self):
+        tp = Throughput(label="x")
+        tp.record(10, 10, 1.0)
+        assert "reach-timesteps/s" in tp.format()
+
+
+class TestTrace:
+    def test_noop_without_dir(self, monkeypatch):
+        monkeypatch.delenv("DDR_PROFILE_DIR", raising=False)
+        assert profile_dir_from_env() is None
+        with trace():  # must not require jax or write anything
+            pass
+
+    def test_env_var_activates(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("DDR_PROFILE_DIR", str(tmp_path / "prof"))
+        assert profile_dir_from_env() == str(tmp_path / "prof")
+
+    def test_trace_writes_profile(self, tmp_path):
+        import jax.numpy as jnp
+
+        with trace(str(tmp_path)):
+            jnp.arange(8).sum().block_until_ready()
+        assert any(tmp_path.rglob("*"))  # trace artifacts written
