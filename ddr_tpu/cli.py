@@ -18,6 +18,7 @@ _COMMANDS = {
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",
+    "gen-config-docs": "ddr_tpu.scripts.gen_config_docs",
 }
 
 

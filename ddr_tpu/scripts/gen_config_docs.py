@@ -1,0 +1,112 @@
+"""Generate ``docs/config_reference.md`` from the Pydantic config models
+(reference /root/reference/scripts/gen_config_docs.py:1-122).
+
+Covers the core :class:`~ddr_tpu.validation.configs.Config` tree plus the BMI and
+benchmark configs, one table per model, from each model's JSON schema so the docs can
+never drift from the code.
+
+Usage: ``python -m ddr_tpu.scripts.gen_config_docs [output.md]``
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+HEADER = """# Configuration reference
+
+Auto-generated from the Pydantic models by `python -m ddr_tpu.scripts.gen_config_docs`
+— do not edit by hand. All models reject unknown keys (`extra="forbid"`).
+
+YAML configs are loaded by `ddr_tpu.validation.configs.load_config`, which also
+accepts dotted CLI overrides (`ddr train config.yaml experiment.epochs=5`).
+"""
+
+
+def _schema_type(prop: dict[str, Any], defs: dict[str, Any]) -> str:
+    if "$ref" in prop:
+        name = prop["$ref"].rsplit("/", 1)[-1]
+        target = defs.get(name, {})
+        if "enum" in target:  # inline enum values: the reference a config author needs
+            return " \\| ".join(repr(v) for v in target["enum"])
+        return name
+    if "anyOf" in prop:
+        return " \\| ".join(_schema_type(p, defs) for p in prop["anyOf"])
+    if "allOf" in prop:
+        return " & ".join(_schema_type(p, defs) for p in prop["allOf"])
+    t = prop.get("type")
+    if t == "array":
+        return f"list[{_schema_type(prop.get('items', {}), defs)}]"
+    if t == "object":
+        extra = prop.get("additionalProperties")
+        if isinstance(extra, dict):
+            return f"dict[{_schema_type(extra, defs)}]"
+        return "dict"
+    if "enum" in prop:
+        return " \\| ".join(repr(v) for v in prop["enum"])
+    return str(t or "any")
+
+
+def _fmt_default(prop: dict[str, Any]) -> str:
+    if "default" not in prop:
+        return "**required**"
+    d = prop["default"]
+    if d is None:
+        return "`None`"
+    s = json.dumps(d) if isinstance(d, (dict, list)) else str(d)
+    if len(s) > 48:
+        s = s[:45] + "..."
+    return f"`{s}`"
+
+
+def _model_section(name: str, schema: dict[str, Any], defs: dict[str, Any]) -> list[str]:
+    lines = [f"## `{name}`", ""]
+    doc = (schema.get("description") or "").strip().split("\n")[0]
+    if doc:
+        lines += [doc, ""]
+    lines += ["| field | type | default | description |", "|---|---|---|---|"]
+    for field, prop in schema.get("properties", {}).items():
+        desc = (prop.get("description") or "").replace("|", "\\|")
+        lines.append(
+            f"| `{field}` | {_schema_type(prop, defs)} | {_fmt_default(prop)} | {desc} |"
+        )
+    lines.append("")
+    return lines
+
+
+def generate() -> str:
+    from ddr_tpu.benchmarks.configs import BenchmarkConfig
+    from ddr_tpu.bmi.config import BmiInitConfig
+    from ddr_tpu.validation.configs import Config
+
+    out = [HEADER]
+    emitted: set[str] = set()  # BenchmarkConfig embeds Config: emit each model once
+    for root_name, model in (
+        ("Config", Config),
+        ("BmiInitConfig", BmiInitConfig),
+        ("BenchmarkConfig", BenchmarkConfig),
+    ):
+        schema = model.model_json_schema()
+        defs = schema.get("$defs", {})
+        if root_name not in emitted:
+            emitted.add(root_name)
+            out += _model_section(root_name, schema, defs)
+        for def_name, def_schema in sorted(defs.items()):
+            if def_schema.get("type") == "object" and def_name not in emitted:
+                emitted.add(def_name)
+                out += _model_section(def_name, def_schema, defs)
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(argv or [])
+    out_path = Path(argv[0]) if argv else Path("docs/config_reference.md")
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(generate())
+    print(f"Wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,49 @@
+"""Config-reference generator tests (reference scripts/gen_config_docs.py:1-122)."""
+
+from __future__ import annotations
+
+from ddr_tpu.scripts.gen_config_docs import generate, main
+
+
+def test_generate_covers_all_models():
+    md = generate()
+    for section in (
+        "Config",
+        "DataSources",
+        "Params",
+        "Kan",
+        "ExperimentConfig",
+        "BmiInitConfig",
+        "BenchmarkConfig",
+        "LTIRouteConfig",
+    ):
+        assert f"## `{section}`" in md
+
+
+def test_required_and_defaults_marked():
+    md = generate()
+    assert "**required**" in md  # name, kan, ...
+    assert "`tpu`" in md  # device default
+
+
+def test_table_rows_have_exactly_four_cells():
+    for line in generate().splitlines():
+        if line.startswith("| `"):
+            assert line.count("|") - line.count("\\|") == 5, line
+
+
+def test_no_duplicate_sections():
+    md = generate()
+    headers = [ln for ln in md.splitlines() if ln.startswith("## ")]
+    assert len(headers) == len(set(headers))
+
+
+def test_enum_values_inlined():
+    md = generate()
+    assert "'merit'" in md and "'training'" in md
+
+
+def test_main_writes_file(tmp_path):
+    out = tmp_path / "ref.md"
+    assert main([str(out)]) == 0
+    assert out.read_text().startswith("# Configuration reference")
