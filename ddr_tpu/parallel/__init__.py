@@ -1,10 +1,16 @@
-"""Parallelism layer: reach-dimension SPMD over a device mesh + topological-range
-partitioning (first-class components with no reference counterpart, SURVEY.md §2.11)."""
+"""Parallelism layer: reach-dimension SPMD over a device mesh, topological-range
+partitioning, and the explicit-collective pipelined wavefront router (first-class
+components with no reference counterpart, SURVEY.md §2.11)."""
 
 from ddr_tpu.parallel.partition import (
     ReachPartition,
     permute_routing_data,
     topological_range_partition,
+)
+from ddr_tpu.parallel.pipeline import (
+    PipelineSchedule,
+    build_pipeline_schedule,
+    pipelined_route,
 )
 from ddr_tpu.parallel.sharding import (
     make_mesh,
@@ -16,8 +22,11 @@ from ddr_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "PipelineSchedule",
     "ReachPartition",
+    "build_pipeline_schedule",
     "permute_routing_data",
+    "pipelined_route",
     "topological_range_partition",
     "make_mesh",
     "reach_sharding",

@@ -1,0 +1,278 @@
+"""Pipelined wavefront routing: explicit-collective multi-chip Muskingum-Cunge.
+
+The GSPMD path (:mod:`ddr_tpu.parallel.sharding`) lets XLA insert collectives inside
+every level of every timestep's solve. This module is the scalable alternative the
+topological-range partition was designed for (SURVEY.md §2.11/§5): with contiguous
+topological ranges, every cross-shard edge points from a lower shard to a higher
+shard, so the triangular solve is block forward substitution — shard k's block
+depends only on *final* boundary values from shards < k. The cross-shard latency is
+hidden by software-pipelining over timesteps:
+
+    at global step g, shard s routes ITS timestep t = g - s
+
+so every chip solves one local timestep per global step (full utilization after S-1
+fill steps), and the only communication is one ``psum`` of a length-B boundary vector
+per global step (B = cross-shard edges), riding ICI. A lower shard runs *ahead* of a
+higher shard, so by the time shard s needs the boundary discharge of shard s' < s for
+timestep t, it was produced d = s - s' steps ago and sits in a short history buffer
+carried through the scan.
+
+Forward/inference engine (`ddr test` / `ddr route` / BMI at CONUS scale); training
+uses the differentiable GSPMD path. Inputs must already be in partitioned order
+(:func:`ddr_tpu.parallel.partition.permute_routing_data`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
+from ddr_tpu.routing.network import level_schedule
+from ddr_tpu.routing.solver import _sweep_down
+
+__all__ = ["PipelineSchedule", "build_pipeline_schedule", "pipelined_route"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PipelineSchedule:
+    """Static pipeline layout.
+
+    Per-shard arrays are stacked on a leading shard axis (sharded over the mesh, so
+    each shard sees its own block inside ``shard_map``); boundary-edge arrays are
+    replicated. ``n_local`` is the sentinel for padded local indices.
+
+    Attributes
+    ----------
+    lvl_src, lvl_tgt:
+        (S, D, E) per-shard local level schedules (local indices, pad ``n_local``).
+    loc_src, loc_tgt:
+        (S, E_loc) per-shard local edge lists for the upstream SpMV.
+    out_src, in_tgt:
+        (S, B) boundary views: local source index if the edge leaves this shard /
+        local target index if it enters it; ``n_local`` otherwise.
+    delay:
+        (B,) pipeline delay of each boundary edge: target shard - source shard.
+    """
+
+    lvl_src: jnp.ndarray
+    lvl_tgt: jnp.ndarray
+    loc_src: jnp.ndarray
+    loc_tgt: jnp.ndarray
+    out_src: jnp.ndarray
+    in_tgt: jnp.ndarray
+    delay: jnp.ndarray
+    n_shards: int = dataclasses.field(metadata={"static": True})
+    n_local: int = dataclasses.field(metadata={"static": True})
+    n_boundary: int = dataclasses.field(metadata={"static": True})
+
+
+def build_pipeline_schedule(
+    rows: np.ndarray, cols: np.ndarray, n: int, n_shards: int
+) -> PipelineSchedule:
+    """Split a partitioned-order COO adjacency into per-shard local schedules plus
+    the boundary-edge pipeline layout.
+
+    ``rows``/``cols`` must already be in topological-range-partitioned order (every
+    cross-shard edge goes to a strictly higher shard) and ``n`` divisible by
+    ``n_shards`` (equal shard_map blocks).
+    """
+    if n % n_shards:
+        raise ValueError(f"n={n} not divisible by n_shards={n_shards}; pad the batch")
+    n_local = n // n_shards
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    src_shard = cols // n_local
+    tgt_shard = rows // n_local
+    if (src_shard > tgt_shard).any():
+        raise ValueError("edges must not point to lower shards (partition the batch first)")
+
+    local = src_shard == tgt_shard
+    l_src, l_tgt, l_shard = cols[local] % n_local, rows[local] % n_local, src_shard[local]
+    b_src, b_tgt = cols[~local], rows[~local]
+    b_sshard, b_tshard = src_shard[~local], tgt_shard[~local]
+
+    # Per-shard local level schedules (shared builder with build_network), padded to
+    # a common (D, E) rectangle across shards.
+    schedules = [
+        level_schedule(l_tgt[l_shard == s], l_src[l_shard == s], n_local)
+        for s in range(n_shards)
+    ]
+    d_max = max(1, *(d for _, _, d in schedules))
+    e_max = max(1, *(ls.shape[1] if ls.size else 1 for ls, _, _ in schedules))
+    eloc_max = max(1, int(np.bincount(l_shard, minlength=n_shards).max()) if l_shard.size else 1)
+
+    lvl_src = np.full((n_shards, d_max, e_max), n_local, dtype=np.int64)
+    lvl_tgt = np.full((n_shards, d_max, e_max), n_local, dtype=np.int64)
+    loc_src = np.full((n_shards, eloc_max), n_local, dtype=np.int64)
+    loc_tgt = np.full((n_shards, eloc_max), n_local, dtype=np.int64)
+    for s, (ls, lt, depth) in enumerate(schedules):
+        if depth:
+            lvl_src[s, :depth, : ls.shape[1]] = ls
+            lvl_tgt[s, :depth, : lt.shape[1]] = lt
+        m = l_shard == s
+        loc_src[s, : m.sum()] = l_src[m]
+        loc_tgt[s, : m.sum()] = l_tgt[m]
+
+    n_boundary = max(1, len(b_src))  # keep shapes non-empty for the single-shard case
+    out_src = np.full((n_shards, n_boundary), n_local, dtype=np.int64)
+    in_tgt = np.full((n_shards, n_boundary), n_local, dtype=np.int64)
+    delay = np.ones(n_boundary, dtype=np.int64)
+    for e in range(len(b_src)):
+        out_src[b_sshard[e], e] = b_src[e] % n_local
+        in_tgt[b_tshard[e], e] = b_tgt[e] % n_local
+        delay[e] = b_tshard[e] - b_sshard[e]
+
+    return PipelineSchedule(
+        lvl_src=jnp.asarray(lvl_src, jnp.int32),
+        lvl_tgt=jnp.asarray(lvl_tgt, jnp.int32),
+        loc_src=jnp.asarray(loc_src, jnp.int32),
+        loc_tgt=jnp.asarray(loc_tgt, jnp.int32),
+        out_src=jnp.asarray(out_src, jnp.int32),
+        in_tgt=jnp.asarray(in_tgt, jnp.int32),
+        delay=jnp.asarray(delay, jnp.int32),
+        n_shards=n_shards,
+        n_local=n_local,
+        n_boundary=n_boundary,
+    )
+
+
+def pipelined_route(
+    mesh: Mesh,
+    schedule: PipelineSchedule,
+    channels: ChannelState,
+    spatial_params: dict[str, Any],
+    q_prime: jnp.ndarray,
+    q_init: jnp.ndarray | None = None,
+    bounds: Bounds = Bounds(),
+    dt: float = 3600.0,
+    axis_name: str = "reach",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Route ``(T, N)`` inflows over the mesh; returns ``(runoff (T, N), q_final (N,))``.
+
+    Semantics match :func:`ddr_tpu.routing.mc.route` on the same (partitioned-order)
+    inputs: ``runoff[0]`` is the clamped initial state (hotstart from ``q_prime[0]``
+    unless ``q_init`` is given), step t consumes ``q_prime[t-1]``.
+    """
+    T = q_prime.shape[0]
+    S, n_local, B = schedule.n_shards, schedule.n_local, schedule.n_boundary
+    G = T + S - 1
+    has_init = q_init is not None
+    if not has_init:
+        q_init = jnp.zeros(q_prime.shape[1], q_prime.dtype)
+
+    n_mann = spatial_params["n"]
+    p_sp = spatial_params["p_spatial"]
+    q_sp = spatial_params["q_spatial"]
+    # None observed-geometry overrides become all-NaN arrays (identical semantics:
+    # NaN entries fall back to the derived geometry), keeping shard_map specs uniform.
+    nan = jnp.full_like(channels.length, jnp.nan)
+    twd_in = channels.top_width_data if channels.top_width_data is not None else nan
+    ssd_in = channels.side_slope_data if channels.side_slope_data is not None else nan
+
+    def shard_fn(lvl_src, lvl_tgt, loc_src, loc_tgt, out_src, in_tgt, delay,
+                 length, slope, x_st, twd, ssd, n_c, p_c, q_c, qp, qi):
+        # Per-shard blocks arrive with the leading shard axis of size 1.
+        lvl_src, lvl_tgt = lvl_src[0], lvl_tgt[0]
+        loc_src, loc_tgt = loc_src[0], loc_tgt[0]
+        out_src, in_tgt = out_src[0], in_tgt[0]
+        ch = ChannelState(
+            length=length, slope=slope, x_storage=x_st,
+            top_width_data=twd, side_slope_data=ssd,
+        )
+        s_idx = jax.lax.axis_index(axis_name)
+
+        def step(carry, g):
+            q, hist = carry  # q: (n_local,), hist: (S, B) boundary history
+            tau = g - s_idx
+            active = (tau >= 0) & (tau < T)
+            tau_c = jnp.clip(tau, 0, T - 1)
+            qp_tau = jax.lax.dynamic_index_in_dim(qp, tau_c, keepdims=False)
+            qp_prev = jax.lax.dynamic_index_in_dim(
+                qp, jnp.maximum(tau_c - 1, 0), keepdims=False
+            )
+
+            # Boundary values for this shard's current stage. The stream carries the
+            # RAW solve outputs: within one timestep's triangular solve, downstream
+            # rows couple to the unclamped x[src] (route_step clamps only after the
+            # whole-network solve), so the solve-contribution (source at OUR stage,
+            # produced d steps ago -> hist[d-1]) is used raw, while the SpMV needs
+            # the source's clamped previous-stage discharge -> max(hist[d], lb).
+            x_in = hist[delay - 1, jnp.arange(B)]
+            q_prev_in = jnp.maximum(
+                hist[jnp.minimum(delay, S - 1), jnp.arange(B)], bounds.discharge
+            )
+
+            # Muskingum-Cunge step (mirrors routing.mc.route_step on the local block).
+            c, _, _ = celerity(q, n_c, p_c, q_c, ch, bounds)
+            c1, c2, c3, c4 = muskingum_coefficients(ch.length, c, ch.x_storage, dt)
+            i_t = jax.ops.segment_sum(
+                jnp.concatenate([q, jnp.zeros(1, q.dtype)])[loc_src],
+                loc_tgt,
+                num_segments=n_local + 1,
+            )[:n_local]
+            i_t = i_t.at[in_tgt].add(jnp.where(in_tgt < n_local, q_prev_in, 0.0), mode="drop")
+            b_step = c2 * i_t + c3 * q + c4 * jnp.maximum(qp_prev, bounds.discharge)
+
+            # Stage 0 is the hotstart solve (I - N) q0 = q'_0 (c1 = 1), or the
+            # provided carry state. hotstart_discharge solves with the RAW first
+            # inflow and clamps only the result (routing/mc.py), so no clamp here.
+            is_hot = tau == 0
+            c1_eff = jnp.where(is_hot, jnp.ones_like(c1), c1)
+            b_eff = jnp.where(is_hot, qp_tau, b_step)
+            c1_at_tgt = jnp.concatenate([c1_eff, jnp.zeros(1, c1_eff.dtype)])[in_tgt]
+            b_eff = b_eff.at[in_tgt].add(c1_at_tgt * x_in, mode="drop")
+
+            x = _sweep_down(c1_eff, b_eff, lvl_src, lvl_tgt)
+            if has_init:
+                x = jnp.where(is_hot, jnp.maximum(qi, bounds.discharge), x)
+            q_new = jnp.maximum(x, bounds.discharge)
+            q_next = jnp.where(active, q_new, q)
+
+            # Publish raw boundary solve outputs: one psum per global step, each slot
+            # owned by exactly one source shard (sentinel slots contribute zero).
+            mine = (out_src < n_local) & active
+            v_out = jnp.where(
+                mine, jnp.concatenate([x, jnp.zeros(1, q.dtype)])[out_src], 0.0
+            )
+            new_row = jax.lax.psum(v_out, axis_name)
+            hist = jnp.concatenate([new_row[None], hist[:-1]], axis=0)
+
+            return (q_next, hist), jnp.where(active, q_next, 0.0)
+
+        init = (
+            jnp.full((n_local,), bounds.discharge, qp.dtype),
+            jnp.zeros((S, B), qp.dtype),
+        )
+        (q_fin, _), outs = jax.lax.scan(step, init, jnp.arange(G))  # outs: (G, n_local)
+        # Shard s's stage t lives at global step t + s.
+        runoff = jax.lax.dynamic_slice(outs, (s_idx, 0), (T, n_local))
+        return runoff, q_fin
+
+    shard = P(axis_name)
+    rep = P()
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            shard, shard, shard, shard, shard, shard, rep,  # schedule
+            shard, shard, shard, shard, shard,  # channel arrays
+            shard, shard, shard,  # spatial params
+            P(None, axis_name), shard,  # q_prime, q_init
+        ),
+        out_specs=(P(None, axis_name), shard),
+        check_vma=False,
+    )
+    return fn(
+        schedule.lvl_src, schedule.lvl_tgt, schedule.loc_src, schedule.loc_tgt,
+        schedule.out_src, schedule.in_tgt, schedule.delay,
+        channels.length, channels.slope, channels.x_storage, twd_in, ssd_in,
+        n_mann, p_sp, q_sp, q_prime, q_init,
+    )

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["RiverNetwork", "compute_levels", "build_network"]
+__all__ = ["RiverNetwork", "compute_levels", "level_schedule", "build_network"]
 
 
 @jax.tree_util.register_dataclass
@@ -121,6 +121,38 @@ def compute_levels(rows: np.ndarray, cols: np.ndarray, n: int) -> np.ndarray:
     return level
 
 
+def level_schedule(
+    rows: np.ndarray, cols: np.ndarray, n: int
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Edges grouped by target level and padded to a ``(depth, e_max)`` rectangle.
+
+    Padding slots hold the sentinel ``n`` (consumed by the solver's clip-gather /
+    drop-scatter convention). Shared by :func:`build_network` and the per-shard
+    schedules of :mod:`ddr_tpu.parallel.pipeline`.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    level = compute_levels(rows, cols, n)
+    depth = int(level.max()) if n else 0
+
+    if rows.size == 0 or depth == 0:
+        return np.zeros((0, 1), dtype=np.int64), np.zeros((0, 1), dtype=np.int64), 0
+
+    tgt_level = level[rows]  # every edge's target has level >= 1
+    order = np.argsort(tgt_level, kind="stable")
+    s_src = cols[order]
+    s_tgt = rows[order]
+    counts = np.bincount(tgt_level[order], minlength=depth + 1)[1:]  # levels 1..depth
+    e_max = int(counts.max())
+    lvl_src = np.full((depth, e_max), n, dtype=np.int64)
+    lvl_tgt = np.full((depth, e_max), n, dtype=np.int64)
+    col_pos = _ranges(np.zeros(depth, dtype=np.int64), counts.astype(np.int64))
+    row_pos = np.repeat(np.arange(depth), counts)
+    lvl_src[row_pos, col_pos] = s_src
+    lvl_tgt[row_pos, col_pos] = s_tgt
+    return lvl_src, lvl_tgt, depth
+
+
 def build_network(rows: np.ndarray, cols: np.ndarray, n: int) -> RiverNetwork:
     """Build the jit-ready :class:`RiverNetwork` from a COO adjacency.
 
@@ -130,27 +162,7 @@ def build_network(rows: np.ndarray, cols: np.ndarray, n: int) -> RiverNetwork:
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
-    level = compute_levels(rows, cols, n)
-    depth = int(level.max()) if n else 0
-
-    if rows.size == 0 or depth == 0:
-        lvl_src = np.zeros((0, 1), dtype=np.int64)
-        lvl_tgt = np.zeros((0, 1), dtype=np.int64)
-        depth = 0
-    else:
-        tgt_level = level[rows]  # every edge's target has level >= 1
-        order = np.argsort(tgt_level, kind="stable")
-        s_src = cols[order]
-        s_tgt = rows[order]
-        counts = np.bincount(tgt_level[order], minlength=depth + 1)[1:]  # levels 1..depth
-        e_max = int(counts.max())
-        lvl_src = np.full((depth, e_max), n, dtype=np.int64)
-        lvl_tgt = np.full((depth, e_max), n, dtype=np.int64)
-        starts = np.concatenate([[0], np.cumsum(counts)])
-        col_pos = _ranges(np.zeros(depth, dtype=np.int64), counts.astype(np.int64))
-        row_pos = np.repeat(np.arange(depth), counts)
-        lvl_src[row_pos, col_pos] = s_src
-        lvl_tgt[row_pos, col_pos] = s_tgt
+    lvl_src, lvl_tgt, depth = level_schedule(rows, cols, n)
 
     return RiverNetwork(
         edge_src=jnp.asarray(cols, dtype=jnp.int32),

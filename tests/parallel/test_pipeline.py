@@ -1,0 +1,154 @@
+"""Pipelined wavefront router tests: exact agreement with the single-device engine
+on an 8-virtual-device CPU mesh (the multi-chip analog of the reference's CPU-only
+CI, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin
+from ddr_tpu.parallel import (
+    make_mesh,
+    permute_routing_data,
+    topological_range_partition,
+)
+from ddr_tpu.parallel.pipeline import build_pipeline_schedule, pipelined_route
+from ddr_tpu.routing.mc import route
+from ddr_tpu.routing.model import prepare_batch
+from ddr_tpu.routing.network import build_network as build_network_for
+
+N, S, T_DAYS = 64, 8, 4
+
+
+@pytest.fixture(scope="module")
+def partitioned():
+    basin = make_basin(n_segments=N, n_gauges=4, n_days=T_DAYS, seed=3)
+    rd = basin.routing_data
+    part = topological_range_partition(rd.adjacency_rows, rd.adjacency_cols, N, S)
+    rd = permute_routing_data(rd, part)
+    network, channels, _ = prepare_batch(rd, 0.001)
+    params = {
+        k: jnp.asarray(np.asarray(v)[part.perm], jnp.float32)
+        for k, v in basin.true_params.items()
+    }
+    q_prime = jnp.asarray(basin.q_prime[:, part.perm])
+    return rd, network, channels, params, q_prime
+
+
+class TestScheduleBuilder:
+    def test_rejects_indivisible_n(self):
+        with pytest.raises(ValueError, match="divisible"):
+            build_pipeline_schedule(np.array([1]), np.array([0]), 10, 4)
+
+    def test_rejects_backward_edges(self):
+        # Edge from shard 1 (node 3) down to shard 0 (node 0): not partitioned order.
+        with pytest.raises(ValueError, match="lower shards"):
+            build_pipeline_schedule(np.array([0]), np.array([3]), 4, 2)
+
+    def test_boundary_accounting(self, partitioned):
+        rd, *_ = partitioned
+        sched = build_pipeline_schedule(rd.adjacency_rows, rd.adjacency_cols, N, S)
+        n_local = N // S
+        cross = (
+            np.asarray(rd.adjacency_cols) // n_local
+            != np.asarray(rd.adjacency_rows) // n_local
+        ).sum()
+        assert sched.n_boundary == max(1, cross)
+        assert int((np.asarray(sched.delay) >= 1).sum()) == sched.n_boundary
+
+
+class TestPipelinedRoute:
+    def test_matches_single_device_route(self, partitioned):
+        rd, network, channels, params, q_prime = partitioned
+        want = route(network, channels, params, q_prime, gauges=None)
+
+        mesh = make_mesh(S)
+        sched = build_pipeline_schedule(rd.adjacency_rows, rd.adjacency_cols, N, S)
+        runoff, q_fin = pipelined_route(mesh, sched, channels, params, q_prime)
+
+        np.testing.assert_allclose(
+            np.asarray(runoff), np.asarray(want.runoff), rtol=2e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(q_fin), np.asarray(want.final_discharge), rtol=2e-5, atol=1e-5
+        )
+
+    def test_hotstart_with_dry_reaches(self, partitioned):
+        # Regression: q_prime[0] entries below the discharge floor (dry reaches)
+        # must reach the hotstart solve RAW — hotstart_discharge clamps only the
+        # result, and the pre-clamp error accumulates downstream.
+        rd, network, channels, params, q_prime = partitioned
+        q_prime = q_prime.at[0].set(0.0)
+        want = route(network, channels, params, q_prime, gauges=None)
+        mesh = make_mesh(S)
+        sched = build_pipeline_schedule(rd.adjacency_rows, rd.adjacency_cols, N, S)
+        runoff, _ = pipelined_route(mesh, sched, channels, params, q_prime)
+        np.testing.assert_allclose(
+            np.asarray(runoff), np.asarray(want.runoff), rtol=2e-5, atol=1e-5
+        )
+
+    def test_matches_with_carry_state(self, partitioned):
+        rd, network, channels, params, q_prime = partitioned
+        q_init = jnp.asarray(np.random.default_rng(1).uniform(0.5, 3.0, N), jnp.float32)
+        want = route(network, channels, params, q_prime, q_init=q_init, gauges=None)
+
+        mesh = make_mesh(S)
+        sched = build_pipeline_schedule(rd.adjacency_rows, rd.adjacency_cols, N, S)
+        runoff, q_fin = pipelined_route(mesh, sched, channels, params, q_prime, q_init=q_init)
+
+        np.testing.assert_allclose(
+            np.asarray(runoff), np.asarray(want.runoff), rtol=2e-5, atol=1e-5
+        )
+
+    @pytest.mark.parametrize(
+        ("name", "rows", "cols", "n"),
+        [
+            ("star", np.full(7, 7), np.arange(7), 8),  # delays 1..7 into one sink
+            ("skip", np.array([2, 4, 6, 3, 5, 7]), np.array([0, 2, 4, 1, 3, 5]), 8),
+        ],
+    )
+    def test_multi_hop_delays_with_carry_state(self, name, rows, cols, n):
+        # Regression: the boundary stream must carry RAW solve outputs — clamped
+        # values diverge whenever an upstream solve goes below the discharge floor
+        # (caught on exactly these topologies).
+        from ddr_tpu.routing.mc import ChannelState
+
+        rng = np.random.default_rng(0)
+        network = build_network_for(rows, cols, n)
+        channels = ChannelState(
+            length=jnp.asarray(rng.uniform(1000, 3000, n), jnp.float32),
+            slope=jnp.asarray(rng.uniform(0.001, 0.01, n), jnp.float32),
+            x_storage=jnp.full(n, 0.3, jnp.float32),
+        )
+        params = {
+            "n": jnp.full(n, 0.05),
+            "p_spatial": jnp.full(n, 21.0),
+            "q_spatial": jnp.full(n, 0.5),
+        }
+        q_prime = jnp.asarray(rng.uniform(0.1, 1.0, (4, n)), jnp.float32)
+        q_init = jnp.asarray(rng.uniform(0.5, 3.0, n), jnp.float32)
+        want = route(network, channels, params, q_prime, q_init=q_init, gauges=None)
+        sched = build_pipeline_schedule(rows, cols, n, n)
+        got, _ = pipelined_route(
+            make_mesh(n), sched, channels, params, q_prime, q_init=q_init
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want.runoff), rtol=2e-5, atol=1e-5
+        )
+
+    def test_single_shard_degenerates_to_route(self):
+        basin = make_basin(n_segments=32, n_gauges=2, n_days=3, seed=5)
+        rd = basin.routing_data
+        network, channels, _ = prepare_batch(rd, 0.001)
+        params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+        q_prime = jnp.asarray(basin.q_prime)
+        want = route(network, channels, params, q_prime, gauges=None)
+
+        mesh = make_mesh(1)
+        sched = build_pipeline_schedule(rd.adjacency_rows, rd.adjacency_cols, 32, 1)
+        runoff, _ = pipelined_route(mesh, sched, channels, params, q_prime)
+        np.testing.assert_allclose(
+            np.asarray(runoff), np.asarray(want.runoff), rtol=2e-5, atol=1e-5
+        )
