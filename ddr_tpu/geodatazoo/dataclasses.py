@@ -116,7 +116,9 @@ class Dates(BaseModel):
         if self.rho is None:
             return
         rng = rng or np.random.default_rng()
-        start = int(rng.integers(0, len(self.daily_time_range) - self.rho))
+        # Inclusive-of-last-window bound: start = len - rho must be drawable so the
+        # period's final days are sampleable (and rho == len means one full window).
+        start = int(rng.integers(0, len(self.daily_time_range) - self.rho + 1))
         self.set_batch_time(self.daily_time_range[start : start + self.rho])
 
     def set_date_range(self, chunk: np.ndarray) -> None:

@@ -1,0 +1,108 @@
+"""Dates time-window machinery tests (reference dataclasses.py:69-187 behavior)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.dataclasses import Dates
+
+
+@pytest.fixture
+def dates():
+    return Dates(start_time="1981/10/01", end_time="1981/10/31", rho=5)
+
+
+class TestRanges:
+    def test_daily_range_inclusive(self, dates):
+        assert len(dates.daily_time_range) == 31
+        assert str(dates.daily_time_range[0].date()) == "1981-10-01"
+        assert str(dates.daily_time_range[-1].date()) == "1981-10-31"
+
+    def test_hourly_range_left_inclusive(self, dates):
+        # D days span (D-1)*24 hours with the left-inclusive convention.
+        assert len(dates.hourly_time_range) == 30 * 24
+
+    def test_numerical_time_range_origin_1980(self):
+        d = Dates(start_time="1980/01/01", end_time="1980/01/03")
+        np.testing.assert_array_equal(d.numerical_time_range, [0, 1, 2])
+
+    def test_initial_batch_is_full_period(self, dates):
+        assert len(dates.batch_daily_time_range) == 31
+        np.testing.assert_array_equal(dates.daily_indices, np.arange(31))
+
+    def test_rho_larger_than_period_rejected(self):
+        with pytest.raises(ValueError, match="rho"):
+            Dates(start_time="1981/10/01", end_time="1981/10/05", rho=10)
+
+    def test_rho_equal_to_period_yields_full_window(self):
+        d = Dates(start_time="1981/10/01", end_time="1981/10/05", rho=5)
+        d.calculate_time_period(np.random.default_rng(0))
+        assert len(d.batch_daily_time_range) == 5
+
+
+class TestTrainingWindows:
+    def test_random_window_has_rho_days(self, dates):
+        dates.calculate_time_period(np.random.default_rng(0))
+        assert len(dates.batch_daily_time_range) == 5
+        assert len(dates.batch_hourly_time_range) == 4 * 24
+
+    def test_window_stays_inside_period(self, dates):
+        for seed in range(10):
+            dates.calculate_time_period(np.random.default_rng(seed))
+            assert dates.batch_daily_time_range[0] >= dates.daily_time_range[0]
+            assert dates.batch_daily_time_range[-1] <= dates.daily_time_range[-1]
+
+    def test_indices_map_into_full_ranges(self, dates):
+        dates.calculate_time_period(np.random.default_rng(3))
+        i0 = dates.daily_indices[0]
+        assert dates.daily_time_range[i0] == dates.batch_daily_time_range[0]
+        h0 = dates.hourly_indices[0]
+        assert dates.hourly_time_range[h0] == dates.batch_hourly_time_range[0]
+        assert len(dates.hourly_indices) == len(dates.batch_hourly_time_range)
+
+    def test_every_day_sampleable(self, dates):
+        # The final window [len-rho, len-1] must be drawable, or the period's last
+        # days never appear in training.
+        seen_last = False
+        for seed in range(200):
+            dates.calculate_time_period(np.random.default_rng(seed))
+            if dates.batch_daily_time_range[-1] == dates.daily_time_range[-1]:
+                seen_last = True
+                break
+        assert seen_last
+
+    def test_no_rho_is_noop(self):
+        d = Dates(start_time="1981/10/01", end_time="1981/10/10")
+        d.calculate_time_period(np.random.default_rng(0))
+        assert len(d.batch_daily_time_range) == 10
+
+    def test_reproducible_with_seeded_rng(self, dates):
+        dates.calculate_time_period(np.random.default_rng(7))
+        first = dates.batch_daily_time_range.copy()
+        dates.calculate_time_period(np.random.default_rng(7))
+        assert (dates.batch_daily_time_range == first).all()
+
+
+class TestInferenceChunks:
+    def test_set_date_range_selects_chunk(self, dates):
+        dates.set_date_range(np.array([2, 3, 4]))
+        assert len(dates.batch_daily_time_range) == 3
+        np.testing.assert_array_equal(dates.daily_indices, [2, 3, 4])
+
+    def test_create_time_windows_partitions_period(self, dates):
+        windows = dates.create_time_windows()
+        assert windows.shape == (6, 5)  # 31 // 5 windows
+        np.testing.assert_array_equal(windows.ravel(), np.arange(30))
+
+    def test_create_time_windows_requires_rho(self):
+        d = Dates(start_time="1981/10/01", end_time="1981/10/10")
+        with pytest.raises(ValueError, match="rho"):
+            d.create_time_windows()
+
+    def test_numerical_range_follows_batch(self, dates):
+        dates.set_date_range(np.array([0, 1]))
+        origin_offset = dates.numerical_time_range[0]
+        d2 = Dates(start_time="1981/10/01", end_time="1981/10/02")
+        assert origin_offset == d2.numerical_time_range[0]
+        assert len(dates.numerical_time_range) == 2

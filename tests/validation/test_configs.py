@@ -1,0 +1,107 @@
+"""Config validation tests (reference tests/validation/test_configs.py, 14 tests)."""
+
+from __future__ import annotations
+
+import pytest
+import yaml
+
+from ddr_tpu.validation.configs import Config, load_config, validate_config
+from ddr_tpu.validation.enums import GeoDataset, Mode
+
+
+def _minimal(**extra):
+    raw = {
+        "name": "t",
+        "geodataset": "synthetic",
+        "mode": "training",
+        "kan": {"input_var_names": ["a"]},
+    }
+    raw.update(extra)
+    return raw
+
+
+class TestAcceptance:
+    def test_minimal_config_valid(self):
+        cfg = Config(**_minimal())
+        assert cfg.geodataset is GeoDataset.synthetic
+        assert cfg.mode is Mode.training
+        assert cfg.device == "tpu"
+
+    def test_defaults_populated(self):
+        cfg = Config(**_minimal())
+        assert cfg.params.parameter_ranges["n"] == [0.015, 0.25]
+        assert cfg.params.parameter_ranges["p_spatial"] == [1.0, 200.0]
+        assert "p_spatial" in cfg.params.log_space_parameters
+        assert cfg.params.defaults["p_spatial"] == 21
+        assert cfg.params.tau == 3
+        assert cfg.experiment.warmup == 3
+        assert cfg.experiment.max_area_diff_sqkm == 50
+
+    def test_learning_rate_keys_coerced_to_int(self):
+        cfg = Config(**_minimal(experiment={"learning_rate": {"1": 0.01, "5": 0.001}}))
+        assert cfg.experiment.learning_rate == {1: 0.01, 5: 0.001}
+
+    def test_mode_and_geodataset_enums(self):
+        for mode in ("training", "testing", "routing"):
+            assert Config(**_minimal(mode=mode)).mode.value == mode
+
+
+class TestRejection:
+    def test_unknown_top_level_key(self):
+        with pytest.raises(ValueError):
+            Config(**_minimal(not_a_field=1))
+
+    def test_unknown_nested_key(self):
+        with pytest.raises(ValueError):
+            Config(**_minimal(experiment={"bogus": 2}))
+
+    def test_bad_mode(self):
+        with pytest.raises(ValueError):
+            Config(**_minimal(mode="predicting"))
+
+    def test_bad_geodataset(self):
+        with pytest.raises(ValueError):
+            Config(**_minimal(geodataset="camels"))
+
+    def test_missing_kan(self):
+        raw = _minimal()
+        del raw["kan"]
+        with pytest.raises(ValueError):
+            Config(**raw)
+
+
+class TestLoadConfig:
+    def test_yaml_plus_overrides(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump(_minimal(params={"save_path": str(tmp_path)})))
+        cfg = load_config(p, ["experiment.epochs=7", "seed=42"], save_config=False)
+        assert cfg.experiment.epochs == 7
+        assert cfg.seed == 42
+
+    def test_override_requires_equals(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump(_minimal()))
+        with pytest.raises(ValueError, match="override"):
+            load_config(p, ["epochs"], save_config=False)
+
+    def test_saves_validated_yaml(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump(_minimal(params={"save_path": str(tmp_path)})))
+        load_config(p, save_config=True)
+        saved = yaml.safe_load((tmp_path / "pydantic_config.yaml").read_text())
+        assert saved["name"] == "t"
+
+    def test_seeding_is_deterministic(self, tmp_path):
+        import numpy as np
+
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump(_minimal(np_seed=7)))
+        load_config(p, save_config=False)
+        a = np.random.uniform()
+        load_config(p, save_config=False)
+        assert np.random.uniform() == a
+
+    def test_validate_config_passthrough(self):
+        cfg = Config(**_minimal())
+        assert validate_config(cfg) is cfg
+        assert validate_config(_minimal()).name == "t"

@@ -1,0 +1,155 @@
+"""Metrics battery math tests against hand-computed values
+(reference tests/validation/test_metrics.py strategy, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.validation.metrics import Metrics
+
+
+@pytest.fixture
+def simple():
+    pred = np.array([[1.0, 2.0, 3.0, 4.0]])
+    target = np.array([[1.0, 2.0, 3.0, 5.0]])
+    return Metrics(pred=pred, target=target)
+
+
+class TestBasicStatistics:
+    def test_perfect_prediction(self):
+        x = np.array([[1.0, 5.0, 2.0, 8.0]])
+        m = Metrics(pred=x, target=x.copy())
+        assert m.nse[0] == pytest.approx(1.0)
+        assert m.kge[0] == pytest.approx(1.0)
+        assert m.rmse[0] == 0.0
+        assert m.bias[0] == 0.0
+        assert m.mae[0] == 0.0
+        assert m.corr[0] == pytest.approx(1.0)
+
+    def test_bias_rmse_mae(self, simple):
+        assert simple.bias[0] == pytest.approx(-0.25)
+        assert simple.mae[0] == pytest.approx(0.25)
+        assert simple.rmse[0] == pytest.approx(0.5)  # sqrt(1/4)
+
+    def test_nse_hand_computed(self, simple):
+        # target mean 2.75; sst = 8.75; ssres = 1 -> NSE = 1 - 1/8.75
+        assert simple.nse[0] == pytest.approx(1 - 1 / 8.75)
+        assert simple.r2[0] == simple.nse[0]
+
+    def test_mean_prediction_gives_zero_nse(self):
+        target = np.array([[1.0, 2.0, 3.0, 4.0]])
+        pred = np.full((1, 4), target.mean())
+        m = Metrics(pred=pred, target=target)
+        assert m.nse[0] == pytest.approx(0.0)
+
+    def test_pbias(self):
+        m = Metrics(pred=np.array([[2.0, 2.0]]), target=np.array([[1.0, 1.0]]))
+        assert m.pbias[0] == pytest.approx(100.0)
+
+    def test_ub_rmse_removes_constant_bias(self):
+        target = np.array([[1.0, 2.0, 3.0, 4.0]])
+        m = Metrics(pred=target + 5.0, target=target)
+        assert m.rmse[0] == pytest.approx(5.0)
+        assert m.ub_rmse[0] == pytest.approx(0.0)
+
+    def test_correlations(self):
+        target = np.array([[1.0, 2.0, 3.0, 4.0]])
+        m = Metrics(pred=2 * target + 1, target=target)  # affine: r = 1
+        assert m.corr[0] == pytest.approx(1.0)
+        assert m.corr_spearman[0] == pytest.approx(1.0)
+        m2 = Metrics(pred=-target + 10, target=target)
+        assert m2.corr[0] == pytest.approx(-1.0)
+
+
+class TestKge:
+    def test_kge_formula(self):
+        rng = np.random.default_rng(0)
+        target = rng.uniform(1, 10, (1, 50))
+        pred = target * 1.2 + rng.normal(0, 0.5, (1, 50))
+        m = Metrics(pred=pred, target=target)
+        r = np.corrcoef(pred[0], target[0])[0, 1]
+        alpha = pred.std() / target.std()
+        beta = pred.mean() / target.mean()
+        want = 1 - np.sqrt((r - 1) ** 2 + (alpha - 1) ** 2 + (beta - 1) ** 2)
+        assert m.kge[0] == pytest.approx(want, rel=1e-6)
+
+    def test_kge_nan_for_constant_target(self):
+        m = Metrics(pred=np.array([[1.0, 2.0, 3.0]]), target=np.ones((1, 3)))
+        assert np.isnan(m.kge[0])
+
+
+class TestFlowSplits:
+    def test_fhv_overestimated_peaks(self):
+        rng = np.random.default_rng(1)
+        target = np.sort(rng.uniform(1, 10, (1, 200)))
+        pred = target.copy()
+        pred[0, -4:] *= 2.0  # inflate the top 2% flows
+        m = Metrics(pred=pred, target=target)
+        assert m.fhv[0] > 0
+        assert m.flv[0] == pytest.approx(0.0)
+
+    def test_flv_underestimated_lows(self):
+        rng = np.random.default_rng(2)
+        target = np.sort(rng.uniform(1, 10, (1, 100)))
+        pred = target.copy()
+        pred[0, :30] *= 0.5  # halve the bottom 30%
+        m = Metrics(pred=pred, target=target)
+        assert m.flv[0] < 0
+
+    def test_rmse_splits_cover_sorted_ranges(self):
+        rng = np.random.default_rng(3)
+        target = rng.uniform(1, 10, (1, 100))
+        m = Metrics(pred=target + 1.0, target=target)
+        for name in ("rmse_low", "rmse_mid", "rmse_high"):
+            assert np.isfinite(getattr(m, name)[0])
+
+
+class TestNanHandling:
+    def test_nan_pred_raises(self):
+        with pytest.raises(ValueError, match="NaN"):
+            Metrics(pred=np.array([[1.0, np.nan]]), target=np.ones((1, 2)))
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="shape"):
+            Metrics(pred=np.ones((1, 3)), target=np.ones((1, 4)))
+
+    def test_nan_target_masked(self):
+        m = Metrics(
+            pred=np.array([[1.0, 2.0, 3.0, 4.0]]),
+            target=np.array([[1.0, np.nan, 3.0, 4.0]]),
+        )
+        assert np.isfinite(m.nse[0])  # computed over the 3 valid points
+        assert m.bias[0] == pytest.approx(0.0)
+
+    def test_all_nan_target_gauge_stays_nan(self):
+        m = Metrics(
+            pred=np.ones((2, 3)),
+            target=np.vstack([np.ones(3), np.full(3, np.nan)]),
+        )
+        assert np.isnan(m.nse[1])
+        assert np.isnan(m.kge[1])
+
+
+class TestShapesAndSerialization:
+    def test_1d_inputs_promoted(self):
+        m = Metrics(pred=np.array([1.0, 2.0]), target=np.array([1.0, 2.0]))
+        assert m.ngrid == 1 and m.nt == 2
+
+    def test_per_gauge_vectors(self):
+        m = Metrics(pred=np.ones((5, 10)), target=np.ones((5, 10)))
+        for name in ("nse", "rmse", "kge", "bias", "corr", "fdc_rmse"):
+            assert getattr(m, name).shape == (5,)
+
+    def test_json_dump_round_trips(self, simple):
+        payload = json.loads(simple.model_dump_json())
+        assert "nse" in payload and "pred" not in payload
+        assert payload["rmse"][0] == pytest.approx(0.5)
+
+    def test_fdc_rmse_scale_mismatch(self):
+        rng = np.random.default_rng(4)
+        target = rng.uniform(1, 10, (1, 300))
+        m = Metrics(pred=target * 2.0, target=target)
+        assert m.fdc_rmse[0] > 0
