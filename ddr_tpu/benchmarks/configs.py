@@ -14,7 +14,7 @@ from typing import Any
 from pydantic import BaseModel, ConfigDict, Field, field_validator
 
 from ddr_tpu.benchmarks.irf import IRF_FAMILIES
-from ddr_tpu.validation.configs import Config, _set_seed
+from ddr_tpu.validation.configs import BENCHMARK_SECTION_KEYS, Config, _set_seed
 
 
 class LTIRouteConfig(BaseModel):
@@ -59,11 +59,13 @@ class BenchmarkConfig(BaseModel):
 
 def validate_benchmark_config(raw: dict[str, Any]) -> BenchmarkConfig:
     """Flat-dict layout parity with the reference: the ``lti`` (or legacy
-    ``diffroute``) and ``summed_q_prime`` keys are split out, everything else is the
-    core config."""
+    ``diffroute``) and ``summed_q_prime`` keys — :data:`BENCHMARK_SECTION_KEYS`, the
+    sections the core loader ignores — are split out, everything else is the core
+    config."""
     raw = dict(raw)
     lti = raw.pop("lti", raw.pop("diffroute", {}))
     summed_q_prime = raw.pop("summed_q_prime", None)
+    assert not set(raw) & set(BENCHMARK_SECTION_KEYS), "unsplit benchmark section"
     ddr = raw["ddr"] if set(raw) == {"ddr"} else raw
     cfg = BenchmarkConfig(
         ddr=Config(**ddr) if not isinstance(ddr, Config) else ddr,

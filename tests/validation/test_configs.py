@@ -101,6 +101,35 @@ class TestLoadConfig:
         load_config(p, save_config=False)
         assert np.random.uniform() == a
 
+    def test_benchmark_sections_ignored_by_core_loader(self, tmp_path):
+        # One YAML drives every command: `ddr train` must tolerate the benchmark
+        # harness's sections (which validate_benchmark_config consumes itself).
+        p = tmp_path / "c.yaml"
+        p.write_text(
+            yaml.safe_dump(
+                _minimal(lti={"irf_fn": "hayami"}, summed_q_prime="/tmp/sqp.zarr")
+            )
+        )
+        cfg = load_config(p, save_config=False)
+        assert cfg.name == "t"
+
+    def test_nested_ddr_layout_accepted(self, tmp_path):
+        # The benchmark harness's nested layout must also drive core commands.
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump({"ddr": _minimal(), "lti": {"irf_fn": "pure_lag"}}))
+        cfg = load_config(p, ["experiment.epochs=9"], save_config=False)
+        assert cfg.name == "t"
+        assert cfg.experiment.epochs == 9
+
+    def test_override_of_benchmark_section_fails_loudly(self, tmp_path):
+        # Explicit CLI input must never be silently dropped: overriding a benchmark
+        # section through the core loader is an error (the section was popped before
+        # overrides apply, so extra="forbid" rejects it).
+        p = tmp_path / "c.yaml"
+        p.write_text(yaml.safe_dump(_minimal(lti={"irf_fn": "hayami"})))
+        with pytest.raises(ValueError):
+            load_config(p, ["lti.irf_fn=pure_lag"], save_config=False)
+
     def test_validate_config_passthrough(self):
         cfg = Config(**_minimal())
         assert validate_config(cfg) is cfg
