@@ -25,7 +25,6 @@ uses the differentiable GSPMD path. Inputs must already be in partitioned order
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any
 
 import jax

@@ -9,7 +9,6 @@ import logging
 import os
 from pathlib import Path
 
-import jax.numpy as jnp
 import numpy as np
 
 from ddr_tpu.io import zarrlite

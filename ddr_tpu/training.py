@@ -15,13 +15,11 @@ off-by-one day windowing).
 from __future__ import annotations
 
 import pickle
-from functools import partial
 from pathlib import Path
 from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from ddr_tpu.routing.mc import Bounds, ChannelState, GaugeIndex, route
