@@ -1,31 +1,59 @@
 """Benchmark: reach-timesteps/sec/chip for the Muskingum-Cunge routing forward pass.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} and ALWAYS exits 0 —
+on any failure the line still appears with an "error" field so the driver records a
+parseable payload instead of a traceback (round-1 failure mode: BENCH_r01.json rc=1,
+"Unable to initialize backend 'axon'").
 
 The reference publishes no throughput numbers (BASELINE.md), so ``vs_baseline`` is
 measured against an in-process re-creation of the reference's CPU execution path
-(torch + scipy spsolve_triangular per timestep, the same algorithm as
-/root/reference/src/ddr/routing/mmc.py:415-441 + utils.py:535-627) on the same
-synthetic network, extrapolated per reach-timestep. Run on the TPU chip when present.
+(torch elementwise physics + scipy spsolve_triangular per timestep, the same algorithm
+as /root/reference/src/ddr/routing/mmc.py:415-441 + utils.py:535-627, including the
+PatternMapper values-only CSR update of utils.py:89-102) on the same synthetic
+network generator, normalized per reach-timestep.
+
+Shape bounds: default N=8192 / T=240 keeps a single-variant compile inside the known
+TPU-tunnel budget; override with DDR_BENCH_N / DDR_BENCH_T. If no accelerator backend
+initializes, the bench falls back to CPU at reduced shapes and says so in the payload.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+DEFAULT_N = 8192
+DEFAULT_T = 240
+CPU_FALLBACK_N = 2048
+CPU_FALLBACK_T = 48
+
+
+def _init_backend() -> str:
+    """Initialize a jax backend defensively; returns the platform name.
+
+    Never lets a failed accelerator-plugin init propagate: retries on CPU so the
+    bench always produces a number on whatever is available.
+    """
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
 
 
 def _synthetic(n: int, t_hours: int, seed: int = 0):
     from ddr_tpu.geodatazoo.synthetic import make_basin
 
-    basin = make_basin(n_segments=n, n_gauges=8, n_days=max(2, t_hours // 24), seed=seed)
-    return basin
+    return make_basin(n_segments=n, n_gauges=8, n_days=max(2, -(-t_hours // 24)), seed=seed)
 
 
-def bench_tpu(n: int = 8192, t_hours: int = 720) -> float:
-    """Returns reach-timesteps/sec for the jitted forward route."""
+def bench_route(n: int, t_hours: int) -> float:
+    """Reach-timesteps/sec for the jitted forward route on the active backend."""
     import jax
     import jax.numpy as jnp
 
@@ -53,7 +81,10 @@ def bench_tpu(n: int = 8192, t_hours: int = 720) -> float:
 
 def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
     """Reference-equivalent CPU path: torch elementwise physics + scipy triangular
-    solve per timestep (float64, like /root/reference/src/ddr/routing/utils.py:590-596)."""
+    solve per timestep (float64, /root/reference/src/ddr/routing/utils.py:590-596),
+    with the CSR sparsity pattern built ONCE and only its values refreshed per step —
+    the honest analog of the reference's PatternMapper
+    (/root/reference/src/ddr/routing/utils.py:25-129)."""
     import scipy.sparse as sp
     import torch
     from scipy.sparse.linalg import spsolve_triangular
@@ -64,15 +95,24 @@ def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
     N_mat = sp.coo_matrix((np.ones(len(rows)), (rows, cols)), shape=(n, n)).tocsr()
     eye = sp.eye(n, format="csr")
 
+    # Pattern probe (once): A = I - diag(c1) @ N has diagonal ones plus -c1[row] at
+    # each edge; per step only the data vector is rewritten in CSR order.
+    A = (eye - N_mat).tocsr()
+    A.sort_indices()
+    nz_rows, nz_cols = A.nonzero()
+    is_diag = nz_rows == nz_cols
+
     length = torch.tensor(rd.length)
     slope = torch.tensor(np.maximum(rd.slope, 1e-3))
     x = torch.tensor(rd.x)
     n_mann = torch.tensor(basin.true_params["n"])
     q_sp = torch.tensor(basin.true_params["q_spatial"])
     p_sp = torch.tensor(basin.true_params["p_spatial"])
-    q_prime = torch.tensor(basin.q_prime[:t_hours].astype(np.float64))
+    q_prime = torch.clamp(
+        torch.tensor(basin.q_prime[:t_hours].astype(np.float64)), min=1e-4
+    )
 
-    def step(q_t):
+    def step(q_t, q_prime_t):
         qe = q_sp + 1e-6
         depth = torch.clamp(
             ((q_t * n_mann * (qe + 1)) / (p_sp * slope**0.5 + 1e-8)) ** (3.0 / (5.0 + 3.0 * qe)),
@@ -92,33 +132,70 @@ def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
         c3 = (2 * k * (1 - x) - 3600.0) / denom
         c4 = 2 * 3600.0 / denom
         i_t = torch.tensor(N_mat @ q_t.numpy())
-        b = c2 * i_t + c3 * q_t + c4 * torch.clamp(q_prime[0], min=1e-4)
-        A = eye - sp.diags(c1.numpy()) @ N_mat
-        sol = spsolve_triangular(A.tocsr(), b.numpy(), lower=True)
+        b = c2 * i_t + c3 * q_t + c4 * q_prime_t
+        c1_np = c1.numpy()
+        A.data = np.where(is_diag, 1.0, -c1_np[nz_rows])
+        sol = spsolve_triangular(A, b.numpy(), lower=True)
         return torch.clamp(torch.tensor(sol), min=1e-4)
 
-    q_t = torch.clamp(torch.tensor(np.linalg.norm(basin.q_prime[0]) * np.ones(n)), min=1e-4)
-    step(q_t)  # warm
+    # Physical cold start: hotstart accumulation (I - N) q0 = q'_0, the reference's
+    # compute_hotstart_discharge (/root/reference/src/ddr/routing/mmc.py:25-66).
+    # A still holds the I - N values here (first rewritten in the warm step below).
+    q0 = spsolve_triangular(A, q_prime[0].numpy(), lower=True)
+    q_t = torch.clamp(torch.tensor(q0), min=1e-4)
+    step(q_t, q_prime[0])  # warm
     t0 = time.perf_counter()
-    for _ in range(t_hours):
-        q_t = step(q_t)
+    for t in range(t_hours):
+        q_t = step(q_t, q_prime[t])
     dt = time.perf_counter() - t0
     return n * t_hours / dt
 
 
 def main() -> None:
-    tpu_rts = bench_tpu()
-    ref_rts = bench_reference_cpu()
-    print(
-        json.dumps(
-            {
-                "metric": "reach-timesteps/sec/chip (synthetic 8192-reach network, 720h forward route)",
-                "value": round(tpu_rts, 1),
-                "unit": "reach-timesteps/s",
-                "vs_baseline": round(tpu_rts / ref_rts, 2),
-            }
-        )
+    out: dict = {
+        "metric": "reach-timesteps/sec/chip (synthetic network, forward route)",
+        "value": None,
+        "unit": "reach-timesteps/s",
+        "vs_baseline": None,
+    }
+    try:
+        platform = _init_backend()
+        out["device"] = platform
+    except Exception as e:  # noqa: BLE001 — payload must still print
+        out["error"] = f"backend init failed: {type(e).__name__}: {e}"
+        print(json.dumps(out), flush=True)
+        return
+
+    if platform == "cpu":
+        n, t_hours = CPU_FALLBACK_N, CPU_FALLBACK_T
+    else:
+        n, t_hours = DEFAULT_N, DEFAULT_T
+    try:
+        n = int(os.environ.get("DDR_BENCH_N", n))
+        t_hours = int(os.environ.get("DDR_BENCH_T", t_hours))
+    except ValueError as e:
+        out["error"] = f"bad DDR_BENCH_N/DDR_BENCH_T override: {e}"
+        print(json.dumps(out), flush=True)
+        return
+    out["metric"] = (
+        f"reach-timesteps/sec/chip (synthetic {n}-reach network, {t_hours}h forward route)"
     )
+
+    try:
+        rts = bench_route(n, t_hours)
+        out["value"] = round(rts, 1)
+    except Exception as e:  # noqa: BLE001
+        out["error"] = f"route bench failed: {type(e).__name__}: {e}"
+
+    try:
+        ref_rts = bench_reference_cpu()
+        out["baseline_value"] = round(ref_rts, 1)
+        if out["value"] is not None:
+            out["vs_baseline"] = round(out["value"] / ref_rts, 2)
+    except Exception as e:  # noqa: BLE001
+        out.setdefault("error", f"cpu baseline failed: {type(e).__name__}: {e}")
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
