@@ -1,49 +1,37 @@
 """Benchmark: reach-timesteps/sec/chip for the Muskingum-Cunge routing forward pass.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} and ALWAYS exits 0 —
-on any failure the line still appears with an "error" field so the driver records a
-parseable payload instead of a traceback (round-1 failure mode: BENCH_r01.json rc=1,
-"Unable to initialize backend 'axon'").
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} and ALWAYS exits 0.
+
+Architecture: the parent process never imports jax. Each phase (accelerator probe,
+route benchmark, CPU reference baseline) runs in a subprocess with a timeout, so a
+wedged TPU tunnel — which *hangs* backend init rather than raising (round-1 failure:
+BENCH_r01.json rc=1 "Unable to initialize backend 'axon'") — can never prevent the
+JSON payload from being emitted. If the accelerator probe fails or times out, the
+route benchmark reruns on CPU (tunnel registration disabled) at reduced shapes.
 
 The reference publishes no throughput numbers (BASELINE.md), so ``vs_baseline`` is
 measured against an in-process re-creation of the reference's CPU execution path
-(torch elementwise physics + scipy spsolve_triangular per timestep, the same algorithm
-as /root/reference/src/ddr/routing/mmc.py:415-441 + utils.py:535-627, including the
-PatternMapper values-only CSR update of utils.py:89-102) on the same synthetic
-network generator, normalized per reach-timestep.
+(torch elementwise physics + scipy spsolve_triangular per timestep, the same
+algorithm as /root/reference/src/ddr/routing/mmc.py:415-441 + utils.py:535-627,
+including the PatternMapper values-only CSR update of utils.py:89-102) on the same
+synthetic network generator, normalized per reach-timestep.
 
-Shape bounds: default N=8192 / T=240 keeps a single-variant compile inside the known
-TPU-tunnel budget; override with DDR_BENCH_N / DDR_BENCH_T. If no accelerator backend
-initializes, the bench falls back to CPU at reduced shapes and says so in the payload.
+Env knobs: DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_PROBE_TIMEOUT /
+DDR_BENCH_TIMEOUT (seconds, accelerator probe / each benchmark subprocess).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 DEFAULT_N = 8192
 DEFAULT_T = 240
 CPU_FALLBACK_N = 2048
 CPU_FALLBACK_T = 48
-
-
-def _init_backend() -> str:
-    """Initialize a jax backend defensively; returns the platform name.
-
-    Never lets a failed accelerator-plugin init propagate: retries on CPU so the
-    bench always produces a number on whatever is available.
-    """
-    import jax
-
-    try:
-        return jax.devices()[0].platform
-    except Exception:
-        jax.config.update("jax_platforms", "cpu")
-        return jax.devices()[0].platform
 
 
 def _synthetic(n: int, t_hours: int, seed: int = 0):
@@ -85,6 +73,7 @@ def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
     with the CSR sparsity pattern built ONCE and only its values refreshed per step —
     the honest analog of the reference's PatternMapper
     (/root/reference/src/ddr/routing/utils.py:25-129)."""
+    import numpy as np
     import scipy.sparse as sp
     import torch
     from scipy.sparse.linalg import spsolve_triangular
@@ -151,6 +140,36 @@ def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
     return n * t_hours / dt
 
 
+# ---------------------------------------------------------------------------
+# Subprocess harness (parent never imports jax; a hung tunnel cannot block it).
+# ---------------------------------------------------------------------------
+
+_CPU_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+
+
+def _run_child(code: str, timeout: float, cpu_only: bool) -> tuple[str | None, str]:
+    """Run a python snippet in a subprocess; returns (last stdout line, error)."""
+    env = dict(os.environ)
+    if cpu_only:
+        env.update(_CPU_ENV)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout:.0f}s"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else "no stderr"
+        return None, f"rc={proc.returncode}: {tail}"
+    return (lines[-1] if lines else None), ""
+
+
 def main() -> None:
     out: dict = {
         "metric": "reach-timesteps/sec/chip (synthetic network, forward route)",
@@ -159,17 +178,28 @@ def main() -> None:
         "vs_baseline": None,
     }
     try:
-        platform = _init_backend()
-        out["device"] = platform
-    except Exception as e:  # noqa: BLE001 — payload must still print
-        out["error"] = f"backend init failed: {type(e).__name__}: {e}"
+        probe_timeout = float(os.environ.get("DDR_BENCH_PROBE_TIMEOUT", 900))
+        bench_timeout = float(os.environ.get("DDR_BENCH_TIMEOUT", 2400))
+    except ValueError as e:
+        out["error"] = f"bad DDR_BENCH_PROBE_TIMEOUT/DDR_BENCH_TIMEOUT override: {e}"
         print(json.dumps(out), flush=True)
         return
 
-    if platform == "cpu":
+    # Phase 1: can an accelerator backend initialize at all?
+    platform, probe_err = _run_child(
+        "import jax; print(jax.devices()[0].platform)", probe_timeout, cpu_only=False
+    )
+    if platform is None or platform == "cpu":
+        out["device"] = "cpu"
+        if probe_err:
+            out["probe_error"] = f"accelerator probe failed ({probe_err}); CPU fallback"
         n, t_hours = CPU_FALLBACK_N, CPU_FALLBACK_T
+        cpu_only = True
     else:
+        out["device"] = platform
         n, t_hours = DEFAULT_N, DEFAULT_T
+        cpu_only = False
+
     try:
         n = int(os.environ.get("DDR_BENCH_N", n))
         t_hours = int(os.environ.get("DDR_BENCH_T", t_hours))
@@ -181,19 +211,46 @@ def main() -> None:
         f"reach-timesteps/sec/chip (synthetic {n}-reach network, {t_hours}h forward route)"
     )
 
-    try:
-        rts = bench_route(n, t_hours)
-        out["value"] = round(rts, 1)
-    except Exception as e:  # noqa: BLE001
-        out["error"] = f"route bench failed: {type(e).__name__}: {e}"
+    # Phase 2: the route benchmark (on the accelerator if the probe passed).
+    val, err = _run_child(
+        f"import bench; print(bench.bench_route({n}, {t_hours}))", bench_timeout, cpu_only
+    )
+    if val is None and not cpu_only:
+        # Accelerator died mid-benchmark: salvage a CPU number, respecting any
+        # explicit shape overrides (they may exist to bound wall-clock).
+        out["route_error"] = f"accelerator route bench failed ({err}); retrying on CPU"
+        out["device"] = "cpu"
+        n = int(os.environ.get("DDR_BENCH_N", CPU_FALLBACK_N))
+        t_hours = int(os.environ.get("DDR_BENCH_T", CPU_FALLBACK_T))
+        out["metric"] = (
+            f"reach-timesteps/sec/chip (synthetic {n}-reach network, {t_hours}h forward route)"
+        )
+        val, err = _run_child(
+            f"import bench; print(bench.bench_route({n}, {t_hours}))", bench_timeout, True
+        )
+        if val is None:
+            out["route_error"] += f"; CPU retry failed ({err})"
+    if val is not None:
+        try:
+            out["value"] = round(float(val), 1)
+        except ValueError:
+            out["route_error"] = f"unparseable route output: {val!r}"
+    else:
+        out.setdefault("route_error", err)
 
-    try:
-        ref_rts = bench_reference_cpu()
-        out["baseline_value"] = round(ref_rts, 1)
-        if out["value"] is not None:
-            out["vs_baseline"] = round(out["value"] / ref_rts, 2)
-    except Exception as e:  # noqa: BLE001
-        out.setdefault("error", f"cpu baseline failed: {type(e).__name__}: {e}")
+    # Phase 3: the reference-equivalent CPU baseline.
+    ref, err = _run_child(
+        "import bench; print(bench.bench_reference_cpu())", bench_timeout, cpu_only=True
+    )
+    if ref is not None:
+        try:
+            out["baseline_value"] = round(float(ref), 1)
+            if out["value"] is not None:
+                out["vs_baseline"] = round(out["value"] / float(ref), 2)
+        except ValueError:
+            out["baseline_error"] = f"unparseable baseline output: {ref!r}"
+    else:
+        out["baseline_error"] = err
 
     print(json.dumps(out), flush=True)
 

@@ -1,0 +1,110 @@
+"""RAPID Sandbox fixtures: the externally-published golden oracle.
+
+Adapts the published 5-reach RAPID Sandbox network (tests/input/Sandbox/README.md;
+David 2025, CC-BY-4.0) into MERIT format and builds it through the repo's real
+engine -> zarrlite -> loader pipeline, the same adaptation the reference performs in
+/root/reference/tests/benchmarks/conftest.py:44-98. The .nc4 files are NetCDF4/HDF5,
+read via h5py (no netCDF4 package in this environment).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import h5py
+import numpy as np
+import pandas as pd
+import pytest
+
+TESTS_DIR = Path(__file__).parent.parent
+SANDBOX_IN = TESTS_DIR / "input" / "Sandbox"
+SANDBOX_OUT = TESTS_DIR / "output" / "Sandbox"
+
+# RAPID2 reach ordering and Muskingum parameters (k_Sandbox.csv, x_Sandbox.csv,
+# namelist_Sandbox.yml IS_dtR).
+RAPID2_REACH_IDS = [10, 20, 30, 40, 50]
+SANDBOX_K = 9000.0  # seconds
+SANDBOX_X = 0.25
+SANDBOX_DT = 900.0  # RAPID2 routing substep
+QEXT_WINDOW = 10800.0  # Qext is 3-hourly
+
+
+def read_nc_var(path: Path, name: str) -> np.ndarray:
+    with h5py.File(path, "r") as f:
+        return np.asarray(f[name][:])
+
+
+@pytest.fixture(scope="session")
+def sandbox_connectivity() -> pd.DataFrame:
+    """rapid_connect CSV: columns [COMID, NextDownID] (0 = outlet)."""
+    df = pd.read_csv(SANDBOX_IN / "rapid_connect_Sandbox.csv", header=None)
+    df.columns = ["COMID", "NextDownID"]
+    return df
+
+
+@pytest.fixture(scope="session")
+def sandbox_merit_fp(sandbox_connectivity: pd.DataFrame) -> pd.DataFrame:
+    """Sandbox connectivity in MERIT flowpath format (COMID, NextDownID, up1-up4)."""
+    up: dict[int, list[int]] = {}
+    for comid, nxt in sandbox_connectivity.itertuples(index=False):
+        if int(nxt) != 0:
+            up.setdefault(int(nxt), []).append(int(comid))
+    records = []
+    for comid, nxt in sandbox_connectivity.itertuples(index=False):
+        ups = up.get(int(comid), [])
+        records.append(
+            {
+                "COMID": int(comid),
+                "NextDownID": int(nxt),
+                **{f"up{i + 1}": (ups[i] if i < len(ups) else 0) for i in range(4)},
+                # 5 km reaches at 0.1% slope: the same nominal channel the reference
+                # assigns the Sandbox (/root/reference/tests/benchmarks/conftest.py).
+                "lengthkm": 5.0,
+                "slope": 0.001,
+            }
+        )
+    return pd.DataFrame(records)
+
+
+@pytest.fixture(scope="session")
+def sandbox_zarr_path(tmp_path_factory: pytest.TempPathFactory, sandbox_merit_fp) -> Path:
+    """Sandbox adjacency built through the real engine into a zarrlite store."""
+    from ddr_tpu.engine.merit import build_merit_adjacency
+
+    out = tmp_path_factory.mktemp("sandbox_zarr") / "sandbox_adjacency.zarr"
+    return build_merit_adjacency(sandbox_merit_fp, out)
+
+
+@pytest.fixture(scope="session")
+def sandbox_qext() -> np.ndarray:
+    """(80, 5) 3-hourly lateral inflow, RAPID2 reach order."""
+    return read_nc_var(SANDBOX_IN / "Qext_Sandbox_19700101_19700110.nc4", "Qext")
+
+
+@pytest.fixture(scope="session")
+def sandbox_qinit() -> np.ndarray:
+    """(5,) initial discharge [9, 9, 27, 18, 63] m3/s."""
+    return read_nc_var(SANDBOX_IN / "Qinit_Sandbox_19700101_19700110.nc4", "Qout").squeeze()
+
+
+@pytest.fixture(scope="session")
+def sandbox_expected_qout() -> np.ndarray:
+    """(80, 5) RAPID2 published discharge (window means)."""
+    return read_nc_var(SANDBOX_OUT / "Qout_Sandbox_19700101_19700110.nc4", "Qout")
+
+
+@pytest.fixture(scope="session")
+def sandbox_expected_qfinal() -> np.ndarray:
+    """(5,) RAPID2 published final state."""
+    return read_nc_var(SANDBOX_OUT / "Qfinal_Sandbox_19700101_19700110.nc4", "Qout").squeeze()
+
+
+@pytest.fixture(scope="session")
+def sandbox_hourly_qprime(sandbox_qext: np.ndarray) -> np.ndarray:
+    """Qext linearly interpolated from 3-hourly (80 pts) to hourly (238 pts),
+    mirroring the reference's sandbox_hourly_qprime fixture."""
+    t3 = np.arange(sandbox_qext.shape[0]) * 3.0
+    t1 = np.arange(t3[-1] + 1)
+    return np.stack(
+        [np.interp(t1, t3, sandbox_qext[:, i]) for i in range(sandbox_qext.shape[1])], axis=1
+    ).astype(np.float32)
