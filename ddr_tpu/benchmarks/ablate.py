@@ -1,0 +1,62 @@
+"""Single-variant route() throughput ablation (one process per variant).
+
+Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect}``
+Prints one JSON line {n, t_hours, schedule, depth, rts, ms_per_step, device}.
+
+The TPU tunnel serializes processes and a mid-compile kill wedges the grant, so
+each (N, schedule) variant runs in its own process with exactly one compile; the
+ablation table in docs/tpu.md is assembled from these lines.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    n, t_hours = int(sys.argv[1]), int(sys.argv[2])
+    schedule = sys.argv[3] if len(sys.argv) > 3 else "fused"
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.geodatazoo.synthetic import make_basin
+    from ddr_tpu.routing.mc import route
+    from ddr_tpu.routing.model import prepare_batch
+
+    basin = make_basin(n_segments=n, n_gauges=8, n_days=max(2, -(-t_hours // 24)), seed=0)
+    rd = basin.routing_data
+    network, channels, gauges = prepare_batch(rd, 1e-4, fused=(schedule == "fused"))
+    params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+    q_prime = jnp.asarray(basin.q_prime[:t_hours])
+
+    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
+    t0 = time.perf_counter()
+    fn(q_prime).block_until_ready()
+    compile_s = time.perf_counter() - t0
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn(q_prime).block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(
+        json.dumps(
+            {
+                "n": n,
+                "t_hours": t_hours,
+                "schedule": schedule,
+                "depth": network.depth,
+                "rts": round(n * t_hours / dt, 1),
+                "ms_per_step": round(dt / t_hours * 1e3, 3),
+                "compile_s": round(compile_s, 1),
+                "device": jax.devices()[0].platform,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

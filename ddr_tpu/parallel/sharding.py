@@ -74,16 +74,33 @@ def shard_channels(mesh: Mesh, channels: ChannelState) -> ChannelState:
 
 def shard_network(mesh: Mesh, network: RiverNetwork) -> RiverNetwork:
     """Edge lists are replicated (they index the global reach space); the level
-    schedule rows stay replicated too — the scatter targets are what's sharded."""
+    schedule rows stay replicated too — the scatter targets are what's sharded.
+
+    The fused (level-contiguous permuted) schedule is DROPPED here: its per-call
+    permutation gathers use replicated indices over reach-sharded operands, which
+    GSPMD can only lower as full all-gathers — defeating the sharding. Distributed
+    execution always rides the rectangle scan schedule (or the explicit pipelined
+    router), whose collectives stay at cross-shard river edges.
+    """
+    import jax.numpy as jnp
+
     rep = replicated(mesh)
+    empty1 = jnp.zeros(0, jnp.int32)
+    empty2 = jnp.zeros((0, 1), jnp.int32)
     return RiverNetwork(
         edge_src=jax.device_put(network.edge_src, rep),
         edge_tgt=jax.device_put(network.edge_tgt, rep),
         lvl_src=jax.device_put(network.lvl_src, rep),
         lvl_tgt=jax.device_put(network.lvl_tgt, rep),
+        perm=empty1,
+        inv_perm=empty1,
+        pred=empty2,
+        down=empty2,
         n=network.n,
         depth=network.depth,
         n_edges=network.n_edges,
+        level_starts=(),
+        fused=False,
     )
 
 

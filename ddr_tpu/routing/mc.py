@@ -32,7 +32,7 @@ import numpy as np
 
 from ddr_tpu.geometry.trapezoidal import trapezoidal_geometry
 from ddr_tpu.routing.network import RiverNetwork
-from ddr_tpu.routing.solver import solve_lower_triangular
+from ddr_tpu.routing.solver import fused_solve, solve_lower_triangular
 
 __all__ = [
     "Bounds",
@@ -184,13 +184,21 @@ def celerity(
 
 
 def hotstart_discharge(
-    network: RiverNetwork, q_prime_t0: jnp.ndarray, discharge_lb: float
+    network: RiverNetwork,
+    q_prime_t0: jnp.ndarray,
+    discharge_lb: float,
+    permuted: bool = False,
 ) -> jnp.ndarray:
     """Cold-start initial discharge: solve (I - N) Q0 = q'_0, the topological
     accumulation of lateral inflows (/root/reference/src/ddr/routing/mmc.py:25-66).
-    Differentiable through the custom-VJP solver."""
+    Differentiable through the custom-VJP solver. ``permuted=True`` takes/returns
+    arrays already in the fused network's level-contiguous order."""
     ones = jnp.ones(network.n, dtype=q_prime_t0.dtype)
-    return jnp.maximum(solve_lower_triangular(network, ones, q_prime_t0), discharge_lb)
+    if permuted:
+        q0 = fused_solve(network.level_starts, ones, q_prime_t0, network.pred, network.down)
+    else:
+        q0 = solve_lower_triangular(network, ones, q_prime_t0)
+    return jnp.maximum(q0, discharge_lb)
 
 
 def route_step(
@@ -203,15 +211,23 @@ def route_step(
     q_prime_t: jnp.ndarray,
     bounds: Bounds,
     dt: float = DT_SECONDS,
+    permuted: bool = False,
 ) -> jnp.ndarray:
     """One Muskingum-Cunge step (reference ``route_timestep``,
     /root/reference/src/ddr/routing/mmc.py:487-559). ``q_prime_t`` must already be
-    clamped to the discharge lower bound."""
+    clamped to the discharge lower bound. With ``permuted=True`` every per-reach
+    array is in the fused network's level-contiguous order and the scatter-free
+    unrolled solve runs directly (no per-step permutes)."""
     c, _, _ = celerity(q_t, n_mann, p_spatial, q_spatial, channels, bounds)
     c1, c2, c3, c4 = muskingum_coefficients(channels.length, c, channels.x_storage, dt)
-    i_t = network.upstream_sum(q_t)
-    b = c2 * i_t + c3 * q_t + c4 * q_prime_t
-    q_t1 = solve_lower_triangular(network, c1, b)
+    if permuted:
+        i_t = network.upstream_sum_perm(q_t)
+        b = c2 * i_t + c3 * q_t + c4 * q_prime_t
+        q_t1 = fused_solve(network.level_starts, c1, b, network.pred, network.down)
+    else:
+        i_t = network.upstream_sum(q_t)
+        b = c2 * i_t + c3 * q_t + c4 * q_prime_t
+        q_t1 = solve_lower_triangular(network, c1, b)
     return jnp.maximum(q_t1, bounds.discharge)
 
 
@@ -244,13 +260,38 @@ def route(
     Matches the reference forward loop semantics
     (/root/reference/src/ddr/routing/mmc.py:365-443): output[0] is the clamped initial
     state; step t consumes ``q_prime[t-1]``.
+
+    On a fused network, every per-reach array is permuted into level-contiguous
+    order ONCE here; the whole scan then runs scatter-free in permuted space and
+    only the outputs are mapped back.
     """
     n_mann = spatial_params["n"]
     q_spatial = spatial_params["q_spatial"]
     p_spatial = spatial_params["p_spatial"]
 
+    permuted = network.fused
+    if permuted:
+        p = network.perm
+
+        def _p(a):
+            return a if (a is None or jnp.ndim(a) == 0) else a[p]
+
+        channels = ChannelState(
+            length=channels.length[p],
+            slope=channels.slope[p],
+            x_storage=channels.x_storage[p],
+            top_width_data=_p(channels.top_width_data),
+            side_slope_data=_p(channels.side_slope_data),
+        )
+        n_mann, q_spatial, p_spatial = _p(n_mann), _p(q_spatial), _p(p_spatial)
+        q_prime = q_prime[:, p]
+        if q_init is not None:
+            q_init = q_init[p]
+        if gauges is not None:
+            gauges = dataclasses.replace(gauges, flat_idx=network.inv_perm[gauges.flat_idx])
+
     if q_init is None:
-        q0 = hotstart_discharge(network, q_prime[0], bounds.discharge)
+        q0 = hotstart_discharge(network, q_prime[0], bounds.discharge, permuted=permuted)
     else:
         q0 = jnp.maximum(q_init, bounds.discharge)
 
@@ -260,10 +301,15 @@ def route(
     def body(q_t, q_prime_prev):
         q_prime_clamp = jnp.maximum(q_prime_prev, bounds.discharge)
         q_t1 = route_step(
-            network, channels, n_mann, p_spatial, q_spatial, q_t, q_prime_clamp, bounds, dt
+            network, channels, n_mann, p_spatial, q_spatial, q_t, q_prime_clamp, bounds, dt,
+            permuted=permuted,
         )
         return q_t1, emit(q_t1)
 
     q_final, outs = jax.lax.scan(body, q0, q_prime[:-1])
     runoff = jnp.concatenate([emit(q0)[None, :], outs], axis=0)
+    if permuted:
+        q_final = q_final[network.inv_perm]
+        if gauges is None:
+            runoff = runoff[:, network.inv_perm]
     return RouteResult(runoff=runoff, final_discharge=q_final)

@@ -29,15 +29,16 @@ __all__ = ["dmc", "prepare_batch", "denormalize_spatial_parameters"]
 
 
 def prepare_batch(
-    rd: RoutingData, slope_min: float
+    rd: RoutingData, slope_min: float, fused: bool | None = None
 ) -> tuple[RiverNetwork, ChannelState, GaugeIndex | None]:
     """RoutingData -> (static network, channel state, gauge aggregation).
 
     Mirrors ``MuskingumCunge._set_network_context``
     (/root/reference/src/ddr/routing/mmc.py:271-304): slope clamped to its minimum,
     observed top-width/side-slope carried for data override when present.
+    ``fused`` forwards to :func:`build_network` (None = auto-select schedule).
     """
-    network = build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
+    network = build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=fused)
 
     def _opt(a):
         if a is None or np.asarray(a).size == 0:

@@ -29,34 +29,67 @@ __all__ = ["RiverNetwork", "compute_levels", "level_schedule", "build_network"]
 class RiverNetwork:
     """Static river topology carried through jit.
 
+    Two solve schedules coexist:
+
+    *Rectangle schedule* (always present) — edges grouped by target level and padded
+    to ``(D, E_max)``; the solve is a ``lax.scan`` of gather + scatter-add steps.
+    Used by the pipelined multi-shard router and as the fallback for very deep or
+    high-degree networks.
+
+    *Fused schedule* (``fused=True``) — reaches permuted level-contiguously
+    (``perm``), predecessors padded to a fixed-width gather table ``pred`` (river
+    networks have in-degree <= 4, /root/reference/engine/src/ddr_engine/merit/graph.py:9-52),
+    downstreams to ``down`` (dendritic: out-degree 1). Each level update is then a
+    fixed-width *gather* plus a statically-sliced in-place update — no scatter at
+    all — and the level loop unrolls into the jit body (``level_starts`` is static),
+    eliminating the per-level scan-trip overhead that dominates on TPU.
+
     Attributes
     ----------
     edge_src, edge_tgt:
-        Flat edge list, ``(E,)`` int32. ``src`` drains into ``tgt``.
+        Flat edge list, ``(E,)`` int32, original (caller) order. ``src`` drains into
+        ``tgt``.
     lvl_src, lvl_tgt:
-        The same edges grouped by the longest-path level of their target and padded to
-        a rectangle ``(D, E_max)``. Padding slots hold ``n`` (out-of-bounds), which JAX
-        scatters silently drop (``mode="drop"``).
-    n, depth, n_edges:
-        Static metadata (not traced).
+        Rectangle schedule, original order. Padding slots hold ``n`` (out-of-bounds),
+        which JAX scatters silently drop (``mode="drop"``).
+    perm, inv_perm:
+        Level-contiguous permutation: ``x_perm = x[perm]``, ``x = x_perm[inv_perm]``.
+        Empty when ``fused`` is False.
+    pred:
+        ``(n, U)`` padded predecessor table in *permuted* space (sentinel ``n``).
+    down:
+        ``(n, D)`` padded downstream table in *permuted* space (sentinel ``n``).
+    n, depth, n_edges, level_starts, fused:
+        Static metadata (not traced). ``level_starts[L] .. level_starts[L+1]`` is
+        level L's contiguous permuted index range.
     """
 
     edge_src: jnp.ndarray
     edge_tgt: jnp.ndarray
     lvl_src: jnp.ndarray
     lvl_tgt: jnp.ndarray
+    perm: jnp.ndarray
+    inv_perm: jnp.ndarray
+    pred: jnp.ndarray
+    down: jnp.ndarray
     n: int = dataclasses.field(metadata={"static": True})
     depth: int = dataclasses.field(metadata={"static": True})
     n_edges: int = dataclasses.field(metadata={"static": True})
+    level_starts: tuple = dataclasses.field(default=(), metadata={"static": True})
+    fused: bool = dataclasses.field(default=False, metadata={"static": True})
 
     def upstream_sum(self, x: jnp.ndarray) -> jnp.ndarray:
-        """Sparse mat-vec ``N @ x``: sum of upstream values per reach.
+        """Sparse mat-vec ``N @ x``: sum of upstream values per reach (original order).
 
         Equivalent of the reference's per-timestep SpMV
         (``i_t = network @ discharge``, /root/reference/src/ddr/routing/mmc.py:535),
         expressed as a segment-sum over the edge list — the TPU-friendly form.
         """
         return jax.ops.segment_sum(x[self.edge_src], self.edge_tgt, num_segments=self.n)
+
+    def upstream_sum_perm(self, x_perm: jnp.ndarray) -> jnp.ndarray:
+        """``N @ x`` in permuted space: one fixed-width gather, no scatter."""
+        return x_perm.at[self.pred].get(mode="fill", fill_value=0).sum(axis=1)
 
 
 def _ranges(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
@@ -153,23 +186,86 @@ def level_schedule(
     return lvl_src, lvl_tgt, depth
 
 
-def build_network(rows: np.ndarray, cols: np.ndarray, n: int) -> RiverNetwork:
+# Fused-schedule applicability limits: river networks have in-degree <= 4 (MERIT
+# up1-up4) and out-degree 1 (dendritic); the unrolled level loop compiles one gather
+# + slice-update pair per level, so very deep networks fall back to the scan.
+FUSED_MAX_IN_DEGREE = 8
+FUSED_MAX_OUT_DEGREE = 4
+FUSED_MAX_DEPTH = 512
+
+
+def _padded_adjacency_table(
+    point: np.ndarray, neighbor: np.ndarray, n: int, width: int
+) -> np.ndarray:
+    """``(n, width)`` table: for each node, its neighbors padded with sentinel ``n``."""
+    table = np.full((n, max(width, 1)), n, dtype=np.int64)
+    order = np.argsort(point, kind="stable")
+    pt, nb = point[order], neighbor[order]
+    starts = np.searchsorted(pt, np.arange(n + 1))
+    counts = starts[1:] - starts[:-1]
+    col = np.arange(len(pt)) - starts[:-1].repeat(counts)
+    table[pt, col] = nb
+    return table
+
+
+def build_network(
+    rows: np.ndarray, cols: np.ndarray, n: int, fused: bool | None = None
+) -> RiverNetwork:
     """Build the jit-ready :class:`RiverNetwork` from a COO adjacency.
 
     ``rows`` are downstream (target) indices, ``cols`` upstream (source) — the
     binsparse ``indices_0/indices_1`` arrays of the reference's zarr stores
     (/root/reference/engine/src/ddr_engine/core/zarr_io.py:87-392).
+
+    ``fused=None`` auto-selects the fused (scatter-free, unrolled) solve schedule
+    whenever the network's degree/depth fit its limits; ``False`` forces the
+    rectangle scan schedule — what ``shard_network`` enforces for distributed
+    execution and the pipelined multi-shard router builds its per-shard variants
+    from.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     lvl_src, lvl_tgt, depth = level_schedule(rows, cols, n)
+
+    in_deg = np.bincount(rows, minlength=n) if rows.size else np.zeros(n, dtype=np.int64)
+    out_deg = np.bincount(cols, minlength=n) if cols.size else np.zeros(n, dtype=np.int64)
+    max_in = int(in_deg.max()) if n else 0
+    max_out = int(out_deg.max()) if n else 0
+    eligible = depth <= FUSED_MAX_DEPTH and max_in <= FUSED_MAX_IN_DEGREE and max_out <= FUSED_MAX_OUT_DEGREE
+    if fused is None:
+        fused = eligible
+    elif fused and not eligible:
+        raise ValueError(
+            f"network exceeds fused-schedule limits (depth={depth}, in={max_in}, out={max_out})"
+        )
+
+    if fused:
+        level = compute_levels(rows, cols, n)
+        perm = np.lexsort((np.arange(n), level))  # level-major, stable within level
+        inv = np.empty(n, dtype=np.int64)
+        inv[perm] = np.arange(n)
+        counts = np.bincount(level, minlength=depth + 1)
+        level_starts = tuple(np.concatenate([[0], np.cumsum(counts)]).tolist())
+        p_rows, p_cols = inv[rows], inv[cols]  # edges in permuted space
+        pred = _padded_adjacency_table(p_rows, p_cols, n, max_in)
+        down = _padded_adjacency_table(p_cols, p_rows, n, max_out)
+    else:
+        perm = inv = np.zeros(0, dtype=np.int64)
+        pred = down = np.zeros((0, 1), dtype=np.int64)
+        level_starts = ()
 
     return RiverNetwork(
         edge_src=jnp.asarray(cols, dtype=jnp.int32),
         edge_tgt=jnp.asarray(rows, dtype=jnp.int32),
         lvl_src=jnp.asarray(lvl_src, dtype=jnp.int32),
         lvl_tgt=jnp.asarray(lvl_tgt, dtype=jnp.int32),
+        perm=jnp.asarray(perm, dtype=jnp.int32),
+        inv_perm=jnp.asarray(inv, dtype=jnp.int32),
+        pred=jnp.asarray(pred, dtype=jnp.int32),
+        down=jnp.asarray(down, dtype=jnp.int32),
         n=int(n),
         depth=depth,
         n_edges=int(rows.size),
+        level_starts=level_starts,
+        fused=bool(fused),
     )

@@ -26,13 +26,15 @@ per-reach form ``grad_c1 = grad_b * (N @ x)``.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ddr_tpu.routing.network import RiverNetwork
 
-__all__ = ["solve_lower_triangular", "solve_transposed"]
+__all__ = ["solve_lower_triangular", "solve_transposed", "fused_solve"]
 
 
 def _sweep_down(c1, b, lvl_src, lvl_tgt):
@@ -93,6 +95,68 @@ def _solve_bwd(res, grad_x):
 _solve.defvjp(_solve_fwd, _solve_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Fused (scatter-free) schedule: level-contiguous permuted space.
+#
+# Each level L occupies the static slice [starts[L], starts[L+1]) of the permuted
+# reach axis; its update is one fixed-width predecessor *gather* plus a statically
+# sliced in-place set — no scatter, no scan trip. The level loop unrolls into the
+# jit body (depth is static and bounded by FUSED_MAX_DEPTH). All arrays here live
+# in permuted space; `route()` permutes once per call, `solve_lower_triangular`
+# per solve.
+# ---------------------------------------------------------------------------
+
+
+def _fused_sweep_down(starts, c1, b, pred):
+    """Forward substitution, permuted space: x_i = b_i + c1_i * sum_preds x_p."""
+    x = b
+    for lvl in range(1, len(starts) - 1):
+        s, e = starts[lvl], starts[lvl + 1]
+        contrib = x.at[pred[s:e]].get(mode="fill", fill_value=0).sum(axis=1)
+        x = x.at[s:e].set(b[s:e] + c1[s:e] * contrib, indices_are_sorted=True)
+    return x
+
+
+def _fused_sweep_up(starts, c1, g, down):
+    """Transposed solve, permuted space: y_j = g_j + sum_downs c1_d * y_d.
+
+    Downstream nodes sit at strictly higher levels, so sweeping levels in
+    descending order finalizes y[d] before it is pulled — a gather, where the
+    rectangle schedule needed a scatter-add.
+    """
+    y = g
+    for lvl in range(len(starts) - 3, -1, -1):  # deepest level keeps y = g
+        s, e = starts[lvl], starts[lvl + 1]
+        d = down[s:e]
+        contrib = (y.at[d].get(mode="fill", fill_value=0) * c1.at[d].get(mode="fill", fill_value=0)).sum(axis=1)
+        y = y.at[s:e].set(g[s:e] + contrib, indices_are_sorted=True)
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def fused_solve(starts, c1, b, pred, down):
+    """Solve ``(I - diag(c1) N) x = b`` in permuted space (see module docstring)."""
+    return _fused_sweep_down(starts, c1, b, pred)
+
+
+def _fused_solve_fwd(starts, c1, b, pred, down):
+    x = _fused_sweep_down(starts, c1, b, pred)
+    return x, (c1, x, pred, down)
+
+
+def _fused_solve_bwd(starts, res, grad_x):
+    c1, x, pred, down = res
+    grad_b = _fused_sweep_up(starts, c1, grad_x, down)
+    # grad_c1 = grad_b * (N @ x): same math as the rectangle path, via the
+    # predecessor gather table instead of a segment-sum.
+    nx = x.at[pred].get(mode="fill", fill_value=0).sum(axis=1)
+    f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)
+    return (grad_b * nx, grad_b, f0(pred), f0(down))
+
+
+fused_solve.defvjp(_fused_solve_fwd, _fused_solve_bwd)
+
+
 def solve_lower_triangular(network: RiverNetwork, c1: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Solve ``(I - diag(c1) N) x = b`` exactly in ``network.depth`` wavefront steps.
 
@@ -105,9 +169,19 @@ def solve_lower_triangular(network: RiverNetwork, c1: jnp.ndarray, b: jnp.ndarra
         raise ValueError(
             f"c1 {c1.shape} and b {b.shape} must both have shape ({network.n},)"
         )
+    if network.fused:
+        x_p = fused_solve(
+            network.level_starts, c1[network.perm], b[network.perm], network.pred, network.down
+        )
+        return x_p[network.inv_perm]
     return _solve(c1, b, network.lvl_src, network.lvl_tgt, network.edge_src, network.edge_tgt)
 
 
 def solve_transposed(network: RiverNetwork, c1: jnp.ndarray, g: jnp.ndarray) -> jnp.ndarray:
     """Transposed solve ``A^T y = g`` (exposed for tests and diagnostics)."""
+    if network.fused:
+        y_p = _fused_sweep_up(
+            network.level_starts, c1[network.perm], g[network.perm], network.down
+        )
+        return y_p[network.inv_perm]
     return _sweep_up(c1, g, network.lvl_src, network.lvl_tgt)

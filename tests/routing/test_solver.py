@@ -17,6 +17,14 @@ from ddr_tpu.routing.network import build_network, compute_levels
 from ddr_tpu.routing.solver import solve_lower_triangular, solve_transposed
 
 
+@pytest.fixture(params=[None, False], ids=["auto", "rect"])
+def schedule(request):
+    """Run each solve test under the auto-selected (fused where eligible) and the
+    forced rectangle-scan schedule — both are production paths (the rectangle one
+    backs distributed execution and deep networks)."""
+    return request.param
+
+
 def _random_dag(rng, n, max_up=3):
     """Random topologically-ordered DAG: each node picks 0..max_up upstream nodes."""
     rows, cols = [], []
@@ -61,46 +69,46 @@ class TestLevels:
 
 
 class TestSolve:
-    def test_identity_when_c1_zero(self, rng):
+    def test_identity_when_c1_zero(self, rng, schedule):
         rows, cols = _random_dag(rng, 50)
-        net = build_network(rows, cols, 50)
+        net = build_network(rows, cols, 50, fused=schedule)
         b = jnp.asarray(rng.normal(size=50).astype(np.float32))
         x = solve_lower_triangular(net, jnp.zeros(50), b)
         np.testing.assert_allclose(np.asarray(x), np.asarray(b), rtol=1e-6)
 
     @pytest.mark.parametrize("n", [2, 17, 200])
-    def test_chain_vs_scipy(self, chain_coo, rng, n):
+    def test_chain_vs_scipy(self, chain_coo, rng, n, schedule):
         rows, cols = chain_coo(n)
-        net = build_network(rows, cols, n)
+        net = build_network(rows, cols, n, fused=schedule)
         c1 = rng.uniform(-0.9, 0.95, n).astype(np.float32)
         b = rng.uniform(0.1, 5.0, n).astype(np.float32)
         x = solve_lower_triangular(net, jnp.asarray(c1), jnp.asarray(b))
         ref = _scipy_solve(rows, cols, n, c1, b)
         np.testing.assert_allclose(np.asarray(x), ref, rtol=2e-5, atol=1e-5)
 
-    def test_tree_vs_scipy(self, tree_coo, rng):
+    def test_tree_vs_scipy(self, tree_coo, rng, schedule):
         rows, cols, n = tree_coo(4)
-        net = build_network(rows, cols, n)
+        net = build_network(rows, cols, n, fused=schedule)
         c1 = rng.uniform(0.0, 0.99, n).astype(np.float32)
         b = rng.uniform(0.1, 5.0, n).astype(np.float32)
         x = solve_lower_triangular(net, jnp.asarray(c1), jnp.asarray(b))
         ref = _scipy_solve(rows, cols, n, c1, b)
         np.testing.assert_allclose(np.asarray(x), ref, rtol=2e-5, atol=1e-5)
 
-    def test_random_dag_vs_scipy(self, rng):
+    def test_random_dag_vs_scipy(self, rng, schedule):
         n = 300
         rows, cols = _random_dag(rng, n)
-        net = build_network(rows, cols, n)
+        net = build_network(rows, cols, n, fused=schedule)
         c1 = rng.uniform(-0.5, 0.9, n).astype(np.float32)
         b = rng.uniform(0.1, 5.0, n).astype(np.float32)
         x = solve_lower_triangular(net, jnp.asarray(c1), jnp.asarray(b))
         ref = _scipy_solve(rows, cols, n, c1, b)
         np.testing.assert_allclose(np.asarray(x), ref, rtol=5e-5, atol=5e-5)
 
-    def test_transposed_vs_scipy(self, rng):
+    def test_transposed_vs_scipy(self, rng, schedule):
         n = 120
         rows, cols = _random_dag(rng, n)
-        net = build_network(rows, cols, n)
+        net = build_network(rows, cols, n, fused=schedule)
         c1 = rng.uniform(-0.5, 0.9, n).astype(np.float32)
         g = rng.normal(size=n).astype(np.float32)
         y = solve_transposed(net, jnp.asarray(c1), jnp.asarray(g))
@@ -109,10 +117,10 @@ class TestSolve:
         ref = spsolve_triangular(A.T.tocsr(), g.astype(np.float64), lower=False)
         np.testing.assert_allclose(np.asarray(y), ref, rtol=5e-5, atol=5e-5)
 
-    def test_jit_compatible(self, rng):
+    def test_jit_compatible(self, rng, schedule):
         n = 64
         rows, cols = _random_dag(rng, n)
-        net = build_network(rows, cols, n)
+        net = build_network(rows, cols, n, fused=schedule)
         f = jax.jit(lambda c1, b: solve_lower_triangular(net, c1, b))
         c1 = jnp.asarray(rng.uniform(0, 0.9, n).astype(np.float32))
         b = jnp.asarray(rng.uniform(0.1, 5, n).astype(np.float32))
@@ -124,16 +132,16 @@ class TestSolve:
 
 
 class TestGradients:
-    def _setup(self, rng, n=60):
+    def _setup(self, rng, schedule, n=60):
         rows, cols = _random_dag(rng, n)
-        net = build_network(rows, cols, n)
+        net = build_network(rows, cols, n, fused=schedule)
         c1 = jnp.asarray(rng.uniform(0.05, 0.9, n).astype(np.float32))
         b = jnp.asarray(rng.uniform(0.5, 5.0, n).astype(np.float32))
         w = jnp.asarray(rng.normal(size=n).astype(np.float32))
         return net, c1, b, w
 
-    def test_grad_b_finite_difference(self, rng):
-        net, c1, b, w = self._setup(rng)
+    def test_grad_b_finite_difference(self, rng, schedule):
+        net, c1, b, w = self._setup(rng, schedule)
 
         def loss(b_):
             return jnp.sum(w * solve_lower_triangular(net, c1, b_))
@@ -146,8 +154,8 @@ class TestGradients:
             fd = (loss(bp) - loss(bm)) / (2 * eps)
             np.testing.assert_allclose(np.asarray(g[i]), np.asarray(fd), rtol=5e-2, atol=1e-3)
 
-    def test_grad_c1_finite_difference(self, rng):
-        net, c1, b, w = self._setup(rng)
+    def test_grad_c1_finite_difference(self, rng, schedule):
+        net, c1, b, w = self._setup(rng, schedule)
 
         def loss(c1_):
             return jnp.sum(w * solve_lower_triangular(net, c1_, b))
@@ -160,8 +168,69 @@ class TestGradients:
             fd = (loss(cp) - loss(cm)) / (2 * eps)
             np.testing.assert_allclose(np.asarray(g[i]), np.asarray(fd), rtol=5e-2, atol=1e-3)
 
-    def test_grads_flow_through_jit(self, rng):
-        net, c1, b, w = self._setup(rng)
+    def test_grads_flow_through_jit(self, rng, schedule):
+        net, c1, b, w = self._setup(rng, schedule)
         g = jax.jit(jax.grad(lambda c: jnp.sum(solve_lower_triangular(net, c, b))))(c1)
         assert np.isfinite(np.asarray(g)).all()
         assert float(jnp.abs(g).sum()) > 0
+
+
+class TestScheduleEquivalence:
+    """The fused (scatter-free permuted) and rectangle scan schedules are two
+    lowerings of the same solve; they must agree in values and gradients."""
+
+    def _nets(self, rng, n=400):
+        # Dendritic chain-with-confluences: in/out degrees within fused limits.
+        rows = np.array([int(rng.integers(i + 1, min(n, i + 40))) for i in range(n - 1)])
+        cols = np.arange(n - 1, dtype=np.int64)
+        nf = build_network(rows, cols, n, fused=True)
+        nr = build_network(rows, cols, n, fused=False)
+        assert nf.fused and not nr.fused
+        return nf, nr
+
+    def test_solve_agrees(self, rng):
+        nf, nr = self._nets(rng)
+        n = nf.n
+        c1 = jnp.asarray(rng.uniform(-0.5, 0.9, n).astype(np.float32))
+        b = jnp.asarray(rng.uniform(0.1, 5.0, n).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(solve_lower_triangular(nf, c1, b)),
+            np.asarray(solve_lower_triangular(nr, c1, b)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_transposed_agrees(self, rng):
+        nf, nr = self._nets(rng)
+        n = nf.n
+        c1 = jnp.asarray(rng.uniform(-0.5, 0.9, n).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(solve_transposed(nf, c1, g)),
+            np.asarray(solve_transposed(nr, c1, g)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_gradients_agree(self, rng):
+        nf, nr = self._nets(rng)
+        n = nf.n
+        c1 = jnp.asarray(rng.uniform(-0.5, 0.9, n).astype(np.float32))
+        b = jnp.asarray(rng.uniform(0.5, 2.0, n).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=n).astype(np.float32))
+
+        def loss(net):
+            return lambda c, bb: jnp.sum(w * solve_lower_triangular(net, c, bb))
+
+        gf = jax.grad(loss(nf), argnums=(0, 1))(c1, b)
+        gr = jax.grad(loss(nr), argnums=(0, 1))(c1, b)
+        np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gr[0]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gr[1]), rtol=1e-4, atol=1e-5)
+
+    def test_fused_ineligible_raises(self, rng):
+        # Out-degree beyond the fused limit must refuse fused=True explicitly.
+        n = 20
+        rows = np.arange(1, n, dtype=np.int64)
+        cols = np.zeros(n - 1, dtype=np.int64)  # node 0 feeds everyone
+        with pytest.raises(ValueError, match="fused-schedule limits"):
+            build_network(rows, cols, n, fused=True)
+        net = build_network(rows, cols, n)  # auto falls back
+        assert not net.fused
