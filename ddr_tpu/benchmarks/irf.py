@@ -146,9 +146,11 @@ def route_lti(
     """Route ``(T, N)`` lateral inflows through per-reach LTI channels.
 
     ``pad_steps`` zero-padding bounds the circular-wrap error of the FFT (composed
-    path responses have exponential tails); default 8× the kernel length. Frequency
-    bins are solved in ``freq_batch`` chunks via ``lax.map(..., batch_size=...)`` to
-    bound memory at large T×N.
+    path responses have exponential tails); the default scales with network depth —
+    a path through D cascaded reaches has mean delay ≈ D × the per-reach mean, so a
+    depth-independent pad would wrap tail energy into early timesteps on deep
+    networks. Frequency bins are solved in ``freq_batch`` chunks via
+    ``lax.map(..., batch_size=...)`` to bound memory at large T×N.
 
     Returns (T, N) discharge at every reach — gauge extraction/aggregation is the
     caller's job (unlike DiffRoute, no per-gage re-routing is needed).
@@ -158,7 +160,11 @@ def route_lti(
         raise ValueError(f"q_prime has {n} reaches, network has {network.n}")
     kernels = jnp.asarray(kernels, jnp.float32)
     if pad_steps is None:
-        pad_steps = 8 * kernels.shape[1]
+        # Composed tail length ~ depth * mean per-reach delay (kernels sum to 1).
+        mean_delay = float(
+            jnp.mean(jnp.sum(kernels * jnp.arange(kernels.shape[1]), axis=1))
+        )
+        pad_steps = int(max(8 * kernels.shape[1], network.depth * mean_delay + 4 * kernels.shape[1]))
     n_fft = _next_pow2(T + pad_steps)
 
     h_hat = jnp.fft.rfft(kernels, n=n_fft, axis=1).T  # (F, N) complex

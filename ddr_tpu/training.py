@@ -152,6 +152,12 @@ def make_batch_train_step(
     return step
 
 
+# Bump when the checkpoint blob layout changes; load_state refuses mismatches with
+# a clear error instead of failing cryptically mid-restore.
+CHECKPOINT_FORMAT = "ddr-tpu-checkpoint"
+CHECKPOINT_VERSION = 1
+
+
 def save_state(
     save_dir: str | Path,
     name: str,
@@ -168,6 +174,8 @@ def save_state(
     save_dir.mkdir(parents=True, exist_ok=True)
     path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.pkl"
     blob = {
+        "format": CHECKPOINT_FORMAT,
+        "version": CHECKPOINT_VERSION,
         "epoch": epoch,
         "mini_batch": mini_batch,
         "params": jax.device_get(params),
@@ -180,9 +188,29 @@ def save_state(
 
 
 def load_state(path: str | Path) -> dict:
-    """Load a checkpoint blob (reference scripts_utils.load_checkpoint:45-73)."""
-    with Path(path).open("rb") as f:
-        return pickle.load(f)
+    """Load and schema-check a checkpoint blob (reference
+    scripts_utils.load_checkpoint:45-73). Raises ``ValueError`` on corrupt,
+    foreign, or version-mismatched blobs."""
+    path = Path(path)
+    try:
+        with path.open("rb") as f:
+            blob = pickle.load(f)
+    except (pickle.UnpicklingError, EOFError, AttributeError) as e:
+        raise ValueError(f"corrupt checkpoint {path}: {e}") from e
+    if not isinstance(blob, dict) or blob.get("format") != CHECKPOINT_FORMAT:
+        raise ValueError(
+            f"{path} is not a ddr-tpu checkpoint (missing format marker; "
+            "pre-versioning blobs must be re-saved)"
+        )
+    if blob.get("version") != CHECKPOINT_VERSION:
+        raise ValueError(
+            f"checkpoint {path} has version {blob.get('version')}, "
+            f"this build reads version {CHECKPOINT_VERSION}"
+        )
+    missing = {"epoch", "mini_batch", "params", "opt_state"} - blob.keys()
+    if missing:
+        raise ValueError(f"checkpoint {path} missing fields: {sorted(missing)}")
+    return blob
 
 
 def latest_checkpoint(save_dir: str | Path) -> Path | None:

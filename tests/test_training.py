@@ -105,3 +105,69 @@ def test_nan_observations_are_masked():
         jnp.asarray(np.nan_to_num(obs)), jnp.asarray(mask),
     )
     assert np.isfinite(float(loss)), "NaN observations leaked into the loss"
+
+
+class TestCheckpointSchema:
+    """Version/schema guard on checkpoint blobs (pre-versioning blobs and corrupt
+    files must fail with a clear ValueError, not a cryptic KeyError mid-restore)."""
+
+    def _save(self, tmp_path):
+        from ddr_tpu.training import save_state
+
+        return save_state(tmp_path, "t", epoch=1, mini_batch=2, params={"w": 1.0}, opt_state={})
+
+    def test_round_trip(self, tmp_path):
+        from ddr_tpu.training import load_state
+
+        blob = load_state(self._save(tmp_path))
+        assert blob["epoch"] == 1 and blob["mini_batch"] == 2
+        assert blob["params"] == {"w": 1.0}
+
+    def test_corrupt_blob_raises(self, tmp_path):
+        import pytest
+
+        from ddr_tpu.training import load_state
+
+        p = tmp_path / "bad.pkl"
+        p.write_bytes(b"\x80\x04 this is not a pickle")
+        with pytest.raises(ValueError, match="corrupt checkpoint"):
+            load_state(p)
+
+    def test_pre_versioning_blob_raises(self, tmp_path):
+        import pickle
+
+        import pytest
+
+        from ddr_tpu.training import load_state
+
+        p = tmp_path / "old.pkl"
+        with p.open("wb") as f:
+            pickle.dump({"epoch": 0, "params": {}}, f)  # round-1 layout: no marker
+        with pytest.raises(ValueError, match="not a ddr-tpu checkpoint"):
+            load_state(p)
+
+    def test_version_mismatch_raises(self, tmp_path):
+        import pickle
+
+        import pytest
+
+        from ddr_tpu.training import CHECKPOINT_FORMAT, load_state
+
+        p = tmp_path / "future.pkl"
+        with p.open("wb") as f:
+            pickle.dump({"format": CHECKPOINT_FORMAT, "version": 999}, f)
+        with pytest.raises(ValueError, match="version 999"):
+            load_state(p)
+
+    def test_missing_fields_raises(self, tmp_path):
+        import pickle
+
+        import pytest
+
+        from ddr_tpu.training import CHECKPOINT_FORMAT, CHECKPOINT_VERSION, load_state
+
+        p = tmp_path / "partial.pkl"
+        with p.open("wb") as f:
+            pickle.dump({"format": CHECKPOINT_FORMAT, "version": CHECKPOINT_VERSION}, f)
+        with pytest.raises(ValueError, match="missing fields"):
+            load_state(p)
