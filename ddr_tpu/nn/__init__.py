@@ -1,0 +1,20 @@
+"""Neural networks: native KAN + pykan-compat path for reference-trained weights."""
+
+from ddr_tpu.nn.compat import PykanKan, PykanKANLayer
+from ddr_tpu.nn.kan import Kan, KANLayer, bspline_basis
+from ddr_tpu.nn.torch_import import (
+    ImportedKan,
+    import_state_dict,
+    load_reference_checkpoint,
+)
+
+__all__ = [
+    "Kan",
+    "KANLayer",
+    "bspline_basis",
+    "PykanKan",
+    "PykanKANLayer",
+    "ImportedKan",
+    "import_state_dict",
+    "load_reference_checkpoint",
+]

@@ -1,0 +1,134 @@
+"""pykan-compatible KAN forward path, for running reference-trained weights on TPU.
+
+The reference's network (/root/reference/src/ddr/nn/kan.py:11-62) wraps pykan's
+``KAN([h, h], grid, k)`` between two Linear layers. pykan's parameterization differs
+from :class:`ddr_tpu.nn.kan.KANLayer` in three ways that make a straight parameter
+remap impossible:
+
+1. **Per-input adaptive grids** — pykan stores an explicit, data-fitted knot vector
+   per input feature (``act_fun.0.grid``: ``(in, G + 2k + 1)``), not a shared uniform
+   grid over a fixed range.
+2. **Edge scaling** — each (input, output) edge carries ``scale_base``, ``scale_sp``
+   and a prunable ``mask``: phi(x) = mask * (scale_base * silu(x) + scale_sp * spline(x)).
+3. **Node affines** — after summing edges, pykan applies two elementwise affine
+   transforms (``subnode_scale/bias`` then ``node_scale/bias``).
+
+:class:`PykanKan` reproduces that forward pass exactly (modulo float precision) as a
+flax module, so weights imported by :mod:`ddr_tpu.nn.torch_import` produce the same
+parameter fields the reference would. pykan's *symbolic* branch (``symbolic_fun``) is
+supported only in its default disabled state (all-zero masks) — the importer rejects
+checkpoints that activated it.
+
+Everything here is pure elementwise math + einsum: XLA fuses it cleanly; the basis
+recursion unrolls at trace time just like the native layer's.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PykanKANLayer", "PykanKan", "pykan_bspline_basis"]
+
+
+def pykan_bspline_basis(x: jnp.ndarray, knots: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Order-``k`` B-spline basis on **per-feature** knot vectors.
+
+    x: (..., F); knots: (F, K) with K = G + 2k + 1 extended knots per input feature
+    (pykan ``KANLayer.grid``). Returns (..., F, K - k - 1) = (..., F, G + k) basis
+    values via the Cox-de Boor recursion — identical math to
+    :func:`ddr_tpu.nn.kan.bspline_basis` but with the knot axis broadcast per feature
+    (the shape convention of pykan's ``B_batch``).
+    """
+    x = x[..., None]  # (..., F, 1)
+    b = ((x >= knots[:, :-1]) & (x < knots[:, 1:])).astype(x.dtype)
+    for d in range(1, k + 1):
+        left = (x - knots[:, : -(d + 1)]) / (knots[:, d:-1] - knots[:, : -(d + 1)])
+        right = (knots[:, d + 1 :] - x) / (knots[:, d + 1 :] - knots[:, 1:-d])
+        b = left * b[..., :-1] + right * b[..., 1:]
+    return b
+
+
+class PykanKANLayer(nn.Module):
+    """One pykan-parameterized KAN layer (edge splines + edge scales + node affines).
+
+    Parameter fields mirror pykan's ``KANLayer`` + the per-layer affine parameters its
+    ``MultKAN`` owner applies (``subnode_*``, ``node_*``), composed here because the
+    reference always uses width ``[h, h]`` (one KANLayer per pykan model, no
+    multiplication nodes).
+    """
+
+    features: int
+    grid_size: int = 50
+    spline_order: int = 2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_features = x.shape[-1]
+        n_knots = self.grid_size + 2 * self.spline_order + 1
+        n_basis = self.grid_size + self.spline_order
+
+        def uniform_knots(key, shape, dtype=jnp.float32):
+            del key
+            base = jnp.linspace(
+                -1.0 - self.spline_order * (2.0 / self.grid_size),
+                1.0 + self.spline_order * (2.0 / self.grid_size),
+                n_knots,
+                dtype=dtype,
+            )
+            return jnp.broadcast_to(base, shape)
+
+        # pykan updates knots from data, not by gradient; when training through this
+        # module, freeze "knots" (e.g. optax.masked) to match reference behavior.
+        knots = self.param("knots", uniform_knots, (in_features, n_knots))
+        coef = self.param(
+            "coef", nn.initializers.normal(stddev=0.1), (in_features, self.features, n_basis)
+        )
+        mask = self.param("mask", nn.initializers.ones, (in_features, self.features))
+        scale_base = self.param(
+            "scale_base", nn.initializers.ones, (in_features, self.features)
+        )
+        scale_sp = self.param("scale_sp", nn.initializers.ones, (in_features, self.features))
+        subnode_scale = self.param("subnode_scale", nn.initializers.ones, (self.features,))
+        subnode_bias = self.param("subnode_bias", nn.initializers.zeros, (self.features,))
+        node_scale = self.param("node_scale", nn.initializers.ones, (self.features,))
+        node_bias = self.param("node_bias", nn.initializers.zeros, (self.features,))
+
+        basis = pykan_bspline_basis(x, knots, self.spline_order)  # (..., in, n_basis)
+        spline = jnp.einsum("...ig,iog->...io", basis, coef)  # (..., in, out)
+        edge = mask * (scale_base * jax.nn.silu(x)[..., None] + scale_sp * spline)
+        y = jnp.sum(edge, axis=-2)  # (..., out)
+        y = subnode_scale * y + subnode_bias
+        return node_scale * y + node_bias
+
+
+class PykanKan(nn.Module):
+    """Reference network with pykan-parameterized hidden layers.
+
+    Same I/O contract as :class:`ddr_tpu.nn.kan.Kan` — ``(N, n_inputs)`` z-scored
+    attributes in, ``{param_name: (N,)}`` sigmoids out — but bit-compatible (at
+    float32) with the reference's ``kan`` module so its shipped trained weights
+    (/root/reference/examples/README.md:9-16) can be served from JAX.
+    """
+
+    input_var_names: tuple[str, ...]
+    learnable_parameters: tuple[str, ...]
+    hidden_size: int = 21
+    num_hidden_layers: int = 2
+    grid: int = 50
+    k: int = 2
+
+    @nn.compact
+    def __call__(self, inputs: jnp.ndarray) -> dict[str, jnp.ndarray]:
+        x = nn.Dense(self.hidden_size, name="input")(inputs)
+        for i in range(self.num_hidden_layers):
+            x = PykanKANLayer(
+                self.hidden_size,
+                grid_size=self.grid,
+                spline_order=self.k,
+                name=f"layer_{i}",
+            )(x)
+        x = nn.Dense(len(self.learnable_parameters), name="output")(x)
+        x = jax.nn.sigmoid(x)
+        return {name: x[..., i] for i, name in enumerate(self.learnable_parameters)}

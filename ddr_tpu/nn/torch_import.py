@@ -1,0 +1,182 @@
+"""Import reference (torch + pykan) checkpoints into :class:`ddr_tpu.nn.compat.PykanKan`.
+
+The reference saves ``{"model_state_dict", "optimizer_state_dict", "rng_state", ...,
+"epoch", "mini_batch"}`` blobs (/root/reference/src/ddr/validation/utils.py:55-80) and
+reloads only ``model_state_dict`` for resume/inference
+(/root/reference/src/ddr/scripts_utils.py:45-73). This module maps that state dict —
+whose hidden layers are pykan ``MultKAN`` models — onto the flax parameter tree of
+:class:`PykanKan`, inferring ``hidden_size`` / ``num_hidden_layers`` / ``grid`` / ``k``
+from tensor shapes so a checkpoint is self-describing.
+
+Torch is used only to unpickle (``weights_only=True`` — the blob is untrusted data, so
+arbitrary-object unpickling is refused); all tensors are converted to numpy
+immediately. Checkpoints that enabled pykan's symbolic branch (nonzero
+``symbolic_fun.*.mask``) cannot be represented and are rejected explicitly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from ddr_tpu.nn.compat import PykanKan
+
+__all__ = ["ImportedKan", "import_state_dict", "load_reference_checkpoint"]
+
+
+@dataclass
+class ImportedKan:
+    """A reference checkpoint translated to JAX."""
+
+    model: PykanKan
+    params: dict  # flax params pytree: {"params": {...}}
+    hidden_size: int
+    num_hidden_layers: int
+    grid: int
+    k: int
+    epoch: int | None = None
+    mini_batch: int | None = None
+    extras: dict[str, Any] = field(default_factory=dict)
+
+
+def _np(t: Any) -> np.ndarray:
+    """torch.Tensor | ndarray -> float32 ndarray (detached copy)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def import_state_dict(
+    state_dict: Mapping[str, Any],
+    input_var_names: tuple[str, ...],
+    learnable_parameters: tuple[str, ...],
+) -> ImportedKan:
+    """Map a reference ``model_state_dict`` onto ``PykanKan`` params.
+
+    Accepts torch tensors or numpy arrays as values (tests fabricate numpy state
+    dicts so they need no torch at all). Raises ``ValueError`` on shape/key
+    mismatches and ``NotImplementedError`` for activated symbolic branches.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    for req in ("input.weight", "input.bias", "output.weight", "output.bias"):
+        if req not in sd:
+            raise ValueError(f"not a reference kan state dict: missing {req!r}")
+
+    in_w = sd["input.weight"]  # torch Linear: (out, in)
+    out_w = sd["output.weight"]
+    hidden_size, n_inputs = in_w.shape
+    n_outputs = out_w.shape[0]
+    if n_inputs != len(input_var_names):
+        raise ValueError(
+            f"checkpoint expects {n_inputs} inputs, config names {len(input_var_names)}: "
+            f"{list(input_var_names)}"
+        )
+    if n_outputs != len(learnable_parameters):
+        raise ValueError(
+            f"checkpoint predicts {n_outputs} parameters, config names "
+            f"{len(learnable_parameters)}: {list(learnable_parameters)}"
+        )
+
+    layer_ids = sorted(
+        {int(key.split(".")[1]) for key in sd if key.startswith("layers.")}
+    )
+    if layer_ids != list(range(len(layer_ids))):
+        raise ValueError(f"non-contiguous pykan layer indices: {layer_ids}")
+    if not layer_ids:
+        raise ValueError("reference kan checkpoint has no hidden KAN layers")
+
+    # Infer grid/k from knot/basis counts: knots = G + 2k + 1, basis = G + k.
+    grid0 = sd["layers.0.act_fun.0.grid"]
+    coef0 = sd["layers.0.act_fun.0.coef"]
+    n_knots, n_basis = grid0.shape[1], coef0.shape[2]
+    k = n_knots - n_basis - 1
+    grid = n_basis - k
+    if k < 1 or grid < 1:
+        raise ValueError(
+            f"cannot infer pykan (grid, k) from knots={n_knots}, basis={n_basis}"
+        )
+
+    params: dict[str, Any] = {
+        "input": {"kernel": in_w.T, "bias": sd["input.bias"]},
+        "output": {"kernel": out_w.T, "bias": sd["output.bias"]},
+    }
+    deep = [key for key in sd if ".act_fun." in key and ".act_fun.0." not in key]
+    if deep:
+        raise NotImplementedError(
+            f"pykan models with multi-KANLayer width lists are not supported "
+            f"(found {sorted(deep)[:3]}...); the reference always uses width [h, h]"
+        )
+
+    for i in layer_ids:
+        p = f"layers.{i}."
+        sym_mask = sd.get(p + "symbolic_fun.0.mask")
+        if sym_mask is not None and np.any(sym_mask != 0):
+            raise NotImplementedError(
+                f"layer {i} has an active pykan symbolic branch "
+                f"({int(np.count_nonzero(sym_mask))} nonzero mask entries); the TPU "
+                "compat path implements only the numerical (spline) branch. Prune or "
+                "unfix the symbolic functions in pykan before exporting."
+            )
+        coef = sd[p + "act_fun.0.coef"]  # (in, out, n_basis)
+        if coef.shape[:2] != (hidden_size, hidden_size):
+            raise ValueError(
+                f"layer {i} coef shape {coef.shape} inconsistent with hidden "
+                f"size {hidden_size}"
+            )
+        params[f"layer_{i}"] = {
+            "knots": sd[p + "act_fun.0.grid"],
+            "coef": coef,
+            "mask": sd[p + "act_fun.0.mask"],
+            "scale_base": sd[p + "act_fun.0.scale_base"],
+            "scale_sp": sd[p + "act_fun.0.scale_sp"],
+            "subnode_scale": sd[p + "subnode_scale_0"],
+            "subnode_bias": sd[p + "subnode_bias_0"],
+            "node_scale": sd[p + "node_scale_0"],
+            "node_bias": sd[p + "node_bias_0"],
+        }
+
+    model = PykanKan(
+        input_var_names=tuple(input_var_names),
+        learnable_parameters=tuple(learnable_parameters),
+        hidden_size=hidden_size,
+        num_hidden_layers=len(layer_ids),
+        grid=grid,
+        k=k,
+    )
+    return ImportedKan(
+        model=model,
+        params={"params": params},
+        hidden_size=hidden_size,
+        num_hidden_layers=len(layer_ids),
+        grid=grid,
+        k=k,
+    )
+
+
+def load_reference_checkpoint(
+    path: str | Path,
+    input_var_names: tuple[str, ...],
+    learnable_parameters: tuple[str, ...],
+) -> ImportedKan:
+    """Load a reference ``.pt`` blob (full save or bare state dict) from disk."""
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked into the env
+        raise ImportError(
+            "importing reference .pt checkpoints requires torch (CPU build is "
+            "enough); alternatively pass the state dict to import_state_dict()"
+        ) from e
+
+    blob = torch.load(path, map_location="cpu", weights_only=True)
+    if not isinstance(blob, dict):
+        raise ValueError(f"unsupported checkpoint payload of type {type(blob)!r}")
+    state_dict = blob.get("model_state_dict", blob)
+    imported = import_state_dict(state_dict, input_var_names, learnable_parameters)
+    if "model_state_dict" in blob:
+        imported.epoch = blob.get("epoch")
+        imported.mini_batch = blob.get("mini_batch")
+    return imported

@@ -1,0 +1,218 @@
+"""Torch-checkpoint import shim: pykan semantics oracle + real reference blob.
+
+The oracle re-implements pykan's MultKAN forward (edge splines scaled by
+scale_base/scale_sp/mask, then subnode/node affines) with scipy's BSpline.basis_element
+— an implementation wholly independent of ddr_tpu.nn.compat — so agreement is evidence
+the compat module reproduces the reference parameterization, not just itself.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.interpolate import BSpline
+
+from ddr_tpu.nn.compat import PykanKan, pykan_bspline_basis
+from ddr_tpu.nn.kan import bspline_basis
+from ddr_tpu.nn.torch_import import import_state_dict, load_reference_checkpoint
+
+REFERENCE_PT = (
+    "/root/reference/examples/lynker_hydrofabric/"
+    "ddr-v0.5.2.lynker_hydrofabric_trained_weights.pt"
+)
+
+LYNKER_INPUTS = (
+    "SoilGrids1km_clay", "aridity", "meanelevation", "meanP", "NDVI",
+    "meanslope", "log_uparea", "SoilGrids1km_sand", "ETPOT_Hargr", "Porosity",
+)
+LYNKER_PARAMS = ("n", "q_spatial", "p_spatial")
+
+
+def _random_grids(rng, in_features, grid, k, lo=-3.0, hi=3.0):
+    """Per-feature strictly-increasing extended knot vectors spanning [lo, hi]."""
+    n_knots = grid + 2 * k + 1
+    steps = rng.uniform(0.1, 1.0, size=(in_features, n_knots - 1))
+    knots = np.concatenate(
+        [np.zeros((in_features, 1)), np.cumsum(steps, axis=1)], axis=1
+    )
+    knots = lo + (hi - lo) * knots / knots[:, -1:]
+    return knots.astype(np.float32)
+
+
+def _fake_state_dict(rng, n_in, hidden, n_out, n_layers, grid, k):
+    sd = {
+        "input.weight": rng.normal(size=(hidden, n_in)).astype(np.float32),
+        "input.bias": rng.normal(size=(hidden,)).astype(np.float32),
+        "output.weight": rng.normal(size=(n_out, hidden)).astype(np.float32),
+        "output.bias": rng.normal(size=(n_out,)).astype(np.float32),
+    }
+    for i in range(n_layers):
+        p = f"layers.{i}."
+        sd[p + "act_fun.0.grid"] = _random_grids(rng, hidden, grid, k)
+        sd[p + "act_fun.0.coef"] = rng.normal(
+            scale=0.3, size=(hidden, hidden, grid + k)
+        ).astype(np.float32)
+        sd[p + "act_fun.0.mask"] = (
+            rng.uniform(size=(hidden, hidden)) > 0.1
+        ).astype(np.float32)
+        sd[p + "act_fun.0.scale_base"] = rng.normal(size=(hidden, hidden)).astype(np.float32)
+        sd[p + "act_fun.0.scale_sp"] = rng.normal(size=(hidden, hidden)).astype(np.float32)
+        sd[p + "symbolic_fun.0.mask"] = np.zeros((hidden, hidden), np.float32)
+        sd[p + "symbolic_fun.0.affine"] = np.zeros((hidden, hidden, 4), np.float32)
+        for name in ("node", "subnode"):
+            sd[p + f"{name}_scale_0"] = rng.normal(
+                loc=1.0, scale=0.2, size=(hidden,)
+            ).astype(np.float32)
+            sd[p + f"{name}_bias_0"] = rng.normal(scale=0.2, size=(hidden,)).astype(np.float32)
+    return sd
+
+
+def _scipy_basis(x, knots, k):
+    """(batch, in) -> (batch, in, grid + k) basis values via scipy BSpline."""
+    batch, n_in = x.shape
+    n_basis = knots.shape[1] - k - 1
+    out = np.zeros((batch, n_in, n_basis))
+    for f in range(n_in):
+        for g in range(n_basis):
+            bf = BSpline.basis_element(knots[f, g : g + k + 2], extrapolate=False)
+            vals = bf(x[:, f].astype(np.float64))
+            out[:, f, g] = np.nan_to_num(vals, nan=0.0)
+    return out
+
+
+def _silu(x):
+    return x / (1.0 + np.exp(-x))
+
+
+def _oracle_forward(sd, x, k, n_layers):
+    """pykan MultKAN semantics in numpy (float64), independent of ddr_tpu."""
+    h = x @ sd["input.weight"].T.astype(np.float64) + sd["input.bias"]
+    for i in range(n_layers):
+        p = f"layers.{i}."
+        basis = _scipy_basis(h, sd[p + "act_fun.0.grid"].astype(np.float64), k)
+        spline = np.einsum("big,iog->bio", basis, sd[p + "act_fun.0.coef"].astype(np.float64))
+        edge = sd[p + "act_fun.0.mask"] * (
+            sd[p + "act_fun.0.scale_base"] * _silu(h)[:, :, None]
+            + sd[p + "act_fun.0.scale_sp"] * spline
+        )
+        h = edge.sum(axis=1)
+        h = sd[p + "subnode_scale_0"] * h + sd[p + "subnode_bias_0"]
+        h = sd[p + "node_scale_0"] * h + sd[p + "node_bias_0"]
+    out = h @ sd["output.weight"].T.astype(np.float64) + sd["output.bias"]
+    return 1.0 / (1.0 + np.exp(-out))
+
+
+class TestPerFeatureBasis:
+    def test_matches_shared_grid_basis(self):
+        """With identical knots per feature, the per-feature basis equals the native one."""
+        k, grid = 3, 5
+        h = 2.0 / grid
+        knots1d = np.arange(-k, grid + k + 1, dtype=np.float32) * h - 1.0
+        x = jnp.asarray(np.random.default_rng(0).uniform(-0.99, 0.99, (17, 4)), jnp.float32)
+        shared = bspline_basis(x, jnp.asarray(knots1d), k)
+        per_feature = pykan_bspline_basis(
+            x, jnp.broadcast_to(knots1d, (4, knots1d.size)), k
+        )
+        np.testing.assert_allclose(np.asarray(shared), np.asarray(per_feature), atol=1e-6)
+
+    def test_partition_of_unity_inside_grid(self):
+        rng = np.random.default_rng(1)
+        knots = _random_grids(rng, 3, grid=8, k=2)
+        # interior of every feature's active region: [knots[k], knots[-k-1]]
+        lo = knots[:, 2].max() + 0.05
+        hi = knots[:, -3].min() - 0.05
+        x = jnp.asarray(rng.uniform(lo, hi, (50, 3)), jnp.float32)
+        b = pykan_bspline_basis(x, jnp.asarray(knots), 2)
+        np.testing.assert_allclose(np.asarray(b).sum(-1), 1.0, atol=1e-5)
+
+
+class TestImportRoundtrip:
+    def test_matches_pykan_oracle(self):
+        rng = np.random.default_rng(42)
+        n_in, hidden, n_out, n_layers, grid, k = 5, 7, 3, 2, 6, 2
+        sd = _fake_state_dict(rng, n_in, hidden, n_out, n_layers, grid, k)
+        imported = import_state_dict(sd, tuple("abcde"), ("n", "q_spatial", "p_spatial"))
+        assert (imported.grid, imported.k) == (grid, k)
+        assert imported.hidden_size == hidden
+        assert imported.num_hidden_layers == n_layers
+
+        # Keep hidden activations inside every grid's interior: z-scored-scale inputs
+        # and ±3 grids make boundary-convention differences a non-issue.
+        x = rng.uniform(-0.5, 0.5, (11, n_in)).astype(np.float32)
+        got = imported.model.apply(imported.params, jnp.asarray(x))
+        want = _oracle_forward(sd, x.astype(np.float64), k, n_layers)
+        for i, name in enumerate(("n", "q_spatial", "p_spatial")):
+            np.testing.assert_allclose(
+                np.asarray(got[name]), want[:, i], rtol=2e-4, atol=2e-5
+            )
+
+    def test_roundtrip_through_torch_save(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(3)
+        sd = _fake_state_dict(rng, 4, 6, 2, 1, 5, 3)
+        blob = {
+            "model_state_dict": {key: torch.tensor(v) for key, v in sd.items()},
+            "epoch": 7,
+            "mini_batch": 13,
+        }
+        path = tmp_path / "ckpt.pt"
+        torch.save(blob, path)
+        imported = load_reference_checkpoint(path, tuple("wxyz"), ("n", "q_spatial"))
+        assert (imported.epoch, imported.mini_batch) == (7, 13)
+        x = jnp.asarray(rng.uniform(-0.5, 0.5, (5, 4)), jnp.float32)
+        direct = import_state_dict(sd, tuple("wxyz"), ("n", "q_spatial"))
+        got = imported.model.apply(imported.params, x)
+        want = direct.model.apply(direct.params, x)
+        for name in ("n", "q_spatial"):
+            np.testing.assert_allclose(np.asarray(got[name]), np.asarray(want[name]))
+
+
+class TestValidation:
+    def test_active_symbolic_branch_rejected(self):
+        rng = np.random.default_rng(5)
+        sd = _fake_state_dict(rng, 3, 4, 2, 1, 5, 2)
+        sd["layers.0.symbolic_fun.0.mask"][1, 2] = 1.0
+        with pytest.raises(NotImplementedError, match="symbolic"):
+            import_state_dict(sd, tuple("abc"), ("n", "q_spatial"))
+
+    def test_wrong_input_count_rejected(self):
+        sd = _fake_state_dict(np.random.default_rng(6), 3, 4, 2, 1, 5, 2)
+        with pytest.raises(ValueError, match="inputs"):
+            import_state_dict(sd, ("only", "two"), ("n", "q_spatial"))
+
+    def test_wrong_output_count_rejected(self):
+        sd = _fake_state_dict(np.random.default_rng(7), 3, 4, 2, 1, 5, 2)
+        with pytest.raises(ValueError, match="parameters"):
+            import_state_dict(sd, tuple("abc"), ("n",))
+
+    def test_not_a_kan_state_dict(self):
+        with pytest.raises(ValueError, match="missing"):
+            import_state_dict({"foo": np.zeros(3)}, ("a",), ("n",))
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_PT), reason="reference weights not mounted"
+)
+class TestRealReferenceWeights:
+    def test_shipped_lynker_weights_load_and_run(self):
+        imported = load_reference_checkpoint(REFERENCE_PT, LYNKER_INPUTS, LYNKER_PARAMS)
+        assert imported.hidden_size == 21
+        assert imported.num_hidden_layers == 2
+        assert (imported.grid, imported.k) == (50, 2)
+        assert imported.epoch == 5 and imported.mini_batch == 38
+
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(64, len(LYNKER_INPUTS))), jnp.float32
+        )
+        out = imported.model.apply(imported.params, x)
+        assert set(out) == set(LYNKER_PARAMS)
+        for name in LYNKER_PARAMS:
+            arr = np.asarray(out[name])
+            assert arr.shape == (64,)
+            assert np.all(np.isfinite(arr))
+            assert np.all((arr > 0) & (arr < 1))
+        # Trained weights are not the identity: predictions must vary across inputs.
+        assert np.asarray(out["n"]).std() > 1e-4
