@@ -129,10 +129,26 @@ class ZarrArray(_Node):
         self.dtype = np.dtype(_DTYPE_NAMES[meta["data_type"]])
         self.chunks = tuple(meta["chunk_grid"]["configuration"]["chunk_shape"])
         self.fill_value = _decode_fill(meta.get("fill_value", 0), self.dtype)
+        key_enc = meta.get("chunk_key_encoding", {"name": "default"})
+        sep = key_enc.get("configuration", {}).get("separator", "/")
+        if key_enc.get("name") != "default" or sep != "/":
+            # Refuse rather than silently resolve no chunk files and return fill.
+            raise NotImplementedError(
+                f"chunk_key_encoding {key_enc!r} not supported (default with '/' only)"
+            )
         self._codecs = meta.get("codecs", [{"name": "bytes"}])
+        self._endian = "<"
         for codec in self._codecs:
             if codec["name"] not in ("bytes", "gzip"):
                 raise NotImplementedError(f"codec {codec['name']!r} not supported")
+            if codec["name"] == "bytes":
+                endian = codec.get("configuration", {}).get("endian", "little")
+                if endian not in ("little", "big"):
+                    raise NotImplementedError(
+                        f"bytes codec endian {endian!r} not supported "
+                        "('little' or 'big' only)"
+                    )
+                self._endian = {"little": "<", "big": ">"}[endian]
 
     @property
     def ndim(self) -> int:
@@ -149,11 +165,11 @@ class ZarrArray(_Node):
         for codec in reversed(self._codecs):
             if codec["name"] == "gzip":
                 raw = gzip.decompress(raw)
-        arr = np.frombuffer(raw, dtype=self.dtype.newbyteorder("<"))
+        arr = np.frombuffer(raw, dtype=self.dtype.newbyteorder(self._endian))
         return arr.astype(self.dtype, copy=False).reshape(self.chunks)
 
     def _encode_chunk(self, chunk: np.ndarray) -> bytes:
-        raw = np.ascontiguousarray(chunk, dtype=self.dtype.newbyteorder("<")).tobytes()
+        raw = np.ascontiguousarray(chunk, dtype=self.dtype.newbyteorder(self._endian)).tobytes()
         for codec in self._codecs:
             if codec["name"] == "gzip":
                 raw = gzip.compress(raw, compresslevel=codec.get("configuration", {}).get("level", 5))

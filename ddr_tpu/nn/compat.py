@@ -46,7 +46,10 @@ def pykan_bspline_basis(x: jnp.ndarray, knots: jnp.ndarray, k: int) -> jnp.ndarr
     for d in range(1, k + 1):
         left = (x - knots[:, : -(d + 1)]) / (knots[:, d:-1] - knots[:, : -(d + 1)])
         right = (knots[:, d + 1 :] - x) / (knots[:, d + 1 :] - knots[:, 1:-d])
-        b = left * b[..., :-1] + right * b[..., 1:]
+        # Degenerate (repeated) knots from pykan's percentile-fitted grids make
+        # 0/0 -> inf * b=0 -> NaN terms; pykan zeroes them (B_batch's nan_to_num),
+        # i.e. the standard 0/0 := 0 B-spline convention. Match it.
+        b = jnp.nan_to_num(left * b[..., :-1] + right * b[..., 1:], nan=0.0)
     return b
 
 

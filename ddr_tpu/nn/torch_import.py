@@ -16,7 +16,7 @@ immediately. Checkpoints that enabled pykan's symbolic branch (nonzero
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Mapping
 
@@ -39,7 +39,6 @@ class ImportedKan:
     k: int
     epoch: int | None = None
     mini_batch: int | None = None
-    extras: dict[str, Any] = field(default_factory=dict)
 
 
 def _np(t: Any) -> np.ndarray:
@@ -122,10 +121,17 @@ def import_state_dict(
                 "unfix the symbolic functions in pykan before exporting."
             )
         coef = sd[p + "act_fun.0.coef"]  # (in, out, n_basis)
-        if coef.shape[:2] != (hidden_size, hidden_size):
+        if coef.shape != (hidden_size, hidden_size, n_basis):
             raise ValueError(
-                f"layer {i} coef shape {coef.shape} inconsistent with hidden "
-                f"size {hidden_size}"
+                f"layer {i} coef shape {coef.shape} != expected "
+                f"({hidden_size}, {hidden_size}, {n_basis}); all layers must share "
+                f"layer 0's (grid={grid}, k={k}) — per-layer grid refinement is not "
+                "representable in a single PykanKan"
+            )
+        if sd[p + "act_fun.0.grid"].shape != (hidden_size, n_knots):
+            raise ValueError(
+                f"layer {i} grid shape {sd[p + 'act_fun.0.grid'].shape} != expected "
+                f"({hidden_size}, {n_knots})"
             )
         params[f"layer_{i}"] = {
             "knots": sd[p + "act_fun.0.grid"],

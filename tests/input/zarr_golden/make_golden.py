@@ -1,0 +1,143 @@
+"""Generate the committed zarr-v3 golden store WITHOUT ddr_tpu.io.zarrlite.
+
+Every byte below is derived directly from the zarr v3 core spec
+(https://zarr-specs.readthedocs.io/en/latest/v3/core/v3.0.html): metadata documents
+are hand-built JSON, chunk payloads are C-order ``struct``-packed scalars (not numpy
+``tobytes`` of the arrays under test), and the gzip chunk is compressed with
+``mtime=0`` for reproducibility. ``tests/io/test_zarrlite_interop.py`` then asserts
+that zarrlite reads these bytes to the expected values and writes byte-identical
+chunks for the uncompressed cases — interop evidence that does not depend on the
+implementation it is testing.
+
+Run from the repo root to regenerate:  python tests/input/zarr_golden/make_golden.py
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import struct
+from pathlib import Path
+
+HERE = Path(__file__).parent
+STORE = HERE / "store"
+
+
+def write_json(path: Path, doc: dict) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=2))
+
+
+def array_meta(shape, dtype, chunks, fill, codecs, attributes=None) -> dict:
+    return {
+        "zarr_format": 3,
+        "node_type": "array",
+        "shape": list(shape),
+        "data_type": dtype,
+        "chunk_grid": {"name": "regular", "configuration": {"chunk_shape": list(chunks)}},
+        "chunk_key_encoding": {"name": "default", "configuration": {"separator": "/"}},
+        "fill_value": fill,
+        "codecs": codecs,
+        "attributes": attributes or {},
+    }
+
+
+BYTES_LE = [{"name": "bytes", "configuration": {"endian": "little"}}]
+BYTES_BE = [{"name": "bytes", "configuration": {"endian": "big"}}]
+GZIP5 = BYTES_LE + [{"name": "gzip", "configuration": {"level": 5}}]
+
+
+def main() -> None:
+    write_json(
+        STORE / "zarr.json",
+        {
+            "zarr_format": 3,
+            "node_type": "group",
+            "attributes": {"title": "zarrlite interop golden store", "answer": 42},
+        },
+    )
+
+    # ints: (5, 3) int32 = arange(15) row-major, chunks (2, 2) -> 3x2 chunk grid with
+    # edge chunks padded by fill_value=-1. Chunk (i, j) holds rows 2i..2i+1, cols
+    # 2j..2j+1 of the logical array; payload is C-order over the CHUNK shape.
+    write_json(
+        STORE / "ints" / "zarr.json",
+        array_meta((5, 3), "int32", (2, 2), -1, BYTES_LE, {"role": "edge-chunk case"}),
+    )
+
+    def int_chunk(values):
+        return b"".join(struct.pack("<i", v) for v in values)
+
+    chunks_ints = {
+        (0, 0): [0, 1, 3, 4],
+        (0, 1): [2, -1, 5, -1],
+        (1, 0): [6, 7, 9, 10],
+        (1, 1): [8, -1, 11, -1],
+        (2, 0): [12, 13, -1, -1],
+        (2, 1): [14, -1, -1, -1],
+    }
+    for (i, j), vals in chunks_ints.items():
+        p = STORE / "ints" / "c" / str(i) / str(j)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(int_chunk(vals))
+
+    # floats: (7,) float64 [0.5, -1.5, nan, 3.25, 10.0, -0.125, 2**-40], chunks (4,),
+    # fill NaN, bytes+gzip(level=5). Edge chunk padded with NaN.
+    write_json(
+        STORE / "floats" / "zarr.json",
+        array_meta((7,), "float64", (4,), "NaN", GZIP5),
+    )
+    f_vals = [0.5, -1.5, float("nan"), 3.25, 10.0, -0.125, 2.0**-40]
+
+    def f64_chunk(values):
+        return b"".join(struct.pack("<d", v) for v in values)
+
+    (STORE / "floats" / "c").mkdir(parents=True, exist_ok=True)
+    for i, vals in enumerate([f_vals[:4], f_vals[4:] + [float("nan")]]):
+        payload = gzip.compress(f64_chunk(vals), compresslevel=5, mtime=0)
+        (STORE / "floats" / "c" / str(i)).write_bytes(payload)
+
+    # bige: (3,) int16 BIG-endian bytes codec — legal v3 that a little-endian-only
+    # reader decodes to garbage. Values [1, -2, 300].
+    write_json(STORE / "bige" / "zarr.json", array_meta((3,), "int16", (3,), 0, BYTES_BE))
+    (STORE / "bige" / "c").mkdir(parents=True, exist_ok=True)
+    (STORE / "bige" / "c" / "0").write_bytes(
+        b"".join(struct.pack(">h", v) for v in [1, -2, 300])
+    )
+
+    # flags: (4,) bool [True, False, False, True], one chunk, raw.
+    write_json(STORE / "flags" / "zarr.json", array_meta((4,), "bool", (4,), False, BYTES_LE))
+    (STORE / "flags" / "c").mkdir(parents=True, exist_ok=True)
+    (STORE / "flags" / "c" / "0").write_bytes(bytes([1, 0, 0, 1]))
+
+    # scalar: rank-0 float32 = 6.5; chunk key for rank 0 is just "c".
+    write_json(STORE / "scalar" / "zarr.json", array_meta((), "float32", (), 0.0, BYTES_LE))
+    (STORE / "scalar" / "c").write_bytes(struct.pack("<f", 6.5))
+
+    # sub/missing_chunks: (4,) int64 with NO chunk files -> reads as all fill (=7).
+    write_json(
+        STORE / "sub" / "zarr.json",
+        {"zarr_format": 3, "node_type": "group", "attributes": {}},
+    )
+    write_json(
+        STORE / "sub" / "missing_chunks" / "zarr.json",
+        array_meta((4,), "int64", (4,), 7, BYTES_LE),
+    )
+
+    # Unsupported-but-legal v3 metadata: zarrlite must refuse LOUDLY, never return fill.
+    write_json(
+        STORE / "zstd_codec" / "zarr.json",
+        array_meta(
+            (2,), "int32", (2,), 0,
+            BYTES_LE + [{"name": "zstd", "configuration": {"level": 0, "checksum": False}}],
+        ),
+    )
+    dot = array_meta((2,), "int32", (2,), 0, BYTES_LE)
+    dot["chunk_key_encoding"] = {"name": "default", "configuration": {"separator": "."}}
+    write_json(STORE / "dot_separator" / "zarr.json", dot)
+
+    print(f"golden store written under {STORE}")
+
+
+if __name__ == "__main__":
+    main()

@@ -192,6 +192,29 @@ class TestValidation:
         with pytest.raises(ValueError, match="missing"):
             import_state_dict({"foo": np.zeros(3)}, ("a",), ("n",))
 
+    def test_per_layer_grid_refinement_rejected(self):
+        """Layers refined to different grid resolutions must fail at import, not apply."""
+        rng = np.random.default_rng(8)
+        sd = _fake_state_dict(rng, 3, 4, 2, 2, 5, 2)
+        sd["layers.1.act_fun.0.grid"] = _random_grids(rng, 4, grid=9, k=2)
+        sd["layers.1.act_fun.0.coef"] = rng.normal(size=(4, 4, 11)).astype(np.float32)
+        with pytest.raises(ValueError, match="grid refinement"):
+            import_state_dict(sd, tuple("abc"), ("n", "q_spatial"))
+
+    def test_degenerate_duplicate_knots_stay_finite(self):
+        """pykan's percentile grids can carry repeated knots (tied attribute values);
+        the basis must zero those terms (0/0 := 0) like pykan's nan_to_num, not NaN."""
+        rng = np.random.default_rng(9)
+        sd = _fake_state_dict(rng, 3, 4, 2, 1, 6, 2)
+        grid = sd["layers.0.act_fun.0.grid"]
+        grid[:, 4] = grid[:, 5]  # duplicate an interior knot on every feature
+        grid[1, 2] = grid[1, 3] = grid[1, 4]  # triple knot on one feature
+        imported = import_state_dict(sd, tuple("abc"), ("n", "q_spatial"))
+        x = jnp.asarray(rng.uniform(-0.5, 0.5, (16, 3)), jnp.float32)
+        out = imported.model.apply(imported.params, x)
+        for name in ("n", "q_spatial"):
+            assert np.all(np.isfinite(np.asarray(out[name])))
+
 
 @pytest.mark.skipif(
     not os.path.exists(REFERENCE_PT), reason="reference weights not mounted"
