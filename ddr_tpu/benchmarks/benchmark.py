@@ -26,7 +26,7 @@ from ddr_tpu.io import zarrlite
 from ddr_tpu.routing.mc import GaugeIndex
 from ddr_tpu.routing.model import prepare_batch
 from ddr_tpu.scripts_utils import compute_daily_runoff
-from ddr_tpu.scripts.common import build_kan, evaluate_hourly, get_flow_fn, timed
+from ddr_tpu.scripts.common import build_kan, evaluate_hourly, get_flow_fn, kan_arch, timed
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.metrics import Metrics
 from ddr_tpu.validation.plots import plot_box_fig, plot_cdf
@@ -123,7 +123,7 @@ def benchmark(bench_cfg: BenchmarkConfig) -> dict[str, Metrics]:
     flow = get_flow_fn(cfg, dataset)
     kan_model, params = build_kan(cfg)
     if cfg.experiment.checkpoint:
-        params = load_state(cfg.experiment.checkpoint)["params"]
+        params = load_state(cfg.experiment.checkpoint, expected_arch=kan_arch(cfg))["params"]
     else:
         log.warning("No checkpoint: benchmarking an untrained spatial model")
 

@@ -124,7 +124,7 @@ class DdrBmi:
         from ddr_tpu.geometry.trapezoidal import trapezoidal_geometry
         from ddr_tpu.routing.mc import Bounds, hotstart_discharge, route_step
         from ddr_tpu.routing.model import denormalize_spatial_parameters, prepare_batch
-        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.scripts.common import build_kan, kan_arch
         from ddr_tpu.training import load_state
         from ddr_tpu.validation.configs import load_config
 
@@ -164,7 +164,8 @@ class DdrBmi:
         attrs = jnp.asarray(rd.normalized_spatial_attributes, jnp.float32)
         if self._bmi_cfg.kan_checkpoint is not None:
             params = jax.tree.map(
-                jnp.asarray, load_state(self._bmi_cfg.kan_checkpoint)["params"]
+                jnp.asarray,
+                load_state(self._bmi_cfg.kan_checkpoint, expected_arch=kan_arch(self._cfg))["params"],
             )
         else:
             log.warning("No kan_checkpoint given: routing with randomly-initialized KAN")

@@ -88,9 +88,27 @@ def import_state_dict(
     if not layer_ids:
         raise ValueError("reference kan checkpoint has no hidden KAN layers")
 
+    _LAYER_KEYS = (
+        "act_fun.0.grid", "act_fun.0.coef", "act_fun.0.mask",
+        "act_fun.0.scale_base", "act_fun.0.scale_sp",
+        "subnode_scale_0", "subnode_bias_0", "node_scale_0", "node_bias_0",
+    )
+    for i in layer_ids:
+        absent = [k for k in _LAYER_KEYS if f"layers.{i}.{k}" not in sd]
+        if absent:
+            raise ValueError(
+                f"layers.{i} is not a pykan MultKAN state dict: missing "
+                f"{[f'layers.{i}.{k}' for k in absent]}"
+            )
+
     # Infer grid/k from knot/basis counts: knots = G + 2k + 1, basis = G + k.
     grid0 = sd["layers.0.act_fun.0.grid"]
     coef0 = sd["layers.0.act_fun.0.coef"]
+    if grid0.ndim != 2 or coef0.ndim != 3:
+        raise ValueError(
+            f"layers.0 tensors are not pykan-shaped: grid ndim {grid0.ndim} "
+            f"(want 2), coef ndim {coef0.ndim} (want 3)"
+        )
     n_knots, n_basis = grid0.shape[1], coef0.shape[2]
     k = n_knots - n_basis - 1
     grid = n_basis - k

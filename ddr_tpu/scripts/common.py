@@ -67,10 +67,27 @@ def build_kan(cfg: Config) -> tuple[Kan, Any]:
         num_hidden_layers=cfg.kan.num_hidden_layers,
         grid=cfg.kan.grid,
         k=cfg.kan.k,
+        grid_range=tuple(cfg.kan.grid_range),
     )
     dummy = np.zeros((1, len(cfg.kan.input_var_names)), dtype=np.float32)
     params = model.init(jax.random.key(cfg.seed), dummy)
     return model, params
+
+
+def kan_arch(cfg: Config) -> dict:
+    """Architecture fingerprint stored in / checked against checkpoints
+    (``training.save_state``/``load_state``): same param shapes under a different
+    grid_range or input ordering would silently compute the wrong function."""
+    return {
+        "model": "kan",
+        "input_var_names": list(cfg.kan.input_var_names),
+        "learnable_parameters": list(cfg.kan.learnable_parameters),
+        "hidden_size": cfg.kan.hidden_size,
+        "num_hidden_layers": cfg.kan.num_hidden_layers,
+        "grid": cfg.kan.grid,
+        "k": cfg.kan.k,
+        "grid_range": list(cfg.kan.grid_range),
+    }
 
 
 def get_flow_fn(cfg: Config, dataset: Any) -> Callable[..., np.ndarray]:

@@ -17,7 +17,7 @@ from ddr_tpu.geodatazoo.loader import DataLoader
 from ddr_tpu.io import zarrlite
 from ddr_tpu.routing.model import dmc
 from ddr_tpu.scripts_utils import safe_mean, safe_percentile
-from ddr_tpu.scripts.common import build_kan, get_flow_fn, parse_cli, timed
+from ddr_tpu.scripts.common import build_kan, get_flow_fn, kan_arch, parse_cli, timed
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.configs import Config
 from ddr_tpu.validation.plots import plot_routing_hydrograph
@@ -53,7 +53,7 @@ def route_domain(cfg: Config, dataset=None, params=None) -> np.ndarray:
     kan_model, fresh = build_kan(cfg)
     if params is None:
         if cfg.experiment.checkpoint:
-            params = load_state(cfg.experiment.checkpoint)["params"]
+            params = load_state(cfg.experiment.checkpoint, expected_arch=kan_arch(cfg))["params"]
         else:
             log.warning("Routing with an untrained spatial model.")
             params = fresh

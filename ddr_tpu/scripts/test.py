@@ -13,7 +13,7 @@ import numpy as np
 
 from ddr_tpu.io import zarrlite
 from ddr_tpu.scripts_utils import compute_daily_runoff
-from ddr_tpu.scripts.common import build_kan, evaluate_hourly, get_flow_fn, parse_cli, timed
+from ddr_tpu.scripts.common import build_kan, evaluate_hourly, get_flow_fn, kan_arch, parse_cli, timed
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.configs import Config
 from ddr_tpu.validation.metrics import Metrics
@@ -29,7 +29,7 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
     kan_model, fresh = build_kan(cfg)
     if params is None:
         if cfg.experiment.checkpoint:
-            params = load_state(cfg.experiment.checkpoint)["params"]
+            params = load_state(cfg.experiment.checkpoint, expected_arch=kan_arch(cfg))["params"]
         else:
             log.warning("Creating new spatial model for evaluation.")
             params = fresh

@@ -20,6 +20,7 @@ from ddr_tpu.scripts.common import (
     build_kan,
     daily_observation_targets,
     get_flow_fn,
+    kan_arch,
     parse_cli,
     timed,
 )
@@ -56,7 +57,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
     start_epoch, start_mini_batch, blob = 1, 0, None
     if cfg.experiment.checkpoint:
-        blob = load_state(cfg.experiment.checkpoint)
+        blob = load_state(cfg.experiment.checkpoint, expected_arch=kan_arch(cfg))
         params = blob["params"]
         start_epoch = blob["epoch"]
         start_mini_batch = 0 if blob["mini_batch"] == 0 else blob["mini_batch"] + 1
@@ -145,6 +146,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     params,
                     opt_state,
                     rng_state=loader.state(),
+                    arch=kan_arch(cfg),
                 )
                 n_done += 1
                 if max_batches is not None and n_done >= max_batches:

@@ -154,8 +154,10 @@ def make_batch_train_step(
 
 # Bump when the checkpoint blob layout changes; load_state refuses mismatches with
 # a clear error instead of failing cryptically mid-restore.
+# v2: adds "arch" (the hyperparameters the params were trained under, e.g. KAN
+# grid_range) so params cannot silently be evaluated under a different architecture.
 CHECKPOINT_FORMAT = "ddr-tpu-checkpoint"
-CHECKPOINT_VERSION = 1
+CHECKPOINT_VERSION = 2
 
 
 def save_state(
@@ -166,10 +168,12 @@ def save_state(
     params: Any,
     opt_state: Any,
     rng_state: Any = None,
+    arch: dict | None = None,
 ) -> Path:
     """Mid-epoch resumable checkpoint (reference validation/utils.py:12-78): model
     params, optimizer state, and data-sampling RNG state, named
-    ``_{name}_epoch_{E}_mb_{B}.pkl``."""
+    ``_{name}_epoch_{E}_mb_{B}.pkl``. ``arch`` records the architecture
+    hyperparameters the params assume; ``load_state`` cross-checks it."""
     save_dir = Path(save_dir)
     save_dir.mkdir(parents=True, exist_ok=True)
     path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.pkl"
@@ -181,16 +185,19 @@ def save_state(
         "params": jax.device_get(params),
         "opt_state": jax.device_get(opt_state),
         "rng_state": rng_state,
+        "arch": arch,
     }
     with path.open("wb") as f:
         pickle.dump(blob, f)
     return path
 
 
-def load_state(path: str | Path) -> dict:
+def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
     """Load and schema-check a checkpoint blob (reference
     scripts_utils.load_checkpoint:45-73). Raises ``ValueError`` on corrupt,
-    foreign, or version-mismatched blobs."""
+    foreign, version-mismatched, or — when both the blob and the caller state an
+    architecture — architecture-mismatched blobs (a KAN trained under one
+    ``grid_range`` evaluates to garbage under another, with identical param shapes)."""
     path = Path(path)
     try:
         with path.open("rb") as f:
@@ -210,6 +217,17 @@ def load_state(path: str | Path) -> dict:
     missing = {"epoch", "mini_batch", "params", "opt_state"} - blob.keys()
     if missing:
         raise ValueError(f"checkpoint {path} missing fields: {sorted(missing)}")
+    saved_arch = blob.get("arch")
+    if expected_arch is not None and saved_arch is not None and saved_arch != expected_arch:
+        diff = {
+            key: (saved_arch.get(key), expected_arch.get(key))
+            for key in set(saved_arch) | set(expected_arch)
+            if saved_arch.get(key) != expected_arch.get(key)
+        }
+        raise ValueError(
+            f"checkpoint {path} was trained under a different architecture; "
+            f"mismatched fields (saved, expected): {diff}"
+        )
     return blob
 
 

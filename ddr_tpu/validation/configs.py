@@ -14,6 +14,7 @@ configs can be built before data stores exist.
 from __future__ import annotations
 
 import logging
+import math
 import random
 from pathlib import Path
 from typing import Any
@@ -87,6 +88,20 @@ class Kan(BaseModel):
     learnable_parameters: list[str] = Field(default_factory=lambda: ["n", "q_spatial"])
     grid: int = 3
     k: int = 3
+    grid_range: list[float] = Field(
+        default_factory=lambda: [-2.0, 2.0],
+        description="Spline support [lo, hi] for z-scored inputs (ddr_tpu extension; "
+        "the reference relies on pykan's data-adaptive grids instead)",
+    )
+
+    @field_validator("grid_range")
+    @classmethod
+    def _grid_range_valid(cls, v: list[float]) -> list[float]:
+        if len(v) != 2 or not all(math.isfinite(b) for b in v) or not v[0] < v[1]:
+            raise ValueError(
+                f"grid_range must be finite [lo, hi] with lo < hi, got {v}"
+            )
+        return v
 
 
 class ExperimentConfig(BaseModel):

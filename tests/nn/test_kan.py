@@ -80,3 +80,93 @@ class TestKan:
         p_zero["params"]["spline_coef"] = jnp.zeros_like(p["params"]["spline_coef"])
         base_only = layer.apply(p_zero, x)
         assert float(jnp.abs(full - base_only).max()) > 1e-4
+
+
+class TestGridRange:
+    """Spline-support coverage for z-scored inputs (the pykan-adaptive-grid gap)."""
+
+    @staticmethod
+    def _fit_rmse(grid_range, seed=0, steps=400):
+        """Train a 2-layer KAN stack on a smooth function of N(0,1) inputs."""
+        import optax
+
+        rng = np.random.default_rng(seed)
+        X = jnp.asarray(rng.normal(size=(1024, 3)), jnp.float32)
+        Xte = jnp.asarray(rng.normal(size=(512, 3)), jnp.float32)
+
+        def f(x):
+            return (
+                jnp.sin(1.5 * x[:, 0]) + 0.5 * jnp.tanh(2 * x[:, 1]) + 0.3 * x[:, 2] ** 2
+            )[:, None]
+
+        Y, Yte = f(X), f(Xte)
+
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = KANLayer(8, grid_size=3, spline_order=3, grid_range=grid_range)(x)
+                return KANLayer(1, grid_size=3, spline_order=3, grid_range=grid_range)(x)
+
+        net = Net()
+        params = net.init(jax.random.key(seed), X[:2])
+        opt = optax.adam(3e-3)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            loss, g = jax.value_and_grad(
+                lambda p: jnp.mean((net.apply(p, X) - Y) ** 2)
+            )(p)
+            updates, s = opt.update(g, s)
+            return optax.apply_updates(p, updates), s, loss
+
+        for _ in range(steps):
+            params, state, _ = step(params, state)
+        return float(jnp.sqrt(jnp.mean((net.apply(params, Xte) - Yte) ** 2)))
+
+    def test_default_range_covers_spline_input_bulk(self):
+        """Coverage measured on what the splines actually see: the Dense projection
+        of z-scored inputs (std ~1.4 under kaiming init). The (-2,2) default covers
+        ~86% of that mass; the old (-1,1) support covered only ~55%."""
+        model, params, x = _make()
+        _, inter = model.apply(params, x, capture_intermediates=True)
+        h = np.asarray(inter["intermediates"]["Dense_0"]["__call__"][0])
+        lo, hi = model.grid_range
+        frac_default = float(np.mean((h >= lo) & (h <= hi)))
+        frac_narrow = float(np.mean((h >= -1.0) & (h <= 1.0)))
+        assert frac_default > 0.8, frac_default
+        assert frac_narrow < 0.65, frac_narrow
+
+    def test_default_beats_narrow_and_wide(self):
+        """The (-2,2) default fits a smooth function of z-scored inputs strictly
+        better than the pykan-static (-1,1) support (tails go spline-less) AND a
+        (-4,4) support (resolution diluted where the data lives). Measured margins
+        are ~35%/55%; asserted at 10% to absorb seed sensitivity."""
+        rmse_default = self._fit_rmse((-2.0, 2.0))
+        rmse_narrow = self._fit_rmse((-1.0, 1.0))
+        rmse_wide = self._fit_rmse((-4.0, 4.0))
+        assert rmse_default < rmse_narrow * 0.9, (rmse_default, rmse_narrow)
+        assert rmse_default < rmse_wide * 0.9, (rmse_default, rmse_wide)
+
+    def test_grid_range_plumbs_from_config(self):
+        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.validation.configs import Config
+
+        cfg = Config(
+            name="t", geodataset="synthetic", mode="routing",
+            kan={"input_var_names": ["a", "b"], "grid_range": [-4.0, 4.0]},
+        )
+        model, _ = build_kan(cfg)
+        assert model.grid_range == (-4.0, 4.0)
+
+    def test_invalid_grid_range_rejected(self):
+        import pytest
+        from ddr_tpu.validation.configs import Config
+
+        with pytest.raises(Exception, match="grid_range"):
+            Config(
+                name="t", geodataset="synthetic", mode="routing",
+                kan={"input_var_names": ["a"], "grid_range": [2.0, -2.0]},
+            )

@@ -192,6 +192,21 @@ class TestValidation:
         with pytest.raises(ValueError, match="missing"):
             import_state_dict({"foo": np.zeros(3)}, ("a",), ("n",))
 
+    def test_plain_mlp_layers_rejected_with_valueerror(self):
+        """An ordinary torch MLP ('layers.0.weight') must fail the documented way
+        (ValueError), not with a raw KeyError mid-mapping."""
+        rng = np.random.default_rng(11)
+        sd = {
+            "input.weight": rng.normal(size=(4, 3)).astype(np.float32),
+            "input.bias": np.zeros(4, np.float32),
+            "output.weight": rng.normal(size=(2, 4)).astype(np.float32),
+            "output.bias": np.zeros(2, np.float32),
+            "layers.0.weight": rng.normal(size=(4, 4)).astype(np.float32),
+            "layers.0.bias": np.zeros(4, np.float32),
+        }
+        with pytest.raises(ValueError, match="not a pykan"):
+            import_state_dict(sd, tuple("abc"), ("n", "q_spatial"))
+
     def test_per_layer_grid_refinement_rejected(self):
         """Layers refined to different grid resolutions must fail at import, not apply."""
         rng = np.random.default_rng(8)

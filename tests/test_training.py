@@ -171,3 +171,60 @@ class TestCheckpointSchema:
             pickle.dump({"format": CHECKPOINT_FORMAT, "version": CHECKPOINT_VERSION}, f)
         with pytest.raises(ValueError, match="missing fields"):
             load_state(p)
+
+    def test_arch_mismatch_raises(self, tmp_path):
+        """Same param shapes under a different grid_range compute a different
+        function; the blob's arch fingerprint must refuse the cross-load."""
+        import pytest
+
+        from ddr_tpu.training import load_state, save_state
+
+        arch = {"model": "kan", "grid_range": [-1.0, 1.0], "grid": 3}
+        p = save_state(
+            tmp_path, "t", epoch=1, mini_batch=0, params={"w": 1.0}, opt_state={}, arch=arch
+        )
+        # same arch loads fine
+        assert load_state(p, expected_arch=dict(arch))["params"] == {"w": 1.0}
+        # and with no expectation stated, loads fine (inference-only tools)
+        assert load_state(p)["arch"] == arch
+        with pytest.raises(ValueError, match="grid_range"):
+            load_state(
+                p, expected_arch={"model": "kan", "grid_range": [-2.0, 2.0], "grid": 3}
+            )
+
+    def test_archless_blob_loads_with_expectation(self, tmp_path):
+        """A v2 blob saved without arch (non-KAN producers) never hard-fails."""
+        from ddr_tpu.training import load_state, save_state
+
+        p = save_state(tmp_path, "t", epoch=1, mini_batch=0, params={}, opt_state={})
+        assert load_state(p, expected_arch={"model": "kan"})["arch"] is None
+
+    def test_train_checkpoints_carry_kan_arch(self, tmp_path):
+        """End-to-end: ddr train writes blobs whose arch matches the config, and
+        resuming under an edited grid_range refuses."""
+        import pytest
+
+        from ddr_tpu.scripts.common import kan_arch
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.training import load_state
+        from ddr_tpu.validation.configs import Config
+
+        cfg = Config(
+            name="archck", geodataset="synthetic", mode="training",
+            kan={"input_var_names": [f"a{i}" for i in range(10)]},
+            experiment={
+                "start_time": "1981/10/01", "end_time": "1981/11/30",
+                "epochs": 1, "batch_size": 2, "rho": 5, "warmup": 1,
+            },
+            params={"save_path": tmp_path},
+        )
+        train(cfg, max_batches=1)
+        ckpts = sorted((tmp_path / "saved_models").glob("*.pkl"))
+        assert ckpts, "training wrote no checkpoint"
+        assert load_state(ckpts[0])["arch"] == kan_arch(cfg)
+
+        cfg2 = cfg.model_copy(deep=True)
+        cfg2.kan.grid_range = [-3.0, 3.0]
+        cfg2.experiment.checkpoint = ckpts[0]
+        with pytest.raises(ValueError, match="different architecture"):
+            train(cfg2, max_batches=1)
