@@ -59,10 +59,12 @@ def bench_route(n: int, t_hours: int) -> float:
 
     fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
     fn(q_prime).block_until_ready()  # compile
-    reps = 3
+    # Queue all reps, block once: a blocking sync through the axon tunnel costs
+    # ~70ms of poll latency, which is device-idle time, not device throughput.
+    reps = 5
     t0 = time.perf_counter()
-    for _ in range(reps):
-        fn(q_prime).block_until_ready()
+    outs = [fn(q_prime) for _ in range(reps)]
+    jax.block_until_ready(outs)
     dt = (time.perf_counter() - t0) / reps
     return n * t_hours / dt
 

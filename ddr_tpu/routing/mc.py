@@ -240,6 +240,7 @@ def route(
     gauges: GaugeIndex | None = None,
     bounds: Bounds = Bounds(),
     dt: float = DT_SECONDS,
+    engine: str | None = None,
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -264,26 +265,63 @@ def route(
     On a fused network, every per-reach array is permuted into level-contiguous
     order ONCE here; the whole scan then runs scatter-free in permuted space and
     only the outputs are mapped back.
+
+    ``engine`` selects the schedule: ``"wavefront"`` (time-skewed, T + depth waves
+    — :mod:`ddr_tpu.routing.wavefront`), ``"step"`` (per-timestep scan), or ``None``
+    to auto-select wavefront whenever the network carries its tables.
     """
     n_mann = spatial_params["n"]
     q_spatial = spatial_params["q_spatial"]
     p_spatial = spatial_params["p_spatial"]
 
-    permuted = network.fused
-    if permuted:
-        p = network.perm
+    def _permute_physics(p):
+        """Per-reach physics arrays re-ordered by a node permutation ``p``."""
 
-        def _p(a):
+        def _g(a):
             return a if (a is None or jnp.ndim(a) == 0) else a[p]
 
-        channels = ChannelState(
+        ch = ChannelState(
             length=channels.length[p],
             slope=channels.slope[p],
             x_storage=channels.x_storage[p],
-            top_width_data=_p(channels.top_width_data),
-            side_slope_data=_p(channels.side_slope_data),
+            top_width_data=_g(channels.top_width_data),
+            side_slope_data=_g(channels.side_slope_data),
         )
-        n_mann, q_spatial, p_spatial = _p(n_mann), _p(q_spatial), _p(p_spatial)
+        return ch, _g(n_mann), _g(q_spatial), _g(p_spatial)
+
+    if engine is None:
+        engine = "wavefront" if (network.wavefront and q_prime.shape[0] >= 2) else "step"
+    if engine == "wavefront":
+        if not network.wavefront:
+            raise ValueError("network was built without wavefront tables")
+        if q_init is None:
+            q0 = hotstart_discharge(network, q_prime[0], bounds.discharge)
+        else:
+            q0 = jnp.maximum(q_init, bounds.discharge)
+
+        # Physics closures run inside the wave scan in wf_perm (bucket) order.
+        channels_p, n_mann_p, q_spatial_p, p_spatial_p = _permute_physics(network.wf_perm)
+
+        def celerity_fn(q_prev):
+            return celerity(q_prev, n_mann_p, p_spatial_p, q_spatial_p, channels_p, bounds)[0]
+
+        def coefficients_fn(c):
+            return muskingum_coefficients(channels_p.length, c, channels_p.x_storage, dt)
+
+        from ddr_tpu.routing.wavefront import wavefront_route_core
+
+        runoff_full, q_final = wavefront_route_core(
+            network, celerity_fn, coefficients_fn, q_prime, q0, bounds.discharge
+        )
+        runoff = jax.vmap(gauges.aggregate)(runoff_full) if gauges is not None else runoff_full
+        return RouteResult(runoff=runoff, final_discharge=q_final)
+    if engine != "step":
+        raise ValueError(f"unknown engine {engine!r} (use 'wavefront' or 'step')")
+
+    permuted = network.fused
+    if permuted:
+        p = network.perm
+        channels, n_mann, q_spatial, p_spatial = _permute_physics(p)
         q_prime = q_prime[:, p]
         if q_init is not None:
             q_init = q_init[p]

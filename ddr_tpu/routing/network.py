@@ -77,6 +77,21 @@ class RiverNetwork:
     n_edges: int = dataclasses.field(metadata={"static": True})
     level_starts: tuple = dataclasses.field(default=(), metadata={"static": True})
     fused: bool = dataclasses.field(default=False, metadata={"static": True})
+    # Wavefront (time-skewed) schedule tables (ddr_tpu.routing.wavefront).
+    # ``level``: longest-path level per node, original order. Nodes are re-ordered
+    # by in-degree bucket (``wf_perm``/``wf_inv``) so the per-wave history gather
+    # carries no padding: ``wf_idx`` is the flat ring index per (node, predecessor)
+    # slot, bucket-concatenated; ``wf_mask`` zeroes the few intra-bucket pad slots;
+    # ``wf_buckets`` is the static ((node_start, node_end, width), ...) layout.
+    level: jnp.ndarray = dataclasses.field(default_factory=lambda: jnp.zeros(0, jnp.int32))
+    wf_perm: jnp.ndarray = dataclasses.field(default_factory=lambda: jnp.zeros(0, jnp.int32))
+    wf_inv: jnp.ndarray = dataclasses.field(default_factory=lambda: jnp.zeros(0, jnp.int32))
+    wf_idx: jnp.ndarray = dataclasses.field(default_factory=lambda: jnp.zeros(0, jnp.int32))
+    wf_mask: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.zeros(0, jnp.float32)
+    )
+    wf_buckets: tuple = dataclasses.field(default=(), metadata={"static": True})
+    wavefront: bool = dataclasses.field(default=False, metadata={"static": True})
 
     def upstream_sum(self, x: jnp.ndarray) -> jnp.ndarray:
         """Sparse mat-vec ``N @ x``: sum of upstream values per reach (original order).
@@ -193,6 +208,12 @@ FUSED_MAX_IN_DEGREE = 8
 FUSED_MAX_OUT_DEGREE = 4
 FUSED_MAX_DEPTH = 512
 
+# Wavefront-schedule limits: the (depth + 2, n + 1) rolling history buffer and the
+# (n, max_in_degree) gather tables must stay modest; beyond these the time-skewed
+# engine falls back to the per-timestep schedules.
+WAVEFRONT_MAX_IN_DEGREE = 64
+WAVEFRONT_MAX_DEPTH = 1024
+
 
 def _padded_adjacency_table(
     point: np.ndarray, neighbor: np.ndarray, n: int, width: int
@@ -206,6 +227,62 @@ def _padded_adjacency_table(
     col = np.arange(len(pt)) - starts[:-1].repeat(counts)
     table[pt, col] = nb
     return table
+
+
+def _wavefront_tables(
+    rows: np.ndarray, cols: np.ndarray, n: int, level: np.ndarray, in_deg: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, tuple]:
+    """Degree-bucketed gather layout for the wavefront engine.
+
+    TPU gathers cost ~constant per INDEX (measured ~7ns), so the (n, max_in) padded
+    table wastes most of the gather on sentinel slots when the mean in-degree (~1 for
+    river networks) is far below the max. Nodes are re-ordered by power-of-two
+    in-degree bucket; each bucket's slots are exactly its width, so total gathered
+    indices <= 2 * n_edges. Slot values are flat indices into the history ring
+    ``H.reshape(-1)`` of shape (depth + 2, n + 1): slot for edge p -> i is
+    ``(gap - 1) * (n + 1) + p_permuted`` with gap = level[i] - level[p]; pad slots
+    point at the always-zero sentinel column (ring row 0, col n).
+    """
+    order = np.argsort(in_deg, kind="stable")  # deg-0 first, then ascending
+    inv = np.empty(n, dtype=np.int64)
+    inv[order] = np.arange(n)
+
+    deg_sorted = in_deg[order]
+    # preds per node (original ids), grouped by target
+    e_order = np.argsort(rows, kind="stable")
+    e_tgt, e_src = rows[e_order], cols[e_order]
+    tgt_starts = np.searchsorted(e_tgt, np.arange(n + 1))
+
+    idx_parts: list[np.ndarray] = []
+    mask_parts: list[np.ndarray] = []
+    buckets: list[tuple[int, int, int]] = []
+    row_len = n + 1
+    pos = int(np.searchsorted(deg_sorted, 1))  # first node with in-degree >= 1
+    while pos < n:
+        d = int(deg_sorted[pos])
+        width = 1 << (d - 1).bit_length()  # next pow2 >= d
+        end = int(np.searchsorted(deg_sorted, width + 1))
+        cnt = end - pos
+        tbl = np.full((cnt, width), row_len - 1, dtype=np.int64)  # sentinel: row0,col n
+        msk = np.zeros((cnt, width), dtype=np.float32)
+        nodes = order[pos:end]
+        starts, ends_ = tgt_starts[nodes], tgt_starts[nodes + 1]
+        counts = ends_ - starts
+        flat = _ranges(starts, ends_)  # all non-empty: every node here has deg >= 1
+        row_pos = np.repeat(np.arange(cnt), counts)
+        col_pos = np.arange(len(flat)) - np.repeat(np.cumsum(counts) - counts, counts)
+        preds = e_src[flat]
+        gaps = level[np.repeat(nodes, counts)] - level[preds]
+        tbl[row_pos, col_pos] = (gaps - 1) * row_len + inv[preds]
+        msk[row_pos, col_pos] = 1.0
+        idx_parts.append(tbl.reshape(-1))
+        mask_parts.append(msk.reshape(-1))
+        buckets.append((pos, end, width))
+        pos = end
+
+    wf_idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, dtype=np.int64)
+    wf_mask = np.concatenate(mask_parts) if mask_parts else np.zeros(0, dtype=np.float32)
+    return order, inv, wf_idx, wf_mask, tuple(buckets)
 
 
 def build_network(
@@ -239,8 +316,9 @@ def build_network(
             f"network exceeds fused-schedule limits (depth={depth}, in={max_in}, out={max_out})"
         )
 
+    level = compute_levels(rows, cols, n) if n else np.zeros(0, dtype=np.int32)
+
     if fused:
-        level = compute_levels(rows, cols, n)
         perm = np.lexsort((np.arange(n), level))  # level-major, stable within level
         inv = np.empty(n, dtype=np.int64)
         inv[perm] = np.arange(n)
@@ -253,6 +331,23 @@ def build_network(
         perm = inv = np.zeros(0, dtype=np.int64)
         pred = down = np.zeros((0, 1), dtype=np.int64)
         level_starts = ()
+
+    wavefront = (
+        0 < depth <= WAVEFRONT_MAX_DEPTH
+        and 0 < max_in <= WAVEFRONT_MAX_IN_DEGREE
+        # Flat ring indices ((gap-1)*(n+1)+col, gap <= depth) must fit int32; beyond
+        # this the cast would wrap negative and XLA's index clamping would silently
+        # read wrong history slots.
+        and (depth + 2) * (n + 1) < 2**31
+    )
+    if wavefront:
+        wf_perm, wf_inv, wf_idx, wf_mask, wf_buckets = _wavefront_tables(
+            rows, cols, n, level, in_deg
+        )
+    else:
+        wf_perm = wf_inv = wf_idx = np.zeros(0, dtype=np.int64)
+        wf_mask = np.zeros(0, dtype=np.float32)
+        wf_buckets = ()
 
     return RiverNetwork(
         edge_src=jnp.asarray(cols, dtype=jnp.int32),
@@ -268,4 +363,11 @@ def build_network(
         n_edges=int(rows.size),
         level_starts=level_starts,
         fused=bool(fused),
+        level=jnp.asarray(level, dtype=jnp.int32),
+        wf_perm=jnp.asarray(wf_perm, dtype=jnp.int32),
+        wf_inv=jnp.asarray(wf_inv, dtype=jnp.int32),
+        wf_idx=jnp.asarray(wf_idx, dtype=jnp.int32),
+        wf_mask=jnp.asarray(wf_mask, dtype=jnp.float32),
+        wf_buckets=wf_buckets,
+        wavefront=bool(wavefront),
     )

@@ -1,0 +1,150 @@
+"""Time-skewed wavefront routing engine: T + depth waves instead of T x depth steps.
+
+The per-timestep engines (ddr_tpu.routing.mc.route's scan over ``route_step``) pay
+``T * depth`` sequential dependencies: each hourly step runs a level sweep whose
+per-level gather/scatter is tiny, so the chip idles on fixed per-op cost — measured
+88% of route() runtime at N=8192 (docs/tpu.md has the ablation).
+
+This module reschedules the SAME arithmetic on anti-diagonals of the (timestep,
+level) grid. Reach ``i`` at longest-path level ``L(i)`` computes its timestep-``t``
+value at wave ``w = t + L(i)``; its dependencies —
+
+    x_t[i] = b_t(i) + c1_t(i) * sum_p x_t[p]              (same-timestep solve)
+    b_t(i) = c2*sum_p max(x_{t-1}[p], lb) + c3*x_{t-1}[i] + c4*q'_{t-1}[i]
+    c*_t(i) from celerity(max(x_{t-1}[i], lb))
+
+— were all produced at strictly earlier waves, so every wave updates ALL N reaches
+at once (each for a different in-flight timestep) and the whole route is
+``T - 1 + depth`` fully-vectorized waves.
+
+TPU cost shaping (each documented by measurement in docs/tpu.md):
+
+* ONE history gather per wave. TPU gathers cost ~7ns per index, so they are the
+  budget. The same gathered predecessor values serve both the same-timestep solve
+  sum (raw) and the NEXT wave's previous-timestep inflow sum (clamped) — the inflow
+  a reach needs at wave w+1 is exactly what its solve gather read at wave w, carried
+  as a per-reach running sum instead of re-gathered.
+* Degree-bucketed compact tables (RiverNetwork.wf_*): gathered indices ~ n_edges,
+  not n * max_in_degree.
+* Clamp semantics match route_step / the reference (clamp ONCE after the full
+  solve): the ring stores raw solve values; clamps happen at previous-timestep read
+  sites and on emission.
+* The time-skew applied to inputs (``qs[w, i] = q'[w - 1 - L(i), i]``) and outputs
+  (``x_t[i] = ys[t + L(i) - 1, i]``) is expressed as per-node dynamic slices of
+  time-contiguous rows (cost ~ per node), never as (T, N) element gathers (cost ~
+  per element, ~100x more).
+
+This is a schedule change only: per-reach arithmetic and predecessor summation
+order match ``mc.route_step`` (reference semantics:
+/root/reference/src/ddr/routing/mmc.py:365-443,487-559), so results agree to float
+associativity. Differentiable with standard JAX AD through the scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddr_tpu.routing.network import RiverNetwork
+
+__all__ = ["wavefront_route_core"]
+
+
+def _shift_rows(rows: jnp.ndarray, starts: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Per-row dynamic slice: out[i] = rows[i, starts[i] : starts[i] + width]."""
+    return jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(row, (s,), (width,))
+    )(rows, starts)
+
+
+def wavefront_route_core(
+    network: RiverNetwork,
+    celerity_fn,
+    coefficients_fn,
+    q_prime: jnp.ndarray,
+    q0: jnp.ndarray,
+    discharge_lb: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Route timesteps 1..T-1 by wavefront; returns (runoff (T, N), final (N,)).
+
+    ``celerity_fn(q_prev) -> c`` and ``coefficients_fn(c) -> (c1, c2, c3, c4)``
+    close over per-reach channels/params ALREADY PERMUTED by ``network.wf_perm``
+    (the caller does this once; see mc.route). ``q_prime`` (T, N) and ``q0`` (N,)
+    arrive in original order; outputs are returned in original order.
+    """
+    T, n = q_prime.shape
+    depth = network.depth
+    if T < 2:
+        return q0[None, :][:T], q0
+
+    perm, inv = network.wf_perm, network.wf_inv
+    level_p = network.level[perm]  # (N,) levels in bucket order
+    n_waves = (T - 1) + depth
+    row_len = n + 1
+    q0p = q0[perm]
+
+    # Input skew, slice-based: node i's wave series is its q' row shifted by L(i).
+    # Only q'[0 .. T-2] feeds steps; out-of-range waves clamp to the edge columns
+    # (their outputs are masked anyway).
+    qT = q_prime.T[perm][:, : T - 1]  # (N, T-1)
+    padded = jnp.concatenate(
+        [
+            jnp.repeat(qT[:, :1], depth, axis=1),
+            qT,
+            jnp.repeat(qT[:, -1:], depth, axis=1),
+        ],
+        axis=1,
+    )
+    qs = _shift_rows(padded, depth - level_p, n_waves).T  # (W, N)
+    qs = jnp.maximum(qs, discharge_lb)
+
+    # Previous-timestep inflow sums for wave 1: sum_p x_0[p] (q0 is already clamped).
+    s_init = network.upstream_sum(q0)[perm]
+
+    q0_pad = jnp.concatenate([q0p, jnp.zeros(1, q0.dtype)])
+    ring0 = jnp.broadcast_to(q0_pad, (depth + 2, row_len))
+
+    wf_idx, wf_mask, buckets = network.wf_idx, network.wf_mask, network.wf_buckets
+    n_deg0 = buckets[0][0] if buckets else n
+
+    def reduce_buckets(gathered: jnp.ndarray, clamped: bool) -> jnp.ndarray:
+        """Per-node sums from the flat bucket-concatenated gather."""
+        parts = [jnp.zeros(n_deg0, gathered.dtype)]
+        off = 0
+        for node_start, node_end, width in buckets:
+            cnt = (node_end - node_start) * width
+            blk = gathered[off : off + cnt].reshape(node_end - node_start, width)
+            if clamped:
+                msk = wf_mask[off : off + cnt].reshape(blk.shape)
+                blk = jnp.maximum(blk, discharge_lb) * msk
+            parts.append(blk.sum(axis=1))
+            off += cnt
+        return jnp.concatenate(parts)
+
+    def body(carry, wave_inputs):
+        ring, s_state = carry
+        q_prime_prev, w = wave_inputs
+        q_prev = jnp.maximum(ring[0, :n], discharge_lb)  # clamped x_{t-1}[i]
+        c = celerity_fn(q_prev)
+        c1, c2, c3, c4 = coefficients_fn(c)
+        gathered = ring.reshape(-1)[wf_idx]  # THE gather: raw x_t[p] per edge slot
+        x_pred = reduce_buckets(gathered, clamped=False)
+        s_next = reduce_buckets(gathered, clamped=True)  # wave w+1's inflow sums
+        b = c2 * s_state + c3 * q_prev + c4 * q_prime_prev
+        y = b + c1 * x_pred  # raw solve value: downstream consumers read this
+        # Outside the valid (t, L) region keep the initial state: early slots must
+        # read as x_0 (correctness), late slots must stay finite (hygiene).
+        ok = (w > level_p) & (w <= level_p + (T - 1))
+        y = jnp.where(ok, y, q0p)
+        ring = jnp.concatenate(
+            [jnp.concatenate([y, jnp.zeros(1, y.dtype)])[None], ring[:-1]], axis=0
+        )
+        return (ring, s_next), jnp.maximum(y, discharge_lb)
+
+    waves = jnp.arange(1, n_waves + 1)
+    (_, _), ys = jax.lax.scan(body, (ring0, s_init), (qs, waves))  # ys: (W, N)
+
+    # Un-skew + un-permute, slice-based: x_t[i] sits at ys[t + L(i) - 1, i].
+    routed = _shift_rows(ys.T, level_p, T - 1)[inv].T  # (T-1, N) original order
+    runoff = jnp.concatenate([q0[None, :], routed], axis=0)
+    return runoff, routed[-1]

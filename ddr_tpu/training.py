@@ -209,10 +209,19 @@ def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
             f"{path} is not a ddr-tpu checkpoint (missing format marker; "
             "pre-versioning blobs must be re-saved)"
         )
-    if blob.get("version") != CHECKPOINT_VERSION:
+    version = blob.get("version")
+    if version == 1 and expected_arch is not None:
+        # v1 blobs predate the arch fingerprint, so an arch-stating caller (KAN
+        # loaders) cannot verify e.g. grid_range compatibility — refuse rather than
+        # silently compute a different function with identically-shaped params.
         raise ValueError(
-            f"checkpoint {path} has version {blob.get('version')}, "
-            f"this build reads version {CHECKPOINT_VERSION}"
+            f"checkpoint {path} is version 1 (no architecture fingerprint); this "
+            "loader requires one — re-save the checkpoint with the current build"
+        )
+    if version not in (1, CHECKPOINT_VERSION):
+        raise ValueError(
+            f"checkpoint {path} has version {version}, "
+            f"this build reads versions 1 (arch-less loads only) and {CHECKPOINT_VERSION}"
         )
     missing = {"epoch", "mini_batch", "params", "opt_state"} - blob.keys()
     if missing:

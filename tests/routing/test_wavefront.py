@@ -1,0 +1,200 @@
+"""Wavefront (time-skewed) engine: parity against the per-timestep engine.
+
+The wavefront schedule must be a pure re-ordering of route_step's arithmetic —
+identical physics, identical predecessor sums — so every test here pins it against
+engine="step" on the same inputs, including gradients (standard AD through the
+wave scan vs the step engine's custom-VJP solver)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin
+from ddr_tpu.routing.mc import Bounds, route
+from ddr_tpu.routing.model import prepare_batch
+from ddr_tpu.routing.network import build_network
+
+
+def _setup(n=512, t=48, seed=0):
+    basin = make_basin(n_segments=n, n_gauges=4, n_days=max(2, -(-t // 24)), seed=seed)
+    network, channels, gauges = prepare_batch(basin.routing_data, 1e-4)
+    params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+    q_prime = jnp.asarray(basin.q_prime[:t])
+    return network, channels, gauges, params, q_prime
+
+
+def _assert_close(a, b, rtol=2e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+class TestForwardParity:
+    def test_full_domain(self):
+        network, channels, _, params, q_prime = _setup()
+        assert network.wavefront, "synthetic basin should carry wavefront tables"
+        wf = route(network, channels, params, q_prime, engine="wavefront")
+        st = route(network, channels, params, q_prime, engine="step")
+        _assert_close(wf.runoff, st.runoff)
+        _assert_close(wf.final_discharge, st.final_discharge)
+
+    def test_gauge_aggregated(self):
+        network, channels, gauges, params, q_prime = _setup(seed=1)
+        wf = route(network, channels, params, q_prime, gauges=gauges, engine="wavefront")
+        st = route(network, channels, params, q_prime, gauges=gauges, engine="step")
+        _assert_close(wf.runoff, st.runoff)
+
+    def test_with_carried_state(self):
+        network, channels, _, params, q_prime = _setup(seed=2)
+        q_init = jnp.asarray(
+            np.random.default_rng(0).uniform(0.1, 5.0, network.n), jnp.float32
+        )
+        wf = route(network, channels, params, q_prime, q_init=q_init, engine="wavefront")
+        st = route(network, channels, params, q_prime, q_init=q_init, engine="step")
+        _assert_close(wf.runoff, st.runoff)
+
+    def test_chunked_carry_equivalence(self):
+        """Sequential chunked inference (carry final_discharge) matches one pass."""
+        network, channels, _, params, q_prime = _setup(t=48, seed=3)
+        full = route(network, channels, params, q_prime, engine="wavefront")
+        a = route(network, channels, params, q_prime[:24], engine="wavefront")
+        # chunk 2 overlaps one input row (step t consumes q_prime[t-1]) and its
+        # row 0 re-emits the carried state — the ddr test chunking convention.
+        b = route(
+            network, channels, params, q_prime[23:], q_init=a.final_discharge,
+            engine="wavefront",
+        )
+        _assert_close(
+            jnp.concatenate([a.runoff, b.runoff[1:]], axis=0), full.runoff
+        )
+
+    def test_deep_chain(self):
+        """A pure chain (depth = n - 1) is the wavefront's worst case for skew."""
+        n, t = 300, 30
+        rows, cols = np.arange(1, n), np.arange(n - 1)
+        network = build_network(rows, cols, n)
+        assert network.wavefront and network.depth == n - 1
+        rng = np.random.default_rng(4)
+        from ddr_tpu.routing.mc import ChannelState
+
+        channels = ChannelState(
+            length=jnp.asarray(rng.uniform(1e3, 1e4, n), jnp.float32),
+            slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
+            x_storage=jnp.full(n, 0.3, jnp.float32),
+        )
+        params = {
+            "n": jnp.full(n, 0.03, jnp.float32),
+            "q_spatial": jnp.full(n, 0.4, jnp.float32),
+            "p_spatial": jnp.full(n, 21.0, jnp.float32),
+        }
+        q_prime = jnp.asarray(rng.uniform(0.0, 2.0, (t, n)), jnp.float32)
+        wf = route(network, channels, params, q_prime, engine="wavefront")
+        st = route(network, channels, params, q_prime, engine="step")
+        _assert_close(wf.runoff, st.runoff, rtol=5e-4, atol=1e-4)
+
+    def test_single_timestep(self):
+        """T=1 exercises wavefront_route_core's early return (forced: auto-select
+        would fall back to the step engine below T=2)."""
+        network, channels, _, params, q_prime = _setup(t=24)
+        wf = route(network, channels, params, q_prime[:1], engine="wavefront")
+        st = route(network, channels, params, q_prime[:1], engine="step")
+        assert wf.runoff.shape == st.runoff.shape == (1, network.n)
+        _assert_close(wf.runoff, st.runoff)
+        _assert_close(wf.final_discharge, st.final_discharge)
+
+
+class TestGradientParity:
+    def test_grad_matches_step_engine(self):
+        network, channels, gauges, params, q_prime = _setup(n=256, t=24, seed=5)
+
+        def loss(p, engine):
+            r = route(network, channels, p, q_prime, gauges=gauges, engine=engine)
+            return jnp.mean(r.runoff ** 2)
+
+        g_wf = jax.grad(lambda p: loss(p, "wavefront"))(params)
+        g_st = jax.grad(lambda p: loss(p, "step"))(params)
+        for k in params:
+            _assert_close(g_wf[k], g_st[k], rtol=1e-3, atol=1e-5)
+
+    def test_grad_wrt_inflow(self):
+        network, channels, _, params, q_prime = _setup(n=128, t=12, seed=6)
+
+        def loss(qp, engine):
+            return jnp.sum(route(network, channels, params, qp, engine=engine).runoff)
+
+        g_wf = jax.grad(lambda qp: loss(qp, "wavefront"))(q_prime)
+        g_st = jax.grad(lambda qp: loss(qp, "step"))(q_prime)
+        _assert_close(g_wf, g_st, rtol=1e-3, atol=1e-5)
+
+
+class TestEligibility:
+    def test_edgeless_network_has_no_wavefront(self):
+        network = build_network(np.zeros(0, np.int64), np.zeros(0, np.int64), 8)
+        assert not network.wavefront
+        # auto-select must quietly use the step engine
+        channels_n = 8
+        from ddr_tpu.routing.mc import ChannelState
+
+        channels = ChannelState(
+            length=jnp.full(channels_n, 1e3), slope=jnp.full(channels_n, 1e-3),
+            x_storage=jnp.full(channels_n, 0.3),
+        )
+        params = {
+            "n": jnp.full(channels_n, 0.03),
+            "q_spatial": jnp.full(channels_n, 0.4),
+            "p_spatial": jnp.full(channels_n, 21.0),
+        }
+        qp = jnp.ones((4, channels_n))
+        out = route(network, channels, params, qp)
+        assert out.runoff.shape == (4, channels_n)
+
+    def test_forcing_wavefront_without_tables_raises(self):
+        network = build_network(np.zeros(0, np.int64), np.zeros(0, np.int64), 4)
+        from ddr_tpu.routing.mc import ChannelState
+
+        channels = ChannelState(
+            length=jnp.full(4, 1e3), slope=jnp.full(4, 1e-3), x_storage=jnp.full(4, 0.3)
+        )
+        params = {
+            "n": jnp.full(4, 0.03), "q_spatial": jnp.full(4, 0.4),
+            "p_spatial": jnp.full(4, 21.0),
+        }
+        with pytest.raises(ValueError, match="wavefront tables"):
+            route(network, channels, params, jnp.ones((4, 4)), engine="wavefront")
+
+    def test_bucket_tables_decode_to_the_edge_list(self):
+        """wf_idx/wf_mask/wf_buckets must be a lossless re-encoding of the edges:
+        decoding every real slot recovers exactly the (src, gap) multiset per node."""
+        network, *_ = _setup(n=256)
+        n = network.n
+        lvl = np.asarray(network.level)
+        perm = np.asarray(network.wf_perm)
+        idx = np.asarray(network.wf_idx)
+        mask = np.asarray(network.wf_mask)
+        row_len = n + 1
+
+        decoded = []  # (tgt_original, src_original, gap)
+        off = 0
+        for node_start, node_end, width in network.wf_buckets:
+            cnt = (node_end - node_start) * width
+            tbl = idx[off : off + cnt].reshape(node_end - node_start, width)
+            msk = mask[off : off + cnt].reshape(tbl.shape)
+            for j in range(node_end - node_start):
+                tgt = perm[node_start + j]
+                for k in range(width):
+                    if msk[j, k]:
+                        gap = tbl[j, k] // row_len + 1
+                        src = perm[tbl[j, k] % row_len]
+                        decoded.append((tgt, src, gap))
+                    else:
+                        assert tbl[j, k] == row_len - 1  # sentinel: ring[0, n]
+            off += cnt
+        assert off == len(idx)
+
+        rows = np.asarray(network.edge_tgt)
+        cols = np.asarray(network.edge_src)
+        expected = sorted((t, s, lvl[t] - lvl[s]) for t, s in zip(rows, cols))
+        assert sorted(decoded) == expected
+        # gathered index count stays within 2x the edge count (pow2 bucket padding)
+        assert len(idx) <= 2 * network.n_edges

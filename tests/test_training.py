@@ -192,6 +192,29 @@ class TestCheckpointSchema:
                 p, expected_arch={"model": "kan", "grid_range": [-2.0, 2.0], "grid": 3}
             )
 
+    def test_v1_blob_loads_archless_but_not_with_expectation(self, tmp_path):
+        """Round-1 (v1) blobs stay loadable by arch-agnostic tools (geometry
+        predictor, plain inference) but are refused when the caller states an
+        architecture — v1 predates the fingerprint, so nothing can verify it."""
+        import pickle
+
+        import pytest
+
+        from ddr_tpu.training import CHECKPOINT_FORMAT, load_state
+
+        p = tmp_path / "v1.pkl"
+        with p.open("wb") as f:
+            pickle.dump(
+                {
+                    "format": CHECKPOINT_FORMAT, "version": 1,
+                    "epoch": 2, "mini_batch": 5, "params": {"w": 2.0}, "opt_state": {},
+                },
+                f,
+            )
+        assert load_state(p)["params"] == {"w": 2.0}
+        with pytest.raises(ValueError, match="version 1"):
+            load_state(p, expected_arch={"model": "kan"})
+
     def test_archless_blob_loads_with_expectation(self, tmp_path):
         """A v2 blob saved without arch (non-KAN producers) never hard-fails."""
         from ddr_tpu.training import load_state, save_state
