@@ -20,8 +20,16 @@ from ddr_tpu.parallel.sharding import (
     shard_network,
     sharded_route,
 )
+from ddr_tpu.parallel.wavefront import (
+    ShardedWavefront,
+    build_sharded_wavefront,
+    sharded_wavefront_route,
+)
 
 __all__ = [
+    "ShardedWavefront",
+    "build_sharded_wavefront",
+    "sharded_wavefront_route",
     "PipelineSchedule",
     "ReachPartition",
     "build_pipeline_schedule",

@@ -170,17 +170,20 @@ def compute_levels(rows: np.ndarray, cols: np.ndarray, n: int) -> np.ndarray:
 
 
 def level_schedule(
-    rows: np.ndarray, cols: np.ndarray, n: int
+    rows: np.ndarray, cols: np.ndarray, n: int, level: np.ndarray | None = None
 ) -> tuple[np.ndarray, np.ndarray, int]:
     """Edges grouped by target level and padded to a ``(depth, e_max)`` rectangle.
 
     Padding slots hold the sentinel ``n`` (consumed by the solver's clip-gather /
     drop-scatter convention). Shared by :func:`build_network` and the per-shard
-    schedules of :mod:`ddr_tpu.parallel.pipeline`.
+    schedules of :mod:`ddr_tpu.parallel.pipeline`. Pass ``level`` when the caller
+    already computed it (the Kahn layering is the dominant host-side build cost on
+    multi-million-reach graphs).
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
-    level = compute_levels(rows, cols, n)
+    if level is None:
+        level = compute_levels(rows, cols, n)
     depth = int(level.max()) if n else 0
 
     if rows.size == 0 or depth == 0:
@@ -302,7 +305,8 @@ def build_network(
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
-    lvl_src, lvl_tgt, depth = level_schedule(rows, cols, n)
+    level = compute_levels(rows, cols, n) if n else np.zeros(0, dtype=np.int32)
+    lvl_src, lvl_tgt, depth = level_schedule(rows, cols, n, level=level)
 
     in_deg = np.bincount(rows, minlength=n) if rows.size else np.zeros(n, dtype=np.int64)
     out_deg = np.bincount(cols, minlength=n) if cols.size else np.zeros(n, dtype=np.int64)
@@ -315,8 +319,6 @@ def build_network(
         raise ValueError(
             f"network exceeds fused-schedule limits (depth={depth}, in={max_in}, out={max_out})"
         )
-
-    level = compute_levels(rows, cols, n) if n else np.zeros(0, dtype=np.int32)
 
     if fused:
         perm = np.lexsort((np.arange(n), level))  # level-major, stable within level

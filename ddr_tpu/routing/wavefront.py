@@ -98,8 +98,11 @@ def wavefront_route_core(
     qs = _shift_rows(padded, depth - level_p, n_waves).T  # (W, N)
     qs = jnp.maximum(qs, discharge_lb)
 
-    # Previous-timestep inflow sums for wave 1: sum_p x_0[p] (q0 is already clamped).
-    s_init = network.upstream_sum(q0)[perm]
+    # Previous-timestep inflow sums: wave 1's only consumers are level-0 nodes
+    # (predecessor-free by definition), so the initial value is exactly zero;
+    # every later wave carries the clamped reduction of the previous wave's gather
+    # (which reads q0 out of the ring's init rows for t=1 consumers).
+    s_init = jnp.zeros_like(q0p)
 
     q0_pad = jnp.concatenate([q0p, jnp.zeros(1, q0.dtype)])
     ring0 = jnp.broadcast_to(q0_pad, (depth + 2, row_len))
