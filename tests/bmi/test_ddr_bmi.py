@@ -366,3 +366,263 @@ class TestFinalize:
         assert model._step_fn is None
         with pytest.raises(RuntimeError):
             model.update()
+
+
+def record_substep_inflows(model, until):
+    """Wrap the compiled step fn to capture each sub-step's q_prime, then restore."""
+    seen = []
+    real_step = model._step_fn
+    model._step_fn = lambda q, qp: (seen.append(np.asarray(qp).copy()) or real_step(q, qp))
+    try:
+        model.update_until(until)
+    finally:
+        model._step_fn = real_step
+    return seen
+
+
+class TestSubStepping:
+    def test_update_until_runs_expected_substeps(self, fresh_bmi):
+        calls = record_substep_inflows(fresh_bmi, 4 * 3600.0)
+        assert len(calls) == 4
+        assert fresh_bmi.get_current_time() == 4 * 3600.0
+
+    def test_no_substep_when_dt_matches(self, fresh_bmi):
+        assert len(record_substep_inflows(fresh_bmi, 3600.0)) == 1
+
+    def test_multi_coupling_intervals(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        for k in range(1, 4):
+            fresh_bmi.set_value(
+                "land_surface_water_source__volume_flow_rate", np.full(n, float(k))
+            )
+            fresh_bmi.update_until(k * 2 * 3600.0)
+            assert fresh_bmi.get_current_time() == k * 2 * 3600.0
+
+    def test_constant_equals_per_step_updates(self, bmi_config_file):
+        """Constant interpolation over one 4h interval must reproduce 4 single
+        updates with the same inflow re-sent each step (ngen's usual pattern)."""
+        n_models = []
+        for _ in range(2):
+            m = DdrBmi()
+            m.initialize(str(bmi_config_file))
+            n_models.append(m)
+        a, b = n_models
+        n = a.get_grid_size(0)
+        inflow = np.full(n, 1.25)
+        a.set_value("land_surface_water_source__volume_flow_rate", inflow)
+        a.update_until(4 * 3600.0)
+        for _ in range(4):
+            b.set_value("land_surface_water_source__volume_flow_rate", inflow)
+            b.update()
+        np.testing.assert_allclose(
+            a.get_value_ptr("channel_exit_water_x-section__volume_flow_rate"),
+            b.get_value_ptr("channel_exit_water_x-section__volume_flow_rate"),
+            rtol=1e-6,
+        )
+
+
+class TestUpdateUntilBoundaries:
+    """The deferral semantics VERDICT flagged as documented-but-untested: requests
+    are rounded to whole routing steps; below half a step the model defers (a
+    deviation from the reference's max(1, round(...)), kept deliberately so ngen's
+    clock never desynchronizes)."""
+
+    def test_exactly_half_step_defers(self, fresh_bmi):
+        fresh_bmi.update_until(1800.0)  # round(0.5) == 0: banker's rounding
+        assert fresh_bmi.get_current_time() == 0.0
+
+    def test_just_above_half_step_advances_full_step(self, fresh_bmi):
+        fresh_bmi.update_until(1801.0)
+        assert fresh_bmi.get_current_time() == 3600.0  # snapped to the routing grid
+
+    def test_one_and_a_half_steps_rounds_to_two(self, fresh_bmi):
+        fresh_bmi.update_until(5400.0)
+        assert fresh_bmi.get_current_time() == 7200.0
+
+    def test_deferral_preserves_queued_inflows_and_state(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 2.0))
+        fresh_bmi.update()
+        q_before = fresh_bmi.get_value_ptr(
+            "channel_exit_water_x-section__volume_flow_rate"
+        ).copy()
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 9.0))
+        fresh_bmi.update_until(fresh_bmi.get_current_time() + 900.0)  # defers
+        np.testing.assert_array_equal(
+            q_before,
+            fresh_bmi.get_value_ptr("channel_exit_water_x-section__volume_flow_rate"),
+        )
+        assert fresh_bmi._lateral_inflow.sum() == pytest.approx(9.0 * n)
+
+    def test_backward_time_is_noop(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.update()
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 3.0))
+        fresh_bmi.update_until(0.0)
+        assert fresh_bmi.get_current_time() == 3600.0
+        assert fresh_bmi._lateral_inflow.sum() == pytest.approx(3.0 * n)
+
+
+class TestInterpolationRampValues:
+    """Pin the exact per-substep inflows the engine receives (VERDICT: ramp values
+    were untested). The step function is wrapped to record its q_prime argument."""
+
+    def _linear_model(self, bmi_config_file, tmp_path):
+        raw = yaml.safe_load(bmi_config_file.read_text())
+        raw["interpolation"] = "linear"
+        cfg = tmp_path / "bmi_linear_ramp.yaml"
+        cfg.write_text(yaml.safe_dump(raw))
+        model = DdrBmi()
+        model.initialize(str(cfg))
+        return model
+
+    def test_linear_ramps_between_intervals(self, bmi_config_file, tmp_path):
+        model = self._linear_model(bmi_config_file, tmp_path)
+        n = model.get_grid_size(0)
+        model.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 1.0))
+        model.update_until(4 * 3600.0)  # first interval: constant fallback
+        model.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 3.0))
+        seen = record_substep_inflows(model, 8 * 3600.0)
+        # alpha = (step+1)/4: inflows 1.5, 2.0, 2.5, 3.0
+        assert len(seen) == 4
+        for got, want in zip(seen, (1.5, 2.0, 2.5, 3.0)):
+            np.testing.assert_allclose(got, np.full(n, want), rtol=1e-6)
+
+    def test_linear_first_interval_falls_back_to_constant(self, bmi_config_file, tmp_path):
+        model = self._linear_model(bmi_config_file, tmp_path)
+        n = model.get_grid_size(0)
+        model.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 2.0))
+        seen = record_substep_inflows(model, 3 * 3600.0)
+        assert len(seen) == 3
+        for got in seen:
+            np.testing.assert_allclose(got, np.full(n, 2.0), rtol=1e-6)
+
+    def test_linear_single_substep_uses_current(self, bmi_config_file, tmp_path):
+        model = self._linear_model(bmi_config_file, tmp_path)
+        n = model.get_grid_size(0)
+        model.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 1.0))
+        model.update_until(3600.0)
+        model.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 5.0))
+        seen = record_substep_inflows(model, 2 * 3600.0)  # n_steps == 1: no ramp possible
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], np.full(n, 5.0), rtol=1e-6)
+
+    def test_constant_holds_inflow_every_substep(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 1.0))
+        fresh_bmi.update()
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 4.0))
+        seen = record_substep_inflows(fresh_bmi, fresh_bmi.get_current_time() + 3 * 3600.0)
+        assert len(seen) == 3
+        for got in seen:
+            np.testing.assert_allclose(got, np.full(n, 4.0), rtol=1e-6)
+
+
+class TestPrevInflowIndependence:
+    def test_prev_inflow_stored_after_update(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 2.5))
+        fresh_bmi.update()
+        assert fresh_bmi._has_prev_inflow
+        np.testing.assert_allclose(fresh_bmi._prev_lateral_inflow, 2.5)
+
+    def test_prev_and_current_are_different_objects(self, fresh_bmi):
+        assert fresh_bmi._prev_lateral_inflow is not fresh_bmi._lateral_inflow
+        assert not np.shares_memory(
+            fresh_bmi._prev_lateral_inflow, fresh_bmi._lateral_inflow
+        )
+
+    def test_zeroing_current_does_not_affect_prev(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 1.5))
+        fresh_bmi.update()
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.zeros(n))
+        np.testing.assert_allclose(fresh_bmi._prev_lateral_inflow, 1.5)
+
+
+class TestPointerStability:
+    @pytest.mark.parametrize(
+        "name",
+        [
+            "channel_exit_water_x-section__volume_flow_rate",
+            "channel_water_flow__speed",
+            "channel_water__mean_depth",
+            "channel_water__id",
+        ],
+    )
+    def test_all_output_ptrs_stable_across_update(self, fresh_bmi, name):
+        ptr = fresh_bmi.get_value_ptr(name)
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 1.0))
+        fresh_bmi.update()
+        assert fresh_bmi.get_value_ptr(name) is ptr
+
+    def test_update_mutates_in_place(self, fresh_bmi):
+        q_ptr = fresh_bmi.get_value_ptr("channel_exit_water_x-section__volume_flow_rate")
+        before = q_ptr.copy()
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, 2.0))
+        fresh_bmi.update()
+        assert not np.array_equal(q_ptr, before)  # same buffer, new values
+
+
+class TestValueEdgeCases:
+    def test_zero_inflow_floors_at_discharge_bound(self, fresh_bmi):
+        fresh_bmi.update()  # no inflow set at all
+        q = fresh_bmi.get_value_ptr("channel_exit_water_x-section__volume_flow_rate")
+        assert np.isfinite(q).all()
+        assert (q >= 1e-4 - 1e-9).all()
+
+    def test_negative_inflow_stays_finite_and_bounded(self, fresh_bmi):
+        n = fresh_bmi.get_grid_size(0)
+        fresh_bmi.set_value("land_surface_water_source__volume_flow_rate", np.full(n, -5.0))
+        fresh_bmi.update()
+        q = fresh_bmi.get_value_ptr("channel_exit_water_x-section__volume_flow_rate")
+        assert np.isfinite(q).all()
+        assert (q >= 1e-4 - 1e-9).all()
+
+    def test_get_value_at_indices_repeated_indices(self, fresh_bmi):
+        fresh_bmi.update()
+        full = fresh_bmi.get_value_ptr("channel_exit_water_x-section__volume_flow_rate")
+        dest = np.zeros(3, dtype=np.float32)
+        out = fresh_bmi.get_value_at_indices(
+            "channel_exit_water_x-section__volume_flow_rate", dest, np.array([2, 2, 0])
+        )
+        np.testing.assert_allclose(out, full[[2, 2, 0]])
+
+    def test_set_value_at_indices_accumulates_nothing(self, fresh_bmi):
+        """Repeated set_value_at_indices overwrites, never accumulates."""
+        fresh_bmi.set_value_at_indices(
+            "land_surface_water_source__volume_flow_rate", np.array([1]), np.array([2.0])
+        )
+        fresh_bmi.set_value_at_indices(
+            "land_surface_water_source__volume_flow_rate", np.array([1]), np.array([3.0])
+        )
+        assert fresh_bmi._lateral_inflow[1] == 3.0
+
+    def test_segment_id_values_match_grid(self, bmi):
+        ids = bmi.get_value_ptr("channel_water__id")
+        assert len(np.unique(ids)) == len(ids)  # unique per segment
+
+
+class TestFinalizeLifecycle:
+    def test_finalize_then_update_raises(self, bmi_config_file):
+        model = DdrBmi()
+        model.initialize(str(bmi_config_file))
+        model.finalize()
+        with pytest.raises(RuntimeError):
+            model.update()
+
+    def test_finalize_is_idempotent(self, bmi_config_file):
+        model = DdrBmi()
+        model.initialize(str(bmi_config_file))
+        model.finalize()
+        model.finalize()
+
+    def test_reinitialize_after_finalize(self, bmi_config_file):
+        model = DdrBmi()
+        model.initialize(str(bmi_config_file))
+        model.finalize()
+        model.initialize(str(bmi_config_file))
+        model.update()
+        assert model.get_current_time() == 3600.0
