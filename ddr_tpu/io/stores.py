@@ -14,13 +14,24 @@ Group layout
 - one array per data variable, shaped ``(n_ids, n_time)`` — e.g. ``Qr`` for lateral
   inflow (m^3/s), ``streamflow`` for USGS observations (m^3/s).
 
-``s3://`` URIs are rejected with a clear error (this environment has no egress; the
-reference's anonymous-S3 path, readers.py:427-436, is out of scope by design).
+Remote backends
+---------------
+The facades are duck-typed over :class:`GroupLike` — the small surface zarrlite's
+``ZarrGroup``, zarr-python's ``Group``, and an icechunk session all provide — and
+URIs are dispatched through a scheme registry. An environment WITH egress plugs in
+the reference's anonymous-S3 icechunk path (readers.py:413-443) without touching
+the data layer:
+
+    register_store_backend("s3", lambda uri: icechunk_group_for(uri))
+
+In this zero-egress environment no remote backend is registered, so ``s3://`` URIs
+fail fast with a message that says exactly that.
 """
 
 from __future__ import annotations
 
 from pathlib import Path
+from typing import Any, Callable, Iterator, Protocol, runtime_checkable
 
 import numpy as np
 import pandas as pd
@@ -28,21 +39,97 @@ import pandas as pd
 from ddr_tpu.io import zarrlite
 
 __all__ = [
+    "GroupLike",
+    "read_array",
     "HydroStore",
     "open_hydro_store",
     "write_hydro_store",
     "AttributeStore",
     "open_attribute_store",
     "write_attribute_store",
+    "register_store_backend",
+    "unregister_store_backend",
 ]
 
 ORIGIN = pd.Timestamp("1980/01/01")  # store epoch (reference dataclasses.py:74)
 
 
+@runtime_checkable
+class GroupLike(Protocol):
+    """What the store facades actually require of a zarr-ish group.
+
+    ``attrs`` is a mapping; ``__getitem__`` returns either a sub-group or an
+    array-like exposing ``.shape`` plus ``.read()`` or ``__array__``. zarrlite
+    groups satisfy this natively; zarr-python / icechunk groups already do too
+    (their arrays have ``shape`` and ``__array__``), so adapters only need these
+    four members.
+    """
+
+    attrs: Any
+
+    def __getitem__(self, name: str) -> Any: ...
+
+    def __contains__(self, name: str) -> bool: ...
+
+    def keys(self) -> Iterator[str]: ...
+
+
+def _is_array(node: Any) -> bool:
+    """Arrays have a shape; groups don't (true for zarrlite AND zarr-python)."""
+    return hasattr(node, "shape")
+
+
+def read_array(node: Any) -> np.ndarray:
+    """Materialize an array-like: zarrlite's ``.read()`` or numpy's ``__array__``."""
+    if hasattr(node, "read"):
+        return node.read()
+    return np.asarray(node)
+
+
+_STORE_BACKENDS: dict[str, Callable[[str], GroupLike]] = {}
+
+
+def register_store_backend(scheme: str, opener: Callable[[str], GroupLike]) -> None:
+    """Register an opener for ``scheme://...`` URIs (e.g. ``"s3"`` -> icechunk).
+
+    The opener receives the full URI and must return a :class:`GroupLike`."""
+    _STORE_BACKENDS[scheme.lower()] = opener
+
+
+def unregister_store_backend(scheme: str) -> None:
+    _STORE_BACKENDS.pop(scheme.lower(), None)
+
+
+def _resolve_group(store: str | Path, kind: str) -> GroupLike:
+    """Dispatch a path/URI to the right backend; local filesystem is the default."""
+    uri = str(store)
+    if "://" in uri:
+        scheme = uri.split("://", 1)[0].lower()
+        opener = _STORE_BACKENDS.get(scheme)
+        if opener is not None:
+            return opener(uri)
+        if scheme == "file":
+            from urllib.parse import urlparse
+
+            parsed = urlparse(uri)
+            if parsed.netloc not in ("", "localhost"):
+                raise ValueError(
+                    f"file:// URIs with a remote host are not supported: {uri!r}"
+                )
+            return zarrlite.open_group(parsed.path)
+        raise ValueError(
+            f"No backend registered for {scheme}:// {kind} {uri!r}. This environment "
+            "has no egress; either materialize the store locally and point the "
+            "config at the path, or register_store_backend"
+            f"({scheme!r}, opener) with an icechunk/zarr opener."
+        )
+    return zarrlite.open_group(uri)
+
+
 class HydroStore:
     """Read façade over one time-series group: id lookup + time alignment."""
 
-    def __init__(self, group: zarrlite.ZarrGroup) -> None:
+    def __init__(self, group: GroupLike) -> None:
         self.group = group
         self.start_date = pd.Timestamp(group.attrs["start_date"])
         self.freq = group.attrs.get("freq", "D")
@@ -61,9 +148,9 @@ class HydroStore:
     def n_time(self, var: str = "Qr") -> int:
         return self[var].shape[1]
 
-    def __getitem__(self, var: str) -> zarrlite.ZarrArray:
+    def __getitem__(self, var: str):
         arr = self.group[var]
-        if not isinstance(arr, zarrlite.ZarrArray):
+        if not _is_array(arr):
             raise KeyError(f"{var} is not an array variable")
         return arr
 
@@ -73,21 +160,17 @@ class HydroStore:
     def select(self, var: str, id_rows: np.ndarray, time_cols: np.ndarray) -> np.ndarray:
         """Fancy-select ``(rows, cols)`` out of a variable; reads then slices
         (stores here are modest; chunk-pruned reads are a later optimization)."""
-        data = self[var].read()
+        data = read_array(self[var])
         return data[np.asarray(id_rows)[:, None], np.asarray(time_cols)[None, :]]
 
 
 def open_hydro_store(store: str | Path) -> HydroStore:
-    """Open a local hydro store. The reference accepts ``s3://`` icechunk URIs
-    (readers.py:413-443); zero-egress environments must materialize stores locally
-    first, so S3 URIs fail fast with a clear message."""
-    store = str(store)
-    if store.startswith("s3://"):
-        raise ValueError(
-            f"S3 stores are not reachable from this environment (no egress): {store}. "
-            "Materialize the store locally and point the config at the local path."
-        )
-    return HydroStore(zarrlite.open_group(store))
+    """Open a hydro store from a local path or any registered ``scheme://`` URI.
+
+    The reference accepts ``s3://`` icechunk URIs (readers.py:413-443); with no
+    backend registered those fail fast with a message naming the registration
+    seam."""
+    return HydroStore(_resolve_group(store, "hydro store"))
 
 
 def write_hydro_store(
@@ -128,33 +211,28 @@ class AttributeStore:
     ``(n_ids,)`` vector per attribute name.
     """
 
-    def __init__(self, group: zarrlite.ZarrGroup) -> None:
+    def __init__(self, group: GroupLike) -> None:
         self.group = group
         self.ids: list = list(group.attrs["ids"])
         self.id_to_index = {i: k for k, i in enumerate(self.ids)}
 
     @property
     def attribute_names(self) -> list[str]:
-        return [k for k in self.group.keys() if isinstance(self.group[k], zarrlite.ZarrArray)]
+        return [k for k in self.group.keys() if _is_array(self.group[k])]
 
     def matrix(self, names: list[str]) -> np.ndarray:
         """Stack the named attributes into ``(len(names), n_ids)`` float32."""
         return np.stack(
-            [np.asarray(self.group[n].read(), dtype=np.float32) for n in names], axis=0
+            [np.asarray(read_array(self.group[n]), dtype=np.float32) for n in names], axis=0
         )
 
     def as_mapping(self) -> dict[str, np.ndarray]:
         """{name: (n_ids,)} view for the statistics machinery."""
-        return {n: self.group[n].read() for n in self.attribute_names}
+        return {n: read_array(self.group[n]) for n in self.attribute_names}
 
 
 def open_attribute_store(path: str | Path) -> AttributeStore:
-    path = str(path)
-    if path.startswith("s3://"):
-        raise ValueError(
-            f"S3 attribute stores are not reachable from this environment (no egress): {path}"
-        )
-    return AttributeStore(zarrlite.open_group(path))
+    return AttributeStore(_resolve_group(path, "attribute store"))
 
 
 def write_attribute_store(

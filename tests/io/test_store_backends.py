@@ -1,0 +1,205 @@
+"""Remote-store seam: scheme registry + GroupLike protocol (VERDICT missing #6).
+
+An icechunk/S3 backend must be addable without touching the data layer: these
+tests register a purely in-memory backend implementing only the GroupLike surface
+and drive the full HydroStore/AttributeStore facades through it, and pin the
+fail-fast message for unregistered schemes."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.io.stores import (
+    AttributeStore,
+    GroupLike,
+    HydroStore,
+    open_attribute_store,
+    open_hydro_store,
+    register_store_backend,
+    unregister_store_backend,
+    write_hydro_store,
+)
+
+
+class _MemArray:
+    """Minimal array-like: only what the facades touch (shape + read)."""
+
+    def __init__(self, data):
+        self.data = np.asarray(data)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def read(self):
+        return self.data
+
+
+class _MemGroup:
+    """Minimal GroupLike with no zarrlite ancestry at all."""
+
+    def __init__(self, attrs, arrays):
+        self.attrs = attrs
+        self._arrays = {k: _MemArray(v) for k, v in arrays.items()}
+
+    def __getitem__(self, name):
+        return self._arrays[name]
+
+    def __contains__(self, name):
+        return name in self._arrays
+
+    def keys(self):
+        return iter(self._arrays)
+
+
+@pytest.fixture()
+def mem_backend():
+    opened = []
+
+    def opener(uri):
+        opened.append(uri)
+        return _MemGroup(
+            attrs={"start_date": "1981/10/01", "freq": "h", "ids": ["cat-1", "cat-2"]},
+            arrays={"Qr": np.arange(12, dtype=np.float32).reshape(2, 6)},
+        )
+
+    register_store_backend("mems", opener)
+    yield opened
+    unregister_store_backend("mems")
+
+
+class TestBackendRegistry:
+    def test_registered_scheme_serves_hydro_store(self, mem_backend):
+        store = open_hydro_store("mems://bucket/run-42")
+        assert mem_backend == ["mems://bucket/run-42"]
+        assert isinstance(store, HydroStore)
+        assert store.ids == ["cat-1", "cat-2"]
+        assert store.is_hourly
+        assert store.n_time("Qr") == 6
+        np.testing.assert_array_equal(
+            store.select("Qr", np.array([1]), np.array([0, 2])), [[6.0, 8.0]]
+        )
+
+    def test_registered_scheme_serves_attribute_store(self):
+        register_store_backend(
+            "memattr",
+            lambda uri: _MemGroup(
+                attrs={"ids": ["a", "b", "c"]},
+                arrays={"slope": np.array([1.0, 2.0, 3.0]), "area": np.ones(3)},
+            ),
+        )
+        try:
+            store = open_attribute_store("memattr://x")
+            assert isinstance(store, AttributeStore)
+            assert sorted(store.attribute_names) == ["area", "slope"]
+            np.testing.assert_array_equal(
+                store.matrix(["slope"]), np.array([[1.0, 2.0, 3.0]], np.float32)
+            )
+        finally:
+            unregister_store_backend("memattr")
+
+    def test_unregistered_scheme_names_the_seam(self):
+        with pytest.raises(ValueError, match="register_store_backend"):
+            open_hydro_store("s3://bucket/repo")
+        with pytest.raises(ValueError, match="no egress"):
+            open_attribute_store("s3://bucket/attrs")
+
+    def test_scheme_is_case_insensitive(self, mem_backend):
+        register_store_backend("MEMS", lambda uri: pytest.fail("should reuse lowercase"))
+        unregister_store_backend("MEMS")  # removed the lowercase entry
+        with pytest.raises(ValueError, match="register_store_backend"):
+            open_hydro_store("mems://gone")
+
+    def test_file_scheme_maps_to_local_path(self, tmp_path):
+        write_hydro_store(
+            tmp_path / "st", ["g1"], "1981/10/01", "D", {"Qr": np.ones((1, 4))}
+        )
+        store = open_hydro_store(f"file://{tmp_path / 'st'}")
+        assert store.ids == ["g1"]
+
+    def test_local_paths_bypass_registry(self, tmp_path, mem_backend):
+        write_hydro_store(
+            tmp_path / "local", ["g1"], "1981/10/01", "D", {"Qr": np.ones((1, 4))}
+        )
+        store = open_hydro_store(tmp_path / "local")
+        assert mem_backend == []  # no backend consulted
+
+    def test_zarrlite_group_satisfies_protocol(self, tmp_path):
+        from ddr_tpu.io import zarrlite
+
+        group = zarrlite.create_group(tmp_path / "g")
+        assert isinstance(group, GroupLike)
+        assert isinstance(_MemGroup({}, {}), GroupLike)
+
+
+class _ArrayOnly:
+    """zarr-python-style array: shape + __array__, no .read()."""
+
+    def __init__(self, data):
+        self._d = np.asarray(data)
+
+    @property
+    def shape(self):
+        return self._d.shape
+
+    def __array__(self, dtype=None):
+        return self._d.astype(dtype) if dtype else self._d
+
+
+class TestZarrPythonStyleArrays:
+    def test_facades_accept_array_without_read(self):
+        class G:
+            attrs = {"start_date": "1981/10/01", "freq": "D", "ids": ["x", "y"]}
+
+            def __getitem__(self, k):
+                return _ArrayOnly(np.arange(6, dtype=np.float32).reshape(2, 3))
+
+            def __contains__(self, k):
+                return k == "Qr"
+
+            def keys(self):
+                return iter(["Qr"])
+
+        register_store_backend("zp", lambda uri: G())
+        try:
+            store = open_hydro_store("zp://x")
+            assert store.n_time("Qr") == 3
+            np.testing.assert_array_equal(
+                store.select("Qr", np.array([0, 1]), np.array([2])), [[2.0], [5.0]]
+            )
+        finally:
+            unregister_store_backend("zp")
+
+    def test_attribute_store_accepts_array_without_read(self):
+        class G:
+            attrs = {"ids": ["a", "b"]}
+
+            def __getitem__(self, k):
+                return _ArrayOnly(np.array([1.0, 2.0]))
+
+            def __contains__(self, k):
+                return True
+
+            def keys(self):
+                return iter(["slope"])
+
+        register_store_backend("zpa", lambda uri: G())
+        try:
+            store = open_attribute_store("zpa://x")
+            assert store.attribute_names == ["slope"]
+            np.testing.assert_array_equal(store.as_mapping()["slope"], [1.0, 2.0])
+        finally:
+            unregister_store_backend("zpa")
+
+
+class TestFileUriParsing:
+    def test_file_uri_with_remote_host_rejected(self):
+        with pytest.raises(ValueError, match="remote host"):
+            open_hydro_store("file://example.com/data/store")
+
+    def test_file_uri_three_slash_absolute(self, tmp_path):
+        write_hydro_store(
+            tmp_path / "abs", ["g"], "1981/10/01", "D", {"Qr": np.ones((1, 2))}
+        )
+        assert open_hydro_store(f"file://{tmp_path / 'abs'}").ids == ["g"]
