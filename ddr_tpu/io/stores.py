@@ -109,14 +109,14 @@ def _resolve_group(store: str | Path, kind: str) -> GroupLike:
         if opener is not None:
             return opener(uri)
         if scheme == "file":
-            from urllib.parse import urlparse
+            from urllib.parse import unquote, urlparse
 
             parsed = urlparse(uri)
             if parsed.netloc not in ("", "localhost"):
                 raise ValueError(
                     f"file:// URIs with a remote host are not supported: {uri!r}"
                 )
-            return zarrlite.open_group(parsed.path)
+            return zarrlite.open_group(unquote(parsed.path))
         raise ValueError(
             f"No backend registered for {scheme}:// {kind} {uri!r}. This environment "
             "has no egress; either materialize the store locally and point the "

@@ -2,9 +2,11 @@
 
 ``compute_daily_runoff`` applies the tau-dependent boundary trim
 (/root/reference/src/ddr/scripts_utils.py:18-42): start ``13 + tau`` hours (spin-up +
-timezone offset), end ``-11 + tau``. For a D-day hourly window this leaves exactly
-``24 * (D - 1)`` hours, so the daily means align with observation days ``1..D-1``
-(the reference's adaptive-area interpolation reduces to an exact block mean here).
+timezone offset), end ``-11 + tau``. A D-day window spans ``(D - 1) * 24`` hourly
+steps, so the trim leaves ``D - 2`` daily blocks aligned with observation days
+``1..D-2`` — the reference's ``obs[:, 1:-1]`` cut (quantified in
+tests/test_daily_alignment.py; the reference's adaptive-area interpolation reduces
+to an exact block mean here).
 """
 
 from __future__ import annotations

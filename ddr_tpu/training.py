@@ -6,10 +6,13 @@ step — KAN forward, denormalization, routing scan, daily aggregation, masked L
 backward through the custom-VJP solver — is one jit-compiled ``train_step``; optax
 provides clip-by-global-norm + Adam with an injectable learning rate.
 
-Alignment: the tau trim (13+tau : -11+tau) leaves exactly D-1 whole days for a D-day
-window, compared against observation days 1..D-1 with the first ``warmup`` days masked
-(see ddr_tpu/scripts_utils.py docstring for the deviation note vs the reference's
-off-by-one day windowing).
+Alignment: for a D-day window ((D-1)*24 hourly steps), the tau trim
+(13+tau : -11+tau) leaves D-2 daily blocks compared against observation days
+1..D-2 — exactly the reference's windowing (scripts_utils.py:18-42 + train.py's
+obs[:, 1:-1]). Each block intentionally blends (1/3) of calendar day d with (2/3)
+of day d+1 (the 13+tau=16h timezone offset); quantified in
+tests/test_daily_alignment.py: median NSE ~0.98 aligned vs ~0.93/~0.83 for a
+one-day misalignment on an autocorrelated signal.
 """
 
 from __future__ import annotations
@@ -54,7 +57,8 @@ def set_learning_rate(opt_state: Any, lr: float) -> Any:
 
 
 def daily_from_hourly(runoff_tg: jnp.ndarray, tau: int) -> jnp.ndarray:
-    """(T, G) hourly gauge flow -> (D-1, G) daily means after the tau trim."""
+    """(T, G) hourly gauge flow -> (D-2, G) daily means after the tau trim
+    (T = (D-1)*24 for a D-day window; alignment pinned in tests/test_daily_alignment.py)."""
     sliced = runoff_tg[(13 + tau) : (-11 + tau)]
     num_days = sliced.shape[0] // 24
     return sliced[: num_days * 24].reshape(num_days, 24, -1).mean(axis=1)

@@ -7,6 +7,8 @@ fail-fast message for unregistered schemes."""
 
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 import numpy as np
 import pytest
 
@@ -20,6 +22,16 @@ from ddr_tpu.io.stores import (
     unregister_store_backend,
     write_hydro_store,
 )
+
+
+@contextmanager
+def temp_backend(scheme, opener):
+    """Register a backend for the block; never leaks into _STORE_BACKENDS."""
+    register_store_backend(scheme, opener)
+    try:
+        yield
+    finally:
+        unregister_store_backend(scheme)
 
 
 class _MemArray:
@@ -82,22 +94,17 @@ class TestBackendRegistry:
         )
 
     def test_registered_scheme_serves_attribute_store(self):
-        register_store_backend(
-            "memattr",
-            lambda uri: _MemGroup(
-                attrs={"ids": ["a", "b", "c"]},
-                arrays={"slope": np.array([1.0, 2.0, 3.0]), "area": np.ones(3)},
-            ),
+        opener = lambda uri: _MemGroup(
+            attrs={"ids": ["a", "b", "c"]},
+            arrays={"slope": np.array([1.0, 2.0, 3.0]), "area": np.ones(3)},
         )
-        try:
+        with temp_backend("memattr", opener):
             store = open_attribute_store("memattr://x")
             assert isinstance(store, AttributeStore)
             assert sorted(store.attribute_names) == ["area", "slope"]
             np.testing.assert_array_equal(
                 store.matrix(["slope"]), np.array([[1.0, 2.0, 3.0]], np.float32)
             )
-        finally:
-            unregister_store_backend("memattr")
 
     def test_unregistered_scheme_names_the_seam(self):
         with pytest.raises(ValueError, match="register_store_backend"):
@@ -161,15 +168,12 @@ class TestZarrPythonStyleArrays:
             def keys(self):
                 return iter(["Qr"])
 
-        register_store_backend("zp", lambda uri: G())
-        try:
+        with temp_backend("zp", lambda uri: G()):
             store = open_hydro_store("zp://x")
             assert store.n_time("Qr") == 3
             np.testing.assert_array_equal(
                 store.select("Qr", np.array([0, 1]), np.array([2])), [[2.0], [5.0]]
             )
-        finally:
-            unregister_store_backend("zp")
 
     def test_attribute_store_accepts_array_without_read(self):
         class G:
@@ -184,13 +188,10 @@ class TestZarrPythonStyleArrays:
             def keys(self):
                 return iter(["slope"])
 
-        register_store_backend("zpa", lambda uri: G())
-        try:
+        with temp_backend("zpa", lambda uri: G()):
             store = open_attribute_store("zpa://x")
             assert store.attribute_names == ["slope"]
             np.testing.assert_array_equal(store.as_mapping()["slope"], [1.0, 2.0])
-        finally:
-            unregister_store_backend("zpa")
 
 
 class TestFileUriParsing:
@@ -203,3 +204,11 @@ class TestFileUriParsing:
             tmp_path / "abs", ["g"], "1981/10/01", "D", {"Qr": np.ones((1, 2))}
         )
         assert open_hydro_store(f"file://{tmp_path / 'abs'}").ids == ["g"]
+
+    def test_percent_encoded_file_uri_decodes(self, tmp_path):
+        store_dir = tmp_path / "my store"
+        write_hydro_store(
+            store_dir, ["g"], "1981/10/01", "D", {"Qr": np.ones((1, 2))}
+        )
+        uri = "file://" + str(store_dir).replace(" ", "%20")
+        assert open_hydro_store(uri).ids == ["g"]

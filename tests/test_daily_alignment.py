@@ -1,0 +1,77 @@
+"""Daily-aggregation window alignment, quantified (VERDICT weak #7).
+
+Both aggregators share the reference's exact trim formula
+(``[13+tau : -11+tau]``, /root/reference/src/ddr/scripts_utils.py:18-42) and are
+compared against observation days ``1..D-2`` (the reference's ``obs[:, 1:-1]``).
+These tests pin (a) that the two in-repo implementations agree with each other,
+(b) the shape contract, and (c) that the alignment has measurable teeth: on an
+autocorrelated daily signal, the aligned comparison scores median NSE ~0.98 (not
+1.0 — the 13+tau=16h trim intentionally blends (1/3) of calendar day d with (2/3)
+of day d+1, the reference's timezone offset), while a one-day misalignment drops
+it to ~0.93 (early) / ~0.83 (late). A windowing regression would trip this gap."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.scripts_utils import compute_daily_runoff
+from ddr_tpu.training import daily_from_hourly
+from ddr_tpu.validation.metrics import Metrics
+
+TAU = 3
+
+
+def _median_nse(pred_dg: np.ndarray, target_dg: np.ndarray) -> float:
+    return float(np.nanmedian(Metrics(pred=pred_dg.T, target=target_dg.T).nse))
+
+
+def _make(seed=0, n_days=40, n_gauges=5):
+    rng = np.random.default_rng(seed)
+    truth = np.cumsum(rng.normal(size=(n_days, n_gauges)), axis=0) + 20.0
+    hourly = np.repeat(truth[: n_days - 1], 24, axis=0).astype(np.float32)  # (D-1)*24
+    return truth, hourly
+
+
+class TestWindowContract:
+    def test_shape_is_d_minus_2_days(self):
+        truth, hourly = _make()
+        daily = np.asarray(daily_from_hourly(jnp.asarray(hourly), TAU))
+        assert daily.shape == (truth.shape[0] - 2, truth.shape[1])
+
+    def test_training_and_script_paths_agree(self):
+        """daily_from_hourly (jit path, (T, G)) == compute_daily_runoff ((G, T))."""
+        _, hourly = _make(seed=1)
+        a = np.asarray(daily_from_hourly(jnp.asarray(hourly), TAU))
+        b = compute_daily_runoff(hourly.T, TAU).T
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_tau_shifts_the_window(self):
+        _, hourly = _make(seed=2)
+        a = np.asarray(daily_from_hourly(jnp.asarray(hourly), 0))
+        b = np.asarray(daily_from_hourly(jnp.asarray(hourly), 6))
+        assert a.shape == b.shape
+        assert not np.allclose(a, b)
+
+
+class TestAlignmentHasTeeth:
+    def test_aligned_days_score_highest_nse(self):
+        truth, hourly = _make()
+        daily = np.asarray(daily_from_hourly(jnp.asarray(hourly), TAU))
+        obs = truth[1:-1]  # the reference's obs[:, 1:-1] target days
+        aligned = _median_nse(daily, obs)
+        early = _median_nse(daily, truth[0:-2])
+        late = _median_nse(daily[:-1], truth[2:-1])
+        assert aligned > 0.95, aligned
+        assert aligned > early + 0.02, (aligned, early)
+        assert aligned > late + 0.05, (aligned, late)
+
+    def test_timezone_blend_coefficients(self):
+        """At tau=3 the trim starts at hour 16, so daily block d is exactly
+        (1/3) * calendar day d + (2/3) * day d+1 — the documented blend."""
+        n_days, g = 10, 3
+        truth = np.random.default_rng(3).normal(size=(n_days, g)) + 20.0
+        hourly = np.repeat(truth[: n_days - 1], 24, axis=0).astype(np.float32)
+        daily = np.asarray(daily_from_hourly(jnp.asarray(hourly), TAU))
+        want = (1.0 / 3.0) * truth[:-2] + (2.0 / 3.0) * truth[1:-1]
+        np.testing.assert_allclose(daily, want, rtol=1e-5)
