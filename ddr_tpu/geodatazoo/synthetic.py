@@ -34,7 +34,7 @@ class SyntheticBasin:
     routing_data: RoutingData
     q_prime: np.ndarray  # (T, N) hourly lateral inflow over the FULL period
     true_params: dict[str, np.ndarray]  # physical-space truth
-    obs_daily: np.ndarray | None = None  # (D-1, G) filled by observe()
+    obs_daily: np.ndarray | None = None  # (D-2, G) filled by observe()
     gauge_segments: np.ndarray | None = None
 
 
@@ -125,7 +125,7 @@ def make_basin(
 def observe(basin: SyntheticBasin, cfg) -> SyntheticBasin:
     """Generate 'observations' by routing with the true parameters (twin experiment).
 
-    Produces both ``basin.obs_daily`` (D-1, G) for direct loss targets and an
+    Produces both ``basin.obs_daily`` (D-2, G) for direct loss targets and an
     :class:`ObservationSet` on the routing data (a full (G, D) table with day 0 NaN,
     mirroring how real observation stores align to the window) so scripts treat the
     synthetic dataset exactly like Merit/Lynker.
@@ -141,7 +141,7 @@ def observe(basin: SyntheticBasin, cfg) -> SyntheticBasin:
     )
     params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
     res = route(network, channels, params, jnp.asarray(basin.q_prime), gauges=gauges)
-    daily = compute_daily_runoff(np.asarray(res.runoff).T, tau=cfg.params.tau)  # (G, D-1)
+    daily = compute_daily_runoff(np.asarray(res.runoff).T, tau=cfg.params.tau)  # (G, D-2)
     basin.obs_daily = daily.T  # (D-1, G)
 
     rd = basin.routing_data

@@ -241,6 +241,7 @@ def route(
     bounds: Bounds = Bounds(),
     dt: float = DT_SECONDS,
     engine: str | None = None,
+    q_prime_permuted: bool = False,
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -269,6 +270,12 @@ def route(
     ``engine`` selects the schedule: ``"wavefront"`` (time-skewed, T + depth waves
     — :mod:`ddr_tpu.routing.wavefront`), ``"step"`` (per-timestep scan), or ``None``
     to auto-select wavefront whenever the network carries its tables.
+
+    ``q_prime_permuted=True`` declares that ``q_prime``'s columns are already in
+    ``network.wf_perm`` order (pre-permuted on the host, e.g.
+    ``q_prime[:, np.asarray(network.wf_perm)]``), skipping the one per-element
+    device permutation the wavefront engine otherwise pays (~7ms at N=8192; see
+    docs/tpu.md). Only meaningful for the wavefront engine.
     """
     n_mann = spatial_params["n"]
     q_spatial = spatial_params["q_spatial"]
@@ -290,17 +297,17 @@ def route(
         return ch, _g(n_mann), _g(q_spatial), _g(p_spatial)
 
     if engine is None:
-        engine = "wavefront" if (network.wavefront and q_prime.shape[0] >= 2) else "step"
+        engine = "wavefront" if network.wavefront else "step"
+    if q_prime_permuted and engine != "wavefront":
+        raise ValueError("q_prime_permuted is only valid with the wavefront engine")
     if engine == "wavefront":
         if not network.wavefront:
             raise ValueError("network was built without wavefront tables")
-        if q_init is None:
-            q0 = hotstart_discharge(network, q_prime[0], bounds.discharge)
-        else:
-            q0 = jnp.maximum(q_init, bounds.discharge)
 
-        # Physics closures run inside the wave scan in wf_perm (bucket) order.
+        # The whole engine runs in wf_perm (bucket, level) order; outputs are
+        # mapped back only where original order is actually needed.
         channels_p, n_mann_p, q_spatial_p, p_spatial_p = _permute_physics(network.wf_perm)
+        q_init_p = None if q_init is None else q_init[network.wf_perm]
 
         def celerity_fn(q_prev):
             return celerity(q_prev, n_mann_p, p_spatial_p, q_spatial_p, channels_p, bounds)[0]
@@ -310,11 +317,18 @@ def route(
 
         from ddr_tpu.routing.wavefront import wavefront_route_core
 
-        runoff_full, q_final = wavefront_route_core(
-            network, celerity_fn, coefficients_fn, q_prime, q0, bounds.discharge
+        runoff_p, final_p = wavefront_route_core(
+            network, celerity_fn, coefficients_fn, q_prime, q_init_p,
+            bounds.discharge, q_prime_permuted=q_prime_permuted,
         )
-        runoff = jax.vmap(gauges.aggregate)(runoff_full) if gauges is not None else runoff_full
-        return RouteResult(runoff=runoff, final_discharge=q_final)
+        if gauges is not None:
+            gauges_p = dataclasses.replace(
+                gauges, flat_idx=network.wf_inv[gauges.flat_idx]
+            )
+            runoff = jax.vmap(gauges_p.aggregate)(runoff_p)
+        else:
+            runoff = runoff_p[:, network.wf_inv]
+        return RouteResult(runoff=runoff, final_discharge=final_p[network.wf_inv])
     if engine != "step":
         raise ValueError(f"unknown engine {engine!r} (use 'wavefront' or 'step')")
 

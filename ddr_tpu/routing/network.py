@@ -91,6 +91,9 @@ class RiverNetwork:
         default_factory=lambda: jnp.zeros(0, jnp.float32)
     )
     wf_buckets: tuple = dataclasses.field(default=(), metadata={"static": True})
+    # Static (start, end, level) column runs in wf_perm order: the time-skew
+    # slice schedule (level-contiguous within each degree bucket).
+    wf_level_runs: tuple = dataclasses.field(default=(), metadata={"static": True})
     wavefront: bool = dataclasses.field(default=False, metadata={"static": True})
 
     def upstream_sum(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -234,8 +237,8 @@ def _padded_adjacency_table(
 
 def _wavefront_tables(
     rows: np.ndarray, cols: np.ndarray, n: int, level: np.ndarray, in_deg: np.ndarray
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, tuple]:
-    """Degree-bucketed gather layout for the wavefront engine.
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, tuple, tuple]:
+    """Degree-bucketed, level-run-ordered gather layout for the wavefront engine.
 
     TPU gathers cost ~constant per INDEX (measured ~7ns), so the (n, max_in) padded
     table wastes most of the gather on sentinel slots when the mean in-degree (~1 for
@@ -245,12 +248,33 @@ def _wavefront_tables(
     ``H.reshape(-1)`` of shape (depth + 2, n + 1): slot for edge p -> i is
     ``(gap - 1) * (n + 1) + p_permuted`` with gap = level[i] - level[p]; pad slots
     point at the always-zero sentinel column (ring row 0, col n).
+
+    WITHIN each bucket, nodes sort by level, and ``wf_level_runs`` records the
+    resulting contiguous (start, end, level) column runs. The engine's input/output
+    time-skews then compile to a few hundred STATIC slices (measured ~0.03ms at
+    N=8192) instead of per-node dynamic-slice gathers or (T, N) element gathers
+    (measured 15-29ms — strided/transposed gathers are the chip's worst pattern).
     """
-    order = np.argsort(in_deg, kind="stable")  # deg-0 first, then ascending
+    # bucket b holds in-degrees (2^(b-2), 2^(b-1)] (width 2^(b-1)); bucket 0 = deg 0
+    bucket_id = np.zeros(n, dtype=np.int64)
+    nz = in_deg > 0
+    bucket_id[nz] = 1 + np.ceil(np.log2(in_deg[nz])).astype(np.int64)
+    bucket_id[in_deg == 1] = 1
+    order = np.lexsort((np.arange(n), level, bucket_id))  # (bucket, level, node)
     inv = np.empty(n, dtype=np.int64)
     inv[order] = np.arange(n)
 
-    deg_sorted = in_deg[order]
+    bucket_sorted = bucket_id[order]
+    level_sorted = level[order]
+    # Contiguous (start, end, level) column runs in the permuted order (static
+    # slice schedule for the time-skews).
+    change = np.flatnonzero(np.diff(level_sorted) != 0) + 1
+    starts_r = np.concatenate([[0], change])
+    ends_r = np.concatenate([change, [n]])
+    level_runs = tuple(
+        (int(s), int(e), int(level_sorted[s])) for s, e in zip(starts_r, ends_r)
+    )
+
     # preds per node (original ids), grouped by target
     e_order = np.argsort(rows, kind="stable")
     e_tgt, e_src = rows[e_order], cols[e_order]
@@ -260,11 +284,11 @@ def _wavefront_tables(
     mask_parts: list[np.ndarray] = []
     buckets: list[tuple[int, int, int]] = []
     row_len = n + 1
-    pos = int(np.searchsorted(deg_sorted, 1))  # first node with in-degree >= 1
+    pos = int(np.searchsorted(bucket_sorted, 1))  # first node with in-degree >= 1
     while pos < n:
-        d = int(deg_sorted[pos])
-        width = 1 << (d - 1).bit_length()  # next pow2 >= d
-        end = int(np.searchsorted(deg_sorted, width + 1))
+        b = int(bucket_sorted[pos])
+        width = 1 << (b - 1)
+        end = int(np.searchsorted(bucket_sorted, b + 1))
         cnt = end - pos
         tbl = np.full((cnt, width), row_len - 1, dtype=np.int64)  # sentinel: row0,col n
         msk = np.zeros((cnt, width), dtype=np.float32)
@@ -285,7 +309,7 @@ def _wavefront_tables(
 
     wf_idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, dtype=np.int64)
     wf_mask = np.concatenate(mask_parts) if mask_parts else np.zeros(0, dtype=np.float32)
-    return order, inv, wf_idx, wf_mask, tuple(buckets)
+    return order, inv, wf_idx, wf_mask, tuple(buckets), level_runs
 
 
 def build_network(
@@ -343,13 +367,14 @@ def build_network(
         and (depth + 2) * (n + 1) < 2**31
     )
     if wavefront:
-        wf_perm, wf_inv, wf_idx, wf_mask, wf_buckets = _wavefront_tables(
+        wf_perm, wf_inv, wf_idx, wf_mask, wf_buckets, wf_level_runs = _wavefront_tables(
             rows, cols, n, level, in_deg
         )
     else:
         wf_perm = wf_inv = wf_idx = np.zeros(0, dtype=np.int64)
         wf_mask = np.zeros(0, dtype=np.float32)
         wf_buckets = ()
+        wf_level_runs = ()
 
     return RiverNetwork(
         edge_src=jnp.asarray(cols, dtype=jnp.int32),
@@ -371,5 +396,6 @@ def build_network(
         wf_idx=jnp.asarray(wf_idx, dtype=jnp.int32),
         wf_mask=jnp.asarray(wf_mask, dtype=jnp.float32),
         wf_buckets=wf_buckets,
+        wf_level_runs=wf_level_runs,
         wavefront=bool(wavefront),
     )

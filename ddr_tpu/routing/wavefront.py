@@ -7,7 +7,7 @@ per-level gather/scatter is tiny, so the chip idles on fixed per-op cost — mea
 
 This module reschedules the SAME arithmetic on anti-diagonals of the (timestep,
 level) grid. Reach ``i`` at longest-path level ``L(i)`` computes its timestep-``t``
-value at wave ``w = t + L(i)``; its dependencies —
+value at wave ``w = t + L(i) + 1``; its dependencies —
 
     x_t[i] = b_t(i) + c1_t(i) * sum_p x_t[p]              (same-timestep solve)
     b_t(i) = c2*sum_p max(x_{t-1}[p], lb) + c3*x_{t-1}[i] + c4*q'_{t-1}[i]
@@ -15,7 +15,8 @@ value at wave ``w = t + L(i)``; its dependencies —
 
 — were all produced at strictly earlier waves, so every wave updates ALL N reaches
 at once (each for a different in-flight timestep) and the whole route is
-``T - 1 + depth`` fully-vectorized waves.
+``T + depth`` waves. The hotstart solve ``(I - N) q0 = q'_0`` rides in-band as the
+t = 0 diagonal (c1 = 1, b = q'_0), so no separate solve exists.
 
 TPU cost shaping (each documented by measurement in docs/tpu.md):
 
@@ -24,15 +25,17 @@ TPU cost shaping (each documented by measurement in docs/tpu.md):
   sum (raw) and the NEXT wave's previous-timestep inflow sum (clamped) — the inflow
   a reach needs at wave w+1 is exactly what its solve gather read at wave w, carried
   as a per-reach running sum instead of re-gathered.
-* Degree-bucketed compact tables (RiverNetwork.wf_*): gathered indices ~ n_edges,
-  not n * max_in_degree.
+* Degree-bucketed compact tables (RiverNetwork.wf_*): gathered indices ~ n_edges.
 * Clamp semantics match route_step / the reference (clamp ONCE after the full
   solve): the ring stores raw solve values; clamps happen at previous-timestep read
   sites and on emission.
-* The time-skew applied to inputs (``qs[w, i] = q'[w - 1 - L(i), i]``) and outputs
-  (``x_t[i] = ys[t + L(i) - 1, i]``) is expressed as per-node dynamic slices of
-  time-contiguous rows (cost ~ per node), never as (T, N) element gathers (cost ~
-  per element, ~100x more).
+* The input/output time-skews compile to STATIC level-run slices
+  (RiverNetwork.wf_level_runs; nodes are level-contiguous within each degree
+  bucket) — measured ~0.03ms vs 15-29ms for dynamic-slice row gathers, element
+  gathers, or anything fused with a transpose, the chip's worst access patterns.
+  The one remaining per-element permutation (q_prime columns into wf order) can be
+  hoisted to the host: pass ``q_prime_permuted=True`` with pre-permuted inflows
+  (``q_prime[:, np.asarray(network.wf_perm)]``) to remove it entirely.
 
 This is a schedule change only: per-reach arithmetic and predecessor summation
 order match ``mc.route_step`` (reference semantics:
@@ -50,11 +53,18 @@ from ddr_tpu.routing.network import RiverNetwork
 __all__ = ["wavefront_route_core"]
 
 
-def _shift_rows(rows: jnp.ndarray, starts: jnp.ndarray, width: int) -> jnp.ndarray:
-    """Per-row dynamic slice: out[i] = rows[i, starts[i] : starts[i] + width]."""
-    return jax.vmap(
-        lambda row, s: jax.lax.dynamic_slice(row, (s,), (width,))
-    )(rows, starts)
+def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.ndarray:
+    """Assemble (width, N) from static per-run row windows of ``src``.
+
+    Run (s, e, L) contributes ``src[start_of(L) : start_of(L) + width, s:e]`` —
+    every slice is static (``start_of`` is evaluated on Python ints at trace
+    time), so XLA compiles pure streaming copies.
+    """
+    blocks = [
+        jax.lax.dynamic_slice(src, (start_of(L), s), (width, e - s))
+        for (s, e, L) in runs
+    ]
+    return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 else blocks[0]
 
 
 def wavefront_route_core(
@@ -62,50 +72,40 @@ def wavefront_route_core(
     celerity_fn,
     coefficients_fn,
     q_prime: jnp.ndarray,
-    q0: jnp.ndarray,
+    q_init: jnp.ndarray | None,
     discharge_lb: float,
+    q_prime_permuted: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Route timesteps 1..T-1 by wavefront; returns (runoff (T, N), final (N,)).
+    """Route timesteps 0..T-1 by wavefront, entirely in wf_perm order.
 
     ``celerity_fn(q_prev) -> c`` and ``coefficients_fn(c) -> (c1, c2, c3, c4)``
-    close over per-reach channels/params ALREADY PERMUTED by ``network.wf_perm``
-    (the caller does this once; see mc.route). ``q_prime`` (T, N) and ``q0`` (N,)
-    arrive in original order; outputs are returned in original order.
+    close over per-reach channels/params ALREADY PERMUTED by ``network.wf_perm``.
+    ``q_init`` (wf order) carries state across chunks; ``None`` hotstarts in-band
+    from ``q_prime[0]``. Returns ``(runoff (T, N), final (N,))`` in wf order —
+    the caller aggregates gauges / un-permutes as needed.
     """
     T, n = q_prime.shape
     depth = network.depth
-    if T < 2:
-        return q0[None, :][:T], q0
-
-    perm, inv = network.wf_perm, network.wf_inv
-    level_p = network.level[perm]  # (N,) levels in bucket order
-    n_waves = (T - 1) + depth
+    runs = network.wf_level_runs
+    level_p = network.level[network.wf_perm]  # (N,) levels, wf order
+    n_waves = T + depth
     row_len = n + 1
-    q0p = q0[perm]
 
-    # Input skew, slice-based: node i's wave series is its q' row shifted by L(i).
-    # Only q'[0 .. T-2] feeds steps; out-of-range waves clamp to the edge columns
-    # (their outputs are masked anyway).
-    qT = q_prime.T[perm][:, : T - 1]  # (N, T-1)
+    qp_p = q_prime if q_prime_permuted else q_prime[:, network.wf_perm]
+
+    # Input skew: wave w hands reach i q'[clip(t-1, 0, T-2)] with t = w - 1 - L(i);
+    # the clip's edge copies live in the pad rows, and the t = 0 row is q'[0] (the
+    # hotstart forcing, used raw).
+    right_edge = qp_p[T - 2 : T - 1] if T >= 2 else qp_p[:1]
     padded = jnp.concatenate(
         [
-            jnp.repeat(qT[:, :1], depth, axis=1),
-            qT,
-            jnp.repeat(qT[:, -1:], depth, axis=1),
+            jnp.broadcast_to(qp_p[0], (depth + 1, n)),
+            qp_p[: T - 1],
+            jnp.broadcast_to(right_edge[0], (depth, n)),
         ],
-        axis=1,
-    )
-    qs = _shift_rows(padded, depth - level_p, n_waves).T  # (W, N)
-    qs = jnp.maximum(qs, discharge_lb)
-
-    # Previous-timestep inflow sums: wave 1's only consumers are level-0 nodes
-    # (predecessor-free by definition), so the initial value is exactly zero;
-    # every later wave carries the clamped reduction of the previous wave's gather
-    # (which reads q0 out of the ring's init rows for t=1 consumers).
-    s_init = jnp.zeros_like(q0p)
-
-    q0_pad = jnp.concatenate([q0p, jnp.zeros(1, q0.dtype)])
-    ring0 = jnp.broadcast_to(q0_pad, (depth + 2, row_len))
+        axis=0,
+    )  # (T + 2*depth, n); row r <-> q' index clip(r - (depth+1), 0, T-2)
+    qs = _skew_by_level_runs(padded, runs, lambda L: depth - L, n_waves)  # (W, n)
 
     wf_idx, wf_mask, buckets = network.wf_idx, network.wf_mask, network.wf_buckets
     n_deg0 = buckets[0][0] if buckets else n
@@ -124,30 +124,41 @@ def wavefront_route_core(
             off += cnt
         return jnp.concatenate(parts)
 
+    ring0 = jnp.zeros((depth + 2, row_len), qp_p.dtype)
+    s0 = jnp.zeros(n, qp_p.dtype)
+    t_of_wave = lambda w: w - 1 - level_p  # noqa: E731
+
     def body(carry, wave_inputs):
         ring, s_state = carry
-        q_prime_prev, w = wave_inputs
+        q_row, w = wave_inputs
+        t_node = t_of_wave(w)
         q_prev = jnp.maximum(ring[0, :n], discharge_lb)  # clamped x_{t-1}[i]
         c = celerity_fn(q_prev)
         c1, c2, c3, c4 = coefficients_fn(c)
         gathered = ring.reshape(-1)[wf_idx]  # THE gather: raw x_t[p] per edge slot
         x_pred = reduce_buckets(gathered, clamped=False)
         s_next = reduce_buckets(gathered, clamped=True)  # wave w+1's inflow sums
-        b = c2 * s_state + c3 * q_prev + c4 * q_prime_prev
-        y = b + c1 * x_pred  # raw solve value: downstream consumers read this
-        # Outside the valid (t, L) region keep the initial state: early slots must
-        # read as x_0 (correctness), late slots must stay finite (hygiene).
-        ok = (w > level_p) & (w <= level_p + (T - 1))
-        y = jnp.where(ok, y, q0p)
+
+        b_step = c2 * s_state + c3 * q_prev + c4 * jnp.maximum(q_row, discharge_lb)
+        is_hot = t_node == 0
+        b = jnp.where(is_hot, q_row, b_step)  # hotstart: (I - N) q0 = q'_0, raw
+        c1_eff = jnp.where(is_hot, 1.0, c1)
+        y = b + c1_eff * x_pred  # raw solve value: downstream consumers read this
+        if q_init is not None:
+            y = jnp.where(is_hot, jnp.maximum(q_init, discharge_lb), y)
+        # Outside the valid (t, L) region store zeros: never read by valid
+        # consumers (their sources are valid at the waves they reference), and
+        # keeps late-wave garbage finite.
+        ok = (t_node >= 0) & (t_node <= T - 1)
+        y = jnp.where(ok, y, 0.0)
         ring = jnp.concatenate(
             [jnp.concatenate([y, jnp.zeros(1, y.dtype)])[None], ring[:-1]], axis=0
         )
         return (ring, s_next), jnp.maximum(y, discharge_lb)
 
     waves = jnp.arange(1, n_waves + 1)
-    (_, _), ys = jax.lax.scan(body, (ring0, s_init), (qs, waves))  # ys: (W, N)
+    (_, _), ys = jax.lax.scan(body, (ring0, s0), (qs, waves))  # ys: (W, n)
 
-    # Un-skew + un-permute, slice-based: x_t[i] sits at ys[t + L(i) - 1, i].
-    routed = _shift_rows(ys.T, level_p, T - 1)[inv].T  # (T-1, N) original order
-    runoff = jnp.concatenate([q0[None, :], routed], axis=0)
-    return runoff, routed[-1]
+    # Un-skew (static runs): x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L).
+    runoff = _skew_by_level_runs(ys, runs, lambda L: L, T)
+    return runoff, runoff[-1]

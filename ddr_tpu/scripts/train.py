@@ -118,7 +118,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                         jnp.asarray(obs_mask),
                     )
                     loss = float(loss)  # device sync: the timing covers the whole step
-                daily = np.asarray(daily)  # (D-1, G)
+                daily = np.asarray(daily)  # (D-2, G)
                 log.info(
                     f"epoch {epoch} mini-batch {i}: loss={loss:.5f} "
                     f"({throughput.last_rate:,.0f} reach-timesteps/s)"

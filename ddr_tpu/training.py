@@ -84,8 +84,8 @@ def make_train_step(
 
     - ``attrs``: (N, A) z-scored KAN inputs
     - ``q_prime``: (T, N) hourly lateral inflow (already flow-scaled)
-    - ``obs_daily``: (D-1, G) observed daily discharge aligned to days 1..D-1
-    - ``obs_mask``: (D-1, G) True where the observation is valid
+    - ``obs_daily``: (D-2, G) observed daily discharge aligned to days 1..D-2
+    - ``obs_mask``: (D-2, G) True where the observation is valid
     """
     n_segments = channels.length.shape[0]
 
@@ -95,7 +95,7 @@ def make_train_step(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
         result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
-        daily = daily_from_hourly(result.runoff, tau)  # (D-1, G)
+        daily = daily_from_hourly(result.runoff, tau)  # (D-2, G)
         mask = obs_mask.at[:warmup].set(False)
         err = jnp.where(mask, jnp.abs(daily - jnp.where(mask, obs_daily, 0.0)), 0.0)
         loss = err.sum() / jnp.maximum(mask.sum(), 1)
@@ -138,7 +138,7 @@ def make_batch_train_step(
             raw, parameter_ranges, log_space_parameters, defaults, channels.length.shape[0]
         )
         result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
-        daily = daily_from_hourly(result.runoff, tau)  # (D-1, G)
+        daily = daily_from_hourly(result.runoff, tau)  # (D-2, G)
         mask = obs_mask.at[:warmup].set(False)
         err = jnp.where(mask, jnp.abs(daily - jnp.where(mask, obs_daily, 0.0)), 0.0)
         loss = err.sum() / jnp.maximum(mask.sum(), 1)

@@ -94,8 +94,8 @@ class TestForwardParity:
         _assert_close(wf.runoff, st.runoff, rtol=5e-4, atol=1e-4)
 
     def test_single_timestep(self):
-        """T=1 exercises wavefront_route_core's early return (forced: auto-select
-        would fall back to the step engine below T=2)."""
+        """T=1 runs the wave scan with only the in-band hotstart diagonal active:
+        runoff is a single row equal to the clamped hotstart state."""
         network, channels, _, params, q_prime = _setup(t=24)
         wf = route(network, channels, params, q_prime[:1], engine="wavefront")
         st = route(network, channels, params, q_prime[:1], engine="step")
