@@ -1,0 +1,221 @@
+"""Field-level binsparse on-disk contract + MERIT pipeline edge cases.
+
+Mirrors the reference engine suite's granular coverage
+(/root/reference/tests/engine/core/test_zarr_io.py,
+/root/reference/tests/engine/merit/test_{graph,build,io}.py): every metadata
+attribute and array the binsparse spec promises, plus the degenerate networks
+(isolated COMIDs, single nodes, headwater-only gauges) that real MERIT extracts
+contain."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+from scipy import sparse
+
+from ddr_tpu.engine.core import (
+    coo_from_zarr,
+    coo_from_zarr_group,
+    coo_to_zarr,
+    coo_to_zarr_group,
+    read_coo_arrays,
+)
+from ddr_tpu.engine.merit import build_upstream_dict, create_adjacency_matrix
+from ddr_tpu.io import zarrlite
+
+
+def _fp(rows):
+    """Flowpath table from (COMID, up1..up4, lengthkm, slope) tuples."""
+    return pd.DataFrame(
+        rows, columns=["COMID", "up1", "up2", "up3", "up4", "lengthkm", "slope"]
+    )
+
+
+@pytest.fixture()
+def y_network():
+    """10, 20 -> 30 -> 40, plus isolated COMID 99."""
+    return _fp(
+        [
+            (10, 0, 0, 0, 0, 1.0, 0.001),
+            (20, 0, 0, 0, 0, 2.0, 0.002),
+            (30, 10, 20, 0, 0, 3.0, 0.003),
+            (40, 30, 0, 0, 0, 4.0, 0.004),
+            (99, 0, 0, 0, 0, 9.0, 0.009),
+        ]
+    )
+
+
+class TestBinsparseOnDiskContract:
+    @pytest.fixture()
+    def store(self, tmp_path, y_network):
+        coo, order = create_adjacency_matrix(y_network)
+        path = tmp_path / "adj.zarr"
+        coo_to_zarr(coo, order, path, "merit")
+        return path, coo, order
+
+    def test_required_arrays_exist(self, store):
+        path, _, _ = store
+        root = zarrlite.open_group(path)
+        for name in ("indices_0", "indices_1", "values", "order"):
+            assert name in root, name
+
+    def test_format_attr(self, store):
+        root = zarrlite.open_group(store[0])
+        assert root.attrs["format"] == "COO"
+
+    def test_shape_attr_matches_matrix(self, store):
+        path, coo, _ = store
+        root = zarrlite.open_group(path)
+        assert tuple(root.attrs["shape"]) == coo.shape == (5, 5)
+
+    def test_geodataset_attr(self, store):
+        assert zarrlite.open_group(store[0]).attrs["geodataset"] == "merit"
+
+    def test_data_types_attr_matches_arrays(self, store):
+        root = zarrlite.open_group(store[0])
+        dt = root.attrs["data_types"]
+        for name in ("indices_0", "indices_1", "values"):
+            assert root[name].read().dtype == np.dtype(dt[name])
+
+    def test_indices_are_int32(self, store):
+        root = zarrlite.open_group(store[0])
+        assert root["indices_0"].read().dtype == np.int32
+        assert root["indices_1"].read().dtype == np.int32
+
+    def test_values_all_ones_uint8(self, store):
+        vals = zarrlite.open_group(store[0])["values"].read()
+        assert vals.dtype == np.uint8
+        np.testing.assert_array_equal(vals, 1)
+
+    def test_coo_is_lower_triangular(self, store):
+        root = zarrlite.open_group(store[0])
+        assert np.all(root["indices_0"].read() > root["indices_1"].read())
+
+    def test_order_roundtrips_comids(self, store):
+        path, _, order = store
+        _, back = coo_from_zarr(path)
+        assert back == order
+        assert all(isinstance(c, (int, np.integer)) for c in back)
+
+    def test_matrix_roundtrips_exactly(self, store):
+        path, coo, _ = store
+        back, _ = coo_from_zarr(path)
+        np.testing.assert_array_equal(back.toarray(), coo.toarray())
+
+    def test_read_coo_arrays_matches_memory(self, store):
+        path, coo, order = store
+        root = zarrlite.open_group(path)
+        back, raw_order = read_coo_arrays(root)
+        np.testing.assert_array_equal(back.toarray(), coo.toarray())
+        np.testing.assert_array_equal(raw_order, np.asarray(order, dtype=np.int64))
+
+    def test_subgroup_carries_gauge_attrs(self, tmp_path, y_network):
+        coo, order = create_adjacency_matrix(y_network)
+        root = zarrlite.create_group(tmp_path / "gauges.zarr")
+        sub = coo_to_zarr_group(
+            root, "01013500", coo, order, "merit", gage_catchment=30, gage_idx=2
+        )
+        assert sub.attrs["gage_catchment"] == 30
+        assert sub.attrs["gage_idx"] == 2
+        back, back_order = coo_from_zarr_group(root["01013500"])
+        assert back_order == order
+        np.testing.assert_array_equal(back.toarray(), coo.toarray())
+
+
+class TestMeritEdgeCases:
+    def test_isolated_comid_appended_after_connected_order(self, y_network):
+        coo, order = create_adjacency_matrix(y_network)
+        assert order[-1] == 99
+        assert set(order[:-1]) == {10, 20, 30, 40}
+
+    def test_isolated_comid_has_no_edges(self, y_network):
+        coo, order = create_adjacency_matrix(y_network)
+        iso = order.index(99)
+        assert iso not in set(coo.row.tolist()) | set(coo.col.tolist())
+
+    def test_edge_count_matches_connections(self, y_network):
+        coo, _ = create_adjacency_matrix(y_network)
+        assert coo.nnz == 3  # 10->30, 20->30, 30->40
+
+    def test_matrix_encodes_expected_edges(self, y_network):
+        coo, order = create_adjacency_matrix(y_network)
+        pos = {c: i for i, c in enumerate(order)}
+        edges = set(zip(coo.row.tolist(), coo.col.tolist()))
+        assert edges == {
+            (pos[30], pos[10]),
+            (pos[30], pos[20]),
+            (pos[40], pos[30]),
+        }
+
+    def test_topological_order_valid(self, y_network):
+        coo, order = create_adjacency_matrix(y_network)
+        pos = {c: i for i, c in enumerate(order)}
+        assert pos[10] < pos[30] < pos[40]
+        assert pos[20] < pos[30]
+
+    def test_single_connection_network(self):
+        coo, order = create_adjacency_matrix(
+            _fp([(1, 0, 0, 0, 0, 1.0, 0.001), (2, 1, 0, 0, 0, 1.0, 0.001)])
+        )
+        assert order == [1, 2]
+        assert coo.nnz == 1
+
+    def test_all_isolated_raises(self):
+        with pytest.raises(ValueError, match="No upstream connections"):
+            create_adjacency_matrix(
+                _fp([(1, 0, 0, 0, 0, 1.0, 0.001), (2, 0, 0, 0, 0, 1.0, 0.001)])
+            )
+
+    def test_upstream_dict_ignores_nonpositive_and_nan(self):
+        fp = _fp(
+            [
+                (10, 0, -1, 0, 0, 1.0, 0.001),
+                (20, 10, np.nan, 0, 0, 1.0, 0.001),
+            ]
+        )
+        assert build_upstream_dict(fp) == {20: [10]}
+
+    def test_upstream_dict_sorts_upstreams(self):
+        fp = _fp([(30, 20, 10, 0, 0, 1.0, 0.001)])
+        assert build_upstream_dict(fp) == {30: [10, 20]}
+
+    def test_non_dendritic_rejected(self):
+        # 10 drains into BOTH 20 and 30
+        fp = _fp(
+            [
+                (20, 10, 0, 0, 0, 1.0, 0.001),
+                (30, 10, 0, 0, 0, 1.0, 0.001),
+            ]
+        )
+        with pytest.raises(AssertionError, match="multiple successors"):
+            create_adjacency_matrix(fp)
+
+    def test_missing_up_columns_tolerated(self):
+        fp = pd.DataFrame({"COMID": [1, 2], "up1": [0, 1]})
+        assert build_upstream_dict(fp) == {2: [1]}
+
+    def test_self_loop_is_removed_as_cycle(self):
+        fp = _fp(
+            [
+                (10, 10, 0, 0, 0, 1.0, 0.001),  # self-cycle
+                (20, 0, 0, 0, 0, 1.0, 0.001),
+                (30, 20, 0, 0, 0, 1.0, 0.001),
+            ]
+        )
+        coo, order = create_adjacency_matrix(fp)
+        assert 10 not in order  # cycle flowpath dropped, rest rebuilt
+        assert set(order) == {20, 30}
+
+    def test_two_cycle_removed(self):
+        fp = _fp(
+            [
+                (10, 20, 0, 0, 0, 1.0, 0.001),
+                (20, 10, 0, 0, 0, 1.0, 0.001),
+                (30, 0, 0, 0, 0, 1.0, 0.001),
+                (40, 30, 0, 0, 0, 1.0, 0.001),
+            ]
+        )
+        coo, order = create_adjacency_matrix(fp)
+        assert set(order) == {30, 40}
+        assert coo.nnz == 1

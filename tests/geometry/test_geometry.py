@@ -203,3 +203,113 @@ class TestGeometryScript:
         # Downstream-most reaches accumulate more discharge.
         q_mean = root["discharge_mean"].read()
         assert q_mean[9] > q_mean[0]
+
+
+class TestTrapezoidPhysics:
+    """Physical-consistency battery mirroring the reference's trapezoid suite
+    (/root/reference/tests/geometry): internal consistency of the returned
+    geometry, bound enforcement, and monotone responses to each driver."""
+
+    def _geom(self, **over):
+        import jax.numpy as jnp
+
+        from ddr_tpu.geometry.trapezoidal import trapezoidal_geometry
+
+        base = dict(
+            n=jnp.full(6, 0.035),
+            p_spatial=jnp.full(6, 21.0),
+            q_spatial=jnp.full(6, 0.45),
+            discharge=jnp.asarray([0.5, 1.0, 5.0, 20.0, 100.0, 500.0]),
+            slope=jnp.full(6, 2e-3),
+        )
+        base.update(over)
+        return {k: np.asarray(v) for k, v in trapezoidal_geometry(**base).items()}
+
+    def test_returns_all_expected_keys(self):
+        g = self._geom()
+        assert set(g) == {
+            "depth", "top_width", "bottom_width", "side_slope",
+            "cross_sectional_area", "wetted_perimeter", "hydraulic_radius",
+            "velocity",
+        }
+
+    def test_all_values_positive_and_finite(self):
+        for name, v in self._geom().items():
+            assert np.all(np.isfinite(v)), name
+            assert np.all(v > 0), name
+
+    def test_area_consistent_with_trapezoid_formula(self):
+        g = self._geom()
+        want = (g["top_width"] + g["bottom_width"]) * g["depth"] / 2.0
+        np.testing.assert_allclose(g["cross_sectional_area"], want, rtol=1e-5)
+
+    def test_hydraulic_radius_consistent(self):
+        g = self._geom()
+        np.testing.assert_allclose(
+            g["hydraulic_radius"],
+            g["cross_sectional_area"] / g["wetted_perimeter"],
+            rtol=1e-5,
+        )
+
+    def test_top_width_follows_leopold_maddock(self):
+        g = self._geom()
+        np.testing.assert_allclose(
+            g["top_width"], 21.0 * g["depth"] ** (0.45 + 1e-6), rtol=1e-5
+        )
+
+    def test_depth_lower_bound_applied(self):
+        import jax.numpy as jnp
+
+        g = self._geom(discharge=jnp.full(6, 1e-9), depth_lb=0.05)
+        np.testing.assert_allclose(g["depth"], 0.05, rtol=1e-6)
+
+    def test_bottom_width_lower_bound_applied(self):
+        import jax.numpy as jnp
+
+        # q -> 1 (triangular): bottom width collapses onto its floor
+        g = self._geom(q_spatial=jnp.full(6, 0.999), bottom_width_lb=0.2)
+        assert np.all(g["bottom_width"] >= 0.2 - 1e-6)
+
+    def test_higher_roughness_gives_greater_depth(self):
+        import jax.numpy as jnp
+
+        lo = self._geom(n=jnp.full(6, 0.02))
+        hi = self._geom(n=jnp.full(6, 0.08))
+        assert np.all(hi["depth"] > lo["depth"])
+
+    def test_steeper_slope_gives_lower_depth_higher_velocity(self):
+        import jax.numpy as jnp
+
+        mild = self._geom(slope=jnp.full(6, 1e-4))
+        steep = self._geom(slope=jnp.full(6, 1e-2))
+        assert np.all(steep["depth"] < mild["depth"])
+        assert np.all(steep["velocity"] > mild["velocity"])
+
+    def test_q_near_zero_hits_side_slope_floor(self):
+        import jax.numpy as jnp
+
+        g = self._geom(q_spatial=jnp.full(6, 1e-6))
+        # q -> 0 drives the raw side slope to ~0; the clamp floor (0.5, the
+        # reference's physical band) takes over, leaving top - bottom = depth.
+        np.testing.assert_allclose(g["side_slope"], 0.5, rtol=1e-5)
+        np.testing.assert_allclose(
+            g["top_width"] - g["bottom_width"], g["depth"], rtol=1e-4
+        )
+
+    def test_velocity_satisfies_manning(self):
+        g = self._geom()
+        v_manning = (1.0 / 0.035) * g["hydraulic_radius"] ** (2.0 / 3.0) * np.sqrt(2e-3)
+        np.testing.assert_allclose(g["velocity"], v_manning, rtol=1e-4)
+
+    def test_discharge_closure_approximately_recovered(self):
+        """v * A should reproduce the driving discharge (the Manning inversion is
+        exact for the wide-channel closure; tolerance covers the trapezoid
+        correction)."""
+        g = self._geom()
+        q_back = g["velocity"] * g["cross_sectional_area"]
+        driving = np.array([0.5, 1.0, 5.0, 20.0, 100.0, 500.0])
+        np.testing.assert_allclose(q_back, driving, rtol=0.35)
+
+    def test_output_shapes_match_input(self):
+        for v in self._geom().values():
+            assert v.shape == (6,)

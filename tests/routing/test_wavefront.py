@@ -93,6 +93,26 @@ class TestForwardParity:
         st = route(network, channels, params, q_prime, engine="step")
         _assert_close(wf.runoff, st.runoff, rtol=5e-4, atol=1e-4)
 
+    def test_host_permuted_inflow_fast_path(self):
+        """q_prime_permuted=True with host-pre-permuted columns must match the
+        in-jit permute exactly (the documented hoist contract), and the flag must
+        refuse on the step engine."""
+        network, channels, gauges, params, q_prime = _setup(seed=7)
+        qp_host = jnp.asarray(
+            np.asarray(q_prime)[:, np.asarray(network.wf_perm)]
+        )
+        a = route(network, channels, params, q_prime, gauges=gauges, engine="wavefront")
+        b = route(
+            network, channels, params, qp_host, gauges=gauges,
+            engine="wavefront", q_prime_permuted=True,
+        )
+        np.testing.assert_array_equal(np.asarray(a.runoff), np.asarray(b.runoff))
+        np.testing.assert_array_equal(
+            np.asarray(a.final_discharge), np.asarray(b.final_discharge)
+        )
+        with pytest.raises(ValueError, match="q_prime_permuted"):
+            route(network, channels, params, qp_host, engine="step", q_prime_permuted=True)
+
     def test_single_timestep(self):
         """T=1 runs the wave scan with only the in-band hotstart diagonal active:
         runoff is a single row equal to the clamped hotstart state."""
