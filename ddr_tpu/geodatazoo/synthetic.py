@@ -141,8 +141,8 @@ def observe(basin: SyntheticBasin, cfg) -> SyntheticBasin:
     )
     params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
     res = route(network, channels, params, jnp.asarray(basin.q_prime), gauges=gauges)
-    daily = compute_daily_runoff(np.asarray(res.runoff).T, tau=cfg.params.tau)  # (G, D-2)
-    basin.obs_daily = daily.T  # (D-2, G)
+    daily = compute_daily_runoff(np.asarray(res.runoff).T, tau=cfg.params.tau)  # (G, D-1)
+    basin.obs_daily = daily.T  # (D-1, G)
 
     rd = basin.routing_data
     n_days = len(rd.dates.daily_time_range)

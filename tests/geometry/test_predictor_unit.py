@@ -1,0 +1,201 @@
+"""GeometryPredictor unit tests with a stub KAN — the reference's mock strategy
+(/root/reference/tests/geometry/ TestGeometryPredictor,
+TestAdaptAttributes, TestComputeGeometryStatistics) without checkpoint round trips."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.geometry.adapters import (
+    HYDROATLAS_TO_MERIT,
+    MERIT_ATTRIBUTE_NAMES,
+    adapt_attributes,
+    detect_source,
+)
+from ddr_tpu.geometry.predictor import GeometryPredictor
+from ddr_tpu.geometry.statistics import GEOMETRY_VARS, compute_geometry_statistics
+
+PARAM_RANGES = {"n": [0.015, 0.25], "q_spatial": [0.0, 1.0], "p_spatial": [1.0, 200.0]}
+
+
+class _StubKan:
+    """Deterministic stand-in for the flax KAN: constant sigmoid outputs."""
+
+    def __init__(self, outputs=("n", "q_spatial", "p_spatial"), value=0.5):
+        self.outputs = outputs
+        self.value = value
+
+    def apply(self, params, x):
+        return {k: jnp.full(x.shape[0], self.value, jnp.float32) for k in self.outputs}
+
+
+def _predictor(outputs=("n", "q_spatial", "p_spatial"), stats_ranges=None):
+    a = len(MERIT_ATTRIBUTE_NAMES)
+    return GeometryPredictor(
+        kan_model=_StubKan(outputs),
+        kan_params={},
+        attribute_names=list(MERIT_ATTRIBUTE_NAMES),
+        means=np.full(a, 5.0),
+        stds=np.full(a, 2.0),
+        parameter_ranges={k: PARAM_RANGES[k] for k in PARAM_RANGES if k in outputs or k != "p_spatial"},
+        log_space_parameters=["p_spatial"],
+        defaults={"p_spatial": 21.0},
+        attribute_minimums={"depth": 0.01, "bottom_width": 0.01, "slope": 0.001},
+        stats_ranges=stats_ranges,
+    )
+
+
+def _attrs(n=8, value=5.0):
+    return {name: np.full(n, value) for name in MERIT_ATTRIBUTE_NAMES}
+
+
+class TestPredictOutputs:
+    def test_returns_all_geometry_vars(self):
+        out = _predictor().predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        for var in GEOMETRY_VARS + ("velocity", "cross_sectional_area", "wetted_perimeter"):
+            assert var in out, var
+        for p in ("n", "p_spatial", "q_spatial"):
+            assert p in out, p
+
+    def test_output_shape(self):
+        out = _predictor().predict(_attrs(12), discharge=np.ones(12), slope=np.full(12, 0.01))
+        for v in out.values():
+            assert v.shape == (12,)
+
+    def test_all_values_positive(self):
+        out = _predictor().predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        for name, v in out.items():
+            assert (v > 0).all(), name
+
+    def test_n_within_configured_bounds(self):
+        out = _predictor().predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        lo, hi = PARAM_RANGES["n"]
+        assert (out["n"] >= lo).all() and (out["n"] <= hi).all()
+
+    def test_q_spatial_within_bounds(self):
+        out = _predictor().predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        assert (out["q_spatial"] >= 0).all() and (out["q_spatial"] <= 1).all()
+
+    def test_p_spatial_log_space_midpoint(self):
+        """sigmoid 0.5 through log-space [1, 200] lands at sqrt(200), not 100.5."""
+        out = _predictor().predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        np.testing.assert_allclose(out["p_spatial"], np.sqrt(200.0), rtol=2e-2)
+
+    def test_p_spatial_default_when_not_learned(self):
+        """A KAN trained without p_spatial falls back to the config default
+        (reference predictor behavior for MERIT-era checkpoints)."""
+        pred = _predictor(outputs=("n", "q_spatial"))
+        out = pred.predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        np.testing.assert_allclose(out["p_spatial"], 21.0, rtol=1e-6)
+
+    def test_deterministic(self):
+        p = _predictor()
+        a = p.predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        b = p.predict(_attrs(), discharge=np.ones(8), slope=np.full(8, 0.01))
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+    def test_accepts_hydroatlas_names(self):
+        """source='auto' converts HydroATLAS attributes before normalization."""
+        n = 6
+        attrs = {name: np.full(n, 5.0) for name in HYDROATLAS_TO_MERIT}
+        out = _predictor().predict(attrs, discharge=np.ones(n), slope=np.full(n, 0.01))
+        assert out["depth"].shape == (n,)
+        assert np.isfinite(out["depth"]).all()
+
+    def test_discharge_slope_floors_applied(self):
+        """Zero discharge / zero slope are floored by attribute_minimums, not NaN."""
+        out = _predictor().predict(
+            _attrs(), discharge=np.zeros(8), slope=np.zeros(8)
+        )
+        for name, v in out.items():
+            assert np.isfinite(v).all(), name
+
+    def test_predict_parameters_batched_path(self):
+        pred = _predictor()
+        params = pred.predict_parameters(np.zeros((16, len(MERIT_ATTRIBUTE_NAMES)), np.float32))
+        assert set(params) == {"n", "q_spatial", "p_spatial"}
+        assert params["n"].shape == (16,)
+
+    def test_ood_below_p10_warns(self, caplog):
+        pred = _predictor(
+            stats_ranges={name: {"p10": 1.0, "p90": 9.0} for name in MERIT_ATTRIBUTE_NAMES}
+        )
+        with caplog.at_level("WARNING"):
+            pred.predict(_attrs(value=-50.0), discharge=np.ones(8), slope=np.full(8, 0.01))
+        assert "below training p10" in caplog.text
+
+
+class TestDetectSourcePrecedence:
+    def test_merit_takes_precedence_over_extra_vars(self):
+        """A dataset carrying BOTH name sets detects as MERIT (reference
+        test_merit_takes_precedence_over_extra_vars)."""
+        attrs = {name: np.zeros(3) for name in MERIT_ATTRIBUTE_NAMES}
+        attrs.update({name: np.zeros(3) for name in HYDROATLAS_TO_MERIT})
+        assert detect_source(attrs) == "merit"
+
+    def test_partial_merit_is_not_detected(self):
+        attrs = {name: np.zeros(3) for name in MERIT_ATTRIBUTE_NAMES[:5]}
+        assert detect_source(attrs) is None
+
+    def test_extra_unknown_vars_ignored(self):
+        attrs = {name: np.zeros(3) for name in MERIT_ATTRIBUTE_NAMES}
+        attrs["extra_junk"] = np.zeros(3)
+        assert detect_source(attrs) == "merit"
+        out = adapt_attributes(attrs)
+        assert "extra_junk" not in out
+
+    def test_explicit_merit_source_skips_detection(self):
+        attrs = {name: np.arange(3.0) for name in MERIT_ATTRIBUTE_NAMES}
+        out = adapt_attributes(attrs, source="merit")
+        assert list(out) == list(MERIT_ATTRIBUTE_NAMES)
+
+    def test_missing_merit_attribute_raises(self):
+        attrs = {name: np.zeros(3) for name in MERIT_ATTRIBUTE_NAMES[:-1]}
+        with pytest.raises(ValueError, match="Missing MERIT"):
+            adapt_attributes(attrs, source="merit")
+
+
+class TestStatisticsBehaviors:
+    def _stats(self, q):
+        n_reach = q.shape[1]
+        return compute_geometry_statistics(
+            n=np.full(n_reach, 0.05),
+            p_spatial=np.full(n_reach, 21.0),
+            q_spatial=np.full(n_reach, 0.4),
+            slope=np.full(n_reach, 0.005),
+            daily_accumulated_discharge=q,
+        )
+
+    def test_constant_discharge_gives_equal_stats(self):
+        stats = self._stats(np.full((10, 4), 7.0))
+        for var in GEOMETRY_VARS:
+            np.testing.assert_allclose(stats[f"{var}_min"], stats[f"{var}_max"], rtol=1e-6)
+            np.testing.assert_allclose(stats[f"{var}_mean"], stats[f"{var}_median"], rtol=1e-6)
+
+    def test_attribute_minimums_forwarded(self):
+        n_reach = 3
+        stats = compute_geometry_statistics(
+            n=np.full(n_reach, 0.05),
+            p_spatial=np.full(n_reach, 21.0),
+            q_spatial=np.full(n_reach, 0.4),
+            slope=np.full(n_reach, 0.005),
+            daily_accumulated_discharge=np.full((4, n_reach), 1e-9),
+            attribute_minimums={"depth": 0.42},
+        )
+        np.testing.assert_allclose(stats["depth_min"], 0.42, rtol=1e-6)
+
+    def test_nan_days_ignored(self):
+        q = np.full((6, 3), 5.0)
+        q[2, :] = np.nan
+        stats = self._stats(q)
+        assert np.isfinite(stats["discharge_mean"]).all()
+        np.testing.assert_allclose(stats["discharge_mean"], 5.0, rtol=1e-6)
+
+    def test_median_reflects_distribution(self):
+        q = np.concatenate([np.full((9, 2), 1.0), np.full((1, 2), 100.0)])
+        stats = self._stats(q)
+        np.testing.assert_allclose(stats["discharge_median"], 1.0, rtol=1e-6)
+        assert (stats["discharge_mean"] > 10.0).all()

@@ -1,0 +1,150 @@
+"""Unit tests for the shared script utilities, at the reference's granularity
+(/root/reference/tests/scripts/test_scripts_utils.py: TestComputeDailyRunoff,
+TestResolveLearningRate, TestSafePercentile, TestSafeMean) plus the routing
+terminal summary (TestPrintRoutingSummary)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ddr_tpu.scripts.router import print_routing_summary
+from ddr_tpu.scripts_utils import (
+    compute_daily_runoff,
+    resolve_learning_rate,
+    safe_mean,
+    safe_percentile,
+)
+
+
+class TestComputeDailyRunoff:
+    def test_shape(self):
+        """D-day window of (D-1)*24 hourly steps -> D-2 daily values."""
+        d = 10
+        hourly = np.random.default_rng(0).uniform(0, 5, (3, (d - 1) * 24))
+        daily = compute_daily_runoff(hourly, tau=3)
+        assert daily.shape == (3, d - 2)
+
+    def test_known_values(self):
+        """Constant signal survives trim + block mean exactly."""
+        hourly = np.full((2, 9 * 24), 7.5)
+        daily = compute_daily_runoff(hourly, tau=3)
+        np.testing.assert_allclose(daily, np.full((2, 8), 7.5), rtol=1e-12)
+
+    def test_block_mean_of_step_signal(self):
+        """A signal constant within each post-trim 24h block reproduces the block
+        values exactly (downsample is an exact block mean)."""
+        tau = 3
+        t_total = 6 * 24
+        hourly = np.zeros((1, t_total))
+        sliced_len = t_total - (13 + tau) - (11 - tau)
+        n_days = sliced_len // 24
+        vals = np.arange(1.0, n_days + 1)
+        start = 13 + tau
+        for i, v in enumerate(vals):
+            hourly[0, start + 24 * i : start + 24 * (i + 1)] = v
+        daily = compute_daily_runoff(hourly, tau=tau)
+        np.testing.assert_allclose(daily[0], vals, rtol=1e-12)
+
+    def test_different_tau_shifts_window(self):
+        rng = np.random.default_rng(1)
+        hourly = rng.uniform(0, 5, (1, 8 * 24))
+        d3 = compute_daily_runoff(hourly, tau=3)
+        d5 = compute_daily_runoff(hourly, tau=5)
+        assert d3.shape == d5.shape
+        assert not np.allclose(d3, d5)
+
+    def test_tau_window_matches_manual_slice(self):
+        tau = 4
+        hourly = np.random.default_rng(2).uniform(0, 5, (2, 7 * 24))
+        daily = compute_daily_runoff(hourly, tau=tau)
+        sliced = hourly[:, 13 + tau : -11 + tau]
+        nd = sliced.shape[1] // 24
+        manual = sliced[:, : nd * 24].reshape(2, nd, 24).mean(axis=2)
+        np.testing.assert_allclose(daily, manual, rtol=1e-6)
+
+
+class TestResolveLearningRate:
+    def test_exact_match(self):
+        assert resolve_learning_rate({1: 0.01, 3: 0.001}, 3) == 0.001
+
+    def test_fallback_to_latest_before(self):
+        assert resolve_learning_rate({1: 0.01, 3: 0.001}, 2) == 0.01
+        assert resolve_learning_rate({1: 0.01, 3: 0.001}, 10) == 0.001
+
+    def test_before_first_entry_uses_first(self):
+        assert resolve_learning_rate({5: 0.1}, 1) == 0.1
+
+    def test_single_entry(self):
+        assert resolve_learning_rate({1: 0.02}, 100) == 0.02
+
+
+class TestSafePercentile:
+    def test_with_nans(self):
+        vals = np.array([1.0, np.nan, 3.0, np.nan, 5.0])
+        assert safe_percentile(vals, 50) == pytest.approx(3.0)
+
+    def test_all_nan(self):
+        assert np.isnan(safe_percentile(np.array([np.nan, np.nan]), 50))
+
+    def test_empty(self):
+        assert np.isnan(safe_percentile(np.array([]), 50))
+
+    def test_no_nan(self):
+        assert safe_percentile(np.arange(101.0), 90) == pytest.approx(90.0)
+
+    def test_inf_excluded(self):
+        vals = np.array([1.0, np.inf, 2.0, -np.inf, 3.0])
+        assert safe_percentile(vals, 50) == pytest.approx(2.0)
+
+
+class TestSafeMean:
+    def test_with_nans(self):
+        assert safe_mean(np.array([1.0, np.nan, 3.0])) == pytest.approx(2.0)
+
+    def test_all_nan(self):
+        assert np.isnan(safe_mean(np.array([np.nan])))
+
+    def test_no_nan(self):
+        assert safe_mean(np.array([2.0, 4.0])) == pytest.approx(3.0)
+
+
+class TestPrintRoutingSummary:
+    """Reference /root/reference/tests/scripts/test_router.py TestPrintRoutingSummary."""
+
+    def _capture(self, capsys, discharge=None, runtime=12.34, out="chrout.zarr"):
+        if discharge is None:
+            discharge = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        print_routing_summary(discharge, ["a", "b"], runtime, Path(out))
+        return capsys.readouterr().out
+
+    def test_prints_to_stdout(self, capsys):
+        assert len(self._capture(capsys)) > 0
+
+    def test_contains_segment_count(self, capsys):
+        assert "2" in self._capture(capsys)
+        out = self._capture(capsys, discharge=np.ones((7, 4)))
+        assert "7" in out
+
+    def test_contains_timestep_count(self, capsys):
+        out = self._capture(capsys, discharge=np.ones((2, 48)))
+        assert "48" in out
+
+    def test_contains_runtime(self, capsys):
+        assert "12.34" in self._capture(capsys, runtime=12.34)
+
+    def test_contains_discharge_stats(self, capsys):
+        out = self._capture(capsys, discharge=np.full((2, 3), 5.0))
+        assert "5.000" in out  # mean and peaks all 5
+
+    def test_contains_output_path(self, capsys):
+        assert "chrout.zarr" in self._capture(capsys, out="chrout.zarr")
+
+    def test_single_segment_single_timestep(self, capsys):
+        out = self._capture(capsys, discharge=np.array([[1.5]]))
+        assert "1" in out and "1.500" in out
+
+    def test_nan_robust(self, capsys):
+        disch = np.array([[1.0, np.nan], [np.nan, 3.0]])
+        out = self._capture(capsys, discharge=disch)
+        assert "nan" not in out.split("mean discharge")[1].splitlines()[0]
