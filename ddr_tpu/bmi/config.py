@@ -44,3 +44,10 @@ class BmiInitConfig(BaseModel):
         if not Path(v).exists():
             raise ValueError(f"ddr_config does not exist: {v}")
         return v
+
+    @field_validator("kan_checkpoint")
+    @classmethod
+    def _checkpoint_exists(cls, v: Path | None) -> Path | None:
+        if v is not None and not Path(v).exists():
+            raise ValueError(f"kan_checkpoint does not exist: {v}")
+        return v

@@ -125,7 +125,7 @@ def make_basin(
 def observe(basin: SyntheticBasin, cfg) -> SyntheticBasin:
     """Generate 'observations' by routing with the true parameters (twin experiment).
 
-    Produces both ``basin.obs_daily`` (D-2, G) for direct loss targets and an
+    Produces both ``basin.obs_daily`` (D-1, G) for direct loss targets and an
     :class:`ObservationSet` on the routing data (a full (G, D) table with day 0 NaN,
     mirroring how real observation stores align to the window) so scripts treat the
     synthetic dataset exactly like Merit/Lynker.

@@ -20,7 +20,7 @@ from ddr_tpu.scripts_utils import safe_mean, safe_percentile
 from ddr_tpu.scripts.common import build_kan, get_flow_fn, kan_arch, parse_cli, timed
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.configs import Config
-from ddr_tpu.validation.plots import plot_routing_hydrograph
+from ddr_tpu.validation.plots import plot_routing_hydrograph, select_plot_segments
 
 log = logging.getLogger(__name__)
 
@@ -95,11 +95,13 @@ def route_domain(cfg: Config, dataset=None, params=None) -> np.ndarray:
         }
     )
     print_routing_summary(discharge, output_ids, runtime, out_path)
-    top = np.argsort(np.nanmax(discharge, axis=1))[-5:]
+    sel = select_plot_segments(
+        discharge, output_ids, target_catchments=getattr(dataset, "target_catchments", None)
+    )
     plot_routing_hydrograph(
-        discharge[top],
+        discharge[sel],
         None,
-        [output_ids[int(i)] for i in top],
+        [output_ids[int(i)] for i in sel],
         Path(cfg.params.save_path) / "plots/routing_hydrograph.png",
     )
     return discharge

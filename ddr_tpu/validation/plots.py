@@ -9,6 +9,7 @@ so they run headless.
 
 from __future__ import annotations
 
+import logging
 from pathlib import Path
 from typing import Any, Sequence
 
@@ -24,8 +25,11 @@ __all__ = [
     "plot_box_fig",
     "plot_drainage_area_boxplots",
     "plot_gauge_map",
+    "select_plot_segments",
     "plot_routing_hydrograph",
 ]
+
+log = logging.getLogger(__name__)
 
 
 def _finish(fig, path: str | Path) -> Path:
@@ -146,6 +150,31 @@ def plot_gauge_map(
     ax.set_ylabel("latitude")
     ax.set_title(f"gauge {metric_name}")
     return _finish(fig, path)
+
+
+def select_plot_segments(
+    discharge: np.ndarray,
+    segment_ids: Sequence[Any],
+    target_catchments: Sequence[Any] | None = None,
+    max_segments: int = 5,
+) -> list[int]:
+    """Indices of segments worth plotting (reference router.py's selection):
+    configured target catchments when present (missing ids filtered out, warning
+    logged), else the ``max_segments`` largest by mean discharge."""
+    ids = [str(s) for s in segment_ids]
+    if target_catchments:
+        pos = {s: i for i, s in enumerate(ids)}
+        sel = [pos[str(t)] for t in target_catchments if str(t) in pos]
+        missing = [str(t) for t in target_catchments if str(t) not in pos]
+        if missing:
+            log.warning(f"Target catchments not in routed output, skipping: {missing}")
+        if sel:
+            return sel[:max_segments]
+    mean = np.nanmean(np.atleast_2d(np.asarray(discharge)), axis=1)
+    # All-NaN segments must sort last, not first (argsort puts NaN at the end
+    # ascending, which [::-1] would promote to the front).
+    order = np.argsort(np.nan_to_num(mean, nan=-np.inf))[::-1]
+    return [int(i) for i in order[: min(max_segments, len(ids))]]
 
 
 def plot_routing_hydrograph(

@@ -1,0 +1,196 @@
+"""Flowpath-attribute writers at the reference suite's granularity
+(/root/reference/tests/engine/merit/test_flowpath_attributes.py,
+lynker_hydrofabric/test_flowpath_attributes.py): dtype contracts, order
+alignment, NaN for unmatched ids, namespace separation between datasets."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ddr_tpu.engine.lynker import (
+    build_lynker_hydrofabric_adjacency,
+    write_flowpath_attributes,
+)
+from ddr_tpu.engine.merit import (
+    build_merit_adjacency,
+    write_merit_flowpath_attributes,
+)
+from ddr_tpu.io import zarrlite
+
+MERIT_FP = pd.DataFrame(
+    {
+        "COMID": [1, 2, 3, 4],
+        "NextDownID": [3, 3, 4, 0],
+        "up1": [0, 0, 1, 3],
+        "up2": [0, 0, 2, 0],
+        "lengthkm": [1.5, 2.0, 3.0, 4.5],
+        "slope": [0.01, 0.02, 0.005, 0.001],
+    }
+)
+
+LYNKER_FP = pd.DataFrame(
+    {
+        "id": ["wb-1", "wb-2", "wb-3"],
+        "toid": ["nex-10", "nex-10", "nex-11"],
+        "tot_drainage_areasqkm": [10.0, 12.0, 30.0],
+    }
+)
+LYNKER_NET = pd.DataFrame(
+    {
+        "id": ["wb-1", "wb-2", "wb-3", "nex-10", "nex-11"],
+        "toid": ["nex-10", "nex-10", "nex-11", "wb-3", None],
+        "hl_uri": [None] * 5,
+    }
+)
+LYNKER_ATTRS = pd.DataFrame(
+    {
+        "id": ["wb-1", "wb-2", "wb-3"],
+        "Length_m": [1000.0, 1500.0, 2000.0],
+        "So": [0.01, 0.012, 0.007],
+        "TopWdth": [5.0, 6.0, 12.0],
+        "ChSlp": [1.0, 1.2, 2.0],
+        "MusX": [0.25, 0.3, 0.28],
+    }
+)
+
+MERIT_ATTR_ARRAYS = ("length_m", "slope")
+LYNKER_ATTR_ARRAYS = ("length_m", "slope", "top_width", "side_slope", "muskingum_x", "toid")
+
+
+class TestMeritFlowpathAttributes:
+    @pytest.fixture()
+    def store(self, tmp_path):
+        return build_merit_adjacency(MERIT_FP, tmp_path / "conus.zarr")
+
+    def test_arrays_exist(self, store):
+        root = zarrlite.open_group(store)
+        for name in MERIT_ATTR_ARRAYS:
+            assert name in root, name
+
+    def test_arrays_same_length_as_order(self, store):
+        root = zarrlite.open_group(store)
+        n = len(root["order"].read())
+        for name in MERIT_ATTR_ARRAYS:
+            assert root[name].read().shape == (n,)
+
+    def test_float32_dtypes(self, store):
+        root = zarrlite.open_group(store)
+        for name in MERIT_ATTR_ARRAYS:
+            assert root[name].read().dtype == np.float32, name
+
+    def test_length_converted_to_meters(self, store):
+        root = zarrlite.open_group(store)
+        order = root["order"].read().tolist()
+        length_m = root["length_m"].read()
+        assert length_m[order.index(1)] == pytest.approx(1500.0)
+        assert length_m[order.index(4)] == pytest.approx(4500.0)
+
+    def test_slope_values_aligned(self, store):
+        root = zarrlite.open_group(store)
+        order = root["order"].read().tolist()
+        slope = root["slope"].read()
+        for comid, want in zip(MERIT_FP["COMID"], MERIT_FP["slope"]):
+            assert slope[order.index(comid)] == pytest.approx(want, abs=1e-7)
+
+    def test_nan_for_missing_comids(self, tmp_path):
+        """Attributes written from a table missing some COMIDs leave NaN there."""
+        store = build_merit_adjacency(MERIT_FP[["COMID", "NextDownID", "up1", "up2"]],
+                                      tmp_path / "bare.zarr")
+        write_merit_flowpath_attributes(MERIT_FP[MERIT_FP["COMID"] != 2], store)
+        root = zarrlite.open_group(store)
+        order = root["order"].read().tolist()
+        length_m = root["length_m"].read()
+        assert np.isnan(length_m[order.index(2)])
+        assert length_m[order.index(1)] == pytest.approx(1500.0)
+
+    def test_no_extra_lynker_arrays(self, store):
+        """MERIT stores must not grow Lynker-only arrays (top_width etc.)."""
+        root = zarrlite.open_group(store)
+        for name in ("top_width", "side_slope", "muskingum_x", "toid"):
+            assert name not in root, name
+
+    def test_attributeless_table_skips_write(self, tmp_path, caplog):
+        store = build_merit_adjacency(MERIT_FP[["COMID", "NextDownID", "up1", "up2"]],
+                                      tmp_path / "noattr.zarr")
+        root = zarrlite.open_group(store)
+        assert "length_m" not in root
+        assert "slope" not in root
+
+
+class TestLynkerFlowpathAttributes:
+    @pytest.fixture()
+    def store(self, tmp_path):
+        out = build_lynker_hydrofabric_adjacency(LYNKER_FP, LYNKER_NET, tmp_path / "conus.zarr")
+        write_flowpath_attributes(
+            {
+                "flowpath-attributes-ml": LYNKER_ATTRS,
+                "flowpaths": LYNKER_FP[["id", "toid"]],
+                "network": LYNKER_NET[["id", "toid"]],
+            },
+            out,
+        )
+        return out
+
+    def test_all_arrays_exist(self, store):
+        root = zarrlite.open_group(store)
+        for name in LYNKER_ATTR_ARRAYS:
+            assert name in root, name
+
+    def test_float_arrays_float32(self, store):
+        root = zarrlite.open_group(store)
+        for name in LYNKER_ATTR_ARRAYS[:-1]:
+            assert root[name].read().dtype == np.float32, name
+
+    def test_toid_int32(self, store):
+        assert zarrlite.open_group(store)["toid"].read().dtype == np.int32
+
+    def test_values_aligned_to_order(self, store):
+        # On disk the order array stores the numeric waterbody parts (int32).
+        root = zarrlite.open_group(store)
+        order = root["order"].read().tolist()
+        tw = root["top_width"].read()
+        assert tw[order.index(3)] == pytest.approx(12.0)
+        assert root["muskingum_x"].read()[order.index(1)] == pytest.approx(0.25)
+
+    def test_toid_resolves_nexus_hop(self, store):
+        """wb-1 -> nex-10 -> wb-3: stored toid is the downstream waterbody number."""
+        root = zarrlite.open_group(store)
+        order = root["order"].read().tolist()
+        toid = root["toid"].read()
+        assert toid[order.index(1)] == 3
+        assert toid[order.index(2)] == 3
+
+    def test_terminal_toid_zero(self, store):
+        """wb-3 drains to an unmapped nexus: toid stays 0."""
+        root = zarrlite.open_group(store)
+        order = root["order"].read().tolist()
+        assert root["toid"].read()[order.index(3)] == 0
+
+    def test_nan_for_missing_attribute_ids(self, tmp_path):
+        out = build_lynker_hydrofabric_adjacency(LYNKER_FP, LYNKER_NET, tmp_path / "c2.zarr")
+        write_flowpath_attributes(
+            {
+                "flowpath-attributes-ml": LYNKER_ATTRS[LYNKER_ATTRS["id"] != "wb-2"],
+                "flowpaths": LYNKER_FP[["id", "toid"]],
+            },
+            out,
+        )
+        root = zarrlite.open_group(out)
+        order = root["order"].read().tolist()
+        assert np.isnan(root["length_m"].read()[order.index(2)])
+        assert root["length_m"].read()[order.index(1)] == pytest.approx(1000.0)
+
+    def test_without_network_table_toid_skips_nexus(self, tmp_path):
+        """No network table: nexus toids cannot resolve -> 0 (documented fallback)."""
+        out = build_lynker_hydrofabric_adjacency(LYNKER_FP, LYNKER_NET, tmp_path / "c3.zarr")
+        write_flowpath_attributes(
+            {
+                "flowpath-attributes-ml": LYNKER_ATTRS,
+                "flowpaths": LYNKER_FP[["id", "toid"]],
+            },
+            out,
+        )
+        root = zarrlite.open_group(out)
+        assert (root["toid"].read() == 0).all()

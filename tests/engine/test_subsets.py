@@ -1,0 +1,161 @@
+"""Upstream-subset semantics and builder edge cases at the reference's granularity
+(/root/reference/tests/engine/merit/test_graph.py TestSubsetUpstream,
+test_integration.py TestGaugeIntegration/TestEdgeCases)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ddr_tpu.engine import graph as G
+from ddr_tpu.engine.core import coo_from_zarr, list_geodatasets
+from ddr_tpu.engine.merit import (
+    build_gauge_adjacencies,
+    build_merit_adjacency,
+    build_upstream_dict,
+    create_adjacency_matrix,
+)
+from ddr_tpu.geodatazoo.dataclasses import GaugeSet, MERITGauge
+from ddr_tpu.io import zarrlite
+
+# Sandbox-shaped chain-with-branches: 10 -> 30 <- 20, 30 -> 50 <- 40 (outlet 50).
+SANDBOX = pd.DataFrame(
+    {
+        "COMID": [10, 20, 30, 40, 50],
+        "NextDownID": [30, 30, 50, 50, 0],
+        "up1": [0, 0, 10, 0, 30],
+        "up2": [0, 0, 20, 0, 40],
+    }
+)
+
+
+def _subset(origin: int) -> set[int]:
+    """Upstream closure of ``origin`` (inclusive) via the native ancestors mask."""
+    upstream = build_upstream_dict(SANDBOX)
+    ids = sorted({c for dn, ups in upstream.items() for c in (dn, *ups)})
+    idx = {c: i for i, c in enumerate(ids)}
+    src, dst = [], []
+    for dn in upstream:
+        for up in upstream[dn]:
+            src.append(idx[up])
+            dst.append(idx[dn])
+    mask = G.ancestors_mask(
+        np.asarray(src, np.int64), np.asarray(dst, np.int64), len(ids),
+        np.array([idx[origin]]),
+    )
+    return {ids[i] for i in np.flatnonzero(mask)}
+
+
+class TestUpstreamSubsets:
+    def test_outlet_returns_all_nodes(self):
+        assert _subset(50) == {10, 20, 30, 40, 50}
+
+    def test_intermediate_node(self):
+        assert _subset(30) == {10, 20, 30}
+
+    def test_headwater_returns_self(self):
+        assert _subset(10) == {10}
+
+    def test_subsets_are_nested(self):
+        assert _subset(30) < _subset(50)
+        assert _subset(10) < _subset(30)
+
+    def test_node_30_upstreams(self):
+        d = build_upstream_dict(SANDBOX)
+        assert d[30] == [10, 20]
+
+    def test_node_50_upstreams(self):
+        d = build_upstream_dict(SANDBOX)
+        assert d[50] == [30, 40]
+
+    def test_headwaters_not_keys(self):
+        d = build_upstream_dict(SANDBOX)
+        for hw in (10, 20, 40):
+            assert hw not in d
+
+
+class TestSandboxMatrix:
+    def test_shape_and_nnz(self):
+        coo, order = create_adjacency_matrix(SANDBOX)
+        assert coo.shape == (5, 5)
+        assert coo.nnz == 4
+        assert len(order) == 5
+
+    def test_encodes_correct_edges(self):
+        coo, order = create_adjacency_matrix(SANDBOX)
+        pos = {c: i for i, c in enumerate(order)}
+        edges = {(order[r], order[c]) for r, c in zip(coo.row, coo.col)}
+        assert edges == {(30, 10), (30, 20), (50, 30), (50, 40)}
+
+    def test_outlet_has_no_outgoing_edges(self):
+        coo, order = create_adjacency_matrix(SANDBOX)
+        outlet_idx = order.index(50)
+        assert outlet_idx not in set(coo.col.tolist())
+
+    def test_order_is_topological(self):
+        _, order = create_adjacency_matrix(SANDBOX)
+        pos = {c: i for i, c in enumerate(order)}
+        assert pos[10] < pos[30] < pos[50]
+        assert pos[20] < pos[30]
+        assert pos[40] < pos[50]
+
+
+class TestBuilderEdgeCases:
+    def test_empty_dataframe_raises(self):
+        empty = pd.DataFrame(columns=["COMID", "NextDownID", "up1", "up2"])
+        with pytest.raises(ValueError, match="No upstream connections"):
+            create_adjacency_matrix(empty)
+
+    def test_two_node_network(self, tmp_path):
+        fp = pd.DataFrame({"COMID": [1, 2], "NextDownID": [2, 0], "up1": [0, 1]})
+        out = build_merit_adjacency(fp, tmp_path / "two.zarr")
+        coo, order = coo_from_zarr(out)
+        assert order == [1, 2]
+        assert coo.nnz == 1
+
+    def test_deep_parent_dirs_created(self, tmp_path):
+        out = build_merit_adjacency(SANDBOX, tmp_path / "a" / "b" / "c" / "conus.zarr")
+        assert out.exists()
+        _, order = coo_from_zarr(out)
+        assert len(order) == 5
+
+    def test_gauge_store_existing_raises(self, tmp_path):
+        conus = build_merit_adjacency(SANDBOX, tmp_path / "conus.zarr")
+        gs = GaugeSet(gauges=[MERITGauge(STAID="1", STANAME="a", DRAIN_SQKM=1, COMID=50)])
+        build_gauge_adjacencies(SANDBOX, conus, gs, tmp_path / "g.zarr")
+        with pytest.raises(FileExistsError):
+            build_gauge_adjacencies(SANDBOX, conus, gs, tmp_path / "g.zarr")
+
+    def test_gauge_groups_cover_requested_set(self, tmp_path):
+        conus = build_merit_adjacency(SANDBOX, tmp_path / "conus.zarr")
+        gs = GaugeSet(
+            gauges=[
+                MERITGauge(STAID="1", STANAME="a", DRAIN_SQKM=1, COMID=10),  # headwater
+                MERITGauge(STAID="2", STANAME="b", DRAIN_SQKM=2, COMID=30),
+                MERITGauge(STAID="3", STANAME="c", DRAIN_SQKM=3, COMID=50),  # outlet
+            ]
+        )
+        out = build_gauge_adjacencies(SANDBOX, conus, gs, tmp_path / "g.zarr")
+        root = zarrlite.open_group(out)
+        for staid in ("00000001", "00000002", "00000003"):
+            assert staid in root
+        # nested sizes: headwater 1, mid 3, outlet 5
+        assert len(root["00000001"]["order"].read()) == 1
+        assert len(root["00000002"]["order"].read()) == 3
+        assert len(root["00000003"]["order"].read()) == 5
+
+    def test_headwater_gauge_has_empty_coo(self, tmp_path):
+        conus = build_merit_adjacency(SANDBOX, tmp_path / "conus.zarr")
+        gs = GaugeSet(gauges=[MERITGauge(STAID="1", STANAME="a", DRAIN_SQKM=1, COMID=20)])
+        out = build_gauge_adjacencies(SANDBOX, conus, gs, tmp_path / "g.zarr")
+        sub = zarrlite.open_group(out)["00000001"]
+        assert sub["indices_0"].shape[0] == 0
+        assert sub["order"].read().tolist() == [20]
+
+
+class TestRegistry:
+    def test_list_geodatasets_sorted(self):
+        names = list_geodatasets()
+        assert names == sorted(names)
+        assert "merit" in names and "lynker" in names

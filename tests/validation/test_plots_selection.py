@@ -1,0 +1,155 @@
+"""Plot-segment selection + hydrograph plot behaviors, and remaining metric
+corners, at the reference's granularity (/root/reference/tests/validation/
+TestSelectPlotSegments, TestPlotRoutingHydrograph, TestMetricsSpearman,
+TestMetricsSingleTimestep, TestParamsDefaults)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.validation.metrics import Metrics
+from ddr_tpu.validation.plots import plot_routing_hydrograph, select_plot_segments
+
+
+class TestSelectPlotSegments:
+    def _discharge(self):
+        # mean discharge ranks: seg2 > seg0 > seg1
+        return np.array([[5.0, 5.0], [1.0, 1.0], [9.0, 9.0]])
+
+    def test_selects_target_catchments_when_provided(self):
+        sel = select_plot_segments(self._discharge(), ["a", "b", "c"], ["b", "a"])
+        assert sel == [1, 0]
+
+    def test_filters_out_missing_target_catchments(self, caplog):
+        with caplog.at_level("WARNING"):
+            sel = select_plot_segments(self._discharge(), ["a", "b", "c"], ["b", "zzz"])
+        assert sel == [1]
+        assert "zzz" in caplog.text
+
+    def test_all_targets_missing_falls_back_to_max_mean(self):
+        sel = select_plot_segments(self._discharge(), ["a", "b", "c"], ["x", "y"])
+        assert sel[0] == 2  # highest mean discharge
+
+    def test_falls_back_to_max_mean_discharge(self):
+        sel = select_plot_segments(self._discharge(), ["a", "b", "c"])
+        assert sel == [2, 0, 1]
+
+    def test_max_segments_respected(self):
+        d = np.arange(20, dtype=float).reshape(10, 2)
+        sel = select_plot_segments(d, [str(i) for i in range(10)], max_segments=3)
+        assert len(sel) == 3
+        assert sel == [9, 8, 7]
+
+    def test_single_segment(self):
+        sel = select_plot_segments(np.array([[1.0, 2.0]]), ["only"])
+        assert sel == [0]
+
+    def test_non_string_targets_coerced(self):
+        sel = select_plot_segments(self._discharge(), [101, 102, 103], [102])
+        assert sel == [1]
+
+
+class TestPlotRoutingHydrograph:
+    def test_creates_png_file(self, tmp_path):
+        p = plot_routing_hydrograph(
+            np.random.default_rng(0).uniform(0, 5, (3, 48)), None, ["a", "b", "c"],
+            tmp_path / "h.png",
+        )
+        assert p.exists() and p.stat().st_size > 0
+
+    def test_creates_parent_directories(self, tmp_path):
+        p = plot_routing_hydrograph(
+            np.ones((1, 5)), None, ["a"], tmp_path / "x" / "y" / "h.png"
+        )
+        assert p.exists()
+
+    def test_single_segment_1d_input(self, tmp_path):
+        p = plot_routing_hydrograph(np.ones(24), None, ["a"], tmp_path / "h.png")
+        assert p.exists()
+
+    def test_single_timestep(self, tmp_path):
+        p = plot_routing_hydrograph(np.ones((2, 1)), None, ["a", "b"], tmp_path / "h.png")
+        assert p.exists()
+
+    def test_explicit_time_axis(self, tmp_path):
+        t = np.arange(10) * 3600.0
+        p = plot_routing_hydrograph(np.ones((1, 10)), t, ["a"], tmp_path / "h.png")
+        assert p.exists()
+
+    def test_many_segments_legend_suppressed(self, tmp_path):
+        """>12 segments: renders without a legend (and without error)."""
+        d = np.random.default_rng(1).uniform(0, 5, (15, 10))
+        p = plot_routing_hydrograph(d, None, [str(i) for i in range(15)], tmp_path / "h.png")
+        assert p.exists()
+
+
+class TestMetricsCorners:
+    def test_spearman_monotonic(self):
+        """A monotone (nonlinear) relationship gives Spearman 1."""
+        target = np.array([[1.0, 2.0, 3.0, 4.0, 5.0]])
+        pred = target**3
+        m = Metrics(pred=pred, target=target)
+        np.testing.assert_allclose(np.asarray(m.corr_spearman), [1.0], atol=1e-9)
+
+    def test_spearman_antimonotonic(self):
+        target = np.array([[1.0, 2.0, 3.0, 4.0, 5.0]])
+        m = Metrics(pred=-(target**3), target=target)
+        np.testing.assert_allclose(np.asarray(m.corr_spearman), [-1.0], atol=1e-9)
+
+    def test_single_timestep_does_not_crash(self):
+        """T=1: correlations are undefined (NaN) but construction must survive
+        (reference TestMetricsSingleTimestep)."""
+        m = Metrics(pred=np.array([[2.0]]), target=np.array([[3.0]]))
+        assert np.isfinite(np.asarray(m.rmse)).all()
+
+    def test_pearson_linear_transform_invariant(self):
+        rng = np.random.default_rng(0)
+        target = rng.uniform(0, 10, (1, 50))
+        m = Metrics(pred=3.0 * target + 2.0, target=target)
+        np.testing.assert_allclose(np.asarray(m.corr), [1.0], atol=1e-6)
+
+
+class TestParamsDefaults:
+    """Default physical-parameter config matches the reference's bands
+    (/root/reference/src/ddr/validation/configs.py:81-122)."""
+
+    def _params(self):
+        from ddr_tpu.validation.configs import Params
+
+        return Params()
+
+    def test_attribute_minimums_defaults(self):
+        """Matches /root/reference/src/ddr/validation/configs.py:26-35 defaults."""
+        mins = self._params().attribute_minimums
+        assert mins["velocity"] == pytest.approx(0.01)
+        assert mins["depth"] == pytest.approx(0.01)
+        assert mins["discharge"] == pytest.approx(0.0001)
+        assert mins["slope"] == pytest.approx(0.001)
+        assert mins["bottom_width"] == pytest.approx(0.01)
+
+    def test_parameter_ranges_defaults(self):
+        ranges = self._params().parameter_ranges
+        assert ranges["n"] == [0.015, 0.25]
+        assert ranges["q_spatial"] == [0.0, 1.0]
+        assert ranges["p_spatial"] == [1.0, 200.0]
+
+    def test_log_space_default(self):
+        assert self._params().log_space_parameters == ["p_spatial"]
+
+    def test_defaults_p_spatial(self):
+        assert self._params().defaults["p_spatial"] == 21
+
+    def test_tau_default(self):
+        assert self._params().tau == 3
+
+
+class TestSelectPlotSegmentsNaN:
+    def test_all_nan_segment_ranks_last(self):
+        d = np.array([[np.nan, np.nan], [1.0, 1.0], [9.0, 9.0]])
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            sel = select_plot_segments(d, ["a", "b", "c"], max_segments=2)
+        assert sel == [2, 1]  # NaN row excluded from the top picks
