@@ -160,12 +160,24 @@ def select_plot_segments(
 ) -> list[int]:
     """Indices of segments worth plotting (reference router.py's selection):
     configured target catchments when present (missing ids filtered out, warning
-    logged), else the ``max_segments`` largest by mean discharge."""
+    logged), else the ``max_segments`` largest by mean discharge.
+
+    Ids are matched on their numeric part, mirroring the datasets' target
+    normalization (``BaseGeoDataset._target_key``): a target spelled ``wb-123``
+    or ``123`` matches a routed ``cat-123``."""
     ids = [str(s) for s in segment_ids]
     if target_catchments:
-        pos = {s: i for i, s in enumerate(ids)}
-        sel = [pos[str(t)] for t in target_catchments if str(t) in pos]
-        missing = [str(t) for t in target_catchments if str(t) not in pos]
+
+        def _key(value):
+            s = str(value)
+            try:
+                return int(float(s.split("-")[1])) if "-" in s else int(float(s))
+            except ValueError:
+                return s
+
+        pos = {_key(s): i for i, s in enumerate(ids)}
+        sel = [pos[_key(t)] for t in target_catchments if _key(t) in pos]
+        missing = [str(t) for t in target_catchments if _key(t) not in pos]
         if missing:
             log.warning(f"Target catchments not in routed output, skipping: {missing}")
         if sel:

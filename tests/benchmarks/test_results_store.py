@@ -1,0 +1,132 @@
+"""Benchmark results-store contract + LTI config defaults, at the reference
+suite's granularity (/root/reference/tests/benchmarks/ TestSaveResults,
+TestDiffRouteConfig, TestBenchmarkConfig)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.benchmarks import benchmark, validate_benchmark_config
+from ddr_tpu.benchmarks.configs import BenchmarkConfig, LTIRouteConfig
+from ddr_tpu.io import zarrlite
+
+N_ATTRS = 10
+
+
+@pytest.fixture(scope="module")
+def bench_run(tmp_path_factory):
+    """One full two-phase benchmark run shared by every store-contract test."""
+    tmp = tmp_path_factory.mktemp("bench_store")
+    cfg = validate_benchmark_config(
+        {
+            "name": "store_test",
+            "geodataset": "synthetic",
+            "mode": "testing",
+            "kan": {"input_var_names": [f"a{i}" for i in range(N_ATTRS)]},
+            "experiment": {
+                "start_time": "1981/10/01", "end_time": "1981/10/10", "warmup": 1,
+            },
+            "params": {"save_path": str(tmp)},
+            "lti": {"irf_fn": "muskingum", "max_delay": 48},
+        }
+    )
+    results = benchmark(cfg)
+    return tmp, results
+
+
+class TestResultsStore:
+    def test_creates_zarr(self, bench_run):
+        tmp, _ = bench_run
+        assert (tmp / "benchmark_results.zarr").exists()
+
+    def test_has_data_vars(self, bench_run):
+        tmp, _ = bench_run
+        root = zarrlite.open_group(tmp / "benchmark_results.zarr")
+        for name in ("mc_predictions", "lti_predictions", "observations"):
+            assert name in root, name
+
+    def test_shapes_match(self, bench_run):
+        tmp, _ = bench_run
+        root = zarrlite.open_group(tmp / "benchmark_results.zarr")
+        mc = root["mc_predictions"].read()
+        lti = root["lti_predictions"].read()
+        obs = root["observations"].read()
+        assert mc.shape == lti.shape == obs.shape
+
+    def test_attrs_include_version_and_provenance(self, bench_run):
+        tmp, _ = bench_run
+        root = zarrlite.open_group(tmp / "benchmark_results.zarr")
+        assert "version" in root.attrs
+        assert root.attrs["irf_fn"] == "muskingum"
+        assert "model_checkpoint" in root.attrs
+
+    def test_gage_ids_attr_matches_rows(self, bench_run):
+        tmp, _ = bench_run
+        root = zarrlite.open_group(tmp / "benchmark_results.zarr")
+        assert len(root.attrs["gage_ids"]) == root["mc_predictions"].read().shape[0]
+
+    def test_predictions_finite_where_observed(self, bench_run):
+        tmp, _ = bench_run
+        root = zarrlite.open_group(tmp / "benchmark_results.zarr")
+        assert np.isfinite(root["mc_predictions"].read()).all()
+
+    def test_metrics_keys(self, bench_run):
+        _, results = bench_run
+        assert set(results) == {"mc", "lti"}
+
+
+class TestLTIRouteConfigDefaults:
+    """Reference TestDiffRouteConfig (validation/diffroute.py defaults)."""
+
+    def test_defaults(self):
+        cfg = LTIRouteConfig()
+        assert cfg.enabled is True
+        assert cfg.irf_fn == "muskingum"
+        assert cfg.max_delay == 100
+        assert cfg.dt == pytest.approx(1.0 / 24.0)
+        assert cfg.k is None  # resolved to the RAPID 9000 s default downstream
+        assert cfg.x == pytest.approx(0.3)
+
+    def test_custom_values(self):
+        cfg = LTIRouteConfig(irf_fn="hayami", max_delay=50, k=0.25, x=0.1)
+        assert cfg.irf_fn == "hayami"
+        assert cfg.k == 0.25
+
+    def test_extra_field_rejected(self):
+        with pytest.raises(ValueError):
+            LTIRouteConfig(unknown=1)
+
+    def test_x_upper_bound(self):
+        with pytest.raises(ValueError):
+            LTIRouteConfig(x=0.5)
+
+    def test_nash_n_lower_bound(self):
+        with pytest.raises(ValueError):
+            LTIRouteConfig(nash_n=0)
+
+
+class TestBenchmarkConfigShape:
+    def _ddr(self, tmp_path):
+        return {
+            "name": "b",
+            "geodataset": "synthetic",
+            "mode": "testing",
+            "kan": {"input_var_names": ["a0"]},
+            "params": {"save_path": str(tmp_path)},
+        }
+
+    def test_construction_nested(self, tmp_path):
+        cfg = BenchmarkConfig(ddr=self._ddr(tmp_path))
+        assert cfg.lti.enabled is True
+        assert cfg.summed_q_prime is None
+
+    def test_extra_field_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            BenchmarkConfig(ddr=self._ddr(tmp_path), bogus=1)
+
+    def test_summed_q_prime_optional_path(self, tmp_path):
+        cfg = BenchmarkConfig(
+            ddr=self._ddr(tmp_path), summed_q_prime=tmp_path / "sqp.zarr"
+        )
+        assert cfg.summed_q_prime == tmp_path / "sqp.zarr"

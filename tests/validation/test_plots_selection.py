@@ -153,3 +153,11 @@ class TestSelectPlotSegmentsNaN:
             warnings.simplefilter("ignore", RuntimeWarning)
             sel = select_plot_segments(d, ["a", "b", "c"], max_segments=2)
         assert sel == [2, 1]  # NaN row excluded from the top picks
+
+    def test_prefix_normalized_matching(self):
+        """wb-/cat- prefixes and bare numerals all refer to the same catchment
+        (mirrors BaseGeoDataset._target_key)."""
+        d = np.array([[5.0, 5.0], [1.0, 1.0], [9.0, 9.0]])
+        assert select_plot_segments(d, ["cat-101", "cat-102", "cat-103"], ["wb-102"]) == [1]
+        assert select_plot_segments(d, ["cat-101", "cat-102", "cat-103"], ["103"]) == [2]
+        assert select_plot_segments(d, [101, 102, 103], ["cat-101"]) == [0]
