@@ -242,6 +242,7 @@ def route(
     dt: float = DT_SECONDS,
     engine: str | None = None,
     q_prime_permuted: bool = False,
+    remat_physics: bool = True,
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -276,6 +277,10 @@ def route(
     ``q_prime[:, np.asarray(network.wf_perm)]``), skipping the one per-element
     device permutation the wavefront engine otherwise pays (~7ms at N=8192; see
     docs/tpu.md). Only meaningful for the wavefront engine.
+
+    ``remat_physics`` (wavefront engine) rematerializes the per-wave elementwise
+    physics in the backward pass instead of storing its intermediates — ~27%
+    faster full VJP on the v5e chip; forward bitwise-unchanged (docs/tpu.md).
     """
     n_mann = spatial_params["n"]
     q_spatial = spatial_params["q_spatial"]
@@ -320,6 +325,7 @@ def route(
         runoff_p, final_p = wavefront_route_core(
             network, celerity_fn, coefficients_fn, q_prime, q_init_p,
             bounds.discharge, q_prime_permuted=q_prime_permuted,
+            remat_physics=remat_physics,
         )
         if gauges is not None:
             gauges_p = dataclasses.replace(

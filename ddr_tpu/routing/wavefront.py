@@ -75,6 +75,7 @@ def wavefront_route_core(
     q_init: jnp.ndarray | None,
     discharge_lb: float,
     q_prime_permuted: bool = False,
+    remat_physics: bool = True,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Route timesteps 0..T-1 by wavefront, entirely in wf_perm order.
 
@@ -83,6 +84,14 @@ def wavefront_route_core(
     ``q_init`` (wf order) carries state across chunks; ``None`` hotstarts in-band
     from ``q_prime[0]``. Returns ``(runoff (T, N), final (N,))`` in wf order —
     the caller aggregates gauges / un-permutes as needed.
+
+    ``remat_physics`` wraps the per-wave elementwise physics (Manning inversion ->
+    celerity -> Muskingum coefficients) in :func:`jax.checkpoint`: the backward
+    pass recomputes the chain from the one saved ``q_prev`` row instead of
+    loading ~10 stored intermediates per wave from HBM. Measured on the v5e chip
+    at N=8192/T=240 this cuts the full-VJP time ~27% (72 -> 53 ms). Forward
+    results are bitwise-unchanged; gradients agree to float-reassociation
+    tolerance (XLA fuses the two backward programs differently).
     """
     T, n = q_prime.shape
     depth = network.depth
@@ -128,13 +137,18 @@ def wavefront_route_core(
     s0 = jnp.zeros(n, qp_p.dtype)
     t_of_wave = lambda w: w - 1 - level_p  # noqa: E731
 
+    def physics(q_prev):
+        return coefficients_fn(celerity_fn(q_prev))
+
+    if remat_physics:
+        physics = jax.checkpoint(physics)
+
     def body(carry, wave_inputs):
         ring, s_state = carry
         q_row, w = wave_inputs
         t_node = t_of_wave(w)
         q_prev = jnp.maximum(ring[0, :n], discharge_lb)  # clamped x_{t-1}[i]
-        c = celerity_fn(q_prev)
-        c1, c2, c3, c4 = coefficients_fn(c)
+        c1, c2, c3, c4 = physics(q_prev)
         gathered = ring.reshape(-1)[wf_idx]  # THE gather: raw x_t[p] per edge slot
         x_pred = reduce_buckets(gathered, clamped=False)
         s_next = reduce_buckets(gathered, clamped=True)  # wave w+1's inflow sums

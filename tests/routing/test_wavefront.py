@@ -218,3 +218,32 @@ class TestEligibility:
         assert sorted(decoded) == expected
         # gathered index count stays within 2x the edge count (pow2 bucket padding)
         assert len(idx) <= 2 * network.n_edges
+
+
+class TestRematPhysics:
+    """remat_physics replays the same physics in the backward; forward results are
+    bitwise-equal, gradients agree to float-reassociation tolerance."""
+
+    def test_forward_identical(self):
+        network, channels, _, params, qp = _setup(n=64, seed=5)
+        a = route(network, channels, params, qp, engine="wavefront", remat_physics=True)
+        b = route(network, channels, params, qp, engine="wavefront", remat_physics=False)
+        np.testing.assert_array_equal(np.asarray(a.runoff), np.asarray(b.runoff))
+
+    def test_gradients_identical(self):
+        network, channels, gauges, params, qp = _setup(n=64, seed=5)
+
+        def loss(p, remat):
+            return route(
+                network, channels, p, qp, gauges=gauges,
+                engine="wavefront", remat_physics=remat,
+            ).runoff.mean()
+
+        g_on = jax.grad(lambda p: loss(p, True))(params)
+        g_off = jax.grad(lambda p: loss(p, False))(params)
+        for k in g_on:
+            # XLA fuses the two programs differently; parity is float-reassociation
+            # level, not bitwise.
+            np.testing.assert_allclose(
+                np.asarray(g_on[k]), np.asarray(g_off[k]), rtol=2e-4, atol=1e-7
+            )
