@@ -1,0 +1,161 @@
+"""Lynker graph-prep behaviors at the reference suite's granularity
+(/root/reference/tests/engine/lynker_hydrofabric/test_graph.py: 17 tests over
+preprocess/find_origin/subset; test_determinism.py: build invariance)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ddr_tpu.engine.core import coo_from_zarr
+from ddr_tpu.engine.lynker import (
+    build_lynker_hydrofabric_adjacency,
+    create_matrix,
+    find_origin,
+    preprocess_river_network,
+    subset,
+)
+from ddr_tpu.geodatazoo.dataclasses import Gauge
+
+# Deeper fixture than test_lynker_build's: a 7-waterbody, two-confluence network
+# (every flowpath toid is a nexus, as in the real hydrofabric — the reference's
+# create_matrix resolves strictly through the nexus hop, io.py:97-116).
+#   wb-1, wb-2, wb-3 -> nex-10 -> wb-4;
+#   wb-4, wb-5 -> nex-11 -> wb-6;  wb-6 -> nex-12 -> wb-7; wb-7 -> nex-13 (terminal)
+FP = pd.DataFrame(
+    {
+        "id": [f"wb-{i}" for i in range(1, 8)],
+        "toid": ["nex-10", "nex-10", "nex-10", "nex-11", "nex-11", "nex-12", "nex-13"],
+        "tot_drainage_areasqkm": [5.0, 6.0, 4.0, 20.0, 7.0, 30.0, 40.0],
+    }
+)
+NET = pd.DataFrame(
+    {
+        "id": [f"wb-{i}" for i in range(1, 8)] + ["nex-10", "nex-11", "nex-12", "nex-13"],
+        "toid": ["nex-10", "nex-10", "nex-10", "nex-11", "nex-11", "nex-12", "nex-13",
+                 "wb-4", "wb-6", "wb-7", None],
+        "hl_uri": [None, None, None, "gages-00000004", None, None, "gages-00000007",
+                   None, None, None, None],
+    }
+)
+
+
+class TestPreprocess:
+    def test_collapses_nexus_chains(self):
+        d = preprocess_river_network(NET)
+        assert d["wb-4"] == ["wb-1", "wb-2", "wb-3"]
+        assert d["wb-6"] == ["wb-4", "wb-5"]
+        assert d["wb-7"] == ["wb-6"]
+
+    def test_three_way_confluence(self):
+        d = preprocess_river_network(NET)
+        assert "wb-3" in d["wb-4"]
+
+    def test_headwaters_absent(self):
+        d = preprocess_river_network(NET)
+        for hw in ("wb-1", "wb-2", "wb-3", "wb-5"):
+            assert hw not in d
+
+    def test_terminal_nexus_dropped(self):
+        """wb-7 -> nex-13 -> None produces no connection."""
+        d = preprocess_river_network(NET)
+        all_ups = {u for ups in d.values() for u in ups}
+        assert "wb-7" not in all_ups or "wb-7" in d  # wb-7 only appears as downstream
+
+    def test_duplicate_rows_collapse(self):
+        doubled = pd.concat([NET, NET], ignore_index=True)
+        assert preprocess_river_network(doubled) == preprocess_river_network(NET)
+
+    def test_upstreams_sorted(self):
+        d = preprocess_river_network(NET)
+        for ups in d.values():
+            assert ups == sorted(ups)
+
+
+class TestSubsetTraversal:
+    def test_outlet_covers_all(self):
+        d = preprocess_river_network(NET)
+        conns = subset("wb-7", d)
+        nodes = {n for pair in conns for n in pair}
+        assert nodes == {f"wb-{i}" for i in range(1, 8)}
+        assert len(conns) == 6  # tree edges
+
+    def test_intermediate(self):
+        d = preprocess_river_network(NET)
+        conns = subset("wb-4", d)
+        nodes = {n for pair in conns for n in pair}
+        assert nodes == {"wb-1", "wb-2", "wb-3", "wb-4"}
+
+    def test_headwater_empty(self):
+        d = preprocess_river_network(NET)
+        assert subset("wb-2", d) == []
+
+    def test_unknown_origin_empty(self):
+        d = preprocess_river_network(NET)
+        assert subset("wb-999", d) == []
+
+    def test_connection_orientation(self):
+        """Pairs are (downstream, upstream)."""
+        d = preprocess_river_network(NET)
+        conns = subset("wb-6", d)
+        assert ("wb-6", "wb-4") in conns
+        assert ("wb-4", "wb-6") not in conns
+
+    def test_deep_chain_beyond_recursion_limit(self):
+        """The iterative traversal survives chains longer than Python's default
+        recursion limit (reference hit this at CONUS scale)."""
+        n = 5000
+        d = {f"wb-{i}": [f"wb-{i-1}"] for i in range(1, n)}
+        conns = subset(f"wb-{n-1}", d)
+        assert len(conns) == n - 1
+
+
+class TestFindOriginHlUri:
+    def test_match_on_hl_uri(self):
+        g = Gauge(STAID="00000004", STANAME="x", DRAIN_SQKM=20.0)
+        assert find_origin(g, FP, NET) == "wb-4"
+
+    def test_staid_zero_fill_respected(self):
+        """Gauge STAIDs validate zero-filled; hl_uri entries match exactly."""
+        g = Gauge(STAID="00000007", STANAME="x", DRAIN_SQKM=40.0)
+        assert find_origin(g, FP, NET) == "wb-7"
+
+    def test_closest_drainage_area_wins(self):
+        net = NET.copy()
+        net.loc[net["id"] == "wb-5", "hl_uri"] = "gages-00000009"
+        net.loc[net["id"] == "wb-6", "hl_uri"] = "gages-00000009"
+        g = Gauge(STAID="00000009", STANAME="x", DRAIN_SQKM=8.0)
+        assert find_origin(g, FP, net) == "wb-5"  # |7-8| < |30-8|
+
+
+class TestMatrixStructure:
+    def test_nexus_hop_resolved_to_edge(self):
+        coo, order = create_matrix(FP, NET)
+        edges = {(order[r], order[c]) for r, c in zip(coo.row, coo.col)}
+        assert ("wb-4", "wb-3") in edges  # wb-3 -> nex-10 -> wb-4
+
+    def test_nnz_matches_tree(self):
+        coo, order = create_matrix(FP, NET)
+        assert coo.nnz == 6
+        assert len(order) == 7
+
+    def test_topological_invariant(self):
+        _, order = create_matrix(FP, NET)
+        pos = {w: i for i, w in enumerate(order)}
+        assert pos["wb-1"] < pos["wb-4"] < pos["wb-6"] < pos["wb-7"]
+
+    def test_row_permutation_invariant(self, tmp_path):
+        """Build is deterministic under input row shuffling (reference
+        test_determinism.py)."""
+        rng = np.random.default_rng(3)
+        fp_shuf = FP.sample(frac=1.0, random_state=7).reset_index(drop=True)
+        net_shuf = NET.sample(frac=1.0, random_state=9).reset_index(drop=True)
+        a = build_lynker_hydrofabric_adjacency(FP, NET, tmp_path / "a.zarr")
+        b = build_lynker_hydrofabric_adjacency(fp_shuf, net_shuf, tmp_path / "b.zarr")
+        ca, oa = coo_from_zarr(a)
+        cb, ob = coo_from_zarr(b)
+        # Same edge set in conus space regardless of input ordering.
+        ea = {(oa[r], oa[c]) for r, c in zip(ca.row, ca.col)}
+        eb = {(ob[r], ob[c]) for r, c in zip(cb.row, cb.col)}
+        assert ea == eb
