@@ -27,6 +27,7 @@ __all__ = [
     "read_zarr",
     "convert_ft3_s_to_m3_s",
     "read_gage_info",
+    "derive_gage_reference_columns",
     "filter_gages_by_area_threshold",
     "filter_gages_by_da_valid",
     "filter_headwater_gages",
@@ -97,6 +98,36 @@ def read_gage_info(gage_info_path: Path | str) -> dict[str, list]:
     for col in optional:
         if col in df.columns:
             out[col] = df[col].tolist()
+    return out
+
+
+def derive_gage_reference_columns(df: pd.DataFrame) -> pd.DataFrame:
+    """Derive the ABS_DIFF / DA_VALID / FLOW_SCALE gauge-reference columns from raw
+    drainage areas (the column-derivation stage of the reference's gage-reference
+    builder, /root/reference/references/geo_io/build_gage_references.py:122-146;
+    the upstream spatial-join stage needs geopandas and stays out of scope).
+
+    Requires ``DRAIN_SQKM``, ``COMID_DRAIN_SQKM``, ``COMID_UNITAREA_SQKM``:
+
+    - ``ABS_DIFF`` = |DRAIN_SQKM − COMID_DRAIN_SQKM|
+    - ``DA_VALID`` = ABS_DIFF <= max(COMID_UNITAREA_SQKM, 100 km²)
+    - ``FLOW_SCALE`` = (unit − ABS_DIFF)/unit when the gauge sits upstream of the
+      catchment outlet (DRAIN < COMID_DRAIN) and the mismatch is inside one unit
+      area; 1.0 otherwise.
+
+    Returns a copy with the three columns added.
+    """
+    required = {"DRAIN_SQKM", "COMID_DRAIN_SQKM", "COMID_UNITAREA_SQKM"}
+    missing = required - set(df.columns)
+    if missing:
+        raise KeyError(f"gage table is missing columns: {sorted(missing)}")
+    out = df.copy()
+    diff = out["DRAIN_SQKM"] - out["COMID_DRAIN_SQKM"]
+    out["ABS_DIFF"] = diff.abs()
+    out["DA_VALID"] = out["ABS_DIFF"] <= out["COMID_UNITAREA_SQKM"].clip(lower=100.0)
+    unit = out["COMID_UNITAREA_SQKM"]
+    scale = (unit - out["ABS_DIFF"]) / unit
+    out["FLOW_SCALE"] = scale.where((diff < 0) & (out["ABS_DIFF"] < unit), 1.0)
     return out
 
 

@@ -58,10 +58,12 @@ class TestPreprocess:
             assert hw not in d
 
     def test_terminal_nexus_dropped(self):
-        """wb-7 -> nex-13 -> None produces no connection."""
+        """wb-7 -> nex-13 -> None produces no connection: wb-7 is never an
+        upstream, and no phantom downstream node appears for nex-13."""
         d = preprocess_river_network(NET)
         all_ups = {u for ups in d.values() for u in ups}
-        assert "wb-7" not in all_ups or "wb-7" in d  # wb-7 only appears as downstream
+        assert "wb-7" not in all_ups
+        assert set(d) == {"wb-4", "wb-6", "wb-7"}  # exactly the real confluences
 
     def test_duplicate_rows_collapse(self):
         doubled = pd.concat([NET, NET], ignore_index=True)
@@ -148,7 +150,6 @@ class TestMatrixStructure:
     def test_row_permutation_invariant(self, tmp_path):
         """Build is deterministic under input row shuffling (reference
         test_determinism.py)."""
-        rng = np.random.default_rng(3)
         fp_shuf = FP.sample(frac=1.0, random_state=7).reset_index(drop=True)
         net_shuf = NET.sample(frac=1.0, random_state=9).reset_index(drop=True)
         a = build_lynker_hydrofabric_adjacency(FP, NET, tmp_path / "a.zarr")

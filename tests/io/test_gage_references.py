@@ -1,0 +1,126 @@
+"""Gage-reference column derivation (ABS_DIFF / DA_VALID / FLOW_SCALE), mirroring
+/root/reference/tests/references/test_build_gage_references.py."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ddr_tpu.io.readers import compute_flow_scale_factor, derive_gage_reference_columns
+
+
+def _table(**cols):
+    base = {
+        "STAID": ["00000001"] * len(next(iter(cols.values()))),
+    }
+    base.update(cols)
+    return pd.DataFrame(base)
+
+
+class TestAbsDiff:
+    def test_computed(self):
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=[100.0, 4.0], COMID_DRAIN_SQKM=[105.0, 8.0],
+                   COMID_UNITAREA_SQKM=[50.0, 50.0])
+        )
+        np.testing.assert_array_almost_equal(out["ABS_DIFF"], [5.0, 4.0])
+
+    def test_symmetric(self):
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=[100.0, 110.0], COMID_DRAIN_SQKM=[110.0, 100.0],
+                   COMID_UNITAREA_SQKM=[50.0, 50.0])
+        )
+        np.testing.assert_array_almost_equal(out["ABS_DIFF"], [10.0, 10.0])
+
+    def test_input_not_mutated(self):
+        df = _table(DRAIN_SQKM=[100.0], COMID_DRAIN_SQKM=[105.0], COMID_UNITAREA_SQKM=[50.0])
+        derive_gage_reference_columns(df)
+        assert "ABS_DIFF" not in df.columns
+
+    def test_missing_column_raises(self):
+        with pytest.raises(KeyError, match="COMID_UNITAREA_SQKM"):
+            derive_gage_reference_columns(
+                _table(DRAIN_SQKM=[1.0], COMID_DRAIN_SQKM=[1.0])
+            )
+
+
+class TestDaValid:
+    def _da_valid(self, abs_pairs):
+        drain = [100.0] * len(abs_pairs)
+        comid = [100.0 + d for d, _ in abs_pairs]
+        unit = [u for _, u in abs_pairs]
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=drain, COMID_DRAIN_SQKM=comid, COMID_UNITAREA_SQKM=unit)
+        )
+        return out["DA_VALID"].tolist()
+
+    def test_valid_when_within_unit_area(self):
+        assert self._da_valid([(5.0, 10.0), (50.0, 50.0)]) == [True, True]
+
+    def test_invalid_when_exceeds_threshold(self):
+        assert self._da_valid([(150.0, 30.0)]) == [False]
+
+    def test_small_unit_area_uses_100km_floor(self):
+        # 60 <= max(30, 100) = 100 -> valid
+        assert self._da_valid([(60.0, 30.0)]) == [True]
+
+    def test_large_unit_area_uses_actual_value(self):
+        # 150 <= max(200, 100) = 200 -> valid
+        assert self._da_valid([(150.0, 200.0)]) == [True]
+
+
+class TestFlowScale:
+    def test_no_scaling_when_gage_downstream(self):
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=[200.0], COMID_DRAIN_SQKM=[180.0], COMID_UNITAREA_SQKM=[50.0])
+        )
+        assert out["FLOW_SCALE"].iloc[0] == 1.0
+
+    def test_scaling_when_gage_upstream(self):
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=[80.0], COMID_DRAIN_SQKM=[100.0], COMID_UNITAREA_SQKM=[50.0])
+        )
+        assert out["FLOW_SCALE"].iloc[0] == pytest.approx((50.0 - 20.0) / 50.0)
+
+    def test_no_scaling_when_mismatch_exceeds_unit_area(self):
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=[10.0], COMID_DRAIN_SQKM=[100.0], COMID_UNITAREA_SQKM=[50.0])
+        )
+        assert out["FLOW_SCALE"].iloc[0] == 1.0
+
+    def test_matches_scalar_path(self):
+        """The vectorized derivation agrees with compute_flow_scale_factor (the
+        runtime fallback used when the CSV lacks FLOW_SCALE)."""
+        rng = np.random.default_rng(0)
+        drain = rng.uniform(10, 300, 50)
+        comid = rng.uniform(10, 300, 50)
+        unit = rng.uniform(20, 120, 50)
+        out = derive_gage_reference_columns(
+            _table(DRAIN_SQKM=drain, COMID_DRAIN_SQKM=comid, COMID_UNITAREA_SQKM=unit)
+        )
+        scalar = [
+            compute_flow_scale_factor(d, c, u) for d, c, u in zip(drain, comid, unit)
+        ]
+        np.testing.assert_allclose(out["FLOW_SCALE"], scalar, rtol=1e-12)
+
+    def test_round_trip_through_filters(self):
+        """Derived columns drive the training-time filters end to end."""
+        from ddr_tpu.io.readers import filter_gages_by_da_valid
+
+        df = derive_gage_reference_columns(
+            pd.DataFrame(
+                {
+                    "STAID": ["00000001", "00000002"],
+                    "DRAIN_SQKM": [100.0, 100.0],
+                    "COMID_DRAIN_SQKM": [105.0, 400.0],
+                    "COMID_UNITAREA_SQKM": [50.0, 50.0],
+                }
+            )
+        )
+        gage_dict = {c: df[c].tolist() for c in df.columns}
+        kept, dropped = filter_gages_by_da_valid(
+            np.array(["00000001", "00000002"]), gage_dict
+        )
+        assert kept.tolist() == ["00000001"]
+        assert dropped == 1
