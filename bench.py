@@ -40,12 +40,10 @@ def _synthetic(n: int, t_hours: int, seed: int = 0):
     return make_basin(n_segments=n, n_gauges=8, n_days=max(2, -(-t_hours // 24)), seed=seed)
 
 
-def bench_route(n: int, t_hours: int) -> float:
-    """Reach-timesteps/sec for the jitted forward route on the active backend."""
-    import jax
+def _bench_setup(n: int, t_hours: int):
+    """Shared benchmark inputs: (network, channels, gauges, params, q_prime)."""
     import jax.numpy as jnp
 
-    from ddr_tpu.routing.mc import route
     from ddr_tpu.routing.model import prepare_batch
     from ddr_tpu.validation.configs import Config
 
@@ -56,17 +54,49 @@ def bench_route(n: int, t_hours: int) -> float:
     )
     params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
     q_prime = jnp.asarray(basin.q_prime[:t_hours])
+    return network, channels, gauges, params, q_prime
 
-    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
-    fn(q_prime).block_until_ready()  # compile
-    # Queue all reps, block once: a blocking sync through the axon tunnel costs
-    # ~70ms of poll latency, which is device-idle time, not device throughput.
+
+def _timed_rate(fn, arg, n: int, t_hours: int) -> float:
+    """Compile once, then queue all reps and block once: a blocking sync through
+    the axon tunnel costs ~70ms of poll latency, which is device-idle time, not
+    device throughput."""
+    import jax
+
+    jax.block_until_ready(fn(arg))  # compile
     reps = 5
     t0 = time.perf_counter()
-    outs = [fn(q_prime) for _ in range(reps)]
+    outs = [fn(arg) for _ in range(reps)]
     jax.block_until_ready(outs)
     dt = (time.perf_counter() - t0) / reps
     return n * t_hours / dt
+
+
+def bench_route(n: int, t_hours: int) -> float:
+    """Reach-timesteps/sec for the jitted forward route on the active backend."""
+    import jax
+
+    from ddr_tpu.routing.mc import route
+
+    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours)
+    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
+    return _timed_rate(fn, q_prime, n, t_hours)
+
+
+def bench_grad(n: int, t_hours: int) -> float:
+    """Reach-timesteps/sec for the full VJP (value_and_grad of a gauge-loss route)
+    on the active backend — the training-path throughput."""
+    import jax
+
+    from ddr_tpu.routing.mc import route
+
+    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours)
+
+    def loss(p):
+        return route(network, channels, p, q_prime, gauges=gauges).runoff.mean()
+
+    fn = jax.jit(jax.value_and_grad(loss))
+    return _timed_rate(fn, params, n, t_hours)
 
 
 def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
@@ -222,6 +252,7 @@ def main() -> None:
         # explicit shape overrides (they may exist to bound wall-clock).
         out["route_error"] = f"accelerator route bench failed ({err}); retrying on CPU"
         out["device"] = "cpu"
+        cpu_only = True  # later phases must not touch the dead accelerator
         n = int(os.environ.get("DDR_BENCH_N", CPU_FALLBACK_N))
         t_hours = int(os.environ.get("DDR_BENCH_T", CPU_FALLBACK_T))
         out["metric"] = (
@@ -239,6 +270,20 @@ def main() -> None:
             out["route_error"] = f"unparseable route output: {val!r}"
     else:
         out.setdefault("route_error", err)
+
+    # Phase 2b (best-effort): training-path throughput — the full VJP. Failure
+    # only omits the extra field; the headline metric is already settled.
+    if out["value"] is not None:
+        gval, gerr = _run_child(
+            f"import bench; print(bench.bench_grad({n}, {t_hours}))", bench_timeout, cpu_only
+        )
+        if gval is not None:
+            try:
+                out["grad_value"] = round(float(gval), 1)
+            except ValueError:
+                out["grad_error"] = f"unparseable grad output: {gval!r}"
+        else:
+            out["grad_error"] = gerr
 
     # Phase 3: the reference-equivalent CPU baseline.
     ref, err = _run_child(
