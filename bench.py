@@ -267,7 +267,10 @@ def main() -> None:
         try:
             out["value"] = round(float(val), 1)
         except ValueError:
-            out["route_error"] = f"unparseable route output: {val!r}"
+            # Append: a prior accelerator-failure diagnostic must survive.
+            prior = out.get("route_error")
+            msg = f"unparseable route output: {val!r}"
+            out["route_error"] = f"{prior}; {msg}" if prior else msg
     else:
         out.setdefault("route_error", err)
 

@@ -104,7 +104,9 @@ def build_pipeline_schedule(
         level_schedule(l_tgt[l_shard == s], l_src[l_shard == s], n_local)
         for s in range(n_shards)
     ]
-    d_max = max(1, *(d for _, _, d in schedules))
+    # Rows, not topological depth: level_schedule may split oversized levels into
+    # extra chunk rows, so the scan length is ls.shape[0] >= depth.
+    d_max = max(1, *(ls.shape[0] if ls.size else d for ls, _, d in schedules))
     e_max = max(1, *(ls.shape[1] if ls.size else 1 for ls, _, _ in schedules))
     eloc_max = max(1, int(np.bincount(l_shard, minlength=n_shards).max()) if l_shard.size else 1)
 
@@ -114,8 +116,8 @@ def build_pipeline_schedule(
     loc_tgt = np.full((n_shards, eloc_max), n_local, dtype=np.int64)
     for s, (ls, lt, depth) in enumerate(schedules):
         if depth:
-            lvl_src[s, :depth, : ls.shape[1]] = ls
-            lvl_tgt[s, :depth, : lt.shape[1]] = lt
+            lvl_src[s, : ls.shape[0], : ls.shape[1]] = ls
+            lvl_tgt[s, : lt.shape[0], : lt.shape[1]] = lt
         m = l_shard == s
         loc_src[s, : m.sum()] = l_src[m]
         loc_tgt[s, : m.sum()] = l_tgt[m]

@@ -32,9 +32,11 @@ class RiverNetwork:
     Two solve schedules coexist:
 
     *Rectangle schedule* (always present) — edges grouped by target level and padded
-    to ``(D, E_max)``; the solve is a ``lax.scan`` of gather + scatter-add steps.
-    Used by the pipelined multi-shard router and as the fallback for very deep or
-    high-degree networks.
+    to a ``(n_rows, width)`` rectangle, where oversized levels are split into
+    multiple chunk rows so the padded size stays O(E) (``n_rows >= depth``; size
+    scans by ``lvl_src.shape[0]``, never by ``depth``). The solve is a ``lax.scan``
+    of gather + scatter-add steps. Used by the pipelined multi-shard router and as
+    the fallback for very deep or high-degree networks.
 
     *Fused schedule* (``fused=True``) — reaches permuted level-contiguously
     (``perm``), predecessors padded to a fixed-width gather table ``pred`` (river
@@ -175,13 +177,22 @@ def compute_levels(rows: np.ndarray, cols: np.ndarray, n: int) -> np.ndarray:
 def level_schedule(
     rows: np.ndarray, cols: np.ndarray, n: int, level: np.ndarray | None = None
 ) -> tuple[np.ndarray, np.ndarray, int]:
-    """Edges grouped by target level and padded to a ``(depth, e_max)`` rectangle.
+    """Edges grouped by target level and padded to a ``(n_rows, width)`` rectangle.
 
     Padding slots hold the sentinel ``n`` (consumed by the solver's clip-gather /
     drop-scatter convention). Shared by :func:`build_network` and the per-shard
     schedules of :mod:`ddr_tpu.parallel.pipeline`. Pass ``level`` when the caller
     already computed it (the Kahn layering is the dominant host-side build cost on
     multi-million-reach graphs).
+
+    Oversized levels are split into chunks of at most ``max(1024, 2 * mean)``
+    edges — within-level edges are independent (every source sits at a strictly
+    lower level), so extra scan rows for the same level are semantically free.
+    This bounds the padded rectangle at O(n_edges) even when level sizes are
+    heavily skewed (a single huge confluence level otherwise inflates
+    ``depth x e_max`` to gigabytes at continental scale), so ``n_rows`` can
+    exceed the returned topological ``depth``. Consumers must size scans by
+    ``lvl_src.shape[0]``, not ``depth``.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -197,11 +208,19 @@ def level_schedule(
     s_src = cols[order]
     s_tgt = rows[order]
     counts = np.bincount(tgt_level[order], minlength=depth + 1)[1:]  # levels 1..depth
-    e_max = int(counts.max())
-    lvl_src = np.full((depth, e_max), n, dtype=np.int64)
-    lvl_tgt = np.full((depth, e_max), n, dtype=np.int64)
-    col_pos = _ranges(np.zeros(depth, dtype=np.int64), counts.astype(np.int64))
-    row_pos = np.repeat(np.arange(depth), counts)
+    e_mean = int(np.ceil(counts.sum() / depth))
+    e_cap = max(1024, 2 * e_mean)
+    chunks = np.maximum(1, -(-counts // e_cap))  # chunks per level
+    width = int(min(int(counts.max()), e_cap))
+    row_base = np.concatenate([[0], np.cumsum(chunks)])  # first row of each level
+    n_rows = int(row_base[-1])
+
+    lvl_src = np.full((n_rows, width), n, dtype=np.int64)
+    lvl_tgt = np.full((n_rows, width), n, dtype=np.int64)
+    pos_in_level = _ranges(np.zeros(depth, dtype=np.int64), counts.astype(np.int64))
+    level_of_edge = np.repeat(np.arange(depth), counts)
+    row_pos = row_base[level_of_edge] + pos_in_level // width
+    col_pos = pos_in_level % width
     lvl_src[row_pos, col_pos] = s_src
     lvl_tgt[row_pos, col_pos] = s_tgt
     return lvl_src, lvl_tgt, depth

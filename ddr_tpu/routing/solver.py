@@ -158,7 +158,9 @@ fused_solve.defvjp(_fused_solve_fwd, _fused_solve_bwd)
 
 
 def solve_lower_triangular(network: RiverNetwork, c1: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Solve ``(I - diag(c1) N) x = b`` exactly in ``network.depth`` wavefront steps.
+    """Solve ``(I - diag(c1) N) x = b`` in one wavefront step per schedule row
+    (``network.lvl_src.shape[0]`` — the topological depth plus any chunk rows
+    split off oversized levels).
 
     Unlike naive autodiff through the sweep (which would checkpoint the carry at every
     level), the custom VJP stores only the final solution and replays a single

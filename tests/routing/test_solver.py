@@ -234,3 +234,78 @@ class TestScheduleEquivalence:
             build_network(rows, cols, n, fused=True)
         net = build_network(rows, cols, n)  # auto falls back
         assert not net.fused
+
+
+class TestChunkedSchedule:
+    """level_schedule caps the padded rectangle at O(n_edges) by splitting
+    oversized levels into chunk rows (safe: within-level edges are independent)."""
+
+    def _skewed(self, n_chain=100, n_wide=5000):
+        # n_wide headwaters (ids 0..n_wide-1) all drain into the chain head
+        # (id n_wide), then a chain of n_chain further reaches: one level is
+        # ~50x wider than every other. Ids are topologically ordered
+        # (downstream > upstream), as the binsparse stores guarantee.
+        head = n_wide
+        n = n_wide + n_chain + 1
+        rows = [head] * n_wide + list(range(head + 1, n))
+        cols = list(range(n_wide)) + list(range(head, n - 1))
+        return np.asarray(rows), np.asarray(cols), n
+
+    def test_rectangle_is_capped(self):
+        from ddr_tpu.routing.network import level_schedule
+
+        rows, cols, n = self._skewed()
+        lvl_src, lvl_tgt, depth = level_schedule(rows, cols, n)
+        assert lvl_src.shape[1] <= 1024
+        assert lvl_src.shape[0] > depth  # chunk rows added
+        # Every real edge appears exactly once.
+        real = lvl_tgt[lvl_tgt < n]
+        assert real.size == len(rows)
+
+    def test_chunked_solve_matches_scipy(self, rng):
+        import scipy.sparse as sp
+        from scipy.sparse.linalg import spsolve_triangular
+
+        from ddr_tpu.routing.network import build_network
+        from ddr_tpu.routing.solver import solve_lower_triangular, solve_transposed
+
+        rows, cols, n = self._skewed(n_chain=60, n_wide=3000)
+        net = build_network(rows, cols, n, fused=False)
+        assert net.lvl_src.shape[0] > net.depth  # chunking active
+        c1 = jnp.asarray(rng.uniform(0.05, 0.9, n), jnp.float32)
+        b = jnp.asarray(rng.uniform(0.1, 2.0, n), jnp.float32)
+        A = sp.eye(n) - sp.diags(np.asarray(c1, np.float64)) @ sp.coo_matrix(
+            (np.ones(len(rows)), (rows, cols)), shape=(n, n)
+        )
+        want = spsolve_triangular(A.tocsr().astype(np.float64), np.asarray(b, np.float64), lower=True)
+        got = solve_lower_triangular(net, c1, b)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=1e-5)
+        # Transposed sweep (the backward path) under chunking:
+        want_t = spsolve_triangular(
+            A.tocsr().T.tocsr().astype(np.float64), np.asarray(b, np.float64), lower=False
+        )
+        got_t = solve_transposed(net, c1, b)
+        np.testing.assert_allclose(np.asarray(got_t), want_t, rtol=2e-4, atol=1e-5)
+
+    def test_chunked_gradients_finite_difference(self, rng):
+        from ddr_tpu.routing.network import build_network
+        from ddr_tpu.routing.solver import solve_lower_triangular
+
+        rows, cols, n = self._skewed(n_chain=20, n_wide=1500)
+        net = build_network(rows, cols, n, fused=False)
+        c1 = jnp.asarray(rng.uniform(0.1, 0.8, n), jnp.float32)
+        b = jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32)
+
+        def loss(c):
+            return jnp.sum(solve_lower_triangular(net, c, b) ** 2)
+
+        g = jax.grad(loss)(c1)
+        # Headwater c1 values are never used (a headwater is no edge's target).
+        assert np.asarray(g[0]) == 0.0
+        # The confluence head concentrates the signal, so the finite difference
+        # stays well above float32 resolution of the million-scale loss.
+        head = n - 21  # n_chain=20 chain reaches after the confluence
+        eps = 1e-3
+        e = jnp.zeros(n).at[head].set(eps)
+        fd = (loss(c1 + e) - loss(c1 - e)) / (2 * eps)
+        assert np.asarray(g[head]) == pytest.approx(float(fd), rel=0.01)
