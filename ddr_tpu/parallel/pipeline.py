@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
-from ddr_tpu.routing.network import level_schedule
+from ddr_tpu.routing.network import compute_levels, level_schedule
 from ddr_tpu.routing.solver import _sweep_down
 
 __all__ = ["PipelineSchedule", "build_pipeline_schedule", "pipelined_route"]
@@ -99,14 +99,26 @@ def build_pipeline_schedule(
     b_sshard, b_tshard = src_shard[~local], tgt_shard[~local]
 
     # Per-shard local level schedules (shared builder with build_network), padded to
-    # a common (D, E) rectangle across shards.
+    # a common (D, E) rectangle across shards. One SHARED chunk cap: the stacked
+    # rectangle takes its row count and width from different shards, so letting
+    # each shard pick its own cap would re-admit the deep-shard x wide-shard
+    # memory blowup the chunking exists to prevent.
+    shard_levels = [
+        compute_levels(l_tgt[l_shard == s], l_src[l_shard == s], n_local)
+        for s in range(n_shards)
+    ]
+    total_depth = sum(int(lv.max()) if lv.size else 0 for lv in shard_levels)
+    e_cap = max(1024, 2 * -(-int(l_shard.size) // max(1, total_depth)))
     schedules = [
-        level_schedule(l_tgt[l_shard == s], l_src[l_shard == s], n_local)
+        level_schedule(
+            l_tgt[l_shard == s], l_src[l_shard == s], n_local,
+            level=shard_levels[s], e_cap=e_cap,
+        )
         for s in range(n_shards)
     ]
     # Rows, not topological depth: level_schedule may split oversized levels into
     # extra chunk rows, so the scan length is ls.shape[0] >= depth.
-    d_max = max(1, *(ls.shape[0] if ls.size else d for ls, _, d in schedules))
+    d_max = max(1, *(ls.shape[0] for ls, _, _ in schedules))
     e_max = max(1, *(ls.shape[1] if ls.size else 1 for ls, _, _ in schedules))
     eloc_max = max(1, int(np.bincount(l_shard, minlength=n_shards).max()) if l_shard.size else 1)
 

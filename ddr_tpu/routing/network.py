@@ -175,7 +175,11 @@ def compute_levels(rows: np.ndarray, cols: np.ndarray, n: int) -> np.ndarray:
 
 
 def level_schedule(
-    rows: np.ndarray, cols: np.ndarray, n: int, level: np.ndarray | None = None
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    level: np.ndarray | None = None,
+    e_cap: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray, int]:
     """Edges grouped by target level and padded to a ``(n_rows, width)`` rectangle.
 
@@ -192,7 +196,9 @@ def level_schedule(
     heavily skewed (a single huge confluence level otherwise inflates
     ``depth x e_max`` to gigabytes at continental scale), so ``n_rows`` can
     exceed the returned topological ``depth``. Consumers must size scans by
-    ``lvl_src.shape[0]``, not ``depth``.
+    ``lvl_src.shape[0]``, not ``depth``. Callers stacking several schedules
+    into one rectangle (the pipelined router) pass an explicit shared
+    ``e_cap`` so every schedule chunks against the same width.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -208,8 +214,9 @@ def level_schedule(
     s_src = cols[order]
     s_tgt = rows[order]
     counts = np.bincount(tgt_level[order], minlength=depth + 1)[1:]  # levels 1..depth
-    e_mean = int(np.ceil(counts.sum() / depth))
-    e_cap = max(1024, 2 * e_mean)
+    if e_cap is None:
+        e_mean = int(np.ceil(counts.sum() / depth))
+        e_cap = max(1024, 2 * e_mean)
     chunks = np.maximum(1, -(-counts // e_cap))  # chunks per level
     width = int(min(int(counts.max()), e_cap))
     row_base = np.concatenate([[0], np.cumsum(chunks)])  # first row of each level
