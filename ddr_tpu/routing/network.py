@@ -192,10 +192,11 @@ def level_schedule(
     Oversized levels are split into chunks of at most ``max(1024, 2 * mean)``
     edges — within-level edges are independent (every source sits at a strictly
     lower level), so extra scan rows for the same level are semantically free.
-    This bounds the padded rectangle at O(n_edges) even when level sizes are
-    heavily skewed (a single huge confluence level otherwise inflates
-    ``depth x e_max`` to gigabytes at continental scale), so ``n_rows`` can
-    exceed the returned topological ``depth``. Consumers must size scans by
+    This bounds the padded rectangle at O(n_edges + 1024 * depth) — the width
+    floor trades a small bounded pad (tens of MB at continental depth) for
+    keeping wide levels vectorized — where level-size skew otherwise inflates
+    ``depth x e_max`` to gigabytes (a single huge confluence level sets
+    ``e_max``). ``n_rows`` can exceed the returned topological ``depth``. Consumers must size scans by
     ``lvl_src.shape[0]``, not ``depth``. Callers stacking several schedules
     into one rectangle (the pipelined router) pass an explicit shared
     ``e_cap`` so every schedule chunks against the same width.

@@ -309,3 +309,39 @@ class TestChunkedSchedule:
         e = jnp.zeros(n).at[head].set(eps)
         fd = (loss(c1 + e) - loss(c1 - e)) / (2 * eps)
         assert np.asarray(g[head]) == pytest.approx(float(fd), rel=0.01)
+
+    def test_rectangle_bounded_at_scale(self):
+        """The padded schedule stays O(E + 1024*depth) on a 200k-reach graph with
+        skewed level widths (regression: this build previously allocated
+        depth x e_max — e_max set by the single widest level — and took
+        >10 minutes at 131k reaches)."""
+        from ddr_tpu.routing.network import build_network
+
+        n = 200_000
+        rng = np.random.default_rng(0)
+        cols = np.arange(n - 1)
+        rows = np.minimum(cols + rng.integers(1, 64, size=n - 1), n - 1)
+        net = build_network(rows, cols, n)
+        rows_n, width = net.lvl_src.shape
+        assert width <= 1024
+        # Chunk rows beyond the topological depth are bounded by E / width.
+        assert rows_n <= net.depth + (n - 1) // width + 1
+
+    def test_pipeline_shards_share_cap(self):
+        """Stacked per-shard schedules chunk against one shared width: a
+        wide-flat shard must not dictate an unchunked e_max that multiplies
+        against a deep shard's row count."""
+        from ddr_tpu.parallel.pipeline import build_pipeline_schedule
+
+        # shard 0 (ids 0..4095): 4000 headwaters into one confluence (wide, flat)
+        # shard 1 (ids 4096..8191): one long chain (deep, thin)
+        half = 4096
+        rows = [4000] * 4000 + list(range(half + 1, 2 * half))
+        cols = list(range(4000)) + list(range(half, 2 * half - 1))
+        sched = build_pipeline_schedule(
+            np.asarray(rows), np.asarray(cols), 2 * half, n_shards=2
+        )
+        s, d, e = sched.lvl_src.shape
+        assert s == 2
+        assert e <= 1024  # the 4000-wide level was chunked, not taken whole
+        assert d <= half + 4000 // e + 1
