@@ -1,0 +1,99 @@
+"""Build every store the MERIT example needs — offline, deterministic, no
+external data.
+
+Adjacency + per-gauge subset stores come from the real engine builders
+(the same path a CONUS run takes, docs/engine/binsparse.md); the lateral-inflow,
+observation, and attribute stores are synthesized with a fixed seed, with
+observations derived from the inflows so training has signal to fit.
+
+Run once from this directory:
+
+    python prepare.py
+
+then train/route with config.yaml.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from ddr_tpu.engine.merit import build_gauge_adjacencies, build_merit_adjacency
+from ddr_tpu.geodatazoo.dataclasses import GaugeSet, MERITGauge
+from ddr_tpu.io.stores import write_attribute_store, write_hydro_store
+
+HERE = Path(__file__).parent
+DATA = HERE / "data"
+N_DAYS = 400  # 1981-09-25 onward: covers the config's train window
+ATTRS = [f"a{i}" for i in range(8)]
+
+
+def main() -> None:
+    fp = pd.read_csv(HERE / "flowpaths.csv")
+    comids = fp["COMID"].tolist()
+    rng = np.random.default_rng(7)
+
+    DATA.mkdir(exist_ok=True)
+    conus = DATA / "merit_conus_adjacency.zarr"
+    gages_store = DATA / "merit_gages_adjacency.zarr"
+    # Gate on the LAST-built store: an interrupted first run must rebuild, not
+    # silently skip the missing gauge subsets.
+    if not gages_store.exists():
+        if conus.exists():
+            import shutil
+
+            shutil.rmtree(conus)
+        build_merit_adjacency(fp, conus)
+        gauges = GaugeSet(
+            gauges=[
+                MERITGauge(STAID="11111111", STANAME="mid-basin", DRAIN_SQKM=120, COMID=107),
+                MERITGauge(STAID="22222222", STANAME="outlet", DRAIN_SQKM=400, COMID=110),
+            ]
+        )
+        build_gauge_adjacencies(fp, conus, gauges, gages_store)
+
+    # Catchment attributes (z-scorable, seeded).
+    write_attribute_store(
+        DATA / "attributes.zarr",
+        comids,
+        {name: rng.normal(loc=5.0, scale=2.0, size=len(comids)).astype(np.float32) for name in ATTRS},
+    )
+
+    # Daily lateral inflows: seasonal cycle + storm pulses per catchment.
+    t = np.arange(N_DAYS)
+    seasonal = 1.0 + 0.5 * np.sin(2 * np.pi * t / 365.0)
+    qr = np.empty((len(comids), N_DAYS), dtype=np.float32)
+    for i in range(len(comids)):
+        storms = rng.gamma(2.0, 0.6, N_DAYS) * (rng.random(N_DAYS) < 0.15)
+        qr[i] = (0.4 * seasonal + storms).astype(np.float32)
+    write_hydro_store(
+        DATA / "streamflow.zarr", comids, "1981/09/25", "D", {"Qr": qr}, units={"Qr": "m3 s-1"}
+    )
+
+    # Observations: accumulated upstream inflow per gauge + noise — enough signal
+    # for the KAN to fit without circularly baking in the routing model.
+    upstream = {
+        "11111111": [101, 102, 103, 104, 105, 106, 107],
+        "22222222": comids,
+    }
+    pos = {c: i for i, c in enumerate(comids)}
+    obs = np.stack(
+        [
+            qr[[pos[c] for c in ups]].sum(axis=0) * rng.uniform(0.9, 1.1)
+            for ups in upstream.values()
+        ]
+    ).astype(np.float32)
+    write_hydro_store(
+        DATA / "observations.zarr",
+        list(upstream),
+        "1981/09/25",
+        "D",
+        {"streamflow": obs},
+        id_dim="gage_id",
+        units={"streamflow": "m3 s-1"},
+    )
+    print(f"stores written under {DATA}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Guard the shipped MERIT-format example end to end: prepare -> train -> route
+(the real-data path on committed fixtures, examples/merit_basin/)."""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+EXAMPLE = Path(__file__).resolve().parents[1] / "examples" / "merit_basin"
+
+
+@pytest.fixture(scope="module")
+def example_dir(tmp_path_factory):
+    """Copy the example to a tmp dir (keeps the repo tree clean) and prepare it."""
+    tmp = tmp_path_factory.mktemp("merit_example")
+    dst = tmp / "merit_basin"
+    shutil.copytree(EXAMPLE, dst, ignore=shutil.ignore_patterns("data", "output"))
+    proc = subprocess.run(
+        [sys.executable, "prepare.py"], cwd=dst, capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+    return dst
+
+
+class TestMeritExample:
+    def test_prepare_builds_all_stores(self, example_dir):
+        for store in (
+            "merit_conus_adjacency.zarr",
+            "merit_gages_adjacency.zarr",
+            "attributes.zarr",
+            "streamflow.zarr",
+            "observations.zarr",
+        ):
+            assert (example_dir / "data" / store).exists(), store
+
+    def test_prepare_is_idempotent(self, example_dir):
+        proc = subprocess.run(
+            [sys.executable, "prepare.py"], cwd=example_dir, capture_output=True, text=True
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_train_and_route(self, example_dir):
+        from ddr_tpu.scripts.router import route_domain
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.training import latest_checkpoint
+        from ddr_tpu.validation.configs import load_config
+
+        cfg = load_config(
+            example_dir / "config.yaml",
+            overrides=[
+                "experiment.epochs=1",
+                f"params.save_path={example_dir / 'output'}",
+                f"data_sources.attributes={example_dir / 'data/attributes.zarr'}",
+                f"data_sources.conus_adjacency={example_dir / 'data/merit_conus_adjacency.zarr'}",
+                f"data_sources.gages_adjacency={example_dir / 'data/merit_gages_adjacency.zarr'}",
+                f"data_sources.streamflow={example_dir / 'data/streamflow.zarr'}",
+                f"data_sources.observations={example_dir / 'data/observations.zarr'}",
+                f"data_sources.gages={example_dir / 'gages.csv'}",
+                f"data_sources.statistics={example_dir / 'output/stats'}",
+            ],
+            save_config=False,
+        )
+        params, _ = train(cfg, max_batches=1)
+        assert params is not None
+        ckpt = latest_checkpoint(Path(cfg.params.save_path) / "saved_models")
+        assert ckpt is not None
+
+        # Route WITH the trained checkpoint — the documented sequence.
+        route_cfg = cfg.model_copy(deep=True)
+        route_cfg.mode = route_cfg.mode.__class__("routing")
+        route_cfg.experiment.rho = None
+        route_cfg.experiment.checkpoint = ckpt
+        discharge = route_domain(route_cfg)
+        assert discharge.shape[0] == 2  # one series per gauge
+        assert np.isfinite(discharge).all()
