@@ -48,37 +48,71 @@ def _schema_type(prop: dict[str, Any], defs: dict[str, Any]) -> str:
     return str(t or "any")
 
 
-def _fmt_default(prop: dict[str, Any]) -> str:
-    if "default" not in prop:
-        return "**required**"
-    d = prop["default"]
+def _fmt_value(d: Any) -> str:
     if d is None:
         return "`None`"
-    s = json.dumps(d) if isinstance(d, (dict, list)) else str(d)
+    s = json.dumps(d, default=str) if isinstance(d, (dict, list)) else str(d)
     if len(s) > 48:
         s = s[:45] + "..."
-    return f"`{s}`"
+    return f"`{s.replace('|', chr(92) + '|')}`"
 
 
-def _model_section(name: str, schema: dict[str, Any], defs: dict[str, Any]) -> list[str]:
+def _fmt_default(prop: dict[str, Any], field_info: Any) -> str:
+    if "default" in prop:
+        return _fmt_value(prop["default"])
+    # default_factory fields carry no "default" in the JSON schema but are NOT
+    # required; materialize the factory value for the docs.
+    if field_info is not None and field_info.default_factory is not None:
+        return _fmt_value(field_info.default_factory())
+    return "**required**"
+
+
+def _model_section(
+    name: str, schema: dict[str, Any], defs: dict[str, Any], model: Any = None
+) -> list[str]:
     lines = [f"## `{name}`", ""]
     doc = (schema.get("description") or "").strip().split("\n")[0]
     if doc:
         lines += [doc, ""]
     lines += ["| field | type | default | description |", "|---|---|---|---|"]
+    fields = getattr(model, "model_fields", {}) if model is not None else {}
     for field, prop in schema.get("properties", {}).items():
         desc = (prop.get("description") or "").replace("|", "\\|")
         lines.append(
-            f"| `{field}` | {_schema_type(prop, defs)} | {_fmt_default(prop)} | {desc} |"
+            f"| `{field}` | {_schema_type(prop, defs)} | "
+            f"{_fmt_default(prop, fields.get(field))} | {desc} |"
         )
     lines.append("")
     return lines
+
+
+def _collect_models(model: Any, acc: dict[str, Any]) -> None:
+    """Map class name -> pydantic model for ``model`` and every nested model."""
+    import typing
+
+    from pydantic import BaseModel
+
+    name = model.__name__
+    if name in acc:
+        return
+    acc[name] = model
+    for f in model.model_fields.values():
+        stack = [f.annotation]
+        while stack:
+            t = stack.pop()
+            stack.extend(typing.get_args(t))
+            if isinstance(t, type) and issubclass(t, BaseModel):
+                _collect_models(t, acc)
 
 
 def generate() -> str:
     from ddr_tpu.benchmarks.configs import BenchmarkConfig
     from ddr_tpu.bmi.config import BmiInitConfig
     from ddr_tpu.validation.configs import Config
+
+    models: dict[str, Any] = {}
+    for m in (Config, BmiInitConfig, BenchmarkConfig):
+        _collect_models(m, models)
 
     out = [HEADER]
     emitted: set[str] = set()  # BenchmarkConfig embeds Config: emit each model once
@@ -91,11 +125,11 @@ def generate() -> str:
         defs = schema.get("$defs", {})
         if root_name not in emitted:
             emitted.add(root_name)
-            out += _model_section(root_name, schema, defs)
+            out += _model_section(root_name, schema, defs, model)
         for def_name, def_schema in sorted(defs.items()):
             if def_schema.get("type") == "object" and def_name not in emitted:
                 emitted.add(def_name)
-                out += _model_section(def_name, def_schema, defs)
+                out += _model_section(def_name, def_schema, defs, models.get(def_name))
     return "\n".join(out)
 
 
@@ -109,4 +143,6 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

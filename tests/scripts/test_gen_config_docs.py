@@ -47,3 +47,16 @@ def test_main_writes_file(tmp_path):
     out = tmp_path / "ref.md"
     assert main([str(out)]) == 0
     assert out.read_text().startswith("# Configuration reference")
+
+
+def test_factory_defaults_not_marked_required():
+    """default_factory fields must show their materialized value, not **required**
+    (regression: grid_range/learnable_parameters were mislabeled)."""
+    from ddr_tpu.scripts.gen_config_docs import generate
+
+    text = generate()
+    kan_section = text.split("## `Kan`")[1].split("## ")[0]
+    assert "[-2.0, 2.0]" in kan_section
+    assert '["n", "q_spatial"]' in kan_section
+    # Genuinely required fields keep the marker.
+    assert "**required**" in kan_section  # input_var_names
