@@ -22,7 +22,7 @@ from ddr_tpu.geodatazoo.dataclasses import Dates, RoutingData
 from ddr_tpu.io.readers import ObservationSet
 from ddr_tpu.validation.enums import Mode
 
-__all__ = ["SyntheticBasin", "make_basin", "Synthetic"]
+__all__ = ["SyntheticBasin", "make_basin", "make_deep_network", "Synthetic"]
 
 N_ATTRIBUTES = 10  # the 10 canonical MERIT attributes (/root/reference/src/ddr/geometry/adapters.py:22-33)
 
@@ -49,17 +49,118 @@ def _dendritic_network(rng: np.random.Generator, n: int) -> tuple[np.ndarray, np
     return np.asarray(rows, dtype=np.int64), np.asarray(cols, dtype=np.int64)
 
 
+def make_deep_network(
+    n: int,
+    depth: int,
+    seed: int = 0,
+    alpha: float = 0.5,
+    trib_reach: float = 8.0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """CONUS-realistic deep dendritic topology with EXACT longest-path depth.
+
+    The default generator (:func:`_dendritic_network`) draws each downstream hop
+    from up to ``n // 8`` away, which collapses topological depth to ~30 even at
+    N=32k — nothing like real continental river networks, where mainstem
+    longest-path depths run in the low thousands (global MERIT: ~2.9M reaches,
+    /root/reference/scripts/geometry_predictor.py:80). This generator controls
+    depth directly, mimicking the real structure: one mainstem per outlet, a
+    headwater-heavy level-population profile, and tributaries that join nearby
+    downstream levels (confluences with in-degree mostly 2).
+
+    Construction (levels = longest-path distance from headwaters, by design):
+
+    1. Level populations ``counts[L] ~ (L + 1) ** -alpha`` (headwater-heavy,
+       monotone non-increasing, each >= 1) summing to ``n``.
+    2. Node ids are level-major, so every edge points to a strictly higher id —
+       the produced COO is topologically sorted lower-triangular like the
+       engine-built stores (/root/reference/docs/engine/binsparse.md:33-47).
+    3. Each level-L node (L >= 1) gets one PRIMARY upstream drawn without
+       replacement from level L-1 (feasible since counts are non-increasing);
+       this pins its longest-path level to exactly L and threads a full-depth
+       mainstem through every level.
+    4. Every remaining node (out-degree still 0, level < depth) becomes a
+       TRIBUTARY: it drains into a node ``1 + Geometric(1 / trib_reach)`` levels
+       downstream (clipped to the last level), uniformly within that level —
+       locality matching how real tributaries join nearby mainstem reaches.
+
+    Returns ``(rows, cols)``: edge src=cols[i] drains into tgt=rows[i], dendritic
+    (out-degree 1, E = n - counts[depth] edges, so mean in-degree is just under 1
+    with confluences mostly in-degree 2). ``seed`` also accepts an existing
+    ``np.random.Generator`` (shared-stream callers like :func:`make_basin`).
+    """
+    if depth < 1 or n < depth + 1:
+        raise ValueError(f"need n >= depth + 1 (got n={n}, depth={depth})")
+    rng = np.random.default_rng(seed)  # passes Generators through unchanged
+
+    # Level populations: power-law decay, forced monotone non-increasing, min 1.
+    raw = (np.arange(1, depth + 2, dtype=np.float64)) ** (-alpha)
+    counts = np.maximum(1, np.floor(raw * (n / raw.sum()))).astype(np.int64)
+    counts = np.minimum.accumulate(counts)  # non-increasing => primaries feasible
+    # Distribute the rounding remainder to the earliest (widest) levels without
+    # breaking monotonicity: add 1 to levels 0..r-1 repeatedly.
+    deficit = n - int(counts.sum())
+    while deficit > 0:
+        take = min(deficit, depth + 1)
+        counts[:take] += 1
+        deficit -= take
+    while deficit < 0:  # floor overshoot: shave deepest levels first, keep >= 1
+        removable = np.flatnonzero(counts > 1)  # level 0 shaveable last (near-pure mainstems)
+        shave = removable[-min(-deficit, removable.size):]
+        counts[shave] -= 1
+        deficit += shave.size
+    assert counts.sum() == n and (counts >= 1).all()
+
+    starts = np.concatenate([[0], np.cumsum(counts)])  # level L ids: [starts[L], starts[L+1])
+    src_parts: list[np.ndarray] = []
+    tgt_parts: list[np.ndarray] = []
+    has_out = np.zeros(n, dtype=bool)
+
+    # Primaries: one per node of level L, drawn without replacement from level L-1.
+    for L in range(1, depth + 1):
+        prev = np.arange(starts[L - 1], starts[L])
+        cur = np.arange(starts[L], starts[L + 1])
+        chosen = rng.permutation(prev)[: cur.size]
+        src_parts.append(chosen)
+        tgt_parts.append(cur)
+        has_out[chosen] = True
+
+    # Tributaries: every still-unassigned node below the last level drains
+    # 1 + Geometric levels downstream (clipped), uniform within the target level.
+    pending = np.flatnonzero(~has_out[: starts[depth]])
+    if pending.size:
+        lvl_of = np.repeat(np.arange(depth + 1), counts)
+        hop = 1 + rng.geometric(1.0 / trib_reach, size=pending.size)
+        tgt_lvl = np.minimum(lvl_of[pending] + hop, depth)
+        tgt = starts[tgt_lvl] + rng.integers(0, counts[tgt_lvl])
+        src_parts.append(pending)
+        tgt_parts.append(tgt)
+
+    cols = np.concatenate(src_parts)
+    rows = np.concatenate(tgt_parts)
+    order = np.lexsort((cols, rows))
+    return rows[order], cols[order]
+
+
 def make_basin(
     n_segments: int = 64,
     n_gauges: int = 4,
     n_days: int = 8,
     seed: int = 0,
     start_time: str = "1981/10/01",
+    depth: int | None = None,
 ) -> SyntheticBasin:
-    """Build a synthetic basin with a storm-hydrograph forcing."""
+    """Build a synthetic basin with a storm-hydrograph forcing.
+
+    ``depth`` switches the topology to the CONUS-realistic deep generator
+    (:func:`make_deep_network`) with that exact longest-path depth; ``None``
+    keeps the historical shallow random tree.
+    """
     rng = np.random.default_rng(seed)
     n = n_segments
-    rows, cols = _dendritic_network(rng, n)
+    if depth is None:
+        rows, cols = _dendritic_network(rng, n)
+    else:
+        rows, cols = make_deep_network(n, depth, seed=rng)  # shared stream, no seed reuse
 
     length = rng.uniform(800, 6000, n)
     slope = rng.uniform(5e-4, 0.02, n)

@@ -1,0 +1,72 @@
+"""Generator contracts for the synthetic basins, focused on the deep
+CONUS-realistic topology (round-3 requirement: the bench/ablation networks must
+carry mainstem-scale longest-path depth, not the ~30 the shallow tree tops out at).
+"""
+
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin, make_deep_network
+from ddr_tpu.routing.network import compute_levels
+
+
+@pytest.mark.parametrize("n,depth", [(64, 10), (500, 120), (5000, 1500)])
+def test_deep_network_exact_depth(n, depth):
+    rows, cols = make_deep_network(n, depth, seed=3)
+    level = compute_levels(rows, cols, n)
+    assert int(level.max()) == depth
+
+
+def test_deep_network_is_sorted_dendritic():
+    n, depth = 2000, 400
+    rows, cols = make_deep_network(n, depth, seed=7)
+    # topologically sorted lower-triangular: src strictly below tgt
+    assert (cols < rows).all()
+    out_deg = np.bincount(cols, minlength=n)
+    assert out_deg.max() == 1  # dendritic: every reach drains to one downstream
+    # every non-outlet reach drains somewhere; outlets are the last level only
+    level = compute_levels(rows, cols, n)
+    no_out = np.flatnonzero(out_deg == 0)
+    assert (level[no_out] == depth).all()
+
+
+def test_deep_network_headwater_heavy():
+    """Level populations decay: more headwaters than deep mainstem reaches."""
+    n, depth = 20000, 2000
+    rows, cols = make_deep_network(n, depth, seed=0)
+    level = compute_levels(rows, cols, n)
+    counts = np.bincount(level, minlength=depth + 1)
+    assert (counts >= 1).all()  # mainstem threads every level
+    assert counts[0] > 4 * counts[depth]
+
+
+def test_deep_network_determinism():
+    a = make_deep_network(300, 50, seed=11)
+    b = make_deep_network(300, 50, seed=11)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    c = make_deep_network(300, 50, seed=12)
+    assert not np.array_equal(a[0], c[0])
+
+
+@pytest.mark.parametrize("n,depth", [(102, 100), (11, 10)])
+def test_deep_network_near_pure_mainstem(n, depth):
+    """Minimal-width networks (n barely above depth+1) must terminate and hit
+    the exact depth — regression for the count-shave loop spinning when only
+    level 0 had shaveable population."""
+    rows, cols = make_deep_network(n, depth, seed=1)
+    level = compute_levels(rows, cols, n)
+    assert int(level.max()) == depth
+
+
+def test_deep_network_rejects_infeasible():
+    with pytest.raises(ValueError):
+        make_deep_network(5, 10)
+
+
+def test_make_basin_deep_topology_end_to_end():
+    basin = make_basin(n_segments=256, n_gauges=2, n_days=2, seed=0, depth=60)
+    rd = basin.routing_data
+    level = compute_levels(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
+    assert int(level.max()) == 60
+    assert basin.q_prime.shape == (48, 256)
