@@ -16,7 +16,16 @@ algorithm as /root/reference/src/ddr/routing/mmc.py:415-441 + utils.py:535-627,
 including the PatternMapper values-only CSR update of utils.py:89-102) on the same
 synthetic network generator, normalized per reach-timestep.
 
-Env knobs: DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_PROBE_TIMEOUT /
+Round 3 adds the CONUS-realistic topology phase: the headline metric stays on the
+legacy shallow generator (cross-round comparability), and a second measurement
+(``deep_value``/``deep_metric``) routes a deep network (longest-path depth in the
+thousands, like continental MERIT) through whatever engine
+``build_routing_network`` auto-selects — the depth-chunked wavefront at these
+shapes — so the recorded number exercises the flagship-topology path, not the
+shallow best case.
+
+Env knobs: DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_DEEP_N /
+DDR_BENCH_DEEP_DEPTH (deep-topology phase; 0 disables it), DDR_BENCH_PROBE_TIMEOUT /
 DDR_BENCH_TIMEOUT (seconds, accelerator probe / each benchmark subprocess).
 """
 
@@ -32,15 +41,24 @@ DEFAULT_N = 8192
 DEFAULT_T = 240
 CPU_FALLBACK_N = 2048
 CPU_FALLBACK_T = 48
+# Deep-topology phase defaults (the CONUS-shaped regime: depth in the thousands).
+DEEP_N = 262144
+DEEP_DEPTH = 2048
+# CPU fallback still exercises the depth-chunked path: depth > the single-ring
+# cap (1024), so build_routing_network cannot select the single-ring engine.
+CPU_DEEP_N = 4096
+CPU_DEEP_DEPTH = 1536
 
 
-def _synthetic(n: int, t_hours: int, seed: int = 0):
+def _synthetic(n: int, t_hours: int, seed: int = 0, depth: int | None = None):
     from ddr_tpu.geodatazoo.synthetic import make_basin
 
-    return make_basin(n_segments=n, n_gauges=8, n_days=max(2, -(-t_hours // 24)), seed=seed)
+    return make_basin(
+        n_segments=n, n_gauges=8, n_days=max(2, -(-t_hours // 24)), seed=seed, depth=depth
+    )
 
 
-def _bench_setup(n: int, t_hours: int):
+def _bench_setup(n: int, t_hours: int, depth: int | None = None):
     """Shared benchmark inputs: (network, channels, gauges, params, q_prime)."""
     import jax.numpy as jnp
 
@@ -48,7 +66,7 @@ def _bench_setup(n: int, t_hours: int):
     from ddr_tpu.validation.configs import Config
 
     cfg = Config(name="bench", geodataset="synthetic", mode="routing", kan={"input_var_names": ["a"]})
-    basin = _synthetic(n, t_hours)
+    basin = _synthetic(n, t_hours, depth=depth)
     network, channels, gauges = prepare_batch(
         basin.routing_data, cfg.params.attribute_minimums["slope"]
     )
@@ -72,15 +90,39 @@ def _timed_rate(fn, arg, n: int, t_hours: int) -> float:
     return n * t_hours / dt
 
 
-def bench_route(n: int, t_hours: int) -> float:
-    """Reach-timesteps/sec for the jitted forward route on the active backend."""
+def bench_route(n: int, t_hours: int, depth: int | None = None) -> float:
+    """Reach-timesteps/sec for the jitted forward route on the active backend.
+
+    ``depth`` switches the topology to the deep CONUS-realistic generator;
+    prepare_batch's auto-selection then routes it through the depth-chunked
+    wavefront (ddr_tpu.routing.chunked)."""
     import jax
 
     from ddr_tpu.routing.mc import route
 
-    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours)
+    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
     fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
     return _timed_rate(fn, q_prime, n, t_hours)
+
+
+def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
+    """Deep-topology route bench; prints ``"<rate> <engine-label>"`` so the record
+    names the engine that ACTUALLY ran (auto-selection may pick the single-ring
+    wavefront when the requested depth fits its caps)."""
+    import jax
+
+    from ddr_tpu.routing.chunked import ChunkedNetwork
+    from ddr_tpu.routing.mc import route
+
+    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
+    if isinstance(network, ChunkedNetwork):
+        engine = f"depth-chunked-wavefront[{network.n_chunks}-band]"
+    elif getattr(network, "wavefront", False):
+        engine = "single-ring-wavefront"
+    else:
+        engine = "step"
+    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
+    return f"{_timed_rate(fn, q_prime, n, t_hours)} {engine}"
 
 
 def bench_grad(n: int, t_hours: int) -> float:
@@ -283,10 +325,45 @@ def main() -> None:
         if gval is not None:
             try:
                 out["grad_value"] = round(float(gval), 1)
+                out["grad_metric"] = (
+                    "reach-timesteps/sec/chip, full VJP (value_and_grad of the "
+                    "gauge-loss route), same shapes and unit as the headline"
+                )
             except ValueError:
                 out["grad_error"] = f"unparseable grad output: {gval!r}"
         else:
             out["grad_error"] = gerr
+
+    # Phase 2c (best-effort): the deep CONUS-shaped topology — depth in the
+    # thousands, routed by whatever build_routing_network auto-selects (the
+    # depth-chunked wavefront at these shapes).
+    try:
+        deep_n = int(os.environ.get("DDR_BENCH_DEEP_N", DEEP_N if not cpu_only else CPU_DEEP_N))
+        deep_depth = int(
+            os.environ.get("DDR_BENCH_DEEP_DEPTH", DEEP_DEPTH if not cpu_only else CPU_DEEP_DEPTH)
+        )
+    except ValueError as e:
+        deep_n, deep_depth = 0, 0
+        out["deep_error"] = f"bad DDR_BENCH_DEEP_N/DDR_BENCH_DEEP_DEPTH override: {e}"
+    if deep_n > 0 and deep_depth > 0:
+        dval, derr = _run_child(
+            f"import bench; print(bench.bench_route_deep({deep_n}, {t_hours}, {deep_depth}))",
+            bench_timeout,
+            cpu_only,
+        )
+        if dval is not None:
+            try:
+                rate_str, _, engine = dval.partition(" ")
+                out["deep_value"] = round(float(rate_str), 1)
+                out["deep_metric"] = (
+                    f"reach-timesteps/sec/chip, deep CONUS-shaped topology "
+                    f"({deep_n} reaches, longest-path depth {deep_depth}, {t_hours}h "
+                    f"forward route, engine={engine or 'unknown'})"
+                )
+            except ValueError:
+                out["deep_error"] = f"unparseable deep output: {dval!r}"
+        else:
+            out["deep_error"] = derr
 
     # Phase 3: the reference-equivalent CPU baseline.
     ref, err = _run_child(

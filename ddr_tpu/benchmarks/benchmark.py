@@ -98,7 +98,9 @@ def run_lti_benchmark(
         np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
     )  # (T, N)
 
-    network, _, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    network, _, gauges = prepare_batch(
+        rd, cfg.params.attribute_minimums["slope"], chunked=False
+    )  # route_lti reads RiverNetwork solve schedules
     if gauges is None:
         gauges = GaugeIndex.from_ragged(rd.outflow_idx)
     k_val = lti.k if lti.k is not None else 0.1042

@@ -180,7 +180,9 @@ class DdrBmi:
         spatial = jax.tree.map(jax.device_get, spatial)  # drop the KAN graph
         spatial = {k: jnp.asarray(v, jnp.float32) for k, v in spatial.items()}
 
-        network, channels, _ = prepare_batch(rd, self._cfg.params.attribute_minimums["slope"])
+        network, channels, _ = prepare_batch(
+            rd, self._cfg.params.attribute_minimums["slope"], chunked=False
+        )  # route_step needs a plain RiverNetwork
         bounds = Bounds.from_config(self._cfg.params.attribute_minimums)
         dt = self._timestep
         depth_lb = float(self._cfg.params.attribute_minimums.get("depth", 0.01))

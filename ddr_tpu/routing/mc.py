@@ -282,6 +282,18 @@ def route(
     physics in the backward pass instead of storing its intermediates — ~27%
     faster full VJP on the v5e chip; forward bitwise-unchanged (docs/tpu.md).
     """
+    from ddr_tpu.routing.chunked import ChunkedNetwork, route_chunked
+
+    if isinstance(network, ChunkedNetwork):
+        if engine not in (None, "wavefront"):
+            raise ValueError("a ChunkedNetwork always routes via the chunked wavefront")
+        if q_prime_permuted:
+            raise ValueError("q_prime_permuted is not supported on a ChunkedNetwork")
+        return route_chunked(
+            network, channels, spatial_params, q_prime, q_init=q_init,
+            gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
+        )
+
     n_mann = spatial_params["n"]
     q_spatial = spatial_params["q_spatial"]
     p_spatial = spatial_params["p_spatial"]
@@ -322,7 +334,7 @@ def route(
 
         from ddr_tpu.routing.wavefront import wavefront_route_core
 
-        runoff_p, final_p = wavefront_route_core(
+        runoff_p, final_p, _ = wavefront_route_core(
             network, celerity_fn, coefficients_fn, q_prime, q_init_p,
             bounds.discharge, q_prime_permuted=q_prime_permuted,
             remat_physics=remat_physics,

@@ -29,16 +29,31 @@ __all__ = ["dmc", "prepare_batch", "denormalize_spatial_parameters"]
 
 
 def prepare_batch(
-    rd: RoutingData, slope_min: float, fused: bool | None = None
-) -> tuple[RiverNetwork, ChannelState, GaugeIndex | None]:
+    rd: RoutingData, slope_min: float, fused: bool | None = None, chunked: bool = True
+) -> tuple["RiverNetwork | Any", ChannelState, GaugeIndex | None]:
     """RoutingData -> (static network, channel state, gauge aggregation).
 
     Mirrors ``MuskingumCunge._set_network_context``
     (/root/reference/src/ddr/routing/mmc.py:271-304): slope clamped to its minimum,
     observed top-width/side-slope carried for data override when present.
-    ``fused`` forwards to :func:`build_network` (None = auto-select schedule).
+    ``fused`` forwards to :func:`build_network`; ``None`` (the default) delegates
+    to :func:`ddr_tpu.routing.chunked.build_routing_network`, which keeps deep
+    continental networks on a wavefront-class engine (depth-chunked) instead of
+    silently falling back to the per-timestep step engine.
+
+    ``chunked=False`` guarantees a plain :class:`RiverNetwork` — required by
+    consumers that drive per-timestep stepping or re-shard the network themselves
+    (the BMI coupler's ``route_step`` loop, ``shard_network``, the LTI
+    comparator); on deep networks those fall back to the step engine as before.
     """
-    network = build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=fused)
+    if fused is None and chunked:
+        from ddr_tpu.routing.chunked import build_routing_network
+
+        network = build_routing_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
+    else:
+        network = build_network(
+            rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=fused
+        )
 
     def _opt(a):
         if a is None or np.asarray(a).size == 0:

@@ -21,7 +21,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["RiverNetwork", "compute_levels", "level_schedule", "build_network"]
+__all__ = [
+    "RiverNetwork",
+    "compute_levels",
+    "level_schedule",
+    "build_network",
+    "single_ring_eligible",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -248,6 +254,22 @@ WAVEFRONT_MAX_IN_DEGREE = 64
 WAVEFRONT_MAX_DEPTH = 1024
 
 
+def single_ring_eligible(depth: int, max_in: int, n: int) -> bool:
+    """Can the single-ring wavefront engine carry this topology?
+
+    The ONE definition shared by :func:`build_network`'s auto-selection and
+    :func:`ddr_tpu.routing.chunked.build_routing_network`'s chunked-vs-single
+    decision — heuristic depth/in-degree caps plus the hard int32 flat-ring-index
+    limit ((gap-1)*(n+1)+col must not wrap negative, or XLA's index clamping
+    silently reads wrong history slots).
+    """
+    return (
+        0 < depth <= WAVEFRONT_MAX_DEPTH
+        and 0 < max_in <= WAVEFRONT_MAX_IN_DEGREE
+        and (depth + 2) * (n + 1) < 2**31
+    )
+
+
 def _padded_adjacency_table(
     point: np.ndarray, neighbor: np.ndarray, n: int, width: int
 ) -> np.ndarray:
@@ -340,7 +362,12 @@ def _wavefront_tables(
 
 
 def build_network(
-    rows: np.ndarray, cols: np.ndarray, n: int, fused: bool | None = None
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    fused: bool | None = None,
+    wavefront: bool | None = None,
+    level: np.ndarray | None = None,
 ) -> RiverNetwork:
     """Build the jit-ready :class:`RiverNetwork` from a COO adjacency.
 
@@ -353,10 +380,21 @@ def build_network(
     rectangle scan schedule — what ``shard_network`` enforces for distributed
     execution and the pipelined multi-shard router builds its per-shard variants
     from.
+
+    ``wavefront=None`` auto-selects the time-skewed schedule by the heuristic
+    depth/degree caps below; ``True`` forces the tables regardless of the caps
+    (the depth-chunked router builds its per-chunk subnetworks this way — each
+    chunk's ring is budgeted by construction), still enforcing the hard int32
+    ring-index limit; ``False`` skips them.
+
+    ``level`` passes a precomputed longest-path layering (the Kahn sweep is the
+    dominant host cost on multi-million-reach graphs; multi-schedule builders
+    compute it once and share it).
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
-    level = compute_levels(rows, cols, n) if n else np.zeros(0, dtype=np.int32)
+    if level is None:
+        level = compute_levels(rows, cols, n) if n else np.zeros(0, dtype=np.int32)
     lvl_src, lvl_tgt, depth = level_schedule(rows, cols, n, level=level)
 
     in_deg = np.bincount(rows, minlength=n) if rows.size else np.zeros(n, dtype=np.int64)
@@ -385,14 +423,13 @@ def build_network(
         pred = down = np.zeros((0, 1), dtype=np.int64)
         level_starts = ()
 
-    wavefront = (
-        0 < depth <= WAVEFRONT_MAX_DEPTH
-        and 0 < max_in <= WAVEFRONT_MAX_IN_DEGREE
-        # Flat ring indices ((gap-1)*(n+1)+col, gap <= depth) must fit int32; beyond
-        # this the cast would wrap negative and XLA's index clamping would silently
-        # read wrong history slots.
-        and (depth + 2) * (n + 1) < 2**31
-    )
+    if wavefront is None:
+        wavefront = single_ring_eligible(depth, max_in, n)
+    elif wavefront and not (depth + 2) * (n + 1) < 2**31:
+        raise ValueError(
+            f"wavefront ring indices overflow int32 (depth={depth}, n={n}); "
+            "use the depth-chunked router (ddr_tpu.routing.chunked)"
+        )
     if wavefront:
         wf_perm, wf_inv, wf_idx, wf_mask, wf_buckets, wf_level_runs = _wavefront_tables(
             rows, cols, n, level, in_deg

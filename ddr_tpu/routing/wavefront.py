@@ -76,14 +76,27 @@ def wavefront_route_core(
     discharge_lb: float,
     q_prime_permuted: bool = False,
     remat_physics: bool = True,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    x_ext: jnp.ndarray | None = None,
+    s_ext: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Route timesteps 0..T-1 by wavefront, entirely in wf_perm order.
 
     ``celerity_fn(q_prev) -> c`` and ``coefficients_fn(c) -> (c1, c2, c3, c4)``
     close over per-reach channels/params ALREADY PERMUTED by ``network.wf_perm``.
     ``q_init`` (wf order) carries state across chunks; ``None`` hotstarts in-band
-    from ``q_prime[0]``. Returns ``(runoff (T, N), final (N,))`` in wf order —
-    the caller aggregates gauges / un-permutes as needed.
+    from ``q_prime[0]``. Returns ``(runoff (T, N), final (N,), raw (T, N))`` in
+    wf order — ``raw`` is the pre-clamp solve value (``runoff = max(raw, lb)``),
+    which the depth-chunked router publishes to downstream chunks (their
+    same-timestep solve sums read RAW predecessor values, exactly like the ring).
+    The caller aggregates gauges / un-permutes as needed.
+
+    ``x_ext``/``s_ext`` inject predecessor sums that live OUTSIDE this network
+    (the depth-chunked router: upstream chunks already routed every timestep).
+    Both are (T, N) in wf order: ``x_ext[t, i]`` = sum of RAW external
+    predecessor solve values at timestep t (joins the same-timestep solve, so at
+    t=0 it participates in the in-band hotstart accumulation), ``s_ext[t, i]`` =
+    sum of CLAMPED external predecessor values at t-1 (joins the
+    previous-timestep inflow; row 0 is unused — hotstart has no inflow term).
 
     ``remat_physics`` wraps the per-wave elementwise physics (Manning inversion ->
     celerity -> Muskingum coefficients) in :func:`jax.checkpoint`: the backward
@@ -116,6 +129,22 @@ def wavefront_route_core(
     )  # (T + 2*depth, n); row r <-> q' index clip(r - (depth+1), 0, T-2)
     qs = _skew_by_level_runs(padded, runs, lambda L: depth - L, n_waves)  # (W, n)
 
+    # External-predecessor skew: wave w hands reach i ext[t, i] with
+    # t = w - 1 - L(i) exactly (zeros outside [0, T-1]): padded row r holds
+    # ext[r - depth], and level-L blocks start at row depth - L, so block row
+    # w - 1 lands on ext index w - 1 - L.
+    has_ext = x_ext is not None
+
+    def _skew_ext(ext):
+        z = jnp.zeros((depth, n), ext.dtype)
+        return _skew_by_level_runs(
+            jnp.concatenate([z, ext, z], axis=0), runs, lambda L: depth - L, n_waves
+        )
+
+    if has_ext:
+        xe = _skew_ext(x_ext)  # contract: ext arrays arrive already in wf order
+        se = _skew_ext(s_ext)
+
     wf_idx, wf_mask, buckets = network.wf_idx, network.wf_mask, network.wf_buckets
     n_deg0 = buckets[0][0] if buckets else n
 
@@ -145,15 +174,19 @@ def wavefront_route_core(
 
     def body(carry, wave_inputs):
         ring, s_state = carry
-        q_row, w = wave_inputs
+        if has_ext:
+            q_row, xe_row, se_row, w = wave_inputs
+        else:
+            q_row, w = wave_inputs
+            xe_row = se_row = 0.0
         t_node = t_of_wave(w)
         q_prev = jnp.maximum(ring[0, :n], discharge_lb)  # clamped x_{t-1}[i]
         c1, c2, c3, c4 = physics(q_prev)
         gathered = ring.reshape(-1)[wf_idx]  # THE gather: raw x_t[p] per edge slot
-        x_pred = reduce_buckets(gathered, clamped=False)
+        x_pred = reduce_buckets(gathered, clamped=False) + xe_row
         s_next = reduce_buckets(gathered, clamped=True)  # wave w+1's inflow sums
 
-        b_step = c2 * s_state + c3 * q_prev + c4 * jnp.maximum(q_row, discharge_lb)
+        b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, discharge_lb)
         is_hot = t_node == 0
         b = jnp.where(is_hot, q_row, b_step)  # hotstart: (I - N) q0 = q'_0, raw
         c1_eff = jnp.where(is_hot, 1.0, c1)
@@ -168,11 +201,13 @@ def wavefront_route_core(
         ring = jnp.concatenate(
             [jnp.concatenate([y, jnp.zeros(1, y.dtype)])[None], ring[:-1]], axis=0
         )
-        return (ring, s_next), jnp.maximum(y, discharge_lb)
+        return (ring, s_next), y
 
     waves = jnp.arange(1, n_waves + 1)
-    (_, _), ys = jax.lax.scan(body, (ring0, s0), (qs, waves))  # ys: (W, n)
+    xs = (qs, xe, se, waves) if has_ext else (qs, waves)
+    (_, _), ys = jax.lax.scan(body, (ring0, s0), xs)  # ys: (W, n) RAW solve values
 
     # Un-skew (static runs): x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L).
-    runoff = _skew_by_level_runs(ys, runs, lambda L: L, T)
-    return runoff, runoff[-1]
+    raw = _skew_by_level_runs(ys, runs, lambda L: L, T)
+    runoff = jnp.maximum(raw, discharge_lb)
+    return runoff, runoff[-1], raw

@@ -61,9 +61,15 @@ def _fmt_default(prop: dict[str, Any], field_info: Any) -> str:
     if "default" in prop:
         return _fmt_value(prop["default"])
     # default_factory fields carry no "default" in the JSON schema but are NOT
-    # required; materialize the factory value for the docs.
+    # required; materialize the factory value for the docs. pydantic v2 also
+    # permits factories taking the validated-data dict — those can't be
+    # materialized without a model instance, so fall back to a placeholder
+    # instead of crashing doc generation.
     if field_info is not None and field_info.default_factory is not None:
-        return _fmt_value(field_info.default_factory())
+        try:
+            return _fmt_value(field_info.default_factory())
+        except TypeError:
+            return "*(computed default)*"
     return "**required**"
 
 

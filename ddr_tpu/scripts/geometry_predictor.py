@@ -64,7 +64,9 @@ def generate_geometry_dataset(cfg: Config, dataset=None) -> Path:
 
     # Daily accumulated discharge: (I - N) Q = q'_day for every day at once
     # (reference :193-213 loops days; vmap turns it into one program).
-    network, channels, _ = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    network, channels, _ = prepare_batch(
+        rd, cfg.params.attribute_minimums["slope"], chunked=False
+    )  # hotstart_discharge solves on the RiverNetwork schedules
     flow = get_flow_fn(cfg, dataset)
     q_hourly = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
     q_daily_lateral = q_hourly[::24]  # one sample per day (daily stores repeat x24)

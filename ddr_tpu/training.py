@@ -31,9 +31,11 @@ from ddr_tpu.routing.network import RiverNetwork
 
 __all__ = [
     "make_optimizer",
+    "masked_l1_daily",
     "set_learning_rate",
     "make_train_step",
     "make_batch_train_step",
+    "make_sharded_train_step",
     "save_state",
     "load_state",
 ]
@@ -62,6 +64,17 @@ def daily_from_hourly(runoff_tg: jnp.ndarray, tau: int) -> jnp.ndarray:
     sliced = runoff_tg[(13 + tau) : (-11 + tau)]
     num_days = sliced.shape[0] // 24
     return sliced[: num_days * 24].reshape(num_days, 24, -1).mean(axis=1)
+
+
+def masked_l1_daily(runoff_tg, obs_daily, obs_mask, tau: int, warmup: int):
+    """THE training objective, shared by every train-step builder: daily means
+    after the tau trim, warmup days masked out, masked mean-L1 (reference
+    train.py:95-104). Returns ``(loss, daily)``. One definition so the
+    single-program, batch, and sharded builders cannot drift apart."""
+    daily = daily_from_hourly(runoff_tg, tau)  # (D-2, G)
+    mask = obs_mask.at[:warmup].set(False)
+    err = jnp.where(mask, jnp.abs(daily - jnp.where(mask, obs_daily, 0.0)), 0.0)
+    return err.sum() / jnp.maximum(mask.sum(), 1), daily
 
 
 def make_train_step(
@@ -95,11 +108,7 @@ def make_train_step(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
         result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
-        daily = daily_from_hourly(result.runoff, tau)  # (D-2, G)
-        mask = obs_mask.at[:warmup].set(False)
-        err = jnp.where(mask, jnp.abs(daily - jnp.where(mask, obs_daily, 0.0)), 0.0)
-        loss = err.sum() / jnp.maximum(mask.sum(), 1)
-        return loss, daily
+        return masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
 
     @jax.jit
     def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
@@ -138,16 +147,69 @@ def make_batch_train_step(
             raw, parameter_ranges, log_space_parameters, defaults, channels.length.shape[0]
         )
         result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
-        daily = daily_from_hourly(result.runoff, tau)  # (D-2, G)
-        mask = obs_mask.at[:warmup].set(False)
-        err = jnp.where(mask, jnp.abs(daily - jnp.where(mask, obs_daily, 0.0)), 0.0)
-        loss = err.sum() / jnp.maximum(mask.sum(), 1)
-        return loss, daily
+        return masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
 
     @jax.jit
     def step(params, opt_state, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
         (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, daily
+
+    return step
+
+
+def make_sharded_train_step(
+    kan_model,
+    mesh,
+    schedule,
+    channels: ChannelState,
+    gauges: GaugeIndex,
+    bounds: Bounds,
+    parameter_ranges: dict[str, list[float]],
+    log_space_parameters: list[str],
+    defaults: dict[str, float],
+    tau: int,
+    warmup: int,
+    optimizer: optax.GradientTransformation,
+):
+    """Multi-chip train step on the SHARDED WAVEFRONT engine.
+
+    This is the engine distributed training should ride: the GSPMD path
+    (``make_batch_train_step`` under ``shard_network``) drops the fused and
+    wavefront tables and executes the rectangle step engine — correct, but it
+    re-inherits the ``T x depth`` per-level sequential cost the wavefront work
+    eliminated. ``sharded_wavefront_route`` keeps the ``T + depth``-wave schedule
+    under ``shard_map`` (one psum per wave) and is differentiable, so the whole
+    step — KAN forward, routing, masked L1, backward, optimizer — compiles to one
+    SPMD program. Gradient parity with the single-program route is pinned in
+    tests/parallel/test_sharded_wavefront.py and asserted by the driver dryrun.
+
+    ``schedule`` is a :class:`ddr_tpu.parallel.wavefront.ShardedWavefront` built
+    from the topological-range-partitioned adjacency; ``channels``/``gauges`` and
+    every per-reach call-time array must be in the same partitioned order.
+    Loss/windowing semantics match :func:`make_train_step` exactly.
+    """
+    from ddr_tpu.parallel.wavefront import sharded_wavefront_route
+
+    n_segments = channels.length.shape[0]
+
+    def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
+        raw = kan_model.apply(params, attrs)
+        spatial = denormalize_spatial_parameters(
+            raw, parameter_ranges, log_space_parameters, defaults, n_segments
+        )
+        runoff, _ = sharded_wavefront_route(
+            mesh, schedule, channels, spatial, q_prime, bounds=bounds
+        )
+        return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
+
+    @jax.jit
+    def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
+        (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, attrs, q_prime, obs_daily, obs_mask
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -19,8 +19,19 @@ from scipy import stats
 __all__ = ["Metrics"]
 
 
+def _nanmean(x, axis=1, keepdims=False):
+    """NaN-masked mean with an EXPLICIT empty-slice contract: slices with zero
+    valid entries yield NaN silently (np.nanmean emits 'Mean of empty slice'
+    RuntimeWarnings on all-NaN gauges, which the battery hits routinely on
+    sparse observation records)."""
+    valid = ~np.isnan(x)
+    cnt = valid.sum(axis=axis, keepdims=keepdims)
+    total = np.where(valid, x, 0.0).sum(axis=axis, keepdims=keepdims)
+    return np.where(cnt > 0, total / np.maximum(cnt, 1), np.nan)
+
+
 def _rmse(pred, target, axis=1):
-    return np.sqrt(np.nanmean((pred - target) ** 2, axis=axis))
+    return np.sqrt(_nanmean((pred - target) ** 2, axis=axis))
 
 
 def _p_bias(pred, target):
@@ -68,12 +79,12 @@ class Metrics:
 
     def _compute(self) -> None:
         g = self.ngrid
-        self.bias = np.nanmean(self.pred - self.target, axis=1)
+        self.bias = _nanmean(self.pred - self.target, axis=1)
         self.rmse = _rmse(self.pred, self.target)
-        self.mae = np.nanmean(np.abs(self.pred - self.target), axis=1)
+        self.mae = _nanmean(np.abs(self.pred - self.target), axis=1)
 
-        pred_anom = self.pred - np.nanmean(self.pred, axis=1, keepdims=True)
-        target_anom = self.target - np.nanmean(self.target, axis=1, keepdims=True)
+        pred_anom = self.pred - _nanmean(self.pred, axis=1, keepdims=True)
+        target_anom = self.target - _nanmean(self.target, axis=1, keepdims=True)
         self.ub_rmse = _rmse(pred_anom, target_anom)
         self.fdc_rmse = _rmse(self._fdc(self.pred), self._fdc(self.target))
 

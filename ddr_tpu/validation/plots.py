@@ -162,9 +162,12 @@ def select_plot_segments(
     configured target catchments when present (missing ids filtered out, warning
     logged), else the ``max_segments`` largest by mean discharge.
 
-    Ids are matched on their numeric part, mirroring the datasets' target
-    normalization (``BaseGeoDataset._target_key``): a target spelled ``wb-123``
-    or ``123`` matches a routed ``cat-123``."""
+    Ids are matched exact-string first; targets with no exact match fall back to
+    their numeric part, mirroring the datasets' target normalization
+    (``BaseGeoDataset._target_key``): a target spelled ``wb-123`` or ``123``
+    matches a routed ``cat-123``. Exact-first matching avoids the collision where
+    two routed ids share a numeric suffix (``cat-123`` and ``wb-123``) and the
+    later one would silently win."""
     ids = [str(s) for s in segment_ids]
     if target_catchments:
 
@@ -175,9 +178,27 @@ def select_plot_segments(
             except ValueError:
                 return s
 
-        pos = {_key(s): i for i, s in enumerate(ids)}
-        sel = [pos[_key(t)] for t in target_catchments if _key(t) in pos]
-        missing = [str(t) for t in target_catchments if _key(t) not in pos]
+        exact = {s: i for i, s in enumerate(ids)}
+        pos = {}
+        for i, s in enumerate(ids):
+            k = _key(s)
+            if k in pos:
+                log.warning(
+                    f"routed ids {ids[pos[k]]!r} and {s!r} share numeric key {k}; "
+                    "numeric-fallback matches resolve to the first"
+                )
+            else:
+                pos[k] = i
+
+        def _find(t):
+            s = str(t)
+            if s in exact:
+                return exact[s]
+            return pos.get(_key(s))
+
+        found = [(t, _find(t)) for t in target_catchments]
+        sel = [i for _, i in found if i is not None]
+        missing = [str(t) for t, i in found if i is None]
         if missing:
             log.warning(f"Target catchments not in routed output, skipping: {missing}")
         if sel:
