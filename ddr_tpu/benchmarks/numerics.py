@@ -1,0 +1,116 @@
+"""Float32 error budget vs depth/T: every engine against the float64 oracle.
+
+The north star promises "bit-identical NSE at float32 tolerance" at continental
+scale; this module turns that from a hope into a measured growth law. Each f32
+engine (per-timestep step, single-ring wavefront, depth-chunked wavefront) routes
+the same deep synthetic basin as the float64 STEP engine (the oracle — itself
+pinned to scipy's float64 forward substitution in tests/routing/test_solver.py),
+and we record
+
+* ``rel_max``: max elementwise relative error over the (T, N) runoff, and
+* ``one_minus_nse``: 1 - NSE of the f32 series against the f64 series (the
+  metric the north star is phrased in).
+
+Measured law (CPU, see docs/tpu.md "Numerics"): rel_max is FLAT in depth and T
+(~1e-5..2e-4, set by isolated small-magnitude reaches), and 1-NSE grows ~depth^2
+from ~1e-11 (depth 64) to ~1e-7 (depth 2048) — extrapolating to ~1e-6 at CONUS
+depth 5000, three orders below NSE-visibility (1e-3). The schedule changes
+(wavefront/chunked) add at most ~50% over the step engine's own f32 rounding:
+same arithmetic, reassociated.
+
+Run: ``python -m ddr_tpu.benchmarks.numerics`` (prints the table).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["measure_engine_errors", "main"]
+
+
+def _nse_complement(sim: np.ndarray, obs: np.ndarray) -> float:
+    obs_m = obs.mean(axis=0, keepdims=True)
+    return float(((sim - obs) ** 2).sum() / (((obs - obs_m) ** 2).sum() + 1e-30))
+
+
+def measure_engine_errors(
+    n: int, depth: int, T: int, seed: int = 0, chunk_bands: int = 4
+) -> dict[str, tuple[float, float]]:
+    """{engine: (rel_max, 1-NSE)} for each f32 engine vs the f64 step oracle.
+
+    Requires x64 enabled (the CLI entrypoint below does it); engines compared on
+    an identical deep synthetic basin. ``chunk_bands`` forces the chunked build
+    into at least that many bands so cross-band error is actually exercised.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not jax.config.read("jax_enable_x64"):
+        raise RuntimeError("enable x64 first (JAX_ENABLE_X64=1) — the oracle is float64")
+
+    from ddr_tpu.geodatazoo.synthetic import make_deep_network
+    from ddr_tpu.routing.chunked import build_chunked_network
+    from ddr_tpu.routing.mc import ChannelState, route
+    from ddr_tpu.routing.network import build_network
+
+    rows, cols = make_deep_network(n, depth, seed=seed)
+
+    def channels(dtype):
+        rng = np.random.default_rng(seed)
+        return ChannelState(
+            length=jnp.asarray(rng.uniform(1000, 5000, n), dtype),
+            slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), dtype),
+            x_storage=jnp.full(n, 0.3, dtype),
+        )
+
+    def params(dtype):
+        return {
+            "n": jnp.full(n, 0.05, dtype),
+            "q_spatial": jnp.full(n, 0.5, dtype),
+            "p_spatial": jnp.full(n, 21.0, dtype),
+        }
+
+    qp = np.random.default_rng(seed + 1).uniform(0.01, 1.0, (T, n))
+    net_step = build_network(rows, cols, n, fused=False)
+    oracle = np.asarray(
+        route(net_step, channels(jnp.float64), params(jnp.float64),
+              jnp.asarray(qp, jnp.float64), engine="step").runoff
+    )
+
+    out: dict[str, np.ndarray] = {}
+    qp32 = jnp.asarray(qp, jnp.float32)
+    out["step-f32"] = np.asarray(
+        route(net_step, channels(jnp.float32), params(jnp.float32), qp32, engine="step").runoff
+    )
+    net_auto = build_network(rows, cols, n)
+    if net_auto.wavefront:
+        out["wavefront-f32"] = np.asarray(
+            route(net_auto, channels(jnp.float32), params(jnp.float32), qp32,
+                  engine="wavefront").runoff
+        )
+    budget = max(4000, (depth // chunk_bands + 2) * (n + 1))
+    cn = build_chunked_network(rows, cols, n, cell_budget=budget)
+    out[f"chunked-f32[{cn.n_chunks}]"] = np.asarray(
+        route(cn, channels(jnp.float32), params(jnp.float32), qp32).runoff
+    )
+
+    return {
+        k: (float(np.max(np.abs(v - oracle) / (np.abs(oracle) + 1e-9))),
+            _nse_complement(v, oracle))
+        for k, v in out.items()
+    }
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    print(f"{'n':>7} {'depth':>5} {'T':>4} | {'engine':<16} {'rel_max':>9} {'1-NSE':>9}")
+    for n, depth in [(2000, 64), (2000, 256), (4000, 1024), (6000, 2048)]:
+        for T in (24, 96, 240):
+            for k, (rel, one_nse) in measure_engine_errors(n, depth, T).items():
+                print(f"{n:>7} {depth:>5} {T:>4} | {k:<16} {rel:9.2e} {one_nse:9.2e}")
+
+
+if __name__ == "__main__":
+    main()

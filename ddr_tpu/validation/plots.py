@@ -179,14 +179,12 @@ def select_plot_segments(
                 return s
 
         exact = {s: i for i, s in enumerate(ids)}
-        pos = {}
+        pos: dict = {}
+        dup_keys = set()
         for i, s in enumerate(ids):
             k = _key(s)
             if k in pos:
-                log.warning(
-                    f"routed ids {ids[pos[k]]!r} and {s!r} share numeric key {k}; "
-                    "numeric-fallback matches resolve to the first"
-                )
+                dup_keys.add(k)
             else:
                 pos[k] = i
 
@@ -194,7 +192,13 @@ def select_plot_segments(
             s = str(t)
             if s in exact:
                 return exact[s]
-            return pos.get(_key(s))
+            k = _key(s)
+            if k in dup_keys:  # warn only when the fallback is actually ambiguous
+                log.warning(
+                    f"target {s!r} matches multiple routed ids by numeric key {k}; "
+                    "using the first"
+                )
+            return pos.get(k)
 
         found = [(t, _find(t)) for t in target_catchments]
         sel = [i for _, i in found if i is not None]

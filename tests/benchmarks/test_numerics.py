@@ -1,0 +1,37 @@
+"""Error-budget harness: the measurement itself must stay runnable and the
+engine-vs-oracle contract must hold at a small deep shape (subprocess because
+x64 is a process-global jax switch the shared test process must not flip)."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_measure_engine_errors_contract():
+    code = """
+import json, jax
+jax.config.update("jax_enable_x64", True)
+from ddr_tpu.benchmarks.numerics import measure_engine_errors
+res = measure_engine_errors(600, 150, 24, seed=3)
+print(json.dumps({k: list(v) for k, v in res.items()}))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=600, env=env
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert any(k.startswith("chunked-f32") for k in res)
+    for engine, (rel_max, one_nse) in res.items():
+        assert rel_max < 1e-3, (engine, rel_max)   # flat-in-depth contract
+        assert one_nse < 1e-6, (engine, one_nse)   # NSE-identical at f32 tolerance
+
+
+def test_requires_x64():
+    import pytest
+
+    from ddr_tpu.benchmarks.numerics import measure_engine_errors
+
+    with pytest.raises(RuntimeError, match="x64"):
+        measure_engine_errors(64, 8, 4)
