@@ -369,14 +369,23 @@ class TestFinalize:
 
 
 def record_substep_inflows(model, until):
-    """Wrap the compiled step fn to capture each sub-step's q_prime, then restore."""
+    """Capture each sub-step's effective q_prime from the batched interval call
+    (update_until is ONE dispatch now; the per-step series comes from the shared
+    production ramp, ddr_bmi.interval_inflows), then restore."""
+    from ddr_tpu.bmi.ddr_bmi import interval_inflows
+
     seen = []
-    real_step = model._step_fn
-    model._step_fn = lambda q, qp: (seen.append(np.asarray(qp).copy()) or real_step(q, qp))
+    real = model._multi_step_fn
+
+    def wrapper(q_t, cur, prev, n_steps, linear, cold):
+        seen.extend(np.asarray(interval_inflows(cur, prev, n_steps, linear)))
+        return real(q_t, cur, prev, n_steps, linear, cold)
+
+    model._multi_step_fn = wrapper
     try:
         model.update_until(until)
     finally:
-        model._step_fn = real_step
+        model._multi_step_fn = real
     return seen
 
 
