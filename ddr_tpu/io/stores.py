@@ -116,14 +116,26 @@ def _resolve_group(store: str | Path, kind: str) -> GroupLike:
                 raise ValueError(
                     f"file:// URIs with a remote host are not supported: {uri!r}"
                 )
-            return zarrlite.open_group(unquote(parsed.path))
+            return _open_local_group(unquote(parsed.path))
         raise ValueError(
             f"No backend registered for {scheme}:// {kind} {uri!r}. This environment "
             "has no egress; either materialize the store locally and point the "
             "config at the path, or register_store_backend"
             f"({scheme!r}, opener) with an icechunk/zarr opener."
         )
-    return zarrlite.open_group(uri)
+    return _open_local_group(uri)
+
+
+def _open_local_group(path: str) -> GroupLike:
+    """Local directory: sniff format — zarr v2 (``.zgroup``, read by the
+    independent :mod:`ddr_tpu.io.zarr2` backend; published hydrology datasets
+    often ship legacy v2) vs zarr v3 (zarrlite). Shared by the plain-path and
+    ``file://`` branches so the same store opens identically through both."""
+    if (Path(path) / ".zgroup").exists():
+        from ddr_tpu.io import zarr2
+
+        return zarr2.open_group(path)
+    return zarrlite.open_group(path)
 
 
 class HydroStore:

@@ -41,16 +41,12 @@ def pykan_bspline_basis(x: jnp.ndarray, knots: jnp.ndarray, k: int) -> jnp.ndarr
     :func:`ddr_tpu.nn.kan.bspline_basis` but with the knot axis broadcast per feature
     (the shape convention of pykan's ``B_batch``).
     """
-    x = x[..., None]  # (..., F, 1)
-    b = ((x >= knots[:, :-1]) & (x < knots[:, 1:])).astype(x.dtype)
-    for d in range(1, k + 1):
-        left = (x - knots[:, : -(d + 1)]) / (knots[:, d:-1] - knots[:, : -(d + 1)])
-        right = (knots[:, d + 1 :] - x) / (knots[:, d + 1 :] - knots[:, 1:-d])
-        # Degenerate (repeated) knots from pykan's percentile-fitted grids make
-        # 0/0 -> inf * b=0 -> NaN terms; pykan zeroes them (B_batch's nan_to_num),
-        # i.e. the standard 0/0 := 0 B-spline convention. Match it.
-        b = jnp.nan_to_num(left * b[..., :-1] + right * b[..., 1:], nan=0.0)
-    return b
+    # One shared Cox-de Boor implementation (ddr_tpu.nn.kan.bspline_basis);
+    # zero_degenerate applies pykan B_batch's per-step 0/0 := 0 convention for
+    # the repeated knots percentile-fitted grids can carry.
+    from ddr_tpu.nn.kan import bspline_basis
+
+    return bspline_basis(x, knots, k, zero_degenerate=True)
 
 
 class PykanKANLayer(nn.Module):

@@ -20,43 +20,82 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-__all__ = ["KANLayer", "Kan", "bspline_basis"]
+__all__ = ["KANLayer", "Kan", "bspline_basis", "uniform_knots", "update_grid_from_samples"]
 
 
-def bspline_basis(x: jnp.ndarray, knots: jnp.ndarray, k: int) -> jnp.ndarray:
+def bspline_basis(
+    x: jnp.ndarray, knots: jnp.ndarray, k: int, zero_degenerate: bool = False
+) -> jnp.ndarray:
     """Order-``k`` B-spline basis functions of ``x`` on ``knots``.
 
-    x: (..., F); knots: (G + 2k + 1,) extended uniform knot vector.
-    Returns (..., F, G + k) basis values via Cox-de Boor.
+    x: (..., F); knots: (G + 2k + 1,) shared knot vector, or (F, G + 2k + 1)
+    per-feature knots (the adaptive-grid form — pykan keeps one grid per input).
+    Returns (..., F, G + k) basis values via Cox-de Boor. THE basis
+    implementation — the pykan compat layer wraps it with
+    ``zero_degenerate=True``, which applies the standard 0/0 := 0 convention
+    PER RECURSION STEP (pykan ``B_batch``'s nan_to_num) so repeated knots from
+    percentile-fitted grids don't poison later steps; the native layers keep
+    strictly-increasing knots by construction and skip the extra ops.
     """
     x = x[..., None]
-    b = ((x >= knots[:-1]) & (x < knots[1:])).astype(x.dtype)
+    b = ((x >= knots[..., :-1]) & (x < knots[..., 1:])).astype(x.dtype)
     for d in range(1, k + 1):
-        left = (x - knots[: -(d + 1)]) / (knots[d:-1] - knots[: -(d + 1)]) * b[..., :-1]
-        right = (knots[d + 1 :] - x) / (knots[d + 1 :] - knots[1:-d]) * b[..., 1:]
+        left = (
+            (x - knots[..., : -(d + 1)])
+            / (knots[..., d:-1] - knots[..., : -(d + 1)])
+            * b[..., :-1]
+        )
+        right = (
+            (knots[..., d + 1 :] - x)
+            / (knots[..., d + 1 :] - knots[..., 1:-d])
+            * b[..., 1:]
+        )
         b = left + right
+        if zero_degenerate:
+            b = jnp.nan_to_num(b, nan=0.0)
     return b
 
 
+def uniform_knots(grid_size: int, spline_order: int, grid_range, dtype=jnp.float32) -> jnp.ndarray:
+    """Extended uniform knot vector over ``grid_range``: (G + 2k + 1,)."""
+    lo, hi = grid_range
+    h = (hi - lo) / grid_size
+    return (
+        jnp.arange(-spline_order, grid_size + spline_order + 1, dtype=dtype) * h + lo
+    )
+
+
 class KANLayer(nn.Module):
-    """One KAN layer: learnable spline activation per (input, output) edge."""
+    """One KAN layer: learnable spline activation per (input, output) edge.
+
+    ``adaptive=True`` stores PER-FEATURE knot vectors as a parameter (initialized
+    uniform over ``grid_range``) so :func:`update_grid_from_samples` can refit
+    them to the data distribution, pykan-style. Knots are ``stop_gradient``-ed in
+    the forward pass — they move only by explicit grid updates, never by Adam
+    (matching pykan, whose grids are buffers refit from samples, not trained).
+    """
 
     features: int
     grid_size: int = 3
     spline_order: int = 3
     grid_range: tuple[float, float] = (-1.0, 1.0)
+    adaptive: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         in_features = x.shape[-1]
-        lo, hi = self.grid_range
-        h = (hi - lo) / self.grid_size
-        knots = (
-            jnp.arange(-self.spline_order, self.grid_size + self.spline_order + 1, dtype=x.dtype)
-            * h
-            + lo
-        )
         n_basis = self.grid_size + self.spline_order
+        if self.adaptive:
+            knots = self.param(
+                "knots",
+                lambda _key, shape: jnp.broadcast_to(
+                    uniform_knots(self.grid_size, self.spline_order, self.grid_range), shape
+                ),
+                (in_features, self.grid_size + 2 * self.spline_order + 1),
+            )
+            knots = jax.lax.stop_gradient(knots)
+        else:
+            knots = uniform_knots(self.grid_size, self.spline_order, self.grid_range, x.dtype)
 
         w_base = self.param(
             "w_base", nn.initializers.kaiming_normal(), (in_features, self.features)
@@ -86,6 +125,10 @@ class Kan(nn.Module):
     num_hidden_layers: int = 1
     grid: int = 3
     k: int = 3
+    # Per-feature data-refittable grids (pykan's update_grid_from_samples role);
+    # off by default: static grids add no parameters and the reference's own
+    # training loop never invokes pykan's update either.
+    adaptive_grid: bool = False
     # Spline support for the hidden layers' inputs — the Dense projection of
     # z-scored attributes, std ~1.4 under kaiming init. (-2, 2) covers ~86% of that
     # mass vs ~55% for (-1, 1) (rest rides the silu-only path), while ranges beyond
@@ -107,6 +150,7 @@ class Kan(nn.Module):
                 grid_size=self.grid,
                 spline_order=self.k,
                 grid_range=self.grid_range,
+                adaptive=self.adaptive_grid,
             )(x)
         x = nn.Dense(
             len(self.learnable_parameters),
@@ -115,3 +159,94 @@ class Kan(nn.Module):
         )(x)
         x = jax.nn.sigmoid(x)
         return {name: x[..., i] for i, name in enumerate(self.learnable_parameters)}
+
+
+def _adapt_knots(x_col: jnp.ndarray, grid_size: int, spline_order: int,
+                 grid_eps: float) -> jnp.ndarray:
+    """New extended knot vector for ONE feature from its sample distribution.
+
+    pykan's grid recipe (update_grid_from_samples): interior grid points are a
+    ``grid_eps``-blend of the uniform grid over [min, max] and the sample
+    quantiles (eps=1 -> uniform, eps->0 -> fully adaptive); the k extension
+    knots on each side repeat the edge spacing. A minimum-spacing floor keeps
+    the Cox-de Boor denominators nonzero on tied samples.
+    """
+    qs = jnp.quantile(x_col, jnp.linspace(0.0, 1.0, grid_size + 1))
+    uni = jnp.linspace(x_col.min(), x_col.max(), grid_size + 1)
+    interior = grid_eps * uni + (1.0 - grid_eps) * qs
+    # enforce strictly increasing with a spacing floor relative to the span
+    span = jnp.maximum(interior[-1] - interior[0], 1e-3)
+    min_h = 1e-3 * span / grid_size
+    interior = interior[0] + jnp.concatenate(
+        [jnp.zeros(1), jnp.cumsum(jnp.maximum(jnp.diff(interior), min_h))]
+    )
+    # widen a hair so min/max samples sit strictly inside the half-open basis
+    # support (x == last knot would otherwise get an all-zero basis row)
+    margin = 1e-3 * span
+    interior = interior.at[0].add(-margin).at[-1].add(margin)
+    h_lo = interior[1] - interior[0]
+    h_hi = interior[-1] - interior[-2]
+    left = interior[0] - h_lo * jnp.arange(spline_order, 0, -1)
+    right = interior[-1] + h_hi * jnp.arange(1, spline_order + 1)
+    return jnp.concatenate([left, interior, right])
+
+
+def update_grid_from_samples(
+    kan: "Kan", variables, inputs: jnp.ndarray, grid_eps: float = 0.02
+):
+    """Refit every adaptive KANLayer's knots to the data and re-solve its spline
+    coefficients so the layer FUNCTION is preserved on the samples — the native
+    equivalent of pykan's ``update_grid_from_samples``
+    (/root/reference/src/ddr/nn/kan.py:36-41 constructs pykan KANs whose grids
+    carry exactly this refit capability). Returns updated ``variables``; call
+    periodically during training, outside the jitted step (grids are
+    stop_gradient-ed, so Adam state for them stays exactly zero).
+
+    The coefficient refit solves ridge-regularized least squares per input
+    feature: ``min_c ||B_new c - y_old||^2`` where ``y_old`` is the OLD spline's
+    per-edge output at the sample points — so the network computes the same
+    function immediately after the update, just parameterized on knots placed
+    where the data actually lives.
+    """
+    if not kan.adaptive_grid:
+        raise ValueError("Kan was built with adaptive_grid=False; nothing to update")
+
+    params = dict(variables["params"])
+    k = kan.k
+
+    for i in range(kan.num_hidden_layers):
+        # Recapture per layer: KANLayer_i's INPUT is its predecessor's output in
+        # the Dense_0 -> KANLayer_0 -> ... -> Dense_1 chain, and earlier layers'
+        # refits (approximate, lstsq) shift downstream inputs — refitting each
+        # layer against the CURRENT upstream function keeps the residual from
+        # compounding across layers.
+        _, inter = kan.apply(
+            {**variables, "params": params}, inputs,
+            capture_intermediates=True, mutable=["intermediates"],
+        )
+        inter = inter["intermediates"]
+        x_in = inter["Dense_0" if i == 0 else f"KANLayer_{i - 1}"]["__call__"][0]
+        layer = dict(params[f"KANLayer_{i}"])
+        knots_old = layer["knots"]  # (in, K)
+        coef_old = layer["spline_coef"]  # (in, n_basis, out)
+
+        basis_old = bspline_basis(x_in, knots_old, k)  # (N, in, n_basis)
+        y_old = jnp.einsum("nig,igf->nif", basis_old, coef_old)  # per-edge spline out
+
+        knots_new = jax.vmap(
+            lambda col: _adapt_knots(col, kan.grid, k, grid_eps)
+        )(x_in.T)  # (in, K)
+        basis_new = bspline_basis(x_in, knots_new, k)  # (N, in, n_basis)
+
+        def refit(B, y):
+            # ridge-regularized normal equations: stable under collapsed basis
+            # columns (features whose samples miss part of the new support)
+            G = B.T @ B + 1e-6 * jnp.eye(B.shape[1], dtype=B.dtype)
+            return jnp.linalg.solve(G, B.T @ y)
+
+        coef_new = jax.vmap(refit, in_axes=(1, 1))(basis_new, y_old)  # (in, n_basis, out)
+        layer["knots"] = knots_new.astype(knots_old.dtype)
+        layer["spline_coef"] = coef_new.astype(coef_old.dtype)
+        params[f"KANLayer_{i}"] = layer
+
+    return {**variables, "params": params}

@@ -30,7 +30,7 @@ __all__ = ["dmc", "prepare_batch", "denormalize_spatial_parameters"]
 
 def prepare_batch(
     rd: RoutingData, slope_min: float, fused: bool | None = None, chunked: bool = True
-) -> tuple["RiverNetwork | Any", ChannelState, GaugeIndex | None]:
+) -> tuple[RiverNetwork | Any, ChannelState, GaugeIndex | None]:
     """RoutingData -> (static network, channel state, gauge aggregation).
 
     Mirrors ``MuskingumCunge._set_network_context``

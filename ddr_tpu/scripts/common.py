@@ -68,6 +68,7 @@ def build_kan(cfg: Config) -> tuple[Kan, Any]:
         grid=cfg.kan.grid,
         k=cfg.kan.k,
         grid_range=tuple(cfg.kan.grid_range),
+        adaptive_grid=cfg.kan.adaptive_grid,
     )
     dummy = np.zeros((1, len(cfg.kan.input_var_names)), dtype=np.float32)
     params = model.init(jax.random.key(cfg.seed), dummy)
@@ -87,6 +88,10 @@ def kan_arch(cfg: Config) -> dict:
         "grid": cfg.kan.grid,
         "k": cfg.kan.k,
         "grid_range": list(cfg.kan.grid_range),
+        # only fingerprinted when on: adaptive grids add a `knots` param leaf, so
+        # the checkpoint structure genuinely differs; static checkpoints written
+        # before this field existed keep loading unchanged.
+        **({"adaptive_grid": True} if cfg.kan.adaptive_grid else {}),
     }
 
 

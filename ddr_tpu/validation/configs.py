@@ -15,7 +15,10 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import random
+import re
+from datetime import datetime
 from pathlib import Path
 from typing import Any
 
@@ -93,6 +96,12 @@ class Kan(BaseModel):
         description="Spline support [lo, hi] for z-scored inputs (ddr_tpu extension; "
         "the reference relies on pykan's data-adaptive grids instead)",
     )
+    adaptive_grid: bool = Field(
+        default=False,
+        description="Store per-feature refittable knot grids (pykan's "
+        "update_grid_from_samples capability, ddr_tpu.nn.kan.update_grid_from_samples); "
+        "grids move only by explicit updates, never by the optimizer",
+    )
 
     @field_validator("grid_range")
     @classmethod
@@ -150,6 +159,13 @@ class Config(BaseModel):
     seed: int = 0
     device: str = Field(default="tpu", description='"tpu", "cpu", or "cpu:N" for a virtual mesh')
     s3_region: str = "us-east-2"
+    run_dir: str | None = Field(
+        default=None,
+        description="Run-directory root: when set, load_config creates "
+        "<run_dir>/<name>/<YYYY-MM-DD_HH-MM-SS>/ and points params.save_path at it "
+        "— the equivalent of the reference's hydra run-dir management "
+        "(config/hydra/settings.yaml: output/${name}/${now:...} + chdir)",
+    )
 
 
 def _set_seed(cfg: Config) -> None:
@@ -165,6 +181,55 @@ def _apply_override(d: dict, dotted: str, value: str) -> None:
     for k in keys[:-1]:
         cur = cur.setdefault(k, {})
     cur[keys[-1]] = yaml.safe_load(value)
+
+
+_INTERP = re.compile(r"\$\{([^${}]+)\}")
+
+
+def _resolve_expr(expr: str, raw: dict, stack: tuple) -> Any:
+    """Resolve one ``${...}`` expression: env var, timestamp, or config ref.
+
+    The OmegaConf subset the reference's configs actually use
+    (/root/reference/config/example_config.yaml:15-30, config/hydra/settings.yaml):
+    ``${oc.env:VAR,default}`` / ``${oc.env:VAR}``, ``${now:%fmt}``, and dotted
+    config references ``${a.b}``.
+    """
+    if expr.startswith("oc.env:"):
+        var, sep, default = expr[len("oc.env:"):].partition(",")
+        val = os.environ.get(var.strip())
+        if val is not None:
+            return val
+        if not sep:
+            raise ValueError(f"environment variable {var!r} is not set and ${{{expr}}} has no default")
+        return default
+    if expr.startswith("now:"):
+        return datetime.now().strftime(expr[len("now:"):])
+    if expr in stack:
+        raise ValueError(f"circular config interpolation through ${{{expr}}}")
+    cur: Any = raw
+    for part in expr.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise ValueError(f"config interpolation ${{{expr}}} does not resolve")
+        cur = cur[part]
+    return _interpolate(cur, raw, stack + (expr,))
+
+
+def _interpolate(node: Any, raw: dict, stack: tuple = ()) -> Any:
+    """Recursively resolve ``${...}`` interpolations in strings of a config tree.
+
+    A string that IS a single expression keeps the resolved value's type; mixed
+    strings concatenate resolved pieces as text.
+    """
+    if isinstance(node, dict):
+        return {k: _interpolate(v, raw, stack) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_interpolate(v, raw, stack) for v in node]
+    if not isinstance(node, str) or "${" not in node:
+        return node
+    full = _INTERP.fullmatch(node)
+    if full:
+        return _resolve_expr(full.group(1), raw, stack)
+    return _INTERP.sub(lambda m: str(_resolve_expr(m.group(1), raw, stack)), node)
 
 
 def load_config(
@@ -198,8 +263,15 @@ def load_config(
             raise ValueError(f"override {ov!r} must look like key.subkey=value")
         k, v = ov.split("=", 1)
         _apply_override(raw, k, v)
+    # Interpolation AFTER overrides: an override can introduce or retarget
+    # ${oc.env:...}/${ref} expressions, exactly as with hydra's composition.
+    raw = _interpolate(raw, raw)
     cfg = Config(**raw)
     _set_seed(cfg)
+    if cfg.run_dir is not None:
+        run_path = Path(cfg.run_dir) / cfg.name / datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+        run_path.mkdir(parents=True, exist_ok=True)
+        cfg.params.save_path = run_path  # a real Path: Params lacks assignment validation
     if save_config:
         save_dir = Path(cfg.params.save_path)
         if save_dir.is_dir():

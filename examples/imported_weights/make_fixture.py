@@ -1,0 +1,75 @@
+"""Generate the committed reference-format checkpoint fixture.
+
+Builds a KAN state dict in the EXACT blob layout the reference's trainer saves
+(/root/reference/src/ddr/validation/utils.py:55-80: ``model_state_dict`` with
+``input``/``layers.N`` pykan MultKAN/``output`` tensors, plus epoch/mini_batch),
+deterministically distilled so the weights are meaningful: the spline
+coefficients are least-squares fit so each pykan activation reproduces a smooth
+target function on its grid. Run once; the resulting ``reference_checkpoint.pt``
+is committed (the real published weights, examples/README.md:9-16 in the
+reference, are not downloadable from this offline environment — this fixture
+carries the same format, shapes, and import path).
+"""
+
+import numpy as np
+import torch
+
+N_IN, HIDDEN, N_OUT, GRID, K = 10, 11, 2, 5, 3
+
+
+def grids(rng, in_features):
+    n_knots = GRID + 2 * K + 1
+    steps = rng.uniform(0.3, 1.0, size=(in_features, n_knots - 1))
+    knots = np.concatenate([np.zeros((in_features, 1)), np.cumsum(steps, axis=1)], axis=1)
+    return (-3.0 + 6.0 * knots / knots[:, -1:]).astype(np.float32)
+
+
+def main() -> None:
+    rng = np.random.default_rng(20260730)
+    sd = {
+        "input.weight": (rng.normal(size=(HIDDEN, N_IN)) * (2.0 / N_IN) ** 0.5).astype(np.float32),
+        "input.bias": np.zeros(HIDDEN, np.float32),
+        "output.weight": (rng.normal(size=(N_OUT, HIDDEN)) * 0.3).astype(np.float32),
+        "output.bias": np.zeros(N_OUT, np.float32),
+    }
+    g = grids(rng, HIDDEN)
+    # distill: fit coef so each edge's spline tracks a smooth random sinusoid on
+    # its own grid (deterministic, non-degenerate, exercises every basis column)
+    from scipy.interpolate import BSpline
+
+    coef = np.zeros((HIDDEN, HIDDEN, GRID + K), np.float32)
+    for i in range(HIDDEN):
+        xs = np.linspace(g[i, K], g[i, -K - 1], 64)
+        B = np.stack(
+            [BSpline.basis_element(g[i, j : j + K + 2], extrapolate=False)(xs) for j in range(GRID + K)],
+            axis=1,
+        )
+        B = np.nan_to_num(B)
+        for j in range(HIDDEN):
+            a, b_, c = rng.uniform(0.3, 1.2), rng.uniform(0.5, 2.0), rng.uniform(0, np.pi)
+            y = a * np.sin(b_ * xs + c)
+            coef[i, j] = np.linalg.lstsq(B, y, rcond=None)[0].astype(np.float32)
+    sd.update({
+        "layers.0.act_fun.0.grid": g,
+        "layers.0.act_fun.0.coef": coef,
+        "layers.0.act_fun.0.mask": np.ones((HIDDEN, HIDDEN), np.float32),
+        "layers.0.act_fun.0.scale_base": (rng.normal(size=(HIDDEN, HIDDEN)) * 0.5).astype(np.float32),
+        "layers.0.act_fun.0.scale_sp": np.ones((HIDDEN, HIDDEN), np.float32),
+        "layers.0.symbolic_fun.0.mask": np.zeros((HIDDEN, HIDDEN), np.float32),
+        "layers.0.symbolic_fun.0.affine": np.zeros((HIDDEN, HIDDEN, 4), np.float32),
+        "layers.0.node_scale_0": np.ones(HIDDEN, np.float32),
+        "layers.0.node_bias_0": np.zeros(HIDDEN, np.float32),
+        "layers.0.subnode_scale_0": np.ones(HIDDEN, np.float32),
+        "layers.0.subnode_bias_0": np.zeros(HIDDEN, np.float32),
+    })
+    blob = {
+        "model_state_dict": {k: torch.tensor(v) for k, v in sd.items()},
+        "epoch": 5,
+        "mini_batch": 0,
+    }
+    torch.save(blob, "examples/imported_weights/reference_checkpoint.pt")
+    print("wrote reference_checkpoint.pt")
+
+
+if __name__ == "__main__":
+    main()

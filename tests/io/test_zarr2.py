@@ -1,0 +1,185 @@
+"""zarr v2 read backend: fixtures are hand-built from the v2 storage spec (JSON
+metadata + dot-keyed zlib chunks), NOT written by any code in this repo — so the
+GroupLike protocol is finally exercised by an implementation that wasn't
+developed alongside its own writer (VERDICT round-2 item 9)."""
+
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from ddr_tpu.io import zarr2
+from ddr_tpu.io.stores import (
+    open_hydro_store,
+    read_array,
+    unregister_store_backend,
+)
+
+
+def _write_v2_array(path, data, chunks, compressor={"id": "zlib", "level": 1},
+                    order="C", fill_value=0.0, drop_chunks=()):
+    """Spec-derived writer: .zarray JSON + dot-keyed (optionally zlib) chunks."""
+    path.mkdir(parents=True)
+    meta = {
+        "zarr_format": 2,
+        "shape": list(data.shape),
+        "chunks": list(chunks),
+        "dtype": data.dtype.str,
+        "compressor": compressor,
+        "fill_value": fill_value,
+        "order": order,
+        "filters": None,
+    }
+    (path / ".zarray").write_text(json.dumps(meta))
+    grid = [max(1, -(-s // c)) for s, c in zip(data.shape, chunks)]
+    import itertools
+
+    for idx in itertools.product(*(range(g) for g in grid)):
+        if idx in drop_chunks:
+            continue
+        # full-size chunk buffer, edge chunks padded with fill (per spec)
+        chunk = np.full(chunks, fill_value, dtype=data.dtype)
+        sel = tuple(slice(i * c, min((i + 1) * c, s)) for i, c, s in zip(idx, chunks, data.shape))
+        trim = tuple(slice(0, sl.stop - sl.start) for sl in sel)
+        chunk[trim] = data[sel]
+        raw = chunk.tobytes(order=order)
+        if compressor is not None:
+            raw = zlib.compress(raw)
+        (path / ".".join(map(str, idx))).write_bytes(raw)
+
+
+def _write_v2_group(path, attrs):
+    path.mkdir(parents=True, exist_ok=True)
+    (path / ".zgroup").write_text(json.dumps({"zarr_format": 2}))
+    (path / ".zattrs").write_text(json.dumps(attrs))
+
+
+@pytest.fixture
+def v2_store(tmp_path):
+    root = tmp_path / "legacy.zarr"
+    rng = np.random.default_rng(0)
+    qr = rng.uniform(0, 5, (6, 50)).astype(np.float32)
+    _write_v2_group(root, {
+        "start_date": "1990/01/01", "freq": "D",
+        "ids": ["cat-1", "cat-2", "cat-3", "cat-4", "cat-5", "cat-6"],
+    })
+    _write_v2_array(root / "Qr", qr, chunks=(4, 16))  # uneven edge chunks
+    return root, qr
+
+
+def test_reads_hand_built_v2_store(v2_store):
+    root, qr = v2_store
+    g = zarr2.open_group(root)
+    assert g.attrs["freq"] == "D"
+    assert "Qr" in g and list(g.keys()) == ["Qr"]
+    np.testing.assert_array_equal(g["Qr"].read(), qr)
+    np.testing.assert_array_equal(np.asarray(g["Qr"]), qr)  # __array__ protocol
+
+
+def test_hydro_store_facade_over_v2(v2_store):
+    """open_hydro_store sniffs .zgroup and serves the SAME facade API as v3."""
+    root, qr = v2_store
+    store = open_hydro_store(root)
+    assert store.ids[0] == "cat-1" and not store.is_hourly
+    sel = store.select("Qr", np.array([1, 3]), np.arange(10, 20))
+    np.testing.assert_array_equal(sel, qr[[1, 3]][:, 10:20])
+
+
+def test_scheme_registration_dispatch(v2_store):
+    root, qr = v2_store
+    zarr2.register("zarr2")
+    try:
+        store = open_hydro_store(f"zarr2://{root}")
+        np.testing.assert_array_equal(read_array(store["Qr"]), qr)
+    finally:
+        unregister_store_backend("zarr2")
+
+
+def test_missing_chunk_is_fill_value(tmp_path):
+    data = np.arange(32, dtype=np.float64).reshape(4, 8)
+    root = tmp_path / "s.zarr"
+    _write_v2_group(root, {"ids": []})
+    _write_v2_array(root / "x", data, chunks=(2, 4), fill_value=-9.0, drop_chunks=((1, 1),))
+    got = zarr2.open_group(root)["x"].read()
+    expect = data.copy()
+    expect[2:4, 4:8] = -9.0
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_uncompressed_fortran_order_and_int_dtype(tmp_path):
+    data = np.arange(24, dtype=np.int32).reshape(6, 4)
+    root = tmp_path / "s.zarr"
+    _write_v2_group(root, {"ids": []})
+    _write_v2_array(root / "x", data, chunks=(6, 4), compressor=None, order="F")
+    np.testing.assert_array_equal(zarr2.open_group(root)["x"].read(), data)
+
+
+def test_nested_subgroup(tmp_path):
+    root = tmp_path / "s.zarr"
+    _write_v2_group(root, {"ids": []})
+    _write_v2_group(root / "sub", {"tag": 7})
+    _write_v2_array(root / "sub" / "y", np.ones(5, dtype=np.float32), chunks=(3,))
+    g = zarr2.open_group(root)
+    assert g["sub"].attrs["tag"] == 7
+    np.testing.assert_array_equal(g["sub"]["y"].read(), np.ones(5, np.float32))
+
+
+def test_unsupported_features_named(tmp_path):
+    root = tmp_path / "s.zarr"
+    _write_v2_group(root, {"ids": []})
+    _write_v2_array(root / "x", np.ones((2, 2), np.float32), chunks=(2, 2),
+                    compressor={"id": "blosc", "cname": "lz4"})
+    with pytest.raises(ValueError, match="blosc"):
+        zarr2.open_group(root)["x"].read()
+    bad = tmp_path / "v3ish"
+    bad.mkdir()
+    with pytest.raises(FileNotFoundError, match="zgroup"):
+        zarr2.open_group(bad)
+
+
+def test_slash_dimension_separator(tmp_path):
+    """zarr >= 2.8 nested stores: dimension_separator '/' -> chunk files at
+    nested paths; silently-all-fill reads here were a review-caught bug."""
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    root = tmp_path / "s.zarr"
+    _write_v2_group(root, {"ids": []})
+    arr = root / "x"
+    arr.mkdir()
+    meta = {
+        "zarr_format": 2, "shape": [3, 4], "chunks": [2, 2], "dtype": "<f4",
+        "compressor": None, "fill_value": 0.0, "order": "C", "filters": None,
+        "dimension_separator": "/",
+    }
+    (arr / ".zarray").write_text(json.dumps(meta))
+    import itertools
+
+    for i, j in itertools.product(range(2), range(2)):
+        chunk = np.zeros((2, 2), np.float32)
+        r0, r1 = i * 2, min((i + 1) * 2, 3)
+        c0, c1 = j * 2, min((j + 1) * 2, 4)
+        chunk[: r1 - r0, : c1 - c0] = data[r0:r1, c0:c1]
+        d = arr / str(i)
+        d.mkdir(exist_ok=True)
+        (d / str(j)).write_bytes(chunk.tobytes())
+    np.testing.assert_array_equal(zarr2.open_group(root)["x"].read(), data)
+
+
+def test_unknown_separator_raises(tmp_path):
+    root = tmp_path / "s.zarr"
+    _write_v2_group(root, {"ids": []})
+    arr = root / "x"
+    arr.mkdir()
+    (arr / ".zarray").write_text(json.dumps({
+        "zarr_format": 2, "shape": [2], "chunks": [2], "dtype": "<f4",
+        "compressor": None, "fill_value": 0.0, "order": "C", "filters": None,
+        "dimension_separator": ":",
+    }))
+    with pytest.raises(ValueError, match="dimension_separator"):
+        zarr2.open_group(root)["x"]
+
+
+def test_file_uri_opens_v2_store(v2_store):
+    root, qr = v2_store
+    store = open_hydro_store(f"file://{root}")
+    np.testing.assert_array_equal(read_array(store["Qr"]), qr)

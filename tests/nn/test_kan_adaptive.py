@@ -1,0 +1,138 @@
+"""Adaptive (data-refittable) KAN grids: the native equivalent of pykan's
+update_grid_from_samples — function-preserving coefficient refit on knots moved
+to where the data lives, grids excluded from gradient training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.nn.kan import Kan, update_grid_from_samples
+
+ATTRS = tuple(f"a{i}" for i in range(6))
+
+
+def _build(adaptive=True, seed=0, n=512):
+    kan = Kan(
+        input_var_names=ATTRS, learnable_parameters=("n", "q_spatial"),
+        hidden_size=7, num_hidden_layers=2, grid=5, k=3, adaptive_grid=adaptive,
+    )
+    rng = np.random.default_rng(seed)
+    # deliberately skewed, non-centered inputs: the static grid's worst case
+    x = jnp.asarray(rng.lognormal(0.0, 0.7, (n, len(ATTRS))) - 1.0, jnp.float32)
+    variables = kan.init(jax.random.PRNGKey(seed), x)
+    return kan, variables, x
+
+
+class TestGridUpdate:
+    def test_function_preserved_tightly_in_support(self):
+        """On z-scored inputs (the production case: attributes are z-scored and
+        the Dense projection keeps them near the static support), the refit
+        preserves the function to sub-percent."""
+        kan = Kan(
+            input_var_names=ATTRS, learnable_parameters=("n", "q_spatial"),
+            hidden_size=7, num_hidden_layers=2, grid=5, k=3, adaptive_grid=True,
+        )
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(0, 1, (512, len(ATTRS))), jnp.float32)
+        variables = kan.init(jax.random.PRNGKey(2), x)
+        before = kan.apply(variables, x)
+        after = kan.apply(update_grid_from_samples(kan, variables, x), x)
+        for k in before:
+            err = np.abs(np.asarray(after[k]) - np.asarray(before[k]))
+            # bulk preservation sub-percent; the worst points are samples the
+            # Dense projection pushes past the old +-2 support (old spline = 0
+            # with a kink there — inherently lstsq-approximate, like pykan)
+            assert np.quantile(err, 0.9) < 2e-2, (k, np.quantile(err, 0.9))
+            b = np.asarray(before[k])
+            nse = 1 - (err**2).sum() / (((b - b.mean()) ** 2).sum() + 1e-12)
+            assert nse > 0.98, (k, nse)  # worst points are past-support kinks
+
+    def test_function_preserved_statistically_on_heavy_tails(self):
+        """With 13% of layer inputs OUTSIDE the old static support (where the old
+        spline is identically zero, a kink no smooth spline on the wider adapted
+        grid can represent exactly), preservation is lstsq-approximate — same
+        contract as pykan. Assert NSE-level agreement, not elementwise."""
+        kan, variables, x = _build()
+        before = kan.apply(variables, x)
+        after = kan.apply(update_grid_from_samples(kan, variables, x), x)
+        for k in before:
+            b, a = np.asarray(before[k]), np.asarray(after[k])
+            nse = 1 - ((a - b) ** 2).sum() / (((b - b.mean()) ** 2).sum() + 1e-12)
+            assert nse > 0.97, (k, nse)
+
+    def test_knots_follow_data_distribution(self):
+        kan, variables, x = _build()
+        updated = update_grid_from_samples(kan, variables, x, grid_eps=0.0)
+        knots = updated["params"]["KANLayer_0"]["knots"]  # (in, K)
+        k = kan.k
+        interior = np.asarray(knots)[:, k:-k]  # (in, grid+1)
+        # layer-0 inputs are the Dense projection of the samples; interior knots
+        # at eps=0 are their per-feature quantiles -> strictly inside the range
+        # and denser than uniform around the median
+        h = np.diff(interior, axis=1)
+        assert (h > 0).all()
+        # quantile knots differ measurably from the uniform init
+        init_knots = variables["params"]["KANLayer_0"]["knots"]
+        assert float(np.max(np.abs(np.asarray(init_knots) - np.asarray(knots)))) > 0.05
+
+    def test_grids_get_zero_gradients(self):
+        kan, variables, x = _build()
+
+        def loss(v):
+            out = kan.apply(v, x)
+            return sum(jnp.sum(o**2) for o in out.values())
+
+        grads = jax.grad(loss)(variables)
+        for i in range(2):
+            g = grads["params"][f"KANLayer_{i}"]["knots"]
+            assert float(jnp.abs(g).max()) == 0.0
+            gc = grads["params"][f"KANLayer_{i}"]["spline_coef"]
+            assert float(jnp.abs(gc).max()) > 0.0  # coefficients DO train
+
+    def test_update_then_train_descends(self):
+        import optax
+
+        kan, variables, x = _build()
+        target = jnp.asarray(np.random.default_rng(1).uniform(0.2, 0.8, (x.shape[0],)), jnp.float32)
+
+        def loss_fn(v):
+            return jnp.mean((kan.apply(v, x)["n"] - target) ** 2)
+
+        opt = optax.adam(1e-2)
+        state = opt.init(variables)
+        v = variables
+        for step in range(30):
+            if step == 10:
+                v = update_grid_from_samples(kan, v, x)
+            l, g = jax.value_and_grad(loss_fn)(v)
+            upd, state = opt.update(g, state, v)
+            v = optax.apply_updates(v, upd)
+        assert float(loss_fn(v)) < float(loss_fn(variables)) * 0.8
+
+    def test_static_kan_rejects_update(self):
+        kan, variables, x = _build(adaptive=False)
+        with pytest.raises(ValueError, match="adaptive_grid=False"):
+            update_grid_from_samples(kan, variables, x)
+
+    def test_static_and_adaptive_init_agree(self):
+        """Before any update, adaptive grids are the same uniform knots — the
+        two modes compute the identical function at init."""
+        kan_s, v_s, x = _build(adaptive=False, seed=4)
+        kan_a = Kan(
+            input_var_names=ATTRS, learnable_parameters=("n", "q_spatial"),
+            hidden_size=7, num_hidden_layers=2, grid=5, k=3, adaptive_grid=True,
+        )
+        v_a = kan_a.init(jax.random.PRNGKey(4), x)
+        # graft the static params into the adaptive structure (same shapes + knots)
+        pa = jax.tree.map(lambda a: a, v_a)
+        import flax
+
+        pa = flax.core.unfreeze(pa) if hasattr(flax.core, "unfreeze") else pa
+        for mod, leaves in v_s["params"].items():
+            for name, val in leaves.items():
+                pa["params"][mod][name] = val
+        out_s = kan_s.apply(v_s, x)
+        out_a = kan_a.apply(pa, x)
+        for k in out_s:
+            np.testing.assert_allclose(np.asarray(out_a[k]), np.asarray(out_s[k]), rtol=1e-6)

@@ -1,0 +1,99 @@
+"""OmegaConf-subset interpolation + run-dir management in load_config — the
+hydra-layer conveniences of the reference's config stack
+(/root/reference/config/example_config.yaml:15-30, config/hydra/settings.yaml)."""
+
+import re
+
+import pytest
+import yaml
+
+from ddr_tpu.validation.configs import load_config
+
+BASE = {
+    "name": "interp",
+    "geodataset": "synthetic",
+    "mode": "routing",
+    "kan": {"input_var_names": ["a"]},
+}
+
+
+def _cfg(tmp_path, extra, overrides=None, monkeypatch=None, env=None):
+    if env and monkeypatch:
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump({**BASE, **extra}))
+    return load_config(p, overrides=overrides, save_config=False)
+
+
+def test_env_with_default_unset(tmp_path):
+    cfg = _cfg(tmp_path, {"name": "ddr-v${oc.env:DDR_VERSION_UNSET_XYZ,dev}"})
+    assert cfg.name == "ddr-vdev"
+
+
+def test_env_set_wins_over_default(tmp_path, monkeypatch):
+    cfg = _cfg(tmp_path, {"name": "ddr-${oc.env:DDR_V,dev}"},
+               monkeypatch=monkeypatch, env={"DDR_V": "9.9"})
+    assert cfg.name == "ddr-9.9"
+
+
+def test_env_no_default_missing_raises(tmp_path):
+    with pytest.raises(ValueError, match="not set"):
+        _cfg(tmp_path, {"name": "${oc.env:DDR_DEFINITELY_MISSING_VAR}"})
+
+
+def test_env_path_composition(tmp_path, monkeypatch):
+    cfg = _cfg(
+        tmp_path,
+        {"data_sources": {"gages": "${oc.env:DDR_DATA_DIR,./data}/gage_info.csv"}},
+        monkeypatch=monkeypatch, env={"DDR_DATA_DIR": "/mnt/stores"},
+    )
+    assert str(cfg.data_sources.gages) == "/mnt/stores/gage_info.csv"
+
+
+def test_config_reference_and_mixing(tmp_path):
+    cfg = _cfg(tmp_path, {"name": "ddr-${geodataset}-${mode}"})
+    assert cfg.name == "ddr-synthetic-routing"
+
+
+def test_reference_preserves_type(tmp_path):
+    cfg = _cfg(tmp_path, {"np_seed": 7, "seed": "${np_seed}"})
+    assert cfg.seed == 7
+
+
+def test_circular_reference_raises(tmp_path):
+    with pytest.raises(ValueError, match="circular"):
+        _cfg(tmp_path, {"name": "${device}", "device": "${name}"})
+
+
+def test_unresolvable_reference_raises(tmp_path):
+    with pytest.raises(ValueError, match="does not resolve"):
+        _cfg(tmp_path, {"name": "${no.such.key}"})
+
+
+def test_override_can_use_interpolation(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDR_N", "from-env")
+    cfg = _cfg(tmp_path, {}, overrides=["name=${oc.env:DDR_N}"])
+    assert cfg.name == "from-env"
+
+
+def test_now_timestamp(tmp_path):
+    cfg = _cfg(tmp_path, {"name": "run-${now:%Y}"})
+    assert re.fullmatch(r"run-\d{4}", cfg.name)
+
+
+def test_run_dir_creates_timestamped_save_path(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump({**BASE, "run_dir": str(tmp_path / "output")}))
+    cfg = load_config(p, save_config=True)
+    out = tmp_path / "output" / "interp"
+    runs = list(out.iterdir())
+    assert len(runs) == 1
+    assert re.fullmatch(r"\d{4}-\d{2}-\d{2}_\d{2}-\d{2}-\d{2}", runs[0].name)
+    assert str(cfg.params.save_path) == str(runs[0])
+    assert (runs[0] / "pydantic_config.yaml").exists()  # config snapshot lands in-run
+
+
+def test_no_run_dir_keeps_save_path(tmp_path):
+    cfg = _cfg(tmp_path, {"params": {"save_path": str(tmp_path)}})
+    assert str(cfg.params.save_path) == str(tmp_path)
