@@ -93,6 +93,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 log.info(f"Setting learning rate: {cfg.experiment.learning_rate[epoch]}")
                 opt_state = set_learning_rate(opt_state, cfg.experiment.learning_rate[epoch])
 
+            grids_refit = epoch not in cfg.kan.grid_update_epochs
             for i, rd in enumerate(loader):
                 if epoch == start_epoch and i < start_mini_batch:
                     log.info(f"Skipping mini-batch {i}. Resuming at {start_mini_batch}")
@@ -103,6 +104,18 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
                 network, channels, gauges = prepare_batch(rd, slope_min)
                 attrs = jnp.asarray(rd.normalized_spatial_attributes)
+
+                if not grids_refit:
+                    # pykan-style data refit of the spline grids on the first
+                    # EXECUTED mini-batch of the epoch (not literal i == 0, so a
+                    # mid-epoch resume still refits), outside the jitted step
+                    # (function-preserving lstsq; the optimizer never moves
+                    # knots — ddr_tpu.nn.kan docstring).
+                    from ddr_tpu.nn.kan import update_grid_from_samples
+
+                    params = update_grid_from_samples(kan_model, params, attrs)
+                    grids_refit = True
+                    log.info(f"epoch {epoch}: adaptive KAN grids refit from batch attributes")
                 obs_daily, obs_mask = daily_observation_targets(rd)
 
                 with throughput.batch(rd.n_segments, q_prime.shape[0]):

@@ -24,7 +24,7 @@ from typing import Any
 
 import numpy as np
 import yaml
-from pydantic import BaseModel, ConfigDict, Field, field_validator
+from pydantic import BaseModel, ConfigDict, Field, field_validator, model_validator
 
 from ddr_tpu.validation.enums import GeoDataset, Mode
 
@@ -102,6 +102,21 @@ class Kan(BaseModel):
         "update_grid_from_samples capability, ddr_tpu.nn.kan.update_grid_from_samples); "
         "grids move only by explicit updates, never by the optimizer",
     )
+    grid_update_epochs: list[int] = Field(
+        default_factory=list,
+        description="Epochs whose FIRST mini-batch refits the adaptive grids from "
+        "that batch's attributes before stepping (requires adaptive_grid; pykan "
+        "refits early in training the same way). Empty = never",
+    )
+
+    @model_validator(mode="after")
+    def _grid_updates_need_adaptive(self) -> "Kan":
+        if self.grid_update_epochs and not self.adaptive_grid:
+            raise ValueError(
+                "kan.grid_update_epochs requires kan.adaptive_grid=true "
+                "(static grids have no refittable knots)"
+            )
+        return self
 
     @field_validator("grid_range")
     @classmethod

@@ -97,3 +97,11 @@ def test_run_dir_creates_timestamped_save_path(tmp_path):
 def test_no_run_dir_keeps_save_path(tmp_path):
     cfg = _cfg(tmp_path, {"params": {"save_path": str(tmp_path)}})
     assert str(cfg.params.save_path) == str(tmp_path)
+
+
+def test_grid_update_epochs_requires_adaptive(tmp_path):
+    with pytest.raises(Exception, match="adaptive_grid"):
+        _cfg(tmp_path, {"kan": {"input_var_names": ["a"], "grid_update_epochs": [2]}})
+    cfg = _cfg(tmp_path, {"kan": {"input_var_names": ["a"], "adaptive_grid": True,
+                                  "grid_update_epochs": [2]}})
+    assert cfg.kan.grid_update_epochs == [2]
