@@ -33,9 +33,14 @@ TPU cost shaping (each documented by measurement in docs/tpu.md):
   (RiverNetwork.wf_level_runs; nodes are level-contiguous within each degree
   bucket) — measured ~0.03ms vs 15-29ms for dynamic-slice row gathers, element
   gathers, or anything fused with a transpose, the chip's worst access patterns.
-  The one remaining per-element permutation (q_prime columns into wf order) can be
-  hoisted to the host: pass ``q_prime_permuted=True`` with pre-permuted inflows
-  (``q_prime[:, np.asarray(network.wf_perm)]``) to remove it entirely.
+  EXCEPT past ``SKEW_SLICE_MAX_RUNS`` (deep networks: runs ~ depth x degree
+  buckets): XLA op count — and compile time, measured 4+ minutes at depth 1200 —
+  scales with run count, so there the skew becomes one per-column
+  ``take_along_axis`` gather; the per-element gather cost is the price of a
+  tractable compile, and the deep regime's larger per-wave arithmetic amortizes
+  it. The one remaining per-element permutation (q_prime columns into wf order)
+  can be hoisted to the host: pass ``q_prime_permuted=True`` with pre-permuted
+  inflows (``q_prime[:, np.asarray(network.wf_perm)]``) to remove it entirely.
 
 This is a schedule change only: per-reach arithmetic and predecessor summation
 order match ``mc.route_step`` (reference semantics:
@@ -47,24 +52,42 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ddr_tpu.routing.network import RiverNetwork
 
 __all__ = ["wavefront_route_core"]
 
 
-def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.ndarray:
-    """Assemble (width, N) from static per-run row windows of ``src``.
+# Above this many level runs the static-slice skew is compiled as a per-column
+# gather instead: XLA op count (and compile time) scales with run count — at
+# continental depth (runs ~ depth x degree-buckets, thousands) the slice build
+# measured 4+ MINUTES of compile for a single depth-1200 chunk, vs O(1) ops for
+# the gather. At shallow depth the slices stay: measured ~0.03ms vs 15-29ms for
+# gather-shaped skews at N=8192 (docs/tpu.md).
+SKEW_SLICE_MAX_RUNS = 128
 
-    Run (s, e, L) contributes ``src[start_of(L) : start_of(L) + width, s:e]`` —
-    every slice is static (``start_of`` is evaluated on Python ints at trace
-    time), so XLA compiles pure streaming copies.
+
+def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.ndarray:
+    """Assemble (width, N) from per-run row windows of ``src``.
+
+    Run (s, e, L) contributes ``src[start_of(L) : start_of(L) + width, s:e]``.
+    Few runs: one STATIC slice each (``start_of`` is evaluated on Python ints at
+    trace time) — pure streaming copies. Many runs (deep networks): one
+    ``take_along_axis`` gather with per-column start rows — constant op count,
+    trading per-element gather cost for tractable compiles.
     """
-    blocks = [
-        jax.lax.dynamic_slice(src, (start_of(L), s), (width, e - s))
-        for (s, e, L) in runs
-    ]
-    return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 else blocks[0]
+    if len(runs) <= SKEW_SLICE_MAX_RUNS:
+        blocks = [
+            jax.lax.dynamic_slice(src, (start_of(L), s), (width, e - s))
+            for (s, e, L) in runs
+        ]
+        return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 else blocks[0]
+    starts = np.empty(src.shape[1], dtype=np.int32)
+    for s, e, L in runs:
+        starts[s:e] = start_of(L)
+    rows = jnp.asarray(starts)[None, :] + jnp.arange(width, dtype=jnp.int32)[:, None]
+    return jnp.take_along_axis(src, rows, axis=0)
 
 
 def wavefront_route_core(
