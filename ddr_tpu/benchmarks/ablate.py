@@ -44,9 +44,12 @@ def main() -> None:
     extra: dict = {}
     engine = None
     if schedule in ("chunked", "wavefront", "step"):
-        # channels/gauges ALWAYS from prepare_batch (one construction, incl. the
-        # observed-geometry overrides); only the network structure varies.
-        network, channels, gauges = prepare_batch(rd, 1e-4, fused=False, chunked=False)
+        # channels/gauges via the shared builder (identical physics incl. the
+        # observed-geometry overrides); build ONLY the network structure this
+        # variant measures — no throwaway prepare_batch network build.
+        from ddr_tpu.routing.model import prepare_channels
+
+        channels, gauges = prepare_channels(rd, 1e-4)
         if schedule == "chunked":
             from ddr_tpu.routing.chunked import build_chunked_network
 
@@ -63,6 +66,11 @@ def main() -> None:
             )
             engine = "wavefront"
         else:
+            from ddr_tpu.routing.network import build_network
+
+            network = build_network(
+                rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=False
+            )
             engine = "step"
     else:
         network, channels, gauges = prepare_batch(rd, 1e-4, fused=(schedule == "fused"))

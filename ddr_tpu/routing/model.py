@@ -25,7 +25,7 @@ from ddr_tpu.routing.mc import (
 )
 from ddr_tpu.routing.network import RiverNetwork, build_network
 
-__all__ = ["dmc", "prepare_batch", "denormalize_spatial_parameters"]
+__all__ = ["dmc", "prepare_batch", "prepare_channels", "denormalize_spatial_parameters"]
 
 
 def prepare_batch(
@@ -54,6 +54,16 @@ def prepare_batch(
         network = build_network(
             rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=fused
         )
+    channels, gauges = prepare_channels(rd, slope_min)
+    return network, channels, gauges
+
+
+def prepare_channels(
+    rd: RoutingData, slope_min: float
+) -> tuple[ChannelState, GaugeIndex | None]:
+    """The channel-state/gauge half of :func:`prepare_batch` — for callers that
+    build their own network structure (the ablation harness's chunked/forced
+    variants) and must still route identical physics."""
 
     def _opt(a):
         if a is None or np.asarray(a).size == 0:
@@ -70,7 +80,7 @@ def prepare_batch(
     gauges = None
     if rd.outflow_idx is not None and len(rd.outflow_idx) != rd.n_segments:
         gauges = GaugeIndex.from_ragged(rd.outflow_idx)
-    return network, channels, gauges
+    return channels, gauges
 
 
 def denormalize_spatial_parameters(

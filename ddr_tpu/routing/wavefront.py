@@ -61,11 +61,13 @@ __all__ = ["wavefront_route_core"]
 
 # Above this many level runs the static-slice skew is compiled as a per-column
 # gather instead: XLA op count (and compile time) scales with run count — at
-# continental depth (runs ~ depth x degree-buckets, thousands) the slice build
+# continental depth (runs ~ depth x degree-buckets, ~3-4k) the slice build
 # measured 4+ MINUTES of compile for a single depth-1200 chunk, vs O(1) ops for
 # the gather. At shallow depth the slices stay: measured ~0.03ms vs 15-29ms for
-# gather-shaped skews at N=8192 (docs/tpu.md).
-SKEW_SLICE_MAX_RUNS = 128
+# gather-shaped skews at N=8192 (docs/tpu.md). 512 keeps the whole advertised
+# shallow regime (N=65k default topology measures ~130 runs) on the fast slice
+# path while catching every deep configuration well before compile blows up.
+SKEW_SLICE_MAX_RUNS = 512
 
 
 def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.ndarray:
