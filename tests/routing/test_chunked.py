@@ -195,3 +195,55 @@ def test_chunk_local_levels_bounded_by_band_span():
     ) == len(rows)
     for net in cn.chunks:
         assert net.depth <= depth
+
+
+def test_high_in_degree_confluence_routes_via_chunked():
+    """A reservoir-like node with in-degree far past the single-ring cap (64)
+    must fall to the chunked router and still match the step engine — the
+    bucketed gather tables carry arbitrary degree."""
+    n_up, chain = 200, 1200  # deep chain below the confluence
+    n = n_up + chain
+    rows = np.concatenate([np.full(n_up, n_up), np.arange(n_up + 1, n)])
+    cols = np.concatenate([np.arange(n_up), np.arange(n_up, n - 1)])
+    level = compute_levels(rows, cols, n)
+    assert int(level.max()) == chain
+    net = build_routing_network(rows, cols, n)
+    assert isinstance(net, ChunkedNetwork)
+
+    rng = np.random.default_rng(0)
+    channels = ChannelState(
+        length=jnp.asarray(rng.uniform(1000, 5000, n), jnp.float32),
+        slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
+        x_storage=jnp.full(n, 0.3, jnp.float32),
+    )
+    params = {"n": jnp.full(n, 0.05), "q_spatial": jnp.full(n, 0.5),
+              "p_spatial": jnp.full(n, 21.0)}
+    qp = jnp.asarray(rng.uniform(0.01, 1.0, (6, n)), jnp.float32)
+    ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
+    res = route(net, channels, params, qp)
+    assert _rel(res.runoff, ref.runoff) < 1e-4
+
+
+def test_braided_divergence_matches_step():
+    """Out-degree 2 (braided channel: one reach feeding two downstream branches)
+    is outside the dendritic assumption but inside the lower-triangular solve
+    semantics; the chunked router must match the step engine there too."""
+    # 0 -> {1, 2}; 1 -> 3; 2 -> 3; 3 -> 4; then a chain 4 -> 5 -> ... -> n-1
+    chain = 300
+    n = 4 + chain
+    rows = np.concatenate([[1, 2, 3, 3], np.arange(4, n)])
+    cols = np.concatenate([[0, 0, 1, 2], np.arange(3, n - 1)])
+    rng = np.random.default_rng(1)
+    channels = ChannelState(
+        length=jnp.asarray(rng.uniform(1000, 5000, n), jnp.float32),
+        slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
+        x_storage=jnp.full(n, 0.3, jnp.float32),
+    )
+    params = {"n": jnp.full(n, 0.05), "q_spatial": jnp.full(n, 0.5),
+              "p_spatial": jnp.full(n, 21.0)}
+    qp = jnp.asarray(rng.uniform(0.01, 1.0, (5, n)), jnp.float32)
+    ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
+    cn = build_chunked_network(rows, cols, n, cell_budget=2000)
+    assert cn.n_chunks > 1
+    res = route(cn, channels, params, qp)
+    assert _rel(res.runoff, ref.runoff) < 1e-4
