@@ -197,20 +197,10 @@ def test_chunk_local_levels_bounded_by_band_span():
         assert net.depth <= depth
 
 
-def test_high_in_degree_confluence_routes_via_chunked():
-    """A reservoir-like node with in-degree far past the single-ring cap (64)
-    must fall to the chunked router and still match the step engine — the
-    bucketed gather tables carry arbitrary degree."""
-    n_up, chain = 200, 1200  # deep chain below the confluence
-    n = n_up + chain
-    rows = np.concatenate([np.full(n_up, n_up), np.arange(n_up + 1, n)])
-    cols = np.concatenate([np.arange(n_up), np.arange(n_up, n - 1)])
-    level = compute_levels(rows, cols, n)
-    assert int(level.max()) == chain
-    net = build_routing_network(rows, cols, n)
-    assert isinstance(net, ChunkedNetwork)
-
-    rng = np.random.default_rng(0)
+def _state(n, T, seed, const_params=True):
+    """Physics state for hand-built topologies (deterministic, shared by the
+    extreme-topology tests; _setup draws from the deep generator instead)."""
+    rng = np.random.default_rng(seed)
     channels = ChannelState(
         length=jnp.asarray(rng.uniform(1000, 5000, n), jnp.float32),
         slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
@@ -218,7 +208,25 @@ def test_high_in_degree_confluence_routes_via_chunked():
     )
     params = {"n": jnp.full(n, 0.05), "q_spatial": jnp.full(n, 0.5),
               "p_spatial": jnp.full(n, 21.0)}
-    qp = jnp.asarray(rng.uniform(0.01, 1.0, (6, n)), jnp.float32)
+    qp = jnp.asarray(rng.uniform(0.01, 1.0, (T, n)), jnp.float32)
+    return channels, params, qp
+
+
+def test_high_in_degree_confluence_routes_via_chunked():
+    """A reservoir-like node with in-degree far past the single-ring cap (64)
+    must fall to the chunked router and still match the step engine — the
+    bucketed gather tables carry arbitrary degree. chain stays BELOW the depth
+    cap (1024) so in-degree is the SOLE selection trigger."""
+    n_up, chain = 200, 500
+    n = n_up + chain
+    rows = np.concatenate([np.full(n_up, n_up), np.arange(n_up + 1, n)])
+    cols = np.concatenate([np.arange(n_up), np.arange(n_up, n - 1)])
+    level = compute_levels(rows, cols, n)
+    assert int(level.max()) == chain <= 1024  # depth alone would stay single-ring
+    net = build_routing_network(rows, cols, n)
+    assert isinstance(net, ChunkedNetwork)
+
+    channels, params, qp = _state(n, 6, seed=0)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     res = route(net, channels, params, qp)
     assert _rel(res.runoff, ref.runoff) < 1e-4
@@ -233,15 +241,7 @@ def test_braided_divergence_matches_step():
     n = 4 + chain
     rows = np.concatenate([[1, 2, 3, 3], np.arange(4, n)])
     cols = np.concatenate([[0, 0, 1, 2], np.arange(3, n - 1)])
-    rng = np.random.default_rng(1)
-    channels = ChannelState(
-        length=jnp.asarray(rng.uniform(1000, 5000, n), jnp.float32),
-        slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
-        x_storage=jnp.full(n, 0.3, jnp.float32),
-    )
-    params = {"n": jnp.full(n, 0.05), "q_spatial": jnp.full(n, 0.5),
-              "p_spatial": jnp.full(n, 21.0)}
-    qp = jnp.asarray(rng.uniform(0.01, 1.0, (5, n)), jnp.float32)
+    channels, params, qp = _state(n, 5, seed=1)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     cn = build_chunked_network(rows, cols, n, cell_budget=2000)
     assert cn.n_chunks > 1
