@@ -19,7 +19,13 @@ from ddr_tpu.routing.chunked import (
     build_routing_network,
 )
 from ddr_tpu.routing.mc import ChannelState, GaugeIndex, route
-from ddr_tpu.routing.network import RiverNetwork, build_network, compute_levels
+from ddr_tpu.routing.network import (
+    WAVEFRONT_MAX_DEPTH,
+    WAVEFRONT_MAX_IN_DEGREE,
+    RiverNetwork,
+    build_network,
+    compute_levels,
+)
 
 
 def _setup(n, depth, T, seed=2):
@@ -104,14 +110,7 @@ def test_chunked_deep_chain_worst_case():
     n = 64
     rows = np.arange(1, n, dtype=np.int64)
     cols = np.arange(n - 1, dtype=np.int64)
-    rng = np.random.default_rng(3)
-    channels = ChannelState(
-        length=jnp.asarray(rng.uniform(1000, 5000, n), jnp.float32),
-        slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
-        x_storage=jnp.full(n, 0.3, jnp.float32),
-    )
-    params = {"n": jnp.full(n, 0.05), "q_spatial": jnp.full(n, 0.5), "p_spatial": jnp.full(n, 21.0)}
-    qp = jnp.asarray(rng.uniform(0.01, 1.0, (10, n)), jnp.float32)
+    channels, params, qp = _state(n, 10, seed=3)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     cn = build_chunked_network(rows, cols, n, cell_budget=200)  # tiny: many bands
     assert cn.n_chunks >= 4
@@ -197,7 +196,7 @@ def test_chunk_local_levels_bounded_by_band_span():
         assert net.depth <= depth
 
 
-def _state(n, T, seed, const_params=True):
+def _state(n, T, seed):
     """Physics state for hand-built topologies (deterministic, shared by the
     extreme-topology tests; _setup draws from the deep generator instead)."""
     rng = np.random.default_rng(seed)
@@ -222,7 +221,8 @@ def test_high_in_degree_confluence_routes_via_chunked():
     rows = np.concatenate([np.full(n_up, n_up), np.arange(n_up + 1, n)])
     cols = np.concatenate([np.arange(n_up), np.arange(n_up, n - 1)])
     level = compute_levels(rows, cols, n)
-    assert int(level.max()) == chain <= 1024  # depth alone would stay single-ring
+    assert int(level.max()) == chain <= WAVEFRONT_MAX_DEPTH  # depth alone stays single-ring
+    assert n_up > WAVEFRONT_MAX_IN_DEGREE  # the load-bearing trigger
     net = build_routing_network(rows, cols, n)
     assert isinstance(net, ChunkedNetwork)
 
