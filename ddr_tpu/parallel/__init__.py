@@ -25,11 +25,19 @@ from ddr_tpu.parallel.wavefront import (
     build_sharded_wavefront,
     sharded_wavefront_route,
 )
+from ddr_tpu.parallel.chunked import (
+    ShardedChunked,
+    build_sharded_chunked,
+    route_chunked_sharded,
+)
 
 __all__ = [
     "ShardedWavefront",
     "build_sharded_wavefront",
     "sharded_wavefront_route",
+    "ShardedChunked",
+    "build_sharded_chunked",
+    "route_chunked_sharded",
     "PipelineSchedule",
     "ReachPartition",
     "build_pipeline_schedule",

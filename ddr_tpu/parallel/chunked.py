@@ -1,0 +1,257 @@
+"""Sharded depth-chunked wavefront: continental depth x multi-chip, composed.
+
+The two deep-regime facts that force this composition (docs/tpu.md):
+
+* the SHARDED wavefront's per-shard ring is ``(depth + 2) * (n_local + 1)`` —
+  at CONUS scale (N ~ 2.9M, depth ~4000, 8 shards) that is ~5.8 GB plus
+  comparable skew buffers, overflowing a v5e chip's HBM on its own;
+* the DEPTH-CHUNKED router bounds ring memory by banding the level axis, but is
+  single-program.
+
+Here each ring-budgeted level band runs through
+:func:`ddr_tpu.parallel.wavefront.sharded_wavefront_route` (reach-sharded waves,
+one psum per wave) with cross-band dependencies forwarded as the same
+raw/clamped precomputed series the single-chip chunked router uses — bands
+sequential, shards parallel within a band, ring per shard per band
+``(span + 2) * (n_band / S + 1)`` cells. Sequential cost stays ``C*T + depth``
+waves; per-wave traffic stays one boundary psum.
+
+Layout details: within a band, nodes sort by global level, so equal contiguous
+shard blocks preserve the one-directional cross-shard property
+(:mod:`ddr_tpu.parallel.partition`'s invariant); each band pads to a multiple of
+the shard count with edgeless sentinel slots whose inputs read a zero/neutral
+filler column (they route the discharge floor and nothing consumes them).
+Differentiable end to end: every step is gathers/scatters/psum under shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ddr_tpu.parallel.wavefront import ShardedWavefront, build_sharded_wavefront
+from ddr_tpu.routing.chunked import (
+    CHUNK_CELL_BUDGET,
+    boundary_buffer_columns,
+    boundary_ext_series,
+    pack_level_bands,
+)
+from ddr_tpu.routing.network import compute_levels
+
+__all__ = ["ShardedChunked", "build_sharded_chunked", "route_chunked_sharded"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedChunked:
+    """Per-band sharded-wavefront schedules + cross-band wiring.
+
+    ``gidx[b]`` maps band-b slots (padded, band-local order) to ORIGINAL node
+    ids, sentinel ``n`` for pad slots (inputs append a filler column there).
+    ``pub_idx[b]`` / ``ext_cols[b]`` / ``ext_tgt[b]`` follow
+    :class:`ddr_tpu.routing.chunked.ChunkedNetwork`'s boundary-buffer contract,
+    in band-local (padded) indices. ``out_sel`` gathers the concatenated
+    (pad-free via sentinel-drop) band outputs back to original order.
+    """
+
+    bands: tuple[ShardedWavefront, ...]
+    gidx: tuple[jnp.ndarray, ...]
+    pub_idx: tuple[jnp.ndarray, ...]
+    ext_cols: tuple[jnp.ndarray, ...]
+    ext_tgt: tuple[jnp.ndarray, ...]
+    out_sel: jnp.ndarray
+    n: int = dataclasses.field(metadata={"static": True})
+    depth: int = dataclasses.field(metadata={"static": True})
+    n_shards: int = dataclasses.field(metadata={"static": True})
+    n_boundary: int = dataclasses.field(metadata={"static": True})
+    n_bands: int = dataclasses.field(metadata={"static": True})
+
+
+def build_sharded_chunked(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    n_shards: int,
+    cell_budget: int = CHUNK_CELL_BUDGET,
+    level: np.ndarray | None = None,
+) -> ShardedChunked:
+    """Band the level axis with a PER-SHARD ring budget and build each band's
+    sharded-wavefront schedule over its level-sorted, shard-padded local order."""
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if level is None:
+        level = compute_levels(rows, cols, n)
+    depth = int(level.max()) if n else 0
+    counts = np.bincount(level, minlength=depth + 1)
+    band_ranges = pack_level_bands(counts, cell_budget, ring_cols_divisor=n_shards)
+    n_bands = len(band_ranges)
+
+    band_of_level = np.empty(depth + 1, dtype=np.int64)
+    for bi, (lo, hi) in enumerate(band_ranges):
+        band_of_level[lo:hi] = bi
+    band_of_node = band_of_level[level]
+    # band-local order: sort by (band, level, id) — level-sorted inside the band,
+    # so equal shard blocks keep cross-shard edges one-directional.
+    order = np.lexsort((np.arange(n), level, band_of_node))
+    pos = np.empty(n, dtype=np.int64)
+    pos[order] = np.arange(n)
+    band_sizes = np.bincount(band_of_node, minlength=n_bands)
+    offsets = np.concatenate([[0], np.cumsum(band_sizes)])
+
+    src_band = band_of_node[cols]
+    tgt_band = band_of_node[rows]
+    is_ext = src_band != tgt_band
+    ext_src_o, ext_tgt_o = cols[is_ext], rows[is_ext]
+    buf_src, col_of_src, b_starts = boundary_buffer_columns(
+        ext_src_o, band_of_node, n, n_bands
+    )
+
+    loc_band = tgt_band[~is_ext]
+    l_rows_all, l_cols_all = rows[~is_ext], cols[~is_ext]
+    e_order = np.argsort(loc_band, kind="stable")
+    e_starts = np.searchsorted(loc_band[e_order], np.arange(n_bands + 1))
+    x_order = np.argsort(tgt_band[is_ext], kind="stable")
+    x_starts = np.searchsorted(tgt_band[is_ext][x_order], np.arange(n_bands + 1))
+
+    bands: list[ShardedWavefront] = []
+    gidx: list[jnp.ndarray] = []
+    pub_idx: list[jnp.ndarray] = []
+    ext_cols_l: list[jnp.ndarray] = []
+    ext_tgt_l: list[jnp.ndarray] = []
+    out_sel_parts: list[np.ndarray] = []
+    slot_base = 0
+
+    for bi in range(n_bands):
+        off, n_b = int(offsets[bi]), int(band_sizes[bi])
+        pad = (-n_b) % n_shards
+        n_pad = n_b + pad
+        esl = e_order[e_starts[bi] : e_starts[bi + 1]]
+        l_rows = pos[l_rows_all[esl]] - off
+        l_cols = pos[l_cols_all[esl]] - off
+        bands.append(build_sharded_wavefront(l_rows, l_cols, n_pad, n_shards))
+
+        g = np.full(n_pad, n, dtype=np.int64)  # sentinel for pad slots
+        g[:n_b] = order[off : off + n_b]
+        gidx.append(jnp.asarray(g, jnp.int32))
+        # original-order reassembly: original id order[off + j] lives at concat
+        # slot slot_base + j (pad slots are simply never selected)
+        sel = np.empty(n_b, dtype=np.int64)
+        sel[:] = slot_base + np.arange(n_b)
+        out_sel_parts.append(sel)
+        slot_base += n_pad
+
+        pub = buf_src[b_starts[bi] : b_starts[bi + 1]]
+        pub_idx.append(jnp.asarray(pos[pub] - off, jnp.int32))
+        xsl = x_order[x_starts[bi] : x_starts[bi + 1]]
+        ext_cols_l.append(jnp.asarray(col_of_src[ext_src_o[xsl]], jnp.int32))
+        ext_tgt_l.append(jnp.asarray(pos[ext_tgt_o[xsl]] - off, jnp.int32))
+
+    # out_sel[i] = concat slot of original node i
+    concat_orig = np.concatenate(
+        [order[int(offsets[b]) : int(offsets[b]) + int(band_sizes[b])] for b in range(n_bands)]
+    ) if n else np.zeros(0, np.int64)
+    out_sel = np.empty(n, dtype=np.int64)
+    out_sel[concat_orig] = np.concatenate(out_sel_parts) if n else np.zeros(0, np.int64)
+
+    return ShardedChunked(
+        bands=tuple(bands),
+        gidx=tuple(gidx),
+        pub_idx=tuple(pub_idx),
+        ext_cols=tuple(ext_cols_l),
+        ext_tgt=tuple(ext_tgt_l),
+        out_sel=jnp.asarray(out_sel, jnp.int32),
+        n=int(n),
+        depth=depth,
+        n_shards=n_shards,
+        n_boundary=int(len(buf_src)),
+        n_bands=n_bands,
+    )
+
+
+def route_chunked_sharded(
+    mesh: Mesh,
+    layout: ShardedChunked,
+    channels: Any,
+    spatial_params: dict[str, Any],
+    q_prime: jnp.ndarray,
+    q_init: jnp.ndarray | None = None,
+    bounds: Any = None,
+    dt: float = 3600.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Route ``(T, N)`` inflows (ORIGINAL node order) band-by-band over the mesh.
+
+    Returns ``(runoff (T, N), final (N,))`` in original order. Differentiable.
+    """
+    from ddr_tpu.parallel.wavefront import sharded_wavefront_route
+    from ddr_tpu.routing.mc import Bounds, ChannelState
+
+    if bounds is None:
+        bounds = Bounds()
+    T = q_prime.shape[0]
+    lb = bounds.discharge
+
+    def _pad1(a, filler):
+        """Append the pad-slot filler so sentinel index n reads a neutral value."""
+        if a is None or jnp.ndim(a) == 0:
+            return a
+        if a.ndim == 1:
+            return jnp.concatenate([a, jnp.full((1,), filler, a.dtype)])
+        return jnp.concatenate([a, jnp.full((a.shape[0], 1), filler, a.dtype)], axis=1)
+
+    # neutral pad physics: positive finite everywhere the math divides/roots
+    ch_ext = ChannelState(
+        length=_pad1(channels.length, 1000.0),
+        slope=_pad1(channels.slope, 1e-3),
+        x_storage=_pad1(channels.x_storage, 0.3),
+        top_width_data=_pad1(channels.top_width_data, np.nan),
+        side_slope_data=_pad1(channels.side_slope_data, np.nan),
+    )
+    sp_ext = {
+        "n": _pad1(spatial_params["n"], 0.05),
+        "q_spatial": _pad1(spatial_params["q_spatial"], 0.5),
+        "p_spatial": _pad1(spatial_params["p_spatial"], 21.0),
+    }
+    qp_ext = _pad1(q_prime, 0.0)
+    qi_ext = None if q_init is None else _pad1(q_init, lb)
+
+    bnd = jnp.zeros((T, 0), q_prime.dtype)
+    outs: list[jnp.ndarray] = []
+    finals: list[jnp.ndarray] = []
+
+    for bi, sched in enumerate(layout.bands):
+        g = layout.gidx[bi]
+        ch_b = ChannelState(
+            length=ch_ext.length[g],
+            slope=ch_ext.slope[g],
+            x_storage=ch_ext.x_storage[g],
+            top_width_data=None if ch_ext.top_width_data is None else ch_ext.top_width_data[g],
+            side_slope_data=None if ch_ext.side_slope_data is None else ch_ext.side_slope_data[g],
+        )
+        sp_b = {k: (v if jnp.ndim(v) == 0 else v[g]) for k, v in sp_ext.items()}
+        qp_b = qp_ext[:, g]
+        qi_b = None if qi_ext is None else qi_ext[g]
+
+        e_cols, e_tgt = layout.ext_cols[bi], layout.ext_tgt[bi]
+        n_pad = sched.n_shards * sched.n_local
+        if int(e_cols.shape[0]):
+            x_ext, s_ext = boundary_ext_series(bnd, e_cols, e_tgt, n_pad, lb)
+        else:
+            x_ext = s_ext = None
+
+        runoff_b, final_b, raw_b = sharded_wavefront_route(
+            mesh, sched, ch_b, sp_b, qp_b, q_init=qi_b, bounds=bounds, dt=dt,
+            x_ext=x_ext, s_ext=s_ext, return_raw=True,
+        )
+        outs.append(runoff_b)
+        finals.append(final_b)
+        if int(layout.pub_idx[bi].shape[0]):
+            bnd = jnp.concatenate([bnd, raw_b[:, layout.pub_idx[bi]]], axis=1)
+
+    runoff = jnp.concatenate(outs, axis=1)[:, layout.out_sel]
+    final = jnp.concatenate(finals)[layout.out_sel]
+    return runoff, final

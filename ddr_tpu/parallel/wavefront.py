@@ -160,10 +160,22 @@ def sharded_wavefront_route(
     bounds: Bounds = Bounds(),
     dt: float = 3600.0,
     axis_name: str = "reach",
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    x_ext: jnp.ndarray | None = None,
+    s_ext: jnp.ndarray | None = None,
+    return_raw: bool = False,
+) -> tuple[jnp.ndarray, ...]:
     """Route ``(T, N)`` inflows over the mesh; returns ``(runoff (T, N), final (N,))``.
 
     All per-reach inputs must be in partitioned order. Differentiable end to end.
+
+    ``x_ext``/``s_ext`` inject predecessor sums living OUTSIDE this network —
+    the sharded-chunked router's upstream bands (same contract as
+    :func:`ddr_tpu.routing.wavefront.wavefront_route_core`): both (T, N)
+    partitioned order, ``x_ext[t]`` = RAW external solve sums at t (joins the
+    same-timestep solve incl. the in-band hotstart), ``s_ext[t]`` = CLAMPED
+    external sums at t-1 (joins the previous-timestep inflow; row 0 unused).
+    ``return_raw=True`` appends the pre-clamp solve values (T, N) — what a
+    downstream band's ``x_ext`` must read.
     """
     T = q_prime.shape[0]
     S, nl, B, D = schedule.n_shards, schedule.n_local, schedule.n_boundary, schedule.depth
@@ -171,13 +183,21 @@ def sharded_wavefront_route(
     has_init = q_init is not None
     if not has_init:
         q_init = jnp.zeros(q_prime.shape[1], q_prime.dtype)
+    if (x_ext is None) != (s_ext is None):
+        raise ValueError(
+            "x_ext and s_ext must be passed together (raw same-timestep sums AND "
+            "clamped previous-timestep sums form one external-inflow contract)"
+        )
+    has_ext = x_ext is not None
+    if not has_ext:
+        x_ext = s_ext = jnp.zeros((1, q_prime.shape[1]), q_prime.dtype)
 
     nan = jnp.full_like(channels.length, jnp.nan)
     twd_in = channels.top_width_data if channels.top_width_data is not None else nan
     ssd_in = channels.side_slope_data if channels.side_slope_data is not None else nan
 
     def shard_fn(level, pred_idx, pred_mask, bnd_out, bnd_tgt, bnd_gap,
-                 length, slope, x_st, twd, ssd, n_c, p_c, q_c, qp, qi):
+                 length, slope, x_st, twd, ssd, n_c, p_c, q_c, qp, qi, xe, se):
         level, pred_idx, pred_mask = level[0], pred_idx[0], pred_mask[0]
         bnd_out, bnd_tgt = bnd_out[0], bnd_tgt[0]
         ch = ChannelState(
@@ -206,20 +226,37 @@ def sharded_wavefront_route(
             lambda row, s: jax.lax.dynamic_slice(row, (s,), (n_waves,))
         )(padded, D - level).T  # (W, nl)
 
+        if has_ext:
+            # ext skew: wave w hands reach i ext[t, i] with t = w - 1 - L(i)
+            # exactly, zeros outside [0, T-1] (see wavefront_route_core).
+            def _skew_ext(ext_loc):  # (T, nl) -> (W, nl)
+                z = jnp.zeros((nl, D), ext_loc.dtype)
+                padded_e = jnp.concatenate([z, ext_loc.T, z], axis=1)
+                return jax.vmap(
+                    lambda row, s: jax.lax.dynamic_slice(row, (s,), (n_waves,))
+                )(padded_e, D - level).T
+
+            xe_s = _skew_ext(xe)
+            se_s = _skew_ext(se)
+
         ring0 = jnp.zeros((D + 2, nl + 1), qp.dtype)
         hist0 = jnp.zeros((D + 1, B), qp.dtype)
         s0 = jnp.zeros(nl, qp.dtype)
 
         def body(carry, wave_inputs):
             ring, hist, s_state = carry
-            q_row, w = wave_inputs
+            if has_ext:
+                q_row, xe_row, se_row, w = wave_inputs
+            else:
+                q_row, w = wave_inputs
+                xe_row = se_row = 0.0
             t_node = w - 1 - level
             q_prev = jnp.maximum(ring[0, :nl], bounds.discharge)
             c, _, _ = celerity(q_prev, n_c, p_c, q_c, ch, bounds)
             c1, c2, c3, c4 = muskingum_coefficients(ch.length, c, ch.x_storage, dt)
 
             g = ring.reshape(-1)[flat_idx].reshape(nl, -1)  # raw x_t[p], local preds
-            x_local = (g * mask).sum(axis=1)
+            x_local = (g * mask).sum(axis=1) + xe_row  # ext joins the same-t solve
             s_local = (jnp.maximum(g, bounds.discharge) * mask).sum(axis=1)
 
             # Boundary reads: edge e's source published x_t[src] gap waves before the
@@ -237,7 +274,10 @@ def sharded_wavefront_route(
             )
             x_pred = x_local + x_bnd
 
-            b_step = c2 * s_state + c3 * q_prev + c4 * jnp.maximum(q_row, bounds.discharge)
+            # se_row joins at CONSUMPTION time (this wave's inflow term), exactly
+            # like wavefront_route_core: s_ext[t] is the clamped external sum at
+            # the node's own previous timestep.
+            b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, bounds.discharge)
             is_hot = t_node == 0
             c1_eff = jnp.where(is_hot, 1.0, c1)
             b_eff = jnp.where(is_hot, q_row, b_step)  # hotstart: b = q'_0, raw
@@ -254,19 +294,24 @@ def sharded_wavefront_route(
             ring = jnp.concatenate(
                 [jnp.concatenate([y, jnp.zeros(1, y.dtype)])[None], ring[:-1]], 0
             )
-            return (ring, hist, s_local + s_bnd), jnp.maximum(y, bounds.discharge)
+            return (ring, hist, s_local + s_bnd), y  # RAW; clamp after un-skew
 
         waves = jnp.arange(1, n_waves + 1)
-        (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), (qs, waves))
+        xs = (qs, xe_s, se_s, waves) if has_ext else (qs, waves)
+        (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), xs)
 
         # Un-skew: x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L(i)).
-        routed = jax.vmap(
+        raw = jax.vmap(
             lambda row, s: jax.lax.dynamic_slice(row, (s,), (T,))
         )(ys.T, level).T  # (T, nl)
+        routed = jnp.maximum(raw, bounds.discharge)
+        if return_raw:
+            return routed, routed[-1], raw
         return routed, routed[-1]
 
     shard = P(axis_name)
     rep = P()
+    out_specs = (P(None, axis_name), shard) + ((P(None, axis_name),) if return_raw else ())
     fn = jax.shard_map(
         shard_fn,
         mesh=mesh,
@@ -275,8 +320,9 @@ def sharded_wavefront_route(
             shard, shard, shard, shard, shard,  # channel arrays
             shard, shard, shard,  # spatial params
             P(None, axis_name), shard,  # q_prime, q_init
+            P(None, axis_name), P(None, axis_name),  # x_ext, s_ext
         ),
-        out_specs=(P(None, axis_name), shard),
+        out_specs=out_specs,
         check_vma=False,
     )
     return fn(
@@ -284,5 +330,5 @@ def sharded_wavefront_route(
         schedule.bnd_out, schedule.bnd_tgt, schedule.bnd_gap,
         channels.length, channels.slope, channels.x_storage, twd_in, ssd_in,
         spatial_params["n"], spatial_params["p_spatial"], spatial_params["q_spatial"],
-        q_prime, q_init,
+        q_prime, q_init, x_ext, s_ext,
     )

@@ -51,16 +51,79 @@ from ddr_tpu.routing.network import (
 
 __all__ = [
     "ChunkedNetwork",
+    "boundary_buffer_columns",
+    "boundary_ext_series",
     "build_chunked_network",
     "build_routing_network",
+    "pack_level_bands",
     "route_chunked",
     "CHUNK_CELL_BUDGET",
 ]
+
+
+def boundary_buffer_columns(
+    ext_src: np.ndarray, band_of_node: np.ndarray, n: int, n_bands: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """THE boundary-buffer column layout, shared by the single-chip and sharded
+    chunked builders: unique external-edge sources ordered by publishing band.
+
+    Returns ``(buf_src, col_of_src, b_starts)``: buffer column -> original source
+    id; original id -> column (-1 if not a boundary source); and the per-band
+    column ranges ``b_starts[b] : b_starts[b+1]``.
+    """
+    uniq_src = np.unique(ext_src)
+    buf_order = np.argsort(band_of_node[uniq_src], kind="stable")
+    buf_src = uniq_src[buf_order]
+    col_of_src = np.full(n, -1, dtype=np.int64)
+    col_of_src[buf_src] = np.arange(len(buf_src))
+    b_starts = np.searchsorted(band_of_node[buf_src], np.arange(n_bands + 1))
+    return buf_src, col_of_src, b_starts
+
+
+def boundary_ext_series(bnd, e_cols, e_tgt, n_out: int, lb: float):
+    """THE cross-band forwarding contract, shared by both chunked routers:
+    from the raw boundary buffer ``bnd`` (T, B), build ``x_ext`` (raw
+    same-timestep sums — downstream solves read RAW predecessor values, exactly
+    like the intra-band ring) and ``s_ext`` (clamped-per-predecessor
+    previous-timestep sums; row 0 zero — hotstart has no inflow term), both
+    (T, n_out) scatter-added at the band-local targets ``e_tgt``."""
+    T = bnd.shape[0]
+    gathered = bnd[:, e_cols]
+    x_ext = jnp.zeros((T, n_out), bnd.dtype).at[:, e_tgt].add(gathered)
+    prev = jnp.concatenate([jnp.zeros((1, bnd.shape[1]), bnd.dtype), bnd[:-1]], 0)
+    s_gath = jnp.maximum(prev[:, e_cols], lb)
+    s_ext = jnp.zeros((T, n_out), bnd.dtype).at[:, e_tgt].add(s_gath)
+    return x_ext, s_ext
 
 # Default per-band ring-cell budget: 2^26 cells = 256 MB of float32 ring. Keeps the
 # band's skew buffers ((T + span) * n_c) near a GB at T=240 and bounds band count at
 # CONUS scale to ~10 (each extra band costs T extra waves).
 CHUNK_CELL_BUDGET = 1 << 26
+
+
+def pack_level_bands(
+    counts: np.ndarray, cell_budget: int, ring_cols_divisor: int = 1
+) -> list[tuple[int, int]]:
+    """Greedy packing of consecutive levels into ring-budgeted bands.
+
+    Each band (lo, hi) satisfies ``(span + 1) * (ceil(n_band / ring_cols_divisor)
+    + 1) <= cell_budget`` — the EXACT ring cell upper bound including shard
+    padding (divisor = shard count when the ring is per-shard, as in the
+    sharded-chunked router; ceil because bands pad to a shard multiple). A single
+    over-wide level still forms its own valid band — its ring is only 2 rows.
+    """
+    depth = len(counts) - 1
+    bands: list[tuple[int, int]] = []
+    s, acc = 0, 0
+    for L in range(depth + 1):
+        span = L - s + 1
+        cols = -(-(acc + int(counts[L])) // ring_cols_divisor)  # ceil-div
+        if L > s and (span + 1) * (cols + 1) > cell_budget:
+            bands.append((s, L))
+            s, acc = L, 0
+        acc += int(counts[L])
+    bands.append((s, depth + 1))
+    return bands
 
 
 @jax.tree_util.register_dataclass
@@ -123,17 +186,7 @@ def build_chunked_network(
         level = compute_levels(rows, cols, n)
     depth = int(level.max()) if n else 0
     counts = np.bincount(level, minlength=depth + 1)
-
-    # Greedy band packing over consecutive levels.
-    bands: list[tuple[int, int]] = []
-    s, acc = 0, 0
-    for L in range(depth + 1):
-        span = L - s + 1
-        if L > s and (span + 1) * (acc + int(counts[L]) + 1) > cell_budget:
-            bands.append((s, L))
-            s, acc = L, 0
-        acc += int(counts[L])
-    bands.append((s, depth + 1))
+    bands = pack_level_bands(counts, cell_budget)
     n_chunks = len(bands)
 
     band_of_level = np.empty(depth + 1, dtype=np.int64)
@@ -153,12 +206,9 @@ def build_chunked_network(
     # Boundary buffer columns: unique external sources, grouped by publishing band.
     ext_src_o = cols[is_ext]
     ext_tgt_o = rows[is_ext]
-    uniq_src = np.unique(ext_src_o)  # sorted by original id
-    buf_order = np.argsort(band_of_node[uniq_src], kind="stable")
-    buf_src = uniq_src[buf_order]  # buffer column -> original source id
-    col_of_src = np.full(n, -1, dtype=np.int64)
-    col_of_src[buf_src] = np.arange(len(buf_src))
-    buf_band = band_of_node[buf_src]
+    buf_src, col_of_src, b_starts = boundary_buffer_columns(
+        ext_src_o, band_of_node, n, n_chunks
+    )
 
     chunks: list[RiverNetwork] = []
     gidx: list[jnp.ndarray] = []
@@ -173,7 +223,6 @@ def build_chunked_network(
     e_starts = np.searchsorted(loc_band[e_order], np.arange(n_chunks + 1))
     x_order = np.argsort(tgt_band[is_ext], kind="stable")
     x_starts = np.searchsorted(tgt_band[is_ext][x_order], np.arange(n_chunks + 1))
-    b_starts = np.searchsorted(buf_band, np.arange(n_chunks + 1))
 
     for ci in range(n_chunks):
         off, n_c = int(offsets[ci]), int(band_sizes[ci])
@@ -293,11 +342,7 @@ def route_chunked(
 
         e_cols, e_tgt = network.ext_cols[ci], network.ext_tgt[ci]
         if int(e_cols.shape[0]):
-            gathered = bnd[:, e_cols]  # (T, E_c) raw upstream-band solve values
-            x_ext = jnp.zeros((T, net.n), qp_c.dtype).at[:, e_tgt].add(gathered)
-            prev = jnp.concatenate([jnp.zeros((1, bnd.shape[1]), bnd.dtype), bnd[:-1]], 0)
-            s_gath = jnp.maximum(prev[:, e_cols], lb)  # clamp per predecessor, then sum
-            s_ext = jnp.zeros((T, net.n), qp_c.dtype).at[:, e_tgt].add(s_gath)
+            x_ext, s_ext = boundary_ext_series(bnd, e_cols, e_tgt, net.n, lb)
         else:
             x_ext = s_ext = None
 
