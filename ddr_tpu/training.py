@@ -36,6 +36,7 @@ __all__ = [
     "make_train_step",
     "make_batch_train_step",
     "make_sharded_train_step",
+    "make_sharded_chunked_train_step",
     "save_state",
     "load_state",
 ]
@@ -77,6 +78,23 @@ def masked_l1_daily(runoff_tg, obs_daily, obs_mask, tau: int, warmup: int):
     return err.sum() / jnp.maximum(mask.sum(), 1), daily
 
 
+def _make_step(loss_fn, optimizer):
+    """Shared jitted step scaffolding for every builder whose loss takes
+    ``(params, attrs, q_prime, obs_daily, obs_mask)``: value_and_grad ->
+    clip+Adam update -> apply. One definition so the builders cannot drift."""
+
+    @jax.jit
+    def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
+        (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, attrs, q_prime, obs_daily, obs_mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, daily
+
+    return step
+
+
 def make_train_step(
     kan_model,
     network: RiverNetwork,
@@ -110,16 +128,7 @@ def make_train_step(
         result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
         return masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
 
-    @jax.jit
-    def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
-        (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, attrs, q_prime, obs_daily, obs_mask
-        )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, daily
-
-    return step
+    return _make_step(loss_fn, optimizer)
 
 
 def make_batch_train_step(
@@ -206,16 +215,48 @@ def make_sharded_train_step(
         )
         return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
 
-    @jax.jit
-    def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
-        (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, attrs, q_prime, obs_daily, obs_mask
-        )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, daily
+    return _make_step(loss_fn, optimizer)
 
-    return step
+
+def make_sharded_chunked_train_step(
+    kan_model,
+    mesh,
+    layout,
+    channels: ChannelState,
+    gauges: GaugeIndex,
+    bounds: Bounds,
+    parameter_ranges: dict[str, list[float]],
+    log_space_parameters: list[str],
+    defaults: dict[str, float],
+    tau: int,
+    warmup: int,
+    optimizer: optax.GradientTransformation,
+):
+    """Multi-chip train step at CONTINENTAL DEPTH: the sharded depth-chunked
+    router (:func:`ddr_tpu.parallel.chunked.route_chunked_sharded`) under the
+    mesh — the engine whose per-shard-per-band ring stays HBM-feasible where the
+    monolithic sharded wavefront's does not (docs/tpu.md "Continental depth").
+
+    ``layout`` is a :class:`ddr_tpu.parallel.chunked.ShardedChunked`; unlike
+    :func:`make_sharded_train_step`, every per-reach array stays in ORIGINAL
+    node order (the layout carries its own band/shard permutations). Loss and
+    windowing are :func:`masked_l1_daily`, identical to every other builder.
+    """
+    from ddr_tpu.parallel.chunked import route_chunked_sharded
+
+    n_segments = channels.length.shape[0]
+
+    def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
+        raw = kan_model.apply(params, attrs)
+        spatial = denormalize_spatial_parameters(
+            raw, parameter_ranges, log_space_parameters, defaults, n_segments
+        )
+        runoff, _ = route_chunked_sharded(
+            mesh, layout, channels, spatial, q_prime, bounds=bounds
+        )
+        return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
+
+    return _make_step(loss_fn, optimizer)
 
 
 # Bump when the checkpoint blob layout changes; load_state refuses mismatches with
