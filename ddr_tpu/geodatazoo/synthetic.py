@@ -273,14 +273,14 @@ class Synthetic:
                 start_time=cfg.experiment.start_time, end_time=cfg.experiment.end_time
             ).daily_time_range
         )
-        n_segments = int(getattr(cfg, "synthetic_segments", 0) or 64)
         self.basin = observe(
             make_basin(
-                n_segments=n_segments,
+                n_segments=cfg.synthetic_segments or 64,
                 n_gauges=4,
                 n_days=n_days,
                 seed=cfg.np_seed,
                 start_time=cfg.experiment.start_time,
+                depth=cfg.synthetic_depth,
             ),
             cfg,
         )

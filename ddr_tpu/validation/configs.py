@@ -174,6 +174,18 @@ class Config(BaseModel):
     seed: int = 0
     device: str = Field(default="tpu", description='"tpu", "cpu", or "cpu:N" for a virtual mesh')
     s3_region: str = "us-east-2"
+    synthetic_segments: int | None = Field(
+        default=None,
+        ge=1,
+        description="Synthetic geodataset: number of reaches (default 64). Was "
+        "previously read via getattr but unreachable from YAML (extra=forbid)",
+    )
+    synthetic_depth: int | None = Field(
+        default=None,
+        ge=1,
+        description="Synthetic geodataset: exact longest-path depth (the "
+        "CONUS-realistic deep generator); None keeps the shallow random tree",
+    )
     run_dir: str | None = Field(
         default=None,
         description="Run-directory root: when set, load_config creates "

@@ -70,3 +70,22 @@ def test_make_basin_deep_topology_end_to_end():
     level = compute_levels(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
     assert int(level.max()) == 60
     assert basin.q_prime.shape == (48, 256)
+
+
+def test_synthetic_dataset_config_knobs():
+    """synthetic_segments / synthetic_depth are REAL config fields now (the
+    getattr-only read was unreachable from YAML under extra=forbid)."""
+    from ddr_tpu.geodatazoo.synthetic import Synthetic
+    from ddr_tpu.validation.configs import Config
+
+    cfg = Config(
+        name="t", geodataset="synthetic", mode="training",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={"start_time": "1981/10/01", "end_time": "1981/10/04", "rho": 3},
+        synthetic_segments=200, synthetic_depth=50,
+    )
+    ds = Synthetic(cfg)
+    rd = ds.routing_data
+    assert rd.n_segments == 200
+    level = compute_levels(rd.adjacency_rows, rd.adjacency_cols, 200)
+    assert int(level.max()) == 50
