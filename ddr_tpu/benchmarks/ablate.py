@@ -78,13 +78,16 @@ def main() -> None:
     fn = jax.jit(
         lambda qp: route(network, channels, params, qp, gauges=gauges, engine=engine).runoff
     )
+    # TRUE compile time via AOT lowering (the old first-call timing folded one
+    # full execution in — at deep CPU shapes a ~0.6s compile read as 107s)
     t0 = time.perf_counter()
-    fn(q_prime).block_until_ready()
+    compiled = fn.lower(q_prime).compile()
     compile_s = time.perf_counter() - t0
+    compiled(q_prime).block_until_ready()  # warm buffers
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        fn(q_prime).block_until_ready()
+        compiled(q_prime).block_until_ready()
     dt = (time.perf_counter() - t0) / reps
     print(
         json.dumps(

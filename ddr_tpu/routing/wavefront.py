@@ -34,11 +34,11 @@ TPU cost shaping (each documented by measurement in docs/tpu.md):
   bucket) — measured ~0.03ms vs 15-29ms for dynamic-slice row gathers, element
   gathers, or anything fused with a transpose, the chip's worst access patterns.
   EXCEPT past ``SKEW_SLICE_MAX_RUNS`` (deep networks: runs ~ depth x degree
-  buckets): XLA op count — and compile time, measured 4+ minutes at depth 1200 —
-  scales with run count, so there the skew becomes one per-column
-  ``take_along_axis`` gather; the per-element gather cost is the price of a
-  tractable compile, and the deep regime's larger per-wave arithmetic amortizes
-  it. The one remaining per-element permutation (q_prime columns into wf order)
+  buckets): XLA op count — and compile time, measured ~230s at depth 1200 —
+  scales with run count, so there the skew becomes ONE vmapped dynamic-slice
+  over transposed columns (n slice-starts, compile ~1s; see
+  ``_skew_by_level_runs``), whose per-slice gather cost the deep regime's larger
+  per-wave arithmetic amortizes. The one remaining per-element permutation (q_prime columns into wf order)
   can be hoisted to the host: pass ``q_prime_permuted=True`` with pre-permuted
   inflows (``q_prime[:, np.asarray(network.wf_perm)]``) to remove it entirely.
 
@@ -75,9 +75,13 @@ def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.nda
 
     Run (s, e, L) contributes ``src[start_of(L) : start_of(L) + width, s:e]``.
     Few runs: one STATIC slice each (``start_of`` is evaluated on Python ints at
-    trace time) — pure streaming copies. Many runs (deep networks): one
-    ``take_along_axis`` gather with per-column start rows — constant op count,
-    trading per-element gather cost for tractable compiles.
+    trace time) — pure streaming copies. Many runs (deep networks): ONE vmapped
+    dynamic-slice over transposed columns — n slice-starts (n int32s, gather
+    indexes per SLICE not per element), constant op count; measured compile
+    ~230s -> ~1s on a depth-1200 chunk vs the per-run slice build. (A
+    take_along_axis variant would materialize a (width, n) index matrix —
+    hundreds of MB of embedded constants at bench shapes — so the slice-start
+    form is the one that scales.)
     """
     if len(runs) <= SKEW_SLICE_MAX_RUNS:
         blocks = [
@@ -88,8 +92,10 @@ def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.nda
     starts = np.empty(src.shape[1], dtype=np.int32)
     for s, e, L in runs:
         starts[s:e] = start_of(L)
-    rows = jnp.asarray(starts)[None, :] + jnp.arange(width, dtype=jnp.int32)[:, None]
-    return jnp.take_along_axis(src, rows, axis=0)
+    sl = jax.vmap(lambda row, s0: jax.lax.dynamic_slice(row, (s0,), (width,)))(
+        src.T, jnp.asarray(starts)
+    )
+    return sl.T
 
 
 def wavefront_route_core(
