@@ -125,14 +125,15 @@ def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
     return f"{_timed_rate(fn, q_prime, n, t_hours)} {engine}"
 
 
-def bench_grad(n: int, t_hours: int) -> float:
+def bench_grad(n: int, t_hours: int, depth: int | None = None) -> float:
     """Reach-timesteps/sec for the full VJP (value_and_grad of a gauge-loss route)
-    on the active backend — the training-path throughput."""
+    on the active backend — the training-path throughput. ``depth`` switches to
+    the deep CONUS-realistic topology (auto-selected engine)."""
     import jax
 
     from ddr_tpu.routing.mc import route
 
-    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours)
+    network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
 
     def loss(p):
         return route(network, channels, p, q_prime, gauges=gauges).runoff.mean()
@@ -244,6 +245,24 @@ def _run_child(code: str, timeout: float, cpu_only: bool) -> tuple[str | None, s
     return (lines[-1] if lines else None), ""
 
 
+def _record_float(out: dict, key: str, code: str, timeout: float, cpu_only: bool,
+                  metric_key: str | None = None, metric: str | None = None) -> None:
+    """Best-effort phase plumbing shared by the grad/deep/deep-grad extras: run
+    the child, parse its last line as a float into ``out[key]``, or record
+    ``out[key + "_error"]`` — never fatal to the headline record."""
+    val, err = _run_child(code, timeout, cpu_only)
+    if val is None:
+        out[key + "_error"] = err
+        return
+    try:
+        out[key] = round(float(val), 1)
+    except ValueError:
+        out[key + "_error"] = f"unparseable output: {val!r}"
+        return
+    if metric_key and metric:
+        out[metric_key] = metric
+
+
 def main() -> None:
     out: dict = {
         "metric": "reach-timesteps/sec/chip (synthetic network, forward route)",
@@ -319,20 +338,16 @@ def main() -> None:
     # Phase 2b (best-effort): training-path throughput — the full VJP. Failure
     # only omits the extra field; the headline metric is already settled.
     if out["value"] is not None:
-        gval, gerr = _run_child(
-            f"import bench; print(bench.bench_grad({n}, {t_hours}))", bench_timeout, cpu_only
+        _record_float(
+            out, "grad_value",
+            f"import bench; print(bench.bench_grad({n}, {t_hours}))",
+            bench_timeout, cpu_only,
+            metric_key="grad_metric",
+            metric=(
+                "reach-timesteps/sec/chip, full VJP (value_and_grad of the "
+                "gauge-loss route), same shapes and unit as the headline"
+            ),
         )
-        if gval is not None:
-            try:
-                out["grad_value"] = round(float(gval), 1)
-                out["grad_metric"] = (
-                    "reach-timesteps/sec/chip, full VJP (value_and_grad of the "
-                    "gauge-loss route), same shapes and unit as the headline"
-                )
-            except ValueError:
-                out["grad_error"] = f"unparseable grad output: {gval!r}"
-        else:
-            out["grad_error"] = gerr
 
     # Phase 2c (best-effort): the deep CONUS-shaped topology — depth in the
     # thousands, routed by whatever build_routing_network auto-selects (the
@@ -364,6 +379,20 @@ def main() -> None:
                 out["deep_error"] = f"unparseable deep output: {dval!r}"
         else:
             out["deep_error"] = derr
+
+        # Phase 2d (best-effort): deep training-path throughput — the full VJP
+        # through the auto-selected deep engine.
+        if "deep_value" in out:
+            _record_float(
+                out, "deep_grad_value",
+                f"import bench; print(bench.bench_grad({deep_n}, {t_hours}, depth={deep_depth}))",
+                bench_timeout, cpu_only,
+                metric_key="deep_grad_metric",
+                metric=(
+                    "reach-timesteps/sec/chip, full VJP on the deep topology, "
+                    "same shapes as deep_metric"
+                ),
+            )
 
     # Phase 3: the reference-equivalent CPU baseline.
     ref, err = _run_child(

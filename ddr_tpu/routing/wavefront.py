@@ -59,14 +59,15 @@ from ddr_tpu.routing.network import RiverNetwork
 __all__ = ["wavefront_route_core"]
 
 
-# Above this many level runs the static-slice skew is compiled as a per-column
-# gather instead: XLA op count (and compile time) scales with run count — at
-# continental depth (runs ~ depth x degree-buckets, ~3-4k) the slice build
-# measured 4+ MINUTES of compile for a single depth-1200 chunk, vs O(1) ops for
-# the gather. At shallow depth the slices stay: measured ~0.03ms vs 15-29ms for
-# gather-shaped skews at N=8192 (docs/tpu.md). 512 keeps the whole advertised
-# shallow regime (N=65k default topology measures ~130 runs) on the fast slice
-# path while catching every deep configuration well before compile blows up.
+# Above this many level runs the static-slice skew compiles as ONE vmapped
+# dynamic-slice over transposed columns instead: XLA op count (and compile
+# time) scales with run count — at continental depth (runs ~ depth x
+# degree-buckets, ~3-4k) the per-run slice build measured ~230s of compile for
+# a single depth-1200 chunk vs ~1s for the vmapped form. At shallow depth the
+# static slices stay: measured ~0.03ms vs 15-29ms for gather-shaped skews at
+# N=8192 (docs/tpu.md). 512 keeps the whole advertised shallow regime (N=65k
+# default topology measures ~130 runs) on the fast slice path while catching
+# every deep configuration well before compile blows up.
 SKEW_SLICE_MAX_RUNS = 512
 
 
