@@ -78,11 +78,19 @@ def _bench_setup(n: int, t_hours: int, depth: int | None = None):
 def _timed_rate(fn, arg, n: int, t_hours: int) -> float:
     """Compile once, then queue all reps and block once: a blocking sync through
     the axon tunnel costs ~70ms of poll latency, which is device-idle time, not
-    device throughput."""
+    device throughput. Reps scale to ~2s of queued device work (measured at
+    N=8192/T=240 on the live chip: 5 reps still reads 38% low because the fixed
+    poll latency is comparable to the 19ms route itself; 1-ms-route shapes need
+    ~50 queued to amortize it, while a 15s deep route needs no amortizing)."""
     import jax
 
-    jax.block_until_ready(fn(arg))  # compile
-    reps = 5
+    est0 = time.perf_counter()
+    jax.block_until_ready(fn(arg))  # compile + one timed run (upper-bounds est)
+    est = time.perf_counter() - est0
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(arg))
+    est = min(est, time.perf_counter() - t0)  # post-compile single-run estimate
+    reps = max(3, min(50, int(2.0 / max(est, 1e-3))))
     t0 = time.perf_counter()
     outs = [fn(arg) for _ in range(reps)]
     jax.block_until_ready(outs)
