@@ -53,6 +53,7 @@ __all__ = [
     "ChunkedNetwork",
     "boundary_buffer_columns",
     "boundary_ext_series",
+    "auto_cell_budget",
     "build_chunked_network",
     "build_routing_network",
     "pack_level_bands",
@@ -95,10 +96,54 @@ def boundary_ext_series(bnd, e_cols, e_tgt, n_out: int, lb: float):
     s_ext = jnp.zeros((T, n_out), bnd.dtype).at[:, e_tgt].add(s_gath)
     return x_ext, s_ext
 
-# Default per-band ring-cell budget: 2^26 cells = 256 MB of float32 ring. Keeps the
-# band's skew buffers ((T + span) * n_c) near a GB at T=240 and bounds band count at
-# CONUS scale to ~10 (each extra band costs T extra waves).
+# Per-band ring-cell MEMORY CAP: 2^26 cells = 256 MB of float32 ring (keeps the
+# band's skew buffers ((T + span) * n_c) near a GB at T=240). The speed-optimal
+# budget is far below this cap — see :func:`auto_cell_budget`, the default.
 CHUNK_CELL_BUDGET = 1 << 26
+
+# Measured per-wave cost constants on the attached v5e (docs/tpu.md, "Continental
+# depth"): a wave pays a fixed dispatch/physics cost plus a full ring-buffer copy
+# (XLA's copy insertion cannot prove the in-body ring gather and the row write
+# don't alias, so each scan iteration rewrites the carry; measured ~210 GB/s
+# effective, vs 0.15ns/idx for the gather itself). Small rings make that copy
+# cheap; each extra band costs T extra waves of fixed cost. auto_cell_budget
+# balances the two.
+_WAVE_FIXED_S = 35e-6
+_RING_COPY_BYTES_PER_S = 2.1e11
+
+
+def auto_cell_budget(
+    n: int,
+    depth: int,
+    t_nominal: int = 240,
+    max_bands: int = 64,
+    cap: int = CHUNK_CELL_BUDGET,
+) -> int:
+    """Speed-optimal band ring budget from the measured TPU wave-cost model.
+
+    Minimizes ``(C * T + depth) * (fixed + ring_bytes / copy_bw)`` over band
+    count C (uniform-level-width approximation: ``ring(C) ~ (span+1)(span*rho+1)``
+    with ``span = depth / C``, ``rho = n / depth``). Measured on the chip at
+    N=65536/depth=1024/T=240: the default 2^26 memory cap yields 2 bands and
+    7.4M rt/s; C=16 (budget 2^18) yields 99.7M rt/s — the ring-copy tax, not
+    memory, is what sizes bands. ``max_bands`` caps compile time (the band loop
+    unrolls into the jit program) and host build time.
+    """
+    if depth <= 0 or n <= 0:
+        return cap
+    rho = max(1.0, n / depth)
+    best_budget, best_cost = cap, float("inf")
+    c = 1
+    while c <= max_bands:
+        span = max(1, -(-depth // c))
+        ring_cells = (span + 1) * (int(span * rho) + 1)
+        if ring_cells <= cap:
+            waves = c * t_nominal + depth
+            cost = waves * (_WAVE_FIXED_S + ring_cells * 4 / _RING_COPY_BYTES_PER_S)
+            if cost < best_cost:
+                best_cost, best_budget = cost, ring_cells
+        c *= 2
+    return max(best_budget, 2)
 
 
 def pack_level_bands(
@@ -170,7 +215,7 @@ def build_chunked_network(
     rows: np.ndarray,
     cols: np.ndarray,
     n: int,
-    cell_budget: int = CHUNK_CELL_BUDGET,
+    cell_budget: int | None = None,
     level: np.ndarray | None = None,
 ) -> ChunkedNetwork:
     """Band the level axis greedily and build per-band wavefront subnetworks.
@@ -178,7 +223,10 @@ def build_chunked_network(
     Bands are maximal runs of consecutive levels with
     ``(span + 1) * (n_band + 1) <= cell_budget`` (the band ring's cell count upper
     bound; a single over-wide level still forms its own valid band — its ring is
-    only 2 rows). O(E) host work beyond the shared Kahn layering.
+    only 2 rows). ``cell_budget=None`` picks the speed-optimal budget from the
+    measured TPU wave-cost model (:func:`auto_cell_budget` — small rings beat
+    the 2^26 memory cap by >10x on deep networks). O(E) host work beyond the
+    shared Kahn layering.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -186,6 +234,8 @@ def build_chunked_network(
         level = compute_levels(rows, cols, n)
     depth = int(level.max()) if n else 0
     counts = np.bincount(level, minlength=depth + 1)
+    if cell_budget is None:
+        cell_budget = auto_cell_budget(n, depth)
     bands = pack_level_bands(counts, cell_budget)
     n_chunks = len(bands)
 
@@ -268,7 +318,7 @@ def build_routing_network(
     rows: np.ndarray,
     cols: np.ndarray,
     n: int,
-    cell_budget: int = CHUNK_CELL_BUDGET,
+    cell_budget: int | None = None,
 ) -> RiverNetwork | ChunkedNetwork:
     """Auto-select the fastest eligible topology structure for :func:`route`.
 

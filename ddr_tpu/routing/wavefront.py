@@ -180,6 +180,26 @@ def wavefront_route_core(
     wf_idx, wf_mask, buckets = network.wf_idx, network.wf_mask, network.wf_buckets
     n_deg0 = buckets[0][0] if buckets else n
 
+    # Rotating FLAT ring. Two profiled pathologies shape this:
+    # (a) the concatenate-shift form (`ring = concat([y_row, ring[:-1]])`)
+    #     lowers to a chunked copy-through-scratch inner loop — ~4-5ms/wave on a
+    #     256MB deep-band ring, 60-70% of the whole route;
+    # (b) a 2-D ring carry is tiled T(8,128), but the gather wants flat
+    #     indexing — `ring.reshape(-1)` is then a LAYOUT-CHANGING reshape that
+    #     XLA materializes by copying the full ring every wave (the rotation
+    #     alone recovered only ~25% until the carry itself went 1-D).
+    # So the carry IS the flat (R * row_len,) buffer: wave w writes ONE
+    # contiguous row at offset ``(w % R) * row_len`` and the gather rows rotate
+    # with it — a predecessor emitted at wave w - d lives at flat offset
+    # ``((w - d) % R) * row_len``. wf_idx encodes (d - 1, col) as
+    # ``(d - 1) * row_len + col``; the per-wave rotation is a scalar mod plus
+    # two vector ops on the edge table. Rows never written (w - d < 1, early
+    # waves) land on still-zero ring rows, preserving the zero-history
+    # semantics of the shift form bit for bit.
+    ring_rows = depth + 2
+    wf_row = wf_idx // row_len  # d - 1, static per slot
+    wf_col = wf_idx - wf_row * row_len
+
     def reduce_buckets(gathered: jnp.ndarray, clamped: bool) -> jnp.ndarray:
         """Per-node sums from the flat bucket-concatenated gather."""
         parts = [jnp.zeros(n_deg0, gathered.dtype)]
@@ -194,7 +214,7 @@ def wavefront_route_core(
             off += cnt
         return jnp.concatenate(parts)
 
-    ring0 = jnp.zeros((depth + 2, row_len), qp_p.dtype)
+    ring0 = jnp.zeros(ring_rows * row_len, qp_p.dtype)
     s0 = jnp.zeros(n, qp_p.dtype)
     t_of_wave = lambda w: w - 1 - level_p  # noqa: E731
 
@@ -212,9 +232,13 @@ def wavefront_route_core(
             q_row, w = wave_inputs
             xe_row = se_row = 0.0
         t_node = t_of_wave(w)
-        q_prev = jnp.maximum(ring[0, :n], discharge_lb)  # clamped x_{t-1}[i]
+        h1 = jax.lax.rem(w - 1, ring_rows)  # row of wave w - 1's output
+        q_prev_row = jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n]
+        q_prev = jnp.maximum(q_prev_row, discharge_lb)  # clamped x_{t-1}[i]
         c1, c2, c3, c4 = physics(q_prev)
-        gathered = ring.reshape(-1)[wf_idx]  # THE gather: raw x_t[p] per edge slot
+        rot = h1 - wf_row  # (h1 - (d - 1)) mod R, in two vector ops
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        gathered = ring[rot * row_len + wf_col]  # THE gather: raw x_t[p]
         x_pred = reduce_buckets(gathered, clamped=False) + xe_row
         s_next = reduce_buckets(gathered, clamped=True)  # wave w+1's inflow sums
 
@@ -230,8 +254,9 @@ def wavefront_route_core(
         # keeps late-wave garbage finite.
         ok = (t_node >= 0) & (t_node <= T - 1)
         y = jnp.where(ok, y, 0.0)
-        ring = jnp.concatenate(
-            [jnp.concatenate([y, jnp.zeros(1, y.dtype)])[None], ring[:-1]], axis=0
+        h = jax.lax.rem(w, ring_rows)  # this wave's row
+        ring = jax.lax.dynamic_update_slice(
+            ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]), (h * row_len,)
         )
         return (ring, s_next), y
 

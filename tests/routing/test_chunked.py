@@ -247,3 +247,44 @@ def test_braided_divergence_matches_step():
     assert cn.n_chunks > 1
     res = route(cn, channels, params, qp)
     assert _rel(res.runoff, ref.runoff) < 1e-4
+
+
+class TestAutoCellBudget:
+    """auto_cell_budget: the measured-TPU-cost-model band sizing (docs/tpu.md).
+
+    On-chip measurement that motivated it (N=65536, depth=1024, T=240): the
+    2^26 memory cap packs 2 bands and routes at 7.4M rt/s; budget 2^18 packs
+    16 bands and routes at 99.7M rt/s — per-wave cost is dominated by XLA's
+    ring-carry copy, so small rings win until the C*T extra waves' fixed cost
+    takes over.
+    """
+
+    def test_prefers_small_rings_on_deep_networks(self):
+        from ddr_tpu.routing.chunked import CHUNK_CELL_BUDGET, auto_cell_budget
+
+        b = auto_cell_budget(65536, 1024)
+        # The optimum sits orders below the memory cap (C ~ 8-16 bands).
+        assert b < CHUNK_CELL_BUDGET // 16
+        assert b >= 2
+
+    def test_respects_memory_cap(self):
+        from ddr_tpu.routing.chunked import CHUNK_CELL_BUDGET, auto_cell_budget
+
+        for n, d in [(65536, 1024), (2_900_000, 4000), (8192, 30), (16, 4)]:
+            assert 2 <= auto_cell_budget(n, d) <= CHUNK_CELL_BUDGET
+
+    def test_degenerate_shapes(self):
+        from ddr_tpu.routing.chunked import CHUNK_CELL_BUDGET, auto_cell_budget
+
+        assert auto_cell_budget(0, 0) == CHUNK_CELL_BUDGET
+        assert auto_cell_budget(100, 0) == CHUNK_CELL_BUDGET
+
+    def test_default_build_uses_auto(self):
+        n, depth, T = 600, 150, 8
+        rows, cols, channels, params, qp = _setup(n, depth, T)
+        cn = build_chunked_network(rows, cols, n)  # cell_budget=None -> auto
+        ref = route(
+            build_network(rows, cols, n, fused=False), channels, params, qp, engine="step"
+        )
+        res = route(cn, channels, params, qp)
+        assert _rel(res.runoff, ref.runoff) < 1e-4
