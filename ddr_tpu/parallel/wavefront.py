@@ -204,7 +204,18 @@ def sharded_wavefront_route(
             length=length, slope=slope, x_storage=x_st,
             top_width_data=twd, side_slope_data=ssd,
         )
+        # Rotating FLAT buffers (same rationale as wavefront_route_core: the
+        # concatenate-shift lowers to a full copy-through-scratch of the carry
+        # every wave, and a 2-D carry read flat forces a layout-copy besides).
+        # Wave w writes ring row ``w % R`` / hist row ``w % R_h``; a value from
+        # wave w - d lives at row ``(w - d) % R``. Unwritten rows stay zero,
+        # preserving the shift form's zero-history semantics bitwise.
+        row_len = nl + 1
+        ring_rows = D + 2
+        hist_rows = D + 1
         flat_idx = pred_idx.reshape(-1)
+        pr_row = flat_idx // row_len  # gap - 1, static per slot
+        pr_col = flat_idx - pr_row * row_len
         mask = pred_mask
         ar_b = jnp.arange(B)
 
@@ -239,8 +250,8 @@ def sharded_wavefront_route(
             xe_s = _skew_ext(xe)
             se_s = _skew_ext(se)
 
-        ring0 = jnp.zeros((D + 2, nl + 1), qp.dtype)
-        hist0 = jnp.zeros((D + 1, B), qp.dtype)
+        ring0 = jnp.zeros(ring_rows * row_len, qp.dtype)
+        hist0 = jnp.zeros(hist_rows * B, qp.dtype)
         s0 = jnp.zeros(nl, qp.dtype)
 
         def body(carry, wave_inputs):
@@ -251,19 +262,27 @@ def sharded_wavefront_route(
                 q_row, w = wave_inputs
                 xe_row = se_row = 0.0
             t_node = w - 1 - level
-            q_prev = jnp.maximum(ring[0, :nl], bounds.discharge)
+            h1 = jax.lax.rem(w - 1, ring_rows)  # ring row of wave w - 1's output
+            q_prev_row = jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:nl]
+            q_prev = jnp.maximum(q_prev_row, bounds.discharge)
             c, _, _ = celerity(q_prev, n_c, p_c, q_c, ch, bounds)
             c1, c2, c3, c4 = muskingum_coefficients(ch.length, c, ch.x_storage, dt)
 
-            g = ring.reshape(-1)[flat_idx].reshape(nl, -1)  # raw x_t[p], local preds
+            rot = h1 - pr_row  # (h1 - (gap - 1)) mod R, in two vector ops
+            rot = jnp.where(rot < 0, rot + ring_rows, rot)
+            g = ring[rot * row_len + pr_col].reshape(nl, -1)  # raw x_t[p], local preds
             x_local = (g * mask).sum(axis=1) + xe_row  # ext joins the same-t solve
             s_local = (jnp.maximum(g, bounds.discharge) * mask).sum(axis=1)
 
             # Boundary reads: edge e's source published x_t[src] gap waves before the
-            # target's wave -> hist[gap-1]. The clamped previous-timestep inflow the
-            # target needs NEXT wave is the clamp of this same read (mirroring how
-            # the local path reuses its solve gather), carried via s_state.
-            x_b = hist[bnd_gap - 1, ar_b]
+            # target's wave -> the hist row written at wave w - gap. The clamped
+            # previous-timestep inflow the target needs NEXT wave is the clamp of
+            # this same read (mirroring how the local path reuses its solve
+            # gather), carried via s_state.
+            hb1 = jax.lax.rem(w - 1, hist_rows)
+            hrot = hb1 - (bnd_gap - 1)
+            hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
+            x_b = hist[hrot * B + ar_b]
             s_b = jnp.maximum(x_b, bounds.discharge)
             own = bnd_tgt < nl
             x_bnd = (
@@ -290,9 +309,13 @@ def sharded_wavefront_route(
             v_out = jnp.where(
                 bnd_out < nl, jnp.concatenate([y, jnp.zeros(1, y.dtype)])[bnd_out], 0.0
             )
-            hist = jnp.concatenate([jax.lax.psum(v_out, axis_name)[None], hist[:-1]], 0)
-            ring = jnp.concatenate(
-                [jnp.concatenate([y, jnp.zeros(1, y.dtype)])[None], ring[:-1]], 0
+            hist = jax.lax.dynamic_update_slice(
+                hist, jax.lax.psum(v_out, axis_name), (jax.lax.rem(w, hist_rows) * B,)
+            )
+            ring = jax.lax.dynamic_update_slice(
+                ring,
+                jnp.concatenate([y, jnp.zeros(1, y.dtype)]),
+                (jax.lax.rem(w, ring_rows) * row_len,),
             )
             return (ring, hist, s_local + s_bnd), y  # RAW; clamp after un-skew
 
