@@ -36,7 +36,6 @@ from jax.sharding import Mesh
 
 from ddr_tpu.parallel.wavefront import ShardedWavefront, build_sharded_wavefront
 from ddr_tpu.routing.chunked import (
-    CHUNK_CELL_BUDGET,
     boundary_buffer_columns,
     boundary_ext_series,
     pack_level_bands,
@@ -77,17 +76,25 @@ def build_sharded_chunked(
     cols: np.ndarray,
     n: int,
     n_shards: int,
-    cell_budget: int = CHUNK_CELL_BUDGET,
+    cell_budget: int | None = None,
     level: np.ndarray | None = None,
 ) -> ShardedChunked:
     """Band the level axis with a PER-SHARD ring budget and build each band's
-    sharded-wavefront schedule over its level-sorted, shard-padded local order."""
+    sharded-wavefront schedule over its level-sorted, shard-padded local order.
+
+    ``cell_budget=None`` uses :func:`ddr_tpu.routing.chunked.auto_cell_budget`
+    (the measured speed-optimal band size; the per-shard ring is then
+    ~budget/n_shards cells, under the same 2^26-cell memory cap)."""
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     if level is None:
         level = compute_levels(rows, cols, n)
     depth = int(level.max()) if n else 0
     counts = np.bincount(level, minlength=depth + 1)
+    if cell_budget is None:
+        from ddr_tpu.routing.chunked import auto_cell_budget
+
+        cell_budget = auto_cell_budget(n, depth)
     band_ranges = pack_level_bands(counts, cell_budget, ring_cols_divisor=n_shards)
     n_bands = len(band_ranges)
 
