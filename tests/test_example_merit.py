@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import os
 import shutil
 import subprocess
 import sys
@@ -11,7 +12,16 @@ from pathlib import Path
 import numpy as np
 import pytest
 
-EXAMPLE = Path(__file__).resolve().parents[1] / "examples" / "merit_basin"
+REPO = Path(__file__).resolve().parents[1]
+EXAMPLE = REPO / "examples" / "merit_basin"
+
+# The example is copied OUT of the repo tree, so the spawned interpreter needs
+# the repo root on PYTHONPATH to import ddr_tpu (in-repo users get it from cwd
+# or an installed package; the suite must not depend on either).
+_ENV = dict(
+    os.environ,
+    PYTHONPATH=os.pathsep.join(filter(None, [str(REPO), os.environ.get("PYTHONPATH", "")])),
+)
 
 
 @pytest.fixture(scope="module")
@@ -21,7 +31,7 @@ def example_dir(tmp_path_factory):
     dst = tmp / "merit_basin"
     shutil.copytree(EXAMPLE, dst, ignore=shutil.ignore_patterns("data", "output"))
     proc = subprocess.run(
-        [sys.executable, "prepare.py"], cwd=dst, capture_output=True, text=True
+        [sys.executable, "prepare.py"], cwd=dst, capture_output=True, text=True, env=_ENV
     )
     assert proc.returncode == 0, proc.stderr
     return dst
@@ -40,7 +50,8 @@ class TestMeritExample:
 
     def test_prepare_is_idempotent(self, example_dir):
         proc = subprocess.run(
-            [sys.executable, "prepare.py"], cwd=example_dir, capture_output=True, text=True
+            [sys.executable, "prepare.py"],
+            cwd=example_dir, capture_output=True, text=True, env=_ENV,
         )
         assert proc.returncode == 0, proc.stderr
 
