@@ -114,8 +114,14 @@ class Metrics:
             self.rmse_mid[i] = _rmse(ps[i_lo:i_hi], ts[i_lo:i_hi], axis=0)
 
             if mask.sum() > 1:
-                self.corr[i] = stats.pearsonr(pred, target)[0]
-                self.corr_spearman[i] = stats.spearmanr(pred, target)[0]
+                if np.ptp(pred) == 0 or np.ptp(target) == 0:
+                    # Correlation is undefined on a constant series; scipy warns
+                    # (ConstantInputWarning) and returns nan — make the nan
+                    # contract explicit and the battery warning-free.
+                    self.corr[i] = self.corr_spearman[i] = np.nan
+                else:
+                    self.corr[i] = stats.pearsonr(pred, target)[0]
+                    self.corr_spearman[i] = stats.spearmanr(pred, target)[0]
                 pm, tm = pred.mean(), target.mean()
                 psd, tsd = pred.std(), target.std()
                 r = self.corr[i]
