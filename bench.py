@@ -121,9 +121,12 @@ def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
 
     from ddr_tpu.routing.chunked import ChunkedNetwork
     from ddr_tpu.routing.mc import route
+    from ddr_tpu.routing.stacked import StackedChunked
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
-    if isinstance(network, ChunkedNetwork):
+    if isinstance(network, StackedChunked):
+        engine = f"stacked-chunked-wavefront[{network.n_chunks}-band-scan]"
+    elif isinstance(network, ChunkedNetwork):
         engine = f"depth-chunked-wavefront[{network.n_chunks}-band]"
     elif getattr(network, "wavefront", False):
         engine = "single-ring-wavefront"

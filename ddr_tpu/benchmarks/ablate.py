@@ -1,6 +1,6 @@
 """Single-variant route() throughput ablation (one process per variant).
 
-Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect|wavefront|chunked|step} [DEPTH]``
+Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect|wavefront|chunked|stacked|step} [DEPTH]``
 Prints one JSON line {n, t_hours, schedule, depth, rts, ms_per_step, device,
 [n_chunks]}.
 
@@ -43,7 +43,7 @@ def main() -> None:
 
     extra: dict = {}
     engine = None
-    if schedule in ("chunked", "wavefront", "step"):
+    if schedule in ("chunked", "stacked", "wavefront", "step"):
         # channels/gauges via the shared builder (identical physics incl. the
         # observed-geometry overrides); build ONLY the network structure this
         # variant measures — no throwaway prepare_batch network build.
@@ -55,6 +55,12 @@ def main() -> None:
 
             network = build_chunked_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
             extra["n_chunks"] = network.n_chunks
+        elif schedule == "stacked":
+            from ddr_tpu.routing.stacked import build_stacked_chunked
+
+            network = build_stacked_chunked(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments)
+            extra["n_chunks"] = network.n_chunks
+            extra["n_cap"] = network.n_cap
         elif schedule == "wavefront":
             from ddr_tpu.routing.network import build_network
 

@@ -319,13 +319,16 @@ def build_routing_network(
     cols: np.ndarray,
     n: int,
     cell_budget: int | None = None,
-) -> RiverNetwork | ChunkedNetwork:
+):
     """Auto-select the fastest eligible topology structure for :func:`route`.
 
     Single-ring wavefront when its heuristic caps fit (the measured-fastest
-    engine at benchable depth), otherwise the depth-chunked router — deep
-    networks no longer silently fall back to the per-timestep step engine.
-    Shallow no-edge graphs keep the plain network (nothing to schedule).
+    engine at benchable depth), otherwise the STACKED depth-chunked router
+    (:mod:`ddr_tpu.routing.stacked` — one scanned band program, compile O(1)
+    in band count) — deep networks no longer silently fall back to the
+    per-timestep step engine. Shallow no-edge graphs keep the plain network
+    (nothing to schedule). An explicit ``cell_budget`` selects the unrolled
+    :class:`ChunkedNetwork` with that exact banding (the ablation/debug path).
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -333,7 +336,13 @@ def build_routing_network(
     depth = int(level.max()) if n else 0
     max_in = int(np.bincount(rows, minlength=n).max()) if rows.size else 0
     if depth > 0 and not single_ring_eligible(depth, max_in, n):
-        return build_chunked_network(rows, cols, n, cell_budget=cell_budget, level=level)
+        if cell_budget is not None:
+            return build_chunked_network(
+                rows, cols, n, cell_budget=cell_budget, level=level
+            )
+        from ddr_tpu.routing.stacked import build_stacked_chunked
+
+        return build_stacked_chunked(rows, cols, n, level=level)
     return build_network(rows, cols, n, level=level)
 
 

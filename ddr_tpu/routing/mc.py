@@ -283,13 +283,15 @@ def route(
     faster full VJP on the v5e chip; forward bitwise-unchanged (docs/tpu.md).
     """
     from ddr_tpu.routing.chunked import ChunkedNetwork, route_chunked
+    from ddr_tpu.routing.stacked import StackedChunked, route_stacked
 
-    if isinstance(network, ChunkedNetwork):
+    if isinstance(network, (ChunkedNetwork, StackedChunked)):
         if engine not in (None, "wavefront"):
             raise ValueError("a ChunkedNetwork always routes via the chunked wavefront")
         if q_prime_permuted:
             raise ValueError("q_prime_permuted is not supported on a ChunkedNetwork")
-        return route_chunked(
+        router = route_stacked if isinstance(network, StackedChunked) else route_chunked
+        return router(
             network, channels, spatial_params, q_prime, q_init=q_init,
             gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
         )

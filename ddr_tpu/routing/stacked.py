@@ -1,0 +1,460 @@
+"""Stacked depth-chunked wavefront: ONE compiled band program, scanned over bands.
+
+:mod:`ddr_tpu.routing.chunked` unrolls its band loop into the jit body, so
+compile time (and XLA program size) grows linearly with band count — measured
+~200-280s on the CPU backend at 4-8 bands and ~70s on the chip at 16. But the
+measured TPU wave-cost model (:func:`ddr_tpu.routing.chunked.auto_cell_budget`)
+wants MANY small bands — C=64 at continental scale (N~2.9M, depth~4000), where
+the unrolled form is compile-bound. This module makes the band axis a
+``lax.scan``: every band is padded to one shared static frame and the compiled
+program is a single band step, so compile cost is O(1) in band count.
+
+The shared frame (:class:`StackedChunked`, built host-side in O(E + C*K)):
+
+* a UNIFIED degree-bucket layout: per power-of-two in-degree bucket, the slot
+  count is the max across bands; every band places its (bucket, level)-sorted
+  nodes at its buckets' fronts and pads the rest with sentinel slots (gather
+  mask 0, ring sentinel column) — the same compact-gather scheme as
+  :func:`ddr_tpu.routing.network.build_network`'s wavefront tables, made
+  band-uniform;
+* one ring of ``(span_max + 2) * (n_cap + 1)`` cells (flat, rotating — the
+  profiled copy-tax fixes of :mod:`ddr_tpu.routing.wavefront` carry over);
+* the cross-band boundary buffer ``bnd (T, B_total + 1)`` is the scan CARRY:
+  each band scatters the raw series of its published sources into its columns
+  and reads its external predecessors from columns earlier bands wrote (the
+  :func:`ddr_tpu.routing.chunked.boundary_ext_series` contract, sentinel-safe).
+
+Semantics are identical to :func:`ddr_tpu.routing.chunked.route_chunked`
+(reference loop: /root/reference/src/ddr/routing/mmc.py:365-443): output[0] is
+the clamped in-band hotstart solve, step t consumes ``q_prime[t-1]``, clamping
+happens once per timestep after the full band-distributed solve.
+Differentiable end to end (scans + gathers + scatters under standard AD).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.routing.chunked import (
+    CHUNK_CELL_BUDGET,
+    _RING_COPY_BYTES_PER_S,
+    _WAVE_FIXED_S,
+    boundary_buffer_columns,
+    pack_level_bands,
+)
+from ddr_tpu.routing.network import compute_levels
+
+__all__ = [
+    "StackedChunked",
+    "auto_band_count",
+    "build_stacked_chunked",
+    "pack_level_bands_balanced",
+    "route_stacked",
+]
+
+
+def auto_band_count(
+    n: int, depth: int, t_nominal: int = 240, max_bands: int = 256
+) -> int:
+    """Speed-optimal band count from the measured TPU wave-cost model
+    (:func:`ddr_tpu.routing.chunked.auto_cell_budget`'s model, solved for C —
+    the stacked router compiles O(1) in C, so no compile-driven cap applies
+    below ``max_bands``)."""
+    if depth <= 0 or n <= 0:
+        return 1
+    best_c, best_cost = 1, float("inf")
+    c = 1
+    while c <= max_bands:
+        span = max(1, -(-depth // c))
+        nb = max(1, -(-n // c))
+        ring = (span + 1) * (nb + 1)
+        if ring <= CHUNK_CELL_BUDGET:
+            waves = c * t_nominal + depth
+            cost = waves * (_WAVE_FIXED_S + ring * 4 / _RING_COPY_BYTES_PER_S)
+            if cost < best_cost:
+                best_cost, best_c = cost, c
+        c *= 2
+    return best_c
+
+
+def pack_level_bands_balanced(
+    counts: np.ndarray, target_span: int, target_nodes: int
+) -> list[tuple[int, int]]:
+    """Greedy banding bounded in BOTH dimensions: cut when a band would exceed
+    ``target_span`` levels or ``target_nodes`` nodes. Bounds the stacked frame
+    (``span_max``, ``n_cap``) to the targets plus one level's width, so
+    sentinel padding stays proportional to level-width variance instead of
+    band-size variance. A single over-wide level still forms its own band."""
+    depth = len(counts) - 1
+    bands: list[tuple[int, int]] = []
+    s, acc = 0, 0
+    for L in range(depth + 1):
+        if L > s and (L - s >= target_span or acc + int(counts[L]) > target_nodes):
+            bands.append((s, L))
+            s, acc = L, 0
+        acc += int(counts[L])
+    bands.append((s, depth + 1))
+    return bands
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class StackedChunked:
+    """Band-uniform stacked topology. All per-band arrays have a leading C axis.
+
+    Sentinels: node slots use ``n_cap`` (inputs are padded with one extra
+    column), boundary columns use ``n_boundary`` (the buffer's always-unread
+    scratch column), gather slots use the ring's always-zero sentinel cell.
+    """
+
+    gidx: jnp.ndarray  # (C, n_cap) original node id per slot, sentinel n
+    level: jnp.ndarray  # (C, n_cap) band-LOCAL level per slot, 0 on sentinels
+    wf_row: jnp.ndarray  # (C, E_cap) ring row distance (gap - 1), 0 on pads
+    wf_col: jnp.ndarray  # (C, E_cap) ring col (source slot), n_cap on pads
+    wf_mask: jnp.ndarray  # (C, E_cap) 1.0 on real gather slots
+    ext_cols: jnp.ndarray  # (C, X_cap) boundary col of each external edge
+    ext_tgt: jnp.ndarray  # (C, X_cap) target slot, n_cap on pads
+    pub_src: jnp.ndarray  # (C, P_cap) published source slot, n_cap on pads
+    pub_col: jnp.ndarray  # (C, P_cap) boundary col to write, n_boundary on pads
+    out_map: jnp.ndarray  # (N,) flat (c * n_cap + slot) of each original node
+    buckets: tuple = dataclasses.field(metadata={"static": True})
+    n: int = dataclasses.field(metadata={"static": True})
+    depth: int = dataclasses.field(metadata={"static": True})
+    span_max: int = dataclasses.field(metadata={"static": True})
+    n_cap: int = dataclasses.field(metadata={"static": True})
+    n_edges: int = dataclasses.field(metadata={"static": True})
+    n_boundary: int = dataclasses.field(metadata={"static": True})
+    n_chunks: int = dataclasses.field(metadata={"static": True})
+
+
+def build_stacked_chunked(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    cell_budget: int | None = None,
+    level: np.ndarray | None = None,
+) -> StackedChunked:
+    """Band the level axis (same packer/budget as the unrolled router) and build
+    the band-uniform stacked frame. O(E) host work beyond the Kahn layering."""
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if level is None:
+        level = compute_levels(rows, cols, n)
+    depth = int(level.max()) if n else 0
+    counts = np.bincount(level, minlength=depth + 1)
+    if cell_budget is None:
+        c_star = auto_band_count(n, depth)
+        bands = pack_level_bands_balanced(
+            counts, max(1, -(-depth // c_star)), max(1, -(-n // c_star))
+        )
+    else:
+        bands = pack_level_bands(counts, cell_budget)
+    C = len(bands)
+    band_lo = np.array([lo for lo, _ in bands], dtype=np.int64)
+    span_max = max(hi - lo for lo, hi in bands)
+
+    band_of_level = np.empty(depth + 1, dtype=np.int64)
+    for ci, (lo, hi) in enumerate(bands):
+        band_of_level[lo:hi] = ci
+    band = band_of_level[level]
+
+    tgt_band = band[rows]
+    is_ext = band[cols] != tgt_band  # levels rise along edges => src band <= tgt band
+    loc_rows, loc_cols = rows[~is_ext], cols[~is_ext]
+    ext_src_o, ext_tgt_o = cols[is_ext], rows[is_ext]
+
+    # --- degree-rank slot frame (local in-band edges only) ---
+    # Each band's nodes fill slots by in-degree-DESCENDING rank, so
+    # n_cap = max band size (no cross-band bucket inflation) and the static
+    # per-slot gather width is the cross-band max of each rank's power-of-two
+    # degree bucket — a non-increasing profile whose equal-width runs form the
+    # static reduction buckets.
+    deg = np.zeros(n, dtype=np.int64)
+    np.add.at(deg, loc_rows, 1)
+    width_of = np.zeros(n, dtype=np.int64)
+    nz = deg > 0
+    width_of[nz] = 1 << np.ceil(np.log2(deg[nz])).astype(np.int64)
+    width_of[deg == 1] = 1
+
+    n_band = np.bincount(band, minlength=C) if n else np.zeros(C, dtype=np.int64)
+    n_cap = int(n_band.max()) if C else 0
+    order = np.lexsort((np.arange(n), level, -width_of, band))
+    band_sorted = band[order]
+    first = np.searchsorted(band_sorted, np.arange(C))
+    rank = np.arange(n) - first[band_sorted]
+    slot = np.empty(n, dtype=np.int64)
+    slot[order] = rank
+
+    wp = np.zeros(n_cap, dtype=np.int64)  # per-slot width profile (non-increasing)
+    np.maximum.at(wp, rank, width_of[order])
+    e_off = np.concatenate([[0], np.cumsum(wp)])
+    e_cap = max(1, int(e_off[-1]))
+    change = np.flatnonzero(np.diff(wp) != 0) + 1
+    starts_r = np.concatenate([[0], change])
+    ends_r = np.concatenate([change, [n_cap]])
+    buckets = tuple(
+        (int(s), int(e), int(wp[s])) for s, e in zip(starts_r, ends_r)
+    )
+
+    gidx = np.full((C, n_cap), n, dtype=np.int64)
+    gidx[band, slot] = np.arange(n)
+    level_s = np.zeros((C, n_cap), dtype=np.int64)
+    level_s[band, slot] = level - band_lo[band]
+
+    # --- local-edge gather table in the unified frame ---
+    row_len = n_cap + 1
+    wf_row = np.zeros((C, e_cap), dtype=np.int64)
+    wf_col = np.full((C, e_cap), n_cap, dtype=np.int64)  # ring sentinel col
+    wf_mask = np.zeros((C, e_cap), dtype=np.float32)
+    if loc_rows.size:
+        ekey = band[loc_rows] * np.int64(n_cap) + slot[loc_rows]
+        es = np.argsort(ekey, kind="stable")
+        ek = ekey[es]
+        seq = np.arange(len(ek)) - np.searchsorted(ek, ek)
+        t_node = loc_rows[es]
+        base = e_off[slot[t_node]]
+        wf_row[band[t_node], base + seq] = level[t_node] - level[loc_cols[es]] - 1
+        wf_col[band[t_node], base + seq] = slot[loc_cols[es]]
+        wf_mask[band[t_node], base + seq] = 1.0
+
+    # --- boundary buffer wiring (shared column layout) ---
+    buf_src, col_of_src, b_starts = boundary_buffer_columns(ext_src_o, band, n, C)
+    B_total = len(buf_src)
+    p_cap = max(1, int(np.max(b_starts[1:] - b_starts[:-1])) if C else 1)
+    pub_src = np.full((C, p_cap), n_cap, dtype=np.int64)
+    pub_col = np.full((C, p_cap), B_total, dtype=np.int64)
+    for ci in range(C):
+        pub = buf_src[b_starts[ci] : b_starts[ci + 1]]
+        pub_src[ci, : len(pub)] = slot[pub]
+        pub_col[ci, : len(pub)] = np.arange(b_starts[ci], b_starts[ci + 1])
+
+    x_cnt = np.bincount(band[ext_tgt_o], minlength=C) if ext_tgt_o.size else np.zeros(C, int)
+    x_cap = max(1, int(x_cnt.max()) if C else 1)
+    ext_cols = np.full((C, x_cap), B_total, dtype=np.int64)
+    ext_tgt = np.full((C, x_cap), n_cap, dtype=np.int64)
+    if ext_tgt_o.size:
+        xb = band[ext_tgt_o]
+        xs_ = np.argsort(xb, kind="stable")
+        xseq = np.arange(len(xs_)) - np.searchsorted(xb[xs_], xb[xs_])
+        ext_cols[xb[xs_], xseq] = col_of_src[ext_src_o[xs_]]
+        ext_tgt[xb[xs_], xseq] = slot[ext_tgt_o[xs_]]
+
+    out_map = band * np.int64(n_cap) + slot
+
+    if (span_max + 2) * row_len >= 2**31:
+        raise ValueError(
+            f"stacked ring overflows int32 (span_max={span_max}, n_cap={n_cap}); "
+            "lower the cell budget"
+        )
+
+    return StackedChunked(
+        gidx=jnp.asarray(gidx, jnp.int32),
+        level=jnp.asarray(level_s, jnp.int32),
+        wf_row=jnp.asarray(wf_row, jnp.int32),
+        wf_col=jnp.asarray(wf_col, jnp.int32),
+        wf_mask=jnp.asarray(wf_mask, jnp.float32),
+        ext_cols=jnp.asarray(ext_cols, jnp.int32),
+        ext_tgt=jnp.asarray(ext_tgt, jnp.int32),
+        pub_src=jnp.asarray(pub_src, jnp.int32),
+        pub_col=jnp.asarray(pub_col, jnp.int32),
+        out_map=jnp.asarray(out_map, jnp.int32),
+        buckets=buckets,
+        n=int(n),
+        depth=depth,
+        span_max=int(span_max),
+        n_cap=n_cap,
+        n_edges=int(rows.size),
+        n_boundary=int(B_total),
+        n_chunks=C,
+    )
+
+
+def _skew_cols(src: jnp.ndarray, starts: jnp.ndarray, width: int) -> jnp.ndarray:
+    """(R, m) -> (width, m): column j yields ``src[starts[j] : starts[j]+width, j]``
+    (one vmapped dynamic-slice per column; jax clamps out-of-range starts)."""
+    sl = jax.vmap(lambda col, s0: jax.lax.dynamic_slice(col, (s0,), (width,)))(
+        src.T, starts
+    )
+    return sl.T
+
+
+def route_stacked(
+    network: StackedChunked,
+    channels: Any,
+    spatial_params: dict[str, Any],
+    q_prime: jnp.ndarray,
+    q_init: jnp.ndarray | None = None,
+    gauges: Any | None = None,
+    bounds: Any = None,
+    dt: float = 3600.0,
+    remat_physics: bool = True,
+):
+    """Route ``(T, N)`` inflows with one scanned band program; same contract as
+    :func:`ddr_tpu.routing.mc.route`. All inputs in ORIGINAL node order."""
+    from ddr_tpu.routing.mc import (
+        Bounds,
+        RouteResult,
+        celerity,
+        muskingum_coefficients,
+    )
+
+    if bounds is None:
+        bounds = Bounds()
+    T = q_prime.shape[0]
+    lb = bounds.discharge
+    C, n_cap = network.n_chunks, network.n_cap
+    span = network.span_max
+    row_len = n_cap + 1
+    ring_rows = span + 2
+    n_waves = T + span
+    B = network.n_boundary
+    buckets = network.buckets
+
+    g = network.gidx  # (C, n_cap), sentinel n
+    pad0 = lambda a: jnp.concatenate([a, jnp.zeros(1, a.dtype)])  # noqa: E731
+    pad1 = lambda a: jnp.concatenate([a, jnp.ones(1, a.dtype)])  # noqa: E731
+
+    # Stacked per-band inputs (sentinel slots read benign pad values; their
+    # outputs are never gathered by real slots, published, or selected).
+    length_s = pad1(channels.length)[g]
+    slope_s = pad1(channels.slope)[g]
+    xst_s = pad0(channels.x_storage)[g]
+    nanrow = jnp.full(network.n + 1, jnp.nan, length_s.dtype)
+    twd_s = nanrow[g] if channels.top_width_data is None else pad0(channels.top_width_data)[g]
+    ssd_s = nanrow[g] if channels.side_slope_data is None else pad0(channels.side_slope_data)[g]
+    nm_s = pad1(spatial_params["n"])[g]
+    qs_s = pad1(spatial_params["q_spatial"])[g]
+    ps_s = pad1(spatial_params["p_spatial"])[g]
+    qp_s = jnp.moveaxis(
+        jnp.concatenate([q_prime, jnp.zeros((T, 1), q_prime.dtype)], axis=1)[:, g], 1, 0
+    )  # (C, T, n_cap)
+    qi_s = None if q_init is None else pad0(q_init)[g]
+
+    def reduce_buckets(gathered: jnp.ndarray, mask_row: jnp.ndarray, clamped: bool):
+        # Buckets cover [0, n_cap) in slot order (width non-increasing; a
+        # trailing width-0 run holds the in-band-degree-0 slots).
+        parts = []
+        off = 0
+        for node_start, node_end, width in buckets:
+            cnt_nodes = node_end - node_start
+            if width == 0:
+                parts.append(jnp.zeros(cnt_nodes, gathered.dtype))
+                continue
+            cnt = cnt_nodes * width
+            blk = gathered[off : off + cnt].reshape(cnt_nodes, width)
+            msk = mask_row[off : off + cnt].reshape(blk.shape)
+            if clamped:
+                blk = jnp.maximum(blk, lb)
+            parts.append((blk * msk).sum(axis=1))
+            off += cnt
+        return jnp.concatenate(parts) if parts else jnp.zeros(n_cap, gathered.dtype)
+
+    def physics_of(q_prev, nm, ps_, qs_, ch):
+        c = celerity(q_prev, nm, ps_, qs_, ch, bounds)[0]
+        return muskingum_coefficients(ch.length, c, ch.x_storage, dt)
+
+    def band_step(bnd, band_in):
+        from ddr_tpu.routing.mc import ChannelState
+
+        (lvl, wf_row, wf_col, wf_mask, e_cols, e_tgt, p_src, p_col,
+         ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c) = band_in
+        ch = ChannelState(length=ln, slope=sl, x_storage=xs_,
+                          top_width_data=twd, side_slope_data=ssd)
+
+        # External-predecessor series from the boundary carry (sentinel edge
+        # slots read the scratch column and scatter into the dropped slot).
+        gath = bnd[:, e_cols]  # (T, X_cap)
+        x_ext = jnp.zeros((T, row_len), bnd.dtype).at[:, e_tgt].add(gath)[:, :n_cap]
+        prev = jnp.concatenate([jnp.zeros((1, B + 1), bnd.dtype), bnd[:-1]], axis=0)
+        s_ext = (
+            jnp.zeros((T, row_len), bnd.dtype)
+            .at[:, e_tgt].add(jnp.maximum(prev[:, e_cols], lb))[:, :n_cap]
+        )
+
+        # Input skew (wavefront_route_core's layout, span_max frame).
+        right_edge = qp_c[T - 2 : T - 1] if T >= 2 else qp_c[:1]
+        padded = jnp.concatenate(
+            [
+                jnp.broadcast_to(qp_c[0], (span + 1, n_cap)),
+                qp_c[: T - 1],
+                jnp.broadcast_to(right_edge[0], (span, n_cap)),
+            ],
+            axis=0,
+        )
+        qs_sk = _skew_cols(padded, span - lvl, n_waves)
+        zpad = jnp.zeros((span, n_cap), bnd.dtype)
+        xe_sk = _skew_cols(jnp.concatenate([zpad, x_ext, zpad], 0), span - lvl, n_waves)
+        se_sk = _skew_cols(jnp.concatenate([zpad, s_ext, zpad], 0), span - lvl, n_waves)
+
+        def physics(q_prev):
+            return physics_of(q_prev, nm, psp, qsp, ch)
+
+        if remat_physics:
+            physics = jax.checkpoint(physics)
+
+        ring0 = jnp.zeros(ring_rows * row_len, qp_c.dtype)
+        s0 = jnp.zeros(n_cap, qp_c.dtype)
+
+        def body(carry, wave_inputs):
+            ring, s_state = carry
+            q_row, xe_row, se_row, w = wave_inputs
+            t_node = w - 1 - lvl
+            h1 = jax.lax.rem(w - 1, ring_rows)
+            q_prev = jnp.maximum(
+                jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+            )
+            c1, c2, c3, c4 = physics(q_prev)
+            rot = h1 - wf_row
+            rot = jnp.where(rot < 0, rot + ring_rows, rot)
+            gathered = ring[rot * row_len + wf_col]
+            x_pred = reduce_buckets(gathered, wf_mask, clamped=False) + xe_row
+            s_next = reduce_buckets(gathered, wf_mask, clamped=True)
+
+            b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
+            is_hot = t_node == 0
+            b = jnp.where(is_hot, q_row, b_step)
+            c1_eff = jnp.where(is_hot, 1.0, c1)
+            y = b + c1_eff * x_pred
+            if qi_s is not None:
+                y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
+            ok = (t_node >= 0) & (t_node <= T - 1)
+            y = jnp.where(ok, y, 0.0)
+            h = jax.lax.rem(w, ring_rows)
+            ring = jax.lax.dynamic_update_slice(
+                ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]), (h * row_len,)
+            )
+            return (ring, s_next), y
+
+        waves = jnp.arange(1, n_waves + 1)
+        (_, _), ys = jax.lax.scan(body, (ring0, s0), (qs_sk, xe_sk, se_sk, waves))
+
+        raw = _skew_cols(ys, lvl, T)  # (T, n_cap), un-skewed
+        # Publish raw series of this band's boundary sources (sentinel pads
+        # write the scratch column from the always-zero pad source column).
+        raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), raw.dtype)], axis=1)
+        bnd = bnd.at[:, p_col].set(raw_pad[:, p_src])
+        return bnd, raw
+
+    band_xs = (
+        network.level, network.wf_row, network.wf_col, network.wf_mask,
+        network.ext_cols, network.ext_tgt, network.pub_src, network.pub_col,
+        length_s, slope_s, xst_s, twd_s, ssd_s, nm_s, qs_s, ps_s, qp_s,
+        qi_s if qi_s is not None else jnp.zeros((C, n_cap), q_prime.dtype),
+    )
+    bnd0 = jnp.zeros((T, B + 1), q_prime.dtype)
+    _, raw_all = jax.lax.scan(band_step, bnd0, band_xs)  # (C, T, n_cap)
+
+    runoff_all = jnp.maximum(raw_all, lb)
+    flat = jnp.moveaxis(runoff_all, 0, 1).reshape(T, C * n_cap)
+    final = flat[-1, network.out_map]
+    if gauges is not None:
+        mapped = dataclasses.replace(gauges, flat_idx=network.out_map[gauges.flat_idx])
+        runoff = jax.vmap(mapped.aggregate)(flat)
+    else:
+        runoff = flat[:, network.out_map]
+    return RouteResult(runoff=runoff, final_discharge=final)

@@ -119,8 +119,14 @@ def test_chunked_deep_chain_worst_case():
 
 
 def test_auto_selection_deep_vs_shallow():
+    from ddr_tpu.routing.stacked import StackedChunked
+
     rows, cols = make_deep_network(8000, 1500, seed=0)  # depth > single-ring cap
-    assert isinstance(build_routing_network(rows, cols, 8000), ChunkedNetwork)
+    assert isinstance(build_routing_network(rows, cols, 8000), StackedChunked)
+    # an explicit budget keeps the unrolled chunked router (ablation/debug path)
+    assert isinstance(
+        build_routing_network(rows, cols, 8000, cell_budget=100_000), ChunkedNetwork
+    )
     rows, cols = make_deep_network(2000, 200, seed=0)
     net = build_routing_network(rows, cols, 2000)
     assert isinstance(net, RiverNetwork) and net.wavefront
@@ -223,8 +229,10 @@ def test_high_in_degree_confluence_routes_via_chunked():
     level = compute_levels(rows, cols, n)
     assert int(level.max()) == chain <= WAVEFRONT_MAX_DEPTH  # depth alone stays single-ring
     assert n_up > WAVEFRONT_MAX_IN_DEGREE  # the load-bearing trigger
+    from ddr_tpu.routing.stacked import StackedChunked
+
     net = build_routing_network(rows, cols, n)
-    assert isinstance(net, ChunkedNetwork)
+    assert isinstance(net, StackedChunked)
 
     channels, params, qp = _state(n, 6, seed=0)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
