@@ -93,6 +93,12 @@ def measure_engine_errors(
     out[f"chunked-f32[{cn.n_chunks}]"] = np.asarray(
         route(cn, channels(jnp.float32), params(jnp.float32), qp32).runoff
     )
+    from ddr_tpu.routing.stacked import build_stacked_chunked
+
+    sn = build_stacked_chunked(rows, cols, n)
+    out[f"stacked-f32[{sn.n_chunks}]"] = np.asarray(
+        route(sn, channels(jnp.float32), params(jnp.float32), qp32).runoff
+    )
 
     return {
         k: (float(np.max(np.abs(v - oracle) / (np.abs(oracle) + 1e-9))),

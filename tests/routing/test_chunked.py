@@ -187,6 +187,18 @@ def test_conus_scale_preprocessing_stays_linear():
     # Generous wall guard (shared CI boxes): the 2.9M build measured ~4s alone.
     assert elapsed < 120, f"host preprocessing took {elapsed:.0f}s — no longer O(E)?"
 
+    # The stacked frame at the same scale: vectorized build, bounded padding.
+    from ddr_tpu.routing.stacked import build_stacked_chunked
+
+    t0 = time.time()
+    sn = build_stacked_chunked(rows, cols, n, level=level)
+    stacked_s = time.time() - t0
+    n_real = int((np.asarray(sn.gidx) < n).sum())
+    assert n_real == n  # every node exactly one slot
+    assert sn.n_chunks * sn.n_cap <= 2 * n + sn.n_chunks * depth  # padding bounded
+    assert (sn.span_max + 2) * (sn.n_cap + 1) < 2**31
+    assert stacked_s < 120, f"stacked build took {stacked_s:.0f}s — no longer O(E)?"
+
 
 def test_chunk_local_levels_bounded_by_band_span():
     """Local (band-subgraph) depth never exceeds the global span of its band."""
