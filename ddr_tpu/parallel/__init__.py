@@ -30,6 +30,11 @@ from ddr_tpu.parallel.chunked import (
     build_sharded_chunked,
     route_chunked_sharded,
 )
+from ddr_tpu.parallel.stacked import (
+    StackedSharded,
+    build_stacked_sharded,
+    route_stacked_sharded,
+)
 
 __all__ = [
     "ShardedWavefront",
@@ -38,6 +43,9 @@ __all__ = [
     "ShardedChunked",
     "build_sharded_chunked",
     "route_chunked_sharded",
+    "StackedSharded",
+    "build_stacked_sharded",
+    "route_stacked_sharded",
     "PipelineSchedule",
     "ReachPartition",
     "build_pipeline_schedule",

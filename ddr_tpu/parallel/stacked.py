@@ -1,0 +1,464 @@
+"""Stacked sharded depth-chunked routing: multi-chip continental depth with
+ONE compiled band program.
+
+:func:`ddr_tpu.parallel.chunked.route_chunked_sharded` unrolls its band loop —
+each band a separate sharded-wavefront program — so compile time grows linearly
+with band count, exactly where the measured wave-cost model wants many small
+bands (161 balanced bands at the 2.9M-reach global-MERIT shape). This module is
+the multi-chip analog of :mod:`ddr_tpu.routing.stacked`: every band is padded
+into one shared static frame, and a single ``shard_map`` body runs an outer
+``lax.scan`` over bands whose step is the (flat, rotating-ring) sharded
+wavefront:
+
+* within a band, nodes sort by (global level, id) and split into S contiguous
+  shard blocks, so intra-band cross-shard edges always point to a HIGHER shard
+  (the one-directional property every explicit-collective router here relies
+  on); within a block, slots are degree-rank ordered (the stacked frame's
+  unified width profile, max'd over bands AND shards);
+* intra-band cross-shard edges ride the sharded wavefront's per-wave boundary
+  history: ONE ``psum`` per wave over a (B_cap,) vector;
+* cross-BAND dependencies ride a REPLICATED boundary buffer ``bnd
+  (T, B_total + 1)`` carried by the band scan: after each band, the raw series
+  of its published sources is ``psum``-assembled once and written into the
+  band's columns (the :func:`ddr_tpu.routing.chunked.boundary_ext_series`
+  contract, sentinel-safe).
+
+Differentiable end to end; semantics match :func:`ddr_tpu.routing.mc.route`
+(reference loop: /root/reference/src/ddr/routing/mmc.py:365-443).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddr_tpu.routing.chunked import boundary_buffer_columns
+from ddr_tpu.routing.network import compute_levels
+from ddr_tpu.routing.stacked import auto_band_count, pack_level_bands_balanced
+
+__all__ = ["StackedSharded", "build_stacked_sharded", "route_stacked_sharded"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class StackedSharded:
+    """Band-and-shard-uniform stacked frame. Sharded arrays lead with S; band
+    arrays lead with C. Sentinels: local slots use ``n_cap_s``, boundary-buffer
+    columns use ``n_boundary``, gather slots use the ring's zero sentinel."""
+
+    gidx: jnp.ndarray  # (S, C, n_cap_s) original id, sentinel n
+    level: jnp.ndarray  # (S, C, n_cap_s) band-local level, 0 on sentinels
+    wf_row: jnp.ndarray  # (S, C, E_cap_s) ring row distance (gap - 1)
+    wf_col: jnp.ndarray  # (S, C, E_cap_s) ring col (local src slot), sentinel n_cap_s
+    wf_mask: jnp.ndarray  # (S, C, E_cap_s)
+    hb_out: jnp.ndarray  # (S, C, B_cap) local src slot if owned else n_cap_s
+    hb_tgt: jnp.ndarray  # (S, C, B_cap) local tgt slot if owned else n_cap_s
+    hb_gap: jnp.ndarray  # (C, B_cap) replicated level gap (1 on pads)
+    ext_cols: jnp.ndarray  # (C, X_cap) replicated bnd column (n_boundary on pads)
+    ext_tgt: jnp.ndarray  # (S, C, X_cap) local tgt slot if owned else n_cap_s
+    pub_src: jnp.ndarray  # (S, C, P_cap) local src slot if owned else n_cap_s
+    pub_col: jnp.ndarray  # (C, P_cap) replicated bnd column (n_boundary on pads)
+    out_map: jnp.ndarray  # (N,) flat c * (S * n_cap_s) + s * n_cap_s + slot
+    buckets: tuple = dataclasses.field(metadata={"static": True})
+    n: int = dataclasses.field(metadata={"static": True})
+    depth: int = dataclasses.field(metadata={"static": True})
+    span_max: int = dataclasses.field(metadata={"static": True})
+    n_cap_s: int = dataclasses.field(metadata={"static": True})
+    n_boundary: int = dataclasses.field(metadata={"static": True})
+    n_bands: int = dataclasses.field(metadata={"static": True})
+    n_shards: int = dataclasses.field(metadata={"static": True})
+
+
+def build_stacked_sharded(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    n_shards: int,
+    level: np.ndarray | None = None,
+) -> StackedSharded:
+    """Build the frame from a COO adjacency in ANY topological order (banding
+    and shard blocks are derived from levels, not from a pre-partitioned id
+    space). O(E) host work beyond the Kahn layering."""
+    S = n_shards
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if level is None:
+        level = compute_levels(rows, cols, n)
+    depth = int(level.max()) if n else 0
+    counts = np.bincount(level, minlength=depth + 1)
+    c_star = auto_band_count(n, depth)
+    bands = pack_level_bands_balanced(
+        counts, max(1, -(-depth // c_star)), max(1, -(-n // c_star))
+    )
+    C = len(bands)
+    band_lo = np.array([lo for lo, _ in bands], dtype=np.int64)
+    span_max = max(hi - lo for lo, hi in bands)
+
+    band_of_level = np.empty(depth + 1, dtype=np.int64)
+    for ci, (lo, hi) in enumerate(bands):
+        band_of_level[lo:hi] = ci
+    band = band_of_level[level]
+    n_band = np.bincount(band, minlength=C)
+
+    # shard blocks: contiguous (level, id) ranks within the band
+    order_lv = np.lexsort((np.arange(n), level, band))
+    first_b = np.searchsorted(band[order_lv], np.arange(C))
+    rank_lv = np.arange(n) - first_b[band[order_lv]]
+    shard = np.empty(n, dtype=np.int64)
+    blk = np.maximum(1, -(-n_band // S))  # per-band block size
+    shard[order_lv] = np.minimum(rank_lv // blk[band[order_lv]], S - 1)
+
+    # edge classes
+    tgt_band = band[rows]
+    is_ext = band[cols] != tgt_band
+    l_rows, l_cols = rows[~is_ext], cols[~is_ext]
+    same_shard = shard[l_rows] == shard[l_cols]
+    if (shard[l_cols] > shard[l_rows]).any():
+        raise AssertionError("intra-band edge points to a lower shard")
+    g_rows, g_cols = l_rows[same_shard], l_cols[same_shard]  # local gather edges
+    h_rows, h_cols = l_rows[~same_shard], l_cols[~same_shard]  # hist edges
+    ext_src_o, ext_tgt_o = cols[is_ext], rows[is_ext]
+
+    # degree-rank slot frame within each (band, shard) group
+    deg = np.zeros(n, dtype=np.int64)
+    np.add.at(deg, g_rows, 1)
+    width_of = np.zeros(n, dtype=np.int64)
+    nzd = deg > 0
+    width_of[nzd] = 1 << np.ceil(np.log2(deg[nzd])).astype(np.int64)
+    width_of[deg == 1] = 1
+
+    grp = band * S + shard  # (band, shard) group id
+    order = np.lexsort((np.arange(n), level, -width_of, grp))
+    grp_sorted = grp[order]
+    first_g = np.searchsorted(grp_sorted, grp_sorted)
+    rank = np.arange(n) - first_g
+    slot = np.empty(n, dtype=np.int64)
+    slot[order] = rank
+    n_cap_s = int(rank.max()) + 1 if n else 1
+
+    wp = np.zeros(n_cap_s, dtype=np.int64)
+    np.maximum.at(wp, rank, width_of[order])
+    e_off = np.concatenate([[0], np.cumsum(wp)])
+    e_cap = max(1, int(e_off[-1]))
+    change = np.flatnonzero(np.diff(wp) != 0) + 1
+    starts_r = np.concatenate([[0], change])
+    ends_r = np.concatenate([change, [n_cap_s]])
+    buckets = tuple((int(s), int(e), int(wp[s])) for s, e in zip(starts_r, ends_r))
+
+    gidx = np.full((S, C, n_cap_s), n, dtype=np.int64)
+    gidx[shard, band, slot] = np.arange(n)
+    level_s = np.zeros((S, C, n_cap_s), dtype=np.int64)
+    level_s[shard, band, slot] = level - band_lo[band]
+
+    # local gather tables
+    row_len = n_cap_s + 1
+    wf_row = np.zeros((S, C, e_cap), dtype=np.int64)
+    wf_col = np.full((S, C, e_cap), n_cap_s, dtype=np.int64)
+    wf_mask = np.zeros((S, C, e_cap), dtype=np.float32)
+    if g_rows.size:
+        ekey = grp[g_rows] * np.int64(n_cap_s) + slot[g_rows]
+        es = np.argsort(ekey, kind="stable")
+        ek = ekey[es]
+        seq = np.arange(len(ek)) - np.searchsorted(ek, ek)
+        t_node = g_rows[es]
+        base = e_off[slot[t_node]]
+        wf_row[shard[t_node], band[t_node], base + seq] = (
+            level[t_node] - level[g_cols[es]] - 1
+        )
+        wf_col[shard[t_node], band[t_node], base + seq] = slot[g_cols[es]]
+        wf_mask[shard[t_node], band[t_node], base + seq] = 1.0
+
+    # intra-band cross-shard (hist) tables
+    hb_cnt = np.bincount(band[h_rows], minlength=C) if h_rows.size else np.zeros(C, int)
+    B_cap = max(1, int(hb_cnt.max()) if C else 1)
+    hb_out = np.full((S, C, B_cap), n_cap_s, dtype=np.int64)
+    hb_tgt = np.full((S, C, B_cap), n_cap_s, dtype=np.int64)
+    hb_gap = np.ones((C, B_cap), dtype=np.int64)
+    if h_rows.size:
+        hb = band[h_rows]
+        hs = np.argsort(hb, kind="stable")
+        hseq = np.arange(len(hs)) - np.searchsorted(hb[hs], hb[hs])
+        hr, hc = h_rows[hs], h_cols[hs]
+        hb_out[shard[hc], hb[hs], hseq] = slot[hc]
+        hb_tgt[shard[hr], hb[hs], hseq] = slot[hr]
+        hb_gap[hb[hs], hseq] = level[hr] - level[hc]
+
+    # cross-band boundary buffer wiring
+    buf_src, col_of_src, b_starts = boundary_buffer_columns(ext_src_o, band, n, C)
+    B_total = len(buf_src)
+    p_cap = max(1, int(np.max(b_starts[1:] - b_starts[:-1])) if C else 1)
+    pub_src = np.full((S, C, p_cap), n_cap_s, dtype=np.int64)
+    pub_col = np.full((C, p_cap), B_total, dtype=np.int64)
+    for ci in range(C):
+        pub = buf_src[b_starts[ci] : b_starts[ci + 1]]
+        pub_src[shard[pub], ci, np.arange(len(pub))] = slot[pub]
+        pub_col[ci, : len(pub)] = np.arange(b_starts[ci], b_starts[ci + 1])
+
+    x_cnt = np.bincount(band[ext_tgt_o], minlength=C) if ext_tgt_o.size else np.zeros(C, int)
+    x_cap = max(1, int(x_cnt.max()) if C else 1)
+    ext_cols = np.full((C, x_cap), B_total, dtype=np.int64)
+    ext_tgt = np.full((S, C, x_cap), n_cap_s, dtype=np.int64)
+    if ext_tgt_o.size:
+        xb = band[ext_tgt_o]
+        xs_ = np.argsort(xb, kind="stable")
+        xseq = np.arange(len(xs_)) - np.searchsorted(xb[xs_], xb[xs_])
+        ext_cols[xb[xs_], xseq] = col_of_src[ext_src_o[xs_]]
+        ext_tgt[shard[ext_tgt_o[xs_]], xb[xs_], xseq] = slot[ext_tgt_o[xs_]]
+
+    out_map = band * np.int64(S * n_cap_s) + shard * np.int64(n_cap_s) + slot
+
+    if (span_max + 2) * row_len >= 2**31:
+        raise ValueError("stacked-sharded ring overflows int32; raise n_shards")
+
+    return StackedSharded(
+        gidx=jnp.asarray(gidx, jnp.int32),
+        level=jnp.asarray(level_s, jnp.int32),
+        wf_row=jnp.asarray(wf_row, jnp.int32),
+        wf_col=jnp.asarray(wf_col, jnp.int32),
+        wf_mask=jnp.asarray(wf_mask, jnp.float32),
+        hb_out=jnp.asarray(hb_out, jnp.int32),
+        hb_tgt=jnp.asarray(hb_tgt, jnp.int32),
+        hb_gap=jnp.asarray(hb_gap, jnp.int32),
+        ext_cols=jnp.asarray(ext_cols, jnp.int32),
+        ext_tgt=jnp.asarray(ext_tgt, jnp.int32),
+        pub_src=jnp.asarray(pub_src, jnp.int32),
+        pub_col=jnp.asarray(pub_col, jnp.int32),
+        out_map=jnp.asarray(out_map, jnp.int32),
+        buckets=buckets,
+        n=int(n),
+        depth=depth,
+        span_max=int(span_max),
+        n_cap_s=n_cap_s,
+        n_boundary=int(B_total),
+        n_bands=C,
+        n_shards=S,
+    )
+
+
+def route_stacked_sharded(
+    mesh: Mesh,
+    layout: StackedSharded,
+    channels: Any,
+    spatial_params: dict[str, Any],
+    q_prime: jnp.ndarray,
+    q_init: jnp.ndarray | None = None,
+    bounds: Any = None,
+    dt: float = 3600.0,
+    axis_name: str = "reach",
+    remat_physics: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Route ``(T, N)`` inflows (ORIGINAL node order) over the mesh with one
+    scanned band program. Returns ``(runoff (T, N), final (N,))`` in original
+    order. Differentiable end to end."""
+    from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
+
+    if bounds is None:
+        bounds = Bounds()
+    T = q_prime.shape[0]
+    lb = bounds.discharge
+    S, C = layout.n_shards, layout.n_bands
+    n_cap = layout.n_cap_s
+    span = layout.span_max
+    row_len = n_cap + 1
+    ring_rows = span + 2
+    hist_rows = span + 1
+    n_waves = T + span
+    B = layout.n_boundary
+    B_cap = layout.hb_gap.shape[1]
+    buckets = layout.buckets
+    has_init = q_init is not None
+
+    g = layout.gidx  # (S, C, n_cap)
+    pad0 = lambda a: jnp.concatenate([a, jnp.zeros(1, a.dtype)])  # noqa: E731
+    pad1 = lambda a: jnp.concatenate([a, jnp.ones(1, a.dtype)])  # noqa: E731
+    length_s = pad1(channels.length)[g]
+    slope_s = pad1(channels.slope)[g]
+    xst_s = pad0(channels.x_storage)[g]
+    nanrow = jnp.full(layout.n + 1, jnp.nan, length_s.dtype)
+    twd_s = nanrow[g] if channels.top_width_data is None else pad0(channels.top_width_data)[g]
+    ssd_s = nanrow[g] if channels.side_slope_data is None else pad0(channels.side_slope_data)[g]
+    nm_s = pad1(spatial_params["n"])[g]
+    qs_s = pad1(spatial_params["q_spatial"])[g]
+    ps_s = pad1(spatial_params["p_spatial"])[g]
+    # (S, C, T, n_cap): band/shard-local inflow series
+    qp_s = jnp.moveaxis(
+        jnp.concatenate([q_prime, jnp.zeros((T, 1), q_prime.dtype)], axis=1)[:, g], 0, 2
+    )
+    qi_s = (
+        pad0(q_init)[g] if has_init else jnp.zeros((S, C, n_cap), q_prime.dtype)
+    )
+
+    def reduce_buckets(gathered, mask_row, clamped):
+        parts = []
+        off = 0
+        for node_start, node_end, width in buckets:
+            cnt_nodes = node_end - node_start
+            if width == 0:
+                parts.append(jnp.zeros(cnt_nodes, gathered.dtype))
+                continue
+            cnt = cnt_nodes * width
+            blk = gathered[off : off + cnt].reshape(cnt_nodes, width)
+            msk = mask_row[off : off + cnt].reshape(blk.shape)
+            if clamped:
+                blk = jnp.maximum(blk, lb)
+            parts.append((blk * msk).sum(axis=1))
+            off += cnt
+        return jnp.concatenate(parts) if parts else jnp.zeros(n_cap, gathered.dtype)
+
+    def _skew_cols(src, starts, width):
+        sl = jax.vmap(lambda col, s0: jax.lax.dynamic_slice(col, (s0,), (width,)))(
+            src.T, starts
+        )
+        return sl.T
+
+    def shard_fn(lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, hbg_r, exc_r, ext_a,
+                 pbs_a, pbc_r, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a,
+                 qp_a, qi_a):
+        # drop the leading per-shard axis shard_map leaves on sharded operands
+        (lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, ext_a, pbs_a, ln_a, sl_a, xs_a,
+         twd_a, ssd_a, nm_a, qsp_a, psp_a, qp_a, qi_a) = (
+            x[0] for x in (lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, ext_a, pbs_a,
+                           ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a,
+                           qp_a, qi_a)
+        )
+        ar_b = jnp.arange(B_cap)
+
+        def band_step(bnd, band_in):
+            (lvl, wfr, wfc, wfm, hbo, hbt, hbg, exc, ext, pbs, pbc,
+             ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c) = band_in
+            ch = ChannelState(length=ln, slope=sl, x_storage=xs_,
+                              top_width_data=twd, side_slope_data=ssd)
+
+            gath = bnd[:, exc]  # (T, X_cap)
+            x_ext = jnp.zeros((T, row_len), bnd.dtype).at[:, ext].add(gath)[:, :n_cap]
+            prev = jnp.concatenate([jnp.zeros((1, B + 1), bnd.dtype), bnd[:-1]], 0)
+            s_ext = (
+                jnp.zeros((T, row_len), bnd.dtype)
+                .at[:, ext].add(jnp.maximum(prev[:, exc], lb))[:, :n_cap]
+            )
+
+            right_edge = qp_c[T - 2 : T - 1] if T >= 2 else qp_c[:1]
+            padded = jnp.concatenate(
+                [
+                    jnp.broadcast_to(qp_c[0], (span + 1, n_cap)),
+                    qp_c[: T - 1],
+                    jnp.broadcast_to(right_edge[0], (span, n_cap)),
+                ],
+                axis=0,
+            )
+            qs_sk = _skew_cols(padded, span - lvl, n_waves)
+            zpad = jnp.zeros((span, n_cap), bnd.dtype)
+            xe_sk = _skew_cols(jnp.concatenate([zpad, x_ext, zpad], 0), span - lvl, n_waves)
+            se_sk = _skew_cols(jnp.concatenate([zpad, s_ext, zpad], 0), span - lvl, n_waves)
+
+            def physics(q_prev):
+                c = celerity(q_prev, nm, psp, qsp, ch, bounds)[0]
+                return muskingum_coefficients(ch.length, c, ch.x_storage, dt)
+
+            if remat_physics:
+                physics = jax.checkpoint(physics)
+
+            ring0 = jnp.zeros(ring_rows * row_len, qp_c.dtype)
+            hist0 = jnp.zeros(hist_rows * B_cap, qp_c.dtype)
+            s0 = jnp.zeros(n_cap, qp_c.dtype)
+
+            def body(carry, wave_inputs):
+                ring, hist, s_state = carry
+                q_row, xe_row, se_row, w = wave_inputs
+                t_node = w - 1 - lvl
+                h1 = jax.lax.rem(w - 1, ring_rows)
+                q_prev = jnp.maximum(
+                    jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+                )
+                c1, c2, c3, c4 = physics(q_prev)
+                rot = h1 - wfr
+                rot = jnp.where(rot < 0, rot + ring_rows, rot)
+                gathered = ring[rot * row_len + wfc]
+                x_local = reduce_buckets(gathered, wfm, clamped=False) + xe_row
+                s_local = reduce_buckets(gathered, wfm, clamped=True)
+
+                hb1 = jax.lax.rem(w - 1, hist_rows)
+                hrot = hb1 - (hbg - 1)
+                hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
+                x_b = hist[hrot * B_cap + ar_b]
+                own_t = hbt < n_cap
+                x_bnd = (
+                    jnp.zeros(row_len, qp_c.dtype)
+                    .at[hbt].add(jnp.where(own_t, x_b, 0.0))[:n_cap]
+                )
+                s_bnd = (
+                    jnp.zeros(row_len, qp_c.dtype)
+                    .at[hbt].add(jnp.where(own_t, jnp.maximum(x_b, lb), 0.0))[:n_cap]
+                )
+                x_pred = x_local + x_bnd
+
+                b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
+                is_hot = t_node == 0
+                b = jnp.where(is_hot, q_row, b_step)
+                c1_eff = jnp.where(is_hot, 1.0, c1)
+                y = b + c1_eff * x_pred
+                if has_init:
+                    y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
+                ok = (t_node >= 0) & (t_node <= T - 1)
+                y = jnp.where(ok, y, 0.0)
+
+                v_out = jnp.where(
+                    hbo < n_cap, jnp.concatenate([y, jnp.zeros(1, y.dtype)])[hbo], 0.0
+                )
+                hist = jax.lax.dynamic_update_slice(
+                    hist, jax.lax.psum(v_out, axis_name),
+                    (jax.lax.rem(w, hist_rows) * B_cap,),
+                )
+                ring = jax.lax.dynamic_update_slice(
+                    ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]),
+                    (jax.lax.rem(w, ring_rows) * row_len,),
+                )
+                return (ring, hist, s_local + s_bnd), y
+
+            waves = jnp.arange(1, n_waves + 1)
+            (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), (qs_sk, xe_sk, se_sk, waves))
+
+            raw = _skew_cols(ys, lvl, T)  # (T, n_cap)
+            raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), raw.dtype)], axis=1)
+            pub_local = jnp.where(pbs[None, :] < n_cap, raw_pad[:, pbs], 0.0)
+            pub_full = jax.lax.psum(pub_local, axis_name)  # (T, P_cap), replicated
+            bnd = bnd.at[:, pbc].set(pub_full)
+            return bnd, raw
+
+        band_xs = (
+            lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, hbg_r, exc_r, ext_a,
+            pbs_a, pbc_r, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a,
+            qp_a, qi_a,
+        )
+        bnd0 = jnp.zeros((T, B + 1), q_prime.dtype)
+        _, raw_all = jax.lax.scan(band_step, bnd0, band_xs)  # (C, T, n_cap)
+        return raw_all
+
+    shard = P(axis_name)
+    rep = P()
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            shard, shard, shard, shard, shard, shard, rep, rep, shard,
+            shard, rep, shard, shard, shard, shard, shard, shard, shard, shard,
+            shard, shard,
+        ),
+        out_specs=P(None, None, axis_name),
+        check_vma=False,
+    )
+    raw_all = fn(
+        layout.level, layout.wf_row, layout.wf_col, layout.wf_mask,
+        layout.hb_out, layout.hb_tgt, layout.hb_gap, layout.ext_cols,
+        layout.ext_tgt, layout.pub_src, layout.pub_col,
+        length_s, slope_s, xst_s, twd_s, ssd_s, nm_s, qs_s, ps_s, qp_s, qi_s,
+    )  # (C, T, S * n_cap)
+    runoff_all = jnp.maximum(raw_all, lb)
+    flat = jnp.moveaxis(runoff_all, 0, 1).reshape(T, C * S * n_cap)
+    runoff = flat[:, layout.out_map]
+    return runoff, runoff[-1]
