@@ -133,3 +133,51 @@ def test_multi_band_forced():
     with mesh:
         runoff, _ = route_stacked_sharded(mesh, layout, channels, params, qp)
     assert _rel(runoff, ref.runoff) < 1e-4
+
+
+def test_fuzz_random_dags_match_step():
+    """Seeded mini-fuzz over irregular DAGs (multi-root, wide confluences,
+    uneven bands after balanced packing) — the stacked-sharded frame has the
+    most sentinel wiring in the repo (local gather + hist + pub + ext, each
+    per shard); random topologies corner it cheaply. Seeded loop rather than
+    hypothesis: each example compiles a shard_map program, so example count
+    is the budget."""
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    from ddr_tpu.routing.stacked import build_stacked_chunked
+
+    mesh = make_mesh(N_DEV)
+    for seed in range(6):
+        rng = np.random.default_rng(100 + seed)
+        n = int(rng.integers(24, 120))
+        edges = []
+        for i in range(1, n):
+            for u in rng.choice(i, size=int(rng.integers(0, min(i, 3) + 1)), replace=False):
+                edges.append((i, int(u)))
+        rows = np.array([e[0] for e in edges], dtype=np.int64)
+        cols = np.array([e[1] for e in edges], dtype=np.int64)
+        T = int(rng.integers(2, 6))
+        channels = ChannelState(
+            length=jnp.asarray(rng.uniform(1000, 5000, n), jnp.float32),
+            slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
+            x_storage=jnp.full(n, 0.3, jnp.float32),
+        )
+        params = {
+            "n": jnp.asarray(rng.uniform(0.02, 0.2, n), jnp.float32),
+            "q_spatial": jnp.asarray(rng.uniform(0.1, 0.9, n), jnp.float32),
+            "p_spatial": jnp.full(n, 21.0, jnp.float32),
+        }
+        qp = jnp.asarray(rng.uniform(0.01, 1.0, (T, n)), jnp.float32)
+        ref = route(
+            build_network(rows, cols, n, fused=False), channels, params, qp, engine="step"
+        )
+        layout = build_stacked_sharded(rows, cols, n, N_DEV)
+        with mesh:
+            runoff, _ = route_stacked_sharded(mesh, layout, channels, params, qp)
+        rel = _rel(runoff, ref.runoff)
+        assert rel < 1e-4, f"seed={seed} n={n} E={len(edges)} bands={layout.n_bands} rel={rel}"
+        # and the single-chip stacked on the same topology
+        sn = build_stacked_chunked(rows, cols, n, cell_budget=max(60, 6 * n))
+        res = route(sn, channels, params, qp)
+        rel_s = _rel(res.runoff, ref.runoff)
+        assert rel_s < 1e-4, f"seed={seed} single-chip stacked rel={rel_s}"
