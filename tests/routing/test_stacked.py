@@ -179,3 +179,14 @@ def test_auto_budget_default_and_jit():
     fn = jax.jit(lambda q: route(sn, channels, params, q).runoff)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     assert _rel(fn(qp), ref.runoff) < 1e-4
+
+
+def test_single_timestep_route():
+    """T=1 exercises the skew frame's degenerate right-edge branch."""
+    n, depth = 300, 80
+    rows, cols, channels, params, qp = _setup(n, depth, 1, seed=11)
+    ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
+    sn = build_stacked_chunked(rows, cols, n, cell_budget=4_000)
+    res = route(sn, channels, params, qp)
+    assert res.runoff.shape == (1, n)
+    assert _rel(res.runoff, ref.runoff) < 1e-4
