@@ -237,13 +237,20 @@ def make_sharded_chunked_train_step(
     mesh — the engine whose per-shard-per-band ring stays HBM-feasible where the
     monolithic sharded wavefront's does not (docs/tpu.md "Continental depth").
 
-    ``layout`` is a :class:`ddr_tpu.parallel.chunked.ShardedChunked`; unlike
-    :func:`make_sharded_train_step`, every per-reach array stays in ORIGINAL
-    node order (the layout carries its own band/shard permutations). Loss and
-    windowing are :func:`masked_l1_daily`, identical to every other builder.
+    ``layout`` is a :class:`ddr_tpu.parallel.chunked.ShardedChunked` or a
+    :class:`ddr_tpu.parallel.stacked.StackedSharded` (the compile-O(1)
+    scan-over-bands form — prefer it at the band counts the cost model picks
+    for continental topology); unlike :func:`make_sharded_train_step`, every
+    per-reach array stays in ORIGINAL node order (the layout carries its own
+    band/shard permutations). Loss and windowing are :func:`masked_l1_daily`,
+    identical to every other builder.
     """
     from ddr_tpu.parallel.chunked import route_chunked_sharded
+    from ddr_tpu.parallel.stacked import StackedSharded, route_stacked_sharded
 
+    router = (
+        route_stacked_sharded if isinstance(layout, StackedSharded) else route_chunked_sharded
+    )
     n_segments = channels.length.shape[0]
 
     def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
@@ -251,9 +258,7 @@ def make_sharded_chunked_train_step(
         spatial = denormalize_spatial_parameters(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
-        runoff, _ = route_chunked_sharded(
-            mesh, layout, channels, spatial, q_prime, bounds=bounds
-        )
+        runoff, _ = router(mesh, layout, channels, spatial, q_prime, bounds=bounds)
         return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
 
     return _make_step(loss_fn, optimizer)

@@ -181,3 +181,55 @@ def test_fuzz_random_dags_match_step():
         res = route(sn, channels, params, qp)
         rel_s = _rel(res.runoff, ref.runoff)
         assert rel_s < 1e-4, f"seed={seed} single-chip stacked rel={rel_s}"
+
+
+def test_train_step_descends():
+    """Full training step over the stacked-sharded engine on a deep twin
+    experiment: KAN -> stacked-sharded route -> masked L1 -> backward ->
+    optimizer, loss descending — make_sharded_chunked_train_step dispatches on
+    the layout type, so the O(1)-compile multi-chip path is trainable."""
+    from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+    from ddr_tpu.nn.kan import Kan
+    from ddr_tpu.routing.mc import Bounds, GaugeIndex
+    from ddr_tpu.routing.model import prepare_channels
+    from ddr_tpu.training import make_optimizer, make_sharded_chunked_train_step
+    from ddr_tpu.validation.configs import Config
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    cfg = Config(
+        name="t", geodataset="synthetic", mode="training",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={"rho": 3, "warmup": 1},
+    )
+    basin = observe(make_basin(n_segments=256, n_gauges=4, n_days=3, seed=0, depth=96), cfg)
+    rd = basin.routing_data
+    channels, gauges = prepare_channels(rd, 1e-4)
+    if gauges is None:
+        gauges = GaugeIndex.from_ragged(rd.outflow_idx)
+    layout = build_stacked_sharded(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, N_DEV)
+    kan = Kan(
+        input_var_names=tuple(cfg.kan.input_var_names),
+        learnable_parameters=tuple(cfg.kan.learnable_parameters),
+        hidden_size=cfg.kan.hidden_size,
+        num_hidden_layers=cfg.kan.num_hidden_layers,
+    )
+    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+    params = kan.init(jax.random.PRNGKey(0), attrs)
+    opt = make_optimizer(1e-3)
+    step = make_sharded_chunked_train_step(
+        kan, make_mesh(N_DEV), layout, channels, gauges,
+        Bounds.from_config(cfg.params.attribute_minimums),
+        cfg.params.parameter_ranges, cfg.params.log_space_parameters,
+        cfg.params.defaults, tau=cfg.params.tau, warmup=1, optimizer=opt,
+    )
+    obs = jnp.asarray(basin.obs_daily)
+    mask = jnp.ones_like(obs, dtype=bool)
+    qp = jnp.asarray(basin.q_prime)
+    state = opt.init(params)
+    losses = []
+    for _ in range(3):
+        params, state, loss, _ = step(params, state, attrs, qp, obs, mask)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
