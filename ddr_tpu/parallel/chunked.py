@@ -83,8 +83,10 @@ def build_sharded_chunked(
     sharded-wavefront schedule over its level-sorted, shard-padded local order.
 
     ``cell_budget=None`` uses :func:`ddr_tpu.routing.chunked.auto_cell_budget`
-    (the measured speed-optimal band size; the per-shard ring is then
-    ~budget/n_shards cells, under the same 2^26-cell memory cap)."""
+    with ``ring_divisor=n_shards`` — the cost model evaluates the PER-SHARD
+    ring (each shard copies ~1/S of a band's columns per wave), so the sharded
+    optimum lands on fewer, wider bands than the single-chip default, under the
+    same 2^26-cell per-shard memory cap."""
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     if level is None:
@@ -94,7 +96,7 @@ def build_sharded_chunked(
     if cell_budget is None:
         from ddr_tpu.routing.chunked import auto_cell_budget
 
-        cell_budget = auto_cell_budget(n, depth)
+        cell_budget = auto_cell_budget(n, depth, ring_divisor=n_shards)
     band_ranges = pack_level_bands(counts, cell_budget, ring_cols_divisor=n_shards)
     n_bands = len(band_ranges)
 

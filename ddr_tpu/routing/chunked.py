@@ -118,6 +118,7 @@ def auto_cell_budget(
     t_nominal: int = 240,
     max_bands: int = 64,
     cap: int = CHUNK_CELL_BUDGET,
+    ring_divisor: int = 1,
 ) -> int:
     """Speed-optimal band ring budget from the measured TPU wave-cost model.
 
@@ -128,6 +129,13 @@ def auto_cell_budget(
     7.4M rt/s; C=16 (budget 2^18) yields 99.7M rt/s — the ring-copy tax, not
     memory, is what sizes bands. ``max_bands`` caps compile time (the band loop
     unrolls into the jit program) and host build time.
+
+    ``ring_divisor`` evaluates the model for a PER-SHARD ring (the
+    sharded-chunked router's layout, where each of S shards carries ~1/S of a
+    band's columns): the copy tax per wave is divided by the shard count, which
+    shifts the optimum toward fewer, wider bands. The returned budget is then
+    per-shard cells, matching :func:`pack_level_bands`'s ``ring_cols_divisor``
+    contract.
     """
     if depth <= 0 or n <= 0:
         return cap
@@ -136,7 +144,7 @@ def auto_cell_budget(
     c = 1
     while c <= max_bands:
         span = max(1, -(-depth // c))
-        ring_cells = (span + 1) * (int(span * rho) + 1)
+        ring_cells = (span + 1) * (int(span * rho / ring_divisor) + 1)
         if ring_cells <= cap:
             waves = c * t_nominal + depth
             cost = waves * (_WAVE_FIXED_S + ring_cells * 4 / _RING_COPY_BYTES_PER_S)

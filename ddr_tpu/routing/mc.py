@@ -286,10 +286,11 @@ def route(
     from ddr_tpu.routing.stacked import StackedChunked, route_stacked
 
     if isinstance(network, (ChunkedNetwork, StackedChunked)):
+        kind = type(network).__name__
         if engine not in (None, "wavefront"):
-            raise ValueError("a ChunkedNetwork always routes via the chunked wavefront")
+            raise ValueError(f"a {kind} always routes via its banded wavefront")
         if q_prime_permuted:
-            raise ValueError("q_prime_permuted is not supported on a ChunkedNetwork")
+            raise ValueError(f"q_prime_permuted is not supported on a {kind}")
         router = route_stacked if isinstance(network, StackedChunked) else route_chunked
         return router(
             network, channels, spatial_params, q_prime, q_init=q_init,

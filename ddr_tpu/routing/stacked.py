@@ -197,8 +197,10 @@ def build_stacked_chunked(
     change = np.flatnonzero(np.diff(wp) != 0) + 1
     starts_r = np.concatenate([[0], change])
     ends_r = np.concatenate([change, [n_cap]])
-    buckets = tuple(
-        (int(s), int(e), int(wp[s])) for s, e in zip(starts_r, ends_r)
+    buckets = (
+        tuple((int(s), int(e), int(wp[s])) for s, e in zip(starts_r, ends_r))
+        if n_cap
+        else ()
     )
 
     gidx = np.full((C, n_cap), n, dtype=np.int64)

@@ -7,12 +7,17 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def test_measure_engine_errors_contract():
     code = """
 import json, jax
 jax.config.update("jax_enable_x64", True)
 from ddr_tpu.benchmarks.numerics import measure_engine_errors
+
 res = measure_engine_errors(600, 150, 24, seed=3)
 print(json.dumps({k: list(v) for k, v in res.items()}))
 """

@@ -299,6 +299,33 @@ class TestAutoCellBudget:
         assert auto_cell_budget(0, 0) == CHUNK_CELL_BUDGET
         assert auto_cell_budget(100, 0) == CHUNK_CELL_BUDGET
 
+    def test_ring_divisor_shifts_optimum_to_wider_bands(self):
+        """Per-shard cost model (ring_divisor=S): each shard pays ~1/S of the
+        band's ring-copy tax per wave, so the optimum moves to fewer, wider
+        bands — the per-shard budget must never imply MORE bands than the
+        single-chip budget does at the same shape."""
+        from ddr_tpu.routing.chunked import CHUNK_CELL_BUDGET, auto_cell_budget
+
+        n, depth = 262_144, 2048
+        rho = n / depth
+
+        def implied_bands(budget, div):
+            # invert ring(C) = (span+1)(span*rho/div+1) <= budget over C=2^k
+            c = 1
+            while c <= 64:
+                span = max(1, -(-depth // c))
+                if (span + 1) * (int(span * rho / div) + 1) <= budget:
+                    return c
+                c *= 2
+            return 64
+
+        b1 = auto_cell_budget(n, depth)
+        b8 = auto_cell_budget(n, depth, ring_divisor=8)
+        assert 2 <= b8 <= CHUNK_CELL_BUDGET
+        assert implied_bands(b8, 8) <= implied_bands(b1, 1)
+        # divisor=1 stays the exact legacy model
+        assert b1 == auto_cell_budget(n, depth, ring_divisor=1)
+
     def test_default_build_uses_auto(self):
         n, depth, T = 600, 150, 8
         rows, cols, channels, params, qp = _setup(n, depth, T)

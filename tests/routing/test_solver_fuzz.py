@@ -8,6 +8,8 @@ hypothesis-driven topology search and shrinking."""
 
 from __future__ import annotations
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,6 +21,7 @@ from scipy.sparse.linalg import spsolve_triangular
 from ddr_tpu.routing.network import build_network
 from ddr_tpu.routing.solver import solve_lower_triangular, solve_transposed
 
+pytestmark = pytest.mark.slow
 
 @st.composite
 def dag_cases(draw):

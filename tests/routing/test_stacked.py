@@ -190,3 +190,24 @@ def test_single_timestep_route():
     res = route(sn, channels, params, qp)
     assert res.runoff.shape == (1, n)
     assert _rel(res.runoff, ref.runoff) < 1e-4
+
+
+def test_empty_graph_builds():
+    """n=0 must not crash the public builder (the width profile is size-0, so
+    the bucket comprehension would index wp[0]); the frame is trivial."""
+    empty = np.zeros(0, dtype=np.int64)
+    sn = build_stacked_chunked(empty, empty, 0)
+    assert isinstance(sn, StackedChunked)
+    assert sn.n == 0 and sn.n_cap == 0 and sn.buckets == ()
+
+
+def test_dispatch_error_names_actual_type():
+    """route()'s validation errors name the concrete network type (a
+    StackedChunked error must not claim to be about a ChunkedNetwork)."""
+    n, depth, T = 120, 30, 4
+    rows, cols, channels, params, qp = _setup(n, depth, T, seed=3)
+    sn = build_stacked_chunked(rows, cols, n, cell_budget=2_000)
+    with pytest.raises(ValueError, match="StackedChunked"):
+        route(sn, channels, params, qp, engine="fused")
+    with pytest.raises(ValueError, match="StackedChunked"):
+        route(sn, channels, params, qp, q_prime_permuted=True)

@@ -10,6 +10,8 @@ wide confluences, and budget-forced degenerate bandings."""
 
 from __future__ import annotations
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 from hypothesis import given, settings
@@ -19,6 +21,7 @@ from ddr_tpu.routing.mc import ChannelState, route
 from ddr_tpu.routing.network import build_network
 from ddr_tpu.routing.stacked import build_stacked_chunked
 
+pytestmark = pytest.mark.slow
 
 @st.composite
 def routed_dag_cases(draw):

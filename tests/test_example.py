@@ -12,6 +12,8 @@ import pytest
 
 from ddr_tpu.cli import main as cli_main
 
+pytestmark = pytest.mark.slow
+
 EXAMPLE = Path(__file__).parent.parent / "examples" / "synthetic" / "config.yaml"
 
 

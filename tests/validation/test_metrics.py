@@ -182,3 +182,100 @@ class TestShapesAndSerialization:
         target = rng.uniform(1, 10, (1, 300))
         m = Metrics(pred=target * 2.0, target=target)
         assert m.fdc_rmse[0] > 0
+
+
+class TestVectorizedParity:
+    """The vectorized battery must reproduce the straightforward per-gauge
+    loop (the round-3 implementation, inlined here as the oracle) on random
+    data with realistic NaN sparsity, including all-NaN, constant, and k==1
+    gauges."""
+
+    @staticmethod
+    def _loop_oracle(pred, target):
+        from scipy import stats as sstats
+
+        g = pred.shape[0]
+        out = {
+            nm: np.full(g, np.nan)
+            for nm in (
+                "corr corr_spearman r2 nse flv fhv pbias pbias_mid kge kge_12 "
+                "rmse_low rmse_high rmse_mid"
+            ).split()
+        }
+
+        def p_bias(p, t):
+            d = np.sum(t)
+            return np.nan if d == 0 else np.sum(p - t) / d * 100.0
+
+        def seg_rmse(p, t):
+            return np.sqrt(np.mean((p - t) ** 2)) if p.size else np.nan
+
+        for i in range(g):
+            mask = ~np.isnan(pred[i]) & ~np.isnan(target[i])
+            if not mask.any():
+                continue
+            p, t = pred[i][mask], target[i][mask]
+            ps, ts = np.sort(p), np.sort(t)
+            i_lo, i_hi = round(0.3 * ps.size), round(0.98 * ps.size)
+            out["pbias"][i] = p_bias(p, t)
+            out["flv"][i] = p_bias(ps[:i_lo], ts[:i_lo])
+            out["fhv"][i] = p_bias(ps[i_hi:], ts[i_hi:])
+            out["pbias_mid"][i] = p_bias(ps[i_lo:i_hi], ts[i_lo:i_hi])
+            out["rmse_low"][i] = seg_rmse(ps[:i_lo], ts[:i_lo])
+            out["rmse_high"][i] = seg_rmse(ps[i_hi:], ts[i_hi:])
+            out["rmse_mid"][i] = seg_rmse(ps[i_lo:i_hi], ts[i_lo:i_hi])
+            if mask.sum() > 1:
+                if np.ptp(p) and np.ptp(t):
+                    out["corr"][i] = sstats.pearsonr(p, t)[0]
+                    out["corr_spearman"][i] = sstats.spearmanr(p, t)[0]
+                pm, tm, psd, tsd = p.mean(), t.mean(), p.std(), t.std()
+                r = out["corr"][i]
+                if tsd > 0 and tm != 0:
+                    out["kge"][i] = 1 - np.sqrt(
+                        (r - 1) ** 2 + (psd / tsd - 1) ** 2 + (pm / tm - 1) ** 2
+                    )
+                    if pm != 0:
+                        out["kge_12"][i] = 1 - np.sqrt(
+                            (r - 1) ** 2
+                            + ((psd * tm) / (tsd * pm) - 1) ** 2
+                            + (pm / tm - 1) ** 2
+                        )
+                sst = np.sum((t - tm) ** 2)
+                if sst > 0:
+                    out["nse"][i] = 1 - np.sum((t - p) ** 2) / sst
+                    out["r2"][i] = out["nse"][i]
+        return out
+
+    def test_random_sparse(self):
+        rng = np.random.default_rng(7)
+        g, t = 40, 60
+        pred = np.abs(rng.normal(5, 3, (g, t)))
+        target = np.abs(rng.normal(5, 3, (g, t)))
+        target[rng.random((g, t)) < 0.3] = np.nan
+        target[0] = np.nan  # all-NaN gauge
+        target[1, 1:] = np.nan  # k == 1 gauge
+        pred[2] = 4.2  # constant pred
+        target[3, ~np.isnan(target[3])] = 2.5  # constant target (valid subset)
+        pred[4] = 0.0  # zero-mean pred (kge_12 gate)
+        target[4, ~np.isnan(target[4])] = 0.0  # zero-mean target (kge gate)
+        m = Metrics(pred=pred, target=target)
+        want = self._loop_oracle(m.pred, m.target)
+        for nm, ref in want.items():
+            got = getattr(m, nm)
+            np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=nm)
+
+    def test_fdc_matches_loop(self):
+        rng = np.random.default_rng(3)
+        g, t = 10, 250
+        data = np.abs(rng.normal(4, 2, (g, t)))
+        data[rng.random((g, t)) < 0.4] = np.nan
+        data[5] = np.nan
+        m = Metrics(pred=np.ones((g, t)), target=np.ones((g, t)))
+        got = m._fdc(data)
+        for i in range(g):
+            valid = data[i][~np.isnan(data[i])]
+            if valid.size == 0:
+                valid = np.zeros(t)
+            srt = np.sort(valid)[::-1]
+            idx = (np.arange(100) / 100 * valid.size).astype(int)
+            np.testing.assert_array_equal(got[i], srt[idx], err_msg=str(i))
