@@ -1,8 +1,14 @@
 """Single-variant route() throughput ablation (one process per variant).
 
-Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect|wavefront|chunked|stacked|step} [DEPTH]``
+Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect|wavefront|chunked|stacked|step} [DEPTH] [--grad] [--no-remat]``
 Prints one JSON line {n, t_hours, schedule, depth, rts, ms_per_step, device,
-[n_chunks]}.
+[n_chunks], [peak_hbm_gb]}.
+
+``--grad`` measures the full VJP (value_and_grad of a mean-runoff loss over the
+spatial parameters) instead of the forward route — the deep-backward number
+VERDICT round-3 flagged as unmeasured. ``--no-remat`` disables the per-wave
+physics rematerialization (``remat_physics=False``) so the remat win/loss is a
+two-line ablation.
 
 ``DEPTH`` switches the topology to the CONUS-realistic deep generator with that
 exact longest-path depth (the regime VERDICT round-2 flagged as unmeasured):
@@ -23,9 +29,13 @@ import time
 
 
 def main() -> None:
-    n, t_hours = int(sys.argv[1]), int(sys.argv[2])
-    schedule = sys.argv[3] if len(sys.argv) > 3 else "fused"
-    depth = int(sys.argv[4]) if len(sys.argv) > 4 else None
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    flags = {a for a in sys.argv[1:] if a.startswith("--")}
+    n, t_hours = int(args[0]), int(args[1])
+    schedule = args[2] if len(args) > 2 else "fused"
+    depth = int(args[3]) if len(args) > 3 else None
+    grad = "--grad" in flags
+    remat = "--no-remat" not in flags
 
     import jax
     import jax.numpy as jnp
@@ -81,38 +91,59 @@ def main() -> None:
     else:
         network, channels, gauges = prepare_batch(rd, 1e-4, fused=(schedule == "fused"))
 
-    fn = jax.jit(
-        lambda qp: route(network, channels, params, qp, gauges=gauges, engine=engine).runoff
-    )
+    if grad:
+        def loss(p):
+            return route(
+                network, channels, p, q_prime, gauges=gauges, engine=engine,
+                remat_physics=remat,
+            ).runoff.mean()
+
+        fn = jax.jit(jax.value_and_grad(loss))
+        arg = params
+    else:
+        fn = jax.jit(
+            lambda qp: route(
+                network, channels, params, qp, gauges=gauges, engine=engine,
+                remat_physics=remat,
+            ).runoff
+        )
+        arg = q_prime
     # TRUE compile time via AOT lowering (the old first-call timing folded one
     # full execution in — at deep CPU shapes a ~0.6s compile read as 107s)
     t0 = time.perf_counter()
-    compiled = fn.lower(q_prime).compile()
+    compiled = fn.lower(arg).compile()
     compile_s = time.perf_counter() - t0
-    compiled(q_prime).block_until_ready()  # warm buffers
+    jax.block_until_ready(compiled(arg))  # warm buffers
     # Queue all reps, block once: a blocking sync through the axon tunnel costs
     # ~70ms of poll latency (device-idle, not throughput). Reps scale to ~2s of
     # queued work so fast shallow shapes amortize it (bench.py measured reps=3
     # reading ~40% low at 19ms/route) without deep multi-second routes ballooning.
     t0 = time.perf_counter()
-    compiled(q_prime).block_until_ready()
+    jax.block_until_ready(compiled(arg))
     est = time.perf_counter() - t0
     reps = max(3, min(50, int(2.0 / max(est, 1e-3))))
     t0 = time.perf_counter()
-    outs = [compiled(q_prime) for _ in range(reps)]
+    outs = [compiled(arg) for _ in range(reps)]
     jax.block_until_ready(outs)
     dt = (time.perf_counter() - t0) / reps
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        extra["peak_hbm_gb"] = round(peak / 2**30, 2)
     print(
         json.dumps(
             {
                 "n": n,
                 "t_hours": t_hours,
                 "schedule": schedule,
+                "mode": "vjp" if grad else "forward",
+                "remat": remat,
                 "depth": network.depth,
                 "rts": round(n * t_hours / dt, 1),
                 "ms_per_step": round(dt / t_hours * 1e3, 3),
                 "compile_s": round(compile_s, 1),
-                "device": jax.devices()[0].platform,
+                "device": dev.platform,
                 **extra,
             }
         ),

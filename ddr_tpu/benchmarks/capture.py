@@ -1,0 +1,106 @@
+"""Round-4 TPU measurement session: the deep-regime plan, tunnel-drop-safe.
+
+Usage: ``python -m ddr_tpu.benchmarks.capture [SESSION_FILE]``
+(default ``TPU_SESSION_r04.jsonl`` in the cwd).
+
+Runs the VERDICT round-3 "next round" measurement plan — the stacked and
+auto-budget chunked routers at the shapes they exist for (N=262k/depth=2048
+official deep shape, N=2.9M/depth=4000 continental), forward AND full VJP,
+remat on/off, plus the complete train step at scale — one subprocess per
+measurement (the axon tunnel serializes processes and a mid-compile kill
+wedges the grant, so each variant gets exactly one process and one compile).
+
+Every result line is appended to the session file IMMEDIATELY, and entries
+already present are skipped on re-run — a tunnel drop mid-session loses only
+the in-flight measurement, and re-invoking resumes where it stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# (module, args, timeout_s) — ordered cheapest-first so early tunnel time yields
+# the calibration points even if the session dies before the continental rows.
+PLAN: list[tuple[str, list, int]] = [
+    # calibration shape: prior chip numbers exist (docs/tpu.md deep ablation)
+    ("ablate", [65536, 240, "chunked", 1024], 1800),
+    ("ablate", [65536, 240, "stacked", 1024], 1800),
+    ("ablate", [65536, 240, "stacked", 1024, "--grad"], 2400),
+    # the official deep shape (BENCH deep phase): stacked = what auto-selection picks
+    ("ablate", [262144, 240, "stacked", 2048], 2400),
+    ("ablate", [262144, 240, "stacked", 2048, "--grad"], 3600),
+    ("ablate", [262144, 240, "stacked", 2048, "--grad", "--no-remat"], 3600),
+    ("ablate", [262144, 240, "chunked", 2048], 2400),
+    ("ablate", [262144, 240, "chunked", 2048, "--grad"], 3600),
+    # the full train step at the official deep shape (VERDICT item 3)
+    ("trainbench", [262144, 240, 2048], 3600),
+    # continental: the cost model predicted ~330M rt/s here — validate or correct
+    ("ablate", [2_900_000, 240, "stacked", 4000], 5400),
+    ("ablate", [2_900_000, 240, "stacked", 4000, "--grad"], 7200),
+]
+
+
+def _key(module: str, args: list) -> str:
+    return module + ":" + ",".join(str(a) for a in args)
+
+
+def load_done(session: str) -> set[str]:
+    """Keys of SUCCESSFUL measurements in the session file; errored/timeout
+    entries are excluded so a resume re-runs them."""
+    done: set[str] = set()
+    if os.path.exists(session):
+        with open(session) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                    if "error" not in rec:
+                        done.add(rec["_key"])
+                except (json.JSONDecodeError, KeyError):
+                    pass
+    return done
+
+
+def main() -> None:
+    session = sys.argv[1] if len(sys.argv) > 1 else "TPU_SESSION_r04.jsonl"
+    done = load_done(session)
+
+    for module, args, timeout in PLAN:
+        key = _key(module, args)
+        if key in done:
+            print(f"skip (done): {key}", flush=True)
+            continue
+        print(f"run: {key} (timeout {timeout}s)", flush=True)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", f"ddr_tpu.benchmarks.{module}", *map(str, args)],
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            rec = {"_key": key, "error": f"timed out after {timeout}s"}
+        else:
+            lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+            if proc.returncode != 0 or not lines:
+                tail = proc.stderr.strip().splitlines()[-1:] or ["no stderr"]
+                rec = {"_key": key, "error": f"rc={proc.returncode}: {tail[0][:500]}"}
+            else:
+                try:
+                    rec = {"_key": key, **json.loads(lines[-1])}
+                except json.JSONDecodeError:
+                    rec = {"_key": key, "error": f"unparseable: {lines[-1][:500]}"}
+        rec["wall_s"] = round(time.time() - t0, 1)
+        with open(session, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(f"  -> {json.dumps(rec)}", flush=True)
+        if "error" in rec and "timed out" in rec.get("error", ""):
+            # a wedged grant needs ~10 min to clear; don't burn the whole plan
+            print("  tunnel may be wedged; waiting 600s before next entry", flush=True)
+            time.sleep(600)
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,63 @@ class GeometryPredictor:
             stats_ranges=stats_ranges,
         )
 
+    @classmethod
+    def from_reference_checkpoint(
+        cls,
+        checkpoint_path: str | Path,
+        attribute_names: list[str],
+        learnable_parameters: list[str],
+        parameter_ranges: dict[str, list[float]] | None = None,
+        log_space_parameters: list[str] | None = None,
+        defaults: dict[str, float] | None = None,
+        attribute_minimums: dict[str, float] | None = None,
+        means: np.ndarray | None = None,
+        stds: np.ndarray | None = None,
+        stats_ranges: dict[str, dict[str, float]] | None = None,
+    ) -> "GeometryPredictor":
+        """Build directly from a REFERENCE-format torch ``.pt`` blob (pykan
+        MultKAN state dict, e.g. the published
+        ddr-v0.5.2-merit-geometry-weights.pt) via
+        :func:`ddr_tpu.nn.torch_import.load_reference_checkpoint` — the
+        migration path for users holding reference-trained geometry weights
+        (reference workflow: /root/reference/scripts/geometry_predictor.py:45-115,
+        which torch-loads the blob into its pykan wrapper).
+
+        ``parameter_ranges`` / ``log_space_parameters`` / ``defaults`` /
+        ``attribute_minimums`` default to the config-schema defaults (the
+        published checkpoints were trained under exactly these). ``means`` /
+        ``stds`` default to identity normalization — pass the training
+        statistics when attributes arrive in raw physical units."""
+        from ddr_tpu.nn.torch_import import load_reference_checkpoint
+
+        imported = load_reference_checkpoint(
+            checkpoint_path, tuple(attribute_names), tuple(learnable_parameters)
+        )
+        from ddr_tpu.validation.configs import Params
+
+        schema = Params()
+        n_attr = len(attribute_names)
+        return cls(
+            kan_model=imported.model,
+            kan_params=imported.params,
+            attribute_names=list(attribute_names),
+            means=np.zeros(n_attr, np.float32) if means is None else means,
+            stds=np.ones(n_attr, np.float32) if stds is None else stds,
+            parameter_ranges=(
+                schema.parameter_ranges if parameter_ranges is None else parameter_ranges
+            ),
+            log_space_parameters=(
+                schema.log_space_parameters
+                if log_space_parameters is None
+                else log_space_parameters
+            ),
+            defaults=schema.defaults if defaults is None else defaults,
+            attribute_minimums=(
+                schema.attribute_minimums if attribute_minimums is None else attribute_minimums
+            ),
+            stats_ranges=stats_ranges,
+        )
+
     def predict(
         self,
         attributes: Mapping[str, np.ndarray],

@@ -35,8 +35,16 @@ from ddr_tpu.parallel.stacked import (
     build_stacked_sharded,
     route_stacked_sharded,
 )
+from ddr_tpu.parallel.distributed import (
+    distributed_env,
+    maybe_initialize,
+    process_summary,
+)
 
 __all__ = [
+    "distributed_env",
+    "maybe_initialize",
+    "process_summary",
     "ShardedWavefront",
     "build_sharded_wavefront",
     "sharded_wavefront_route",

@@ -1,0 +1,109 @@
+"""Multi-process (multi-host) execution entry point.
+
+SURVEY.md §5 names DCN-spanning multi-slice execution a first-class TPU-native
+concern. The JAX model: each host process drives its local chips;
+``jax.distributed.initialize`` wires the processes into ONE global device set,
+after which every mesh in :mod:`ddr_tpu.parallel` spans hosts transparently —
+``jax.devices()`` returns the global list, jit programs run SPMD with XLA
+routing collectives over ICI within a slice and DCN across slices. No routing
+or training code changes: the same ``make_mesh`` / ``shard_network`` /
+train-step builders compile identically at any process count (proven by
+tests/parallel/test_multiprocess.py, which runs the GSPMD train step as
+2 processes x 4 virtual CPU devices and checks the loss against the
+single-process 8-device result).
+
+The reference's counterpart is torch's NCCL/MPI process-group bootstrap; here
+the entire backend is ``jax.distributed`` + XLA collectives, configured by
+three values (coordinator address, process count, process id) that come from
+the environment:
+
+* ``DDR_COORDINATOR``    — ``host:port`` of process 0's coordinator service
+* ``DDR_NUM_PROCESSES``  — total process count
+* ``DDR_PROCESS_ID``     — this process's rank
+
+On managed clusters (GKE/SLURM/Cloud TPU pods) where JAX can autodetect these,
+set only ``DDR_DISTRIBUTED=1`` and the no-argument autodetect path is used.
+``maybe_initialize`` is called from the CLI scripts' ``setup_run`` before any
+device access; with none of the variables set it is a no-op, so single-process
+use never pays anything.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Mapping
+
+log = logging.getLogger(__name__)
+
+__all__ = ["distributed_env", "maybe_initialize", "process_summary"]
+
+_initialized = False
+
+
+def distributed_env(environ: Mapping[str, str] | None = None) -> dict | None:
+    """Parse the DDR_* launch variables; None when unset (single-process).
+
+    Explicit mode needs all three of ``DDR_COORDINATOR`` / ``DDR_NUM_PROCESSES``
+    / ``DDR_PROCESS_ID`` (a partial set raises — half-configured launches
+    otherwise deadlock in ``jax.distributed.initialize`` waiting for peers that
+    were never started). ``DDR_DISTRIBUTED=1`` alone selects autodetect mode
+    (empty kwargs: JAX reads the cluster environment, e.g. TPU pod metadata)."""
+    env = os.environ if environ is None else environ
+    keys = ("DDR_COORDINATOR", "DDR_NUM_PROCESSES", "DDR_PROCESS_ID")
+    present = [k for k in keys if env.get(k)]
+    if not present:
+        flag = env.get("DDR_DISTRIBUTED", "").strip().lower()
+        if flag in ("1", "true", "yes", "on"):
+            return {}
+        if flag in ("", "0", "false", "no", "off"):
+            return None
+        # An unrecognized value is a half-configured launch, not a no: every
+        # host silently training single-process is the worst failure mode.
+        raise ValueError(f"unrecognized DDR_DISTRIBUTED value {flag!r} (use 1/0)")
+    if len(present) < len(keys):
+        missing = sorted(set(keys) - set(present))
+        raise ValueError(
+            f"partial multi-process configuration: {present} set but {missing} missing; "
+            "set all three (or only DDR_DISTRIBUTED=1 for cluster autodetection)"
+        )
+    num = int(env["DDR_NUM_PROCESSES"])
+    pid = int(env["DDR_PROCESS_ID"])
+    if not 0 <= pid < num:
+        raise ValueError(f"DDR_PROCESS_ID={pid} out of range for DDR_NUM_PROCESSES={num}")
+    return {
+        "coordinator_address": env["DDR_COORDINATOR"],
+        "num_processes": num,
+        "process_id": pid,
+    }
+
+
+def maybe_initialize(environ: Mapping[str, str] | None = None) -> bool:
+    """Call ``jax.distributed.initialize`` iff the environment requests it.
+
+    Must run before the first device access in the process (jax initializes its
+    backends lazily on first use; after that the global device set is fixed).
+    Idempotent: repeat calls (e.g. setup_run invoked twice in one process)
+    return the first call's answer instead of re-initializing."""
+    global _initialized
+    if _initialized:
+        return True
+    spec = distributed_env(environ)
+    if spec is None:
+        return False
+    import jax
+
+    jax.distributed.initialize(**spec)
+    _initialized = True
+    log.info("multi-process jax initialized: %s", process_summary())
+    return True
+
+
+def process_summary() -> str:
+    """One-line description of this process's slice of the global device set."""
+    import jax
+
+    return (
+        f"process {jax.process_index()}/{jax.process_count()}, "
+        f"{len(jax.local_devices())} local / {len(jax.devices())} global devices"
+    )

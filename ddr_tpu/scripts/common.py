@@ -55,6 +55,13 @@ def setup_run(cfg: Config) -> Config:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
     )
+    # Multi-process launch (DDR_COORDINATOR / DDR_NUM_PROCESSES / DDR_PROCESS_ID,
+    # or DDR_DISTRIBUTED=1 for cluster autodetect): must run before the first
+    # device access so every mesh below spans the global device set. No-op when
+    # the env vars are unset.
+    from ddr_tpu.parallel.distributed import maybe_initialize
+
+    maybe_initialize()
     return cfg
 
 

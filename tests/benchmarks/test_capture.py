@@ -1,0 +1,36 @@
+"""Session-capture resume contract: errored entries re-run, successes skip."""
+
+import json
+
+from ddr_tpu.benchmarks.capture import PLAN, _key, load_done
+
+
+def test_load_done_skips_errors(tmp_path):
+    session = tmp_path / "s.jsonl"
+    lines = [
+        {"_key": "ablate:65536,240,chunked,1024", "rts": 1.0},
+        {"_key": "ablate:262144,240,stacked,2048", "error": "timed out after 2400s"},
+        {"_key": "trainbench:262144,240,2048", "rts": 2.0},
+        "not json at all",
+        {"no_key": True},
+    ]
+    session.write_text(
+        "\n".join(json.dumps(x) if isinstance(x, dict) else x for x in lines) + "\n"
+    )
+    done = load_done(str(session))
+    assert done == {"ablate:65536,240,chunked,1024", "trainbench:262144,240,2048"}
+
+
+def test_load_done_missing_file(tmp_path):
+    assert load_done(str(tmp_path / "absent.jsonl")) == set()
+
+
+def test_plan_keys_unique():
+    keys = [_key(m, a) for m, a, _ in PLAN]
+    assert len(keys) == len(set(keys))
+    # the plan covers both deep engines, both modes, and the train step
+    joined = " ".join(keys)
+    assert "stacked,2048,--grad" in joined
+    assert "chunked,2048,--grad" in joined
+    assert "--no-remat" in joined
+    assert any(k.startswith("trainbench:") for k in keys)
