@@ -405,6 +405,32 @@ def main() -> None:
                 ),
             )
 
+        # Phase 2e (best-effort): the COMPLETE train step at the deep shape —
+        # KAN forward, routing, daily-aggregated masked L1, backward, Adam —
+        # through ddr_tpu.benchmarks.trainbench (the scripts/train.py path).
+        if "deep_value" in out:
+            tval, terr = _run_child(
+                f"import sys; sys.argv = ['trainbench', '{deep_n}', '{t_hours}', "
+                f"'{deep_depth}']; "
+                "from ddr_tpu.benchmarks import trainbench; trainbench.main()",
+                bench_timeout, cpu_only,
+            )
+            if tval:
+                try:
+                    trec = json.loads(tval)
+                    out["train_value"] = trec["rts"]
+                    out["train_metric"] = (
+                        "reach-timesteps/sec/chip, FULL train step (KAN forward + "
+                        f"routing + loss + backward + Adam) on the deep topology, "
+                        f"engine={trec.get('engine', 'unknown')}, "
+                        f"step={trec.get('step_ms', '?')}ms, "
+                        f"peak_hbm_gb={trec.get('peak_hbm_gb')}"
+                    )
+                except (json.JSONDecodeError, KeyError) as e:
+                    out["train_error"] = f"unparseable trainbench output: {e}"
+            elif terr:
+                out["train_error"] = terr
+
     # Phase 3: the reference-equivalent CPU baseline.
     ref, err = _run_child(
         "import bench; print(bench.bench_reference_cpu())", bench_timeout, cpu_only=True

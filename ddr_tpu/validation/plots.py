@@ -1,21 +1,27 @@
 """Diagnostic plots (reference /root/reference/src/ddr/validation/plots.py:18-798).
 
-Same plot inventory as the reference — hydrograph time series, metric CDFs, box
-figures, drainage-area-binned boxplots, gauge maps, routing hydrographs — rendered
-with bare matplotlib (no cartopy/geopandas in this environment; the gauge map is a
-lat/lng scatter). All functions save to a path and return it, and use the Agg backend
-so they run headless.
+Same plot inventory and feature set as the reference — hydrograph time series
+(mass totals + NSE in the legend, extra model lines), metric CDFs (reference
+lines, shared-axes composition), box figures (grouped/notched/5-95 whiskers,
+multi-panel), drainage-area-binned boxplots (multi-model grouped boxes,
+per-bin site counts, publication styling), gauge maps, routing hydrographs
+(date axes, outlet auto-selection) — rendered with bare matplotlib. No
+cartopy/contextily in this environment: the gauge map is a lat/lng scatter
+with an injectable ``basemap`` hook for connected environments (docs/online.md).
+All path-taking functions save and return the path and use the Agg backend so
+they run headless.
 """
 
 from __future__ import annotations
 
 import logging
 from pathlib import Path
-from typing import Any, Sequence
+from typing import Any, Callable, Mapping, Sequence
 
 import matplotlib
 
 matplotlib.use("Agg")
+import matplotlib.dates as mdates
 import matplotlib.pyplot as plt
 import numpy as np
 
@@ -31,12 +37,21 @@ __all__ = [
 
 log = logging.getLogger(__name__)
 
+# The reference's multi-run palette (plots.py:163-189) starts dark-blue/blue/
+# red/deepskyblue; keep the same leading order so side-by-side figures read
+# the same, without the 27-entry repetition.
+_PALETTE = (
+    "darkblue", "blue", "red", "deepskyblue", "black", "darkred", "pink",
+    "gray", "lightgray", "silver", "orchid", "brown",
+)
+# Reference drainage-boxplot model palette ("nature-inspired", plots.py:425).
+_MODEL_PALETTE = ("#82C6E2", "#4878D0", "#D65F5F", "#EE854A")
 
-def _finish(fig, path: str | Path) -> Path:
+
+def _finish(fig, path: str | Path, dpi: int = 120) -> Path:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    fig.tight_layout()
-    fig.savefig(path, dpi=120)
+    fig.savefig(path, dpi=dpi, bbox_inches="tight", facecolor="white")
     plt.close(fig)
     return path
 
@@ -49,83 +64,252 @@ def plot_time_series(
     path: str | Path,
     name: str = "",
     warmup: int = 0,
+    metrics: Mapping[str, float] | None = None,
+    additional_predictions: Sequence[tuple] | None = None,
+    title: str | None = None,
+    xlabel: str | None = None,
 ) -> Path:
-    """Predicted vs observed hydrograph for one gauge (reference plots.py:18-108)."""
-    fig, ax = plt.subplots(figsize=(10, 4))
+    """Predicted vs observed hydrograph for one gauge (reference plots.py:18-93).
+
+    Matches the reference's legend contract: each line carries its mass total
+    ``ΣQ`` and, when ``metrics`` (or a per-entry metrics dict) provides one,
+    its NSE. ``additional_predictions`` entries are ``(values, label)`` or
+    ``(values, label, metrics_dict)`` tuples; ``warmup`` timesteps are trimmed
+    from every plotted series (the reference trims rather than shades)."""
+    fig, ax = plt.subplots(figsize=(10, 5))
     t = np.arange(len(prediction)) if time is None else np.asarray(time)
-    ax.plot(t, np.asarray(observation), label="observed", color="black", lw=1.0)
-    ax.plot(t, np.asarray(prediction), label="predicted", color="tab:blue", lw=1.0)
-    if warmup:
-        ax.axvspan(t[0], t[min(warmup, len(t) - 1)], alpha=0.15, color="gray", label="warmup")
-    ax.set_xlabel("time")
-    ax.set_ylabel("discharge (m³/s)")
-    ax.set_title(f"{name} gauge {gage_id}")
+    t, pred, obs = t[warmup:], np.asarray(prediction)[warmup:], np.asarray(observation)[warmup:]
+
+    obs_label = f"Observation [ΣQ={float(np.nansum(obs)):.1f}]"
+    pred_label = f"DDR [ΣQ={float(np.nansum(pred)):.1f}"
+    if metrics is not None and "nse" in metrics:
+        pred_label += f", NSE: {float(metrics['nse']):.4f}"
+    ax.plot(t, obs, label=obs_label, color="black", lw=1.0)
+    ax.plot(t, pred, label=pred_label + "]", color="tab:blue", lw=1.0)
+    for i, entry in enumerate(additional_predictions or ()):
+        vals, label = np.asarray(entry[0])[warmup:], str(entry[1])
+        extra = entry[2] if len(entry) > 2 else None
+        lbl = f"{label} [ΣQ={float(np.nansum(vals)):.1f}"
+        if extra is not None and "nse" in extra:
+            lbl += f", NSE: {float(extra['nse']):.4f}"
+        # C1, C2, ... — the main prediction already owns tab:blue (C0)
+        ax.plot(t, vals, label=lbl + "]", lw=1.0, color=f"C{i + 1}")
+
+    if xlabel is None:
+        # the production caller plots DAILY timestamps (scripts/train.py); only
+        # claim hours when the axis is a bare sample index
+        xlabel = "Date" if np.issubdtype(np.asarray(t).dtype, np.datetime64) else "Time"
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(r"Discharge $m^3/s$")
+    ax.set_title(
+        title if title is not None else f"Hydrograph - GAGE ID: {gage_id} - Name: {name}"
+    )
     ax.legend(loc="upper right")
+    fig.tight_layout()
     return _finish(fig, path)
 
 
 def plot_cdf(
     metric_sets: dict[str, np.ndarray],
-    path: str | Path,
+    path: str | Path | None = None,
     metric_name: str = "NSE",
-    xlim: tuple[float, float] = (-1.0, 1.0),
-) -> Path:
+    xlim: tuple[float, float] | None = (-1.0, 1.0),
+    reference_line: str | None = None,
+    colors: Sequence[str] | None = None,
+    ax: Any = None,
+) -> Path | Any:
     """Empirical CDFs of a per-gauge metric for one or more runs
-    (reference plots.py:111-227)."""
-    fig, ax = plt.subplots(figsize=(6, 5))
-    for label, values in metric_sets.items():
+    (reference plots.py:111-227).
+
+    ``reference_line``: ``"121"`` adds the y=x diagonal, ``"norm"`` the
+    standard-Gaussian CDF (the reference's two overlays). Passing ``ax``
+    composes into an existing panel and returns the axes instead of saving —
+    ``path`` may then be None."""
+    if ax is None:
+        fig, ax_ = plt.subplots(figsize=(6, 5))
+    else:
+        fig, ax_ = None, ax
+    palette = colors or _PALETTE
+    for i, (label, values) in enumerate(metric_sets.items()):
         v = np.sort(np.asarray(values)[np.isfinite(values)])
         if v.size == 0:
             continue
         cdf = np.arange(1, v.size + 1) / v.size
         med = float(np.median(v))
-        ax.plot(v, cdf, label=f"{label} (median {med:.3f})")
-    ax.set_xlim(*xlim)
-    ax.set_xlabel(metric_name)
-    ax.set_ylabel("CDF")
-    ax.grid(alpha=0.3)
-    ax.legend(loc="upper left")
+        ax_.plot(v, cdf, color=palette[i % len(palette)], label=f"{label} (median {med:.3f})")
+    if reference_line == "121":
+        ax_.plot([0, 1], [0, 1], "k", label="y=x")
+    elif reference_line == "norm":
+        from scipy import stats as _stats
+
+        grid = np.linspace(-5, 5, 1000)
+        ax_.plot(grid, _stats.norm.cdf(grid), "k", label="Gaussian")
+    if xlim is not None:
+        ax_.set_xlim(*xlim)
+    ax_.set_xlabel(metric_name)
+    ax_.set_ylabel("CDF")
+    ax_.grid(alpha=0.3)
+    ax_.legend(loc="best", frameon=False)
+    if fig is None:
+        return ax_
+    fig.tight_layout()
     return _finish(fig, path)
 
 
 def plot_box_fig(
-    data: Sequence[np.ndarray],
+    data: Sequence,
     labels: Sequence[str],
     path: str | Path,
     ylabel: str = "NSE",
     title: str = "",
+    legend_labels: Sequence[str] | None = None,
+    colors: Sequence[str] | None = None,
+    sharey: bool = True,
 ) -> Path:
-    """Side-by-side boxplots of metric distributions (reference plots.py:230-373)."""
-    fig, ax = plt.subplots(figsize=(1.5 * max(4, len(labels)), 5))
-    clean = [np.asarray(d)[np.isfinite(d)] for d in data]
-    ax.boxplot(clean, tick_labels=list(labels), showfliers=False)
-    ax.set_ylabel(ylabel)
-    ax.set_title(title)
-    ax.grid(alpha=0.3, axis="y")
+    """Box plots of metric distributions (reference plots.py:230-373).
+
+    Flat form: ``data`` is a sequence of arrays -> one panel of side-by-side
+    boxes labeled by ``labels``. Grouped form (the reference's multi-panel
+    figure): each ``data[i]`` is itself a sequence of arrays -> one panel per
+    ``labels[i]`` with grouped boxes colored per model and a shared figure
+    legend from ``legend_labels``. Boxes are notched, patch-filled, whiskers
+    at the 5-95 percentiles, fliers hidden — the reference's styling."""
+    # Grouped iff the elements are themselves collections of ARRAY-LIKES; a
+    # flat call passing plain Python lists of floats (the old signature's
+    # Sequence[np.ndarray] loosely honored) must stay one panel of boxes.
+    grouped = (
+        len(data) > 0
+        and isinstance(data[0], (list, tuple))
+        and len(data[0]) > 0
+        and np.ndim(data[0][0]) >= 1
+    )
+    palette = colors or _MODEL_PALETTE
+    box_kw = dict(notch=True, showfliers=False, patch_artist=True, whis=(5, 95), widths=0.5)
+
+    def _clean(arrs):
+        return [
+            (lambda a: a[np.isfinite(a)] if a.size else np.array([np.nan]))(np.asarray(d, float))
+            for d in arrs
+        ]
+
+    if not grouped:
+        fig, ax = plt.subplots(figsize=(1.5 * max(4, len(labels)), 5))
+        bp = ax.boxplot(_clean(data), tick_labels=list(labels), **box_kw)
+        for j, patch in enumerate(bp["boxes"]):
+            patch.set_facecolor(palette[j % len(palette)])
+            patch.set_alpha(0.8)
+        ax.set_ylabel(ylabel)
+        ax.set_title(title)
+        ax.grid(alpha=0.3, axis="y")
+    else:
+        ncols = len(data)
+        fig, axes = plt.subplots(
+            ncols=ncols, nrows=1, sharey=sharey,
+            figsize=(max(6, 2.2 * ncols), 5), constrained_layout=True,
+        )
+        axes = np.atleast_1d(axes)
+        bp = None
+        for i, (ax, group) in enumerate(zip(axes, data)):
+            bp = ax.boxplot(_clean(group), **box_kw)
+            for j, patch in enumerate(bp["boxes"]):
+                patch.set_facecolor(palette[j % len(palette)])
+                patch.set_alpha(0.8)
+            ax.set_xlabel(labels[i])
+            ax.set_xticks([])
+            ax.grid(alpha=0.3, axis="y")
+        axes[0].set_ylabel(ylabel)
+        if legend_labels and bp is not None:
+            fig.legend(
+                bp["boxes"], list(legend_labels), loc="lower center",
+                bbox_to_anchor=(0.5, -0.08), frameon=False, ncol=len(legend_labels),
+            )
+        if title:
+            fig.suptitle(title)
+        return _finish(fig, path)
+    fig.tight_layout()
     return _finish(fig, path)
 
 
 def plot_drainage_area_boxplots(
-    metric_values: np.ndarray,
+    metric_values: np.ndarray | Mapping[str, np.ndarray],
     drainage_areas: np.ndarray,
     path: str | Path,
     metric_name: str = "NSE",
     bins: Sequence[float] = (0, 500, 1000, 5000, 10000, np.inf),
+    colors: Sequence[str] | None = None,
+    y_limits: tuple[float, float] | None = None,
+    title: str | None = None,
 ) -> Path:
-    """Metric distribution binned by gauge drainage area (reference plots.py:376-587)."""
-    metric_values = np.asarray(metric_values, dtype=float)
+    """Metric distributions binned by gauge drainage area (reference
+    plots.py:376-587).
+
+    Single-model form: ``metric_values`` is one per-gauge array. Multi-model
+    form (the reference's grouped figure): a ``{model_name: values}`` mapping
+    draws one colored box per model inside each area bin, with a square-marker
+    legend. Both forms annotate each bin with its site count and separate bins
+    with dashed boundaries."""
+    models = (
+        dict(metric_values)
+        if isinstance(metric_values, Mapping)
+        else {metric_name: np.asarray(metric_values, float)}
+    )
     drainage_areas = np.asarray(drainage_areas, dtype=float)
-    groups, labels = [], []
-    for lo, hi in zip(bins[:-1], bins[1:]):
-        mask = (drainage_areas >= lo) & (drainage_areas < hi) & np.isfinite(metric_values)
-        groups.append(metric_values[mask])
-        hi_label = "∞" if np.isinf(hi) else f"{hi:g}"
-        labels.append(f"{lo:g}-{hi_label}\n(n={int(mask.sum())})")
-    fig, ax = plt.subplots(figsize=(1.6 * len(groups), 5))
-    ax.boxplot([g if g.size else np.array([np.nan]) for g in groups], tick_labels=labels, showfliers=False)
-    ax.set_xlabel("drainage area (km²)")
+    palette = colors or _MODEL_PALETTE
+    n_bins = len(bins) - 1
+    bin_members = [
+        (drainage_areas >= lo) & (drainage_areas < hi) for lo, hi in zip(bins[:-1], bins[1:])
+    ]
+    bin_labels = [
+        f"{lo:g}~{'∞' if np.isinf(hi) else f'{hi:g}'}" for lo, hi in zip(bins[:-1], bins[1:])
+    ]
+
+    fig, ax = plt.subplots(figsize=(max(8, 2.2 * n_bins), 5.5), constrained_layout=True)
+    bin_width = 5.0
+    model_width = bin_width / (len(models) + 2)
+    for j, (mname, values) in enumerate(models.items()):
+        values = np.asarray(values, dtype=float)
+        offset = (j - (len(models) - 1) / 2) * model_width
+        groups, positions = [], []
+        for i, member in enumerate(bin_members):
+            sel = values[member & np.isfinite(values)]
+            groups.append(sel if sel.size else np.array([np.nan]))
+            positions.append(i * bin_width + bin_width / 2 + offset)
+        ax.boxplot(
+            groups, positions=positions, widths=model_width * 0.8,
+            showfliers=False, patch_artist=True,
+            boxprops={"facecolor": palette[j % len(palette)], "alpha": 0.8, "linewidth": 1.2},
+            medianprops={"color": "black", "linewidth": 1.8},
+        )
+    # per-bin site counts above the panel + dashed bin boundaries (reference's
+    # annotation scheme)
+    y_top = ax.get_ylim()[1] if y_limits is None else y_limits[1]
+    for i, member in enumerate(bin_members):
+        ax.text(
+            i * bin_width + bin_width / 2, y_top, f"{int(member.sum())} sites",
+            ha="center", va="bottom", fontsize=9, color="#333333",
+        )
+    for i in range(n_bins + 1):
+        ax.axvline(i * bin_width, color="#333333", linestyle="--", lw=1.0, alpha=0.6)
+    ax.set_xlim(-0.5, n_bins * bin_width + 0.5)
+    if y_limits is not None:
+        ax.set_ylim(*y_limits)
+    ax.set_xticks([i * bin_width + bin_width / 2 for i in range(n_bins)])
+    ax.set_xticklabels(bin_labels)
+    ax.set_xlabel(r"Drainage area (km$^2$)")
     ax.set_ylabel(metric_name)
-    ax.grid(alpha=0.3, axis="y")
+    ax.grid(alpha=0.3, axis="y", linestyle="--")
+    if len(models) > 1:
+        handles = [
+            plt.Line2D(
+                [0], [0], color="#333333", lw=0, marker="s", markersize=9,
+                markerfacecolor=palette[j % len(palette)], markeredgecolor="black",
+                label=mname,
+            )
+            for j, mname in enumerate(models)
+        ]
+        ax.legend(handles=handles, loc="lower left", frameon=True, framealpha=0.9)
+    if title:
+        ax.set_title(title, pad=18)
     return _finish(fig, path)
 
 
@@ -135,21 +319,47 @@ def plot_gauge_map(
     values: np.ndarray,
     path: str | Path,
     metric_name: str = "NSE",
-    vmin: float = -1.0,
-    vmax: float = 1.0,
+    vmin: float | None = -1.0,
+    vmax: float | None = 1.0,
+    colormap: str = "RdYlBu",
+    point_size: int = 18,
+    alpha: float = 0.8,
+    aspect_ratio: float | None = None,
+    padding: float = 0.5,
+    title: str | None = None,
+    basemap: Callable[[Any], None] | None = None,
 ) -> Path:
-    """Gauge locations colored by metric (reference plots.py:590-738; plain lat/lng
-    scatter — no basemap libraries in this environment)."""
-    fig, ax = plt.subplots(figsize=(9, 6))
+    """Gauge locations colored by metric (reference plots.py:590-706).
+
+    No basemap libraries exist in this environment, so the default is a plain
+    lat/lng scatter with the reference's extent/aspect/colorbar handling; a
+    connected environment passes ``basemap=lambda ax: contextily.add_basemap(
+    ax, crs="EPSG:4326")`` to restore tiles (docs/online.md)."""
+    lats, lngs = np.asarray(lats), np.asarray(lngs)
+    fig, ax = plt.subplots(figsize=(10, 4))
     sc = ax.scatter(
-        np.asarray(lngs), np.asarray(lats), c=np.asarray(values), cmap="RdYlBu",
-        vmin=vmin, vmax=vmax, s=18, edgecolors="k", linewidths=0.2,
+        lngs, lats, c=np.asarray(values), cmap=colormap,
+        vmin=vmin, vmax=vmax, s=point_size, alpha=alpha,
+        edgecolors="none",
     )
-    fig.colorbar(sc, ax=ax, label=metric_name)
-    ax.set_xlabel("longitude")
-    ax.set_ylabel("latitude")
-    ax.set_title(f"gauge {metric_name}")
-    return _finish(fig, path)
+    cbar = fig.colorbar(sc, ax=ax)
+    cbar.set_label(metric_name)
+    if aspect_ratio is not None:
+        ax.set_aspect(aspect_ratio)
+    if lngs.size:
+        ax.set_xlim(lngs.min() - padding, lngs.max() + padding)
+        ax.set_ylim(lats.min() - padding, lats.max() + padding)
+    # Hook runs AFTER the extent is final: tile providers raster for the
+    # current axes limits, so calling earlier would fetch the wrong extent.
+    if basemap is not None:
+        try:
+            basemap(ax)
+        except Exception as e:  # tiles are decoration; the data layer must survive
+            log.warning(f"basemap hook failed, rendering without tiles: {e}")
+    ax.set_xlabel("Longitude")
+    ax.set_ylabel("Latitude")
+    ax.set_title(title if title is not None else f"gauge {metric_name}")
+    return _finish(fig, path, dpi=150)
 
 
 def select_plot_segments(
@@ -220,16 +430,28 @@ def plot_routing_hydrograph(
     segment_ids: Sequence[Any],
     path: str | Path,
     title: str = "routed discharge",
+    dpi: int = 150,
 ) -> Path:
-    """Hydrographs for selected segments of a routing run (reference plots.py:741-798)."""
+    """Hydrographs for selected segments of a routing run (reference
+    plots.py:741-798): date-formatted x axis when ``time`` is datetime-like,
+    top/right spines removed, per-segment legend."""
     discharge = np.atleast_2d(np.asarray(discharge))
     t = np.arange(discharge.shape[1]) if time is None else np.asarray(time)
-    fig, ax = plt.subplots(figsize=(10, 4))
+    fig, ax = plt.subplots(figsize=(10, 4.5))
     for i, seg in enumerate(segment_ids):
-        ax.plot(t, discharge[i], lw=1.0, label=str(seg))
-    ax.set_xlabel("time")
-    ax.set_ylabel("discharge (m³/s)")
+        ax.plot(t, discharge[i], lw=1.2, label=f"Segment {seg}")
+    if np.issubdtype(np.asarray(t).dtype, np.datetime64):
+        ax.xaxis.set_major_formatter(mdates.DateFormatter("%Y-%m-%d"))
+        ax.xaxis.set_major_locator(mdates.AutoDateLocator())
+        fig.autofmt_xdate(rotation=30)
+        ax.set_xlabel("Date")
+    else:
+        ax.set_xlabel("time")
+    ax.set_ylabel(r"Discharge (m$^3$/s)")
     ax.set_title(title)
-    if len(segment_ids) <= 12:
-        ax.legend(loc="upper right", fontsize=8)
-    return _finish(fig, path)
+    ax.spines["top"].set_visible(False)
+    ax.spines["right"].set_visible(False)
+    if len(segment_ids) <= 12:  # incl. single segment: the legend carries its id
+        ax.legend(loc="upper right", fontsize=8, frameon=False)
+    fig.tight_layout()
+    return _finish(fig, path, dpi=dpi)

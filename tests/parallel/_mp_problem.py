@@ -1,0 +1,81 @@
+"""The shared synthetic GSPMD train-step problem for the multi-process tests.
+
+One definition, imported by BOTH the in-test single-process comparison and the
+two worker subprocesses (which run with cwd=repo root, so ``tests.parallel``
+is importable) — the comparison is only meaningful if all three processes
+construct the identical problem, and a hand-synchronized copy would drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
+    """Build the fixed seed-3 synthetic basin, run ONE GSPMD train step over an
+    ``n_mesh_devices``-device mesh, and return {loss, param_digest}."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+    from ddr_tpu.nn.kan import Kan
+    from ddr_tpu.parallel import make_mesh, reach_sharding, shard_channels, shard_network
+    from ddr_tpu.routing.mc import Bounds
+    from ddr_tpu.routing.model import prepare_batch
+    from ddr_tpu.training import make_batch_train_step, make_optimizer
+    from ddr_tpu.validation.configs import Config
+
+    cfg = Config(
+        name="multiprocess_test",
+        geodataset="synthetic",
+        mode="training",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/08",
+            "rho": 6,
+            "warmup": 1,
+        },
+        params={"save_path": "/tmp"},
+    )
+    basin = observe(make_basin(n_segments=96, n_gauges=4, n_days=8, seed=3), cfg)
+    rd = basin.routing_data
+    network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    kan_model = Kan(
+        input_var_names=tuple(cfg.kan.input_var_names),
+        learnable_parameters=tuple(cfg.kan.learnable_parameters),
+        hidden_size=cfg.kan.hidden_size,
+        num_hidden_layers=cfg.kan.num_hidden_layers,
+        grid=cfg.kan.grid,
+        k=cfg.kan.k,
+    )
+    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+    params = kan_model.init(jax.random.key(0), attrs)
+    optimizer = make_optimizer(1e-3)
+    opt_state = optimizer.init(params)
+    step = make_batch_train_step(
+        kan_model,
+        Bounds.from_config(cfg.params.attribute_minimums),
+        cfg.params.parameter_ranges,
+        cfg.params.log_space_parameters,
+        cfg.params.defaults,
+        tau=cfg.params.tau,
+        warmup=1,
+        optimizer=optimizer,
+    )
+    obs = jnp.asarray(basin.obs_daily)
+    mask = jnp.ones_like(obs, dtype=bool)
+    q_prime = jnp.asarray(basin.q_prime)
+
+    mesh = make_mesh(n_mesh_devices)
+    with mesh:
+        params2, _, loss, _ = step(
+            params, opt_state,
+            shard_network(mesh, network), shard_channels(mesh, channels), gauges,
+            jax.device_put(attrs, reach_sharding(mesh, 0, 2)),
+            jax.device_put(q_prime, reach_sharding(mesh, 1, 2)),
+            obs, mask,
+        )
+    leaves = jax.tree_util.tree_leaves(params2)
+    digest = float(sum(np.abs(np.asarray(x)).sum() for x in leaves))
+    return {"loss": float(loss), "param_digest": digest}

@@ -21,7 +21,6 @@ import subprocess
 import sys
 from pathlib import Path
 
-import numpy as np
 import pytest
 
 from ddr_tpu.parallel.distributed import distributed_env
@@ -29,83 +28,23 @@ from ddr_tpu.parallel.distributed import distributed_env
 REPO = Path(__file__).resolve().parents[2]
 
 WORKER = r"""
-import json, os, sys
+import json
 
 from ddr_tpu.parallel.distributed import maybe_initialize
 
 assert maybe_initialize() is True
 import jax
-import jax.numpy as jnp
-import numpy as np
 
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8, len(jax.devices())
 assert len(jax.local_devices()) == 4, len(jax.local_devices())
 
-from ddr_tpu.geodatazoo.synthetic import make_basin, observe
-from ddr_tpu.nn.kan import Kan
-from ddr_tpu.parallel import make_mesh, reach_sharding, shard_channels, shard_network
-from ddr_tpu.routing.mc import Bounds
-from ddr_tpu.routing.model import prepare_batch
-from ddr_tpu.training import make_batch_train_step, make_optimizer
-from ddr_tpu.validation.configs import Config
+# cwd is the repo root, so the SHARED problem definition is importable — the
+# single-process comparison in the parent test runs this exact function.
+from tests.parallel._mp_problem import run_gspmd_step
 
-cfg = Config(
-    name="multiprocess_test",
-    geodataset="synthetic",
-    mode="training",
-    kan={"input_var_names": [f"a{i}" for i in range(10)]},
-    experiment={"start_time": "1981/10/01", "end_time": "1981/10/08", "rho": 6, "warmup": 1},
-    params={"save_path": "/tmp"},
-)
-basin = observe(make_basin(n_segments=96, n_gauges=4, n_days=8, seed=3), cfg)
-rd = basin.routing_data
-network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
-kan_model = Kan(
-    input_var_names=tuple(cfg.kan.input_var_names),
-    learnable_parameters=tuple(cfg.kan.learnable_parameters),
-    hidden_size=cfg.kan.hidden_size,
-    num_hidden_layers=cfg.kan.num_hidden_layers,
-    grid=cfg.kan.grid,
-    k=cfg.kan.k,
-)
-attrs = jnp.asarray(rd.normalized_spatial_attributes)
-params = kan_model.init(jax.random.key(0), attrs)
-optimizer = make_optimizer(1e-3)
-opt_state = optimizer.init(params)
-step = make_batch_train_step(
-    kan_model,
-    Bounds.from_config(cfg.params.attribute_minimums),
-    cfg.params.parameter_ranges,
-    cfg.params.log_space_parameters,
-    cfg.params.defaults,
-    tau=cfg.params.tau,
-    warmup=1,
-    optimizer=optimizer,
-)
-obs = jnp.asarray(basin.obs_daily)
-mask = jnp.ones_like(obs, dtype=bool)
-q_prime = jnp.asarray(basin.q_prime)
-
-mesh = make_mesh(8)  # global mesh: spans both processes
-with mesh:
-    params2, _, loss, _ = step(
-        params, opt_state,
-        shard_network(mesh, network), shard_channels(mesh, channels), gauges,
-        jax.device_put(attrs, reach_sharding(mesh, 0, 2)),
-        jax.device_put(q_prime, reach_sharding(mesh, 1, 2)),
-        obs, mask,
-    )
-
-# loss is replicated; the updated KAN params are replicated too — digest them
-# so the parent can assert both processes computed the same update.
-leaves = jax.tree_util.tree_leaves(params2)
-digest = float(sum(np.abs(np.asarray(x)).sum() for x in leaves))
-print("RESULT " + json.dumps({
-    "process": jax.process_index(),
-    "loss": float(loss),
-    "param_digest": digest,
-}))
+result = run_gspmd_step(8)  # global mesh: spans both processes
+print("RESULT " + json.dumps({"process": jax.process_index(), **result}))
 """
 
 
@@ -192,68 +131,14 @@ def test_two_process_gspmd_train_step_matches_single_process():
     )
 
     # and the two-process result matches this (single-process, 8-device) process
-    # running the identical problem — the in-suite GSPMD test already pins that
-    # loss against the unsharded step, so transitively all three agree.
-    import jax
-    import jax.numpy as jnp
+    # running the IDENTICAL problem (same shared builder the workers import) —
+    # the in-suite GSPMD test already pins that loss against the unsharded
+    # step, so transitively all three agree.
+    from tests.parallel._mp_problem import run_gspmd_step
 
-    from ddr_tpu.geodatazoo.synthetic import make_basin, observe
-    from ddr_tpu.nn.kan import Kan
-    from ddr_tpu.parallel import make_mesh, reach_sharding, shard_channels, shard_network
-    from ddr_tpu.routing.mc import Bounds
-    from ddr_tpu.routing.model import prepare_batch
-    from ddr_tpu.training import make_batch_train_step, make_optimizer
-    from ddr_tpu.validation.configs import Config
-
-    cfg = Config(
-        name="multiprocess_test",
-        geodataset="synthetic",
-        mode="training",
-        kan={"input_var_names": [f"a{i}" for i in range(10)]},
-        experiment={"start_time": "1981/10/01", "end_time": "1981/10/08", "rho": 6, "warmup": 1},
-        params={"save_path": "/tmp"},
-    )
-    basin = observe(make_basin(n_segments=96, n_gauges=4, n_days=8, seed=3), cfg)
-    rd = basin.routing_data
-    network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
-    kan_model = Kan(
-        input_var_names=tuple(cfg.kan.input_var_names),
-        learnable_parameters=tuple(cfg.kan.learnable_parameters),
-        hidden_size=cfg.kan.hidden_size,
-        num_hidden_layers=cfg.kan.num_hidden_layers,
-        grid=cfg.kan.grid,
-        k=cfg.kan.k,
-    )
-    attrs = jnp.asarray(rd.normalized_spatial_attributes)
-    params = kan_model.init(jax.random.key(0), attrs)
-    optimizer = make_optimizer(1e-3)
-    opt_state = optimizer.init(params)
-    step = make_batch_train_step(
-        kan_model,
-        Bounds.from_config(cfg.params.attribute_minimums),
-        cfg.params.parameter_ranges,
-        cfg.params.log_space_parameters,
-        cfg.params.defaults,
-        tau=cfg.params.tau,
-        warmup=1,
-        optimizer=optimizer,
-    )
-    obs = jnp.asarray(basin.obs_daily)
-    mask = jnp.ones_like(obs, dtype=bool)
-    q_prime = jnp.asarray(basin.q_prime)
-    mesh = make_mesh(8)
-    with mesh:
-        params2, _, loss, _ = step(
-            params, opt_state,
-            shard_network(mesh, network), shard_channels(mesh, channels), gauges,
-            jax.device_put(attrs, reach_sharding(mesh, 0, 2)),
-            jax.device_put(q_prime, reach_sharding(mesh, 1, 2)),
-            obs, mask,
-        )
-    leaves = jax.tree_util.tree_leaves(params2)
-    digest = float(sum(np.abs(np.asarray(x)).sum() for x in leaves))
-    assert results[0]["loss"] == pytest.approx(float(loss), rel=1e-5)
-    assert results[0]["param_digest"] == pytest.approx(digest, rel=1e-6)
+    single = run_gspmd_step(8)
+    assert results[0]["loss"] == pytest.approx(single["loss"], rel=1e-5)
+    assert results[0]["param_digest"] == pytest.approx(single["param_digest"], rel=1e-6)
 
 
 class TestDistributedFlagParsing:

@@ -50,3 +50,88 @@ def test_all_plots_render(tmp_path, metric_fixture):
     assert plots.plot_routing_hydrograph(
         rng.uniform(0, 5, (3, 40)), t, ["a", "b", "c"], tmp_path / "hydro.png"
     ).exists()
+
+
+class TestReferenceFidelityFeatures:
+    """Round-4 plot upgrades toward the reference's feature set
+    (reference plots.py:18-798): legend mass/NSE annotations, extra model
+    lines, CDF reference lines + panel composition, grouped/multi-panel box
+    figures, multi-model drainage-area boxes, datetime hydrograph axes, and
+    the injectable gauge-map basemap hook."""
+
+    def test_time_series_metrics_and_additional_predictions(self, tmp_path):
+        rng = np.random.default_rng(0)
+        obs = rng.uniform(1, 5, 30)
+        p = plots.plot_time_series(
+            obs + 0.1, obs, None, "g1", tmp_path / "ts.png",
+            warmup=3, metrics={"nse": 0.91},
+            additional_predictions=[
+                (obs + 0.2, "other"),
+                (obs + 0.3, "third", {"nse": 0.5}),
+            ],
+            title="custom",
+        )
+        assert p.exists()
+
+    def test_cdf_reference_lines_and_ax_composition(self, tmp_path, metric_fixture):
+        import matplotlib.pyplot as plt
+
+        assert plots.plot_cdf(
+            {"a": metric_fixture.nse}, tmp_path / "c1.png", reference_line="121"
+        ).exists()
+        assert plots.plot_cdf(
+            {"a": metric_fixture.corr}, tmp_path / "c2.png", reference_line="norm",
+            xlim=(-3, 3),
+        ).exists()
+        fig, axes = plt.subplots(ncols=2)
+        out = plots.plot_cdf({"a": metric_fixture.nse}, ax=axes[0])
+        assert out is axes[0]  # composed, not saved
+        plt.close(fig)
+
+    def test_grouped_box_fig(self, tmp_path, metric_fixture):
+        p = plots.plot_box_fig(
+            [
+                [metric_fixture.nse, metric_fixture.nse - 0.1],
+                [metric_fixture.kge, metric_fixture.kge - 0.1],
+            ],
+            ["NSE", "KGE"],
+            tmp_path / "grouped.png",
+            legend_labels=["model A", "model B"],
+            title="comparison",
+        )
+        assert p.exists()
+
+    def test_multi_model_drainage_boxplots(self, tmp_path, metric_fixture):
+        rng = np.random.default_rng(2)
+        areas = rng.uniform(10, 20000, metric_fixture.nse.size)
+        p = plots.plot_drainage_area_boxplots(
+            {"DDR": metric_fixture.nse, "baseline": metric_fixture.nse - 0.2},
+            areas, tmp_path / "da_multi.png", y_limits=(0.0, 1.0), title="by area",
+        )
+        assert p.exists()
+
+    def test_routing_hydrograph_datetime_axis(self, tmp_path):
+        rng = np.random.default_rng(3)
+        t = np.arange("2000-01-01", "2000-01-31", dtype="datetime64[D]")
+        p = plots.plot_routing_hydrograph(
+            rng.uniform(0, 5, (2, t.size)), t, ["a", "b"], tmp_path / "dt.png"
+        )
+        assert p.exists()
+
+    def test_gauge_map_basemap_hook_failure_tolerated(self, tmp_path, metric_fixture):
+        def broken(ax):
+            raise RuntimeError("no tiles here")
+
+        p = plots.plot_gauge_map(
+            np.linspace(30, 45, 6), np.linspace(-120, -70, 6), metric_fixture.nse,
+            tmp_path / "map.png", basemap=broken, aspect_ratio=1.7,
+        )
+        assert p.exists()
+
+    def test_flat_plain_lists_stay_one_panel(self, tmp_path):
+        """Flat data passed as plain Python lists (the old loose signature) must
+        render one panel of boxes, not be misread as the grouped form."""
+        p = plots.plot_box_fig(
+            [[0.1, 0.5, 0.9], [0.2, 0.6]], ["NSE", "KGE"], tmp_path / "flat.png"
+        )
+        assert p.exists()
