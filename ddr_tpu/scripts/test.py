@@ -68,6 +68,24 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
     warmup = cfg.experiment.warmup
     metrics = Metrics(pred=daily_runoff[:, warmup:], target=daily_obs[:, warmup:])
     log_metrics(metrics, header="Test evaluation")
+
+    # Evaluation figures straight from the run (the reference defers these to a
+    # notebook, /root/reference/scripts/test.py:114): metric CDF + distribution
+    # boxes per gauge battery, saved next to the result store.
+    try:
+        from ddr_tpu.validation.plots import plot_box_fig, plot_cdf
+
+        plot_dir = Path(cfg.params.save_path) / "plots"
+        plot_cdf({cfg.name: metrics.nse}, plot_dir / "test_nse_cdf.png")
+        plot_box_fig(
+            [metrics.nse, metrics.kge, metrics.corr],
+            ["NSE", "KGE", "r"],
+            plot_dir / "test_metric_boxes.png",
+            title=f"{cfg.name} test metrics ({metrics.ngrid} gauges)",
+        )
+    except Exception as e:  # plotting must never fail the evaluation
+        log.warning(f"evaluation plots failed: {e}")
+
     log.info(f"Test run complete; results in {out_path}")
     return metrics
 

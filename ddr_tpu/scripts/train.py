@@ -142,6 +142,11 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
 
                 gage_ids = rd.observations.gage_ids
+                # Legend NSE over the SAME post-warmup window the curve shows
+                # (plot_time_series trims warmup; the batch `metrics` above
+                # include it) — reference train.py:135-144's annotation.
+                w = cfg.experiment.warmup
+                plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
                 plot_time_series(
                     daily[:, -1],
                     target[:, -1],
@@ -149,7 +154,8 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     gage_ids[-1],
                     cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
                     name=cfg.name,
-                    warmup=cfg.experiment.warmup,
+                    warmup=w,
+                    metrics={"nse": float(plotted.nse[0])},
                 )
                 save_state(
                     cfg.params.save_path / "saved_models",

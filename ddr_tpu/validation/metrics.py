@@ -34,13 +34,6 @@ def _rmse(pred, target, axis=1):
     return np.sqrt(_nanmean((pred - target) ** 2, axis=axis))
 
 
-def _p_bias(pred, target):
-    denom = np.sum(target)
-    if denom == 0:
-        return np.nan
-    return np.sum(pred - target) / denom * 100.0
-
-
 @dataclasses.dataclass
 class Metrics:
     """Per-gauge metrics over (n_gauges, n_time) prediction/target arrays."""

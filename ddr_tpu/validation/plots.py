@@ -125,6 +125,8 @@ def plot_cdf(
     standard-Gaussian CDF (the reference's two overlays). Passing ``ax``
     composes into an existing panel and returns the axes instead of saving —
     ``path`` may then be None."""
+    if ax is None and path is None:
+        raise ValueError("plot_cdf needs a save path (or an ax to compose into)")
     if ax is None:
         fig, ax_ = plt.subplots(figsize=(6, 5))
     else:
@@ -186,18 +188,23 @@ def plot_box_fig(
     palette = colors or _MODEL_PALETTE
     box_kw = dict(notch=True, showfliers=False, patch_artist=True, whis=(5, 95), widths=0.5)
 
-    def _clean(arrs):
-        return [
-            (lambda a: a[np.isfinite(a)] if a.size else np.array([np.nan]))(np.asarray(d, float))
-            for d in arrs
-        ]
+    def _clean1(d):
+        # filter FIRST, placeholder after: an all-NaN group must render the
+        # NaN placeholder box (as in plot_drainage_area_boxplots), not vanish
+        a = np.asarray(d, float)
+        a = a[np.isfinite(a)]
+        return a if a.size else np.array([np.nan])
 
-    if not grouped:
-        fig, ax = plt.subplots(figsize=(1.5 * max(4, len(labels)), 5))
-        bp = ax.boxplot(_clean(data), tick_labels=list(labels), **box_kw)
+    def _colored_boxplot(ax, arrs, **kw):
+        bp = ax.boxplot([_clean1(d) for d in arrs], **box_kw, **kw)
         for j, patch in enumerate(bp["boxes"]):
             patch.set_facecolor(palette[j % len(palette)])
             patch.set_alpha(0.8)
+        return bp
+
+    if not grouped:
+        fig, ax = plt.subplots(figsize=(1.5 * max(4, len(labels)), 5))
+        _colored_boxplot(ax, data, tick_labels=list(labels))
         ax.set_ylabel(ylabel)
         ax.set_title(title)
         ax.grid(alpha=0.3, axis="y")
@@ -210,10 +217,7 @@ def plot_box_fig(
         axes = np.atleast_1d(axes)
         bp = None
         for i, (ax, group) in enumerate(zip(axes, data)):
-            bp = ax.boxplot(_clean(group), **box_kw)
-            for j, patch in enumerate(bp["boxes"]):
-                patch.set_facecolor(palette[j % len(palette)])
-                patch.set_alpha(0.8)
+            bp = _colored_boxplot(ax, group)
             ax.set_xlabel(labels[i])
             ax.set_xticks([])
             ax.grid(alpha=0.3, axis="y")

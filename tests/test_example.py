@@ -57,6 +57,10 @@ class TestExampleEndToEnd:
         assert store.exists(), "ddr test wrote no model_test.zarr"
         root = zarrlite.open_group(store)
         assert any(True for _ in root.keys()), "model_test.zarr is empty"
+        # and the in-run evaluation figures (round 4: the reference defers
+        # these to a notebook; ddr test emits them directly)
+        assert (tmp / "output" / "plots" / "test_nse_cdf.png").exists()
+        assert (tmp / "output" / "plots" / "test_metric_boxes.png").exists()
 
     def test_benchmark_compares_against_lti(self, example_run):
         _, cfg, fast, _ = example_run

@@ -135,3 +135,14 @@ class TestReferenceFidelityFeatures:
             [[0.1, 0.5, 0.9], [0.2, 0.6]], ["NSE", "KGE"], tmp_path / "flat.png"
         )
         assert p.exists()
+
+    def test_cdf_requires_path_or_ax(self, metric_fixture):
+        with pytest.raises(ValueError, match="save path"):
+            plots.plot_cdf({"a": metric_fixture.nse})
+
+    def test_all_nan_group_renders_placeholder(self, tmp_path):
+        p = plots.plot_box_fig(
+            [np.full(5, np.nan), np.array([0.1, 0.2, 0.3])], ["empty", "ok"],
+            tmp_path / "nanbox.png",
+        )
+        assert p.exists()
