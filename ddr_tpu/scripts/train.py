@@ -146,7 +146,10 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 # (plot_time_series trims warmup; the batch `metrics` above
                 # include it) — reference train.py:135-144's annotation.
                 w = cfg.experiment.warmup
-                plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
+                legend = None
+                if w < daily.shape[0]:  # an all-warmup window has no score to print
+                    plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
+                    legend = {"nse": float(plotted.nse[0])}
                 plot_time_series(
                     daily[:, -1],
                     target[:, -1],
@@ -155,7 +158,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
                     name=cfg.name,
                     warmup=w,
-                    metrics={"nse": float(plotted.nse[0])},
+                    metrics=legend,
                 )
                 save_state(
                     cfg.params.save_path / "saved_models",

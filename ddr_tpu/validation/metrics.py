@@ -61,6 +61,8 @@ class Metrics:
     def _fdc(self, data: np.ndarray) -> np.ndarray:
         """100-point flow duration curve per gauge (exceedance-sorted);
         all-NaN gauges yield the reference's all-zero curve."""
+        if data.shape[1] == 0:  # zero-length series: the all-zero curve
+            return np.zeros((data.shape[0], 100))
         valid = ~np.isnan(data)
         kv = valid.sum(axis=1)
         srt = np.sort(np.where(valid, data, -np.inf), axis=1)[:, ::-1]
@@ -86,6 +88,15 @@ class Metrics:
         for the moment-based metrics.
         """
         g, t = self.ngrid, self.nt
+        if t == 0:
+            # zero-length series: every metric NaN (matching the k==0 gauge
+            # contract); reductions below have no identity on a 0 axis
+            for nm in (
+                "bias rmse mae ub_rmse fdc_rmse corr corr_spearman r2 nse flv "
+                "fhv pbias pbias_mid kge kge_12 rmse_low rmse_high rmse_mid"
+            ).split():
+                setattr(self, nm, np.full(g, np.nan))
+            return
         self.bias = _nanmean(self.pred - self.target, axis=1)
         self.rmse = _rmse(self.pred, self.target)
         self.mae = _nanmean(np.abs(self.pred - self.target), axis=1)

@@ -83,6 +83,7 @@ def test_carry_state_parity():
     assert _rel(final, ref.final_discharge) < 1e-4
 
 
+@pytest.mark.slow
 def test_gradient_parity_with_step_engine():
     n, depth, T = 400, 100, 8
     rows, cols, net, channels, params, qp = _setup(n, depth, T, seed=4)

@@ -80,6 +80,7 @@ class TestForwardParity:
         assert real_boundary == sched.n_boundary
 
 
+@pytest.mark.slow
 class TestGradients:
     def test_grad_matches_single_program(self):
         """Parameter gradients through psum + ring must equal the single-program

@@ -96,6 +96,7 @@ def test_carry_state_handoff():
     assert _rel(runoff_b, ref2.runoff) < 1e-4
 
 
+@pytest.mark.slow
 def test_gradients_match_step_engine():
     n, depth, T = 320, 80, 6
     rows, cols, channels, params, qp = _setup(n, depth, T, seed=6)
@@ -135,6 +136,7 @@ def test_multi_band_forced():
     assert _rel(runoff, ref.runoff) < 1e-4
 
 
+@pytest.mark.slow
 def test_fuzz_random_dags_match_step():
     """Seeded mini-fuzz over irregular DAGs (multi-root, wide confluences,
     uneven bands after balanced packing) — the stacked-sharded frame has the

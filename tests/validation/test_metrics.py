@@ -279,3 +279,11 @@ class TestVectorizedParity:
             srt = np.sort(valid)[::-1]
             idx = (np.arange(100) / 100 * valid.size).astype(int)
             np.testing.assert_array_equal(got[i], srt[idx], err_msg=str(i))
+
+
+def test_zero_length_time_axis():
+    """(g, 0) inputs must produce all-NaN/zero metrics, not crash in _fdc
+    (hit by an all-warmup legend window before scripts/train.py guarded it)."""
+    m = Metrics(pred=np.zeros((2, 0)), target=np.zeros((2, 0)))
+    assert np.isnan(m.nse).all() and np.isnan(m.pbias).all()
+    assert np.isnan(m.fdc_rmse).all() and m.fdc_rmse.shape == (2,)
