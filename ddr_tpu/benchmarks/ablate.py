@@ -1,6 +1,6 @@
 """Single-variant route() throughput ablation (one process per variant).
 
-Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect|wavefront|chunked|stacked|step} [DEPTH] [--grad] [--no-remat]``
+Usage: ``python -m ddr_tpu.benchmarks.ablate N T_HOURS {fused|rect|wavefront|chunked|stacked|step} [DEPTH] [--grad] [--no-remat] [--remat-bands]``
 Prints one JSON line {n, t_hours, schedule, depth, rts, ms_per_step, device,
 [n_chunks], [peak_hbm_gb]}.
 
@@ -8,7 +8,9 @@ Prints one JSON line {n, t_hours, schedule, depth, rts, ms_per_step, device,
 spatial parameters) instead of the forward route — the deep-backward number
 VERDICT round-3 flagged as unmeasured. ``--no-remat`` disables the per-wave
 physics rematerialization (``remat_physics=False``) so the remat win/loss is a
-two-line ablation.
+two-line ablation. ``--remat-bands`` (stacked schedule only) checkpoints whole
+band steps — the residual-traffic-for-FLOPs trade from docs/tpu.md's
+backward-floor analysis.
 
 ``DEPTH`` switches the topology to the CONUS-realistic deep generator with that
 exact longest-path depth (the regime VERDICT round-2 flagged as unmeasured):
@@ -36,6 +38,7 @@ def main() -> None:
     depth = int(args[3]) if len(args) > 3 else None
     grad = "--grad" in flags
     remat = "--no-remat" not in flags
+    remat_bands = "--remat-bands" in flags
 
     import jax
     import jax.numpy as jnp
@@ -95,7 +98,7 @@ def main() -> None:
         def loss(p):
             return route(
                 network, channels, p, q_prime, gauges=gauges, engine=engine,
-                remat_physics=remat,
+                remat_physics=remat, remat_bands=remat_bands,
             ).runoff.mean()
 
         fn = jax.jit(jax.value_and_grad(loss))
@@ -104,7 +107,7 @@ def main() -> None:
         fn = jax.jit(
             lambda qp: route(
                 network, channels, params, qp, gauges=gauges, engine=engine,
-                remat_physics=remat,
+                remat_physics=remat, remat_bands=remat_bands,
             ).runoff
         )
         arg = q_prime
@@ -139,6 +142,7 @@ def main() -> None:
                 "schedule": schedule,
                 "mode": "vjp" if grad else "forward",
                 "remat": remat,
+                "remat_bands": remat_bands,
                 "depth": network.depth,
                 "rts": round(n * t_hours / dt, 1),
                 "ms_per_step": round(dt / t_hours * 1e3, 3),

@@ -34,6 +34,7 @@ PLAN: list[tuple[str, list, int]] = [
     ("ablate", [262144, 240, "stacked", 2048], 2400),
     ("ablate", [262144, 240, "stacked", 2048, "--grad"], 3600),
     ("ablate", [262144, 240, "stacked", 2048, "--grad", "--no-remat"], 3600),
+    ("ablate", [262144, 240, "stacked", 2048, "--grad", "--remat-bands"], 3600),
     ("ablate", [262144, 240, "chunked", 2048], 2400),
     ("ablate", [262144, 240, "chunked", 2048, "--grad"], 3600),
     # the full train step at the official deep shape (VERDICT item 3)

@@ -243,6 +243,7 @@ def route(
     engine: str | None = None,
     q_prime_permuted: bool = False,
     remat_physics: bool = True,
+    remat_bands: bool = False,
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -281,18 +282,29 @@ def route(
     ``remat_physics`` (wavefront engine) rematerializes the per-wave elementwise
     physics in the backward pass instead of storing its intermediates — ~27%
     faster full VJP on the v5e chip; forward bitwise-unchanged (docs/tpu.md).
+
+    ``remat_bands`` (StackedChunked ONLY; ValueError otherwise) checkpoints
+    whole band steps so the backward recomputes each band's wave scan instead
+    of streaming residuals — see :func:`ddr_tpu.routing.stacked.route_stacked`.
     """
     from ddr_tpu.routing.chunked import ChunkedNetwork, route_chunked
     from ddr_tpu.routing.stacked import StackedChunked, route_stacked
 
+    if remat_bands and not isinstance(network, StackedChunked):
+        raise ValueError("remat_bands is only supported on a StackedChunked")
     if isinstance(network, (ChunkedNetwork, StackedChunked)):
         kind = type(network).__name__
         if engine not in (None, "wavefront"):
             raise ValueError(f"a {kind} always routes via its banded wavefront")
         if q_prime_permuted:
             raise ValueError(f"q_prime_permuted is not supported on a {kind}")
-        router = route_stacked if isinstance(network, StackedChunked) else route_chunked
-        return router(
+        if isinstance(network, StackedChunked):
+            return route_stacked(
+                network, channels, spatial_params, q_prime, q_init=q_init,
+                gauges=gauges, bounds=bounds, dt=dt,
+                remat_physics=remat_physics, remat_bands=remat_bands,
+            )
+        return route_chunked(
             network, channels, spatial_params, q_prime, q_init=q_init,
             gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
         )

@@ -295,9 +295,20 @@ def route_stacked(
     bounds: Any = None,
     dt: float = 3600.0,
     remat_physics: bool = True,
+    remat_bands: bool = False,
 ):
     """Route ``(T, N)`` inflows with one scanned band program; same contract as
-    :func:`ddr_tpu.routing.mc.route`. All inputs in ORIGINAL node order."""
+    :func:`ddr_tpu.routing.mc.route`. All inputs in ORIGINAL node order.
+
+    ``remat_bands`` checkpoints each WHOLE band step: the backward recomputes a
+    band's full wave scan from the boundary-buffer carry instead of streaming
+    per-wave residuals — residual memory drops from O(n_waves x wave-state) to
+    O(carry) per band at ~2x band-forward FLOPs. The trade only pays where
+    residual HBM traffic, not compute, binds the backward (docs/tpu.md "Why the
+    deep backward trails the forward"); on the compute-bound CPU backend it
+    measures 5-24% SLOWER (68.5k vs 71.8-85.1k rt/s at N=4096/d=1536), as the
+    analysis predicts. Default off; the on-chip capture plan measures it where
+    it was designed to win."""
     from ddr_tpu.routing.mc import (
         Bounds,
         RouteResult,
@@ -449,7 +460,8 @@ def route_stacked(
         qi_s if qi_s is not None else jnp.zeros((C, n_cap), q_prime.dtype),
     )
     bnd0 = jnp.zeros((T, B + 1), q_prime.dtype)
-    _, raw_all = jax.lax.scan(band_step, bnd0, band_xs)  # (C, T, n_cap)
+    step_fn = jax.checkpoint(band_step) if remat_bands else band_step
+    _, raw_all = jax.lax.scan(step_fn, bnd0, band_xs)  # (C, T, n_cap)
 
     runoff_all = jnp.maximum(raw_all, lb)
     flat = jnp.moveaxis(runoff_all, 0, 1).reshape(T, C * n_cap)

@@ -61,8 +61,6 @@ class Metrics:
     def _fdc(self, data: np.ndarray) -> np.ndarray:
         """100-point flow duration curve per gauge (exceedance-sorted);
         all-NaN gauges yield the reference's all-zero curve."""
-        if data.shape[1] == 0:  # zero-length series: the all-zero curve
-            return np.zeros((data.shape[0], 100))
         valid = ~np.isnan(data)
         kv = valid.sum(axis=1)
         srt = np.sort(np.where(valid, data, -np.inf), axis=1)[:, ::-1]
