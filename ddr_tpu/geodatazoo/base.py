@@ -11,6 +11,7 @@ the jit boundary converts later (no device placement at collate time).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from abc import ABC, abstractmethod
 from pathlib import Path
@@ -157,9 +158,14 @@ class BaseGeoDataset(ABC):
         return idx
 
     def collate_fn(self, batch: list) -> RoutingData:
+        """Build one batch. The returned RoutingData carries a SNAPSHOT of the
+        batch window (``Dates.snapshot``), never the dataset's shared mutable
+        Dates — collating batch k+1 must not shift batch k's window while it
+        is still being prepared or trained on (the prefetch invariant)."""
         if self.cfg.mode == Mode.training:
             self.dates.calculate_time_period(self._rng)
-            return self._collate_gages(np.asarray(batch))
+            rd = self._collate_gages(np.asarray(batch))
+            return dataclasses.replace(rd, dates=self.dates.snapshot())
         assert self.routing_data is not None, "No RoutingData, cannot batch"
         indices = list(batch)
         if 0 not in indices:
@@ -167,7 +173,7 @@ class BaseGeoDataset(ABC):
             # (reference base_geodataset.py:46-48).
             indices.insert(0, indices[0] - 1)
         self.dates.set_date_range(np.asarray(indices))
-        return self.routing_data
+        return dataclasses.replace(self.routing_data, dates=self.dates.snapshot())
 
     # -- mode initialization ----------------------------------------------------
 

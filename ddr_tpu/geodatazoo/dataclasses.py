@@ -125,6 +125,17 @@ class Dates(BaseModel):
         """Select an explicit daily chunk (sequential inference; reference :169-178)."""
         self.set_batch_time(self.daily_time_range[chunk])
 
+    def snapshot(self) -> "Dates":
+        """An independent Dates carrying the CURRENT batch window.
+
+        ``set_batch_time`` rebinds whole attributes (never mutates the arrays
+        in place), so a shallow copy freezes this batch's window: later
+        ``calculate_time_period``/``set_date_range`` calls on the dataset's
+        shared Dates cannot shift a batch that is already in flight. Every
+        ``collate_fn`` hands its RoutingData a snapshot — the invariant that
+        makes batches safe to prepare ahead (``geodatazoo.loader.prefetch``)."""
+        return self.model_copy()
+
     def create_time_windows(self) -> np.ndarray:
         """Sequential rho-sized day-index windows for chunked inference (reference :180-187)."""
         if self.rho is None:

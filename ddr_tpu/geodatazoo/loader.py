@@ -10,11 +10,52 @@ DataLoader generator state for the same reason).
 
 from __future__ import annotations
 
-from typing import Any, Iterator
+from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
 
-__all__ = ["DataLoader"]
+__all__ = ["DataLoader", "prefetch"]
+
+
+def prefetch(
+    iterable: Iterable[Any], prepare: Callable[[Any], Any], ahead: int = 1
+) -> Iterator[Any]:
+    """Map ``prepare`` over ``iterable`` in a background thread, staying up to
+    ``ahead`` prepared items in front of the consumer.
+
+    The TPU-idiomatic input pipeline move the torch reference gets from
+    ``DataLoader(num_workers=...)``: while the device executes step t, the host
+    thread builds batch t+1's graph schedules and device uploads
+    (``prepare_batch`` is pure host NumPy + ``device_put``, both thread-safe
+    and GIL-releasing), so host prep hides behind device time instead of
+    serializing with it. At most ``ahead + 1`` items are prepared/in-flight
+    beyond the one being consumed (``ahead`` waiting + one the worker is
+    filling). Exceptions in ``prepare`` surface at the consuming ``next()``.
+
+    REQUIREMENT on the source iterable: items must not share mutable state
+    with one another — the fill loop pulls item k+1 from ``iterable`` while
+    item k is still being prepared/consumed. The geodatazoo datasets satisfy
+    this by handing every batch a ``Dates.snapshot()`` and a fresh
+    RoutingData (see ``BaseGeoDataset.collate_fn``).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending: list = []
+        it = iter(iterable)
+        try:
+            while len(pending) <= ahead:
+                pending.append(pool.submit(prepare, next(it)))
+        except StopIteration:
+            it = None
+        while pending:
+            item = pending.pop(0).result()
+            if it is not None:
+                try:
+                    pending.append(pool.submit(prepare, next(it)))
+                except StopIteration:
+                    it = None
+            yield item
 
 
 class DataLoader:

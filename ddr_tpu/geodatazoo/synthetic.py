@@ -306,6 +306,9 @@ class Synthetic:
         return idx
 
     def collate_fn(self, batch: list) -> RoutingData:
+        """Per-batch RoutingData with a window SNAPSHOT (``Dates.snapshot``) and
+        freshly-windowed observations — the shared ``self.routing_data`` is
+        never mutated, so batches stay valid under prefetch lookahead."""
         if self.cfg.mode == Mode.training:
             self.dates.calculate_time_period(self._rng)
         else:
@@ -313,13 +316,14 @@ class Synthetic:
             if 0 not in indices:
                 indices.insert(0, indices[0] - 1)
             self.dates.set_date_range(np.asarray(indices))
-        # Observations re-windowed to the batch's daily range.
-        self.routing_data.observations = ObservationSet(
+        obs = ObservationSet(
             gage_ids=list(self._full_obs.gage_ids),
             time=np.asarray(self.dates.batch_daily_time_range),
             streamflow=self._full_obs.streamflow[:, self.dates.daily_indices],
         )
-        return self.routing_data
+        return dataclasses.replace(
+            self.routing_data, dates=self.dates.snapshot(), observations=obs
+        )
 
     def streamflow(self, **kwargs) -> np.ndarray:
         """(T_batch, N) hourly lateral inflow for the current batch window."""

@@ -11,7 +11,7 @@ import logging
 import jax.numpy as jnp
 import numpy as np
 
-from ddr_tpu.geodatazoo.loader import DataLoader
+from ddr_tpu.geodatazoo.loader import DataLoader, prefetch
 from ddr_tpu.profiling import Throughput, trace
 from ddr_tpu.routing.mc import Bounds
 from ddr_tpu.routing.model import prepare_batch
@@ -94,17 +94,30 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 opt_state = set_learning_rate(opt_state, cfg.experiment.learning_rate[epoch])
 
             grids_refit = epoch not in cfg.kan.grid_update_epochs
-            for i, rd in enumerate(loader):
-                if epoch == start_epoch and i < start_mini_batch:
-                    log.info(f"Skipping mini-batch {i}. Resuming at {start_mini_batch}")
-                    continue
 
+            def _batches(epoch=epoch):
+                for i, rd in enumerate(loader):
+                    if epoch == start_epoch and i < start_mini_batch:
+                        log.info(f"Skipping mini-batch {i}. Resuming at {start_mini_batch}")
+                        continue
+                    yield i, rd
+
+            def _prepare(item):
+                # Everything batch-local and training-state-independent: runs
+                # one batch AHEAD in the prefetch thread, hiding graph-schedule
+                # builds + device uploads behind the device's current step.
+                i, rd = item
                 q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
                 if rd.flow_scale is not None:
                     q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
                 network, channels, gauges = prepare_batch(rd, slope_min)
                 attrs = jnp.asarray(rd.normalized_spatial_attributes)
+                obs_daily, obs_mask = daily_observation_targets(rd)
+                return i, rd, q_prime, network, channels, gauges, attrs, obs_daily, obs_mask
 
+            for (
+                i, rd, q_prime, network, channels, gauges, attrs, obs_daily, obs_mask
+            ) in prefetch(_batches(), _prepare):
                 if not grids_refit:
                     # pykan-style data refit of the spline grids on the first
                     # EXECUTED mini-batch of the epoch (not literal i == 0, so a
@@ -116,7 +129,6 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     params = update_grid_from_samples(kan_model, params, attrs)
                     grids_refit = True
                     log.info(f"epoch {epoch}: adaptive KAN grids refit from batch attributes")
-                obs_daily, obs_mask = daily_observation_targets(rd)
 
                 with throughput.batch(rd.n_segments, q_prime.shape[0]):
                     params, opt_state, loss, daily = step(
