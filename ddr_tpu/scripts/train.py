@@ -7,6 +7,7 @@ and Adam update are one compiled XLA program per network shape).
 from __future__ import annotations
 
 import logging
+from pathlib import Path
 
 import jax.numpy as jnp
 import numpy as np
@@ -69,7 +70,21 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
     lr = resolve_learning_rate(cfg.experiment.learning_rate, start_epoch)
     optimizer = make_optimizer(lr)
-    opt_state = blob["opt_state"] if blob and blob.get("opt_state") is not None else optimizer.init(params)
+    if blob and blob.get("opt_state") is not None:
+        if Path(cfg.experiment.checkpoint).is_dir():
+            # orbax form: without a target the optax state restores as plain
+            # containers — re-restore it structurally now that the optimizer
+            # (and thus the state template) exists.
+            from ddr_tpu.training import load_state_orbax
+
+            template = optimizer.init(params)
+            blob = load_state_orbax(
+                cfg.experiment.checkpoint,
+                target={"params": params, "opt_state": template},
+            )
+        opt_state = blob["opt_state"]
+    else:
+        opt_state = optimizer.init(params)
 
     step = make_batch_train_step(
         kan_model,

@@ -17,6 +17,7 @@ one-day misalignment on an autocorrelated signal.
 
 from __future__ import annotations
 
+import json
 import pickle
 from pathlib import Path
 from typing import Any
@@ -39,6 +40,8 @@ __all__ = [
     "make_sharded_chunked_train_step",
     "save_state",
     "load_state",
+    "save_state_orbax",
+    "load_state_orbax",
 ]
 
 
@@ -311,11 +314,22 @@ def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
     architecture — architecture-mismatched blobs (a KAN trained under one
     ``grid_range`` evaluates to garbage under another, with identical param shapes)."""
     path = Path(path)
+    if path.is_dir():
+        # the orbax directory form (load_state_orbax raises the module's clear
+        # ValueError on a half-written dir with no meta.json). NOTE: optax
+        # states restore as plain containers without a `target` — train resume
+        # re-restores opt_state with its template (scripts/train.py).
+        return load_state_orbax(path, expected_arch=expected_arch)
     try:
         with path.open("rb") as f:
             blob = pickle.load(f)
     except (pickle.UnpicklingError, EOFError, AttributeError) as e:
         raise ValueError(f"corrupt checkpoint {path}: {e}") from e
+    return _validate_blob(blob, path, expected_arch)
+
+
+def _validate_blob(blob: Any, path: Path, expected_arch: dict | None) -> dict:
+    """The checkpoint schema contract, shared by the pickle and orbax loaders."""
     if not isinstance(blob, dict) or blob.get("format") != CHECKPOINT_FORMAT:
         raise ValueError(
             f"{path} is not a ddr-tpu checkpoint (missing format marker; "
@@ -352,7 +366,100 @@ def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
     return blob
 
 
+def save_state_orbax(
+    save_dir: str | Path,
+    name: str,
+    epoch: int,
+    mini_batch: int,
+    params: Any,
+    opt_state: Any,
+    rng_state: Any = None,
+    arch: dict | None = None,
+) -> Path:
+    """Orbax-backed checkpoint: ``_{name}_epoch_{E}_mb_{B}.orbax/`` holding the
+    array pytrees under ``state/`` (orbax StandardCheckpointer — the
+    TPU-ecosystem store: tensorstore-backed, and under ``jax.distributed`` each
+    process writes exactly its addressable shards, so multi-host sharded
+    training state needs no host-0 gather) plus ``meta.json`` with the same
+    schema fields the pickle blob carries. ``load_state`` auto-detects the
+    directory form, so orbax checkpoints are drop-in for every existing loader
+    (`experiment.checkpoint`, train resume, geometry predictor)."""
+    import orbax.checkpoint as ocp
+
+    save_dir = Path(save_dir).resolve()
+    save_dir.mkdir(parents=True, exist_ok=True)
+    path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.orbax"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / "state", {"params": params, "opt_state": opt_state}, force=True)
+    # Only process 0 writes the (tiny, replicated) metadata, atomically via
+    # rename — under jax.distributed every process runs this function for the
+    # collective array save, and N concurrent write_text calls on one shared
+    # file can interleave. meta.json is also written LAST: its presence marks
+    # the checkpoint complete, so a preempted save is detected on load.
+    if jax.process_index() == 0:
+        meta = {
+            "format": CHECKPOINT_FORMAT,
+            "version": CHECKPOINT_VERSION,
+            "epoch": epoch,
+            "mini_batch": mini_batch,
+            "rng_state": rng_state,
+            "arch": arch,
+        }
+        tmp = path / ".meta.json.tmp"
+        tmp.write_text(json.dumps(meta, default=_json_np))
+        tmp.rename(path / "meta.json")
+    return path
+
+
+def _json_np(obj: Any):
+    """JSON encoder for the numpy scalars/arrays an RNG state blob may carry."""
+    import numpy as np
+
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"not JSON-serializable: {type(obj)}")
+
+
+def load_state_orbax(
+    path: str | Path, expected_arch: dict | None = None, target: Any = None
+) -> dict:
+    """Load an orbax checkpoint directory with the SAME schema contract as the
+    pickle loader. ``target`` (optional ``{"params": ..., "opt_state": ...}``
+    exemplar pytree) restores custom node types exactly — without it, optax
+    states come back as plain nested containers, which ``optax.apply_updates``
+    consumers must re-tree themselves."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).resolve()
+    meta_path = path / "meta.json"
+    if not meta_path.exists():
+        raise ValueError(
+            f"corrupt checkpoint {path}: not an orbax ddr-tpu checkpoint "
+            "(no meta.json — a preempted save, or not a checkpoint at all)"
+        )
+    try:
+        blob = json.loads(meta_path.read_text())
+    except json.JSONDecodeError as e:
+        raise ValueError(f"corrupt checkpoint {path}: {e}") from e
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            state = ckptr.restore(path / "state", target)
+        else:
+            state = ckptr.restore(path / "state")
+    blob.update(state)
+    return _validate_blob(blob, path, expected_arch)
+
+
 def latest_checkpoint(save_dir: str | Path) -> Path | None:
-    """Most recent checkpoint by mtime (reference train_and_test.py:139-144)."""
-    paths = sorted(Path(save_dir).glob("_*_epoch_*_mb_*.pkl"), key=lambda p: p.stat().st_mtime)
+    """Most recent checkpoint by mtime, either format
+    (reference train_and_test.py:139-144)."""
+    save_dir = Path(save_dir)
+    paths = sorted(
+        [*save_dir.glob("_*_epoch_*_mb_*.pkl"), *save_dir.glob("_*_epoch_*_mb_*.orbax")],
+        key=lambda p: p.stat().st_mtime,
+    )
     return paths[-1] if paths else None

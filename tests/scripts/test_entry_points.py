@@ -69,6 +69,28 @@ class TestTrainScript:
         params, _ = train(cfg2, max_batches=1)
         assert params is not None
 
+    def test_train_resume_from_orbax_checkpoint(self, tmp_path):
+        """The orbax directory form must be drop-in for experiment.checkpoint:
+        params restore structurally, and the optax state is re-restored with
+        its template so the optimizer consumes it directly."""
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.training import latest_checkpoint, load_state, save_state_orbax
+        from ddr_tpu.validation.configs import Config
+
+        cfg = Config(**_synthetic_cfg_dict(tmp_path))
+        train(cfg, max_batches=1)
+        blob = load_state(latest_checkpoint(tmp_path / "saved_models"))
+        ob = save_state_orbax(
+            tmp_path / "saved_models", "orbax_resume",
+            epoch=blob["epoch"], mini_batch=blob["mini_batch"],
+            params=blob["params"], opt_state=blob["opt_state"],
+            rng_state=blob.get("rng_state"), arch=blob.get("arch"),
+        )
+        cfg2 = Config(**_synthetic_cfg_dict(tmp_path))
+        cfg2.experiment.checkpoint = ob
+        params, _ = train(cfg2, max_batches=1)
+        assert params is not None
+
 
 class TestTestScript:
     def test_test_on_merit_fixture(self, merit_cfg, tmp_path):

@@ -386,3 +386,77 @@ def test_deep_batch_train_step_auto_selects_stacked():
     q_prime = jnp.asarray(basin.q_prime)
     _, _, loss, _ = step(params, opt_state, network, channels, gauges, attrs, q_prime, obs, mask)
     assert np.isfinite(float(loss))
+
+
+class TestOrbaxCheckpoints:
+    """Orbax-backed checkpoint directories: same schema contract as the pickle
+    blobs, auto-detected by load_state, structural optax restore via target."""
+
+    def _save(self, tmp_path, arch=None):
+        from ddr_tpu.training import make_optimizer, save_state_orbax
+
+        params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)}
+        opt = make_optimizer(1e-3)
+        opt_state = opt.init(params)
+        rng_state = {"bit_generator": np.random.default_rng(5).bit_generator.state}
+        path = save_state_orbax(
+            tmp_path, "ob", epoch=3, mini_batch=7, params=params,
+            opt_state=opt_state, rng_state=rng_state, arch=arch,
+        )
+        return path, params, opt, opt_state
+
+    def test_round_trip_via_autodetect(self, tmp_path):
+        from ddr_tpu.training import load_state
+
+        path, params, _, _ = self._save(tmp_path, arch={"grid": 3})
+        assert path.is_dir() and path.suffix == ".orbax"
+        blob = load_state(path, expected_arch={"grid": 3})
+        assert blob["epoch"] == 3 and blob["mini_batch"] == 7
+        np.testing.assert_array_equal(np.asarray(blob["params"]["w"]), np.asarray(params["w"]))
+        assert blob["rng_state"]["bit_generator"]["bit_generator"] == "PCG64"
+
+    def test_arch_mismatch_raises(self, tmp_path):
+        from ddr_tpu.training import load_state_orbax
+
+        path, *_ = self._save(tmp_path, arch={"grid": 3})
+        with pytest.raises(ValueError, match="different architecture"):
+            load_state_orbax(path, expected_arch={"grid": 50})
+
+    def test_target_restores_optax_structure(self, tmp_path):
+        """With a target exemplar the restored opt_state is a REAL optax state
+        (the optimizer can consume it directly), not nested dicts."""
+        from ddr_tpu.training import load_state_orbax
+
+        path, params, opt, opt_state = self._save(tmp_path)
+        blob = load_state_orbax(path, target={"params": params, "opt_state": opt_state})
+        grads = jax.tree_util.tree_map(jnp.ones_like, blob["params"])
+        updates, _ = opt.update(grads, blob["opt_state"], blob["params"])
+        assert jax.tree_util.tree_structure(updates) == jax.tree_util.tree_structure(params)
+
+    def test_not_an_orbax_checkpoint_raises(self, tmp_path):
+        from ddr_tpu.training import load_state_orbax
+
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(ValueError, match="no meta.json"):
+            load_state_orbax(tmp_path / "empty")
+
+    def test_preempted_save_raises_clear_error(self, tmp_path):
+        """A dir with state/ but no meta.json (crash between the array save and
+        the meta rename) must raise the module's ValueError, not leak
+        IsADirectoryError through the pickle branch."""
+        from ddr_tpu.training import load_state
+
+        path, *_ = self._save(tmp_path)
+        (path / "meta.json").unlink()
+        with pytest.raises(ValueError, match="corrupt checkpoint"):
+            load_state(path)
+
+    def test_latest_checkpoint_sees_orbax_dirs(self, tmp_path):
+        from ddr_tpu.training import latest_checkpoint, save_state
+
+        save_state(tmp_path, "ob", epoch=1, mini_batch=0, params={"w": 1.0}, opt_state={})
+        import time as _time
+
+        _time.sleep(0.05)
+        path, *_ = self._save(tmp_path)  # newer orbax dir
+        assert latest_checkpoint(tmp_path) == path
