@@ -141,6 +141,37 @@ class dmc:
         self.epoch = epoch
         self.mini_batch = mini_batch
 
+    def state_dict(self) -> dict[str, Any]:
+        """Round-trippable wrapper state — config, progress counters, and the
+        carried discharge (reference torch_mc.py:297-339, which additionally
+        hauls torch module buffers; here the KAN parameters live outside the
+        wrapper, so this is exactly the non-parameter state)."""
+        return {
+            "cfg": self.cfg,
+            "device": self.device,
+            "epoch": self.epoch,
+            "mini_batch": self.mini_batch,
+            "discharge_t": (
+                None if self._discharge_t is None else np.asarray(self._discharge_t)
+            ),
+        }
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        """Restore :meth:`state_dict` output; physics bounds/ranges are rebuilt
+        from the restored cfg (the reference recreates its routing engine the
+        same way, torch_mc.py:336-339)."""
+        self.cfg = state.get("cfg", self.cfg)
+        self.device = state.get("device", self.device)
+        self.epoch = int(state.get("epoch", 0))
+        self.mini_batch = int(state.get("mini_batch", 0))
+        mins = self.cfg.params.attribute_minimums
+        self.bounds = Bounds.from_config(mins)
+        self.parameter_ranges = self.cfg.params.parameter_ranges
+        self.log_space_parameters = self.cfg.params.log_space_parameters
+        self.defaults = self.cfg.params.defaults
+        dq = state.get("discharge_t")
+        self._discharge_t = None if dq is None else jnp.asarray(dq, jnp.float32)
+
     def forward(
         self,
         routing_dataclass: RoutingData,

@@ -57,31 +57,41 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     )
 
     start_epoch, start_mini_batch, blob = 1, 0, None
-    if cfg.experiment.checkpoint:
-        blob = load_state(cfg.experiment.checkpoint, expected_arch=kan_arch(cfg))
-        params = blob["params"]
-        start_epoch = blob["epoch"]
-        start_mini_batch = 0 if blob["mini_batch"] == 0 else blob["mini_batch"] + 1
-        if blob.get("rng_state"):
-            loader.set_state(blob["rng_state"])
-        log.info(f"Resuming from {cfg.experiment.checkpoint} at epoch {start_epoch}")
+    ckpt = Path(cfg.experiment.checkpoint) if cfg.experiment.checkpoint else None
+    orbax_resume = ckpt is not None and ckpt.is_dir()
+    if ckpt is not None:
+        if orbax_resume:
+            # orbax form: read ONLY the metadata now; the single targeted array
+            # restore happens below once the optimizer template exists (an
+            # untargeted restore would materialize the full state unsharded).
+            from ddr_tpu.training import peek_orbax_meta
+
+            meta = peek_orbax_meta(ckpt)
+        else:
+            blob = load_state(ckpt, expected_arch=kan_arch(cfg))
+            params = blob["params"]
+            meta = blob
+        start_epoch = meta["epoch"]
+        start_mini_batch = 0 if meta["mini_batch"] == 0 else meta["mini_batch"] + 1
+        if meta.get("rng_state"):
+            loader.set_state(meta["rng_state"])
+        log.info(f"Resuming from {ckpt} at epoch {start_epoch}")
     else:
         log.info("Creating new spatial model")
 
     lr = resolve_learning_rate(cfg.experiment.learning_rate, start_epoch)
     optimizer = make_optimizer(lr)
-    if blob and blob.get("opt_state") is not None:
-        if Path(cfg.experiment.checkpoint).is_dir():
-            # orbax form: without a target the optax state restores as plain
-            # containers — re-restore it structurally now that the optimizer
-            # (and thus the state template) exists.
-            from ddr_tpu.training import load_state_orbax
+    if orbax_resume:
+        from ddr_tpu.training import load_state_orbax
 
-            template = optimizer.init(params)
-            blob = load_state_orbax(
-                cfg.experiment.checkpoint,
-                target={"params": params, "opt_state": template},
-            )
+        # the freshly-initialized KAN params are the exact structural template
+        blob = load_state_orbax(
+            ckpt,
+            expected_arch=kan_arch(cfg),
+            target={"params": params, "opt_state": optimizer.init(params)},
+        )
+        params, opt_state = blob["params"], blob["opt_state"]
+    elif blob and blob.get("opt_state") is not None:
         opt_state = blob["opt_state"]
     else:
         opt_state = optimizer.init(params)

@@ -408,6 +408,12 @@ def save_state_orbax(
         tmp = path / ".meta.json.tmp"
         tmp.write_text(json.dumps(meta, default=_json_np))
         tmp.rename(path / "meta.json")
+    if jax.process_count() > 1:
+        # Barrier: non-zero processes must not return (and possibly read the
+        # checkpoint back) before process 0's completeness marker lands.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ddr_tpu_ckpt_meta_written")
     return path
 
 
@@ -422,6 +428,27 @@ def _json_np(obj: Any):
     if isinstance(obj, np.ndarray):
         return obj.tolist()
     raise TypeError(f"not JSON-serializable: {type(obj)}")
+
+
+def peek_orbax_meta(path: str | Path) -> dict:
+    """meta.json only — NO array I/O. A resumer reads epoch/rng_state here,
+    builds its optimizer and state template, then does ONE targeted restore
+    (untargeted restores materialize the full state unsharded on every
+    process, which the multi-host sharded form exists to avoid)."""
+    path = Path(path).resolve()
+    meta_path = path / "meta.json"
+    if not meta_path.exists():
+        raise ValueError(
+            f"corrupt checkpoint {path}: not an orbax ddr-tpu checkpoint "
+            "(no meta.json — a preempted save, or not a checkpoint at all)"
+        )
+    try:
+        meta = json.loads(meta_path.read_text())
+    except json.JSONDecodeError as e:
+        raise ValueError(f"corrupt checkpoint {path}: {e}") from e
+    if not isinstance(meta, dict) or meta.get("format") != CHECKPOINT_FORMAT:
+        raise ValueError(f"{path} is not a ddr-tpu checkpoint (missing format marker)")
+    return meta
 
 
 def load_state_orbax(
@@ -455,11 +482,16 @@ def load_state_orbax(
 
 
 def latest_checkpoint(save_dir: str | Path) -> Path | None:
-    """Most recent checkpoint by mtime, either format
-    (reference train_and_test.py:139-144)."""
+    """Most recent COMPLETE checkpoint by mtime, either format
+    (reference train_and_test.py:139-144). Orbax dirs without their meta.json
+    completeness marker (a preempted save) are skipped, so auto-resume falls
+    back to the previous intact checkpoint instead of failing forever."""
     save_dir = Path(save_dir)
+    orbax = [
+        p for p in save_dir.glob("_*_epoch_*_mb_*.orbax") if (p / "meta.json").exists()
+    ]
     paths = sorted(
-        [*save_dir.glob("_*_epoch_*_mb_*.pkl"), *save_dir.glob("_*_epoch_*_mb_*.orbax")],
+        [*save_dir.glob("_*_epoch_*_mb_*.pkl"), *orbax],
         key=lambda p: p.stat().st_mtime,
     )
     return paths[-1] if paths else None

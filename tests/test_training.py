@@ -460,3 +460,24 @@ class TestOrbaxCheckpoints:
         _time.sleep(0.05)
         path, *_ = self._save(tmp_path)  # newer orbax dir
         assert latest_checkpoint(tmp_path) == path
+
+    def test_preempted_save_invisible_to_latest_checkpoint(self, tmp_path):
+        """An incomplete .orbax dir must not shadow the previous good checkpoint
+        in auto-resume discovery."""
+        from ddr_tpu.training import latest_checkpoint, save_state
+
+        good = save_state(tmp_path, "g", epoch=1, mini_batch=0, params={"w": 1.0}, opt_state={})
+        import time as _time
+
+        _time.sleep(0.05)
+        path, *_ = self._save(tmp_path)  # newer
+        (path / "meta.json").unlink()  # preempted: no completeness marker
+        assert latest_checkpoint(tmp_path) == good
+
+    def test_peek_meta_reads_no_arrays(self, tmp_path):
+        from ddr_tpu.training import peek_orbax_meta
+
+        path, *_ = self._save(tmp_path, arch={"grid": 3})
+        meta = peek_orbax_meta(path)
+        assert meta["epoch"] == 3 and meta["mini_batch"] == 7
+        assert "params" not in meta and "opt_state" not in meta
