@@ -66,7 +66,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             # untargeted restore would materialize the full state unsharded).
             from ddr_tpu.training import peek_orbax_meta
 
-            meta = peek_orbax_meta(ckpt)
+            meta = peek_orbax_meta(ckpt, expected_arch=kan_arch(cfg))
         else:
             blob = load_state(ckpt, expected_arch=kan_arch(cfg))
             params = blob["params"]

@@ -317,8 +317,10 @@ def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
     if path.is_dir():
         # the orbax directory form (load_state_orbax raises the module's clear
         # ValueError on a half-written dir with no meta.json). NOTE: optax
-        # states restore as plain containers without a `target` — train resume
-        # re-restores opt_state with its template (scripts/train.py).
+        # states restore as plain containers without a `target` — resumers
+        # peek the metadata first and do ONE targeted restore instead
+        # (peek_orbax_meta + load_state_orbax(target=...), as scripts/train.py
+        # does).
         return load_state_orbax(path, expected_arch=expected_arch)
     try:
         with path.open("rb") as f:
@@ -328,8 +330,9 @@ def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
     return _validate_blob(blob, path, expected_arch)
 
 
-def _validate_blob(blob: Any, path: Path, expected_arch: dict | None) -> dict:
-    """The checkpoint schema contract, shared by the pickle and orbax loaders."""
+def _validate_meta(blob: Any, path: Path, expected_arch: dict | None) -> dict:
+    """Format/version/arch contract — everything checkable WITHOUT the arrays
+    (shared by the full loaders and the orbax metadata peek)."""
     if not isinstance(blob, dict) or blob.get("format") != CHECKPOINT_FORMAT:
         raise ValueError(
             f"{path} is not a ddr-tpu checkpoint (missing format marker; "
@@ -349,7 +352,7 @@ def _validate_blob(blob: Any, path: Path, expected_arch: dict | None) -> dict:
             f"checkpoint {path} has version {version}, "
             f"this build reads versions 1 (arch-less loads only) and {CHECKPOINT_VERSION}"
         )
-    missing = {"epoch", "mini_batch", "params", "opt_state"} - blob.keys()
+    missing = {"epoch", "mini_batch"} - blob.keys()
     if missing:
         raise ValueError(f"checkpoint {path} missing fields: {sorted(missing)}")
     saved_arch = blob.get("arch")
@@ -363,6 +366,15 @@ def _validate_blob(blob: Any, path: Path, expected_arch: dict | None) -> dict:
             f"checkpoint {path} was trained under a different architecture; "
             f"mismatched fields (saved, expected): {diff}"
         )
+    return blob
+
+
+def _validate_blob(blob: Any, path: Path, expected_arch: dict | None) -> dict:
+    """The full checkpoint schema contract: metadata + array-field presence."""
+    _validate_meta(blob, path, expected_arch)
+    missing = {"params", "opt_state"} - blob.keys()
+    if missing:
+        raise ValueError(f"checkpoint {path} missing fields: {sorted(missing)}")
     return blob
 
 
@@ -430,10 +442,11 @@ def _json_np(obj: Any):
     raise TypeError(f"not JSON-serializable: {type(obj)}")
 
 
-def peek_orbax_meta(path: str | Path) -> dict:
-    """meta.json only — NO array I/O. A resumer reads epoch/rng_state here,
-    builds its optimizer and state template, then does ONE targeted restore
-    (untargeted restores materialize the full state unsharded on every
+def peek_orbax_meta(path: str | Path, expected_arch: dict | None = None) -> dict:
+    """meta.json only — NO array I/O, FULL metadata validation (format,
+    version, arch fingerprint). A resumer validates + reads epoch/rng_state
+    here, builds its optimizer and state template, then does ONE targeted
+    restore (untargeted restores materialize the full state unsharded on every
     process, which the multi-host sharded form exists to avoid)."""
     path = Path(path).resolve()
     meta_path = path / "meta.json"
@@ -446,9 +459,7 @@ def peek_orbax_meta(path: str | Path) -> dict:
         meta = json.loads(meta_path.read_text())
     except json.JSONDecodeError as e:
         raise ValueError(f"corrupt checkpoint {path}: {e}") from e
-    if not isinstance(meta, dict) or meta.get("format") != CHECKPOINT_FORMAT:
-        raise ValueError(f"{path} is not a ddr-tpu checkpoint (missing format marker)")
-    return meta
+    return _validate_meta(meta, path, expected_arch)
 
 
 def load_state_orbax(
@@ -462,16 +473,9 @@ def load_state_orbax(
     import orbax.checkpoint as ocp
 
     path = Path(path).resolve()
-    meta_path = path / "meta.json"
-    if not meta_path.exists():
-        raise ValueError(
-            f"corrupt checkpoint {path}: not an orbax ddr-tpu checkpoint "
-            "(no meta.json — a preempted save, or not a checkpoint at all)"
-        )
-    try:
-        blob = json.loads(meta_path.read_text())
-    except json.JSONDecodeError as e:
-        raise ValueError(f"corrupt checkpoint {path}: {e}") from e
+    # validates format/version/arch BEFORE any array I/O, so e.g. an arch
+    # mismatch raises the module's clear error, not a tensorstore shape error
+    blob = peek_orbax_meta(path, expected_arch=expected_arch)
     with ocp.StandardCheckpointer() as ckptr:
         if target is not None:
             state = ckptr.restore(path / "state", target)

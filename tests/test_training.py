@@ -481,3 +481,12 @@ class TestOrbaxCheckpoints:
         meta = peek_orbax_meta(path)
         assert meta["epoch"] == 3 and meta["mini_batch"] == 7
         assert "params" not in meta and "opt_state" not in meta
+
+    def test_peek_validates_arch(self, tmp_path):
+        """Arch mismatches must fail at the metadata peek (clear ValueError),
+        BEFORE any tensorstore array I/O could die on a shape error."""
+        from ddr_tpu.training import peek_orbax_meta
+
+        path, *_ = self._save(tmp_path, arch={"grid": 3})
+        with pytest.raises(ValueError, match="different architecture"):
+            peek_orbax_meta(path, expected_arch={"grid": 50})
