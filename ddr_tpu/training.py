@@ -401,8 +401,32 @@ def save_state_orbax(
     save_dir = Path(save_dir).resolve()
     save_dir.mkdir(parents=True, exist_ok=True)
     path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.orbax"
+    state = {"params": params, "opt_state": opt_state}
+    if jax.process_count() > 1:
+        # orbax refuses host-local (single-device) arrays in a multi-process
+        # setting — replicated leaves (KAN params, optax counters) must become
+        # GLOBAL fully-replicated arrays so every process agrees on ownership;
+        # genuinely sharded leaves already carry a global sharding and pass
+        # through untouched.
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        from jax.sharding import Mesh, PartitionSpec
+
+        mesh = Mesh(_np.asarray(jax.devices()), ("_ckpt",))
+
+        def _globalize(x):
+            if isinstance(x, jax.Array) and not x.sharding.is_fully_addressable:
+                return x  # already global/sharded
+            if isinstance(x, jax.Array) or hasattr(x, "__array__"):
+                # P() = every process holds the identical full value
+                return multihost_utils.host_local_array_to_global_array(
+                    _np.asarray(x), mesh, PartitionSpec()
+                )
+            return x
+
+        state = jax.tree_util.tree_map(_globalize, state)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path / "state", {"params": params, "opt_state": opt_state}, force=True)
+        ckptr.save(path / "state", state, force=True)
     # Only process 0 writes the (tiny, replicated) metadata, atomically via
     # rename — under jax.distributed every process runs this function for the
     # collective array save, and N concurrent write_text calls on one shared
@@ -482,7 +506,9 @@ def load_state_orbax(
         else:
             state = ckptr.restore(path / "state")
     blob.update(state)
-    return _validate_blob(blob, path, expected_arch)
+    # metadata already validated by the peek above; params/opt_state presence
+    # is guaranteed by construction of the restored state dict
+    return blob
 
 
 def latest_checkpoint(save_dir: str | Path) -> Path | None:

@@ -153,3 +153,67 @@ class TestDistributedFlagParsing:
     def test_unrecognized_value_raises(self):
         with pytest.raises(ValueError, match="unrecognized DDR_DISTRIBUTED"):
             distributed_env({"DDR_DISTRIBUTED": "maybe"})
+
+
+ORBAX_WORKER = r"""
+import json, sys
+
+from ddr_tpu.parallel.distributed import maybe_initialize
+
+assert maybe_initialize() is True
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddr_tpu.training import load_state_orbax, make_optimizer, peek_orbax_meta, save_state_orbax
+
+out_dir = sys.argv[1]
+params = {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones(4)}
+opt = make_optimizer(1e-3)
+opt_state = opt.init(params)
+# every process calls save (collective array write); only process 0 writes meta,
+# and the barrier guarantees BOTH processes see a complete checkpoint afterwards
+path = save_state_orbax(out_dir, "mp", epoch=4, mini_batch=1,
+                        params=params, opt_state=opt_state, arch={"grid": 3})
+meta = peek_orbax_meta(path, expected_arch={"grid": 3})
+blob = load_state_orbax(path, target={"params": params, "opt_state": opt_state})
+digest = float(sum(np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(blob["params"])))
+print("RESULT " + json.dumps({
+    "process": jax.process_index(), "epoch": meta["epoch"], "digest": digest,
+}))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_orbax_save_and_load(tmp_path):
+    """The multi-host orbax path end to end: collective save, process-0 meta
+    write, post-meta barrier, and a collective targeted restore — both
+    processes must see the complete checkpoint and identical state."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PALLAS_AXON_POOL_IPS="",
+            DDR_COORDINATOR=f"127.0.0.1:{port}",
+            DDR_NUM_PROCESSES="2",
+            DDR_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", ORBAX_WORKER, str(tmp_path)],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    results = {}
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"process {pid} failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results[pid] = json.loads(line[len("RESULT "):])
+    assert results[0]["epoch"] == results[1]["epoch"] == 4
+    assert results[0]["digest"] == pytest.approx(results[1]["digest"], rel=1e-12)
+    assert results[0]["digest"] == pytest.approx(70.0)  # sum(arange(12)) + sum(ones(4))
