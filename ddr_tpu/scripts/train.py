@@ -105,6 +105,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
         tau=cfg.params.tau,
         warmup=cfg.experiment.warmup,
         optimizer=optimizer,
+        remat_bands=cfg.experiment.remat_bands,
     )
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0

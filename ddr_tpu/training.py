@@ -143,6 +143,7 @@ def make_batch_train_step(
     tau: int,
     warmup: int,
     optimizer: optax.GradientTransformation,
+    remat_bands: bool = False,
 ):
     """Like :func:`make_train_step` but with the network/channels/gauges as call-time
     arguments, so one jitted function serves every training batch.
@@ -151,14 +152,24 @@ def make_batch_train_step(
     (``RiverNetwork.n/depth/n_edges``, ``GaugeIndex.n_gauges``): repeated gauge
     subsets across epochs — the common case, since the sampler cycles a fixed gauge
     list — hit the compile cache instead of re-tracing (the recompilation-churn
-    mitigation from SURVEY.md §7 hard-parts (e))."""
+    mitigation from SURVEY.md §7 hard-parts (e)).
+
+    ``remat_bands`` (``experiment.remat_bands``) applies band-level backward
+    checkpointing WHEN the batch's network is the stacked deep router; other
+    engines ignore it (shallow batches must not error under a deep-tuned
+    config)."""
 
     def loss_fn(params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
+        from ddr_tpu.routing.stacked import StackedChunked
+
         raw = kan_model.apply(params, attrs)
         spatial = denormalize_spatial_parameters(
             raw, parameter_ranges, log_space_parameters, defaults, channels.length.shape[0]
         )
-        result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
+        result = route(
+            network, channels, spatial, q_prime, gauges=gauges, bounds=bounds,
+            remat_bands=remat_bands and isinstance(network, StackedChunked),
+        )
         return masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
 
     @jax.jit

@@ -143,6 +143,14 @@ class ExperimentConfig(BaseModel):
     shuffle: bool = True
     warmup: int = Field(default=3, description="Days excluded from the loss while routing spins up")
     max_area_diff_sqkm: float | None = 50
+    remat_bands: bool = Field(
+        default=False,
+        description=(
+            "Checkpoint whole band steps in the stacked deep router's backward "
+            "(residual-HBM-for-FLOPs trade, docs/tpu.md backward-floor analysis); "
+            "only meaningful when the batch topology auto-selects the stacked engine"
+        ),
+    )
     test_start_time: str | None = Field(
         default=None, description="Evaluation period start for train-and-test (default 1995/10/01)"
     )

@@ -490,3 +490,47 @@ class TestOrbaxCheckpoints:
         path, *_ = self._save(tmp_path, arch={"grid": 3})
         with pytest.raises(ValueError, match="different architecture"):
             peek_orbax_meta(path, expected_arch={"grid": 50})
+
+
+def test_batch_step_remat_bands_matches_default_on_deep_topology():
+    """experiment.remat_bands plumbs through make_batch_train_step: identical
+    loss on a stacked deep batch, and silently ignored on a shallow batch."""
+    from ddr_tpu.routing.stacked import build_stacked_chunked
+    from ddr_tpu.training import make_batch_train_step
+
+    cfg = _cfg()
+    basin = observe(make_basin(n_segments=256, n_gauges=3, n_days=4, seed=9, depth=96), cfg)
+    rd = basin.routing_data
+    _, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    network = build_stacked_chunked(
+        rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, cell_budget=3_000
+    )
+    kan_model = Kan(
+        input_var_names=tuple(cfg.kan.input_var_names),
+        learnable_parameters=tuple(cfg.kan.learnable_parameters),
+    )
+    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+    params = kan_model.init(jax.random.key(0), attrs)
+    optimizer = make_optimizer(0.01)
+    opt_state = optimizer.init(params)
+    kw = dict(
+        bounds=Bounds.from_config(cfg.params.attribute_minimums),
+        parameter_ranges=cfg.params.parameter_ranges,
+        log_space_parameters=cfg.params.log_space_parameters,
+        defaults=cfg.params.defaults, tau=cfg.params.tau, warmup=1,
+        optimizer=optimizer,
+    )
+    obs = jnp.asarray(basin.obs_daily)
+    mask = jnp.ones_like(obs, dtype=bool)
+    qp = jnp.asarray(basin.q_prime)
+
+    step0 = make_batch_train_step(kan_model, **kw)
+    step1 = make_batch_train_step(kan_model, **kw, remat_bands=True)
+    _, _, l0, _ = step0(params, opt_state, network, channels, gauges, attrs, qp, obs, mask)
+    _, _, l1, _ = step1(params, opt_state, network, channels, gauges, attrs, qp, obs, mask)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+
+    # shallow batch: plain network, flag must be a no-op, not an error
+    net_p, ch_p, g_p = prepare_batch(rd, cfg.params.attribute_minimums["slope"], chunked=False)
+    _, _, l2, _ = step1(params, opt_state, net_p, ch_p, g_p, attrs, qp, obs, mask)
+    assert np.isfinite(float(l2))
