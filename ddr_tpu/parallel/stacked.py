@@ -251,10 +251,16 @@ def route_stacked_sharded(
     dt: float = 3600.0,
     axis_name: str = "reach",
     remat_physics: bool = True,
+    remat_bands: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Route ``(T, N)`` inflows (ORIGINAL node order) over the mesh with one
     scanned band program. Returns ``(runoff (T, N), final (N,))`` in original
-    order. Differentiable end to end."""
+    order. Differentiable end to end.
+
+    ``remat_bands`` checkpoints each whole band step (wave scan + boundary
+    psum) exactly like the single-chip stacked router: the backward replays a
+    band's forward — collectives included — instead of streaming per-wave
+    residuals. Same trade, same default-off; the chip capture plan decides."""
     from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
 
     if bounds is None:
@@ -436,7 +442,8 @@ def route_stacked_sharded(
             qp_a, qi_a,
         )
         bnd0 = jnp.zeros((T, B + 1), q_prime.dtype)
-        _, raw_all = jax.lax.scan(band_step, bnd0, band_xs)  # (C, T, n_cap)
+        step_fn = jax.checkpoint(band_step) if remat_bands else band_step
+        _, raw_all = jax.lax.scan(step_fn, bnd0, band_xs)  # (C, T, n_cap)
         return raw_all
 
     shard = P(axis_name)
@@ -452,6 +459,11 @@ def route_stacked_sharded(
         out_specs=P(None, None, axis_name),
         check_vma=False,
     )
+    if remat_bands:
+        # jax.checkpoint inside shard_map cannot trace eagerly ("eager
+        # closed_call"); real callers jit the whole train step anyway, and
+        # this keeps the eager contract identical for both settings.
+        fn = jax.jit(fn)
     raw_all = fn(
         layout.level, layout.wf_row, layout.wf_col, layout.wf_mask,
         layout.hb_out, layout.hb_tgt, layout.hb_gap, layout.ext_cols,

@@ -245,6 +245,7 @@ def make_sharded_chunked_train_step(
     tau: int,
     warmup: int,
     optimizer: optax.GradientTransformation,
+    remat_bands: bool = False,
 ):
     """Multi-chip train step at CONTINENTAL DEPTH: the sharded depth-chunked
     router (:func:`ddr_tpu.parallel.chunked.route_chunked_sharded`) under the
@@ -258,13 +259,21 @@ def make_sharded_chunked_train_step(
     per-reach array stays in ORIGINAL node order (the layout carries its own
     band/shard permutations). Loss and windowing are :func:`masked_l1_daily`,
     identical to every other builder.
+
+    ``remat_bands`` (``experiment.remat_bands``) applies band-level backward
+    checkpointing on a :class:`StackedSharded` layout; the layout is fixed at
+    builder time, so requesting it with a chunked layout raises immediately.
     """
     from ddr_tpu.parallel.chunked import route_chunked_sharded
     from ddr_tpu.parallel.stacked import StackedSharded, route_stacked_sharded
 
-    router = (
-        route_stacked_sharded if isinstance(layout, StackedSharded) else route_chunked_sharded
-    )
+    stacked = isinstance(layout, StackedSharded)
+    if remat_bands and not stacked:
+        # layout is fixed at builder time, so this is a static
+        # misconfiguration — fail now, as mc.route does, instead of silently
+        # streaming full residuals until the backward OOMs
+        raise ValueError("remat_bands requires a StackedSharded layout")
+    router = route_stacked_sharded if stacked else route_chunked_sharded
     n_segments = channels.length.shape[0]
 
     def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
@@ -272,7 +281,8 @@ def make_sharded_chunked_train_step(
         spatial = denormalize_spatial_parameters(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
-        runoff, _ = router(mesh, layout, channels, spatial, q_prime, bounds=bounds)
+        kw = {"remat_bands": remat_bands} if stacked else {}
+        runoff, _ = router(mesh, layout, channels, spatial, q_prime, bounds=bounds, **kw)
         return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
 
     return _make_step(loss_fn, optimizer)
