@@ -462,7 +462,10 @@ def route_stacked_sharded(
     if remat_bands:
         # jax.checkpoint inside shard_map cannot trace eagerly ("eager
         # closed_call"); real callers jit the whole train step anyway, and
-        # this keeps the eager contract identical for both settings.
+        # this keeps the eager contract identical for both settings. NOTE:
+        # the wrapper is per-call (the closure is rebuilt each invocation),
+        # so an eager loop recompiles every time — jit the CALLER for
+        # repeat-call performance, as the train-step builders do.
         fn = jax.jit(fn)
     raw_all = fn(
         layout.level, layout.wf_row, layout.wf_col, layout.wf_mask,

@@ -11,18 +11,16 @@ from __future__ import annotations
 import numpy as np
 
 
-def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
-    """Build the fixed seed-3 synthetic basin, run ONE GSPMD train step over an
-    ``n_mesh_devices``-device mesh, and return {loss, param_digest}."""
+def _make_problem():
+    """The FIXED seed-3 synthetic training problem both step runners share —
+    one construction site so the GSPMD and explicit-collective tests can never
+    drift onto different problems."""
     import jax
     import jax.numpy as jnp
 
     from ddr_tpu.geodatazoo.synthetic import make_basin, observe
     from ddr_tpu.nn.kan import Kan
-    from ddr_tpu.parallel import make_mesh, reach_sharding, shard_channels, shard_network
-    from ddr_tpu.routing.mc import Bounds
-    from ddr_tpu.routing.model import prepare_batch
-    from ddr_tpu.training import make_batch_train_step, make_optimizer
+    from ddr_tpu.training import make_optimizer
     from ddr_tpu.validation.configs import Config
 
     cfg = Config(
@@ -39,8 +37,6 @@ def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
         params={"save_path": "/tmp"},
     )
     basin = observe(make_basin(n_segments=96, n_gauges=4, n_days=8, seed=3), cfg)
-    rd = basin.routing_data
-    network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
     kan_model = Kan(
         input_var_names=tuple(cfg.kan.input_var_names),
         learnable_parameters=tuple(cfg.kan.learnable_parameters),
@@ -49,9 +45,34 @@ def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
         grid=cfg.kan.grid,
         k=cfg.kan.k,
     )
+    optimizer = make_optimizer(1e-3)
+    obs = jnp.asarray(basin.obs_daily)
+    mask = jnp.ones_like(obs, dtype=bool)
+    return cfg, basin, kan_model, optimizer, obs, mask
+
+
+def _digest(params) -> float:
+    import jax
+
+    return float(sum(np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(params)))
+
+
+def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
+    """Build the fixed seed-3 synthetic basin, run ONE GSPMD train step over an
+    ``n_mesh_devices``-device mesh, and return {loss, param_digest}."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.parallel import make_mesh, reach_sharding, shard_channels, shard_network
+    from ddr_tpu.routing.mc import Bounds
+    from ddr_tpu.routing.model import prepare_batch
+    from ddr_tpu.training import make_batch_train_step
+
+    cfg, basin, kan_model, optimizer, obs, mask = _make_problem()
+    rd = basin.routing_data
+    network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
     attrs = jnp.asarray(rd.normalized_spatial_attributes)
     params = kan_model.init(jax.random.key(0), attrs)
-    optimizer = make_optimizer(1e-3)
     opt_state = optimizer.init(params)
     step = make_batch_train_step(
         kan_model,
@@ -63,8 +84,6 @@ def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
         warmup=1,
         optimizer=optimizer,
     )
-    obs = jnp.asarray(basin.obs_daily)
-    mask = jnp.ones_like(obs, dtype=bool)
     q_prime = jnp.asarray(basin.q_prime)
 
     mesh = make_mesh(n_mesh_devices)
@@ -76,6 +95,49 @@ def run_gspmd_step(n_mesh_devices: int = 8) -> dict:
             jax.device_put(q_prime, reach_sharding(mesh, 1, 2)),
             obs, mask,
         )
-    leaves = jax.tree_util.tree_leaves(params2)
-    digest = float(sum(np.abs(np.asarray(x)).sum() for x in leaves))
-    return {"loss": float(loss), "param_digest": digest}
+    return {"loss": float(loss), "param_digest": _digest(params2)}
+
+
+def run_sharded_wavefront_step(n_mesh_devices: int = 8) -> dict:
+    """ONE explicit-collective (shard_map, 1 psum/wave) train step on the fixed
+    seed-3 problem over an ``n_mesh_devices``-device mesh; {loss, param_digest}.
+
+    The multi-process analog of the GSPMD step above — proves the
+    explicit-collective stack is process-count-agnostic too, not just XLA's
+    partitioner."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.parallel import (
+        build_sharded_wavefront,
+        make_mesh,
+        permute_routing_data,
+        topological_range_partition,
+    )
+    from ddr_tpu.routing.mc import Bounds
+    from ddr_tpu.routing.model import prepare_batch
+    from ddr_tpu.training import make_sharded_train_step
+
+    cfg, basin, kan_model, optimizer, obs, mask = _make_problem()
+    rd = basin.routing_data
+    n = rd.n_segments
+    part = topological_range_partition(rd.adjacency_rows, rd.adjacency_cols, n, n_mesh_devices)
+    rd = permute_routing_data(rd, part)
+    network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    sched = build_sharded_wavefront(rd.adjacency_rows, rd.adjacency_cols, n, n_mesh_devices)
+    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+    params = kan_model.init(jax.random.key(0), attrs)
+    opt_state = optimizer.init(params)
+    step = make_sharded_train_step(
+        kan_model, make_mesh(n_mesh_devices), sched, channels, gauges,
+        Bounds.from_config(cfg.params.attribute_minimums),
+        cfg.params.parameter_ranges,
+        cfg.params.log_space_parameters,
+        cfg.params.defaults,
+        tau=cfg.params.tau,
+        warmup=1,
+        optimizer=optimizer,
+    )
+    q_prime = jnp.asarray(basin.q_prime[:, part.perm])
+    params2, _, loss, _ = step(params, opt_state, attrs, q_prime, obs, mask)
+    return {"loss": float(loss), "param_digest": _digest(params2)}

@@ -217,3 +217,62 @@ def test_two_process_orbax_save_and_load(tmp_path):
     assert results[0]["epoch"] == results[1]["epoch"] == 4
     assert results[0]["digest"] == pytest.approx(results[1]["digest"], rel=1e-12)
     assert results[0]["digest"] == pytest.approx(70.0)  # sum(arange(12)) + sum(ones(4))
+
+
+SWF_WORKER = r"""
+import json
+
+from ddr_tpu.parallel.distributed import maybe_initialize
+
+assert maybe_initialize() is True
+import jax
+
+from tests.parallel._mp_problem import run_sharded_wavefront_step
+
+result = run_sharded_wavefront_step(8)
+print("RESULT " + json.dumps({"process": jax.process_index(), **result}))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_sharded_wavefront_step_matches_single_process():
+    """The EXPLICIT-COLLECTIVE train step (shard_map, one psum per wave) is
+    process-count-agnostic too: 2 processes x 4 devices reproduce this
+    process's single-process 8-device loss and update."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PALLAS_AXON_POOL_IPS="",
+            DDR_COORDINATOR=f"127.0.0.1:{port}",
+            DDR_NUM_PROCESSES="2",
+            DDR_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", SWF_WORKER],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    results = {}
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"process {pid} failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results[pid] = json.loads(line[len("RESULT "):])
+
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-12)
+    # BOTH processes must hold the identical post-step parameters (a missed
+    # psum in the backward could diverge the update while losses agree)
+    assert results[0]["param_digest"] == pytest.approx(
+        results[1]["param_digest"], rel=1e-12
+    )
+    from tests.parallel._mp_problem import run_sharded_wavefront_step
+
+    single = run_sharded_wavefront_step(8)
+    assert results[0]["loss"] == pytest.approx(single["loss"], rel=1e-5)
+    assert results[0]["param_digest"] == pytest.approx(single["param_digest"], rel=1e-6)
