@@ -19,7 +19,12 @@ import numpy as np
 
 from ddr_tpu.geodatazoo.dataclasses import RoutingData
 
-__all__ = ["ReachPartition", "topological_range_partition", "permute_routing_data"]
+__all__ = [
+    "ReachPartition",
+    "pad_routing_data",
+    "topological_range_partition",
+    "permute_routing_data",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +61,63 @@ def topological_range_partition(
     inv[perm] = np.arange(n)
     bounds = np.linspace(0, n, n_shards + 1).astype(np.int64)
     return ReachPartition(perm=perm, inv=inv, bounds=bounds)
+
+
+def pad_routing_data(rd: RoutingData, multiple: int) -> RoutingData:
+    """Append isolated pad reaches until ``n_segments`` is a multiple of ``multiple``.
+
+    Pad reaches carry no edges, zero attributes, benign channel geometry, and are
+    referenced by no gauge, so discharge at every real reach and every gauge is
+    bit-unchanged — they only absorb their own zero lateral inflow. Needed by the
+    equal-shard-block engines (``build_sharded_wavefront`` raises on indivisible
+    ``n``); callers must pad ``q_prime`` columns with zeros to match
+    (:meth:`ParallelTrainer.prepare` does).
+    """
+    n = rd.n_segments
+    k = (-n) % multiple
+    if k == 0:
+        return rd
+
+    def _pad1(a, value):
+        if a is None:
+            return None
+        a = np.asarray(a)
+        return np.concatenate([a, np.full(k, value, dtype=a.dtype)])
+
+    nsa = rd.normalized_spatial_attributes
+    if nsa is not None:
+        nsa = np.asarray(nsa)
+        nsa = np.concatenate([nsa, np.zeros((k, nsa.shape[1]), dtype=nsa.dtype)])
+    sa = rd.spatial_attributes
+    if sa is not None:
+        sa = np.asarray(sa)
+        sa = np.concatenate([sa, np.zeros((sa.shape[0], k), dtype=sa.dtype)], axis=1)
+    div = rd.divide_ids
+    if div is not None:
+        div = np.asarray(div)
+        if div.dtype.kind in "iu":
+            pad_ids = np.full(k, -1, dtype=div.dtype)
+        else:
+            pad_ids = np.asarray([f"__pad{i}__" for i in range(k)], dtype=div.dtype)
+        div = np.concatenate([div, pad_ids])
+    return RoutingData(
+        n_segments=n + k,
+        adjacency_rows=rd.adjacency_rows,
+        adjacency_cols=rd.adjacency_cols,
+        spatial_attributes=sa,
+        normalized_spatial_attributes=nsa,
+        length=_pad1(rd.length, 1.0),
+        slope=_pad1(rd.slope, 1.0),
+        side_slope=_pad1(rd.side_slope, 1.0),
+        top_width=_pad1(rd.top_width, 1.0),
+        x=_pad1(rd.x, 0.0),
+        dates=rd.dates,
+        observations=rd.observations,
+        divide_ids=div,
+        outflow_idx=rd.outflow_idx,
+        gage_catchment=rd.gage_catchment,
+        flow_scale=_pad1(rd.flow_scale, 1.0),
+    )
 
 
 def permute_routing_data(rd: RoutingData, part: ReachPartition) -> RoutingData:

@@ -1,0 +1,373 @@
+"""CLI-reachable multi-chip training: the last mile between ``ddr train`` and the
+sharded train-step builders (SURVEY.md §2.11; the role the reference never needed —
+its trainer is single-device, /root/reference/scripts/train.py:21-203).
+
+``experiment.parallel`` selects the engine; ``device`` sizes the mesh
+(``"cpu:8"`` = 8-virtual-device CPU mesh for tests/dryruns, ``"tpu"`` = every
+visible chip):
+
+- ``"gspmd"``: the SAME jitted :func:`ddr_tpu.training.make_batch_train_step` as
+  single-device, with reach-sharded inputs — XLA GSPMD inserts the collectives at
+  cross-shard river edges. One jit cache serves every batch; batches are
+  topological-range partitioned so collectives are one-directional.
+- ``"sharded-wavefront"``: the explicit-collective shard_map wavefront
+  (:func:`ddr_tpu.training.make_sharded_train_step`, one psum per wave). Batches
+  are padded to a shard multiple and partitioned; built steps are LRU-cached per
+  batch topology, so recurring gauge subsets (guaranteed within an epoch, and
+  across epochs under ``experiment.shuffle=false``) do not recompile.
+- ``"stacked-sharded"``: the O(1)-compile scan-over-bands deep engine
+  (:func:`ddr_tpu.training.make_sharded_chunked_train_step` over
+  :func:`ddr_tpu.parallel.stacked.build_stacked_sharded`); per-reach arrays stay
+  in original node order and ``experiment.remat_bands`` is honored.
+
+Every mode optimizes :func:`ddr_tpu.training.masked_l1_daily` — the single shared
+objective — so switching ``parallel`` changes the schedule, never the math
+(single-device loss parity pinned in tests/parallel/test_cli_parallel.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+from typing import Any, Callable
+
+import numpy as np
+
+from ddr_tpu.geodatazoo.dataclasses import RoutingData
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "PARALLEL_MODES",
+    "ParallelTrainer",
+    "ensure_device_platform",
+    "parse_device",
+]
+
+#: Accepted values of ``experiment.parallel`` (validated by the config schema).
+PARALLEL_MODES = ("none", "gspmd", "sharded-wavefront", "stacked-sharded")
+
+
+def parse_device(device: str) -> tuple[str, int | None]:
+    """``Config.device`` -> ``(platform, device_count | None)``.
+
+    ``"tpu"`` -> ``("tpu", None)`` (all visible chips); ``"cpu:8"`` -> ``("cpu", 8)``
+    (8-virtual-device host mesh); ``"tpu:4"`` -> ``("tpu", 4)`` (first 4 chips).
+    """
+    plat, sep, cnt = device.partition(":")
+    if not sep:
+        return plat, None
+    try:
+        n = int(cnt)
+    except ValueError as e:
+        raise ValueError(f"device {device!r}: count after ':' must be an integer") from e
+    if n < 1:
+        raise ValueError(f"device {device!r}: count must be >= 1")
+    return plat, n
+
+
+def ensure_device_platform(device: str) -> None:
+    """Make ``Config.device`` effective BEFORE the first JAX device access.
+
+    ``"cpu"``/``"cpu:N"`` redirect JAX onto the host platform (with N virtual
+    devices for the ``:N`` form) — but only if the backend is still
+    uninitialized: the image's sitecustomize pre-imports jax against the axon
+    TPU tunnel, and flipping platforms after initialization is not possible, so
+    an already-initialized backend is left alone with a warning. ``"tpu"`` is a
+    no-op (the default platform resolution already prefers accelerators).
+    """
+    import os
+
+    plat, n = parse_device(device)
+    if plat != "cpu":
+        return
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover - private-API drift
+        initialized = False
+    if initialized:
+        have = jax.local_device_count()
+        if jax.default_backend() != "cpu" or (n is not None and have < n):
+            log.warning(
+                f"device={device!r} requested but the JAX backend is already "
+                f"initialized ({jax.default_backend()}, {have} devices); set "
+                "JAX_PLATFORMS=cpu / XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{n or ''} before importing jax"
+            )
+        return
+    if n is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        else:
+            import re
+
+            m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+            if m and int(m.group(1)) < n:
+                log.warning(
+                    f"device={device!r} requested but XLA_FLAGS already forces "
+                    f"{m.group(1)} host devices; the mesh build will fail — drop "
+                    "the stale xla_force_host_platform_device_count flag"
+                )
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _batch_key(rd: RoutingData) -> str:
+    """Identity of everything a sharded step builder bakes in as compile-time
+    constants: topology, channel geometry, and the gauge index. Batches with the
+    same key can safely share a built (and compiled) step."""
+    h = hashlib.sha1()
+    h.update(str(rd.n_segments).encode())
+    for a in (
+        rd.adjacency_rows,
+        rd.adjacency_cols,
+        rd.length,
+        rd.slope,
+        rd.x,
+        rd.top_width,
+        rd.side_slope,
+    ):
+        h.update(b"|")
+        if a is not None:
+            h.update(np.ascontiguousarray(a).tobytes())
+    if rd.outflow_idx is not None:
+        for g in rd.outflow_idx:
+            h.update(b"#")
+            h.update(np.ascontiguousarray(g).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class PreparedBatch:
+    """Host-side product of :meth:`ParallelTrainer.prepare` (built in the
+    prefetch thread): everything the device step needs, already sharded."""
+
+    mode: str
+    attrs: Any  # (N', A) step input, partitioned/padded order
+    q_prime: Any  # (T, N') step input
+    n_timesteps: int
+    # gspmd payload (None otherwise)
+    network: Any = None
+    channels: Any = None
+    gauges: Any = None
+    # explicit-engine payload (None for gspmd)
+    step_fn: Callable | None = None
+
+
+class ParallelTrainer:
+    """Per-batch multi-chip step dispatch for the training loop.
+
+    Construct once per run (builds the mesh and, for GSPMD, the one reusable
+    jitted step); call :meth:`prepare` per batch off-thread and :meth:`step`
+    on the training thread.
+    """
+
+    def __init__(self, cfg: Any, kan_model: Any, optimizer: Any) -> None:
+        import jax
+
+        from ddr_tpu.parallel.sharding import make_mesh
+        from ddr_tpu.routing.mc import Bounds
+        from ddr_tpu.training import make_batch_train_step
+
+        mode = cfg.experiment.parallel
+        if mode not in PARALLEL_MODES or mode == "none":
+            raise ValueError(
+                f"experiment.parallel={mode!r} is not a parallel mode; "
+                f"expected one of {PARALLEL_MODES[1:]}"
+            )
+        self.mode = mode
+        self.cfg = cfg
+        self.kan_model = kan_model
+        self.optimizer = optimizer
+        _, n = parse_device(cfg.device)
+        self.mesh = make_mesh(n)
+        self.n_shards = int(self.mesh.devices.size)
+        self.slope_min = cfg.params.attribute_minimums["slope"]
+        self.bounds = Bounds.from_config(cfg.params.attribute_minimums)
+        # Built-step LRU: each entry retains a compiled XLA executable, and under
+        # experiment.shuffle=True the sampler re-draws gauge membership per epoch,
+        # so keys recur only within an epoch (shuffle=False recurs across epochs).
+        # The cap bounds host memory; evicted topologies simply rebuild.
+        from collections import OrderedDict
+
+        self._step_cache: OrderedDict[str, Callable] = OrderedDict()
+        self._step_cache_max = 32
+        self._builder_kw = dict(
+            parameter_ranges=cfg.params.parameter_ranges,
+            log_space_parameters=cfg.params.log_space_parameters,
+            defaults=cfg.params.defaults,
+            tau=cfg.params.tau,
+            warmup=cfg.experiment.warmup,
+            optimizer=optimizer,
+        )
+        if mode == "gspmd":
+            # remat_bands is a stacked-engine knob; the GSPMD path executes the
+            # rectangle step engine (shard_network docstring), so it never applies.
+            self._gspmd_step = make_batch_train_step(
+                kan_model, self.bounds, **self._builder_kw
+            )
+        log.info(
+            f"multi-chip training: parallel={mode} over {self.n_shards} devices "
+            f"({jax.devices()[0].platform})"
+        )
+
+    def _cached_step(self, key: str, build: Callable[[], Callable]) -> Callable:
+        """LRU lookup/insert for built sharded steps."""
+        step = self._step_cache.get(key)
+        if step is not None:
+            self._step_cache.move_to_end(key)
+            return step
+        step = build()
+        self._step_cache[key] = step
+        if len(self._step_cache) > self._step_cache_max:
+            self._step_cache.popitem(last=False)
+        return step
+
+    # ---- host-side batch preparation (prefetch-thread safe) ----
+
+    def prepare(self, rd: RoutingData, q_prime: np.ndarray) -> PreparedBatch:
+        """Batch -> sharded device inputs + the step to run.
+
+        ``q_prime`` is the already-flow-scaled (T, N) lateral inflow in the
+        batch's original reach order.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ddr_tpu.parallel.partition import (
+            pad_routing_data,
+            permute_routing_data,
+            topological_range_partition,
+        )
+        from ddr_tpu.parallel.sharding import reach_sharding, shard_channels, shard_network
+        from ddr_tpu.routing.model import prepare_batch, prepare_channels
+
+        T = int(q_prime.shape[0])
+        if self.mode == "stacked-sharded":
+            # The stacked-sharded layout keeps ORIGINAL node order (it carries
+            # its own band/shard permutations), so no partition/pad here.
+            def _build_stacked():
+                from ddr_tpu.parallel.stacked import build_stacked_sharded
+                from ddr_tpu.training import make_sharded_chunked_train_step
+
+                layout = build_stacked_sharded(
+                    rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, self.n_shards
+                )
+                channels, gauges = prepare_channels(rd, self.slope_min)
+                return make_sharded_chunked_train_step(
+                    self.kan_model,
+                    self.mesh,
+                    layout,
+                    channels,
+                    gauges,
+                    self.bounds,
+                    remat_bands=self.cfg.experiment.remat_bands,
+                    **self._builder_kw,
+                )
+
+            step = self._cached_step(_batch_key(rd), _build_stacked)
+            return PreparedBatch(
+                mode=self.mode,
+                attrs=jnp.asarray(rd.normalized_spatial_attributes),
+                q_prime=jnp.asarray(q_prime),
+                n_timesteps=T,
+                step_fn=step,
+            )
+
+        # Both remaining modes share the pad -> zero-pad q' -> partition ->
+        # permute host transform (equal shard blocks + one-directional edges).
+        def _pad_and_partition(rd, q_prime):
+            rd_pad = pad_routing_data(rd, self.n_shards)
+            n_pad = rd_pad.n_segments - rd.n_segments
+            if n_pad:
+                q_prime = np.concatenate(
+                    [q_prime, np.zeros((T, n_pad), dtype=q_prime.dtype)], axis=1
+                )
+            part = topological_range_partition(
+                rd_pad.adjacency_rows, rd_pad.adjacency_cols, rd_pad.n_segments, self.n_shards
+            )
+            return permute_routing_data(rd_pad, part), q_prime[:, part.perm]
+
+        if self.mode == "sharded-wavefront":
+            rd_p, q_prime = _pad_and_partition(rd, q_prime)
+
+            def _build_wavefront():
+                from ddr_tpu.parallel.wavefront import build_sharded_wavefront
+                from ddr_tpu.training import make_sharded_train_step
+
+                schedule = build_sharded_wavefront(
+                    rd_p.adjacency_rows, rd_p.adjacency_cols, rd_p.n_segments, self.n_shards
+                )
+                channels, gauges = prepare_channels(rd_p, self.slope_min)
+                return make_sharded_train_step(
+                    self.kan_model,
+                    self.mesh,
+                    schedule,
+                    channels,
+                    gauges,
+                    self.bounds,
+                    **self._builder_kw,
+                )
+
+            step = self._cached_step(_batch_key(rd_p), _build_wavefront)
+            return PreparedBatch(
+                mode=self.mode,
+                attrs=jnp.asarray(rd_p.normalized_spatial_attributes),
+                q_prime=jnp.asarray(q_prime),
+                n_timesteps=T,
+                step_fn=step,
+            )
+
+        # gspmd — NamedSharding device_put requires the reach axis divisible by
+        # the mesh, so the same pad/partition transform applies
+        rd_p, q_prime = _pad_and_partition(rd, q_prime)
+        # chunked=False: shard_network needs the plain RiverNetwork (GSPMD rides
+        # the rectangle scan schedule; the fused tables would all-gather).
+        network, channels, gauges = prepare_batch(rd_p, self.slope_min, chunked=False)
+        return PreparedBatch(
+            mode=self.mode,
+            attrs=jax.device_put(
+                jnp.asarray(rd_p.normalized_spatial_attributes),
+                reach_sharding(self.mesh, 0, 2),
+            ),
+            q_prime=jax.device_put(
+                jnp.asarray(q_prime), reach_sharding(self.mesh, 1, 2)
+            ),
+            n_timesteps=T,
+            network=shard_network(self.mesh, network),
+            channels=shard_channels(self.mesh, channels),
+            gauges=gauges,
+        )
+
+    # ---- device step ----
+
+    def step(self, prep: PreparedBatch, params, opt_state, obs_daily, obs_mask):
+        """Run one training step; same returns as ``make_batch_train_step``:
+        ``(params, opt_state, loss, daily)``."""
+        import jax.numpy as jnp
+
+        obs_daily = jnp.asarray(obs_daily)
+        obs_mask = jnp.asarray(obs_mask)
+        with self.mesh:
+            if prep.mode == "gspmd":
+                return self._gspmd_step(
+                    params,
+                    opt_state,
+                    prep.network,
+                    prep.channels,
+                    prep.gauges,
+                    prep.attrs,
+                    prep.q_prime,
+                    obs_daily,
+                    obs_mask,
+                )
+            return prep.step_fn(
+                params, opt_state, prep.attrs, prep.q_prime, obs_daily, obs_mask
+            )

@@ -55,6 +55,11 @@ def setup_run(cfg: Config) -> Config:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
     )
+    # Device selection (cpu / cpu:N virtual mesh) must land before the first
+    # device access; no-op for "tpu" or an already-initialized backend.
+    from ddr_tpu.parallel.train import ensure_device_platform
+
+    ensure_device_platform(cfg.device)
     # Multi-process launch (DDR_COORDINATOR / DDR_NUM_PROCESSES / DDR_PROCESS_ID,
     # or DDR_DISTRIBUTED=1 for cluster autodetect): must run before the first
     # device access so every mesh below spans the global device set. No-op when

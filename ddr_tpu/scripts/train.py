@@ -96,17 +96,28 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     else:
         opt_state = optimizer.init(params)
 
-    step = make_batch_train_step(
-        kan_model,
-        Bounds.from_config(cfg.params.attribute_minimums),
-        cfg.params.parameter_ranges,
-        cfg.params.log_space_parameters,
-        cfg.params.defaults,
-        tau=cfg.params.tau,
-        warmup=cfg.experiment.warmup,
-        optimizer=optimizer,
-        remat_bands=cfg.experiment.remat_bands,
-    )
+    par = None
+    if cfg.experiment.parallel != "none":
+        # Multi-chip path (experiment.parallel=gspmd|sharded-wavefront|
+        # stacked-sharded over the device/mesh `device` selects): per-batch
+        # partitioning + sharded step dispatch live in ParallelTrainer; the loop
+        # below is otherwise identical.
+        from ddr_tpu.parallel.train import ParallelTrainer
+
+        par = ParallelTrainer(cfg, kan_model, optimizer)
+        step = None
+    else:
+        step = make_batch_train_step(
+            kan_model,
+            Bounds.from_config(cfg.params.attribute_minimums),
+            cfg.params.parameter_ranges,
+            cfg.params.log_space_parameters,
+            cfg.params.defaults,
+            tau=cfg.params.tau,
+            warmup=cfg.experiment.warmup,
+            optimizer=optimizer,
+            remat_bands=cfg.experiment.remat_bands,
+        )
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
     throughput = Throughput(label="train")
@@ -132,18 +143,26 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 # Everything batch-local and training-state-independent: runs
                 # one batch AHEAD in the prefetch thread, hiding graph-schedule
                 # builds + device uploads behind the device's current step.
+                # `attrs` stays in ORIGINAL batch order for the KAN grid refit;
+                # in parallel mode it stays a host array (the payload carries its
+                # own partitioned device copy) and is uploaded only if a refit
+                # actually happens.
                 i, rd = item
                 q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
                 if rd.flow_scale is not None:
                     q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
-                network, channels, gauges = prepare_batch(rd, slope_min)
-                attrs = jnp.asarray(rd.normalized_spatial_attributes)
                 obs_daily, obs_mask = daily_observation_targets(rd)
-                return i, rd, q_prime, network, channels, gauges, attrs, obs_daily, obs_mask
+                if par is not None:
+                    payload = par.prepare(rd, q_prime)
+                    attrs = rd.normalized_spatial_attributes
+                else:
+                    payload = (jnp.asarray(q_prime), *prepare_batch(rd, slope_min))
+                    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+                return i, rd, payload, attrs, obs_daily, obs_mask
 
-            for (
-                i, rd, q_prime, network, channels, gauges, attrs, obs_daily, obs_mask
-            ) in prefetch(_batches(), _prepare):
+            for i, rd, payload, attrs, obs_daily, obs_mask in prefetch(
+                _batches(), _prepare
+            ):
                 if not grids_refit:
                     # pykan-style data refit of the spline grids on the first
                     # EXECUTED mini-batch of the epoch (not literal i == 0, so a
@@ -152,22 +171,29 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     # knots — ddr_tpu.nn.kan docstring).
                     from ddr_tpu.nn.kan import update_grid_from_samples
 
-                    params = update_grid_from_samples(kan_model, params, attrs)
+                    params = update_grid_from_samples(kan_model, params, jnp.asarray(attrs))
                     grids_refit = True
                     log.info(f"epoch {epoch}: adaptive KAN grids refit from batch attributes")
 
-                with throughput.batch(rd.n_segments, q_prime.shape[0]):
-                    params, opt_state, loss, daily = step(
-                        params,
-                        opt_state,
-                        network,
-                        channels,
-                        gauges,
-                        attrs,
-                        jnp.asarray(q_prime),
-                        jnp.asarray(obs_daily),
-                        jnp.asarray(obs_mask),
-                    )
+                n_timesteps = payload.n_timesteps if par is not None else payload[0].shape[0]
+                with throughput.batch(rd.n_segments, n_timesteps):
+                    if par is not None:
+                        params, opt_state, loss, daily = par.step(
+                            payload, params, opt_state, obs_daily, obs_mask
+                        )
+                    else:
+                        q_prime, network, channels, gauges = payload
+                        params, opt_state, loss, daily = step(
+                            params,
+                            opt_state,
+                            network,
+                            channels,
+                            gauges,
+                            attrs,
+                            q_prime,
+                            jnp.asarray(obs_daily),
+                            jnp.asarray(obs_mask),
+                        )
                     loss = float(loss)  # device sync: the timing covers the whole step
                 daily = np.asarray(daily)  # (D-2, G)
                 log.info(

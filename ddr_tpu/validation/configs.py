@@ -143,6 +143,17 @@ class ExperimentConfig(BaseModel):
     shuffle: bool = True
     warmup: int = Field(default=3, description="Days excluded from the loss while routing spins up")
     max_area_diff_sqkm: float | None = 50
+    parallel: str = Field(
+        default="none",
+        description=(
+            "Multi-chip training engine: 'none' (single-device batch step), "
+            "'gspmd' (reach-sharded inputs, XLA-inserted collectives), "
+            "'sharded-wavefront' (explicit shard_map wavefront, one psum/wave), "
+            "or 'stacked-sharded' (O(1)-compile deep scan-over-bands). The mesh "
+            "spans the devices `device` selects ('cpu:8' = virtual 8-device host "
+            "mesh); see ddr_tpu.parallel.train"
+        ),
+    )
     remat_bands: bool = Field(
         default=False,
         description=(
@@ -163,6 +174,17 @@ class ExperimentConfig(BaseModel):
     def _coerce_epoch_keys(cls, v: Any) -> Any:
         if isinstance(v, dict):
             return {int(k): float(val) for k, val in v.items()}
+        return v
+
+    @field_validator("parallel")
+    @classmethod
+    def _parallel_known(cls, v: str) -> str:
+        from ddr_tpu.parallel.train import PARALLEL_MODES
+
+        if v not in PARALLEL_MODES:
+            raise ValueError(
+                f"experiment.parallel must be one of {PARALLEL_MODES}, got {v!r}"
+            )
         return v
 
 

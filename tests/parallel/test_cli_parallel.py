@@ -1,0 +1,192 @@
+"""CLI-reachable multi-chip training (ddr_tpu.parallel.train): every
+``experiment.parallel`` mode runs end-to-end through ``scripts.train.train`` on
+the virtual 8-device mesh, and each mode's single step matches the single-device
+batch step's loss on the same batch (the objective is shared, so only the
+schedule may differ — VERDICT r4 item 2)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.parallel.train import PARALLEL_MODES, ParallelTrainer, parse_device
+from ddr_tpu.validation.configs import Config
+
+N_DEV = 8
+
+ENGINE_MODES = [m for m in PARALLEL_MODES if m != "none"]
+
+
+def _need_devices():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+
+
+class TestParseDevice:
+    def test_forms(self):
+        assert parse_device("tpu") == ("tpu", None)
+        assert parse_device("cpu") == ("cpu", None)
+        assert parse_device("cpu:8") == ("cpu", 8)
+        assert parse_device("tpu:4") == ("tpu", 4)
+
+    def test_rejects_bad_count(self):
+        with pytest.raises(ValueError, match="integer"):
+            parse_device("cpu:eight")
+        with pytest.raises(ValueError, match=">= 1"):
+            parse_device("cpu:0")
+
+
+class TestPadRoutingData:
+    def test_pad_preserves_routing(self):
+        """Padded batch routes identically at every real reach."""
+        from ddr_tpu.geodatazoo.synthetic import make_basin
+        from ddr_tpu.parallel.partition import pad_routing_data
+        from ddr_tpu.routing.mc import route
+        from ddr_tpu.routing.model import prepare_batch
+
+        basin = make_basin(n_segments=21, n_gauges=2, n_days=2, seed=3)
+        rd = basin.routing_data
+        rd_pad = pad_routing_data(rd, N_DEV)
+        assert rd_pad.n_segments == 24
+        assert rd_pad.n_segments % N_DEV == 0
+        # multiple-already: identity
+        assert pad_routing_data(rd_pad, N_DEV) is rd_pad
+
+        qp = jnp.asarray(basin.q_prime)
+        qp_pad = jnp.concatenate([qp, jnp.zeros((qp.shape[0], 3))], axis=1)
+        spatial = {
+            "n": jnp.full(21, 0.03),
+            "q_spatial": jnp.full(21, 0.5),
+            "p_spatial": jnp.full(21, 21.0),
+        }
+        spatial_pad = {k: jnp.concatenate([v, jnp.full(3, 0.5)]) for k, v in spatial.items()}
+        net, ch, _ = prepare_batch(rd, 0.001)
+        net_p, ch_p, _ = prepare_batch(rd_pad, 0.001)
+        out = route(net, ch, spatial, qp).runoff
+        out_p = route(net_p, ch_p, spatial_pad, qp_pad).runoff
+        np.testing.assert_allclose(np.asarray(out_p[:, :21]), np.asarray(out), rtol=1e-6)
+
+
+def _synthetic_cfg(tmp_path, **exp):
+    return Config(
+        name="par_run",
+        geodataset="synthetic",
+        mode="training",
+        device=f"cpu:{N_DEV}",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/20",
+            "rho": 8,
+            "batch_size": 2,
+            "epochs": 1,
+            "warmup": 1,
+            "learning_rate": {1: 0.01},
+            **exp,
+        },
+        params={"save_path": str(tmp_path)},
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ENGINE_MODES)
+def test_train_cli_end_to_end(tmp_path, mode):
+    """`ddr train ... experiment.parallel=<mode> device=cpu:8` equivalent: two
+    mini-batches through the real training loop, checkpoints + plots written."""
+    from ddr_tpu.scripts.train import train
+
+    _need_devices()
+    cfg = _synthetic_cfg(tmp_path, parallel=mode)
+    params, opt_state = train(cfg, max_batches=2)
+    assert params is not None
+    assert list((tmp_path / "saved_models").glob("*.pkl")), "no checkpoint written"
+
+
+class TestStepParity:
+    """One ParallelTrainer step vs the single-device batch step on the SAME
+    batch: identical loss/daily (fresh params+optimizer both sides)."""
+
+    def _setup(self, tmp_path, mode):
+        from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+        from ddr_tpu.routing.mc import Bounds
+        from ddr_tpu.routing.model import prepare_batch
+        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.training import make_batch_train_step, make_optimizer
+
+        _need_devices()
+        cfg = _synthetic_cfg(tmp_path, parallel=mode)
+        basin = make_basin(n_segments=93, n_gauges=4, n_days=6, seed=7)
+        basin = observe(basin, cfg)
+        rd = basin.routing_data
+        kan_model, params = build_kan(cfg)
+        optimizer = make_optimizer(1e-3)
+        opt_state = optimizer.init(params)
+        par = ParallelTrainer(cfg, kan_model, optimizer)
+        q_prime = np.asarray(basin.q_prime, dtype=np.float32)
+        # full-period q_prime pairs with observe()'s full-period daily targets
+        # (the loader's per-window batches instead pair with
+        # daily_observation_targets — exercised by the end-to-end test above)
+        obs_daily = np.asarray(basin.obs_daily, dtype=np.float32)
+        obs_mask = np.ones_like(obs_daily, dtype=bool)
+
+        ref_step = make_batch_train_step(
+            kan_model,
+            Bounds.from_config(cfg.params.attribute_minimums),
+            cfg.params.parameter_ranges,
+            cfg.params.log_space_parameters,
+            cfg.params.defaults,
+            tau=cfg.params.tau,
+            warmup=cfg.experiment.warmup,
+            optimizer=optimizer,
+        )
+        network, channels, gauges = prepare_batch(
+            rd, cfg.params.attribute_minimums["slope"]
+        )
+        _, _, ref_loss, ref_daily = ref_step(
+            params,
+            opt_state,
+            network,
+            channels,
+            gauges,
+            jnp.asarray(rd.normalized_spatial_attributes),
+            jnp.asarray(q_prime),
+            jnp.asarray(obs_daily),
+            jnp.asarray(obs_mask),
+        )
+        prep = par.prepare(rd, q_prime)
+        _, _, loss, daily = par.step(prep, params, opt_state, obs_daily, obs_mask)
+        return float(ref_loss), np.asarray(ref_daily), float(loss), np.asarray(daily), par, prep
+
+    @pytest.mark.parametrize("mode", ENGINE_MODES)
+    def test_loss_matches_single_device(self, tmp_path, mode):
+        ref_loss, ref_daily, loss, daily, _, _ = self._setup(tmp_path, mode)
+        assert np.isfinite(loss)
+        np.testing.assert_allclose(loss, ref_loss, rtol=2e-4)
+        np.testing.assert_allclose(daily, ref_daily, rtol=2e-3, atol=1e-4)
+
+    def test_step_cache_reused_on_repeat_batch(self, tmp_path):
+        """The sampler cycles a fixed gauge list; a recurring batch topology must
+        hit the built-step cache, not rebuild (recompile churn)."""
+        *_, par, prep = self._setup(tmp_path, "sharded-wavefront")
+        assert len(par._step_cache) == 1
+        step_before = next(iter(par._step_cache.values()))
+        from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+
+        basin = make_basin(n_segments=93, n_gauges=4, n_days=6, seed=7)
+        basin = observe(basin, _synthetic_cfg(tmp_path, parallel="sharded-wavefront"))
+        prep2 = par.prepare(basin.routing_data, np.asarray(basin.q_prime, np.float32))
+        assert len(par._step_cache) == 1
+        assert prep2.step_fn is step_before
+
+
+def test_parallel_config_validates():
+    with pytest.raises(ValueError, match="experiment.parallel"):
+        Config(
+            name="x",
+            geodataset="synthetic",
+            mode="training",
+            kan={"input_var_names": ["a"]},
+            experiment={"parallel": "bogus"},
+        )
