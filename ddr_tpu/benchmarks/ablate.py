@@ -33,6 +33,16 @@ import time
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = {a for a in sys.argv[1:] if a.startswith("--")}
+    known = {"--grad", "--no-remat", "--remat-bands"}
+    unknown = flags - known
+    if unknown:
+        # A typo'd flag must NOT silently measure the default variant and emit
+        # an official-looking record (capture sessions would archive it as real).
+        print(
+            f"unknown flags {sorted(unknown)}; known: {sorted(known)}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     n, t_hours = int(args[0]), int(args[1])
     schedule = args[2] if len(args) > 2 else "fused"
     depth = int(args[3]) if len(args) > 3 else None

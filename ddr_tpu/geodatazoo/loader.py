@@ -40,7 +40,8 @@ def prefetch(
     """
     from concurrent.futures import ThreadPoolExecutor
 
-    with ThreadPoolExecutor(max_workers=1) as pool:
+    pool = ThreadPoolExecutor(max_workers=1)
+    try:
         pending: list = []
         it = iter(iterable)
         try:
@@ -56,6 +57,12 @@ def prefetch(
                 except StopIteration:
                     it = None
             yield item
+    finally:
+        # Early consumer exit (train.py's max_batches cutoff, GeneratorExit) or
+        # a prepare error must not block for one full host-prep latency on a
+        # batch nobody will consume: drop queued work and return immediately
+        # (an already-RUNNING prepare still finishes in its thread, harmlessly).
+        pool.shutdown(wait=False, cancel_futures=True)
 
 
 class DataLoader:

@@ -43,6 +43,19 @@ from ddr_tpu.routing.stacked import auto_band_count, pack_level_bands_balanced
 
 __all__ = ["StackedSharded", "build_stacked_sharded", "route_stacked_sharded"]
 
+import logging
+import weakref
+
+log = logging.getLogger(__name__)
+
+# Track repeat EAGER remat_bands calls per layout to warn (once) about the
+# per-call re-jit; trace-time executions (inside a jitted train step) excluded.
+# WeakValueDictionary (not a set of ids): an entry dies with its layout, so a
+# recycled object address can never be mistaken for a repeat call, and the
+# registry cannot grow past the set of live layouts.
+_EAGER_REMAT_SEEN: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
+_EAGER_REMAT_WARNED = False
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -465,8 +478,23 @@ def route_stacked_sharded(
         # this keeps the eager contract identical for both settings. NOTE:
         # the wrapper is per-call (the closure is rebuilt each invocation),
         # so an eager loop recompiles every time — jit the CALLER for
-        # repeat-call performance, as the train-step builders do.
+        # repeat-call performance, as the train-step builders do; a repeat
+        # eager call on the same layout warns once (below).
         fn = jax.jit(fn)
+        if not isinstance(q_prime, jax.core.Tracer):  # eager call, not a trace
+            global _EAGER_REMAT_WARNED
+            if _EAGER_REMAT_SEEN.get(id(layout)) is layout and not _EAGER_REMAT_WARNED:
+                log.warning(
+                    "route_stacked_sharded(remat_bands=True) called eagerly more "
+                    "than once with the same layout: each call re-jits the full "
+                    "band program; jit the caller (as the train-step builders do) "
+                    "to reuse the compile"
+                )
+                _EAGER_REMAT_WARNED = True
+            try:
+                _EAGER_REMAT_SEEN[id(layout)] = layout
+            except TypeError:  # pragma: no cover - non-weakrefable layout type
+                pass
     raw_all = fn(
         layout.level, layout.wf_row, layout.wf_col, layout.wf_mask,
         layout.hb_out, layout.hb_tgt, layout.hb_gap, layout.ext_cols,

@@ -419,6 +419,10 @@ def save_state_orbax(
     (`experiment.checkpoint`, train resume, geometry predictor)."""
     import orbax.checkpoint as ocp
 
+    # Validate BEFORE the collective array save and on EVERY process: raising
+    # on process 0 alone after ckptr.save would leave the other hosts hanging
+    # in the completion barrier below.
+    _require_json_plain(rng_state, "rng_state")
     save_dir = Path(save_dir).resolve()
     save_dir.mkdir(parents=True, exist_ok=True)
     path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.orbax"
@@ -472,6 +476,36 @@ def save_state_orbax(
 
         multihost_utils.sync_global_devices("ddr_tpu_ckpt_meta_written")
     return path
+
+
+def _require_json_plain(obj: Any, where: str) -> None:
+    """Reject rng-state structures JSON would silently rewrite into something a
+    consumer could mis-restore. Tuples become lists with no marker — the exact
+    structural drift the pickle path would have preserved — so they fail at
+    save time. ndarrays also restore as lists, but that form is explicitly
+    accepted by every known consumer (numpy ``bit_generator.state`` setters
+    round-trip bit-identically — e.g. MT19937's ``key`` array), so ``_json_np``
+    keeps encoding them; numpy scalars map to the equivalent Python number."""
+    import numpy as np
+
+    if obj is None or isinstance(
+        obj, (bool, int, float, str, np.integer, np.floating, np.ndarray)
+    ):
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _require_json_plain(v, f"{where}.{k}")
+        return
+    if isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _require_json_plain(v, f"{where}[{i}]")
+        return
+    raise TypeError(
+        f"{where} is {type(obj).__name__}: save_state_orbax serializes rng_state "
+        "through JSON, which would rewrite this to a different structure on "
+        "restore (tuples become lists). Use dict/list/str/number/ndarray leaves, "
+        "or checkpoint with save_state (pickle) instead"
+    )
 
 
 def _json_np(obj: Any):

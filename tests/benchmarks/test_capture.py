@@ -34,3 +34,18 @@ def test_plan_keys_unique():
     assert "chunked,2048,--grad" in joined
     assert "--no-remat" in joined
     assert any(k.startswith("trainbench:") for k in keys)
+
+
+def test_ablate_rejects_unknown_flags(monkeypatch, capsys):
+    """A typo'd flag must exit non-zero (capture records an error row), never
+    silently measure the default variant under an official-looking JSON."""
+    import sys
+
+    import pytest as _pytest
+
+    from ddr_tpu.benchmarks import ablate
+
+    monkeypatch.setattr(sys, "argv", ["ablate", "8", "2", "rect", "--gard"])
+    with _pytest.raises(SystemExit) as e:
+        ablate.main()
+    assert e.value.code == 2

@@ -138,6 +138,25 @@ class TestPrefetch:
         time.sleep(0.2)  # give the worker time to overrun if it were unbounded
         assert len(prepared) <= 3  # consumed 1 + ahead 1 + one in flight
 
+    def test_early_exit_is_prompt(self):
+        """Abandoning the iterator (train.py's max_batches cutoff) must not
+        block on queued prepares of batches nobody will consume."""
+        import time
+
+        from ddr_tpu.geodatazoo.loader import prefetch
+
+        def slow_prep(x):
+            time.sleep(1.5)
+            return x
+
+        it = prefetch(range(10), slow_prep, ahead=1)
+        next(it)  # ~1.5s: first item must complete
+        t0 = time.perf_counter()
+        it.close()  # GeneratorExit -> shutdown(wait=False, cancel_futures=True)
+        # the QUEUED prepare is cancelled; only an already-running one may
+        # finish in its thread, and close() must not wait for it
+        assert time.perf_counter() - t0 < 1.0
+
 
 class TestCollatePurity:
     """collate_fn must hand each batch an INDEPENDENT window: collating batch

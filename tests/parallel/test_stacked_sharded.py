@@ -285,3 +285,30 @@ def test_builder_rejects_remat_bands_on_chunked_layout():
             cfg.params.defaults, tau=3, warmup=1,
             optimizer=make_optimizer(1e-3), remat_bands=True,
         )
+
+
+def test_repeat_eager_remat_bands_warns_once(caplog, monkeypatch):
+    """Eager remat_bands re-jits the band program per call (the closure is
+    rebuilt); a repeated eager call on the same layout must warn exactly once,
+    and trace-time executions inside a jitted caller must not."""
+    import logging
+    import weakref
+
+    import ddr_tpu.parallel.stacked as stacked_mod
+
+    # the warn-once registry is process-global: reset so this test is
+    # order-independent and repeatable
+    monkeypatch.setattr(stacked_mod, "_EAGER_REMAT_WARNED", False)
+    monkeypatch.setattr(stacked_mod, "_EAGER_REMAT_SEEN", weakref.WeakValueDictionary())
+
+    n, depth, T = 48, 12, 2
+    rows, cols, channels, params, qp = _setup(n, depth, T, seed=9)
+    layout = build_stacked_sharded(rows, cols, n, N_DEV)
+    mesh = make_mesh(N_DEV)
+    with caplog.at_level(logging.WARNING, logger="ddr_tpu.parallel.stacked"):
+        with mesh:
+            route_stacked_sharded(mesh, layout, channels, params, qp, remat_bands=True)
+            assert not [r for r in caplog.records if "re-jits" in r.message]
+            route_stacked_sharded(mesh, layout, channels, params, qp, remat_bands=True)
+            route_stacked_sharded(mesh, layout, channels, params, qp, remat_bands=True)
+    assert len([r for r in caplog.records if "re-jits" in r.message]) == 1

@@ -422,6 +422,44 @@ class TestOrbaxCheckpoints:
         with pytest.raises(ValueError, match="different architecture"):
             load_state_orbax(path, expected_arch={"grid": 50})
 
+    def test_non_json_plain_rng_state_fails_at_save(self, tmp_path):
+        """JSON silently rewrites tuples/ndarrays to lists, so an rng blob that
+        would restore structurally different from the pickle path must fail AT
+        SAVE TIME, not corrupt a later resume."""
+        from ddr_tpu.training import make_optimizer, save_state_orbax
+
+        params = {"w": jnp.ones(3)}
+        opt_state = make_optimizer(1e-3).init(params)
+        for bad, pattern in [
+            ({"key": (1, 2)}, "rng_state.key is tuple"),
+            ({"deep": {"inner": [1, (2,)]}}, r"rng_state.deep.inner\[1\] is tuple"),
+        ]:
+            with pytest.raises(TypeError, match=pattern):
+                save_state_orbax(
+                    tmp_path, "bad", epoch=1, mini_batch=0, params=params,
+                    opt_state=opt_state, rng_state=bad,
+                )
+        # the real loader blob (dict of ints/strs) still saves, and so does an
+        # MT19937-style state whose ndarray 'key' leaf round-trips through JSON
+        # bit-identically (numpy state setters accept the list form)
+        rng_state = {"bit_generator": np.random.default_rng(5).bit_generator.state}
+        save_state_orbax(
+            tmp_path, "ok", epoch=1, mini_batch=0, params=params,
+            opt_state=opt_state, rng_state=rng_state,
+        )
+        mt_state = {"bit_generator": np.random.Generator(np.random.MT19937(3)).bit_generator.state}
+        path = save_state_orbax(
+            tmp_path, "mt", epoch=1, mini_batch=0, params=params,
+            opt_state=opt_state, rng_state=mt_state,
+        )
+        from ddr_tpu.training import peek_orbax_meta
+
+        restored = peek_orbax_meta(path)["rng_state"]["bit_generator"]
+        g = np.random.Generator(np.random.MT19937(99))
+        g.bit_generator.state = restored
+        g2 = np.random.Generator(np.random.MT19937(3))
+        assert g.standard_normal(4).tolist() == g2.standard_normal(4).tolist()
+
     def test_target_restores_optax_structure(self, tmp_path):
         """With a target exemplar the restored opt_state is a REAL optax state
         (the optimizer can consume it directly), not nested dicts."""
