@@ -261,6 +261,21 @@ class ObservationSet:
         return ObservationSet(gage_ids, self.time, self.streamflow[rows])
 
 
+def _honor_s3_region(cfg: Any, store_uri: Any) -> None:
+    """Route ``cfg.s3_region`` (reference configs.py:247 + read_ic's ``region``
+    argument) to the default icechunk opener for ``s3://`` stores. The opener
+    reads the region AT OPEN TIME, so this works regardless of which store
+    triggered auto-registration first; a custom registered opener is
+    unaffected. ``load_config`` also sets it — this covers readers constructed
+    on hand-built configs."""
+    if store_uri and str(store_uri).lower().startswith("s3://"):
+        region = getattr(cfg, "s3_region", None)
+        if region:
+            from ddr_tpu.io import remote
+
+            remote.set_default_region(region)
+
+
 class StreamflowReader:
     """Lateral-inflow (q') reader over a hydro store (reference readers.py:446-531).
 
@@ -272,6 +287,7 @@ class StreamflowReader:
 
     def __init__(self, cfg: Any) -> None:
         self.cfg = cfg
+        _honor_s3_region(cfg, cfg.data_sources.streamflow)
         self.store: HydroStore = open_hydro_store(cfg.data_sources.streamflow)
         self.is_hourly = bool(
             getattr(cfg.data_sources, "is_hourly", False) or self.store.is_hourly
@@ -329,6 +345,7 @@ class USGSObservationReader:
 
     def __init__(self, cfg: Any) -> None:
         self.cfg = cfg
+        _honor_s3_region(cfg, cfg.data_sources.observations)
         self.store = open_hydro_store(cfg.data_sources.observations)
         if cfg.data_sources.gages is None:
             raise ValueError("data_sources.gages must be set for USGSObservationReader")

@@ -24,8 +24,9 @@ the data layer:
 
     register_store_backend("s3", lambda uri: icechunk_group_for(uri))
 
-In this zero-egress environment no remote backend is registered, so ``s3://`` URIs
-fail fast with a message that says exactly that.
+``s3://`` URIs auto-register the icechunk adapter in :mod:`ddr_tpu.io.remote`
+(config-only deployment); in this zero-egress environment — where icechunk is not
+installed — they fail fast with a RuntimeError naming the missing dependency.
 """
 
 from __future__ import annotations
@@ -106,6 +107,15 @@ def _resolve_group(store: str | Path, kind: str) -> GroupLike:
     if "://" in uri:
         scheme = uri.split("://", 1)[0].lower()
         opener = _STORE_BACKENDS.get(scheme)
+        if opener is None and scheme == "s3":
+            # Auto-register the icechunk/S3 backend so a networked deployment is
+            # config-only (the reference's S3 default paths work verbatim). With
+            # icechunk absent the opener raises a RuntimeError naming the
+            # missing dependency at open time.
+            from ddr_tpu.io import remote
+
+            remote.enable_remote_stores()
+            opener = _STORE_BACKENDS.get(scheme)
         if opener is not None:
             return opener(uri)
         if scheme == "file":

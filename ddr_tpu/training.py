@@ -488,9 +488,15 @@ def _require_json_plain(obj: Any, where: str) -> None:
     keeps encoding them; numpy scalars map to the equivalent Python number."""
     import numpy as np
 
-    if obj is None or isinstance(
-        obj, (bool, int, float, str, np.integer, np.floating, np.ndarray)
-    ):
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == object:
+            # an object array could smuggle tuples past the guard below
+            raise TypeError(
+                f"{where} is an object-dtype ndarray: its elements would be "
+                "JSON-rewritten unpredictably; use numeric arrays or plain lists"
+            )
+        return
+    if obj is None or isinstance(obj, (bool, int, float, str, np.integer, np.floating)):
         return
     if isinstance(obj, dict):
         for k, v in obj.items():

@@ -325,6 +325,12 @@ def load_config(
     raw = _interpolate(raw, raw)
     cfg = Config(**raw)
     _set_seed(cfg)
+    if cfg.s3_region:
+        # the remote-store opener resolves this lazily at open time, so setting
+        # it here covers every s3:// consumer regardless of construction order
+        from ddr_tpu.io.remote import set_default_region
+
+        set_default_region(cfg.s3_region)
     if cfg.run_dir is not None:
         run_path = Path(cfg.run_dir) / cfg.name / datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
         run_path.mkdir(parents=True, exist_ok=True)

@@ -107,10 +107,20 @@ class TestBackendRegistry:
             )
 
     def test_unregistered_scheme_names_the_seam(self):
+        # non-s3 schemes fail with the registration seam named; s3 now
+        # auto-registers the icechunk backend and fails on the missing
+        # dependency instead (tests/io/test_remote.py covers that path)
         with pytest.raises(ValueError, match="register_store_backend"):
-            open_hydro_store("s3://bucket/repo")
+            open_hydro_store("gs://bucket/repo")
         with pytest.raises(ValueError, match="no egress"):
-            open_attribute_store("s3://bucket/attrs")
+            open_attribute_store("gs://bucket/attrs")
+        from ddr_tpu.io.stores import unregister_store_backend as _unreg
+
+        try:
+            with pytest.raises(RuntimeError, match="icechunk"):
+                open_hydro_store("s3://bucket/repo")
+        finally:
+            _unreg("s3")  # drop the auto-registered backend for test isolation
 
     def test_scheme_is_case_insensitive(self, mem_backend):
         register_store_backend("MEMS", lambda uri: pytest.fail("should reuse lowercase"))

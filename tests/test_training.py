@@ -433,6 +433,7 @@ class TestOrbaxCheckpoints:
         for bad, pattern in [
             ({"key": (1, 2)}, "rng_state.key is tuple"),
             ({"deep": {"inner": [1, (2,)]}}, r"rng_state.deep.inner\[1\] is tuple"),
+            ({"o": np.array([(1, 2)], dtype=object)}, "object-dtype"),
         ]:
             with pytest.raises(TypeError, match=pattern):
                 save_state_orbax(
