@@ -19,6 +19,7 @@ _COMMANDS = {
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",
     "gen-config-docs": "ddr_tpu.scripts.gen_config_docs",
+    "sweep": "ddr_tpu.scripts.sweep",
 }
 
 

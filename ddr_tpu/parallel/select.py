@@ -1,0 +1,146 @@
+"""Multi-chip engine auto-selection — the mesh-level analog of
+:func:`ddr_tpu.routing.network.single_ring_eligible` (which arbitrates the
+single-chip engines).
+
+One documented policy, grounded in the recorded measurements, consumed by BOTH
+the forward convenience router (:func:`route_parallel`) and the training CLI
+(``experiment.parallel=auto`` -> :class:`ddr_tpu.parallel.train.ParallelTrainer`):
+
+========================  =====================================================
+regime                    engine and evidence
+========================  =====================================================
+CPU backend (any shape)   ``gspmd`` — on host meshes the explicit shard_map
+                          engines invert: MULTICHIP_r04.json scale rows measured
+                          gspmd_step 210 ms vs sharded-wavefront 5060 ms and
+                          pipelined 2724 ms (N=8192, T=48, 8 virtual devices),
+                          the same scan-dispatch-overhead inversion as the
+                          single-chip CPU table (docs/tpu.md "CPU inversion").
+accelerator, per-shard    ``sharded-wavefront`` — the GSPMD path executes the
+ring feasible             rectangle step engine (T x depth sequential cost);
+                          on-chip the wavefront class wins by ~61x at N=8192
+                          (docs/tpu.md VJP table), and the sharded wavefront
+                          keeps that schedule with one psum per wave. Feasibility
+                          is single_ring_eligible on the PER-SHARD ring
+                          (depth + 2) * (n/S + 1).
+accelerator, deep         ``stacked-sharded`` — bands bound the per-shard ring
+(ring infeasible)         under the same 2^26-cell budget and ONE scanned band
+                          program keeps compile O(1) in band count
+                          (docs/tpu.md "Continental depth").
+========================  =====================================================
+
+The pipelined wavefront (:mod:`ddr_tpu.parallel.pipeline`) is deliberately NOT
+in the policy: it is forward-only (no VJP) and was beaten by gspmd on the host
+mesh in every recorded row; it remains available as an explicit per-timestep
+streaming router for BMI-style couplings, not a training engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["select_for_topology", "select_parallel_engine", "route_parallel"]
+
+
+def select_for_topology(
+    platform: str, rows: np.ndarray, cols: np.ndarray, n: int, n_shards: int
+) -> str:
+    """Policy pick straight from a COO adjacency — derives depth/max-in-degree
+    only when the platform row actually consults them (CPU short-circuits to
+    gspmd without the O(E) layering). The one shared entry for the training CLI
+    (``parallel=auto``) and :func:`route_parallel`."""
+    if platform == "cpu":
+        return "gspmd"
+    from ddr_tpu.routing.network import compute_levels
+
+    rows = np.asarray(rows)
+    level = compute_levels(rows, np.asarray(cols), n)
+    depth = int(level.max()) if n else 0
+    max_in = int(np.bincount(rows, minlength=n).max()) if len(rows) else 1
+    return select_parallel_engine(platform, n, depth, n_shards, max(1, max_in))
+
+
+def select_parallel_engine(
+    platform: str,
+    n: int,
+    depth: int,
+    n_shards: int,
+    max_in: int = 4,
+) -> str:
+    """Pick the multi-chip engine for a topology on a backend (table above).
+
+    ``platform`` is the mesh devices' platform string (``"cpu"``/``"tpu"``);
+    ``depth`` the longest-path level count; ``max_in`` the max in-degree
+    (dendritic rivers are <= 4; the default is conservative for feasibility).
+    """
+    if platform == "cpu":
+        return "gspmd"
+    from ddr_tpu.routing.network import single_ring_eligible
+
+    n_local = -(-n // max(1, n_shards))
+    if single_ring_eligible(depth, max_in, n_local):
+        return "sharded-wavefront"
+    return "stacked-sharded"
+
+
+def _mesh_platform(mesh: Any) -> str:
+    return mesh.devices.flat[0].platform
+
+
+def route_parallel(
+    mesh: Any,
+    rd: Any,
+    channels: Any,
+    spatial_params: dict[str, Any],
+    q_prime: Any,
+    bounds: Any = None,
+    engine: str | None = None,
+):
+    """Route one batch over the mesh with the policy-selected engine.
+
+    ``rd`` is a (pre-partitioned for GSPMD/wavefront, original order for
+    stacked) :class:`RoutingData`; returns ``(runoff, engine_used)`` where
+    ``runoff`` is the full ``(T, N)`` reach discharge. This is the forward
+    (inference/benchmark) counterpart of the CLI training dispatch; both consume
+    :func:`select_parallel_engine` so the policy cannot fork.
+    """
+    from ddr_tpu.routing.mc import Bounds
+
+    bounds = bounds or Bounds()
+    rows = np.asarray(rd.adjacency_rows)
+    cols = np.asarray(rd.adjacency_cols)
+    n = rd.n_segments
+    if engine is None:
+        engine = select_for_topology(
+            _mesh_platform(mesh), rows, cols, n, int(mesh.devices.size)
+        )
+
+    if engine == "gspmd":
+        from ddr_tpu.parallel.sharding import sharded_route
+        from ddr_tpu.routing.network import build_network
+
+        network = build_network(rows, cols, n, fused=False)
+        return (
+            sharded_route(mesh, network, channels, spatial_params, q_prime, bounds=bounds).runoff,
+            engine,
+        )
+    if engine == "sharded-wavefront":
+        from ddr_tpu.parallel.wavefront import build_sharded_wavefront, sharded_wavefront_route
+
+        sched = build_sharded_wavefront(rows, cols, n, int(mesh.devices.size))
+        with mesh:
+            runoff, _ = sharded_wavefront_route(
+                mesh, sched, channels, spatial_params, q_prime, bounds=bounds
+            )
+        return runoff, engine
+    if engine == "stacked-sharded":
+        from ddr_tpu.parallel.stacked import build_stacked_sharded, route_stacked_sharded
+
+        layout = build_stacked_sharded(rows, cols, n, int(mesh.devices.size))
+        with mesh:
+            runoff, _ = route_stacked_sharded(
+                mesh, layout, channels, spatial_params, q_prime, bounds=bounds
+            )
+        return runoff, engine
+    raise ValueError(f"unknown parallel engine {engine!r}")

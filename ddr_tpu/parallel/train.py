@@ -19,6 +19,10 @@ visible chip):
   (:func:`ddr_tpu.training.make_sharded_chunked_train_step` over
   :func:`ddr_tpu.parallel.stacked.build_stacked_sharded`); per-reach arrays stay
   in original node order and ``experiment.remat_bands`` is honored.
+- ``"auto"``: resolves one of the above PER BATCH via the documented
+  measurement-grounded policy (:mod:`ddr_tpu.parallel.select`): gspmd on host
+  meshes, sharded-wavefront on accelerators while the per-shard ring is
+  feasible, stacked-sharded past that depth.
 
 Every mode optimizes :func:`ddr_tpu.training.masked_l1_daily` — the single shared
 objective — so switching ``parallel`` changes the schedule, never the math
@@ -46,7 +50,10 @@ __all__ = [
 ]
 
 #: Accepted values of ``experiment.parallel`` (validated by the config schema).
-PARALLEL_MODES = ("none", "gspmd", "sharded-wavefront", "stacked-sharded")
+#: ``auto`` resolves per batch via
+#: :func:`ddr_tpu.parallel.select.select_parallel_engine` (the documented
+#: measurement-grounded policy).
+PARALLEL_MODES = ("none", "auto", "gspmd", "sharded-wavefront", "stacked-sharded")
 
 
 def parse_device(device: str) -> tuple[str, int | None]:
@@ -164,17 +171,16 @@ class PreparedBatch:
 class ParallelTrainer:
     """Per-batch multi-chip step dispatch for the training loop.
 
-    Construct once per run (builds the mesh and, for GSPMD, the one reusable
-    jitted step); call :meth:`prepare` per batch off-thread and :meth:`step`
-    on the training thread.
+    Construct once per run (builds the mesh); call :meth:`prepare` per batch
+    off-thread and :meth:`step` on the training thread. The one reusable jitted
+    GSPMD batch step is built lazily on the first gspmd batch (auto mode may
+    never take that branch), so builder errors for it surface at the first
+    step, not at construction.
     """
 
     def __init__(self, cfg: Any, kan_model: Any, optimizer: Any) -> None:
-        import jax
-
         from ddr_tpu.parallel.sharding import make_mesh
         from ddr_tpu.routing.mc import Bounds
-        from ddr_tpu.training import make_batch_train_step
 
         mode = cfg.experiment.parallel
         if mode not in PARALLEL_MODES or mode == "none":
@@ -207,16 +213,28 @@ class ParallelTrainer:
             warmup=cfg.experiment.warmup,
             optimizer=optimizer,
         )
-        if mode == "gspmd":
-            # remat_bands is a stacked-engine knob; the GSPMD path executes the
-            # rectangle step engine (shard_network docstring), so it never applies.
-            self._gspmd_step = make_batch_train_step(
-                kan_model, self.bounds, **self._builder_kw
-            )
+        self.platform = self.mesh.devices.flat[0].platform
+        self._gspmd_step_cached = None
+        self._auto_logged: set[str] = set()
+        self._auto_modes: dict[str, str] = {}
         log.info(
             f"multi-chip training: parallel={mode} over {self.n_shards} devices "
-            f"({jax.devices()[0].platform})"
+            f"({self.platform})"
         )
+
+    @property
+    def _gspmd_step(self):
+        """The one reusable jitted GSPMD batch step, built on first need (auto
+        mode may never take the gspmd branch)."""
+        if self._gspmd_step_cached is None:
+            from ddr_tpu.training import make_batch_train_step
+
+            # remat_bands is a stacked-engine knob; the GSPMD path executes the
+            # rectangle step engine (shard_network docstring), so it never applies.
+            self._gspmd_step_cached = make_batch_train_step(
+                self.kan_model, self.bounds, **self._builder_kw
+            )
+        return self._gspmd_step_cached
 
     def _cached_step(self, key: str, build: Callable[[], Callable]) -> Callable:
         """LRU lookup/insert for built sharded steps."""
@@ -250,7 +268,28 @@ class ParallelTrainer:
         from ddr_tpu.routing.model import prepare_batch, prepare_channels
 
         T = int(q_prime.shape[0])
-        if self.mode == "stacked-sharded":
+        mode = self.mode
+        if mode == "auto":
+            from ddr_tpu.parallel.select import select_for_topology
+
+            # cpu short-circuits inside the helper (no O(E) layering); on
+            # accelerators the per-topology answer is memoized so recurring
+            # batches skip the re-analysis alongside their cached step
+            key = _batch_key(rd)
+            mode = self._auto_modes.get(key)
+            if mode is None:
+                mode = select_for_topology(
+                    self.platform, rd.adjacency_rows, rd.adjacency_cols,
+                    rd.n_segments, self.n_shards,
+                )
+                self._auto_modes[key] = mode
+            if mode not in self._auto_logged:
+                self._auto_logged.add(mode)
+                log.info(
+                    f"parallel=auto selected {mode} "
+                    f"(platform={self.platform}, N={rd.n_segments})"
+                )
+        if mode == "stacked-sharded":
             # The stacked-sharded layout keeps ORIGINAL node order (it carries
             # its own band/shard permutations), so no partition/pad here.
             def _build_stacked():
@@ -274,7 +313,7 @@ class ParallelTrainer:
 
             step = self._cached_step(_batch_key(rd), _build_stacked)
             return PreparedBatch(
-                mode=self.mode,
+                mode=mode,
                 attrs=jnp.asarray(rd.normalized_spatial_attributes),
                 q_prime=jnp.asarray(q_prime),
                 n_timesteps=T,
@@ -295,7 +334,7 @@ class ParallelTrainer:
             )
             return permute_routing_data(rd_pad, part), q_prime[:, part.perm]
 
-        if self.mode == "sharded-wavefront":
+        if mode == "sharded-wavefront":
             rd_p, q_prime = _pad_and_partition(rd, q_prime)
 
             def _build_wavefront():
@@ -318,7 +357,7 @@ class ParallelTrainer:
 
             step = self._cached_step(_batch_key(rd_p), _build_wavefront)
             return PreparedBatch(
-                mode=self.mode,
+                mode=mode,
                 attrs=jnp.asarray(rd_p.normalized_spatial_attributes),
                 q_prime=jnp.asarray(q_prime),
                 n_timesteps=T,
@@ -332,7 +371,7 @@ class ParallelTrainer:
         # the rectangle scan schedule; the fused tables would all-gather).
         network, channels, gauges = prepare_batch(rd_p, self.slope_min, chunked=False)
         return PreparedBatch(
-            mode=self.mode,
+            mode=mode,
             attrs=jax.device_put(
                 jnp.asarray(rd_p.normalized_spatial_attributes),
                 reach_sharding(self.mesh, 0, 2),

@@ -1,0 +1,150 @@
+"""``ddr sweep`` — cartesian config sweeps, the reference's hydra ``--multirun``
+(/root/reference/config/hydra/settings.yaml ``sweep.dir``/``subdir``) without the
+hydra dependency.
+
+Usage::
+
+    ddr sweep <command> [config.yaml] key=a,b other.key=x,y fixed.key=v ...
+
+Every override whose value is an UNBRACKETED comma list is a sweep axis; the
+cartesian product of all axes runs sequentially (one process — the device grant
+serializes anyway), each combination in its own run directory
+``<save_path>/multirun/<stamp>/<override_dirname>`` where ``override_dirname``
+names the combination exactly like hydra's ``${hydra.job.override_dirname}``
+(``experiment.rho=4,kan.grid=5``). Bracketed values (``a=[1,2]``) stay list
+literals, as in hydra. A failing combination is recorded and the sweep
+continues; the exit code is non-zero if any run failed. ``summary.json`` at the
+sweep root maps each combination to its run dir and exit code — the artifact
+the capture-driven tuning rounds consume.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import sys
+from datetime import datetime
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+__all__ = ["expand_sweep", "main"]
+
+#: Commands a sweep may drive (same modules the top-level CLI dispatches to).
+SWEEPABLE = {
+    "train": "ddr_tpu.scripts.train",
+    "test": "ddr_tpu.scripts.test",
+    "train-and-test": "ddr_tpu.scripts.train_and_test",
+    "route": "ddr_tpu.scripts.router",
+}
+
+
+def _is_axis(value: str) -> bool:
+    """``a,b`` sweeps; ``[a,b]``/``{a: b}`` are YAML literals; a single value is
+    fixed (hydra's convention)."""
+    v = value.strip()
+    return "," in v and not (v.startswith("[") or v.startswith("{"))
+
+
+def expand_sweep(overrides: list[str]) -> tuple[list[list[str]], list[str]]:
+    """Split overrides into sweep combinations and fixed overrides.
+
+    Returns ``(combos, fixed)`` where each combo is a list of ``key=value``
+    overrides, one per axis, in the cartesian product (first axis varies
+    slowest — hydra's ordering).
+    """
+    axes: list[list[str]] = []
+    fixed: list[str] = []
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} must look like key.subkey=value")
+        key, value = ov.split("=", 1)
+        if _is_axis(value):
+            axes.append([f"{key}={v.strip()}" for v in value.split(",")])
+        else:
+            fixed.append(ov)
+    combos = [list(c) for c in itertools.product(*axes)] if axes else [[]]
+    return combos, fixed
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(argv or [])
+    if not argv or argv[0] in {"-h", "--help"}:
+        print(
+            "usage: ddr sweep {" + ",".join(SWEEPABLE) + "} [config.yaml] "
+            "key=a,b fixed=v ...\n  comma-listed values sweep (cartesian product); "
+            "each run lands in <save_path>/multirun/<stamp>/<overrides>/"
+        )
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd not in SWEEPABLE:
+        print(
+            f"ddr sweep: unknown command {cmd!r}; choose from {sorted(SWEEPABLE)}",
+            file=sys.stderr,
+        )
+        return 2
+    path = None
+    overrides: list[str] = []
+    for a in rest:
+        if "=" in a:
+            overrides.append(a)
+        elif path is None:
+            path = a
+        else:
+            raise SystemExit(f"unexpected argument {a!r}")
+    combos, fixed = expand_sweep(overrides)
+
+    # Sweep root under the config's save_path, resolved with the SAME include
+    # composition + ${...} interpolation + override semantics the per-run loads
+    # use (a fixed params.save_path override wins over the file).
+    from ddr_tpu.validation.configs import (
+        _apply_override,
+        _interpolate,
+        _load_yaml_with_includes,
+    )
+
+    raw: dict = {}
+    if path is not None:
+        raw = _load_yaml_with_includes(Path(path))
+        if isinstance(raw.get("ddr"), dict) and set(raw) == {"ddr"}:
+            raw = raw["ddr"]
+    for ov in fixed:
+        k, v = ov.split("=", 1)
+        _apply_override(raw, k, v)
+    raw = _interpolate(raw, raw)
+    base_save = str(raw.get("params", {}).get("save_path", "./"))
+    sweep_root = Path(base_save) / "multirun" / datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    sweep_root.mkdir(parents=True, exist_ok=True)
+
+    import importlib
+
+    mod = importlib.import_module(SWEEPABLE[cmd])
+    results = []
+    for i, combo in enumerate(combos):
+        dirname = ",".join(combo) if combo else "default"
+        run_dir = sweep_root / dirname
+        run_argv = ([path] if path else []) + fixed + combo + [
+            f"params.save_path={run_dir}",
+            "run_dir=null",  # per-run dirs are the sweep's job, not load_config's
+        ]
+        log.info(f"sweep run {i + 1}/{len(combos)}: {dirname}")
+        run_dir.mkdir(parents=True, exist_ok=True)
+        try:
+            rc = mod.main(run_argv) or 0
+        except SystemExit as e:  # a run aborting must not kill the sweep
+            # e.code may be None (success), an int, or a message string (failure)
+            rc = e.code if isinstance(e.code, int) else (0 if e.code is None else 1)
+        except Exception:
+            log.exception(f"sweep run {dirname} raised")
+            rc = 1
+        results.append({"overrides": combo, "run_dir": str(run_dir), "exit_code": rc})
+    (sweep_root / "summary.json").write_text(json.dumps(results, indent=2))
+    n_failed = sum(1 for r in results if r["exit_code"] != 0)
+    log.info(f"sweep complete: {len(results) - n_failed}/{len(results)} runs ok -> {sweep_root}")
+    print(str(sweep_root))
+    return 1 if n_failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

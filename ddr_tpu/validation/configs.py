@@ -147,6 +147,7 @@ class ExperimentConfig(BaseModel):
         default="none",
         description=(
             "Multi-chip training engine: 'none' (single-device batch step), "
+            "'auto' (per-batch policy pick, ddr_tpu.parallel.select), "
             "'gspmd' (reach-sharded inputs, XLA-inserted collectives), "
             "'sharded-wavefront' (explicit shard_map wavefront, one psum/wave), "
             "or 'stacked-sharded' (O(1)-compile deep scan-over-bands). The mesh "
@@ -289,6 +290,40 @@ def _interpolate(node: Any, raw: dict, stack: tuple = ()) -> Any:
     return _INTERP.sub(lambda m: str(_resolve_expr(m.group(1), raw, stack)), node)
 
 
+def _deep_merge(base: dict, over: dict) -> dict:
+    """Nested-dict merge, ``over`` winning (hydra defaults-list semantics)."""
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _load_yaml_with_includes(path: Path, _stack: tuple = ()) -> dict:
+    """Read one YAML file, resolving its ``include:`` list first (hydra's
+    defaults-list analog): includes merge in order, later winning, and the
+    including file's own keys win over all of them. Paths are relative to the
+    including file; cycles are an error."""
+    path = Path(path).resolve()
+    if path in _stack:
+        chain = " -> ".join(str(p) for p in (*_stack, path))
+        raise ValueError(f"circular config include: {chain}")
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    includes = raw.pop("include", None) or []
+    if isinstance(includes, (str, Path)):
+        includes = [includes]
+    merged: dict = {}
+    for inc in includes:
+        inc_path = Path(inc)
+        if not inc_path.is_absolute():
+            inc_path = path.parent / inc_path
+        merged = _deep_merge(merged, _load_yaml_with_includes(inc_path, _stack + (path,)))
+    return _deep_merge(merged, raw)
+
+
 def load_config(
     path: str | Path | None = None,
     overrides: list[str] | None = None,
@@ -298,12 +333,14 @@ def load_config(
     """Load + validate a config from YAML with ``a.b=c`` overrides.
 
     Replaces the reference's hydra.main -> OmegaConf -> validate_config chain
-    (/root/reference/src/ddr/validation/configs.py:283-309).
+    (/root/reference/src/ddr/validation/configs.py:283-309). A top-level
+    ``include: [base.yaml, ...]`` list composes config files (the hydra
+    defaults-list / config-group analog): includes merge first, the file's own
+    keys override them, CLI overrides override everything.
     """
     raw: dict = dict(base or {})
     if path is not None:
-        with open(path) as f:
-            raw.update(yaml.safe_load(f) or {})
+        raw = _deep_merge(raw, _load_yaml_with_includes(Path(path)))
     # Benchmark-only sections may share the YAML (one file drives every command);
     # the benchmark harness validates them itself (benchmarks/configs.py), the core
     # config ignores them — the analog of the reference's validate_benchmark_config

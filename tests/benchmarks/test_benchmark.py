@@ -107,6 +107,7 @@ class TestSummedQPrime:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow
     def test_two_phase_benchmark_on_synthetic(self, tmp_path):
         bench_cfg = validate_benchmark_config(
             _raw_cfg(tmp_path, lti={"irf_fn": "muskingum", "max_delay": 48})

@@ -19,6 +19,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the fast leg is dominated by train-step
+# backward compiles that are identical run to run; caching them cuts warm re-runs
+# roughly in half (measured: tests/test_training.py 88s cold -> 40s warm).
+# Override the location with DDR_TEST_JAX_CACHE ("" disables).
+_cache_dir = os.environ.get("DDR_TEST_JAX_CACHE", "/tmp/ddr_tpu_test_jax_cache")
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
 import numpy as np
 import pytest
 

@@ -94,9 +94,12 @@ class TestStatistics:
 
 
 class TestPredictor:
-    @pytest.fixture()
-    def trained_run(self, tmp_path):
-        """Train one synthetic mini-batch so a real checkpoint + stats JSON exist."""
+    @pytest.fixture(scope="class")
+    def trained_run(self, tmp_path_factory):
+        """Train one synthetic mini-batch so a real checkpoint + stats JSON exist.
+
+        Class-scoped: the three predictor tests read the SAME checkpoint (none
+        mutates it), so the ~7s train+compile runs once, not per test."""
         import json
 
         import yaml
@@ -104,6 +107,8 @@ class TestPredictor:
         from ddr_tpu.scripts.train import train
         from ddr_tpu.training import latest_checkpoint
         from ddr_tpu.validation.configs import Config
+
+        tmp_path = tmp_path_factory.mktemp("geom_predictor")
 
         cfg_dict = {
             "name": "geom_test",

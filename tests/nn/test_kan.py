@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddr_tpu.nn.kan import Kan, KANLayer, bspline_basis
 
@@ -139,6 +140,7 @@ class TestGridRange:
         assert frac_default > 0.8, frac_default
         assert frac_narrow < 0.65, frac_narrow
 
+    @pytest.mark.slow
     def test_default_beats_narrow_and_wide(self):
         """The (-2,2) default fits a smooth function of z-scored inputs strictly
         better than the pykan-static (-1,1) support (tails go spline-less) AND a

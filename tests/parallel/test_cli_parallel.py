@@ -17,6 +17,16 @@ from ddr_tpu.validation.configs import Config
 N_DEV = 8
 
 ENGINE_MODES = [m for m in PARALLEL_MODES if m != "none"]
+# Fast-leg parity rungs: gspmd + stacked-sharded. "auto" resolves to gspmd on
+# the CPU mesh (identical engine; selection itself is pinned in test_select),
+# and the sharded-wavefront step has its own train-step tests — both stay on
+# the slow leg here.
+PARITY_MODES = [
+    "gspmd",
+    "stacked-sharded",
+    pytest.param("auto", marks=pytest.mark.slow),
+    pytest.param("sharded-wavefront", marks=pytest.mark.slow),
+]
 
 
 def _need_devices():
@@ -159,7 +169,7 @@ class TestStepParity:
         _, _, loss, daily = par.step(prep, params, opt_state, obs_daily, obs_mask)
         return float(ref_loss), np.asarray(ref_daily), float(loss), np.asarray(daily), par, prep
 
-    @pytest.mark.parametrize("mode", ENGINE_MODES)
+    @pytest.mark.parametrize("mode", PARITY_MODES)
     def test_loss_matches_single_device(self, tmp_path, mode):
         ref_loss, ref_daily, loss, daily, _, _ = self._setup(tmp_path, mode)
         assert np.isfinite(loss)

@@ -1,0 +1,130 @@
+"""Multi-chip engine selection policy (ddr_tpu.parallel.select) — VERDICT r4
+item 5: one documented function arbitrating gspmd / sharded-wavefront /
+stacked-sharded, shared by the forward router and the training CLI."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.parallel.select import route_parallel, select_parallel_engine
+
+N_DEV = 8
+
+
+class TestPolicy:
+    def test_cpu_always_gspmd(self):
+        """Host meshes invert the explicit engines (MULTICHIP_r04 scale rows:
+        gspmd 210ms vs wavefront 5060ms) — gspmd regardless of shape."""
+        assert select_parallel_engine("cpu", 8192, 40, 8) == "gspmd"
+        assert select_parallel_engine("cpu", 2_900_000, 4000, 256) == "gspmd"
+
+    def test_tpu_shallow_is_sharded_wavefront(self):
+        assert select_parallel_engine("tpu", 65536, 200, 8) == "sharded-wavefront"
+
+    def test_tpu_deep_is_stacked_sharded(self):
+        """Past the per-shard ring feasibility (single_ring_eligible on
+        (depth+2)*(n/S+1)) the banded scan engine takes over."""
+        assert select_parallel_engine("tpu", 2_900_000, 4000, 8) == "stacked-sharded"
+        # sharding CAN rescue feasibility: same depth, many more shards
+        n, depth = 65536, 1000
+        assert select_parallel_engine("tpu", n, depth, 8) == "sharded-wavefront"
+        assert select_parallel_engine("tpu", n, 1200, 8) == "stacked-sharded"
+
+
+class TestRouteParallel:
+    def _problem(self, n, depth, T, seed=0):
+        from ddr_tpu.geodatazoo.synthetic import make_basin
+        from ddr_tpu.parallel import (
+            make_mesh,
+            permute_routing_data,
+            topological_range_partition,
+        )
+        from ddr_tpu.routing.model import prepare_channels
+
+        if len(jax.devices()) < N_DEV:
+            pytest.skip(f"needs {N_DEV} devices")
+        basin = make_basin(n_segments=n, n_gauges=2, n_days=1, seed=seed, depth=depth)
+        rd = basin.routing_data
+        part = topological_range_partition(
+            rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, N_DEV
+        )
+        rd = permute_routing_data(rd, part)
+        channels, _ = prepare_channels(rd, 0.001)
+        spatial = {
+            "n": jnp.full(n, 0.05),
+            "q_spatial": jnp.full(n, 0.4),
+            "p_spatial": jnp.full(n, 21.0),
+        }
+        qp = jnp.asarray(basin.q_prime[:T, part.perm])
+        return make_mesh(N_DEV), rd, channels, spatial, qp
+
+    def test_policy_engine_matches_reference(self):
+        """route_parallel on the virtual CPU mesh: policy picks gspmd, and the
+        result matches the single-program step engine."""
+        from ddr_tpu.routing.mc import route
+        from ddr_tpu.routing.network import build_network
+
+        mesh, rd, channels, spatial, qp = self._problem(n=256, depth=None, T=6)
+        runoff, engine = route_parallel(mesh, rd, channels, spatial, qp)
+        assert engine == "gspmd"  # cpu platform -> policy row 1
+        ref = route(
+            build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=False),
+            channels, spatial, qp, engine="step",
+        ).runoff
+        np.testing.assert_allclose(np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_forced_engine_overrides_policy(self):
+        from ddr_tpu.routing.mc import route
+        from ddr_tpu.routing.network import build_network
+
+        mesh, rd, channels, spatial, qp = self._problem(n=128, depth=None, T=3)
+        runoff, engine = route_parallel(
+            mesh, rd, channels, spatial, qp, engine="sharded-wavefront"
+        )
+        assert engine == "sharded-wavefront"
+        ref = route(
+            build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=False),
+            channels, spatial, qp, engine="step",
+        ).runoff
+        np.testing.assert_allclose(np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_unknown_engine_raises(self):
+        mesh, rd, channels, spatial, qp = self._problem(n=64, depth=None, T=2)
+        with pytest.raises(ValueError, match="unknown parallel engine"):
+            route_parallel(mesh, rd, channels, spatial, qp, engine="bogus")
+
+
+def test_auto_mode_resolves_per_policy(tmp_path):
+    """experiment.parallel=auto through ParallelTrainer: on the CPU mesh the
+    policy resolves gspmd and the prepared batch says so."""
+    from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+    from ddr_tpu.parallel.train import ParallelTrainer
+    from ddr_tpu.scripts.common import build_kan
+    from ddr_tpu.training import make_optimizer
+    from ddr_tpu.validation.configs import Config
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    cfg = Config(
+        name="auto_run",
+        geodataset="synthetic",
+        mode="training",
+        device=f"cpu:{N_DEV}",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={"rho": 4, "warmup": 1, "parallel": "auto"},
+        params={"save_path": str(tmp_path)},
+    )
+    basin = make_basin(n_segments=64, n_gauges=2, n_days=3, seed=1)
+    basin = observe(basin, cfg)
+    kan_model, params = build_kan(cfg)
+    optimizer = make_optimizer(1e-3)
+    par = ParallelTrainer(cfg, kan_model, optimizer)
+    prep = par.prepare(basin.routing_data, np.asarray(basin.q_prime, np.float32))
+    assert prep.mode == "gspmd"
+    obs = np.asarray(basin.obs_daily, np.float32)
+    mask = np.ones_like(obs, dtype=bool)
+    _, _, loss, _ = par.step(prep, params, optimizer.init(params), obs, mask)
+    assert np.isfinite(float(loss))

@@ -42,9 +42,12 @@ def _rel(a, b):
     return float(jnp.max(jnp.abs(a - b) / (jnp.abs(b) + 1e-6)))
 
 
-@pytest.mark.parametrize("cell_budget", [200_000, 3_000])
+@pytest.mark.parametrize(
+    "cell_budget",
+    [200_000, pytest.param(3_000, marks=pytest.mark.slow)],
+)
 def test_matches_step_engine(cell_budget):
-    n, depth, T = 600, 150, 10
+    n, depth, T = 240, 60, 6
     rows, cols, net, channels, params, qp = _setup(n, depth, T)
     ref = route(net, channels, params, qp, engine="step")
     layout = build_sharded_chunked(rows, cols, n, N_DEV, cell_budget=cell_budget)
@@ -54,6 +57,7 @@ def test_matches_step_engine(cell_budget):
     assert _rel(final, ref.final_discharge) < 1e-4
 
 
+@pytest.mark.slow
 def test_multi_band_with_shard_padding():
     """Band sizes not divisible by the shard count force sentinel pad slots —
     outputs must still be exact and pad values must never leak."""
@@ -69,6 +73,7 @@ def test_multi_band_with_shard_padding():
     assert _rel(runoff, ref.runoff) < 1e-4
 
 
+@pytest.mark.slow
 def test_carry_state_parity():
     n, depth, T = 400, 100, 8
     rows, cols, net, channels, params, qp = _setup(n, depth, T, seed=4)
@@ -115,6 +120,7 @@ def test_per_shard_ring_budget_honored():
         assert (sched.depth + 2) * (sched.n_local + 1) <= budget or sched.depth == 0
 
 
+@pytest.mark.slow
 def test_train_step_descends_at_depth():
     """Full training step over the composed engine on a DEEP twin experiment:
     KAN -> sharded-chunked route -> masked L1 -> backward -> optimizer, loss

@@ -27,7 +27,9 @@ from ddr_tpu.routing.model import prepare_batch
 N_DEV = 8
 
 
-def _setup(n=512, t=36, seed=0):
+def _setup(n=256, t=24, seed=0):
+    # ONE shared topology per (n, t) — distinct seeds would recompile the
+    # shard_map program per test; topology variety lives in the fuzz batteries.
     if len(jax.devices()) < N_DEV:
         pytest.skip(f"needs {N_DEV} devices")
     basin = make_basin(n_segments=n, n_gauges=4, n_days=max(2, -(-t // 24)), seed=seed)
@@ -59,7 +61,7 @@ class TestForwardParity:
         )
 
     def test_with_carried_state(self):
-        mesh, sched, network, channels, params, q_prime = _setup(seed=1)
+        mesh, sched, network, channels, params, q_prime = _setup()
         q_init = jnp.asarray(
             np.random.default_rng(0).uniform(0.1, 5.0, network.n), jnp.float32
         )
@@ -86,7 +88,7 @@ class TestGradients:
         """Parameter gradients through psum + ring must equal the single-program
         route's gradients (which themselves are pinned against finite differences
         in tests/routing)."""
-        mesh, sched, network, channels, params, q_prime = _setup(n=256, t=24, seed=2)
+        mesh, sched, network, channels, params, q_prime = _setup()
 
         def loss_sharded(p):
             with mesh:
@@ -105,7 +107,7 @@ class TestGradients:
 
     def test_grad_finite_difference_probe(self):
         """Directional FD check directly on the sharded engine."""
-        mesh, sched, network, channels, params, q_prime = _setup(n=128, t=12, seed=3)
+        mesh, sched, network, channels, params, q_prime = _setup(n=128, t=12)
 
         def loss(p):
             with mesh:

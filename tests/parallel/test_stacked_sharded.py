@@ -50,7 +50,7 @@ def _rel(a, b):
 
 
 def test_matches_step_engine():
-    n, depth, T = 640, 160, 10
+    n, depth, T = 400, 100, 6
     rows, cols, channels, params, qp = _setup(n, depth, T)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     layout = build_stacked_sharded(rows, cols, n, N_DEV)
@@ -62,6 +62,7 @@ def test_matches_step_engine():
     assert _rel(final, ref.final_discharge) < 1e-4
 
 
+@pytest.mark.slow
 def test_matches_single_chip_stacked():
     """The sharded frame reorders slots but must agree with the single-chip
     stacked router to reassociation tolerance."""
@@ -78,6 +79,7 @@ def test_matches_single_chip_stacked():
     assert _rel(runoff, single.runoff) < 1e-5
 
 
+@pytest.mark.slow
 def test_carry_state_handoff():
     n, depth, T = 400, 100, 10
     rows, cols, channels, params, qp = _setup(n, depth, T, seed=4)
@@ -124,7 +126,7 @@ def test_gradients_match_step_engine():
 def test_multi_band_forced():
     """Deep enough that the model packs several bands; every node appears in
     exactly one slot and the frame bounds hold."""
-    n, depth, T = 800, 300, 6
+    n, depth, T = 400, 170, 4
     rows, cols, channels, params, qp = _setup(n, depth, T, seed=9)
     layout = build_stacked_sharded(rows, cols, n, N_DEV)
     assert layout.n_bands > 1

@@ -49,9 +49,14 @@ def _rel(a, b):
     return float(jnp.max(jnp.abs(a - b) / (jnp.abs(b) + 1e-6)))
 
 
-@pytest.mark.parametrize("cell_budget", [200_000, 20_000, 4_000])
+@pytest.mark.parametrize(
+    "cell_budget",
+    [60_000, 8_000, pytest.param(2_500, marks=pytest.mark.slow)],
+)
 def test_chunked_matches_step_engine(cell_budget):
-    n, depth, T = 600, 150, 16
+    # budgets span the 1-band / few-band / many-band regimes at this shape
+    # (the old 600x150 shape lives on in the slow-leg scale tests)
+    n, depth, T = 320, 80, 8
     rows, cols, channels, params, qp = _setup(n, depth, T)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     cn = build_chunked_network(rows, cols, n, cell_budget=cell_budget)
@@ -72,7 +77,7 @@ def test_chunked_multi_band_actually_splits():
 
 
 def test_chunked_gauges_and_carry_state():
-    n, depth, T = 500, 120, 12
+    n, depth, T = 320, 80, 8
     rows, cols, channels, params, qp = _setup(n, depth, T, seed=5)
     gauges = GaugeIndex.from_ragged([np.array([n - 1]), np.array([5, 17, 200])])
     qi = jnp.asarray(np.random.default_rng(0).uniform(0.1, 2.0, n), jnp.float32)
@@ -80,17 +85,17 @@ def test_chunked_gauges_and_carry_state():
         build_network(rows, cols, n, fused=False), channels, params, qp,
         q_init=qi, gauges=gauges, engine="step",
     )
-    cn = build_chunked_network(rows, cols, n, cell_budget=5_000)
+    cn = build_chunked_network(rows, cols, n, cell_budget=9_000)  # 2-3 bands
     res = route(cn, channels, params, qp, q_init=qi, gauges=gauges)
     assert res.runoff.shape == (T, 2)
     assert _rel(res.runoff, ref.runoff) < 1e-4
 
 
 def test_chunked_differentiable_matches_step_grad():
-    n, depth, T = 300, 80, 8
+    n, depth, T = 160, 40, 6
     rows, cols, channels, params, qp = _setup(n, depth, T, seed=7)
     net_step = build_network(rows, cols, n, fused=False)
-    cn = build_chunked_network(rows, cols, n, cell_budget=4_000)
+    cn = build_chunked_network(rows, cols, n, cell_budget=3_500)  # 2-3 bands: band-program compiles are the cost
     assert cn.n_chunks > 1
 
     def loss(nm, network, **kw):
@@ -107,12 +112,12 @@ def test_chunked_differentiable_matches_step_grad():
 
 def test_chunked_deep_chain_worst_case():
     """Pure mainstem (depth = n - 1): every band boundary is a single edge."""
-    n = 64
+    n = 32
     rows = np.arange(1, n, dtype=np.int64)
     cols = np.arange(n - 1, dtype=np.int64)
-    channels, params, qp = _state(n, 10, seed=3)
+    channels, params, qp = _state(n, 6, seed=3)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
-    cn = build_chunked_network(rows, cols, n, cell_budget=200)  # tiny: many bands
+    cn = build_chunked_network(rows, cols, n, cell_budget=120)  # tiny: many bands
     assert cn.n_chunks >= 4
     res = route(cn, channels, params, qp)
     assert _rel(res.runoff, ref.runoff) < 1e-4
@@ -234,7 +239,7 @@ def test_high_in_degree_confluence_routes_via_chunked():
     must fall to the chunked router and still match the step engine — the
     bucketed gather tables carry arbitrary degree. chain stays BELOW the depth
     cap (1024) so in-degree is the SOLE selection trigger."""
-    n_up, chain = 200, 500
+    n_up, chain = 100, 200
     n = n_up + chain
     rows = np.concatenate([np.full(n_up, n_up), np.arange(n_up + 1, n)])
     cols = np.concatenate([np.arange(n_up), np.arange(n_up, n - 1)])
@@ -246,7 +251,7 @@ def test_high_in_degree_confluence_routes_via_chunked():
     net = build_routing_network(rows, cols, n)
     assert isinstance(net, StackedChunked)
 
-    channels, params, qp = _state(n, 6, seed=0)
+    channels, params, qp = _state(n, 4, seed=0)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
     res = route(net, channels, params, qp)
     assert _rel(res.runoff, ref.runoff) < 1e-4
@@ -257,13 +262,13 @@ def test_braided_divergence_matches_step():
     is outside the dendritic assumption but inside the lower-triangular solve
     semantics; the chunked router must match the step engine there too."""
     # 0 -> {1, 2}; 1 -> 3; 2 -> 3; 3 -> 4; then a chain 4 -> 5 -> ... -> n-1
-    chain = 300
+    chain = 120
     n = 4 + chain
     rows = np.concatenate([[1, 2, 3, 3], np.arange(4, n)])
     cols = np.concatenate([[0, 0, 1, 2], np.arange(3, n - 1)])
-    channels, params, qp = _state(n, 5, seed=1)
+    channels, params, qp = _state(n, 4, seed=1)
     ref = route(build_network(rows, cols, n, fused=False), channels, params, qp, engine="step")
-    cn = build_chunked_network(rows, cols, n, cell_budget=2000)
+    cn = build_chunked_network(rows, cols, n, cell_budget=6_000)  # 2-3 bands
     assert cn.n_chunks > 1
     res = route(cn, channels, params, qp)
     assert _rel(res.runoff, ref.runoff) < 1e-4
@@ -327,7 +332,8 @@ class TestAutoCellBudget:
         assert b1 == auto_cell_budget(n, depth, ring_divisor=1)
 
     def test_default_build_uses_auto(self):
-        n, depth, T = 600, 150, 8
+        n, depth, T = 320, 80, 8  # same shape+seed as the parity sweep: the step
+        # reference hits the in-process jit cache
         rows, cols, channels, params, qp = _setup(n, depth, T)
         cn = build_chunked_network(rows, cols, n)  # cell_budget=None -> auto
         ref = route(

@@ -179,7 +179,7 @@ class TestScheduleEquivalence:
     """The fused (scatter-free permuted) and rectangle scan schedules are two
     lowerings of the same solve; they must agree in values and gradients."""
 
-    def _nets(self, rng, n=400):
+    def _nets(self, rng, n=120):
         # Dendritic chain-with-confluences: in/out degrees within fused limits.
         rows = np.array([int(rng.integers(i + 1, min(n, i + 40))) for i in range(n - 1)])
         cols = np.arange(n - 1, dtype=np.int64)

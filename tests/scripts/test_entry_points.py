@@ -69,6 +69,7 @@ class TestTrainScript:
         params, _ = train(cfg2, max_batches=1)
         assert params is not None
 
+    @pytest.mark.slow
     def test_train_resume_from_orbax_checkpoint(self, tmp_path):
         """The orbax directory form must be drop-in for experiment.checkpoint:
         params restore structurally, and the optax state is re-restored with
@@ -176,6 +177,7 @@ class TestSummedQPrime:
 
 
 class TestTrainAndTest:
+    @pytest.mark.slow
     def test_synthetic_train_and_test(self, tmp_path):
         from ddr_tpu.scripts.train_and_test import train_and_test
         from ddr_tpu.validation.configs import Config

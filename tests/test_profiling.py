@@ -50,6 +50,7 @@ class TestTrace:
         monkeypatch.setenv("DDR_PROFILE_DIR", str(tmp_path / "prof"))
         assert profile_dir_from_env() == str(tmp_path / "prof")
 
+    @pytest.mark.slow
     def test_trace_writes_profile(self, tmp_path):
         import jax.numpy as jnp
 

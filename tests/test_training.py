@@ -254,6 +254,7 @@ class TestCheckpointSchema:
             train(cfg2, max_batches=1)
 
 
+@pytest.mark.slow
 def test_twin_experiment_with_adaptive_grid_refit():
     """Adaptive-grid training end to end on the twin experiment: a mid-training
     grid refit (pykan-style) must not break descent — loss keeps falling after
@@ -301,6 +302,7 @@ def test_twin_experiment_with_adaptive_grid_refit():
     assert losses[-1] < losses[0] * 0.9, f"loss did not decrease: {losses}"
 
 
+@pytest.mark.slow
 def test_twin_experiment_on_deep_stacked_topology():
     """The CONUS-shaped training path: a deep network whose prepare_batch
     auto-selection routes through the STACKED chunked engine (the
@@ -531,6 +533,7 @@ class TestOrbaxCheckpoints:
             peek_orbax_meta(path, expected_arch={"grid": 50})
 
 
+@pytest.mark.slow
 def test_batch_step_remat_bands_matches_default_on_deep_topology():
     """experiment.remat_bands plumbs through make_batch_train_step: identical
     loss on a stacked deep batch, and silently ignored on a shallow batch."""
