@@ -98,8 +98,20 @@ def _timed_rate(fn, arg, n: int, t_hours: int) -> float:
     return n * t_hours / dt
 
 
-def bench_route(n: int, t_hours: int, depth: int | None = None) -> float:
-    """Reach-timesteps/sec for the jitted forward route on the active backend.
+def _peak_suffix() -> str:
+    """`` peak_gb=<gb>`` for the record when the backend reports device memory
+    (TPU); empty on CPU (no peak_bytes_in_use) — VERDICT r4 item 3: no
+    measurement row without its HBM envelope."""
+    import jax
+
+    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+    peak = stats.get("peak_bytes_in_use")
+    return f" peak_gb={peak / 2**30:.2f}" if peak is not None else ""
+
+
+def bench_route(n: int, t_hours: int, depth: int | None = None) -> str:
+    """``"<rate>[ peak_gb=<gb>]"`` for the jitted forward route on the active
+    backend.
 
     ``depth`` switches the topology to the deep CONUS-realistic generator;
     prepare_batch's auto-selection then routes it through the depth-chunked
@@ -110,7 +122,7 @@ def bench_route(n: int, t_hours: int, depth: int | None = None) -> float:
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
     fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
-    return _timed_rate(fn, q_prime, n, t_hours)
+    return f"{_timed_rate(fn, q_prime, n, t_hours)}{_peak_suffix()}"
 
 
 def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
@@ -133,13 +145,14 @@ def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
     else:
         engine = "step"
     fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
-    return f"{_timed_rate(fn, q_prime, n, t_hours)} {engine}"
+    return f"{_timed_rate(fn, q_prime, n, t_hours)} {engine}{_peak_suffix()}"
 
 
-def bench_grad(n: int, t_hours: int, depth: int | None = None) -> float:
-    """Reach-timesteps/sec for the full VJP (value_and_grad of a gauge-loss route)
-    on the active backend — the training-path throughput. ``depth`` switches to
-    the deep CONUS-realistic topology (auto-selected engine)."""
+def bench_grad(n: int, t_hours: int, depth: int | None = None) -> str:
+    """``"<rate>[ peak_gb=<gb>]"`` for the full VJP (value_and_grad of a
+    gauge-loss route) on the active backend — the training-path throughput.
+    ``depth`` switches to the deep CONUS-realistic topology (auto-selected
+    engine)."""
     import jax
 
     from ddr_tpu.routing.mc import route
@@ -150,7 +163,7 @@ def bench_grad(n: int, t_hours: int, depth: int | None = None) -> float:
         return route(network, channels, p, q_prime, gauges=gauges).runoff.mean()
 
     fn = jax.jit(jax.value_and_grad(loss))
-    return _timed_rate(fn, params, n, t_hours)
+    return f"{_timed_rate(fn, params, n, t_hours)}{_peak_suffix()}"
 
 
 def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
@@ -256,20 +269,42 @@ def _run_child(code: str, timeout: float, cpu_only: bool) -> tuple[str | None, s
     return (lines[-1] if lines else None), ""
 
 
+def _split_peak(val: str) -> tuple[str, float | None]:
+    """Strip the optional trailing `` peak_gb=<gb>`` token a bench child appends
+    (``_peak_suffix``); returns ``(rest, peak_gb | None)``."""
+    tokens = val.split()
+    peak = None
+    kept = []
+    for t in tokens:
+        if t.startswith("peak_gb="):
+            try:
+                peak = float(t[len("peak_gb="):])
+            except ValueError:
+                pass
+            continue
+        kept.append(t)
+    return " ".join(kept), peak
+
+
 def _record_float(out: dict, key: str, code: str, timeout: float, cpu_only: bool,
-                  metric_key: str | None = None, metric: str | None = None) -> None:
+                  metric_key: str | None = None, metric: str | None = None,
+                  peak_key: str | None = None) -> None:
     """Best-effort phase plumbing shared by the grad/deep/deep-grad extras: run
-    the child, parse its last line as a float into ``out[key]``, or record
-    ``out[key + "_error"]`` — never fatal to the headline record."""
+    the child, parse its last line as a float into ``out[key]`` (recording any
+    ``peak_gb=`` token under ``peak_key``), or record ``out[key + "_error"]`` —
+    never fatal to the headline record."""
     val, err = _run_child(code, timeout, cpu_only)
     if val is None:
         out[key + "_error"] = err
         return
+    val, peak = _split_peak(val)
     try:
         out[key] = round(float(val), 1)
     except ValueError:
         out[key + "_error"] = f"unparseable output: {val!r}"
         return
+    if peak_key:
+        out[peak_key] = peak
     if metric_key and metric:
         out[metric_key] = metric
 
@@ -336,8 +371,10 @@ def main() -> None:
         if val is None:
             out["route_error"] += f"; CPU retry failed ({err})"
     if val is not None:
+        val, peak = _split_peak(val)
         try:
             out["value"] = round(float(val), 1)
+            out["peak_hbm_gb"] = peak
         except ValueError:
             # Append: a prior accelerator-failure diagnostic must survive.
             prior = out.get("route_error")
@@ -358,6 +395,7 @@ def main() -> None:
                 "reach-timesteps/sec/chip, full VJP (value_and_grad of the "
                 "gauge-loss route), same shapes and unit as the headline"
             ),
+            peak_key="grad_peak_hbm_gb",
         )
 
     # Phase 2c (best-effort): the deep CONUS-shaped topology — depth in the
@@ -379,6 +417,8 @@ def main() -> None:
         )
         if dval is not None:
             try:
+                dval, dpeak = _split_peak(dval)
+                out["deep_peak_hbm_gb"] = dpeak
                 rate_str, _, engine = dval.partition(" ")
                 out["deep_value"] = round(float(rate_str), 1)
                 out["deep_metric"] = (
@@ -403,6 +443,7 @@ def main() -> None:
                     "reach-timesteps/sec/chip, full VJP on the deep topology, "
                     "same shapes as deep_metric"
                 ),
+                peak_key="deep_grad_peak_hbm_gb",
             )
 
         # Phase 2e (best-effort): the COMPLETE train step at the deep shape —
@@ -419,6 +460,7 @@ def main() -> None:
                 try:
                     trec = json.loads(tval)
                     out["train_value"] = trec["rts"]
+                    out["train_peak_hbm_gb"] = trec.get("peak_hbm_gb")
                     out["train_metric"] = (
                         "reach-timesteps/sec/chip, FULL train step (KAN forward + "
                         f"routing + loss + backward + Adam) on the deep topology, "

@@ -99,48 +99,108 @@ def route_parallel(
 ):
     """Route one batch over the mesh with the policy-selected engine.
 
-    ``rd`` is a (pre-partitioned for GSPMD/wavefront, original order for
-    stacked) :class:`RoutingData`; returns ``(runoff, engine_used)`` where
-    ``runoff`` is the full ``(T, N)`` reach discharge. This is the forward
-    (inference/benchmark) counterpart of the CLI training dispatch; both consume
-    :func:`select_parallel_engine` so the policy cannot fork.
+    ``rd``, ``channels``, ``spatial_params`` and ``q_prime`` are all in the
+    batch's ORIGINAL reach order regardless of engine — the function pads to a
+    shard multiple and topological-range-partitions internally where the chosen
+    engine needs it (the caller cannot do so, since the engine — and with it
+    the required layout — is only decided here), and the returned ``(T, N)``
+    runoff is restored to original order. Returns ``(runoff, engine_used)``.
+    This is the forward (inference/benchmark) counterpart of the CLI training
+    dispatch; both consume :func:`select_parallel_engine` so the policy cannot
+    fork.
     """
+    import jax.numpy as jnp
+
     from ddr_tpu.routing.mc import Bounds
 
     bounds = bounds or Bounds()
     rows = np.asarray(rd.adjacency_rows)
     cols = np.asarray(rd.adjacency_cols)
     n = rd.n_segments
+    n_shards = int(mesh.devices.size)
     if engine is None:
-        engine = select_for_topology(
-            _mesh_platform(mesh), rows, cols, n, int(mesh.devices.size)
-        )
+        engine = select_for_topology(_mesh_platform(mesh), rows, cols, n, n_shards)
 
-    if engine == "gspmd":
-        from ddr_tpu.parallel.sharding import sharded_route
-        from ddr_tpu.routing.network import build_network
-
-        network = build_network(rows, cols, n, fused=False)
-        return (
-            sharded_route(mesh, network, channels, spatial_params, q_prime, bounds=bounds).runoff,
-            engine,
-        )
-    if engine == "sharded-wavefront":
-        from ddr_tpu.parallel.wavefront import build_sharded_wavefront, sharded_wavefront_route
-
-        sched = build_sharded_wavefront(rows, cols, n, int(mesh.devices.size))
-        with mesh:
-            runoff, _ = sharded_wavefront_route(
-                mesh, sched, channels, spatial_params, q_prime, bounds=bounds
-            )
-        return runoff, engine
     if engine == "stacked-sharded":
+        # keeps original node order natively (the layout carries its own perms)
         from ddr_tpu.parallel.stacked import build_stacked_sharded, route_stacked_sharded
 
-        layout = build_stacked_sharded(rows, cols, n, int(mesh.devices.size))
+        layout = build_stacked_sharded(rows, cols, n, n_shards)
         with mesh:
             runoff, _ = route_stacked_sharded(
                 mesh, layout, channels, spatial_params, q_prime, bounds=bounds
             )
         return runoff, engine
-    raise ValueError(f"unknown parallel engine {engine!r}")
+
+    if engine not in ("gspmd", "sharded-wavefront"):
+        raise ValueError(f"unknown parallel engine {engine!r}")
+
+    # gspmd / sharded-wavefront: pad to a shard multiple (zero-impact isolated
+    # reaches), partition, permute every per-reach input, route, un-permute.
+    from ddr_tpu.parallel.partition import (
+        pad_routing_data,
+        permute_routing_data,
+        topological_range_partition,
+    )
+
+    rd_pad = pad_routing_data(rd, n_shards)
+    n_pad = rd_pad.n_segments - n
+    q_prime = jnp.asarray(q_prime)
+    spatial_params = {k: jnp.asarray(v) for k, v in spatial_params.items()}
+    if n_pad:
+        q_prime = jnp.concatenate(
+            [q_prime, jnp.zeros((q_prime.shape[0], n_pad), q_prime.dtype)], axis=1
+        )
+        spatial_params = {
+            k: jnp.concatenate([v, jnp.full((n_pad,), 0.5, v.dtype)])
+            for k, v in spatial_params.items()
+        }
+    part = topological_range_partition(
+        rd_pad.adjacency_rows, rd_pad.adjacency_cols, rd_pad.n_segments, n_shards
+    )
+    rd_p = permute_routing_data(rd_pad, part)
+
+    def _perm_channel(a, fill):
+        # pad with benign values (isolated reaches; never reach a gauge), then
+        # permute — preserves the caller's channel values exactly
+        if a is None:
+            return None
+        a = jnp.asarray(a)
+        if n_pad:
+            a = jnp.concatenate([a, jnp.full((n_pad,), fill, a.dtype)])
+        return a[part.perm]
+
+    channels_p = type(channels)(
+        length=_perm_channel(channels.length, 1.0),
+        slope=_perm_channel(channels.slope, 1.0),
+        x_storage=_perm_channel(channels.x_storage, 0.0),
+        top_width_data=_perm_channel(channels.top_width_data, 1.0),
+        side_slope_data=_perm_channel(channels.side_slope_data, 1.0),
+    )
+    spatial_p = {k: v[part.perm] for k, v in spatial_params.items()}
+    qp_p = q_prime[:, part.perm]
+
+    if engine == "gspmd":
+        from ddr_tpu.parallel.sharding import sharded_route
+
+        from ddr_tpu.routing.network import build_network
+
+        network = build_network(
+            rd_p.adjacency_rows, rd_p.adjacency_cols, rd_p.n_segments, fused=False
+        )
+        runoff = sharded_route(
+            mesh, network, channels_p, spatial_p, qp_p, bounds=bounds
+        ).runoff
+    else:
+        from ddr_tpu.parallel.wavefront import build_sharded_wavefront, sharded_wavefront_route
+
+        sched = build_sharded_wavefront(
+            rd_p.adjacency_rows, rd_p.adjacency_cols, rd_p.n_segments, n_shards
+        )
+        with mesh:
+            runoff, _ = sharded_wavefront_route(
+                mesh, sched, channels_p, spatial_p, qp_p, bounds=bounds
+            )
+    # back to original order, pads dropped (original reach i sits at column
+    # part.inv[i]; pads occupy the columns of old indices >= n)
+    return runoff[:, part.inv[:n]], engine

@@ -21,6 +21,7 @@ log = logging.getLogger(__name__)
 
 __all__ = [
     "parse_cli",
+    "split_config_argv",
     "setup_run",
     "build_kan",
     "get_flow_fn",
@@ -30,19 +31,25 @@ __all__ = [
 ]
 
 
-def parse_cli(argv: list[str] | None, mode: str) -> Config:
-    """``[config.yaml] [a.b=c ...]`` -> validated Config with ``mode`` forced and the
-    run directories created."""
-    argv = list(argv or [])
+def split_config_argv(argv: list[str] | None) -> tuple[str | None, list[str]]:
+    """``[config.yaml] [a.b=c ...]`` -> ``(path, overrides)`` — the ONE CLI arg
+    grammar, shared by every script entry point and the sweep runner."""
     path = None
-    overrides = []
-    for a in argv:
+    overrides: list[str] = []
+    for a in argv or []:
         if "=" in a:
             overrides.append(a)
         elif path is None:
             path = a
         else:
             raise SystemExit(f"unexpected argument {a!r}")
+    return path, overrides
+
+
+def parse_cli(argv: list[str] | None, mode: str) -> Config:
+    """``[config.yaml] [a.b=c ...]`` -> validated Config with ``mode`` forced and the
+    run directories created."""
+    path, overrides = split_config_argv(argv)
     overrides.append(f"mode={mode}")
     cfg = load_config(path, overrides)
     return setup_run(cfg)

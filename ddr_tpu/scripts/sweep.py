@@ -31,13 +31,15 @@ log = logging.getLogger(__name__)
 
 __all__ = ["expand_sweep", "main"]
 
-#: Commands a sweep may drive (same modules the top-level CLI dispatches to).
-SWEEPABLE = {
-    "train": "ddr_tpu.scripts.train",
-    "test": "ddr_tpu.scripts.test",
-    "train-and-test": "ddr_tpu.scripts.train_and_test",
-    "route": "ddr_tpu.scripts.router",
-}
+def _sweepable() -> dict[str, str]:
+    """Config-driven commands a sweep may drive — derived from the CLI's own
+    dispatch table so the two can never drift."""
+    from ddr_tpu.cli import _COMMANDS
+
+    return {k: _COMMANDS[k] for k in ("train", "test", "train-and-test", "route")}
+
+
+SWEEPABLE = _sweepable()
 
 
 def _is_axis(value: str) -> bool:
@@ -84,15 +86,9 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
-    path = None
-    overrides: list[str] = []
-    for a in rest:
-        if "=" in a:
-            overrides.append(a)
-        elif path is None:
-            path = a
-        else:
-            raise SystemExit(f"unexpected argument {a!r}")
+    from ddr_tpu.scripts.common import split_config_argv
+
+    path, overrides = split_config_argv(rest)
     combos, fixed = expand_sweep(overrides)
 
     # Sweep root under the config's save_path, resolved with the SAME include
@@ -104,9 +100,16 @@ def main(argv: list[str] | None = None) -> int:
         _load_yaml_with_includes,
     )
 
+    from ddr_tpu.validation.configs import BENCHMARK_SECTION_KEYS
+
     raw: dict = {}
     if path is not None:
         raw = _load_yaml_with_includes(Path(path))
+        # mirror load_config exactly: benchmark-owned sections pop BEFORE the
+        # nested-"ddr" unwrap check, or a shared benchmark/train YAML never
+        # unwraps and save_path resolution silently falls back to "./"
+        for benchmark_key in BENCHMARK_SECTION_KEYS:
+            raw.pop(benchmark_key, None)
         if isinstance(raw.get("ddr"), dict) and set(raw) == {"ddr"}:
             raw = raw["ddr"]
     for ov in fixed:

@@ -22,8 +22,28 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache: the fast leg is dominated by train-step
 # backward compiles that are identical run to run; caching them cuts warm re-runs
 # roughly in half (measured: tests/test_training.py 88s cold -> 40s warm).
-# Override the location with DDR_TEST_JAX_CACHE ("" disables).
+# The directory is keyed by the HOST CPU's feature set: XLA:CPU serializes
+# AOT executables specialized to the compiling machine, and this pod migrates
+# between heterogeneous hosts — a cross-host cache hit logs
+# "could lead to execution errors such as SIGILL" (observed live). Override the
+# location with DDR_TEST_JAX_CACHE ("" disables).
 _cache_dir = os.environ.get("DDR_TEST_JAX_CACHE", "/tmp/ddr_tpu_test_jax_cache")
+if _cache_dir and "DDR_TEST_JAX_CACHE" not in os.environ:
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as _f:
+            # x86 spells the feature line "flags", aarch64 spells it "Features"
+            _flags = next(
+                (ln for ln in _f if ln.startswith(("flags", "Features"))), ""
+            )
+    except OSError:
+        _flags = ""
+    if not _flags:
+        import platform
+
+        _flags = platform.processor() or platform.machine()
+    _cache_dir += "_" + hashlib.sha1(_flags.encode()).hexdigest()[:10]
 if _cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

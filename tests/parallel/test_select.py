@@ -36,60 +36,67 @@ class TestPolicy:
 
 class TestRouteParallel:
     def _problem(self, n, depth, T, seed=0):
+        """ORIGINAL-order inputs — route_parallel pads/partitions internally
+        (the engine, and with it the layout, is only decided inside)."""
         from ddr_tpu.geodatazoo.synthetic import make_basin
-        from ddr_tpu.parallel import (
-            make_mesh,
-            permute_routing_data,
-            topological_range_partition,
-        )
+        from ddr_tpu.parallel import make_mesh
         from ddr_tpu.routing.model import prepare_channels
 
         if len(jax.devices()) < N_DEV:
             pytest.skip(f"needs {N_DEV} devices")
         basin = make_basin(n_segments=n, n_gauges=2, n_days=1, seed=seed, depth=depth)
         rd = basin.routing_data
-        part = topological_range_partition(
-            rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, N_DEV
-        )
-        rd = permute_routing_data(rd, part)
         channels, _ = prepare_channels(rd, 0.001)
         spatial = {
             "n": jnp.full(n, 0.05),
             "q_spatial": jnp.full(n, 0.4),
             "p_spatial": jnp.full(n, 21.0),
         }
-        qp = jnp.asarray(basin.q_prime[:T, part.perm])
+        qp = jnp.asarray(basin.q_prime[:T])
         return make_mesh(N_DEV), rd, channels, spatial, qp
 
-    def test_policy_engine_matches_reference(self):
-        """route_parallel on the virtual CPU mesh: policy picks gspmd, and the
-        result matches the single-program step engine."""
+    def _reference(self, rd, channels, spatial, qp):
         from ddr_tpu.routing.mc import route
         from ddr_tpu.routing.network import build_network
 
-        mesh, rd, channels, spatial, qp = self._problem(n=256, depth=None, T=6)
-        runoff, engine = route_parallel(mesh, rd, channels, spatial, qp)
-        assert engine == "gspmd"  # cpu platform -> policy row 1
-        ref = route(
+        return route(
             build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=False),
             channels, spatial, qp, engine="step",
         ).runoff
+
+    def test_policy_engine_matches_reference(self):
+        """route_parallel on the virtual CPU mesh: policy picks gspmd, and the
+        ORIGINAL-order result matches the single-program step engine."""
+        mesh, rd, channels, spatial, qp = self._problem(n=256, depth=None, T=6)
+        runoff, engine = route_parallel(mesh, rd, channels, spatial, qp)
+        assert engine == "gspmd"  # cpu platform -> policy row 1
+        ref = self._reference(rd, channels, spatial, qp)
         np.testing.assert_allclose(np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
     def test_forced_engine_overrides_policy(self):
-        from ddr_tpu.routing.mc import route
-        from ddr_tpu.routing.network import build_network
-
         mesh, rd, channels, spatial, qp = self._problem(n=128, depth=None, T=3)
         runoff, engine = route_parallel(
             mesh, rd, channels, spatial, qp, engine="sharded-wavefront"
         )
         assert engine == "sharded-wavefront"
-        ref = route(
-            build_network(rd.adjacency_rows, rd.adjacency_cols, rd.n_segments, fused=False),
-            channels, spatial, qp, engine="step",
-        ).runoff
+        ref = self._reference(rd, channels, spatial, qp)
         np.testing.assert_allclose(np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_non_shard_multiple_batch(self):
+        """n not divisible by the mesh: the internal pad/partition must make
+        every engine work on an arbitrary batch size and return original order."""
+        mesh, rd, channels, spatial, qp = self._problem(n=93, depth=None, T=3)
+        ref = self._reference(rd, channels, spatial, qp)
+        for engine in ("gspmd", "sharded-wavefront", "stacked-sharded"):
+            runoff, used = route_parallel(
+                mesh, rd, channels, spatial, qp, engine=engine
+            )
+            assert used == engine
+            assert runoff.shape == (3, 93)
+            np.testing.assert_allclose(
+                np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5,
+                err_msg=engine,
+            )
 
     def test_unknown_engine_raises(self):
         mesh, rd, channels, spatial, qp = self._problem(n=64, depth=None, T=2)

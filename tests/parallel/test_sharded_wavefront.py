@@ -106,8 +106,13 @@ class TestGradients:
             )
 
     def test_grad_finite_difference_probe(self):
-        """Directional FD check directly on the sharded engine."""
-        mesh, sched, network, channels, params, q_prime = _setup(n=128, t=12)
+        """Directional FD check directly on the sharded engine.
+
+        seed=3 deliberately: the probe needs a topology whose loss has a
+        float32-measurable gradient (|g| ~1e-3); the file-default seed-0 basin
+        is near-flat here (|g| ~1e-6), where eps*|g| sits below float32's loss
+        resolution and the central difference is identically zero."""
+        mesh, sched, network, channels, params, q_prime = _setup(n=128, t=12, seed=3)
 
         def loss(p):
             with mesh:
@@ -120,10 +125,14 @@ class TestGradients:
             return jnp.mean(runoff**2)
 
         g = jax.grad(loss_j)(params)
-        rng = np.random.default_rng(0)
-        direction = {
-            k: jnp.asarray(rng.normal(size=v.shape), jnp.float32) for k, v in params.items()
-        }
+        # Probe ALONG the gradient: a random direction can land nearly orthogonal
+        # to a small gradient (measured: analytic ~1.7e-6 at this shape/seed),
+        # where the float32 central difference underflows to 0 and the relative
+        # check is vacuous noise-vs-noise. Along g/|g| the directional
+        # derivative is |g| > 0 by construction.
+        norm = float(jnp.sqrt(sum(jnp.vdot(g[k], g[k]) for k in params)))
+        assert norm > 0, "gradient identically zero"
+        direction = {k: g[k] / norm for k in params}
         eps = 1e-3
         plus = {k: params[k] + eps * direction[k] for k in params}
         minus = {k: params[k] - eps * direction[k] for k in params}
