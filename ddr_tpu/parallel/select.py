@@ -36,11 +36,24 @@ streaming router for BMI-style couplings, not a training engine.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, NamedTuple
 
 import numpy as np
 
-__all__ = ["select_for_topology", "select_parallel_engine", "route_parallel"]
+__all__ = [
+    "ParallelRouteResult",
+    "route_parallel",
+    "select_for_topology",
+    "select_parallel_engine",
+]
+
+
+class ParallelRouteResult(NamedTuple):
+    """:func:`route_parallel` output, all in ORIGINAL reach order."""
+
+    runoff: Any  # (T, N)
+    final_discharge: Any  # (N,) — the carry for the next sequential chunk
+    engine: str
 
 
 def select_for_topology(
@@ -88,29 +101,59 @@ def _mesh_platform(mesh: Any) -> str:
     return mesh.devices.flat[0].platform
 
 
+# Per-topology routing plans: chunked inference calls route_parallel once per
+# TIME chunk of the same reach set (dmc.forward with carry_state), so the
+# partition, engine layout, and the jit-compiled engine program are cached and
+# reused — the inference analog of ParallelTrainer's built-step LRU. Keyed by
+# (adjacency hash, n_shards, engine, bounds, mesh id); entries evict LRU.
+_PLAN_CACHE: "OrderedDict[tuple, Callable]" = None  # type: ignore[assignment]
+_PLAN_CACHE_MAX = 16
+
+
+def _plan_cache():
+    global _PLAN_CACHE
+    if _PLAN_CACHE is None:
+        from collections import OrderedDict
+
+        _PLAN_CACHE = OrderedDict()
+    return _PLAN_CACHE
+
+
+def _topology_key(rd: Any, n_shards: int, engine: str, bounds: Any, mesh: Any) -> tuple:
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(str(rd.n_segments).encode())
+    for a in (rd.adjacency_rows, rd.adjacency_cols):
+        h.update(b"|")
+        if a is not None:
+            h.update(np.ascontiguousarray(a).tobytes())
+    return (h.hexdigest(), n_shards, engine, repr(bounds), id(mesh))
+
+
 def route_parallel(
     mesh: Any,
     rd: Any,
     channels: Any,
     spatial_params: dict[str, Any],
     q_prime: Any,
+    q_init: Any = None,
     bounds: Any = None,
     engine: str | None = None,
-):
+) -> ParallelRouteResult:
     """Route one batch over the mesh with the policy-selected engine.
 
-    ``rd``, ``channels``, ``spatial_params`` and ``q_prime`` are all in the
-    batch's ORIGINAL reach order regardless of engine — the function pads to a
-    shard multiple and topological-range-partitions internally where the chosen
-    engine needs it (the caller cannot do so, since the engine — and with it
-    the required layout — is only decided here), and the returned ``(T, N)``
-    runoff is restored to original order. Returns ``(runoff, engine_used)``.
-    This is the forward (inference/benchmark) counterpart of the CLI training
-    dispatch; both consume :func:`select_parallel_engine` so the policy cannot
-    fork.
+    ``rd``, ``channels``, ``spatial_params``, ``q_prime`` and ``q_init`` are
+    all in the batch's ORIGINAL reach order regardless of engine — the function
+    pads to a shard multiple and topological-range-partitions internally where
+    the chosen engine needs it (the caller cannot do so, since the engine — and
+    with it the required layout — is only decided here), and the returned
+    runoff / final discharge are restored to original order. ``q_init`` carries
+    discharge state across sequential chunks (``ddr test`` / ``ddr route``
+    chunked inference). This is the forward (inference/benchmark) counterpart
+    of the CLI training dispatch; both consume :func:`select_parallel_engine`
+    so the policy cannot fork.
     """
-    import jax.numpy as jnp
-
     from ddr_tpu.routing.mc import Bounds
 
     bounds = bounds or Bounds()
@@ -120,87 +163,158 @@ def route_parallel(
     n_shards = int(mesh.devices.size)
     if engine is None:
         engine = select_for_topology(_mesh_platform(mesh), rows, cols, n, n_shards)
+    if engine not in ("gspmd", "sharded-wavefront", "stacked-sharded"):
+        raise ValueError(f"unknown parallel engine {engine!r}")
+
+    cache = _plan_cache()
+    key = _topology_key(rd, n_shards, engine, bounds, mesh)
+    plan = cache.get(key)
+    if plan is not None:
+        cache.move_to_end(key)
+    else:
+        plan = _build_plan(mesh, rd, engine, n_shards, bounds)
+        cache[key] = plan
+        if len(cache) > _PLAN_CACHE_MAX:
+            cache.popitem(last=False)
+    runoff, final = plan(channels, spatial_params, q_prime, q_init)
+    return ParallelRouteResult(runoff, final, engine)
+
+
+def _build_plan(mesh: Any, rd: Any, engine: str, n_shards: int, bounds: Any) -> Callable:
+    """One reusable routing plan for a topology: the engine layout is built
+    once and the routing program is jit-compiled once; repeat calls (chunked
+    inference over the same reach set) pay neither again."""
+    import jax
+    import jax.numpy as jnp
+
+    rows = np.asarray(rd.adjacency_rows)
+    cols = np.asarray(rd.adjacency_cols)
+    n = rd.n_segments
 
     if engine == "stacked-sharded":
         # keeps original node order natively (the layout carries its own perms)
         from ddr_tpu.parallel.stacked import build_stacked_sharded, route_stacked_sharded
 
         layout = build_stacked_sharded(rows, cols, n, n_shards)
-        with mesh:
-            runoff, _ = route_stacked_sharded(
-                mesh, layout, channels, spatial_params, q_prime, bounds=bounds
+        fn = jax.jit(
+            lambda ch, sp, qp, qi: route_stacked_sharded(
+                mesh, layout, ch, sp, qp, q_init=qi, bounds=bounds
             )
-        return runoff, engine
+        )
 
-    if engine not in ("gspmd", "sharded-wavefront"):
-        raise ValueError(f"unknown parallel engine {engine!r}")
+        def plan(channels, spatial, qp, qi):
+            with mesh:
+                return fn(channels, spatial, jnp.asarray(qp), qi)
+
+        return plan
 
     # gspmd / sharded-wavefront: pad to a shard multiple (zero-impact isolated
-    # reaches), partition, permute every per-reach input, route, un-permute.
-    from ddr_tpu.parallel.partition import (
-        pad_routing_data,
-        permute_routing_data,
-        topological_range_partition,
-    )
+    # reaches) and topological-range-partition; the pad/permute/un-permute is
+    # traced into the SAME jitted program as the route.
+    from ddr_tpu.parallel.partition import pad_routing_data, topological_range_partition
 
     rd_pad = pad_routing_data(rd, n_shards)
     n_pad = rd_pad.n_segments - n
-    q_prime = jnp.asarray(q_prime)
-    spatial_params = {k: jnp.asarray(v) for k, v in spatial_params.items()}
-    if n_pad:
-        q_prime = jnp.concatenate(
-            [q_prime, jnp.zeros((q_prime.shape[0], n_pad), q_prime.dtype)], axis=1
-        )
-        spatial_params = {
-            k: jnp.concatenate([v, jnp.full((n_pad,), 0.5, v.dtype)])
-            for k, v in spatial_params.items()
-        }
     part = topological_range_partition(
         rd_pad.adjacency_rows, rd_pad.adjacency_cols, rd_pad.n_segments, n_shards
     )
-    rd_p = permute_routing_data(rd_pad, part)
+    perm = jnp.asarray(part.perm)
+    keep = jnp.asarray(part.inv[:n])
 
-    def _perm_channel(a, fill):
+    def _perm1(a, fill):
         # pad with benign values (isolated reaches; never reach a gauge), then
-        # permute — preserves the caller's channel values exactly
+        # permute — preserves the caller's values exactly
         if a is None:
             return None
         a = jnp.asarray(a)
         if n_pad:
             a = jnp.concatenate([a, jnp.full((n_pad,), fill, a.dtype)])
-        return a[part.perm]
+        return a[perm]
 
-    channels_p = type(channels)(
-        length=_perm_channel(channels.length, 1.0),
-        slope=_perm_channel(channels.slope, 1.0),
-        x_storage=_perm_channel(channels.x_storage, 0.0),
-        top_width_data=_perm_channel(channels.top_width_data, 1.0),
-        side_slope_data=_perm_channel(channels.side_slope_data, 1.0),
-    )
-    spatial_p = {k: v[part.perm] for k, v in spatial_params.items()}
-    qp_p = q_prime[:, part.perm]
-
-    if engine == "gspmd":
-        from ddr_tpu.parallel.sharding import sharded_route
-
-        from ddr_tpu.routing.network import build_network
-
-        network = build_network(
-            rd_p.adjacency_rows, rd_p.adjacency_cols, rd_p.n_segments, fused=False
+    def _prepare_inputs(channels, spatial, qp, qi):
+        channels_p = type(channels)(
+            length=_perm1(channels.length, 1.0),
+            slope=_perm1(channels.slope, 1.0),
+            x_storage=_perm1(channels.x_storage, 0.0),
+            top_width_data=_perm1(channels.top_width_data, 1.0),
+            side_slope_data=_perm1(channels.side_slope_data, 1.0),
         )
-        runoff = sharded_route(
-            mesh, network, channels_p, spatial_p, qp_p, bounds=bounds
-        ).runoff
-    else:
+        spatial_p = {k: _perm1(jnp.asarray(v), 0.5) for k, v in spatial.items()}
+        qp = jnp.asarray(qp)
+        if n_pad:
+            qp = jnp.concatenate(
+                [qp, jnp.zeros((qp.shape[0], n_pad), qp.dtype)], axis=1
+            )
+        qp_p = qp[:, perm]
+        qi_p = None if qi is None else _perm1(jnp.asarray(qi), 0.0)
+        return channels_p, spatial_p, qp_p, qi_p
+
+    if engine == "sharded-wavefront":
         from ddr_tpu.parallel.wavefront import build_sharded_wavefront, sharded_wavefront_route
 
+        # adjacency rewritten into partitioned ids (what permute_routing_data
+        # does for full batches; only the edge lists matter to the schedule)
         sched = build_sharded_wavefront(
-            rd_p.adjacency_rows, rd_p.adjacency_cols, rd_p.n_segments, n_shards
+            part.inv[np.asarray(rd_pad.adjacency_rows)],
+            part.inv[np.asarray(rd_pad.adjacency_cols)],
+            rd_pad.n_segments,
+            n_shards,
         )
-        with mesh:
-            runoff, _ = sharded_wavefront_route(
-                mesh, sched, channels_p, spatial_p, qp_p, bounds=bounds
+
+        def _run(ch, sp, qp, qi):
+            ch_p, sp_p, qp_p, qi_p = _prepare_inputs(ch, sp, qp, qi)
+            runoff, final = sharded_wavefront_route(
+                mesh, sched, ch_p, sp_p, qp_p, q_init=qi_p, bounds=bounds
             )
-    # back to original order, pads dropped (original reach i sits at column
-    # part.inv[i]; pads occupy the columns of old indices >= n)
-    return runoff[:, part.inv[:n]], engine
+            return runoff[:, keep], final[keep]
+
+        fn = jax.jit(_run)
+
+        def plan(channels, spatial, qp, qi):
+            with mesh:
+                return fn(channels, spatial, qp, qi)
+
+        return plan
+
+    # gspmd: the network tables index the partitioned id space; inputs are
+    # permuted + device_put with reach shardings OUTSIDE the jit (placement is
+    # not traceable), the route itself is one cached jitted program.
+    from ddr_tpu.parallel.sharding import (
+        reach_sharding,
+        shard_channels,
+        shard_network,
+    )
+    from ddr_tpu.routing.mc import route
+    from ddr_tpu.routing.network import build_network
+
+    network = shard_network(
+        mesh,
+        build_network(
+            part.inv[np.asarray(rd_pad.adjacency_rows)],
+            part.inv[np.asarray(rd_pad.adjacency_cols)],
+            rd_pad.n_segments,
+            fused=False,
+        ),
+    )
+
+    def _run_gspmd(ch, sp, qp, qi):
+        runoff = route(network, ch, sp, qp, q_init=qi, bounds=bounds)
+        return runoff.runoff[:, keep], runoff.final_discharge[keep]
+
+    fn = jax.jit(_run_gspmd)
+    s1 = reach_sharding(mesh)
+    s2 = reach_sharding(mesh, rank_1_axis=1, ndim=2)
+
+    def plan(channels, spatial, qp, qi):
+        import jax as _jax
+
+        ch_p, sp_p, qp_p, qi_p = _prepare_inputs(channels, spatial, qp, qi)
+        ch_p = shard_channels(mesh, ch_p)
+        sp_p = {k: _jax.device_put(v, s1) for k, v in sp_p.items()}
+        qp_p = _jax.device_put(qp_p, s2)
+        if qi_p is not None:
+            qi_p = _jax.device_put(qi_p, s1)
+        with mesh:
+            return fn(ch_p, sp_p, qp_p, qi_p)
+
+    return plan

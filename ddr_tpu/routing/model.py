@@ -129,6 +129,11 @@ class dmc:
         self.parameter_ranges = cfg.params.parameter_ranges
         self.log_space_parameters = cfg.params.log_space_parameters
         self.defaults = cfg.params.defaults
+        # Multi-chip inference: experiment.parallel != "none" routes every
+        # forward through the policy dispatcher (ddr_tpu.parallel.select) over
+        # the mesh `device` sizes — `ddr route`/`ddr test`/BMI callers gain
+        # multi-chip with no script changes ("auto" = per-batch policy pick).
+        self._init_parallel()
         self._discharge_t: jnp.ndarray | None = None
         self.epoch = 0
         self.mini_batch = 0
@@ -136,6 +141,22 @@ class dmc:
         self.n: jnp.ndarray | None = None
         self.q_spatial: jnp.ndarray | None = None
         self.p_spatial: jnp.ndarray | None = None
+
+    def _init_parallel(self) -> None:
+        """(Re)derive the multi-chip state from the CURRENT cfg/device — called
+        by both __init__ and load_state_dict so a restored cfg's
+        ``experiment.parallel`` is honored like every other cfg-derived field."""
+        self._parallel = getattr(self.cfg.experiment, "parallel", "none")
+        self._mesh = None
+        if self._parallel != "none":
+            from ddr_tpu.parallel.sharding import make_mesh
+            from ddr_tpu.parallel.train import ensure_device_platform, parse_device
+
+            # non-CLI callers (BMI couplings, notebooks) have not gone through
+            # setup_run; idempotent — a no-op once the backend is initialized
+            ensure_device_platform(self.device)
+            _, n_dev = parse_device(self.device)
+            self._mesh = make_mesh(n_dev)
 
     def set_progress_info(self, epoch: int, mini_batch: int) -> None:
         self.epoch = epoch
@@ -169,6 +190,7 @@ class dmc:
         self.parameter_ranges = self.cfg.params.parameter_ranges
         self.log_space_parameters = self.cfg.params.log_space_parameters
         self.defaults = self.cfg.params.defaults
+        self._init_parallel()
         dq = state.get("discharge_t")
         self._discharge_t = None if dq is None else jnp.asarray(dq, jnp.float32)
 
@@ -180,9 +202,17 @@ class dmc:
         carry_state: bool = False,
     ) -> dict[str, jnp.ndarray]:
         rd = routing_dataclass
-        network, channels, gauges = prepare_batch(
-            rd, slope_min=self.cfg.params.attribute_minimums["slope"]
-        )
+        if self._mesh is not None:
+            # the parallel dispatcher builds its own engine layout; only the
+            # channel physics + gauge index are needed here
+            network = None
+            channels, gauges = prepare_channels(
+                rd, self.cfg.params.attribute_minimums["slope"]
+            )
+        else:
+            network, channels, gauges = prepare_batch(
+                rd, slope_min=self.cfg.params.attribute_minimums["slope"]
+            )
         params = denormalize_spatial_parameters(
             spatial_parameters,
             self.parameter_ranges,
@@ -201,6 +231,26 @@ class dmc:
             q_prime = q_prime * jnp.asarray(rd.flow_scale, jnp.float32)[None, :]
 
         q_init = self._discharge_t if (carry_state and self._discharge_t is not None) else None
+        if self._mesh is not None:
+            from ddr_tpu.parallel.select import route_parallel
+
+            pres = route_parallel(
+                self._mesh,
+                rd,
+                channels,
+                params,
+                q_prime,
+                q_init=q_init,
+                bounds=self.bounds,
+                engine=None if self._parallel == "auto" else self._parallel,
+            )
+            self._discharge_t = pres.final_discharge
+            runoff = pres.runoff  # (T, N) all reaches, original order
+            if gauges is not None:
+                import jax
+
+                runoff = jax.vmap(gauges.aggregate)(runoff)
+            return {"runoff": runoff.T}
         result: RouteResult = route(
             network,
             channels,

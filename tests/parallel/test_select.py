@@ -68,17 +68,19 @@ class TestRouteParallel:
         """route_parallel on the virtual CPU mesh: policy picks gspmd, and the
         ORIGINAL-order result matches the single-program step engine."""
         mesh, rd, channels, spatial, qp = self._problem(n=256, depth=None, T=6)
-        runoff, engine = route_parallel(mesh, rd, channels, spatial, qp)
-        assert engine == "gspmd"  # cpu platform -> policy row 1
+        res = route_parallel(mesh, rd, channels, spatial, qp)
+        assert res.engine == "gspmd"  # cpu platform -> policy row 1
+        runoff = res.runoff
         ref = self._reference(rd, channels, spatial, qp)
         np.testing.assert_allclose(np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
     def test_forced_engine_overrides_policy(self):
         mesh, rd, channels, spatial, qp = self._problem(n=128, depth=None, T=3)
-        runoff, engine = route_parallel(
+        res = route_parallel(
             mesh, rd, channels, spatial, qp, engine="sharded-wavefront"
         )
-        assert engine == "sharded-wavefront"
+        assert res.engine == "sharded-wavefront"
+        runoff = res.runoff
         ref = self._reference(rd, channels, spatial, qp)
         np.testing.assert_allclose(np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
@@ -88,13 +90,12 @@ class TestRouteParallel:
         mesh, rd, channels, spatial, qp = self._problem(n=93, depth=None, T=3)
         ref = self._reference(rd, channels, spatial, qp)
         for engine in ("gspmd", "sharded-wavefront", "stacked-sharded"):
-            runoff, used = route_parallel(
-                mesh, rd, channels, spatial, qp, engine=engine
-            )
-            assert used == engine
-            assert runoff.shape == (3, 93)
+            res = route_parallel(mesh, rd, channels, spatial, qp, engine=engine)
+            assert res.engine == engine
+            assert res.runoff.shape == (3, 93)
+            assert res.final_discharge.shape == (93,)
             np.testing.assert_allclose(
-                np.asarray(runoff), np.asarray(ref), rtol=1e-4, atol=1e-5,
+                np.asarray(res.runoff), np.asarray(ref), rtol=1e-4, atol=1e-5,
                 err_msg=engine,
             )
 
@@ -135,3 +136,50 @@ def test_auto_mode_resolves_per_policy(tmp_path):
     mask = np.ones_like(obs, dtype=bool)
     _, _, loss, _ = par.step(prep, params, optimizer.init(params), obs, mask)
     assert np.isfinite(float(loss))
+
+
+class TestParallelInference:
+    """dmc with experiment.parallel set: `ddr route`/`ddr test` chunked
+    inference rides route_parallel — including carried state — and must match
+    the single-device wrapper exactly."""
+
+    def _cfgs(self, tmp_path, mode):
+        from ddr_tpu.validation.configs import Config
+
+        def mk(parallel):
+            return Config(
+                name="par_inf",
+                geodataset="synthetic",
+                mode="testing",
+                device=f"cpu:{N_DEV}" if parallel != "none" else "cpu",
+                kan={"input_var_names": [f"a{i}" for i in range(10)]},
+                experiment={"rho": 4, "parallel": parallel},
+                params={"save_path": str(tmp_path)},
+            )
+
+        return mk("none"), mk(mode)
+
+    @pytest.mark.parametrize("mode", ["auto", "stacked-sharded"])
+    def test_chunked_inference_matches_single_device(self, tmp_path, mode):
+        from ddr_tpu.geodatazoo.synthetic import make_basin
+        from ddr_tpu.routing.model import dmc
+
+        if len(jax.devices()) < N_DEV:
+            pytest.skip(f"needs {N_DEV} devices")
+        cfg_ref, cfg_par = self._cfgs(tmp_path, mode)
+        basin = make_basin(n_segments=61, n_gauges=3, n_days=3, seed=4)
+        rd = basin.routing_data
+        raw = {
+            "n": jnp.full(61, 0.4),
+            "q_spatial": jnp.full(61, 0.5),
+        }
+        qp = np.asarray(basin.q_prime, np.float32)
+        h = qp.shape[0] // 2
+        ref_m, par_m = dmc(cfg_ref), dmc(cfg_par)
+        # two sequential chunks with carried state through both wrappers
+        ref_a = ref_m.forward(rd, qp[:h], raw)["runoff"]
+        ref_b = ref_m.forward(rd, qp[h:], raw, carry_state=True)["runoff"]
+        par_a = par_m.forward(rd, qp[:h], raw)["runoff"]
+        par_b = par_m.forward(rd, qp[h:], raw, carry_state=True)["runoff"]
+        np.testing.assert_allclose(np.asarray(par_a), np.asarray(ref_a), rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(par_b), np.asarray(ref_b), rtol=2e-4, atol=1e-5)
