@@ -97,9 +97,18 @@ def ensure_device_platform(device: str) -> None:
         initialized = xla_bridge.backends_are_initialized()
     except Exception:  # pragma: no cover - private-API drift
         initialized = False
+    from ddr_tpu.parallel.distributed import distributed_env
+
+    # On a multi-host launch (DDR_* env set) the GLOBAL device set is what
+    # `device`'s count refers to: each process contributes only its local
+    # devices, so per-process comparisons below would predict failures that
+    # never happen once jax.distributed stitches the mesh.
+    multi_host = distributed_env(os.environ) is not None
     if initialized:
-        have = jax.local_device_count()
-        if jax.default_backend() != "cpu" or (n is not None and have < n):
+        have = len(jax.devices())  # global count under jax.distributed
+        if jax.default_backend() != "cpu" or (
+            n is not None and have < n and not multi_host
+        ):
             log.warning(
                 f"device={device!r} requested but the JAX backend is already "
                 f"initialized ({jax.default_backend()}, {have} devices); set "
@@ -113,7 +122,7 @@ def ensure_device_platform(device: str) -> None:
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={n}"
             ).strip()
-        else:
+        elif not multi_host:
             import re
 
             m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)

@@ -9,6 +9,7 @@ from __future__ import annotations
 import logging
 from pathlib import Path
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -121,6 +122,17 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
     throughput = Throughput(label="train")
+    # Multi-process (jax.distributed) discipline: plots/logs come from process 0
+    # only; checkpoints switch to the COLLECTIVE orbax writer (every process
+    # writes its addressable shards, process-0 meta, completion barrier —
+    # host-0-only pickle would strand processes with per-host storage at resume);
+    # and the prefetch thread is disabled — its device_puts against GLOBAL
+    # shardings are collective-ordered operations, and a lookahead thread could
+    # interleave them differently across processes (distributed deadlock).
+    # Every process sees identical batches (same seeded loader), so the loop
+    # stays in lockstep.
+    is_primary = jax.process_index() == 0
+    multiprocess = jax.process_count() > 1
 
     # try/finally so the aggregate summary survives every exit path, including the
     # KeyboardInterrupt that main() treats as a normal way to end a long run.
@@ -160,9 +172,11 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     attrs = jnp.asarray(rd.normalized_spatial_attributes)
                 return i, rd, payload, attrs, obs_daily, obs_mask
 
-            for i, rd, payload, attrs, obs_daily, obs_mask in prefetch(
-                _batches(), _prepare
-            ):
+            batch_stream = (
+                map(_prepare, _batches()) if multiprocess
+                else prefetch(_batches(), _prepare)
+            )
+            for i, rd, payload, attrs, obs_daily, obs_mask in batch_stream:
                 if not grids_refit:
                     # pykan-style data refit of the spline grids on the first
                     # EXECUTED mini-batch of the epoch (not literal i == 0, so a
@@ -205,35 +219,51 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 metrics = Metrics(pred=daily.T, target=target.T)
                 log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
 
-                gage_ids = rd.observations.gage_ids
-                # Legend NSE over the SAME post-warmup window the curve shows
-                # (plot_time_series trims warmup; the batch `metrics` above
-                # include it) — reference train.py:135-144's annotation.
-                w = cfg.experiment.warmup
-                legend = None
-                if w < daily.shape[0]:  # an all-warmup window has no score to print
-                    plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
-                    legend = {"nse": float(plotted.nse[0])}
-                plot_time_series(
-                    daily[:, -1],
-                    target[:, -1],
-                    rd.dates.batch_daily_time_range[1:-1],
-                    gage_ids[-1],
-                    cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
-                    name=cfg.name,
-                    warmup=w,
-                    metrics=legend,
-                )
-                save_state(
-                    cfg.params.save_path / "saved_models",
-                    cfg.name,
-                    epoch,
-                    i,
-                    params,
-                    opt_state,
-                    rng_state=loader.state(),
-                    arch=kan_arch(cfg),
-                )
+                if multiprocess:
+                    # collective multi-host checkpoint (all processes call it)
+                    from ddr_tpu.training import save_state_orbax
+
+                    save_state_orbax(
+                        cfg.params.save_path / "saved_models",
+                        cfg.name,
+                        epoch,
+                        i,
+                        params,
+                        opt_state,
+                        rng_state=loader.state(),
+                        arch=kan_arch(cfg),
+                    )
+                if is_primary:
+                    gage_ids = rd.observations.gage_ids
+                    # Legend NSE over the SAME post-warmup window the curve shows
+                    # (plot_time_series trims warmup; the batch `metrics` above
+                    # include it) — reference train.py:135-144's annotation.
+                    w = cfg.experiment.warmup
+                    legend = None
+                    if w < daily.shape[0]:  # an all-warmup window has no score to print
+                        plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
+                        legend = {"nse": float(plotted.nse[0])}
+                    plot_time_series(
+                        daily[:, -1],
+                        target[:, -1],
+                        rd.dates.batch_daily_time_range[1:-1],
+                        gage_ids[-1],
+                        cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
+                        name=cfg.name,
+                        warmup=w,
+                        metrics=legend,
+                    )
+                    if not multiprocess:
+                        save_state(
+                            cfg.params.save_path / "saved_models",
+                            cfg.name,
+                            epoch,
+                            i,
+                            params,
+                            opt_state,
+                            rng_state=loader.state(),
+                            arch=kan_arch(cfg),
+                        )
                 n_done += 1
                 if max_batches is not None and n_done >= max_batches:
                     return params, opt_state

@@ -562,10 +562,47 @@ def load_state_orbax(
     # mismatch raises the module's clear error, not a tensorstore shape error
     blob = peek_orbax_meta(path, expected_arch=expected_arch)
     with ocp.StandardCheckpointer() as ckptr:
-        if target is not None:
-            state = ckptr.restore(path / "state", target)
-        else:
-            state = ckptr.restore(path / "state")
+        try:
+            if target is not None:
+                state = ckptr.restore(path / "state", target)
+            else:
+                state = ckptr.restore(path / "state")
+        except ValueError as e:
+            if "devices used to save" not in str(e):
+                raise
+            # Cross-topology restore: the checkpoint was written by a DIFFERENT
+            # device set (e.g. a 2-host collective save restored on one host for
+            # eval). Re-restore every leaf fully replicated on the CURRENT
+            # devices — correct for this trainer's state (KAN params and optax
+            # moments are replicated in training; genuinely sharded state would
+            # need explicit target shardings, which the caller can still pass).
+            import numpy as _np
+
+            if target is not None:
+                # keep the caller's tree structure (custom optax nodes); only
+                # the shardings are replaced with replicated-on-current-devices
+                from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+                sharding = NamedSharding(
+                    Mesh(_np.asarray(jax.devices()), ("_r",)), PartitionSpec()
+                )
+                template = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        _np.shape(x), _np.asarray(x).dtype, sharding=sharding
+                    ),
+                    target,
+                )
+                state = ckptr.restore(path / "state", template)
+            else:
+                # untargeted: restore every leaf as a HOST numpy array (no
+                # device placement, so no topology to mismatch); the tree
+                # structure comes from the checkpoint's own metadata
+                pt = ocp.PyTreeCheckpointer()
+                meta_tree = pt.metadata(path / "state").item_metadata.tree
+                restore_args = jax.tree_util.tree_map(
+                    lambda _m: ocp.RestoreArgs(restore_type=_np.ndarray), meta_tree
+                )
+                state = pt.restore(path / "state", restore_args=restore_args)
     blob.update(state)
     # metadata already validated by the peek above; params/opt_state presence
     # is guaranteed by construction of the restored state dict

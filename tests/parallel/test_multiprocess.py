@@ -54,6 +54,39 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+
+def _run_two_process(worker_src: str, *argv: str, timeout: int = 900) -> dict[int, dict]:
+    """Launch two jax.distributed worker processes (4 virtual CPU devices each,
+    DDR_* env contract) running ``worker_src`` and collect each one's
+    ``RESULT {json}`` line. The ONE launch recipe for every test here."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PALLAS_AXON_POOL_IPS="",
+            DDR_COORDINATOR=f"127.0.0.1:{port}",
+            DDR_NUM_PROCESSES="2",
+            DDR_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", worker_src, *argv],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    results = {}
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"process {pid} failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results[pid] = json.loads(line[len("RESULT "):])
+    return results
+
+
 class TestDistributedEnv:
     def test_unset_is_single_process(self):
         assert distributed_env({}) is None
@@ -94,34 +127,7 @@ class TestDistributedEnv:
 @pytest.mark.slow
 def test_two_process_gspmd_train_step_matches_single_process():
     """2 processes x 4 devices == 1 process x 8 devices, same loss and update."""
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            PALLAS_AXON_POOL_IPS="",
-            DDR_COORDINATOR=f"127.0.0.1:{port}",
-            DDR_NUM_PROCESSES="2",
-            DDR_PROCESS_ID=str(pid),
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", WORKER],
-                env=env,
-                cwd=REPO,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    results = {}
-    for pid, p in enumerate(procs):
-        out, err = p.communicate(timeout=900)
-        assert p.returncode == 0, f"process {pid} failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
-        results[pid] = json.loads(line[len("RESULT "):])
+    results = _run_two_process(WORKER)
 
     assert results[0]["process"] == 0 and results[1]["process"] == 1
     # both processes see the identical replicated loss and parameter update
@@ -189,31 +195,7 @@ def test_two_process_orbax_save_and_load(tmp_path):
     """The multi-host orbax path end to end: collective save, process-0 meta
     write, post-meta barrier, and a collective targeted restore — both
     processes must see the complete checkpoint and identical state."""
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            PALLAS_AXON_POOL_IPS="",
-            DDR_COORDINATOR=f"127.0.0.1:{port}",
-            DDR_NUM_PROCESSES="2",
-            DDR_PROCESS_ID=str(pid),
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", ORBAX_WORKER, str(tmp_path)],
-                env=env, cwd=REPO,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            )
-        )
-    results = {}
-    for pid, p in enumerate(procs):
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"process {pid} failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
-        results[pid] = json.loads(line[len("RESULT "):])
+    results = _run_two_process(ORBAX_WORKER, str(tmp_path), timeout=600)
     assert results[0]["epoch"] == results[1]["epoch"] == 4
     assert results[0]["digest"] == pytest.approx(results[1]["digest"], rel=1e-12)
     assert results[0]["digest"] == pytest.approx(70.0)  # sum(arange(12)) + sum(ones(4))
@@ -239,31 +221,7 @@ def test_two_process_sharded_wavefront_step_matches_single_process():
     """The EXPLICIT-COLLECTIVE train step (shard_map, one psum per wave) is
     process-count-agnostic too: 2 processes x 4 devices reproduce this
     process's single-process 8-device loss and update."""
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            PALLAS_AXON_POOL_IPS="",
-            DDR_COORDINATOR=f"127.0.0.1:{port}",
-            DDR_NUM_PROCESSES="2",
-            DDR_PROCESS_ID=str(pid),
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", SWF_WORKER],
-                env=env, cwd=REPO,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            )
-        )
-    results = {}
-    for pid, p in enumerate(procs):
-        out, err = p.communicate(timeout=900)
-        assert p.returncode == 0, f"process {pid} failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
-        results[pid] = json.loads(line[len("RESULT "):])
+    results = _run_two_process(SWF_WORKER)
 
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-12)
     # BOTH processes must hold the identical post-step parameters (a missed
@@ -276,3 +234,61 @@ def test_two_process_sharded_wavefront_step_matches_single_process():
     single = run_sharded_wavefront_step(8)
     assert results[0]["loss"] == pytest.approx(single["loss"], rel=1e-5)
     assert results[0]["param_digest"] == pytest.approx(single["param_digest"], rel=1e-6)
+
+
+CLI_TRAIN_WORKER = r"""
+import json, sys
+
+import jax
+import numpy as np
+
+from ddr_tpu.validation.configs import Config
+
+# setup_run -> maybe_initialize wires jax.distributed from the DDR_* env —
+# the EXACT path `ddr train` takes on a multi-host launch.
+from ddr_tpu.scripts.common import setup_run
+from ddr_tpu.scripts.train import train
+
+out_dir = sys.argv[1]
+cfg = setup_run(Config(
+    name="mp_cli",
+    geodataset="synthetic",
+    mode="training",
+    device="cpu:8",
+    kan={"input_var_names": [f"a{i}" for i in range(10)]},
+    experiment={
+        "start_time": "1981/10/01", "end_time": "1981/10/16",
+        "rho": 6, "batch_size": 2, "epochs": 1, "warmup": 1,
+        "parallel": "gspmd",
+    },
+    params={"save_path": out_dir},
+))
+assert jax.process_count() == 2
+params, _ = train(cfg, max_batches=1)
+digest = float(sum(np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(params)))
+print("RESULT " + json.dumps({"process": jax.process_index(), "param_digest": digest}))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_cli_train_collective_checkpoint(tmp_path):
+    """The USER-FACING multi-host path: `ddr train` semantics (setup_run ->
+    ParallelTrainer gspmd) across 2 processes x 4 devices sharing ONE save dir —
+    both processes finish with identical parameters, the checkpoint is the
+    COLLECTIVE orbax form (complete: meta.json present; no racing .pkl writes),
+    and it restores."""
+    results = _run_two_process(CLI_TRAIN_WORKER, str(tmp_path))
+    # identical replicated post-step parameters on both hosts
+    assert results[0]["param_digest"] == pytest.approx(
+        results[1]["param_digest"], rel=1e-12
+    )
+    saved = tmp_path / "saved_models"
+    orbax_dirs = list(saved.glob("*.orbax"))
+    assert len(orbax_dirs) == 1, orbax_dirs
+    assert (orbax_dirs[0] / "meta.json").exists()  # completeness marker
+    assert not list(saved.glob("*.pkl"))  # no host-0 pickle racing the collective
+    assert list((tmp_path / "plots").glob("*.png"))  # process-0 plot
+    from ddr_tpu.training import load_state
+
+    blob = load_state(orbax_dirs[0])
+    assert blob["epoch"] == 1 and blob["mini_batch"] == 0
