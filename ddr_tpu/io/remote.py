@@ -148,9 +148,23 @@ class XarrayConventionGroup:
             time_arr = group["time"]
             units = dict(getattr(time_arr, "attrs", {}) or {}).get("units")
             times = _decode_cf_time(read_array(time_arr), units)
-            self.attrs["start_date"] = times[0].strftime("%Y/%m/%d")
             step_hours = (
                 (times[1] - times[0]).total_seconds() / 3600 if len(times) > 1 else 24
+            )
+            origin = times[0]
+            midnight = origin.normalize() == origin
+            if step_hours > 1 and not midnight:
+                # a daily store whose first record is off-midnight would have
+                # its whole-day offsets silently floored — same silent
+                # mis-indexing class as the cadence check below
+                raise ValueError(
+                    f"daily remote store starts off-midnight ({origin}); "
+                    "the day-offset alignment would silently shift every window"
+                )
+            # hourly stores keep the full timestamp (a 13:00 first record is
+            # legitimate; truncating to the date would read 13 hours early)
+            self.attrs["start_date"] = origin.strftime(
+                "%Y/%m/%d" if midnight else "%Y/%m/%d %H:%M"
             )
             # only hourly and daily cadences exist in the facade contract; a
             # 3-/6-hourly store silently labeled "D" would mis-index every

@@ -24,7 +24,34 @@ __all__ = [
     "pad_routing_data",
     "topological_range_partition",
     "permute_routing_data",
+    "topology_sha",
 ]
+
+
+def topology_sha(rd: "RoutingData") -> str:
+    """sha1 over ``(n_segments, adjacency)`` — the one topology fingerprint
+    shared by the trainer's built-step cache and the inference plan cache.
+
+    Memoized on the RoutingData instance (batches are assembled once at collate
+    and never mutated afterwards), so chunked inference hashes a CONUS-scale
+    adjacency once per batch, not once per time chunk."""
+    import hashlib
+
+    cached = getattr(rd, "_topology_sha", None)
+    if cached is not None:
+        return cached
+    h = hashlib.sha1()
+    h.update(str(rd.n_segments).encode())
+    for a in (rd.adjacency_rows, rd.adjacency_cols):
+        h.update(b"|")
+        if a is not None:
+            h.update(np.ascontiguousarray(a).tobytes())
+    digest = h.hexdigest()
+    try:
+        rd._topology_sha = digest
+    except Exception:  # pragma: no cover - exotic frozen/slotted stand-ins
+        pass
+    return digest
 
 
 @dataclasses.dataclass(frozen=True)

@@ -120,15 +120,9 @@ def _plan_cache():
 
 
 def _topology_key(rd: Any, n_shards: int, engine: str, bounds: Any, mesh: Any) -> tuple:
-    import hashlib
+    from ddr_tpu.parallel.partition import topology_sha
 
-    h = hashlib.sha1()
-    h.update(str(rd.n_segments).encode())
-    for a in (rd.adjacency_rows, rd.adjacency_cols):
-        h.update(b"|")
-        if a is not None:
-            h.update(np.ascontiguousarray(a).tobytes())
-    return (h.hexdigest(), n_shards, engine, repr(bounds), id(mesh))
+    return (topology_sha(rd), n_shards, engine, repr(bounds), id(mesh))
 
 
 def route_parallel(
@@ -154,6 +148,8 @@ def route_parallel(
     of the CLI training dispatch; both consume :func:`select_parallel_engine`
     so the policy cannot fork.
     """
+    import jax.numpy as jnp
+
     from ddr_tpu.routing.mc import Bounds
 
     bounds = bounds or Bounds()
@@ -161,6 +157,12 @@ def route_parallel(
     cols = np.asarray(rd.adjacency_cols)
     n = rd.n_segments
     n_shards = int(mesh.devices.size)
+    # route()'s contract allows scalar spatial parameters; the pad/permute
+    # machinery needs per-reach vectors — normalize up front for every engine
+    spatial_params = {
+        k: (jnp.broadcast_to(v, (n,)) if jnp.ndim(v) == 0 else v)
+        for k, v in ((k2, jnp.asarray(v2)) for k2, v2 in spatial_params.items())
+    }
     if engine is None:
         engine = select_for_topology(_mesh_platform(mesh), rows, cols, n, n_shards)
     if engine not in ("gspmd", "sharded-wavefront", "stacked-sharded"):

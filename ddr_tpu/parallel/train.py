@@ -101,9 +101,25 @@ def ensure_device_platform(device: str) -> None:
 
     # On a multi-host launch (DDR_* env set) the GLOBAL device set is what
     # `device`'s count refers to: each process contributes only its local
-    # devices, so per-process comparisons below would predict failures that
-    # never happen once jax.distributed stitches the mesh.
-    multi_host = distributed_env(os.environ) is not None
+    # devices — cpu:N must therefore force N / num_processes virtual devices
+    # PER PROCESS (forcing N each would make the global set N * P and a
+    # make_mesh(N) span host 0's devices only).
+    dist_spec = distributed_env(os.environ)
+    multi_host = dist_spec is not None
+    n_procs = (dist_spec or {}).get("num_processes")
+    if n is not None and multi_host:
+        if n_procs:
+            n = -(-n // int(n_procs))  # per-process share (ceil)
+        else:
+            # DDR_DISTRIBUTED=1 autodetect: process count unknown here — the
+            # caller must size XLA_FLAGS per host explicitly
+            log.warning(
+                f"device={device!r} with DDR_DISTRIBUTED autodetect: cannot "
+                "derive the per-process virtual device count; set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=<local> on "
+                "each host"
+            )
+            n = None
     if initialized:
         have = len(jax.devices())  # global count under jax.distributed
         if jax.default_backend() != "cpu" or (
@@ -137,19 +153,14 @@ def ensure_device_platform(device: str) -> None:
 
 def _batch_key(rd: RoutingData) -> str:
     """Identity of everything a sharded step builder bakes in as compile-time
-    constants: topology, channel geometry, and the gauge index. Batches with the
-    same key can safely share a built (and compiled) step."""
+    constants: topology (the shared memoized fingerprint), channel geometry,
+    and the gauge index. Batches with the same key can safely share a built
+    (and compiled) step."""
+    from ddr_tpu.parallel.partition import topology_sha
+
     h = hashlib.sha1()
-    h.update(str(rd.n_segments).encode())
-    for a in (
-        rd.adjacency_rows,
-        rd.adjacency_cols,
-        rd.length,
-        rd.slope,
-        rd.x,
-        rd.top_width,
-        rd.side_slope,
-    ):
+    h.update(topology_sha(rd).encode())
+    for a in (rd.length, rd.slope, rd.x, rd.top_width, rd.side_slope):
         h.update(b"|")
         if a is not None:
             h.update(np.ascontiguousarray(a).tobytes())

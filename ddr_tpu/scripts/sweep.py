@@ -70,6 +70,14 @@ def expand_sweep(overrides: list[str]) -> tuple[list[list[str]], list[str]]:
     return combos, fixed
 
 
+def _combo_dirname(combo: list[str]) -> str:
+    """One directory name per combination, DIRECTLY under the sweep root: path
+    separators in override values (data paths) must not nest or escape it
+    (hydra's override_dirname has the same constraint)."""
+    dirname = ",".join(combo) if combo else "default"
+    return dirname.replace("/", "_").replace("\\", "_")
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(argv or [])
     if not argv or argv[0] in {"-h", "--help"}:
@@ -91,31 +99,13 @@ def main(argv: list[str] | None = None) -> int:
     path, overrides = split_config_argv(rest)
     combos, fixed = expand_sweep(overrides)
 
-    # Sweep root under the config's save_path, resolved with the SAME include
-    # composition + ${...} interpolation + override semantics the per-run loads
-    # use (a fixed params.save_path override wins over the file).
-    from ddr_tpu.validation.configs import (
-        _apply_override,
-        _interpolate,
-        _load_yaml_with_includes,
-    )
+    # Sweep root under the config's save_path, resolved with the SAME
+    # pre-validation pipeline load_config uses (includes, benchmark-key pop,
+    # "ddr" unwrap, overrides, interpolation) — shared code, zero drift; a
+    # fixed params.save_path override wins over the file.
+    from ddr_tpu.validation.configs import load_raw_config
 
-    from ddr_tpu.validation.configs import BENCHMARK_SECTION_KEYS
-
-    raw: dict = {}
-    if path is not None:
-        raw = _load_yaml_with_includes(Path(path))
-        # mirror load_config exactly: benchmark-owned sections pop BEFORE the
-        # nested-"ddr" unwrap check, or a shared benchmark/train YAML never
-        # unwraps and save_path resolution silently falls back to "./"
-        for benchmark_key in BENCHMARK_SECTION_KEYS:
-            raw.pop(benchmark_key, None)
-        if isinstance(raw.get("ddr"), dict) and set(raw) == {"ddr"}:
-            raw = raw["ddr"]
-    for ov in fixed:
-        k, v = ov.split("=", 1)
-        _apply_override(raw, k, v)
-    raw = _interpolate(raw, raw)
+    raw = load_raw_config(path, fixed)
     base_save = str(raw.get("params", {}).get("save_path", "./"))
     sweep_root = Path(base_save) / "multirun" / datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
     sweep_root.mkdir(parents=True, exist_ok=True)
@@ -125,7 +115,7 @@ def main(argv: list[str] | None = None) -> int:
     mod = importlib.import_module(SWEEPABLE[cmd])
     results = []
     for i, combo in enumerate(combos):
-        dirname = ",".join(combo) if combo else "default"
+        dirname = _combo_dirname(combo)
         run_dir = sweep_root / dirname
         run_argv = ([path] if path else []) + fixed + combo + [
             f"params.save_path={run_dir}",

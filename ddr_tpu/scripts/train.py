@@ -133,6 +133,14 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     # stays in lockstep.
     is_primary = jax.process_index() == 0
     multiprocess = jax.process_count() > 1
+    if multiprocess and par is None:
+        # P independent single-device loops all writing one save dir is never
+        # what a distributed launch means — and the collective checkpoint path
+        # below would corrupt (every process thinks the full array is its own)
+        raise ValueError(
+            "multi-process launch (jax.process_count() > 1) requires "
+            "experiment.parallel != 'none' — e.g. experiment.parallel=auto"
+        )
 
     # try/finally so the aggregate summary survives every exit path, including the
     # KeyboardInterrupt that main() treats as a normal way to end a long run.

@@ -324,6 +324,41 @@ def _load_yaml_with_includes(path: Path, _stack: tuple = ()) -> dict:
     return _deep_merge(merged, raw)
 
 
+def load_raw_config(
+    path: str | Path | None = None,
+    overrides: list[str] | None = None,
+    base: dict | None = None,
+) -> dict:
+    """``path + overrides -> interpolated raw mapping`` — the pre-validation
+    half of :func:`load_config`, shared with the sweep runner's root-path
+    resolution so the two can never diverge.
+
+    Benchmark-only sections may share the YAML (one file drives every command);
+    the benchmark harness validates them itself (benchmarks/configs.py), the
+    core config ignores them — the analog of the reference's
+    validate_benchmark_config popping model-specific keys before DDR
+    validation. Both of the harness's layouts are accepted: flat, or the core
+    config nested under "ddr". Popping happens BEFORE CLI overrides so an
+    explicit override targeting a benchmark section still fails loudly via
+    extra="forbid" instead of being dropped. Interpolation runs AFTER
+    overrides: an override can introduce or retarget ``${oc.env:...}``/
+    ``${ref}`` expressions, exactly as with hydra's composition.
+    """
+    raw: dict = dict(base or {})
+    if path is not None:
+        raw = _deep_merge(raw, _load_yaml_with_includes(Path(path)))
+    for benchmark_key in BENCHMARK_SECTION_KEYS:
+        raw.pop(benchmark_key, None)
+    if isinstance(raw.get("ddr"), dict) and set(raw) == {"ddr"}:
+        raw = raw["ddr"]
+    for ov in overrides or []:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} must look like key.subkey=value")
+        k, v = ov.split("=", 1)
+        _apply_override(raw, k, v)
+    return _interpolate(raw, raw)
+
+
 def load_config(
     path: str | Path | None = None,
     overrides: list[str] | None = None,
@@ -338,28 +373,7 @@ def load_config(
     defaults-list / config-group analog): includes merge first, the file's own
     keys override them, CLI overrides override everything.
     """
-    raw: dict = dict(base or {})
-    if path is not None:
-        raw = _deep_merge(raw, _load_yaml_with_includes(Path(path)))
-    # Benchmark-only sections may share the YAML (one file drives every command);
-    # the benchmark harness validates them itself (benchmarks/configs.py), the core
-    # config ignores them — the analog of the reference's validate_benchmark_config
-    # popping model-specific keys before DDR validation. Both of the harness's
-    # layouts are accepted: flat, or the core config nested under "ddr". Popping
-    # happens BEFORE CLI overrides so an explicit override targeting a benchmark
-    # section still fails loudly via extra="forbid" instead of being dropped.
-    for benchmark_key in BENCHMARK_SECTION_KEYS:
-        raw.pop(benchmark_key, None)
-    if isinstance(raw.get("ddr"), dict) and set(raw) == {"ddr"}:
-        raw = raw["ddr"]
-    for ov in overrides or []:
-        if "=" not in ov:
-            raise ValueError(f"override {ov!r} must look like key.subkey=value")
-        k, v = ov.split("=", 1)
-        _apply_override(raw, k, v)
-    # Interpolation AFTER overrides: an override can introduce or retarget
-    # ${oc.env:...}/${ref} expressions, exactly as with hydra's composition.
-    raw = _interpolate(raw, raw)
+    raw = load_raw_config(path, overrides, base)
     cfg = Config(**raw)
     _set_seed(cfg)
     if cfg.s3_region:

@@ -234,3 +234,31 @@ class TestS3Registration:
             save_config=False,
         )
         assert remote._DEFAULT_REGION == "us-west-2"
+
+
+class TestTimeOrigin:
+    def test_daily_off_midnight_raises(self, tmp_path):
+        """A daily store starting off-midnight would silently floor every
+        whole-day offset — refuse like the cadence check does."""
+        g = zarrlite.create_group(tmp_path / "offmid")
+        g.create_array("divide_id", np.arange(3, dtype=np.int64))
+        g.create_array(
+            "time", np.arange(0, 72, 24, dtype=np.int64),
+            attributes={"units": "hours since 1980-01-01 13:00"},
+        )
+        with pytest.raises(ValueError, match="off-midnight"):
+            XarrayConventionGroup(zarrlite.open_group(tmp_path / "offmid"))
+
+    def test_hourly_off_midnight_keeps_full_timestamp(self, tmp_path):
+        """An hourly store legitimately starting at 13:00 must carry the full
+        timestamp (date truncation would read every window 13 hours early)."""
+        g = zarrlite.create_group(tmp_path / "h13")
+        g.create_array("divide_id", np.arange(2, dtype=np.int64))
+        g.create_array(
+            "time", np.arange(48, dtype=np.int64),
+            attributes={"units": "hours since 1990-06-01 13:00"},
+        )
+        adapted = XarrayConventionGroup(zarrlite.open_group(tmp_path / "h13"))
+        assert adapted.attrs["freq"] == "h"
+        hs = stores.HydroStore(adapted)
+        assert hs.start_date == pd.Timestamp("1990-06-01 13:00")

@@ -200,3 +200,17 @@ def test_parallel_config_validates():
             kan={"input_var_names": ["a"]},
             experiment={"parallel": "bogus"},
         )
+
+
+def test_multiprocess_requires_parallel_mode(tmp_path, monkeypatch):
+    """P independent single-device loops all writing one save dir is never what
+    a distributed launch means — train() must refuse parallel='none' there."""
+    import jax as _jax
+
+    from ddr_tpu.scripts.train import train
+
+    monkeypatch.setattr(_jax, "process_count", lambda: 2)
+    cfg = _synthetic_cfg(tmp_path, parallel="none")
+    cfg.device = "cpu"
+    with pytest.raises(ValueError, match="experiment.parallel"):
+        train(cfg, max_batches=1)

@@ -183,3 +183,26 @@ class TestParallelInference:
         par_b = par_m.forward(rd, qp[h:], raw, carry_state=True)["runoff"]
         np.testing.assert_allclose(np.asarray(par_a), np.asarray(ref_a), rtol=2e-4, atol=1e-5)
         np.testing.assert_allclose(np.asarray(par_b), np.asarray(ref_b), rtol=2e-4, atol=1e-5)
+
+
+def test_route_parallel_accepts_scalar_spatial(tmp_path):
+    """route()'s contract allows scalar parameters; the parallel dispatcher
+    must broadcast them instead of crashing in the pad/permute machinery."""
+    from ddr_tpu.geodatazoo.synthetic import make_basin
+    from ddr_tpu.parallel import make_mesh
+    from ddr_tpu.routing.model import prepare_channels
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    basin = make_basin(n_segments=21, n_gauges=2, n_days=1, seed=2)
+    rd = basin.routing_data
+    channels, _ = prepare_channels(rd, 0.001)
+    spatial = {
+        "n": jnp.full(21, 0.05),
+        "q_spatial": jnp.full(21, 0.4),
+        "p_spatial": jnp.float32(21.0),  # scalar — allowed by route()
+    }
+    qp = jnp.asarray(basin.q_prime[:2])
+    res = route_parallel(make_mesh(N_DEV), rd, channels, spatial, qp, engine="gspmd")
+    assert res.runoff.shape == (2, 21)
+    assert np.isfinite(np.asarray(res.runoff)).all()

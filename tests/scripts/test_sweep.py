@@ -111,3 +111,14 @@ class TestSweepCli:
             assert r["exit_code"] == 0
             run_dir = sweep_root / r["overrides"][0]
             assert (run_dir / "saved_models").exists(), f"no checkpoint dir in {run_dir}"
+
+
+def test_combo_dirname_sanitizes_path_separators():
+    from ddr_tpu.scripts.sweep import _combo_dirname
+
+    assert _combo_dirname([]) == "default"
+    assert _combo_dirname(["a=1", "b=2"]) == "a=1,b=2"
+    # a path-valued axis must stay ONE directory component under the root
+    d = _combo_dirname(["data_sources.streamflow=/data/a"])
+    assert "/" not in d and "\\" not in d
+    assert _combo_dirname(["p=../escape"]) == "p=.._escape"
