@@ -20,6 +20,7 @@ from ddr_tpu.validation.configs import Config, load_config
 log = logging.getLogger(__name__)
 
 __all__ = [
+    "is_primary_process",
     "parse_cli",
     "split_config_argv",
     "setup_run",
@@ -170,6 +171,14 @@ def daily_observation_targets(rd: Any) -> tuple[np.ndarray, np.ndarray]:
     target = obs[:, 1:-1].T  # (D-2, G)
     mask = np.isfinite(target)
     return np.where(mask, target, 0.0).astype(np.float32), mask
+
+
+def is_primary_process() -> bool:
+    """True on the one process that should write shared artifacts (result
+    stores, plots, summaries) under a ``jax.distributed`` launch — outputs are
+    replicated across processes, so N processes writing one path is a race,
+    not redundancy. Always True single-process."""
+    return jax.process_index() == 0
 
 
 @contextmanager

@@ -17,7 +17,14 @@ from ddr_tpu.geodatazoo.loader import DataLoader
 from ddr_tpu.io import zarrlite
 from ddr_tpu.routing.model import dmc
 from ddr_tpu.scripts_utils import safe_mean, safe_percentile
-from ddr_tpu.scripts.common import build_kan, get_flow_fn, kan_arch, parse_cli, timed
+from ddr_tpu.scripts.common import (
+    build_kan,
+    get_flow_fn,
+    is_primary_process,
+    kan_arch,
+    parse_cli,
+    timed,
+)
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.configs import Config
 from ddr_tpu.validation.plots import plot_routing_hydrograph, select_plot_segments
@@ -80,30 +87,33 @@ def route_domain(cfg: Config, dataset=None, params=None) -> np.ndarray:
         discharge[:, rd.dates.hourly_indices] = np.asarray(out["runoff"])
     runtime = time.perf_counter() - t0
 
+    # Routed discharge is replicated across processes under jax.distributed —
+    # shared artifacts are written once, by the primary (scripts/common.py).
     out_path = Path(cfg.params.save_path) / "chrout.zarr"
-    root = zarrlite.create_group(out_path)
-    root.create_array("discharge", discharge)
-    root.attrs.update(
-        {
-            "description": "DDR routed discharge",
-            "start_time": cfg.experiment.start_time,
-            "end_time": cfg.experiment.end_time,
-            "version": os.environ.get("DDR_VERSION", "dev"),
-            "ids": [str(i) for i in output_ids],
-            "units": "m3/s",
-            "model": str(cfg.experiment.checkpoint or "No Trained Model"),
-        }
-    )
-    print_routing_summary(discharge, output_ids, runtime, out_path)
-    sel = select_plot_segments(
-        discharge, output_ids, target_catchments=getattr(dataset, "target_catchments", None)
-    )
-    plot_routing_hydrograph(
-        discharge[sel],
-        None,
-        [output_ids[int(i)] for i in sel],
-        Path(cfg.params.save_path) / "plots/routing_hydrograph.png",
-    )
+    if is_primary_process():
+        root = zarrlite.create_group(out_path)
+        root.create_array("discharge", discharge)
+        root.attrs.update(
+            {
+                "description": "DDR routed discharge",
+                "start_time": cfg.experiment.start_time,
+                "end_time": cfg.experiment.end_time,
+                "version": os.environ.get("DDR_VERSION", "dev"),
+                "ids": [str(i) for i in output_ids],
+                "units": "m3/s",
+                "model": str(cfg.experiment.checkpoint or "No Trained Model"),
+            }
+        )
+        print_routing_summary(discharge, output_ids, runtime, out_path)
+        sel = select_plot_segments(
+            discharge, output_ids, target_catchments=getattr(dataset, "target_catchments", None)
+        )
+        plot_routing_hydrograph(
+            discharge[sel],
+            None,
+            [output_ids[int(i)] for i in sel],
+            Path(cfg.params.save_path) / "plots/routing_hydrograph.png",
+        )
     return discharge
 
 

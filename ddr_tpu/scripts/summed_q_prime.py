@@ -20,7 +20,7 @@ from ddr_tpu.io import zarrlite
 from ddr_tpu.io.readers import USGSObservationReader, read_zarr
 from ddr_tpu.io.stores import open_hydro_store
 from ddr_tpu.scripts_utils import safe_mean, safe_percentile
-from ddr_tpu.scripts.common import parse_cli, timed
+from ddr_tpu.scripts.common import is_primary_process, parse_cli, timed
 from ddr_tpu.validation.configs import Config
 from ddr_tpu.validation.metrics import Metrics
 from ddr_tpu.validation.utils import log_metrics
@@ -105,6 +105,8 @@ def eval_q_prime(cfg: Config) -> Metrics:
     obs = observations.sel_gages(available).streamflow[:, :n_days]
     metrics = Metrics(pred=preds, target=obs)
     log_metrics(metrics, header="Summed Q' baseline")
+    if not is_primary_process():  # shared artifacts: one writer per launch
+        return metrics
     save_dir = Path(cfg.params.save_path)
     print_metrics_summary(metrics, available, save_dir)
 

@@ -13,7 +13,7 @@ import numpy as np
 
 from ddr_tpu.io import zarrlite
 from ddr_tpu.scripts_utils import compute_daily_runoff
-from ddr_tpu.scripts.common import build_kan, evaluate_hourly, get_flow_fn, kan_arch, parse_cli, timed
+from ddr_tpu.scripts.common import is_primary_process, build_kan, evaluate_hourly, get_flow_fn, kan_arch, parse_cli, timed
 from ddr_tpu.training import load_state
 from ddr_tpu.validation.configs import Config
 from ddr_tpu.validation.metrics import Metrics
@@ -48,23 +48,26 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
     daily_obs = observations[:, 1 : 1 + daily_runoff.shape[1]]
     time_range = dataset.dates.daily_time_range[1 : 1 + daily_runoff.shape[1]]
 
+    # Predictions are replicated across processes under jax.distributed —
+    # shared artifacts are written once, by the primary (scripts/common.py).
     out_path = Path(cfg.params.save_path) / "model_test.zarr"
-    root = zarrlite.create_group(out_path)
-    root.create_array("predictions", daily_runoff)
-    root.create_array("observations", daily_obs.astype(np.float32))
-    root.attrs.update(
-        {
-            "description": "Predictions and obs for time period",
-            "start_time": cfg.experiment.start_time,
-            "end_time": cfg.experiment.end_time,
-            "version": os.environ.get("DDR_VERSION", "dev"),
-            "gage_ids": gage_ids,
-            "time": [str(t) for t in time_range],
-            "units": "m3/s",
-            "evaluation_basins_file": str(cfg.data_sources.gages),
-            "model": str(cfg.experiment.checkpoint or "No Trained Model"),
-        }
-    )
+    if is_primary_process():
+        root = zarrlite.create_group(out_path)
+        root.create_array("predictions", daily_runoff)
+        root.create_array("observations", daily_obs.astype(np.float32))
+        root.attrs.update(
+            {
+                "description": "Predictions and obs for time period",
+                "start_time": cfg.experiment.start_time,
+                "end_time": cfg.experiment.end_time,
+                "version": os.environ.get("DDR_VERSION", "dev"),
+                "gage_ids": gage_ids,
+                "time": [str(t) for t in time_range],
+                "units": "m3/s",
+                "evaluation_basins_file": str(cfg.data_sources.gages),
+                "model": str(cfg.experiment.checkpoint or "No Trained Model"),
+            }
+        )
     warmup = cfg.experiment.warmup
     metrics = Metrics(pred=daily_runoff[:, warmup:], target=daily_obs[:, warmup:])
     log_metrics(metrics, header="Test evaluation")
@@ -72,19 +75,20 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
     # Evaluation figures straight from the run (the reference defers these to a
     # notebook, /root/reference/scripts/test.py:114): metric CDF + distribution
     # boxes per gauge battery, saved next to the result store.
-    try:
-        from ddr_tpu.validation.plots import plot_box_fig, plot_cdf
+    if is_primary_process():
+        try:
+            from ddr_tpu.validation.plots import plot_box_fig, plot_cdf
 
-        plot_dir = Path(cfg.params.save_path) / "plots"
-        plot_cdf({cfg.name: metrics.nse}, plot_dir / "test_nse_cdf.png")
-        plot_box_fig(
-            [metrics.nse, metrics.kge, metrics.corr],
-            ["NSE", "KGE", "r"],
-            plot_dir / "test_metric_boxes.png",
-            title=f"{cfg.name} test metrics ({metrics.ngrid} gauges)",
-        )
-    except Exception as e:  # plotting must never fail the evaluation
-        log.warning(f"evaluation plots failed: {e}")
+            plot_dir = Path(cfg.params.save_path) / "plots"
+            plot_cdf({cfg.name: metrics.nse}, plot_dir / "test_nse_cdf.png")
+            plot_box_fig(
+                [metrics.nse, metrics.kge, metrics.corr],
+                ["NSE", "KGE", "r"],
+                plot_dir / "test_metric_boxes.png",
+                title=f"{cfg.name} test metrics ({metrics.ngrid} gauges)",
+            )
+        except Exception as e:  # plotting must never fail the evaluation
+            log.warning(f"evaluation plots failed: {e}")
 
     log.info(f"Test run complete; results in {out_path}")
     return metrics

@@ -131,7 +131,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     # interleave them differently across processes (distributed deadlock).
     # Every process sees identical batches (same seeded loader), so the loop
     # stays in lockstep.
-    is_primary = jax.process_index() == 0
+    from ddr_tpu.scripts.common import is_primary_process
+
+    is_primary = is_primary_process()
     multiprocess = jax.process_count() > 1
     if multiprocess and par is None:
         # P independent single-device loops all writing one save dir is never
