@@ -309,7 +309,70 @@ def _record_float(out: dict, key: str, code: str, timeout: float, cpu_only: bool
         out[metric_key] = metric
 
 
-def main() -> None:
+_USAGE = """\
+usage: bench.py [-h | --help]
+
+Benchmark reach-timesteps/sec/chip for the Muskingum-Cunge routing forward
+pass. Prints ONE JSON line and always exits 0. Configure via env vars:
+DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_DEEP_N / DDR_BENCH_DEEP_DEPTH
+(deep-topology phase; 0 disables), DDR_BENCH_PROBE_TIMEOUT / DDR_BENCH_TIMEOUT
+(seconds). Set DDR_METRICS_DIR to also emit the timings as observability JSONL
+events (run_log.bench.jsonl, same schema as training — docs/observability.md).
+"""
+
+
+def _open_bench_recorder():
+    """Observability JSONL sink when DDR_METRICS_DIR is set (None otherwise).
+
+    Explicit host=0: the observability package is jax-free, and this parent
+    process must never import jax (a wedged tunnel would hang it)."""
+    events_dir = os.environ.get("DDR_METRICS_DIR")
+    if not events_dir:
+        return None
+    try:
+        from ddr_tpu.observability import Recorder
+
+        return Recorder.open_run(events_dir, cmd="bench", host=0, n_hosts=1)
+    except Exception as e:  # telemetry must never break the benchmark record
+        print(f"bench: telemetry disabled ({e})", file=sys.stderr)
+        return None
+
+
+def _emit_bench_events(rec, out: dict) -> None:
+    """Forward the recorded rates as ``step`` events (same schema as training:
+    one event per measured phase, reach_timesteps_per_sec carries the rate)."""
+    if rec is None:
+        return
+    phases = {
+        "value": "route",
+        "grad_value": "grad",
+        "deep_value": "deep-route",
+        "deep_grad_value": "deep-grad",
+        "train_value": "train-step",
+        "baseline_value": "reference-cpu",
+    }
+    for key, phase in phases.items():
+        if out.get(key) is not None:
+            rec.emit(
+                "step",
+                phase=phase,
+                reach_timesteps_per_sec=out[key],
+                engine=out.get("device"),
+            )
+    rec.merge_summary(
+        "bench", {k: v for k, v in out.items() if not isinstance(v, (dict, list))}
+    )
+    rec.close(status="ok")
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if any(a in ("-h", "--help") for a in argv):
+        print(_USAGE, end="")
+        return
+    rec = _open_bench_recorder()
+    if rec is not None:
+        rec.emit("run_start", cmd="bench", n_hosts=1)
     out: dict = {
         "metric": "reach-timesteps/sec/chip (synthetic network, forward route)",
         "value": None,
@@ -322,6 +385,7 @@ def main() -> None:
     except ValueError as e:
         out["error"] = f"bad DDR_BENCH_PROBE_TIMEOUT/DDR_BENCH_TIMEOUT override: {e}"
         print(json.dumps(out), flush=True)
+        _emit_bench_events(rec, out)
         return
 
     # Phase 1: can an accelerator backend initialize at all?
@@ -345,6 +409,7 @@ def main() -> None:
     except ValueError as e:
         out["error"] = f"bad DDR_BENCH_N/DDR_BENCH_T override: {e}"
         print(json.dumps(out), flush=True)
+        _emit_bench_events(rec, out)
         return
     out["metric"] = (
         f"reach-timesteps/sec/chip (synthetic {n}-reach network, {t_hours}h forward route)"
@@ -488,6 +553,7 @@ def main() -> None:
         out["baseline_error"] = err
 
     print(json.dumps(out), flush=True)
+    _emit_bench_events(rec, out)
 
 
 if __name__ == "__main__":

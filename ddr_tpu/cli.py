@@ -18,6 +18,7 @@ _COMMANDS = {
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",
+    "metrics": "ddr_tpu.observability.metrics_cli",
     "gen-config-docs": "ddr_tpu.scripts.gen_config_docs",
     "sweep": "ddr_tpu.scripts.sweep",
 }

@@ -1,0 +1,50 @@
+"""Run observability: structured JSONL metrics events, span tracing, recompile
+tracking, throughput counters, and the ``ddr metrics`` CLI.
+
+Importable without jax (bench.py's jax-free parent process records through it);
+jax is consulted lazily and only when already loaded. See docs/observability.md
+for the event schema and worked examples.
+"""
+
+from ddr_tpu.observability.events import (
+    EVENT_TYPES,
+    Recorder,
+    activate,
+    deactivate,
+    device_memory_stats,
+    emit_heartbeat,
+    get_recorder,
+    host_layout,
+    metrics_dir_from_env,
+    run_telemetry,
+)
+from ddr_tpu.observability.recompile import CompileTracker
+from ddr_tpu.observability.spans import (
+    profile_dir_from_env,
+    span,
+    spanned,
+    trace,
+    trace_active,
+)
+from ddr_tpu.observability.throughput import MIN_BATCH_SECONDS, Throughput
+
+__all__ = [
+    "EVENT_TYPES",
+    "Recorder",
+    "activate",
+    "deactivate",
+    "get_recorder",
+    "run_telemetry",
+    "metrics_dir_from_env",
+    "device_memory_stats",
+    "emit_heartbeat",
+    "host_layout",
+    "CompileTracker",
+    "span",
+    "spanned",
+    "trace",
+    "trace_active",
+    "profile_dir_from_env",
+    "Throughput",
+    "MIN_BATCH_SECONDS",
+]

@@ -1,0 +1,352 @@
+"""Structured run telemetry: JSONL metrics events.
+
+The reference DDR observes runs through wall-clock brackets and tqdm labels;
+our port until now added only the ``Throughput`` counter and an opt-in profiler
+trace. This module is the structured replacement: a process-local
+:class:`Recorder` that appends one JSON object per line to a run log, so every
+later perf PR reports through one machine-readable format (``ddr metrics``
+summarizes it; ``bench.py`` emits the same schema).
+
+Event envelope (shared by every event type)::
+
+    {"event": <type>, "t": <seconds since recorder start, monotonic>,
+     "wall": <unix seconds>, "host": <process index>, "pid": <os pid>,
+     "seq": <per-recorder counter>, ...payload}
+
+Event types (:data:`EVENT_TYPES`): ``run_start``, ``step``, ``eval``,
+``compile``, ``heartbeat``, ``span``, ``run_end``.
+
+Multi-process discipline: the run's main log (``run_log.<cmd>.jsonl``) is
+written by the primary process only (:func:`ddr_tpu.scripts.common.is_primary_process`);
+every other host writes a ``run_log.<cmd>.host<K>.jsonl`` sidecar next to it, so
+straggler diagnosis (heartbeats) works per host without write races. Each event
+is a single ``write()`` of one ``\\n``-terminated line on an append-positioned
+handle — atomic at the POSIX level for the line sizes involved.
+
+This module must stay importable WITHOUT jax (``bench.py``'s parent process
+never imports jax by design): jax is only consulted when it is already in
+``sys.modules``, and heavy ddr_tpu modules are imported lazily.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any, Iterator
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "EVENT_TYPES",
+    "Recorder",
+    "get_recorder",
+    "activate",
+    "deactivate",
+    "run_telemetry",
+    "metrics_dir_from_env",
+    "device_memory_stats",
+    "emit_heartbeat",
+    "host_layout",
+]
+
+#: The closed vocabulary of event types (docs/observability.md has one schema
+#: table per type). ``Recorder.emit`` warns on — but still writes — anything
+#: else, so ad-hoc experiments don't lose data while the schema catches drift.
+EVENT_TYPES = ("run_start", "step", "eval", "compile", "heartbeat", "span", "run_end")
+
+
+def metrics_dir_from_env() -> str | None:
+    """``DDR_METRICS_DIR`` env var -> run-log directory override (None = use the
+    run's ``save_path``)."""
+    return os.environ.get("DDR_METRICS_DIR") or None
+
+
+def host_layout() -> tuple[int, int]:
+    """``(process_index, process_count)`` without forcing a jax import/init.
+
+    Single-process (or jax never imported): ``(0, 1)``. Used by every default
+    path; callers that must not touch jax (bench.py's parent) pass explicit
+    ``host``/``n_hosts`` instead.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0, 1
+    try:
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # backend not initializable here — act single-process
+        return 0, 1
+
+
+def _json_default(obj: Any):
+    """numpy scalars / Paths / anything else -> JSON-safe."""
+    try:
+        return float(obj)
+    except (TypeError, ValueError):
+        return str(obj)
+
+
+class Recorder:
+    """Process-local JSONL event writer with per-run aggregation.
+
+    One instance per run per process. ``emit`` is thread-safe (the training
+    loop's prefetch thread records spans concurrently with the step thread).
+    ``close`` writes the terminal ``run_end`` event carrying the aggregate
+    summary (event counts, span totals, anything merged via
+    :meth:`merge_summary`) so a truncated tail never loses the rollup.
+    """
+
+    def __init__(
+        self,
+        path: str | Path,
+        host: int = 0,
+        n_hosts: int = 1,
+        tags: dict[str, Any] | None = None,
+    ) -> None:
+        self.path = Path(path)
+        self.host = int(host)
+        self.n_hosts = int(n_hosts)
+        self.tags = dict(tags or {})
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._counts: dict[str, int] = {}
+        self._spans: dict[str, list[float]] = {}  # path -> [count, total_seconds]
+        self._extra: dict[str, Any] = {}
+        self._closed = False
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = self.path.open("w", encoding="utf-8")
+
+    # ---- construction ----
+
+    @classmethod
+    def open_run(
+        cls,
+        base_dir: str | Path,
+        cmd: str = "run",
+        tags: dict[str, Any] | None = None,
+        host: int | None = None,
+        n_hosts: int | None = None,
+    ) -> "Recorder":
+        """Open the run log for ``cmd`` under ``base_dir``.
+
+        The primary process owns ``run_log.<cmd>.jsonl``; every other host gets
+        the ``run_log.<cmd>.host<K>.jsonl`` sidecar. ``host=None`` resolves the
+        layout from the live jax process grid (via
+        ``scripts.common.is_primary_process`` when available) — pass explicit
+        values from jax-free callers.
+        """
+        if host is None or n_hosts is None:
+            h, n = host_layout()
+            host = h if host is None else host
+            n_hosts = n if n_hosts is None else n_hosts
+            try:  # the one shared primary-process predicate (scripts/common.py)
+                from ddr_tpu.scripts.common import is_primary_process
+
+                if is_primary_process():
+                    host = 0
+            except Exception:
+                pass
+        name = (
+            f"run_log.{cmd}.jsonl" if host == 0 else f"run_log.{cmd}.host{host}.jsonl"
+        )
+        return cls(Path(base_dir) / name, host=host, n_hosts=n_hosts, tags=tags)
+
+    # ---- event emission ----
+
+    def emit(self, event: str, **payload: Any) -> None:
+        """Append one event line (atomic single write + flush)."""
+        if event not in EVENT_TYPES:
+            log.warning(f"unknown telemetry event type {event!r} (writing anyway)")
+        with self._lock:
+            if self._closed:
+                return
+            rec: dict[str, Any] = {
+                "event": event,
+                "t": round(time.perf_counter() - self._t0, 6),
+                "wall": round(time.time(), 3),
+                "host": self.host,
+                "pid": os.getpid(),
+                "seq": self._seq,
+            }
+            if self.tags:
+                rec["tags"] = self.tags
+            rec.update(payload)
+            self._seq += 1
+            self._counts[event] = self._counts.get(event, 0) + 1
+            self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+            self._fh.flush()
+
+    def record_span(self, path: str, seconds: float) -> None:
+        """Aggregate one finished span and emit its ``span`` event."""
+        with self._lock:
+            agg = self._spans.setdefault(path, [0, 0.0])
+            agg[0] += 1
+            agg[1] += seconds
+        self.emit("span", name=path, seconds=round(seconds, 6))
+
+    def merge_summary(self, key: str, value: Any) -> None:
+        """Attach an extra rollup (e.g. compile-tracker counts) to ``run_end``."""
+        with self._lock:
+            self._extra[key] = value
+
+    # ---- rollup / lifecycle ----
+
+    def summary(self) -> dict[str, Any]:
+        with self._lock:
+            out: dict[str, Any] = {
+                "events": dict(self._counts),
+                "spans": {
+                    k: {"count": int(c), "seconds": round(s, 6)}
+                    for k, (c, s) in sorted(self._spans.items())
+                },
+            }
+            out.update(self._extra)
+            return out
+
+    def close(self, status: str = "ok") -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self.emit(
+                "run_end",
+                status=status,
+                duration_s=round(time.perf_counter() - self._t0, 3),
+                summary=self.summary(),
+            )
+            self._closed = True
+            self._fh.close()
+
+
+# ---------------------------------------------------------------------------
+# The process-wide active recorder (what span()/CompileTracker/loops emit to).
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Recorder | None = None
+
+
+def get_recorder() -> Recorder | None:
+    """The active recorder, or None when telemetry is off (all emit sites are
+    None-guarded, so instrumented code paths cost ~nothing without a run log)."""
+    return _ACTIVE
+
+
+def activate(rec: Recorder) -> None:
+    global _ACTIVE
+    if _ACTIVE is not None and _ACTIVE is not rec:
+        log.warning(f"replacing active telemetry recorder {_ACTIVE.path}")
+    _ACTIVE = rec
+
+
+def deactivate(rec: Recorder | None = None) -> None:
+    """Clear the active recorder (no-op if ``rec`` is given and isn't active)."""
+    global _ACTIVE
+    if rec is None or _ACTIVE is rec:
+        _ACTIVE = None
+
+
+@contextmanager
+def run_telemetry(
+    cfg: Any = None,
+    cmd: str = "run",
+    base_dir: str | Path | None = None,
+    tags: dict[str, Any] | None = None,
+    **run_info: Any,
+) -> Iterator[Recorder | None]:
+    """Open + activate the run log for a CLI command; emit ``run_start`` /
+    ``run_end`` around the body.
+
+    The log directory is ``DDR_METRICS_DIR`` if set, else the run's
+    ``cfg.params.save_path``; with neither, telemetry is off and the body runs
+    with a None recorder. Exception-safe: ``run_end.status`` records ``ok``,
+    ``interrupted`` (KeyboardInterrupt), or ``error:<Type>``, and the recorder
+    is always deactivated and closed.
+    """
+    base = base_dir or metrics_dir_from_env()
+    if base is None and cfg is not None:
+        base = getattr(getattr(cfg, "params", None), "save_path", None)
+    if base is None:
+        yield None
+        return
+    rec = Recorder.open_run(base, cmd=cmd, tags=tags)
+    activate(rec)
+    info = _cfg_summary(cfg)
+    info.update(run_info)
+    rec.emit("run_start", cmd=cmd, n_hosts=rec.n_hosts, **info)
+    status = "ok"
+    try:
+        yield rec
+    except BaseException as e:
+        status = (
+            "interrupted" if isinstance(e, KeyboardInterrupt) else f"error:{type(e).__name__}"
+        )
+        raise
+    finally:
+        deactivate(rec)
+        rec.close(status=status)
+
+
+def _cfg_summary(cfg: Any) -> dict[str, Any]:
+    """The run-identifying slice of a Config for ``run_start`` (best-effort:
+    any missing attribute is simply omitted)."""
+    if cfg is None:
+        return {}
+    out: dict[str, Any] = {}
+    for attr in ("name", "mode", "device"):
+        v = getattr(cfg, attr, None)
+        if v is not None:
+            out[attr] = str(getattr(v, "value", v))  # enums render by value
+    exp = getattr(cfg, "experiment", None)
+    for attr in ("parallel", "epochs", "batch_size", "warmup"):
+        v = getattr(exp, attr, None)
+        if v is not None:
+            out[attr] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats: per-host liveness + device memory, for straggler diagnosis.
+# ---------------------------------------------------------------------------
+
+
+def device_memory_stats(max_devices: int = 8) -> list[dict[str, Any]]:
+    """Per-local-device memory stats where the backend reports them (TPU);
+    id/platform-only entries otherwise (CPU). Empty when jax was never
+    imported. Capped at ``max_devices`` entries to bound event size."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices[:max_devices]:
+        entry: dict[str, Any] = {
+            "id": int(getattr(d, "id", -1)),
+            "platform": str(getattr(d, "platform", "?")),
+        }
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                entry[k] = int(stats[k])
+        out.append(entry)
+    return out
+
+
+def emit_heartbeat(rec: Recorder | None = None, **payload: Any) -> None:
+    """Emit one ``heartbeat`` event (step index + device memory) to ``rec`` or
+    the active recorder; silent no-op with neither."""
+    rec = rec if rec is not None else get_recorder()
+    if rec is None:
+        return
+    rec.emit("heartbeat", devices=device_memory_stats(), **payload)

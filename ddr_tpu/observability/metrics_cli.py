@@ -1,0 +1,239 @@
+"""``ddr metrics`` — summarize / tail a run's telemetry JSONL.
+
+Reads the event stream written by :mod:`ddr_tpu.observability.events`
+(``run_log.<cmd>.jsonl`` plus any per-host sidecars) and renders it for humans:
+
+- ``summarize <log-or-dir>``: run header, steps/sec, reach-timesteps/sec,
+  compile counts per engine, a sampled loss curve, per-span time breakdown,
+  per-host heartbeat liveness;
+- ``tail <log-or-dir> [-n N]``: the last N events, one compact line each.
+
+Pointing either command at a directory merges every ``*.jsonl`` inside (the
+multi-host case). Corrupt lines are skipped and counted, never fatal — a run
+killed mid-write must still summarize.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any
+
+__all__ = ["main", "load_events", "summarize", "tail"]
+
+#: Envelope keys hidden from per-event payload rendering.
+_ENVELOPE = ("event", "t", "wall", "host", "pid", "seq", "tags")
+
+
+def load_events(path: str | Path) -> tuple[list[dict], int]:
+    """``(events, n_corrupt_lines)`` from one JSONL file or a directory of them.
+
+    Multi-file reads merge on wall-clock (then sequence) order; single files
+    keep their native order.
+    """
+    p = Path(path)
+    files = sorted(p.glob("*.jsonl")) if p.is_dir() else [p]
+    if not files:
+        raise FileNotFoundError(f"no .jsonl run logs under {p}")
+    events: list[dict] = []
+    bad = 0
+    for f in files:
+        with f.open("r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(ev, dict):
+                    events.append(ev)
+                else:
+                    bad += 1
+    if len(files) > 1:
+        events.sort(key=lambda e: (e.get("wall", 0.0), e.get("host", 0), e.get("seq", 0)))
+    return events, bad
+
+
+def _table(rows: list[list[str]], header: list[str], indent: str = "  ") -> str:
+    """Plain fixed-width text table (no deps)."""
+    cols = [header, *rows]
+    widths = [max(len(str(r[i])) for r in cols) for i in range(len(header))]
+    lines = []
+    for r in cols:
+        lines.append(indent + "  ".join(str(v).ljust(w) for v, w in zip(r, widths)).rstrip())
+    return "\n".join(lines)
+
+
+def _sample(values: list[float], k: int = 16) -> list[float]:
+    """Evenly-spaced ≤k-point sample preserving first and last."""
+    if len(values) <= k:
+        return values
+    idx = [round(i * (len(values) - 1) / (k - 1)) for i in range(k)]
+    return [values[i] for i in idx]
+
+
+def _fmt(v: float) -> str:
+    if v == 0:
+        return "0"
+    if abs(v) >= 1e5 or abs(v) < 1e-3:
+        return f"{v:.3g}"
+    return f"{v:,.4g}"
+
+
+def summarize(events: list[dict], bad: int = 0, out=None) -> int:
+    out = out or sys.stdout
+    w = out.write
+    if not events:
+        w("no events found\n")
+        return 1
+    by_type: dict[str, list[dict]] = {}
+    for e in events:
+        by_type.setdefault(str(e.get("event")), []).append(e)
+    start = by_type.get("run_start", [{}])[0]
+    ends = by_type.get("run_end", [])
+    end = ends[-1] if ends else {}
+
+    ident = " ".join(
+        f"{k}={start[k]}" for k in ("name", "cmd", "mode", "device", "parallel") if k in start
+    )
+    w(f"run      : {ident or '(no run_start event)'}\n")
+    hosts = sorted({int(e.get("host", 0)) for e in events})
+    status = end.get("status", "(no run_end — crashed or still running)")
+    w(f"status   : {status}   hosts: {len(hosts)} {hosts}\n")
+    dur = end.get("duration_s")
+    if dur is None and events:
+        dur = max(float(e.get("t", 0.0)) for e in events)
+    w(f"duration : {float(dur):.3f} s\n")
+    counts = ", ".join(f"{k} {len(v)}" for k, v in sorted(by_type.items()))
+    w(f"events   : {len(events)} total — {counts}")
+    w(f" ({bad} corrupt lines skipped)\n" if bad else "\n")
+
+    steps = by_type.get("step", [])
+    if steps:
+        rates = [float(e["reach_timesteps_per_sec"]) for e in steps if "reach_timesteps_per_sec" in e]
+        secs = sum(float(e.get("seconds", 0.0)) for e in steps)
+        line = f"steps    : {len(steps)}"
+        if secs > 0:  # bench-phase step events carry rates but no durations
+            line += f"   {len(steps) / secs:.3g} steps/s"
+        if rates:
+            line += f"   mean {_fmt(sum(rates) / len(rates))} reach-timesteps/s"
+        engines = sorted({str(e.get("engine")) for e in steps if e.get("engine")})
+        if engines:
+            line += f"   engine={','.join(engines)}"
+        w(line + "\n")
+        losses = [float(e["loss"]) for e in steps if e.get("loss") is not None]
+        if losses:
+            pts = " ".join(_fmt(v) for v in _sample(losses))
+            w(f"loss     : first {_fmt(losses[0])} -> last {_fmt(losses[-1])} (min {_fmt(min(losses))})\n")
+            w(f"loss curve: {pts}\n")
+
+    evals = by_type.get("eval", [])
+    if evals:
+        rates = [float(e["reach_timesteps_per_sec"]) for e in evals if "reach_timesteps_per_sec" in e]
+        mean = f"   mean {_fmt(sum(rates) / len(rates))} reach-timesteps/s" if rates else ""
+        w(f"evals    : {len(evals)}{mean}\n")
+
+    compiles = by_type.get("compile", [])
+    if compiles:
+        per_engine: dict[str, dict[str, float]] = {}
+        for e in compiles:
+            eng = per_engine.setdefault(str(e.get("engine", "?")), {"misses": 0, "build_s": 0.0})
+            eng["misses"] += 1
+            eng["build_s"] += float(e.get("build_seconds") or 0.0)
+        # the trailing hit counters on the last compile event per engine are the
+        # richest in-log source; run_end's summary (if present) wins over them
+        summary_compile = (end.get("summary") or {}).get("compile", {})
+        rows = []
+        for eng, agg in sorted(per_engine.items()):
+            hits = summary_compile.get(eng, {}).get("hits")
+            if hits is None:
+                last = [e for e in compiles if str(e.get("engine", "?")) == eng][-1]
+                hits = last.get("hits", "?")
+            rows.append([eng, str(int(agg["misses"])), str(hits), f"{agg['build_s']:.3f}"])
+        w(f"compiles : {len(compiles)} miss events\n")
+        w(_table(rows, ["engine", "misses", "hits", "build_s"]) + "\n")
+
+    beats = by_type.get("heartbeat", [])
+    if beats:
+        per_host: dict[int, dict[str, Any]] = {}
+        for e in beats:
+            h = per_host.setdefault(int(e.get("host", 0)), {"n": 0, "last_t": 0.0, "last_step": "?"})
+            h["n"] += 1
+            h["last_t"] = max(h["last_t"], float(e.get("t", 0.0)))
+            if e.get("step") is not None:
+                h["last_step"] = e["step"]
+        rows = [
+            [f"host{h}", str(v["n"]), str(v["last_step"]), f"{v['last_t']:.1f}s"]
+            for h, v in sorted(per_host.items())
+        ]
+        w("heartbeats:\n" + _table(rows, ["host", "count", "last step", "last seen"]) + "\n")
+
+    spans = by_type.get("span", [])
+    span_agg: dict[str, list[float]] = {}
+    for e in spans:
+        agg = span_agg.setdefault(str(e.get("name", "?")), [0, 0.0])
+        agg[0] += 1
+        agg[1] += float(e.get("seconds", 0.0))
+    if span_agg:
+        rows = [
+            [name, str(int(c)), f"{s:.4f}", f"{1e3 * s / c:.2f}"]
+            for name, (c, s) in sorted(span_agg.items(), key=lambda kv: -kv[1][1])
+        ]
+        w("spans (by total time):\n" + _table(rows, ["span", "count", "total_s", "mean_ms"]) + "\n")
+    return 0
+
+
+def tail(events: list[dict], n: int = 20, out=None) -> int:
+    out = out or sys.stdout
+    if not events:
+        out.write("no events found\n")
+        return 1
+    for e in events[-n:]:
+        payload = " ".join(
+            f"{k}={json.dumps(v, default=str) if isinstance(v, (dict, list)) else v}"
+            for k, v in e.items()
+            if k not in _ENVELOPE
+        )
+        out.write(
+            f"[{float(e.get('t', 0.0)):10.3f}s] host{e.get('host', 0)} "
+            f"{e.get('event', '?'):<10} {payload}\n".rstrip() + "\n"
+        )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ddr metrics",
+        description="Summarize or tail a ddr run-telemetry JSONL log "
+        "(run_log.*.jsonl written under the run's save_path / DDR_METRICS_DIR).",
+    )
+    sub = parser.add_subparsers(dest="command")
+    p_sum = sub.add_parser("summarize", help="aggregate a run log into a table")
+    p_sum.add_argument("log", help="run_log .jsonl file, or a directory of them")
+    p_tail = sub.add_parser("tail", help="print the last N events")
+    p_tail.add_argument("log", help="run_log .jsonl file, or a directory of them")
+    p_tail.add_argument("-n", type=int, default=20, help="events to show (default 20)")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
+        return int(e.code or 0)
+    if not args.command:
+        parser.print_help()
+        return 2
+    try:
+        events, bad = load_events(args.log)
+    except (FileNotFoundError, OSError) as e:
+        print(f"ddr metrics: {e}", file=sys.stderr)
+        return 1
+    if args.command == "summarize":
+        return summarize(events, bad)
+    return tail(events, n=args.n)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,122 @@
+"""Recompile / step-cache tracking: make every jit-cache miss auditable.
+
+The multi-chip trainer (:mod:`ddr_tpu.parallel.train`) keeps built sharded
+steps in a per-topology LRU, and the gspmd/single-device paths lean on the jit
+compile cache — a silent miss in either re-pays seconds-to-minutes of XLA
+compile per batch with no visible symptom beyond a BENCH regression. The
+:class:`CompileTracker` counts hits/misses per engine and emits a ``compile``
+JSONL event (batch-topology hash, build seconds, cache occupancy) on every
+miss, so "why was epoch 2 slow" is answerable from the run log.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable
+
+from ddr_tpu.observability.events import get_recorder
+
+log = logging.getLogger(__name__)
+
+__all__ = ["CompileTracker"]
+
+
+class CompileTracker:
+    """Per-engine hit/miss counters for step caches, with ``compile`` events on
+    misses.
+
+    Two tracking styles, matching the two cache kinds in the stack:
+
+    - explicit caches (the trainer's built-step LRU): call :meth:`hit` /
+      :meth:`miss` from the cache's own lookup;
+    - jit compile caches (gspmd / single-device steps): call :meth:`track_jit`
+      after each step — it polls the jitted callable's ``_cache_size()`` and
+      converts growth into a miss.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.engines: dict[str, dict[str, Any]] = {}
+        self._jit_sizes: dict[str, int] = {}
+
+    def _eng(self, engine: str) -> dict[str, Any]:
+        return self.engines.setdefault(
+            engine, {"hits": 0, "misses": 0, "build_seconds": 0.0}
+        )
+
+    def hit(self, engine: str, key: str | None = None) -> None:
+        with self._lock:
+            self._eng(engine)["hits"] += 1
+
+    def miss(
+        self,
+        engine: str,
+        key: str | None = None,
+        seconds: float = 0.0,
+        cache_entries: int | None = None,
+        **tags: Any,
+    ) -> None:
+        """Count a miss and emit its ``compile`` event (``key`` is the batch
+        topology hash, so auto-engine decisions and recompile storms are
+        auditable per topology)."""
+        with self._lock:
+            eng = self._eng(engine)
+            eng["misses"] += 1
+            eng["build_seconds"] += float(seconds)
+            hits, misses = eng["hits"], eng["misses"]
+        rec = get_recorder()
+        if rec is not None:
+            rec.emit(
+                "compile",
+                engine=engine,
+                key=key,
+                build_seconds=round(float(seconds), 4),
+                cache_entries=cache_entries,
+                hits=hits,
+                misses=misses,
+                **tags,
+            )
+
+    def track_jit(
+        self, engine: str, fn: Callable, key: str | None = None, **tags: Any
+    ) -> None:
+        """Poll a jitted callable's compile-cache size; growth counts (and
+        emits) a miss, a steady size counts a hit. Silently does nothing when
+        the jax version doesn't expose ``_cache_size``."""
+        try:
+            size = int(fn._cache_size())
+        except Exception:
+            return
+        with self._lock:
+            prev = self._jit_sizes.get(engine)
+            self._jit_sizes[engine] = size
+        if prev is None or size > prev:
+            self.miss(engine, key=key, cache_entries=size, source="jit-cache", **tags)
+        else:
+            self.hit(engine, key=key)
+
+    # ---- inspection ----
+
+    def counts(self, engine: str | None = None) -> tuple[int, int]:
+        """``(hits, misses)`` for one engine, or totals across all."""
+        with self._lock:
+            if engine is not None:
+                eng = self.engines.get(engine, {})
+                return int(eng.get("hits", 0)), int(eng.get("misses", 0))
+            return (
+                sum(e["hits"] for e in self.engines.values()),
+                sum(e["misses"] for e in self.engines.values()),
+            )
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        """Copy of the per-engine counters (for ``run_end`` summaries)."""
+        with self._lock:
+            return {
+                k: {
+                    "hits": v["hits"],
+                    "misses": v["misses"],
+                    "build_seconds": round(v["build_seconds"], 4),
+                }
+                for k, v in sorted(self.engines.items())
+            }

@@ -1,0 +1,142 @@
+"""Span tracing: nested Python-level timings that mirror into the XLA profiler.
+
+``span(name)`` is the one annotation primitive for hot paths:
+
+- it times the enclosed Python region (at jit-trace time that means "once per
+  compile" — exactly the costs a recompile hunt needs to see) and records the
+  nested ``parent/child`` path to the active :class:`~ddr_tpu.observability.events.Recorder`;
+- when jax is loaded it opens a matching ``jax.named_scope`` so the ops traced
+  inside carry the span name in HLO / profiler timelines;
+- when a profiler trace is ACTIVE (:func:`trace`), it additionally opens a
+  ``jax.profiler.TraceAnnotation`` so the region shows on the xprof timeline.
+
+``trace(log_dir)`` is the run-level ``jax.profiler`` context (activated by an
+explicit dir or ``DDR_PROFILE_DIR``; no-op otherwise). It is exception-safe and
+RE-ENTRANT: a nested ``trace()`` call never double-starts the profiler — the
+outermost active call owns start/stop (regression-pinned in
+tests/observability/test_spans.py).
+
+Importable without jax (bench.py's parent): jax is consulted only when already
+in ``sys.modules``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+import threading
+import time
+from contextlib import ExitStack, contextmanager
+from typing import Callable, Iterator
+
+log = logging.getLogger(__name__)
+
+__all__ = ["span", "spanned", "trace", "trace_active", "profile_dir_from_env"]
+
+_tls = threading.local()
+
+# Profiler trace state: depth counts every live trace() frame (so nesting is
+# observable), dir is set only while the profiler is actually started.
+_TRACE = {"depth": 0, "dir": None}
+
+
+def profile_dir_from_env() -> str | None:
+    """``DDR_PROFILE_DIR`` env var -> profiler log dir (None = profiling off)."""
+    return os.environ.get("DDR_PROFILE_DIR") or None
+
+
+def trace_active() -> bool:
+    """True while some :func:`trace` context has the profiler running."""
+    return _TRACE["depth"] > 0
+
+
+@contextmanager
+def trace(log_dir: str | None = None) -> Iterator[None]:
+    """``jax.profiler.trace`` context when a log dir is given (argument or
+    ``DDR_PROFILE_DIR``); transparent no-op otherwise.
+
+    Re-entrant: if a trace is already running, nested calls (with or without a
+    dir) only bump the depth counter — the profiler is started and stopped
+    exactly once, by the outermost activating call, even when the body raises.
+    """
+    if _TRACE["depth"] > 0:
+        _TRACE["depth"] += 1
+        try:
+            yield
+        finally:
+            _TRACE["depth"] -= 1
+        return
+    log_dir = log_dir or profile_dir_from_env()
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    log.info(f"Writing XLA profiler trace to {log_dir}")
+    _TRACE["depth"], _TRACE["dir"] = 1, str(log_dir)
+    try:
+        with jax.profiler.trace(str(log_dir)):
+            yield
+    finally:
+        _TRACE["depth"], _TRACE["dir"] = 0, None
+
+
+def _stack() -> list[str]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+@contextmanager
+def span(name: str, emit: bool = True) -> Iterator[None]:
+    """Time a named region; nest freely (the recorded path is ``outer/inner``).
+
+    Exception-safe: the nesting stack unwinds and the timing is recorded on
+    every exit path. Emission goes to the active recorder only (``emit=False``
+    keeps the profiler annotations but skips the JSONL event).
+    """
+    stack = _stack()
+    path = "/".join((*stack, name))
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        with ExitStack() as ctx:
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                try:
+                    ctx.enter_context(jax.named_scope(name))
+                except Exception:  # never let annotation plumbing break the op
+                    pass
+                if trace_active():
+                    try:
+                        ctx.enter_context(jax.profiler.TraceAnnotation(name))
+                    except Exception:
+                        pass
+            yield
+    finally:
+        stack.pop()
+        dt = time.perf_counter() - t0
+        if emit:
+            from ddr_tpu.observability.events import get_recorder
+
+            rec = get_recorder()
+            if rec is not None:
+                rec.record_span(path, dt)
+
+
+def spanned(name: str) -> Callable:
+    """Decorator form of :func:`span` for whole-function hot paths
+    (``@spanned("wavefront-core")``)."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

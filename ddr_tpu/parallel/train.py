@@ -34,11 +34,13 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import logging
+import time
 from typing import Any, Callable
 
 import numpy as np
 
 from ddr_tpu.geodatazoo.dataclasses import RoutingData
+from ddr_tpu.observability import CompileTracker, span
 
 log = logging.getLogger(__name__)
 
@@ -186,6 +188,9 @@ class PreparedBatch:
     gauges: Any = None
     # explicit-engine payload (None for gspmd)
     step_fn: Callable | None = None
+    # batch-topology hash (the step-cache key) — carried so compile events can
+    # name the topology that triggered a jit-cache miss
+    topo_key: str | None = None
 
 
 class ParallelTrainer:
@@ -225,6 +230,9 @@ class ParallelTrainer:
 
         self._step_cache: OrderedDict[str, Callable] = OrderedDict()
         self._step_cache_max = 32
+        # Per-engine LRU/jit hit-miss counters; misses emit `compile` JSONL
+        # events through the active telemetry recorder (docs/observability.md).
+        self.compile_tracker = CompileTracker()
         self._builder_kw = dict(
             parameter_ranges=cfg.params.parameter_ranges,
             log_space_parameters=cfg.params.log_space_parameters,
@@ -256,16 +264,26 @@ class ParallelTrainer:
             )
         return self._gspmd_step_cached
 
-    def _cached_step(self, key: str, build: Callable[[], Callable]) -> Callable:
-        """LRU lookup/insert for built sharded steps."""
+    def _cached_step(self, key: str, build: Callable[[], Callable], engine: str) -> Callable:
+        """LRU lookup/insert for built sharded steps, hit/miss-tracked per
+        engine (a miss emits a ``compile`` event keyed by the topology hash)."""
         step = self._step_cache.get(key)
         if step is not None:
             self._step_cache.move_to_end(key)
+            self.compile_tracker.hit(engine, key)
             return step
+        t0 = time.perf_counter()
         step = build()
         self._step_cache[key] = step
         if len(self._step_cache) > self._step_cache_max:
             self._step_cache.popitem(last=False)
+        self.compile_tracker.miss(
+            engine,
+            key,
+            seconds=time.perf_counter() - t0,
+            cache_entries=len(self._step_cache),
+            **({"via": "auto"} if self.mode == "auto" else {}),
+        )
         return step
 
     # ---- host-side batch preparation (prefetch-thread safe) ----
@@ -276,6 +294,10 @@ class ParallelTrainer:
         ``q_prime`` is the already-flow-scaled (T, N) lateral inflow in the
         batch's original reach order.
         """
+        with span("prepare"):
+            return self._prepare(rd, q_prime)
+
+    def _prepare(self, rd: RoutingData, q_prime: np.ndarray) -> PreparedBatch:
         import jax
         import jax.numpy as jnp
 
@@ -331,13 +353,15 @@ class ParallelTrainer:
                     **self._builder_kw,
                 )
 
-            step = self._cached_step(_batch_key(rd), _build_stacked)
+            key = _batch_key(rd)
+            step = self._cached_step(key, _build_stacked, engine=mode)
             return PreparedBatch(
                 mode=mode,
                 attrs=jnp.asarray(rd.normalized_spatial_attributes),
                 q_prime=jnp.asarray(q_prime),
                 n_timesteps=T,
                 step_fn=step,
+                topo_key=key,
             )
 
         # Both remaining modes share the pad -> zero-pad q' -> partition ->
@@ -375,13 +399,15 @@ class ParallelTrainer:
                     **self._builder_kw,
                 )
 
-            step = self._cached_step(_batch_key(rd_p), _build_wavefront)
+            key = _batch_key(rd_p)
+            step = self._cached_step(key, _build_wavefront, engine=mode)
             return PreparedBatch(
                 mode=mode,
                 attrs=jnp.asarray(rd_p.normalized_spatial_attributes),
                 q_prime=jnp.asarray(q_prime),
                 n_timesteps=T,
                 step_fn=step,
+                topo_key=key,
             )
 
         # gspmd — NamedSharding device_put requires the reach axis divisible by
@@ -390,8 +416,15 @@ class ParallelTrainer:
         # chunked=False: shard_network needs the plain RiverNetwork (GSPMD rides
         # the rectangle scan schedule; the fused tables would all-gather).
         network, channels, gauges = prepare_batch(rd_p, self.slope_min, chunked=False)
+        # The topology hash names this batch in `compile` events when the one
+        # shared gspmd jit cache grows; rd_p is rebuilt per batch, so the O(E)
+        # hash is only worth paying while a run log is active.
+        from ddr_tpu.observability import get_recorder
+        from ddr_tpu.parallel.partition import topology_sha
+
         return PreparedBatch(
             mode=mode,
+            topo_key=topology_sha(rd_p) if get_recorder() is not None else None,
             attrs=jax.device_put(
                 jnp.asarray(rd_p.normalized_spatial_attributes),
                 reach_sharding(self.mesh, 0, 2),
@@ -414,9 +447,9 @@ class ParallelTrainer:
 
         obs_daily = jnp.asarray(obs_daily)
         obs_mask = jnp.asarray(obs_mask)
-        with self.mesh:
+        with self.mesh, span(f"step-{prep.mode}"):
             if prep.mode == "gspmd":
-                return self._gspmd_step(
+                out = self._gspmd_step(
                     params,
                     opt_state,
                     prep.network,
@@ -427,6 +460,12 @@ class ParallelTrainer:
                     obs_daily,
                     obs_mask,
                 )
+                # the one shared gspmd jit recompiles per network shape — poll
+                # its compile cache so those misses land in the run log too
+                self.compile_tracker.track_jit(
+                    "gspmd", self._gspmd_step_cached, key=prep.topo_key
+                )
+                return out
             return prep.step_fn(
                 params, opt_state, prep.attrs, prep.q_prime, obs_daily, obs_mask
             )

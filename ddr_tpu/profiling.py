@@ -1,98 +1,11 @@
-"""Profiling and throughput observability.
-
-The reference's tracing story is wall-clock brackets + tqdm labels
-(/root/reference/scripts/train.py:174,196-197, /root/reference/src/ddr/routing/
-mmc.py:415-420) — no profiler, no throughput counters. On TPU the picture that
-matters is different: XLA programs are opaque to Python-level timers, so this module
-provides the two tools SURVEY.md §5 calls for instead:
-
-- :class:`Throughput` — per-batch reach-timesteps/sec counters (the
-  ``reach-timesteps/sec/chip`` north-star metric in BASELINE.json), aggregated over a
-  run. Callers time the *synchronized* step (after ``block_until_ready``/``float()``)
-  so the number covers the whole compiled program, not the dispatch.
-- :func:`trace` — a ``jax.profiler`` trace context (XLA op-level timeline viewable in
-  xprof/tensorboard), activated by passing a log dir or exporting
-  ``DDR_PROFILE_DIR``; a no-op otherwise, so scripts can wrap their hot loops
-  unconditionally.
-"""
+"""Back-compat shim: profiling/throughput observability now lives in
+:mod:`ddr_tpu.observability` (Recorder/JSONL events, span tracing, recompile
+tracking — docs/observability.md). This module keeps the original import
+surface (``Throughput``, ``trace``, ``profile_dir_from_env``) working."""
 
 from __future__ import annotations
 
-import dataclasses
-import logging
-import os
-import time
-from contextlib import contextmanager
-from typing import Iterator
-
-log = logging.getLogger(__name__)
+from ddr_tpu.observability.spans import profile_dir_from_env, trace
+from ddr_tpu.observability.throughput import Throughput
 
 __all__ = ["Throughput", "trace", "profile_dir_from_env"]
-
-
-@dataclasses.dataclass
-class Throughput:
-    """Running reach-timesteps/sec counter.
-
-    One "reach-timestep" is one reach advanced one routing step — the unit that is
-    invariant to batch shape, so throughput is comparable across subgraph sizes,
-    window lengths, and chip counts.
-    """
-
-    label: str = "routing"
-    total_reach_timesteps: float = 0.0
-    total_seconds: float = 0.0
-    batches: int = 0
-    last_rate: float = 0.0
-
-    def record(self, n_reaches: int, n_timesteps: int, seconds: float) -> float:
-        """Record one synchronized batch; returns its reach-timesteps/sec."""
-        work = float(n_reaches) * float(n_timesteps)
-        self.total_reach_timesteps += work
-        self.total_seconds += seconds
-        self.batches += 1
-        self.last_rate = work / seconds if seconds > 0 else float("inf")
-        return self.last_rate
-
-    @contextmanager
-    def batch(self, n_reaches: int, n_timesteps: int) -> Iterator[None]:
-        """Time a batch body. The body must synchronize on its device results
-        (``block_until_ready`` / ``float(loss)``) before exiting."""
-        start = time.perf_counter()
-        yield
-        self.record(n_reaches, n_timesteps, time.perf_counter() - start)
-
-    @property
-    def rate(self) -> float:
-        """Aggregate reach-timesteps/sec over all recorded batches."""
-        return self.total_reach_timesteps / self.total_seconds if self.total_seconds else 0.0
-
-    def format(self) -> str:
-        return (
-            f"{self.label}: {self.rate:,.0f} reach-timesteps/s "
-            f"(last batch {self.last_rate:,.0f}, {self.batches} batches)"
-        )
-
-    def log_summary(self) -> None:
-        if self.batches:
-            log.info(self.format())
-
-
-def profile_dir_from_env() -> str | None:
-    """``DDR_PROFILE_DIR`` env var -> profiler log dir (None = profiling off)."""
-    return os.environ.get("DDR_PROFILE_DIR") or None
-
-
-@contextmanager
-def trace(log_dir: str | None = None) -> Iterator[None]:
-    """``jax.profiler.trace`` context when a log dir is given (argument or
-    ``DDR_PROFILE_DIR``); transparent no-op otherwise."""
-    log_dir = log_dir or profile_dir_from_env()
-    if not log_dir:
-        yield
-        return
-    import jax
-
-    log.info(f"Writing XLA profiler trace to {log_dir}")
-    with jax.profiler.trace(str(log_dir)):
-        yield

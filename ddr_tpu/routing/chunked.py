@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ddr_tpu.observability import spanned
 from ddr_tpu.routing.network import (
     RiverNetwork,
     build_network,
@@ -354,6 +355,7 @@ def build_routing_network(
     return build_network(rows, cols, n, level=level)
 
 
+@spanned("chunked-route")
 def route_chunked(
     network: ChunkedNetwork,
     channels: Any,

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ddr_tpu.observability import spanned
 from ddr_tpu.routing.network import RiverNetwork
 
 __all__ = ["solve_lower_triangular", "solve_transposed", "fused_solve"]
@@ -157,6 +158,7 @@ def _fused_solve_bwd(starts, res, grad_x):
 fused_solve.defvjp(_fused_solve_fwd, _fused_solve_bwd)
 
 
+@spanned("solve")
 def solve_lower_triangular(network: RiverNetwork, c1: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Solve ``(I - diag(c1) N) x = b`` in one wavefront step per schedule row
     (``network.lvl_src.shape[0]`` — the topological depth plus any chunk rows
@@ -179,6 +181,7 @@ def solve_lower_triangular(network: RiverNetwork, c1: jnp.ndarray, b: jnp.ndarra
     return _solve(c1, b, network.lvl_src, network.lvl_tgt, network.edge_src, network.edge_tgt)
 
 
+@spanned("solve-transposed")
 def solve_transposed(network: RiverNetwork, c1: jnp.ndarray, g: jnp.ndarray) -> jnp.ndarray:
     """Transposed solve ``A^T y = g`` (exposed for tests and diagnostics)."""
     if network.fused:

@@ -47,6 +47,7 @@ from ddr_tpu.routing.chunked import (
     boundary_buffer_columns,
     pack_level_bands,
 )
+from ddr_tpu.observability import spanned
 from ddr_tpu.routing.network import compute_levels
 
 __all__ = [
@@ -285,6 +286,7 @@ def _skew_cols(src: jnp.ndarray, starts: jnp.ndarray, width: int) -> jnp.ndarray
     return sl.T
 
 
+@spanned("stacked-route")
 def route_stacked(
     network: StackedChunked,
     channels: Any,

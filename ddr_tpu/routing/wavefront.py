@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ddr_tpu.observability import spanned
 from ddr_tpu.routing.network import RiverNetwork
 
 __all__ = ["wavefront_route_core"]
@@ -99,6 +100,7 @@ def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.nda
     return sl.T
 
 
+@spanned("wavefront-core")
 def wavefront_route_core(
     network: RiverNetwork,
     celerity_fn,

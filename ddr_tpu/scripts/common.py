@@ -137,7 +137,7 @@ def evaluate_hourly(
     import jax.numpy as jnp
 
     from ddr_tpu.geodatazoo.loader import DataLoader
-    from ddr_tpu.profiling import Throughput
+    from ddr_tpu.observability import Throughput, get_recorder, span
     from ddr_tpu.routing.model import dmc
 
     routing_model = routing_model or dmc(cfg)
@@ -147,13 +147,23 @@ def evaluate_hourly(
         (n_gauges, len(dataset.dates.hourly_time_range)), dtype=np.float32
     )
     throughput = Throughput(label="evaluate")
+    rec = get_recorder()
     for i, rd in enumerate(loader):
         q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
-        with throughput.batch(rd.n_segments, q_prime.shape[0]):
+        with throughput.batch(rd.n_segments, q_prime.shape[0]), span("eval-batch"):
             raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
             out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
             chunk = np.asarray(out["runoff"])  # device sync
         predictions[:, rd.dates.hourly_indices] = chunk
+        if rec is not None:
+            rec.emit(
+                "eval",
+                batch=i,
+                n_reaches=int(rd.n_segments),
+                n_timesteps=int(q_prime.shape[0]),
+                seconds=round(throughput.last_seconds, 6),
+                reach_timesteps_per_sec=round(throughput.last_rate, 1),
+            )
     throughput.log_summary()
     return predictions
 

@@ -78,13 +78,28 @@ def route_domain(cfg: Config, dataset=None, params=None) -> np.ndarray:
         else [str(d) for d in np.asarray(rd0.divide_ids)[:n_outputs]]
     )
 
+    from ddr_tpu.observability import get_recorder, span
+
+    rec = get_recorder()
     t0 = time.perf_counter()
     discharge = np.zeros((n_outputs, len(dataset.dates.hourly_time_range)), dtype=np.float32)
     for i, rd in enumerate(loader):
+        t_b = time.perf_counter()
         q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
-        raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
-        out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
-        discharge[:, rd.dates.hourly_indices] = np.asarray(out["runoff"])
+        with span("route-batch"):
+            raw = kan_model.apply(params, jnp.asarray(rd.normalized_spatial_attributes))
+            out = routing_model.forward(rd, q_prime, raw, carry_state=i > 0)
+            discharge[:, rd.dates.hourly_indices] = np.asarray(out["runoff"])  # sync
+        if rec is not None:
+            dt = max(time.perf_counter() - t_b, 1e-6)
+            rec.emit(
+                "eval",
+                batch=i,
+                n_reaches=int(rd.n_segments),
+                n_timesteps=int(q_prime.shape[0]),
+                seconds=round(dt, 6),
+                reach_timesteps_per_sec=round(rd.n_segments * q_prime.shape[0] / dt, 1),
+            )
     runtime = time.perf_counter() - t0
 
     # Routed discharge is replicated across processes under jax.distributed —
@@ -118,12 +133,15 @@ def route_domain(cfg: Config, dataset=None, params=None) -> np.ndarray:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from ddr_tpu.observability import run_telemetry
+
     cfg = parse_cli(argv, mode="routing")
-    with timed("routing"):
-        try:
+    # interrupt caught outside run_telemetry: the run log must say "interrupted"
+    try:
+        with timed("routing"), run_telemetry(cfg, "route"):
             route_domain(cfg)
-        except KeyboardInterrupt:
-            log.info("Keyboard interrupt received")
+    except KeyboardInterrupt:
+        log.info("Keyboard interrupt received")
     return 0
 
 

@@ -95,12 +95,15 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from ddr_tpu.observability import run_telemetry
+
     cfg = parse_cli(argv, mode="testing")
-    with timed("testing"):
-        try:
+    # interrupt caught outside run_telemetry: the run log must say "interrupted"
+    try:
+        with timed("testing"), run_telemetry(cfg, "test"):
             test(cfg)
-        except KeyboardInterrupt:
-            log.info("Keyboard interrupt received")
+    except KeyboardInterrupt:
+        log.info("Keyboard interrupt received")
     return 0
 
 

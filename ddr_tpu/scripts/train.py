@@ -7,6 +7,7 @@ and Adam update are one compiled XLA program per network shape).
 from __future__ import annotations
 
 import logging
+import os
 from pathlib import Path
 
 import jax
@@ -14,7 +15,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ddr_tpu.geodatazoo.loader import DataLoader, prefetch
-from ddr_tpu.profiling import Throughput, trace
+from ddr_tpu.observability import (
+    CompileTracker,
+    Throughput,
+    emit_heartbeat,
+    get_recorder,
+    run_telemetry,
+    span,
+    trace,
+)
 from ddr_tpu.routing.mc import Bounds
 from ddr_tpu.routing.model import prepare_batch
 from ddr_tpu.scripts_utils import resolve_learning_rate
@@ -122,6 +131,21 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
     throughput = Throughput(label="train")
+    # Telemetry (active when main() opened a run log; None-guarded otherwise):
+    # step/compile/heartbeat events per docs/observability.md. The parallel
+    # trainer owns its own tracker (its LRU emits the compile events); the
+    # single-device path polls the one jitted step's compile cache.
+    rec = get_recorder()
+    tracker = par.compile_tracker if par is not None else CompileTracker()
+    try:
+        heartbeat_every = int(os.environ.get("DDR_HEARTBEAT_EVERY", "25") or 0)
+    except ValueError:
+        # a telemetry knob must never abort training
+        log.warning(
+            f"ignoring malformed DDR_HEARTBEAT_EVERY="
+            f"{os.environ['DDR_HEARTBEAT_EVERY']!r} (want an integer)"
+        )
+        heartbeat_every = 25
     # Multi-process (jax.distributed) discipline: plots/logs come from process 0
     # only; checkpoints switch to the COLLECTIVE orbax writer (every process
     # writes its addressable shards, process-0 meta, completion barrier —
@@ -207,19 +231,39 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                         )
                     else:
                         q_prime, network, channels, gauges = payload
-                        params, opt_state, loss, daily = step(
-                            params,
-                            opt_state,
-                            network,
-                            channels,
-                            gauges,
-                            attrs,
-                            q_prime,
-                            jnp.asarray(obs_daily),
-                            jnp.asarray(obs_mask),
-                        )
+                        with span("step-single"):
+                            params, opt_state, loss, daily = step(
+                                params,
+                                opt_state,
+                                network,
+                                channels,
+                                gauges,
+                                attrs,
+                                q_prime,
+                                jnp.asarray(obs_daily),
+                                jnp.asarray(obs_mask),
+                            )
                     loss = float(loss)  # device sync: the timing covers the whole step
                 daily = np.asarray(daily)  # (D-2, G)
+                if par is None and rec is not None:
+                    # one jitted step serves every batch; compile-cache growth
+                    # means this batch's topology re-traced — record it (the
+                    # O(E) topology hash is only worth paying with a run log)
+                    from ddr_tpu.parallel.partition import topology_sha
+
+                    tracker.track_jit("single", step, key=topology_sha(rd))
+                if rec is not None:
+                    rec.emit(
+                        "step",
+                        epoch=epoch,
+                        batch=i,
+                        loss=loss,
+                        n_reaches=int(rd.n_segments),
+                        n_timesteps=int(n_timesteps),
+                        seconds=round(throughput.last_seconds, 6),
+                        reach_timesteps_per_sec=round(throughput.last_rate, 1),
+                        engine=payload.mode if par is not None else "single",
+                    )
                 log.info(
                     f"epoch {epoch} mini-batch {i}: loss={loss:.5f} "
                     f"({throughput.last_rate:,.0f} reach-timesteps/s)"
@@ -275,20 +319,37 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             arch=kan_arch(cfg),
                         )
                 n_done += 1
+                # Per-host liveness: every host emits (each to its own log
+                # file), so a straggler/stalled host is visible from the run
+                # telemetry alone. First executed batch always beats, then
+                # every DDR_HEARTBEAT_EVERY-th (0 disables).
+                if heartbeat_every and (n_done == 1 or n_done % heartbeat_every == 0):
+                    emit_heartbeat(rec, epoch=epoch, batch=i, step=n_done)
                 if max_batches is not None and n_done >= max_batches:
                     return params, opt_state
         return params, opt_state
     finally:
         throughput.log_summary()
+        if rec is not None:
+            rec.merge_summary("compile", tracker.snapshot())
+            rec.merge_summary(
+                "throughput",
+                {
+                    "reach_timesteps_per_sec": round(throughput.rate, 1),
+                    "batches": throughput.batches,
+                },
+            )
 
 
 def main(argv: list[str] | None = None) -> int:
     cfg = parse_cli(argv, mode="training")
-    with timed("training"), trace():
-        try:
+    # KeyboardInterrupt is caught OUTSIDE run_telemetry so the run log records
+    # status=interrupted (catching inside would close it as "ok").
+    try:
+        with timed("training"), run_telemetry(cfg, "train"), trace():
             train(cfg)
-        except KeyboardInterrupt:
-            log.info("Keyboard interrupt received")
+    except KeyboardInterrupt:
+        log.info("Keyboard interrupt received")
     return 0
 
 

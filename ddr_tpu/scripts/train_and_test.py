@@ -34,12 +34,16 @@ def train_and_test(cfg: Config) -> None:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from ddr_tpu.observability import run_telemetry
+
     cfg = parse_cli(argv, mode="training")
-    with timed("train-and-test"):
-        try:
+    # one run log spans both phases (train steps then eval events); interrupt
+    # caught outside run_telemetry so the log records status=interrupted
+    try:
+        with timed("train-and-test"), run_telemetry(cfg, "train-and-test"):
             train_and_test(cfg)
-        except KeyboardInterrupt:
-            log.info("Keyboard interrupt received")
+    except KeyboardInterrupt:
+        log.info("Keyboard interrupt received")
     return 0
 
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ddr_tpu.observability import spanned
 from ddr_tpu.routing.mc import Bounds, ChannelState, GaugeIndex, route
 from ddr_tpu.routing.model import denormalize_spatial_parameters
 from ddr_tpu.routing.network import RiverNetwork
@@ -123,6 +124,7 @@ def make_train_step(
     """
     n_segments = channels.length.shape[0]
 
+    @spanned("loss")
     def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
         raw = kan_model.apply(params, attrs)
         spatial = denormalize_spatial_parameters(
@@ -159,6 +161,7 @@ def make_batch_train_step(
     engines ignore it (shallow batches must not error under a deep-tuned
     config)."""
 
+    @spanned("loss")
     def loss_fn(params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
         from ddr_tpu.routing.stacked import StackedChunked
 
@@ -219,6 +222,7 @@ def make_sharded_train_step(
 
     n_segments = channels.length.shape[0]
 
+    @spanned("loss")
     def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
         raw = kan_model.apply(params, attrs)
         spatial = denormalize_spatial_parameters(
@@ -276,6 +280,7 @@ def make_sharded_chunked_train_step(
     router = route_stacked_sharded if stacked else route_chunked_sharded
     n_segments = channels.length.shape[0]
 
+    @spanned("loss")
     def loss_fn(params, attrs, q_prime, obs_daily, obs_mask):
         raw = kan_model.apply(params, attrs)
         spatial = denormalize_spatial_parameters(

@@ -1,0 +1,202 @@
+"""Recorder / JSONL event tests: round-trip, envelope invariants,
+primary-process-only main-log writes under a faked 2-process layout, heartbeat
+payloads, and the run_telemetry lifecycle."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability import (
+    EVENT_TYPES,
+    Recorder,
+    activate,
+    deactivate,
+    device_memory_stats,
+    emit_heartbeat,
+    get_recorder,
+    run_telemetry,
+)
+
+
+def _read(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+class TestRecorderRoundTrip:
+    def test_events_round_trip_with_envelope(self, tmp_path):
+        p = tmp_path / "log.jsonl"
+        rec = Recorder(p, host=0, n_hosts=1, tags={"run": "x"})
+        rec.emit("run_start", name="r")
+        rec.emit("step", epoch=1, batch=0, loss=np.float32(1.5), seconds=0.25)
+        rec.emit("compile", engine="gspmd", key="abc")
+        rec.close()
+        events = _read(p)
+        assert [e["event"] for e in events] == ["run_start", "step", "compile", "run_end"]
+        for e in events:
+            assert {"event", "t", "wall", "host", "pid", "seq"} <= set(e)
+            assert e["host"] == 0
+            assert e["tags"] == {"run": "x"}
+        # numpy payloads serialize as plain JSON numbers
+        assert events[1]["loss"] == pytest.approx(1.5)
+        # seq strictly increasing, t monotone non-decreasing
+        assert [e["seq"] for e in events] == list(range(len(events)))
+        ts = [e["t"] for e in events]
+        assert ts == sorted(ts)
+
+    def test_run_end_carries_summary(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl", host=0)
+        rec.emit("step", loss=1.0)
+        rec.record_span("train/step", 0.5)
+        rec.merge_summary("compile", {"gspmd": {"hits": 3, "misses": 1}})
+        rec.close(status="ok")
+        end = _read(tmp_path / "log.jsonl")[-1]
+        assert end["event"] == "run_end"
+        assert end["status"] == "ok"
+        assert end["summary"]["events"]["step"] == 1
+        assert end["summary"]["spans"]["train/step"]["count"] == 1
+        assert end["summary"]["compile"]["gspmd"]["misses"] == 1
+
+    def test_close_is_idempotent_and_emits_nothing_after(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        rec.close()
+        rec.close()
+        rec.emit("step", loss=1.0)  # dropped, not an error
+        assert [e["event"] for e in _read(tmp_path / "log.jsonl")] == ["run_end"]
+
+    def test_unknown_event_type_warns_but_writes(self, tmp_path, caplog):
+        rec = Recorder(tmp_path / "log.jsonl")
+        with caplog.at_level("WARNING"):
+            rec.emit("bogus", x=1)
+        assert "bogus" in caplog.text
+        assert _read(tmp_path / "log.jsonl")[0]["event"] == "bogus"
+        rec.close()
+
+    def test_event_vocabulary_is_closed(self):
+        assert set(EVENT_TYPES) == {
+            "run_start", "step", "eval", "compile", "heartbeat", "span", "run_end",
+        }
+
+
+class TestPrimaryProcessWrites:
+    """Main log from the primary process only; other hosts get sidecars."""
+
+    def test_host0_owns_main_log(self, tmp_path):
+        rec = Recorder.open_run(tmp_path, cmd="train", host=0, n_hosts=2)
+        assert rec.path == tmp_path / "run_log.train.jsonl"
+        rec.close()
+
+    def test_secondary_host_writes_sidecar(self, tmp_path):
+        rec = Recorder.open_run(tmp_path, cmd="train", host=1, n_hosts=2)
+        assert rec.path == tmp_path / "run_log.train.host1.jsonl"
+        rec.emit("heartbeat", step=3)
+        rec.close()
+        # the main log was never touched by the non-primary process
+        assert not (tmp_path / "run_log.train.jsonl").exists()
+        assert _read(rec.path)[0]["host"] == 1
+
+    def test_faked_two_process_layout_resolves_sidecar(self, tmp_path, monkeypatch):
+        """Under a faked jax 2-process layout, the non-primary recorder picks
+        its sidecar automatically (via scripts.common.is_primary_process)."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        rec = Recorder.open_run(tmp_path, cmd="train")
+        assert rec.host == 1 and rec.n_hosts == 2
+        assert rec.path.name == "run_log.train.host1.jsonl"
+        rec.close()
+        rec0 = Recorder.open_run(tmp_path, cmd="train", host=0, n_hosts=2)
+        assert rec0.path.name == "run_log.train.jsonl"
+        rec0.close()
+
+
+class TestHeartbeat:
+    def test_emit_heartbeat_includes_devices(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        emit_heartbeat(rec, epoch=2, batch=5, step=7)
+        rec.close()
+        hb = _read(tmp_path / "log.jsonl")[0]
+        assert hb["event"] == "heartbeat"
+        assert hb["step"] == 7
+        assert isinstance(hb["devices"], list)
+
+    def test_device_memory_stats_shape(self):
+        import jax  # noqa: F401  — ensures the lazy jax path is exercised
+
+        stats = device_memory_stats(max_devices=2)
+        assert isinstance(stats, list) and len(stats) <= 2
+        for entry in stats:
+            assert "id" in entry and "platform" in entry
+
+    def test_no_active_recorder_is_silent(self):
+        deactivate()
+        emit_heartbeat(step=1)  # must not raise
+
+
+class _Params:
+    def __init__(self, save_path):
+        self.save_path = save_path
+
+
+class _Cfg:
+    def __init__(self, save_path):
+        self.name = "telem_run"
+        self.mode = "training"
+        self.device = "cpu:8"
+        self.params = _Params(save_path)
+        self.experiment = type("E", (), {"parallel": "auto", "epochs": 2, "batch_size": 4, "warmup": 1})()
+
+
+class TestRunTelemetry:
+    def test_lifecycle_and_activation(self, tmp_path):
+        cfg = _Cfg(str(tmp_path))
+        assert get_recorder() is None
+        with run_telemetry(cfg, "train") as rec:
+            assert get_recorder() is rec
+            rec.emit("step", loss=0.5)
+        assert get_recorder() is None
+        events = _read(tmp_path / "run_log.train.jsonl")
+        assert [e["event"] for e in events] == ["run_start", "step", "run_end"]
+        start = events[0]
+        assert start["name"] == "telem_run"
+        assert start["parallel"] == "auto" and start["epochs"] == 2
+        assert events[-1]["status"] == "ok"
+
+    def test_metrics_dir_env_overrides_save_path(self, tmp_path, monkeypatch):
+        override = tmp_path / "elsewhere"
+        monkeypatch.setenv("DDR_METRICS_DIR", str(override))
+        with run_telemetry(_Cfg(str(tmp_path / "save")), "train"):
+            pass
+        assert (override / "run_log.train.jsonl").exists()
+        assert not (tmp_path / "save").exists()
+
+    def test_exception_recorded_and_reraised(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            with run_telemetry(_Cfg(str(tmp_path)), "train"):
+                raise RuntimeError("boom")
+        end = _read(tmp_path / "run_log.train.jsonl")[-1]
+        assert end["status"] == "error:RuntimeError"
+        assert get_recorder() is None
+
+    def test_interrupt_status(self, tmp_path):
+        with pytest.raises(KeyboardInterrupt):
+            with run_telemetry(_Cfg(str(tmp_path)), "train"):
+                raise KeyboardInterrupt
+        assert _read(tmp_path / "run_log.train.jsonl")[-1]["status"] == "interrupted"
+
+    def test_no_dir_no_cfg_disables(self, monkeypatch):
+        monkeypatch.delenv("DDR_METRICS_DIR", raising=False)
+        with run_telemetry(None, "train") as rec:
+            assert rec is None
+        assert get_recorder() is None
+
+
+@pytest.fixture(autouse=True)
+def _clean_active_recorder():
+    """Never leak an active recorder between tests."""
+    yield
+    deactivate()
+    assert get_recorder() is None

@@ -1,0 +1,156 @@
+"""``ddr metrics`` CLI tests: summarize/tail on a golden run log, multi-host
+directory merging, corrupt-line tolerance, and help/exit-code smoke checks
+(incl. ``bench.py --help``)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ddr_tpu.observability import metrics_dir_from_env
+from ddr_tpu.observability.metrics_cli import load_events, main
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _write_golden(path: Path) -> Path:
+    """A small but complete run log: every event type, two engines, a loss
+    curve, heartbeats from two hosts (sidecar merged separately)."""
+    events = [
+        {"event": "run_start", "t": 0.0, "wall": 100.0, "host": 0, "pid": 1, "seq": 0,
+         "cmd": "train", "name": "golden", "device": "cpu:8", "parallel": "auto",
+         "epochs": 2, "n_hosts": 1},
+        {"event": "compile", "t": 0.5, "wall": 100.5, "host": 0, "pid": 1, "seq": 1,
+         "engine": "stacked-sharded", "key": "aaa111", "build_seconds": 1.5,
+         "cache_entries": 1, "hits": 0, "misses": 1},
+        {"event": "span", "t": 0.6, "wall": 100.6, "host": 0, "pid": 1, "seq": 2,
+         "name": "prepare", "seconds": 0.4},
+        {"event": "heartbeat", "t": 1.2, "wall": 101.2, "host": 0, "pid": 1, "seq": 3,
+         "step": 1, "devices": [{"id": 0, "platform": "cpu"}]},
+        {"event": "run_end", "t": 9.0, "wall": 109.0, "host": 0, "pid": 1, "seq": 100,
+         "status": "ok", "duration_s": 9.0,
+         "summary": {"events": {"step": 4}, "spans": {},
+                     "compile": {"stacked-sharded": {"hits": 3, "misses": 1,
+                                                     "build_seconds": 1.5}}}},
+    ]
+    for i in range(4):
+        events.insert(3 + i, {
+            "event": "step", "t": 1.0 + i, "wall": 101.0 + i, "host": 0, "pid": 1,
+            "seq": 4 + i, "epoch": 1 + i // 2, "batch": i % 2,
+            "loss": 2.0 / (i + 1), "n_reaches": 33, "n_timesteps": 96,
+            "seconds": 0.5, "reach_timesteps_per_sec": 6336.0,
+            "engine": "stacked-sharded",
+        })
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    return path
+
+
+class TestLoadEvents:
+    def test_single_file(self, tmp_path):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        events, bad = load_events(p)
+        assert bad == 0 and len(events) == 9
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        with p.open("a") as f:
+            f.write('{"event": "step", "t":\n')  # killed mid-write
+            f.write("not json at all\n")
+        events, bad = load_events(p)
+        assert bad == 2 and len(events) == 9
+
+    def test_directory_merges_host_sidecars(self, tmp_path):
+        _write_golden(tmp_path / "run_log.train.jsonl")
+        sidecar = {"event": "heartbeat", "t": 1.3, "wall": 101.3, "host": 1,
+                   "pid": 2, "seq": 0, "step": 1, "devices": []}
+        (tmp_path / "run_log.train.host1.jsonl").write_text(json.dumps(sidecar) + "\n")
+        events, _ = load_events(tmp_path)
+        assert len(events) == 10
+        assert {e.get("host") for e in events} == {0, 1}
+        walls = [e["wall"] for e in events]
+        assert walls == sorted(walls)  # merged in wall order
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_events(tmp_path / "nope.jsonl")
+        with pytest.raises(FileNotFoundError):
+            load_events(tmp_path)  # empty dir: no .jsonl inside
+
+
+class TestSummarize:
+    def test_golden_log_renders(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "name=golden" in out
+        assert "status   : ok" in out
+        assert "steps    : 4" in out
+        assert "reach-timesteps/s" in out
+        assert "stacked-sharded" in out
+        # hits come from the run_end summary rollup
+        assert "loss" in out and "0.5" in out
+        assert "heartbeats" in out
+        assert "prepare" in out  # span table
+
+    def test_multi_host_dir(self, tmp_path, capsys):
+        _write_golden(tmp_path / "run_log.train.jsonl")
+        (tmp_path / "run_log.train.host1.jsonl").write_text(
+            json.dumps({"event": "heartbeat", "t": 1.0, "wall": 101.0, "host": 1,
+                        "pid": 2, "seq": 0, "step": 1, "devices": []}) + "\n"
+        )
+        assert main(["summarize", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "hosts: 2" in out
+        assert "host1" in out
+
+
+class TestTail:
+    def test_tail_last_n(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        assert main(["tail", str(p), "-n", "2"]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        assert "run_end" in lines[-1]
+        assert "status=ok" in lines[-1]
+
+
+class TestExitCodes:
+    def test_help_exits_zero(self):
+        assert main(["--help"]) == 0
+        assert main(["summarize", "--help"]) == 0
+
+    def test_no_command_is_usage_error(self):
+        assert main([]) == 2
+
+    def test_missing_log_is_error(self, tmp_path):
+        assert main(["summarize", str(tmp_path / "nope.jsonl")]) == 1
+
+    def test_ddr_cli_dispatches_metrics(self):
+        from ddr_tpu.cli import main as ddr_main
+
+        assert ddr_main(["metrics", "--help"]) == 0
+
+
+class TestBenchSmoke:
+    def test_bench_help_exits_zero(self):
+        """`bench.py --help` must print usage and exit 0 WITHOUT running the
+        benchmark (and without importing jax in the parent)."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--help"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0
+        assert "usage" in proc.stdout.lower()
+        assert "DDR_METRICS_DIR" in proc.stdout
+
+    def test_metrics_dir_env_helper(self, monkeypatch):
+        monkeypatch.delenv("DDR_METRICS_DIR", raising=False)
+        assert metrics_dir_from_env() is None
+        monkeypatch.setenv("DDR_METRICS_DIR", "/tmp/x")
+        assert metrics_dir_from_env() == "/tmp/x"

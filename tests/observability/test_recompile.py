@@ -1,0 +1,164 @@
+"""CompileTracker tests: hit/miss counters, compile events on misses, jit
+cache-growth detection, and the ParallelTrainer built-step LRU integration
+(cached vs uncached step build)."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import pytest
+
+from ddr_tpu.observability import CompileTracker, Recorder, activate, deactivate
+
+
+def _read(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+@pytest.fixture()
+def rec(tmp_path):
+    r = Recorder(tmp_path / "log.jsonl")
+    activate(r)
+    yield r
+    deactivate(r)
+    r.close()
+
+
+class TestCompileTracker:
+    def test_miss_emits_compile_event(self, rec):
+        t = CompileTracker()
+        t.miss("stacked-sharded", key="deadbeef", seconds=1.25, cache_entries=1)
+        t.hit("stacked-sharded", key="deadbeef")
+        assert t.counts("stacked-sharded") == (1, 1)
+        events = [e for e in _read(rec.path) if e["event"] == "compile"]
+        assert len(events) == 1  # hits never emit
+        ev = events[0]
+        assert ev["engine"] == "stacked-sharded"
+        assert ev["key"] == "deadbeef"
+        assert ev["build_seconds"] == pytest.approx(1.25)
+        assert ev["cache_entries"] == 1
+
+    def test_counts_aggregate_across_engines(self):
+        t = CompileTracker()
+        t.miss("a")
+        t.hit("a")
+        t.miss("b")
+        assert t.counts() == (1, 2)
+        snap = t.snapshot()
+        assert snap["a"] == {"hits": 1, "misses": 1, "build_seconds": 0.0}
+        assert snap["b"]["misses"] == 1
+
+    def test_track_jit_counts_growth_as_miss(self, rec):
+        class _Fake:
+            def __init__(self):
+                self.size = 0
+
+            def _cache_size(self):
+                return self.size
+
+        fn = _Fake()
+        t = CompileTracker()
+        fn.size = 1
+        t.track_jit("single", fn, key="k1")  # first sighting: miss
+        t.track_jit("single", fn, key="k1")  # steady: hit
+        fn.size = 2
+        t.track_jit("single", fn, key="k2")  # growth: miss
+        assert t.counts("single") == (1, 2)
+        keys = [e["key"] for e in _read(rec.path) if e["event"] == "compile"]
+        assert keys == ["k1", "k2"]
+
+    def test_track_jit_tolerates_unsupported_fn(self):
+        t = CompileTracker()
+        t.track_jit("single", lambda: None)  # no _cache_size: silent no-op
+        assert t.counts("single") == (0, 0)
+
+    def test_track_jit_on_real_jit(self):
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda x: x + 1)
+        t = CompileTracker()
+        fn(jnp.arange(3))
+        t.track_jit("single", fn)
+        fn(jnp.arange(3))  # same shape: cache hit
+        t.track_jit("single", fn)
+        fn(jnp.arange(5))  # new shape: recompile
+        t.track_jit("single", fn)
+        hits, misses = t.counts("single")
+        if hits == 0 and misses == 0:
+            pytest.skip("this jax version exposes no _cache_size")
+        assert (hits, misses) == (1, 2)
+
+
+class TestTrainerStepCache:
+    """The trainer's built-step LRU: a repeated batch topology is a hit (no
+    compile event); a fresh one is a miss with the topology hash."""
+
+    def _trainer(self, tmp_path):
+        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.parallel.train import ParallelTrainer
+        from ddr_tpu.training import make_optimizer
+        from ddr_tpu.validation.configs import Config
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        cfg = Config(
+            name="obs_run",
+            geodataset="synthetic",
+            mode="training",
+            device="cpu:8",
+            kan={"input_var_names": [f"a{i}" for i in range(10)]},
+            experiment={
+                "start_time": "1981/10/01",
+                "end_time": "1981/10/20",
+                "rho": 8,
+                "batch_size": 2,
+                "epochs": 1,
+                "warmup": 1,
+                "learning_rate": {1: 0.01},
+                "parallel": "stacked-sharded",
+            },
+            params={"save_path": str(tmp_path)},
+        )
+        kan_model, _ = build_kan(cfg)
+        return ParallelTrainer(cfg, kan_model, make_optimizer(1e-3))
+
+    def test_repeat_topology_is_cached(self, tmp_path, rec):
+        import numpy as np
+
+        from ddr_tpu.geodatazoo.synthetic import make_basin
+
+        par = self._trainer(tmp_path)
+        basin = make_basin(n_segments=33, n_gauges=2, n_days=3, seed=5)
+        rd = basin.routing_data
+        q_prime = np.asarray(basin.q_prime, dtype=np.float32)
+
+        prep1 = par.prepare(rd, q_prime)
+        assert par.compile_tracker.counts("stacked-sharded") == (0, 1)
+        prep2 = par.prepare(rd, q_prime)  # same topology: LRU hit, no rebuild
+        assert par.compile_tracker.counts("stacked-sharded") == (1, 1)
+        assert prep1.step_fn is prep2.step_fn
+        assert prep1.topo_key == prep2.topo_key
+
+        compile_events = [e for e in _read(rec.path) if e["event"] == "compile"]
+        assert len(compile_events) == 1
+        assert compile_events[0]["key"] == prep1.topo_key
+        assert compile_events[0]["engine"] == "stacked-sharded"
+        # prepare() is span-traced
+        assert any(
+            e["event"] == "span" and e["name"].startswith("prepare")
+            for e in _read(rec.path)
+        )
+
+    def test_new_topology_is_a_second_miss(self, tmp_path, rec):
+        import numpy as np
+
+        from ddr_tpu.geodatazoo.synthetic import make_basin
+
+        par = self._trainer(tmp_path)
+        for seed, n in ((5, 33), (6, 41)):
+            basin = make_basin(n_segments=n, n_gauges=2, n_days=3, seed=seed)
+            par.prepare(basin.routing_data, np.asarray(basin.q_prime, dtype=np.float32))
+        assert par.compile_tracker.counts("stacked-sharded") == (0, 2)
+        keys = {e["key"] for e in _read(rec.path) if e["event"] == "compile"}
+        assert len(keys) == 2

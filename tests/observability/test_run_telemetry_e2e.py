@@ -1,0 +1,104 @@
+"""End-to-end acceptance: a CPU-mesh parallel `ddr train` dry-run writes a run
+log with run_start / step (finite rate) / compile (topology hash) / heartbeat /
+run_end; `ddr metrics summarize` renders it; a repeated-topology second epoch
+recompiles nothing on the LRU-cached engines."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import pytest
+
+from ddr_tpu.observability import run_telemetry
+from ddr_tpu.validation.configs import Config
+
+N_DEV = 8
+
+
+def _cfg(tmp_path, **exp):
+    return Config(
+        name="telem_e2e",
+        geodataset="synthetic",
+        mode="training",
+        device=f"cpu:{N_DEV}",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/20",
+            "rho": 8,
+            "batch_size": 2,
+            "epochs": 2,
+            "warmup": 1,
+            "learning_rate": {1: 0.01},
+            "shuffle": False,  # identical batches across epochs: epoch 2 must be all cache hits
+            **exp,
+        },
+        params={"save_path": str(tmp_path)},
+    )
+
+
+@pytest.mark.slow
+def test_train_dry_run_produces_complete_run_log(tmp_path, monkeypatch):
+    from ddr_tpu.scripts.train import train
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices")
+    monkeypatch.setenv("DDR_HEARTBEAT_EVERY", "1")
+    # gspmd: the one engine exercising the shared-jit compile-cache tracking
+    # (the shard_map engines' LRU tracking is pinned in test_recompile.py)
+    cfg = _cfg(tmp_path, parallel="gspmd")
+    with run_telemetry(cfg, "train"):
+        train(cfg, max_batches=4)  # 2 epochs x 2 batches, same topologies
+
+    log_path = tmp_path / "run_log.train.jsonl"
+    events = [json.loads(line) for line in log_path.read_text().splitlines()]
+    by_type: dict[str, list] = {}
+    for e in events:
+        by_type.setdefault(e["event"], []).append(e)
+
+    assert len(by_type["run_start"]) == 1
+    steps = by_type["step"]
+    assert len(steps) == 4
+    for s in steps:
+        assert math.isfinite(float(s["reach_timesteps_per_sec"]))
+        assert s["engine"] == "gspmd"
+    compiles = by_type["compile"]
+    assert len(compiles) >= 1
+    # every compile event names the batch topology (sha1 hex)
+    assert all(isinstance(c["key"], str) and len(c["key"]) == 40 for c in compiles)
+    assert by_type["heartbeat"], "heartbeats missing"
+    end = by_type["run_end"][-1]
+    assert end["status"] == "ok"
+
+    # Repeated-topology epoch 2 (shuffle=False): ZERO recompiles — all misses
+    # land in epoch 1 (≤ 2 batches), and every epoch-2 step is a cache hit.
+    compile_summary = end["summary"]["compile"]["gspmd"]
+    assert compile_summary["misses"] == len(compiles) <= 2
+    assert compile_summary["hits"] == len(steps) - compile_summary["misses"] >= 2
+
+    # And the CLI renders it without error.
+    from ddr_tpu.observability.metrics_cli import main as metrics_main
+
+    assert metrics_main(["summarize", str(log_path)]) == 0
+    assert metrics_main(["tail", str(log_path)]) == 0
+
+
+@pytest.mark.slow
+def test_eval_events_from_test_pipeline(tmp_path):
+    """`ddr test`-path evaluation emits eval events with finite rates."""
+    from ddr_tpu.scripts.test import test as run_test
+
+    cfg = _cfg(tmp_path, parallel="none")
+    cfg.mode = "testing"
+    with run_telemetry(cfg, "test"):
+        run_test(cfg)
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "run_log.test.jsonl").read_text().splitlines()
+    ]
+    evals = [e for e in events if e["event"] == "eval"]
+    assert evals
+    assert all(math.isfinite(float(e["reach_timesteps_per_sec"])) for e in evals)
+    assert events[-1]["event"] == "run_end" and events[-1]["status"] == "ok"

@@ -1,0 +1,181 @@
+"""Span nesting / trace re-entrancy tests (incl. the regression for
+double-started profiler traces and exception safety)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from contextlib import contextmanager
+
+import pytest
+
+from ddr_tpu.observability import (
+    Recorder,
+    activate,
+    deactivate,
+    profile_dir_from_env,
+    span,
+    spanned,
+    trace,
+    trace_active,
+)
+from ddr_tpu.observability.spans import _stack
+
+
+def _read(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+@pytest.fixture()
+def rec(tmp_path):
+    r = Recorder(tmp_path / "log.jsonl")
+    activate(r)
+    yield r
+    deactivate(r)
+    r.close()
+
+
+class TestSpanNesting:
+    def test_paths_nest(self, rec):
+        with span("outer"):
+            with span("inner"):
+                pass
+            with span("inner2"):
+                pass
+        names = [e["name"] for e in _read(rec.path) if e["event"] == "span"]
+        # children close (and emit) before their parent
+        assert names == ["outer/inner", "outer/inner2", "outer"]
+
+    def test_span_without_recorder_is_noop(self):
+        deactivate()
+        with span("lonely"):
+            pass  # must not raise, nothing to write to
+
+    def test_exception_unwinds_stack_and_still_records(self, rec):
+        with pytest.raises(ValueError):
+            with span("outer"):
+                with span("bad"):
+                    raise ValueError("boom")
+        assert _stack() == []  # fully unwound
+        names = [e["name"] for e in _read(rec.path) if e["event"] == "span"]
+        assert names == ["outer/bad", "outer"]  # both timed despite the raise
+        with span("after"):
+            pass
+        assert _read(rec.path)[-1]["name"] == "after"  # no stale prefix
+
+    def test_spanned_decorator(self, rec):
+        @spanned("fn")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert [e["name"] for e in _read(rec.path) if e["event"] == "span"] == ["fn"]
+
+    def test_thread_local_stacks(self, rec):
+        paths = []
+        orig = rec.record_span
+        rec.record_span = lambda p, s: (paths.append(p), orig(p, s))
+
+        def worker():
+            with span("thread-span"):
+                pass
+
+        with span("main-span"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        # the worker's span must NOT nest under the main thread's open span
+        assert "thread-span" in paths and "main-span/thread-span" not in paths
+
+    def test_span_inside_jit_traces_once_per_compile(self, rec):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            with span("jit-body"):
+                return x * 2
+
+        f(jnp.arange(4))
+        f(jnp.arange(4))  # cache hit: no re-trace, no second span
+        names = [e["name"] for e in _read(rec.path) if e["event"] == "span"]
+        assert names.count("jit-body") == 1
+
+
+class _CountingTrace:
+    """Stand-in for jax.profiler.trace that counts starts/stops."""
+
+    def __init__(self):
+        self.starts = 0
+        self.stops = 0
+
+    @contextmanager
+    def __call__(self, log_dir):
+        self.starts += 1
+        try:
+            yield
+        finally:
+            self.stops += 1
+
+
+class TestTraceReentrancy:
+    def test_noop_without_dir(self, monkeypatch):
+        monkeypatch.delenv("DDR_PROFILE_DIR", raising=False)
+        assert profile_dir_from_env() is None
+        with trace():
+            assert not trace_active()
+
+    def test_nested_trace_starts_profiler_once(self, tmp_path, monkeypatch):
+        import jax
+
+        counter = _CountingTrace()
+        monkeypatch.setattr(jax.profiler, "trace", counter)
+        with trace(str(tmp_path)):
+            assert trace_active()
+            with trace(str(tmp_path)):  # re-entrant: must NOT double-start
+                assert trace_active()
+            with trace():  # dir-less nested call: also a no-op
+                assert trace_active()
+            assert counter.starts == 1
+        assert counter.starts == 1 and counter.stops == 1
+        assert not trace_active()
+
+    def test_exception_stops_profiler_and_resets_state(self, tmp_path, monkeypatch):
+        import jax
+
+        counter = _CountingTrace()
+        monkeypatch.setattr(jax.profiler, "trace", counter)
+        with pytest.raises(RuntimeError):
+            with trace(str(tmp_path)):
+                raise RuntimeError("boom")
+        assert counter.stops == 1
+        assert not trace_active()
+        # and a fresh trace can start again afterwards
+        with trace(str(tmp_path)):
+            pass
+        assert counter.starts == 2 and counter.stops == 2
+
+    def test_span_opens_trace_annotation_only_when_tracing(self, tmp_path, monkeypatch, rec):
+        import jax
+
+        entered = []
+
+        class _Annot:
+            def __init__(self, name):
+                self.name = name
+
+            def __enter__(self):
+                entered.append(self.name)
+
+            def __exit__(self, *exc):
+                return False
+
+        monkeypatch.setattr(jax.profiler, "trace", _CountingTrace())
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation", _Annot)
+        with span("outside"):
+            pass
+        assert entered == []
+        with trace(str(tmp_path)):
+            with span("inside"):
+                pass
+        assert entered == ["inside"]

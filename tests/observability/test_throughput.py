@@ -1,0 +1,56 @@
+"""Throughput counter regressions: the zero-duration clamp (no more inf rates
+in JSONL aggregation) and the shim's continued API compatibility."""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from ddr_tpu.observability import MIN_BATCH_SECONDS, Throughput
+
+
+class TestZeroDurationClamp:
+    def test_zero_seconds_clamps_finite_with_warning(self, caplog):
+        tp = Throughput(label="t")
+        with caplog.at_level("WARNING"):
+            rate = tp.record(n_reaches=100, n_timesteps=24, seconds=0.0)
+        assert math.isfinite(rate) and rate > 0
+        assert rate == pytest.approx(100 * 24 / MIN_BATCH_SECONDS)
+        assert "clamp" in caplog.text
+        assert tp.last_seconds == MIN_BATCH_SECONDS
+        assert math.isfinite(tp.rate)
+
+    def test_negative_and_nan_also_clamp(self):
+        tp = Throughput()
+        assert math.isfinite(tp.record(10, 10, -1.0))
+        assert math.isfinite(tp.record(10, 10, float("nan")))
+        assert tp.total_seconds == pytest.approx(2 * MIN_BATCH_SECONDS)
+
+    def test_normal_durations_unchanged(self, caplog):
+        tp = Throughput()
+        with caplog.at_level("WARNING"):
+            rate = tp.record(100, 24, 2.0)
+        assert rate == pytest.approx(1200.0)
+        assert tp.last_seconds == 2.0
+        assert "clamp" not in caplog.text
+
+    def test_last_seconds_tracks_batch_context(self):
+        import time
+
+        tp = Throughput()
+        with tp.batch(10, 10):
+            time.sleep(0.005)
+        assert tp.last_seconds >= 0.005
+
+
+class TestProfilingShim:
+    def test_shim_reexports(self):
+        from ddr_tpu import profiling
+        from ddr_tpu.observability import throughput as obs_tp
+
+        assert profiling.Throughput is obs_tp.Throughput
+        from ddr_tpu.observability.spans import trace as obs_trace
+
+        assert profiling.trace is obs_trace
+        assert callable(profiling.profile_dir_from_env)
