@@ -27,6 +27,8 @@ shallow best case.
 Env knobs: DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_DEEP_N /
 DDR_BENCH_DEEP_DEPTH (deep-topology phase; 0 disables it), DDR_BENCH_PROBE_TIMEOUT /
 DDR_BENCH_TIMEOUT (seconds, accelerator probe / each benchmark subprocess).
+JAX_PLATFORMS=cpu skips the accelerator probe entirely (CPU-only rounds go
+straight to the fallback shapes instead of waiting out the probe timeout).
 """
 
 from __future__ import annotations
@@ -316,8 +318,10 @@ Benchmark reach-timesteps/sec/chip for the Muskingum-Cunge routing forward
 pass. Prints ONE JSON line and always exits 0. Configure via env vars:
 DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_DEEP_N / DDR_BENCH_DEEP_DEPTH
 (deep-topology phase; 0 disables), DDR_BENCH_PROBE_TIMEOUT / DDR_BENCH_TIMEOUT
-(seconds). Set DDR_METRICS_DIR to also emit the timings as observability JSONL
-events (run_log.bench.jsonl, same schema as training — docs/observability.md).
+(seconds). JAX_PLATFORMS=cpu skips the accelerator probe (no probe-timeout
+stall on CPU-only hosts). Set DDR_METRICS_DIR to also emit the timings as
+observability JSONL events (run_log.bench.jsonl, same schema as training —
+docs/observability.md).
 """
 
 
@@ -388,10 +392,20 @@ def main(argv: list[str] | None = None) -> None:
         _emit_bench_events(rec, out)
         return
 
-    # Phase 1: can an accelerator backend initialize at all?
-    platform, probe_err = _run_child(
-        "import jax; print(jax.devices()[0].platform)", probe_timeout, cpu_only=False
-    )
+    # Phase 1: can an accelerator backend initialize at all? Skipped outright
+    # when the environment already pins the host platform (JAX_PLATFORMS=cpu):
+    # the probe child would inherit that pin and report "cpu" anyway, after
+    # waiting out a possibly-wedged tunnel for up to DDR_BENCH_PROBE_TIMEOUT
+    # (900 s default) — the stall that ate whole CPU-only bench rounds
+    # (BENCH_r04/r05).
+    pinned = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if pinned == "cpu":
+        platform, probe_err = "cpu", ""
+        out["probe_skipped"] = "JAX_PLATFORMS=cpu pinned in the environment"
+    else:
+        platform, probe_err = _run_child(
+            "import jax; print(jax.devices()[0].platform)", probe_timeout, cpu_only=False
+        )
     if platform is None or platform == "cpu":
         out["device"] = "cpu"
         if probe_err:

@@ -15,6 +15,7 @@ _COMMANDS = {
     "test": "ddr_tpu.scripts.test",
     "route": "ddr_tpu.scripts.router",
     "train-and-test": "ddr_tpu.scripts.train_and_test",
+    "serve": "ddr_tpu.scripts.serve",
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",

@@ -109,9 +109,14 @@ class _TransposedArray:
         self._arr = arr
         self.shape = tuple(reversed(arr.shape))
 
-    def __array__(self, dtype=None) -> np.ndarray:
+    def __array__(self, dtype=None, copy=None) -> np.ndarray:
+        # NumPy 2 passes ``copy`` (np.asarray(..., copy=False) etc.); a
+        # 1-positional-arg __array__ raises TypeError there. The materialized
+        # transpose is always freshly read, so both copy=False (no extra copy
+        # happens) and copy=True (the data aliases nothing caller-visible)
+        # are satisfied without branching.
         data = read_array(self._arr).T
-        return data if dtype is None else data.astype(dtype)
+        return data if dtype is None else data.astype(dtype, copy=False)
 
 
 class XarrayConventionGroup:
@@ -148,9 +153,27 @@ class XarrayConventionGroup:
             time_arr = group["time"]
             units = dict(getattr(time_arr, "attrs", {}) or {}).get("units")
             times = _decode_cf_time(read_array(time_arr), units)
-            step_hours = (
-                (times[1] - times[0]).total_seconds() / 3600 if len(times) > 1 else 24
-            )
+            if len(times) > 1:
+                # decide cadence from the WHOLE axis, not times[1]-times[0]: a
+                # store with a gap (or mixed cadence) would otherwise be
+                # stamped with a uniform freq and every later window read
+                # would silently mis-index past the first irregularity
+                deltas = np.diff(times.asi8)  # ns since epoch -> exact ints
+                if deltas.min() != deltas.max():
+                    # don't call either step "the" cadence: when the FIRST gap
+                    # is the anomaly, deltas[0] is not the normal step
+                    bad = int(np.argmax(deltas != deltas[0]))
+                    raise ValueError(
+                        "remote store time axis is not uniform: steps range "
+                        f"{pd.Timedelta(int(deltas.min()))} to "
+                        f"{pd.Timedelta(int(deltas.max()))}, first divergence "
+                        f"at index {bad + 1} ({times[bad]} -> {times[bad + 1]}); "
+                        "the facade contract requires an evenly spaced axis "
+                        "before stamping freq"
+                    )
+                step_hours = float(deltas[0]) / 3.6e12
+            else:
+                step_hours = 24
             origin = times[0]
             midnight = origin.normalize() == origin
             if step_hours > 1 and not midnight:
@@ -179,6 +202,19 @@ class XarrayConventionGroup:
                     "the data layer handles hourly (1h) and daily (24h) stores"
                 )
             self._coords.add("time")
+        # xarray marks every coordinate variable by naming its array after its
+        # own (sole) dimension; any such 1-D self-dimensioned array (lat/lon
+        # bounds dims, ensemble axes, ...) is a coordinate, not data — hide it
+        # from keys() like the id/time coords so attribute iteration over the
+        # group sees data variables only.
+        for k in self._group.keys():
+            if k in self._coords:
+                continue
+            dims = dict(getattr(self._group[k], "attrs", {}) or {}).get(
+                "_ARRAY_DIMENSIONS"
+            )
+            if dims is not None and list(dims) == [k]:
+                self._coords.add(k)
 
     def _wrap(self, name: str, node: Any) -> Any:
         dims = dict(getattr(node, "attrs", {}) or {}).get("_ARRAY_DIMENSIONS")

@@ -58,7 +58,20 @@ __all__ = [
 #: The closed vocabulary of event types (docs/observability.md has one schema
 #: table per type). ``Recorder.emit`` warns on — but still writes — anything
 #: else, so ad-hoc experiments don't lose data while the schema catches drift.
-EVENT_TYPES = ("run_start", "step", "eval", "compile", "heartbeat", "span", "run_end")
+#: ``serve_request``/``serve_batch``/``serve_shed`` are the forecast-serving
+#: layer's admit/batch/shed decisions (:mod:`ddr_tpu.serving`).
+EVENT_TYPES = (
+    "run_start",
+    "step",
+    "eval",
+    "compile",
+    "heartbeat",
+    "span",
+    "run_end",
+    "serve_request",
+    "serve_batch",
+    "serve_shed",
+)
 
 
 def metrics_dir_from_env() -> str | None:

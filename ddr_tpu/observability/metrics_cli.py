@@ -132,6 +132,8 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
             w(f"loss     : first {_fmt(losses[0])} -> last {_fmt(losses[-1])} (min {_fmt(min(losses))})\n")
             w(f"loss curve: {pts}\n")
 
+    _summarize_serving(by_type, w)
+
     evals = by_type.get("eval", [])
     if evals:
         rates = [float(e["reach_timesteps_per_sec"]) for e in evals if "reach_timesteps_per_sec" in e]
@@ -186,6 +188,74 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
         ]
         w("spans (by total time):\n" + _table(rows, ["span", "count", "total_s", "mean_ms"]) + "\n")
     return 0
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample (no numpy dep —
+    this CLI stays importable in jax-free parents like bench.py's)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _summarize_serving(by_type: dict[str, list[dict]], w) -> None:
+    """The forecast-serving section: request latency percentiles, batch
+    occupancy, shed reasons (events emitted by :mod:`ddr_tpu.serving`)."""
+    reqs = by_type.get("serve_request", [])
+    batches = by_type.get("serve_batch", [])
+    sheds = by_type.get("serve_shed", [])
+    if not (reqs or batches or sheds):
+        return
+    if reqs:
+        statuses: dict[str, int] = {}
+        for e in reqs:
+            s = str(e.get("status", "?"))
+            statuses[s] = statuses.get(s, 0) + 1
+        # percentiles over SERVED requests only: sheds/rejects carry ~0
+        # latencies and would drag p50 down exactly when the service is
+        # overloaded (their counts render below)
+        lat = sorted(
+            float(e["latency_s"])
+            for e in reqs
+            if e.get("latency_s") is not None and e.get("status") == "ok"
+        )
+        line = f"serving  : {len(reqs)} requests — " + ", ".join(
+            f"{k} {v}" for k, v in sorted(statuses.items())
+        )
+        if lat:
+            p50, p90, p99 = (_percentile(lat, q) for q in (0.50, 0.90, 0.99))
+            line += (
+                f"   latency p50 {1e3 * p50:.1f}ms  p90 {1e3 * p90:.1f}ms  "
+                f"p99 {1e3 * p99:.1f}ms"
+            )
+        w(line + "\n")
+    if batches:
+        sizes = [float(e.get("size", 0)) for e in batches]
+        occ = [float(e["occupancy"]) for e in batches if e.get("occupancy") is not None]
+        secs = [float(e.get("seconds", 0.0)) for e in batches]
+        line = f"batches  : {len(batches)}   mean size {sum(sizes) / len(sizes):.2f}"
+        if occ:
+            line += f"   mean occupancy {100 * sum(occ) / len(occ):.0f}%"
+        if any(secs):
+            line += f"   mean {1e3 * sum(secs) / len(secs):.1f}ms/batch"
+        per_net: dict[str, int] = {}
+        for e in batches:
+            key = str(e.get("network", "?"))
+            per_net[key] = per_net.get(key, 0) + 1
+        if len(per_net) > 1:
+            line += "   (" + ", ".join(f"{k} {v}" for k, v in sorted(per_net.items())) + ")"
+        w(line + "\n")
+    if sheds:
+        reasons: dict[str, int] = {}
+        for e in sheds:
+            r = str(e.get("reason", "?"))
+            reasons[r] = reasons.get(r, 0) + 1
+        w(
+            f"sheds    : {len(sheds)} — "
+            + ", ".join(f"{k} {v}" for k, v in sorted(reasons.items()))
+            + "\n"
+        )
 
 
 def tail(events: list[dict], n: int = 20, out=None) -> int:

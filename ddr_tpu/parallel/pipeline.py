@@ -30,7 +30,10 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ddr_tpu.parallel.sharding import shard_map_compat
 
 from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
 from ddr_tpu.routing.network import compute_levels, level_schedule
@@ -271,7 +274,7 @@ def pipelined_route(
 
     shard = P(axis_name)
     rep = P()
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(

@@ -106,8 +106,24 @@ def _mesh_platform(mesh: Any) -> str:
 # partition, engine layout, and the jit-compiled engine program are cached and
 # reused — the inference analog of ParallelTrainer's built-step LRU. Keyed by
 # (adjacency hash, n_shards, engine, bounds, mesh id); entries evict LRU.
-_PLAN_CACHE: "OrderedDict[tuple, Callable]" = None  # type: ignore[assignment]
+# Each entry stores ``(mesh, plan)``: ``id(mesh)`` alone is not an identity
+# (CPython recycles addresses), so a hit additionally verifies the cached mesh
+# IS the caller's mesh and rebuilds otherwise — a plan closed over a dead
+# mesh can never be returned to a new mesh that inherited its address. The
+# strong reference also keeps a cached plan's mesh alive, so live entries
+# cannot collide by construction.
+_PLAN_CACHE: "OrderedDict[tuple, tuple[Any, Callable]]" = None  # type: ignore[assignment]
 _PLAN_CACHE_MAX = 16
+
+#: Monotonic count of plans ever built. Cache SIZE stops moving at the LRU cap
+#: while eviction churn keeps rebuilding (and recompiling) plans; auditors
+#: (the serving layer's recompile tracking) watch this counter instead.
+_PLAN_BUILDS = 0
+
+
+def plan_build_count() -> int:
+    """How many routing plans have been built (never decreases)."""
+    return _PLAN_BUILDS
 
 
 def _plan_cache():
@@ -170,12 +186,15 @@ def route_parallel(
 
     cache = _plan_cache()
     key = _topology_key(rd, n_shards, engine, bounds, mesh)
-    plan = cache.get(key)
-    if plan is not None:
+    entry = cache.get(key)
+    if entry is not None and entry[0] is mesh:
+        plan = entry[1]
         cache.move_to_end(key)
     else:
         plan = _build_plan(mesh, rd, engine, n_shards, bounds)
-        cache[key] = plan
+        global _PLAN_BUILDS
+        _PLAN_BUILDS += 1
+        cache[key] = (mesh, plan)
         if len(cache) > _PLAN_CACHE_MAX:
             cache.popitem(last=False)
     runoff, final = plan(channels, spatial_params, q_prime, q_init)

@@ -30,9 +30,28 @@ __all__ = [
     "reach_sharding",
     "replicated",
     "shard_channels",
+    "shard_map_compat",
     "shard_network",
     "sharded_route",
 ]
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across the API move: top-level ``jax.shard_map``
+    (jax >= 0.6, ``check_vma``) when present, else the 0.4.x
+    ``jax.experimental.shard_map`` (same semantics, flag named ``check_rep``).
+    The one entry every explicit-collective engine builds through, so the jax
+    pin of the runtime image can move in either direction without touching
+    the engines."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
 
 
 def make_mesh(n_devices: int | None = None, axis_name: str = "reach") -> Mesh:

@@ -35,7 +35,10 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ddr_tpu.parallel.sharding import shard_map_compat
 
 from ddr_tpu.routing.chunked import boundary_buffer_columns
 from ddr_tpu.routing.network import compute_levels
@@ -461,7 +464,7 @@ def route_stacked_sharded(
 
     shard = P(axis_name)
     rep = P()
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(

@@ -111,7 +111,22 @@ def ensure_device_platform(device: str) -> None:
     n_procs = (dist_spec or {}).get("num_processes")
     if n is not None and multi_host:
         if n_procs:
-            n = -(-n // int(n_procs))  # per-process share (ceil)
+            n_procs = int(n_procs)
+            if n % n_procs:
+                # ceil-dividing silently would make the GLOBAL device set
+                # ceil(n/p)*p > n: every mesh sized from `device` then spans a
+                # subset of hosts' devices and the launch wedges or mis-shards.
+                # The requested count is unrealizable — say so.
+                lower = n - n % n_procs
+                upper = n + n_procs - n % n_procs
+                hint = f"cpu:{lower} or cpu:{upper}" if lower else f"cpu:{upper}"
+                raise ValueError(
+                    f"device={device!r} under a {n_procs}-process launch: {n} "
+                    f"is not divisible by the process count; each process "
+                    f"contributes the same number of local devices, so the "
+                    f"global count must be a multiple of {n_procs} (use {hint})"
+                )
+            n = n // n_procs  # exact per-process share
         else:
             # DDR_DISTRIBUTED=1 autodetect: process count unknown here — the
             # caller must size XLA_FLAGS per host explicitly

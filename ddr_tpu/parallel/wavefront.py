@@ -37,7 +37,10 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ddr_tpu.parallel.sharding import shard_map_compat
 
 from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
 
@@ -335,7 +338,7 @@ def sharded_wavefront_route(
     shard = P(axis_name)
     rep = P()
     out_specs = (P(None, axis_name), shard) + ((P(None, axis_name),) if return_raw else ())
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(

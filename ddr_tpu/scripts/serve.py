@@ -1,0 +1,97 @@
+"""``ddr serve`` — the batched, hot-reloadable forecast service (docs/serving.md).
+
+Builds a :class:`~ddr_tpu.serving.service.ForecastService` from the standard
+run config: the configured geodataset supplies the routing domain and its
+hourly forcing, ``experiment.checkpoint`` (or a fresh init, with a warning)
+supplies the KAN params, and ``<save_path>/saved_models`` — where ``ddr
+train`` drops checkpoints — is watched for hot-reload, so a trainer and a
+server pointed at the same run directory form a live train-to-serve loop.
+Warmup compiles every (network, model) pair before the HTTP front starts
+answering ``/readyz``, and the whole run is wrapped in ``run_telemetry`` so
+``ddr metrics summarize`` reports request latencies and batch occupancy.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import numpy as np
+
+from ddr_tpu.scripts.common import build_kan, get_flow_fn, kan_arch, parse_cli
+from ddr_tpu.serving.config import ServeConfig
+from ddr_tpu.serving.service import ForecastService
+from ddr_tpu.validation.configs import Config
+
+log = logging.getLogger(__name__)
+
+
+def build_service(
+    cfg: Config,
+    serve_cfg: ServeConfig | None = None,
+    warmup: bool = True,
+    watch: bool = True,
+) -> ForecastService:
+    """Config -> warmed service with the run's dataset registered as network
+    ``"default"`` and its KAN as model ``"default"`` (the testable core of
+    ``ddr serve``; the CLI adds telemetry + the HTTP front)."""
+    # Service first: its __init__ runs ensure_device_platform, which must land
+    # BEFORE anything below touches jax (dataset construction routes the
+    # synthetic twin; forcing reads go through jnp) or a cpu:N mesh request
+    # would find an already-initialized 1-device backend.
+    service = ForecastService(cfg, serve_cfg)
+    dataset = cfg.geodataset.get_dataset_class(cfg)
+    rd = dataset.routing_data
+    if rd is None:
+        raise ValueError("dataset carries no routing data; cannot serve")
+    flow = get_flow_fn(cfg, dataset)
+    # The dataset's Dates open on the FULL experiment window, so this reads the
+    # whole period's hourly forcing once; requests then window into it via t0.
+    forcing = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+    service.register_network("default", rd, forcing=forcing)
+
+    kan_model, params = build_kan(cfg)
+    arch = kan_arch(cfg)
+    source = None
+    if cfg.experiment.checkpoint:
+        from ddr_tpu.training import load_state
+
+        params = load_state(cfg.experiment.checkpoint, expected_arch=arch)["params"]
+        source = str(cfg.experiment.checkpoint)
+    else:
+        log.warning("no experiment.checkpoint configured; serving a fresh KAN init")
+    service.register_model("default", kan_model, params, arch=arch, source=source)
+    if watch:
+        service.watch_checkpoints("default", Path(cfg.params.save_path) / "saved_models")
+    if warmup:
+        service.warmup()
+    return service
+
+
+def serve(cfg: Config, serve_cfg: ServeConfig | None = None) -> int:
+    from ddr_tpu.serving.http_api import serve_http
+
+    service = build_service(cfg, serve_cfg)
+    try:
+        serve_http(service, block=True)
+    except KeyboardInterrupt:
+        log.info("shutting down forecast service")
+    finally:
+        service.close()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    from ddr_tpu.observability import run_telemetry
+
+    cfg = parse_cli(argv, mode="testing")
+    try:
+        with run_telemetry(cfg, "serve"):
+            return serve(cfg, ServeConfig.from_env())
+    except KeyboardInterrupt:
+        log.info("Keyboard interrupt received")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,42 @@
+"""Batched, hot-reloadable forecast serving (docs/serving.md).
+
+The first subsystem that makes the repo a *service* rather than a pile of
+scripts: a model registry with atomic checkpoint hot-reload
+(:mod:`~ddr_tpu.serving.registry`), a bounded request queue + micro-batcher
+with deadlines and backpressure (:mod:`~ddr_tpu.serving.batcher`), per-network
+pre-compiled batched route programs with jit-cache recompile auditing
+(:mod:`~ddr_tpu.serving.service`), a stdlib HTTP JSON API with health/ready
+probes (:mod:`~ddr_tpu.serving.http_api`), and in-process/HTTP clients
+(:mod:`~ddr_tpu.serving.client`). Entry point: ``ddr serve``.
+
+Import discipline: this package (and everything reachable from
+``ServeConfig``/``MicroBatcher``/``ModelRegistry``) stays importable without
+jax; the service imports jax lazily at network-registration/warmup time.
+"""
+
+from ddr_tpu.serving.batcher import (
+    ForecastRequest,
+    MicroBatcher,
+    QueueFullError,
+    RequestShedError,
+)
+from ddr_tpu.serving.client import ForecastClient, HttpForecastClient
+from ddr_tpu.serving.config import BACKPRESSURE_POLICIES, ServeConfig
+from ddr_tpu.serving.registry import CheckpointWatcher, ModelEntry, ModelRegistry
+from ddr_tpu.serving.service import ForecastService, NetworkEntry
+
+__all__ = [
+    "BACKPRESSURE_POLICIES",
+    "CheckpointWatcher",
+    "ForecastClient",
+    "ForecastRequest",
+    "ForecastService",
+    "HttpForecastClient",
+    "MicroBatcher",
+    "ModelEntry",
+    "ModelRegistry",
+    "NetworkEntry",
+    "QueueFullError",
+    "RequestShedError",
+    "ServeConfig",
+]

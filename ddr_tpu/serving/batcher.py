@@ -1,0 +1,221 @@
+"""Request queue + micro-batcher: coalesce concurrent forecasts into compiled
+batch slots.
+
+The serving analog of continuous batching in LLM inference stacks (Orca-style;
+PAPERS.md): the expensive object is a pre-compiled batched route program, so
+the scheduler's job is to keep its batch slot full without holding fresh
+requests hostage. Mechanism only — this module knows nothing about JAX,
+networks, or events; the service supplies ``execute`` and observes decisions
+through the ``on_shed`` callback, which keeps every policy path unit-testable
+with a stub executor (tests/serving/test_batcher.py).
+
+Scheduling policy:
+
+- one bounded FIFO queue (``queue_cap``); a full queue triggers the configured
+  backpressure: ``reject-new`` fails the arriving request, ``shed-oldest``
+  fails the queue head and admits the arrival;
+- the worker takes the queue head, holds its batch open up to ``batch_wait_s``
+  for more requests with the SAME batch key (network, model), caps at
+  ``max_batch``, and preserves FIFO order across keys — a burst on network A
+  cannot starve a lone request on network B beyond one batch;
+- requests whose deadline passed while queued are shed at extraction time,
+  never executed: a late answer to a forecast request is a wrong answer;
+- ``execute`` failures fail that batch's requests individually; the worker
+  survives and keeps draining (one poisoned batch must not kill the service).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Hashable
+
+log = logging.getLogger(__name__)
+
+__all__ = ["QueueFullError", "RequestShedError", "ForecastRequest", "MicroBatcher"]
+
+
+class QueueFullError(RuntimeError):
+    """Raised to the submitter (reject-new) or set on the victim's future
+    (shed-oldest) when the bounded queue is at capacity."""
+
+
+class RequestShedError(RuntimeError):
+    """Set on a request's future when it is shed (queue-full victim or expired
+    deadline); carries the machine-readable reason."""
+
+    def __init__(self, reason: str, message: str) -> None:
+        super().__init__(message)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class ForecastRequest:
+    """One queued unit of work. ``key`` groups co-batchable requests (the
+    service uses ``(network, model)``); ``payload`` is opaque to the batcher."""
+
+    key: Hashable
+    payload: Any
+    future: Future = dataclasses.field(default_factory=Future)
+    meta: dict = dataclasses.field(default_factory=dict)
+    admitted: float = 0.0  # monotonic seconds, stamped by admit()
+    deadline: float | None = None  # monotonic seconds, None = no deadline
+
+    def age(self, now: float | None = None) -> float:
+        return (time.monotonic() if now is None else now) - self.admitted
+
+
+class MicroBatcher:
+    """Bounded FIFO queue + coalescing worker thread.
+
+    ``execute(key, requests)`` runs on the worker thread and must resolve every
+    request's future (the service's batch executor). ``on_shed(request,
+    reason)`` fires after a future is failed with :class:`RequestShedError` —
+    the observability hook.
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[Hashable, list[ForecastRequest]], None],
+        max_batch: int = 8,
+        queue_cap: int = 128,
+        batch_wait_s: float = 0.005,
+        backpressure: str = "reject-new",
+        on_shed: Callable[[ForecastRequest, str], None] | None = None,
+    ) -> None:
+        if backpressure not in ("reject-new", "shed-oldest"):
+            raise ValueError(f"unknown backpressure policy {backpressure!r}")
+        self._execute = execute
+        self.max_batch = int(max_batch)
+        self.queue_cap = int(queue_cap)
+        self.batch_wait_s = float(batch_wait_s)
+        self.backpressure = backpressure
+        self._on_shed = on_shed
+        self._q: list[ForecastRequest] = []
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._stats = {"admitted": 0, "served": 0, "shed": 0, "rejected": 0, "batches": 0}
+        self._worker = threading.Thread(
+            target=self._loop, name="ddr-serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ---- admission ----
+
+    def submit(self, req: ForecastRequest) -> ForecastRequest:
+        """Admit one request, applying backpressure; returns ``req`` with its
+        admission timestamp set. Raises :class:`QueueFullError` under
+        reject-new; under shed-oldest the queue head's future is failed
+        instead and the arrival is admitted."""
+        victim: ForecastRequest | None = None
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("batcher is shut down")
+            if len(self._q) >= self.queue_cap:
+                if self.backpressure == "reject-new":
+                    self._stats["rejected"] += 1
+                    raise QueueFullError(
+                        f"queue at capacity ({self.queue_cap}); request rejected"
+                    )
+                victim = self._q.pop(0)
+                self._stats["shed"] += 1
+            req.admitted = time.monotonic()
+            self._q.append(req)
+            self._stats["admitted"] += 1
+            self._cond.notify_all()
+        if victim is not None:
+            self._fail_shed(victim, "queue-full")
+        return req
+
+    def _fail_shed(self, req: ForecastRequest, reason: str) -> None:
+        err = RequestShedError(reason, f"request shed ({reason})")
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(err)
+        if self._on_shed is not None:
+            try:
+                self._on_shed(req, reason)
+            except Exception:  # observability must never break the data path
+                log.exception("on_shed callback failed")
+
+    # ---- worker ----
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stopping:
+                    self._cond.wait()
+                if self._stopping and not self._q:
+                    return
+                head = self._q[0]
+                key = head.key
+                # Hold the head's batch open for co-batchable arrivals, but
+                # never past batch_wait_s from NOW (the head may have queued
+                # behind earlier batches for longer than the window already).
+                hold_until = time.monotonic() + self.batch_wait_s
+                while (
+                    not self._stopping
+                    and sum(1 for r in self._q if r.key == key) < self.max_batch
+                    and time.monotonic() < hold_until
+                ):
+                    self._cond.wait(timeout=max(0.0, hold_until - time.monotonic()))
+                batch: list[ForecastRequest] = []
+                rest: list[ForecastRequest] = []
+                for r in self._q:
+                    if r.key == key and len(batch) < self.max_batch:
+                        batch.append(r)
+                    else:
+                        rest.append(r)
+                self._q = rest
+                depth = len(rest)
+                self._cond.notify_all()
+
+            now = time.monotonic()
+            live: list[ForecastRequest] = []
+            for r in batch:
+                if r.deadline is not None and now > r.deadline:
+                    with self._cond:
+                        self._stats["shed"] += 1
+                    self._fail_shed(r, "deadline")
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            for r in live:
+                r.meta["queue_depth"] = depth
+            try:
+                self._execute(key, live)
+                with self._cond:
+                    self._stats["served"] += len(live)
+                    self._stats["batches"] += 1
+            except BaseException as e:  # noqa: BLE001 - worker must survive anything
+                log.exception(f"batch executor failed for key {key!r}")
+                for r in live:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(e)
+
+    # ---- lifecycle / inspection ----
+
+    def stats(self) -> dict[str, int]:
+        with self._cond:
+            out = dict(self._stats)
+            out["depth"] = len(self._q)
+            return out
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker. ``drain=True`` serves what is already queued first;
+        ``drain=False`` sheds the backlog (reason ``queue-full``, the shutdown
+        flavor of load shedding)."""
+        with self._cond:
+            self._stopping = True
+            backlog = [] if drain else list(self._q)
+            if not drain:
+                self._q = []
+            self._cond.notify_all()
+        for r in backlog:
+            with self._cond:
+                self._stats["shed"] += 1
+            self._fail_shed(r, "queue-full")
+        self._worker.join(timeout=10.0)

@@ -1,0 +1,121 @@
+"""Forecast clients: in-process (tests, notebooks, couplings) and HTTP.
+
+:class:`ForecastClient` talks straight to a :class:`ForecastService` — no
+sockets, full backpressure semantics — which is what the serving tests hammer
+with dozens of threads. :class:`HttpForecastClient` is the same surface over
+``urllib`` against a running ``ddr serve`` (stdlib only, like the server)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from ddr_tpu.serving.service import ForecastService
+
+__all__ = ["ForecastClient", "HttpForecastClient"]
+
+
+class ForecastClient:
+    """In-process client: submit/forecast against a live service instance."""
+
+    def __init__(self, service: ForecastService) -> None:
+        self._service = service
+
+    def submit(self, **kwargs) -> Future:
+        return self._service.submit(**kwargs)
+
+    def forecast(self, timeout: float | None = None, **kwargs) -> dict:
+        """Blocking forecast; the result dict's ``runoff`` is a numpy array
+        ``(horizon, n_gauges)``."""
+        return self._service.forecast(timeout=timeout, **kwargs)
+
+    def healthy(self) -> bool:
+        return True  # in-process: alive iff we are
+
+    def ready(self) -> bool:
+        return self._service.ready
+
+    def stats(self) -> dict:
+        return self._service.stats()
+
+
+class HttpForecastClient:
+    """Minimal stdlib client for the JSON API (tests and smoke checks)."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str) -> tuple[int, dict]:
+        try:
+            with urllib.request.urlopen(
+                self.base_url + path, timeout=self.timeout
+            ) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def healthy(self) -> bool:
+        """False (not an exception) when the server is down or unreachable —
+        these two are probe loops' predicates, not RPCs."""
+        try:
+            code, _ = self._get("/healthz")
+        except urllib.error.URLError:
+            return False
+        return code == 200
+
+    def ready(self) -> bool:
+        try:
+            code, _ = self._get("/readyz")
+        except urllib.error.URLError:
+            return False
+        return code == 200
+
+    def stats(self) -> dict:
+        code, body = self._get("/v1/stats")
+        if code != 200:
+            raise RuntimeError(f"/v1/stats -> {code}: {body}")
+        return body
+
+    def forecast(
+        self,
+        network: str,
+        model: str = "default",
+        q_prime: Any | None = None,
+        t0: int | None = None,
+        gauges: list[int] | None = None,
+        deadline_ms: float | None = None,
+    ) -> dict:
+        """POST /v1/forecast; raises RuntimeError with the server's error body
+        on any non-200. ``runoff`` comes back as a numpy array."""
+        body: dict[str, Any] = {"network": network, "model": model}
+        if q_prime is not None:
+            body["q_prime"] = np.asarray(q_prime, dtype=np.float32).tolist()
+        if t0 is not None:
+            body["t0"] = int(t0)
+        if gauges is not None:
+            body["gauges"] = [int(g) for g in gauges]
+        if deadline_ms is not None:
+            body["deadline_ms"] = float(deadline_ms)
+        data = json.dumps(body).encode("utf-8")
+        req = urllib.request.Request(
+            self.base_url + "/v1/forecast",
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = json.loads(e.read() or b"{}")
+            raise RuntimeError(
+                f"forecast failed ({e.code}): {detail.get('error', detail)}"
+            ) from e
+        out["runoff"] = np.asarray(out["runoff"], dtype=np.float32)
+        return out

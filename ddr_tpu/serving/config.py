@@ -1,0 +1,110 @@
+"""Serving-layer configuration: one frozen knob set, env-overridable.
+
+Every knob has a ``DDR_SERVE_*`` environment variable (documented in
+docs/serving.md next to ``DDR_METRICS_DIR``/``DDR_HEARTBEAT_EVERY``), so a
+deployment tunes backpressure without touching the run config — the same
+convention the observability layer uses. Construction order: dataclass
+defaults < environment < explicit keyword overrides (tests pass keywords;
+operators export variables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = ["BACKPRESSURE_POLICIES", "ServeConfig"]
+
+#: Accepted ``backpressure`` values: what happens when the request queue is at
+#: ``queue_cap`` and another request arrives.
+#:
+#: - ``reject-new``: the arriving request fails immediately (callers see the
+#:   rejection and can back off — the default, load is pushed to the edge);
+#: - ``shed-oldest``: the oldest queued request is failed and the new one
+#:   admitted (freshness wins — right for forecast traffic where a stale
+#:   request's answer is about to be superseded anyway).
+BACKPRESSURE_POLICIES = ("reject-new", "shed-oldest")
+
+_ENV_PREFIX = "DDR_SERVE_"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Forecast-service knobs (env var in parentheses).
+
+    ``max_batch`` is also the compiled batch slot size: requests are padded to
+    exactly this leading dimension so every micro-batch reuses ONE jitted
+    program per (network, model) — batch-size-driven recompiles cannot exist.
+    """
+
+    #: Coalesced requests per executed batch — and the static leading dim of
+    #: the compiled program (DDR_SERVE_MAX_BATCH).
+    max_batch: int = 8
+    #: Bounded queue capacity; beyond it the backpressure policy applies
+    #: (DDR_SERVE_QUEUE_CAP).
+    queue_cap: int = 128
+    #: How long the batcher holds the queue head open for co-batchable
+    #: requests, seconds (DDR_SERVE_BATCH_WAIT_MS, milliseconds).
+    batch_wait_s: float = 0.005
+    #: Default per-request deadline from admission, seconds; expired requests
+    #: are shed, never executed (DDR_SERVE_DEADLINE_MS, milliseconds).
+    deadline_s: float = 30.0
+    #: Queue-full policy, one of :data:`BACKPRESSURE_POLICIES`
+    #: (DDR_SERVE_BACKPRESSURE).
+    backpressure: str = "reject-new"
+    #: Checkpoint-watch poll cadence, seconds (DDR_SERVE_RELOAD_POLL_MS,
+    #: milliseconds). 0 disables watching.
+    reload_poll_s: float = 2.0
+    #: HTTP bind address (DDR_SERVE_HOST).
+    host: str = "127.0.0.1"
+    #: HTTP port; 0 = ephemeral, the bound port is logged (DDR_SERVE_PORT).
+    port: int = 8080
+    #: Forecast horizon in hourly steps for networks registered without an
+    #: explicit one (DDR_SERVE_HORIZON_HOURS).
+    horizon_hours: int = 72
+
+    def __post_init__(self) -> None:
+        if self.backpressure not in BACKPRESSURE_POLICIES:
+            raise ValueError(
+                f"backpressure must be one of {BACKPRESSURE_POLICIES}, "
+                f"got {self.backpressure!r}"
+            )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.queue_cap < 1:
+            raise ValueError(f"queue_cap must be >= 1, got {self.queue_cap}")
+        if self.horizon_hours < 1:
+            raise ValueError(f"horizon_hours must be >= 1, got {self.horizon_hours}")
+
+    @classmethod
+    def from_env(cls, environ: dict | None = None, **overrides) -> "ServeConfig":
+        """Defaults < ``DDR_SERVE_*`` environment < explicit ``overrides``."""
+        env = os.environ if environ is None else environ
+
+        def _get(name: str, cast, scale: float = 1.0):
+            raw = env.get(_ENV_PREFIX + name)
+            if raw is None or raw == "":
+                return None
+            try:
+                v = cast(raw)
+            except ValueError as e:
+                raise ValueError(f"bad {_ENV_PREFIX}{name}={raw!r}: {e}") from e
+            return v * scale if scale != 1.0 else v
+
+        from_env: dict = {}
+        for key, var, cast, scale in (
+            ("max_batch", "MAX_BATCH", int, 1.0),
+            ("queue_cap", "QUEUE_CAP", int, 1.0),
+            ("batch_wait_s", "BATCH_WAIT_MS", float, 1e-3),
+            ("deadline_s", "DEADLINE_MS", float, 1e-3),
+            ("backpressure", "BACKPRESSURE", str, 1.0),
+            ("reload_poll_s", "RELOAD_POLL_MS", float, 1e-3),
+            ("host", "HOST", str, 1.0),
+            ("port", "PORT", int, 1.0),
+            ("horizon_hours", "HORIZON_HOURS", int, 1.0),
+        ):
+            v = _get(var, cast, scale)
+            if v is not None:
+                from_env[key] = v
+        from_env.update(overrides)
+        return cls(**from_env)

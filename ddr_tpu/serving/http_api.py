@@ -1,0 +1,195 @@
+"""Stdlib HTTP JSON front for :class:`~ddr_tpu.serving.service.ForecastService`.
+
+``http.server.ThreadingHTTPServer`` only — this environment installs no web
+framework, and the hot path is the compiled route program, not request
+parsing. Each connection gets a thread; all threads funnel into the service's
+micro-batcher, which is where concurrency actually coalesces.
+
+Endpoints (all JSON):
+
+- ``GET /healthz`` — process liveness (200 whenever the server answers);
+- ``GET /readyz`` — 200 after :meth:`ForecastService.warmup` completed, 503
+  before (load balancers gate traffic on this, so cold-compile latency is
+  never user-visible);
+- ``GET /v1/models`` / ``GET /v1/networks`` / ``GET /v1/stats`` — registry,
+  domains, and queue/compile/latency counters;
+- ``POST /v1/forecast`` — body ``{"network": str, "model"?: str, "q_prime"?:
+  [[...]], "t0"?: int, "gauges"?: [int], "deadline_ms"?: num}``; answers
+  ``{"runoff": [[...]], "version": int, "engine": str, ...}``.
+
+Error mapping: validation -> 400, unknown name -> 404, queue-full rejection ->
+429 (with ``Retry-After``), shed/deadline -> 503, not-warm -> 503.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from ddr_tpu.serving.batcher import QueueFullError, RequestShedError
+from ddr_tpu.serving.service import ForecastService
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ForecastHTTPServer", "serve_http"]
+
+#: Hard ceiling on request body size (a (720, 65536) float payload is ~1.9 GB
+#: of JSON — nobody means that; bulk forcings belong in a registered store).
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "ForecastHTTPServer"
+
+    # ---- plumbing ----
+
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        log.debug("http %s", format % args)
+
+    def _send(self, code: int, payload: dict, headers: dict | None = None) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):  # client went away
+            pass
+
+    # ---- GET ----
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        svc = self.server.service
+        if self.path == "/healthz":
+            self._send(200, {"status": "ok"})
+        elif self.path == "/readyz":
+            if svc.ready:
+                self._send(200, {"status": "ready"})
+            else:
+                self._send(503, {"status": "warming"})
+        elif self.path == "/v1/stats":
+            self._send(200, svc.stats())
+        elif self.path == "/v1/models":
+            self._send(200, {"models": svc.stats()["models"]})
+        elif self.path == "/v1/networks":
+            self._send(200, {"networks": svc.stats()["networks"]})
+        else:
+            self._send(404, {"error": f"no route for {self.path}"})
+
+    # ---- POST ----
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path != "/v1/forecast":
+            self._send(404, {"error": f"no route for {self.path}"})
+            return
+        svc = self.server.service
+        if not svc.ready:
+            self._send(503, {"error": "service is warming up", "status": "warming"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._send(400, {"error": "bad Content-Length"})
+            return
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._send(400, {"error": f"body must be 1..{MAX_BODY_BYTES} bytes"})
+            return
+        try:
+            body = json.loads(self.rfile.read(length))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            self._send(400, {"error": f"invalid JSON body: {e}"})
+            return
+        if not isinstance(body, dict) or "network" not in body:
+            self._send(400, {"error": 'body must be an object with "network"'})
+            return
+        deadline_ms = body.get("deadline_ms")
+        try:
+            fut = svc.submit(
+                network=str(body["network"]),
+                model=str(body.get("model", "default")),
+                q_prime=body.get("q_prime"),
+                t0=body.get("t0"),
+                gauges=body.get("gauges"),
+                deadline_s=None if deadline_ms is None else float(deadline_ms) / 1e3,
+            )
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)}, headers={"Retry-After": "1"})
+            return
+        except KeyError as e:
+            self._send(404, {"error": f"unknown model {e}"})
+            return
+        except ValueError as e:
+            code = 404 if "unknown network" in str(e) else 400
+            self._send(code, {"error": str(e)})
+            return
+        except TypeError as e:
+            # np.asarray raises TypeError (not ValueError) for e.g. a dict
+            # q_prime — still a malformed request, not a server error
+            self._send(400, {"error": f"malformed request value: {e}"})
+            return
+        try:
+            # wait slightly past the request deadline: the batcher sheds
+            # expired requests itself and that error is the informative one
+            wait = (float(deadline_ms) / 1e3 if deadline_ms is not None
+                    else svc.serve_cfg.deadline_s) + 5.0
+            result = fut.result(timeout=wait)
+        except RequestShedError as e:
+            self._send(503, {"error": str(e), "reason": e.reason})
+            return
+        except FutureTimeoutError:
+            self._send(503, {"error": "request timed out in service"})
+            return
+        except Exception as e:  # executor failure surfaced on the future
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        result = dict(result)
+        result["runoff"] = np.asarray(result["runoff"]).tolist()
+        self._send(200, result)
+
+
+class ForecastHTTPServer(ThreadingHTTPServer):
+    """Threaded HTTP server bound to one :class:`ForecastService`."""
+
+    daemon_threads = True
+
+    def __init__(self, service: ForecastService, host: str, port: int) -> None:
+        self.service = service
+        super().__init__((host, port), _Handler)
+
+    @property
+    def url(self) -> str:
+        host, port = self.server_address[:2]
+        return f"http://{host}:{port}"
+
+
+def serve_http(
+    service: ForecastService,
+    host: str | None = None,
+    port: int | None = None,
+    block: bool = False,
+) -> ForecastHTTPServer:
+    """Start the HTTP front (ServeConfig host/port defaults; ``port=0`` binds
+    an ephemeral port — tests read ``server.url``). ``block=True`` runs
+    ``serve_forever`` on this thread (the ``ddr serve`` CLI); otherwise a
+    daemon thread serves and the server object is returned for shutdown."""
+    host = service.serve_cfg.host if host is None else host
+    port = service.serve_cfg.port if port is None else port
+    server = ForecastHTTPServer(service, host, port)
+    log.info(f"forecast API listening on {server.url}")
+    if block:
+        server.serve_forever()
+        return server
+    thread = threading.Thread(
+        target=server.serve_forever, name="ddr-serve-http", daemon=True
+    )
+    thread.start()
+    return server

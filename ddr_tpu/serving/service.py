@@ -1,0 +1,621 @@
+"""ForecastService: registered networks × hot-reloadable models, served from
+pre-compiled batched route programs.
+
+The paper's core asset — a cheap jitted Muskingum-Cunge step over a fixed
+network topology — amortizes under request batching exactly like a compiled
+LLM decode step: topology (and the batch slot shape) is the compile key, KAN
+params and forcings are arguments. The service holds, per registered
+``(network, model)`` pair, ONE jitted program
+
+    serve_fn(kan_params, q_prime_batch) -> gauge_runoff_batch
+
+with a static ``(max_batch, horizon, n_reaches)`` input slot (requests are
+zero-padded into it), so after :meth:`ForecastService.warmup` there is exactly
+one compile per pair and NO request-driven recompiles — audited live by the
+PR-1 :class:`~ddr_tpu.observability.recompile.CompileTracker` (``compile``
+events on any jit-cache growth; the e2e test asserts zero after warmup).
+
+Engines: single-host serving routes through the single-chip auto-selection
+(:func:`ddr_tpu.routing.model.prepare_batch` — wavefront / depth-chunked /
+stacked by topology). With ``experiment.parallel != "none"`` the service
+instead dispatches through :func:`ddr_tpu.parallel.select.route_parallel` over
+the configured mesh, so the documented multi-chip policy (gspmd on host
+meshes, sharded-wavefront / stacked-sharded on accelerators) decides the
+engine per network; its per-topology plan cache plays the jit cache's role and
+is growth-tracked the same way.
+
+Every admit/batch/serve/shed decision is a JSONL event (``serve_request``,
+``serve_batch``, ``serve_shed`` — docs/observability.md) on the active
+recorder, so ``ddr metrics summarize`` reports request latency percentiles and
+batch occupancy with no extra wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from ddr_tpu.observability import CompileTracker, get_recorder, span
+from ddr_tpu.serving.batcher import (
+    ForecastRequest,
+    MicroBatcher,
+    QueueFullError,
+    RequestShedError,
+)
+from ddr_tpu.serving.config import ServeConfig
+from ddr_tpu.serving.registry import ModelRegistry
+
+log = logging.getLogger(__name__)
+
+__all__ = ["NetworkEntry", "ForecastService", "QueueFullError", "RequestShedError"]
+
+
+@dataclasses.dataclass
+class NetworkEntry:
+    """One registered routing domain: topology + channel physics + forcing
+    source, with the serve-time static structures built once at registration."""
+
+    name: str
+    rd: Any  # RoutingData
+    forcing: np.ndarray | None  # (T_total, N) hourly lateral inflow, or None
+    horizon: int  # hourly steps per forecast (the compiled T)
+    network: Any  # built routing network (engine auto-selected)
+    channels: Any  # ChannelState
+    gauge_index: Any | None  # GaugeIndex, or None = full-domain outputs
+    engine: str  # single-chip engine kind baked into the program
+    mesh_policy: str  # what parallel/select's policy picks for this topology
+    topology_key: str  # shared topology sha (compile-event key)
+
+    @property
+    def n_segments(self) -> int:
+        return int(self.rd.n_segments)
+
+    @property
+    def n_outputs(self) -> int:
+        """Output columns: gauges when the network carries a gauge set, else
+        every reach."""
+        return self.gauge_index.n_gauges if self.gauge_index is not None else self.n_segments
+
+
+def _engine_kind(network: Any) -> str:
+    from ddr_tpu.routing.chunked import ChunkedNetwork
+    from ddr_tpu.routing.stacked import StackedChunked
+
+    if isinstance(network, StackedChunked):
+        return "stacked"
+    if isinstance(network, ChunkedNetwork):
+        return "chunked"
+    return "wavefront" if getattr(network, "wavefront", False) else "step"
+
+
+class ForecastService:
+    """Batched, hot-reloadable forecast serving over registered networks.
+
+    Lifecycle: construct -> :meth:`register_network` / :meth:`register_model`
+    (+ optional :meth:`watch_checkpoints`) -> :meth:`warmup` -> submit traffic
+    (:meth:`submit` / :meth:`forecast`, or the HTTP front in
+    :mod:`ddr_tpu.serving.http_api`) -> :meth:`close`.
+    """
+
+    def __init__(self, cfg: Any, serve_cfg: ServeConfig | None = None) -> None:
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg or ServeConfig.from_env()
+        self.registry = ModelRegistry()
+        self.tracker = CompileTracker()
+        self._networks: dict[str, NetworkEntry] = {}
+        self._fns: dict[tuple[str, str], Any] = {}  # (network, model) -> jitted fn
+        self._plan_sizes: dict[str, int] = {}  # mesh mode: plan-cache growth watch
+        self._lock = threading.Lock()
+        self._ready = False
+        self._mesh = None
+        self._parallel = getattr(getattr(cfg, "experiment", None), "parallel", "none")
+        if self._parallel != "none":
+            from ddr_tpu.parallel.sharding import make_mesh
+            from ddr_tpu.parallel.train import ensure_device_platform, parse_device
+
+            ensure_device_platform(cfg.device)
+            _, n_dev = parse_device(cfg.device)
+            self._mesh = make_mesh(n_dev)
+        self._batcher = MicroBatcher(
+            execute=self._execute,
+            max_batch=self.serve_cfg.max_batch,
+            queue_cap=self.serve_cfg.queue_cap,
+            batch_wait_s=self.serve_cfg.batch_wait_s,
+            backpressure=self.serve_cfg.backpressure,
+            on_shed=self._on_shed,
+        )
+
+    # ---- registration ----
+
+    def register_network(
+        self,
+        name: str,
+        routing_data: Any,
+        forcing: np.ndarray | None = None,
+        horizon: int | None = None,
+    ) -> NetworkEntry:
+        """Register a routing domain. ``forcing`` (hourly ``(T_total, N)``)
+        lets requests reference a time window (``t0``) instead of shipping a
+        full q_prime payload; ``horizon`` fixes the compiled forecast length
+        (default: the ServeConfig horizon, capped to the forcing length)."""
+        import jax
+
+        from ddr_tpu.parallel.partition import topology_sha
+        from ddr_tpu.parallel.select import select_for_topology
+        from ddr_tpu.routing.model import prepare_batch
+
+        rd = routing_data
+        if forcing is not None:
+            forcing = np.asarray(forcing, dtype=np.float32)
+            if forcing.ndim != 2 or forcing.shape[1] != rd.n_segments:
+                raise ValueError(
+                    f"forcing must be (T, {rd.n_segments}), got {forcing.shape}"
+                )
+        if horizon is None:
+            horizon = self.serve_cfg.horizon_hours
+            if forcing is not None:
+                horizon = min(horizon, len(forcing))
+        if horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        if forcing is not None and len(forcing) < horizon:
+            raise ValueError(
+                f"forcing covers {len(forcing)} hourly steps < horizon {horizon}"
+            )
+        network, channels, gauge_index = prepare_batch(
+            rd, slope_min=self.cfg.params.attribute_minimums["slope"]
+        )
+        platform = jax.devices()[0].platform
+        mesh_policy = select_for_topology(
+            platform,
+            np.asarray(rd.adjacency_rows),
+            np.asarray(rd.adjacency_cols),
+            rd.n_segments,
+            n_shards=jax.device_count(),
+        )
+        entry = NetworkEntry(
+            name=name,
+            rd=rd,
+            forcing=forcing,
+            horizon=int(horizon),
+            network=network,
+            channels=channels,
+            gauge_index=gauge_index,
+            engine=_engine_kind(network),
+            mesh_policy=mesh_policy,
+            topology_key=topology_sha(rd),
+        )
+        with self._lock:
+            if name in self._networks:
+                raise ValueError(f"network {name!r} is already registered")
+            self._networks[name] = entry
+            self._ready = False  # new pair needs a warmup pass
+        log.info(
+            f"registered network {name!r}: {rd.n_segments} reaches, horizon "
+            f"{entry.horizon}h, engine {entry.engine} (mesh policy: {mesh_policy})"
+        )
+        return entry
+
+    def register_model(
+        self,
+        name: str,
+        kan_model: Any,
+        params: Any,
+        arch: dict | None = None,
+        source: str | None = None,
+    ):
+        with self._lock:
+            self._ready = False
+        return self.registry.register(name, kan_model, params, arch=arch, source=source)
+
+    def watch_checkpoints(self, name: str, directory, poll_s: float | None = None):
+        """Hot-reload ``name`` from the newest checkpoint under ``directory``
+        (ServeConfig ``reload_poll_s`` cadence; 0 disables)."""
+        poll = self.serve_cfg.reload_poll_s if poll_s is None else poll_s
+        if poll <= 0:
+            log.info("checkpoint watching disabled (reload_poll_s <= 0)")
+            return None
+        return self.registry.watch(name, directory, poll_s=poll)
+
+    # ---- warmup / readiness ----
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    def networks(self) -> dict[str, NetworkEntry]:
+        with self._lock:
+            return dict(self._networks)
+
+    def warmup(self) -> None:
+        """Compile every (network, model) pair's batched program now, so first
+        request latency is bounded by execution, not XLA. Each pair emits
+        exactly one ``compile`` event here; the e2e contract is zero after."""
+        pairs = [
+            (net, model)
+            for net in self.networks().values()
+            for model in self.registry.names()
+        ]
+        if not pairs:
+            raise RuntimeError("nothing to warm: register a network and a model first")
+        for net, model in pairs:
+            with span(f"serve-warmup/{net.name}/{model}"):
+                t0 = time.perf_counter()
+                zeros = np.zeros(
+                    (self.serve_cfg.max_batch, net.horizon, net.n_segments),
+                    dtype=np.float32,
+                )
+                self._run_batch(net, self.registry.get(model), zeros, warmup=True)
+                log.info(
+                    f"warmed ({net.name}, {model}) [{self._engine_label(net)}] in "
+                    f"{time.perf_counter() - t0:.2f}s"
+                )
+        with self._lock:
+            self._ready = True
+
+    # ---- request path ----
+
+    def submit(
+        self,
+        network: str,
+        model: str = "default",
+        q_prime: Any | None = None,
+        t0: int | None = None,
+        gauges: Any | None = None,
+        deadline_s: float | None = None,
+    ) -> Future:
+        """Admit one forecast request; returns its Future.
+
+        Exactly one of ``q_prime`` (a full ``(horizon, N)`` forcing payload)
+        or ``t0`` (an hourly offset into the network's registered forcing;
+        default 0) selects the inflow window. ``gauges`` picks output columns
+        (gauge indices when the network has a gauge set, reach indices
+        otherwise; default all). Invalid requests raise immediately —
+        validation failures are the caller's bug, not load."""
+        net = self._networks.get(network)
+        if net is None:
+            raise ValueError(f"unknown network {network!r}")
+        self.registry.get(model)  # raises KeyError on unknown models
+        if q_prime is not None and t0 is not None:
+            raise ValueError("pass q_prime or t0, not both")
+        if q_prime is not None:
+            qp = np.asarray(q_prime, dtype=np.float32)
+            if qp.shape != (net.horizon, net.n_segments):
+                raise ValueError(
+                    f"q_prime must be ({net.horizon}, {net.n_segments}), got {qp.shape}"
+                )
+        else:
+            if net.forcing is None:
+                raise ValueError(
+                    f"network {network!r} has no registered forcing; requests "
+                    "must carry q_prime"
+                )
+            start = 0 if t0 is None else int(t0)
+            if not 0 <= start <= len(net.forcing) - net.horizon:
+                raise ValueError(
+                    f"t0={start} out of range for forcing of {len(net.forcing)} "
+                    f"hourly steps and horizon {net.horizon}"
+                )
+            qp = net.forcing[start : start + net.horizon]
+        if gauges is None:
+            gauge_sel = None
+        else:
+            gauge_sel = np.asarray(gauges, dtype=np.int64).ravel()
+            if gauge_sel.size == 0:
+                raise ValueError("gauges must be a non-empty index list (or omitted)")
+            if gauge_sel.min() < 0 or gauge_sel.max() >= net.n_outputs:
+                raise ValueError(
+                    f"gauge index out of range [0, {net.n_outputs}) for "
+                    f"network {network!r}"
+                )
+        deadline = time.monotonic() + (
+            self.serve_cfg.deadline_s if deadline_s is None else float(deadline_s)
+        )
+        req = ForecastRequest(
+            key=(network, model),
+            payload={"q_prime": qp, "gauges": gauge_sel},
+            deadline=deadline,
+            meta={"network": network, "model": model},
+        )
+        try:
+            self._batcher.submit(req)
+        except QueueFullError:
+            self._emit(
+                "serve_shed",
+                reason="queue-full",
+                policy=self.serve_cfg.backpressure,
+                network=network,
+                model=model,
+                age_s=0.0,
+            )
+            self._emit(
+                "serve_request",
+                status="shed:queue-full",
+                network=network,
+                model=model,
+                latency_s=0.0,
+            )
+            raise
+        return req.future
+
+    def forecast(self, timeout: float | None = None, **kwargs) -> dict:
+        """Blocking convenience wrapper over :meth:`submit` (the in-process
+        client path)."""
+        return self.submit(**kwargs).result(timeout=timeout)
+
+    # ---- execution (batcher worker thread) ----
+
+    def _engine_label(self, net: NetworkEntry) -> str:
+        """The (network, engine) pair name used for compile accounting."""
+        engine = net.mesh_policy if self._mesh is not None else net.engine
+        return f"{net.name}:{engine}"
+
+    def _execute(self, key: tuple, reqs: list[ForecastRequest]) -> None:
+        try:
+            self._execute_inner(key, reqs)
+        except BaseException as e:
+            # the batcher fails the futures; telemetry must still account for
+            # every admitted request reaching a terminal state
+            now = time.monotonic()
+            for r in reqs:
+                self._emit(
+                    "serve_request",
+                    status=f"error:{type(e).__name__}",
+                    network=r.meta.get("network"),
+                    model=r.meta.get("model"),
+                    latency_s=round(now - r.admitted, 6),
+                )
+            raise
+
+    def _execute_inner(self, key: tuple, reqs: list[ForecastRequest]) -> None:
+        network_name, model_name = key
+        net = self._networks[network_name]
+        entry = self.registry.get(model_name)  # ONE snapshot for the whole batch
+        mb = self.serve_cfg.max_batch
+        qp = np.zeros((mb, net.horizon, net.n_segments), dtype=np.float32)
+        for i, r in enumerate(reqs):
+            qp[i] = r.payload["q_prime"]
+        with span(f"serve-batch/{network_name}", emit=False):
+            t0 = time.perf_counter()
+            # (>= len(reqs), T, n_outputs); the jitted path returns the full
+            # padded slot, the mesh path only the live rows
+            runoff = self._run_batch(net, entry, qp, n_live=len(reqs))
+            seconds = time.perf_counter() - t0
+        now = time.monotonic()
+        # All telemetry is written BEFORE any future resolves: a client that
+        # reads the run log right after its result must find its own events.
+        self._emit(
+            "serve_batch",
+            network=network_name,
+            model=model_name,
+            engine=self._engine_label(net),
+            size=len(reqs),
+            occupancy=round(len(reqs) / mb, 4),
+            seconds=round(seconds, 6),
+            version=entry.version,
+            queue_depth=reqs[0].meta.get("queue_depth"),
+        )
+        outs = []
+        for i, r in enumerate(reqs):
+            sel = r.payload["gauges"]
+            out = runoff[i] if sel is None else runoff[i][:, sel]
+            outs.append(out)
+            self._emit(
+                "serve_request",
+                status="ok",
+                network=network_name,
+                model=model_name,
+                latency_s=round(now - r.admitted, 6),
+                version=entry.version,
+                n_gauges=int(out.shape[1]),
+            )
+        for r, out in zip(reqs, outs):
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(
+                    {
+                        "runoff": out,
+                        "network": network_name,
+                        "model": model_name,
+                        "version": entry.version,
+                        "engine": self._engine_label(net),
+                    }
+                )
+
+    def _run_batch(
+        self,
+        net: NetworkEntry,
+        entry,
+        qp: np.ndarray,
+        n_live: int | None = None,
+        warmup: bool = False,
+    ) -> np.ndarray:
+        """Route one padded batch; returns host ``(>= n_live, T, n_outputs)``.
+        Every call feeds the compile tracker, so any post-warmup cache growth
+        surfaces as a ``compile`` event."""
+        import jax
+
+        t0 = time.perf_counter()
+        label = self._engine_label(net)
+        if self._mesh is not None:
+            # pad rows carry no request; the mesh path has no batch-shape
+            # compile key, so only live rows are routed (warmup routes one —
+            # the plan compile is per topology, not per row)
+            rows = 1 if warmup else (qp.shape[0] if n_live is None else n_live)
+            out = self._run_batch_mesh(net, entry, qp[:rows])
+            self._track_plan_cache(
+                label, net, time.perf_counter() - t0 if warmup else 0.0
+            )
+        else:
+            fn = self._serve_fn(net, entry)
+            out = np.asarray(jax.block_until_ready(fn(entry.params, qp)))
+            # jit-cache growth is per compiled fn = per (network, model) pair;
+            # a shared network:engine key would count a second model's warmup
+            # as a hit and mask its (real) compile
+            self.tracker.track_jit(
+                f"{net.name}/{entry.name}:{net.engine}", fn, key=net.topology_key,
+                seconds=round(time.perf_counter() - t0, 4) if warmup else 0.0,
+            )
+        return out
+
+    def _serve_fn(self, net: NetworkEntry, entry):
+        """The (network, model) pair's jitted batched program (built once)."""
+        cache_key = (net.name, entry.name)
+        fn = self._fns.get(cache_key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ddr_tpu.routing.mc import Bounds, route
+        from ddr_tpu.routing.model import denormalize_spatial_parameters
+
+        attrs = jnp.asarray(net.rd.normalized_spatial_attributes)
+        scale = (
+            None
+            if net.rd.flow_scale is None
+            else jnp.asarray(net.rd.flow_scale, jnp.float32)
+        )
+        bounds = Bounds.from_config(self.cfg.params.attribute_minimums)
+        p = self.cfg.params
+        kan_model, network, channels, gauges = (
+            entry.kan_model, net.network, net.channels, net.gauge_index,
+        )
+        n = net.n_segments
+
+        def _serve(kan_params, q_prime_b):  # (B, T, N) -> (B, T, n_outputs)
+            raw = kan_model.apply(kan_params, attrs)
+            phys = denormalize_spatial_parameters(
+                raw, p.parameter_ranges, p.log_space_parameters, p.defaults, n
+            )
+
+            def one(qp):
+                if scale is not None:
+                    qp = qp * scale[None, :]
+                return route(
+                    network, channels, phys, qp, gauges=gauges, bounds=bounds
+                ).runoff
+
+            return jax.vmap(one)(q_prime_b)
+
+        fn = jax.jit(_serve)
+        with self._lock:
+            self._fns[cache_key] = fn
+        return fn
+
+    def _run_batch_mesh(self, net: NetworkEntry, entry, qp: np.ndarray) -> np.ndarray:
+        """Mesh-mode execution: the policy-selected multi-chip engine via
+        route_parallel's per-topology plan cache, one request at a time (the
+        reach dimension, not the batch, is what the mesh parallelizes)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ddr_tpu.parallel.select import route_parallel
+        from ddr_tpu.routing.mc import Bounds
+        from ddr_tpu.routing.model import denormalize_spatial_parameters
+
+        raw = entry.kan_model.apply(
+            entry.params, jnp.asarray(net.rd.normalized_spatial_attributes)
+        )
+        p = self.cfg.params
+        phys = denormalize_spatial_parameters(
+            raw, p.parameter_ranges, p.log_space_parameters, p.defaults, net.n_segments
+        )
+        bounds = Bounds.from_config(p.attribute_minimums)
+        engine = None if self._parallel == "auto" else self._parallel
+        outs = []
+        for b in range(qp.shape[0]):
+            q = jnp.asarray(qp[b])
+            if net.rd.flow_scale is not None:
+                q = q * jnp.asarray(net.rd.flow_scale, jnp.float32)[None, :]
+            res = route_parallel(
+                self._mesh, net.rd, net.channels, phys, q,
+                bounds=bounds, engine=engine,
+            )
+            runoff = res.runoff  # (T, N) original order
+            if net.gauge_index is not None:
+                runoff = jax.vmap(net.gauge_index.aggregate)(runoff)
+            outs.append(runoff)
+        return np.asarray(jax.block_until_ready(jnp.stack(outs)))
+
+    def _track_plan_cache(self, label: str, net: NetworkEntry, seconds: float) -> None:
+        """Mesh-mode recompile audit: route_parallel's plan cache is the compile
+        cache. Growth is read from the MONOTONIC build counter, not the cache
+        size — size pins at the LRU cap while eviction churn keeps rebuilding
+        plans, which would record a recompile storm as all-hits. The counter is
+        global, so one shared watermark attributes each build to the label that
+        ran it (per-label watermarks would emit phantom misses whenever another
+        network's warmup built a plan in between)."""
+        from ddr_tpu.parallel.select import _plan_cache, plan_build_count
+
+        builds = plan_build_count()
+        prev = self._plan_sizes.get("__builds__")
+        self._plan_sizes["__builds__"] = builds
+        if prev is None or builds > prev:
+            self.tracker.miss(
+                label, key=net.topology_key, seconds=round(seconds, 4),
+                cache_entries=len(_plan_cache()), source="plan-cache",
+            )
+        else:
+            self.tracker.hit(label)
+
+    # ---- observability / lifecycle ----
+
+    def _on_shed(self, req: ForecastRequest, reason: str) -> None:
+        self._emit(
+            "serve_shed",
+            reason=reason,
+            policy=self.serve_cfg.backpressure,
+            network=req.meta.get("network"),
+            model=req.meta.get("model"),
+            age_s=round(req.age(), 6),
+        )
+        self._emit(
+            "serve_request",
+            status=f"shed:{reason}",
+            network=req.meta.get("network"),
+            model=req.meta.get("model"),
+            latency_s=round(req.age(), 6),
+        )
+
+    @staticmethod
+    def _emit(event: str, **payload) -> None:
+        rec = get_recorder()
+        if rec is not None:
+            rec.emit(event, **payload)
+
+    def stats(self) -> dict:
+        """Queue/served/shed counters, compile accounting, model versions —
+        the /v1/stats payload."""
+        hits, misses = self.tracker.counts()
+        return {
+            "ready": self._ready,
+            "queue": self._batcher.stats(),
+            "compiles": {"hits": hits, "misses": misses, **self.tracker.snapshot()},
+            "models": {
+                entry.name: {"version": entry.version, "source": entry.source}
+                for entry in (
+                    self.registry.get(n) for n in self.registry.names()
+                )  # one snapshot per model: version and source stay paired
+            },
+            "networks": {
+                name: {
+                    "n_reaches": net.n_segments,
+                    "horizon": net.horizon,
+                    "engine": self._engine_label(net),
+                    "n_outputs": net.n_outputs,
+                }
+                for name, net in self.networks().items()
+            },
+        }
+
+    def close(self, drain: bool = True) -> None:
+        self.registry.close()
+        self._batcher.close(drain=drain)
+        rec = get_recorder()
+        if rec is not None:
+            rec.merge_summary("serve", self.stats())

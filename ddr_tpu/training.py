@@ -18,6 +18,7 @@ one-day misalignment on an autocorrelated signal.
 from __future__ import annotations
 
 import json
+import os
 import pickle
 from pathlib import Path
 from typing import Any
@@ -328,8 +329,13 @@ def save_state(
         "rng_state": rng_state,
         "arch": arch,
     }
-    with path.open("wb") as f:
+    # tmp + atomic rename: concurrent readers (the serving layer's
+    # CheckpointWatcher polls this directory) must never observe a
+    # half-written blob under the final name
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("wb") as f:
         pickle.dump(blob, f)
+    os.replace(tmp, path)
     return path
 
 

@@ -112,6 +112,51 @@ class TestXarrayConventionGroup:
         assert arr.shape == qr.shape  # (ids, time) again
         np.testing.assert_array_equal(np.asarray(arr), qr)
 
+    def test_transposed_array_numpy2_copy_kwarg(self, tmp_path):
+        """NumPy 2 calls __array__(dtype, copy=...); a 1-arg signature raises
+        TypeError there (advisor r5). Both copy flavors must materialize."""
+        ids, qr = _xarray_style_store(tmp_path / "ic", transposed=True)
+        adapted = XarrayConventionGroup(zarrlite.open_group(tmp_path / "ic"))
+        arr = adapted["Qr"]
+        # np.asarray(..., copy=...) only forwards on NumPy 2; call directly so
+        # the contract is pinned on NumPy 1 environments too
+        np.testing.assert_array_equal(arr.__array__(copy=False), qr)
+        np.testing.assert_array_equal(arr.__array__(copy=True), qr)
+        out = arr.__array__(dtype=np.float64, copy=None)
+        assert out.dtype == np.float64
+        np.testing.assert_allclose(out, qr)
+
+    def test_self_dimensioned_coordinates_hidden_from_keys(self, tmp_path):
+        """xarray coordinate variables are exactly the arrays named after their
+        own dimension; any such 1-D array must vanish from keys() like the
+        id/time coords (advisor r5) — while 1-D DATA variables over the id dim
+        stay visible."""
+        _xarray_style_store(tmp_path / "ic")
+        g = zarrlite.open_group(tmp_path / "ic")
+        g.create_array(
+            "ensemble", np.arange(3), attributes={"_ARRAY_DIMENSIONS": ["ensemble"]}
+        )
+        g.create_array(
+            "lat", np.linspace(30, 40, 5),
+            attributes={"_ARRAY_DIMENSIONS": ["divide_id"]},
+        )
+        adapted = XarrayConventionGroup(zarrlite.open_group(tmp_path / "ic"))
+        assert sorted(adapted.keys()) == ["Qr", "lat"]
+        assert "ensemble" in adapted  # hidden from iteration, still addressable
+
+    def test_rejects_non_uniform_time_axis(self, tmp_path):
+        """freq must come from the WHOLE axis: a daily store with a gap would
+        otherwise be stamped 'D' and silently mis-index every window past the
+        gap (advisor r5)."""
+        g = zarrlite.create_group(tmp_path / "gap")
+        g.create_array("divide_id", np.arange(3, dtype=np.int64))
+        g.create_array(
+            "time", np.array([0, 1, 2, 5, 6], dtype=np.int64),
+            attributes={"units": "days since 1980-01-01"},
+        )
+        with pytest.raises(ValueError, match="not uniform"):
+            XarrayConventionGroup(zarrlite.open_group(tmp_path / "gap"))
+
     def test_rejects_sub_daily_non_hourly_cadence(self, tmp_path):
         """A 6-hourly store must refuse, not silently mislabel as daily."""
         g = zarrlite.create_group(tmp_path / "ic6h")

@@ -161,6 +161,37 @@ class TestDistributedFlagParsing:
             distributed_env({"DDR_DISTRIBUTED": "maybe"})
 
 
+class TestCpuCountDivisibility:
+    """advisor r5: cpu:N under a multi-process launch must not silently
+    ceil-divide — ceil(n/p)*p > n would build a larger global device set than
+    `device` names and every mesh sized from it mis-shards."""
+
+    def _launch_env(self, monkeypatch, n_procs: int):
+        monkeypatch.setenv("DDR_COORDINATOR", "127.0.0.1:9999")
+        monkeypatch.setenv("DDR_NUM_PROCESSES", str(n_procs))
+        monkeypatch.setenv("DDR_PROCESS_ID", "0")
+
+    def test_indivisible_count_raises(self, monkeypatch):
+        from ddr_tpu.parallel.train import ensure_device_platform
+
+        self._launch_env(monkeypatch, 2)
+        with pytest.raises(ValueError, match="not divisible by the process count"):
+            ensure_device_platform("cpu:7")
+
+    def test_error_names_nearest_valid_counts(self, monkeypatch):
+        from ddr_tpu.parallel.train import ensure_device_platform
+
+        self._launch_env(monkeypatch, 4)
+        with pytest.raises(ValueError, match=r"cpu:4 or cpu:8"):
+            ensure_device_platform("cpu:6")
+
+    def test_divisible_count_accepted(self, monkeypatch):
+        from ddr_tpu.parallel.train import ensure_device_platform
+
+        self._launch_env(monkeypatch, 2)
+        ensure_device_platform("cpu:8")  # 4 per process: no raise
+
+
 ORBAX_WORKER = r"""
 import json, sys
 

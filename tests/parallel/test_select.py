@@ -104,6 +104,41 @@ class TestRouteParallel:
         with pytest.raises(ValueError, match="unknown parallel engine"):
             route_parallel(mesh, rd, channels, spatial, qp, engine="bogus")
 
+    def test_plan_cache_verifies_mesh_identity(self):
+        """advisor r5: the plan cache keys on id(mesh); a recycled address
+        (new mesh object inheriting a dead mesh's id) must NOT hit the stale
+        plan. Entries store (mesh, plan) and a hit verifies `is` identity —
+        simulated here by planting a poisoned entry under the live mesh's key."""
+        from ddr_tpu.parallel.select import _plan_cache, _topology_key
+
+        mesh, rd, channels, spatial, qp = self._problem(n=64, depth=None, T=2)
+        from ddr_tpu.routing.mc import Bounds
+
+        key = _topology_key(rd, N_DEV, "gspmd", Bounds(), mesh)
+
+        def poisoned_plan(*a, **k):
+            raise AssertionError("stale plan from a recycled mesh id was executed")
+
+        # the cached mesh is a DIFFERENT object that (by simulation) produced
+        # the same key — exactly what id() reuse after GC looks like
+        other_mesh = object()
+        _plan_cache()[key] = (other_mesh, poisoned_plan)
+        res = route_parallel(mesh, rd, channels, spatial, qp, engine="gspmd")
+        assert res.runoff.shape == (2, 64)  # rebuilt, not poisoned
+        cached_mesh, _ = _plan_cache()[key]
+        assert cached_mesh is mesh  # the rebuild replaced the stale entry
+
+    def test_plan_cache_reuses_plan_for_same_mesh(self):
+        """Sanity check on the fix: identity verification must not defeat the
+        cache — a repeat call with the SAME mesh reuses the entry."""
+        from ddr_tpu.parallel.select import _plan_cache
+
+        mesh, rd, channels, spatial, qp = self._problem(n=64, depth=None, T=2)
+        route_parallel(mesh, rd, channels, spatial, qp, engine="gspmd")
+        size = len(_plan_cache())
+        route_parallel(mesh, rd, channels, spatial, qp, engine="gspmd")
+        assert len(_plan_cache()) == size
+
 
 def test_auto_mode_resolves_per_policy(tmp_path):
     """experiment.parallel=auto through ParallelTrainer: on the CPU mesh the

@@ -231,7 +231,10 @@ class TestShardedTrainStep:
         opt_state = optimizer.init(params)
         _, _, loss_swf, daily_swf = step(params, opt_state, attrs, q_prime, obs, mask)
         _, _, loss_ref, daily_ref = ref_step(params, opt_state, attrs, q_prime, obs, mask)
-        assert float(loss_swf) == pytest.approx(float(loss_ref), rel=1e-4)
+        # abs floor matches the daily tolerance below: near-zero losses (the
+        # twin setup routes to ~machine-eps L1) differ by summation order
+        # between the sharded and single-program schedules
+        assert float(loss_swf) == pytest.approx(float(loss_ref), rel=1e-4, abs=1e-6)
         np.testing.assert_allclose(
             np.asarray(daily_swf), np.asarray(daily_ref), rtol=2e-4, atol=1e-4
         )

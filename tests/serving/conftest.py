@@ -1,0 +1,95 @@
+"""Serving-layer fixtures: a minimal Config, synthetic-basin services, and an
+active telemetry recorder whose JSONL the tests read back."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin
+from ddr_tpu.observability import Recorder, activate, deactivate
+from ddr_tpu.serving import ForecastService, ServeConfig
+from ddr_tpu.validation.configs import Config
+
+
+def make_cfg(tmp_path, **overrides) -> Config:
+    d = {
+        "name": "serve_test",
+        "geodataset": "synthetic",
+        "mode": "testing",
+        "kan": {"input_var_names": [f"a{i}" for i in range(10)]},
+        "experiment": {"start_time": "1981/10/01", "end_time": "1981/10/10"},
+        "params": {"save_path": str(tmp_path)},
+    }
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(d.get(k), dict):
+            d[k].update(v)
+        else:
+            d[k] = v
+    return Config(**d)
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return make_cfg(tmp_path)
+
+
+@pytest.fixture
+def service_factory(tmp_path):
+    """Build a ForecastService over a fresh synthetic basin; every service is
+    closed (backlog shed) at teardown regardless of test outcome."""
+    created: list[ForecastService] = []
+
+    def make(
+        n_segments: int = 48,
+        horizon: int = 12,
+        n_days: int = 4,
+        warmup: bool = True,
+        cfg: Config | None = None,
+        **serve_kw,
+    ) -> ForecastService:
+        from ddr_tpu.scripts.common import build_kan, kan_arch
+
+        cfg = cfg or make_cfg(tmp_path)
+        basin = make_basin(n_segments=n_segments, n_gauges=4, n_days=n_days, seed=1)
+        kan_model, params = build_kan(cfg)
+        serve_kw.setdefault("max_batch", 4)
+        serve_kw.setdefault("batch_wait_s", 0.002)
+        svc = ForecastService(
+            cfg, ServeConfig(horizon_hours=horizon, **serve_kw)
+        )
+        svc.register_network("default", basin.routing_data, forcing=basin.q_prime)
+        svc.register_model("default", kan_model, params, arch=kan_arch(cfg))
+        if warmup:
+            svc.warmup()
+        created.append(svc)
+        return svc
+
+    yield make
+    for svc in created:
+        svc.close(drain=False)
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """An ACTIVE Recorder; yields the log path for read-back via events_of."""
+    path = tmp_path / "run_log.serve.jsonl"
+    rec = Recorder(path)
+    activate(rec)
+    yield path
+    deactivate(rec)
+    rec.close()
+
+
+def events_of(path, *types: str) -> list[dict]:
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if not types or ev.get("event") in types:
+                out.append(ev)
+    return out

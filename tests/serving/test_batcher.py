@@ -1,0 +1,213 @@
+"""Micro-batcher mechanism tests: coalescing, deadlines, both backpressure
+policies, executor-failure isolation — all against a stub executor (no jax)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ddr_tpu.serving.batcher import (
+    ForecastRequest,
+    MicroBatcher,
+    QueueFullError,
+    RequestShedError,
+)
+from ddr_tpu.serving.config import ServeConfig
+
+
+class _RecordingExecutor:
+    """Stub executor: records (key, size) per batch, resolves every future."""
+
+    def __init__(self, delay: float = 0.0, fail_keys: set | None = None) -> None:
+        self.batches: list[tuple[object, int]] = []
+        self.delay = delay
+        self.fail_keys = fail_keys or set()
+        self.gate: threading.Event | None = None
+
+    def __call__(self, key, reqs) -> None:
+        if self.gate is not None:
+            assert self.gate.wait(timeout=5.0), "executor gate never opened"
+        if self.delay:
+            time.sleep(self.delay)
+        if key in self.fail_keys:
+            raise RuntimeError(f"executor poisoned for {key!r}")
+        self.batches.append((key, len(reqs)))
+        for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(r.payload)
+
+
+def _req(key="net", payload=0, deadline_s: float | None = 30.0) -> ForecastRequest:
+    deadline = None if deadline_s is None else time.monotonic() + deadline_s
+    return ForecastRequest(key=key, payload=payload, deadline=deadline)
+
+
+class TestCoalescing:
+    def test_same_key_requests_share_a_batch(self):
+        ex = _RecordingExecutor()
+        b = MicroBatcher(ex, max_batch=4, batch_wait_s=0.2)
+        try:
+            reqs = [b.submit(_req(payload=i)) for i in range(4)]
+            assert [r.future.result(timeout=5) for r in reqs] == [0, 1, 2, 3]
+            assert ex.batches == [("net", 4)]
+        finally:
+            b.close()
+
+    def test_max_batch_caps_extraction(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()  # hold the worker so all 10 queue first
+        b = MicroBatcher(ex, max_batch=4, batch_wait_s=0.0)
+        try:
+            reqs = [b.submit(_req(payload=i)) for i in range(10)]
+            ex.gate.set()
+            for r in reqs:
+                r.future.result(timeout=5)
+            sizes = [n for _, n in ex.batches]
+            assert sum(sizes) == 10
+            assert max(sizes) <= 4
+            assert len(sizes) >= 3  # 10 requests cannot fit in 2 batches of 4
+        finally:
+            b.close()
+
+    def test_fifo_across_keys(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(ex, max_batch=8, batch_wait_s=0.0)
+        try:
+            ra1 = b.submit(_req(key="a", payload="a1"))
+            rb = b.submit(_req(key="b", payload="b"))
+            ra2 = b.submit(_req(key="a", payload="a2"))
+            ex.gate.set()
+            for r in (ra1, rb, ra2):
+                r.future.result(timeout=5)
+            # head key "a" coalesces a1+a2 into the first batch; b follows
+            assert ex.batches == [("a", 2), ("b", 1)]
+        finally:
+            b.close()
+
+
+class TestDeadlines:
+    def test_expired_request_is_shed_not_executed(self):
+        shed = []
+        ex = _RecordingExecutor(delay=0.15)
+        b = MicroBatcher(
+            ex, max_batch=1, batch_wait_s=0.0, on_shed=lambda r, why: shed.append(why)
+        )
+        try:
+            first = b.submit(_req(payload="slow"))  # occupies the worker
+            doomed = b.submit(_req(payload="late", deadline_s=0.02))
+            assert first.future.result(timeout=5) == "slow"
+            with pytest.raises(RequestShedError) as ei:
+                doomed.future.result(timeout=5)
+            assert ei.value.reason == "deadline"
+            assert shed == ["deadline"]
+            assert ("net", 1) in ex.batches and len(ex.batches) == 1
+            assert b.stats()["shed"] == 1
+        finally:
+            b.close()
+
+
+class TestBackpressure:
+    def _blocked(self, policy: str, on_shed=None):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(
+            ex, max_batch=1, queue_cap=1, batch_wait_s=0.0,
+            backpressure=policy, on_shed=on_shed,
+        )
+        # first request is extracted by the worker and blocks on the gate;
+        # second fills the queue to capacity
+        r_exec = b.submit(_req(payload="executing"))
+        t0 = time.monotonic()
+        while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+            time.sleep(0.002)
+        r_q = b.submit(_req(payload="queued"))
+        return ex, b, r_exec, r_q
+
+    def test_reject_new(self):
+        ex, b, r_exec, r_q = self._blocked("reject-new")
+        try:
+            with pytest.raises(QueueFullError):
+                b.submit(_req(payload="overflow"))
+            ex.gate.set()
+            assert r_exec.future.result(timeout=5) == "executing"
+            assert r_q.future.result(timeout=5) == "queued"
+            assert b.stats()["rejected"] == 1
+        finally:
+            b.close()
+
+    def test_shed_oldest(self):
+        shed = []
+        ex, b, r_exec, r_q = self._blocked(
+            "shed-oldest", on_shed=lambda r, why: shed.append((r.payload, why))
+        )
+        try:
+            newest = b.submit(_req(payload="newest"))  # displaces "queued"
+            with pytest.raises(RequestShedError) as ei:
+                r_q.future.result(timeout=5)
+            assert ei.value.reason == "queue-full"
+            assert shed == [("queued", "queue-full")]
+            ex.gate.set()
+            assert r_exec.future.result(timeout=5) == "executing"
+            assert newest.future.result(timeout=5) == "newest"
+        finally:
+            b.close()
+
+
+class TestFailureIsolation:
+    def test_poisoned_batch_fails_alone(self):
+        ex = _RecordingExecutor(fail_keys={"bad"})
+        b = MicroBatcher(ex, max_batch=4, batch_wait_s=0.0)
+        try:
+            bad = b.submit(_req(key="bad", payload="x"))
+            with pytest.raises(RuntimeError, match="poisoned"):
+                bad.future.result(timeout=5)
+            ok = b.submit(_req(key="good", payload="y"))
+            assert ok.future.result(timeout=5) == "y"
+        finally:
+            b.close()
+
+    def test_close_without_drain_sheds_backlog(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(ex, max_batch=1, batch_wait_s=0.0)
+        b.submit(_req(payload="executing"))
+        t0 = time.monotonic()
+        while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+            time.sleep(0.002)
+        backlog = b.submit(_req(payload="backlog"))
+        ex.gate.set()
+        b.close(drain=False)
+        with pytest.raises(RequestShedError):
+            backlog.future.result(timeout=5)
+
+    def test_submit_after_close_raises(self):
+        b = MicroBatcher(_RecordingExecutor(), max_batch=1)
+        b.close()
+        with pytest.raises(RuntimeError, match="shut down"):
+            b.submit(_req())
+
+
+class TestServeConfig:
+    def test_env_overrides_and_precedence(self):
+        env = {
+            "DDR_SERVE_MAX_BATCH": "16",
+            "DDR_SERVE_BATCH_WAIT_MS": "2.5",
+            "DDR_SERVE_BACKPRESSURE": "shed-oldest",
+            "DDR_SERVE_DEADLINE_MS": "1500",
+        }
+        c = ServeConfig.from_env(environ=env, max_batch=32)
+        assert c.max_batch == 32  # explicit kwarg beats env
+        assert c.batch_wait_s == pytest.approx(0.0025)
+        assert c.deadline_s == pytest.approx(1.5)
+        assert c.backpressure == "shed-oldest"
+
+    def test_bad_values_raise(self):
+        with pytest.raises(ValueError, match="backpressure"):
+            ServeConfig(backpressure="drop-everything")
+        with pytest.raises(ValueError, match="DDR_SERVE_MAX_BATCH"):
+            ServeConfig.from_env(environ={"DDR_SERVE_MAX_BATCH": "many"})
+        with pytest.raises(ValueError, match="max_batch"):
+            ServeConfig(max_batch=0)

@@ -1,0 +1,108 @@
+"""HTTP front tests: health/readiness probes, the forecast POST surface, and
+the error mapping — real sockets on an ephemeral port."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ddr_tpu.serving import HttpForecastClient
+from ddr_tpu.serving.http_api import serve_http
+
+
+@pytest.fixture
+def server(service_factory):
+    svc = service_factory(n_segments=32, horizon=8, n_days=2)
+    srv = serve_http(svc, port=0)
+    yield srv, svc
+    srv.shutdown()
+
+
+def _post(url, body: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        url + "/v1/forecast",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestProbes:
+    def test_healthz_and_readyz(self, server):
+        srv, _ = server
+        c = HttpForecastClient(srv.url)
+        assert c.healthy() and c.ready()
+
+    def test_readyz_503_before_warmup(self, service_factory):
+        svc = service_factory(n_segments=24, horizon=8, n_days=2, warmup=False)
+        srv = serve_http(svc, port=0)
+        try:
+            c = HttpForecastClient(srv.url)
+            assert c.healthy() and not c.ready()
+            code, body = _post(srv.url, {"network": "default", "t0": 0})
+            assert code == 503 and body["status"] == "warming"
+            svc.warmup()
+            assert c.ready()
+        finally:
+            srv.shutdown()
+
+    def test_stats_models_networks_endpoints(self, server):
+        srv, _ = server
+        c = HttpForecastClient(srv.url)
+        s = c.stats()
+        assert s["ready"] and "default" in s["networks"]
+        code, body = c._get("/v1/models")
+        assert code == 200 and body["models"]["default"]["version"] == 1
+
+    def test_unknown_route_404(self, server):
+        srv, _ = server
+        code, _ = HttpForecastClient(srv.url)._get("/v2/whatever")
+        assert code == 404
+
+
+class TestForecastPost:
+    def test_roundtrip_with_gauge_subset(self, server):
+        srv, svc = server
+        c = HttpForecastClient(srv.url)
+        out = c.forecast("default", t0=3, gauges=[0, 2])
+        assert out["runoff"].shape == (8, 2)
+        assert out["version"] == 1
+        # same numbers as the in-process path
+        direct = svc.forecast(network="default", t0=3, gauges=[0, 2], timeout=30)
+        np.testing.assert_allclose(out["runoff"], direct["runoff"], rtol=1e-5)
+
+    def test_q_prime_payload_roundtrip(self, server):
+        srv, svc = server
+        net = svc.networks()["default"]
+        c = HttpForecastClient(srv.url)
+        out = c.forecast("default", q_prime=net.forcing[:8])
+        assert out["runoff"].shape == (8, 4)
+
+    def test_error_mapping(self, server):
+        srv, _ = server
+        assert _post(srv.url, {"t0": 0})[0] == 400  # no network field
+        assert _post(srv.url, {"network": "nope"})[0] == 404
+        assert _post(srv.url, {"network": "default", "model": "nope"})[0] == 404
+        code, body = _post(srv.url, {"network": "default", "t0": 99999})
+        assert code == 400 and "out of range" in body["error"]
+        # np.asarray raises TypeError for dict payloads — still a 400, never a
+        # dropped connection
+        code, body = _post(srv.url, {"network": "default", "q_prime": {"a": 1}})
+        assert code == 400 and "malformed" in body["error"]
+        # malformed JSON body
+        req = urllib.request.Request(
+            srv.url + "/v1/forecast", data=b"{not json",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400

@@ -1,0 +1,245 @@
+"""End-to-end serving: concurrent in-process clients over a synthetic network,
+hot-reload under load, and the zero-recompile-after-warmup contract asserted
+from ``compile`` events in the run's JSONL log (PR-1 CompileTracker).
+
+The tier-1 variant keeps shapes small; the ``slow``-marked variant is the
+acceptance run — 32 concurrent clients on a 2048-reach network with a
+checkpoint hot-reload mid-load and exactly one compile per (network, model)
+pair, none after warmup.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin
+from ddr_tpu.scripts.common import build_kan, kan_arch
+from ddr_tpu.serving import ForecastClient, ForecastService, ServeConfig
+from tests.serving.conftest import events_of, make_cfg
+
+
+def _build(tmp_path, n_segments, horizon, serve_cfg: ServeConfig, parallel="none"):
+    cfg = make_cfg(tmp_path, experiment={"parallel": parallel})
+    basin = make_basin(n_segments=n_segments, n_gauges=4, n_days=3, seed=7)
+    kan_model, params = build_kan(cfg)
+    svc = ForecastService(cfg, serve_cfg)
+    svc.register_network("default", basin.routing_data, forcing=basin.q_prime)
+    svc.register_model("default", kan_model, params, arch=kan_arch(cfg))
+    return svc, cfg, params
+
+
+def _hammer(svc, n_clients: int, reqs_per_client: int, t0_span: int, timeout=180.0):
+    """n_clients threads, each blocking-forecasting reqs_per_client times.
+    Returns (results, errors) — errors must come back empty: backpressure is
+    sized away (queue_cap > concurrent load), so every request must succeed."""
+    client = ForecastClient(svc)
+    results: list[dict] = []
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+    start = threading.Barrier(n_clients)
+
+    def run(cid: int):
+        try:
+            start.wait(timeout=30)
+            for i in range(reqs_per_client):
+                out = client.forecast(
+                    network="default",
+                    t0=(cid * reqs_per_client + i) % t0_span,
+                    timeout=timeout,
+                )
+                with lock:
+                    results.append(out)
+        except BaseException as e:  # noqa: BLE001 - collected for the assertion
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(c,)) for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 60)
+    return results, errors
+
+
+class TestE2E:
+    def test_concurrent_clients_zero_recompiles_after_warmup(
+        self, tmp_path, recorder
+    ):
+        svc, _, _ = _build(
+            tmp_path, n_segments=256, horizon=24,
+            serve_cfg=ServeConfig(
+                max_batch=8, batch_wait_s=0.05, queue_cap=64,
+                deadline_s=120.0, horizon_hours=24,
+            ),
+        )
+        try:
+            svc.warmup()
+            warm_compiles = len(events_of(recorder, "compile"))
+            assert warm_compiles == 1  # one (network, model) pair -> one compile
+            results, errors = _hammer(svc, n_clients=8, reqs_per_client=3, t0_span=24)
+            assert not errors
+            assert len(results) == 24
+            assert all(r["runoff"].shape == (24, 4) for r in results)
+            # THE serving contract: warmup paid the only compile; the load
+            # phase added zero compile events to the run log.
+            assert len(events_of(recorder, "compile")) == warm_compiles
+            batch_sizes = [e["size"] for e in events_of(recorder, "serve_batch")]
+            assert sum(batch_sizes) == 24
+            assert max(batch_sizes) > 1, "concurrent requests never coalesced"
+        finally:
+            svc.close()
+
+    def test_hot_reload_under_load_drops_nothing(self, tmp_path, recorder):
+        """Swap params continuously while clients hammer: every request
+        succeeds, versions move forward, and no swap triggers a recompile."""
+        svc, _, params = _build(
+            tmp_path, n_segments=128, horizon=12,
+            serve_cfg=ServeConfig(
+                max_batch=4, batch_wait_s=0.02, queue_cap=64,
+                deadline_s=120.0, horizon_hours=12,
+            ),
+        )
+        try:
+            svc.warmup()
+            warm_compiles = len(events_of(recorder, "compile"))
+            stop = threading.Event()
+
+            def swapper():
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    svc.registry.swap_params(
+                        "default",
+                        jax.tree_util.tree_map(lambda a: a * (1 + 1e-4 * i), params),
+                    )
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=swapper)
+            t.start()
+            results, errors = _hammer(svc, n_clients=6, reqs_per_client=4, t0_span=36)
+            stop.set()
+            t.join(timeout=10)
+            assert not errors
+            assert len(results) == 24
+            # deterministic version check: one synchronous swap, then one more
+            # request MUST serve the new version (the concurrent swapper above
+            # is the atomicity stressor; load may outrun its first swap)
+            final = svc.registry.swap_params(
+                "default", jax.tree_util.tree_map(lambda a: a * 1.5, params)
+            )
+            post = svc.forecast(network="default", t0=0, timeout=120)
+            assert post["version"] == final.version > 1
+            assert len(events_of(recorder, "compile")) == warm_compiles
+            statuses = [e["status"] for e in events_of(recorder, "serve_request")]
+            assert statuses.count("ok") == 25 and len(statuses) == 25
+        finally:
+            svc.close()
+
+    def test_checkpoint_file_reload_roundtrip(self, tmp_path, recorder):
+        """The full file-based loop: ddr-train-style checkpoint appears on
+        disk -> watcher swaps it in -> requests serve the new version, with
+        zero recompiles."""
+        from ddr_tpu.training import save_state
+
+        svc, cfg, params = _build(
+            tmp_path, n_segments=64, horizon=12,
+            serve_cfg=ServeConfig(max_batch=4, horizon_hours=12),
+        )
+        try:
+            svc.warmup()
+            watcher = svc.registry.watch(
+                "default", tmp_path / "saved_models", poll_s=60
+            )
+            v1 = svc.forecast(network="default", t0=0, timeout=60)
+            assert v1["version"] == 1
+            new_params = jax.tree_util.tree_map(lambda a: a * 1.05, params)
+            save_state(
+                tmp_path / "saved_models", "serve_test", epoch=1, mini_batch=0,
+                params=new_params, opt_state={}, arch=kan_arch(cfg),
+            )
+            assert watcher.check_now()
+            v2 = svc.forecast(network="default", t0=0, timeout=60)
+            assert v2["version"] == 2
+            # the registry really holds the checkpoint's values, and the swap
+            # paid no compile (params are jit arguments, not compile keys)
+            served = svc.registry.get("default").params
+            leaf_new = jax.tree_util.tree_leaves(new_params)[0]
+            leaf_served = jax.tree_util.tree_leaves(served)[0]
+            np.testing.assert_allclose(np.asarray(leaf_served), np.asarray(leaf_new))
+            assert len(events_of(recorder, "compile")) == 1
+        finally:
+            svc.close()
+
+
+@pytest.mark.slow
+class TestAcceptance:
+    def test_32_clients_2048_reaches_one_compile_hot_reload(self, tmp_path, recorder):
+        """The PR acceptance run: >= 32 concurrent in-process clients on a
+        synthetic 2048-reach network, exactly one compile per (network,
+        model) pair after warmup (from the JSONL log), and a checkpoint
+        hot-reload during load with zero dropped or failed requests."""
+        from ddr_tpu.training import save_state
+
+        svc, cfg, params = _build(
+            tmp_path, n_segments=2048, horizon=24,
+            serve_cfg=ServeConfig(
+                max_batch=8, batch_wait_s=0.05, queue_cap=256,
+                deadline_s=300.0, horizon_hours=24,
+            ),
+        )
+        try:
+            svc.warmup()
+            warm_compiles = len(events_of(recorder, "compile"))
+            assert warm_compiles == 1
+            watcher = svc.registry.watch(
+                "default", tmp_path / "saved_models", poll_s=0.1
+            )
+
+            reload_done = threading.Event()
+
+            def mid_load_reload():
+                time.sleep(1.0)  # let the load ramp first
+                save_state(
+                    tmp_path / "saved_models", "serve_test", epoch=1,
+                    mini_batch=0,
+                    params=jax.tree_util.tree_map(lambda a: a * 1.02, params),
+                    opt_state={}, arch=kan_arch(cfg),
+                )
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if svc.registry.get("default").version >= 2:
+                        reload_done.set()
+                        return
+                    time.sleep(0.05)
+
+            r = threading.Thread(target=mid_load_reload)
+            r.start()
+            results, errors = _hammer(
+                svc, n_clients=32, reqs_per_client=4, t0_span=24, timeout=600
+            )
+            r.join(timeout=120)
+            assert not errors, f"dropped/failed requests: {errors[:3]}"
+            assert len(results) == 128
+            assert reload_done.is_set(), "hot reload never landed"
+            # a post-reload wave must serve version 2 (the first wave may have
+            # outrun the reload; this wave cannot)
+            wave2, errors2 = _hammer(
+                svc, n_clients=32, reqs_per_client=1, t0_span=24, timeout=600
+            )
+            assert not errors2
+            assert {r_["version"] for r_ in wave2} == {2}
+            # exactly one compile per (network, engine) pair, all at warmup —
+            # neither 160 requests nor the reload added any
+            compiles = events_of(recorder, "compile")
+            assert len(compiles) == warm_compiles == 1
+            statuses = [e["status"] for e in events_of(recorder, "serve_request")]
+            assert statuses.count("ok") == 160 and len(statuses) == 160
+            sizes = [e["size"] for e in events_of(recorder, "serve_batch")]
+            assert max(sizes) > 1  # 32 concurrent clients must coalesce
+        finally:
+            svc.close()
