@@ -1,9 +1,10 @@
 """Run observability: structured JSONL metrics events, span tracing, recompile
-tracking, throughput counters, and the ``ddr metrics`` CLI.
+tracking, throughput counters, live Prometheus metrics + numerical-health
+watchdog, and the ``ddr metrics`` CLI.
 
 Importable without jax (bench.py's jax-free parent process records through it);
 jax is consulted lazily and only when already loaded. See docs/observability.md
-for the event schema and worked examples.
+for the event schema, the live-metrics endpoint table, and worked examples.
 """
 
 from ddr_tpu.observability.events import (
@@ -13,13 +14,24 @@ from ddr_tpu.observability.events import (
     deactivate,
     device_memory_stats,
     emit_heartbeat,
+    flush_every_from_env,
     get_recorder,
     host_layout,
     metrics_dir_from_env,
     run_telemetry,
 )
+from ddr_tpu.observability.health import HealthConfig, HealthStats, HealthWatchdog
+from ddr_tpu.observability.prometheus import (
+    event_tee,
+    maybe_start_exporter_from_env,
+    render_text,
+    start_exporter,
+)
 from ddr_tpu.observability.recompile import CompileTracker
+from ddr_tpu.observability.registry import MetricsRegistry, get_registry, set_registry
 from ddr_tpu.observability.spans import (
+    ProfilerBusyError,
+    capture_profile,
     profile_dir_from_env,
     span,
     spanned,
@@ -36,6 +48,7 @@ __all__ = [
     "get_recorder",
     "run_telemetry",
     "metrics_dir_from_env",
+    "flush_every_from_env",
     "device_memory_stats",
     "emit_heartbeat",
     "host_layout",
@@ -45,6 +58,18 @@ __all__ = [
     "trace",
     "trace_active",
     "profile_dir_from_env",
+    "ProfilerBusyError",
+    "capture_profile",
     "Throughput",
     "MIN_BATCH_SECONDS",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "render_text",
+    "event_tee",
+    "start_exporter",
+    "maybe_start_exporter_from_env",
+    "HealthConfig",
+    "HealthStats",
+    "HealthWatchdog",
 ]

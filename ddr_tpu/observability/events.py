@@ -50,6 +50,7 @@ __all__ = [
     "deactivate",
     "run_telemetry",
     "metrics_dir_from_env",
+    "flush_every_from_env",
     "device_memory_stats",
     "emit_heartbeat",
     "host_layout",
@@ -57,9 +58,12 @@ __all__ = [
 
 #: The closed vocabulary of event types (docs/observability.md has one schema
 #: table per type). ``Recorder.emit`` warns on — but still writes — anything
-#: else, so ad-hoc experiments don't lose data while the schema catches drift.
+#: else, so ad-hoc experiments don't lose data while the schema catches drift
+#: (scripts/check_event_schema.py enforces it over the tree in CI).
 #: ``serve_request``/``serve_batch``/``serve_shed`` are the forecast-serving
-#: layer's admit/batch/shed decisions (:mod:`ddr_tpu.serving`).
+#: layer's admit/batch/shed decisions (:mod:`ddr_tpu.serving`); ``health`` is
+#: one numerical-health watchdog violation
+#: (:mod:`ddr_tpu.observability.health`).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -71,7 +75,23 @@ EVENT_TYPES = (
     "serve_request",
     "serve_batch",
     "serve_shed",
+    "health",
 )
+
+
+def flush_every_from_env() -> int:
+    """``DDR_METRICS_FLUSH_EVERY`` -> flush cadence in events (default 1 =
+    flush every line, the original behavior). High-rate emitters (serve/health
+    under load) raise it to batch flushes; ``close()`` always flushes, and a
+    malformed value falls back to 1 — a telemetry knob must never abort a run."""
+    raw = os.environ.get("DDR_METRICS_FLUSH_EVERY")
+    if not raw:
+        return 1
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        log.warning(f"ignoring malformed DDR_METRICS_FLUSH_EVERY={raw!r} (want an integer)")
+        return 1
 
 
 def metrics_dir_from_env() -> str | None:
@@ -120,6 +140,7 @@ class Recorder:
         host: int = 0,
         n_hosts: int = 1,
         tags: dict[str, Any] | None = None,
+        flush_every: int | None = None,
     ) -> None:
         self.path = Path(path)
         self.host = int(host)
@@ -133,6 +154,17 @@ class Recorder:
         self._spans: dict[str, list[float]] = {}  # path -> [count, total_seconds]
         self._extra: dict[str, Any] = {}
         self._closed = False
+        # flush cadence: 1 (default) keeps the original flush-per-line
+        # behavior; DDR_METRICS_FLUSH_EVERY=N batches flushes for high-rate
+        # emitters. close() flushes unconditionally.
+        self._flush_every = (
+            flush_every_from_env() if flush_every is None else max(1, int(flush_every))
+        )
+        self._unflushed = 0
+        # emit hooks: called with the full record dict after each write (the
+        # prometheus tee rides here); hook failures are logged, never raised —
+        # observability must not break the data path.
+        self._hooks: list[Any] = []
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = self.path.open("w", encoding="utf-8")
 
@@ -173,8 +205,17 @@ class Recorder:
 
     # ---- event emission ----
 
+    def add_hook(self, hook: Any) -> None:
+        """Register a per-emit observer ``hook(record_dict)`` (idempotent —
+        re-adding the same callable is a no-op, so repeated ``activate()``
+        calls cannot double-count the prometheus tee)."""
+        with self._lock:
+            if hook not in self._hooks:
+                self._hooks.append(hook)
+
     def emit(self, event: str, **payload: Any) -> None:
-        """Append one event line (atomic single write + flush)."""
+        """Append one event line (atomic single write; flushed every
+        ``flush_every`` events and at close)."""
         if event not in EVENT_TYPES:
             log.warning(f"unknown telemetry event type {event!r} (writing anyway)")
         with self._lock:
@@ -194,7 +235,16 @@ class Recorder:
             self._seq += 1
             self._counts[event] = self._counts.get(event, 0) + 1
             self._fh.write(json.dumps(rec, default=_json_default) + "\n")
-            self._fh.flush()
+            self._unflushed += 1
+            if self._unflushed >= self._flush_every:
+                self._fh.flush()
+                self._unflushed = 0
+            hooks = list(self._hooks)
+        for hook in hooks:
+            try:
+                hook(rec)
+            except Exception:
+                log.exception(f"telemetry emit hook {hook!r} failed")
 
     def record_span(self, path: str, seconds: float) -> None:
         """Aggregate one finished span and emit its ``span`` event."""
@@ -234,6 +284,7 @@ class Recorder:
                 summary=self.summary(),
             )
             self._closed = True
+            self._fh.flush()  # batched-flush mode: nothing may linger buffered
             self._fh.close()
 
 
@@ -254,6 +305,15 @@ def activate(rec: Recorder) -> None:
     global _ACTIVE
     if _ACTIVE is not None and _ACTIVE is not rec:
         log.warning(f"replacing active telemetry recorder {_ACTIVE.path}")
+    # Every ACTIVE recorder tees into the process metrics registry: one event
+    # stream, two sinks (JSONL archive + live /metrics). Bare Recorders used
+    # without activate() — unit tests, sidecar experiments — don't tee.
+    try:
+        from ddr_tpu.observability.prometheus import event_tee
+
+        rec.add_hook(event_tee)
+    except Exception:  # the registry must never block telemetry activation
+        log.exception("could not install prometheus tee on the active recorder")
     _ACTIVE = rec
 
 
@@ -281,6 +341,11 @@ def run_telemetry(
     ``interrupted`` (KeyboardInterrupt), or ``error:<Type>``, and the recorder
     is always deactivated and closed.
     """
+    # The scrape endpoint is orthogonal to the run log: DDR_PROM_PORT starts
+    # the background /metrics exporter even when no log directory resolves.
+    from ddr_tpu.observability.prometheus import maybe_start_exporter_from_env
+
+    maybe_start_exporter_from_env()
     base = base_dir or metrics_dir_from_env()
     if base is None and cfg is not None:
         base = getattr(getattr(cfg, "params", None), "save_path", None)

@@ -1,0 +1,359 @@
+"""Numerical-health watchdog: on-device health stats, host-side thresholds.
+
+The Muskingum-Cunge solve gives this stack something most ML serving lacks —
+physics that makes "the numbers went wrong" *checkable*: discharge must stay
+finite and non-negative, the domain's total discharge must stay in proportion
+to its lateral inflow (a scale-free explosion indicator), and training
+gradients must stay bounded. The split here keeps monitoring out of the hot
+path's way:
+
+- :func:`compute_health` runs INSIDE the compiled program (a handful of
+  ``jnp`` reductions over arrays the program already materialized) and returns
+  a :class:`HealthStats` pytree riding the existing step outputs — no extra
+  host sync, no second program, no new jit-cache entry;
+- :class:`HealthWatchdog` runs on the HOST after the step's existing
+  synchronization: it thresholds the (already computed) scalars against
+  :class:`HealthConfig` (``DDR_HEALTH_*`` env knobs), emits one ``health``
+  telemetry event per violating batch, flips the ``ddr_health_status`` gauge,
+  and tracks consecutive violations so the serving layer can degrade
+  ``/readyz`` after K bad batches.
+
+``HealthStats``/``compute_health`` need jax, but registration is lazy so this
+module (and the package ``__init__``) stays importable in jax-free processes.
+
+On ``mass_residual`` semantics: it is ``(Σ outputs − Σ inflow) / (|Σ inflow| +
+eps)`` over the live, finite entries of the window — NOT an exact conservation law
+(routed discharge accumulates downstream, and gauge-aggregated outputs cover a
+subset of reaches), but for a fixed (network, gauge set) the ratio is stable
+across healthy windows and explodes with the solve, which is exactly what a
+watchdog needs. The default threshold is +inf (off); operators calibrate
+``DDR_HEALTH_MAX_RESIDUAL`` per domain from a healthy run's telemetry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+import threading
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "HealthStats",
+    "HealthConfig",
+    "HealthWatchdog",
+    "compute_health",
+    "compute_health_host",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthStats:
+    """On-device numerical-health scalars for one routed batch / train step.
+
+    All fields are 0-d arrays (or None) so the pytree rides step outputs with
+    a few bytes of transfer. Registered with jax lazily (first
+    :func:`compute_health` call) to keep this module jax-free at import.
+    """
+
+    nonfinite: Any  # int32 count of non-finite entries (outputs + inflow)
+    q_min: Any  # min over finite output discharge
+    q_max: Any  # max over finite output discharge
+    mass_residual: Any  # scale-free outflow/inflow imbalance (docstring above)
+    grad_norm: Any = None  # optax global_norm(grads); train steps only
+
+
+_REGISTERED = False
+_REGISTER_LOCK = threading.Lock()
+
+
+def _ensure_registered() -> None:
+    """Register :class:`HealthStats` as a jax pytree dataclass exactly once.
+    Lazy so importing this module never imports jax (package contract)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    with _REGISTER_LOCK:
+        if _REGISTERED:
+            return
+        import jax
+
+        jax.tree_util.register_dataclass(
+            HealthStats,
+            data_fields=["nonfinite", "q_min", "q_max", "mass_residual", "grad_norm"],
+            meta_fields=[],
+        )
+        _REGISTERED = True
+
+
+def compute_health(runoff: Any, q_prime: Any | None = None,
+                   final_discharge: Any | None = None,
+                   row_mask: Any | None = None) -> HealthStats:
+    """Health scalars from routed outputs — call INSIDE the compiled program.
+
+    ``runoff`` is the route output ((T, G) gauge-aggregated, (T, N) full
+    domain, or batched with a leading dim); ``q_prime`` the lateral inflow the
+    window consumed; ``final_discharge`` the (N,) carry state when available.
+    ``row_mask`` (boolean over the LEADING axis) restricts everything to the
+    live rows of a padded batch slot — pad rows carry no request, and letting
+    their clamped output discharge into the sums would make the residual (and
+    q_min) a function of batch occupancy instead of the solve. A handful of
+    full-array reductions (isfinite + masked min/max/sum), fused by XLA into
+    the surrounding program — never a second kernel launch worth caring
+    about, never a host sync.
+    """
+    import jax.numpy as jnp
+
+    _ensure_registered()
+    runoff = jnp.asarray(runoff)
+
+    def _valid(arr):
+        """Boolean validity of ``arr``'s entries under the leading-axis mask."""
+        if row_mask is None:
+            return jnp.ones(arr.shape, bool)
+        m = jnp.asarray(row_mask, bool)
+        m = m.reshape(m.shape + (1,) * (arr.ndim - m.ndim))
+        return jnp.broadcast_to(m, arr.shape)
+
+    finite = jnp.isfinite(runoff)
+    valid = _valid(runoff)
+    live_finite = finite & valid
+    nonfinite = jnp.sum(~finite & valid).astype(jnp.int32)
+    big = jnp.asarray(jnp.finfo(runoff.dtype).max, runoff.dtype)
+    q_min = jnp.min(jnp.where(live_finite, runoff, big))
+    q_max = jnp.max(jnp.where(live_finite, runoff, -big))
+    # total output discharge vs total lateral inflow over the (live, finite)
+    # window — finite-only so one NaN cannot silently zero the denominator;
+    # both sides sum over the same rows/steps, so normalization cancels in
+    # the ratio and batch occupancy does not leak in
+    out_mass = jnp.sum(jnp.where(live_finite, runoff, 0.0))
+    if q_prime is not None:
+        qp = jnp.asarray(q_prime)
+        qp_live = jnp.isfinite(qp) & _valid(qp)
+        nonfinite = nonfinite + jnp.sum(~jnp.isfinite(qp) & _valid(qp)).astype(jnp.int32)
+        in_mass = jnp.sum(jnp.where(qp_live, qp, 0.0))
+    else:
+        in_mass = jnp.asarray(0.0, runoff.dtype)
+    if final_discharge is not None:
+        fd = jnp.asarray(final_discharge)
+        nonfinite = nonfinite + jnp.sum(~jnp.isfinite(fd)).astype(jnp.int32)
+    residual = (out_mass - in_mass) / (jnp.abs(in_mass) + 1e-6)
+    return HealthStats(
+        nonfinite=nonfinite, q_min=q_min, q_max=q_max, mass_residual=residual
+    )
+
+
+def compute_health_host(runoff: Any, q_prime: Any | None = None) -> HealthStats:
+    """Numpy twin of :func:`compute_health` for results that ALREADY live on
+    the host (the serving mesh path materializes its batch as a numpy array —
+    re-uploading it to device just to reduce it would add H2D traffic and a
+    sync to the hot path). Same fields, same semantics."""
+    import numpy as np
+
+    runoff = np.asarray(runoff)
+    finite = np.isfinite(runoff)
+    nonfinite = int((~finite).sum())
+    big = np.finfo(runoff.dtype).max if runoff.dtype.kind == "f" else np.inf
+    q_min = float(np.where(finite, runoff, big).min()) if runoff.size else float("inf")
+    q_max = float(np.where(finite, runoff, -big).max()) if runoff.size else float("-inf")
+    out_mass = float(np.where(finite, runoff, 0.0).sum())
+    in_mass = 0.0
+    if q_prime is not None:
+        qp = np.asarray(q_prime)
+        qp_finite = np.isfinite(qp)
+        nonfinite += int((~qp_finite).sum())
+        in_mass = float(np.where(qp_finite, qp, 0.0).sum())
+    residual = (out_mass - in_mass) / (abs(in_mass) + 1e-6)
+    return HealthStats(
+        nonfinite=nonfinite, q_min=q_min, q_max=q_max, mass_residual=residual
+    )
+
+
+_ENV_PREFIX = "DDR_HEALTH_"
+_FALSEY = ("0", "false", "no", "off")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Watchdog thresholds (env var in parentheses; defaults are permissive —
+    only non-finite values violate out of the box, the one failure mode that
+    is wrong on every domain)."""
+
+    #: Master switch (DDR_HEALTH_ENABLED; 0/false/no/off disables).
+    enabled: bool = True
+    #: Non-finite entries tolerated per batch (DDR_HEALTH_MAX_NONFINITE).
+    max_nonfinite: int = 0
+    #: Discharge ceiling, m^3/s (DDR_HEALTH_MAX_DISCHARGE; inf = off).
+    max_discharge: float = math.inf
+    #: |mass_residual| ceiling (DDR_HEALTH_MAX_RESIDUAL; inf = off —
+    #: calibrate per domain, see the module docstring).
+    max_residual: float = math.inf
+    #: Gradient global-norm ceiling (DDR_HEALTH_MAX_GRAD_NORM; inf = off;
+    #: a non-finite grad norm always violates).
+    max_grad_norm: float = math.inf
+    #: Consecutive violating batches before the watchdog reports *degraded*
+    #: (serving flips /readyz to 503 at this point) (DDR_HEALTH_BAD_BATCHES).
+    bad_batches: int = 3
+
+    def __post_init__(self) -> None:
+        if self.bad_batches < 1:
+            raise ValueError(f"bad_batches must be >= 1, got {self.bad_batches}")
+        if self.max_nonfinite < 0:
+            raise ValueError(f"max_nonfinite must be >= 0, got {self.max_nonfinite}")
+
+    @classmethod
+    def from_env(cls, environ: dict | None = None, **overrides) -> "HealthConfig":
+        """Defaults < ``DDR_HEALTH_*`` environment < explicit overrides (the
+        ServeConfig convention)."""
+        env = os.environ if environ is None else environ
+
+        def _get(name: str, cast):
+            raw = env.get(_ENV_PREFIX + name)
+            if raw is None or raw == "":
+                return None
+            try:
+                return cast(raw)
+            except ValueError as e:
+                raise ValueError(f"bad {_ENV_PREFIX}{name}={raw!r}: {e}") from e
+
+        from_env: dict = {}
+        for key, var, cast in (
+            ("enabled", "ENABLED", lambda s: s.strip().lower() not in _FALSEY),
+            ("max_nonfinite", "MAX_NONFINITE", int),
+            ("max_discharge", "MAX_DISCHARGE", float),
+            ("max_residual", "MAX_RESIDUAL", float),
+            ("max_grad_norm", "MAX_GRAD_NORM", float),
+            ("bad_batches", "BAD_BATCHES", int),
+        ):
+            v = _get(var, cast)
+            if v is not None:
+                from_env[key] = v
+        from_env.update(overrides)
+        return cls(**from_env)
+
+
+class HealthWatchdog:
+    """Host-side thresholder over :class:`HealthStats`.
+
+    One instance per run/service. :meth:`observe` is called once per batch
+    AFTER the step's existing host synchronization (the stats rode the step
+    outputs, so reading them transfers a few scalars, not a new computation).
+    Thread-safe: serving observes from the batcher worker while HTTP threads
+    read :attr:`degraded`.
+    """
+
+    def __init__(self, config: HealthConfig | None = None, registry: Any = None) -> None:
+        self.config = config or HealthConfig.from_env()
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._batches = 0
+        self._violations = 0
+        self._last_reasons: list[str] = []
+        if registry is None:
+            from ddr_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._registry = registry
+        self._gauge = registry.gauge(
+            "ddr_health_status",
+            "Numerical health of the last observed batch (1 healthy, 0 violating)",
+        )
+        self._gauge.set(1.0)
+
+    # ---- observation ----
+
+    def check(self, stats: HealthStats) -> list[str]:
+        """Pure threshold evaluation -> violation reasons (no state, no I/O)."""
+        cfg = self.config
+        reasons: list[str] = []
+        if int(stats.nonfinite) > cfg.max_nonfinite:
+            reasons.append("non-finite")
+        q_max = float(stats.q_max)
+        if q_max > cfg.max_discharge:
+            reasons.append("discharge-max")
+        residual = float(stats.mass_residual)
+        if not math.isfinite(residual) or abs(residual) > cfg.max_residual:
+            reasons.append("mass-residual")
+        if stats.grad_norm is not None:
+            gn = float(stats.grad_norm)
+            if not math.isfinite(gn) or gn > cfg.max_grad_norm:
+                reasons.append("grad-norm")
+        return reasons
+
+    def observe(self, stats: HealthStats, **context: Any) -> list[str]:
+        """Threshold one batch's stats; returns the violation reasons (empty =
+        healthy). A violating batch emits exactly ONE ``health`` telemetry
+        event (reasons + values + ``context``), bumps the violation counters,
+        and flips ``ddr_health_status`` to 0; a healthy batch resets the
+        consecutive counter and flips the gauge back to 1."""
+        if not self.config.enabled:
+            return []
+        reasons = self.check(stats)
+        with self._lock:
+            self._batches += 1
+            if reasons:
+                self._consecutive += 1
+                self._violations += 1
+            else:
+                self._consecutive = 0
+            self._last_reasons = reasons
+            consecutive = self._consecutive
+        self._gauge.set(0.0 if reasons else 1.0)
+        if not reasons:
+            return reasons
+        payload = {
+            "reasons": reasons,
+            "nonfinite": int(stats.nonfinite),
+            "q_min": float(stats.q_min),
+            "q_max": float(stats.q_max),
+            "mass_residual": float(stats.mass_residual),
+            "consecutive": consecutive,
+            **context,
+        }
+        if stats.grad_norm is not None:
+            payload["grad_norm"] = float(stats.grad_norm)
+        from ddr_tpu.observability.events import get_recorder
+        from ddr_tpu.observability.prometheus import event_tee
+
+        rec = get_recorder()
+        if rec is not None:
+            rec.emit("health", **payload)  # the recorder's tee updates metrics
+        else:
+            try:  # same contract as recorder hooks: metrics must never raise
+                event_tee({"event": "health", **payload}, self._registry)
+            except Exception:
+                log.exception("health metrics tee failed")
+        log.warning(
+            f"numerical health violation ({', '.join(reasons)}): "
+            + " ".join(f"{k}={v}" for k, v in payload.items() if k != "reasons")
+        )
+        return reasons
+
+    # ---- state ----
+
+    @property
+    def consecutive_bad(self) -> int:
+        with self._lock:
+            return self._consecutive
+
+    @property
+    def degraded(self) -> bool:
+        """True after ``bad_batches`` consecutive violations — the serving
+        layer's /readyz -> 503 signal. A single healthy batch clears it."""
+        with self._lock:
+            return self._consecutive >= self.config.bad_batches
+
+    def status(self) -> dict[str, Any]:
+        """Rollup for /v1/stats and run_end summaries."""
+        with self._lock:
+            return {
+                "enabled": self.config.enabled,
+                "batches": self._batches,
+                "violations": self._violations,
+                "consecutive_bad": self._consecutive,
+                "degraded": self._consecutive >= self.config.bad_batches,
+                "last_reasons": list(self._last_reasons),
+            }

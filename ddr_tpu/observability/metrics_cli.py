@@ -4,7 +4,8 @@ Reads the event stream written by :mod:`ddr_tpu.observability.events`
 (``run_log.<cmd>.jsonl`` plus any per-host sidecars) and renders it for humans:
 
 - ``summarize <log-or-dir>``: run header, steps/sec, reach-timesteps/sec,
-  compile counts per engine, a sampled loss curve, per-span time breakdown,
+  compile counts per engine, a sampled loss curve, serving latency
+  percentiles, numerical-health violations, per-span time breakdown,
   per-host heartbeat liveness;
 - ``tail <log-or-dir> [-n N]``: the last N events, one compact line each.
 
@@ -133,6 +134,7 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
             w(f"loss curve: {pts}\n")
 
     _summarize_serving(by_type, w)
+    _summarize_health(by_type, end, w)
 
     evals = by_type.get("eval", [])
     if evals:
@@ -256,6 +258,50 @@ def _summarize_serving(by_type: dict[str, list[dict]], w) -> None:
             + ", ".join(f"{k} {v}" for k, v in sorted(reasons.items()))
             + "\n"
         )
+
+
+def _summarize_health(by_type: dict[str, list[dict]], end: dict, w) -> None:
+    """The numerical-health section: one ``health`` event per violating batch
+    (ddr_tpu.observability.health), plus the run_end watchdog rollup when
+    present. Shown whenever either source has something to say."""
+    events = by_type.get("health", [])
+    rollup = (end.get("summary") or {}).get("health") or {}
+    if not events and not rollup:
+        return
+    reasons: dict[str, int] = {}
+    worst_nonfinite = 0
+    worst_q = None
+    worst_grad = None
+    last_consecutive = 0
+    for e in events:
+        for r in e.get("reasons") or ["?"]:
+            reasons[str(r)] = reasons.get(str(r), 0) + 1
+        worst_nonfinite = max(worst_nonfinite, int(e.get("nonfinite") or 0))
+        if e.get("q_max") is not None:
+            q = float(e["q_max"])
+            worst_q = q if worst_q is None else max(worst_q, q)
+        if e.get("grad_norm") is not None:
+            g = float(e["grad_norm"])
+            if g == g:  # NaN grad norms render via the non-finite count
+                worst_grad = g if worst_grad is None else max(worst_grad, g)
+        last_consecutive = int(e.get("consecutive") or 0)
+    line = f"health   : {len(events)} violating batches"
+    if rollup.get("batches"):
+        line += f" / {rollup['batches']} observed"
+    if reasons:
+        line += " — " + ", ".join(f"{k} {v}" for k, v in sorted(reasons.items()))
+    w(line + "\n")
+    if events:
+        details = [f"worst nonfinite {worst_nonfinite}"]
+        if worst_q is not None:
+            details.append(f"max discharge {_fmt(worst_q)}")
+        if worst_grad is not None:
+            details.append(f"max grad-norm {_fmt(worst_grad)}")
+        details.append(f"last consecutive run {last_consecutive}")
+        w("           " + "   ".join(details) + "\n")
+    if rollup.get("degraded"):
+        w("           DEGRADED at run end "
+          f"(consecutive_bad {rollup.get('consecutive_bad')})\n")
 
 
 def tail(events: list[dict], n: int = 20, out=None) -> int:

@@ -1,0 +1,323 @@
+"""Prometheus text exposition + background exporter + event->metric tee.
+
+Three pieces that turn the in-process :mod:`~ddr_tpu.observability.registry`
+into something a dashboard can scrape:
+
+- :func:`render_text` — the registry in Prometheus text exposition format
+  0.0.4 (``# HELP`` / ``# TYPE`` / one line per series; histograms as
+  cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``);
+- :func:`event_tee` — the mapping from run-telemetry events (events.py
+  schema) to instrument updates. Installed as a :class:`Recorder` hook by
+  ``activate()``, so every ``emit()`` that lands in the JSONL also updates the
+  live registry — one event stream, two sinks;
+- :func:`start_exporter` / :func:`maybe_start_exporter_from_env` — a stdlib
+  daemon HTTP server answering ``GET /metrics``, started when
+  ``DDR_PROM_PORT`` is set, so long training runs are scrapeable without the
+  serving layer (``ddr serve`` additionally exposes the same text on its own
+  ``/metrics``).
+
+jax-free by construction (package contract), stdlib only.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from ddr_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "CONTENT_TYPE",
+    "render_text",
+    "event_tee",
+    "declare_serve_metrics",
+    "start_exporter",
+    "maybe_start_exporter_from_env",
+    "stop_exporter",
+]
+
+#: The exposition-format content type scrapers expect.
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Batch-occupancy buckets: fractions of the compiled batch slot.
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if v != v:
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_str(names: tuple[str, ...], values: tuple[str, ...], const: dict,
+                extra: dict | None = None) -> str:
+    pairs = dict(const)
+    pairs.update(zip(names, values))
+    if extra:
+        pairs.update(extra)
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_escape(str(v))}"' for k, v in sorted(pairs.items()))
+    return "{" + body + "}"
+
+
+def render_text(registry: MetricsRegistry | None = None) -> str:
+    """The whole registry in Prometheus text exposition format 0.0.4."""
+    registry = registry or get_registry()
+    const = registry.const_labels
+    out: list[str] = []
+    for metric in registry.collect():
+        if metric.help:
+            out.append(f"# HELP {metric.name} {_escape(metric.help)}")
+        out.append(f"# TYPE {metric.name} {metric.kind}")
+        series = metric.series()
+        if isinstance(metric, Histogram):
+            for key, state in sorted(series.items()):
+                cum = 0
+                for bound, n in zip(metric.buckets, state["buckets"]):
+                    cum += n
+                    lab = _labels_str(metric.labels, key, const, {"le": _fmt(bound)})
+                    out.append(f"{metric.name}_bucket{lab} {cum}")
+                cum += state["buckets"][-1]
+                lab = _labels_str(metric.labels, key, const, {"le": "+Inf"})
+                out.append(f"{metric.name}_bucket{lab} {cum}")
+                plain = _labels_str(metric.labels, key, const)
+                out.append(f"{metric.name}_sum{plain} {_fmt(state['sum'])}")
+                out.append(f"{metric.name}_count{plain} {state['count']}")
+        else:
+            for key, value in sorted(series.items()):
+                lab = _labels_str(metric.labels, key, const)
+                out.append(f"{metric.name}{lab} {_fmt(value)}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Event -> instrument mapping (the Recorder tee).
+# ---------------------------------------------------------------------------
+
+
+def declare_serve_metrics(registry: MetricsRegistry | None = None) -> MetricsRegistry:
+    """Declare the serving/health instrument set up front so ``GET /metrics``
+    exposes every name (``# TYPE`` lines at least) from the first scrape, not
+    only after traffic has touched each code path. Idempotent."""
+    r = registry or get_registry()
+    r.counter("ddr_requests_total", "Forecast requests by terminal status",
+              labels=("status", "network", "model"))
+    r.histogram("ddr_request_latency_seconds",
+                "Admit-to-completion latency of served (status=ok) requests",
+                labels=("network", "model"))
+    r.counter("ddr_batches_total", "Executed micro-batches",
+              labels=("network", "model"))
+    r.histogram("ddr_batch_occupancy",
+                "Fraction of the compiled batch slot filled per executed batch",
+                labels=("network", "model"), buckets=OCCUPANCY_BUCKETS)
+    r.histogram("ddr_batch_seconds", "Device execution time per micro-batch",
+                labels=("network", "model"))
+    qd = r.gauge("ddr_queue_depth", "Request queue depth after the last batch extraction")
+    if not qd.series():
+        qd.set(0.0)
+    r.counter("ddr_sheds_total", "Shed/rejected requests by reason", labels=("reason",))
+    r.counter("ddr_compiles_total", "Step/plan-cache compile misses", labels=("engine",))
+    r.counter("ddr_hot_reloads_total", "Checkpoint hot-reloads applied", labels=("model",))
+    r.gauge("ddr_model_version", "Current params version per model", labels=("model",))
+    hs = r.gauge(
+        "ddr_health_status",
+        "Numerical health of the last observed batch (1 healthy, 0 violating)",
+    )
+    if not hs.series():  # healthy until a watchdog says otherwise
+        hs.set(1.0)
+    r.counter("ddr_health_violations_total",
+              "Health-watchdog threshold violations by reason", labels=("reason",))
+    return r
+
+
+def _get(payload: dict, key: str, default: float = 0.0) -> float:
+    v = payload.get(key)
+    try:
+        return default if v is None else float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
+    """Update the registry from one telemetry event record (``{"event": ...,
+    **payload}``). The one mapping both sinks share: Recorder hooks call it per
+    emit, and the serving layer calls it directly when no recorder is active.
+
+    Unknown events update only the generic ``ddr_events_total`` counter, so a
+    new event type never breaks the tee (the schema checker in
+    scripts/check_event_schema.py is what keeps names honest).
+    """
+    r = registry or get_registry()
+    event = str(record.get("event", "?"))
+    r.counter("ddr_events_total", "Telemetry events by type", labels=("event",)).inc(
+        event=event
+    )
+    if event in ("serve_request", "serve_batch", "serve_shed", "health") and (
+        r.get("ddr_requests_total") is None  # declare once, not per event —
+    ):  # the full declaration sweep is too heavy for the request hot path
+        declare_serve_metrics(r)
+    if event == "step":
+        engine = str(record.get("engine", "?"))
+        r.counter("ddr_steps_total", "Training steps", labels=("engine",)).inc(
+            engine=engine
+        )
+        if record.get("seconds") is not None:
+            r.histogram(
+                "ddr_step_seconds", "Synchronized training-step duration",
+                labels=("engine",),
+            ).observe(_get(record, "seconds"), engine=engine)
+        if record.get("loss") is not None:
+            r.gauge("ddr_loss", "Loss of the most recent training step").set(
+                _get(record, "loss", math.nan)
+            )
+    elif event == "eval":
+        r.counter("ddr_evals_total", "Inference batches").inc()
+    elif event == "compile":
+        r.counter("ddr_compiles_total", "Step/plan-cache compile misses",
+                  labels=("engine",)).inc(engine=str(record.get("engine", "?")))
+    elif event == "heartbeat":
+        r.counter("ddr_heartbeats_total", "Liveness heartbeats").inc()
+    elif event == "serve_request":
+        status = str(record.get("status", "?"))
+        network = str(record.get("network", "?"))
+        model = str(record.get("model", "?"))
+        r.get("ddr_requests_total").inc(status=status, network=network, model=model)
+        if status == "ok" and record.get("latency_s") is not None:
+            r.get("ddr_request_latency_seconds").observe(
+                _get(record, "latency_s"), network=network, model=model
+            )
+    elif event == "serve_batch":
+        network = str(record.get("network", "?"))
+        model = str(record.get("model", "?"))
+        r.get("ddr_batches_total").inc(network=network, model=model)
+        if record.get("occupancy") is not None:
+            r.get("ddr_batch_occupancy").observe(
+                _get(record, "occupancy"), network=network, model=model
+            )
+        if record.get("seconds") is not None:
+            r.get("ddr_batch_seconds").observe(
+                _get(record, "seconds"), network=network, model=model
+            )
+        if record.get("queue_depth") is not None:
+            r.get("ddr_queue_depth").set(_get(record, "queue_depth"))
+    elif event == "serve_shed":
+        r.get("ddr_sheds_total").inc(reason=str(record.get("reason", "?")))
+    elif event == "health":
+        for reason in record.get("reasons") or ["?"]:
+            r.get("ddr_health_violations_total").inc(reason=str(reason))
+
+
+# ---------------------------------------------------------------------------
+# Background exporter (DDR_PROM_PORT): GET /metrics on a daemon thread.
+# ---------------------------------------------------------------------------
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    server: "MetricsHTTPServer"
+
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        log.debug("prom %s", format % args)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = render_text(self.server.registry).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class MetricsHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, registry: MetricsRegistry, host: str, port: int) -> None:
+        self.registry = registry
+        super().__init__((host, port), _MetricsHandler)
+
+    @property
+    def url(self) -> str:
+        host, port = self.server_address[:2]
+        return f"http://{host}:{port}/metrics"
+
+
+_EXPORTER: MetricsHTTPServer | None = None
+_EXPORTER_LOCK = threading.Lock()
+
+
+def start_exporter(
+    port: int, host: str = "0.0.0.0", registry: MetricsRegistry | None = None
+) -> MetricsHTTPServer:
+    """Serve ``GET /metrics`` on a daemon thread; returns the server (its
+    ``url`` reports the bound port — ``port=0`` binds ephemeral for tests).
+    One exporter per process: a second call returns the existing server."""
+    global _EXPORTER
+    with _EXPORTER_LOCK:
+        if _EXPORTER is not None:
+            return _EXPORTER
+        server = MetricsHTTPServer(registry or get_registry(), host, port)
+        thread = threading.Thread(
+            target=server.serve_forever, name="ddr-prom-exporter", daemon=True
+        )
+        thread.start()
+        _EXPORTER = server
+    log.info(f"prometheus exporter listening on {server.url}")
+    return server
+
+
+def maybe_start_exporter_from_env() -> MetricsHTTPServer | None:
+    """Start the exporter iff ``DDR_PROM_PORT`` is set to a valid port; a
+    malformed value or an unbindable port logs and returns None — a metrics
+    knob must never take the run down."""
+    raw = os.environ.get("DDR_PROM_PORT")
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        log.warning(f"ignoring malformed DDR_PROM_PORT={raw!r} (want an integer)")
+        return None
+    try:
+        return start_exporter(port)
+    except OSError as e:
+        log.warning(f"could not bind prometheus exporter on port {port}: {e}")
+        return None
+
+
+def stop_exporter() -> None:
+    """Shut the process exporter down (tests)."""
+    global _EXPORTER
+    with _EXPORTER_LOCK:
+        server, _EXPORTER = _EXPORTER, None
+    if server is not None:
+        server.shutdown()
+        server.server_close()

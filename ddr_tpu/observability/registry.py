@@ -1,0 +1,258 @@
+"""In-process metrics registry: counters, gauges, fixed-bucket histograms.
+
+The JSONL run log (events.py) is the stack's *archival* telemetry — complete,
+ordered, replayable. What it cannot do is answer "what is the p99 right now"
+to a dashboard poller without re-reading the file. This module is the live
+half: a small, thread-safe, dependency-free registry whose instruments the
+event stream tees into (:mod:`ddr_tpu.observability.prometheus` maps events to
+instrument updates and renders the Prometheus text exposition).
+
+Design constraints, in order:
+
+- **jax-free and stdlib-only** (the package contract: bench.py's parent
+  process imports observability without jax);
+- **cheap enough for the serve hot path**: one dict lookup + float add under
+  one registry lock per update — no allocation on the repeat path;
+- **Prometheus-shaped**: counters only go up, histograms are fixed cumulative
+  buckets chosen at declaration, every series is (name, sorted label values),
+  so the text exposition in prometheus.py is a straight dump.
+
+Instruments are declared get-or-create (:meth:`MetricsRegistry.counter` twice
+with the same name returns the same object; a kind/label mismatch raises), so
+emit-site code can declare lazily without coordination.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+from typing import Any, Iterable
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+]
+
+#: Default histogram buckets for request/step latencies, seconds. Spans the
+#: routing stack's real range: sub-ms cache hits to tens-of-seconds cold
+#: compiles (warmup); Prometheus convention, cumulative, +Inf implied.
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class _Instrument:
+    """Shared series bookkeeping: one instrument = name + label names + a
+    series map keyed by the label-values tuple. Zero-label instruments hold
+    exactly one series, keyed by ``()``."""
+
+    kind = "untyped"
+
+    def __init__(
+        self, registry: "MetricsRegistry", name: str, help: str, labels: tuple[str, ...]
+    ) -> None:
+        self._registry = registry
+        self._lock = registry._lock  # one lock per registry, shared
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self._series: dict[tuple[str, ...], Any] = {}
+
+    def _key(self, label_values: dict[str, Any]) -> tuple[str, ...]:
+        if set(label_values) != set(self.labels):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labels}, "
+                f"got {tuple(sorted(label_values))}"
+            )
+        return tuple(str(label_values[k]) for k in self.labels)
+
+    def series(self) -> dict[tuple[str, ...], Any]:
+        """Snapshot of ``label-values -> value`` (scalar, or histogram state
+        dict) — what the exposition renderer iterates."""
+        with self._lock:
+            return {
+                k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in self._series.items()
+            }
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (Prometheus ``counter``)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease (inc {amount})")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Instrument):
+    """Set-to-current-value instrument (Prometheus ``gauge``)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), math.nan))
+
+
+class Histogram(_Instrument):
+    """Fixed cumulative-bucket histogram (Prometheus ``histogram``).
+
+    Buckets are chosen once at declaration (upper bounds, sorted; ``+Inf`` is
+    implicit). Each series holds ``{"buckets": [n per bound], "sum": float,
+    "count": int}`` — ``observe`` is one bisect + three adds.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labels, buckets: Iterable[float]) -> None:
+        super().__init__(registry, name, help, labels)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError(f"histogram {self.name!r} needs at least one bucket")
+        if any(not math.isfinite(b) for b in bounds):
+            raise ValueError(f"histogram {self.name!r}: +Inf bucket is implicit")
+        self.buckets = tuple(bounds)
+
+    def observe(self, value: float, **labels: Any) -> None:
+        value = float(value)
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = self._series[key] = {
+                    "buckets": [0] * (len(self.buckets) + 1),  # +1 = the +Inf bucket
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            # NaN observations land in +Inf only (bisect on NaN is undefined);
+            # they still count, so a NaN-emitting bug shows up in count vs sum
+            idx = len(self.buckets) if value != value else bisect.bisect_left(self.buckets, value)
+            state["buckets"][idx] += 1
+            state["sum"] += value if value == value else 0.0
+            state["count"] += 1
+
+
+class MetricsRegistry:
+    """Named instruments + constant labels, rendered by prometheus.py.
+
+    ``const_labels`` (e.g. ``host``) are attached to every exported series —
+    the multi-host analog of the run log's per-host sidecars.
+    """
+
+    def __init__(self, const_labels: dict[str, Any] | None = None) -> None:
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Instrument] = {}
+        self.const_labels = {str(k): str(v) for k, v in (const_labels or {}).items()}
+
+    # ---- declaration (get-or-create) ----
+
+    def _declare(self, cls, name: str, help: str, labels: tuple, **kw) -> Any:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        labels = tuple(labels)
+        for lab in labels:
+            if not _LABEL_RE.match(lab):
+                raise ValueError(f"invalid label name {lab!r} on metric {name!r}")
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labels != labels:
+                    raise ValueError(
+                        f"metric {name!r} already declared as {existing.kind} with "
+                        f"labels {existing.labels}; cannot redeclare as {cls.kind} "
+                        f"with labels {labels}"
+                    )
+                return existing
+            metric = cls(self, name, help, labels, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "", labels: Iterable[str] = ()) -> Counter:
+        return self._declare(Counter, name, help, tuple(labels))
+
+    def gauge(self, name: str, help: str = "", labels: Iterable[str] = ()) -> Gauge:
+        return self._declare(Gauge, name, help, tuple(labels))
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labels: Iterable[str] = (),
+        buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+    ) -> Histogram:
+        return self._declare(Histogram, name, help, tuple(labels), buckets=buckets)
+
+    # ---- inspection ----
+
+    def collect(self) -> list[_Instrument]:
+        """Declared instruments in declaration order (dict order is stable)."""
+        with self._lock:
+            return list(self._metrics.values())
+
+    def get(self, name: str) -> _Instrument | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Drop every instrument AND series (tests; production never resets —
+        Prometheus counters are cumulative by contract)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# ---------------------------------------------------------------------------
+# The process-wide default registry (what the event tee and /metrics serve).
+# ---------------------------------------------------------------------------
+
+_DEFAULT: MetricsRegistry | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process default registry, created on first use with the writer's
+    host index as a constant label (the same layout the run log stamps)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            from ddr_tpu.observability.events import host_layout
+
+            host, _ = host_layout()
+            _DEFAULT = MetricsRegistry(const_labels={"host": host})
+        return _DEFAULT
+
+
+def set_registry(registry: MetricsRegistry | None) -> None:
+    """Swap (or clear, with None) the process default registry — tests."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = registry

@@ -33,13 +33,24 @@ from typing import Callable, Iterator
 
 log = logging.getLogger(__name__)
 
-__all__ = ["span", "spanned", "trace", "trace_active", "profile_dir_from_env"]
+__all__ = [
+    "span",
+    "spanned",
+    "trace",
+    "trace_active",
+    "profile_dir_from_env",
+    "ProfilerBusyError",
+    "capture_profile",
+]
 
 _tls = threading.local()
 
 # Profiler trace state: depth counts every live trace() frame (so nesting is
 # observable), dir is set only while the profiler is actually started.
 _TRACE = {"depth": 0, "dir": None}
+# Serializes concurrent capture_profile() starts (HTTP threads race; trace()
+# itself stays lock-free — it is used from one thread by construction).
+_CAPTURE_LOCK = threading.Lock()
 
 
 def profile_dir_from_env() -> str | None:
@@ -81,6 +92,56 @@ def trace(log_dir: str | None = None) -> Iterator[None]:
             yield
     finally:
         _TRACE["depth"], _TRACE["dir"] = 0, None
+
+
+class ProfilerBusyError(RuntimeError):
+    """A profiler capture/trace is already running (exactly one may own the
+    ``jax.profiler`` session per process)."""
+
+
+def capture_profile(log_dir: str, seconds: float) -> threading.Timer:
+    """Start a ``jax.profiler`` trace NOW; a daemon timer stops it after
+    ``seconds`` — the on-demand flavor of :func:`trace` behind the serving
+    API's ``POST /v1/profile`` (run-level tracing wraps the whole command;
+    this captures a window of live traffic without restarting anything).
+
+    Returns the stop timer (tests ``join`` it). Raises
+    :class:`ProfilerBusyError` while any :func:`trace` or capture is active —
+    the profiler is a process singleton, and silently nesting would hand the
+    caller a trace owned by someone else's stop.
+    """
+    import jax
+
+    seconds = float(seconds)
+    if seconds <= 0:
+        raise ValueError(f"capture seconds must be > 0, got {seconds}")
+    log_dir = str(log_dir)
+    with _CAPTURE_LOCK:
+        if _TRACE["depth"] > 0:
+            raise ProfilerBusyError(
+                f"a profiler trace is already running (dir={_TRACE['dir']})"
+            )
+        _TRACE["depth"], _TRACE["dir"] = 1, log_dir
+        try:
+            jax.profiler.start_trace(log_dir)
+        except BaseException:
+            _TRACE["depth"], _TRACE["dir"] = 0, None
+            raise
+    log.info(f"profiler capture started: {seconds:.3g}s -> {log_dir}")
+
+    def _stop() -> None:
+        try:
+            jax.profiler.stop_trace()
+            log.info(f"profiler capture finished -> {log_dir}")
+        except Exception:
+            log.exception("profiler capture stop failed")
+        finally:
+            _TRACE["depth"], _TRACE["dir"] = 0, None
+
+    timer = threading.Timer(seconds, _stop)
+    timer.daemon = True
+    timer.start()
+    return timer
 
 
 def _stack() -> list[str]:

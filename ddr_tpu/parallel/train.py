@@ -218,7 +218,9 @@ class ParallelTrainer:
     step, not at construction.
     """
 
-    def __init__(self, cfg: Any, kan_model: Any, optimizer: Any) -> None:
+    def __init__(
+        self, cfg: Any, kan_model: Any, optimizer: Any, collect_health: bool = False
+    ) -> None:
         from ddr_tpu.parallel.sharding import make_mesh
         from ddr_tpu.routing.mc import Bounds
 
@@ -232,6 +234,10 @@ class ParallelTrainer:
         self.cfg = cfg
         self.kan_model = kan_model
         self.optimizer = optimizer
+        #: When True every built step returns the 5-tuple with an on-device
+        #: HealthStats aux (ddr_tpu.observability.health) — part of each
+        #: step's ONE compiled program, identical across all engines.
+        self.collect_health = bool(collect_health)
         _, n = parse_device(cfg.device)
         self.mesh = make_mesh(n)
         self.n_shards = int(self.mesh.devices.size)
@@ -255,6 +261,7 @@ class ParallelTrainer:
             tau=cfg.params.tau,
             warmup=cfg.experiment.warmup,
             optimizer=optimizer,
+            collect_health=self.collect_health,
         )
         self.platform = self.mesh.devices.flat[0].platform
         self._gspmd_step_cached = None

@@ -114,10 +114,15 @@ class GaugeIndex:
 @dataclasses.dataclass(frozen=True)
 class RouteResult:
     """``runoff``: (T, G) gauge-aggregated (or (T, N) full-domain) discharge;
-    ``final_discharge``: (N,) carry state for sequential inference."""
+    ``final_discharge``: (N,) carry state for sequential inference;
+    ``health``: on-device :class:`~ddr_tpu.observability.health.HealthStats`
+    when routed with ``collect_health=True`` (None otherwise — None is an
+    empty pytree node, so existing consumers and compiled programs are
+    unaffected)."""
 
     runoff: jnp.ndarray
     final_discharge: jnp.ndarray
+    health: Any = None
 
 
 def denormalize(value: jnp.ndarray, bounds: tuple[float, float], log_space: bool = False) -> jnp.ndarray:
@@ -244,6 +249,7 @@ def route(
     q_prime_permuted: bool = False,
     remat_physics: bool = True,
     remat_bands: bool = False,
+    collect_health: bool = False,
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -286,9 +292,29 @@ def route(
     ``remat_bands`` (StackedChunked ONLY; ValueError otherwise) checkpoints
     whole band steps so the backward recomputes each band's wave scan instead
     of streaming residuals — see :func:`ddr_tpu.routing.stacked.route_stacked`.
+
+    ``collect_health=True`` additionally computes on-device numerical-health
+    scalars (:func:`ddr_tpu.observability.health.compute_health` — non-finite
+    counts, discharge min/max, mass-balance residual) over the result and
+    returns them as ``RouteResult.health``. They ride the program's existing
+    outputs: a few fused reductions, no extra host sync, no second program.
     """
     from ddr_tpu.routing.chunked import ChunkedNetwork, route_chunked
     from ddr_tpu.routing.stacked import StackedChunked, route_stacked
+
+    def _finish(result: RouteResult) -> RouteResult:
+        if not collect_health:
+            return result
+        from ddr_tpu.observability.health import compute_health
+
+        # q_prime sums are permutation-invariant, so whichever engine order
+        # the local variable ended up in, the residual is identical
+        return dataclasses.replace(
+            result,
+            health=compute_health(
+                result.runoff, q_prime, final_discharge=result.final_discharge
+            ),
+        )
 
     if remat_bands and not isinstance(network, StackedChunked):
         raise ValueError("remat_bands is only supported on a StackedChunked")
@@ -299,15 +325,15 @@ def route(
         if q_prime_permuted:
             raise ValueError(f"q_prime_permuted is not supported on a {kind}")
         if isinstance(network, StackedChunked):
-            return route_stacked(
+            return _finish(route_stacked(
                 network, channels, spatial_params, q_prime, q_init=q_init,
                 gauges=gauges, bounds=bounds, dt=dt,
                 remat_physics=remat_physics, remat_bands=remat_bands,
-            )
-        return route_chunked(
+            ))
+        return _finish(route_chunked(
             network, channels, spatial_params, q_prime, q_init=q_init,
             gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
-        )
+        ))
 
     n_mann = spatial_params["n"]
     q_spatial = spatial_params["q_spatial"]
@@ -361,7 +387,9 @@ def route(
             runoff = jax.vmap(gauges_p.aggregate)(runoff_p)
         else:
             runoff = runoff_p[:, network.wf_inv]
-        return RouteResult(runoff=runoff, final_discharge=final_p[network.wf_inv])
+        return _finish(
+            RouteResult(runoff=runoff, final_discharge=final_p[network.wf_inv])
+        )
     if engine != "step":
         raise ValueError(f"unknown engine {engine!r} (use 'wavefront' or 'step')")
 
@@ -397,4 +425,4 @@ def route(
         q_final = q_final[network.inv_perm]
         if gauges is None:
             runoff = runoff[:, network.inv_perm]
-    return RouteResult(runoff=runoff, final_discharge=q_final)
+    return _finish(RouteResult(runoff=runoff, final_discharge=q_final))

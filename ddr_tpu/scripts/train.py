@@ -106,6 +106,19 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     else:
         opt_state = optimizer.init(params)
 
+    # Numerical-health watchdog (docs/observability.md "Live metrics &
+    # health"): when telemetry is on and DDR_HEALTH_ENABLED isn't 0, every
+    # built step also returns an on-device HealthStats aux (non-finite counts,
+    # discharge range, mass residual, pre-clip grad norm) that the host
+    # thresholds per batch. Part of the step's one compiled program — the
+    # flag is fixed before building so it cannot flip mid-run and recompile.
+    from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
+
+    health_cfg = HealthConfig.from_env()
+    rec = get_recorder()
+    health_on = health_cfg.enabled and rec is not None
+    watchdog = HealthWatchdog(health_cfg) if health_on else None
+
     par = None
     if cfg.experiment.parallel != "none":
         # Multi-chip path (experiment.parallel=gspmd|sharded-wavefront|
@@ -114,7 +127,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
         # below is otherwise identical.
         from ddr_tpu.parallel.train import ParallelTrainer
 
-        par = ParallelTrainer(cfg, kan_model, optimizer)
+        par = ParallelTrainer(cfg, kan_model, optimizer, collect_health=health_on)
         step = None
     else:
         step = make_batch_train_step(
@@ -127,6 +140,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             warmup=cfg.experiment.warmup,
             optimizer=optimizer,
             remat_bands=cfg.experiment.remat_bands,
+            collect_health=health_on,
         )
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
@@ -135,7 +149,6 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     # step/compile/heartbeat events per docs/observability.md. The parallel
     # trainer owns its own tracker (its LRU emits the compile events); the
     # single-device path polls the one jitted step's compile cache.
-    rec = get_recorder()
     tracker = par.compile_tracker if par is not None else CompileTracker()
     try:
         heartbeat_every = int(os.environ.get("DDR_HEARTBEAT_EVERY", "25") or 0)
@@ -224,15 +237,16 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     log.info(f"epoch {epoch}: adaptive KAN grids refit from batch attributes")
 
                 n_timesteps = payload.n_timesteps if par is not None else payload[0].shape[0]
+                hstats = None
                 with throughput.batch(rd.n_segments, n_timesteps):
                     if par is not None:
-                        params, opt_state, loss, daily = par.step(
+                        out = par.step(
                             payload, params, opt_state, obs_daily, obs_mask
                         )
                     else:
                         q_prime, network, channels, gauges = payload
                         with span("step-single"):
-                            params, opt_state, loss, daily = step(
+                            out = step(
                                 params,
                                 opt_state,
                                 network,
@@ -243,8 +257,17 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                 jnp.asarray(obs_daily),
                                 jnp.asarray(obs_mask),
                             )
+                    if health_on:
+                        params, opt_state, loss, daily, hstats = out
+                    else:
+                        params, opt_state, loss, daily = out
                     loss = float(loss)  # device sync: the timing covers the whole step
                 daily = np.asarray(daily)  # (D-2, G)
+                if watchdog is not None and hstats is not None:
+                    # stats rode the step outputs and the loss sync already
+                    # landed — reading them here moves a few scalars, runs
+                    # nothing. One `health` event per violating batch.
+                    watchdog.observe(hstats, epoch=epoch, batch=i)
                 if par is None and rec is not None:
                     # one jitted step serves every batch; compile-cache growth
                     # means this batch's topology re-traced — record it (the
@@ -339,6 +362,8 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     "batches": throughput.batches,
                 },
             )
+            if watchdog is not None:
+                rec.merge_summary("health", watchdog.status())
 
 
 def main(argv: list[str] | None = None) -> int:

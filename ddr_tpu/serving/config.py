@@ -62,6 +62,11 @@ class ServeConfig:
     #: Forecast horizon in hourly steps for networks registered without an
     #: explicit one (DDR_SERVE_HORIZON_HOURS).
     horizon_hours: int = 72
+    #: Ceiling on ``POST /v1/profile?seconds=N`` capture length, seconds
+    #: (DDR_SERVE_PROFILE_MAX_SECONDS). Profiler traces buffer device activity
+    #: in memory until stopped — an unbounded N is a memory-growth footgun on
+    #: a serving host, so the API clamps requests at 400 past this.
+    profile_max_seconds: float = 60.0
 
     def __post_init__(self) -> None:
         if self.backpressure not in BACKPRESSURE_POLICIES:
@@ -75,6 +80,10 @@ class ServeConfig:
             raise ValueError(f"queue_cap must be >= 1, got {self.queue_cap}")
         if self.horizon_hours < 1:
             raise ValueError(f"horizon_hours must be >= 1, got {self.horizon_hours}")
+        if self.profile_max_seconds <= 0:
+            raise ValueError(
+                f"profile_max_seconds must be > 0, got {self.profile_max_seconds}"
+            )
 
     @classmethod
     def from_env(cls, environ: dict | None = None, **overrides) -> "ServeConfig":
@@ -102,6 +111,7 @@ class ServeConfig:
             ("host", "HOST", str, 1.0),
             ("port", "PORT", int, 1.0),
             ("horizon_hours", "HORIZON_HOURS", int, 1.0),
+            ("profile_max_seconds", "PROFILE_MAX_SECONDS", float, 1.0),
         ):
             v = _get(var, cast, scale)
             if v is not None:

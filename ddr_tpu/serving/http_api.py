@@ -5,17 +5,28 @@ framework, and the hot path is the compiled route program, not request
 parsing. Each connection gets a thread; all threads funnel into the service's
 micro-batcher, which is where concurrency actually coalesces.
 
-Endpoints (all JSON):
+Endpoints (all JSON unless noted):
 
 - ``GET /healthz`` — process liveness (200 whenever the server answers);
-- ``GET /readyz`` — 200 after :meth:`ForecastService.warmup` completed, 503
-  before (load balancers gate traffic on this, so cold-compile latency is
-  never user-visible);
+- ``GET /readyz`` — 200 after :meth:`ForecastService.warmup` completed; 503
+  while warming, 503 ``warmup-failed`` when warmup threw (terminal — stop
+  waiting on this pod), and 503 ``unhealthy`` while the numerical-health
+  watchdog reports *degraded* (K consecutive violating batches; it clears
+  itself on the next healthy batch). Load balancers gate traffic here, so
+  cold compiles AND numerically-broken replicas are never user-visible;
+- ``GET /metrics`` — Prometheus text exposition of the live registry
+  (request latency histogram, occupancy, queue depth, sheds, compiles,
+  hot-reloads, ``ddr_health_status``; docs/observability.md has the table);
 - ``GET /v1/models`` / ``GET /v1/networks`` / ``GET /v1/stats`` — registry,
-  domains, and queue/compile/latency counters;
+  domains, and queue/compile/latency/health counters (the two slices are
+  computed alone — no full stats snapshot per poll);
 - ``POST /v1/forecast`` — body ``{"network": str, "model"?: str, "q_prime"?:
   [[...]], "t0"?: int, "gauges"?: [int], "deadline_ms"?: num}``; answers
-  ``{"runoff": [[...]], "version": int, "engine": str, ...}``.
+  ``{"runoff": [[...]], "version": int, "engine": str, ...}``;
+- ``POST /v1/profile?seconds=N`` — start an on-demand ``jax.profiler``
+  capture of live traffic into ``DDR_METRICS_DIR`` (fallbacks: the active
+  run-log directory, then a tmpdir); answers 202 with the trace dir, 409
+  while another capture/trace is running, 400 past the configured cap.
 
 Error mapping: validation -> 400, unknown name -> 404, queue-full rejection ->
 429 (with ``Retry-After``), shed/deadline -> 503, not-warm -> 503.
@@ -25,10 +36,12 @@ from __future__ import annotations
 
 import json
 import logging
+import tempfile
 import threading
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
@@ -65,30 +78,64 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):  # client went away
             pass
 
+    def _send_text(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     # ---- GET ----
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         svc = self.server.service
-        if self.path == "/healthz":
+        path = urlsplit(self.path).path
+        if path == "/healthz":
             self._send(200, {"status": "ok"})
-        elif self.path == "/readyz":
-            if svc.ready:
-                self._send(200, {"status": "ready"})
-            else:
-                self._send(503, {"status": "warming"})
-        elif self.path == "/v1/stats":
+        elif path == "/readyz":
+            self._send(*self._readyz(svc))
+        elif path == "/metrics":
+            from ddr_tpu.observability.prometheus import CONTENT_TYPE, render_text
+
+            self._send_text(200, render_text(svc.metrics), CONTENT_TYPE)
+        elif path == "/v1/stats":
             self._send(200, svc.stats())
-        elif self.path == "/v1/models":
-            self._send(200, {"models": svc.stats()["models"]})
-        elif self.path == "/v1/networks":
-            self._send(200, {"networks": svc.stats()["networks"]})
+        elif path == "/v1/models":
+            self._send(200, {"models": svc.models_info()})
+        elif path == "/v1/networks":
+            self._send(200, {"networks": svc.networks_info()})
         else:
             self._send(404, {"error": f"no route for {self.path}"})
+
+    @staticmethod
+    def _readyz(svc: ForecastService) -> tuple[int, dict]:
+        """Readiness tri-state: warmup-failed and health-degraded are both
+        503 (traffic must not land here) but with distinct, machine-readable
+        statuses — a failed warmup is terminal for the pod, a degraded
+        watchdog clears itself on the next healthy batch."""
+        if svc.warmup_error is not None:
+            return 503, {"status": "warmup-failed", "error": svc.warmup_error}
+        if not svc.ready:
+            return 503, {"status": "warming"}
+        if svc.watchdog.degraded:
+            return 503, {
+                "status": "unhealthy",
+                "consecutive_bad": svc.watchdog.consecutive_bad,
+            }
+        return 200, {"status": "ready"}
 
     # ---- POST ----
 
     def do_POST(self) -> None:  # noqa: N802 - http.server API
-        if self.path != "/v1/forecast":
+        path = urlsplit(self.path).path
+        if path == "/v1/profile":
+            self._post_profile()
+            return
+        if path != "/v1/forecast":
             self._send(404, {"error": f"no route for {self.path}"})
             return
         svc = self.server.service
@@ -154,6 +201,48 @@ class _Handler(BaseHTTPRequestHandler):
         result = dict(result)
         result["runoff"] = np.asarray(result["runoff"]).tolist()
         self._send(200, result)
+
+    def _post_profile(self) -> None:
+        """``POST /v1/profile?seconds=N``: capture a ``jax.profiler`` trace of
+        live traffic for N seconds. Responds 202 immediately (the capture runs
+        while the service keeps serving); the trace lands under
+        ``DDR_METRICS_DIR`` (fallbacks: the active run-log directory, then a
+        fresh tmpdir), ready for xprof/tensorboard."""
+        from ddr_tpu.observability import get_recorder, metrics_dir_from_env
+        from ddr_tpu.observability.spans import ProfilerBusyError, capture_profile
+
+        svc = self.server.service
+        query = parse_qs(urlsplit(self.path).query)
+        raw = query.get("seconds", ["2"])[0]
+        try:
+            seconds = float(raw)
+        except ValueError:
+            self._send(400, {"error": f"seconds={raw!r} is not a number"})
+            return
+        cap = svc.serve_cfg.profile_max_seconds
+        if not 0 < seconds <= cap:
+            self._send(
+                400,
+                {"error": f"seconds must be in (0, {cap}] "
+                          f"(DDR_SERVE_PROFILE_MAX_SECONDS), got {seconds}"},
+            )
+            return
+        rec = get_recorder()
+        trace_dir = metrics_dir_from_env() or (
+            str(rec.path.parent) if rec is not None
+            else tempfile.mkdtemp(prefix="ddr-profile-")
+        )
+        try:
+            capture_profile(trace_dir, seconds)
+        except ProfilerBusyError as e:
+            self._send(409, {"error": str(e)})
+            return
+        except Exception as e:  # profiler start failures are server-side
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(
+            202, {"status": "capturing", "seconds": seconds, "trace_dir": trace_dir}
+        )
 
 
 class ForecastHTTPServer(ThreadingHTTPServer):

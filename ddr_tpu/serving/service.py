@@ -27,7 +27,12 @@ is growth-tracked the same way.
 Every admit/batch/serve/shed decision is a JSONL event (``serve_request``,
 ``serve_batch``, ``serve_shed`` — docs/observability.md) on the active
 recorder, so ``ddr metrics summarize`` reports request latency percentiles and
-batch occupancy with no extra wiring.
+batch occupancy with no extra wiring; the same decisions feed the live
+Prometheus registry (``GET /metrics``). Every executed batch additionally
+returns on-device numerical-health stats riding the compiled program's own
+outputs (:mod:`ddr_tpu.observability.health`): the host thresholds them,
+violating batches emit one ``health`` event each, and K consecutive
+violations degrade ``/readyz`` to 503 until a healthy batch clears it.
 """
 
 from __future__ import annotations
@@ -42,6 +47,8 @@ from typing import Any
 import numpy as np
 
 from ddr_tpu.observability import CompileTracker, get_recorder, span
+from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
+from ddr_tpu.observability.prometheus import declare_serve_metrics, event_tee
 from ddr_tpu.serving.batcher import (
     ForecastRequest,
     MicroBatcher,
@@ -103,11 +110,25 @@ class ForecastService:
     :mod:`ddr_tpu.serving.http_api`) -> :meth:`close`.
     """
 
-    def __init__(self, cfg: Any, serve_cfg: ServeConfig | None = None) -> None:
+    def __init__(
+        self,
+        cfg: Any,
+        serve_cfg: ServeConfig | None = None,
+        health_cfg: HealthConfig | None = None,
+    ) -> None:
         self.cfg = cfg
         self.serve_cfg = serve_cfg or ServeConfig.from_env()
         self.registry = ModelRegistry()
         self.tracker = CompileTracker()
+        # Numerical-health watchdog (docs/observability.md): every executed
+        # batch's on-device HealthStats — riding the compiled program's
+        # outputs — is thresholded host-side; K consecutive violations degrade
+        # /readyz. Declaring the instrument set here means GET /metrics shows
+        # every serve metric name from the first scrape.
+        self.health_cfg = health_cfg or HealthConfig.from_env()
+        self.watchdog = HealthWatchdog(self.health_cfg)
+        self.metrics = declare_serve_metrics()
+        self._warmup_error: str | None = None
         self._networks: dict[str, NetworkEntry] = {}
         self._fns: dict[tuple[str, str], Any] = {}  # (network, model) -> jitted fn
         self._plan_sizes: dict[str, int] = {}  # mesh mode: plan-cache growth watch
@@ -211,22 +232,38 @@ class ForecastService:
     ):
         with self._lock:
             self._ready = False
-        return self.registry.register(name, kan_model, params, arch=arch, source=source)
+        entry = self.registry.register(name, kan_model, params, arch=arch, source=source)
+        self.metrics.get("ddr_model_version").set(entry.version, model=name)
+        return entry
 
     def watch_checkpoints(self, name: str, directory, poll_s: float | None = None):
         """Hot-reload ``name`` from the newest checkpoint under ``directory``
-        (ServeConfig ``reload_poll_s`` cadence; 0 disables)."""
+        (ServeConfig ``reload_poll_s`` cadence; 0 disables). Each applied
+        reload bumps ``ddr_hot_reloads_total`` and ``ddr_model_version``."""
         poll = self.serve_cfg.reload_poll_s if poll_s is None else poll_s
         if poll <= 0:
             log.info("checkpoint watching disabled (reload_poll_s <= 0)")
             return None
-        return self.registry.watch(name, directory, poll_s=poll)
+
+        def _on_reload(entry) -> None:
+            self.metrics.get("ddr_hot_reloads_total").inc(model=entry.name)
+            self.metrics.get("ddr_model_version").set(entry.version, model=entry.name)
+
+        return self.registry.watch(name, directory, poll_s=poll, on_reload=_on_reload)
 
     # ---- warmup / readiness ----
 
     @property
     def ready(self) -> bool:
         return self._ready
+
+    @property
+    def warmup_error(self) -> str | None:
+        """The failure message of the last ``warmup`` attempt, or None. The
+        HTTP ``/readyz`` distinguishes this terminal state (503
+        ``warmup-failed``) from still-warming — a load balancer should stop
+        waiting on a pod whose compile threw, not retry it forever."""
+        return self._warmup_error
 
     def networks(self) -> dict[str, NetworkEntry]:
         with self._lock:
@@ -235,7 +272,8 @@ class ForecastService:
     def warmup(self) -> None:
         """Compile every (network, model) pair's batched program now, so first
         request latency is bounded by execution, not XLA. Each pair emits
-        exactly one ``compile`` event here; the e2e contract is zero after."""
+        exactly one ``compile`` event here; the e2e contract is zero after.
+        A raising warmup is recorded on :attr:`warmup_error` (and re-raised)."""
         pairs = [
             (net, model)
             for net in self.networks().values()
@@ -243,18 +281,26 @@ class ForecastService:
         ]
         if not pairs:
             raise RuntimeError("nothing to warm: register a network and a model first")
-        for net, model in pairs:
-            with span(f"serve-warmup/{net.name}/{model}"):
-                t0 = time.perf_counter()
-                zeros = np.zeros(
-                    (self.serve_cfg.max_batch, net.horizon, net.n_segments),
-                    dtype=np.float32,
-                )
-                self._run_batch(net, self.registry.get(model), zeros, warmup=True)
-                log.info(
-                    f"warmed ({net.name}, {model}) [{self._engine_label(net)}] in "
-                    f"{time.perf_counter() - t0:.2f}s"
-                )
+        # a retry must present as "warming", not the previous attempt's
+        # terminal "warmup-failed" (orchestrators reschedule on the latter)
+        self._warmup_error = None
+        try:
+            for net, model in pairs:
+                with span(f"serve-warmup/{net.name}/{model}"):
+                    t0 = time.perf_counter()
+                    zeros = np.zeros(
+                        (self.serve_cfg.max_batch, net.horizon, net.n_segments),
+                        dtype=np.float32,
+                    )
+                    self._run_batch(net, self.registry.get(model), zeros, warmup=True)
+                    log.info(
+                        f"warmed ({net.name}, {model}) [{self._engine_label(net)}] in "
+                        f"{time.perf_counter() - t0:.2f}s"
+                    )
+        except BaseException as e:
+            self._warmup_error = f"{type(e).__name__}: {e}"
+            raise
+        self._warmup_error = None
         with self._lock:
             self._ready = True
 
@@ -436,11 +482,14 @@ class ForecastService:
     ) -> np.ndarray:
         """Route one padded batch; returns host ``(>= n_live, T, n_outputs)``.
         Every call feeds the compile tracker, so any post-warmup cache growth
-        surfaces as a ``compile`` event."""
+        surfaces as a ``compile`` event; every non-warmup call feeds the
+        health watchdog (the stats rode the program's own outputs — no extra
+        sync, no second program, zero additional jit-cache entries)."""
         import jax
 
         t0 = time.perf_counter()
         label = self._engine_label(net)
+        health = None
         if self._mesh is not None:
             # pad rows carry no request; the mesh path has no batch-shape
             # compile key, so only live rows are routed (warmup routes one —
@@ -450,9 +499,19 @@ class ForecastService:
             self._track_plan_cache(
                 label, net, time.perf_counter() - t0 if warmup else 0.0
             )
+            if self.health_cfg.enabled and not warmup:
+                from ddr_tpu.observability.health import compute_health_host
+
+                # the mesh batch is already a host array — reduce it with
+                # numpy rather than re-uploading it to device just to monitor
+                health = compute_health_host(out, qp[:rows])
         else:
             fn = self._serve_fn(net, entry)
-            out = np.asarray(jax.block_until_ready(fn(entry.params, qp)))
+            # n_live rides as a TRACED scalar (fixed dtype -> one cache
+            # entry); it masks pad rows out of the in-program health stats
+            live = np.int32(qp.shape[0] if n_live is None else n_live)
+            out_d, health = fn(entry.params, qp, live)
+            out = np.asarray(jax.block_until_ready(out_d))
             # jit-cache growth is per compiled fn = per (network, model) pair;
             # a shared network:engine key would count a second model's warmup
             # as a hit and mask its (real) compile
@@ -460,10 +519,23 @@ class ForecastService:
                 f"{net.name}/{entry.name}:{net.engine}", fn, key=net.topology_key,
                 seconds=round(time.perf_counter() - t0, 4) if warmup else 0.0,
             )
+        if health is not None and not warmup:
+            # the batch already synchronized above; reading the stats moves a
+            # few scalars. One `health` event per violating batch, and the
+            # watchdog's consecutive counter is what degrades /readyz.
+            self.watchdog.observe(
+                health, network=net.name, model=entry.name,
+                batch_size=int(qp.shape[0] if n_live is None else n_live),
+            )
         return out
 
     def _serve_fn(self, net: NetworkEntry, entry):
-        """The (network, model) pair's jitted batched program (built once)."""
+        """The (network, model) pair's jitted batched program (built once).
+
+        Returns ``(runoff_batch, HealthStats | None)`` — health (when the
+        watchdog is enabled; a build-time constant) is a few reductions fused
+        into the SAME program, so monitoring adds no jit-cache entry and no
+        second dispatch."""
         cache_key = (net.name, entry.name)
         fn = self._fns.get(cache_key)
         if fn is not None:
@@ -471,6 +543,7 @@ class ForecastService:
         import jax
         import jax.numpy as jnp
 
+        from ddr_tpu.observability.health import compute_health
         from ddr_tpu.routing.mc import Bounds, route
         from ddr_tpu.routing.model import denormalize_spatial_parameters
 
@@ -487,7 +560,10 @@ class ForecastService:
         )
         n = net.n_segments
 
-        def _serve(kan_params, q_prime_b):  # (B, T, N) -> (B, T, n_outputs)
+        collect_health = self.health_cfg.enabled
+
+        def _serve(kan_params, q_prime_b, n_live):
+            # (B, T, N), scalar live-row count -> ((B, T, n_outputs), health)
             raw = kan_model.apply(kan_params, attrs)
             phys = denormalize_spatial_parameters(
                 raw, p.parameter_ranges, p.log_space_parameters, p.defaults, n
@@ -500,7 +576,15 @@ class ForecastService:
                     network, channels, phys, qp, gauges=gauges, bounds=bounds
                 ).runoff
 
-            return jax.vmap(one)(q_prime_b)
+            runoff_b = jax.vmap(one)(q_prime_b)
+            if collect_health:
+                # pad rows are routed but carry no request: masking them out
+                # keeps the residual (and q_min) occupancy-independent
+                mask = jnp.arange(q_prime_b.shape[0]) < n_live
+                health = compute_health(runoff_b, q_prime_b, row_mask=mask)
+            else:
+                health = None
+            return runoff_b, health
 
         fn = jax.jit(_serve)
         with self._lock:
@@ -582,35 +666,53 @@ class ForecastService:
             latency_s=round(req.age(), 6),
         )
 
-    @staticmethod
-    def _emit(event: str, **payload) -> None:
+    def _emit(self, event: str, **payload) -> None:
         rec = get_recorder()
         if rec is not None:
-            rec.emit(event, **payload)
+            rec.emit(event, **payload)  # the active recorder's tee updates metrics
+        else:
+            # no run log: keep the live /metrics registry fed anyway, through
+            # the same one event->instrument mapping (never both paths, so a
+            # decision can't double-count). Guarded like recorder hooks are —
+            # a metrics bug must never fail the batch worker's requests.
+            try:
+                event_tee({"event": event, **payload}, self.metrics)
+            except Exception:
+                log.exception("serve metrics tee failed")
+
+    def models_info(self) -> dict:
+        """The models slice alone (the ``/v1/models`` payload) — one registry
+        snapshot per model so version and source stay paired; no queue locks,
+        no tracker snapshot."""
+        return {
+            entry.name: {"version": entry.version, "source": entry.source}
+            for entry in (self.registry.get(n) for n in self.registry.names())
+        }
+
+    def networks_info(self) -> dict:
+        """The networks slice alone (the ``/v1/networks`` payload)."""
+        return {
+            name: {
+                "n_reaches": net.n_segments,
+                "horizon": net.horizon,
+                "engine": self._engine_label(net),
+                "n_outputs": net.n_outputs,
+            }
+            for name, net in self.networks().items()
+        }
 
     def stats(self) -> dict:
-        """Queue/served/shed counters, compile accounting, model versions —
-        the /v1/stats payload."""
+        """Queue/served/shed counters, compile accounting, model versions,
+        health rollup — the /v1/stats payload."""
         hits, misses = self.tracker.counts()
         return {
             "ready": self._ready,
+            "warmup_error": self._warmup_error,
             "queue": self._batcher.stats(),
             "compiles": {"hits": hits, "misses": misses, **self.tracker.snapshot()},
-            "models": {
-                entry.name: {"version": entry.version, "source": entry.source}
-                for entry in (
-                    self.registry.get(n) for n in self.registry.names()
-                )  # one snapshot per model: version and source stay paired
-            },
-            "networks": {
-                name: {
-                    "n_reaches": net.n_segments,
-                    "horizon": net.horizon,
-                    "engine": self._engine_label(net),
-                    "n_outputs": net.n_outputs,
-                }
-                for name, net in self.networks().items()
-            },
+            "health": self.watchdog.status(),
+            "models": self.models_info(),
+            "networks": self.networks_info(),
         }
 
     def close(self, drain: bool = True) -> None:

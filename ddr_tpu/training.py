@@ -17,6 +17,7 @@ one-day misalignment on an autocorrelated signal.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import pickle
@@ -83,10 +84,30 @@ def masked_l1_daily(runoff_tg, obs_daily, obs_mask, tau: int, warmup: int):
     return err.sum() / jnp.maximum(mask.sum(), 1), daily
 
 
-def _make_step(loss_fn, optimizer):
+def _make_step(loss_fn, optimizer, collect_health: bool = False):
     """Shared jitted step scaffolding for every builder whose loss takes
     ``(params, attrs, q_prime, obs_daily, obs_mask)``: value_and_grad ->
-    clip+Adam update -> apply. One definition so the builders cannot drift."""
+    clip+Adam update -> apply. One definition so the builders cannot drift.
+
+    With ``collect_health`` the loss aux is ``(daily, HealthStats)``; the step
+    stamps the gradient global-norm into the stats (pre-clip — the watchdog
+    wants the raw explosion signal, not the clipped one) and returns a
+    5-tuple ``(params, opt_state, loss, daily, health)``. Everything stays
+    inside the one jitted program — no extra sync, no second compile."""
+
+    if collect_health:
+
+        @jax.jit
+        def step_h(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
+            (loss, (daily, health)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, attrs, q_prime, obs_daily, obs_mask
+            )
+            health = dataclasses.replace(health, grad_norm=optax.global_norm(grads))
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, daily, health
+
+        return step_h
 
     @jax.jit
     def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
@@ -112,6 +133,7 @@ def make_train_step(
     tau: int,
     warmup: int,
     optimizer: optax.GradientTransformation,
+    collect_health: bool = False,
 ):
     """Build the jitted train step for one compiled network shape.
 
@@ -122,6 +144,10 @@ def make_train_step(
     - ``q_prime``: (T, N) hourly lateral inflow (already flow-scaled)
     - ``obs_daily``: (D-2, G) observed daily discharge aligned to days 1..D-2
     - ``obs_mask``: (D-2, G) True where the observation is valid
+
+    ``collect_health`` appends an on-device
+    :class:`~ddr_tpu.observability.health.HealthStats` (route health +
+    pre-clip grad norm) as a 5th return — see :func:`_make_step`.
     """
     n_segments = channels.length.shape[0]
 
@@ -131,10 +157,16 @@ def make_train_step(
         spatial = denormalize_spatial_parameters(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
-        result = route(network, channels, spatial, q_prime, gauges=gauges, bounds=bounds)
-        return masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
+        result = route(
+            network, channels, spatial, q_prime, gauges=gauges, bounds=bounds,
+            collect_health=collect_health,
+        )
+        loss, daily = masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
+        if collect_health:
+            return loss, (daily, result.health)
+        return loss, daily
 
-    return _make_step(loss_fn, optimizer)
+    return _make_step(loss_fn, optimizer, collect_health=collect_health)
 
 
 def make_batch_train_step(
@@ -147,6 +179,7 @@ def make_batch_train_step(
     warmup: int,
     optimizer: optax.GradientTransformation,
     remat_bands: bool = False,
+    collect_health: bool = False,
 ):
     """Like :func:`make_train_step` but with the network/channels/gauges as call-time
     arguments, so one jitted function serves every training batch.
@@ -173,8 +206,28 @@ def make_batch_train_step(
         result = route(
             network, channels, spatial, q_prime, gauges=gauges, bounds=bounds,
             remat_bands=remat_bands and isinstance(network, StackedChunked),
+            collect_health=collect_health,
         )
-        return masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
+        loss, daily = masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
+        if collect_health:
+            return loss, (daily, result.health)
+        return loss, daily
+
+    if collect_health:
+
+        @jax.jit
+        def step_h(params, opt_state, network, channels, gauges, attrs, q_prime,
+                   obs_daily, obs_mask):
+            (loss, (daily, health)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask
+            )
+            # pre-clip grad norm: the watchdog wants the raw explosion signal
+            health = dataclasses.replace(health, grad_norm=optax.global_norm(grads))
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, daily, health
+
+        return step_h
 
     @jax.jit
     def step(params, opt_state, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
@@ -201,6 +254,7 @@ def make_sharded_train_step(
     tau: int,
     warmup: int,
     optimizer: optax.GradientTransformation,
+    collect_health: bool = False,
 ):
     """Multi-chip train step on the SHARDED WAVEFRONT engine.
 
@@ -232,9 +286,17 @@ def make_sharded_train_step(
         runoff, _ = sharded_wavefront_route(
             mesh, schedule, channels, spatial, q_prime, bounds=bounds
         )
-        return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
+        loss, daily = masked_l1_daily(
+            jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup
+        )
+        if collect_health:
+            from ddr_tpu.observability.health import compute_health
 
-    return _make_step(loss_fn, optimizer)
+            # full-domain runoff, pre-aggregation: health over every reach
+            return loss, (daily, compute_health(runoff, q_prime))
+        return loss, daily
+
+    return _make_step(loss_fn, optimizer, collect_health=collect_health)
 
 
 def make_sharded_chunked_train_step(
@@ -251,6 +313,7 @@ def make_sharded_chunked_train_step(
     warmup: int,
     optimizer: optax.GradientTransformation,
     remat_bands: bool = False,
+    collect_health: bool = False,
 ):
     """Multi-chip train step at CONTINENTAL DEPTH: the sharded depth-chunked
     router (:func:`ddr_tpu.parallel.chunked.route_chunked_sharded`) under the
@@ -289,9 +352,16 @@ def make_sharded_chunked_train_step(
         )
         kw = {"remat_bands": remat_bands} if stacked else {}
         runoff, _ = router(mesh, layout, channels, spatial, q_prime, bounds=bounds, **kw)
-        return masked_l1_daily(jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup)
+        loss, daily = masked_l1_daily(
+            jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup
+        )
+        if collect_health:
+            from ddr_tpu.observability.health import compute_health
 
-    return _make_step(loss_fn, optimizer)
+            return loss, (daily, compute_health(runoff, q_prime))
+        return loss, daily
+
+    return _make_step(loss_fn, optimizer, collect_health=collect_health)
 
 
 # Bump when the checkpoint blob layout changes; load_state refuses mismatches with

@@ -77,8 +77,66 @@ class TestRecorderRoundTrip:
     def test_event_vocabulary_is_closed(self):
         assert set(EVENT_TYPES) == {
             "run_start", "step", "eval", "compile", "heartbeat", "span", "run_end",
-            "serve_request", "serve_batch", "serve_shed",
+            "serve_request", "serve_batch", "serve_shed", "health",
         }
+
+
+class TestFlushBatching:
+    """DDR_METRICS_FLUSH_EVERY: batch flushes for high-rate emitters; close()
+    always drains."""
+
+    def test_default_flushes_every_line(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        rec.emit("step", loss=1.0)
+        # visible to a concurrent reader immediately (the PR-1 behavior)
+        assert len(_read(tmp_path / "log.jsonl")) == 1
+        rec.close()
+
+    def test_batched_flush_defers_then_drains(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl", flush_every=3)
+        rec.emit("step", loss=1.0)
+        rec.emit("step", loss=2.0)
+        assert _read(tmp_path / "log.jsonl") == []  # still buffered
+        rec.emit("step", loss=3.0)  # third event hits the cadence
+        assert len(_read(tmp_path / "log.jsonl")) == 3
+        rec.emit("step", loss=4.0)  # buffered again...
+        rec.close()  # ...but close flushes regardless (run_end included)
+        events = _read(tmp_path / "log.jsonl")
+        assert [e["event"] for e in events] == ["step"] * 4 + ["run_end"]
+
+    def test_env_cadence_and_malformed_fallback(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DDR_METRICS_FLUSH_EVERY", "2")
+        rec = Recorder(tmp_path / "a.jsonl")
+        rec.emit("step", loss=1.0)
+        assert _read(tmp_path / "a.jsonl") == []
+        rec.emit("step", loss=2.0)
+        assert len(_read(tmp_path / "a.jsonl")) == 2
+        rec.close()
+        monkeypatch.setenv("DDR_METRICS_FLUSH_EVERY", "lots")
+        rec2 = Recorder(tmp_path / "b.jsonl")  # falls back to 1, no raise
+        rec2.emit("step", loss=1.0)
+        assert len(_read(tmp_path / "b.jsonl")) == 1
+        rec2.close()
+
+
+class TestEmitHooks:
+    def test_hooks_see_full_record_and_never_break_emit(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        seen = []
+        rec.add_hook(seen.append)
+        rec.add_hook(seen.append)  # idempotent: same callable installs once
+
+        def boom(record):
+            raise RuntimeError("hook bug")
+
+        rec.add_hook(boom)
+        rec.emit("step", loss=1.0)
+        rec.close()
+        # emit survived the raising hook and the good hook saw the envelope
+        steps = [r for r in seen if r["event"] == "step"]
+        assert len(steps) == 1
+        assert steps[0]["loss"] == 1.0 and "seq" in steps[0]
+        assert len(_read(tmp_path / "log.jsonl")) == 2  # step + run_end
 
 
 class TestPrimaryProcessWrites:
@@ -131,6 +189,36 @@ class TestHeartbeat:
         assert isinstance(stats, list) and len(stats) <= 2
         for entry in stats:
             assert "id" in entry and "platform" in entry
+
+    def test_device_memory_stats_cpu_backend_partial_no_raise(self):
+        """On a CPU backend memory_stats() is unsupported: every local device
+        must still yield an id/platform entry, byte fields simply absent."""
+        import jax
+
+        stats = device_memory_stats()
+        assert stats, "an initialized backend must report its devices"
+        assert len(stats) == min(len(jax.local_devices()), 8)
+        for entry in stats:
+            assert entry["platform"] == jax.local_devices()[0].platform
+            assert isinstance(entry["id"], int)
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                assert k not in entry or isinstance(entry[k], int)
+
+    def test_device_memory_stats_without_jax_is_empty(self, monkeypatch):
+        import sys
+
+        monkeypatch.setitem(sys.modules, "jax", None)  # "never imported"
+        assert device_memory_stats() == []
+
+    def test_device_memory_stats_backend_failure_is_empty(self, monkeypatch):
+        import sys
+
+        class _BrokenJax:
+            def local_devices(self):
+                raise RuntimeError("backend exploded")
+
+        monkeypatch.setitem(sys.modules, "jax", _BrokenJax())
+        assert device_memory_stats() == []
 
     def test_no_active_recorder_is_silent(self):
         deactivate()

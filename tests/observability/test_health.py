@@ -1,0 +1,257 @@
+"""Numerical-health watchdog tests: on-device stat computation (standalone,
+inside jit, riding route() and the train step), env-config parsing, and the
+host-side threshold/consecutive/degraded state machine with its telemetry."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.observability import Recorder, activate, deactivate
+from ddr_tpu.observability.health import (
+    HealthConfig,
+    HealthStats,
+    HealthWatchdog,
+    compute_health,
+)
+from ddr_tpu.observability.registry import MetricsRegistry, set_registry
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    set_registry(MetricsRegistry(const_labels={"host": 0}))
+    yield
+    set_registry(None)
+
+
+class TestComputeHealth:
+    def test_clean_batch(self):
+        q = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        h = compute_health(q, q)
+        assert int(h.nonfinite) == 0
+        assert float(h.q_min) == 1.0 and float(h.q_max) == 4.0
+        # runoff == inflow => residual ~ 0
+        assert float(h.mass_residual) == pytest.approx(0.0, abs=1e-5)
+        assert h.grad_norm is None
+
+    def test_counts_nonfinite_in_all_inputs(self):
+        runoff = jnp.asarray([[1.0, jnp.nan]])
+        qp = jnp.asarray([[jnp.inf, 1.0]])
+        fd = jnp.asarray([jnp.nan])
+        h = compute_health(runoff, qp, final_discharge=fd)
+        assert int(h.nonfinite) == 3
+        # min/max over the FINITE entries only
+        assert float(h.q_min) == 1.0 and float(h.q_max) == 1.0
+        assert math.isfinite(float(h.mass_residual))
+
+    def test_row_mask_makes_stats_occupancy_independent(self):
+        """Pad rows of a serving batch slot must not leak into the stats: one
+        live row in a B=4 slot and the same row alone must agree exactly."""
+        live = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])[None]  # (1, T, G)
+        pad = jnp.full((3, 2, 2), 7.0)  # routed pad rows: nonzero discharge
+        batch = jnp.concatenate([live, pad])
+        qp_live = jnp.asarray([[0.5, 0.5], [0.5, 0.5]])[None]
+        qp = jnp.concatenate([qp_live, jnp.zeros((3, 2, 2))])
+        mask = jnp.arange(4) < 1
+        h_masked = compute_health(batch, qp, row_mask=mask)
+        h_alone = compute_health(live, qp_live)
+        for field in ("nonfinite", "q_min", "q_max", "mass_residual"):
+            assert float(getattr(h_masked, field)) == pytest.approx(
+                float(getattr(h_alone, field))
+            ), field
+        # without the mask, pad rows dominate q_max and skew the residual
+        h_unmasked = compute_health(batch, qp)
+        assert float(h_unmasked.q_max) == 7.0
+        assert float(h_unmasked.mass_residual) != pytest.approx(
+            float(h_alone.mass_residual)
+        )
+        # NaNs hiding in PAD rows are ignored; NaNs in LIVE rows still count
+        nan_pad = batch.at[2, 0, 0].set(jnp.nan)
+        assert int(compute_health(nan_pad, qp, row_mask=mask).nonfinite) == 0
+        nan_live = batch.at[0, 0, 0].set(jnp.nan)
+        assert int(compute_health(nan_live, qp, row_mask=mask).nonfinite) == 1
+
+    def test_compute_health_host_matches_device(self):
+        from ddr_tpu.observability.health import compute_health_host
+
+        runoff = np.array([[1.0, np.nan], [2.0, 3.0]], dtype=np.float32)
+        qp = np.array([[0.5, np.inf], [0.5, 0.5]], dtype=np.float32)
+        h_np = compute_health_host(runoff, qp)
+        h_dev = compute_health(jnp.asarray(runoff), jnp.asarray(qp))
+        assert int(h_np.nonfinite) == int(h_dev.nonfinite) == 2
+        assert float(h_np.q_min) == float(h_dev.q_min)
+        assert float(h_np.q_max) == float(h_dev.q_max)
+        assert float(h_np.mass_residual) == pytest.approx(
+            float(h_dev.mass_residual), rel=1e-6
+        )
+
+    def test_inside_jit_is_a_pytree(self):
+        h = jax.jit(lambda q: compute_health(q, q))(jnp.ones((3, 4)))
+        assert isinstance(h, HealthStats)
+        leaves = jax.tree_util.tree_leaves(h)
+        assert all(leaf.shape == () for leaf in leaves)
+
+    def test_route_collect_health_rides_result(self):
+        from ddr_tpu.geodatazoo.synthetic import make_basin
+        from ddr_tpu.routing.mc import route
+        from ddr_tpu.routing.model import prepare_batch
+
+        basin = make_basin(n_segments=16, n_gauges=2, n_days=2, seed=0)
+        network, channels, gauges = prepare_batch(basin.routing_data, 1e-4)
+        params = {
+            "n": jnp.full(16, 0.03),
+            "q_spatial": jnp.full(16, 0.5),
+            "p_spatial": jnp.full(16, 21.0),
+        }
+        qp = jnp.asarray(basin.q_prime[:12])
+        res = route(network, channels, params, qp, gauges=gauges)
+        assert res.health is None  # default: exactly the old result
+        res_h = route(network, channels, params, qp, gauges=gauges, collect_health=True)
+        assert int(res_h.health.nonfinite) == 0
+        np.testing.assert_allclose(
+            np.asarray(res.runoff), np.asarray(res_h.runoff)
+        )  # health is observational only
+        bad = qp.at[0, 3].set(jnp.nan)
+        res_bad = route(network, channels, params, bad, gauges=gauges, collect_health=True)
+        assert int(res_bad.health.nonfinite) > 0
+
+    def test_train_step_returns_health_with_grad_norm(self, tmp_path):
+        from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+        from ddr_tpu.routing.mc import Bounds
+        from ddr_tpu.routing.model import prepare_batch
+        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.training import make_batch_train_step, make_optimizer
+        from tests.serving.conftest import make_cfg
+
+        cfg = make_cfg(tmp_path, mode="training")
+        kan_model, params = build_kan(cfg)
+        basin = observe(make_basin(n_segments=16, n_gauges=2, n_days=4, seed=0), cfg)
+        rd = basin.routing_data
+        optimizer = make_optimizer(1e-3)
+        opt_state = optimizer.init(params)
+        step = make_batch_train_step(
+            kan_model, Bounds(), cfg.params.parameter_ranges,
+            cfg.params.log_space_parameters, cfg.params.defaults,
+            tau=cfg.params.tau, warmup=0, optimizer=optimizer,
+            collect_health=True,
+        )
+        network, channels, gauges = prepare_batch(rd, 1e-4)
+        obs_daily = jnp.asarray(basin.obs_daily)
+        params, opt_state, loss, daily, health = step(
+            params, opt_state, network, channels, gauges,
+            jnp.asarray(rd.normalized_spatial_attributes),
+            jnp.asarray(basin.q_prime), obs_daily,
+            jnp.ones_like(obs_daily, dtype=bool),
+        )
+        assert isinstance(health, HealthStats)
+        assert int(health.nonfinite) == 0
+        gn = float(health.grad_norm)
+        assert math.isfinite(gn) and gn >= 0
+
+
+class TestHealthConfig:
+    def test_defaults_only_flag_nonfinite(self):
+        cfg = HealthConfig()
+        assert cfg.enabled and cfg.max_nonfinite == 0
+        assert cfg.max_discharge == math.inf and cfg.max_residual == math.inf
+
+    def test_env_parsing(self):
+        cfg = HealthConfig.from_env({
+            "DDR_HEALTH_ENABLED": "0",
+            "DDR_HEALTH_MAX_NONFINITE": "5",
+            "DDR_HEALTH_MAX_DISCHARGE": "1e6",
+            "DDR_HEALTH_MAX_GRAD_NORM": "100",
+            "DDR_HEALTH_BAD_BATCHES": "7",
+        })
+        assert not cfg.enabled
+        assert cfg.max_nonfinite == 5
+        assert cfg.max_discharge == 1e6
+        assert cfg.max_grad_norm == 100
+        assert cfg.bad_batches == 7
+
+    def test_overrides_beat_env(self):
+        cfg = HealthConfig.from_env({"DDR_HEALTH_BAD_BATCHES": "7"}, bad_batches=2)
+        assert cfg.bad_batches == 2
+
+    def test_bad_values_raise(self):
+        with pytest.raises(ValueError):
+            HealthConfig(bad_batches=0)
+        with pytest.raises(ValueError):
+            HealthConfig.from_env({"DDR_HEALTH_MAX_NONFINITE": "many"})
+
+
+def _stats(nonfinite=0, q_min=0.1, q_max=10.0, residual=0.0, grad_norm=None):
+    return HealthStats(
+        nonfinite=np.int32(nonfinite), q_min=np.float32(q_min),
+        q_max=np.float32(q_max), mass_residual=np.float32(residual),
+        grad_norm=None if grad_norm is None else np.float32(grad_norm),
+    )
+
+
+class TestWatchdog:
+    def test_healthy_batches_keep_gauge_up(self):
+        w = HealthWatchdog(HealthConfig())
+        assert w.observe(_stats()) == []
+        assert not w.degraded and w.consecutive_bad == 0
+        assert w.status()["batches"] == 1
+
+    def test_each_violation_kind(self):
+        cfg = HealthConfig(max_discharge=100.0, max_residual=10.0, max_grad_norm=1.0)
+        w = HealthWatchdog(cfg)
+        assert w.check(_stats(nonfinite=1)) == ["non-finite"]
+        assert w.check(_stats(q_max=1e4)) == ["discharge-max"]
+        assert w.check(_stats(residual=-50.0)) == ["mass-residual"]
+        assert w.check(_stats(grad_norm=5.0)) == ["grad-norm"]
+        # a NaN grad norm is unhealthy even with the threshold off
+        w_inf = HealthWatchdog(HealthConfig())
+        assert w_inf.check(_stats(grad_norm=math.nan)) == ["grad-norm"]
+
+    def test_consecutive_degraded_and_recovery(self):
+        w = HealthWatchdog(HealthConfig(bad_batches=2))
+        w.observe(_stats(nonfinite=1))
+        assert not w.degraded
+        w.observe(_stats(nonfinite=1))
+        assert w.degraded
+        w.observe(_stats())  # one healthy batch clears it
+        assert not w.degraded and w.consecutive_bad == 0
+
+    def test_disabled_observes_nothing(self):
+        w = HealthWatchdog(HealthConfig(enabled=False))
+        assert w.observe(_stats(nonfinite=99)) == []
+        assert w.status()["batches"] == 0
+
+    def test_one_event_per_violating_batch(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        try:
+            w = HealthWatchdog(HealthConfig())
+            w.observe(_stats())  # healthy: no event
+            w.observe(_stats(nonfinite=2), epoch=1, batch=4)
+        finally:
+            deactivate(rec)
+            rec.close()
+        events = [json.loads(line) for line in (tmp_path / "log.jsonl").read_text().splitlines()]
+        health = [e for e in events if e["event"] == "health"]
+        assert len(health) == 1
+        (ev,) = health
+        assert ev["reasons"] == ["non-finite"]
+        assert ev["nonfinite"] == 2 and ev["epoch"] == 1 and ev["batch"] == 4
+        assert ev["consecutive"] == 1
+
+    def test_gauge_and_counter_flip(self):
+        from ddr_tpu.observability.registry import get_registry
+
+        w = HealthWatchdog(HealthConfig())
+        g = get_registry().get("ddr_health_status")
+        assert g.value() == 1.0
+        w.observe(_stats(nonfinite=1))
+        assert g.value() == 0.0
+        assert get_registry().get("ddr_health_violations_total").value(
+            reason="non-finite") == 1
+        w.observe(_stats())
+        assert g.value() == 1.0

@@ -75,6 +75,29 @@ class TestLoadEvents:
         walls = [e["wall"] for e in events]
         assert walls == sorted(walls)  # merged in wall order
 
+    def test_directory_with_corrupt_lines_in_main_and_sidecar(self, tmp_path):
+        """The multi-host crash case in one read: a directory whose main log
+        AND host sidecars both carry torn/garbage lines must merge the valid
+        events in wall order and count every corrupt line, never raise."""
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        with p.open("a") as f:
+            f.write('{"event": "step", "t":\n')  # main log torn mid-write
+        sidecar = tmp_path / "run_log.train.host1.jsonl"
+        good = {"event": "heartbeat", "t": 1.3, "wall": 101.3, "host": 1,
+                "pid": 2, "seq": 0, "step": 1, "devices": []}
+        sidecar.write_text(
+            json.dumps(good) + "\n"
+            + "garbage not json\n"
+            + '["a", "json", "array", "not", "an", "event"]\n'
+            + "\n"  # blank lines are skipped silently, not corrupt
+        )
+        events, bad = load_events(tmp_path)
+        assert bad == 3
+        assert len(events) == 10  # 9 golden + the sidecar heartbeat
+        assert {e.get("host") for e in events} == {0, 1}
+        walls = [e["wall"] for e in events]
+        assert walls == sorted(walls)
+
     def test_missing_file_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             load_events(tmp_path / "nope.jsonl")
@@ -96,6 +119,30 @@ class TestSummarize:
         assert "loss" in out and "0.5" in out
         assert "heartbeats" in out
         assert "prepare" in out  # span table
+
+    def test_health_section_renders(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        with p.open("a") as f:
+            for i, consec in enumerate((1, 2)):
+                f.write(json.dumps({
+                    "event": "health", "t": 2.0 + i, "wall": 102.0 + i,
+                    "host": 0, "pid": 1, "seq": 50 + i,
+                    "reasons": ["non-finite"], "nonfinite": 3 + i,
+                    "q_min": 1e-4, "q_max": 125.0, "mass_residual": 2.5,
+                    "grad_norm": 7.0, "consecutive": consec,
+                }) + "\n")
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "health   : 2 violating batches — non-finite 2" in out
+        assert "worst nonfinite 4" in out
+        assert "max discharge 125" in out
+        assert "max grad-norm 7" in out
+        assert "last consecutive run 2" in out
+
+    def test_no_health_section_without_events(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        assert main(["summarize", str(p)]) == 0
+        assert "health   :" not in capsys.readouterr().out
 
     def test_multi_host_dir(self, tmp_path, capsys):
         _write_golden(tmp_path / "run_log.train.jsonl")

@@ -1,0 +1,221 @@
+"""Metrics registry + Prometheus exposition tests: instrument semantics,
+label/series bookkeeping, text-format rendering, the event tee, and the
+background exporter on a real ephemeral port."""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ddr_tpu.observability.prometheus import (
+    declare_serve_metrics,
+    event_tee,
+    render_text,
+    start_exporter,
+    stop_exporter,
+)
+from ddr_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    """Every test gets a fresh process-default registry (and no leaked
+    exporter)."""
+    set_registry(MetricsRegistry(const_labels={"host": 0}))
+    yield get_registry()
+    stop_exporter()
+    set_registry(None)
+
+
+class TestInstruments:
+    def test_counter_inc_and_labels(self):
+        r = MetricsRegistry()
+        c = r.counter("ddr_things_total", "things", labels=("kind",))
+        c.inc(kind="a")
+        c.inc(2, kind="a")
+        c.inc(kind="b")
+        assert c.value(kind="a") == 3
+        assert c.value(kind="b") == 1
+        assert c.value(kind="never") == 0
+
+    def test_counter_cannot_decrease(self):
+        c = MetricsRegistry().counter("c_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc(self):
+        g = MetricsRegistry().gauge("g")
+        g.set(5)
+        g.inc(-2)
+        assert g.value() == 3
+
+    def test_histogram_buckets_cumulative(self):
+        h = MetricsRegistry().histogram("h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        state = h.series()[()]
+        assert state["buckets"] == [1, 1, 1]  # per-bucket raw counts incl +Inf
+        assert state["count"] == 3
+        assert state["sum"] == pytest.approx(2.55)
+
+    def test_histogram_observe_on_bound_is_le(self):
+        h = MetricsRegistry().histogram("h", buckets=(1.0,))
+        h.observe(1.0)  # le="1.0" is inclusive (Prometheus semantics)
+        assert h.series()[()]["buckets"] == [1, 0]
+
+    def test_get_or_create_is_idempotent_and_type_checked(self):
+        r = MetricsRegistry()
+        c1 = r.counter("x_total", labels=("a",))
+        assert r.counter("x_total", labels=("a",)) is c1
+        with pytest.raises(ValueError):
+            r.gauge("x_total")
+        with pytest.raises(ValueError):
+            r.counter("x_total", labels=("b",))
+
+    def test_invalid_names_rejected(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError):
+            r.counter("bad name")
+        with pytest.raises(ValueError):
+            r.counter("ok_total", labels=("bad-label",))
+
+
+class TestRenderText:
+    def test_counter_and_gauge_lines(self):
+        r = MetricsRegistry(const_labels={"host": 1})
+        r.counter("ddr_a_total", "a help", labels=("k",)).inc(k="x")
+        r.gauge("ddr_b").set(2.5)
+        txt = render_text(r)
+        assert "# HELP ddr_a_total a help" in txt
+        assert "# TYPE ddr_a_total counter" in txt
+        assert 'ddr_a_total{host="1",k="x"} 1' in txt
+        assert "# TYPE ddr_b gauge" in txt
+        assert 'ddr_b{host="1"} 2.5' in txt
+        assert txt.endswith("\n")
+
+    def test_histogram_exposition_shape(self):
+        r = MetricsRegistry()
+        h = r.histogram("ddr_lat_seconds", "lat", buckets=(0.01, 0.1))
+        h.observe(0.05)
+        h.observe(0.05)
+        txt = render_text(r)
+        assert 'ddr_lat_seconds_bucket{le="0.01"} 0' in txt
+        assert 'ddr_lat_seconds_bucket{le="0.1"} 2' in txt
+        assert 'ddr_lat_seconds_bucket{le="+Inf"} 2' in txt
+        assert "ddr_lat_seconds_sum 0.1" in txt
+        assert "ddr_lat_seconds_count 2" in txt
+
+    def test_label_value_escaping(self):
+        r = MetricsRegistry()
+        r.counter("e_total", labels=("p",)).inc(p='a"b\\c\nd')
+        txt = render_text(r)
+        assert 'p="a\\"b\\\\c\\nd"' in txt
+
+    def test_declared_but_empty_metrics_still_typed(self):
+        r = declare_serve_metrics(MetricsRegistry())
+        txt = render_text(r)
+        # names are visible from the first scrape, before any traffic
+        assert "# TYPE ddr_request_latency_seconds histogram" in txt
+        assert "ddr_health_status 1" in txt  # initialized healthy
+        assert "ddr_queue_depth 0" in txt
+
+
+class TestEventTee:
+    def test_serve_events_update_instruments(self):
+        r = declare_serve_metrics(MetricsRegistry())
+        event_tee({"event": "serve_request", "status": "ok", "network": "n",
+                   "model": "m", "latency_s": 0.02}, r)
+        event_tee({"event": "serve_request", "status": "shed:deadline",
+                   "network": "n", "model": "m", "latency_s": 0.5}, r)
+        event_tee({"event": "serve_batch", "network": "n", "model": "m",
+                   "size": 3, "occupancy": 0.75, "seconds": 0.01,
+                   "queue_depth": 7}, r)
+        event_tee({"event": "serve_shed", "reason": "deadline"}, r)
+        event_tee({"event": "compile", "engine": "wavefront"}, r)
+        assert r.get("ddr_requests_total").value(
+            status="ok", network="n", model="m") == 1
+        # latency histogram only counts served requests
+        assert r.get("ddr_request_latency_seconds").series()[("n", "m")]["count"] == 1
+        assert r.get("ddr_queue_depth").value() == 7
+        assert r.get("ddr_sheds_total").value(reason="deadline") == 1
+        assert r.get("ddr_compiles_total").value(engine="wavefront") == 1
+        assert r.get("ddr_events_total").value(event="serve_request") == 2
+
+    def test_step_and_health_events(self):
+        r = MetricsRegistry()
+        event_tee({"event": "step", "engine": "single", "seconds": 0.2,
+                   "loss": 1.5}, r)
+        event_tee({"event": "health", "reasons": ["non-finite", "grad-norm"]}, r)
+        assert r.get("ddr_steps_total").value(engine="single") == 1
+        assert r.get("ddr_loss").value() == 1.5
+        assert r.get("ddr_health_violations_total").value(reason="non-finite") == 1
+        assert r.get("ddr_health_violations_total").value(reason="grad-norm") == 1
+
+    def test_unknown_event_only_counts_generically(self):
+        r = MetricsRegistry()
+        event_tee({"event": "totally_new"}, r)  # must not raise
+        assert r.get("ddr_events_total").value(event="totally_new") == 1
+
+    def test_recorder_activation_installs_tee(self, tmp_path):
+        from ddr_tpu.observability import Recorder, activate, deactivate
+
+        rec = Recorder(tmp_path / "log.jsonl")
+        try:
+            activate(rec)
+            rec.emit("step", engine="single", seconds=0.1, loss=2.0)
+        finally:
+            deactivate(rec)
+            rec.close()
+        assert get_registry().get("ddr_steps_total").value(engine="single") == 1
+        # re-activation must not double-install the hook
+        rec2 = Recorder(tmp_path / "log2.jsonl")
+        try:
+            activate(rec2)
+            activate(rec2)
+            rec2.emit("step", engine="single", seconds=0.1, loss=2.0)
+        finally:
+            deactivate(rec2)
+            rec2.close()
+        assert get_registry().get("ddr_steps_total").value(engine="single") == 2
+
+
+class TestExporter:
+    def test_scrape_over_http(self):
+        get_registry().counter("ddr_scrape_me_total").inc()
+        server = start_exporter(port=0)
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert 'ddr_scrape_me_total{host="0"} 1' in body
+
+    def test_second_start_returns_same_server(self):
+        s1 = start_exporter(port=0)
+        s2 = start_exporter(port=0)
+        assert s1 is s2
+
+    def test_env_start_and_malformed_port(self, monkeypatch):
+        from ddr_tpu.observability.prometheus import maybe_start_exporter_from_env
+
+        monkeypatch.delenv("DDR_PROM_PORT", raising=False)
+        assert maybe_start_exporter_from_env() is None
+        monkeypatch.setenv("DDR_PROM_PORT", "not-a-port")
+        assert maybe_start_exporter_from_env() is None
+        monkeypatch.setenv("DDR_PROM_PORT", "0")
+        server = maybe_start_exporter_from_env()
+        assert server is not None
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.status == 200
+
+    def test_unknown_path_404(self):
+        server = start_exporter(port=0)
+        url = server.url.replace("/metrics", "/nope")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 404

@@ -1,0 +1,85 @@
+"""scripts/check_event_schema.py: the tree's literal emit() names must all be
+registered EVENT_TYPES — and the checker must actually catch offenders."""
+
+from __future__ import annotations
+
+import importlib.util
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_event_schema.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_event_schema", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_tree_is_clean():
+    """THE CI gate: a new emit() with an unregistered name fails the suite."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all registered" in proc.stdout
+
+
+def test_registered_events_matches_runtime():
+    mod = _load()
+    from ddr_tpu.observability.events import EVENT_TYPES
+
+    assert mod.registered_events(REPO / "ddr_tpu/observability/events.py") == EVENT_TYPES
+
+
+def test_catches_unregistered_emit(tmp_path):
+    mod = _load()
+    root = tmp_path
+    (root / "ddr_tpu/observability").mkdir(parents=True)
+    shutil.copy(
+        REPO / "ddr_tpu/observability/events.py",
+        root / "ddr_tpu/observability/events.py",
+    )
+    (root / "ddr_tpu/rogue.py").write_text(
+        "def f(rec):\n"
+        "    rec.emit('step', loss=1.0)\n"          # fine
+        "    rec.emit('totally_new_event', x=1)\n"  # offender
+        "    rec.emit(variable_name, x=1)\n"        # non-literal: skipped
+    )
+    (root / "bench.py").write_text("")
+    (root / "examples").mkdir()
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--root", str(root)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "totally_new_event" in proc.stderr
+    assert "rogue.py:3" in proc.stderr
+    assert "step" not in proc.stderr.replace("totally_new_event", "")
+
+
+def test_zero_sites_is_an_error(tmp_path):
+    """An empty scan means the matcher rotted — that must fail, not pass."""
+    root = tmp_path
+    (root / "ddr_tpu/observability").mkdir(parents=True)
+    shutil.copy(
+        REPO / "ddr_tpu/observability/events.py",
+        root / "ddr_tpu/observability/events.py",
+    )
+    # strip every emit() call events.py itself contains
+    src = (root / "ddr_tpu/observability/events.py").read_text()
+    (root / "ddr_tpu/observability/events.py").write_text(
+        src.replace(".emit(", ".no_emit(")
+    )
+    (root / "bench.py").write_text("")
+    (root / "examples").mkdir()
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--root", str(root)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "no emit() call sites" in proc.stderr

@@ -47,6 +47,7 @@ def service_factory(tmp_path):
         n_days: int = 4,
         warmup: bool = True,
         cfg: Config | None = None,
+        health_cfg=None,
         **serve_kw,
     ) -> ForecastService:
         from ddr_tpu.scripts.common import build_kan, kan_arch
@@ -57,7 +58,7 @@ def service_factory(tmp_path):
         serve_kw.setdefault("max_batch", 4)
         serve_kw.setdefault("batch_wait_s", 0.002)
         svc = ForecastService(
-            cfg, ServeConfig(horizon_hours=horizon, **serve_kw)
+            cfg, ServeConfig(horizon_hours=horizon, **serve_kw), health_cfg=health_cfg
         )
         svc.register_network("default", basin.routing_data, forcing=basin.q_prime)
         svc.register_model("default", kan_model, params, arch=kan_arch(cfg))

@@ -1,0 +1,169 @@
+"""Serving-side numerical-health acceptance: a NaN-injected batch produces
+exactly one ``health`` event with ZERO additional jit-cache entries, K
+consecutive bad batches degrade /readyz to 503, a healthy batch recovers it,
+and GET /metrics exposes the live registry."""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.health import HealthConfig
+from ddr_tpu.observability.registry import MetricsRegistry, get_registry, set_registry
+from ddr_tpu.serving.http_api import serve_http
+
+from tests.serving.conftest import events_of
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    """The service declares instruments on the process registry — isolate it."""
+    set_registry(MetricsRegistry(const_labels={"host": 0}))
+    yield
+    set_registry(None)
+
+
+@pytest.fixture
+def health_service(service_factory):
+    """A warmed service with a tight degradation threshold (K=2)."""
+
+    def make(**kw):
+        kw.setdefault("n_segments", 24)
+        kw.setdefault("horizon", 8)
+        return service_factory(health_cfg=HealthConfig(bad_batches=2), **kw)
+
+    return make
+
+
+def _nan_qp(svc, network="default"):
+    net = svc.networks()[network]
+    qp = np.zeros((net.horizon, net.n_segments), dtype=np.float32)
+    qp[2, 3] = np.nan
+    return qp
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestWatchdogOnBatches:
+    def test_nan_batch_emits_exactly_one_health_event(self, health_service, recorder):
+        svc = health_service()
+        hits0, misses0 = svc.tracker.counts()
+        svc.forecast(network="default", q_prime=_nan_qp(svc), timeout=60)
+        health = events_of(recorder, "health")
+        assert len(health) == 1
+        (ev,) = health
+        assert "non-finite" in ev["reasons"]
+        assert ev["nonfinite"] > 0
+        assert ev["network"] == "default" and ev["model"] == "default"
+        # the acceptance contract: health riding the step outputs means the
+        # compiled program count did not move — zero new jit-cache entries
+        hits1, misses1 = svc.tracker.counts()
+        assert misses1 == misses0
+        assert svc.watchdog.consecutive_bad == 1 and not svc.watchdog.degraded
+
+    def test_healthy_traffic_emits_no_health_events(self, health_service, recorder):
+        svc = health_service()
+        svc.forecast(network="default", t0=0, timeout=60)
+        assert events_of(recorder, "health") == []
+        assert svc.watchdog.status()["batches"] == 1
+
+    def test_disabled_watchdog_observes_nothing(self, service_factory, recorder):
+        svc = service_factory(
+            n_segments=24, horizon=8, health_cfg=HealthConfig(enabled=False)
+        )
+        svc.forecast(network="default", q_prime=_nan_qp(svc), timeout=60)
+        assert events_of(recorder, "health") == []
+        assert svc.watchdog.status()["batches"] == 0
+
+    def test_stats_carries_health_rollup(self, health_service):
+        svc = health_service()
+        svc.forecast(network="default", q_prime=_nan_qp(svc), timeout=60)
+        s = svc.stats()
+        assert s["health"]["violations"] == 1
+        assert s["health"]["last_reasons"] == ["non-finite"]
+        assert s["warmup_error"] is None
+
+
+class TestReadyzDegradation:
+    def test_degrades_after_k_bad_batches_and_recovers(self, health_service):
+        svc = health_service()
+        srv = serve_http(svc, port=0)
+        try:
+            code, _ = _get(srv.url + "/readyz")
+            assert code == 200
+            svc.forecast(network="default", q_prime=_nan_qp(svc), timeout=60)
+            code, body = _get(srv.url + "/readyz")
+            assert code == 200  # K=2: one bad batch is not degraded yet
+            svc.forecast(network="default", q_prime=_nan_qp(svc), timeout=60)
+            code, body = _get(srv.url + "/readyz")
+            assert code == 503 and '"unhealthy"' in body
+            assert '"consecutive_bad": 2' in body
+            svc.forecast(network="default", t0=0, timeout=60)  # healthy clears
+            code, _ = _get(srv.url + "/readyz")
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+
+class TestMetricsEndpoint:
+    def test_metrics_exposition_after_traffic(self, health_service, recorder):
+        svc = health_service()
+        srv = serve_http(svc, port=0)
+        try:
+            svc.forecast(network="default", t0=0, timeout=60)
+            svc.forecast(network="default", q_prime=_nan_qp(svc), timeout=60)
+            code, body = _get(srv.url + "/metrics")
+        finally:
+            srv.shutdown()
+        assert code == 200
+        # valid exposition: every non-comment line is `name{labels} value`
+        for line in body.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name_part, _, value = line.rpartition(" ")
+            assert name_part and float(value) is not None
+        assert "# TYPE ddr_request_latency_seconds histogram" in body
+        assert 'ddr_request_latency_seconds_bucket{' in body
+        assert 'le="+Inf"' in body
+        assert 'ddr_health_status{host="0"} 0' in body  # flipped by the NaN batch
+        assert 'ddr_requests_total{host="0",model="default",network="default",status="ok"} 2' in body
+        assert "ddr_health_violations_total" in body
+
+    def test_metrics_without_recorder_uses_direct_tee(self, health_service):
+        """No active run log: the service's _emit falls back to updating the
+        registry directly, so /metrics still counts traffic."""
+        svc = health_service()
+        srv = serve_http(svc, port=0)
+        try:
+            svc.forecast(network="default", t0=0, timeout=60)
+            code, body = _get(srv.url + "/metrics")
+        finally:
+            srv.shutdown()
+        assert code == 200
+        assert 'status="ok"' in body and "ddr_batches_total" in body
+
+    def test_hot_reload_counter(self, health_service, tmp_path):
+        from ddr_tpu.scripts.common import kan_arch
+        from ddr_tpu.training import save_state
+        from tests.serving.conftest import make_cfg
+
+        svc = health_service()
+        entry = svc.registry.get("default")
+        save_state(
+            tmp_path / "ckpts", "m", 1, 0, entry.params, None,
+            arch=kan_arch(make_cfg(tmp_path)),
+        )
+        watcher = svc.watch_checkpoints("default", tmp_path / "ckpts", poll_s=60)
+        assert watcher.check_now()
+        reg = get_registry()
+        assert reg.get("ddr_hot_reloads_total").value(model="default") == 1
+        assert reg.get("ddr_model_version").value(model="default") == 2

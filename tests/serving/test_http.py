@@ -56,17 +56,93 @@ class TestProbes:
             srv.shutdown()
 
     def test_stats_models_networks_endpoints(self, server):
-        srv, _ = server
+        srv, svc = server
         c = HttpForecastClient(srv.url)
         s = c.stats()
         assert s["ready"] and "default" in s["networks"]
+        assert s["warmup_error"] is None and "health" in s
         code, body = c._get("/v1/models")
         assert code == 200 and body["models"]["default"]["version"] == 1
+        # the slice endpoints return exactly the stats slices, computed alone
+        code, nets = c._get("/v1/networks")
+        assert code == 200 and nets["networks"] == s["networks"]
+        assert body["models"] == svc.models_info()
+
+    def test_readyz_warmup_failed_is_terminal_503(self, service_factory, monkeypatch):
+        svc = service_factory(n_segments=24, horizon=8, n_days=2, warmup=False)
+        monkeypatch.setattr(
+            svc, "_run_batch",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("XLA OOM")),
+        )
+        with pytest.raises(RuntimeError):
+            svc.warmup()
+        srv = serve_http(svc, port=0)
+        try:
+            code, body = HttpForecastClient(srv.url)._get("/readyz")
+            assert code == 503
+            assert body["status"] == "warmup-failed"
+            assert "XLA OOM" in body["error"]
+        finally:
+            srv.shutdown()
 
     def test_unknown_route_404(self, server):
         srv, _ = server
         code, _ = HttpForecastClient(srv.url)._get("/v2/whatever")
         assert code == 404
+
+    def test_metrics_endpoint_is_prometheus_text(self, server):
+        import urllib.request
+
+        srv, _ = server
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=30) as resp:
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "# TYPE ddr_request_latency_seconds histogram" in body
+        assert "ddr_health_status" in body
+
+
+def _post_raw(url, path, data=b""):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url + path, data=data, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestProfileEndpoint:
+    def test_capture_roundtrip(self, server, tmp_path, monkeypatch):
+        import time
+
+        from ddr_tpu.observability.spans import trace_active
+
+        monkeypatch.setenv("DDR_METRICS_DIR", str(tmp_path))
+        srv, svc = server
+        code, body = _post_raw(srv.url, "/v1/profile?seconds=0.2")
+        assert code == 202
+        assert body["status"] == "capturing" and body["trace_dir"] == str(tmp_path)
+        # busy while running; free again after the timer stops it
+        code, _ = _post_raw(srv.url, "/v1/profile?seconds=0.2")
+        assert code == 409
+        deadline = time.monotonic() + 10
+        while trace_active() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not trace_active()
+        assert any(tmp_path.rglob("*")), "profiler wrote nothing"
+
+    def test_bad_seconds_rejected(self, server):
+        srv, svc = server
+        code, body = _post_raw(srv.url, "/v1/profile?seconds=abc")
+        assert code == 400
+        code, body = _post_raw(srv.url, "/v1/profile?seconds=0")
+        assert code == 400
+        too_long = svc.serve_cfg.profile_max_seconds + 1
+        code, body = _post_raw(srv.url, f"/v1/profile?seconds={too_long}")
+        assert code == 400 and "PROFILE_MAX_SECONDS" in body["error"]
 
 
 class TestForecastPost:
