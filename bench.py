@@ -552,6 +552,17 @@ def main(argv: list[str] | None = None) -> None:
             elif terr:
                 out["train_error"] = terr
 
+    # Grad-over-forward ratios: how much of the forward schedule's throughput
+    # the backward keeps (1.0 = full VJP as fast as the forward route; the
+    # analytic reverse-wavefront adjoint exists to push these up —
+    # docs/benchmarks.md explains the field).
+    if out.get("value") and out.get("grad_value"):
+        out["grad_over_forward_ratio"] = round(out["grad_value"] / out["value"], 3)
+    if out.get("deep_value") and out.get("deep_grad_value"):
+        out["deep_grad_over_forward_ratio"] = round(
+            out["deep_grad_value"] / out["deep_value"], 3
+        )
+
     # Phase 3: the reference-equivalent CPU baseline.
     ref, err = _run_child(
         "import bench; print(bench.bench_reference_cpu())", bench_timeout, cpu_only=True

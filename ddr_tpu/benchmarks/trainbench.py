@@ -99,14 +99,15 @@ def main() -> None:
     p1, o1, loss, _ = call(params, opt_state)
     jax.block_until_ready(loss)
     compile_s = time.perf_counter() - t0
-    # timed reps: queue then block once (axon poll latency is device-idle time)
+    # timed reps: queue then block once (axon poll latency is device-idle time).
+    # Rebind state through every call — the step DONATES params/opt_state
+    # (training._make_step), so the donated inputs are dead after each call.
     t0 = time.perf_counter()
-    _, _, l2, _ = call(p1, o1)
+    p, o, l2, _ = call(p1, o1)
     jax.block_until_ready(l2)
     est = time.perf_counter() - t0
     reps = max(2, min(20, int(2.0 / max(est, 1e-3))))
     t0 = time.perf_counter()
-    p, o = p1, o1
     losses = []
     for _ in range(reps):
         p, o, l_, _ = call(p, o)

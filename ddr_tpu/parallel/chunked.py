@@ -191,10 +191,16 @@ def route_chunked_sharded(
     q_init: jnp.ndarray | None = None,
     bounds: Any = None,
     dt: float = 3600.0,
+    adjoint: str = "ad",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Route ``(T, N)`` inflows (ORIGINAL node order) band-by-band over the mesh.
 
     Returns ``(runoff (T, N), final (N,))`` in original order. Differentiable.
+
+    ``adjoint`` forwards to each band's
+    :func:`~ddr_tpu.parallel.wavefront.sharded_wavefront_route` — ``"ad"`` only
+    this round (the analytic reverse-wavefront adjoint is single-chip; see that
+    function's docstring for the transfer plan).
     """
     from ddr_tpu.parallel.wavefront import sharded_wavefront_route
     from ddr_tpu.routing.mc import Bounds, ChannelState
@@ -254,7 +260,7 @@ def route_chunked_sharded(
 
         runoff_b, final_b, raw_b = sharded_wavefront_route(
             mesh, sched, ch_b, sp_b, qp_b, q_init=qi_b, bounds=bounds, dt=dt,
-            x_ext=x_ext, s_ext=s_ext, return_raw=True,
+            x_ext=x_ext, s_ext=s_ext, return_raw=True, adjoint=adjoint,
         )
         outs.append(runoff_b)
         finals.append(final_b)

@@ -268,10 +268,18 @@ def route_stacked_sharded(
     axis_name: str = "reach",
     remat_physics: bool = True,
     remat_bands: bool = False,
+    adjoint: str = "ad",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Route ``(T, N)`` inflows (ORIGINAL node order) over the mesh with one
     scanned band program. Returns ``(runoff (T, N), final (N,))`` in original
     order. Differentiable end to end.
+
+    ``adjoint``: ``"ad"`` only this round — the single-chip stacked router's
+    analytic band adjoint (:func:`ddr_tpu.routing.stacked._band_analytic`)
+    transfers once the frame carries SHARDED transposed tables and the
+    reverse sweep re-psums the adjoint boundary history toward lower shards;
+    ``"analytic"`` raises ``NotImplementedError`` naming that plan instead of
+    silently measuring the wrong backward.
 
     ``remat_bands`` checkpoints each whole band step (wave scan + boundary
     psum) exactly like the single-chip stacked router: the backward replays a
@@ -279,6 +287,15 @@ def route_stacked_sharded(
     residuals. Same trade, same default-off; the chip capture plan decides."""
     from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
 
+    if adjoint != "ad":
+        if adjoint == "analytic":
+            raise NotImplementedError(
+                "the sharded stacked router differentiates by AD this round; "
+                "the analytic band adjoint needs sharded transposed tables + "
+                "the reversed boundary psum — pass adjoint='ad' here, or use "
+                "the single-chip stacked router for analytic"
+            )
+        raise ValueError(f"unknown adjoint {adjoint!r} (use 'ad')")
     if bounds is None:
         bounds = Bounds()
     T = q_prime.shape[0]

@@ -464,7 +464,14 @@ class ParallelTrainer:
 
     def step(self, prep: PreparedBatch, params, opt_state, obs_daily, obs_mask):
         """Run one training step; same returns as ``make_batch_train_step``:
-        ``(params, opt_state, loss, daily)``."""
+        ``(params, opt_state, loss, daily)``.
+
+        ``params``/``opt_state`` are DONATED to the underlying jitted step
+        (every builder in :mod:`ddr_tpu.training` donates them — no optimizer
+        -state copy per step); callers must rebind from the returns, as the
+        ``ddr train`` loop does. A/B harnesses feeding the same state into
+        several steps should build their reference step with ``donate=False``.
+        """
         import jax.numpy as jnp
 
         obs_daily = jnp.asarray(obs_daily)

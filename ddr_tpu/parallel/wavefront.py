@@ -166,10 +166,21 @@ def sharded_wavefront_route(
     x_ext: jnp.ndarray | None = None,
     s_ext: jnp.ndarray | None = None,
     return_raw: bool = False,
+    adjoint: str = "ad",
 ) -> tuple[jnp.ndarray, ...]:
     """Route ``(T, N)`` inflows over the mesh; returns ``(runoff (T, N), final (N,))``.
 
     All per-reach inputs must be in partitioned order. Differentiable end to end.
+
+    ``adjoint``: the sharded wave body currently differentiates by standard AD
+    only (``"ad"``). The single-chip engines' analytic reverse-wavefront custom
+    VJP (:mod:`ddr_tpu.routing.wavefront`) transfers structurally — the
+    transposed sweep's boundary exchange is the forward's psum with
+    publisher/consumer roles (``bnd_out``/``bnd_tgt``) swapped and the adjoint
+    flowing to LOWER shards — but the sharded transposed tables are not built
+    yet, so ``"analytic"`` raises ``NotImplementedError`` naming the plan
+    rather than silently falling back (an A/B harness must know which backward
+    it measured).
 
     ``x_ext``/``s_ext`` inject predecessor sums living OUTSIDE this network —
     the sharded-chunked router's upstream bands (same contract as
@@ -180,6 +191,15 @@ def sharded_wavefront_route(
     ``return_raw=True`` appends the pre-clamp solve values (T, N) — what a
     downstream band's ``x_ext`` must read.
     """
+    if adjoint != "ad":
+        if adjoint == "analytic":
+            raise NotImplementedError(
+                "the sharded wavefront differentiates by AD this round; the "
+                "analytic reverse-wavefront adjoint (ddr_tpu.routing.wavefront) "
+                "needs sharded transposed tables + the reversed boundary psum "
+                "— pass adjoint='ad' here, or route single-chip for analytic"
+            )
+        raise ValueError(f"unknown adjoint {adjoint!r} (use 'ad')")
     T = q_prime.shape[0]
     S, nl, B, D = schedule.n_shards, schedule.n_local, schedule.n_boundary, schedule.depth
     n_waves = T + D

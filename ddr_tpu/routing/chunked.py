@@ -366,11 +366,19 @@ def route_chunked(
     bounds: Any = None,
     dt: float = 3600.0,
     remat_physics: bool = True,
+    adjoint: str = "analytic",
 ):
     """Route ``(T, N)`` inflows band-by-band; same contract as :func:`mc.route`.
 
     All inputs are in ORIGINAL node order; each band gathers its slice into its
     own wf order via ``gidx`` (one gather per band per array). Differentiable.
+
+    ``adjoint="analytic"`` (default) gives every band's wave scan the analytic
+    reverse-wavefront custom VJP; the band loop itself is plain JAX, so reverse
+    mode walks the bands in REVERSE order automatically and the cotangents of
+    each band's published raw boundary series flow UPSTREAM through the
+    ``x_ext``/``s_ext`` adjoints — the cross-band mirror of the forward's
+    downstream forwarding. ``"ad"`` restores full AD through the wave scans.
     """
     from ddr_tpu.routing.mc import (
         Bounds,
@@ -425,6 +433,7 @@ def route_chunked(
             net, celerity_fn, coefficients_fn, qp_c, qi_c, lb,
             q_prime_permuted=True,  # qp_c was gathered straight into band-wf order
             remat_physics=remat_physics, x_ext=x_ext, s_ext=s_ext,
+            adjoint=adjoint,
         )
         outs.append(runoff_c)
         finals.append(final_c)

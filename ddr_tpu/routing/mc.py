@@ -250,6 +250,7 @@ def route(
     remat_physics: bool = True,
     remat_bands: bool = False,
     collect_health: bool = False,
+    adjoint: str | None = None,
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -298,9 +299,22 @@ def route(
     counts, discharge min/max, mass-balance residual) over the result and
     returns them as ``RouteResult.health``. They ride the program's existing
     outputs: a few fused reductions, no extra host sync, no second program.
+
+    ``adjoint`` selects the backward pass of the WAVEFRONT routing family
+    (single-ring, depth-chunked, stacked): ``"analytic"`` runs the reverse-time
+    wavefront sweep over the transposed network
+    (:mod:`ddr_tpu.routing.wavefront`, custom VJP — the default wherever the
+    network carries its transposed tables), ``"ad"`` is the escape hatch back
+    to standard JAX AD through the wave scan (the pre-adjoint behavior, for
+    A/B comparison). ``None`` auto-selects analytic where supported. The step
+    engine already differentiates through its own custom-VJP triangular solver,
+    so an explicit ``adjoint`` with ``engine="step"`` raises.
     """
     from ddr_tpu.routing.chunked import ChunkedNetwork, route_chunked
     from ddr_tpu.routing.stacked import StackedChunked, route_stacked
+
+    if adjoint not in (None, "analytic", "ad"):
+        raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic', 'ad', or None)")
 
     def _finish(result: RouteResult) -> RouteResult:
         if not collect_health:
@@ -329,10 +343,12 @@ def route(
                 network, channels, spatial_params, q_prime, q_init=q_init,
                 gauges=gauges, bounds=bounds, dt=dt,
                 remat_physics=remat_physics, remat_bands=remat_bands,
+                adjoint=adjoint or "analytic",
             ))
         return _finish(route_chunked(
             network, channels, spatial_params, q_prime, q_init=q_init,
             gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
+            adjoint=adjoint or "analytic",
         ))
 
     n_mann = spatial_params["n"]
@@ -375,10 +391,13 @@ def route(
 
         from ddr_tpu.routing.wavefront import wavefront_route_core
 
+        # analytic adjoint wherever the network carries the transposed tables
+        # (every network this version builds with wavefront tables does)
+        resolved = adjoint or ("analytic" if network.wf_t_width > 0 else "ad")
         runoff_p, final_p, _ = wavefront_route_core(
             network, celerity_fn, coefficients_fn, q_prime, q_init_p,
             bounds.discharge, q_prime_permuted=q_prime_permuted,
-            remat_physics=remat_physics,
+            remat_physics=remat_physics, adjoint=resolved,
         )
         if gauges is not None:
             gauges_p = dataclasses.replace(
@@ -392,6 +411,11 @@ def route(
         )
     if engine != "step":
         raise ValueError(f"unknown engine {engine!r} (use 'wavefront' or 'step')")
+    if adjoint is not None:
+        raise ValueError(
+            "adjoint applies to the wavefront routing family; the step engine "
+            "already differentiates through its custom-VJP triangular solver"
+        )
 
     permuted = network.fused
     if permuted:

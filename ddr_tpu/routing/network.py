@@ -103,6 +103,19 @@ class RiverNetwork:
     # slice schedule (level-contiguous within each degree bucket).
     wf_level_runs: tuple = dataclasses.field(default=(), metadata={"static": True})
     wavefront: bool = dataclasses.field(default=False, metadata={"static": True})
+    # TRANSPOSED wavefront tables (the analytic reverse-wavefront adjoint,
+    # ddr_tpu.routing.wavefront): per node (wf order), its SUCCESSORS' flat ring
+    # indices ``(gap - 1) * (n + 1) + succ_col``, padded to ``wf_t_width`` slots
+    # (sentinel = ring row 0's always-zero column ``n``). The backward sweep
+    # walks the same wave machinery over the transposed adjacency; out-degree in
+    # dendritic river networks is <= 1 almost everywhere (each reach drains to
+    # one downstream), so a fixed-width padded table IS the compact layout here
+    # — no analog of the in-degree bucketing confluences force on ``wf_idx``.
+    # The reverse level runs are ``wf_level_runs`` consumed mirrored: the
+    # adjoint of level-L nodes skews by ``depth - L`` where the forward used
+    # ``L`` (see ``wavefront._reverse_stream`` / ``_unskew_reverse``).
+    wf_t_idx: jnp.ndarray = dataclasses.field(default_factory=lambda: jnp.zeros(0, jnp.int32))
+    wf_t_width: int = dataclasses.field(default=0, metadata={"static": True})
 
     def upstream_sum(self, x: jnp.ndarray) -> jnp.ndarray:
         """Sparse mat-vec ``N @ x``: sum of upstream values per reach (original order).
@@ -361,6 +374,39 @@ def _wavefront_tables(
     return order, inv, wf_idx, wf_mask, tuple(buckets), level_runs
 
 
+def _transposed_wavefront_tables(
+    rows: np.ndarray, cols: np.ndarray, n: int, level: np.ndarray, inv: np.ndarray
+) -> tuple[np.ndarray, int]:
+    """Successor (transposed-adjacency) gather table for the analytic adjoint.
+
+    Node i's row (wf order) lists flat ring indices ``(gap - 1) * (n + 1) +
+    inv[j]`` for each successor j (gap = level[j] - level[i] >= 1), padded to a
+    power-of-two width with the ring's always-zero sentinel cell (row 0, col n).
+    Dendritic river networks have out-degree <= 1 (MERIT: one downstream per
+    reach), so width is 1-2 and padding is negligible — the transpose needs no
+    in-degree-style bucketing. Returns ``(flat (n * width,) table, width)``.
+    """
+    row_len = n + 1
+    order_s = np.argsort(cols, kind="stable")
+    s_src, s_tgt = cols[order_s], rows[order_s]
+    src_starts = np.searchsorted(s_src, np.arange(n + 1))
+    out_deg = src_starts[1:] - src_starts[:-1]
+    max_out = int(out_deg.max()) if n and rows.size else 0
+    width = 1 if max_out <= 1 else 1 << int(max_out - 1).bit_length()
+    tbl = np.full((n, width), row_len - 1, dtype=np.int64)  # sentinel: row0, col n
+    if rows.size:
+        nzn = np.flatnonzero(out_deg)
+        starts, ends_ = src_starts[nzn], src_starts[nzn + 1]
+        counts = ends_ - starts
+        flat = _ranges(starts, ends_)
+        row_pos = np.repeat(inv[nzn], counts)
+        col_pos = np.arange(len(flat)) - np.repeat(np.cumsum(counts) - counts, counts)
+        succ = s_tgt[flat]
+        gaps = level[succ] - level[np.repeat(nzn, counts)]
+        tbl[row_pos, col_pos] = (gaps - 1) * row_len + inv[succ]
+    return tbl.reshape(-1), width
+
+
 def build_network(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -434,11 +480,14 @@ def build_network(
         wf_perm, wf_inv, wf_idx, wf_mask, wf_buckets, wf_level_runs = _wavefront_tables(
             rows, cols, n, level, in_deg
         )
+        wf_t_idx, wf_t_width = _transposed_wavefront_tables(rows, cols, n, level, wf_inv)
     else:
         wf_perm = wf_inv = wf_idx = np.zeros(0, dtype=np.int64)
         wf_mask = np.zeros(0, dtype=np.float32)
         wf_buckets = ()
         wf_level_runs = ()
+        wf_t_idx = np.zeros(0, dtype=np.int64)
+        wf_t_width = 0
 
     return RiverNetwork(
         edge_src=jnp.asarray(cols, dtype=jnp.int32),
@@ -462,4 +511,6 @@ def build_network(
         wf_buckets=wf_buckets,
         wf_level_runs=wf_level_runs,
         wavefront=bool(wavefront),
+        wf_t_idx=jnp.asarray(wf_t_idx, jnp.int32),
+        wf_t_width=int(wf_t_width),
     )

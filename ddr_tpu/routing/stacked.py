@@ -34,6 +34,7 @@ Differentiable end to end (scans + gathers + scatters under standard AD).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -131,6 +132,18 @@ class StackedChunked:
     n_edges: int = dataclasses.field(metadata={"static": True})
     n_boundary: int = dataclasses.field(metadata={"static": True})
     n_chunks: int = dataclasses.field(metadata={"static": True})
+    # Transposed (successor) tables for the analytic reverse-wavefront adjoint
+    # (routing/wavefront.py docstring): slot k's successors occupy flat columns
+    # [k * t_width, (k + 1) * t_width); t_row holds gap - 1, t_col the successor
+    # slot (ring's zero-sentinel column n_cap on pads). Out-degree in dendritic
+    # networks is <= 1, so the fixed width is 1-2 and padding is negligible.
+    t_row: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0, 0), jnp.int32)
+    )  # (C, n_cap * t_width)
+    t_col: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0, 0), jnp.int32)
+    )  # (C, n_cap * t_width)
+    t_width: int = dataclasses.field(default=0, metadata={"static": True})
 
 
 def build_stacked_chunked(
@@ -247,6 +260,26 @@ def build_stacked_chunked(
         ext_cols[xb[xs_], xseq] = col_of_src[ext_src_o[xs_]]
         ext_tgt[xb[xs_], xseq] = slot[ext_tgt_o[xs_]]
 
+    # --- transposed (successor) tables: the analytic adjoint's reverse-wave
+    # gather. Per source slot, its in-band successors at uniform width (max
+    # local out-degree, pow2-rounded; dendritic rivers: 1). ---
+    odeg = np.zeros(n, dtype=np.int64)
+    np.add.at(odeg, loc_cols, 1)
+    max_out = int(odeg.max()) if loc_cols.size else 0
+    t_width = 1 if max_out <= 1 else 1 << int(max_out - 1).bit_length()
+    t_row = np.zeros((C, n_cap * t_width), dtype=np.int64)
+    t_col = np.full((C, n_cap * t_width), n_cap, dtype=np.int64)  # ring sentinel col
+    if loc_cols.size:
+        skey = band[loc_cols] * np.int64(n_cap) + slot[loc_cols]
+        ss = np.argsort(skey, kind="stable")
+        sk = skey[ss]
+        sseq = np.arange(len(sk)) - np.searchsorted(sk, sk)
+        s_node, tgt_node = loc_cols[ss], loc_rows[ss]
+        t_row[band[s_node], slot[s_node] * t_width + sseq] = (
+            level[tgt_node] - level[s_node] - 1
+        )
+        t_col[band[s_node], slot[s_node] * t_width + sseq] = slot[tgt_node]
+
     out_map = band * np.int64(n_cap) + slot
 
     if (span_max + 2) * row_len >= 2**31:
@@ -274,6 +307,9 @@ def build_stacked_chunked(
         n_edges=int(rows.size),
         n_boundary=int(B_total),
         n_chunks=C,
+        t_row=jnp.asarray(t_row, jnp.int32),
+        t_col=jnp.asarray(t_col, jnp.int32),
+        t_width=int(t_width),
     )
 
 
@@ -284,6 +320,263 @@ def _skew_cols(src: jnp.ndarray, starts: jnp.ndarray, width: int) -> jnp.ndarray
         src.T, starts
     )
     return sl.T
+
+
+def _reduce_buckets_frame(gathered, mask_row, buckets, n_cap, lb, clamped):
+    """Per-slot sums from the frame's width-profile gather. ``gathered`` may
+    carry leading batch axes (``(..., E_cap) -> (..., n_cap)``): the analytic
+    backward reduces whole (T, E_cap) residual re-gathers in one call."""
+    lead = gathered.shape[:-1]
+    parts = []
+    off = 0
+    for node_start, node_end, width in buckets:
+        cnt_nodes = node_end - node_start
+        if width == 0:
+            parts.append(jnp.zeros(lead + (cnt_nodes,), gathered.dtype))
+            continue
+        cnt = cnt_nodes * width
+        blk = gathered[..., off : off + cnt].reshape(lead + (cnt_nodes, width))
+        msk = mask_row[off : off + cnt].reshape(cnt_nodes, width)
+        if clamped:
+            blk = jnp.maximum(blk, lb)
+        parts.append((blk * msk).sum(axis=-1))
+        off += cnt
+    if not parts:
+        return jnp.zeros(lead + (n_cap,), gathered.dtype)
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _physics_frame(q_prev, ln, sl, xs_, twd, ssd, nm, qsp, psp, bounds, dt):
+    """The per-wave elementwise physics chain on band-frame arrays (Manning
+    inversion -> celerity -> Muskingum coefficients) — module-level and
+    argument-explicit so the analytic adjoint can ``jax.vjp`` it directly."""
+    from ddr_tpu.routing.mc import ChannelState, celerity, muskingum_coefficients
+
+    ch = ChannelState(length=ln, slope=sl, x_storage=xs_,
+                      top_width_data=twd, side_slope_data=ssd)
+    c = celerity(q_prev, nm, psp, qsp, ch, bounds)[0]
+    return muskingum_coefficients(ln, c, xs_, dt)
+
+
+def _frame_input_skews(qp_c, x_ext, s_ext, lvl, *, T, n_cap, span):
+    """The band frame's forward wave-input skews (dynamic per-slot starts)."""
+    n_waves = T + span
+    right_edge = qp_c[T - 2 : T - 1] if T >= 2 else qp_c[:1]
+    padded = jnp.concatenate(
+        [
+            jnp.broadcast_to(qp_c[0], (span + 1, n_cap)),
+            qp_c[: T - 1],
+            jnp.broadcast_to(right_edge[0], (span, n_cap)),
+        ],
+        axis=0,
+    )
+    qs_sk = _skew_cols(padded, span - lvl, n_waves)
+    zpad = jnp.zeros((span, n_cap), qp_c.dtype)
+    xe_sk = _skew_cols(jnp.concatenate([zpad, x_ext, zpad], 0), span - lvl, n_waves)
+    se_sk = _skew_cols(jnp.concatenate([zpad, s_ext, zpad], 0), span - lvl, n_waves)
+    return qs_sk, xe_sk, se_sk
+
+
+def _frame_wave_scan(physics, lvl, wfr, wfc, wfm, qs_sk, xe_sk, se_sk, qi_c, *,
+                     T, n_cap, span, lb, buckets, has_init, dtype):
+    """One band's wave scan in the shared static frame (the stacked analog of
+    ``wavefront._run_wave_scan``); returns the raw per-wave values ``ys``."""
+    row_len = n_cap + 1
+    ring_rows = span + 2
+    n_waves = T + span
+    ring0 = jnp.zeros(ring_rows * row_len, dtype)
+    s0 = jnp.zeros(n_cap, dtype)
+
+    def body(carry, wave_inputs):
+        ring, s_state = carry
+        q_row, xe_row, se_row, w = wave_inputs
+        t_node = w - 1 - lvl
+        h1 = jax.lax.rem(w - 1, ring_rows)
+        q_prev = jnp.maximum(
+            jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+        )
+        c1, c2, c3, c4 = physics(q_prev)
+        rot = h1 - wfr
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        gathered = ring[rot * row_len + wfc]
+        x_pred = _reduce_buckets_frame(gathered, wfm, buckets, n_cap, lb, False) + xe_row
+        s_next = _reduce_buckets_frame(gathered, wfm, buckets, n_cap, lb, True)
+
+        b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
+        is_hot = t_node == 0
+        b = jnp.where(is_hot, q_row, b_step)
+        c1_eff = jnp.where(is_hot, 1.0, c1)
+        y = b + c1_eff * x_pred
+        if has_init:
+            y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
+        ok = (t_node >= 0) & (t_node <= T - 1)
+        y = jnp.where(ok, y, 0.0)
+        h = jax.lax.rem(w, ring_rows)
+        ring = jax.lax.dynamic_update_slice(
+            ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]), (h * row_len,)
+        )
+        return (ring, s_next), y
+
+    waves = jnp.arange(1, n_waves + 1)
+    (_, _), ys = jax.lax.scan(body, (ring0, s0), (qs_sk, xe_sk, se_sk, waves))
+    return ys
+
+
+# ---------------------------------------------------------------------------
+# Analytic reverse-wavefront adjoint of one band step — the stacked frame's
+# instance of the math documented in ddr_tpu.routing.wavefront: reverse time
+# tau = T-1-t, reverse level M(i) = span - lvl(i), transposed per-slot gather
+# tables (StackedChunked.t_row/t_col), two adjoint rings (z = c1*lam solve
+# propagation, u = c2*lam inflow adjoint), residual = the raw band output only.
+# The band scan's boundary-buffer carry stays on plain AD, so reverse mode
+# walks bands in reverse order and the published series' cotangents flow
+# upstream through x_ext/s_ext — the adjoint boundary series.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _band_analytic(static, lvl, wfr, wfc, wfm, t_r, t_c,
+                   ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c, x_ext, s_ext):
+    return _band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_r, t_c, ln, sl, xs_,
+                              twd, ssd, nm, qsp, psp, qp_c, qi_c, x_ext, s_ext)[0]
+
+
+def _band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_r, t_c,
+                       ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c, x_ext, s_ext):
+    (T, n_cap, span, lb, bounds, dt, buckets, t_width, has_init) = static
+    qs_sk, xe_sk, se_sk = _frame_input_skews(
+        qp_c, x_ext, s_ext, lvl, T=T, n_cap=n_cap, span=span
+    )
+    phys_args = (ln, sl, xs_, twd, ssd, nm, qsp, psp)
+
+    def physics(q_prev):
+        return _physics_frame(q_prev, *phys_args, bounds, dt)
+
+    ys = _frame_wave_scan(
+        physics, lvl, wfr, wfc, wfm, qs_sk, xe_sk, se_sk, qi_c,
+        T=T, n_cap=n_cap, span=span, lb=lb, buckets=buckets,
+        has_init=has_init, dtype=qp_c.dtype,
+    )
+    raw = _skew_cols(ys, lvl, T)
+    res = (raw, qp_c, qi_c, x_ext, s_ext, lvl, wfr, wfc, wfm, t_r, t_c, phys_args)
+    return raw, res
+
+
+def _band_analytic_bwd(static, res, raw_bar):
+    from ddr_tpu.routing.wavefront import _dmax
+
+    (T, n_cap, span, lb, bounds, dt, buckets, t_width, has_init) = static
+    raw, qp_c, qi_c, x_ext, s_ext, lvl, wfr, wfc, wfm, t_r, t_c, phys_args = res
+    row_len = n_cap + 1
+    ring_rows = span + 2
+    n_waves = T + span
+    dtype = raw.dtype
+    M = span - lvl
+
+    # --- everything t-separable hoisted out of the reverse scan (the same
+    # move as wavefront._analytic_bwd: the backward's operands all live in
+    # ``raw``, so the physics chain, its q_prev-derivative, and the operand
+    # sums evaluate as big (T, n_cap) vectorized passes, leaving the scan the
+    # graph-propagation minimum). ---
+    raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), dtype)], axis=1)
+    xpx = _reduce_buckets_frame(raw_pad[:, wfc], wfm, buckets, n_cap, lb, False) + x_ext
+    prev_pad = jnp.concatenate([jnp.zeros((1, row_len), dtype), raw_pad[:-1]], axis=0)
+    s_full = _reduce_buckets_frame(prev_pad[:, wfc], wfm, buckets, n_cap, lb, True) + s_ext
+
+    q_prev_all = jnp.maximum(prev_pad[:, :n_cap], lb)  # (T, n_cap): max(x_{t-1}, lb)
+    qpm1_all = jnp.concatenate([jnp.zeros((1, n_cap), dtype), qp_c[:-1]], axis=0)
+    qpm1c = jnp.maximum(qpm1_all, lb)
+
+    def phys_batch(q, args):
+        return _physics_frame(q, *args, bounds, dt)
+
+    (c1_a, c2_a, c3_a, c4_a), (d1, d2, d3, d4) = jax.jvp(
+        lambda q: phys_batch(q, phys_args),
+        (q_prev_all,), (jnp.ones_like(q_prev_all),),
+    )
+    # Masks, hotstart handling, and the propagation WEIGHTS folded into
+    # precomputed streams exactly as in wavefront._analytic_bwd (lam-ring
+    # scheme): the ring stores lam alone, the body is one gather + one write
+    # + five multiplies, and every output adjoint derives from the un-skewed
+    # lam field in vectorized post-passes.
+    zero_row = jnp.zeros((1, n_cap), dtype)
+    hot_row = zero_row if has_init else jnp.ones((1, n_cap), dtype)
+    zc = jnp.concatenate([hot_row, c1_a[1:]], axis=0)
+    uc = jnp.concatenate([zero_row, c2_a[1:]], axis=0)
+    own_coef = d1 * xpx + d2 * s_full + d3 * q_prev_all + d4 * qpm1c + c3_a
+    dm_all = _dmax(prev_pad[:, :n_cap], lb).at[0].set(0.0)
+    ow = dm_all * own_coef
+
+    # Per-edge weight streams: flat slot (i, k) carries successor j's weight
+    # at slot i's in-flight timestep (pads read the appended zero column).
+    zce = jnp.concatenate([zc, jnp.zeros((T, 1), dtype)], axis=1)[:, t_c]
+    uce = jnp.concatenate([uc, jnp.zeros((T, 1), dtype)], axis=1)[:, t_c]
+
+    # ONE stacked reverse stream over [gbar | ow | dm | zce | uce] columns.
+    e_cap_t = n_cap * t_width
+    off = (0, n_cap, 2 * n_cap, 3 * n_cap, 3 * n_cap + e_cap_t)
+    width_all = 3 * n_cap + 2 * e_cap_t
+    lvl_e = jnp.repeat(lvl, t_width)  # per-edge-slot starts (slots node-major)
+    starts_all = jnp.concatenate([lvl, lvl, lvl, lvl_e, lvl_e])
+    z_l = jnp.zeros((span, width_all), dtype)
+    z_r = jnp.zeros((span + 1, width_all), dtype)
+    padded = jnp.concatenate(
+        [z_l, jnp.concatenate([raw_bar, ow, dm_all, zce, uce], axis=1)[::-1], z_r],
+        axis=0,
+    )
+    stacked_s = _skew_cols(padded, starts_all, n_waves)
+
+    ring0 = jnp.zeros(ring_rows * row_len, dtype)
+    gx0 = jnp.zeros(n_cap, dtype)
+
+    def body(carry, wave_inputs):
+        ring, gx = carry
+        rows, w = wave_inputs
+
+        h1 = jax.lax.rem(w - 1, ring_rows)
+        rot = h1 - t_r
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        g = ring[rot * row_len + t_c]
+        zsum = (rows[off[3] : off[4]] * g).reshape(n_cap, t_width).sum(axis=1)
+        usum = (rows[off[4] :] * g).reshape(n_cap, t_width).sum(axis=1)
+
+        lam = rows[: off[1]] + gx + zsum  # zero outside valid region by construction
+        gx_next = rows[off[1] : off[2]] * lam + rows[off[2] : off[3]] * usum
+
+        h = jax.lax.rem(w, ring_rows)
+        ring = jax.lax.dynamic_update_slice(
+            ring, jnp.concatenate([lam, jnp.zeros(1, dtype)]), (h * row_len,)
+        )
+        return (ring, gx_next), lam
+
+    waves = jnp.arange(1, n_waves + 1)
+    (_, _), lams = jax.lax.scan(body, (ring0, gx0), (stacked_s, waves))
+
+    # --- vectorized adjoint outputs from the un-skewed lam field ---
+    lam_all = _skew_cols(lams, M, T)[::-1]  # (T, n_cap), raw incl. t = 0
+    lam_th = lam_all.at[0].set(0.0)  # no physics on the hotstart diagonal
+    _, pull = jax.vjp(phys_batch, q_prev_all, phys_args)
+    _, theta_bar = pull(
+        (lam_th * xpx, lam_th * s_full, lam_th * q_prev_all, lam_th * qpm1c)
+    )
+
+    z_un = zc * lam_all  # x_ext adjoint; row 0 = hotstart q'_0 term
+    qp_coef = jnp.concatenate([zero_row, (c4_a * _dmax(qpm1_all, lb))[1:]], axis=0)
+    qp_bar = jnp.concatenate([(qp_coef * lam_all)[1:], zero_row], axis=0)
+    qp_bar = qp_bar.at[0].add(z_un[0])
+    s_ext_bar = uc * lam_all
+    q_init_bar = (
+        _dmax(qi_c, lb) * lam_all[0] if has_init else jnp.zeros_like(qi_c)
+    )
+
+    f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)  # noqa: E731
+    (ln_b, sl_b, xs_b, twd_b, ssd_b, nm_b, qsp_b, psp_b) = theta_bar
+    return (f0(lvl), f0(wfr), f0(wfc), jnp.zeros_like(wfm), f0(t_r), f0(t_c),
+            ln_b, sl_b, xs_b, twd_b, ssd_b, nm_b, qsp_b, psp_b,
+            qp_bar, q_init_bar, z_un, s_ext_bar)
+
+
+_band_analytic.defvjp(_band_analytic_fwd, _band_analytic_bwd)
 
 
 @spanned("stacked-route")
@@ -298,9 +591,18 @@ def route_stacked(
     dt: float = 3600.0,
     remat_physics: bool = True,
     remat_bands: bool = False,
+    adjoint: str = "analytic",
 ):
     """Route ``(T, N)`` inflows with one scanned band program; same contract as
     :func:`ddr_tpu.routing.mc.route`. All inputs in ORIGINAL node order.
+
+    ``adjoint="analytic"`` (default) differentiates each band's wave scan with
+    the reverse-wavefront custom VJP (:func:`_band_analytic`): residual = the
+    band's raw output only, backward = the same wave machinery over the
+    transposed slot tables in reverse time. The band scan itself stays on
+    plain AD, so reverse mode walks bands in REVERSE order and the published
+    boundary series' cotangents flow UPSTREAM. ``"ad"`` restores full AD
+    through the wave scans (the pre-adjoint behavior).
 
     ``remat_bands`` checkpoints each WHOLE band step: the backward recomputes a
     band's full wave scan from the boundary-buffer carry instead of streaming
@@ -309,17 +611,20 @@ def route_stacked(
     residual HBM traffic, not compute, binds the backward (docs/tpu.md "Why the
     deep backward trails the forward"); on the compute-bound CPU backend it
     measures 5-24% SLOWER (68.5k vs 71.8-85.1k rt/s at N=4096/d=1536), as the
-    analysis predicts. Default off; the on-chip capture plan measures it where
-    it was designed to win."""
-    from ddr_tpu.routing.mc import (
-        Bounds,
-        RouteResult,
-        celerity,
-        muskingum_coefficients,
-    )
+    analysis predicts. Under the analytic adjoint it is mostly moot (the
+    per-wave residual stream it existed to kill is gone). Default off."""
+    from ddr_tpu.routing.mc import Bounds, RouteResult
 
+    if adjoint not in ("ad", "analytic"):
+        raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic' or 'ad')")
     if bounds is None:
         bounds = Bounds()
+    if adjoint == "analytic" and network.t_width <= 0:
+        raise ValueError(
+            "adjoint='analytic' needs the stacked frame's transposed tables "
+            "(t_row/t_col); rebuild the StackedChunked with this version or "
+            "pass adjoint='ad'"
+        )
     T = q_prime.shape[0]
     lb = bounds.discharge
     C, n_cap = network.n_chunks, network.n_cap
@@ -350,36 +655,14 @@ def route_stacked(
     )  # (C, T, n_cap)
     qi_s = None if q_init is None else pad0(q_init)[g]
 
-    def reduce_buckets(gathered: jnp.ndarray, mask_row: jnp.ndarray, clamped: bool):
-        # Buckets cover [0, n_cap) in slot order (width non-increasing; a
-        # trailing width-0 run holds the in-band-degree-0 slots).
-        parts = []
-        off = 0
-        for node_start, node_end, width in buckets:
-            cnt_nodes = node_end - node_start
-            if width == 0:
-                parts.append(jnp.zeros(cnt_nodes, gathered.dtype))
-                continue
-            cnt = cnt_nodes * width
-            blk = gathered[off : off + cnt].reshape(cnt_nodes, width)
-            msk = mask_row[off : off + cnt].reshape(blk.shape)
-            if clamped:
-                blk = jnp.maximum(blk, lb)
-            parts.append((blk * msk).sum(axis=1))
-            off += cnt
-        return jnp.concatenate(parts) if parts else jnp.zeros(n_cap, gathered.dtype)
-
-    def physics_of(q_prev, nm, ps_, qs_, ch):
-        c = celerity(q_prev, nm, ps_, qs_, ch, bounds)[0]
-        return muskingum_coefficients(ch.length, c, ch.x_storage, dt)
+    has_init = q_init is not None
+    ba_static = (
+        T, n_cap, span, lb, bounds, dt, buckets, network.t_width, has_init,
+    )
 
     def band_step(bnd, band_in):
-        from ddr_tpu.routing.mc import ChannelState
-
-        (lvl, wf_row, wf_col, wf_mask, e_cols, e_tgt, p_src, p_col,
+        (lvl, wf_row, wf_col, wf_mask, t_r, t_c, e_cols, e_tgt, p_src, p_col,
          ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c) = band_in
-        ch = ChannelState(length=ln, slope=sl, x_storage=xs_,
-                          top_width_data=twd, side_slope_data=ssd)
 
         # External-predecessor series from the boundary carry (sentinel edge
         # slots read the scratch column and scatter into the dropped slot).
@@ -391,64 +674,31 @@ def route_stacked(
             .at[:, e_tgt].add(jnp.maximum(prev[:, e_cols], lb))[:, :n_cap]
         )
 
-        # Input skew (wavefront_route_core's layout, span_max frame).
-        right_edge = qp_c[T - 2 : T - 1] if T >= 2 else qp_c[:1]
-        padded = jnp.concatenate(
-            [
-                jnp.broadcast_to(qp_c[0], (span + 1, n_cap)),
-                qp_c[: T - 1],
-                jnp.broadcast_to(right_edge[0], (span, n_cap)),
-            ],
-            axis=0,
-        )
-        qs_sk = _skew_cols(padded, span - lvl, n_waves)
-        zpad = jnp.zeros((span, n_cap), bnd.dtype)
-        xe_sk = _skew_cols(jnp.concatenate([zpad, x_ext, zpad], 0), span - lvl, n_waves)
-        se_sk = _skew_cols(jnp.concatenate([zpad, s_ext, zpad], 0), span - lvl, n_waves)
-
-        def physics(q_prev):
-            return physics_of(q_prev, nm, psp, qsp, ch)
-
-        if remat_physics:
-            physics = jax.checkpoint(physics)
-
-        ring0 = jnp.zeros(ring_rows * row_len, qp_c.dtype)
-        s0 = jnp.zeros(n_cap, qp_c.dtype)
-
-        def body(carry, wave_inputs):
-            ring, s_state = carry
-            q_row, xe_row, se_row, w = wave_inputs
-            t_node = w - 1 - lvl
-            h1 = jax.lax.rem(w - 1, ring_rows)
-            q_prev = jnp.maximum(
-                jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+        if adjoint == "analytic":
+            raw = _band_analytic(
+                ba_static, lvl, wf_row, wf_col, wf_mask, t_r, t_c,
+                ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c, x_ext, s_ext,
             )
-            c1, c2, c3, c4 = physics(q_prev)
-            rot = h1 - wf_row
-            rot = jnp.where(rot < 0, rot + ring_rows, rot)
-            gathered = ring[rot * row_len + wf_col]
-            x_pred = reduce_buckets(gathered, wf_mask, clamped=False) + xe_row
-            s_next = reduce_buckets(gathered, wf_mask, clamped=True)
-
-            b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
-            is_hot = t_node == 0
-            b = jnp.where(is_hot, q_row, b_step)
-            c1_eff = jnp.where(is_hot, 1.0, c1)
-            y = b + c1_eff * x_pred
-            if qi_s is not None:
-                y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
-            ok = (t_node >= 0) & (t_node <= T - 1)
-            y = jnp.where(ok, y, 0.0)
-            h = jax.lax.rem(w, ring_rows)
-            ring = jax.lax.dynamic_update_slice(
-                ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]), (h * row_len,)
+        else:
+            qs_sk, xe_sk, se_sk = _frame_input_skews(
+                qp_c, x_ext, s_ext, lvl, T=T, n_cap=n_cap, span=span
             )
-            return (ring, s_next), y
 
-        waves = jnp.arange(1, n_waves + 1)
-        (_, _), ys = jax.lax.scan(body, (ring0, s0), (qs_sk, xe_sk, se_sk, waves))
+            def physics(q_prev):
+                return _physics_frame(
+                    q_prev, ln, sl, xs_, twd, ssd, nm, qsp, psp, bounds, dt
+                )
 
-        raw = _skew_cols(ys, lvl, T)  # (T, n_cap), un-skewed
+            if remat_physics:
+                physics = jax.checkpoint(physics)
+
+            ys = _frame_wave_scan(
+                physics, lvl, wf_row, wf_col, wf_mask, qs_sk, xe_sk, se_sk, qi_c,
+                T=T, n_cap=n_cap, span=span, lb=lb, buckets=buckets,
+                has_init=has_init, dtype=qp_c.dtype,
+            )
+            raw = _skew_cols(ys, lvl, T)  # (T, n_cap), un-skewed
+
         # Publish raw series of this band's boundary sources (sentinel pads
         # write the scratch column from the always-zero pad source column).
         raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), raw.dtype)], axis=1)
@@ -457,6 +707,7 @@ def route_stacked(
 
     band_xs = (
         network.level, network.wf_row, network.wf_col, network.wf_mask,
+        network.t_row, network.t_col,
         network.ext_cols, network.ext_tgt, network.pub_src, network.pub_col,
         length_s, slope_s, xst_s, twd_s, ssd_s, nm_s, qs_s, ps_s, qp_s,
         qi_s if qi_s is not None else jnp.zeros((C, n_cap), q_prime.dtype),

@@ -20,6 +20,7 @@ from ddr_tpu.validation.configs import Config, load_config
 log = logging.getLogger(__name__)
 
 __all__ = [
+    "apply_compile_cache_env",
     "is_primary_process",
     "parse_cli",
     "split_config_argv",
@@ -30,6 +31,34 @@ __all__ = [
     "evaluate_hourly",
     "timed",
 ]
+
+
+def apply_compile_cache_env() -> str | None:
+    """Wire the persistent XLA compilation cache from ``DDR_COMPILE_CACHE_DIR``.
+
+    Production entrypoints (``ddr train`` / ``ddr serve``) call this at startup
+    BEFORE the first compile: deep-topology train steps measure ~230 s of XLA
+    compile (docs/tpu.md), and serving cold-starts pay the same program builds
+    during warmup — with the cache on a persistent volume, a restart replays
+    them from disk instead. Same three ``jax.config`` keys the test harness
+    already uses (tests/conftest.py); unset/empty disables (no behavior
+    change). Unlike the test harness, the directory is taken verbatim: a
+    production deployment pins its fleet's hardware, and heterogeneous fleets
+    should point the env at per-platform paths themselves
+    (docs/config_reference.md has the knob's reference entry).
+
+    Returns the applied directory, or None when disabled.
+    """
+    import os
+
+    cache_dir = os.environ.get("DDR_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    log.info(f"persistent XLA compile cache: {cache_dir}")
+    return cache_dir
 
 
 def split_config_argv(argv: list[str] | None) -> tuple[str | None, list[str]]:

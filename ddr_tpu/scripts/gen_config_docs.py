@@ -23,6 +23,29 @@ YAML configs are loaded by `ddr_tpu.validation.configs.load_config`, which also
 accepts dotted CLI overrides (`ddr train config.yaml experiment.epochs=5`).
 """
 
+# Process-level knobs that must take effect before/outside config loading live
+# in environment variables, not the YAML tree; documented here so the config
+# reference stays the one page a deployer reads.
+FOOTER = """## Environment knobs (process-level)
+
+Settings that must take effect before or outside config loading are environment
+variables. Families with their own reference tables are linked.
+
+- `DDR_COMPILE_CACHE_DIR` — consumed at `ddr train` / `ddr serve` /
+  `ddr train-and-test` startup: persistent XLA compilation cache
+  (`jax_compilation_cache_dir`, min-compile-time 0.5 s,
+  `jax_persistent_cache_enable_xla_caches=all` — the same three keys the test
+  harness uses). Deep-topology train steps measure ~230 s of XLA compile and
+  serving warmup replays the same program builds; point this at a persistent
+  volume and a restarted trainer/server loads them from disk instead.
+  Unset/empty = off. Heterogeneous fleets should pin per-platform paths
+  (XLA:CPU serializes host-specialized executables).
+- `DDR_METRICS_DIR`, `DDR_HEARTBEAT_EVERY`, `DDR_METRICS_FLUSH_EVERY`,
+  `DDR_PROM_PORT`, `DDR_HEALTH_*` — observability: see docs/observability.md.
+- `DDR_SERVE_*` — serving: see docs/serving.md.
+- `DDR_BENCH_*` — `bench.py`: see `python bench.py --help`.
+"""
+
 
 def _schema_type(prop: dict[str, Any], defs: dict[str, Any]) -> str:
     if "$ref" in prop:
@@ -107,7 +130,10 @@ def _collect_models(model: Any, acc: dict[str, Any]) -> None:
         while stack:
             t = stack.pop()
             stack.extend(typing.get_args(t))
-            if isinstance(t, type) and issubclass(t, BaseModel):
+            # Python 3.10: bare generic aliases (list[str]) pass
+            # isinstance(t, type) but explode in issubclass — skip them via
+            # get_origin (3.11+ returns False from the isinstance already)
+            if isinstance(t, type) and typing.get_origin(t) is None and issubclass(t, BaseModel):
                 _collect_models(t, acc)
 
 
@@ -136,6 +162,7 @@ def generate() -> str:
             if def_schema.get("type") == "object" and def_name not in emitted:
                 emitted.add(def_name)
                 out += _model_section(def_name, def_schema, defs, models.get(def_name))
+    out.append(FOOTER)
     return "\n".join(out)
 
 

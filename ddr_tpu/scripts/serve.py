@@ -83,7 +83,11 @@ def serve(cfg: Config, serve_cfg: ServeConfig | None = None) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.common import apply_compile_cache_env
 
+    # before warmup's program builds: a restarted server replays its compiles
+    # from the persistent cache instead of re-paying the cold-start warmup
+    apply_compile_cache_env()
     cfg = parse_cli(argv, mode="testing")
     try:
         with run_telemetry(cfg, "serve"):

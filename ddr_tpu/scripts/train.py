@@ -141,6 +141,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             optimizer=optimizer,
             remat_bands=cfg.experiment.remat_bands,
             collect_health=health_on,
+            # _prepare pre-permutes q_prime columns on the HOST for single-ring
+            # wavefront batches (wf-hoist fast path; one shared predicate)
+            q_prime_wf_permuted=True,
         )
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
@@ -215,7 +218,16 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     payload = par.prepare(rd, q_prime)
                     attrs = rd.normalized_spatial_attributes
                 else:
-                    payload = (jnp.asarray(q_prime), *prepare_batch(rd, slope_min))
+                    network, channels, gauges = prepare_batch(rd, slope_min)
+                    from ddr_tpu.routing.model import single_ring_wavefront
+
+                    if single_ring_wavefront(network):
+                        # wf-hoist fast path (the step was built with
+                        # q_prime_wf_permuted=True): permute columns on the
+                        # HOST, in the prefetch thread, so the device never
+                        # pays the per-element permutation (~7ms at N=8192)
+                        q_prime = q_prime[:, np.asarray(network.wf_perm)]
+                    payload = (jnp.asarray(q_prime), network, channels, gauges)
                     attrs = jnp.asarray(rd.normalized_spatial_attributes)
                 return i, rd, payload, attrs, obs_daily, obs_mask
 
@@ -367,6 +379,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
 
 def main(argv: list[str] | None = None) -> int:
+    from ddr_tpu.scripts.common import apply_compile_cache_env
+
+    apply_compile_cache_env()  # before the first compile (DDR_COMPILE_CACHE_DIR)
     cfg = parse_cli(argv, mode="training")
     # KeyboardInterrupt is caught OUTSIDE run_telemetry so the run log records
     # status=interrupted (catching inside would close it as "ok").

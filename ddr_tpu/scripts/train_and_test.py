@@ -35,7 +35,9 @@ def train_and_test(cfg: Config) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.common import apply_compile_cache_env
 
+    apply_compile_cache_env()  # before the first compile (DDR_COMPILE_CACHE_DIR)
     cfg = parse_cli(argv, mode="training")
     # one run log spans both phases (train steps then eval events); interrupt
     # caught outside run_telemetry so the log records status=interrupted

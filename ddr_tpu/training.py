@@ -18,6 +18,7 @@ one-day misalignment on an autocorrelated signal.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 import pickle
@@ -84,7 +85,7 @@ def masked_l1_daily(runoff_tg, obs_daily, obs_mask, tau: int, warmup: int):
     return err.sum() / jnp.maximum(mask.sum(), 1), daily
 
 
-def _make_step(loss_fn, optimizer, collect_health: bool = False):
+def _make_step(loss_fn, optimizer, collect_health: bool = False, donate: bool = True):
     """Shared jitted step scaffolding for every builder whose loss takes
     ``(params, attrs, q_prime, obs_daily, obs_mask)``: value_and_grad ->
     clip+Adam update -> apply. One definition so the builders cannot drift.
@@ -93,11 +94,19 @@ def _make_step(loss_fn, optimizer, collect_health: bool = False):
     stamps the gradient global-norm into the stats (pre-clip — the watchdog
     wants the raw explosion signal, not the clipped one) and returns a
     5-tuple ``(params, opt_state, loss, daily, health)``. Everything stays
-    inside the one jitted program — no extra sync, no second compile."""
+    inside the one jitted program — no extra sync, no second compile.
 
+    ``params``/``opt_state`` are DONATED (``donate_argnums=(0, 1)``): the step
+    consumes them and returns replacements, so XLA reuses their buffers for the
+    outputs in place instead of copying the full optimizer state every step.
+    Callers must rebind (``params, opt_state, ... = step(params, opt_state,
+    ...)``) — every trainer in the repo already does; backends without donation
+    support (CPU) just warn-and-copy."""
+
+    donate_argnums = (0, 1) if donate else ()
     if collect_health:
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=donate_argnums)
         def step_h(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
             (loss, (daily, health)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, attrs, q_prime, obs_daily, obs_mask
@@ -109,7 +118,7 @@ def _make_step(loss_fn, optimizer, collect_health: bool = False):
 
         return step_h
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=donate_argnums)
     def step(params, opt_state, attrs, q_prime, obs_daily, obs_mask):
         (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, attrs, q_prime, obs_daily, obs_mask
@@ -134,6 +143,7 @@ def make_train_step(
     warmup: int,
     optimizer: optax.GradientTransformation,
     collect_health: bool = False,
+    donate: bool = True,
 ):
     """Build the jitted train step for one compiled network shape.
 
@@ -148,6 +158,10 @@ def make_train_step(
     ``collect_health`` appends an on-device
     :class:`~ddr_tpu.observability.health.HealthStats` (route health +
     pre-clip grad norm) as a 5th return — see :func:`_make_step`.
+
+    ``donate=True`` (default) donates ``params``/``opt_state`` buffers to the
+    step (:func:`_make_step`); pass ``False`` for A/B harnesses that feed the
+    SAME state into several built steps.
     """
     n_segments = channels.length.shape[0]
 
@@ -166,7 +180,7 @@ def make_train_step(
             return loss, (daily, result.health)
         return loss, daily
 
-    return _make_step(loss_fn, optimizer, collect_health=collect_health)
+    return _make_step(loss_fn, optimizer, collect_health=collect_health, donate=donate)
 
 
 def make_batch_train_step(
@@ -180,6 +194,8 @@ def make_batch_train_step(
     optimizer: optax.GradientTransformation,
     remat_bands: bool = False,
     collect_health: bool = False,
+    donate: bool = True,
+    q_prime_wf_permuted: bool = False,
 ):
     """Like :func:`make_train_step` but with the network/channels/gauges as call-time
     arguments, so one jitted function serves every training batch.
@@ -193,10 +209,20 @@ def make_batch_train_step(
     ``remat_bands`` (``experiment.remat_bands``) applies band-level backward
     checkpointing WHEN the batch's network is the stacked deep router; other
     engines ignore it (shallow batches must not error under a deep-tuned
-    config)."""
+    config).
+
+    ``q_prime_wf_permuted=True`` declares the caller's HOST-SIDE contract that
+    every batch whose network satisfies
+    :func:`ddr_tpu.routing.model.single_ring_wavefront` arrives with
+    ``q_prime`` columns already permuted by ``network.wf_perm``
+    (``q_prime[:, np.asarray(network.wf_perm)]`` during batch prep, as
+    ``ddr train`` does) — the wavefront engine then skips its one per-element
+    device permutation. Batches routed by other engines are unaffected and
+    must arrive in original column order."""
 
     @spanned("loss")
     def loss_fn(params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
+        from ddr_tpu.routing.model import single_ring_wavefront
         from ddr_tpu.routing.stacked import StackedChunked
 
         raw = kan_model.apply(params, attrs)
@@ -207,15 +233,17 @@ def make_batch_train_step(
             network, channels, spatial, q_prime, gauges=gauges, bounds=bounds,
             remat_bands=remat_bands and isinstance(network, StackedChunked),
             collect_health=collect_health,
+            q_prime_permuted=q_prime_wf_permuted and single_ring_wavefront(network),
         )
         loss, daily = masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
         if collect_health:
             return loss, (daily, result.health)
         return loss, daily
 
+    donate_argnums = (0, 1) if donate else ()
     if collect_health:
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=donate_argnums)
         def step_h(params, opt_state, network, channels, gauges, attrs, q_prime,
                    obs_daily, obs_mask):
             (loss, (daily, health)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -229,7 +257,7 @@ def make_batch_train_step(
 
         return step_h
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=donate_argnums)
     def step(params, opt_state, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask):
         (loss, daily), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, network, channels, gauges, attrs, q_prime, obs_daily, obs_mask
@@ -255,6 +283,7 @@ def make_sharded_train_step(
     warmup: int,
     optimizer: optax.GradientTransformation,
     collect_health: bool = False,
+    donate: bool = True,
 ):
     """Multi-chip train step on the SHARDED WAVEFRONT engine.
 
@@ -296,7 +325,7 @@ def make_sharded_train_step(
             return loss, (daily, compute_health(runoff, q_prime))
         return loss, daily
 
-    return _make_step(loss_fn, optimizer, collect_health=collect_health)
+    return _make_step(loss_fn, optimizer, collect_health=collect_health, donate=donate)
 
 
 def make_sharded_chunked_train_step(
@@ -314,6 +343,7 @@ def make_sharded_chunked_train_step(
     optimizer: optax.GradientTransformation,
     remat_bands: bool = False,
     collect_health: bool = False,
+    donate: bool = True,
 ):
     """Multi-chip train step at CONTINENTAL DEPTH: the sharded depth-chunked
     router (:func:`ddr_tpu.parallel.chunked.route_chunked_sharded`) under the
@@ -361,7 +391,7 @@ def make_sharded_chunked_train_step(
             return loss, (daily, compute_health(runoff, q_prime))
         return loss, daily
 
-    return _make_step(loss_fn, optimizer, collect_health=collect_health)
+    return _make_step(loss_fn, optimizer, collect_health=collect_health, donate=donate)
 
 
 # Bump when the checkpoint blob layout changes; load_state refuses mismatches with

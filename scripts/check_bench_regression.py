@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Compare a fresh bench.py record against the repo's latest BENCH_*.json.
+
+The bench rounds (BENCH_r01.json ... BENCH_r05.json) are the repo's recorded
+throughput history; this script is the tooling that notices when a change
+walks one of those numbers backwards. It compares every shared throughput
+field (``value``, ``grad_value``, ``deep_value``, ``deep_grad_value``,
+``train_value``, ``baseline_value``) and WARNS on drops past the threshold
+(default 20%). Ratio fields (``grad_over_forward_ratio``) are reported
+informationally — they move whenever either side of the division does.
+
+Records from different devices are never compared as regressions: a CPU
+fallback round against a TPU round says nothing about the code, so a device
+mismatch downgrades every finding to informational.
+
+Usage::
+
+    python scripts/check_bench_regression.py fresh.json          # vs latest BENCH_*
+    python scripts/check_bench_regression.py fresh.json --baseline BENCH_r05.json
+    python scripts/check_bench_regression.py --run               # run bench.py first
+    python scripts/check_bench_regression.py fresh.json --strict # exit 1 on regression
+
+Wired as a slow-marked test (tests/scripts/test_check_bench_regression.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+#: Throughput fields compared for regressions (reach-timesteps/s — bigger is
+#: better for every one of them).
+THROUGHPUT_KEYS = (
+    "value",
+    "grad_value",
+    "deep_value",
+    "deep_grad_value",
+    "train_value",
+    "baseline_value",
+)
+
+#: Informational ratio fields (reported, never flagged).
+RATIO_KEYS = ("grad_over_forward_ratio", "deep_grad_over_forward_ratio")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def latest_baseline(root: Path = REPO_ROOT) -> Path | None:
+    """The most recent ``BENCH_r<NN>*.json`` by round number (ties: name)."""
+
+    def round_of(p: Path) -> tuple[int, str]:
+        m = re.match(r"BENCH_r(\d+)", p.name)
+        return (int(m.group(1)) if m else -1, p.name)
+
+    cands = sorted(root.glob("BENCH_r*.json"), key=round_of)
+    return cands[-1] if cands else None
+
+
+def load_record(path: Path) -> dict:
+    """A bench record, in either stored form.
+
+    The committed ``BENCH_r*.json`` baselines are the DRIVER's pretty-printed
+    wrappers (``{n, cmd, rc, tail, parsed}``) with the actual bench fields
+    nested under ``"parsed"``; a fresh record is bench.py's one JSON line
+    (possibly preceded by log lines). Whole-file JSON is tried first, then the
+    last non-empty line; a ``parsed`` sub-object is unwrapped.
+    """
+    text = path.read_text()
+    try:
+        rec = json.loads(text)
+    except json.JSONDecodeError:
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        if not lines:
+            raise ValueError(f"{path}: empty bench record") from None
+        rec = json.loads(lines[-1])
+    if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]
+    if not isinstance(rec, dict):
+        raise ValueError(f"{path}: not a bench record (parsed to {type(rec).__name__})")
+    return rec
+
+
+def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
+    """Findings for every shared key: ``status`` is ``regression`` (fresh is
+    more than ``threshold`` below baseline), ``ok``, or ``info`` (ratio
+    fields, or any comparison across mismatched devices)."""
+    findings: list[dict] = []
+    device_mismatch = (
+        fresh.get("device") is not None
+        and baseline.get("device") is not None
+        and fresh["device"] != baseline["device"]
+    )
+    for key in THROUGHPUT_KEYS + RATIO_KEYS:
+        f, b = fresh.get(key), baseline.get(key)
+        if not isinstance(f, (int, float)) or not isinstance(b, (int, float)) or not b:
+            continue
+        ratio = f / b
+        if key in RATIO_KEYS or device_mismatch:
+            status = "info"
+        elif ratio < 1.0 - threshold:
+            status = "regression"
+        else:
+            status = "ok"
+        findings.append(
+            {"key": key, "fresh": f, "baseline": b, "ratio": round(ratio, 3), "status": status}
+        )
+    if device_mismatch:
+        findings.insert(0, {
+            "key": "device",
+            "fresh": fresh["device"],
+            "baseline": baseline["device"],
+            "ratio": None,
+            "status": "info",
+        })
+    return findings
+
+
+def run_bench(timeout: float = 3600.0) -> dict:
+    """Run bench.py in a subprocess and parse its one JSON line."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py")],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(f"bench.py failed (rc={proc.returncode}): {proc.stderr[-400:]}")
+    return json.loads(lines[-1])
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", nargs="?", help="path to a fresh bench JSON record")
+    ap.add_argument("--run", action="store_true", help="run bench.py for the fresh record")
+    ap.add_argument("--baseline", help="baseline record (default: latest BENCH_r*.json)")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="relative drop that counts as a regression (default 0.2)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any regression is found")
+    args = ap.parse_args(argv)
+
+    if args.run:
+        fresh = run_bench()
+    elif args.fresh:
+        fresh = load_record(Path(args.fresh))
+    else:
+        ap.error("pass a fresh record path or --run")
+
+    baseline_path = Path(args.baseline) if args.baseline else latest_baseline()
+    if baseline_path is None:
+        print("check_bench_regression: no BENCH_r*.json baseline found", file=sys.stderr)
+        return 0
+    baseline = load_record(baseline_path)
+
+    findings = compare(fresh, baseline, args.threshold)
+    if not findings:
+        print(f"no comparable fields between fresh record and {baseline_path.name}")
+        return 0
+
+    width = max(len(f["key"]) for f in findings)
+    print(f"fresh vs {baseline_path.name} (warn below {1 - args.threshold:.0%}):")
+    regressions = 0
+    for f in findings:
+        mark = {"ok": " ", "info": "i", "regression": "!"}[f["status"]]
+        ratio = "" if f["ratio"] is None else f" ({f['ratio']:.0%} of baseline)"
+        print(f" {mark} {f['key']:<{width}}  {f['fresh']} vs {f['baseline']}{ratio}")
+        if f["status"] == "regression":
+            regressions += 1
+            print(
+                f"check_bench_regression: WARNING: {f['key']} dropped to "
+                f"{f['ratio']:.0%} of {baseline_path.name}",
+                file=sys.stderr,
+            )
+    return 1 if (args.strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

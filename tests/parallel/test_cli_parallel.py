@@ -150,6 +150,7 @@ class TestStepParity:
             tau=cfg.params.tau,
             warmup=cfg.experiment.warmup,
             optimizer=optimizer,
+            donate=False,  # the same params/opt_state go into par.step next
         )
         network, channels, gauges = prepare_batch(
             rd, cfg.params.attribute_minimums["slope"]

@@ -194,6 +194,7 @@ class TestShardedTrainStep:
             Bounds.from_config(cfg.params.attribute_minimums),
             cfg.params.parameter_ranges, cfg.params.log_space_parameters,
             cfg.params.defaults, tau=cfg.params.tau, warmup=1, optimizer=optimizer,
+            donate=False,  # A/B tests below feed the same state into two steps
         )
         q_prime = jnp.asarray(basin.q_prime[:, part.perm])
         obs = jnp.asarray(basin.obs_daily)
@@ -226,7 +227,7 @@ class TestShardedTrainStep:
             Bounds.from_config(cfg.params.attribute_minimums),
             cfg.params.parameter_ranges, cfg.params.log_space_parameters,
             cfg.params.defaults, tau=cfg.params.tau, warmup=1,
-            optimizer=make_optimizer(1e-3),
+            optimizer=make_optimizer(1e-3), donate=False,
         )
         opt_state = optimizer.init(params)
         _, _, loss_swf, daily_swf = step(params, opt_state, attrs, q_prime, obs, mask)

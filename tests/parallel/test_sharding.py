@@ -153,6 +153,7 @@ class TestShardedTraining:
             tau=cfg.params.tau,
             warmup=1,
             optimizer=optimizer,
+            donate=False,  # the same state feeds the sharded step below
         )
         obs = jnp.asarray(basin.obs_daily)
         mask = jnp.ones_like(obs, dtype=bool)

@@ -1,0 +1,238 @@
+"""Analytic reverse-wavefront adjoint vs standard AD: gradient equivalence.
+
+The wavefront family's ``adjoint="analytic"`` custom VJP must be a pure
+backward-schedule change — identical forward values, gradients matching AD to
+float associativity — across every engine (single-ring wavefront, depth-chunked,
+stacked), both state paths (in-band hotstart and carried ``q_init``), with and
+without ``remat_physics`` on the AD side, on randomized small DAGs whose inputs
+deliberately drive reaches INTO the discharge clamp (zero inflows -> raw solve
+values below the lower bound), so the clamp subgradient path is exercised.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.routing.chunked import build_chunked_network
+from ddr_tpu.routing.mc import Bounds, ChannelState, GaugeIndex, route
+from ddr_tpu.routing.network import build_network
+from ddr_tpu.routing.stacked import build_stacked_chunked
+
+
+def _random_dag(rng, n, max_in=4, p_edge=0.8):
+    """Topologically-ordered random DAG with bounded in-degree; returns
+    (rows, cols) with rows = downstream targets."""
+    rows, cols = [], []
+    for i in range(1, n):
+        if rng.random() > p_edge:
+            continue  # occasional headwater mid-sequence
+        k = int(rng.integers(1, max_in + 1))
+        preds = rng.choice(i, size=min(k, i), replace=False)
+        for p in np.atleast_1d(preds):
+            rows.append(i)
+            cols.append(int(p))
+    return np.asarray(rows, np.int64), np.asarray(cols, np.int64)
+
+
+def _random_inputs(rng, n, t):
+    channels = ChannelState(
+        length=jnp.asarray(rng.uniform(500.0, 5000.0, n), jnp.float32),
+        slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
+        x_storage=jnp.asarray(rng.uniform(0.1, 0.4, n), jnp.float32),
+    )
+    params = {
+        "n": jnp.asarray(rng.uniform(0.02, 0.06, n), jnp.float32),
+        "q_spatial": jnp.asarray(rng.uniform(0.2, 0.8, n), jnp.float32),
+        "p_spatial": jnp.asarray(rng.uniform(5.0, 30.0, n), jnp.float32),
+    }
+    # CLAMP-ACTIVE by construction: ~1/4 of inflow entries are exactly zero, so
+    # headwater hotstart values (raw = q'_0) sit BELOW the discharge bound and
+    # downstream raw values cross it — the backward's dmax path is exercised.
+    q_prime = rng.uniform(0.0, 2.0, (t, n)).astype(np.float32)
+    q_prime[rng.random((t, n)) < 0.25] = 0.0
+    # loss weights: dense, sign-mixed, so every reach-timestep contributes a
+    # distinct cotangent (a mean would make many backward bugs self-cancel)
+    w = jnp.asarray(rng.normal(size=(t, n)), jnp.float32)
+    wf = jnp.asarray(rng.normal(size=n), jnp.float32)
+    return channels, params, jnp.asarray(q_prime), w, wf
+
+
+def _loss_fn(network, channels, w, wf, adjoint, remat_physics, q_init, gauges=None):
+    """Loss over (params, q_prime, length): covers the spatial-parameter,
+    inflow, AND channel-state gradient paths, plus both outputs (runoff and
+    final_discharge)."""
+    if gauges is not None:
+        w = w[:, : gauges.n_gauges]
+
+    def loss(params, q_prime, length):
+        ch = dataclasses.replace(channels, length=length)
+        res = route(
+            network, ch, params, q_prime, q_init=q_init,
+            gauges=gauges, adjoint=adjoint, remat_physics=remat_physics,
+        )
+        return (res.runoff * w).sum() + (res.final_discharge * wf).sum()
+
+    return loss
+
+
+def _grads(network, channels, params, q_prime, w, wf, adjoint, remat, q_init, gauges=None):
+    loss = _loss_fn(network, channels, w, wf, adjoint, remat, q_init, gauges)
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+        params, q_prime, channels.length
+    )
+    return val, grads
+
+
+def _assert_grads_match(ga, gb, label):
+    """rtol 1e-5 in the acceptance sense: componentwise rtol with an absolute
+    floor scaled to each array's gradient magnitude (float32 accumulation
+    noise on near-zero components must not mask real mismatches elsewhere)."""
+    flat_a, _ = jax.tree_util.tree_flatten(ga)
+    flat_b, _ = jax.tree_util.tree_flatten(gb)
+    assert len(flat_a) == len(flat_b)
+    for i, (a, b) in enumerate(zip(flat_a, flat_b)):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = max(np.max(np.abs(a)), np.max(np.abs(b)), 1e-8)
+        np.testing.assert_allclose(
+            a, b, rtol=1e-5, atol=1e-5 * scale,
+            err_msg=f"{label}: gradient leaf {i} diverges (scale={scale})",
+        )
+
+
+def _build(engine, rows, cols, n):
+    if engine == "wavefront":
+        net = build_network(rows, cols, n)
+        assert net.wavefront and net.wf_t_width > 0
+        return net
+    if engine == "chunked":
+        net = build_chunked_network(rows, cols, n, cell_budget=160)
+        assert net.n_chunks >= 2, "banding too coarse to exercise cross-band adjoints"
+        return net
+    net = build_stacked_chunked(rows, cols, n, cell_budget=160)
+    assert net.n_chunks >= 2 and net.t_width > 0
+    return net
+
+
+ENGINES = ("wavefront", "chunked", "stacked")
+
+
+class TestAnalyticMatchesAD:
+    @pytest.mark.parametrize("engine", ENGINES)
+    @pytest.mark.parametrize("init_path", ("hotstart", "q_init"))
+    def test_gradients_match_both_remat_modes(self, engine, init_path):
+        rng = np.random.default_rng(hash((engine, init_path)) % 2**32)
+        n, t = 72, 12
+        rows, cols = _random_dag(rng, n)
+        network = _build(engine, rows, cols, n)
+        channels, params, q_prime, w, wf = _random_inputs(rng, n, t)
+        q_init = (
+            None if init_path == "hotstart"
+            else jnp.asarray(rng.uniform(0.0, 3.0, n), jnp.float32)
+        )
+
+        v_an, g_an = _grads(network, channels, params, q_prime, w, wf,
+                            "analytic", True, q_init)
+        for remat in (True, False):
+            v_ad, g_ad = _grads(network, channels, params, q_prime, w, wf,
+                                "ad", remat, q_init)
+            # identical forward program -> identical value, bit for bit
+            assert float(v_an) == float(v_ad), f"{engine}/{init_path}: forward diverged"
+            _assert_grads_match(g_an, g_ad, f"{engine}/{init_path}/remat={remat}")
+
+    def test_gauge_aggregated_gradients_match(self):
+        """The gauge segment-sum path composes with the custom VJP."""
+        rng = np.random.default_rng(11)
+        n, t = 64, 10
+        rows, cols = _random_dag(rng, n)
+        network = _build("wavefront", rows, cols, n)
+        channels, params, q_prime, w, wf = _random_inputs(rng, n, t)
+        gauges = GaugeIndex.from_ragged(
+            [rng.choice(n, size=3, replace=False) for _ in range(4)]
+        )
+        _, g_an = _grads(network, channels, params, q_prime, w, wf,
+                         "analytic", True, None, gauges=gauges)
+        _, g_ad = _grads(network, channels, params, q_prime, w, wf,
+                         "ad", True, None, gauges=gauges)
+        _assert_grads_match(g_an, g_ad, "gauges")
+
+    def test_single_timestep_window(self):
+        """T=1: only the hotstart diagonal exists; the q'-adjoint reduces to
+        the transposed hotstart solve alone."""
+        rng = np.random.default_rng(3)
+        n = 40
+        rows, cols = _random_dag(rng, n)
+        network = _build("wavefront", rows, cols, n)
+        channels, params, q_prime, w, wf = _random_inputs(rng, n, 1)
+        _, g_an = _grads(network, channels, params, q_prime, w, wf, "analytic", True, None)
+        _, g_ad = _grads(network, channels, params, q_prime, w, wf, "ad", True, None)
+        _assert_grads_match(g_an, g_ad, "T=1")
+
+    def test_step_engine_rejects_adjoint(self):
+        rng = np.random.default_rng(5)
+        n = 16
+        rows, cols = _random_dag(rng, n)
+        network = build_network(rows, cols, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, 4)
+        with pytest.raises(ValueError, match="wavefront routing family"):
+            route(network, channels, params, q_prime, engine="step", adjoint="analytic")
+
+    def test_unknown_adjoint_rejected(self):
+        rng = np.random.default_rng(6)
+        n = 16
+        rows, cols = _random_dag(rng, n)
+        network = build_network(rows, cols, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, 4)
+        with pytest.raises(ValueError, match="adjoint"):
+            route(network, channels, params, q_prime, adjoint="bogus")
+
+
+class TestJitCacheDiscipline:
+    def test_analytic_path_adds_no_jit_cache_entries(self):
+        """ONE jitted value_and_grad on the analytic path compiles exactly one
+        program, and repeat calls (fresh arrays, same shapes) never re-trace —
+        the custom VJP must not smuggle extra cache entries or per-call
+        retraces into the train step."""
+        rng = np.random.default_rng(7)
+        n, t = 48, 8
+        rows, cols = _random_dag(rng, n)
+        network = _build("wavefront", rows, cols, n)
+        channels, params, q_prime, w, wf = _random_inputs(rng, n, t)
+        loss = _loss_fn(network, channels, w, wf, "analytic", True, None)
+        step = jax.jit(jax.value_and_grad(loss))
+        step(params, q_prime, channels.length)
+        assert step._cache_size() == 1
+        params2 = {k: v + 0.001 for k, v in params.items()}
+        step(params2, q_prime * 1.1, channels.length + 1.0)
+        assert step._cache_size() == 1, "analytic adjoint re-traced on a repeat batch"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_random_dags_all_engines(seed):
+    """Wider randomized battery: per seed, one DAG through all three engines,
+    alternating init paths, analytic vs AD."""
+    rng = np.random.default_rng(1000 + seed)
+    n, t = int(rng.integers(40, 120)), int(rng.integers(6, 20))
+    rows, cols = _random_dag(rng, n, max_in=int(rng.integers(1, 6)))
+    channels, params, q_prime, w, wf = _random_inputs(rng, n, t)
+    q_init = (
+        None if seed % 2 == 0
+        else jnp.asarray(rng.uniform(0.0, 3.0, n), jnp.float32)
+    )
+    for engine in ENGINES:
+        if engine == "wavefront":
+            network = build_network(rows, cols, n)
+        elif engine == "chunked":
+            network = build_chunked_network(rows, cols, n, cell_budget=200)
+        else:
+            network = build_stacked_chunked(rows, cols, n, cell_budget=200)
+        _, g_an = _grads(network, channels, params, q_prime, w, wf,
+                         "analytic", True, q_init)
+        _, g_ad = _grads(network, channels, params, q_prime, w, wf,
+                         "ad", True, q_init)
+        _assert_grads_match(g_an, g_ad, f"seed={seed}/{engine}")

@@ -1,0 +1,184 @@
+"""scripts/check_bench_regression.py: the bench-history regression gate.
+
+Fast tests pin the comparison semantics (threshold, device-mismatch downgrade,
+ratio fields informational, strict exit code); the slow test runs the real
+bench.py at tiny shapes and feeds its record through the script end to end.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_bench_regression.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_bench_regression", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCompare:
+    def test_flags_only_drops_past_threshold(self):
+        mod = _load()
+        fresh = {"device": "cpu", "value": 79.0, "grad_value": 85.0}
+        base = {"device": "cpu", "value": 100.0, "grad_value": 100.0}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["value"]["status"] == "regression"  # 79% < 80%
+        assert by_key["grad_value"]["status"] == "ok"  # 85% >= 80%
+
+    def test_improvements_are_ok(self):
+        mod = _load()
+        out = mod.compare({"device": "cpu", "value": 250.0}, {"device": "cpu", "value": 100.0})
+        assert out == [
+            {"key": "value", "fresh": 250.0, "baseline": 100.0, "ratio": 2.5, "status": "ok"}
+        ]
+
+    def test_device_mismatch_downgrades_to_info(self):
+        """A CPU fallback round vs a TPU round says nothing about the code."""
+        mod = _load()
+        out = mod.compare({"device": "cpu", "value": 1.0}, {"device": "tpu", "value": 1e6})
+        assert all(f["status"] == "info" for f in out)
+        assert out[0]["key"] == "device"
+
+    def test_ratio_fields_are_informational(self):
+        mod = _load()
+        fresh = {"device": "cpu", "grad_over_forward_ratio": 0.1}
+        base = {"device": "cpu", "grad_over_forward_ratio": 0.9}
+        (f,) = mod.compare(fresh, base)
+        assert f["status"] == "info"
+
+    def test_missing_and_null_fields_are_skipped(self):
+        mod = _load()
+        fresh = {"device": "cpu", "value": 10.0, "deep_value": None}
+        base = {"device": "cpu", "grad_value": 5.0, "deep_value": 3.0}
+        assert mod.compare(fresh, base) == []
+
+
+class TestLoadRecord:
+    def test_unwraps_driver_wrapper(self, tmp_path):
+        """The committed BENCH_r*.json form: pretty-printed {n,cmd,rc,tail,
+        parsed} wrapper with the bench fields under 'parsed'."""
+        mod = _load()
+        p = tmp_path / "BENCH_r09.json"
+        p.write_text(json.dumps(
+            {"n": 9, "cmd": "python bench.py", "rc": 0, "tail": "...",
+             "parsed": {"device": "cpu", "value": 42.0}},
+            indent=2,
+        ))
+        assert mod.load_record(p) == {"device": "cpu", "value": 42.0}
+
+    def test_reads_last_line_of_log_output(self, tmp_path):
+        mod = _load()
+        p = tmp_path / "fresh.json"
+        p.write_text("some log line\n" + json.dumps({"value": 7.0}) + "\n")
+        assert mod.load_record(p) == {"value": 7.0}
+
+    def test_repo_baseline_is_loadable_and_comparable(self):
+        """The script's primary documented flow: the auto-picked latest
+        BENCH_r*.json must load and expose throughput fields compare() sees."""
+        mod = _load()
+        base = mod.load_record(mod.latest_baseline())
+        findings = mod.compare(dict(base), base)
+        assert findings and all(f["status"] != "regression" for f in findings)
+
+
+class TestLatestBaseline:
+    def test_picks_highest_round(self, tmp_path):
+        mod = _load()
+        for name in ("BENCH_r01.json", "BENCH_r05.json", "BENCH_r03_interactive.json"):
+            (tmp_path / name).write_text("{}")
+        assert mod.latest_baseline(tmp_path).name == "BENCH_r05.json"
+
+    def test_repo_has_a_baseline(self):
+        assert _load().latest_baseline() is not None
+
+    def test_none_when_empty(self, tmp_path):
+        assert _load().latest_baseline(tmp_path) is None
+
+
+class TestCli:
+    def _write(self, path, record):
+        path.write_text(json.dumps(record) + "\n")
+        return path
+
+    def test_ok_exit_and_report(self, tmp_path):
+        fresh = self._write(tmp_path / "fresh.json", {"device": "cpu", "value": 100.0})
+        base = self._write(tmp_path / "base.json", {"device": "cpu", "value": 100.0})
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "value" in proc.stdout
+
+    def test_warns_but_exits_zero_without_strict(self, tmp_path):
+        fresh = self._write(tmp_path / "fresh.json", {"device": "cpu", "value": 10.0})
+        base = self._write(tmp_path / "base.json", {"device": "cpu", "value": 100.0})
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0
+        assert "WARNING" in proc.stderr
+
+    def test_strict_exits_one_on_regression(self, tmp_path):
+        fresh = self._write(tmp_path / "fresh.json", {"device": "cpu", "value": 10.0})
+        base = self._write(tmp_path / "base.json", {"device": "cpu", "value": 100.0})
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base), "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+
+
+@pytest.mark.slow
+def test_end_to_end_against_fresh_bench(tmp_path):
+    """Run the REAL bench.py (tiny shapes, deep phase off) and feed its record
+    through the checker against itself (self-comparison: never a regression)
+    and against a doctored 10x baseline (always a regression under --strict)."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        DDR_BENCH_N="256",
+        DDR_BENCH_T="24",
+        DDR_BENCH_DEEP_N="0",
+        DDR_BENCH_DEEP_DEPTH="0",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=1200, env=env, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    record = json.loads([ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert record.get("value"), record
+    # the new ratio field rides along whenever both throughputs measured
+    if record.get("grad_value"):
+        assert record.get("grad_over_forward_ratio")
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(record) + "\n")
+
+    ok = subprocess.run(
+        [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(fresh), "--strict"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    doctored = dict(record, value=record["value"] * 10)
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(doctored) + "\n")
+    bad = subprocess.run(
+        [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base), "--strict"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert bad.returncode == 1
+    assert "WARNING" in bad.stderr

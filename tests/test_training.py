@@ -79,6 +79,51 @@ def test_twin_experiment_training_reduces_loss():
     assert np.isfinite(float(loss2))
 
 
+def test_batch_step_host_permuted_q_prime_matches():
+    """The wf-hoist fast path (`ddr train`'s contract): a step built with
+    q_prime_wf_permuted=True fed HOST-permuted inflow columns must produce the
+    same loss/daily as the plain step on original-order inflows — and leave
+    non-single-ring batches untouched (same predicate on both sides)."""
+    from ddr_tpu.routing.model import single_ring_wavefront
+    from ddr_tpu.training import make_batch_train_step
+
+    cfg = _cfg()
+    basin = observe(make_basin(n_segments=48, n_gauges=4, n_days=6, seed=3), cfg)
+    rd = basin.routing_data
+    network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
+    assert single_ring_wavefront(network)
+    kan_model = Kan(
+        input_var_names=tuple(cfg.kan.input_var_names),
+        learnable_parameters=tuple(cfg.kan.learnable_parameters),
+    )
+    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+    params = kan_model.init(jax.random.key(0), attrs)
+    optimizer = make_optimizer(1e-3)
+    opt_state = optimizer.init(params)
+    kw = dict(
+        bounds=Bounds.from_config(cfg.params.attribute_minimums),
+        parameter_ranges=cfg.params.parameter_ranges,
+        log_space_parameters=cfg.params.log_space_parameters,
+        defaults=cfg.params.defaults, tau=cfg.params.tau, warmup=1,
+        optimizer=optimizer, donate=False,
+    )
+    step_plain = make_batch_train_step(kan_model, **kw)
+    step_hoist = make_batch_train_step(kan_model, **kw, q_prime_wf_permuted=True)
+    obs = jnp.asarray(basin.obs_daily)
+    mask = jnp.ones_like(obs, dtype=bool)
+    qp = np.asarray(basin.q_prime, np.float32)
+    qp_perm = jnp.asarray(qp[:, np.asarray(network.wf_perm)])
+
+    _, _, l0, d0 = step_plain(
+        params, opt_state, network, channels, gauges, attrs, jnp.asarray(qp), obs, mask
+    )
+    _, _, l1, d1 = step_hoist(
+        params, opt_state, network, channels, gauges, attrs, qp_perm, obs, mask
+    )
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-5, atol=1e-6)
+
+
 def test_nan_observations_are_masked():
     cfg = _cfg()
     basin = observe(make_basin(n_segments=32, n_gauges=3, n_days=6, seed=2), cfg)
@@ -566,8 +611,9 @@ def test_batch_step_remat_bands_matches_default_on_deep_topology():
     mask = jnp.ones_like(obs, dtype=bool)
     qp = jnp.asarray(basin.q_prime)
 
-    step0 = make_batch_train_step(kan_model, **kw)
-    step1 = make_batch_train_step(kan_model, **kw, remat_bands=True)
+    # donate=False: the same params/opt_state feed all three calls below
+    step0 = make_batch_train_step(kan_model, **kw, donate=False)
+    step1 = make_batch_train_step(kan_model, **kw, remat_bands=True, donate=False)
     _, _, l0, _ = step0(params, opt_state, network, channels, gauges, attrs, qp, obs, mask)
     _, _, l1, _ = step1(params, opt_state, network, channels, gauges, attrs, qp, obs, mask)
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
