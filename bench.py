@@ -100,20 +100,39 @@ def _timed_rate(fn, arg, n: int, t_hours: int) -> float:
     return n * t_hours / dt
 
 
-def _peak_suffix() -> str:
-    """`` peak_gb=<gb>`` for the record when the backend reports device memory
-    (TPU); empty on CPU (no peak_bytes_in_use) — VERDICT r4 item 3: no
-    measurement row without its HBM envelope."""
-    import jax
+def _card_suffix(compiled) -> str:
+    """`` key=value`` tokens appended to a bench child's output line: the HBM
+    peak (device ``memory_stats`` where the backend reports it, the compiled
+    program's ``memory_analysis()`` estimate otherwise — so CPU rounds stop
+    recording ``peak_hbm_gb: null``) plus the card-derived roofline fields
+    (``flops=``, ``bytes=``, ``collectives=<compact json>``)."""
+    from ddr_tpu.observability.costs import card_from_compiled, peak_bytes_or_envelope
 
-    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
-    peak = stats.get("peak_bytes_in_use")
-    return f" peak_gb={peak / 2**30:.2f}" if peak is not None else ""
+    card = None
+    try:
+        card = card_from_compiled(compiled, name="bench")
+    except Exception:
+        pass
+    peak = peak_bytes_or_envelope(card=card)
+    tokens = []
+    if peak is not None:
+        tokens.append(f"peak_gb={peak / 2**30:.4f}")
+    if card is not None:
+        if card.flops is not None:
+            tokens.append(f"flops={card.flops:.6g}")
+        if card.bytes_accessed is not None:
+            tokens.append(f"bytes={card.bytes_accessed:.6g}")
+        # always emitted (all-zero included): a record that says "zero
+        # collectives" is distinguishable from one with no card at all
+        tokens.append(
+            "collectives=" + json.dumps(card.collectives, separators=(",", ":"))
+        )
+    return (" " + " ".join(tokens)) if tokens else ""
 
 
 def bench_route(n: int, t_hours: int, depth: int | None = None) -> str:
-    """``"<rate>[ peak_gb=<gb>]"`` for the jitted forward route on the active
-    backend.
+    """``"<rate>[ key=value...]"`` for the jitted forward route on the active
+    backend (AOT-compiled, so the same handle yields the cost-card tokens).
 
     ``depth`` switches the topology to the deep CONUS-realistic generator;
     prepare_batch's auto-selection then routes it through the depth-chunked
@@ -124,7 +143,8 @@ def bench_route(n: int, t_hours: int, depth: int | None = None) -> str:
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
     fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
-    return f"{_timed_rate(fn, q_prime, n, t_hours)}{_peak_suffix()}"
+    compiled = fn.lower(q_prime).compile()
+    return f"{_timed_rate(compiled, q_prime, n, t_hours)}{_card_suffix(compiled)}"
 
 
 def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
@@ -133,25 +153,18 @@ def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
     wavefront when the requested depth fits its caps)."""
     import jax
 
-    from ddr_tpu.routing.chunked import ChunkedNetwork
     from ddr_tpu.routing.mc import route
-    from ddr_tpu.routing.stacked import StackedChunked
+    from ddr_tpu.routing.model import engine_label
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
-    if isinstance(network, StackedChunked):
-        engine = f"stacked-chunked-wavefront[{network.n_chunks}-band-scan]"
-    elif isinstance(network, ChunkedNetwork):
-        engine = f"depth-chunked-wavefront[{network.n_chunks}-band]"
-    elif getattr(network, "wavefront", False):
-        engine = "single-ring-wavefront"
-    else:
-        engine = "step"
+    engine = engine_label(network)
     fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
-    return f"{_timed_rate(fn, q_prime, n, t_hours)} {engine}{_peak_suffix()}"
+    compiled = fn.lower(q_prime).compile()
+    return f"{_timed_rate(compiled, q_prime, n, t_hours)} {engine}{_card_suffix(compiled)}"
 
 
 def bench_grad(n: int, t_hours: int, depth: int | None = None) -> str:
-    """``"<rate>[ peak_gb=<gb>]"`` for the full VJP (value_and_grad of a
+    """``"<rate>[ key=value...]"`` for the full VJP (value_and_grad of a
     gauge-loss route) on the active backend — the training-path throughput.
     ``depth`` switches to the deep CONUS-realistic topology (auto-selected
     engine)."""
@@ -165,7 +178,8 @@ def bench_grad(n: int, t_hours: int, depth: int | None = None) -> str:
         return route(network, channels, p, q_prime, gauges=gauges).runoff.mean()
 
     fn = jax.jit(jax.value_and_grad(loss))
-    return f"{_timed_rate(fn, params, n, t_hours)}{_peak_suffix()}"
+    compiled = fn.lower(params).compile()
+    return f"{_timed_rate(compiled, params, n, t_hours)}{_card_suffix(compiled)}"
 
 
 def bench_reference_cpu(n: int = 2048, t_hours: int = 24) -> float:
@@ -271,42 +285,59 @@ def _run_child(code: str, timeout: float, cpu_only: bool) -> tuple[str | None, s
     return (lines[-1] if lines else None), ""
 
 
-def _split_peak(val: str) -> tuple[str, float | None]:
-    """Strip the optional trailing `` peak_gb=<gb>`` token a bench child appends
-    (``_peak_suffix``); returns ``(rest, peak_gb | None)``."""
-    tokens = val.split()
-    peak = None
-    kept = []
-    for t in tokens:
-        if t.startswith("peak_gb="):
-            try:
-                peak = float(t[len("peak_gb="):])
-            except ValueError:
-                pass
+#: Card tokens a bench child may append (``_card_suffix``) -> record-field
+#: suffix in the parent's JSON.
+_CARD_TOKEN_FIELDS = {"flops": "flops", "bytes": "bytes_accessed", "collectives": "collectives"}
+
+
+def _split_tokens(val: str) -> tuple[str, dict]:
+    """Strip the trailing `` key=value`` tokens a bench child appends
+    (``_card_suffix``); returns ``(rest, tokens)`` with ``peak_gb``/``flops``/
+    ``bytes`` parsed as floats and ``collectives`` as its dict. Malformed
+    tokens are dropped (best-effort — the rate is the payload)."""
+    kept, toks = [], {}
+    for t in val.split():
+        key, sep, raw = t.partition("=")
+        if not sep or key not in ("peak_gb", *_CARD_TOKEN_FIELDS):
+            kept.append(t)
             continue
-        kept.append(t)
-    return " ".join(kept), peak
+        try:
+            toks[key] = json.loads(raw) if key == "collectives" else float(raw)
+        except (ValueError, json.JSONDecodeError):
+            pass
+    return " ".join(kept), toks
+
+
+def _store_card_tokens(out: dict, toks: dict, prefix: str = "") -> None:
+    """Record the card-derived fields of one phase (``flops``,
+    ``bytes_accessed``, ``collectives``; prefixed for non-headline phases)."""
+    for token, field in _CARD_TOKEN_FIELDS.items():
+        if token in toks:
+            out[f"{prefix}{field}"] = toks[token]
 
 
 def _record_float(out: dict, key: str, code: str, timeout: float, cpu_only: bool,
                   metric_key: str | None = None, metric: str | None = None,
-                  peak_key: str | None = None) -> None:
+                  peak_key: str | None = None, card_prefix: str | None = None) -> None:
     """Best-effort phase plumbing shared by the grad/deep/deep-grad extras: run
     the child, parse its last line as a float into ``out[key]`` (recording any
-    ``peak_gb=`` token under ``peak_key``), or record ``out[key + "_error"]`` —
-    never fatal to the headline record."""
+    ``peak_gb=`` token under ``peak_key`` and card tokens under
+    ``card_prefix``), or record ``out[key + "_error"]`` — never fatal to the
+    headline record."""
     val, err = _run_child(code, timeout, cpu_only)
     if val is None:
         out[key + "_error"] = err
         return
-    val, peak = _split_peak(val)
+    val, toks = _split_tokens(val)
     try:
         out[key] = round(float(val), 1)
     except ValueError:
         out[key + "_error"] = f"unparseable output: {val!r}"
         return
     if peak_key:
-        out[peak_key] = peak
+        out[peak_key] = toks.get("peak_gb")
+    if card_prefix is not None:
+        _store_card_tokens(out, toks, prefix=card_prefix)
     if metric_key and metric:
         out[metric_key] = metric
 
@@ -450,10 +481,11 @@ def main(argv: list[str] | None = None) -> None:
         if val is None:
             out["route_error"] += f"; CPU retry failed ({err})"
     if val is not None:
-        val, peak = _split_peak(val)
+        val, toks = _split_tokens(val)
         try:
             out["value"] = round(float(val), 1)
-            out["peak_hbm_gb"] = peak
+            out["peak_hbm_gb"] = toks.get("peak_gb")
+            _store_card_tokens(out, toks)
         except ValueError:
             # Append: a prior accelerator-failure diagnostic must survive.
             prior = out.get("route_error")
@@ -475,6 +507,7 @@ def main(argv: list[str] | None = None) -> None:
                 "gauge-loss route), same shapes and unit as the headline"
             ),
             peak_key="grad_peak_hbm_gb",
+            card_prefix="grad_",
         )
 
     # Phase 2c (best-effort): the deep CONUS-shaped topology — depth in the
@@ -496,8 +529,9 @@ def main(argv: list[str] | None = None) -> None:
         )
         if dval is not None:
             try:
-                dval, dpeak = _split_peak(dval)
-                out["deep_peak_hbm_gb"] = dpeak
+                dval, dtoks = _split_tokens(dval)
+                out["deep_peak_hbm_gb"] = dtoks.get("peak_gb")
+                _store_card_tokens(out, dtoks, prefix="deep_")
                 rate_str, _, engine = dval.partition(" ")
                 out["deep_value"] = round(float(rate_str), 1)
                 out["deep_metric"] = (
@@ -523,6 +557,7 @@ def main(argv: list[str] | None = None) -> None:
                     "same shapes as deep_metric"
                 ),
                 peak_key="deep_grad_peak_hbm_gb",
+                card_prefix="deep_grad_",
             )
 
         # Phase 2e (best-effort): the COMPLETE train step at the deep shape —

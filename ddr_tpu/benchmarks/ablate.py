@@ -140,8 +140,11 @@ def main() -> None:
     jax.block_until_ready(outs)
     dt = (time.perf_counter() - t0) / reps
     dev = jax.devices()[0]
-    stats = getattr(dev, "memory_stats", lambda: None)() or {}
-    peak = stats.get("peak_bytes_in_use")
+    from ddr_tpu.observability.costs import peak_bytes_or_envelope
+
+    # device memory_stats where reported (TPU), the compiled program's own
+    # envelope otherwise (CPU)
+    peak = peak_bytes_or_envelope(compiled, dev)
     if peak is not None:
         extra["peak_hbm_gb"] = round(peak / 2**30, 2)
     print(

@@ -30,10 +30,8 @@ def main() -> None:
 
     from ddr_tpu.geodatazoo.synthetic import make_basin, observe
     from ddr_tpu.nn.kan import Kan
-    from ddr_tpu.routing.chunked import ChunkedNetwork
     from ddr_tpu.routing.mc import Bounds
-    from ddr_tpu.routing.model import prepare_batch
-    from ddr_tpu.routing.stacked import StackedChunked
+    from ddr_tpu.routing.model import engine_label, prepare_batch
     from ddr_tpu.training import make_batch_train_step, make_optimizer
     from ddr_tpu.validation.configs import Config
 
@@ -59,14 +57,7 @@ def main() -> None:
     )
     rd = basin.routing_data
     network, channels, gauges = prepare_batch(rd, cfg.params.attribute_minimums["slope"])
-    if isinstance(network, StackedChunked):
-        engine = f"stacked-chunked-wavefront[{network.n_chunks}-band-scan]"
-    elif isinstance(network, ChunkedNetwork):
-        engine = f"depth-chunked-wavefront[{network.n_chunks}-band]"
-    elif getattr(network, "wavefront", False):
-        engine = "single-ring-wavefront"
-    else:
-        engine = "step"
+    engine = engine_label(network)
 
     kan_model = Kan(
         input_var_names=tuple(cfg.kan.input_var_names),
@@ -94,11 +85,16 @@ def main() -> None:
     mask = jnp.ones_like(obs, dtype=bool)
     q_prime = jnp.asarray(basin.q_prime[:t_hours])
 
-    call = lambda p, o: step(p, o, network, channels, gauges, attrs, q_prime, obs, mask)  # noqa: E731
+    # TRUE compile time via AOT lowering (the ablate.py discipline); the same
+    # handle supplies the CPU peak-memory fallback below.
     t0 = time.perf_counter()
+    compiled = step.lower(
+        params, opt_state, network, channels, gauges, attrs, q_prime, obs, mask
+    ).compile()
+    compile_s = time.perf_counter() - t0
+    call = lambda p, o: compiled(p, o, network, channels, gauges, attrs, q_prime, obs, mask)  # noqa: E731
     p1, o1, loss, _ = call(params, opt_state)
     jax.block_until_ready(loss)
-    compile_s = time.perf_counter() - t0
     # timed reps: queue then block once (axon poll latency is device-idle time).
     # Rebind state through every call — the step DONATES params/opt_state
     # (training._make_step), so the donated inputs are dead after each call.
@@ -116,8 +112,11 @@ def main() -> None:
     dt = (time.perf_counter() - t0) / reps
 
     dev = jax.devices()[0]
-    stats = getattr(dev, "memory_stats", lambda: None)() or {}
-    peak = stats.get("peak_bytes_in_use")
+    from ddr_tpu.observability.costs import peak_bytes_or_envelope
+
+    # device memory_stats where reported (TPU), the compiled program's own
+    # envelope otherwise (CPU)
+    peak = peak_bytes_or_envelope(compiled, dev)
     print(
         json.dumps(
             {

@@ -20,6 +20,7 @@ _COMMANDS = {
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",
     "metrics": "ddr_tpu.observability.metrics_cli",
+    "profile": "ddr_tpu.scripts.profile",
     "gen-config-docs": "ddr_tpu.scripts.gen_config_docs",
     "sweep": "ddr_tpu.scripts.sweep",
 }

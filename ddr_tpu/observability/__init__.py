@@ -7,12 +7,22 @@ jax is consulted lazily and only when already loaded. See docs/observability.md
 for the event schema, the live-metrics endpoint table, and worked examples.
 """
 
+from ddr_tpu.observability.costs import (
+    COLLECTIVE_OPS,
+    ProgramCard,
+    build_card,
+    card_from_compiled,
+    cards_enabled,
+    collective_counts,
+    emit_program_card,
+)
 from ddr_tpu.observability.events import (
     EVENT_TYPES,
     Recorder,
     activate,
     deactivate,
     device_memory_stats,
+    device_peak_bytes,
     emit_heartbeat,
     flush_every_from_env,
     get_recorder,
@@ -21,6 +31,7 @@ from ddr_tpu.observability.events import (
     run_telemetry,
 )
 from ddr_tpu.observability.health import HealthConfig, HealthStats, HealthWatchdog
+from ddr_tpu.observability.phases import STEP_PHASES, PhaseTimer, summarize_phases
 from ddr_tpu.observability.prometheus import (
     event_tee,
     maybe_start_exporter_from_env,
@@ -50,9 +61,20 @@ __all__ = [
     "metrics_dir_from_env",
     "flush_every_from_env",
     "device_memory_stats",
+    "device_peak_bytes",
     "emit_heartbeat",
     "host_layout",
     "CompileTracker",
+    "COLLECTIVE_OPS",
+    "ProgramCard",
+    "build_card",
+    "card_from_compiled",
+    "cards_enabled",
+    "collective_counts",
+    "emit_program_card",
+    "STEP_PHASES",
+    "PhaseTimer",
+    "summarize_phases",
     "span",
     "spanned",
     "trace",

@@ -1,0 +1,396 @@
+"""Compiled-program cost attribution: ProgramCards from XLA's own analyses.
+
+Telemetry so far says *when* compiles happen (``compile`` events) and *how
+fast* steps run (``step`` events); nothing says what a compiled program
+actually costs. This module closes that gap with one artifact per compiled
+XLA program — a :class:`ProgramCard` — built from the AOT handle
+(``jitted.lower(*args).compile()``) and carrying:
+
+- ``cost_analysis()``: FLOPs, bytes accessed, transcendentals — the
+  roofline-model numerator/denominator (arithmetic intensity = flops /
+  bytes accessed; achieved FLOP/s = flops / measured seconds);
+- ``memory_analysis()``: argument / output / temp / generated-code bytes and
+  the derived peak estimate — the HBM envelope, available even on CPU where
+  ``device.memory_stats()`` reports nothing;
+- :func:`collective_counts`: all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all instruction counts parsed from the
+  compiled HLO text (the reusable form of the multichip dryrun's ad-hoc
+  substring probe);
+- input shapes/dtypes with their donation flags, and the compile wall time.
+
+Cards are emitted as ``program_card`` JSONL events alongside ``compile``
+events (``CompileTracker`` wiring), summarized by ``ddr metrics summarize``'s
+per-program cost table, attached to serving's ``models_info``, and written as
+reports by ``ddr profile``.
+
+**Cost note.** jax's dispatch-path compile cache and the AOT path do not
+share executables in this jax version, so building a card for a program that
+was (or will be) compiled implicitly by ``jax.jit`` pays one extra backend
+compile. That is why card emission in the training loops is gated by
+:func:`cards_enabled` (``DDR_PROGRAM_CARDS=0`` opts out) and fires once per
+distinct program; flows that control compilation (``ddr profile``, serving
+warmup) build through :func:`build_card` and RUN the returned executable, so
+they pay nothing extra. With ``DDR_COMPILE_CACHE_DIR`` set the duplicate
+backend compile replays from the persistent cache.
+
+Importable without jax (package contract — bench.py's parent): jax is
+imported inside the card builders only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import time
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "COLLECTIVE_OPS",
+    "ProgramCard",
+    "collective_counts",
+    "card_from_compiled",
+    "build_card",
+    "emit_program_card",
+    "cards_enabled",
+    "peak_bytes_or_envelope",
+]
+
+#: The collective-communication HLO opcodes a sharded routing program can
+#: contain (the set the multichip dryrun has always probed for).
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# One regex per opcode, matching the *instruction* position only: HLO renders
+# an op as `%name = <shape> <opcode>(operands...)`, so requiring the trailing
+# `(` skips the `%all-reduce.3` value names the compiler hands out, and the
+# optional `-start` counts each async pair (start/done) exactly once.
+_COLLECTIVE_RES = {
+    op: re.compile(rf"(?<![\w-]){re.escape(op)}(?:-start)?\(") for op in COLLECTIVE_OPS
+}
+
+
+def cards_enabled() -> bool:
+    """``DDR_PROGRAM_CARDS`` gate for *implicit-jit* card building (default
+    on). The training loops consult it before paying the duplicate AOT
+    compile a card costs there; explicit flows (``ddr profile``, serving
+    warmup) ignore it — their card is free."""
+    return os.environ.get("DDR_PROGRAM_CARDS", "1").strip().lower() not in (
+        "0",
+        "false",
+        "no",
+        "off",
+    )
+
+
+def collective_counts(compiled: Any) -> dict[str, int]:
+    """Collective-instruction counts from a compiled program (or raw HLO text).
+
+    Accepts an AOT ``Compiled`` handle (``jitted.lower(...).compile()``) or
+    the string ``as_text()`` already produced. Counts *instructions* at their
+    opcode position — value names like ``%all-reduce.3`` don't count, and an
+    async ``-start``/``-done`` pair counts once — so the numbers mean "how
+    many collectives does one execution launch", not "how often does the
+    substring appear".
+    """
+    text = compiled if isinstance(compiled, str) else compiled.as_text()
+    return {op: len(rx.findall(text)) for op, rx in _COLLECTIVE_RES.items()}
+
+
+def _flatten_cost(analysis: Any) -> dict[str, float]:
+    """``Compiled.cost_analysis()`` -> one flat dict (jax returns a
+    one-element list of dicts on some versions/backends)."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis or {})
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramCard:
+    """One compiled XLA program's cost/memory/collective profile.
+
+    Every field is best-effort ``None``-able: backends differ in what they
+    report, and a card with holes beats no card. Byte fields come from
+    ``memory_analysis()``; ``peak_bytes`` is XLA's temp allocation plus live
+    arguments/outputs/code minus aliased (donated) bytes — the program's
+    device-memory envelope, which on CPU is the only peak figure available at
+    all (``memory_stats()`` is empty there).
+    """
+
+    name: str
+    engine: str | None = None
+    platform: str | None = None
+    # cost_analysis()
+    flops: float | None = None
+    transcendentals: float | None = None
+    bytes_accessed: float | None = None
+    # memory_analysis()
+    argument_bytes: int | None = None
+    output_bytes: int | None = None
+    temp_bytes: int | None = None
+    alias_bytes: int | None = None
+    generated_code_bytes: int | None = None
+    peak_bytes: int | None = None
+    # compiled-HLO collective mix
+    collectives: dict[str, int] = dataclasses.field(default_factory=dict)
+    # input signature: "f32[48,2048]"-style specs, donation flag per arg
+    input_specs: tuple[str, ...] = ()
+    donated: tuple[bool, ...] = ()
+    compile_seconds: float | None = None
+
+    # ---- derived ----
+
+    @property
+    def arithmetic_intensity(self) -> float | None:
+        """FLOPs per byte accessed — the roofline x-coordinate."""
+        if not self.flops or not self.bytes_accessed:
+            return None
+        return self.flops / self.bytes_accessed
+
+    @property
+    def n_collectives(self) -> int:
+        return sum(self.collectives.values())
+
+    @property
+    def peak_gb(self) -> float | None:
+        return None if self.peak_bytes is None else self.peak_bytes / 2**30
+
+    def achieved_flops(self, seconds: float) -> float | None:
+        """FLOP/s at a measured per-execution wall time (compare against the
+        device's theoretical peak for roofline placement)."""
+        if not self.flops or seconds <= 0:
+            return None
+        return self.flops / seconds
+
+    # ---- (de)serialization ----
+
+    def to_dict(self) -> dict[str, Any]:
+        """JSON-ready dict (the ``program_card`` event payload / report row).
+        Derived fields ride along for grep-ability; ``from_dict`` ignores
+        them."""
+        d = dataclasses.asdict(self)
+        d["input_specs"] = list(self.input_specs)
+        d["donated"] = list(self.donated)
+        d["arithmetic_intensity"] = (
+            None
+            if self.arithmetic_intensity is None
+            else round(self.arithmetic_intensity, 4)
+        )
+        d["n_collectives"] = self.n_collectives
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ProgramCard":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        kw["input_specs"] = tuple(kw.get("input_specs") or ())
+        kw["donated"] = tuple(bool(b) for b in (kw.get("donated") or ()))
+        kw["collectives"] = {
+            str(k): int(v) for k, v in (kw.get("collectives") or {}).items()
+        }
+        return cls(**kw)
+
+    def brief(self) -> dict[str, Any]:
+        """The compact slice servings/stats payloads embed: enough for a
+        dashboard row without the full input signature."""
+        return {
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "arithmetic_intensity": (
+                None
+                if self.arithmetic_intensity is None
+                else round(self.arithmetic_intensity, 4)
+            ),
+            "peak_bytes": self.peak_bytes,
+            "collectives": dict(self.collectives),
+            "compile_seconds": self.compile_seconds,
+        }
+
+
+def _memory_fields(mem: Any) -> dict[str, int | None]:
+    """``memory_analysis()`` object -> the card's byte fields plus the derived
+    ``peak_bytes`` envelope (temps plus live arguments/outputs/code, minus the
+    donated/aliased bytes counted on both sides). Tolerates None / missing
+    attributes (backend differences)."""
+
+    def _mem(attr: str) -> int | None:
+        v = getattr(mem, attr, None)
+        return None if v is None else int(v)
+
+    arg_b, out_b = _mem("argument_size_in_bytes"), _mem("output_size_in_bytes")
+    tmp_b, alias_b = _mem("temp_size_in_bytes"), _mem("alias_size_in_bytes")
+    code_b = _mem("generated_code_size_in_bytes")
+    peak = None
+    if tmp_b is not None:
+        peak = tmp_b + (arg_b or 0) + (out_b or 0) + (code_b or 0) - (alias_b or 0)
+    return {
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": tmp_b,
+        "alias_bytes": alias_b,
+        "generated_code_bytes": code_b,
+        "peak_bytes": peak,
+    }
+
+
+def _aval_spec(aval: Any) -> str:
+    """``f32[48,2048]``-style spec from a ShapedArray-like object."""
+    try:
+        dtype = aval.dtype
+        short = getattr(dtype, "name", str(dtype))
+        short = (
+            short.replace("float", "f").replace("uint", "u").replace("int", "i")
+            .replace("complex", "c").replace("bool", "pred")
+        )
+        return f"{short}[{','.join(str(d) for d in aval.shape)}]"
+    except Exception:
+        return str(aval)
+
+
+def card_from_compiled(
+    compiled: Any,
+    name: str,
+    engine: str | None = None,
+    compile_seconds: float | None = None,
+) -> ProgramCard:
+    """Build a :class:`ProgramCard` from an AOT ``Compiled`` handle.
+
+    Every probe is individually best-effort: a backend that lacks one
+    analysis yields ``None`` fields, never an exception — cost attribution is
+    observability and must not take the program down.
+    """
+    import jax
+
+    cost: dict[str, float] = {}
+    try:
+        cost = _flatten_cost(compiled.cost_analysis())
+    except Exception:
+        log.debug(f"cost_analysis unavailable for {name}", exc_info=True)
+    mem = None
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        log.debug(f"memory_analysis unavailable for {name}", exc_info=True)
+    collectives: dict[str, int] = {}
+    try:
+        collectives = collective_counts(compiled)
+    except Exception:
+        log.debug(f"HLO text unavailable for {name}", exc_info=True)
+    input_specs: tuple[str, ...] = ()
+    donated: tuple[bool, ...] = ()
+    try:
+        # ArgInfo is itself a (leafless) pytree node, so a plain tree_leaves
+        # flattens it away — stop at anything carrying a donation flag
+        args_flat = jax.tree_util.tree_leaves(
+            compiled.args_info, is_leaf=lambda a: hasattr(a, "donated")
+        )
+        input_specs = tuple(
+            _aval_spec(getattr(a, "aval", getattr(a, "_aval", a))) for a in args_flat
+        )
+        donated = tuple(bool(a.donated) for a in args_flat)
+    except Exception:
+        log.debug(f"args_info unavailable for {name}", exc_info=True)
+
+    m = _memory_fields(mem)
+    try:
+        platform = str(jax.devices()[0].platform)
+    except Exception:
+        platform = None
+
+    def _cost(key: str) -> float | None:
+        v = cost.get(key)
+        return None if v is None or v < 0 else float(v)
+
+    return ProgramCard(
+        name=name,
+        engine=engine,
+        platform=platform,
+        flops=_cost("flops"),
+        transcendentals=_cost("transcendentals"),
+        bytes_accessed=_cost("bytes accessed"),
+        argument_bytes=m["argument_bytes"],
+        output_bytes=m["output_bytes"],
+        temp_bytes=m["temp_bytes"],
+        alias_bytes=m["alias_bytes"],
+        generated_code_bytes=m["generated_code_bytes"],
+        peak_bytes=m["peak_bytes"],
+        collectives=collectives,
+        input_specs=input_specs,
+        donated=donated,
+        compile_seconds=compile_seconds,
+    )
+
+
+def build_card(
+    fn: Callable,
+    *args: Any,
+    name: str,
+    engine: str | None = None,
+    **kwargs: Any,
+) -> tuple[ProgramCard, Any]:
+    """AOT-compile a jitted callable for ``args`` and card it.
+
+    Returns ``(card, compiled)`` — callers that control the execution flow
+    (``ddr profile``, serving warmup) should RUN the returned executable so
+    the compile is paid once; post-hoc callers (the train loops' per-miss
+    wiring) drop it and eat the duplicate compile (see the module docstring's
+    cost note). ``args``/``kwargs`` may mix concrete arrays with
+    ``jax.ShapeDtypeStruct`` placeholders — only avals are read.
+    """
+    lowered = fn.lower(*args, **kwargs)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    seconds = time.perf_counter() - t0
+    card = card_from_compiled(
+        compiled, name=name, engine=engine, compile_seconds=round(seconds, 4)
+    )
+    return card, compiled
+
+
+def peak_bytes_or_envelope(
+    compiled: Any = None, device: Any = None, card: ProgramCard | None = None
+) -> int | None:
+    """THE peak-device-memory policy every bench harness shares: the backend's
+    ``peak_bytes_in_use`` where it reports one (TPU), else the compiled
+    program's ``memory_analysis()`` envelope (so CPU rounds stop recording
+    null). Pass a prebuilt ``card`` to reuse its fields; with only
+    ``compiled``, just ``memory_analysis()`` runs — not the full card build
+    (the HLO text dump alone is huge for continental-scale programs). Returns
+    None only when no source has an answer."""
+    from ddr_tpu.observability.events import device_peak_bytes
+
+    peak = device_peak_bytes(device)
+    if peak is not None:
+        return peak
+    if card is not None:
+        return card.peak_bytes
+    if compiled is None:
+        return None
+    try:
+        return _memory_fields(compiled.memory_analysis())["peak_bytes"]
+    except Exception:
+        return None
+
+
+def emit_program_card(card: ProgramCard, key: str | None = None, rec: Any = None) -> None:
+    """Emit one ``program_card`` event for ``card`` to ``rec`` or the active
+    recorder (silent no-op with neither). ``key`` is the batch-topology hash
+    so the card joins its ``compile`` event in the run log."""
+    if rec is None:
+        from ddr_tpu.observability.events import get_recorder
+
+        rec = get_recorder()
+    if rec is None:
+        return
+    payload = card.to_dict()
+    if key is not None:
+        payload["key"] = key
+    rec.emit("program_card", **payload)

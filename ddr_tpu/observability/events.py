@@ -52,6 +52,7 @@ __all__ = [
     "metrics_dir_from_env",
     "flush_every_from_env",
     "device_memory_stats",
+    "device_peak_bytes",
     "emit_heartbeat",
     "host_layout",
 ]
@@ -63,7 +64,11 @@ __all__ = [
 #: ``serve_request``/``serve_batch``/``serve_shed`` are the forecast-serving
 #: layer's admit/batch/shed decisions (:mod:`ddr_tpu.serving`); ``health`` is
 #: one numerical-health watchdog violation
-#: (:mod:`ddr_tpu.observability.health`).
+#: (:mod:`ddr_tpu.observability.health`); ``program_card`` is one compiled
+#: program's cost/memory/collective profile
+#: (:mod:`ddr_tpu.observability.costs`), emitted alongside its ``compile``
+#: event. ``step`` events may additionally carry a ``phases`` dict (step-phase
+#: wallclock decomposition, :mod:`ddr_tpu.observability.phases`).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -76,6 +81,7 @@ EVENT_TYPES = (
     "serve_batch",
     "serve_shed",
     "health",
+    "program_card",
 )
 
 
@@ -419,6 +425,28 @@ def device_memory_stats(max_devices: int = 8) -> list[dict[str, Any]]:
                 entry[k] = int(stats[k])
         out.append(entry)
     return out
+
+
+def device_peak_bytes(device: Any = None) -> int | None:
+    """``peak_bytes_in_use`` of one device, or None where the backend reports
+    no memory stats (CPU) — THE peak-HBM probe bench.py / ablate / trainbench
+    share (each used to hand-roll it). ``device=None`` reads the first device
+    of an already-imported jax; jax is never imported here (package
+    contract)."""
+    if device is None:
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        try:
+            device = jax.devices()[0]
+        except Exception:
+            return None
+    try:
+        stats = getattr(device, "memory_stats", lambda: None)() or {}
+    except Exception:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return None if peak is None else int(peak)
 
 
 def emit_heartbeat(rec: Recorder | None = None, **payload: Any) -> None:

@@ -4,8 +4,10 @@ Reads the event stream written by :mod:`ddr_tpu.observability.events`
 (``run_log.<cmd>.jsonl`` plus any per-host sidecars) and renders it for humans:
 
 - ``summarize <log-or-dir>``: run header, steps/sec, reach-timesteps/sec,
-  compile counts per engine, a sampled loss curve, serving latency
-  percentiles, numerical-health violations, per-span time breakdown,
+  compile counts per engine, a "Where time went" step-phase breakdown, a
+  per-program cost table (``program_card`` events: FLOPs, bytes, arithmetic
+  intensity, peak memory, collectives), a sampled loss curve, serving
+  latency percentiles, numerical-health violations, per-span time breakdown,
   per-host heartbeat liveness;
 - ``tail <log-or-dir> [-n N]``: the last N events, one compact line each.
 
@@ -133,6 +135,8 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
             w(f"loss     : first {_fmt(losses[0])} -> last {_fmt(losses[-1])} (min {_fmt(min(losses))})\n")
             w(f"loss curve: {pts}\n")
 
+    _summarize_phases(by_type, w)
+    _summarize_program_cards(by_type, w)
     _summarize_serving(by_type, w)
     _summarize_health(by_type, end, w)
 
@@ -190,6 +194,60 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
         ]
         w("spans (by total time):\n" + _table(rows, ["span", "count", "total_s", "mean_ms"]) + "\n")
     return 0
+
+
+def _summarize_phases(by_type: dict[str, list[dict]], w) -> None:
+    """"Where time went": per-phase totals/percentages aggregated from the
+    ``phases`` dicts riding ``step`` events (observability.phases). Shares are
+    of the summed phase time — prefetch phases overlap the device step, so
+    they don't sum to wall time."""
+    from ddr_tpu.observability.phases import summarize_phases
+
+    agg = summarize_phases(by_type.get("step", []))
+    if not agg:
+        return
+    rows = [
+        [name, f"{100 * v['share']:.1f}%", f"{v['seconds']:.4f}",
+         f"{1e3 * v['seconds'] / v['count']:.2f}" if v["count"] else "-"]
+        for name, v in agg.items()
+    ]
+    w("where time went (step phases, % of phase time):\n")
+    w(_table(rows, ["phase", "share", "total_s", "mean_ms"]) + "\n")
+
+
+def _summarize_program_cards(by_type: dict[str, list[dict]], w) -> None:
+    """The per-program cost table from ``program_card`` events
+    (observability.costs): one row per distinct (name, engine, key), last
+    card wins — FLOPs, bytes accessed, arithmetic intensity, peak memory,
+    collective count."""
+    cards = by_type.get("program_card", [])
+    if not cards:
+        return
+    latest: dict[tuple, dict] = {}
+    for e in cards:
+        latest[(str(e.get("name", "?")), str(e.get("engine") or "-"), e.get("key"))] = e
+    rows = []
+    for (name, engine, key), e in sorted(latest.items(), key=lambda kv: [str(p) for p in kv[0]]):
+        flops = e.get("flops")
+        bytes_acc = e.get("bytes_accessed")
+        ai = e.get("arithmetic_intensity")
+        peak = e.get("peak_bytes")
+        rows.append([
+            name,
+            engine,
+            # the topology-hash short form distinguishes K same-named programs
+            # (one 'train-step' per distinct batch topology)
+            str(key)[:8] if key else "-",
+            _fmt(float(flops)) if flops is not None else "-",
+            _fmt(float(bytes_acc)) if bytes_acc is not None else "-",
+            f"{float(ai):.3g}" if ai is not None else "-",
+            f"{float(peak) / 2**20:,.1f}" if peak is not None else "-",
+            str(e.get("n_collectives", sum((e.get("collectives") or {}).values()))),
+            f"{float(e['compile_seconds']):.2f}" if e.get("compile_seconds") is not None else "-",
+        ])
+    w(f"programs : {len(cards)} card events, {len(latest)} distinct programs\n")
+    w(_table(rows, ["program", "engine", "key", "flops", "bytes", "fl/B",
+                    "peak_MB", "coll", "compile_s"]) + "\n")
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:

@@ -1,0 +1,114 @@
+"""Step-phase wallclock decomposition for the training/eval loops.
+
+A ``step`` event says how long the synchronized device step took; it says
+nothing about the rest of the loop iteration — data loading, host-side batch
+preparation, post-step evaluation/plotting, checkpointing. When a run is
+slow, the first question is *which* of those buckets grew, and the answer
+should come from the run log, not from re-instrumenting.
+
+:class:`PhaseTimer` is the one primitive: the loop brackets each region with
+``timer.phase("data_load", into=step_phases)`` and attaches the per-step
+``step_phases`` dict to its ``step`` event (rendered by ``ddr metrics
+summarize``'s "Where time went" section); the timer also accumulates run
+totals for the ``run_end`` summary. The Prometheus tee maps the per-step
+dict into the ``ddr_phase_seconds{phase=...}`` histogram, so live dashboards
+see the same decomposition.
+
+Phases measured in a prefetch thread (data-load / host-prep run one batch
+ahead in ``ddr train``) overlap the device step by design — the decomposition
+is "where wall time went per bucket", not a non-overlapping timeline; a
+bucket whose total approaches the run duration is the bottleneck either way.
+
+Stdlib-only and jax-free (package contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+__all__ = ["STEP_PHASES", "PhaseTimer", "summarize_phases"]
+
+#: The canonical loop buckets (a timer accepts any name; these are the ones
+#: the train loop emits and the docs table explains).
+STEP_PHASES = ("data_load", "host_prep", "device_step", "eval", "checkpoint")
+
+
+class PhaseTimer:
+    """Accumulates per-phase wall time, per step and per run.
+
+    Thread-safe: the prefetch thread times data-load/host-prep while the main
+    thread times the device step. Per-step dicts are plain caller-owned dicts
+    (each batch carries its own), so concurrent steps never race on them.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._totals: dict[str, list[float]] = {}  # name -> [count, seconds]
+
+    @contextmanager
+    def phase(self, name: str, into: dict[str, float] | None = None) -> Iterator[None]:
+        """Time a region; add its seconds to the run totals and (when given)
+        to the caller's per-step ``into`` dict. Exception-safe."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                agg = self._totals.setdefault(name, [0, 0.0])
+                agg[0] += 1
+                agg[1] += dt
+            if into is not None:
+                into[name] = round(into.get(name, 0.0) + dt, 6)
+
+    def totals(self) -> dict[str, dict[str, float]]:
+        """``{phase: {count, seconds}}`` run totals so far."""
+        with self._lock:
+            return {
+                k: {"count": int(c), "seconds": round(s, 6)}
+                for k, (c, s) in sorted(self._totals.items())
+            }
+
+    def summary(self) -> dict[str, Any]:
+        """The ``run_end`` rollup: totals plus each phase's share of the summed
+        phase time (not of wall time — prefetch phases overlap the step)."""
+        totals = self.totals()
+        denom = sum(v["seconds"] for v in totals.values())
+        return {
+            "phases": totals,
+            "shares": {
+                k: round(v["seconds"] / denom, 4) if denom > 0 else 0.0
+                for k, v in totals.items()
+            },
+        }
+
+
+def summarize_phases(step_events: list[dict]) -> dict[str, dict[str, float]]:
+    """Aggregate the ``phases`` dicts attached to ``step`` events into
+    ``{phase: {count, seconds, share}}`` — the "Where time went" table's data
+    (shared by ``ddr metrics summarize`` and its tests)."""
+    agg: dict[str, list[float]] = {}
+    for e in step_events:
+        phases = e.get("phases")
+        if not isinstance(phases, dict):
+            continue
+        for name, seconds in phases.items():
+            try:
+                s = float(seconds)
+            except (TypeError, ValueError):
+                continue
+            a = agg.setdefault(str(name), [0, 0.0])
+            a[0] += 1
+            a[1] += s
+    denom = sum(s for _, s in agg.values())
+    return {
+        name: {
+            "count": int(c),
+            "seconds": round(s, 6),
+            "share": round(s / denom, 4) if denom > 0 else 0.0,
+        }
+        for name, (c, s) in sorted(agg.items(), key=lambda kv: -kv[1][1])
+    }

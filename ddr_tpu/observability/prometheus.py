@@ -193,6 +193,21 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
             r.gauge("ddr_loss", "Loss of the most recent training step").set(
                 _get(record, "loss", math.nan)
             )
+        phases = record.get("phases")
+        if isinstance(phases, dict):
+            # step-phase wallclock decomposition (observability.phases) — the
+            # live "where is the loop spending time" view
+            hist = r.histogram(
+                "ddr_phase_seconds",
+                "Per-step wall time by loop phase (data_load/host_prep/"
+                "device_step/eval/checkpoint)",
+                labels=("phase",),
+            )
+            for phase, seconds in phases.items():
+                try:
+                    hist.observe(float(seconds), phase=str(phase))
+                except (TypeError, ValueError):
+                    continue
     elif event == "eval":
         r.counter("ddr_evals_total", "Inference batches").inc()
     elif event == "compile":

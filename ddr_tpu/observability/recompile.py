@@ -55,11 +55,15 @@ class CompileTracker:
         key: str | None = None,
         seconds: float = 0.0,
         cache_entries: int | None = None,
+        card: Any = None,
         **tags: Any,
     ) -> None:
         """Count a miss and emit its ``compile`` event (``key`` is the batch
         topology hash, so auto-engine decisions and recompile storms are
-        auditable per topology)."""
+        auditable per topology). ``card`` (a
+        :class:`~ddr_tpu.observability.costs.ProgramCard`) additionally emits
+        the matching ``program_card`` event — the miss's cost attribution
+        rides the same key."""
         with self._lock:
             eng = self._eng(engine)
             eng["misses"] += 1
@@ -77,13 +81,28 @@ class CompileTracker:
                 misses=misses,
                 **tags,
             )
+            if card is not None:
+                from ddr_tpu.observability.costs import emit_program_card
+
+                emit_program_card(card, key=key, rec=rec)
 
     def track_jit(
-        self, engine: str, fn: Callable, key: str | None = None, **tags: Any
+        self,
+        engine: str,
+        fn: Callable,
+        key: str | None = None,
+        card_builder: Callable[[], Any] | None = None,
+        **tags: Any,
     ) -> None:
         """Poll a jitted callable's compile-cache size; growth counts (and
         emits) a miss, a steady size counts a hit. Silently does nothing when
-        the jax version doesn't expose ``_cache_size``."""
+        the jax version doesn't expose ``_cache_size``.
+
+        ``card_builder`` (zero-arg, returns a ProgramCard or None) is invoked
+        ONLY when a miss was detected, a recorder is active, and
+        ``DDR_PROGRAM_CARDS`` hasn't opted out — it typically AOT-recompiles
+        the just-missed program (the costs.py docstring's cost note), so the
+        gate matters. A raising builder is logged, never fatal."""
         try:
             size = int(fn._cache_size())
         except Exception:
@@ -92,7 +111,19 @@ class CompileTracker:
             prev = self._jit_sizes.get(engine)
             self._jit_sizes[engine] = size
         if prev is None or size > prev:
-            self.miss(engine, key=key, cache_entries=size, source="jit-cache", **tags)
+            card = None
+            if card_builder is not None and get_recorder() is not None:
+                from ddr_tpu.observability.costs import cards_enabled
+
+                if cards_enabled():
+                    try:
+                        card = card_builder()
+                    except Exception:
+                        log.exception(f"program-card build failed for {engine}")
+            self.miss(
+                engine, key=key, cache_entries=size, source="jit-cache",
+                card=card, **tags,
+            )
         else:
             self.hit(engine, key=key)
 

@@ -206,6 +206,9 @@ class PreparedBatch:
     # batch-topology hash (the step-cache key) — carried so compile events can
     # name the topology that triggered a jit-cache miss
     topo_key: str | None = None
+    # True when this batch's step was freshly built (LRU miss) — step() builds
+    # and emits the program's cost card exactly for these batches
+    cache_miss: bool = False
 
 
 class ParallelTrainer:
@@ -267,6 +270,10 @@ class ParallelTrainer:
         self._gspmd_step_cached = None
         self._auto_logged: set[str] = set()
         self._auto_modes: dict[str, str] = {}
+        # Per-(engine, topo_key) ProgramCards: built once per distinct program
+        # (the AOT rebuild a card costs — costs.py's cost note), re-emitted on
+        # LRU-eviction rebuilds so every `compile` event has its card.
+        self._cards: dict[tuple[str, str | None], Any] = {}
         log.info(
             f"multi-chip training: parallel={mode} over {self.n_shards} devices "
             f"({self.platform})"
@@ -286,14 +293,18 @@ class ParallelTrainer:
             )
         return self._gspmd_step_cached
 
-    def _cached_step(self, key: str, build: Callable[[], Callable], engine: str) -> Callable:
+    def _cached_step(
+        self, key: str, build: Callable[[], Callable], engine: str
+    ) -> tuple[Callable, bool]:
         """LRU lookup/insert for built sharded steps, hit/miss-tracked per
-        engine (a miss emits a ``compile`` event keyed by the topology hash)."""
+        engine (a miss emits a ``compile`` event keyed by the topology hash).
+        Returns ``(step, missed)`` — :meth:`step` emits the program's cost
+        card for missed batches, where the call-time arguments exist."""
         step = self._step_cache.get(key)
         if step is not None:
             self._step_cache.move_to_end(key)
             self.compile_tracker.hit(engine, key)
-            return step
+            return step, False
         t0 = time.perf_counter()
         step = build()
         self._step_cache[key] = step
@@ -306,7 +317,7 @@ class ParallelTrainer:
             cache_entries=len(self._step_cache),
             **({"via": "auto"} if self.mode == "auto" else {}),
         )
-        return step
+        return step, True
 
     # ---- host-side batch preparation (prefetch-thread safe) ----
 
@@ -376,7 +387,7 @@ class ParallelTrainer:
                 )
 
             key = _batch_key(rd)
-            step = self._cached_step(key, _build_stacked, engine=mode)
+            step, missed = self._cached_step(key, _build_stacked, engine=mode)
             return PreparedBatch(
                 mode=mode,
                 attrs=jnp.asarray(rd.normalized_spatial_attributes),
@@ -384,6 +395,7 @@ class ParallelTrainer:
                 n_timesteps=T,
                 step_fn=step,
                 topo_key=key,
+                cache_miss=missed,
             )
 
         # Both remaining modes share the pad -> zero-pad q' -> partition ->
@@ -422,7 +434,7 @@ class ParallelTrainer:
                 )
 
             key = _batch_key(rd_p)
-            step = self._cached_step(key, _build_wavefront, engine=mode)
+            step, missed = self._cached_step(key, _build_wavefront, engine=mode)
             return PreparedBatch(
                 mode=mode,
                 attrs=jnp.asarray(rd_p.normalized_spatial_attributes),
@@ -430,6 +442,7 @@ class ParallelTrainer:
                 n_timesteps=T,
                 step_fn=step,
                 topo_key=key,
+                cache_miss=missed,
             )
 
         # gspmd — NamedSharding device_put requires the reach axis divisible by
@@ -478,7 +491,7 @@ class ParallelTrainer:
         obs_mask = jnp.asarray(obs_mask)
         with self.mesh, span(f"step-{prep.mode}"):
             if prep.mode == "gspmd":
-                out = self._gspmd_step(
+                return self._gspmd_step(
                     params,
                     opt_state,
                     prep.network,
@@ -489,12 +502,60 @@ class ParallelTrainer:
                     obs_daily,
                     obs_mask,
                 )
-                # the one shared gspmd jit recompiles per network shape — poll
-                # its compile cache so those misses land in the run log too
-                self.compile_tracker.track_jit(
-                    "gspmd", self._gspmd_step_cached, key=prep.topo_key
-                )
-                return out
             return prep.step_fn(
                 params, opt_state, prep.attrs, prep.q_prime, obs_daily, obs_mask
             )
+
+    def record_compiles(self, prep: PreparedBatch, params, opt_state, obs_daily, obs_mask) -> None:
+        """Post-step compile accounting + program-card emission. The training
+        loop calls this AFTER its step timing brackets close (exactly like the
+        single-device path's ``track_jit`` placement) — the card's duplicate
+        AOT compile must never land in the step's reported seconds.
+
+        gspmd: poll the one shared jit's compile cache (growth = miss) with a
+        card builder; ``lower()`` reads avals only, so the donated-and-consumed
+        params/opt_state are fine to pass. Explicit engines: the LRU miss was
+        already counted at build time in :meth:`prepare` — emit the matching
+        card here (built once per distinct program, re-emitted on
+        LRU-eviction rebuilds)."""
+        if prep.mode == "gspmd":
+            def _card():
+                from ddr_tpu.observability.costs import build_card
+
+                with self.mesh:
+                    return build_card(
+                        self._gspmd_step_cached, params, opt_state, prep.network,
+                        prep.channels, prep.gauges, prep.attrs, prep.q_prime,
+                        obs_daily, obs_mask,
+                        name="train-step", engine="gspmd",
+                    )[0]
+
+            self.compile_tracker.track_jit(
+                "gspmd", self._gspmd_step_cached, key=prep.topo_key,
+                card_builder=_card,
+            )
+        elif prep.cache_miss:
+            self._emit_card(prep, params, opt_state, obs_daily, obs_mask)
+
+    def _emit_card(self, prep: PreparedBatch, params, opt_state, obs_daily, obs_mask) -> None:
+        """Build (once per distinct program) and emit the ``program_card``
+        event for a freshly-built explicit-engine step. Best-effort: card
+        plumbing must never fail a training step."""
+        from ddr_tpu.observability import get_recorder
+        from ddr_tpu.observability.costs import build_card, cards_enabled, emit_program_card
+
+        if get_recorder() is None or not cards_enabled():
+            return
+        cache_key = (prep.mode, prep.topo_key)
+        card = self._cards.get(cache_key)
+        try:
+            if card is None:
+                with self.mesh:
+                    card = self._cards[cache_key] = build_card(
+                        prep.step_fn, params, opt_state, prep.attrs,
+                        prep.q_prime, obs_daily, obs_mask,
+                        name="train-step", engine=prep.mode,
+                    )[0]
+            emit_program_card(card, key=prep.topo_key)
+        except Exception:
+            log.exception(f"program-card build failed for {prep.mode}")

@@ -27,11 +27,31 @@ from ddr_tpu.routing.network import RiverNetwork, build_network
 
 __all__ = [
     "dmc",
+    "engine_label",
     "prepare_batch",
     "prepare_channels",
     "denormalize_spatial_parameters",
     "single_ring_wavefront",
 ]
+
+
+def engine_label(network: Any) -> str:
+    """Human-readable name of the routing engine a built network executes
+    (``stacked-chunked-wavefront[K-band-scan]`` / ``depth-chunked-wavefront
+    [K-band]`` / ``single-ring-wavefront`` / ``step``) — ONE definition for
+    every measurement surface (bench.py records, trainbench lines,
+    ``ddr profile`` reports), so the labels the docs cross-reference cannot
+    drift apart."""
+    from ddr_tpu.routing.chunked import ChunkedNetwork
+    from ddr_tpu.routing.stacked import StackedChunked
+
+    if isinstance(network, StackedChunked):
+        return f"stacked-chunked-wavefront[{network.n_chunks}-band-scan]"
+    if isinstance(network, ChunkedNetwork):
+        return f"depth-chunked-wavefront[{network.n_chunks}-band]"
+    if getattr(network, "wavefront", False):
+        return "single-ring-wavefront"
+    return "step"
 
 
 def single_ring_wavefront(network: Any) -> bool:

@@ -1,0 +1,426 @@
+"""``ddr profile`` — compiled-program cost attribution for the routing stack.
+
+Builds the three programs a training deployment actually runs — the forward
+route, the full VJP (value_and_grad of a gauge-loss route), and the complete
+train step (KAN forward + routing + loss + backward + Adam) — for a config's
+first batch or a synthetic shape, AOT-compiles each once
+(``jit(...).lower(...).compile()``), cards them
+(:class:`~ddr_tpu.observability.costs.ProgramCard`: XLA ``cost_analysis`` /
+``memory_analysis``, collective mix, input signature, compile time), runs K
+timed iterations per program, and writes a JSON + markdown report with
+per-program FLOPs, bytes accessed, arithmetic intensity, achieved FLOP/s,
+peak memory, and collectives — the roofline inputs, so the next perf PR
+optimizes the measured bottleneck instead of a guess.
+
+Usage::
+
+    ddr profile --synthetic [--n 2048] [--t-hours 24] [--depth D]
+    ddr profile config.yaml [a.b=c ...] [--reps 5] [--out DIR] [--trace]
+
+``--out`` defaults to ``DDR_METRICS_DIR`` (else the current directory);
+``--trace`` additionally wraps the timed iterations in a ``jax.profiler``
+capture (Perfetto/xprof-compatible, written under ``<out>/profile_trace``).
+``--peak-flops`` (device peak FLOP/s) adds a %-of-peak column. With telemetry
+active (``DDR_METRICS_DIR``), every card is also emitted as a
+``program_card`` event in ``run_log.profile.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+#: The programs a report always covers, in render order.
+PROGRAMS = ("forward-route", "full-vjp", "train-step")
+
+
+def _synthetic_problem(n: int, t_hours: int, depth: int | None):
+    """(cfg, rd, q_prime, obs_daily, obs_mask) on the synthetic generator —
+    the same construction trainbench measures through.
+
+    ``t_hours`` is normalized to a whole number of days and at least 48 (the
+    tau-trimmed daily aggregation needs >= 1 post-trim day, and the daily
+    observation rows must match it)."""
+    import numpy as np
+
+    from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+    from ddr_tpu.validation.configs import Config
+
+    t = max(48, -(-t_hours // 24) * 24)
+    if t != t_hours:
+        log.info(f"t-hours {t_hours} -> {t} (whole days >= 48 for the train step)")
+    n_days = t // 24
+    cfg = Config(
+        name="profile",
+        geodataset="synthetic",
+        mode="training",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/08",
+            "rho": n_days,
+            "warmup": 1,
+        },
+        params={"save_path": "/tmp"},
+    )
+    basin = observe(
+        make_basin(
+            n_segments=n, n_gauges=min(64, max(4, n // 32)),
+            n_days=n_days, seed=0, depth=depth,
+        ),
+        cfg,
+    )
+    obs = np.asarray(basin.obs_daily, dtype=np.float32)
+    return (
+        cfg,
+        basin.routing_data,
+        np.asarray(basin.q_prime[:t], dtype=np.float32),
+        obs,
+        np.ones_like(obs, dtype=bool),
+    )
+
+
+def _config_problem(cfg):
+    """First training batch of a configured dataset."""
+    import numpy as np
+
+    from ddr_tpu.geodatazoo.loader import DataLoader
+    from ddr_tpu.scripts.common import daily_observation_targets, get_flow_fn
+
+    dataset = cfg.geodataset.get_dataset_class(cfg)
+    flow = get_flow_fn(cfg, dataset)
+    loader = DataLoader(dataset, batch_size=cfg.experiment.batch_size, shuffle=False)
+    rd = next(iter(loader))
+    q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+    if rd.flow_scale is not None:
+        q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
+    obs_daily, obs_mask = daily_observation_targets(rd)
+    return rd, q_prime, obs_daily, obs_mask
+
+
+def _time_compiled(call, warm_args, reps: int):
+    """Mean seconds/iteration of an AOT executable: warm once, queue all reps,
+    block once (the bench.py discipline — a blocking sync through the device
+    tunnel is idle time, not throughput). ``call(args) -> (next_args, out)``
+    threads state so donating programs rebind between reps."""
+    import jax
+
+    args, out = call(warm_args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(reps):
+        args, out = call(args)
+        outs.append(out)
+    jax.block_until_ready(outs)
+    return (time.perf_counter() - t0) / reps
+
+
+def profile_programs(
+    cfg, rd, q_prime, obs_daily, obs_mask, reps: int = 5,
+    trace_dir: str | None = None,
+) -> dict[str, dict[str, Any]]:
+    """Card + time the three production programs for one batch.
+
+    Returns ``{program: {"card": ProgramCard, "seconds_per_iter": s,
+    "reach_timesteps_per_sec": r}}``. Every program is AOT-compiled exactly
+    once and the card rides that same compile (no duplicate builds here).
+    ``trace_dir`` wraps ONLY the timed iterations in ``jax.profiler``
+    captures (one per program, same log dir) — a deep-topology compile can
+    run minutes, and a capture dominated by compiler activity buries the
+    iterations the caller asked to inspect.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.observability.costs import build_card
+    from ddr_tpu.observability.spans import span, trace
+
+    def _timed(call, warm_args):
+        if trace_dir is None:
+            return _time_compiled(call, warm_args, reps)
+        with trace(str(trace_dir)):
+            return _time_compiled(call, warm_args, reps)
+    from ddr_tpu.routing.mc import Bounds, route
+    from ddr_tpu.routing.model import (
+        denormalize_spatial_parameters,
+        engine_label,
+        prepare_batch,
+    )
+    from ddr_tpu.scripts.common import build_kan
+    from ddr_tpu.training import make_batch_train_step, make_optimizer
+
+    p = cfg.params
+    bounds = Bounds.from_config(p.attribute_minimums)
+    network, channels, gauges = prepare_batch(rd, p.attribute_minimums["slope"])
+    engine = engine_label(network)
+    n, t_hours = int(rd.n_segments), int(q_prime.shape[0])
+    attrs = jnp.asarray(rd.normalized_spatial_attributes)
+    kan_model, kan_params = build_kan(cfg)
+    raw = kan_model.apply(kan_params, attrs)
+    spatial = denormalize_spatial_parameters(
+        raw, p.parameter_ranges, p.log_space_parameters, p.defaults, n
+    )
+    spatial = {k: jnp.asarray(v) for k, v in spatial.items()}
+    q_prime_j = jnp.asarray(q_prime)
+    obs_j, mask_j = jnp.asarray(obs_daily), jnp.asarray(obs_mask)
+    out: dict[str, dict[str, Any]] = {}
+
+    # 1. forward route: spatial params + inflow -> gauge runoff
+    fwd = jax.jit(
+        lambda sp, qp: route(
+            network, channels, sp, qp, gauges=gauges, bounds=bounds
+        ).runoff
+    )
+    with span("profile/forward-route"):
+        card, compiled = build_card(
+            fwd, spatial, q_prime_j, name="forward-route", engine=engine
+        )
+        secs = _timed(lambda a: (a, compiled(*a)), (spatial, q_prime_j))
+    out["forward-route"] = {"card": card, "seconds_per_iter": secs}
+
+    # 2. full VJP: the training-path gradient through the routing adjoint
+    def loss(sp):
+        return route(
+            network, channels, sp, q_prime_j, gauges=gauges, bounds=bounds
+        ).runoff.mean()
+
+    vjp = jax.jit(jax.value_and_grad(loss))
+    with span("profile/full-vjp"):
+        card, compiled = build_card(vjp, spatial, name="full-vjp", engine=engine)
+        secs = _timed(lambda a: (a, compiled(*a)), (spatial,))
+    out["full-vjp"] = {"card": card, "seconds_per_iter": secs}
+
+    # 3. the COMPLETE train step, exactly the `ddr train` single-device path
+    # (donates params/opt_state, so the timing loop rebinds through each rep)
+    optimizer = make_optimizer(1e-3)
+    opt_state = optimizer.init(kan_params)
+    step = make_batch_train_step(
+        kan_model,
+        bounds,
+        p.parameter_ranges,
+        p.log_space_parameters,
+        p.defaults,
+        tau=p.tau,
+        warmup=cfg.experiment.warmup,
+        optimizer=optimizer,
+    )
+    with span("profile/train-step"):
+        card, compiled = build_card(
+            step, kan_params, opt_state, network, channels, gauges, attrs,
+            q_prime_j, obs_j, mask_j, name="train-step", engine=engine,
+        )
+
+        def _step_call(state):
+            prm, opt = state
+            prm, opt, loss_v, _ = compiled(
+                prm, opt, network, channels, gauges, attrs, q_prime_j, obs_j, mask_j
+            )
+            return (prm, opt), loss_v
+
+        secs = _timed(_step_call, (kan_params, opt_state))
+    out["train-step"] = {"card": card, "seconds_per_iter": secs}
+
+    for rec in out.values():
+        rec["reach_timesteps_per_sec"] = round(n * t_hours / rec["seconds_per_iter"], 1)
+        rec["seconds_per_iter"] = round(rec["seconds_per_iter"], 6)
+    return out
+
+
+def _fmt_num(v: float | None, scale: float = 1.0, suffix: str = "") -> str:
+    if v is None:
+        return "-"
+    return f"{v / scale:,.3g}{suffix}"
+
+
+def render_markdown(report: dict[str, Any]) -> str:
+    """The human half of the report: one roofline-style row per program."""
+    lines = [
+        "# ddr profile report",
+        "",
+        f"- device: `{report['device']}`  shapes: N={report['n']} "
+        f"T={report['t_hours']}h depth={report['depth']}  reps={report['reps']}",
+        "",
+        "| program | engine | GFLOPs | GB accessed | FLOPs/byte | peak MB | "
+        "collectives | compile s | ms/iter | GFLOP/s |"
+        + (" % peak |" if report.get("peak_flops") else ""),
+        "|---|---|---|---|---|---|---|---|---|---|"
+        + ("---|" if report.get("peak_flops") else ""),
+    ]
+    for name in PROGRAMS:
+        rec = report["programs"].get(name)
+        if rec is None:
+            continue
+        c = rec["card"]
+        achieved = rec.get("achieved_flops_per_sec")
+        row = (
+            f"| {name} | {c.get('engine') or '-'} | {_fmt_num(c.get('flops'), 1e9)} "
+            f"| {_fmt_num(c.get('bytes_accessed'), 2**30)} "
+            f"| {_fmt_num(c.get('arithmetic_intensity'))} "
+            f"| {_fmt_num(c.get('peak_bytes'), 2**20)} "
+            f"| {c.get('n_collectives', 0)} "
+            f"| {_fmt_num(c.get('compile_seconds'))} "
+            f"| {_fmt_num(rec['seconds_per_iter'], 1e-3)} "
+            f"| {_fmt_num(achieved, 1e9)} |"
+        )
+        if report.get("peak_flops"):
+            pct = (
+                f"{100 * achieved / report['peak_flops']:.1f}% |"
+                if achieved
+                else "- |"
+            )
+            row += f" {pct}"
+        lines.append(row)
+    lines += [
+        "",
+        "Reading guide: FLOPs/byte (arithmetic intensity) against the device's "
+        "ridge point says whether a program is compute- or bandwidth-bound; "
+        "GFLOP/s vs the device peak says how far from the roofline it runs; "
+        "`collectives` is the per-execution all-reduce/all-gather/"
+        "reduce-scatter/collective-permute/all-to-all instruction count in the "
+        "compiled HLO (0 on one device). See docs/observability.md "
+        '"Cost attribution & profiling".',
+        "",
+    ]
+    for name in PROGRAMS:
+        rec = report["programs"].get(name)
+        if rec is None:
+            continue
+        nz = {k: v for k, v in rec["card"].get("collectives", {}).items() if v}
+        if nz:
+            lines.append(f"- `{name}` collective mix: {nz}")
+    return "\n".join(lines) + "\n"
+
+
+def run_profile(
+    cfg,
+    rd,
+    q_prime,
+    obs_daily,
+    obs_mask,
+    reps: int,
+    out_dir: Path,
+    trace_dir: Path | None = None,
+    peak_flops: float | None = None,
+    depth: int | None = None,
+) -> dict[str, Any]:
+    """Profile one batch's programs, emit their cards as events, and write
+    ``profile_report.json`` + ``profile_report.md`` under ``out_dir``."""
+    import jax
+
+    from ddr_tpu.observability.costs import emit_program_card
+
+    programs = profile_programs(
+        cfg, rd, q_prime, obs_daily, obs_mask, reps,
+        trace_dir=None if trace_dir is None else str(trace_dir),
+    )
+    report: dict[str, Any] = {
+        "device": str(jax.devices()[0].platform),
+        "n": int(rd.n_segments),
+        "t_hours": int(q_prime.shape[0]),
+        "depth": depth,
+        "reps": int(reps),
+        "peak_flops": peak_flops,
+        "programs": {},
+    }
+    for name, rec in programs.items():
+        card = rec["card"]
+        emit_program_card(card)
+        achieved = card.achieved_flops(rec["seconds_per_iter"])
+        report["programs"][name] = {
+            "card": card.to_dict(),
+            "seconds_per_iter": rec["seconds_per_iter"],
+            "reach_timesteps_per_sec": rec["reach_timesteps_per_sec"],
+            "achieved_flops_per_sec": (
+                None if achieved is None else round(achieved, 1)
+            ),
+            "pct_of_peak": (
+                round(100 * achieved / peak_flops, 2)
+                if achieved and peak_flops
+                else None
+            ),
+        }
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / "profile_report.json").write_text(json.dumps(report, indent=2))
+    md = render_markdown(report)
+    (out_dir / "profile_report.md").write_text(md)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ddr profile",
+        description="Cost-attribute the forward route, full VJP, and train "
+        "step for a config's first batch or a synthetic shape (ProgramCards "
+        "+ timed iterations -> JSON/markdown roofline report).",
+    )
+    parser.add_argument(
+        "config", nargs="*",
+        help="optional config.yaml plus a.b=c overrides (ignored with --synthetic)",
+    )
+    parser.add_argument("--synthetic", action="store_true",
+                        help="profile the synthetic generator instead of a config")
+    parser.add_argument("--n", type=int, default=2048,
+                        help="synthetic reach count (default 2048)")
+    parser.add_argument("--t-hours", type=int, default=48,
+                        help="synthetic window, hourly steps (default 48; "
+                        "rounded up to whole days, minimum 48)")
+    parser.add_argument("--depth", type=int, default=None,
+                        help="synthetic longest-path depth (default: shallow generator)")
+    parser.add_argument("--reps", type=int, default=5,
+                        help="timed iterations per program (default 5)")
+    parser.add_argument("--out", default=None,
+                        help="report directory (default: DDR_METRICS_DIR or .)")
+    parser.add_argument("--trace", action="store_true",
+                        help="wrap the timed iterations in a jax.profiler capture "
+                        "(written under <out>/profile_trace)")
+    parser.add_argument("--peak-flops", type=float, default=None,
+                        help="device peak FLOP/s, adds a %%-of-peak column")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
+        return int(e.code or 0)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.common import apply_compile_cache_env, split_config_argv
+
+    apply_compile_cache_env()
+    depth = args.depth
+    if args.synthetic or not args.config:
+        cfg, rd, q_prime, obs_daily, obs_mask = _synthetic_problem(
+            args.n, args.t_hours, depth
+        )
+    else:
+        from ddr_tpu.scripts.common import parse_cli
+
+        path, overrides = split_config_argv(args.config)
+        cfg = parse_cli([path, *overrides] if path else overrides, mode="training")
+        rd, q_prime, obs_daily, obs_mask = _config_problem(cfg)
+    out_dir = Path(args.out or os.environ.get("DDR_METRICS_DIR") or ".")
+    # the run log (program_card events) lands next to the report
+    with run_telemetry(cfg, "profile", base_dir=out_dir, n=int(rd.n_segments)):
+        report = run_profile(
+            cfg, rd, q_prime, obs_daily, obs_mask,
+            reps=max(1, args.reps),
+            out_dir=out_dir,
+            trace_dir=(out_dir / "profile_trace") if args.trace else None,
+            peak_flops=args.peak_flops,
+            depth=depth,
+        )
+    print(render_markdown(report), end="")
+    log.info(f"profile report written to {out_dir / 'profile_report.json'}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,7 +17,9 @@ import numpy as np
 from ddr_tpu.geodatazoo.loader import DataLoader, prefetch
 from ddr_tpu.observability import (
     CompileTracker,
+    PhaseTimer,
     Throughput,
+    build_card,
     emit_heartbeat,
     get_recorder,
     run_telemetry,
@@ -148,6 +150,11 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
     throughput = Throughput(label="train")
+    # Step-phase wallclock decomposition (docs/observability.md "Cost
+    # attribution & profiling"): each loop bucket lands on the step event's
+    # `phases` dict and in the run_end rollup; the Prometheus tee exports the
+    # same numbers as ddr_phase_seconds histograms.
+    phase_timer = PhaseTimer()
     # Telemetry (active when main() opened a run log; None-guarded otherwise):
     # step/compile/heartbeat events per docs/observability.md. The parallel
     # trainer owns its own tracker (its LRU emits the compile events); the
@@ -208,34 +215,39 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 # `attrs` stays in ORIGINAL batch order for the KAN grid refit;
                 # in parallel mode it stays a host array (the payload carries its
                 # own partitioned device copy) and is uploaded only if a refit
-                # actually happens.
+                # actually happens. Phase timings (data_load / host_prep) ride
+                # a per-batch dict so the prefetch thread never races the main
+                # thread's device_step/eval/checkpoint brackets.
                 i, rd = item
-                q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
-                if rd.flow_scale is not None:
-                    q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
-                obs_daily, obs_mask = daily_observation_targets(rd)
-                if par is not None:
-                    payload = par.prepare(rd, q_prime)
-                    attrs = rd.normalized_spatial_attributes
-                else:
-                    network, channels, gauges = prepare_batch(rd, slope_min)
-                    from ddr_tpu.routing.model import single_ring_wavefront
+                phase_s: dict[str, float] = {}
+                with phase_timer.phase("data_load", into=phase_s):
+                    q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
+                    if rd.flow_scale is not None:
+                        q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
+                    obs_daily, obs_mask = daily_observation_targets(rd)
+                with phase_timer.phase("host_prep", into=phase_s):
+                    if par is not None:
+                        payload = par.prepare(rd, q_prime)
+                        attrs = rd.normalized_spatial_attributes
+                    else:
+                        network, channels, gauges = prepare_batch(rd, slope_min)
+                        from ddr_tpu.routing.model import single_ring_wavefront
 
-                    if single_ring_wavefront(network):
-                        # wf-hoist fast path (the step was built with
-                        # q_prime_wf_permuted=True): permute columns on the
-                        # HOST, in the prefetch thread, so the device never
-                        # pays the per-element permutation (~7ms at N=8192)
-                        q_prime = q_prime[:, np.asarray(network.wf_perm)]
-                    payload = (jnp.asarray(q_prime), network, channels, gauges)
-                    attrs = jnp.asarray(rd.normalized_spatial_attributes)
-                return i, rd, payload, attrs, obs_daily, obs_mask
+                        if single_ring_wavefront(network):
+                            # wf-hoist fast path (the step was built with
+                            # q_prime_wf_permuted=True): permute columns on the
+                            # HOST, in the prefetch thread, so the device never
+                            # pays the per-element permutation (~7ms at N=8192)
+                            q_prime = q_prime[:, np.asarray(network.wf_perm)]
+                        payload = (jnp.asarray(q_prime), network, channels, gauges)
+                        attrs = jnp.asarray(rd.normalized_spatial_attributes)
+                return i, rd, payload, attrs, obs_daily, obs_mask, phase_s
 
             batch_stream = (
                 map(_prepare, _batches()) if multiprocess
                 else prefetch(_batches(), _prepare)
             )
-            for i, rd, payload, attrs, obs_daily, obs_mask in batch_stream:
+            for i, rd, payload, attrs, obs_daily, obs_mask, phase_s in batch_stream:
                 if not grids_refit:
                     # pykan-style data refit of the spline grids on the first
                     # EXECUTED mini-batch of the epoch (not literal i == 0, so a
@@ -250,7 +262,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
                 n_timesteps = payload.n_timesteps if par is not None else payload[0].shape[0]
                 hstats = None
-                with throughput.batch(rd.n_segments, n_timesteps):
+                with throughput.batch(rd.n_segments, n_timesteps), phase_timer.phase(
+                    "device_step", into=phase_s
+                ):
                     if par is not None:
                         out = par.step(
                             payload, params, opt_state, obs_daily, obs_mask
@@ -280,78 +294,112 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     # landed — reading them here moves a few scalars, runs
                     # nothing. One `health` event per violating batch.
                     watchdog.observe(hstats, epoch=epoch, batch=i)
+                if par is not None:
+                    # compile accounting + program cards OUTSIDE the timing
+                    # brackets (a card's duplicate AOT compile must not land
+                    # in this step's seconds/rate)
+                    par.record_compiles(payload, params, opt_state, obs_daily, obs_mask)
                 if par is None and rec is not None:
                     # one jitted step serves every batch; compile-cache growth
                     # means this batch's topology re-traced — record it (the
-                    # O(E) topology hash is only worth paying with a run log)
+                    # O(E) topology hash is only worth paying with a run log).
+                    # A detected miss also builds the program's cost card
+                    # (unless DDR_PROGRAM_CARDS=0): one AOT rebuild per
+                    # distinct program, emitted as its `program_card` event.
                     from ddr_tpu.parallel.partition import topology_sha
 
-                    tracker.track_jit("single", step, key=topology_sha(rd))
-                if rec is not None:
-                    rec.emit(
-                        "step",
-                        epoch=epoch,
-                        batch=i,
-                        loss=loss,
-                        n_reaches=int(rd.n_segments),
-                        n_timesteps=int(n_timesteps),
-                        seconds=round(throughput.last_seconds, 6),
-                        reach_timesteps_per_sec=round(throughput.last_rate, 1),
-                        engine=payload.mode if par is not None else "single",
+                    def _card(q_prime=q_prime, network=network, channels=channels,
+                              gauges=gauges, attrs=attrs, params=params,
+                              opt_state=opt_state, obs_daily=obs_daily,
+                              obs_mask=obs_mask):
+                        return build_card(
+                            step, params, opt_state, network, channels, gauges,
+                            attrs, q_prime, jnp.asarray(obs_daily),
+                            jnp.asarray(obs_mask),
+                            name="train-step", engine="single",
+                        )[0]
+
+                    tracker.track_jit(
+                        "single", step, key=topology_sha(rd), card_builder=_card
                     )
                 log.info(
                     f"epoch {epoch} mini-batch {i}: loss={loss:.5f} "
                     f"({throughput.last_rate:,.0f} reach-timesteps/s)"
                 )
 
-                target = np.where(obs_mask, obs_daily, np.nan)
-                metrics = Metrics(pred=daily.T, target=target.T)
-                log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
+                # try/finally: the step event (loss, seconds, rate, phases)
+                # must survive a raising plot/checkpoint — the step COMPLETED
+                # and updated params, so its record belongs in the log even
+                # when the post-step section takes the run down. The phase
+                # brackets are themselves exception-safe, so a partial
+                # eval/checkpoint timing still lands in the emitted dict.
+                try:
+                    with phase_timer.phase("eval", into=phase_s):
+                        target = np.where(obs_mask, obs_daily, np.nan)
+                        metrics = Metrics(pred=daily.T, target=target.T)
+                        log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
 
-                if multiprocess:
-                    # collective multi-host checkpoint (all processes call it)
-                    from ddr_tpu.training import save_state_orbax
+                    if multiprocess:
+                        # collective multi-host checkpoint (all processes call it)
+                        from ddr_tpu.training import save_state_orbax
 
-                    save_state_orbax(
-                        cfg.params.save_path / "saved_models",
-                        cfg.name,
-                        epoch,
-                        i,
-                        params,
-                        opt_state,
-                        rng_state=loader.state(),
-                        arch=kan_arch(cfg),
-                    )
-                if is_primary:
-                    gage_ids = rd.observations.gage_ids
-                    # Legend NSE over the SAME post-warmup window the curve shows
-                    # (plot_time_series trims warmup; the batch `metrics` above
-                    # include it) — reference train.py:135-144's annotation.
-                    w = cfg.experiment.warmup
-                    legend = None
-                    if w < daily.shape[0]:  # an all-warmup window has no score to print
-                        plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
-                        legend = {"nse": float(plotted.nse[0])}
-                    plot_time_series(
-                        daily[:, -1],
-                        target[:, -1],
-                        rd.dates.batch_daily_time_range[1:-1],
-                        gage_ids[-1],
-                        cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
-                        name=cfg.name,
-                        warmup=w,
-                        metrics=legend,
-                    )
-                    if not multiprocess:
-                        save_state(
-                            cfg.params.save_path / "saved_models",
-                            cfg.name,
-                            epoch,
-                            i,
-                            params,
-                            opt_state,
-                            rng_state=loader.state(),
-                            arch=kan_arch(cfg),
+                        with phase_timer.phase("checkpoint", into=phase_s):
+                            save_state_orbax(
+                                cfg.params.save_path / "saved_models",
+                                cfg.name,
+                                epoch,
+                                i,
+                                params,
+                                opt_state,
+                                rng_state=loader.state(),
+                                arch=kan_arch(cfg),
+                            )
+                    if is_primary:
+                        gage_ids = rd.observations.gage_ids
+                        # Legend NSE over the SAME post-warmup window the curve shows
+                        # (plot_time_series trims warmup; the batch `metrics` above
+                        # include it) — reference train.py:135-144's annotation.
+                        w = cfg.experiment.warmup
+                        legend = None
+                        if w < daily.shape[0]:  # an all-warmup window has no score to print
+                            plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
+                            legend = {"nse": float(plotted.nse[0])}
+                        with phase_timer.phase("eval", into=phase_s):
+                            plot_time_series(
+                                daily[:, -1],
+                                target[:, -1],
+                                rd.dates.batch_daily_time_range[1:-1],
+                                gage_ids[-1],
+                                cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
+                                name=cfg.name,
+                                warmup=w,
+                                metrics=legend,
+                            )
+                        if not multiprocess:
+                            with phase_timer.phase("checkpoint", into=phase_s):
+                                save_state(
+                                    cfg.params.save_path / "saved_models",
+                                    cfg.name,
+                                    epoch,
+                                    i,
+                                    params,
+                                    opt_state,
+                                    rng_state=loader.state(),
+                                    arch=kan_arch(cfg),
+                                )
+                finally:
+                    if rec is not None:
+                        rec.emit(
+                            "step",
+                            epoch=epoch,
+                            batch=i,
+                            loss=loss,
+                            n_reaches=int(rd.n_segments),
+                            n_timesteps=int(n_timesteps),
+                            seconds=round(throughput.last_seconds, 6),
+                            reach_timesteps_per_sec=round(throughput.last_rate, 1),
+                            engine=payload.mode if par is not None else "single",
+                            phases=dict(phase_s),
                         )
                 n_done += 1
                 # Per-host liveness: every host emits (each to its own log
@@ -374,6 +422,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     "batches": throughput.batches,
                 },
             )
+            rec.merge_summary("phases", phase_timer.summary())
             if watchdog is not None:
                 rec.merge_summary("health", watchdog.status())
 

@@ -130,7 +130,10 @@ class ForecastService:
         self.metrics = declare_serve_metrics()
         self._warmup_error: str | None = None
         self._networks: dict[str, NetworkEntry] = {}
-        self._fns: dict[tuple[str, str], Any] = {}  # (network, model) -> jitted fn
+        # (network, model) -> AOT-compiled program (jitted.lower().compile())
+        self._fns: dict[tuple[str, str], Any] = {}
+        # (network, model) -> ProgramCard for that program (models_info slice)
+        self._program_cards: dict[tuple[str, str], Any] = {}
         self._plan_sizes: dict[str, int] = {}  # mesh mode: plan-cache growth watch
         self._lock = threading.Lock()
         self._ready = False
@@ -506,19 +509,26 @@ class ForecastService:
                 # numpy rather than re-uploading it to device just to monitor
                 health = compute_health_host(out, qp[:rows])
         else:
-            fn = self._serve_fn(net, entry)
-            # n_live rides as a TRACED scalar (fixed dtype -> one cache
-            # entry); it masks pad rows out of the in-program health stats
+            fn, card = self._serve_fn(net, entry)
+            # the compile is per pair and happens exactly once, in _serve_fn's
+            # AOT build (a shared network:engine key would count a second
+            # model's warmup as a hit and mask its (real) compile); afterwards
+            # the executable CANNOT recompile — a mismatched batch shape
+            # raises instead of silently re-tracing
+            pair = f"{net.name}/{entry.name}:{net.engine}"
+            if card is not None:
+                self.tracker.miss(
+                    pair, key=net.topology_key,
+                    seconds=round(time.perf_counter() - t0, 4),
+                    cache_entries=len(self._fns), source="aot", card=card,
+                )
+            else:
+                self.tracker.hit(pair)
+            # n_live rides as a TRACED scalar (fixed dtype -> one program);
+            # it masks pad rows out of the in-program health stats
             live = np.int32(qp.shape[0] if n_live is None else n_live)
             out_d, health = fn(entry.params, qp, live)
             out = np.asarray(jax.block_until_ready(out_d))
-            # jit-cache growth is per compiled fn = per (network, model) pair;
-            # a shared network:engine key would count a second model's warmup
-            # as a hit and mask its (real) compile
-            self.tracker.track_jit(
-                f"{net.name}/{entry.name}:{net.engine}", fn, key=net.topology_key,
-                seconds=round(time.perf_counter() - t0, 4) if warmup else 0.0,
-            )
         if health is not None and not warmup:
             # the batch already synchronized above; reading the stats moves a
             # few scalars. One `health` event per violating batch, and the
@@ -530,16 +540,23 @@ class ForecastService:
         return out
 
     def _serve_fn(self, net: NetworkEntry, entry):
-        """The (network, model) pair's jitted batched program (built once).
+        """The (network, model) pair's AOT-compiled batched program, built
+        once via ``jit(...).lower(...).compile()`` so its :class:`ProgramCard`
+        (cost/memory/collective profile — ``models_info``'s ``programs``
+        slice) is a free byproduct of the one compile the pair ever pays.
 
-        Returns ``(runoff_batch, HealthStats | None)`` — health (when the
-        watchdog is enabled; a build-time constant) is a few reductions fused
-        into the SAME program, so monitoring adds no jit-cache entry and no
-        second dispatch."""
+        Returns ``(compiled, card | None)`` — ``card`` only on the call that
+        built (the caller's compile-accounting miss); the program itself maps
+        ``(kan_params, q_prime_batch, n_live) -> (runoff_batch,
+        HealthStats | None)``. Health (when the watchdog is enabled; a
+        build-time constant) is a few reductions fused into the SAME program,
+        so monitoring adds no second program or dispatch. Being AOT, the
+        executable cannot silently re-trace: params swapped by hot reload must
+        (and do — ``device_params``) keep their avals."""
         cache_key = (net.name, entry.name)
         fn = self._fns.get(cache_key)
         if fn is not None:
-            return fn
+            return fn, None
         import jax
         import jax.numpy as jnp
 
@@ -586,10 +603,22 @@ class ForecastService:
                 health = None
             return runoff_b, health
 
-        fn = jax.jit(_serve)
+        from ddr_tpu.observability.costs import build_card
+
+        card, compiled = build_card(
+            jax.jit(_serve),
+            entry.params,
+            jax.ShapeDtypeStruct(
+                (self.serve_cfg.max_batch, net.horizon, n), np.float32
+            ),
+            jax.ShapeDtypeStruct((), np.int32),
+            name=f"serve/{net.name}/{entry.name}",
+            engine=net.engine,
+        )
         with self._lock:
-            self._fns[cache_key] = fn
-        return fn
+            self._fns[cache_key] = compiled
+            self._program_cards[cache_key] = card
+        return compiled, card
 
     def _run_batch_mesh(self, net: NetworkEntry, entry, qp: np.ndarray) -> np.ndarray:
         """Mesh-mode execution: the policy-selected multi-chip engine via
@@ -683,9 +712,23 @@ class ForecastService:
     def models_info(self) -> dict:
         """The models slice alone (the ``/v1/models`` payload) — one registry
         snapshot per model so version and source stay paired; no queue locks,
-        no tracker snapshot."""
+        no tracker snapshot. ``programs`` carries the ProgramCard brief of
+        each compiled (network, model) program — FLOPs, bytes accessed,
+        arithmetic intensity, peak bytes, collective mix — keyed by network
+        (empty until that pair compiled; mesh-mode dispatch has no single
+        program to card)."""
+        with self._lock:
+            cards = dict(self._program_cards)
         return {
-            entry.name: {"version": entry.version, "source": entry.source}
+            entry.name: {
+                "version": entry.version,
+                "source": entry.source,
+                "programs": {
+                    net: card.brief()
+                    for (net, model), card in sorted(cards.items())
+                    if model == entry.name
+                },
+            }
             for entry in (self.registry.get(n) for n in self.registry.names())
         }
 

@@ -9,6 +9,12 @@ field (``value``, ``grad_value``, ``deep_value``, ``deep_grad_value``,
 (default 20%). Ratio fields (``grad_over_forward_ratio``) are reported
 informationally — they move whenever either side of the division does.
 
+Cost-card fields regress in the OTHER direction: peak memory
+(``*peak_hbm_gb``) and per-execution collective counts (``*collectives``,
+from the compiled programs' HLO) growing past the threshold also warns — a
+change that keeps throughput but doubles the HBM envelope or the collective
+mix is still a regression the record history should catch.
+
 Records from different devices are never compared as regressions: a CPU
 fallback round against a TPU round says nothing about the code, so a device
 mismatch downgrades every finding to informational.
@@ -45,6 +51,24 @@ THROUGHPUT_KEYS = (
 
 #: Informational ratio fields (reported, never flagged).
 RATIO_KEYS = ("grad_over_forward_ratio", "deep_grad_over_forward_ratio")
+
+#: Peak-memory fields (GB — SMALLER is better; growth past the threshold warns).
+MEMORY_KEYS = (
+    "peak_hbm_gb",
+    "grad_peak_hbm_gb",
+    "deep_peak_hbm_gb",
+    "deep_grad_peak_hbm_gb",
+    "train_peak_hbm_gb",
+)
+
+#: Collective-mix dict fields ({op: count} per compiled program — any count
+#: growing warns; collectives never help throughput for free).
+COLLECTIVE_KEYS = (
+    "collectives",
+    "grad_collectives",
+    "deep_collectives",
+    "deep_grad_collectives",
+)
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
@@ -85,22 +109,25 @@ def load_record(path: Path) -> dict:
 
 
 def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
-    """Findings for every shared key: ``status`` is ``regression`` (fresh is
-    more than ``threshold`` below baseline), ``ok``, or ``info`` (ratio
-    fields, or any comparison across mismatched devices)."""
+    """Findings for every shared key: ``status`` is ``regression`` (fresh
+    throughput more than ``threshold`` below baseline, or fresh peak
+    memory/collective counts more than ``threshold`` ABOVE it), ``ok``, or
+    ``info`` (ratio fields, or any comparison across mismatched devices)."""
     findings: list[dict] = []
     device_mismatch = (
         fresh.get("device") is not None
         and baseline.get("device") is not None
         and fresh["device"] != baseline["device"]
     )
-    for key in THROUGHPUT_KEYS + RATIO_KEYS:
+    for key in THROUGHPUT_KEYS + RATIO_KEYS + MEMORY_KEYS:
         f, b = fresh.get(key), baseline.get(key)
         if not isinstance(f, (int, float)) or not isinstance(b, (int, float)) or not b:
             continue
         ratio = f / b
         if key in RATIO_KEYS or device_mismatch:
             status = "info"
+        elif key in MEMORY_KEYS:
+            status = "regression" if ratio > 1.0 + threshold else "ok"
         elif ratio < 1.0 - threshold:
             status = "regression"
         else:
@@ -108,6 +135,26 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
         findings.append(
             {"key": key, "fresh": f, "baseline": b, "ratio": round(ratio, 3), "status": status}
         )
+    for key in COLLECTIVE_KEYS:
+        f, b = fresh.get(key), baseline.get(key)
+        if not isinstance(f, dict) or not isinstance(b, dict):
+            continue
+        for op in sorted(set(f) | set(b)):
+            fc, bc = int(f.get(op, 0) or 0), int(b.get(op, 0) or 0)
+            if fc == bc == 0:
+                continue  # an all-zero op row is noise, not signal
+            # same threshold discipline as every other field: growth within
+            # it is ok; appearing from a zero baseline always flags
+            grew = fc > bc * (1.0 + threshold) if bc else fc > 0
+            findings.append({
+                "key": f"{key}.{op}",
+                "fresh": fc,
+                "baseline": bc,
+                "ratio": round(fc / bc, 3) if bc else None,
+                "status": (
+                    "info" if device_mismatch else "regression" if grew else "ok"
+                ),
+            })
     if device_mismatch:
         findings.insert(0, {
             "key": "device",
@@ -169,9 +216,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f" {mark} {f['key']:<{width}}  {f['fresh']} vs {f['baseline']}{ratio}")
         if f["status"] == "regression":
             regressions += 1
+            change = (
+                f"moved to {f['ratio']:.0%} of" if f["ratio"] is not None
+                else f"grew {f['fresh']} from {f['baseline']} vs"
+            )
             print(
-                f"check_bench_regression: WARNING: {f['key']} dropped to "
-                f"{f['ratio']:.0%} of {baseline_path.name}",
+                f"check_bench_regression: WARNING: {f['key']} {change} "
+                f"{baseline_path.name}",
                 file=sys.stderr,
             )
     return 1 if (args.strict and regressions) else 0

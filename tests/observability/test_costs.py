@@ -1,0 +1,250 @@
+"""ProgramCard / collective_counts tests: CPU-built cards for the route, full
+VJP, and train-step programs (non-zero FLOPs, non-null peak memory, zero
+collectives on one device, JSON round-trip), the collective-instruction
+counter against a genuinely sharded program (the multichip dryrun's GSPMD
+route probe, in miniature), and the CompileTracker card wiring."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin, observe
+from ddr_tpu.observability import CompileTracker, Recorder, activate, deactivate
+from ddr_tpu.observability.costs import (
+    COLLECTIVE_OPS,
+    ProgramCard,
+    build_card,
+    card_from_compiled,
+    cards_enabled,
+    collective_counts,
+)
+from ddr_tpu.validation.configs import Config
+
+
+def _read(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+@pytest.fixture()
+def rec(tmp_path):
+    r = Recorder(tmp_path / "log.jsonl")
+    activate(r)
+    yield r
+    deactivate(r)
+    r.close()
+
+
+def _problem(n=64, n_days=3):
+    cfg = Config(
+        name="costs_test",
+        geodataset="synthetic",
+        mode="training",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={"start_time": "1981/10/01", "end_time": "1981/10/08",
+                    "rho": n_days, "warmup": 1},
+        params={"save_path": "/tmp"},
+    )
+    basin = observe(make_basin(n_segments=n, n_gauges=4, n_days=n_days, seed=0), cfg)
+    return cfg, basin
+
+
+class TestCollectiveCounts:
+    def test_counts_instructions_not_value_names(self):
+        # %all-reduce.3 is a value NAME; only the opcode position counts
+        hlo = (
+            "%all-reduce.3 = f32[4]{0} all-reduce(f32[4]{0} %p), to_apply=%add\n"
+            "%x = f32[4]{0} add(%all-reduce.3, %all-reduce.3)\n"
+        )
+        counts = collective_counts(hlo)
+        assert counts["all-reduce"] == 1
+        assert sum(counts.values()) == 1
+
+    def test_async_pair_counts_once(self):
+        hlo = (
+            "%ag = (f32[2], f32[4]) all-gather-start(f32[2] %p), dimensions={0}\n"
+            "%done = f32[4] all-gather-done((f32[2], f32[4]) %ag)\n"
+        )
+        assert collective_counts(hlo)["all-gather"] == 1
+
+    def test_every_probed_op_reported(self):
+        counts = collective_counts("no collectives here")
+        assert set(counts) == set(COLLECTIVE_OPS)
+        assert all(v == 0 for v in counts.values())
+
+    def test_sharded_program_counts_collectives(self):
+        """The dryrun expectation in miniature: a cross-device reduction under
+        a mesh must show at least one all-reduce in the compiled HLO, and the
+        helper must accept the Compiled handle directly."""
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices (host mesh)")
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.asarray(jax.devices()), ("x",))
+        fn = jax.jit(lambda a: (a * 2).sum(), out_shardings=NamedSharding(mesh, P()))
+        a = jax.device_put(jnp.ones((8, 16)), NamedSharding(mesh, P("x")))
+        compiled = fn.lower(a).compile()
+        counts = collective_counts(compiled)
+        assert counts["all-reduce"] >= 1
+        # and the card carries the same mix
+        card = card_from_compiled(compiled, name="sharded-sum")
+        assert card.collectives == counts
+
+
+class TestProgramCard:
+    def test_route_vjp_train_step_cards(self):
+        """CPU cards for the three production programs: non-zero FLOPs,
+        non-null peak memory, stable zero collectives on one device."""
+        from ddr_tpu.routing.mc import Bounds, route
+        from ddr_tpu.routing.model import prepare_batch
+        from ddr_tpu.scripts.common import build_kan
+        from ddr_tpu.training import make_batch_train_step, make_optimizer
+
+        cfg, basin = _problem()
+        rd = basin.routing_data
+        p = cfg.params
+        bounds = Bounds.from_config(p.attribute_minimums)
+        network, channels, gauges = prepare_batch(rd, p.attribute_minimums["slope"])
+        params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+        q_prime = jnp.asarray(basin.q_prime)
+
+        fwd = jax.jit(
+            lambda sp, qp: route(network, channels, sp, qp, gauges=gauges,
+                                 bounds=bounds).runoff
+        )
+        vjp = jax.jit(jax.value_and_grad(
+            lambda sp: route(network, channels, sp, q_prime, gauges=gauges,
+                             bounds=bounds).runoff.mean()
+        ))
+        kan_model, kan_params = build_kan(cfg)
+        optimizer = make_optimizer(1e-3)
+        step = make_batch_train_step(
+            kan_model, bounds, p.parameter_ranges, p.log_space_parameters,
+            p.defaults, tau=p.tau, warmup=1, optimizer=optimizer,
+        )
+        attrs = jnp.asarray(rd.normalized_spatial_attributes)
+        obs = jnp.asarray(basin.obs_daily)
+        mask = jnp.ones_like(obs, dtype=bool)
+
+        cards = {}
+        cards["route"], compiled = build_card(fwd, params, q_prime, name="forward-route")
+        # the returned executable runs (the one the compile paid for)
+        out = compiled(params, q_prime)
+        assert np.isfinite(np.asarray(out)).all()
+        cards["vjp"], _ = build_card(vjp, params, name="full-vjp")
+        cards["step"], _ = build_card(
+            step, kan_params, optimizer.init(kan_params), network, channels,
+            gauges, attrs, q_prime, obs, mask, name="train-step",
+        )
+        for name, card in cards.items():
+            assert card.flops and card.flops > 0, name
+            assert card.peak_bytes is not None and card.peak_bytes > 0, name
+            assert set(card.collectives) == set(COLLECTIVE_OPS), name
+            assert card.n_collectives == 0, name  # one device: no collectives
+            assert card.input_specs, name
+            assert card.compile_seconds is not None, name
+        # VJP moves at least as many bytes as the forward route
+        assert cards["vjp"].bytes_accessed >= cards["route"].bytes_accessed
+        # the train step donates params/opt_state; the route donates nothing
+        assert any(cards["step"].donated)
+        assert not any(cards["route"].donated)
+        assert cards["route"].arithmetic_intensity > 0
+
+    def test_json_round_trip(self):
+        card = ProgramCard(
+            name="x", engine="single", platform="cpu", flops=12.0,
+            bytes_accessed=48.0, peak_bytes=1024,
+            collectives={"all-reduce": 2}, input_specs=("f32[4]",),
+            donated=(True,), compile_seconds=0.5,
+        )
+        rt = ProgramCard.from_dict(json.loads(json.dumps(card.to_dict())))
+        assert rt == card
+        # derived fields survive in the dict form (events are grep-able)
+        d = card.to_dict()
+        assert d["arithmetic_intensity"] == pytest.approx(0.25)
+        assert d["n_collectives"] == 2
+
+    def test_from_dict_ignores_unknown_keys(self):
+        assert ProgramCard.from_dict({"name": "y", "bogus": 1}).name == "y"
+
+    def test_brief_is_compact(self):
+        card = ProgramCard(name="x", flops=10.0, bytes_accessed=5.0)
+        brief = card.brief()
+        assert brief["arithmetic_intensity"] == 2.0
+        assert "input_specs" not in brief
+
+
+class TestCardsEnabled:
+    def test_default_on_and_opt_out(self, monkeypatch):
+        monkeypatch.delenv("DDR_PROGRAM_CARDS", raising=False)
+        assert cards_enabled()
+        monkeypatch.setenv("DDR_PROGRAM_CARDS", "0")
+        assert not cards_enabled()
+        monkeypatch.setenv("DDR_PROGRAM_CARDS", "false")
+        assert not cards_enabled()
+        monkeypatch.setenv("DDR_PROGRAM_CARDS", "1")
+        assert cards_enabled()
+
+
+class TestTrackerWiring:
+    def test_miss_with_card_emits_program_card(self, rec):
+        t = CompileTracker()
+        card = ProgramCard(name="train-step", engine="gspmd", flops=7.0)
+        t.miss("gspmd", key="abc123", seconds=0.5, card=card)
+        events = _read(rec.path)
+        kinds = [e["event"] for e in events]
+        assert kinds == ["compile", "program_card"]
+        pc = events[1]
+        assert pc["key"] == "abc123"
+        assert pc["name"] == "train-step"
+        assert pc["flops"] == 7.0
+
+    def test_track_jit_invokes_builder_only_on_miss(self, rec):
+        class _Fake:
+            size = 0
+
+            def _cache_size(self):
+                return self.size
+
+        calls = []
+
+        def builder():
+            calls.append(1)
+            return ProgramCard(name="p", engine="single")
+
+        fn = _Fake()
+        t = CompileTracker()
+        fn.size = 1
+        t.track_jit("single", fn, key="k1", card_builder=builder)  # miss
+        t.track_jit("single", fn, key="k1", card_builder=builder)  # hit
+        assert len(calls) == 1
+        assert [e["event"] for e in _read(rec.path)] == ["compile", "program_card"]
+
+    def test_track_jit_respects_opt_out(self, rec, monkeypatch):
+        monkeypatch.setenv("DDR_PROGRAM_CARDS", "0")
+
+        class _Fake:
+            def _cache_size(self):
+                return 1
+
+        t = CompileTracker()
+        t.track_jit("single", _Fake(), key="k",
+                     card_builder=lambda: ProgramCard(name="p"))
+        # the compile event still fires; the card build is skipped
+        assert [e["event"] for e in _read(rec.path)] == ["compile"]
+
+    def test_raising_builder_never_fatal(self, rec):
+        class _Fake:
+            def _cache_size(self):
+                return 1
+
+        def bad():
+            raise RuntimeError("boom")
+
+        t = CompileTracker()
+        t.track_jit("single", _Fake(), key="k", card_builder=bad)
+        assert [e["event"] for e in _read(rec.path)] == ["compile"]

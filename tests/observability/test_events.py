@@ -77,7 +77,7 @@ class TestRecorderRoundTrip:
     def test_event_vocabulary_is_closed(self):
         assert set(EVENT_TYPES) == {
             "run_start", "step", "eval", "compile", "heartbeat", "span", "run_end",
-            "serve_request", "serve_batch", "serve_shed", "health",
+            "serve_request", "serve_batch", "serve_shed", "health", "program_card",
         }
 
 

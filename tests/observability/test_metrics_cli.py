@@ -144,6 +144,49 @@ class TestSummarize:
         assert main(["summarize", str(p)]) == 0
         assert "health   :" not in capsys.readouterr().out
 
+    def test_where_time_went_renders(self, tmp_path, capsys):
+        """Step events carrying `phases` dicts aggregate into the per-phase
+        percentage table (biggest bucket first)."""
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        events = [json.loads(l) for l in p.read_text().splitlines()]
+        for e in events:
+            if e["event"] == "step":
+                e["phases"] = {"device_step": 0.4, "eval": 0.1}
+        p.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "where time went" in out
+        assert "device_step  80.0%" in out
+        assert "eval         20.0%" in out
+
+    def test_no_phase_section_without_phase_dicts(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        assert main(["summarize", str(p)]) == 0
+        assert "where time went" not in capsys.readouterr().out
+
+    def test_program_cost_table_renders(self, tmp_path, capsys):
+        """program_card events render one row per distinct program; a re-emit
+        for the same (name, engine, key) doesn't duplicate the row."""
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        card = {
+            "event": "program_card", "t": 0.7, "wall": 100.7, "host": 0,
+            "pid": 1, "seq": 40, "name": "train-step", "engine": "stacked-sharded",
+            "key": "aaa111", "flops": 1.5e9, "bytes_accessed": 3.0e8,
+            "arithmetic_intensity": 5.0, "peak_bytes": 512 * 2**20,
+            "n_collectives": 4, "collectives": {"all-reduce": 4},
+            "compile_seconds": 12.5,
+        }
+        with p.open("a") as f:
+            f.write(json.dumps(card) + "\n")
+            f.write(json.dumps({**card, "seq": 41}) + "\n")  # re-emit, same program
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "programs : 2 card events, 1 distinct programs" in out
+        assert "train-step" in out
+        assert "aaa111" in out  # topology-key short form distinguishes programs
+        assert "512.0" in out  # peak MB
+        assert "12.50" in out  # compile_s
+
     def test_multi_host_dir(self, tmp_path, capsys):
         _write_golden(tmp_path / "run_log.train.jsonl")
         (tmp_path / "run_log.train.host1.jsonl").write_text(

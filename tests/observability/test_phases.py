@@ -1,0 +1,114 @@
+"""PhaseTimer / summarize_phases tests: per-step dicts, run totals, shares,
+and the Prometheus tee's ddr_phase_seconds histogram."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from ddr_tpu.observability.phases import STEP_PHASES, PhaseTimer, summarize_phases
+from ddr_tpu.observability.prometheus import event_tee
+from ddr_tpu.observability.registry import MetricsRegistry
+
+
+class TestPhaseTimer:
+    def test_per_step_dict_and_totals(self):
+        t = PhaseTimer()
+        step = {}
+        with t.phase("data_load", into=step):
+            pass
+        with t.phase("device_step", into=step):
+            pass
+        assert set(step) == {"data_load", "device_step"}
+        assert all(v >= 0 for v in step.values())
+        totals = t.totals()
+        assert totals["data_load"]["count"] == 1
+        assert totals["device_step"]["count"] == 1
+
+    def test_repeated_phase_accumulates_into_step_dict(self):
+        t = PhaseTimer()
+        step = {}
+        for _ in range(3):
+            with t.phase("eval", into=step):
+                pass
+        assert t.totals()["eval"]["count"] == 3
+        assert len(step) == 1  # one accumulated entry, not three
+
+    def test_exception_safe(self):
+        t = PhaseTimer()
+        step = {}
+        with pytest.raises(ValueError):
+            with t.phase("checkpoint", into=step):
+                raise ValueError("x")
+        assert "checkpoint" in step
+        assert t.totals()["checkpoint"]["count"] == 1
+
+    def test_thread_safety(self):
+        """The prefetch thread times data_load while the main thread times
+        device_step — totals must not lose updates."""
+        t = PhaseTimer()
+
+        def worker(name):
+            for _ in range(50):
+                with t.phase(name):
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(n,)) for n in STEP_PHASES]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        totals = t.totals()
+        assert all(totals[n]["count"] == 50 for n in STEP_PHASES)
+
+    def test_summary_shares_sum_to_one(self):
+        t = PhaseTimer()
+        with t.phase("data_load"):
+            pass
+        with t.phase("device_step"):
+            pass
+        shares = t.summary()["shares"]
+        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-3)
+
+
+class TestSummarizePhases:
+    def test_aggregates_step_events(self):
+        events = [
+            {"phases": {"device_step": 3.0, "eval": 1.0}},
+            {"phases": {"device_step": 1.0}},
+            {"no_phases": True},
+            {"phases": {"device_step": "bogus"}},  # malformed value dropped
+        ]
+        agg = summarize_phases(events)
+        assert agg["device_step"]["seconds"] == pytest.approx(4.0)
+        assert agg["device_step"]["count"] == 2
+        assert agg["device_step"]["share"] == pytest.approx(0.8)
+        assert agg["eval"]["share"] == pytest.approx(0.2)
+        # sorted by total time, biggest first
+        assert list(agg) == ["device_step", "eval"]
+
+    def test_empty(self):
+        assert summarize_phases([]) == {}
+
+
+class TestPrometheusTee:
+    def test_step_phases_feed_histogram(self):
+        r = MetricsRegistry()
+        event_tee(
+            {"event": "step", "engine": "single", "seconds": 1.0,
+             "phases": {"device_step": 0.9, "eval": 0.1, "bad": None}},
+            r,
+        )
+        hist = r.get("ddr_phase_seconds")
+        assert hist is not None
+        series = hist.series()
+        assert ("device_step",) in series
+        assert series[("device_step",)]["count"] == 1
+        assert ("eval",) in series
+        assert ("bad",) not in series  # unparseable values are skipped
+
+    def test_step_without_phases_declares_nothing(self):
+        r = MetricsRegistry()
+        event_tee({"event": "step", "engine": "single", "seconds": 1.0}, r)
+        assert r.get("ddr_phase_seconds") is None

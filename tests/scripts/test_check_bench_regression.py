@@ -64,6 +64,69 @@ class TestCompare:
         assert mod.compare(fresh, base) == []
 
 
+class TestCostGrowth:
+    """The cost-card direction: peak memory and collective counts growing past
+    the threshold warn; shrinking (or equal) is ok."""
+
+    def test_peak_memory_growth_flags(self):
+        mod = _load()
+        fresh = {"device": "cpu", "peak_hbm_gb": 1.3, "deep_peak_hbm_gb": 1.1}
+        base = {"device": "cpu", "peak_hbm_gb": 1.0, "deep_peak_hbm_gb": 1.0}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["peak_hbm_gb"]["status"] == "regression"  # +30% > +20%
+        assert by_key["deep_peak_hbm_gb"]["status"] == "ok"  # +10% <= +20%
+
+    def test_peak_memory_shrink_is_ok(self):
+        mod = _load()
+        (f,) = mod.compare(
+            {"device": "cpu", "peak_hbm_gb": 0.4},
+            {"device": "cpu", "peak_hbm_gb": 1.0},
+        )
+        assert f["status"] == "ok"  # memory going DOWN is the good direction
+
+    def test_collective_count_growth_flags(self):
+        mod = _load()
+        fresh = {"device": "tpu", "deep_collectives": {"all-reduce": 14, "all-gather": 0}}
+        base = {"device": "tpu", "deep_collectives": {"all-reduce": 10, "all-gather": 0}}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base)}
+        assert by_key["deep_collectives.all-reduce"]["status"] == "regression"  # +40%
+        assert "deep_collectives.all-gather" not in by_key  # all-zero rows are noise
+
+    def test_collective_growth_within_threshold_is_ok(self):
+        """The threshold applies to collectives like every other field: a +10%
+        count bump under the default 20% threshold reports but doesn't warn."""
+        mod = _load()
+        fresh = {"device": "tpu", "collectives": {"all-reduce": 11}}
+        base = {"device": "tpu", "collectives": {"all-reduce": 10}}
+        (f,) = mod.compare(fresh, base)
+        assert f["status"] == "ok"
+
+    def test_collective_appearing_from_zero_flags(self):
+        mod = _load()
+        fresh = {"device": "tpu", "collectives": {"all-to-all": 2}}
+        base = {"device": "tpu", "collectives": {"all-to-all": 0}}
+        (f,) = mod.compare(fresh, base)
+        assert f["status"] == "regression"
+        assert f["ratio"] is None  # no finite ratio from a zero baseline
+
+    def test_device_mismatch_downgrades_cost_fields(self):
+        mod = _load()
+        out = mod.compare(
+            {"device": "cpu", "peak_hbm_gb": 9.0, "collectives": {"all-reduce": 5}},
+            {"device": "tpu", "peak_hbm_gb": 1.0, "collectives": {"all-reduce": 1}},
+        )
+        assert all(f["status"] == "info" for f in out)
+
+    def test_strict_exit_on_memory_growth(self, tmp_path):
+        mod = _load()
+        fresh = tmp_path / "fresh.json"
+        base = tmp_path / "base.json"
+        fresh.write_text(json.dumps({"device": "cpu", "value": 100.0, "peak_hbm_gb": 2.0}))
+        base.write_text(json.dumps({"device": "cpu", "value": 100.0, "peak_hbm_gb": 1.0}))
+        assert mod.main([str(fresh), "--baseline", str(base), "--strict"]) == 1
+        assert mod.main([str(fresh), "--baseline", str(base)]) == 0  # warn only
+
+
 class TestLoadRecord:
     def test_unwraps_driver_wrapper(self, tmp_path):
         """The committed BENCH_r*.json form: pretty-printed {n,cmd,rc,tail,

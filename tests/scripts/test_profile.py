@@ -1,0 +1,119 @@
+"""``ddr profile`` end-to-end: the --synthetic smoke run (the acceptance
+surface — report JSON/markdown with ProgramCards for forward route, full VJP,
+and train step), plus CLI registration and the markdown renderer."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+
+class TestProfileSynthetic:
+    @pytest.fixture(scope="class")
+    def report_dir(self, tmp_path_factory):
+        """One tiny profile run shared by every assertion below (three AOT
+        compiles is the expensive part)."""
+        out = tmp_path_factory.mktemp("profile_out")
+        from ddr_tpu.scripts.profile import main
+
+        rc = main([
+            "--synthetic", "--n", "64", "--t-hours", "48",
+            "--reps", "1", "--out", str(out),
+        ])
+        assert rc == 0
+        return out
+
+    def test_report_files_written(self, report_dir):
+        assert (report_dir / "profile_report.json").exists()
+        md = (report_dir / "profile_report.md").read_text()
+        assert "forward-route" in md and "full-vjp" in md and "train-step" in md
+
+    def test_cards_cover_all_three_programs(self, report_dir):
+        report = json.loads((report_dir / "profile_report.json").read_text())
+        assert set(report["programs"]) == {"forward-route", "full-vjp", "train-step"}
+        for name, rec in report["programs"].items():
+            card = rec["card"]
+            assert card["flops"] and card["flops"] > 0, name
+            assert card["peak_bytes"] is not None, name
+            assert set(card["collectives"]) == {
+                "all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all",
+            }, name
+            assert rec["seconds_per_iter"] > 0, name
+            assert rec["reach_timesteps_per_sec"] > 0, name
+            assert rec["achieved_flops_per_sec"] > 0, name
+
+    def test_run_log_carries_program_cards(self, report_dir):
+        log = report_dir / "run_log.profile.jsonl"
+        events = [json.loads(l) for l in log.read_text().splitlines()]
+        cards = [e for e in events if e["event"] == "program_card"]
+        assert {e["name"] for e in cards} == {"forward-route", "full-vjp", "train-step"}
+        assert events[-1]["event"] == "run_end"
+        assert events[-1]["status"] == "ok"
+
+    def test_summarize_renders_program_table(self, report_dir, capsys):
+        from ddr_tpu.observability.metrics_cli import main as metrics_main
+
+        assert metrics_main(["summarize", str(report_dir / "run_log.profile.jsonl")]) == 0
+        out = capsys.readouterr().out
+        assert "programs :" in out
+        assert "train-step" in out
+
+
+class TestProfileCli:
+    def test_registered_in_ddr_cli(self, capsys):
+        from ddr_tpu.cli import main
+
+        assert main([]) == 0
+        assert "profile" in capsys.readouterr().out
+
+    def test_help_exits_zero(self):
+        from ddr_tpu.scripts.profile import main
+
+        assert main(["--help"]) == 0
+
+
+class TestRenderMarkdown:
+    def test_peak_flops_column(self):
+        from ddr_tpu.scripts.profile import render_markdown
+
+        report = {
+            "device": "cpu", "n": 8, "t_hours": 48, "depth": None, "reps": 1,
+            "peak_flops": 1e9,
+            "programs": {
+                "forward-route": {
+                    "card": {"engine": "step", "flops": 5e8, "bytes_accessed": 1e6,
+                             "arithmetic_intensity": 500.0, "peak_bytes": 2**20,
+                             "n_collectives": 0, "compile_seconds": 0.1,
+                             "collectives": {"all-reduce": 0}},
+                    "seconds_per_iter": 0.5,
+                    "achieved_flops_per_sec": 1e9,
+                },
+            },
+        }
+        md = render_markdown(report)
+        assert "% peak" in md
+        assert "100.0%" in md
+
+    def test_nonzero_collective_mix_listed(self):
+        from ddr_tpu.scripts.profile import render_markdown
+
+        report = {
+            "device": "tpu", "n": 8, "t_hours": 48, "depth": None, "reps": 1,
+            "peak_flops": None,
+            "programs": {
+                "train-step": {
+                    "card": {"engine": "gspmd", "flops": 1.0, "bytes_accessed": 1.0,
+                             "arithmetic_intensity": 1.0, "peak_bytes": 1,
+                             "n_collectives": 3, "compile_seconds": 0.1,
+                             "collectives": {"all-reduce": 3, "all-gather": 0}},
+                    "seconds_per_iter": 0.5,
+                    "achieved_flops_per_sec": 2.0,
+                },
+            },
+        }
+        md = render_markdown(report)
+        assert "collective mix" in md
+        assert "'all-reduce': 3" in md
+        assert "all-gather" not in md.split("collective mix")[1]  # zeros hidden

@@ -169,3 +169,23 @@ class TestStats:
         assert s["models"]["default"]["version"] == 1
         net = s["networks"]["default"]
         assert net["n_reaches"] == 32 and net["horizon"] == 8 and net["n_outputs"] == 4
+
+    def test_models_info_carries_program_cards(self, service_factory):
+        """The one compiled program per (network, model) pair surfaces its
+        ProgramCard brief on models_info (and thus /v1/models and stats)."""
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        programs = svc.models_info()["default"]["programs"]
+        assert set(programs) == {"default"}  # keyed by network name
+        card = programs["default"]
+        assert card["flops"] and card["flops"] > 0
+        assert card["peak_bytes"] is not None
+        assert card["compile_seconds"] is not None
+        assert sum(card["collectives"].values()) == 0  # single device
+
+    def test_warmup_emits_program_card_event(self, service_factory, recorder):
+        service_factory(n_segments=32, horizon=8, n_days=2)
+        compiles = events_of(recorder, "compile")
+        cards = events_of(recorder, "program_card")
+        assert len(compiles) == len(cards) == 1
+        assert cards[0]["key"] == compiles[0]["key"]
+        assert cards[0]["name"].startswith("serve/default/")
