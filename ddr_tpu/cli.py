@@ -16,6 +16,7 @@ _COMMANDS = {
     "route": "ddr_tpu.scripts.router",
     "train-and-test": "ddr_tpu.scripts.train_and_test",
     "serve": "ddr_tpu.scripts.serve",
+    "loadtest": "ddr_tpu.scripts.loadtest",
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",

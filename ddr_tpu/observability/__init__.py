@@ -40,6 +40,7 @@ from ddr_tpu.observability.prometheus import (
 )
 from ddr_tpu.observability.recompile import CompileTracker
 from ddr_tpu.observability.registry import MetricsRegistry, get_registry, set_registry
+from ddr_tpu.observability.slo import SloConfig, SloTracker, attainment_from_events
 from ddr_tpu.observability.spans import (
     ProfilerBusyError,
     capture_profile,
@@ -94,4 +95,7 @@ __all__ = [
     "HealthConfig",
     "HealthStats",
     "HealthWatchdog",
+    "SloConfig",
+    "SloTracker",
+    "attainment_from_events",
 ]

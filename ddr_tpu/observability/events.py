@@ -68,7 +68,9 @@ __all__ = [
 #: program's cost/memory/collective profile
 #: (:mod:`ddr_tpu.observability.costs`), emitted alongside its ``compile``
 #: event. ``step`` events may additionally carry a ``phases`` dict (step-phase
-#: wallclock decomposition, :mod:`ddr_tpu.observability.phases`).
+#: wallclock decomposition, :mod:`ddr_tpu.observability.phases`). ``slo`` is
+#: one SLO burn-rate alert *transition* (firing/resolved) from the serving
+#: layer's :class:`~ddr_tpu.observability.slo.SloTracker`.
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -82,6 +84,7 @@ EVENT_TYPES = (
     "serve_shed",
     "health",
     "program_card",
+    "slo",
 )
 
 

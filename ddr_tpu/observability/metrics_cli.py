@@ -7,13 +7,19 @@ Reads the event stream written by :mod:`ddr_tpu.observability.events`
   compile counts per engine, a "Where time went" step-phase breakdown, a
   per-program cost table (``program_card`` events: FLOPs, bytes, arithmetic
   intensity, peak memory, collectives), a sampled loss curve, serving
-  latency percentiles, numerical-health violations, per-span time breakdown,
-  per-host heartbeat liveness;
-- ``tail <log-or-dir> [-n N]``: the last N events, one compact line each.
+  latency percentiles + queue/execute decomposition, SLO attainment/burn,
+  numerical-health violations, per-span time breakdown, per-host heartbeat
+  liveness;
+- ``tail <log-or-dir> [-n N]``: the last N events, one compact line each;
+- ``tail --follow [-i SECONDS]``: keep polling the log and print new events
+  as they land (the serve/loadtest live view) — corrupt or half-written
+  lines are skipped, a truncated/rotated file restarts from its top, and
+  Ctrl-C exits cleanly.
 
 Pointing either command at a directory merges every ``*.jsonl`` inside (the
-multi-host case). Corrupt lines are skipped and counted, never fatal — a run
-killed mid-write must still summarize.
+multi-host case; ``--follow`` follows the most recently modified file).
+Corrupt lines are skipped and counted, never fatal — a run killed mid-write
+must still summarize.
 """
 
 from __future__ import annotations
@@ -21,13 +27,23 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 from typing import Any
 
-__all__ = ["main", "load_events", "summarize", "tail"]
+__all__ = ["main", "load_events", "summarize", "tail", "follow"]
 
 #: Envelope keys hidden from per-event payload rendering.
 _ENVELOPE = ("event", "t", "wall", "host", "pid", "seq", "tags")
+
+#: How far back ``follow`` reads an existing log at startup (the last N
+#: events live well inside this; the rest of a huge log is never loaded).
+_FOLLOW_INIT_TAIL_BYTES = 1 << 20
+
+#: Head-of-file fingerprint length for ``follow``'s recreation detector —
+#: JSONL appends never rewrite the head, so a changed head means a new file
+#: (inode numbers alone are unreliable: filesystems recycle them).
+_FOLLOW_FP_BYTES = 128
 
 
 def load_events(path: str | Path) -> tuple[list[dict], int]:
@@ -138,6 +154,7 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
     _summarize_phases(by_type, w)
     _summarize_program_cards(by_type, w)
     _summarize_serving(by_type, w)
+    _summarize_slo(by_type, end, w)
     _summarize_health(by_type, end, w)
 
     evals = by_type.get("eval", [])
@@ -290,6 +307,24 @@ def _summarize_serving(by_type: dict[str, list[dict]], w) -> None:
                 f"p99 {1e3 * p99:.1f}ms"
             )
         w(line + "\n")
+        # the lifecycle decomposition (request tracing): where requests spent
+        # their latency — queued vs executing on device. Filter by field
+        # presence, not status: sheds carry queue_s (their wait is the
+        # overload signal) and the live ddr_serve_queue_seconds histogram
+        # includes them, so the archive replay must agree with the dashboard;
+        # execute_s only ever rides served (ok) events.
+        parts = []
+        for field, label in (("queue_s", "queue"), ("execute_s", "execute")):
+            vals = sorted(
+                float(e[field]) for e in reqs if e.get(field) is not None
+            )
+            if vals:
+                p50, p99 = _percentile(vals, 0.50), _percentile(vals, 0.99)
+                parts.append(
+                    f"{label} p50 {1e3 * p50:.1f}ms p99 {1e3 * p99:.1f}ms"
+                )
+        if parts:
+            w("           " + "   ".join(parts) + "\n")
     if batches:
         sizes = [float(e.get("size", 0)) for e in batches]
         occ = [float(e["occupancy"]) for e in batches if e.get("occupancy") is not None]
@@ -315,6 +350,58 @@ def _summarize_serving(by_type: dict[str, list[dict]], w) -> None:
             f"sheds    : {len(sheds)} — "
             + ", ".join(f"{k} {v}" for k, v in sorted(reasons.items()))
             + "\n"
+        )
+
+
+def _summarize_slo(by_type: dict[str, list[dict]], end: dict, w) -> None:
+    """The SLO section: offline attainment/burn replay over ``serve_request``
+    events (``slo_ok`` field; status for pre-tracing logs), using the
+    objective the run_end serve rollup recorded when present — the archive
+    answer to the live ``ddr_slo_*`` gauges. ``slo`` events (fast-burn alert
+    transitions) render beneath."""
+    from ddr_tpu.observability.slo import attainment_from_events, parse_window_label
+
+    reqs = by_type.get("serve_request", [])
+    rollup = ((end.get("summary") or {}).get("serve") or {}).get("slo") or {}
+    target = rollup.get("target")
+    windows = [
+        secs
+        for secs in map(parse_window_label, rollup.get("windows") or {})
+        if secs is not None
+    ]
+    agg = attainment_from_events(
+        reqs, windows=windows or (60.0, 300.0, 3600.0), target=target
+    )
+    alerts = by_type.get("slo", [])
+    if agg is None and not alerts:
+        return
+    if agg is not None:
+        line = (
+            f"slo      : attainment {100 * agg['attainment']:.2f}% "
+            f"({agg['good']}/{agg['total']} good"
+        )
+        if target is not None:
+            line += f", target {100 * float(target):.1f}%"
+        line += ")"
+        wins = agg.get("windows") or {}
+        if wins:
+            line += "   " + "  ".join(
+                f"{name} {100 * v['attainment']:.1f}%"
+                + (
+                    f" (burn {v['burn_rate']:.2f}x)"
+                    if v.get("burn_rate") is not None
+                    else ""
+                )
+                for name, v in wins.items()
+            )
+        w(line + "\n")
+    if alerts:
+        firing = sum(1 for e in alerts if e.get("state") == "firing")
+        last = alerts[-1]
+        w(
+            f"           {len(alerts)} burn-rate alert transitions "
+            f"({firing} firing) — last: {last.get('state')} "
+            f"burn {last.get('burn_rate')}x over {last.get('window')}\n"
         )
 
 
@@ -380,6 +467,106 @@ def tail(events: list[dict], n: int = 20, out=None) -> int:
     return 0
 
 
+def _parse_event_line(raw: bytes) -> dict | None:
+    """One JSONL line -> event dict, or None for blank/corrupt/partial lines
+    (the follow loop's tolerance: a line racing the writer shows up whole on
+    a later poll only if the writer appends atomically — ours does — so a
+    non-parsing line is garbage, not data to wait for)."""
+    line = raw.decode("utf-8", errors="replace").strip()
+    if not line:
+        return None
+    try:
+        ev = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    return ev if isinstance(ev, dict) else None
+
+
+def follow(
+    path: str | Path,
+    n: int = 20,
+    interval: float = 0.5,
+    out=None,
+    max_polls: int | None = None,
+) -> int:
+    """Poll-based live follow of one run log: print the last ``n`` existing
+    events, then every new complete line as it lands (``tail -f``, but
+    schema-aware and corrupt-line tolerant). A directory follows its most
+    recently modified ``*.jsonl``. Truncation/recreation (a new run reusing
+    the log name) restarts from the new file's top. Ctrl-C exits cleanly with
+    status 0; ``max_polls`` bounds the loop for tests (None = forever)."""
+    out = out or sys.stdout
+    p = Path(path)
+    if p.is_dir():
+        cands = sorted(p.glob("*.jsonl"))
+        if not cands:
+            raise FileNotFoundError(f"no .jsonl run logs under {p}")
+        p = max(cands, key=lambda f: f.stat().st_mtime)
+        out.write(f"following {p}\n")
+    # only the LAST n events matter at startup: back-read a bounded tail, not
+    # a multi-day log (a gigabyte run_log must not stall or OOM the follow)
+    st = p.stat()  # raises FileNotFoundError on a missing file
+    with p.open("rb") as fh:
+        head = fh.read(_FOLLOW_FP_BYTES)  # recreation fingerprint
+        size = st.st_size
+        if size > _FOLLOW_INIT_TAIL_BYTES:
+            fh.seek(size - _FOLLOW_INIT_TAIL_BYTES)
+            fh.readline()  # drop the line the seek cut in half
+            data = fh.read()
+        else:
+            data = head + fh.read()
+        pos = fh.tell()
+    lines = data.split(b"\n")
+    carry = lines.pop()  # partial trailing line: render once its newline lands
+    pos -= len(carry)
+    existing = [ev for ev in (_parse_event_line(ln) for ln in lines) if ev]
+    if existing:
+        tail(existing, n=n, out=out)
+    if hasattr(out, "flush"):
+        out.flush()
+    polls = 0
+    try:
+        while max_polls is None or polls < max_polls:
+            polls += 1
+            time.sleep(max(0.0, interval))
+            try:
+                size = p.stat().st_size
+            except OSError:
+                continue  # rotated away; keep polling for its return
+            if size < pos:
+                pos = 0  # truncated in place: the new content is the run
+            if size == pos:
+                continue
+            try:
+                with p.open("rb") as fh:
+                    if head and fh.read(len(head)) != head:
+                        # recreated under the same name (a new run) — caught
+                        # by the head fingerprint even when the new file is
+                        # already LARGER than our offset: restart from its top
+                        pos = 0
+                    if pos == 0:
+                        fh.seek(0)
+                        head = fh.read(_FOLLOW_FP_BYTES)
+                    fh.seek(pos)
+                    chunk = fh.read()
+            except OSError:
+                continue
+            pos += len(chunk)
+            *complete, carry = chunk.split(b"\n")
+            # a partial line stays buffered in the FILE (we re-read from its
+            # offset next poll), so rewind over it rather than carrying state
+            pos -= len(carry)
+            for raw in complete:
+                ev = _parse_event_line(raw)
+                if ev is not None:
+                    tail([ev], n=1, out=out)
+            if hasattr(out, "flush"):
+                out.flush()
+    except KeyboardInterrupt:
+        pass  # the documented exit path of a follow loop
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="ddr metrics",
@@ -392,6 +579,15 @@ def main(argv: list[str] | None = None) -> int:
     p_tail = sub.add_parser("tail", help="print the last N events")
     p_tail.add_argument("log", help="run_log .jsonl file, or a directory of them")
     p_tail.add_argument("-n", type=int, default=20, help="events to show (default 20)")
+    p_tail.add_argument(
+        "-f", "--follow", action="store_true",
+        help="keep polling and print new events as they land (Ctrl-C to exit; "
+        "a directory follows its most recently modified .jsonl)",
+    )
+    p_tail.add_argument(
+        "-i", "--interval", type=float, default=0.5,
+        help="--follow poll cadence, seconds (default 0.5)",
+    )
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
@@ -399,6 +595,12 @@ def main(argv: list[str] | None = None) -> int:
     if not args.command:
         parser.print_help()
         return 2
+    if args.command == "tail" and args.follow:
+        try:
+            return follow(args.log, n=args.n, interval=args.interval)
+        except (FileNotFoundError, OSError) as e:
+            print(f"ddr metrics: {e}", file=sys.stderr)
+            return 1
     try:
         events, bad = load_events(args.log)
     except (FileNotFoundError, OSError) as e:

@@ -128,6 +128,24 @@ def declare_serve_metrics(registry: MetricsRegistry | None = None) -> MetricsReg
     r.histogram("ddr_request_latency_seconds",
                 "Admit-to-completion latency of served (status=ok) requests",
                 labels=("network", "model"))
+    # the request-lifecycle decomposition: latency = queue wait (admission ->
+    # batch extraction, includes the coalescing hold) + device execution (the
+    # request's batch's execute wall time) + reply overhead
+    r.histogram("ddr_serve_queue_seconds",
+                "Admission-to-extraction queue wait per request (includes the "
+                "coalescing hold)",
+                labels=("network", "model"))
+    r.histogram("ddr_serve_execute_seconds",
+                "Device execution time attributed to each served request (its "
+                "micro-batch's execute wall time)",
+                labels=("network", "model"))
+    r.gauge("ddr_slo_attainment",
+            "Sliding-window SLO attainment over the longest configured window")
+    r.gauge("ddr_slo_burn_rate",
+            "SLO error-budget burn rate per sliding window (1.0 = spending "
+            "exactly the budget)", labels=("window",))
+    r.counter("ddr_slo_alerts_total",
+              "SLO fast-burn alert transitions", labels=("state",))
     r.counter("ddr_batches_total", "Executed micro-batches",
               labels=("network", "model"))
     r.histogram("ddr_batch_occupancy",
@@ -175,7 +193,7 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
     r.counter("ddr_events_total", "Telemetry events by type", labels=("event",)).inc(
         event=event
     )
-    if event in ("serve_request", "serve_batch", "serve_shed", "health") and (
+    if event in ("serve_request", "serve_batch", "serve_shed", "health", "slo") and (
         r.get("ddr_requests_total") is None  # declare once, not per event —
     ):  # the full declaration sweep is too heavy for the request hot path
         declare_serve_metrics(r)
@@ -224,6 +242,20 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
             r.get("ddr_request_latency_seconds").observe(
                 _get(record, "latency_s"), network=network, model=model
             )
+        # the lifecycle decomposition rides the same event: queue wait is
+        # observed for every terminal status that queued (sheds included —
+        # queue time under overload is exactly the signal), execution only
+        # for requests that actually ran
+        if record.get("queue_s") is not None:
+            r.get("ddr_serve_queue_seconds").observe(
+                _get(record, "queue_s"), network=network, model=model
+            )
+        if record.get("execute_s") is not None:
+            r.get("ddr_serve_execute_seconds").observe(
+                _get(record, "execute_s"), network=network, model=model
+            )
+    elif event == "slo":
+        r.get("ddr_slo_alerts_total").inc(state=str(record.get("state", "?")))
     elif event == "serve_batch":
         network = str(record.get("network", "?"))
         model = str(record.get("model", "?"))

@@ -86,6 +86,19 @@ class _Instrument:
                 for k, v in self._series.items()
             }
 
+    def remove(self, **labels: Any) -> bool:
+        """Drop one series by its label values (no-op False when absent).
+
+        For instruments tracking *entities* rather than streams — e.g.
+        ``ddr_model_version{model=...}`` after that model is unloaded — where
+        leaving the series would export a stale value forever. Counters and
+        histograms are cumulative by Prometheus contract; reserve this for
+        gauges whose subject no longer exists.
+        """
+        key = self._key(labels)
+        with self._lock:
+            return self._series.pop(key, None) is not None
+
 
 class Counter(_Instrument):
     """Monotonically increasing count (Prometheus ``counter``)."""

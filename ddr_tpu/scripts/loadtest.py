@@ -1,0 +1,631 @@
+"""``ddr loadtest`` — load generation + latency/SLO reporting for the serving tier.
+
+ROADMAP item 3's proof harness: drive a forecast service hard enough to see
+its real p50/p99, where the time goes (queue wait vs device execution — the
+request-tracing decomposition the serving layer now reports per request), what
+it sheds under pressure and why, and whether the SLO held. Two generator
+shapes, the standard pair from serving benchmarks:
+
+- **open loop** (``--mode open``, default): Poisson arrivals at ``--rps`` —
+  arrival times don't depend on completions, so queueing delay is *measured*,
+  not hidden (a closed loop self-throttles exactly when the service slows
+  down: coordinated omission). In-flight concurrency is capped at
+  ``--max-inflight``; past the cap, arrivals wait client-side, and that wait
+  counts into the request's measured latency (the clock starts at the
+  *scheduled* arrival, so a backed-up client can't hide server slowness).
+- **closed loop** (``--mode closed``): ``--clients`` concurrent synchronous
+  clients, each firing its next request when the last returns — the shape of K
+  well-behaved downstream consumers, and the right mode for "how many
+  forecasts/s can N clients sustain".
+
+Targets: a live HTTP server (``--url http://host:port``), a config-built
+in-process service (``ddr loadtest config.yaml``), or ``--synthetic`` (a
+synthetic basin service built in-process — no data needed; the smoke-test
+path). The report is one flat BENCH-style JSON record written to
+``LOADTEST_<label>.json`` (and printed as the last stdout line), so
+``scripts/check_bench_regression.py`` gates serving latency/SLO drift exactly
+the way it gates routing throughput: latency/shed fields warn when they GROW,
+throughput/attainment when they DROP.
+
+Usage::
+
+    ddr loadtest --synthetic --rps 50 --duration 10
+    ddr loadtest --url http://127.0.0.1:8080 --mode closed --clients 16
+    ddr loadtest config.yaml --rps 200 --deadline-ms 500 --out runs/lt
+
+With ``DDR_METRICS_DIR`` set (or an in-process target, whose config carries a
+``save_path``), the run also writes ``run_log.loadtest.jsonl`` — watch it live
+with ``ddr metrics tail --follow``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from pathlib import Path
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+#: Latency quantiles every report carries, for each lifecycle phase.
+QUANTILES = (0.50, 0.95, 0.99)
+
+
+@dataclasses.dataclass
+class Outcome:
+    """One request's terminal result, as the *client* saw it."""
+
+    status: str  # "ok" | "rejected" | "shed:<reason>" | "error:<what>"
+    latency_s: float
+    queue_s: float | None = None  # server-reported queue wait (ok only)
+    execute_s: float | None = None  # server-reported device time (ok only)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Drivers: one fire(i) -> Outcome per target kind, plus a stats() snapshot.
+# ---------------------------------------------------------------------------
+
+
+class InProcessDriver:
+    """Drive a live :class:`~ddr_tpu.serving.service.ForecastService` directly
+    — full backpressure semantics, no sockets (the smoke/CI path)."""
+
+    def __init__(
+        self,
+        service: Any,
+        network: str = "default",
+        model: str = "default",
+        t0_span: int | None = None,
+        deadline_ms: float | None = None,
+    ) -> None:
+        self.service = service
+        self.network = network
+        self.model = model
+        self.deadline_ms = deadline_ms
+        net = service.networks()[network]
+        if t0_span is None:
+            t0_span = (
+                1 if net.forcing is None
+                else max(1, len(net.forcing) - net.horizon + 1)
+            )
+        self.t0_span = max(1, int(t0_span))
+        deadline_s = service.serve_cfg.deadline_s if deadline_ms is None else deadline_ms / 1e3
+        self._wait_s = deadline_s + 5.0
+
+    def fire(self, i: int) -> Outcome:
+        from ddr_tpu.serving import QueueFullError, RequestShedError
+
+        start = time.monotonic()
+        try:
+            out = self.service.forecast(
+                network=self.network,
+                model=self.model,
+                t0=i % self.t0_span,
+                deadline_s=None if self.deadline_ms is None else self.deadline_ms / 1e3,
+                request_id=f"lt-{i}",
+                timeout=self._wait_s,
+            )
+        except QueueFullError:
+            return Outcome("rejected", time.monotonic() - start)
+        except RequestShedError as e:
+            return Outcome(f"shed:{e.reason}", time.monotonic() - start)
+        except FutureTimeoutError:
+            return Outcome("error:timeout", time.monotonic() - start)
+        except Exception as e:  # noqa: BLE001 - an error is a data point here
+            return Outcome(f"error:{type(e).__name__}", time.monotonic() - start)
+        return Outcome(
+            "ok", time.monotonic() - start, out.get("queue_s"), out.get("execute_s")
+        )
+
+    def stats(self) -> dict:
+        return self.service.stats()
+
+
+class HttpDriver:
+    """Drive a running ``ddr serve`` over its JSON API. Error mapping rides
+    the machine-readable bodies: 429 -> rejected, 503+reason -> shed:<reason>."""
+
+    def __init__(
+        self,
+        url: str,
+        network: str = "default",
+        model: str = "default",
+        t0_span: int = 24,
+        deadline_ms: float | None = None,
+        timeout_s: float = 60.0,
+    ) -> None:
+        from ddr_tpu.serving.client import HttpForecastClient
+
+        self.client = HttpForecastClient(url, timeout=timeout_s)
+        self.network = network
+        self.model = model
+        self.t0_span = max(1, int(t0_span))
+        self.deadline_ms = deadline_ms
+
+    def fire(self, i: int) -> Outcome:
+        start = time.monotonic()
+        try:
+            code, body = self.client.forecast_response(
+                self.network,
+                model=self.model,
+                t0=i % self.t0_span,
+                deadline_ms=self.deadline_ms,
+                request_id=f"lt-{i}",
+            )
+        except Exception as e:  # URLError, socket timeouts, connection resets
+            return Outcome(f"error:{type(e).__name__}", time.monotonic() - start)
+        lat = time.monotonic() - start
+        if code == 200:
+            return Outcome("ok", lat, body.get("queue_s"), body.get("execute_s"))
+        if code == 429:
+            return Outcome("rejected", lat)
+        reason = body.get("reason")
+        if code == 503 and reason:
+            return Outcome(f"shed:{reason}", lat)
+        return Outcome(f"error:http-{code}", lat)
+
+    def stats(self) -> dict:
+        try:
+            return self.client.stats()
+        except Exception:  # a stats failure must not void the measured run
+            log.warning("could not fetch /v1/stats from the target", exc_info=True)
+            return {}
+
+
+# ---------------------------------------------------------------------------
+# Generators.
+# ---------------------------------------------------------------------------
+
+
+def run_open_loop(
+    fire: Callable[[int], Outcome],
+    rps: float,
+    duration_s: float,
+    seed: int = 0,
+    max_inflight: int = 64,
+) -> tuple[list[Outcome], float, int]:
+    """Poisson arrivals at ``rps`` for ``duration_s``; returns ``(outcomes,
+    wall_s, offered)``. ``wall_s`` spans first arrival to last completion (the
+    drain tail is real service time and counts against throughput)."""
+    if rps <= 0:
+        raise ValueError(f"rps must be > 0, got {rps}")
+    rng = random.Random(seed)
+    outcomes: list[Outcome] = []
+    lock = threading.Lock()
+
+    def job(i: int, t_sched: float) -> None:
+        # latency is measured from the SCHEDULED arrival: time spent waiting
+        # for a free worker past --max-inflight is real client-observed
+        # latency under overload, not something to hide (coordinated omission)
+        wait = time.monotonic() - t_sched
+        o = fire(i)
+        if wait > 0:
+            o.latency_s += wait
+        with lock:
+            outcomes.append(o)
+
+    start = time.monotonic()
+    i = 0
+    with ThreadPoolExecutor(
+        max_workers=max(1, int(max_inflight)), thread_name_prefix="ddr-loadtest"
+    ) as pool:
+        t_next = start
+        while t_next - start < duration_s:
+            delay = t_next - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            pool.submit(job, i, t_next)
+            i += 1
+            t_next += rng.expovariate(rps)
+        # pool __exit__ drains in-flight requests before the clock stops
+    return outcomes, time.monotonic() - start, i
+
+
+def run_closed_loop(
+    fire: Callable[[int], Outcome],
+    clients: int,
+    duration_s: float,
+) -> tuple[list[Outcome], float, int]:
+    """``clients`` synchronous workers, each firing back-to-back until the
+    duration elapses (in-flight requests complete); same return shape as
+    :func:`run_open_loop`."""
+    if clients < 1:
+        raise ValueError(f"clients must be >= 1, got {clients}")
+    outcomes: list[Outcome] = []
+    lock = threading.Lock()
+    counter = [0]
+    start = time.monotonic()
+    stop_at = start + duration_s
+
+    def worker() -> None:
+        while True:
+            with lock:
+                if time.monotonic() >= stop_at:
+                    return
+                i = counter[0]
+                counter[0] += 1
+            o = fire(i)
+            with lock:
+                outcomes.append(o)
+
+    threads = [
+        threading.Thread(target=worker, name=f"ddr-loadtest-{c}")
+        for c in range(int(clients))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outcomes, time.monotonic() - start, counter[0]
+
+
+# ---------------------------------------------------------------------------
+# Report.
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    """None on an empty sample (null in the JSON report); otherwise the same
+    nearest-rank formula ``ddr metrics summarize`` uses — one definition, so
+    the report and the log replay can never disagree on a quantile."""
+    if not sorted_vals:
+        return None
+    from ddr_tpu.observability.metrics_cli import _percentile as nearest_rank
+
+    return nearest_rank(sorted_vals, q)
+
+
+def _quantile_fields(values: list[float], prefix: str) -> dict[str, float | None]:
+    """``{<prefix>p50_ms: ..., <prefix>p95_ms: ..., <prefix>p99_ms: ...}``."""
+    vals = sorted(values)
+    out: dict[str, float | None] = {}
+    for q in QUANTILES:
+        v = _percentile(vals, q)
+        out[f"{prefix}p{int(100 * q)}_ms"] = None if v is None else round(1e3 * v, 3)
+    return out
+
+
+def build_report(
+    outcomes: list[Outcome],
+    wall_s: float,
+    offered: int,
+    stats_before: dict | None = None,
+    stats_after: dict | None = None,
+    **meta: Any,
+) -> dict[str, Any]:
+    """One flat BENCH-style record from a measured run: latency quantiles per
+    lifecycle phase, throughput, shed/reject/error rates by reason, batch
+    occupancy (from the service's own counters), and SLO attainment/burn."""
+    total = len(outcomes)
+    oks = [o for o in outcomes if o.ok]
+    sheds_by_reason: dict[str, int] = {}
+    rejected = errors = 0
+    for o in outcomes:
+        if o.status == "rejected":
+            rejected += 1
+        elif o.status.startswith("shed:"):
+            reason = o.status.split(":", 1)[1]
+            sheds_by_reason[reason] = sheds_by_reason.get(reason, 0) + 1
+        elif o.status.startswith("error:"):
+            errors += 1
+    shed = sum(sheds_by_reason.values())
+    denom = max(1, total)
+    wall_s = max(wall_s, 1e-9)
+
+    report: dict[str, Any] = {
+        "kind": "loadtest",
+        "schema_version": 1,
+        **meta,
+        "wall_s": round(wall_s, 3),
+        "offered": offered,
+        "offered_rps": round(offered / wall_s, 3),
+        "requests": total,
+        "ok": len(oks),
+        "rejected": rejected,
+        "shed": shed,
+        "errors": errors,
+        "sheds_by_reason": sheds_by_reason,
+        "throughput_rps": round(len(oks) / wall_s, 3),
+        "shed_rate": round(shed / denom, 6),
+        "reject_rate": round(rejected / denom, 6),
+        "error_rate": round(errors / denom, 6),
+        **_quantile_fields([o.latency_s for o in oks], ""),
+        **_quantile_fields([o.queue_s for o in oks if o.queue_s is not None], "queue_"),
+        **_quantile_fields(
+            [o.execute_s for o in oks if o.execute_s is not None], "execute_"
+        ),
+    }
+
+    # batch occupancy from the service's own counters (the delta over the run)
+    mean_size = occupancy = None
+    try:
+        qb = (stats_before or {}).get("queue") or {}
+        qa = (stats_after or {}).get("queue") or {}
+        served = qa.get("served", 0) - qb.get("served", 0)
+        batches = qa.get("batches", 0) - qb.get("batches", 0)
+        max_batch = ((stats_after or {}).get("config") or {}).get("max_batch")
+        if batches > 0:
+            mean_size = round(served / batches, 3)
+            if max_batch:
+                occupancy = round(mean_size / max_batch, 4)
+    except TypeError:
+        pass
+    report["mean_batch_size"] = mean_size
+    report["mean_batch_occupancy"] = occupancy
+
+    # SLO: the server's own tracker when reachable (it saw the same requests)
+    # — as the DELTA of its lifetime counters over the run, so a long-lived
+    # target's prior traffic (and our unmeasured priming request) can't
+    # pollute this run's attainment; else the client-side good fraction
+    slo = (stats_after or {}).get("slo") or {}
+    slo_before = (stats_before or {}).get("slo") or {}
+    report["slo_target"] = slo.get("target")
+    att = None
+    after_l = slo.get("lifetime") or {}
+    before_l = slo_before.get("lifetime") or {}
+    if isinstance(after_l.get("total"), int):
+        d_total = after_l["total"] - (before_l.get("total") or 0)
+        d_good = (after_l.get("good") or 0) - (before_l.get("good") or 0)
+        if d_total > 0:
+            att = round(d_good / d_total, 6)
+    if att is None and total:
+        att = round(len(oks) / denom, 6)
+    report["slo_attainment"] = att
+    report["slo_burn_rates"] = {
+        w: v.get("burn_rate") for w, v in (slo.get("windows") or {}).items()
+    }
+    return report
+
+
+def render_summary(report: dict[str, Any]) -> str:
+    """The human half: a few lines an operator reads before the JSON."""
+
+    def ms(key: str) -> str:
+        v = report.get(key)
+        return "-" if v is None else f"{v:.1f}"
+
+    lines = [
+        f"loadtest [{report.get('mode')}] {report.get('target')}: "
+        f"{report['requests']} requests in {report['wall_s']:.2f}s "
+        f"({report['offered_rps']:.1f} offered rps, "
+        f"{report['throughput_rps']:.1f} served rps)",
+        f"  latency  p50 {ms('p50_ms')}ms  p95 {ms('p95_ms')}ms  p99 {ms('p99_ms')}ms",
+        f"  queue    p50 {ms('queue_p50_ms')}ms  p95 {ms('queue_p95_ms')}ms  "
+        f"p99 {ms('queue_p99_ms')}ms",
+        f"  execute  p50 {ms('execute_p50_ms')}ms  p95 {ms('execute_p95_ms')}ms  "
+        f"p99 {ms('execute_p99_ms')}ms",
+    ]
+    drops = []
+    if report["rejected"]:
+        drops.append(f"rejected {report['rejected']}")
+    for reason, n in sorted((report.get("sheds_by_reason") or {}).items()):
+        drops.append(f"shed:{reason} {n}")
+    if report["errors"]:
+        drops.append(f"errors {report['errors']}")
+    lines.append("  drops    " + (", ".join(drops) if drops else "none"))
+    att = report.get("slo_attainment")
+    target = report.get("slo_target")
+    slo_line = "  slo      " + ("-" if att is None else f"attainment {100 * att:.2f}%")
+    if target is not None:
+        slo_line += f" (target {100 * target:.1f}%)"
+    burns = {
+        w: b for w, b in (report.get("slo_burn_rates") or {}).items() if b is not None
+    }
+    if burns:
+        from ddr_tpu.observability.slo import parse_window_label
+
+        def _window_seconds(name: str) -> float:
+            secs = parse_window_label(name)
+            return float("inf") if secs is None else secs
+
+        slo_line += "  burn " + "  ".join(
+            f"{w} {b:.2f}x" for w, b in sorted(burns.items(), key=lambda kv: _window_seconds(kv[0]))
+        )
+    lines.append(slo_line)
+    occ = report.get("mean_batch_occupancy")
+    if occ is not None:
+        lines.append(
+            f"  batches  mean size {report['mean_batch_size']}  occupancy {100 * occ:.0f}%"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Target construction + CLI.
+# ---------------------------------------------------------------------------
+
+
+def build_synthetic_service(
+    n: int, horizon: int, save_path: str, serve_overrides: dict | None = None
+):
+    """A warmed ForecastService over a synthetic basin — the zero-data target
+    (``--synthetic``); returns ``(service, cfg)``."""
+    from ddr_tpu.geodatazoo.synthetic import make_basin
+    from ddr_tpu.scripts.common import build_kan, kan_arch
+    from ddr_tpu.serving import ForecastService, ServeConfig
+    from ddr_tpu.validation.configs import Config
+
+    cfg = Config(
+        name="loadtest",
+        geodataset="synthetic",
+        mode="testing",
+        kan={"input_var_names": [f"a{i}" for i in range(10)]},
+        experiment={"start_time": "1981/10/01", "end_time": "1981/10/10"},
+        params={"save_path": str(save_path)},
+    )
+    n_days = max(2, -(-horizon // 24) + 1)  # at least one horizon of t0 slack
+    basin = make_basin(n_segments=n, n_gauges=4, n_days=n_days, seed=11)
+    service = ForecastService(
+        cfg, ServeConfig.from_env(horizon_hours=horizon, **(serve_overrides or {}))
+    )
+    service.register_network("default", basin.routing_data, forcing=basin.q_prime)
+    kan_model, params = build_kan(cfg)
+    service.register_model("default", kan_model, params, arch=kan_arch(cfg))
+    service.warmup()
+    return service, cfg
+
+
+def run_loadtest(driver, args_ns) -> dict[str, Any]:
+    """One measured run against a ready driver: prime, generate, report."""
+    # one unmeasured priming request: the first request after warmup still
+    # pays host-side one-time costs (tracer caches, thread spin-up) that a
+    # 2-second smoke run would otherwise book into its p99
+    driver.fire(0)
+    stats_before = driver.stats()
+    if args_ns.mode == "open":
+        outcomes, wall, offered = run_open_loop(
+            driver.fire, args_ns.rps, args_ns.duration,
+            seed=args_ns.seed, max_inflight=args_ns.max_inflight,
+        )
+    else:
+        outcomes, wall, offered = run_closed_loop(
+            driver.fire, args_ns.clients, args_ns.duration
+        )
+    stats_after = driver.stats()
+    device = None
+    import sys as _sys
+
+    jax = _sys.modules.get("jax")
+    if jax is not None:
+        try:
+            device = str(jax.devices()[0].platform)
+        except Exception:
+            device = None
+    return build_report(
+        outcomes, wall, offered,
+        stats_before=stats_before, stats_after=stats_after,
+        mode=args_ns.mode,
+        target=args_ns.url or ("synthetic" if args_ns.synthetic else "config"),
+        device=device,
+        rps_target=args_ns.rps if args_ns.mode == "open" else None,
+        clients=args_ns.clients if args_ns.mode == "closed" else None,
+        duration_s=args_ns.duration,
+        network=args_ns.network,
+        model=args_ns.model,
+        deadline_ms=args_ns.deadline_ms,
+        seed=args_ns.seed,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ddr loadtest",
+        description="Open/closed-loop load generation against a forecast "
+        "service (HTTP or in-process); writes a LOADTEST_*.json latency/SLO "
+        "report check_bench_regression.py can gate on.",
+    )
+    parser.add_argument(
+        "config", nargs="*",
+        help="optional config.yaml plus a.b=c overrides for an in-process "
+        "service (ignored with --url/--synthetic)",
+    )
+    parser.add_argument("--url", default=None,
+                        help="drive a live ddr serve at this base URL instead")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="drive an in-process service over a synthetic basin")
+    parser.add_argument("--n", type=int, default=512,
+                        help="synthetic reach count (default 512)")
+    parser.add_argument("--horizon", type=int, default=24,
+                        help="synthetic forecast horizon, hours (default 24)")
+    parser.add_argument("--network", default="default")
+    parser.add_argument("--model", default="default")
+    parser.add_argument("--mode", choices=("open", "closed"), default="open")
+    parser.add_argument("--rps", type=float, default=20.0,
+                        help="open-loop target arrival rate (default 20)")
+    parser.add_argument("--clients", type=int, default=8,
+                        help="closed-loop concurrent clients (default 8)")
+    parser.add_argument("--duration", type=float, default=5.0,
+                        help="generation window, seconds (default 5)")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="per-request deadline override, milliseconds")
+    parser.add_argument("--t0-span", type=int, default=None,
+                        help="cycle request t0 over this many hourly offsets "
+                        "(default: the registered forcing's full span; 24 for --url)")
+    parser.add_argument("--max-inflight", type=int, default=64,
+                        help="open-loop in-flight request cap (default 64)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="arrival-process RNG seed (default 0)")
+    parser.add_argument("--label", default=None,
+                        help="report name suffix (LOADTEST_<label>.json; "
+                        "default: a timestamp)")
+    parser.add_argument("--out", default=None,
+                        help="report directory (default: DDR_METRICS_DIR or .)")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
+        return int(e.code or 0)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    from ddr_tpu.observability import run_telemetry
+
+    out_dir = Path(args.out or os.environ.get("DDR_METRICS_DIR") or ".")
+    out_dir.mkdir(parents=True, exist_ok=True)
+    label = args.label or time.strftime("%Y%m%d-%H%M%S")
+
+    service = None
+    cfg = None
+    try:
+        if args.url:
+            driver = HttpDriver(
+                args.url, network=args.network, model=args.model,
+                t0_span=24 if args.t0_span is None else args.t0_span,
+                deadline_ms=args.deadline_ms,
+            )
+        else:
+            from ddr_tpu.scripts.common import apply_compile_cache_env
+
+            apply_compile_cache_env()
+            if args.synthetic or not args.config:
+                service, cfg = build_synthetic_service(
+                    args.n, args.horizon, save_path=str(out_dir)
+                )
+            else:
+                from ddr_tpu.scripts.common import parse_cli, split_config_argv
+                from ddr_tpu.scripts.serve import build_service
+
+                path, overrides = split_config_argv(args.config)
+                cfg = parse_cli(
+                    [path, *overrides] if path else overrides, mode="testing"
+                )
+                service = build_service(cfg, watch=False)
+            driver = InProcessDriver(
+                service, network=args.network, model=args.model,
+                t0_span=args.t0_span, deadline_ms=args.deadline_ms,
+            )
+        with run_telemetry(cfg, "loadtest", mode=args.mode):
+            try:
+                report = run_loadtest(driver, args)
+            finally:
+                # close INSIDE the telemetry context: close() merges the
+                # serve/SLO rollup into run_end, which needs a live recorder
+                if service is not None:
+                    service.close(drain=False)
+                    service = None
+    finally:
+        if service is not None:  # construction failed before the run
+            service.close(drain=False)
+
+    path = out_dir / f"LOADTEST_{label}.json"
+    path.write_text(json.dumps(report, indent=2) + "\n")
+    log.info(f"loadtest report written to {path}")
+    print(render_summary(report))
+    print(json.dumps(report))  # last stdout line stays machine-parseable
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,7 +23,7 @@ from ddr_tpu.serving.batcher import (
 from ddr_tpu.serving.client import ForecastClient, HttpForecastClient
 from ddr_tpu.serving.config import BACKPRESSURE_POLICIES, ServeConfig
 from ddr_tpu.serving.registry import CheckpointWatcher, ModelEntry, ModelRegistry
-from ddr_tpu.serving.service import ForecastService, NetworkEntry
+from ddr_tpu.serving.service import ForecastService, NetworkEntry, make_request_id
 
 __all__ = [
     "BACKPRESSURE_POLICIES",
@@ -39,4 +39,5 @@ __all__ = [
     "QueueFullError",
     "RequestShedError",
     "ServeConfig",
+    "make_request_id",
 ]

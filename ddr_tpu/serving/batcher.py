@@ -13,7 +13,11 @@ Scheduling policy:
 
 - one bounded FIFO queue (``queue_cap``); a full queue triggers the configured
   backpressure: ``reject-new`` fails the arriving request, ``shed-oldest``
-  fails the queue head and admits the arrival;
+  fails the queue head and admits the arrival, ``shed-by-deadline`` fails the
+  queued request with the EARLIEST deadline (the one already most likely to
+  miss it — ties by oldest admission; no-deadline requests are never preferred
+  victims, and an arrival whose own deadline is the earliest is rejected
+  instead of admitted);
 - the worker takes the queue head, holds its batch open up to ``batch_wait_s``
   for more requests with the SAME batch key (network, model), caps at
   ``max_batch``, and preserves FIFO order across keys — a burst on network A
@@ -39,17 +43,25 @@ __all__ = ["QueueFullError", "RequestShedError", "ForecastRequest", "MicroBatche
 
 
 class QueueFullError(RuntimeError):
-    """Raised to the submitter (reject-new) or set on the victim's future
-    (shed-oldest) when the bounded queue is at capacity."""
+    """Raised to the submitter when the bounded queue is at capacity and the
+    policy rejects the arrival (always under reject-new; under
+    shed-by-deadline when the arrival itself holds the earliest deadline).
+    ``request_id`` is stamped by the service so HTTP 429 bodies can echo it."""
+
+    request_id: str | None = None
 
 
 class RequestShedError(RuntimeError):
     """Set on a request's future when it is shed (queue-full victim or expired
-    deadline); carries the machine-readable reason."""
+    deadline); carries the machine-readable reason and the victim's request id
+    (when the submitter stamped one in ``meta``) for error-body echo."""
 
-    def __init__(self, reason: str, message: str) -> None:
+    def __init__(
+        self, reason: str, message: str, request_id: str | None = None
+    ) -> None:
         super().__init__(message)
         self.reason = reason
+        self.request_id = request_id
 
 
 @dataclasses.dataclass
@@ -62,6 +74,7 @@ class ForecastRequest:
     future: Future = dataclasses.field(default_factory=Future)
     meta: dict = dataclasses.field(default_factory=dict)
     admitted: float = 0.0  # monotonic seconds, stamped by admit()
+    extracted: float = 0.0  # monotonic seconds, stamped at batch extraction
     deadline: float | None = None  # monotonic seconds, None = no deadline
 
     def age(self, now: float | None = None) -> float:
@@ -86,7 +99,9 @@ class MicroBatcher:
         backpressure: str = "reject-new",
         on_shed: Callable[[ForecastRequest, str], None] | None = None,
     ) -> None:
-        if backpressure not in ("reject-new", "shed-oldest"):
+        from ddr_tpu.serving.config import BACKPRESSURE_POLICIES
+
+        if backpressure not in BACKPRESSURE_POLICIES:
             raise ValueError(f"unknown backpressure policy {backpressure!r}")
         self._execute = execute
         self.max_batch = int(max_batch)
@@ -120,7 +135,31 @@ class MicroBatcher:
                     raise QueueFullError(
                         f"queue at capacity ({self.queue_cap}); request rejected"
                     )
-                victim = self._q.pop(0)
+                if self.backpressure == "shed-oldest":
+                    victim = self._q.pop(0)
+                else:  # shed-by-deadline: earliest deadline loses, not oldest
+                    idx = min(
+                        range(len(self._q)),
+                        key=lambda i: (
+                            self._q[i].deadline is None,  # no deadline sorts last
+                            self._q[i].deadline or 0.0,
+                            self._q[i].admitted,
+                        ),
+                    )
+                    cand = self._q[idx]
+                    if req.deadline is not None and (
+                        cand.deadline is None or req.deadline < cand.deadline
+                    ):
+                        # the arrival itself is the most-doomed request: reject
+                        # it rather than admit-then-shed (keeps the 429 at the
+                        # edge, where the caller can back off)
+                        self._stats["rejected"] += 1
+                        raise QueueFullError(
+                            f"queue at capacity ({self.queue_cap}) and the "
+                            "arriving request holds the earliest deadline; "
+                            "request rejected"
+                        )
+                    victim = self._q.pop(idx)
                 self._stats["shed"] += 1
             req.admitted = time.monotonic()
             self._q.append(req)
@@ -130,8 +169,33 @@ class MicroBatcher:
             self._fail_shed(victim, "queue-full")
         return req
 
+    def purge(self, predicate, reason: str) -> int:
+        """Shed every QUEUED request matching ``predicate`` with ``reason``;
+        returns the victim count. For administrative removals — e.g. a model
+        unload must fail its queued requests cleanly (a shed with a reason)
+        rather than let them die later on an unknown-model lookup. In-flight
+        batches are untouched: they hold their snapshots and finish."""
+        with self._cond:
+            # one predicate pass splits the queue — never request equality,
+            # which would compare numpy payloads (ambiguous-truth ValueError)
+            victims: list[ForecastRequest] = []
+            survivors: list[ForecastRequest] = []
+            for r in self._q:
+                (victims if predicate(r) else survivors).append(r)
+            if victims:
+                self._q = survivors
+                self._stats["shed"] += len(victims)
+                self._cond.notify_all()
+        for r in victims:
+            self._fail_shed(r, reason)
+        return len(victims)
+
     def _fail_shed(self, req: ForecastRequest, reason: str) -> None:
-        err = RequestShedError(reason, f"request shed ({reason})")
+        err = RequestShedError(
+            reason,
+            f"request shed ({reason})",
+            request_id=req.meta.get("request_id"),
+        )
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(err)
         if self._on_shed is not None:
@@ -175,6 +239,10 @@ class MicroBatcher:
             now = time.monotonic()
             live: list[ForecastRequest] = []
             for r in batch:
+                # extraction closes the queue-wait phase for every batch
+                # member, shed-at-extraction included (its queue wait is the
+                # whole story of why it died)
+                r.extracted = now
                 if r.deadline is not None and now > r.deadline:
                     with self._cond:
                         self._stats["shed"] += 1

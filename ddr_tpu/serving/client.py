@@ -82,7 +82,7 @@ class HttpForecastClient:
             raise RuntimeError(f"/v1/stats -> {code}: {body}")
         return body
 
-    def forecast(
+    def forecast_response(
         self,
         network: str,
         model: str = "default",
@@ -90,9 +90,13 @@ class HttpForecastClient:
         t0: int | None = None,
         gauges: list[int] | None = None,
         deadline_ms: float | None = None,
-    ) -> dict:
-        """POST /v1/forecast; raises RuntimeError with the server's error body
-        on any non-200. ``runoff`` comes back as a numpy array."""
+        request_id: str | None = None,
+    ) -> tuple[int, dict]:
+        """POST /v1/forecast; returns ``(status_code, body)`` without raising
+        on HTTP errors — the load-generation path, where a 429/503 is a data
+        point, not an exception. Error bodies are machine-readable
+        (``reason``, ``request_id``); ``request_id`` rides out as the
+        ``X-DDR-Request-Id`` header and is echoed back by the server."""
         body: dict[str, Any] = {"network": network, "model": model}
         if q_prime is not None:
             body["q_prime"] = np.asarray(q_prime, dtype=np.float32).tolist()
@@ -102,20 +106,45 @@ class HttpForecastClient:
             body["gauges"] = [int(g) for g in gauges]
         if deadline_ms is not None:
             body["deadline_ms"] = float(deadline_ms)
-        data = json.dumps(body).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-DDR-Request-Id"] = str(request_id)
         req = urllib.request.Request(
             self.base_url + "/v1/forecast",
-            data=data,
-            headers={"Content-Type": "application/json"},
+            data=json.dumps(body).encode("utf-8"),
+            headers=headers,
             method="POST",
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                out = json.loads(resp.read())
+                return resp.status, json.loads(resp.read())
         except urllib.error.HTTPError as e:
-            detail = json.loads(e.read() or b"{}")
-            raise RuntimeError(
-                f"forecast failed ({e.code}): {detail.get('error', detail)}"
-            ) from e
+            try:
+                detail = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                detail = {}
+            return e.code, detail
+
+    def forecast(
+        self,
+        network: str,
+        model: str = "default",
+        q_prime: Any | None = None,
+        t0: int | None = None,
+        gauges: list[int] | None = None,
+        deadline_ms: float | None = None,
+        request_id: str | None = None,
+    ) -> dict:
+        """POST /v1/forecast; raises RuntimeError with the server's error body
+        on any non-200. ``runoff`` comes back as a numpy array. Same explicit
+        signature as before request tracing — positional ``model`` callers
+        and kwarg typos keep failing at the call site, not inside the wire
+        layer."""
+        code, out = self.forecast_response(
+            network, model=model, q_prime=q_prime, t0=t0, gauges=gauges,
+            deadline_ms=deadline_ms, request_id=request_id,
+        )
+        if code != 200:
+            raise RuntimeError(f"forecast failed ({code}): {out.get('error', out)}")
         out["runoff"] = np.asarray(out["runoff"], dtype=np.float32)
         return out

@@ -22,8 +22,14 @@ __all__ = ["BACKPRESSURE_POLICIES", "ServeConfig"]
 #:   rejection and can back off — the default, load is pushed to the edge);
 #: - ``shed-oldest``: the oldest queued request is failed and the new one
 #:   admitted (freshness wins — right for forecast traffic where a stale
-#:   request's answer is about to be superseded anyway).
-BACKPRESSURE_POLICIES = ("reject-new", "shed-oldest")
+#:   request's answer is about to be superseded anyway);
+#: - ``shed-by-deadline``: the queued request with the EARLIEST deadline is
+#:   failed (ties by oldest admission; requests without a deadline are never
+#:   preferred victims). Deadline-aware overload: the victim is the request
+#:   already most likely to be shed at extraction anyway, so capacity goes to
+#:   requests that can still make their promise. An arrival whose own deadline
+#:   is the earliest is rejected instead of admitted.
+BACKPRESSURE_POLICIES = ("reject-new", "shed-oldest", "shed-by-deadline")
 
 _ENV_PREFIX = "DDR_SERVE_"
 

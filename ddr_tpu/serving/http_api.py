@@ -22,7 +22,15 @@ Endpoints (all JSON unless noted):
   computed alone — no full stats snapshot per poll);
 - ``POST /v1/forecast`` — body ``{"network": str, "model"?: str, "q_prime"?:
   [[...]], "t0"?: int, "gauges"?: [int], "deadline_ms"?: num}``; answers
-  ``{"runoff": [[...]], "version": int, "engine": str, ...}``;
+  ``{"runoff": [[...]], "version": int, "engine": str, "request_id": str,
+  "queue_s": num, "execute_s": num, ...}``. Request tracing: a caller-supplied
+  ``X-DDR-Request-Id`` header is sanitized and adopted as the request's trace
+  id (else one is minted at admission); EVERY forecast-path response — success,
+  400/404 validation, 429 rejection, 503 shed — echoes it in the
+  ``X-DDR-Request-Id`` header and carries ``request_id`` in the JSON body, and
+  shed/reject bodies additionally carry a machine-readable ``reason``
+  (``queue-full``, ``deadline``, ``timeout``) so clients can branch without
+  parsing prose;
 - ``POST /v1/profile?seconds=N`` — start an on-demand ``jax.profiler``
   capture of live traffic into ``DDR_METRICS_DIR`` (fallbacks: the active
   run-log directory, then a tmpdir); answers 202 with the trace dir, 409
@@ -46,7 +54,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from ddr_tpu.serving.batcher import QueueFullError, RequestShedError
-from ddr_tpu.serving.service import ForecastService
+from ddr_tpu.serving.service import ForecastService, make_request_id
 
 log = logging.getLogger(__name__)
 
@@ -139,24 +147,37 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route for {self.path}"})
             return
         svc = self.server.service
+        # the trace id exists from the first byte: a caller-supplied
+        # X-DDR-Request-Id is adopted (sanitized), else minted here, and every
+        # response on this path — including validation/reject/shed errors —
+        # echoes it (header + body), so the edge can always join its logs to
+        # the server's serve_request events
+        rid = make_request_id(self.headers.get("X-DDR-Request-Id"))
+
+        def send(code: int, payload: dict, headers: dict | None = None) -> None:
+            payload.setdefault("request_id", rid)
+            self._send(
+                code, payload, headers={"X-DDR-Request-Id": rid, **(headers or {})}
+            )
+
         if not svc.ready:
-            self._send(503, {"error": "service is warming up", "status": "warming"})
+            send(503, {"error": "service is warming up", "status": "warming"})
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
-            self._send(400, {"error": "bad Content-Length"})
+            send(400, {"error": "bad Content-Length"})
             return
         if length <= 0 or length > MAX_BODY_BYTES:
-            self._send(400, {"error": f"body must be 1..{MAX_BODY_BYTES} bytes"})
+            send(400, {"error": f"body must be 1..{MAX_BODY_BYTES} bytes"})
             return
         try:
             body = json.loads(self.rfile.read(length))
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
-            self._send(400, {"error": f"invalid JSON body: {e}"})
+            send(400, {"error": f"invalid JSON body: {e}"})
             return
         if not isinstance(body, dict) or "network" not in body:
-            self._send(400, {"error": 'body must be an object with "network"'})
+            send(400, {"error": 'body must be an object with "network"'})
             return
         deadline_ms = body.get("deadline_ms")
         try:
@@ -167,21 +188,26 @@ class _Handler(BaseHTTPRequestHandler):
                 t0=body.get("t0"),
                 gauges=body.get("gauges"),
                 deadline_s=None if deadline_ms is None else float(deadline_ms) / 1e3,
+                request_id=rid,
             )
         except QueueFullError as e:
-            self._send(429, {"error": str(e)}, headers={"Retry-After": "1"})
+            send(
+                429,
+                {"error": str(e), "reason": "queue-full"},
+                headers={"Retry-After": "1"},
+            )
             return
         except KeyError as e:
-            self._send(404, {"error": f"unknown model {e}"})
+            send(404, {"error": f"unknown model {e}"})
             return
         except ValueError as e:
             code = 404 if "unknown network" in str(e) else 400
-            self._send(code, {"error": str(e)})
+            send(code, {"error": str(e)})
             return
         except TypeError as e:
             # np.asarray raises TypeError (not ValueError) for e.g. a dict
             # q_prime — still a malformed request, not a server error
-            self._send(400, {"error": f"malformed request value: {e}"})
+            send(400, {"error": f"malformed request value: {e}"})
             return
         try:
             # wait slightly past the request deadline: the batcher sheds
@@ -190,17 +216,17 @@ class _Handler(BaseHTTPRequestHandler):
                     else svc.serve_cfg.deadline_s) + 5.0
             result = fut.result(timeout=wait)
         except RequestShedError as e:
-            self._send(503, {"error": str(e), "reason": e.reason})
+            send(503, {"error": str(e), "reason": e.reason})
             return
         except FutureTimeoutError:
-            self._send(503, {"error": "request timed out in service"})
+            send(503, {"error": "request timed out in service", "reason": "timeout"})
             return
         except Exception as e:  # executor failure surfaced on the future
-            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            send(500, {"error": f"{type(e).__name__}: {e}"})
             return
         result = dict(result)
         result["runoff"] = np.asarray(result["runoff"]).tolist()
-        self._send(200, result)
+        send(200, result)
 
     def _post_profile(self) -> None:
         """``POST /v1/profile?seconds=N``: capture a ``jax.profiler`` trace of

@@ -103,6 +103,22 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._entries)
 
+    def unregister(self, name: str) -> ModelEntry:
+        """Remove ``name`` (and stop its checkpoint watchers); returns the
+        removed entry. In-flight batches holding a snapshot finish on it —
+        removal only stops NEW lookups."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(f"unknown model {name!r}")
+            del self._entries[name]
+            watchers = [w for w in self._watchers if w._model == name]
+            self._watchers = [w for w in self._watchers if w._model != name]
+        for w in watchers:
+            w.stop()
+        log.info(f"model {name!r} unregistered (was version {entry.version})")
+        return entry
+
     def swap_params(self, name: str, params: Any, source: str | None = None) -> ModelEntry:
         """Atomically replace ``name``'s params; returns the new entry.
 

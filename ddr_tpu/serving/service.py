@@ -28,7 +28,16 @@ Every admit/batch/serve/shed decision is a JSONL event (``serve_request``,
 ``serve_batch``, ``serve_shed`` — docs/observability.md) on the active
 recorder, so ``ddr metrics summarize`` reports request latency percentiles and
 batch occupancy with no extra wiring; the same decisions feed the live
-Prometheus registry (``GET /metrics``). Every executed batch additionally
+Prometheus registry (``GET /metrics``). Each request carries a ``request_id``
+minted at admission (or supplied by the caller — the HTTP front accepts
+``X-DDR-Request-Id``) and monotonic lifecycle stamps, so every
+``serve_request`` event decomposes its latency into queue wait (admission →
+batch extraction) and device execution — all host-side bookkeeping, zero new
+jit-cache entries. A :class:`~ddr_tpu.observability.slo.SloTracker` folds each
+terminal decision into sliding-window SLO attainment and multi-window
+burn-rate gauges (``ddr_slo_attainment``, ``ddr_slo_burn_rate{window}``),
+emitting one ``slo`` event per fast-burn alert transition. Every executed
+batch additionally
 returns on-device numerical-health stats riding the compiled program's own
 outputs (:mod:`ddr_tpu.observability.health`): the host thresholds them,
 violating batches emit one ``health`` event each, and K consecutive
@@ -39,8 +48,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import re
 import threading
 import time
+import uuid
 from concurrent.futures import Future
 from typing import Any
 
@@ -49,6 +60,7 @@ import numpy as np
 from ddr_tpu.observability import CompileTracker, get_recorder, span
 from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
 from ddr_tpu.observability.prometheus import declare_serve_metrics, event_tee
+from ddr_tpu.observability.slo import SloConfig, SloTracker
 from ddr_tpu.serving.batcher import (
     ForecastRequest,
     MicroBatcher,
@@ -60,7 +72,28 @@ from ddr_tpu.serving.registry import ModelRegistry
 
 log = logging.getLogger(__name__)
 
-__all__ = ["NetworkEntry", "ForecastService", "QueueFullError", "RequestShedError"]
+__all__ = [
+    "NetworkEntry",
+    "ForecastService",
+    "QueueFullError",
+    "RequestShedError",
+    "make_request_id",
+]
+
+#: Characters allowed in a caller-supplied request id (header-safe: visible
+#: ASCII only — anything else is stripped before the id is echoed anywhere).
+_REQUEST_ID_STRIP = re.compile(r"[^\x21-\x7e]")
+
+
+def make_request_id(supplied: Any = None) -> str:
+    """The request/trace id for one forecast: a sanitized caller-supplied id
+    (propagated tracing — the HTTP front reads ``X-DDR-Request-Id``), else a
+    fresh 16-hex-char mint. Always non-empty and safe to echo in headers."""
+    if supplied:
+        rid = _REQUEST_ID_STRIP.sub("", str(supplied))[:128]
+        if rid:
+            return rid
+    return uuid.uuid4().hex[:16]
 
 
 @dataclasses.dataclass
@@ -115,11 +148,18 @@ class ForecastService:
         cfg: Any,
         serve_cfg: ServeConfig | None = None,
         health_cfg: HealthConfig | None = None,
+        slo_cfg: SloConfig | None = None,
     ) -> None:
         self.cfg = cfg
         self.serve_cfg = serve_cfg or ServeConfig.from_env()
         self.registry = ModelRegistry()
         self.tracker = CompileTracker()
+        # SLO accounting (docs/serving.md "Request lifecycle & SLOs"): every
+        # terminal request decision is one good/bad observation; the tracker
+        # keeps sliding-window attainment + burn rates and the service mirrors
+        # them onto the ddr_slo_* gauges after each observation.
+        _slo_cfg = slo_cfg or SloConfig.from_env()
+        self.slo: SloTracker | None = SloTracker(_slo_cfg) if _slo_cfg.enabled else None
         # Numerical-health watchdog (docs/observability.md): every executed
         # batch's on-device HealthStats — riding the compiled program's
         # outputs — is thresholded host-side; K consecutive violations degrade
@@ -239,6 +279,27 @@ class ForecastService:
         self.metrics.get("ddr_model_version").set(entry.version, model=name)
         return entry
 
+    def unregister_model(self, name: str) -> None:
+        """Unload a model: drop its registry entry (and checkpoint watchers),
+        its compiled programs, its QUEUED requests (shed with reason
+        ``model-unloaded`` — validly-admitted requests must fail as a clean
+        shed, not a later unknown-model error mid-batch; a batch already
+        in flight finishes on its snapshot), and its per-model gauge series —
+        an unloaded model's ``ddr_model_version`` must not keep exporting the
+        last version forever (stale-gauge hygiene; counters stay, they are
+        cumulative by Prometheus contract). Remaining pairs stay warm."""
+        self.registry.unregister(name)  # raises KeyError on unknown names
+        self._batcher.purge(lambda r: r.key[1] == name, "model-unloaded")
+        with self._lock:
+            for key in [k for k in self._fns if k[1] == name]:
+                self._fns.pop(key, None)
+                self._program_cards.pop(key, None)
+        for metric in ("ddr_model_version",):
+            instrument = self.metrics.get(metric)
+            if instrument is not None:
+                instrument.remove(model=name)
+        log.info(f"unregistered model {name!r}")
+
     def watch_checkpoints(self, name: str, directory, poll_s: float | None = None):
         """Hot-reload ``name`` from the newest checkpoint under ``directory``
         (ServeConfig ``reload_poll_s`` cadence; 0 disables). Each applied
@@ -317,6 +378,7 @@ class ForecastService:
         t0: int | None = None,
         gauges: Any | None = None,
         deadline_s: float | None = None,
+        request_id: str | None = None,
     ) -> Future:
         """Admit one forecast request; returns its Future.
 
@@ -324,8 +386,10 @@ class ForecastService:
         or ``t0`` (an hourly offset into the network's registered forcing;
         default 0) selects the inflow window. ``gauges`` picks output columns
         (gauge indices when the network has a gauge set, reach indices
-        otherwise; default all). Invalid requests raise immediately —
-        validation failures are the caller's bug, not load."""
+        otherwise; default all). ``request_id`` propagates a caller's trace id
+        (sanitized); omitted, one is minted — either way it rides every event
+        and the result dict. Invalid requests raise immediately — validation
+        failures are the caller's bug, not load."""
         net = self._networks.get(network)
         if net is None:
             raise ValueError(f"unknown network {network!r}")
@@ -365,21 +429,24 @@ class ForecastService:
         deadline = time.monotonic() + (
             self.serve_cfg.deadline_s if deadline_s is None else float(deadline_s)
         )
+        rid = make_request_id(request_id)
         req = ForecastRequest(
             key=(network, model),
             payload={"q_prime": qp, "gauges": gauge_sel},
             deadline=deadline,
-            meta={"network": network, "model": model},
+            meta={"network": network, "model": model, "request_id": rid},
         )
         try:
             self._batcher.submit(req)
-        except QueueFullError:
+        except QueueFullError as e:
+            e.request_id = rid  # error bodies echo the id the caller sent
             self._emit(
                 "serve_shed",
                 reason="queue-full",
                 policy=self.serve_cfg.backpressure,
                 network=network,
                 model=model,
+                request_id=rid,
                 age_s=0.0,
             )
             self._emit(
@@ -387,8 +454,15 @@ class ForecastService:
                 status="shed:queue-full",
                 network=network,
                 model=model,
+                request_id=rid,
                 latency_s=0.0,
+                # None, not 0.0: a rejected arrival never queued, and a flood
+                # of zeros would deflate the queue-wait histogram exactly when
+                # its percentiles are the overload signal
+                queue_s=None,
+                slo_ok=False,
             )
+            self._observe_slo(False)
             raise
         return req.future
 
@@ -417,9 +491,21 @@ class ForecastService:
                     status=f"error:{type(e).__name__}",
                     network=r.meta.get("network"),
                     model=r.meta.get("model"),
+                    request_id=r.meta.get("request_id"),
                     latency_s=round(now - r.admitted, 6),
+                    queue_s=self._queue_seconds(r),
+                    slo_ok=False,
                 )
+                self._observe_slo(False)
             raise
+
+    @staticmethod
+    def _queue_seconds(r: ForecastRequest) -> float | None:
+        """Admission-to-extraction wait, or None when the request never left
+        the queue (queue-full victims — their ``age_s`` is the whole story)."""
+        if not r.extracted:
+            return None
+        return round(max(0.0, r.extracted - r.admitted), 6)
 
     def _execute_inner(self, key: tuple, reqs: list[ForecastRequest]) -> None:
         network_name, model_name = key
@@ -450,19 +536,29 @@ class ForecastService:
             queue_depth=reqs[0].meta.get("queue_depth"),
         )
         outs = []
+        exec_s = round(seconds, 6)
         for i, r in enumerate(reqs):
             sel = r.payload["gauges"]
             out = runoff[i] if sel is None else runoff[i][:, sel]
             outs.append(out)
+            good = self._slo_good(r, now)
             self._emit(
                 "serve_request",
                 status="ok",
                 network=network_name,
                 model=model_name,
+                request_id=r.meta.get("request_id"),
                 latency_s=round(now - r.admitted, 6),
+                # the lifecycle decomposition: queue wait is per request,
+                # execution is the batch's device wall time shared by every
+                # member (they ran as one program invocation)
+                queue_s=self._queue_seconds(r),
+                execute_s=exec_s,
                 version=entry.version,
                 n_gauges=int(out.shape[1]),
+                slo_ok=good,
             )
+            self._observe_slo(good)
         for r, out in zip(reqs, outs):
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(
@@ -472,6 +568,9 @@ class ForecastService:
                         "model": model_name,
                         "version": entry.version,
                         "engine": self._engine_label(net),
+                        "request_id": r.meta.get("request_id"),
+                        "queue_s": self._queue_seconds(r),
+                        "execute_s": exec_s,
                     }
                 )
 
@@ -685,6 +784,7 @@ class ForecastService:
             policy=self.serve_cfg.backpressure,
             network=req.meta.get("network"),
             model=req.meta.get("model"),
+            request_id=req.meta.get("request_id"),
             age_s=round(req.age(), 6),
         )
         self._emit(
@@ -692,8 +792,65 @@ class ForecastService:
             status=f"shed:{reason}",
             network=req.meta.get("network"),
             model=req.meta.get("model"),
+            request_id=req.meta.get("request_id"),
             latency_s=round(req.age(), 6),
+            queue_s=self._queue_seconds(req),
+            slo_ok=False,
         )
+        self._observe_slo(False)
+
+    # ---- SLO accounting ----
+
+    def _slo_good(self, req: ForecastRequest, now: float) -> bool:
+        """Whether a SERVED request met the objective: replied within its
+        deadline (a reply after expiry is a miss even though it ran — the
+        batcher only sheds requests that expire while queued), and within the
+        configured latency ceiling when one is set."""
+        if self.slo is None:
+            return True
+        if req.deadline is not None and now > req.deadline:
+            return False
+        ceiling = self.slo.cfg.latency_s
+        return ceiling is None or (now - req.admitted) <= ceiling
+
+    def _observe_slo(self, good: bool) -> None:
+        """Fold one terminal decision into the tracker, then mirror gauges /
+        evaluate alerts via :meth:`_slo_sweep`. Guarded like every
+        observability hook — SLO bookkeeping must never fail a request."""
+        slo = self.slo
+        if slo is None:
+            return
+        try:
+            # gauge mirroring + alert evaluation are O(buckets) scans under
+            # the tracker lock; run them once per bucket rollover (~1/s at the
+            # default windows), not per request — observe() itself stays O(1)
+            if slo.observe(good):
+                self._slo_sweep()
+        except Exception:
+            log.exception("SLO accounting failed")
+
+    def _slo_sweep(self) -> None:
+        """Mirror the tracker onto the ``ddr_slo_*`` gauges and emit one
+        ``slo`` event per fast-burn alert transition. Runs on bucket rollover
+        (traffic) AND from :meth:`stats` (polling) — a firing alert on a
+        replica that then goes idle must still resolve once the bad stretch
+        ages out of the fast window, without waiting for another request."""
+        slo = self.slo
+        if slo is None:
+            return
+        try:
+            att = slo.attainment()
+            if att is not None:
+                self.metrics.get("ddr_slo_attainment").set(att)
+            burn_gauge = self.metrics.get("ddr_slo_burn_rate")
+            for window, burn in slo.burn_rates().items():
+                if burn is not None:
+                    burn_gauge.set(burn, window=window)
+            change = slo.check_alert()
+            if change is not None:
+                self._emit("slo", **change)
+        except Exception:
+            log.exception("SLO accounting failed")
 
     def _emit(self, event: str, **payload) -> None:
         rec = get_recorder()
@@ -746,14 +903,25 @@ class ForecastService:
 
     def stats(self) -> dict:
         """Queue/served/shed counters, compile accounting, model versions,
-        health rollup — the /v1/stats payload."""
+        health + SLO rollups — the /v1/stats payload. ``config`` carries the
+        batching knobs consumers need to interpret the counters (``ddr
+        loadtest`` derives batch occupancy from served/batches/max_batch)."""
+        self._slo_sweep()  # idle replicas resolve stale alerts via polling
         hits, misses = self.tracker.counts()
         return {
             "ready": self._ready,
             "warmup_error": self._warmup_error,
+            "config": {
+                "max_batch": self.serve_cfg.max_batch,
+                "queue_cap": self.serve_cfg.queue_cap,
+                "batch_wait_s": self.serve_cfg.batch_wait_s,
+                "deadline_s": self.serve_cfg.deadline_s,
+                "backpressure": self.serve_cfg.backpressure,
+            },
             "queue": self._batcher.stats(),
             "compiles": {"hits": hits, "misses": misses, **self.tracker.snapshot()},
             "health": self.watchdog.status(),
+            "slo": None if self.slo is None else self.slo.status(),
             "models": self.models_info(),
             "networks": self.networks_info(),
         }

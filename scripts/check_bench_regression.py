@@ -15,6 +15,14 @@ from the compiled programs' HLO) growing past the threshold also warns — a
 change that keeps throughput but doubles the HBM envelope or the collective
 mix is still a regression the record history should catch.
 
+Serving records gate the same way: a ``ddr loadtest`` report
+(``kind: "loadtest"``, written as ``LOADTEST_*.json``) is auto-compared
+against the latest committed LOADTEST record — latency quantiles
+(``p50_ms``/``p95_ms``/``p99_ms`` and their queue/execute splits) and
+shed/reject/error *rates* warn when they GROW, ``throughput_rps`` and
+``slo_attainment`` when they DROP; a drop-rate appearing from a clean (zero)
+baseline always flags.
+
 Records from different devices are never compared as regressions: a CPU
 fallback round against a TPU round says nothing about the code, so a device
 mismatch downgrades every finding to informational.
@@ -23,6 +31,7 @@ Usage::
 
     python scripts/check_bench_regression.py fresh.json          # vs latest BENCH_*
     python scripts/check_bench_regression.py fresh.json --baseline BENCH_r05.json
+    python scripts/check_bench_regression.py LOADTEST_x.json     # vs latest LOADTEST_*
     python scripts/check_bench_regression.py --run               # run bench.py first
     python scripts/check_bench_regression.py fresh.json --strict # exit 1 on regression
 
@@ -70,17 +79,66 @@ COLLECTIVE_KEYS = (
     "deep_grad_collectives",
 )
 
+#: Serving-latency fields from ``ddr loadtest`` reports (milliseconds —
+#: SMALLER is better; growth past the threshold warns).
+LATENCY_KEYS = (
+    "p50_ms",
+    "p95_ms",
+    "p99_ms",
+    "queue_p50_ms",
+    "queue_p95_ms",
+    "queue_p99_ms",
+    "execute_p50_ms",
+    "execute_p95_ms",
+    "execute_p99_ms",
+)
+
+#: Drop-rate fields (fractions of offered load — SMALLER is better). A rate
+#: appearing from a clean zero baseline always flags (same discipline as a
+#: collective op appearing from zero), with a small absolute floor so one
+#: unlucky shed in a tiny run is noise, not a regression.
+RATE_KEYS = ("shed_rate", "reject_rate", "error_rate")
+
+#: Minimum fresh drop-rate that flags against a zero baseline.
+RATE_FLOOR = 0.02
+
+#: Serving fields where BIGGER is better, compared like throughput.
+SERVING_UP_KEYS = ("throughput_rps", "slo_attainment")
+
+
+def is_loadtest_record(rec: dict) -> bool:
+    """Whether a record is a ``ddr loadtest`` report (vs a bench.py record)."""
+    return rec.get("kind") == "loadtest" or "p50_ms" in rec
+
+
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def latest_baseline(root: Path = REPO_ROOT) -> Path | None:
-    """The most recent ``BENCH_r<NN>*.json`` by round number (ties: name)."""
+def latest_baseline(
+    root: Path = REPO_ROOT,
+    pattern: str = "BENCH_r*.json",
+    exclude: Path | None = None,
+) -> Path | None:
+    """The most recent baseline record matching ``pattern``: ``BENCH_r<NN>*``
+    by round number (ties: name); ``LOADTEST_*`` by mtime (labels are
+    free-form — a one-off ``--label smoke`` must not lexically outrank every
+    later timestamped record forever). ``exclude`` drops one path from
+    consideration — the fresh record itself, which a LOADTEST written into
+    the repo root would otherwise self-select (a record is never its own
+    baseline)."""
 
     def round_of(p: Path) -> tuple[int, str]:
         m = re.match(r"BENCH_r(\d+)", p.name)
         return (int(m.group(1)) if m else -1, p.name)
 
-    cands = sorted(root.glob("BENCH_r*.json"), key=round_of)
+    if pattern.startswith("LOADTEST"):
+        key = lambda p: (p.stat().st_mtime, p.name)  # noqa: E731
+    else:
+        key = round_of
+    cands = sorted(root.glob(pattern), key=key)
+    if exclude is not None:
+        resolved = exclude.resolve()
+        cands = [p for p in cands if p.resolve() != resolved]
     return cands[-1] if cands else None
 
 
@@ -110,23 +168,43 @@ def load_record(path: Path) -> dict:
 
 def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
     """Findings for every shared key: ``status`` is ``regression`` (fresh
-    throughput more than ``threshold`` below baseline, or fresh peak
-    memory/collective counts more than ``threshold`` ABOVE it), ``ok``, or
-    ``info`` (ratio fields, or any comparison across mismatched devices)."""
+    throughput/attainment more than ``threshold`` below baseline, or fresh
+    latency/peak-memory/drop-rate/collective counts more than ``threshold``
+    ABOVE it), ``ok``, or ``info`` (ratio fields, or any comparison across
+    mismatched devices)."""
     findings: list[dict] = []
     device_mismatch = (
         fresh.get("device") is not None
         and baseline.get("device") is not None
         and fresh["device"] != baseline["device"]
     )
-    for key in THROUGHPUT_KEYS + RATIO_KEYS + MEMORY_KEYS:
+    smaller_is_better = MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS
+    for key in (
+        THROUGHPUT_KEYS + SERVING_UP_KEYS + RATIO_KEYS + smaller_is_better
+    ):
         f, b = fresh.get(key), baseline.get(key)
-        if not isinstance(f, (int, float)) or not isinstance(b, (int, float)) or not b:
+        if not isinstance(f, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        if not b:
+            # no finite ratio from a zero baseline — but a drop RATE appearing
+            # on a previously-clean record is exactly the regression shape the
+            # gate exists for (same rule as a collective op appearing from 0)
+            if key in RATE_KEYS and f > max(0.0, b):
+                findings.append({
+                    "key": key,
+                    "fresh": f,
+                    "baseline": b,
+                    "ratio": None,
+                    "status": (
+                        "info" if device_mismatch
+                        else "regression" if f > RATE_FLOOR else "ok"
+                    ),
+                })
             continue
         ratio = f / b
         if key in RATIO_KEYS or device_mismatch:
             status = "info"
-        elif key in MEMORY_KEYS:
+        elif key in smaller_is_better:
             status = "regression" if ratio > 1.0 + threshold else "ok"
         elif ratio < 1.0 - threshold:
             status = "regression"
@@ -196,9 +274,19 @@ def main(argv: list[str] | None = None) -> int:
     else:
         ap.error("pass a fresh record path or --run")
 
-    baseline_path = Path(args.baseline) if args.baseline else latest_baseline()
+    # a loadtest report compares against the loadtest history, never a bench
+    # round (the fields don't overlap; mixing them silently compares nothing)
+    pattern = "LOADTEST_*.json" if is_loadtest_record(fresh) else "BENCH_r*.json"
+    baseline_path = (
+        Path(args.baseline)
+        if args.baseline
+        else latest_baseline(
+            pattern=pattern,
+            exclude=Path(args.fresh) if args.fresh else None,
+        )
+    )
     if baseline_path is None:
-        print("check_bench_regression: no BENCH_r*.json baseline found", file=sys.stderr)
+        print(f"check_bench_regression: no {pattern} baseline found", file=sys.stderr)
         return 0
     baseline = load_record(baseline_path)
 

@@ -209,6 +209,197 @@ class TestTail:
         assert "status=ok" in lines[-1]
 
 
+class TestFollow:
+    """`ddr metrics tail --follow`: the poll loop is driven deterministically
+    by monkeypatching its sleep to mutate the log between polls."""
+
+    def _run_follow(self, monkeypatch, path, actions, n=20, max_polls=None):
+        """Run follow() with `actions[i]` executed at the i-th poll sleep."""
+        import io
+
+        from ddr_tpu.observability import metrics_cli
+
+        calls = [0]
+
+        def scripted_sleep(_secs):
+            i = calls[0]
+            calls[0] += 1
+            if i < len(actions):
+                actions[i]()
+
+        monkeypatch.setattr(metrics_cli.time, "sleep", scripted_sleep)
+        out = io.StringIO()
+        rc = metrics_cli.follow(
+            path, n=n, interval=0.0, out=out,
+            max_polls=len(actions) if max_polls is None else max_polls,
+        )
+        return rc, out.getvalue()
+
+    def _append(self, path, *lines):
+        def do():
+            with path.open("a") as f:
+                for ln in lines:
+                    f.write(ln)
+        return do
+
+    def test_prints_existing_then_new_events(self, tmp_path, monkeypatch):
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+        new = {"event": "serve_request", "t": 10.0, "wall": 110.0, "host": 0,
+               "pid": 1, "seq": 101, "status": "ok", "latency_s": 0.02}
+        rc, out = self._run_follow(
+            monkeypatch, p,
+            [self._append(p, json.dumps(new) + "\n"), lambda: None],
+        )
+        assert rc == 0
+        lines = out.strip().splitlines()
+        assert "run_end" in lines[-2]  # the existing tail came first
+        assert "serve_request" in lines[-1] and "status=ok" in lines[-1]
+
+    def test_corrupt_and_blank_lines_skipped(self, tmp_path, monkeypatch):
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+        good = {"event": "heartbeat", "t": 11.0, "wall": 111.0, "host": 0,
+                "pid": 1, "seq": 102, "step": 9, "devices": []}
+        rc, out = self._run_follow(
+            monkeypatch, p,
+            [self._append(
+                p, "garbage not json\n", "\n", json.dumps(good) + "\n"
+            )],
+        )
+        assert rc == 0
+        assert "garbage" not in out
+        assert "heartbeat" in out.strip().splitlines()[-1]
+
+    def test_partial_line_waits_for_its_newline(self, tmp_path, monkeypatch):
+        """A torn write renders once completed — exactly once, never as two
+        half events."""
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+        ev = json.dumps({"event": "serve_request", "t": 12.0, "wall": 112.0,
+                         "host": 0, "pid": 1, "seq": 103, "status": "ok"})
+        rc, out = self._run_follow(
+            monkeypatch, p,
+            [self._append(p, ev[:20]), self._append(p, ev[20:] + "\n")],
+        )
+        assert rc == 0
+        assert out.count("serve_request") == 1
+
+    def test_truncation_restarts_from_top(self, tmp_path, monkeypatch):
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+        fresh = {"event": "run_start", "t": 0.0, "wall": 200.0, "host": 0,
+                 "pid": 2, "seq": 0, "cmd": "serve", "name": "second-run"}
+
+        def recreate():
+            p.write_text(json.dumps(fresh) + "\n")
+
+        rc, out = self._run_follow(monkeypatch, p, [recreate])
+        assert rc == 0
+        # the recreated file's content is the new run, from its first byte
+        assert "second-run" in out.strip().splitlines()[-1]
+
+    def test_recreation_to_a_larger_file_restarts_from_top(
+        self, tmp_path, monkeypatch
+    ):
+        """A new run reusing the log name can outgrow the old read offset
+        between polls — recreation is detected by inode, not size."""
+        p = tmp_path / "run_log.serve.jsonl"
+        p.write_text(json.dumps(
+            {"event": "run_start", "t": 0.0, "wall": 100.0, "host": 0,
+             "pid": 1, "seq": 0, "cmd": "serve", "name": "first"}) + "\n")
+
+        def recreate_bigger():
+            p.unlink()  # new inode
+            events = [{"event": "run_start", "t": 0.0, "wall": 200.0,
+                       "host": 0, "pid": 2, "seq": 0, "cmd": "serve",
+                       "name": "second-bigger"}]
+            events += [{"event": "heartbeat", "t": 1.0 + i, "wall": 201.0 + i,
+                        "host": 0, "pid": 2, "seq": 1 + i, "step": i,
+                        "devices": []} for i in range(8)]
+            p.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+
+        rc, out = self._run_follow(monkeypatch, p, [recreate_bigger])
+        assert rc == 0
+        # the new run's FIRST event (before the old offset) was not skipped
+        assert "name=second-bigger" in out
+        assert out.count("heartbeat") == 8
+
+    def test_directory_follows_most_recent_jsonl(self, tmp_path, monkeypatch):
+        import os
+
+        old = _write_golden(tmp_path / "run_log.train.jsonl")
+        os.utime(old, (1, 1))
+        live = tmp_path / "run_log.serve.jsonl"
+        live.write_text(json.dumps(
+            {"event": "run_start", "t": 0.0, "wall": 300.0, "host": 0,
+             "pid": 3, "seq": 0, "cmd": "serve", "name": "live"}) + "\n")
+        rc, out = self._run_follow(monkeypatch, tmp_path, [lambda: None])
+        assert rc == 0
+        assert f"following {live}" in out
+        assert "name=live" in out
+
+    def test_ctrl_c_exits_zero(self, tmp_path, monkeypatch):
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+
+        def interrupt():
+            raise KeyboardInterrupt
+
+        rc, _ = self._run_follow(monkeypatch, p, [interrupt], max_polls=99)
+        assert rc == 0
+
+    def test_cli_wiring_and_missing_file(self, tmp_path):
+        assert main(["tail", str(tmp_path / "nope.jsonl"), "--follow"]) == 1
+        assert main(["tail", "--help"]) == 0  # --follow/-i documented
+
+
+class TestSloSummarize:
+    def _append_serve(self, path, n_ok=3, n_bad=1, slo_events=False):
+        with path.open("a") as f:
+            seq = 200
+            for i in range(n_ok):
+                f.write(json.dumps({
+                    "event": "serve_request", "t": 5.0 + i, "wall": 105.0 + i,
+                    "host": 0, "pid": 1, "seq": seq, "status": "ok",
+                    "latency_s": 0.02, "queue_s": 0.004, "execute_s": 0.012,
+                    "slo_ok": True}) + "\n")
+                seq += 1
+            for i in range(n_bad):
+                f.write(json.dumps({
+                    "event": "serve_request", "t": 8.0 + i, "wall": 108.0 + i,
+                    "host": 0, "pid": 1, "seq": seq,
+                    "status": "shed:deadline", "latency_s": 0.5,
+                    "queue_s": 0.5, "slo_ok": False}) + "\n")
+                seq += 1
+            if slo_events:
+                f.write(json.dumps({
+                    "event": "slo", "t": 8.5, "wall": 108.5, "host": 0,
+                    "pid": 1, "seq": seq, "state": "firing", "window": "60s",
+                    "burn_rate": 25.0, "attainment": 0.75,
+                    "target": 0.99}) + "\n")
+
+    def test_slo_section_renders_attainment(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+        self._append_serve(p)
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "slo      : attainment 75.00% (3/4 good)" in out
+        # the lifecycle decomposition line rides the serving section; queue
+        # waits INCLUDE sheds (the 500ms deadline victim dominates p99, same
+        # as the live ddr_serve_queue_seconds histogram would show)
+        assert "queue p50" in out and "execute p50" in out
+        assert "queue p50 4.0ms p99 500.0ms" in out
+
+    def test_slo_alert_transitions_render(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.serve.jsonl")
+        self._append_serve(p, slo_events=True)
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "1 burn-rate alert transitions (1 firing)" in out
+        assert "last: firing burn 25.0x over 60s" in out
+
+    def test_no_slo_section_without_serve_events(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        assert main(["summarize", str(p)]) == 0
+        assert "slo      :" not in capsys.readouterr().out
+
+
 class TestExitCodes:
     def test_help_exits_zero(self):
         assert main(["--help"]) == 0

@@ -185,6 +185,96 @@ class TestEventTee:
         assert get_registry().get("ddr_steps_total").value(engine="single") == 2
 
 
+class TestServeTracingExposition:
+    """Exposition correctness of the request-tracing + SLO instruments:
+    the tee mapping, label-value escaping through to the text format,
+    histogram bucket cumulativeness, and gauge staleness after unload."""
+
+    def _request(self, r, status="ok", **extra):
+        event_tee({"event": "serve_request", "status": status, "network": "n",
+                   "model": "m", "latency_s": 0.05, **extra}, r)
+
+    def test_tee_splits_queue_and_execute(self):
+        r = declare_serve_metrics(MetricsRegistry())
+        self._request(r, queue_s=0.004, execute_s=0.02)
+        # a shed still queued: its wait is observed, execution never happened
+        self._request(r, status="shed:deadline", queue_s=0.5)
+        # a queue-full rejection never queued: neither phase observed
+        self._request(r, status="shed:queue-full")
+        q = r.get("ddr_serve_queue_seconds").series()[("n", "m")]
+        e = r.get("ddr_serve_execute_seconds").series()[("n", "m")]
+        assert q["count"] == 2 and q["sum"] == pytest.approx(0.504)
+        assert e["count"] == 1 and e["sum"] == pytest.approx(0.02)
+
+    def test_slo_event_counts_alert_transitions(self):
+        r = declare_serve_metrics(MetricsRegistry())
+        event_tee({"event": "slo", "state": "firing", "window": "60s",
+                   "burn_rate": 20.0}, r)
+        event_tee({"event": "slo", "state": "resolved", "window": "60s"}, r)
+        c = r.get("ddr_slo_alerts_total")
+        assert c.value(state="firing") == 1
+        assert c.value(state="resolved") == 1
+
+    def test_new_instrument_label_escaping_in_exposition(self):
+        """Model/network names with quotes, backslashes, and newlines must
+        render escaped (a raw newline in a label value corrupts the whole
+        scrape, not just one series)."""
+        r = declare_serve_metrics(MetricsRegistry())
+        nasty_net, nasty_model = 'basin "A"\\v1', "kan\nnightly"
+        event_tee({"event": "serve_request", "status": "ok",
+                   "network": nasty_net, "model": nasty_model,
+                   "latency_s": 0.05, "queue_s": 0.004, "execute_s": 0.02}, r)
+        txt = render_text(r)
+        # label pairs render sorted by name: model before network
+        esc = 'model="kan\\nnightly",network="basin \\"A\\"\\\\v1"'
+        assert f"ddr_serve_queue_seconds_count{{{esc}}} 1" in txt
+        assert f"ddr_serve_execute_seconds_count{{{esc}}} 1" in txt
+        assert "\nkan" not in txt  # the raw newline never reaches the wire
+
+    def test_new_histograms_buckets_cumulative_in_exposition(self):
+        r = declare_serve_metrics(MetricsRegistry())
+        for queue_s in (0.0004, 0.004, 0.04, 9.0):
+            event_tee({"event": "serve_request", "status": "ok", "network": "n",
+                       "model": "m", "latency_s": 0.05, "queue_s": queue_s,
+                       "execute_s": 0.01}, r)
+        txt = render_text(r)
+        counts = []
+        for line in txt.splitlines():
+            if line.startswith("ddr_serve_queue_seconds_bucket"):
+                counts.append(int(line.rsplit(" ", 1)[1]))
+        assert counts, "queue histogram missing from exposition"
+        assert counts == sorted(counts)  # le-buckets are CUMULATIVE
+        assert counts[-1] == 4  # +Inf sees every observation
+        assert counts[0] < 4  # 9s lives above the finite buckets
+        assert "ddr_serve_queue_seconds_count" in txt
+        assert "# TYPE ddr_serve_execute_seconds histogram" in txt
+        assert "# TYPE ddr_slo_burn_rate gauge" in txt
+
+    def test_gauge_series_removal_for_unloaded_entities(self):
+        """ddr_model_version{model=...} must stop exporting after an unload —
+        a stale version gauge reads as 'still serving'."""
+        r = declare_serve_metrics(MetricsRegistry())
+        g = r.get("ddr_model_version")
+        g.set(3, model="keep")
+        g.set(7, model="gone")
+        assert 'model="gone"' in render_text(r)
+        assert g.remove(model="gone") is True
+        txt = render_text(r)
+        assert 'model="gone"' not in txt
+        assert 'ddr_model_version{model="keep"} 3' in txt
+        assert g.remove(model="gone") is False  # idempotent no-op
+
+    def test_slo_gauges_render_with_window_labels(self):
+        r = declare_serve_metrics(MetricsRegistry())
+        r.get("ddr_slo_attainment").set(0.995)
+        for window, burn in (("60s", 2.5), ("300s", 0.5)):
+            r.get("ddr_slo_burn_rate").set(burn, window=window)
+        txt = render_text(r)
+        assert "ddr_slo_attainment 0.995" in txt
+        assert 'ddr_slo_burn_rate{window="60s"} 2.5' in txt
+        assert 'ddr_slo_burn_rate{window="300s"} 0.5' in txt
+
+
 class TestExporter:
     def test_scrape_over_http(self):
         get_registry().counter("ddr_scrape_me_total").inc()

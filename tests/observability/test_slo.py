@@ -1,0 +1,259 @@
+"""SLO layer tests: SloConfig validation + env parsing, SloTracker
+sliding-window attainment/burn-rate arithmetic and alert edge detection
+(injected clocks — no sleeps), and the offline event replay."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from ddr_tpu.observability.slo import (
+    SloConfig,
+    SloTracker,
+    attainment_from_events,
+    window_label,
+)
+
+
+class TestSloConfig:
+    def test_defaults(self):
+        cfg = SloConfig()
+        assert cfg.enabled and cfg.target == 0.99
+        assert cfg.windows == (60.0, 300.0, 3600.0)
+        assert cfg.fast_window == 60.0 and cfg.slo_window == 3600.0
+
+    def test_windows_sorted_and_deduped(self):
+        cfg = SloConfig(windows=(300, 60, 300.0))
+        assert cfg.windows == (60.0, 300.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="target"):
+            SloConfig(target=1.0)
+        with pytest.raises(ValueError, match="target"):
+            SloConfig(target=0.0)
+        with pytest.raises(ValueError, match="latency_s"):
+            SloConfig(latency_s=0)
+        with pytest.raises(ValueError, match="windows"):
+            SloConfig(windows=())
+        with pytest.raises(ValueError, match="windows"):
+            SloConfig(windows=(60.0, -1.0))
+        with pytest.raises(ValueError, match="alert_burn_rate"):
+            SloConfig(alert_burn_rate=0)
+        with pytest.raises(ValueError, match="alert_min_samples"):
+            SloConfig(alert_min_samples=0)
+
+    def test_from_env_order_defaults_env_overrides(self):
+        env = {
+            "DDR_SLO_TARGET": "0.95",
+            "DDR_SLO_LATENCY_MS": "250",
+            "DDR_SLO_WINDOWS": "30,600",
+            "DDR_SLO_ALERT_BURN": "6",
+            "DDR_SLO_ALERT_MIN_SAMPLES": "3",
+        }
+        cfg = SloConfig.from_env(env)
+        assert cfg.target == 0.95
+        assert cfg.latency_s == pytest.approx(0.25)  # ms env -> seconds
+        assert cfg.windows == (30.0, 600.0)
+        assert cfg.alert_burn_rate == 6.0 and cfg.alert_min_samples == 3
+        # explicit kwargs beat the environment
+        assert SloConfig.from_env(env, target=0.9).target == 0.9
+
+    def test_from_env_enabled_switch(self):
+        assert SloConfig.from_env({"DDR_SLO_ENABLED": "off"}).enabled is False
+        assert SloConfig.from_env({"DDR_SLO_ENABLED": "1"}).enabled is True
+        assert SloConfig.from_env({}).enabled is True
+
+    def test_from_env_bad_values_raise(self):
+        with pytest.raises(ValueError, match="DDR_SLO_TARGET"):
+            SloConfig.from_env({"DDR_SLO_TARGET": "ninety-nine"})
+        with pytest.raises(ValueError, match="DDR_SLO_WINDOWS"):
+            SloConfig.from_env({"DDR_SLO_WINDOWS": "60,abc"})
+
+    def test_window_label_round_trip(self):
+        from ddr_tpu.observability.slo import parse_window_label
+
+        assert window_label(60.0) == "60s"
+        assert window_label(0.5) == "0.5s"
+        assert parse_window_label("60s") == 60.0
+        assert parse_window_label("0.5s") == 0.5
+        assert parse_window_label("not-a-window") is None
+
+
+def _tracker(**kw) -> SloTracker:
+    kw.setdefault("target", 0.99)
+    kw.setdefault("windows", (10.0, 100.0))
+    return SloTracker(SloConfig(**kw))
+
+
+class TestSloTracker:
+    def test_empty_tracker_reads_none(self):
+        t = _tracker()
+        assert t.attainment(now=1000.0) is None
+        assert t.burn_rate(10.0, now=1000.0) is None
+        assert set(t.burn_rates(now=1000.0)) == {"10s", "100s"}
+
+    def test_attainment_and_burn_per_window(self):
+        t = _tracker()
+        # 50 old observations, all good; 10 recent, half bad
+        for i in range(50):
+            t.observe(True, now=1000.0 + i * 0.1)
+        for i in range(10):
+            t.observe(i % 2 == 0, now=1050.0 + i * 0.1)
+        now = 1052.0
+        # the 10s window sees only the recent half-bad stretch
+        assert t.attainment(10.0, now=now) == pytest.approx(0.5)
+        # the 100s window sees everything: 55/60 good
+        assert t.attainment(100.0, now=now) == pytest.approx(55 / 60)
+        assert t.burn_rate(10.0, now=now) == pytest.approx(0.5 / 0.01)
+        rates = t.burn_rates(now=now)
+        assert rates["10s"] == pytest.approx(50.0)
+        assert rates["100s"] == pytest.approx((5 / 60) / 0.01)
+
+    def test_observe_reports_bucket_rollover(self):
+        """observe() returns True exactly when it opens a new time bucket —
+        the cadence the serving layer uses to gate its O(buckets) gauge
+        mirroring off the per-request path."""
+        t = _tracker()
+        assert t.observe(True, now=100.0) is True
+        # same bucket: no rollover
+        assert t.observe(True, now=100.0 + t._bucket_s / 2) is False
+        assert t.observe(False, now=100.0 + t._bucket_s * 1.5) is True
+
+    def test_all_good_burns_zero(self):
+        t = _tracker()
+        for i in range(20):
+            t.observe(True, now=500.0 + i)
+        assert t.burn_rate(100.0, now=520.0) == 0.0
+
+    def test_memory_is_bounded_by_window(self):
+        t = _tracker(windows=(1.0, 10.0))
+        for i in range(10_000):
+            t.observe(True, now=100.0 + i * 0.01)  # 100s of traffic
+        # pruning keeps only ~slo_window/bucket buckets, not 10k entries
+        assert len(t._buckets) <= int(10.0 / t._bucket_s) + 2
+
+    def test_status_shape(self):
+        t = _tracker(windows=(10.0,))
+        t.observe(True, now=100.0)
+        t.observe(False, now=100.5)
+        s = t.status(now=101.0)
+        assert s["target"] == 0.99
+        assert s["lifetime"] == {"good": 1, "total": 2, "attainment": 0.5}
+        assert s["windows"]["10s"]["total"] == 2
+        assert s["windows"]["10s"]["attainment"] == 0.5
+        assert s["windows"]["10s"]["burn_rate"] == pytest.approx(50.0)
+        assert s["alerting"] is False
+
+    def test_thread_safety_smoke(self):
+        t = _tracker()
+        errs: list[Exception] = []
+
+        def hammer():
+            try:
+                for i in range(500):
+                    t.observe(i % 3 != 0)
+                    t.attainment()
+                    t.burn_rates()
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs
+        assert t.status()["lifetime"]["total"] == 2000
+
+
+class TestAlertEdge:
+    def test_fires_once_then_resolves_once(self):
+        t = _tracker(
+            target=0.99, windows=(10.0, 100.0),
+            alert_burn_rate=14.0, alert_min_samples=5,
+        )
+        # 10 bad requests: burn 100x >> 14x
+        for i in range(10):
+            t.observe(False, now=200.0 + i * 0.1)
+        edge = t.check_alert(now=201.5)
+        assert edge is not None and edge["state"] == "firing"
+        assert edge["window"] == "10s"
+        assert edge["burn_rate"] == pytest.approx(100.0)
+        assert edge["target"] == 0.99
+        assert t.alerting
+        # no repeat while still burning
+        assert t.check_alert(now=201.6) is None
+        # traffic turns good; once the bad stretch ages out, one resolved edge
+        for i in range(20):
+            t.observe(True, now=215.0 + i * 0.1)
+        edge = t.check_alert(now=218.0)
+        assert edge is not None and edge["state"] == "resolved"
+        assert not t.alerting
+        assert t.check_alert(now=218.1) is None
+
+    def test_min_samples_gate(self):
+        t = _tracker(windows=(10.0,), alert_min_samples=10)
+        for i in range(3):
+            t.observe(False, now=300.0 + i * 0.1)  # 100% bad but only 3 samples
+        assert t.check_alert(now=301.0) is None
+        assert not t.alerting
+
+    def test_empty_window_resolves(self):
+        t = _tracker(windows=(10.0,), alert_min_samples=2)
+        for i in range(5):
+            t.observe(False, now=400.0 + i * 0.1)
+        assert t.check_alert(now=401.0)["state"] == "firing"
+        # idle long enough that the fast window is empty
+        edge = t.check_alert(now=500.0)
+        assert edge is not None and edge["state"] == "resolved"
+        assert edge["burn_rate"] is None and edge["attainment"] is None
+
+
+class TestAttainmentFromEvents:
+    def _ev(self, wall, status="ok", slo_ok=None):
+        e = {"event": "serve_request", "wall": wall, "status": status}
+        if slo_ok is not None:
+            e["slo_ok"] = slo_ok
+        return e
+
+    def test_none_without_usable_events(self):
+        assert attainment_from_events([]) is None
+        assert attainment_from_events([{"event": "step", "wall": 1.0}]) is None
+        # a serve_request without a wall clock can't be windowed
+        assert attainment_from_events([{"event": "serve_request"}]) is None
+
+    def test_slo_ok_field_wins_over_status(self):
+        # served ok but LATE: slo_ok=False must count as budget spend
+        events = [self._ev(100.0, "ok", slo_ok=False), self._ev(100.1, "ok")]
+        agg = attainment_from_events(events, windows=(60.0,))
+        assert agg["good"] == 1 and agg["total"] == 2
+        assert agg["attainment"] == 0.5
+
+    def test_status_fallback_for_pre_tracing_logs(self):
+        events = [
+            self._ev(100.0, "ok"),
+            self._ev(100.1, "shed:deadline"),
+            self._ev(100.2, "error:RuntimeError"),
+        ]
+        agg = attainment_from_events(events, windows=(60.0,))
+        assert agg["good"] == 1 and agg["total"] == 3
+
+    def test_windows_trail_last_event(self):
+        events = [self._ev(0.0, "shed:queue-full")] + [
+            self._ev(1000.0 + i, "ok") for i in range(5)
+        ]
+        agg = attainment_from_events(events, windows=(30.0, 2000.0), target=0.9)
+        assert agg["windows"]["30s"] == {
+            "attainment": 1.0, "total": 5, "burn_rate": 0.0,
+        }
+        w = agg["windows"]["2000s"]
+        assert w["total"] == 6 and w["attainment"] == pytest.approx(5 / 6)
+        assert w["burn_rate"] == pytest.approx((1 / 6) / 0.1)
+        assert agg["target"] == 0.9
+        assert agg["burn_rate"] == pytest.approx((1 / 6) / 0.1)
+
+    def test_no_burn_without_target(self):
+        agg = attainment_from_events([self._ev(1.0)], windows=(60.0,))
+        assert "burn_rate" not in agg
+        assert "burn_rate" not in agg["windows"]["60s"]

@@ -127,6 +127,131 @@ class TestCostGrowth:
         assert mod.main([str(fresh), "--baseline", str(base)]) == 0  # warn only
 
 
+class TestLoadtestRecords:
+    """Serving-latency gating: ``ddr loadtest`` reports compare with the
+    opposite polarities (latency/rates warn on GROWTH, throughput/attainment
+    on DROP) and against the LOADTEST_* history, never a bench round."""
+
+    def test_is_loadtest_record(self):
+        mod = _load()
+        assert mod.is_loadtest_record({"kind": "loadtest"})
+        assert mod.is_loadtest_record({"p50_ms": 12.0})  # pre-kind records
+        assert not mod.is_loadtest_record({"value": 100.0})
+
+    def test_latency_growth_flags(self):
+        mod = _load()
+        fresh = {"device": "cpu", "p99_ms": 65.0, "queue_p99_ms": 11.0}
+        base = {"device": "cpu", "p99_ms": 50.0, "queue_p99_ms": 10.0}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["p99_ms"]["status"] == "regression"  # +30% > +20%
+        assert by_key["queue_p99_ms"]["status"] == "ok"  # +10% <= +20%
+
+    def test_latency_shrink_is_ok(self):
+        mod = _load()
+        (f,) = mod.compare(
+            {"device": "cpu", "p50_ms": 8.0}, {"device": "cpu", "p50_ms": 20.0}
+        )
+        assert f["status"] == "ok"  # faster is the good direction
+
+    def test_throughput_and_attainment_drop_flags(self):
+        mod = _load()
+        fresh = {"device": "cpu", "throughput_rps": 70.0, "slo_attainment": 0.70}
+        base = {"device": "cpu", "throughput_rps": 100.0, "slo_attainment": 0.99}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["throughput_rps"]["status"] == "regression"
+        assert by_key["slo_attainment"]["status"] == "regression"  # -29%
+
+    def test_drop_rate_appearing_from_clean_baseline_flags(self):
+        mod = _load()
+        fresh = {"device": "cpu", "shed_rate": 0.25, "reject_rate": 0.01}
+        base = {"device": "cpu", "shed_rate": 0.0, "reject_rate": 0.0}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base)}
+        assert by_key["shed_rate"]["status"] == "regression"
+        assert by_key["shed_rate"]["ratio"] is None  # no finite ratio from 0
+        # one unlucky shed in a tiny run stays under the absolute floor
+        assert by_key["reject_rate"]["status"] == "ok"
+
+    def test_rate_growth_over_nonzero_baseline_uses_threshold(self):
+        mod = _load()
+        fresh = {"device": "cpu", "shed_rate": 0.15}
+        base = {"device": "cpu", "shed_rate": 0.10}
+        (f,) = mod.compare(fresh, base, threshold=0.2)
+        assert f["status"] == "regression"  # +50% > +20%
+
+    def test_device_mismatch_downgrades_loadtest_fields(self):
+        mod = _load()
+        out = mod.compare(
+            {"device": "cpu", "p99_ms": 500.0, "shed_rate": 0.5,
+             "throughput_rps": 1.0},
+            {"device": "tpu", "p99_ms": 5.0, "shed_rate": 0.0,
+             "throughput_rps": 100.0},
+        )
+        assert all(f["status"] == "info" for f in out)
+
+    def test_latest_loadtest_baseline_by_mtime(self, tmp_path):
+        """LOADTEST labels are free-form, so recency is mtime, not name — a
+        one-off `--label smoke` must not lexically outrank every later
+        timestamped record forever."""
+        import os
+
+        mod = _load()
+        for i, name in enumerate((
+            "LOADTEST_smoke.json",  # lexically LAST, but oldest by mtime
+            "LOADTEST_20260801-1200.json",
+            "LOADTEST_20260803-0900.json",
+        )):
+            p = tmp_path / name
+            p.write_text("{}")
+            os.utime(p, (1000 + i, 1000 + i))
+        (tmp_path / "BENCH_r99.json").write_text("{}")
+        picked = mod.latest_baseline(tmp_path, pattern="LOADTEST_*.json")
+        assert picked.name == "LOADTEST_20260803-0900.json"
+
+    def test_fresh_record_is_never_its_own_baseline(self, tmp_path):
+        """A timestamp-named fresh LOADTEST in the baseline dir sorts newest;
+        excluding it must fall back to the real history (or None)."""
+        mod = _load()
+        old = tmp_path / "LOADTEST_20260801-1200.json"
+        fresh = tmp_path / "LOADTEST_20260804-1500.json"
+        old.write_text("{}")
+        fresh.write_text("{}")
+        picked = mod.latest_baseline(
+            tmp_path, pattern="LOADTEST_*.json", exclude=fresh
+        )
+        assert picked == old
+        assert mod.latest_baseline(
+            tmp_path, pattern="LOADTEST_*.json", exclude=old
+        ) == fresh
+        old.unlink()
+        assert mod.latest_baseline(
+            tmp_path, pattern="LOADTEST_*.json", exclude=fresh
+        ) is None
+
+    def test_cli_gates_loadtest_record(self, tmp_path):
+        rec = {"kind": "loadtest", "device": "cpu", "p99_ms": 50.0,
+               "throughput_rps": 100.0, "shed_rate": 0.0,
+               "slo_attainment": 0.995}
+        fresh = tmp_path / "LOADTEST_fresh.json"
+        fresh.write_text(json.dumps(
+            dict(rec, p99_ms=90.0, throughput_rps=60.0)) + "\n")
+        base = tmp_path / "LOADTEST_base.json"
+        base.write_text(json.dumps(rec) + "\n")
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "WARNING" in proc.stderr
+        # self-comparison is always clean
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(fresh),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 class TestLoadRecord:
     def test_unwraps_driver_wrapper(self, tmp_path):
         """The committed BENCH_r*.json form: pretty-printed {n,cmd,rc,tail,

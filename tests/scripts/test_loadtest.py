@@ -1,0 +1,267 @@
+"""``ddr loadtest`` tests.
+
+Fast tests pin the report arithmetic and both generator shapes against a fake
+driver (no service, no jax); the slow test runs the real ``--synthetic``
+in-process smoke end to end and feeds its ``LOADTEST_*.json`` through
+``check_bench_regression.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from ddr_tpu.scripts.loadtest import (
+    Outcome,
+    build_report,
+    main,
+    render_summary,
+    run_closed_loop,
+    run_open_loop,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _ok(latency=0.02, queue=0.004, execute=0.012):
+    return Outcome("ok", latency, queue, execute)
+
+
+class TestBuildReport:
+    def test_counts_rates_and_quantiles(self):
+        outcomes = (
+            [_ok(0.010 + i * 0.001) for i in range(6)]
+            + [Outcome("rejected", 0.001)]
+            + [Outcome("shed:deadline", 0.5), Outcome("shed:queue-full", 0.002)]
+            + [Outcome("error:RuntimeError", 0.1)]
+        )
+        rep = build_report(outcomes, wall_s=2.0, offered=10)
+        assert rep["kind"] == "loadtest" and rep["schema_version"] == 1
+        assert rep["requests"] == 10 and rep["ok"] == 6
+        assert rep["rejected"] == 1 and rep["shed"] == 2 and rep["errors"] == 1
+        assert rep["sheds_by_reason"] == {"deadline": 1, "queue-full": 1}
+        assert rep["shed_rate"] == 0.2
+        assert rep["reject_rate"] == 0.1
+        assert rep["error_rate"] == 0.1
+        assert rep["throughput_rps"] == 3.0  # 6 ok / 2 s
+        assert rep["offered_rps"] == 5.0
+        # latency quantiles are over OK requests only, in milliseconds
+        assert rep["p50_ms"] == pytest.approx(13.0, abs=1.5)
+        assert rep["p99_ms"] == pytest.approx(15.0, abs=0.5)
+        assert rep["queue_p50_ms"] == pytest.approx(4.0)
+        assert rep["execute_p99_ms"] == pytest.approx(12.0)
+
+    def test_empty_run_has_null_quantiles_and_zero_rates(self):
+        rep = build_report([], wall_s=1.0, offered=0)
+        assert rep["requests"] == 0
+        for key in ("p50_ms", "p99_ms", "queue_p50_ms", "execute_p99_ms"):
+            assert rep[key] is None
+        assert rep["shed_rate"] == 0.0 and rep["throughput_rps"] == 0.0
+        assert rep["slo_attainment"] is None
+
+    def test_batch_occupancy_from_stats_delta(self):
+        before = {"queue": {"served": 10, "batches": 5}}
+        after = {
+            "queue": {"served": 26, "batches": 9},
+            "config": {"max_batch": 4},
+        }
+        rep = build_report(
+            [_ok()], wall_s=1.0, offered=1,
+            stats_before=before, stats_after=after,
+        )
+        assert rep["mean_batch_size"] == 4.0  # (26-10)/(9-5)
+        assert rep["mean_batch_occupancy"] == 1.0
+
+    def test_occupancy_none_without_stats(self):
+        rep = build_report([_ok()], wall_s=1.0, offered=1)
+        assert rep["mean_batch_size"] is None
+        assert rep["mean_batch_occupancy"] is None
+
+    def test_slo_prefers_server_tracker(self):
+        after = {
+            "slo": {
+                "target": 0.99,
+                "lifetime": {"good": 97, "total": 100, "attainment": 0.97},
+                "windows": {"60s": {"burn_rate": 3.0}, "300s": {"burn_rate": 1.0}},
+            }
+        }
+        rep = build_report(
+            [_ok()] * 3, wall_s=1.0, offered=3, stats_after=after
+        )
+        assert rep["slo_target"] == 0.99
+        assert rep["slo_attainment"] == 0.97  # the server saw the run
+        assert rep["slo_burn_rates"] == {"60s": 3.0, "300s": 1.0}
+
+    def test_slo_attainment_is_the_delta_over_the_run(self):
+        """Against a long-lived server, prior traffic (and the priming
+        request) must not pollute the measured run's attainment."""
+        before = {"slo": {"lifetime": {"good": 50, "total": 100}}}
+        after = {"slo": {
+            "target": 0.99,
+            "lifetime": {"good": 70, "total": 120, "attainment": 70 / 120},
+        }}
+        rep = build_report(
+            [_ok()] * 20, wall_s=1.0, offered=20,
+            stats_before=before, stats_after=after,
+        )
+        # this run: (70-50)/(120-100) = 100%, NOT the lifetime 58%
+        assert rep["slo_attainment"] == 1.0
+
+    def test_slo_falls_back_to_client_good_fraction(self):
+        outcomes = [_ok()] * 3 + [Outcome("shed:deadline", 0.5)]
+        rep = build_report(outcomes, wall_s=1.0, offered=4)
+        assert rep["slo_attainment"] == 0.75
+
+    def test_meta_kwargs_ride_the_record(self):
+        rep = build_report([_ok()], 1.0, 1, mode="open", device="cpu", seed=7)
+        assert rep["mode"] == "open" and rep["device"] == "cpu" and rep["seed"] == 7
+
+    def test_render_summary_smoke(self):
+        rep = build_report(
+            [_ok()] * 3 + [Outcome("rejected", 0.001)], wall_s=1.0, offered=4,
+            mode="open", target="synthetic",
+        )
+        text = render_summary(rep)
+        assert "latency" in text and "queue" in text and "execute" in text
+        assert "rejected 1" in text
+        assert "slo" in text
+
+
+class TestGenerators:
+    def test_closed_loop_counts_and_unique_indices(self):
+        seen: list[int] = []
+        lock = threading.Lock()
+
+        def fire(i: int) -> Outcome:
+            with lock:
+                seen.append(i)
+            time.sleep(0.002)
+            return _ok()
+
+        outcomes, wall, offered = run_closed_loop(fire, clients=3, duration_s=0.15)
+        assert offered == len(outcomes) == len(seen)
+        assert len(set(seen)) == len(seen)  # every request got its own index
+        assert wall >= 0.15
+
+    def test_open_loop_offered_matches_fired_and_drains(self):
+        fired: list[int] = []
+        lock = threading.Lock()
+
+        def fire(i: int) -> Outcome:
+            with lock:
+                fired.append(i)
+            time.sleep(0.005)
+            return _ok()
+
+        outcomes, wall, offered = run_open_loop(
+            fire, rps=150.0, duration_s=0.25, seed=3, max_inflight=8
+        )
+        assert offered > 10  # Poisson at 150rps over 250ms
+        # the pool drained: every offered arrival completed and was recorded
+        assert len(outcomes) == len(fired) == offered
+        assert wall >= 0.25
+
+    def test_open_loop_counts_client_side_wait_into_latency(self):
+        """Past --max-inflight the clock keeps running from the SCHEDULED
+        arrival — a backed-up client must not hide server slowness
+        (coordinated omission)."""
+
+        def slow_fire(i: int) -> Outcome:
+            time.sleep(0.05)
+            return _ok(latency=0.05)
+
+        # 1 worker, ~20 arrivals in 100ms, each served in 50ms: the backlog
+        # wait dwarfs the 50ms service time for later requests
+        outcomes, _, offered = run_open_loop(
+            slow_fire, rps=200.0, duration_s=0.1, seed=1, max_inflight=1
+        )
+        assert offered >= 5
+        assert max(o.latency_s for o in outcomes) > 0.15
+
+    def test_open_loop_is_seed_deterministic_in_offer_count(self):
+        def fire(i: int) -> Outcome:
+            return _ok()
+
+        _, _, a = run_open_loop(fire, rps=300.0, duration_s=0.2, seed=11)
+        _, _, b = run_open_loop(fire, rps=300.0, duration_s=0.2, seed=11)
+        # identical expovariate streams -> identical arrival schedules
+        assert a == b
+
+    def test_invalid_args_raise(self):
+        with pytest.raises(ValueError, match="rps"):
+            run_open_loop(lambda i: _ok(), rps=0.0, duration_s=1.0)
+        with pytest.raises(ValueError, match="clients"):
+            run_closed_loop(lambda i: _ok(), clients=0, duration_s=1.0)
+
+
+class TestCli:
+    def test_help_exits_zero(self):
+        assert main(["--help"]) == 0
+
+    def test_ddr_cli_dispatches_loadtest(self):
+        from ddr_tpu.cli import main as ddr_main
+
+        assert ddr_main(["loadtest", "--help"]) == 0
+
+
+@pytest.mark.slow
+def test_synthetic_loadtest_end_to_end(tmp_path, monkeypatch):
+    """The acceptance path: `ddr loadtest --synthetic` over a ~2s open-loop
+    run writes a LOADTEST_*.json with non-null p50/p99/attainment and a
+    queue/execute split, feeds the live registry's new instruments, and the
+    regression gate accepts + self-compares the record."""
+    monkeypatch.delenv("DDR_METRICS_DIR", raising=False)
+    rc = main([
+        "--synthetic", "--n", "64", "--horizon", "8",
+        "--rps", "30", "--duration", "2", "--max-inflight", "16",
+        "--out", str(tmp_path), "--label", "smoke",
+    ])
+    assert rc == 0
+    report_path = tmp_path / "LOADTEST_smoke.json"
+    assert report_path.exists()
+    rep = json.loads(report_path.read_text())
+
+    assert rep["kind"] == "loadtest"
+    assert rep["requests"] > 10 and rep["ok"] > 0
+    for key in ("p50_ms", "p99_ms", "queue_p50_ms", "queue_p99_ms",
+                "execute_p50_ms", "execute_p99_ms"):
+        assert rep[key] is not None and rep[key] >= 0.0, key
+    assert rep["p50_ms"] <= rep["p99_ms"]
+    assert rep["slo_attainment"] is not None
+    assert rep["slo_target"] is not None
+    assert isinstance(rep["sheds_by_reason"], dict)
+    assert rep["mean_batch_size"] is not None  # occupancy came from /v1/stats
+
+    # the run log's run_end carries the serve/SLO rollup (the service closes
+    # INSIDE the telemetry context), so summarize can replay the objective
+    run_log = tmp_path / "run_log.loadtest.jsonl"
+    assert run_log.exists()
+    events = [json.loads(ln) for ln in run_log.read_text().splitlines() if ln]
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    serve_rollup = run_end["summary"]["serve"]
+    assert serve_rollup["slo"]["target"] is not None
+    assert serve_rollup["queue"]["served"] > 0
+
+    # the run fed the live request-tracing + SLO instruments
+    from ddr_tpu.observability import get_registry
+    from ddr_tpu.observability.prometheus import render_text
+
+    txt = render_text(get_registry())
+    assert "ddr_serve_queue_seconds_count" in txt
+    assert "ddr_serve_execute_seconds_count" in txt
+    assert "ddr_slo_burn_rate" in txt
+
+    # the regression gate accepts the record and self-compares clean
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_bench_regression.py"),
+         str(report_path), "--baseline", str(report_path), "--strict"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput_rps" in proc.stdout

@@ -156,6 +156,177 @@ class TestBackpressure:
             b.close()
 
 
+class TestShedByDeadline:
+    """The deadline-aware backpressure policy: the victim is the queued
+    request with the EARLIEST deadline — the one already most likely to be
+    shed at extraction — not the oldest admission."""
+
+    def _full_queue(self, *deadlines_s, cap=None):
+        """A batcher whose worker is gated and whose queue holds one request
+        per given deadline (submitted in order, so admission order != deadline
+        order is up to the caller)."""
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        cap = cap if cap is not None else len(deadlines_s)
+        b = MicroBatcher(
+            ex, max_batch=1, queue_cap=cap, batch_wait_s=0.0,
+            backpressure="shed-by-deadline",
+        )
+        r_exec = b.submit(_req(payload="executing"))
+        t0 = time.monotonic()
+        while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+            time.sleep(0.002)
+        queued = [
+            b.submit(_req(payload=f"q{i}", deadline_s=d))
+            for i, d in enumerate(deadlines_s)
+        ]
+        return ex, b, r_exec, queued
+
+    def test_victim_is_earliest_deadline_not_oldest(self):
+        # admission order: q0 (60s), q1 (5s), q2 (30s) — shed-oldest would
+        # kill q0; deadline-aware must kill q1
+        ex, b, r_exec, (q0, q1, q2) = self._full_queue(60.0, 5.0, 30.0)
+        try:
+            newest = b.submit(_req(payload="newest", deadline_s=45.0))
+            with pytest.raises(RequestShedError) as ei:
+                q1.future.result(timeout=5)
+            assert ei.value.reason == "queue-full"
+            ex.gate.set()
+            assert r_exec.future.result(timeout=5) == "executing"
+            assert q0.future.result(timeout=5) == "q0"
+            assert q2.future.result(timeout=5) == "q2"
+            assert newest.future.result(timeout=5) == "newest"
+            assert b.stats()["shed"] == 1
+        finally:
+            b.close()
+
+    def test_no_deadline_requests_are_never_preferred_victims(self):
+        ex, b, r_exec, (q0, q1) = self._full_queue(None, 20.0)
+        try:
+            b.submit(_req(payload="newest", deadline_s=None))
+            # q0 has NO deadline; q1's 20s is "earliest" by the policy
+            with pytest.raises(RequestShedError):
+                q1.future.result(timeout=5)
+            ex.gate.set()
+            assert q0.future.result(timeout=5) == "q0"
+        finally:
+            b.close()
+
+    def test_ties_shed_oldest_admission(self):
+        # two identical no-deadline requests: admission order breaks the tie
+        ex, b, r_exec, (q0, q1) = self._full_queue(None, None)
+        try:
+            b.submit(_req(payload="newest", deadline_s=None))
+            with pytest.raises(RequestShedError):
+                q0.future.result(timeout=5)
+            ex.gate.set()
+            assert q1.future.result(timeout=5) == "q1"
+        finally:
+            b.close()
+
+    def test_arrival_with_earliest_deadline_is_rejected(self):
+        # the arrival itself is the most-doomed request: reject (429 at the
+        # edge) rather than admit-then-shed
+        ex, b, r_exec, (q0,) = self._full_queue(30.0)
+        try:
+            with pytest.raises(QueueFullError, match="earliest deadline"):
+                b.submit(_req(payload="doomed", deadline_s=1.0))
+            assert b.stats()["rejected"] == 1
+            assert b.stats()["shed"] == 0
+            ex.gate.set()
+            assert q0.future.result(timeout=5) == "q0"
+        finally:
+            b.close()
+
+    def test_policy_accepted_by_config(self):
+        assert ServeConfig(backpressure="shed-by-deadline").backpressure == (
+            "shed-by-deadline"
+        )
+
+    def test_shed_error_carries_request_id_from_meta(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(ex, max_batch=1, queue_cap=1, batch_wait_s=0.0,
+                         backpressure="shed-oldest")
+        try:
+            b.submit(_req(payload="executing"))
+            t0 = time.monotonic()
+            while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.002)
+            victim = _req(payload="victim")
+            victim.meta["request_id"] = "trace-me"
+            b.submit(victim)
+            b.submit(_req(payload="newest"))
+            with pytest.raises(RequestShedError) as ei:
+                victim.future.result(timeout=5)
+            assert ei.value.request_id == "trace-me"
+            ex.gate.set()
+        finally:
+            b.close()
+
+
+class TestPurge:
+    def test_purge_sheds_matching_queued_requests_only(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(ex, max_batch=1, queue_cap=8, batch_wait_s=0.0)
+        try:
+            b.submit(_req(key="a", payload="executing"))
+            t0 = time.monotonic()
+            while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.002)
+            doomed = b.submit(_req(key="b", payload="doomed"))
+            doomed.meta["request_id"] = "purge-me"
+            keep = b.submit(_req(key="a", payload="keep"))
+            assert b.purge(lambda r: r.key == "b", "model-unloaded") == 1
+            with pytest.raises(RequestShedError) as ei:
+                doomed.future.result(timeout=5)
+            assert ei.value.reason == "model-unloaded"
+            assert ei.value.request_id == "purge-me"
+            assert b.stats()["shed"] == 1
+            ex.gate.set()
+            # non-matching requests (and the in-flight batch) are untouched
+            assert keep.future.result(timeout=5) == "keep"
+        finally:
+            b.close()
+
+    def test_purge_splits_same_key_numpy_payloads_without_equality(self):
+        """Victim selection must never compare requests for equality — a
+        numpy payload makes ``==`` ambiguous; only the predicate decides."""
+        import numpy as np
+
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(ex, max_batch=1, queue_cap=8, batch_wait_s=0.0)
+        try:
+            b.submit(_req(key="a", payload="executing"))
+            t0 = time.monotonic()
+            while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.002)
+            reqs = []
+            for i in range(3):
+                r = _req(key="a", payload={"q_prime": np.zeros((4, 4))})
+                r.meta["request_id"] = f"id-{i}"
+                reqs.append(b.submit(r))
+            n = b.purge(lambda r: r.meta.get("request_id") == "id-1", "model-unloaded")
+            assert n == 1
+            with pytest.raises(RequestShedError):
+                reqs[1].future.result(timeout=5)
+            ex.gate.set()
+            for r in (reqs[0], reqs[2]):  # same-key survivors still run
+                assert r.future.result(timeout=5) is not None
+        finally:
+            b.close()
+
+    def test_purge_with_no_match_is_a_noop(self):
+        b = MicroBatcher(_RecordingExecutor(), max_batch=1, queue_cap=4)
+        try:
+            assert b.purge(lambda r: True, "model-unloaded") == 0
+            assert b.stats()["shed"] == 0
+        finally:
+            b.close()
+
+
 class TestFailureIsolation:
     def test_poisoned_batch_fails_alone(self):
         ex = _RecordingExecutor(fail_keys={"bad"})

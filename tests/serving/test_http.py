@@ -145,10 +145,135 @@ class TestProfileEndpoint:
         assert code == 400 and "PROFILE_MAX_SECONDS" in body["error"]
 
 
+def _post_traced(url, body: dict, request_id: str | None = None):
+    """POST /v1/forecast returning (code, body, response headers)."""
+    headers = {"Content-Type": "application/json"}
+    if request_id is not None:
+        headers["X-DDR-Request-Id"] = request_id
+    req = urllib.request.Request(
+        url + "/v1/forecast", data=json.dumps(body).encode(),
+        headers=headers, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+class TestRequestTracing:
+    """The trace-id contract: every forecast-path response — success AND
+    error — carries the request id in header + body, and shed/reject bodies
+    are machine-readable (reason + request_id, not prose-only)."""
+
+    def test_success_echoes_supplied_id_and_decomposition(self, server):
+        srv, _ = server
+        code, body, hdrs = _post_traced(
+            srv.url, {"network": "default", "t0": 0}, request_id="edge-abc123"
+        )
+        assert code == 200
+        assert body["request_id"] == "edge-abc123"
+        assert hdrs["X-DDR-Request-Id"] == "edge-abc123"
+        # the lifecycle decomposition rides the success body
+        assert body["queue_s"] >= 0.0
+        assert body["execute_s"] > 0.0
+        assert body["queue_s"] + body["execute_s"] <= 60.0  # sane, not garbage
+
+    def test_minted_id_when_absent(self, server):
+        srv, _ = server
+        code, body, hdrs = _post_traced(srv.url, {"network": "default", "t0": 0})
+        assert code == 200
+        assert body["request_id"] == hdrs["X-DDR-Request-Id"]
+        assert len(body["request_id"]) == 16  # uuid4 hex mint
+        int(body["request_id"], 16)  # hex or raise
+
+    def test_supplied_id_is_sanitized(self, server):
+        srv, _ = server
+        code, body, _ = _post_traced(
+            srv.url, {"network": "default", "t0": 0},
+            request_id="ok\tid with\x01junk",
+        )
+        assert code == 200
+        # non-printing chars and whitespace are stripped, the rest survives
+        assert body["request_id"] == "okidwithjunk"
+
+    def test_validation_errors_carry_request_id(self, server):
+        srv, _ = server
+        for payload, want_code in (
+            ({"t0": 0}, 400),  # no network field
+            ({"network": "nope"}, 404),
+            ({"network": "default", "model": "nope"}, 404),
+        ):
+            code, body, hdrs = _post_traced(srv.url, payload, request_id="v-1")
+            assert code == want_code
+            assert body["request_id"] == "v-1"
+            assert hdrs["X-DDR-Request-Id"] == "v-1"
+
+    def test_429_body_is_machine_readable(self, server, monkeypatch):
+        from ddr_tpu.serving.batcher import QueueFullError
+
+        srv, svc = server
+
+        def full(**kwargs):
+            err = QueueFullError("queue at capacity (1); request rejected")
+            err.request_id = kwargs.get("request_id")
+            raise err
+
+        monkeypatch.setattr(svc, "submit", full)
+        code, body, hdrs = _post_traced(
+            srv.url, {"network": "default", "t0": 0}, request_id="r-429"
+        )
+        assert code == 429
+        assert body["reason"] == "queue-full"
+        assert body["request_id"] == "r-429"
+        assert "error" in body
+        assert hdrs["Retry-After"] == "1"
+        assert hdrs["X-DDR-Request-Id"] == "r-429"
+
+    def test_503_shed_body_is_machine_readable(self, server, monkeypatch):
+        from concurrent.futures import Future
+
+        from ddr_tpu.serving.batcher import RequestShedError
+
+        srv, svc = server
+
+        def shed(**kwargs):
+            fut = Future()
+            fut.set_exception(RequestShedError(
+                "deadline", "request shed (deadline)",
+                request_id=kwargs.get("request_id"),
+            ))
+            return fut
+
+        monkeypatch.setattr(svc, "submit", shed)
+        code, body, hdrs = _post_traced(
+            srv.url, {"network": "default", "t0": 0}, request_id="r-503"
+        )
+        assert code == 503
+        assert body["reason"] == "deadline"
+        assert body["request_id"] == "r-503"
+        assert hdrs["X-DDR-Request-Id"] == "r-503"
+
+    def test_timeout_body_carries_reason(self, server, monkeypatch):
+        from concurrent.futures import Future
+
+        srv, svc = server
+        monkeypatch.setattr(svc, "submit", lambda **kw: Future())  # never resolves
+        # handler waits deadline + 5s; a -4.9s deadline makes that 100ms
+        code, body, _ = _post_traced(
+            srv.url, {"network": "default", "t0": 0, "deadline_ms": -4900}
+        )
+        assert code == 503
+        assert body["reason"] == "timeout"
+        assert body["request_id"]
+
+
 class TestForecastPost:
     def test_roundtrip_with_gauge_subset(self, server):
         srv, svc = server
         c = HttpForecastClient(srv.url)
+        # positional model stays valid (explicit signature, not **kwargs)
+        assert c.forecast("default", "default", t0=3)["model"] == "default"
         out = c.forecast("default", t0=3, gauges=[0, 2])
         assert out["runoff"].shape == (8, 2)
         assert out["version"] == 1

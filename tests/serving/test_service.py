@@ -189,3 +189,138 @@ class TestStats:
         assert len(compiles) == len(cards) == 1
         assert cards[0]["key"] == compiles[0]["key"]
         assert cards[0]["name"].startswith("serve/default/")
+
+    def test_stats_carries_slo_and_config(self, service_factory):
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        svc.forecast(network="default", t0=0, timeout=30)
+        s = svc.stats()
+        cfg = s["config"]
+        assert cfg["max_batch"] == svc.serve_cfg.max_batch
+        assert cfg["backpressure"] == svc.serve_cfg.backpressure
+        assert cfg["queue_cap"] == svc.serve_cfg.queue_cap
+        slo = s["slo"]
+        assert slo["target"] == svc.slo.cfg.target
+        assert slo["lifetime"]["total"] >= 1
+        assert slo["lifetime"]["attainment"] == 1.0
+        assert set(slo["windows"]) == {"60s", "300s", "3600s"}
+        assert slo["alerting"] is False
+
+
+class TestRequestTracing:
+    """The lifecycle decomposition on the in-process path: request ids ride
+    results + events, latency splits into queue/execute, SLO accounting sees
+    every terminal decision."""
+
+    def test_result_carries_minted_id_and_decomposition(self, service_factory):
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        out = svc.forecast(network="default", t0=0, timeout=30)
+        assert len(out["request_id"]) == 16
+        int(out["request_id"], 16)  # hex mint or raise
+        assert out["queue_s"] >= 0.0
+        assert out["execute_s"] > 0.0
+
+    def test_supplied_id_rides_events_and_result(self, service_factory, recorder):
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        out = svc.forecast(
+            network="default", t0=0, request_id="trace-42", timeout=30
+        )
+        assert out["request_id"] == "trace-42"
+        (req,) = events_of(recorder, "serve_request")
+        assert req["request_id"] == "trace-42"
+        assert req["status"] == "ok" and req["slo_ok"] is True
+        # decomposition: queue + execute never exceeds the total
+        assert req["queue_s"] >= 0.0 and req["execute_s"] > 0.0
+        assert req["queue_s"] + req["execute_s"] <= req["latency_s"] + 0.05
+        # execute_s is the request's batch's device wall time, verbatim
+        (batch,) = events_of(recorder, "serve_batch")
+        assert req["execute_s"] == batch["seconds"]
+
+    def test_queue_full_rejection_stamps_id_and_spends_budget(
+        self, service_factory, recorder, monkeypatch
+    ):
+        from ddr_tpu.serving import QueueFullError
+
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+
+        def full(req):
+            raise QueueFullError("queue at capacity (0); request rejected")
+
+        monkeypatch.setattr(svc._batcher, "submit", full)
+        with pytest.raises(QueueFullError) as ei:
+            svc.submit(network="default", t0=0, request_id="rej-1")
+        assert ei.value.request_id == "rej-1"
+        (req,) = events_of(recorder, "serve_request")
+        assert req["status"] == "shed:queue-full"
+        assert req["request_id"] == "rej-1" and req["slo_ok"] is False
+        # a rejected arrival never queued: no queue_s observation (zeros
+        # would deflate the queue-wait histogram exactly under overload)
+        assert req["queue_s"] is None
+        assert svc.slo.status()["lifetime"] == {
+            "good": 0, "total": 1, "attainment": 0.0,
+        }
+
+    def test_slo_gauges_mirror_tracker(self, service_factory):
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        for t0 in range(2):
+            svc.forecast(network="default", t0=t0, timeout=30)
+        assert svc.metrics.get("ddr_slo_attainment").value() == 1.0
+        burn = svc.metrics.get("ddr_slo_burn_rate")
+        assert burn.value(window="60s") == 0.0
+        assert burn.value(window="3600s") == 0.0
+
+    def test_stats_polling_resolves_stale_alert_on_idle(
+        self, service_factory, recorder
+    ):
+        """A firing fast-burn alert on a replica that goes idle must resolve
+        via the stats() poll path — no new request required."""
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        # force the tracker into the alerting state with an empty fast window
+        with svc.slo._lock:
+            svc.slo._alerting = True
+        svc.stats()
+        assert svc.slo.alerting is False
+        (edge,) = events_of(recorder, "slo")
+        assert edge["state"] == "resolved"
+
+    def test_slo_disabled_via_config(self, tmp_path, service_factory):
+        from ddr_tpu.observability.slo import SloConfig
+
+        from ddr_tpu.serving import ForecastService
+
+        svc = ForecastService(
+            make_cfg(tmp_path), ServeConfig(horizon_hours=8),
+            slo_cfg=SloConfig(enabled=False),
+        )
+        assert svc.slo is None
+        svc.close(drain=False)
+
+
+class TestUnregisterModel:
+    def test_unregister_drops_programs_and_gauge_series(self, service_factory):
+        from ddr_tpu.scripts.common import build_kan, kan_arch
+
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        kan_model, params = build_kan(svc.cfg)
+        svc.register_model("second", kan_model, params, arch=kan_arch(svc.cfg))
+        svc.warmup()  # compile the new pair
+        assert svc.forecast(
+            network="default", model="second", t0=0, timeout=30
+        )["model"] == "second"
+        assert svc.metrics.get("ddr_model_version").value(model="second") == 1
+
+        svc.unregister_model("second")
+        assert "second" not in svc.models_info()
+        assert all(key[1] != "second" for key in svc._fns)
+        # the version gauge series is GONE, not zeroed — an unloaded model
+        # must not keep exporting its last version
+        assert ("second",) not in svc.metrics.get("ddr_model_version").series()
+        with pytest.raises(KeyError):
+            svc.submit(network="default", model="second", t0=0)
+        # the surviving pair still serves
+        out = svc.forecast(network="default", t0=0, timeout=30)
+        assert out["model"] == "default"
+
+    def test_unregister_unknown_raises(self, service_factory):
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        with pytest.raises(KeyError):
+            svc.unregister_model("nope")
