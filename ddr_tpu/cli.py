@@ -17,6 +17,7 @@ _COMMANDS = {
     "train-and-test": "ddr_tpu.scripts.train_and_test",
     "serve": "ddr_tpu.scripts.serve",
     "loadtest": "ddr_tpu.scripts.loadtest",
+    "chaos": "ddr_tpu.scripts.chaos",
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",

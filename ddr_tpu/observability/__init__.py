@@ -30,7 +30,16 @@ from ddr_tpu.observability.events import (
     metrics_dir_from_env,
     run_telemetry,
 )
+from ddr_tpu.observability.faults import (
+    FAULT_SITES,
+    FaultPlan,
+    InjectedFault,
+    fault_site,
+    maybe_inject,
+    parse_faults,
+)
 from ddr_tpu.observability.health import HealthConfig, HealthStats, HealthWatchdog
+from ddr_tpu.observability.preempt import PreemptionHandler
 from ddr_tpu.observability.phases import STEP_PHASES, PhaseTimer, summarize_phases
 from ddr_tpu.observability.prometheus import (
     event_tee,
@@ -98,4 +107,11 @@ __all__ = [
     "SloConfig",
     "SloTracker",
     "attainment_from_events",
+    "FAULT_SITES",
+    "FaultPlan",
+    "InjectedFault",
+    "fault_site",
+    "maybe_inject",
+    "parse_faults",
+    "PreemptionHandler",
 ]

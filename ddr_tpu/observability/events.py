@@ -71,6 +71,12 @@ __all__ = [
 #: wallclock decomposition, :mod:`ddr_tpu.observability.phases`). ``slo`` is
 #: one SLO burn-rate alert *transition* (firing/resolved) from the serving
 #: layer's :class:`~ddr_tpu.observability.slo.SloTracker`.
+#: ``fault`` is one injected-fault firing (:mod:`ddr_tpu.observability.faults`,
+#: the ``DDR_FAULTS`` plan); ``preempt`` is the train loop's graceful
+#: SIGTERM/SIGINT drain + emergency save
+#: (:mod:`ddr_tpu.observability.preempt`); ``chaos`` is one
+#: kill/restart/recovery marker from the ``ddr chaos`` verification harness
+#: (:mod:`ddr_tpu.scripts.chaos`).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -85,6 +91,9 @@ EVENT_TYPES = (
     "health",
     "program_card",
     "slo",
+    "fault",
+    "preempt",
+    "chaos",
 )
 
 

@@ -37,6 +37,7 @@ import logging
 import math
 import os
 import threading
+import time
 from typing import Any
 
 log = logging.getLogger(__name__)
@@ -197,12 +198,21 @@ class HealthConfig:
     #: Consecutive violating batches before the watchdog reports *degraded*
     #: (serving flips /readyz to 503 at this point) (DDR_HEALTH_BAD_BATCHES).
     bad_batches: int = 3
+    #: Wall-clock staleness ceiling, seconds (DDR_HEALTH_MAX_STALL_S; inf =
+    #: off). A watchdog that hasn't observed a batch for this long reports
+    #: *stale* — and therefore *degraded* — because a hung collective or a
+    #: wedged input pipeline produces exactly this signature: a live process
+    #: with healthy last-known numbers and no new batches. Calibrate to a
+    #: few multiples of the expected step cadence.
+    max_stall_s: float = math.inf
 
     def __post_init__(self) -> None:
         if self.bad_batches < 1:
             raise ValueError(f"bad_batches must be >= 1, got {self.bad_batches}")
         if self.max_nonfinite < 0:
             raise ValueError(f"max_nonfinite must be >= 0, got {self.max_nonfinite}")
+        if self.max_stall_s <= 0:
+            raise ValueError(f"max_stall_s must be > 0, got {self.max_stall_s}")
 
     @classmethod
     def from_env(cls, environ: dict | None = None, **overrides) -> "HealthConfig":
@@ -227,6 +237,7 @@ class HealthConfig:
             ("max_residual", "MAX_RESIDUAL", float),
             ("max_grad_norm", "MAX_GRAD_NORM", float),
             ("bad_batches", "BAD_BATCHES", int),
+            ("max_stall_s", "MAX_STALL_S", float),
         ):
             v = _get(var, cast)
             if v is not None:
@@ -252,6 +263,9 @@ class HealthWatchdog:
         self._batches = 0
         self._violations = 0
         self._last_reasons: list[str] = []
+        # staleness clock: starts at construction so a run whose FIRST batch
+        # hangs (stuck warmup collective) also trips the stall ceiling
+        self._last_observe = time.monotonic()
         if registry is None:
             from ddr_tpu.observability.registry import get_registry
 
@@ -293,6 +307,7 @@ class HealthWatchdog:
             return []
         reasons = self.check(stats)
         with self._lock:
+            self._last_observe = time.monotonic()
             self._batches += 1
             if reasons:
                 self._consecutive += 1
@@ -340,20 +355,44 @@ class HealthWatchdog:
             return self._consecutive
 
     @property
+    def staleness_s(self) -> float:
+        """Seconds since the last observed batch (or construction)."""
+        with self._lock:
+            return max(0.0, time.monotonic() - self._last_observe)
+
+    @property
+    def stale(self) -> bool:
+        """True when no batch has been observed for ``max_stall_s`` — the
+        wall-clock stall check: a hung collective or wedged input pipeline
+        stops producing batches while every last-known number stays healthy.
+        Off (always False) at the default ``max_stall_s = inf``."""
+        return (
+            self.config.enabled
+            and math.isfinite(self.config.max_stall_s)
+            and self.staleness_s > self.config.max_stall_s
+        )
+
+    @property
     def degraded(self) -> bool:
-        """True after ``bad_batches`` consecutive violations — the serving
-        layer's /readyz -> 503 signal. A single healthy batch clears it."""
+        """True after ``bad_batches`` consecutive violations OR a wall-clock
+        stall — the serving layer's /readyz -> 503 signal. A single healthy
+        batch clears both."""
+        if self.stale:
+            return True
         with self._lock:
             return self._consecutive >= self.config.bad_batches
 
     def status(self) -> dict[str, Any]:
         """Rollup for /v1/stats and run_end summaries."""
+        stale = self.stale
         with self._lock:
             return {
                 "enabled": self.config.enabled,
                 "batches": self._batches,
                 "violations": self._violations,
                 "consecutive_bad": self._consecutive,
-                "degraded": self._consecutive >= self.config.bad_batches,
+                "degraded": stale or self._consecutive >= self.config.bad_batches,
+                "stale": stale,
+                "staleness_s": round(max(0.0, time.monotonic() - self._last_observe), 3),
                 "last_reasons": list(self._last_reasons),
             }

@@ -31,7 +31,12 @@ import time
 from pathlib import Path
 from typing import Any
 
-__all__ = ["main", "load_events", "summarize", "tail", "follow"]
+__all__ = ["main", "load_events", "summarize", "tail", "follow", "detect_stalls"]
+
+#: Default stall threshold: a run whose newest step/heartbeat is older than
+#: this many times its observed cadence is flagged (a hung collective looks
+#: exactly like this — the process is alive, the event stream just stopped).
+STALL_FACTOR = 5.0
 
 #: Envelope keys hidden from per-event payload rendering.
 _ENVELOPE = ("event", "t", "wall", "host", "pid", "seq", "tags")
@@ -104,7 +109,62 @@ def _fmt(v: float) -> str:
     return f"{v:,.4g}"
 
 
-def summarize(events: list[dict], bad: int = 0, out=None) -> int:
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def detect_stalls(
+    events: list[dict],
+    now: float | None = None,
+    factor: float = STALL_FACTOR,
+) -> list[dict]:
+    """Per-host stall findings over a run's ``step``/``heartbeat`` cadence.
+
+    A host is *stalled* when its newest step/heartbeat ``wall`` stamp is older
+    (vs ``now``) than ``factor`` times its observed median inter-event cadence
+    — the signature of a hung collective, a wedged input pipeline, or a dead
+    process that never wrote ``run_end``. A run WITH a ``run_end`` is finished,
+    not stalled; a host with fewer than two liveness events has no cadence to
+    judge against and is skipped. Returns one dict per flagged host
+    (``host``, ``age_s``, ``cadence_s``, ``ratio``, ``last_event``)."""
+    if any(e.get("event") == "run_end" for e in events):
+        return []
+    now = time.time() if now is None else now
+    per_host: dict[int, list[dict]] = {}
+    for e in events:
+        if e.get("event") in ("step", "heartbeat") and e.get("wall") is not None:
+            per_host.setdefault(int(e.get("host", 0)), []).append(e)
+    findings: list[dict] = []
+    for host, evs in sorted(per_host.items()):
+        walls = sorted(float(e["wall"]) for e in evs)
+        if len(walls) < 2:
+            continue
+        deltas = [b - a for a, b in zip(walls, walls[1:]) if b > a]
+        if not deltas:
+            continue
+        cadence = _median(deltas)
+        age = now - walls[-1]
+        if age > factor * cadence:
+            last = max(evs, key=lambda e: float(e["wall"]))
+            findings.append({
+                "host": host,
+                "age_s": round(age, 3),
+                "cadence_s": round(cadence, 3),
+                "ratio": round(age / cadence, 1) if cadence > 0 else float("inf"),
+                "last_event": str(last.get("event")),
+            })
+    return findings
+
+
+def summarize(
+    events: list[dict],
+    bad: int = 0,
+    out=None,
+    now: float | None = None,
+    stall_factor: float = STALL_FACTOR,
+) -> int:
     out = out or sys.stdout
     w = out.write
     if not events:
@@ -131,6 +191,13 @@ def summarize(events: list[dict], bad: int = 0, out=None) -> int:
     counts = ", ".join(f"{k} {len(v)}" for k, v in sorted(by_type.items()))
     w(f"events   : {len(events)} total — {counts}")
     w(f" ({bad} corrupt lines skipped)\n" if bad else "\n")
+
+    for s in detect_stalls(events, now=now, factor=stall_factor):
+        w(
+            f"STALL?   : host{s['host']} last {s['last_event']} {s['age_s']:.0f}s ago "
+            f"— {s['ratio']}x its ~{s['cadence_s']:.1f}s cadence "
+            "(hung collective or dead run?)\n"
+        )
 
     steps = by_type.get("step", [])
     if steps:
@@ -488,13 +555,20 @@ def follow(
     interval: float = 0.5,
     out=None,
     max_polls: int | None = None,
+    stall_factor: float = STALL_FACTOR,
 ) -> int:
     """Poll-based live follow of one run log: print the last ``n`` existing
     events, then every new complete line as it lands (``tail -f``, but
     schema-aware and corrupt-line tolerant). A directory follows its most
     recently modified ``*.jsonl``. Truncation/recreation (a new run reusing
     the log name) restarts from the new file's top. Ctrl-C exits cleanly with
-    status 0; ``max_polls`` bounds the loop for tests (None = forever)."""
+    status 0; ``max_polls`` bounds the loop for tests (None = forever).
+
+    Stall watch: once the live stream has shown enough events to know its
+    cadence, a silence longer than ``stall_factor`` times that cadence prints
+    one ``STALL?`` line (repeated only after events resume and stop again) —
+    the live twin of ``summarize``'s post-hoc check. A ``run_end`` disarms it:
+    a finished run is quiet on purpose."""
     out = out or sys.stdout
     p = Path(path)
     if p.is_dir():
@@ -524,6 +598,37 @@ def follow(
         tail(existing, n=n, out=out)
     if hasattr(out, "flush"):
         out.flush()
+    # stall-watch state: inter-event arrival cadence of the LIVE stream (the
+    # back-read history doesn't count — its stamps are the writer's past)
+    intervals: list[float] = []
+    last_arrival = time.monotonic()
+    stall_warned = False
+    run_ended = any(ev.get("event") == "run_end" for ev in existing)
+
+    def _saw_events(new_events: list[dict]) -> None:
+        nonlocal last_arrival, stall_warned, run_ended
+        now_m = time.monotonic()
+        intervals.append(now_m - last_arrival)
+        del intervals[:-32]  # a bounded window tracks cadence drift
+        last_arrival = now_m
+        stall_warned = False
+        run_ended = run_ended or any(e.get("event") == "run_end" for e in new_events)
+
+    def _check_stall() -> None:
+        nonlocal stall_warned
+        if stall_warned or run_ended or len(intervals) < 2:
+            return
+        cadence = _median(intervals)
+        age = time.monotonic() - last_arrival
+        if cadence > 0 and age > stall_factor * cadence:
+            out.write(
+                f"STALL?   : no events for {age:.1f}s — {age / cadence:.0f}x the "
+                f"~{cadence:.1f}s cadence (hung collective or dead run?)\n"
+            )
+            if hasattr(out, "flush"):
+                out.flush()
+            stall_warned = True
+
     polls = 0
     try:
         while max_polls is None or polls < max_polls:
@@ -532,10 +637,12 @@ def follow(
             try:
                 size = p.stat().st_size
             except OSError:
+                _check_stall()
                 continue  # rotated away; keep polling for its return
             if size < pos:
                 pos = 0  # truncated in place: the new content is the run
             if size == pos:
+                _check_stall()
                 continue
             try:
                 with p.open("rb") as fh:
@@ -556,10 +663,16 @@ def follow(
             # a partial line stays buffered in the FILE (we re-read from its
             # offset next poll), so rewind over it rather than carrying state
             pos -= len(carry)
+            printed: list[dict] = []
             for raw in complete:
                 ev = _parse_event_line(raw)
                 if ev is not None:
                     tail([ev], n=1, out=out)
+                    printed.append(ev)
+            if printed:
+                _saw_events(printed)
+            else:
+                _check_stall()
             if hasattr(out, "flush"):
                 out.flush()
     except KeyboardInterrupt:
@@ -576,6 +689,11 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="command")
     p_sum = sub.add_parser("summarize", help="aggregate a run log into a table")
     p_sum.add_argument("log", help="run_log .jsonl file, or a directory of them")
+    p_sum.add_argument(
+        "--stall-factor", type=float, default=STALL_FACTOR,
+        help="flag a run (no run_end) whose last step/heartbeat is older than "
+        f"FACTOR x its observed cadence (default {STALL_FACTOR:g})",
+    )
     p_tail = sub.add_parser("tail", help="print the last N events")
     p_tail.add_argument("log", help="run_log .jsonl file, or a directory of them")
     p_tail.add_argument("-n", type=int, default=20, help="events to show (default 20)")
@@ -588,6 +706,11 @@ def main(argv: list[str] | None = None) -> int:
         "-i", "--interval", type=float, default=0.5,
         help="--follow poll cadence, seconds (default 0.5)",
     )
+    p_tail.add_argument(
+        "--stall-factor", type=float, default=STALL_FACTOR,
+        help="--follow: warn when the live stream goes silent for FACTOR x its "
+        f"observed cadence (default {STALL_FACTOR:g})",
+    )
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
@@ -597,7 +720,10 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     if args.command == "tail" and args.follow:
         try:
-            return follow(args.log, n=args.n, interval=args.interval)
+            return follow(
+                args.log, n=args.n, interval=args.interval,
+                stall_factor=args.stall_factor,
+            )
         except (FileNotFoundError, OSError) as e:
             print(f"ddr metrics: {e}", file=sys.stderr)
             return 1
@@ -607,7 +733,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"ddr metrics: {e}", file=sys.stderr)
         return 1
     if args.command == "summarize":
-        return summarize(events, bad)
+        return summarize(events, bad, stall_factor=args.stall_factor)
     return tail(events, n=args.n)
 
 

@@ -38,9 +38,12 @@ from ddr_tpu.scripts.common import (
     timed,
 )
 from ddr_tpu.training import (
+    AsyncCheckpointWriter,
+    async_checkpoint_from_env,
     load_state,
     make_batch_train_step,
     make_optimizer,
+    prune_checkpoints_from_env,
     save_state,
     set_learning_rate,
 )
@@ -70,6 +73,30 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
     start_epoch, start_mini_batch, blob = 1, 0, None
     ckpt = Path(cfg.experiment.checkpoint) if cfg.experiment.checkpoint else None
+    if ckpt is not None and ckpt.is_dir() and ckpt.suffix != ".orbax":
+        # experiment.checkpoint pointed at a checkpoint DIRECTORY (the
+        # trainer's saved_models/): resume from the newest VERIFIED candidate
+        # inside it. Corrupt pickle blobs are quarantined by load_state and
+        # skipped; unloadable orbax dirs are skipped — a preemption that tore
+        # the last write falls back to the previous good state instead of
+        # dying forever. Orbax candidates only validate their metadata here;
+        # the one targeted array restore happens below like any direct
+        # orbax resume.
+        from ddr_tpu.training import checkpoint_candidates, peek_orbax_meta
+
+        resume_dir, ckpt = ckpt, None
+        for cand in checkpoint_candidates(resume_dir):
+            try:
+                if cand.is_dir():
+                    peek_orbax_meta(cand, expected_arch=kan_arch(cfg))
+                else:
+                    blob = load_state(cand, expected_arch=kan_arch(cfg))
+                ckpt = cand
+                break
+            except Exception as e:  # noqa: BLE001 - any bad candidate means "next"
+                log.warning(f"skipping unloadable checkpoint {cand.name}: {e}")
+        if ckpt is None:
+            log.warning(f"no loadable checkpoint under {resume_dir}; starting fresh")
     orbax_resume = ckpt is not None and ckpt.is_dir()
     if ckpt is not None:
         if orbax_resume:
@@ -80,7 +107,8 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
             meta = peek_orbax_meta(ckpt, expected_arch=kan_arch(cfg))
         else:
-            blob = load_state(ckpt, expected_arch=kan_arch(cfg))
+            if blob is None:  # direct-path resume (dir scan already loaded it)
+                blob = load_state(ckpt, expected_arch=kan_arch(cfg))
             params = blob["params"]
             meta = blob
         start_epoch = meta["epoch"]
@@ -150,6 +178,14 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
     throughput = Throughput(label="train")
+    # Fault injection (docs/robustness.md): handles resolve ONCE, at build
+    # time — with DDR_FAULTS unset they are None and the armed paths below
+    # cost one `if None` on the host. Nothing injects inside jitted code, so
+    # the fault layer cannot add jit-cache entries.
+    from ddr_tpu.observability.faults import fault_site
+
+    inject_data_load = fault_site("data.load")
+    inject_device_step = fault_site("device.step")
     # Step-phase wallclock decomposition (docs/observability.md "Cost
     # attribution & profiling"): each loop bucket lands on the step event's
     # `phases` dict and in the run_end rollup; the Prometheus tee exports the
@@ -191,6 +227,44 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             "experiment.parallel != 'none' — e.g. experiment.parallel=auto"
         )
 
+    # Async checkpointing (docs/robustness.md): the single-process pickle
+    # path snapshots on the loop thread and serializes/renames on a writer
+    # thread, so device_step overlaps the write. The multi-host orbax save is
+    # a COLLECTIVE every process must enter together — it stays synchronous.
+    ckpt_dir = Path(cfg.params.save_path) / "saved_models"
+    ckpt_writer = (
+        AsyncCheckpointWriter(phase_timer=phase_timer, prune_dir=ckpt_dir)
+        if (async_checkpoint_from_env() and not multiprocess and is_primary)
+        else None
+    )
+    # Preemption (SIGTERM, first SIGINT): finish the in-flight batch, drain
+    # the checkpoint writer, perform ONE emergency save, exit cleanly — a
+    # preempted spot VM resumes from this batch, not the last cadence save.
+    from ddr_tpu.observability.preempt import PreemptionHandler
+
+    preempt = PreemptionHandler()
+    preempt.__enter__()
+
+    def _preempt_save(epoch: int, batch: int) -> None:
+        if ckpt_writer is not None:
+            ckpt_writer.drain()
+        if not multiprocess and is_primary:
+            path = save_state(
+                ckpt_dir,
+                f"{cfg.name}-preempt",
+                epoch,
+                batch,
+                params,
+                opt_state,
+                rng_state=loader.state(),
+                arch=kan_arch(cfg),
+            )
+            log.warning(f"preemption ({preempt.reason}): emergency checkpoint {path}")
+        if rec is not None:
+            rec.emit(
+                "preempt", reason=preempt.reason, epoch=epoch, batch=batch, step=n_done
+            )
+
     # try/finally so the aggregate summary survives every exit path, including the
     # KeyboardInterrupt that main() treats as a normal way to end a long run.
     try:
@@ -221,6 +295,8 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 i, rd = item
                 phase_s: dict[str, float] = {}
                 with phase_timer.phase("data_load", into=phase_s):
+                    if inject_data_load is not None:
+                        inject_data_load(epoch=epoch, batch=i)
                     q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
                     if rd.flow_scale is not None:
                         q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
@@ -265,6 +341,10 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 with throughput.batch(rd.n_segments, n_timesteps), phase_timer.phase(
                     "device_step", into=phase_s
                 ):
+                    if inject_device_step is not None:
+                        # host-side, before dispatch: `step` is the 0-based
+                        # global index of the step about to execute
+                        inject_device_step(step=n_done, epoch=epoch, batch=i)
                     if par is not None:
                         out = par.step(
                             payload, params, opt_state, obs_daily, obs_mask
@@ -376,9 +456,18 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                 metrics=legend,
                             )
                         if not multiprocess:
+                            # async (default): snapshot + enqueue here; the
+                            # serialize/manifest/rename lands on the writer
+                            # thread's checkpoint_io bucket, overlapping the
+                            # next device_step. Sync (DDR_CKPT_ASYNC=0): the
+                            # whole write bills to this phase, as before.
                             with phase_timer.phase("checkpoint", into=phase_s):
-                                save_state(
-                                    cfg.params.save_path / "saved_models",
+                                saver = (
+                                    ckpt_writer.save if ckpt_writer is not None
+                                    else save_state
+                                )
+                                saver(
+                                    ckpt_dir,
                                     cfg.name,
                                     epoch,
                                     i,
@@ -387,6 +476,8 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                     rng_state=loader.state(),
                                     arch=kan_arch(cfg),
                                 )
+                                if ckpt_writer is None:
+                                    prune_checkpoints_from_env(ckpt_dir)
                 finally:
                     if rec is not None:
                         rec.emit(
@@ -408,10 +499,24 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 # every DDR_HEARTBEAT_EVERY-th (0 disables).
                 if heartbeat_every and (n_done == 1 or n_done % heartbeat_every == 0):
                     emit_heartbeat(rec, epoch=epoch, batch=i, step=n_done)
+                if preempt.requested:
+                    # batch i completed and updated params — save exactly that
+                    # state once (drain + emergency checkpoint), then exit
+                    # cleanly inside the preemption grace window
+                    _preempt_save(epoch, i)
+                    return params, opt_state
                 if max_batches is not None and n_done >= max_batches:
                     return params, opt_state
         return params, opt_state
     finally:
+        preempt.__exit__(None, None, None)
+        if ckpt_writer is not None:
+            # every enqueued snapshot must be on disk before train() returns —
+            # resumers and the serving watcher read this directory immediately
+            try:
+                ckpt_writer.close()
+            except Exception:
+                log.exception("async checkpoint writer failed at close")
         throughput.log_summary()
         if rec is not None:
             rec.merge_summary("compile", tracker.snapshot())

@@ -8,6 +8,9 @@ with dozens of threads. :class:`HttpForecastClient` is the same surface over
 from __future__ import annotations
 
 import json
+import logging
+import random
+import time
 import urllib.error
 import urllib.request
 from concurrent.futures import Future
@@ -15,9 +18,35 @@ from typing import Any
 
 import numpy as np
 
-from ddr_tpu.serving.service import ForecastService
+from ddr_tpu.serving.service import ForecastService, make_request_id
 
-__all__ = ["ForecastClient", "HttpForecastClient"]
+log = logging.getLogger(__name__)
+
+__all__ = ["ForecastClient", "HttpForecastClient", "retry_after_seconds"]
+
+#: HTTP statuses a retry can help with: overload backpressure (429 shed/
+#: reject, 503 shed/not-ready). Every other 4xx is the caller's bug — the
+#: same request will fail the same way, so retrying it is pure load.
+_RETRYABLE_STATUSES = (429, 503)
+
+
+def retry_after_seconds(headers: Any) -> float | None:
+    """The server's ``Retry-After`` as seconds, or None (absent/unparseable).
+    Both standard forms: delta-seconds and an HTTP-date."""
+    raw = None if headers is None else headers.get("Retry-After")
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+
+        dt = parsedate_to_datetime(raw)
+        return max(0.0, dt.timestamp() - time.time())
+    except (TypeError, ValueError):
+        return None
 
 
 class ForecastClient:
@@ -45,11 +74,34 @@ class ForecastClient:
 
 
 class HttpForecastClient:
-    """Minimal stdlib client for the JSON API (tests and smoke checks)."""
+    """Minimal stdlib client for the JSON API (tests and smoke checks).
 
-    def __init__(self, base_url: str, timeout: float = 60.0) -> None:
+    Retries are OPT-IN (``retries=0`` keeps the historical one-shot
+    behavior): with ``retries=N``, a forecast that comes back 429/503 or dies
+    on a connection reset is re-sent up to N more times with exponential
+    backoff + full jitter (``retry_backoff_s * 2^attempt * U[0.5, 1.5)``),
+    honoring the server's ``Retry-After`` when it names a longer wait, and
+    bounded by BOTH the attempt budget and ``retry_deadline_s`` of total wall
+    time — a retrying client must converge, not besiege. Every attempt reuses
+    the SAME ``X-DDR-Request-Id`` (minted client-side when the caller didn't
+    supply one), so server-side traces correlate the retry chain as one
+    logical request. Non-429 4xx never retries: the request is wrong, not
+    unlucky."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 60.0,
+        retries: int = 0,
+        retry_backoff_s: float = 0.25,
+        retry_deadline_s: float = 30.0,
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_deadline_s = float(retry_deadline_s)
+        self._rng = random.Random()
 
     def _get(self, path: str) -> tuple[int, dict]:
         try:
@@ -96,7 +148,10 @@ class HttpForecastClient:
         on HTTP errors — the load-generation path, where a 429/503 is a data
         point, not an exception. Error bodies are machine-readable
         (``reason``, ``request_id``); ``request_id`` rides out as the
-        ``X-DDR-Request-Id`` header and is echoed back by the server."""
+        ``X-DDR-Request-Id`` header and is echoed back by the server. With
+        ``retries > 0`` on the client, retryable outcomes (429/503,
+        connection reset/refused) are re-sent per the class docstring; the
+        returned pair is the LAST attempt's."""
         body: dict[str, Any] = {"network": network, "model": model}
         if q_prime is not None:
             body["q_prime"] = np.asarray(q_prime, dtype=np.float32).tolist()
@@ -106,24 +161,65 @@ class HttpForecastClient:
             body["gauges"] = [int(g) for g in gauges]
         if deadline_ms is not None:
             body["deadline_ms"] = float(deadline_ms)
+        if request_id is None and self.retries > 0:
+            # the retry chain must share one trace id; mint it client-side
+            request_id = make_request_id()
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
             headers["X-DDR-Request-Id"] = str(request_id)
+        payload = json.dumps(body).encode("utf-8")
+
+        deadline = time.monotonic() + self.retry_deadline_s
+        attempt = 0
+        while True:
+            code, out, resp_headers, exc = self._post_once(payload, headers)
+            if exc is None and code not in _RETRYABLE_STATUSES:
+                return code, out
+            if attempt >= self.retries:
+                if exc is not None:
+                    raise exc
+                return code, out
+            wait = self.retry_backoff_s * (2**attempt) * self._rng.uniform(0.5, 1.5)
+            server_wait = retry_after_seconds(resp_headers)
+            if server_wait is not None:
+                # the server knows its own drain time; never undercut it
+                wait = max(wait, server_wait)
+            if time.monotonic() + wait > deadline:
+                # the total-deadline bound: hand back what we have rather
+                # than sleeping past the caller's patience
+                if exc is not None:
+                    raise exc
+                return code, out
+            attempt += 1
+            log.info(
+                f"retrying forecast (attempt {attempt}/{self.retries}, "
+                f"request_id={request_id}): "
+                + (f"http {code}" if exc is None else type(exc).__name__)
+            )
+            time.sleep(wait)
+
+    def _post_once(
+        self, payload: bytes, headers: dict[str, str]
+    ) -> tuple[int, dict, Any, Exception | None]:
+        """One POST attempt -> ``(code, body, headers, retryable_exc)``.
+        Non-retryable transport errors raise; retryable ones come back as the
+        4th element so the retry loop owns the raise-or-retry decision."""
         req = urllib.request.Request(
-            self.base_url + "/v1/forecast",
-            data=json.dumps(body).encode("utf-8"),
-            headers=headers,
-            method="POST",
+            self.base_url + "/v1/forecast", data=payload, headers=headers, method="POST"
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.status, json.loads(resp.read())
+                return resp.status, json.loads(resp.read()), resp.headers, None
         except urllib.error.HTTPError as e:
             try:
                 detail = json.loads(e.read() or b"{}")
             except json.JSONDecodeError:
                 detail = {}
-            return e.code, detail
+            return e.code, detail, e.headers, None
+        except (urllib.error.URLError, ConnectionResetError) as e:
+            # connection refused/reset mid-restart: the retryable transport
+            # class (a replica bouncing under a kill is exactly this shape)
+            return 0, {}, None, e
 
     def forecast(
         self,

@@ -212,7 +212,14 @@ class CheckpointWatcher(threading.Thread):
             self.join(timeout=5.0)
 
     def check_now(self) -> bool:
-        """One scan+reload attempt; True when a swap happened."""
+        """One scan+reload attempt; True when a swap happened.
+
+        ``latest_checkpoint`` already skips ``.tmp`` leftovers, ``.corrupt``
+        quarantines, and meta-less orbax dirs, and ``load_state`` verifies the
+        integrity manifest — a bit-flipped or torn blob is quarantined on the
+        spot, so the NEXT scan's ``latest_checkpoint`` lands on the previous
+        good checkpoint instead of retrying the bad one every poll tick. Each
+        bad checkpoint warns exactly once (the stamp memo below)."""
         from ddr_tpu.training import latest_checkpoint
 
         try:
@@ -230,9 +237,11 @@ class CheckpointWatcher(threading.Thread):
         if stamp == self._last:
             return False
         try:
+            from ddr_tpu.observability.faults import maybe_inject
             from ddr_tpu.training import load_state
 
             t0 = time.perf_counter()
+            maybe_inject("registry.reload", path=str(path), model=self._model)
             blob = load_state(path, expected_arch=self._arch)
             entry = self._registry.swap_params(
                 self._model, blob["params"], source=str(path)
@@ -241,10 +250,12 @@ class CheckpointWatcher(threading.Thread):
                 f"hot-reload of {self._model!r} from {path.name} took "
                 f"{time.perf_counter() - t0:.3f}s"
             )
-        except (ValueError, KeyError, OSError) as e:
-            # corrupt / half-written / wrong-arch checkpoint: keep serving the
-            # old params, but remember the stamp so one bad file is logged
-            # once, not every poll
+        except Exception as e:  # noqa: BLE001 - ANY unloadable checkpoint:
+            # corrupt / half-written / wrong-arch / exotic unpickling or orbax
+            # internals (or an injected reload fault): keep serving the old
+            # params, and remember the stamp so one bad file is logged once,
+            # not every poll. Quarantined blobs disappear from the next scan
+            # entirely, so the previous good checkpoint wins.
             log.warning(f"checkpoint {path} not loadable ({e}); keeping current params")
             self._last = stamp
             return False

@@ -194,6 +194,13 @@ class ForecastService:
             backpressure=self.serve_cfg.backpressure,
             on_shed=self._on_shed,
         )
+        # Fault injection (docs/robustness.md): resolved ONCE at construction
+        # — None (the unset-DDR_FAULTS case) costs one `if` per batch, and
+        # the site fires host-side before dispatch, so it can neither add
+        # jit-cache entries nor corrupt an in-flight device program.
+        from ddr_tpu.observability.faults import fault_site
+
+        self._inject_execute = fault_site("serve.execute")
 
     # ---- registration ----
 
@@ -509,6 +516,11 @@ class ForecastService:
 
     def _execute_inner(self, key: tuple, reqs: list[ForecastRequest]) -> None:
         network_name, model_name = key
+        if self._inject_execute is not None:
+            # a `crash` here rides the existing error path: every future in
+            # the batch fails, each request still reaches a terminal
+            # serve_request event (_execute's except block)
+            self._inject_execute(network=network_name, model=model_name, size=len(reqs))
         net = self._networks[network_name]
         entry = self.registry.get(model_name)  # ONE snapshot for the whole batch
         mb = self.serve_cfg.max_batch

@@ -19,9 +19,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import json
+import logging
 import os
 import pickle
+import queue
+import threading
 from pathlib import Path
 from typing import Any
 
@@ -33,6 +37,8 @@ from ddr_tpu.observability import spanned
 from ddr_tpu.routing.mc import Bounds, ChannelState, GaugeIndex, route
 from ddr_tpu.routing.model import denormalize_spatial_parameters
 from ddr_tpu.routing.network import RiverNetwork
+
+log = logging.getLogger(__name__)
 
 __all__ = [
     "make_optimizer",
@@ -46,6 +52,14 @@ __all__ = [
     "load_state",
     "save_state_orbax",
     "load_state_orbax",
+    "AsyncCheckpointWriter",
+    "async_checkpoint_from_env",
+    "checkpoint_candidates",
+    "load_latest_state",
+    "prune_checkpoints",
+    "prune_checkpoints_from_env",
+    "quarantine_checkpoint",
+    "verify_checkpoint",
 ]
 
 
@@ -429,22 +443,139 @@ def save_state(
         "rng_state": rng_state,
         "arch": arch,
     }
+    data = pickle.dumps(blob)
     # tmp + atomic rename: concurrent readers (the serving layer's
     # CheckpointWatcher polls this directory) must never observe a
     # half-written blob under the final name
     tmp = path.with_name(path.name + ".tmp")
-    with tmp.open("wb") as f:
-        pickle.dump(blob, f)
+    tmp.write_bytes(data)
+    # Fault point between the temp write and the rename: a `crash` here
+    # leaves the torn-write `.tmp` shape, a `corrupt` flips bits under the
+    # already-computed manifest digest — exactly the disk/preemption failures
+    # the integrity manifest exists to catch (docs/robustness.md).
+    from ddr_tpu.observability.faults import maybe_inject
+
+    mutated = maybe_inject(
+        "checkpoint.write", data=data, path=str(path), epoch=epoch, mini_batch=mini_batch
+    )
+    if mutated is not data and mutated is not None:
+        tmp.write_bytes(mutated)
+    # manifest BEFORE the blob rename: every complete blob has its manifest,
+    # and an orphan manifest beside a leftover .tmp is harmless
+    _write_manifest(path, data)
     os.replace(tmp, path)
     return path
 
 
-def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
+def _manifest_path(path: Path) -> Path:
+    """The per-checkpoint integrity sidecar: ``<blob>.manifest.json``."""
+    return path.with_name(path.name + ".manifest.json")
+
+
+def _write_manifest(path: Path, data: bytes) -> Path:
+    """Content checksum + byte length beside the blob (atomic rename — the
+    manifest itself must never be observable half-written)."""
+    manifest = {
+        "format": "ddr-tpu-ckpt-manifest",
+        "version": 1,
+        "sha256": hashlib.sha256(data).hexdigest(),
+        "bytes": len(data),
+    }
+    mpath = _manifest_path(path)
+    tmp = mpath.with_name(mpath.name + ".tmp")
+    tmp.write_text(json.dumps(manifest))
+    os.replace(tmp, mpath)
+    return mpath
+
+
+def quarantine_checkpoint(path: str | Path, reason: str = "corrupt") -> Path:
+    """Rename a bad blob (and its manifest) to ``*.corrupt`` so every scan
+    (``latest_checkpoint``, the serving watcher, resume) stops considering it
+    while the evidence stays on disk for the post-mortem."""
+    path = Path(path)
+    target = path.with_name(path.name + ".corrupt")
+    try:
+        os.replace(path, target)
+    except OSError:  # racing another loader's quarantine: theirs won, fine
+        return target
+    mpath = _manifest_path(path)
+    if mpath.exists():
+        try:
+            os.replace(mpath, mpath.with_name(mpath.name + ".corrupt"))
+        except OSError:
+            pass
+    log.warning(f"quarantined checkpoint {path.name} -> {target.name} ({reason})")
+    return target
+
+
+def _verify_once(path: Path, data: bytes) -> str | None:
+    """One manifest check -> failure description, or None when clean /
+    manifest-less (pre-sidecar blobs pass; the unpickle still catches
+    truncation)."""
+    mpath = _manifest_path(path)
+    if not mpath.exists():
+        return None
+    try:
+        manifest = json.loads(mpath.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        return f"corrupt checkpoint manifest {mpath}: {e}"
+    if manifest.get("bytes") != len(data):
+        return (
+            f"corrupt checkpoint {path}: torn write — {len(data)} bytes on "
+            f"disk, manifest records {manifest.get('bytes')}"
+        )
+    if manifest.get("sha256") != hashlib.sha256(data).hexdigest():
+        return (
+            f"corrupt checkpoint {path}: content checksum mismatch "
+            "(bit-flip or partial overwrite)"
+        )
+    return None
+
+
+def verify_checkpoint(path: str | Path, data: bytes | None = None) -> bytes:
+    """Integrity-check one pickle blob against its manifest. Returns the blob
+    bytes so callers never read the file twice. Raises ``ValueError`` WITHOUT
+    quarantining — policy belongs to the caller (``load_state`` quarantines,
+    tests may not want to).
+
+    A first mismatch is re-checked once after a short pause, re-reading both
+    files: a writer OVERWRITING the same checkpoint path renames blob and
+    manifest separately, so a concurrent reader can catch the microsecond
+    window where they disagree — a transient that must not quarantine a valid
+    checkpoint. Real corruption is stable and fails both reads."""
+    import time
+
+    path = Path(path)
+    if data is None:
+        data = path.read_bytes()
+    problem = _verify_once(path, data)
+    if problem is None:
+        return data
+    time.sleep(0.05)
+    data = path.read_bytes()
+    problem = _verify_once(path, data)
+    if problem is not None:
+        raise ValueError(problem)
+    return data
+
+
+def load_state(
+    path: str | Path, expected_arch: dict | None = None, quarantine: bool = True
+) -> dict:
     """Load and schema-check a checkpoint blob (reference
     scripts_utils.load_checkpoint:45-73). Raises ``ValueError`` on corrupt,
     foreign, version-mismatched, or — when both the blob and the caller state an
     architecture — architecture-mismatched blobs (a KAN trained under one
-    ``grid_range`` evaluates to garbage under another, with identical param shapes)."""
+    ``grid_range`` evaluates to garbage under another, with identical param shapes).
+
+    Pickle blobs are verified against their integrity manifest first
+    (:func:`verify_checkpoint`); a torn or bit-flipped blob is quarantined
+    (renamed ``*.corrupt``, ``quarantine=False`` opts out) so the next
+    ``latest_checkpoint`` scan falls back to the previous good checkpoint
+    instead of retrying the bad one forever. Schema/architecture mismatches
+    are NOT corruption and never quarantine — those files are valid, just
+    wrong for this caller.
+    """
     path = Path(path)
     if path.is_dir():
         # the orbax directory form (load_state_orbax raises the module's clear
@@ -455,9 +586,13 @@ def load_state(path: str | Path, expected_arch: dict | None = None) -> dict:
         # does).
         return load_state_orbax(path, expected_arch=expected_arch)
     try:
-        with path.open("rb") as f:
-            blob = pickle.load(f)
-    except (pickle.UnpicklingError, EOFError, AttributeError) as e:
+        data = verify_checkpoint(path)
+        blob = pickle.loads(data)
+    except (pickle.UnpicklingError, EOFError, AttributeError, ValueError) as e:
+        if quarantine and path.exists():
+            quarantine_checkpoint(path, reason=str(e))
+        if isinstance(e, ValueError):
+            raise
         raise ValueError(f"corrupt checkpoint {path}: {e}") from e
     return _validate_blob(blob, path, expected_arch)
 
@@ -720,17 +855,316 @@ def load_state_orbax(
     return blob
 
 
-def latest_checkpoint(save_dir: str | Path) -> Path | None:
-    """Most recent COMPLETE checkpoint by mtime, either format
-    (reference train_and_test.py:139-144). Orbax dirs without their meta.json
-    completeness marker (a preempted save) are skipped, so auto-resume falls
-    back to the previous intact checkpoint instead of failing forever."""
+def checkpoint_candidates(save_dir: str | Path) -> list[Path]:
+    """Every COMPLETE checkpoint under ``save_dir``, newest-first by mtime.
+
+    ``.tmp`` leftovers (a write the writer never finished), ``.corrupt``
+    quarantine renames, and orbax dirs without their ``meta.json``
+    completeness marker are all excluded — none of them is a resume
+    candidate, and a scan that trips over them forever is exactly the failure
+    mode quarantining exists to end."""
     save_dir = Path(save_dir)
     orbax = [
         p for p in save_dir.glob("_*_epoch_*_mb_*.orbax") if (p / "meta.json").exists()
     ]
-    paths = sorted(
-        [*save_dir.glob("_*_epoch_*_mb_*.pkl"), *orbax],
-        key=lambda p: p.stat().st_mtime,
+    pkls = [
+        p for p in save_dir.glob("_*_epoch_*_mb_*.pkl")
+        # suffix check is belt-and-braces: the glob already can't match
+        # *.pkl.tmp / *.pkl.corrupt, but rename races deserve an explicit rule
+        if not p.name.endswith((".tmp", ".corrupt"))
+    ]
+
+    def _mtime(p: Path) -> float:
+        try:
+            return p.stat().st_mtime
+        except OSError:  # racing a quarantine/GC rename: treat as gone
+            return float("-inf")
+
+    return sorted([*pkls, *orbax], key=_mtime, reverse=True)
+
+
+def latest_checkpoint(save_dir: str | Path) -> Path | None:
+    """Most recent COMPLETE checkpoint by mtime, either format
+    (reference train_and_test.py:139-144). Orbax dirs without their meta.json
+    completeness marker (a preempted save), ``.tmp`` leftovers, and
+    ``.corrupt`` quarantined blobs are skipped, so auto-resume falls back to
+    the previous intact checkpoint instead of failing forever."""
+    cands = checkpoint_candidates(save_dir)
+    return cands[0] if cands else None
+
+
+def load_latest_state(
+    save_dir: str | Path, expected_arch: dict | None = None
+) -> tuple[dict, Path] | None:
+    """Resume entry point over a checkpoint DIRECTORY: walk the candidates
+    newest-first, return the first one that verifies and loads — corrupt blobs
+    are quarantined along the way (``load_state``), anything else unloadable
+    (half-written orbax internals, arch drift from an older run sharing the
+    dir) is logged and skipped. ``None`` when nothing under the dir is
+    loadable: the caller starts fresh, which beats dying on a dir of rot."""
+    for path in checkpoint_candidates(save_dir):
+        try:
+            return load_state(path, expected_arch=expected_arch), path
+        except Exception as e:  # noqa: BLE001 - any bad candidate means "next"
+            log.warning(f"skipping unloadable checkpoint {path.name}: {e}")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Retention / GC: long runs must not accumulate unbounded saved_models/.
+# ---------------------------------------------------------------------------
+
+
+def _checkpoint_epoch_mb(path: Path) -> tuple[int, int] | None:
+    """``_{name}_epoch_{E}_mb_{B}.<ext>`` -> (E, B), or None off-pattern."""
+    import re
+
+    m = re.search(r"_epoch_(\d+)_mb_(\d+)\.(?:pkl|orbax)$", path.name)
+    return (int(m.group(1)), int(m.group(2))) if m else None
+
+
+def prune_checkpoints(
+    save_dir: str | Path, keep_last: int, keep_every_epoch: bool = True
+) -> list[Path]:
+    """Delete all but the newest ``keep_last`` checkpoints (``keep_last <= 0``
+    keeps everything — the historical behavior and the default). With
+    ``keep_every_epoch`` the newest checkpoint of EVERY epoch also survives,
+    so a long run keeps one restore point per epoch plus a dense recent
+    window. Manifests go with their blobs; ``.corrupt`` quarantines are never
+    touched (they are evidence, not state). Returns the deleted paths."""
+    if keep_last <= 0:
+        return []
+    cands = checkpoint_candidates(save_dir)  # newest-first
+    keep = set(cands[:keep_last])
+    if keep_every_epoch:
+        best_per_epoch: dict[int, Path] = {}
+        for p in cands:  # newest-first: first hit per epoch wins
+            em = _checkpoint_epoch_mb(p)
+            if em is not None and em[0] not in best_per_epoch:
+                best_per_epoch[em[0]] = p
+        keep.update(best_per_epoch.values())
+    deleted: list[Path] = []
+    for p in cands:
+        if p in keep:
+            continue
+        try:
+            if p.is_dir():
+                import shutil
+
+                shutil.rmtree(p)
+            else:
+                p.unlink()
+                mpath = _manifest_path(p)
+                if mpath.exists():
+                    mpath.unlink()
+        except OSError as e:  # GC must never take the run down
+            log.warning(f"could not prune checkpoint {p.name}: {e}")
+            continue
+        deleted.append(p)
+    if deleted:
+        log.info(f"pruned {len(deleted)} old checkpoints under {save_dir}")
+    return deleted
+
+
+def prune_checkpoints_from_env(save_dir: str | Path) -> list[Path]:
+    """Apply the ``DDR_CKPT_KEEP_LAST`` / ``DDR_CKPT_KEEP_EVERY_EPOCH``
+    retention policy (unset/0 = keep everything; a malformed value is ignored
+    — a GC knob must never abort training)."""
+    raw = os.environ.get("DDR_CKPT_KEEP_LAST")
+    if not raw:
+        return []
+    try:
+        keep_last = int(raw)
+    except ValueError:
+        log.warning(f"ignoring malformed DDR_CKPT_KEEP_LAST={raw!r} (want an integer)")
+        return []
+    keep_epoch = os.environ.get("DDR_CKPT_KEEP_EVERY_EPOCH", "1").strip().lower() not in (
+        "0", "false", "no", "off",
     )
-    return paths[-1] if paths else None
+    return prune_checkpoints(save_dir, keep_last, keep_every_epoch=keep_epoch)
+
+
+# ---------------------------------------------------------------------------
+# Async checkpointing: snapshot on the loop thread, serialize+rename off it.
+# ---------------------------------------------------------------------------
+
+
+def async_checkpoint_from_env() -> bool:
+    """``DDR_CKPT_ASYNC`` master switch (default ON — the overlap is pure win
+    for the single-process pickle path; ``0``/``false``/``no``/``off``
+    disables, and the multi-host orbax path ignores it: collective saves are
+    ordered operations every process must enter together)."""
+    return os.environ.get("DDR_CKPT_ASYNC", "1").strip().lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writer: the train loop's ``checkpoint`` phase
+    shrinks to a device->host snapshot + enqueue, while serialization and the
+    atomic tmp/manifest/rename dance (:func:`save_state`) run on one daemon
+    writer thread — ``device_step`` overlaps the write (the PR 5 ``phases``
+    decomposition shows the shift: per-step ``checkpoint`` collapses, the
+    writer's ``checkpoint_io`` bucket absorbs the wall time).
+
+    Correctness contract:
+
+    - :meth:`save` snapshots ``params``/``opt_state`` via ``jax.device_get``
+      ON THE CALLING THREAD, before returning — the loop's buffer donation
+      may recycle those device buffers the moment the next step runs, so the
+      writer thread must never touch them.
+    - The queue is bounded at 1 pending snapshot with LATEST-WINS coalescing:
+      if the writer is still flushing mini-batch k when k+1 arrives, k's
+      queued (not yet started) snapshot is dropped — the newest state is
+      strictly more valuable, and a slow disk must throttle checkpoint
+      freshness, not memory.
+    - A failed write is re-raised on the NEXT :meth:`save`/:meth:`drain` —
+      checkpointing must not fail silently, but the step that already ran
+      should finish its bookkeeping first.
+    - :meth:`drain` blocks until everything enqueued has landed (the
+      emergency-save path and end-of-run both need "all my state is on disk").
+    """
+
+    def __init__(self, phase_timer: Any = None, prune_dir: str | Path | None = None) -> None:
+        self._queue: queue.Queue = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: BaseException | None = None
+        self._lock = threading.Lock()
+        # outstanding snapshots = queued + in-flight on the writer; the idle
+        # event mirrors `_pending == 0` under the lock, so drain() can never
+        # observe idle while a snapshot is queued-but-unstarted (a bare
+        # "queue empty?" check from the writer races save()'s clear+put)
+        self._pending = 0
+        self._phase_timer = phase_timer
+        self._prune_dir = prune_dir
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="ddr-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    # ---- pending accounting (the idle event's single source of truth) ----
+
+    def _pending_add(self) -> None:
+        with self._lock:
+            self._pending += 1
+            self._idle.clear()
+
+    def _pending_done(self) -> None:
+        with self._lock:
+            self._pending -= 1
+            if self._pending <= 0:
+                self._idle.set()
+
+    # ---- writer thread ----
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            try:
+                if self._phase_timer is not None:
+                    with self._phase_timer.phase("checkpoint_io"):
+                        save_state(**item)
+                else:
+                    save_state(**item)
+                if self._prune_dir is not None:
+                    prune_checkpoints_from_env(self._prune_dir)
+            except BaseException as e:  # noqa: BLE001 - reported on next save/drain
+                with self._lock:
+                    self._error = e
+                log.exception("async checkpoint write failed")
+            finally:
+                self._queue.task_done()
+                self._pending_done()
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError("previous async checkpoint write failed") from err
+
+    # ---- loop-facing surface ----
+
+    def save(
+        self,
+        save_dir: str | Path,
+        name: str,
+        epoch: int,
+        mini_batch: int,
+        params: Any,
+        opt_state: Any,
+        rng_state: Any = None,
+        arch: dict | None = None,
+    ) -> None:
+        """Snapshot now, write later. Same signature as :func:`save_state`."""
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        item = {
+            "save_dir": save_dir,
+            "name": name,
+            "epoch": epoch,
+            "mini_batch": mini_batch,
+            # the snapshot: host copies the writer thread owns outright
+            "params": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+            "rng_state": rng_state,
+            "arch": arch,
+        }
+        self._pending_add()
+        while True:
+            try:
+                self._queue.put_nowait(item)
+                return
+            except queue.Full:
+                # latest-wins: drop the stale QUEUED snapshot (never the one
+                # the writer already started — that one left the queue)
+                try:
+                    stale = self._queue.get_nowait()
+                    self._queue.task_done()
+                    self._pending_done()
+                    log.info(
+                        "async checkpoint writer behind: dropped queued snapshot "
+                        f"epoch {stale['epoch']} mb {stale['mini_batch']}"
+                    )
+                except queue.Empty:
+                    pass  # the writer drained it first; retry the put
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every enqueued snapshot is on disk (True) or the
+        timeout passes (False). Re-raises a pending write error."""
+        ok = self._idle.wait(timeout)
+        self._raise_pending()
+        return ok
+
+    def close(self, timeout: float | None = 60.0) -> None:
+        """Drain, stop the writer thread, surface any terminal write error.
+        Honors ``timeout`` even against a wedged writer: a snapshot still
+        queued behind a stalled write is dropped (and logged) rather than
+        blocking forever — the preemption grace window must end in an exit."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._idle.wait(timeout):
+            log.warning("async checkpoint writer did not drain before close")
+        while True:
+            try:
+                self._queue.put_nowait(None)
+                break
+            except queue.Full:
+                try:
+                    stale = self._queue.get_nowait()
+                    self._queue.task_done()
+                    self._pending_done()
+                    log.warning(
+                        "async checkpoint writer wedged: dropping queued snapshot "
+                        f"epoch {stale['epoch']} mb {stale['mini_batch']}"
+                    )
+                except queue.Empty:
+                    pass
+        self._thread.join(timeout)
+        self._raise_pending()

@@ -23,6 +23,12 @@ shed/reject/error *rates* warn when they GROW, ``throughput_rps`` and
 ``slo_attainment`` when they DROP; a drop-rate appearing from a clean (zero)
 baseline always flags.
 
+``ddr chaos`` reports (``kind: "chaos"``, written as ``CHAOS_*.json``) gate
+against the latest committed CHAOS record the same way: recovery time and the
+resume-fidelity deltas (``recovery_s``, ``loss_delta``,
+``params_max_abs_delta``) warn when they GROW, ``post_restart_attainment``
+when it DROPS, and the shed/reject/error rates follow the loadtest rules.
+
 Records from different devices are never compared as regressions: a CPU
 fallback round against a TPU round says nothing about the code, so a device
 mismatch downgrades every finding to informational.
@@ -103,12 +109,29 @@ RATE_KEYS = ("shed_rate", "reject_rate", "error_rate")
 RATE_FLOOR = 0.02
 
 #: Serving fields where BIGGER is better, compared like throughput.
-SERVING_UP_KEYS = ("throughput_rps", "slo_attainment")
+SERVING_UP_KEYS = ("throughput_rps", "slo_attainment", "post_restart_attainment")
+
+#: ``ddr chaos`` report fields where SMALLER is better: recovery wall time
+#: (kill -> ready / kill -> first resumed step) and the resume-fidelity
+#: deltas against the golden run. Growth past the threshold warns exactly
+#: like latency — a change that doubles recovery time is a robustness
+#: regression even when steady-state throughput held.
+CHAOS_DOWN_KEYS = (
+    "recovery_s",
+    "mean_recovery_s",
+    "loss_delta",
+    "params_max_abs_delta",
+)
 
 
 def is_loadtest_record(rec: dict) -> bool:
     """Whether a record is a ``ddr loadtest`` report (vs a bench.py record)."""
     return rec.get("kind") == "loadtest" or "p50_ms" in rec
+
+
+def is_chaos_record(rec: dict) -> bool:
+    """Whether a record is a ``ddr chaos`` report (kill-and-resume harness)."""
+    return rec.get("kind") == "chaos"
 
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -131,7 +154,7 @@ def latest_baseline(
         m = re.match(r"BENCH_r(\d+)", p.name)
         return (int(m.group(1)) if m else -1, p.name)
 
-    if pattern.startswith("LOADTEST"):
+    if pattern.startswith(("LOADTEST", "CHAOS")):
         key = lambda p: (p.stat().st_mtime, p.name)  # noqa: E731
     else:
         key = round_of
@@ -140,6 +163,31 @@ def latest_baseline(
         resolved = exclude.resolve()
         cands = [p for p in cands if p.resolve() != resolved]
     return cands[-1] if cands else None
+
+
+def latest_chaos_baseline(
+    root: Path = REPO_ROOT, mode: str | None = None, exclude: Path | None = None
+) -> Path | None:
+    """The newest CHAOS_* record of the SAME mode (train vs serve — their
+    ``recovery_s`` measure different journeys, so cross-mode comparison is
+    noise). Records that fail to parse are skipped; ``mode=None`` degrades to
+    plain newest-by-mtime."""
+    cands = sorted(
+        root.glob("CHAOS_*.json"), key=lambda p: (p.stat().st_mtime, p.name),
+        reverse=True,
+    )
+    resolved = exclude.resolve() if exclude is not None else None
+    for p in cands:
+        if resolved is not None and p.resolve() == resolved:
+            continue
+        if mode is None:
+            return p
+        try:
+            if load_record(p).get("mode") == mode:
+                return p
+        except (ValueError, json.JSONDecodeError, OSError):
+            continue
+    return None
 
 
 def load_record(path: Path) -> dict:
@@ -178,7 +226,7 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
         and baseline.get("device") is not None
         and fresh["device"] != baseline["device"]
     )
-    smaller_is_better = MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS
+    smaller_is_better = MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS + CHAOS_DOWN_KEYS
     for key in (
         THROUGHPUT_KEYS + SERVING_UP_KEYS + RATIO_KEYS + smaller_is_better
     ):
@@ -274,17 +322,21 @@ def main(argv: list[str] | None = None) -> int:
     else:
         ap.error("pass a fresh record path or --run")
 
-    # a loadtest report compares against the loadtest history, never a bench
-    # round (the fields don't overlap; mixing them silently compares nothing)
-    pattern = "LOADTEST_*.json" if is_loadtest_record(fresh) else "BENCH_r*.json"
-    baseline_path = (
-        Path(args.baseline)
-        if args.baseline
-        else latest_baseline(
-            pattern=pattern,
-            exclude=Path(args.fresh) if args.fresh else None,
-        )
-    )
+    # loadtest/chaos reports compare against their own record history, never
+    # a bench round (the fields don't overlap; mixing them compares nothing);
+    # chaos additionally pairs by MODE — a train-resume recovery_s against a
+    # serve-replica one is noise
+    exclude = Path(args.fresh) if args.fresh else None
+    if is_chaos_record(fresh):
+        pattern = "CHAOS_*.json"
+        found = latest_chaos_baseline(mode=fresh.get("mode"), exclude=exclude)
+    elif is_loadtest_record(fresh):
+        pattern = "LOADTEST_*.json"
+        found = latest_baseline(pattern=pattern, exclude=exclude)
+    else:
+        pattern = "BENCH_r*.json"
+        found = latest_baseline(pattern=pattern, exclude=exclude)
+    baseline_path = Path(args.baseline) if args.baseline else found
     if baseline_path is None:
         print(f"check_bench_regression: no {pattern} baseline found", file=sys.stderr)
         return 0

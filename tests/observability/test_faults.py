@@ -1,0 +1,220 @@
+"""Fault-injection layer: the DDR_FAULTS grammar, deterministic matching,
+the three actions, telemetry, and the zero-cost-when-off contract."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from ddr_tpu.observability import faults
+from ddr_tpu.observability.events import Recorder, activate, deactivate
+from ddr_tpu.observability.faults import (
+    FaultPlan,
+    InjectedFault,
+    parse_faults,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process plan empty (other suites must never see
+    a leaked fault plan)."""
+    yield
+    faults.configure(None)
+
+
+class TestGrammar:
+    def test_issue_example_parses(self):
+        acts = parse_faults(
+            "crash@step=37;slow@data.load:p=0.1,ms=500;corrupt@checkpoint.write:n=1"
+        )
+        assert [a.describe() for a in acts] == [
+            {"action": "crash", "site": "device.step", "at": 37},
+            {"action": "slow", "site": "data.load", "p": 0.1, "ms": 500.0},
+            {"action": "corrupt", "site": "checkpoint.write", "n": 1},
+        ]
+
+    def test_site_suffix_aliases(self):
+        for token, site in (
+            ("step", "device.step"),
+            ("write", "checkpoint.write"),
+            ("load", "data.load"),
+            ("execute", "serve.execute"),
+            ("reload", "registry.reload"),
+            ("device.step", "device.step"),
+        ):
+            (a,) = parse_faults(f"crash@{token}")
+            assert a.site == site
+
+    def test_empty_clauses_and_whitespace(self):
+        acts = parse_faults(" crash@step=1 ; ; slow@load:ms=5 ;")
+        assert len(acts) == 2
+
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            "crash@nowhere",  # unknown site
+            "explode@step",  # unknown action
+            "crash@step:bogus=1",  # unknown parameter
+            "crashstep",  # no @
+            "crash@step:p",  # parameter without =
+            "crash@step:p=2.0",  # probability out of range
+            "corrupt@device.step",  # no byte payload at that site to flip
+            "corrupt@serve.execute",
+        ],
+    )
+    def test_typos_raise(self, spec):
+        with pytest.raises(ValueError):
+            parse_faults(spec)
+
+    def test_probability_seed_is_stable_across_processes(self):
+        """The p= firing pattern must replay identically in a fresh
+        interpreter (digest-seeded RNG, not PYTHONHASHSEED-salted tuples)."""
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        code = (
+            "from ddr_tpu.observability.faults import FaultPlan, parse_faults\n"
+            "plan = FaultPlan(parse_faults('corrupt@checkpoint.write:p=0.5', seed=7))\n"
+            "p = plan.point('checkpoint.write')\n"
+            "data = b'z' * 100\n"
+            "print(''.join('1' if p(data=data) != data else '0' for _ in range(24)))\n"
+        )
+        runs = {
+            subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                timeout=120,
+                env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                     "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+                     "PYTHONHASHSEED": str(h)},
+            ).stdout.strip()
+            for h in (0, 1)
+        }
+        assert len(runs) == 1 and runs.pop()
+
+    def test_at_param_equals_shorthand(self):
+        (a,) = parse_faults("crash@device.step:at=3")
+        (b,) = parse_faults("crash@step=3")
+        assert a.at == b.at == 3
+
+
+class TestMatching:
+    def test_at_matches_ctx_step(self):
+        plan = FaultPlan(parse_faults("crash@step=2"))
+        p = plan.point("device.step")
+        p(step=0)
+        p(step=1)
+        with pytest.raises(InjectedFault) as e:
+            p(step=2)
+        assert e.value.site == "device.step"
+        p(step=3)  # only the exact step fires
+
+    def test_at_falls_back_to_invocation_counter(self):
+        plan = FaultPlan(parse_faults("crash@checkpoint.write:at=1"))
+        p = plan.point("checkpoint.write")
+        p()  # invocation 0
+        with pytest.raises(InjectedFault):
+            p()  # invocation 1
+
+    def test_n_limits_firings(self):
+        plan = FaultPlan(parse_faults("corrupt@checkpoint.write:n=1"))
+        p = plan.point("checkpoint.write")
+        data = b"a" * 200
+        assert p(data=data) != data
+        assert p(data=data) == data  # budget spent
+
+    def test_probability_is_seeded_and_deterministic(self):
+        def firing_pattern(seed):
+            plan = FaultPlan(parse_faults("corrupt@checkpoint.write:p=0.5", seed=seed))
+            p = plan.point("checkpoint.write")
+            data = b"z" * 100
+            return [p(data=data) != data for _ in range(32)]
+
+        a, b = firing_pattern(7), firing_pattern(7)
+        assert a == b  # same seed -> same faults
+        assert any(a) and not all(a)  # p=0.5 actually mixes
+        assert firing_pattern(8) != a  # another seed -> another pattern
+
+    def test_unarmed_site_resolves_to_none(self):
+        plan = FaultPlan(parse_faults("crash@step=0"))
+        assert plan.point("serve.execute") is None
+        assert plan.point("device.step") is not None
+        with pytest.raises(ValueError):
+            plan.point("not.a.site")
+
+
+class TestActions:
+    def test_slow_sleeps(self):
+        plan = FaultPlan(parse_faults("slow@data.load:ms=60"))
+        p = plan.point("data.load")
+        t0 = time.perf_counter()
+        p()
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_corrupt_flips_bits_same_length(self):
+        plan = FaultPlan(parse_faults("corrupt@checkpoint.write"))
+        mutated = plan.point("checkpoint.write")(data=b"\x00" * 500)
+        assert len(mutated) == 500
+        assert mutated != b"\x00" * 500
+
+    def test_corrupt_without_payload_is_noop(self):
+        plan = FaultPlan(parse_faults("corrupt@checkpoint.write"))
+        assert plan.point("checkpoint.write")() is None
+
+    def test_crash_evaluated_after_slow(self):
+        plan = FaultPlan(parse_faults("slow@step:ms=30;crash@step"))
+        p = plan.point("device.step")
+        t0 = time.perf_counter()
+        with pytest.raises(InjectedFault):
+            p()
+        assert time.perf_counter() - t0 >= 0.02  # the delay still happened
+
+
+class TestProcessPlan:
+    def test_configure_and_fault_site(self):
+        faults.configure("crash@serve.execute:n=1")
+        p = faults.fault_site("serve.execute")
+        assert p is not None
+        with pytest.raises(InjectedFault):
+            p()
+        assert faults.fault_site("device.step") is None
+        faults.configure(None)
+        assert faults.fault_site("serve.execute") is None
+
+    def test_active_plan_reads_env_once(self, monkeypatch):
+        monkeypatch.setenv("DDR_FAULTS", "crash@step=0")
+        monkeypatch.setenv("DDR_FAULTS_SEED", "3")
+        faults._PLAN = None  # force a re-read of the environment
+        try:
+            assert faults.fault_site("device.step") is not None
+            monkeypatch.setenv("DDR_FAULTS", "")
+            # cached: the plan does not flip mid-process
+            assert faults.fault_site("device.step") is not None
+        finally:
+            faults.configure(None)
+
+    def test_maybe_inject_passthrough_when_unarmed(self):
+        faults.configure(None)
+        assert faults.maybe_inject("checkpoint.write", data=b"abc") == b"abc"
+
+    def test_firing_emits_fault_event(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        try:
+            faults.configure("corrupt@checkpoint.write:n=1")
+            faults.maybe_inject("checkpoint.write", data=b"x" * 64, path="ckpt.pkl")
+        finally:
+            deactivate(rec)
+            rec.close()
+        events = [
+            json.loads(line) for line in (tmp_path / "log.jsonl").read_text().splitlines()
+        ]
+        fault_events = [e for e in events if e["event"] == "fault"]
+        assert len(fault_events) == 1
+        (ev,) = fault_events
+        assert ev["action"] == "corrupt"
+        assert ev["site"] == "checkpoint.write"
+        assert ev["path"] == "ckpt.pkl"

@@ -255,3 +255,54 @@ class TestWatchdog:
             reason="non-finite") == 1
         w.observe(_stats())
         assert g.value() == 1.0
+
+
+class TestStaleness:
+    """The wall-clock stall check: a watchdog that stops seeing batches goes
+    stale -> degraded (a hung collective's signature), and a single observe
+    clears it."""
+
+    def test_stale_off_by_default(self):
+        w = HealthWatchdog(HealthConfig())
+        assert not w.stale
+        assert w.staleness_s >= 0.0
+        assert w.status()["stale"] is False
+
+    def test_stale_after_silence_and_cleared_by_observe(self):
+        import time as _time
+
+        w = HealthWatchdog(HealthConfig(max_stall_s=0.05))
+        _time.sleep(0.1)
+        assert w.stale
+        assert w.degraded  # staleness degrades even with zero violations
+        status = w.status()
+        assert status["stale"] is True and status["degraded"] is True
+        assert status["staleness_s"] >= 0.05
+        w.observe(_stats())  # one healthy batch clears it
+        assert not w.stale and not w.degraded
+
+    def test_disabled_watchdog_never_goes_stale(self):
+        import time as _time
+
+        w = HealthWatchdog(HealthConfig(enabled=False, max_stall_s=0.01))
+        _time.sleep(0.03)
+        assert not w.stale
+
+    def test_from_env_and_validation(self, monkeypatch):
+        monkeypatch.setenv("DDR_HEALTH_MAX_STALL_S", "12.5")
+        assert HealthConfig.from_env().max_stall_s == 12.5
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="max_stall_s"):
+            HealthConfig(max_stall_s=0.0)
+
+    def test_serving_readyz_degrades_on_stale(self):
+        """The serving layer reads watchdog.degraded for /readyz — staleness
+        must flow through the same property."""
+        import time as _time
+
+        w = HealthWatchdog(HealthConfig(max_stall_s=0.04, bad_batches=3))
+        w.observe(_stats())
+        assert not w.degraded
+        _time.sleep(0.08)
+        assert w.degraded

@@ -435,3 +435,114 @@ class TestBenchSmoke:
         assert metrics_dir_from_env() is None
         monkeypatch.setenv("DDR_METRICS_DIR", "/tmp/x")
         assert metrics_dir_from_env() == "/tmp/x"
+
+
+class TestStallDetection:
+    """Stall detection: summarize's post-hoc check and follow's live watch
+    both flag a run whose event stream went quiet past N x its cadence."""
+
+    def _steps(self, walls, host=0):
+        return [
+            {"event": "step", "t": w - 100.0, "wall": w, "host": host, "pid": 1,
+             "seq": i, "epoch": 1, "batch": i, "loss": 1.0,
+             "reach_timesteps_per_sec": 10.0, "seconds": 0.5}
+            for i, w in enumerate(walls)
+        ]
+
+    def test_detect_stalls_flags_quiet_host(self):
+        from ddr_tpu.observability.metrics_cli import detect_stalls
+
+        events = self._steps([100.0, 102.0, 104.0, 106.0])
+        findings = detect_stalls(events, now=200.0, factor=5.0)
+        assert len(findings) == 1
+        (f,) = findings
+        assert f["host"] == 0 and f["last_event"] == "step"
+        assert f["cadence_s"] == 2.0 and f["age_s"] == 94.0
+        # a healthy run (age within factor x cadence) stays quiet
+        assert detect_stalls(events, now=112.0, factor=5.0) == []
+
+    def test_run_end_means_finished_not_stalled(self):
+        from ddr_tpu.observability.metrics_cli import detect_stalls
+
+        events = self._steps([100.0, 102.0])
+        events.append({"event": "run_end", "wall": 103.0, "host": 0, "status": "ok"})
+        assert detect_stalls(events, now=10_000.0) == []
+
+    def test_single_event_has_no_cadence_to_judge(self):
+        from ddr_tpu.observability.metrics_cli import detect_stalls
+
+        assert detect_stalls(self._steps([100.0]), now=10_000.0) == []
+
+    def test_per_host_flagging(self):
+        from ddr_tpu.observability.metrics_cli import detect_stalls
+
+        events = self._steps([100.0, 102.0, 198.0, 199.8], host=0)
+        events += self._steps([100.0, 102.0, 104.0], host=1)
+        findings = detect_stalls(events, now=200.0, factor=5.0)
+        assert [f["host"] for f in findings] == [1]  # host0 is current
+
+    def test_heartbeats_count_as_liveness(self):
+        from ddr_tpu.observability.metrics_cli import detect_stalls
+
+        events = [
+            {"event": "heartbeat", "wall": w, "host": 0, "step": i}
+            for i, w in enumerate([100.0, 110.0, 120.0])
+        ]
+        findings = detect_stalls(events, now=500.0, factor=5.0)
+        assert len(findings) == 1 and findings[0]["last_event"] == "heartbeat"
+
+    def test_summarize_prints_stall_line(self, tmp_path):
+        import io
+
+        from ddr_tpu.observability.metrics_cli import summarize
+
+        events = [{"event": "run_start", "wall": 99.0, "host": 0, "cmd": "train"}]
+        events += self._steps([100.0, 101.0, 102.0, 103.0])
+        out = io.StringIO()
+        summarize(events, out=out, now=163.0)
+        text = out.getvalue()
+        assert "STALL?" in text and "host0" in text and "cadence" in text
+        # with run_end present the same events summarize quietly
+        out2 = io.StringIO()
+        summarize(
+            events + [{"event": "run_end", "wall": 104.0, "host": 0,
+                       "status": "ok", "duration_s": 5.0}],
+            out=out2, now=163.0,
+        )
+        assert "STALL?" not in out2.getvalue()
+
+    def test_summarize_cli_stall_factor_flag(self, tmp_path):
+        p = tmp_path / "run_log.train.jsonl"
+        lines = [json.dumps(e) for e in self._steps([100.0, 101.0, 102.0])]
+        p.write_text("\n".join(lines) + "\n")
+        # enormous factor: even an ancient log is "current"
+        assert main(["summarize", str(p), "--stall-factor", "1e18"]) == 0
+
+    def test_follow_warns_once_on_silence_and_rearms(self, tmp_path, monkeypatch):
+        import io
+
+        from ddr_tpu.observability import metrics_cli
+
+        p = tmp_path / "run_log.train.jsonl"
+        p.write_text("")
+        clock = {"t": 1000.0}
+        monkeypatch.setattr(metrics_cli.time, "monotonic", lambda: clock["t"])
+        polls = {"n": 0}
+
+        def fake_sleep(_s):
+            # advance the fake clock 1s per poll; append one event on the
+            # first three polls (cadence ~1s), then go silent
+            polls["n"] += 1
+            clock["t"] += 1.0
+            if polls["n"] <= 3:
+                ev = {"event": "step", "t": polls["n"], "wall": polls["n"],
+                      "host": 0, "pid": 1, "seq": polls["n"], "loss": 1.0}
+                with p.open("a") as f:
+                    f.write(json.dumps(ev) + "\n")
+
+        monkeypatch.setattr(metrics_cli.time, "sleep", fake_sleep)
+        out = io.StringIO()
+        metrics_cli.follow(p, out=out, max_polls=12, stall_factor=3.0)
+        text = out.getvalue()
+        assert text.count("STALL?") == 1  # warned once, not every poll
+        assert "cadence" in text

@@ -1,0 +1,147 @@
+"""``ddr chaos`` harness: log-harvest units, CLI plumbing, and the slow
+kill-and-resume acceptance e2es (train SIGKILL x2 + serve kill/restart under
+load, both gated by check_bench_regression)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from ddr_tpu.scripts import chaos
+
+
+class TestUnits:
+    def test_read_jsonl_tolerates_torn_tail(self, tmp_path):
+        p = tmp_path / "log.jsonl"
+        p.write_text('{"event": "step", "loss": 1.0}\n{"event": "st')
+        events = chaos._read_jsonl(p)
+        assert len(events) == 1
+        assert chaos._read_jsonl(tmp_path / "missing.jsonl") == []
+
+    def test_step_losses_keyed_by_epoch_batch(self):
+        events = [
+            {"event": "step", "epoch": 1, "batch": 0, "loss": 2.0},
+            {"event": "step", "epoch": 1, "batch": 1, "loss": 1.5},
+            {"event": "heartbeat", "epoch": 1},
+            {"event": "step", "epoch": 1, "batch": 1, "loss": 1.4},  # last wins
+        ]
+        assert chaos._step_losses(events) == {(1, 0): 2.0, (1, 1): 1.4}
+
+    def test_train_cfg_resumes_from_own_saved_models(self, tmp_path):
+        class A:
+            segments, epochs = 32, 1
+
+        cfg = chaos._train_cfg_dict(tmp_path / "run", tmp_path / "run/saved_models", A)
+        assert cfg["experiment"]["checkpoint"] == str(tmp_path / "run/saved_models")
+        assert cfg["experiment"]["shuffle"] is False  # resume determinism
+        cfg2 = chaos._train_cfg_dict(tmp_path / "g", None, A)
+        assert "checkpoint" not in cfg2["experiment"]
+
+    def test_subprocess_env_defaults_compile_cache(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DDR_COMPILE_CACHE_DIR", raising=False)
+        monkeypatch.setenv("DDR_METRICS_DIR", "/nope")
+        env = chaos._subprocess_env(tmp_path)
+        assert env["DDR_COMPILE_CACHE_DIR"] == str(tmp_path / "xla_cache")
+        assert "DDR_METRICS_DIR" not in env
+        monkeypatch.setenv("DDR_COMPILE_CACHE_DIR", "/pinned")
+        assert chaos._subprocess_env(tmp_path)["DDR_COMPILE_CACHE_DIR"] == "/pinned"
+
+    def test_render_summary_both_modes(self):
+        train_rep = {
+            "mode": "train", "label": "x", "passed": True, "kills": [1, 2],
+            "signal": "kill", "steps_chaos": 4, "steps_golden": 4,
+            "steps_missing": 0, "loss_delta": 0.0, "params_max_abs_delta": 0.0,
+            "tolerance": 1e-4, "recovery_s": 3.2,
+        }
+        out = chaos.render_summary(train_rep)
+        assert "PASSED" in out and "kills" in out
+        serve_rep = {
+            "mode": "serve", "label": "y", "passed": False, "recovery_s": 9.9,
+            "kill_after_s": 3.0, "requests": 10, "ok": 2, "errors": 8,
+            "error_rate": 0.8, "post_restart_attainment": None,
+            "post_restart_requests": 0,
+        }
+        out = chaos.render_summary(serve_rep)
+        assert "FAILED" in out and "recovery 9.9s" in out
+
+    def test_cli_requires_mode_and_serve_requires_synthetic(self, capsys, tmp_path):
+        assert chaos.main([]) == 2
+        with pytest.raises(SystemExit):
+            chaos.run_chaos_serve(
+                type("A", (), {"synthetic": False, "url": None})()
+            )
+
+    def test_chaos_command_is_dispatchable(self):
+        from ddr_tpu.cli import _COMMANDS
+
+        assert _COMMANDS["chaos"] == "ddr_tpu.scripts.chaos"
+
+
+def _shared_cache_env(monkeypatch):
+    """Point subprocess XLA caches at the test harness's warm cache so the
+    e2es replay compiles instead of re-paying them per subprocess."""
+    import jax
+
+    cache = jax.config.jax_compilation_cache_dir
+    if cache:
+        monkeypatch.setenv("DDR_COMPILE_CACHE_DIR", cache)
+
+
+@pytest.mark.slow
+def test_chaos_train_sigkill_resume_matches_golden(tmp_path, monkeypatch):
+    """THE kill-and-resume acceptance: a real training subprocess SIGKILLed at
+    two distinct mini-batches resumes each time, and the full loss trajectory
+    + final params match the uninterrupted golden run within tolerance."""
+    _shared_cache_env(monkeypatch)
+    rc = chaos.main([
+        "train", "--kills", "1,2", "--label", "e2e", "--out", str(tmp_path),
+        "--timeout", "240",
+    ])
+    assert rc == 0
+    report = json.loads((tmp_path / "CHAOS_e2e.json").read_text())
+    assert report["passed"] is True
+    assert report["kills"] == [1, 2]
+    assert report["steps_missing"] == 0
+    assert report["steps_chaos"] == report["steps_golden"] >= 4
+    assert report["loss_delta"] <= report["tolerance"]
+    assert report["params_max_abs_delta"] <= report["tolerance"]
+    assert report["recovery_s"] > 0
+    # the harness's own telemetry recorded the kills and resumes
+    log = tmp_path / "run_log.chaos.jsonl"
+    events = chaos._read_jsonl(log)
+    actions = [e["action"] for e in events if e["event"] == "chaos"]
+    assert actions.count("kill") == 2 and actions.count("resume") == 2
+
+
+@pytest.mark.slow
+def test_chaos_serve_synthetic_recovers_and_passes_gate(tmp_path, monkeypatch):
+    """`ddr chaos serve --synthetic` completes: the replica is SIGKILLed under
+    open-loop load, restarts, recovers, and the CHAOS record passes the
+    check_bench_regression gate."""
+    _shared_cache_env(monkeypatch)
+    rc = chaos.main([
+        "serve", "--synthetic", "--rps", "8", "--duration", "8",
+        "--kill-after", "2.5", "--label", "se2e", "--out", str(tmp_path),
+        "--boot-timeout", "240",
+    ])
+    assert rc == 0
+    record = tmp_path / "CHAOS_se2e.json"
+    report = json.loads(record.read_text())
+    assert report["recovered"] is True and report["passed"] is True
+    assert report["recovery_s"] > 0
+    assert report["post_restart_requests"] > 0
+    assert report["post_restart_attainment"] > 0.5
+    # the outage is visible in the storm's error accounting
+    assert report["errors"] > 0
+
+    # and the new regression gate accepts it (self-compare: no regressions)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "cbr", Path(__file__).resolve().parents[2] / "scripts/check_bench_regression.py"
+    )
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    assert cbr.main([str(record), "--baseline", str(record), "--strict"]) == 0

@@ -370,3 +370,125 @@ def test_end_to_end_against_fresh_bench(tmp_path):
     )
     assert bad.returncode == 1
     assert "WARNING" in bad.stderr
+
+
+class TestChaosRecords:
+    """``ddr chaos`` gating: recovery time / resume-fidelity deltas warn on
+    GROWTH, post-restart attainment on DROP, and chaos records compare against
+    the CHAOS_* history (by mtime), never a bench round or loadtest record."""
+
+    def _chaos(self, **over):
+        rec = {
+            "kind": "chaos", "mode": "serve", "device": "cpu",
+            "recovery_s": 5.0, "mean_recovery_s": 4.5,
+            "error_rate": 0.3, "shed_rate": 0.0,
+            "post_restart_attainment": 1.0, "throughput_rps": 3.5,
+        }
+        rec.update(over)
+        return rec
+
+    def test_is_chaos_record(self):
+        mod = _load()
+        assert mod.is_chaos_record({"kind": "chaos"})
+        assert not mod.is_chaos_record({"kind": "loadtest"})
+        assert not mod.is_chaos_record({"value": 1.0})
+
+    def test_recovery_growth_flags(self):
+        mod = _load()
+        by_key = {
+            f["key"]: f
+            for f in mod.compare(
+                self._chaos(recovery_s=10.0, mean_recovery_s=9.0), self._chaos(),
+                threshold=0.2,
+            )
+        }
+        assert by_key["recovery_s"]["status"] == "regression"
+        assert by_key["mean_recovery_s"]["status"] == "regression"
+
+    def test_faster_recovery_is_ok(self):
+        mod = _load()
+        by_key = {
+            f["key"]: f
+            for f in mod.compare(self._chaos(recovery_s=2.0), self._chaos())
+        }
+        assert by_key["recovery_s"]["status"] == "ok"
+
+    def test_post_restart_attainment_drop_flags(self):
+        mod = _load()
+        by_key = {
+            f["key"]: f
+            for f in mod.compare(
+                self._chaos(post_restart_attainment=0.5), self._chaos(), threshold=0.2
+            )
+        }
+        assert by_key["post_restart_attainment"]["status"] == "regression"
+
+    def test_train_mode_fidelity_deltas_flag_on_growth(self):
+        mod = _load()
+        fresh = {"kind": "chaos", "device": "cpu", "loss_delta": 0.5,
+                 "params_max_abs_delta": 0.2}
+        base = {"kind": "chaos", "device": "cpu", "loss_delta": 0.0001,
+                "params_max_abs_delta": 0.0001}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["loss_delta"]["status"] == "regression"
+        assert by_key["params_max_abs_delta"]["status"] == "regression"
+
+    def test_device_mismatch_downgrades(self):
+        mod = _load()
+        findings = mod.compare(
+            self._chaos(device="cpu", recovery_s=50.0), self._chaos(device="tpu")
+        )
+        assert all(f["status"] in ("info", "ok") for f in findings)
+
+    def test_chaos_baseline_selected_by_mtime_within_chaos_history(self, tmp_path):
+        import os as _os
+
+        mod = _load()
+        old = tmp_path / "CHAOS_old.json"
+        new = tmp_path / "CHAOS_zz_newer.json"
+        bench = tmp_path / "BENCH_r99.json"
+        loadtest = tmp_path / "LOADTEST_x.json"
+        for p in (old, new, bench, loadtest):
+            p.write_text("{}")
+        _os.utime(old, (1_000_000, 1_000_000))
+        _os.utime(new, (2_000_000, 2_000_000))
+        assert mod.latest_baseline(tmp_path, pattern="CHAOS_*.json") == new
+        # the fresh record never baselines itself
+        assert mod.latest_baseline(
+            tmp_path, pattern="CHAOS_*.json", exclude=new
+        ) == old
+
+    def test_cli_gates_chaos_record_end_to_end(self, tmp_path, capsys):
+        import json as _json
+
+        mod = _load()
+        base = self._chaos()
+        fresh = self._chaos(recovery_s=20.0, post_restart_attainment=0.4)
+        base_p = tmp_path / "CHAOS_base.json"
+        fresh_p = tmp_path / "CHAOS_fresh.json"
+        base_p.write_text(_json.dumps(base))
+        fresh_p.write_text(_json.dumps(fresh))
+        rc = mod.main([str(fresh_p), "--baseline", str(base_p), "--strict"])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "recovery_s" in err and "post_restart_attainment" in err
+        # self-compare passes clean
+        assert mod.main([str(base_p), "--baseline", str(base_p), "--strict"]) == 0
+
+    def test_chaos_baseline_pairs_by_mode(self, tmp_path):
+        import json as _json
+        import os as _os
+
+        mod = _load()
+        serve_rec = tmp_path / "CHAOS_a_serve.json"
+        train_rec = tmp_path / "CHAOS_b_train.json"
+        serve_rec.write_text(_json.dumps(self._chaos(mode="serve")))
+        train_rec.write_text(_json.dumps({"kind": "chaos", "mode": "train"}))
+        _os.utime(serve_rec, (1_000_000, 1_000_000))
+        _os.utime(train_rec, (2_000_000, 2_000_000))  # newest overall
+        # a fresh SERVE record must skip the newer train record
+        assert mod.latest_chaos_baseline(tmp_path, mode="serve") == serve_rec
+        assert mod.latest_chaos_baseline(tmp_path, mode="train") == train_rec
+        assert mod.latest_chaos_baseline(tmp_path, mode="bogus") is None
+        # and mode=None degrades to plain newest
+        assert mod.latest_chaos_baseline(tmp_path, mode=None) == train_rec

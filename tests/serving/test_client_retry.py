@@ -1,0 +1,182 @@
+"""HttpForecastClient retry: opt-in backoff on 429/503/connection-reset,
+Retry-After honor, one request id across the chain, and the never-retry-4xx
+rule — against a scripted stdlib HTTP server."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ddr_tpu.serving.client import HttpForecastClient, retry_after_seconds
+
+
+class _ScriptedServer:
+    """Serves /v1/forecast from a per-instance script of (status, body,
+    headers) tuples; records every request's id header."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests: list[str | None] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                outer.requests.append(self.headers.get("X-DDR-Request-Id"))
+                status, body, headers = (
+                    outer.script.pop(0) if outer.script else (200, {"runoff": []}, {})
+                )
+                payload = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def scripted():
+    servers = []
+
+    def make(script):
+        s = _ScriptedServer(script)
+        servers.append(s)
+        return s
+
+    yield make
+    for s in servers:
+        s.close()
+
+
+class TestRetry:
+    def test_retries_503_until_ok_with_one_request_id(self, scripted):
+        srv = scripted([
+            (503, {"reason": "not-ready"}, {}),
+            (503, {"reason": "shed"}, {}),
+            (200, {"runoff": [[1.0]]}, {}),
+        ])
+        client = HttpForecastClient(srv.url, retries=3, retry_backoff_s=0.01)
+        code, body = client.forecast_response("default", t0=0)
+        assert code == 200
+        assert len(srv.requests) == 3
+        # the whole chain shares ONE minted trace id
+        assert len(set(srv.requests)) == 1 and srv.requests[0]
+
+    def test_retries_429_and_reuses_caller_supplied_id(self, scripted):
+        srv = scripted([(429, {"reason": "queue-full"}, {}), (200, {"runoff": []}, {})])
+        client = HttpForecastClient(srv.url, retries=2, retry_backoff_s=0.01)
+        code, _ = client.forecast_response("default", t0=0, request_id="trace-77")
+        assert code == 200
+        assert srv.requests == ["trace-77", "trace-77"]
+
+    def test_never_retries_other_4xx(self, scripted):
+        srv = scripted([(400, {"error": "bad t0"}, {})])
+        client = HttpForecastClient(srv.url, retries=5, retry_backoff_s=0.01)
+        code, body = client.forecast_response("default", t0=-1)
+        assert code == 400 and body["error"] == "bad t0"
+        assert len(srv.requests) == 1
+
+    def test_attempt_budget_returns_last_response(self, scripted):
+        srv = scripted([(503, {"reason": "shed"}, {})] * 3)
+        client = HttpForecastClient(srv.url, retries=2, retry_backoff_s=0.01)
+        code, body = client.forecast_response("default", t0=0)
+        assert code == 503 and body["reason"] == "shed"
+        assert len(srv.requests) == 3  # 1 + 2 retries, then gave up
+
+    def test_zero_retries_keeps_one_shot_semantics(self, scripted):
+        srv = scripted([(503, {"reason": "shed"}, {})])
+        client = HttpForecastClient(srv.url)
+        code, _ = client.forecast_response("default", t0=0)
+        assert code == 503
+        assert len(srv.requests) == 1
+        # no retries requested -> no client-minted id
+        assert srv.requests == [None]
+
+    def test_honors_retry_after_when_longer(self, scripted):
+        srv = scripted([
+            (503, {"reason": "warming"}, {"Retry-After": "0.2"}),
+            (200, {"runoff": []}, {}),
+        ])
+        client = HttpForecastClient(srv.url, retries=1, retry_backoff_s=0.001)
+        t0 = time.monotonic()
+        code, _ = client.forecast_response("default", t0=0)
+        assert code == 200
+        assert time.monotonic() - t0 >= 0.2
+
+    def test_total_deadline_bounds_the_chain(self, scripted):
+        srv = scripted([(503, {"reason": "shed"}, {})] * 10)
+        client = HttpForecastClient(
+            srv.url, retries=10, retry_backoff_s=0.4, retry_deadline_s=0.05
+        )
+        t0 = time.monotonic()
+        code, _ = client.forecast_response("default", t0=0)
+        assert code == 503
+        assert time.monotonic() - t0 < 0.3  # gave up instead of sleeping on
+        assert len(srv.requests) == 1
+
+    def test_connection_refused_retries_then_raises(self):
+        import socket
+
+        with socket.socket() as s:  # grab a port nothing listens on
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        client = HttpForecastClient(
+            f"http://127.0.0.1:{port}", retries=1, retry_backoff_s=0.01
+        )
+        with pytest.raises(urllib.error.URLError):
+            client.forecast_response("default", t0=0)
+
+    def test_connection_refused_retry_can_succeed_after_restart(self, scripted):
+        # the replica-bounce shape: first attempt hits a dead port, the
+        # "restarted" server answers the retry — via a client whose base_url
+        # is swapped mid-flight to simulate the comeback
+        srv = scripted([(200, {"runoff": []}, {})])
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead = s.getsockname()[1]
+        client = HttpForecastClient(
+            f"http://127.0.0.1:{dead}", retries=3, retry_backoff_s=0.05
+        )
+        threading.Timer(0.01, lambda: setattr(client, "base_url", srv.url)).start()
+        code, _ = client.forecast_response("default", t0=0)
+        assert code == 200
+
+
+class TestRetryAfterParse:
+    def test_delta_seconds_and_absent(self):
+        assert retry_after_seconds({"Retry-After": "3"}) == 3.0
+        assert retry_after_seconds({}) is None
+        assert retry_after_seconds(None) is None
+        assert retry_after_seconds({"Retry-After": "junk"}) is None
+
+    def test_http_date(self):
+        from email.utils import formatdate
+
+        secs = retry_after_seconds({"Retry-After": formatdate(time.time() + 5)})
+        assert secs is not None and 2 <= secs <= 6
+
+    def test_past_http_date_clamps_to_zero(self):
+        from email.utils import formatdate
+
+        assert retry_after_seconds({"Retry-After": formatdate(time.time() - 60)}) == 0.0

@@ -1,0 +1,415 @@
+"""Checkpoint robustness: integrity manifests, quarantine + previous-good
+fallback, retention GC, the async writer, the serving watcher's corrupt-file
+discipline, fault-injected corruption e2e, the async-overlap proof, and the
+SIGTERM emergency-save path."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability import faults
+from ddr_tpu.training import (
+    AsyncCheckpointWriter,
+    checkpoint_candidates,
+    latest_checkpoint,
+    load_latest_state,
+    load_state,
+    prune_checkpoints,
+    save_state,
+    verify_checkpoint,
+)
+
+PARAMS = {"w": np.ones((3, 3), np.float32)}
+OPT = {"m": np.zeros(3, np.float32)}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.configure(None)
+
+
+def _manifest(path: Path) -> Path:
+    return path.with_name(path.name + ".manifest.json")
+
+
+class TestManifest:
+    def test_save_writes_manifest_and_load_verifies(self, tmp_path):
+        p = save_state(tmp_path, "t", 1, 0, PARAMS, OPT, rng_state={"a": 1})
+        m = json.loads(_manifest(p).read_text())
+        assert m["sha256"] and m["bytes"] == p.stat().st_size
+        assert verify_checkpoint(p) == p.read_bytes()
+        blob = load_state(p)
+        assert blob["epoch"] == 1 and blob["mini_batch"] == 0
+
+    def test_bitflip_quarantines_and_falls_back(self, tmp_path):
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        bad = save_state(tmp_path, "t", 1, 1, PARAMS, OPT)
+        raw = bytearray(bad.read_bytes())
+        raw[len(raw) // 2] ^= 0x01  # one flipped bit, length unchanged
+        bad.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            load_state(bad)
+        assert not bad.exists()
+        assert bad.with_name(bad.name + ".corrupt").exists()
+        assert not _manifest(bad).exists()  # quarantined alongside
+        # the previous good checkpoint wins
+        assert latest_checkpoint(tmp_path) == good
+        blob, path = load_latest_state(tmp_path)
+        assert path == good and blob["mini_batch"] == 0
+
+    def test_truncation_detected_via_manifest_length(self, tmp_path):
+        p = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        p.write_bytes(p.read_bytes()[:-10])
+        with pytest.raises(ValueError, match="torn write"):
+            load_state(p)
+        assert not p.exists()  # quarantined
+
+    def test_truncated_pickle_without_manifest_still_quarantines(self, tmp_path):
+        p = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        _manifest(p).unlink()  # a pre-manifest-era blob
+        p.write_bytes(p.read_bytes()[:15])
+        with pytest.raises(ValueError):
+            load_state(p)
+        assert p.with_name(p.name + ".corrupt").exists()
+
+    def test_quarantine_opt_out(self, tmp_path):
+        p = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        p.write_bytes(b"garbage")
+        with pytest.raises(ValueError):
+            load_state(p, quarantine=False)
+        assert p.exists()
+
+    def test_arch_mismatch_is_not_corruption(self, tmp_path):
+        p = save_state(tmp_path, "t", 1, 0, PARAMS, OPT, arch={"grid": 5})
+        with pytest.raises(ValueError, match="different architecture"):
+            load_state(p, expected_arch={"grid": 7})
+        assert p.exists()  # valid file, wrong caller: never quarantined
+
+
+class TestCandidates:
+    def test_tmp_leftover_is_skipped(self, tmp_path):
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        (tmp_path / "_t_epoch_1_mb_1.pkl.tmp").write_bytes(b"torn")
+        assert checkpoint_candidates(tmp_path) == [good]
+        assert latest_checkpoint(tmp_path) == good
+
+    def test_corrupt_rename_is_skipped(self, tmp_path):
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        bad = save_state(tmp_path, "t", 1, 1, PARAMS, OPT)
+        bad.write_bytes(b"x")
+        with pytest.raises(ValueError):
+            load_state(bad)
+        assert latest_checkpoint(tmp_path) == good
+
+    def test_empty_dir_resumes_fresh(self, tmp_path):
+        assert load_latest_state(tmp_path) is None
+
+    def test_bitflipped_orbax_dir_falls_back(self, tmp_path):
+        from ddr_tpu.training import save_state_orbax
+
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        ob = save_state_orbax(tmp_path, "t", 1, 1, PARAMS, OPT)
+        for f in (ob / "state").rglob("*"):
+            if f.is_file() and f.stat().st_size:
+                raw = bytearray(f.read_bytes())
+                raw[len(raw) // 2] ^= 0xFF
+                f.write_bytes(bytes(raw))
+        blob, path = load_latest_state(tmp_path)
+        assert path == good and blob["mini_batch"] == 0
+
+    def test_metaless_orbax_dir_is_skipped(self, tmp_path):
+        from ddr_tpu.training import save_state_orbax
+
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        ob = save_state_orbax(tmp_path, "t", 1, 1, PARAMS, OPT)
+        (ob / "meta.json").unlink()  # the preempted-save shape
+        assert latest_checkpoint(tmp_path) == good
+
+
+class TestPrune:
+    def _write_many(self, tmp_path):
+        paths = []
+        for epoch, mb in [(1, 0), (1, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            p = save_state(tmp_path, "t", epoch, mb, PARAMS, OPT)
+            os.utime(p, (p.stat().st_atime, 1_000_000 + len(paths)))
+            paths.append(p)
+        return paths
+
+    def test_keep_last_plus_every_epoch(self, tmp_path):
+        paths = self._write_many(tmp_path)
+        deleted = prune_checkpoints(tmp_path, keep_last=2, keep_every_epoch=True)
+        kept = set(checkpoint_candidates(tmp_path))
+        # newest two survive, plus epoch 1's newest (epoch 2's newest is
+        # already inside the keep_last window)
+        assert kept == {paths[5], paths[4], paths[2]}
+        assert set(deleted) == {paths[0], paths[1], paths[3]}
+        # manifests go with their blobs
+        for p in deleted:
+            assert not p.with_name(p.name + ".manifest.json").exists()
+
+    def test_keep_last_zero_keeps_everything(self, tmp_path):
+        self._write_many(tmp_path)
+        assert prune_checkpoints(tmp_path, keep_last=0) == []
+        assert len(checkpoint_candidates(tmp_path)) == 6
+
+    def test_corrupt_files_never_pruned(self, tmp_path):
+        self._write_many(tmp_path)
+        bad = tmp_path / "_t_epoch_0_mb_0.pkl.corrupt"
+        bad.write_bytes(b"evidence")
+        prune_checkpoints(tmp_path, keep_last=1, keep_every_epoch=False)
+        assert bad.exists()
+
+    def test_env_knobs(self, tmp_path, monkeypatch):
+        from ddr_tpu.training import prune_checkpoints_from_env
+
+        self._write_many(tmp_path)
+        monkeypatch.delenv("DDR_CKPT_KEEP_LAST", raising=False)
+        assert prune_checkpoints_from_env(tmp_path) == []
+        monkeypatch.setenv("DDR_CKPT_KEEP_LAST", "junk")
+        assert prune_checkpoints_from_env(tmp_path) == []  # malformed: no-op
+        monkeypatch.setenv("DDR_CKPT_KEEP_LAST", "1")
+        monkeypatch.setenv("DDR_CKPT_KEEP_EVERY_EPOCH", "0")
+        prune_checkpoints_from_env(tmp_path)
+        assert len(checkpoint_candidates(tmp_path)) == 1
+
+
+class TestAsyncWriter:
+    def test_save_lands_after_drain(self, tmp_path):
+        w = AsyncCheckpointWriter()
+        try:
+            w.save(tmp_path, "a", 1, 0, PARAMS, OPT, rng_state={"x": 2})
+            assert w.drain(timeout=30.0)
+            p = latest_checkpoint(tmp_path)
+            blob = load_state(p)
+            assert blob["rng_state"] == {"x": 2}
+        finally:
+            w.close()
+
+    def test_latest_wins_coalescing_under_slow_disk(self, tmp_path):
+        # an injected 150ms write delay makes the writer fall behind three
+        # instant saves: queued (unstarted) snapshots are dropped, the NEWEST
+        # always lands
+        faults.configure("slow@checkpoint.write:ms=150")
+        w = AsyncCheckpointWriter()
+        try:
+            for mb in range(4):
+                w.save(tmp_path, "a", 1, mb, PARAMS, OPT)
+            assert w.drain(timeout=30.0)
+        finally:
+            w.close()
+        names = {p.name for p in checkpoint_candidates(tmp_path)}
+        assert "_a_epoch_1_mb_3.pkl" in names  # the newest is never dropped
+        assert len(names) < 4  # something was coalesced away
+
+    def test_write_error_surfaces_on_drain(self, tmp_path):
+        blocked = tmp_path / "not-a-dir"
+        blocked.write_bytes(b"")  # save_dir.mkdir() inside the writer fails
+        w = AsyncCheckpointWriter()
+        try:
+            w.save(blocked, "a", 1, 0, PARAMS, OPT)
+            with pytest.raises(RuntimeError, match="checkpoint write failed"):
+                w.drain(timeout=10.0)
+        finally:
+            try:
+                w.close()
+            except RuntimeError:
+                pass
+
+    def test_close_is_idempotent_and_rejects_late_saves(self, tmp_path):
+        w = AsyncCheckpointWriter()
+        w.close()
+        w.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            w.save(tmp_path, "a", 1, 0, PARAMS, OPT)
+
+
+class TestServingWatcher:
+    def _registry(self):
+        from ddr_tpu.serving.registry import ModelRegistry
+
+        reg = ModelRegistry()
+        reg.register("m", kan_model=object(), params={"w": np.zeros(2)})
+        return reg
+
+    def test_corrupt_newest_quarantined_then_previous_good_wins(self, tmp_path):
+        from ddr_tpu.serving.registry import CheckpointWatcher
+
+        reg = self._registry()
+        save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        bad = save_state(tmp_path, "t", 1, 1, {"w": 2 * PARAMS["w"]}, OPT)
+        raw = bytearray(bad.read_bytes())
+        raw[len(raw) // 2] ^= 0x01
+        bad.write_bytes(bytes(raw))
+        watcher = CheckpointWatcher(
+            registry=reg, name="m", directory=tmp_path, expected_arch=None
+        )
+        # scan 1: newest is corrupt -> quarantined by load_state, no swap
+        assert watcher.check_now() is False
+        assert bad.with_name(bad.name + ".corrupt").exists()
+        # scan 2: the previous good checkpoint loads and swaps in
+        assert watcher.check_now() is True
+        entry = reg.get("m")
+        assert entry.version == 2
+        np.testing.assert_array_equal(np.asarray(entry.params["w"]), PARAMS["w"])
+
+    def test_bad_checkpoint_warns_once_not_every_poll(self, tmp_path, caplog):
+        import logging
+
+        from ddr_tpu.serving.registry import CheckpointWatcher
+
+        reg = self._registry()
+        # arch mismatch: valid blob, wrong for this model — NOT quarantined,
+        # so it stays the newest forever; the stamp memo must stop the retries
+        save_state(tmp_path, "t", 1, 0, PARAMS, OPT, arch={"grid": 5})
+        watcher = CheckpointWatcher(
+            registry=reg, name="m", directory=tmp_path, expected_arch={"grid": 7}
+        )
+        with caplog.at_level(logging.WARNING, logger="ddr_tpu.serving.registry"):
+            assert watcher.check_now() is False
+            assert watcher.check_now() is False
+            assert watcher.check_now() is False
+        warnings = [r for r in caplog.records if "not loadable" in r.message]
+        assert len(warnings) == 1
+
+    def test_reload_fault_injection_keeps_old_params(self, tmp_path):
+        from ddr_tpu.serving.registry import CheckpointWatcher
+
+        reg = self._registry()
+        save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        faults.configure("crash@registry.reload")
+        watcher = CheckpointWatcher(
+            registry=reg, name="m", directory=tmp_path, expected_arch=None
+        )
+        assert watcher.check_now() is False
+        assert reg.get("m").version == 1  # the old params kept serving
+        faults.configure(None)
+        # a NEW checkpoint (new stamp) reloads fine once the fault clears
+        save_state(tmp_path, "t", 1, 1, PARAMS, OPT)
+        assert watcher.check_now() is True
+
+
+# ---------------------------------------------------------------------------
+# e2e: fault-injected training runs (synthetic basin, real train loop).
+# ---------------------------------------------------------------------------
+
+
+def _cfg(tmp_path, **exp):
+    from ddr_tpu.validation.configs import Config
+
+    return Config(**{
+        "name": "robust",
+        "geodataset": "synthetic",
+        "mode": "training",
+        "kan": {"input_var_names": [f"a{i}" for i in range(10)]},
+        "experiment": {
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/20",
+            "rho": 8,
+            "batch_size": 1,
+            "epochs": 1,
+            "warmup": 1,
+            "learning_rate": {1: 0.01},
+            "shuffle": False,
+            **exp,
+        },
+        "params": {"save_path": str(tmp_path)},
+    })
+
+
+@pytest.mark.slow
+def test_corrupt_checkpoint_write_quarantine_and_resume(tmp_path, monkeypatch):
+    """The corrupt@checkpoint.write e2e: train writes a bit-flipped blob under
+    an intact manifest; resume quarantines it and restarts from the previous
+    good checkpoint."""
+    from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.train import train
+
+    monkeypatch.setenv("DDR_CKPT_ASYNC", "0")  # deterministic write ordering
+    run1 = tmp_path / "r1"
+    faults.configure("corrupt@checkpoint.write:at=1")  # second save is corrupt
+    with run_telemetry(_cfg(run1), "train", base_dir=str(run1)):
+        train(_cfg(run1), max_batches=2)
+    faults.configure(None)
+    saved = run1 / "saved_models"
+    assert len(checkpoint_candidates(saved)) == 2  # corruption is latent
+    # the injected fault is on the record
+    events = [
+        json.loads(line)
+        for line in (run1 / "run_log.train.jsonl").read_text().splitlines()
+    ]
+    fault_events = [e for e in events if e["event"] == "fault"]
+    assert [e["action"] for e in fault_events] == ["corrupt"]
+
+    # resume from the DIRECTORY: mb1's blob fails its manifest -> quarantined,
+    # mb0 wins, training restarts at mini-batch 1 and completes
+    cfg2 = _cfg(run1)
+    cfg2.experiment.checkpoint = saved
+    params, _ = train(cfg2, max_batches=1)
+    assert params is not None
+    assert any(p.name.endswith(".corrupt") for p in saved.iterdir())
+    resumed_from = [p for p in checkpoint_candidates(saved) if "_mb_0" in p.name]
+    assert resumed_from, "previous good checkpoint should have survived"
+
+
+@pytest.mark.slow
+def test_async_checkpointing_shrinks_checkpoint_phase(tmp_path, monkeypatch):
+    """The overlap proof: under an injected 120ms write delay, the per-step
+    `checkpoint` phase share (PR 5 phases rollup) collapses with the async
+    writer versus sync mode — the write moved off the loop thread."""
+    from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.train import train
+
+    def phase_totals(run_dir, async_on):
+        monkeypatch.setenv("DDR_CKPT_ASYNC", "1" if async_on else "0")
+        faults.configure("slow@checkpoint.write:ms=120")
+        try:
+            with run_telemetry(_cfg(run_dir), "train", base_dir=str(run_dir)):
+                train(_cfg(run_dir), max_batches=3)
+        finally:
+            faults.configure(None)
+        events = [
+            json.loads(line)
+            for line in (run_dir / "run_log.train.jsonl").read_text().splitlines()
+        ]
+        steps = [e for e in events if e["event"] == "step"]
+        assert len(steps) == 3
+        return sum(e["phases"].get("checkpoint", 0.0) for e in steps)
+
+    sync_s = phase_totals(tmp_path / "sync", async_on=False)
+    async_s = phase_totals(tmp_path / "async", async_on=True)
+    # sync pays 3 x >=120ms on the loop thread; async pays only the
+    # device_get + enqueue there
+    assert sync_s >= 0.3
+    assert async_s < sync_s / 2
+    # and the checkpoints still all landed
+    assert len(checkpoint_candidates(tmp_path / "async" / "saved_models")) == 3
+
+
+@pytest.mark.slow
+def test_sigterm_produces_exactly_one_emergency_checkpoint(tmp_path):
+    """SIGTERM mid-training: the loop drains, writes ONE emergency checkpoint
+    that load_state accepts, and returns cleanly."""
+    from ddr_tpu.scripts.train import train
+
+    cfg = _cfg(tmp_path, epochs=5)
+    timer = threading.Timer(3.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        params, _ = train(cfg)
+    finally:
+        timer.cancel()
+    assert params is not None
+    emergency = sorted((tmp_path / "saved_models").glob("*-preempt_*.pkl"))
+    assert len(emergency) == 1
+    blob = load_state(emergency[0])
+    assert blob["params"] is not None and blob["rng_state"] is not None
+    # the handler was uninstalled on the way out
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
