@@ -26,9 +26,16 @@ shallow best case.
 
 Env knobs: DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_DEEP_N /
 DDR_BENCH_DEEP_DEPTH (deep-topology phase; 0 disables it), DDR_BENCH_PROBE_TIMEOUT /
-DDR_BENCH_TIMEOUT (seconds, accelerator probe / each benchmark subprocess).
-JAX_PLATFORMS=cpu skips the accelerator probe entirely (CPU-only rounds go
-straight to the fallback shapes instead of waiting out the probe timeout).
+DDR_BENCH_TIMEOUT (seconds, accelerator probe / each benchmark subprocess),
+DDR_BENCH_KERNEL / DDR_BENCH_DTYPE (the routing wave-scan implementation and
+compute dtype — the fused-Pallas-kernel and bf16 axes of
+``ddr_tpu.routing.mc.route``; recorded as ``kernel`` / ``compute_dtype`` so
+the regression gate pairs records by dtype). JAX_PLATFORMS=cpu skips the
+accelerator probe entirely (CPU-only rounds go straight to the fallback
+shapes instead of waiting out the probe timeout); the probe timeout now
+defaults to 120 s — early driver rounds burned 15 minutes timing out a
+wedged tunnel before the CPU fallback — and is recorded as
+``probe_timeout_s``.
 """
 
 from __future__ import annotations
@@ -50,6 +57,19 @@ DEEP_DEPTH = 2048
 # cap (1024), so build_routing_network cannot select the single-ring engine.
 CPU_DEEP_N = 4096
 CPU_DEEP_DEPTH = 1536
+
+#: Accelerator-probe timeout default, seconds. Well under the old 900 s: every
+#: driver round so far spent the full probe window on a wedged tunnel before
+#: falling back to CPU — 2 minutes is ample for a healthy backend to init.
+DEFAULT_PROBE_TIMEOUT = 120.0
+
+
+def _kernel_dtype() -> tuple[str | None, str]:
+    """The routing kernel/dtype axes a bench child runs with
+    (DDR_BENCH_KERNEL / DDR_BENCH_DTYPE; None = auto-select)."""
+    kernel = os.environ.get("DDR_BENCH_KERNEL") or None
+    dtype = os.environ.get("DDR_BENCH_DTYPE") or "fp32"
+    return kernel, dtype
 
 
 def _synthetic(n: int, t_hours: int, seed: int = 0, depth: int | None = None):
@@ -108,12 +128,15 @@ def _card_suffix(compiled) -> str:
     (``flops=``, ``bytes=``, ``collectives=<compact json>``)."""
     from ddr_tpu.observability.costs import card_from_compiled, peak_bytes_or_envelope
 
+    kernel, dtype = _kernel_dtype()
     card = None
     try:
-        card = card_from_compiled(compiled, name="bench")
+        card = card_from_compiled(
+            compiled, name="bench", kernel=kernel, compute_dtype=dtype
+        )
     except Exception:
         pass
-    peak = peak_bytes_or_envelope(card=card)
+    peak = peak_bytes_or_envelope(compiled=compiled, card=card)
     tokens = []
     if peak is not None:
         tokens.append(f"peak_gb={peak / 2**30:.4f}")
@@ -142,7 +165,10 @@ def bench_route(n: int, t_hours: int, depth: int | None = None) -> str:
     from ddr_tpu.routing.mc import route
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
-    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
+    kernel, dtype = _kernel_dtype()
+    fn = jax.jit(lambda qp: route(
+        network, channels, params, qp, gauges=gauges, kernel=kernel, dtype=dtype
+    ).runoff)
     compiled = fn.lower(q_prime).compile()
     return f"{_timed_rate(compiled, q_prime, n, t_hours)}{_card_suffix(compiled)}"
 
@@ -158,7 +184,10 @@ def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
     engine = engine_label(network)
-    fn = jax.jit(lambda qp: route(network, channels, params, qp, gauges=gauges).runoff)
+    kernel, dtype = _kernel_dtype()
+    fn = jax.jit(lambda qp: route(
+        network, channels, params, qp, gauges=gauges, kernel=kernel, dtype=dtype
+    ).runoff)
     compiled = fn.lower(q_prime).compile()
     return f"{_timed_rate(compiled, q_prime, n, t_hours)} {engine}{_card_suffix(compiled)}"
 
@@ -173,9 +202,12 @@ def bench_grad(n: int, t_hours: int, depth: int | None = None) -> str:
     from ddr_tpu.routing.mc import route
 
     network, channels, gauges, params, q_prime = _bench_setup(n, t_hours, depth=depth)
+    kernel, dtype = _kernel_dtype()
 
     def loss(p):
-        return route(network, channels, p, q_prime, gauges=gauges).runoff.mean()
+        return route(
+            network, channels, p, q_prime, gauges=gauges, kernel=kernel, dtype=dtype
+        ).runoff.mean()
 
     fn = jax.jit(jax.value_and_grad(loss))
     compiled = fn.lower(params).compile()
@@ -349,10 +381,12 @@ Benchmark reach-timesteps/sec/chip for the Muskingum-Cunge routing forward
 pass. Prints ONE JSON line and always exits 0. Configure via env vars:
 DDR_BENCH_N / DDR_BENCH_T (shapes), DDR_BENCH_DEEP_N / DDR_BENCH_DEEP_DEPTH
 (deep-topology phase; 0 disables), DDR_BENCH_PROBE_TIMEOUT / DDR_BENCH_TIMEOUT
-(seconds). JAX_PLATFORMS=cpu skips the accelerator probe (no probe-timeout
-stall on CPU-only hosts). Set DDR_METRICS_DIR to also emit the timings as
-observability JSONL events (run_log.bench.jsonl, same schema as training —
-docs/observability.md).
+(seconds; probe defaults to 120), DDR_BENCH_KERNEL / DDR_BENCH_DTYPE (routing
+wave-scan implementation pallas|xla and compute dtype fp32|bf16 — docs/tpu.md
+"Fused Pallas kernel & mixed precision"). JAX_PLATFORMS=cpu skips the
+accelerator probe (no probe-timeout stall on CPU-only hosts). Set
+DDR_METRICS_DIR to also emit the timings as observability JSONL events
+(run_log.bench.jsonl, same schema as training — docs/observability.md).
 """
 
 
@@ -415,13 +449,19 @@ def main(argv: list[str] | None = None) -> None:
         "vs_baseline": None,
     }
     try:
-        probe_timeout = float(os.environ.get("DDR_BENCH_PROBE_TIMEOUT", 900))
+        probe_timeout = float(
+            os.environ.get("DDR_BENCH_PROBE_TIMEOUT", DEFAULT_PROBE_TIMEOUT)
+        )
         bench_timeout = float(os.environ.get("DDR_BENCH_TIMEOUT", 2400))
     except ValueError as e:
         out["error"] = f"bad DDR_BENCH_PROBE_TIMEOUT/DDR_BENCH_TIMEOUT override: {e}"
         print(json.dumps(out), flush=True)
         _emit_bench_events(rec, out)
         return
+    out["probe_timeout_s"] = probe_timeout
+    kernel, dtype = _kernel_dtype()
+    out["kernel"] = kernel or "auto"
+    out["compute_dtype"] = dtype
 
     # Phase 1: can an accelerator backend initialize at all? Skipped outright
     # when the environment already pins the host platform (JAX_PLATFORMS=cpu):

@@ -17,6 +17,7 @@ band-scan router at CONUS depth). Reference workload being measured against:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -24,6 +25,10 @@ import time
 def main() -> None:
     n, t_hours = int(sys.argv[1]), int(sys.argv[2])
     depth = int(sys.argv[3]) if len(sys.argv) > 3 else None
+    # the bench.py kernel/dtype axes apply to the train step too — a bf16
+    # bench round must not stamp compute_dtype on an fp32-measured train_value
+    kernel = os.environ.get("DDR_BENCH_KERNEL") or None
+    dtype = os.environ.get("DDR_BENCH_DTYPE") or "fp32"
 
     import jax
     import jax.numpy as jnp
@@ -80,6 +85,8 @@ def main() -> None:
         tau=cfg.params.tau,
         warmup=1,
         optimizer=optimizer,
+        kernel=kernel,
+        dtype=dtype,
     )
     obs = jnp.asarray(basin.obs_daily)
     mask = jnp.ones_like(obs, dtype=bool)

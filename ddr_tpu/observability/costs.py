@@ -128,6 +128,12 @@ class ProgramCard:
     name: str
     engine: str | None = None
     platform: str | None = None
+    # routing-kernel axes the program was built with (None when the program
+    # has no routing inside or the caller didn't say): "pallas"/"xla" and
+    # "fp32"/"bf16" — so a card history can attribute a cost shift to the
+    # fused kernel or the mixed-precision ring, not just to "the code moved"
+    kernel: str | None = None
+    compute_dtype: str | None = None
     # cost_analysis()
     flops: float | None = None
     transcendentals: float | None = None
@@ -260,6 +266,8 @@ def card_from_compiled(
     name: str,
     engine: str | None = None,
     compile_seconds: float | None = None,
+    kernel: str | None = None,
+    compute_dtype: str | None = None,
 ) -> ProgramCard:
     """Build a :class:`ProgramCard` from an AOT ``Compiled`` handle.
 
@@ -313,6 +321,8 @@ def card_from_compiled(
         name=name,
         engine=engine,
         platform=platform,
+        kernel=kernel,
+        compute_dtype=compute_dtype,
         flops=_cost("flops"),
         transcendentals=_cost("transcendentals"),
         bytes_accessed=_cost("bytes accessed"),
@@ -334,6 +344,8 @@ def build_card(
     *args: Any,
     name: str,
     engine: str | None = None,
+    kernel: str | None = None,
+    compute_dtype: str | None = None,
     **kwargs: Any,
 ) -> tuple[ProgramCard, Any]:
     """AOT-compile a jitted callable for ``args`` and card it.
@@ -350,7 +362,8 @@ def build_card(
     compiled = lowered.compile()
     seconds = time.perf_counter() - t0
     card = card_from_compiled(
-        compiled, name=name, engine=engine, compile_seconds=round(seconds, 4)
+        compiled, name=name, engine=engine, compile_seconds=round(seconds, 4),
+        kernel=kernel, compute_dtype=compute_dtype,
     )
     return card, compiled
 

@@ -65,6 +65,16 @@ class HealthStats:
     q_max: Any  # max over finite output discharge
     mass_residual: Any  # scale-free outflow/inflow imbalance (docstring above)
     grad_norm: Any = None  # optax global_norm(grads); train steps only
+    # Mixed-precision (dtype="bf16" routing) counters — None on fp32 batches:
+    # ``overflow`` counts entries (outputs + inflow) whose magnitude exceeds
+    # the bf16 finite max (they saturate/inf inside a bf16 history ring);
+    # ``ulp_drift`` is |mass_residual| expressed in bf16-epsilon units — how
+    # many bf16 ULPs of relative mass imbalance the window shows. Healthy
+    # bf16 windows sit at O(1-10) ULPs; compounding rounding error (the
+    # failure mode unique to the bf16 ring) grows it by orders of magnitude,
+    # which is what DDR_HEALTH_MAX_ULP_DRIFT gates training on.
+    overflow: Any = None
+    ulp_drift: Any = None
 
 
 _REGISTERED = False
@@ -84,7 +94,8 @@ def _ensure_registered() -> None:
 
         jax.tree_util.register_dataclass(
             HealthStats,
-            data_fields=["nonfinite", "q_min", "q_max", "mass_residual", "grad_norm"],
+            data_fields=["nonfinite", "q_min", "q_max", "mass_residual",
+                         "grad_norm", "overflow", "ulp_drift"],
             meta_fields=[],
         )
         _REGISTERED = True
@@ -92,7 +103,8 @@ def _ensure_registered() -> None:
 
 def compute_health(runoff: Any, q_prime: Any | None = None,
                    final_discharge: Any | None = None,
-                   row_mask: Any | None = None) -> HealthStats:
+                   row_mask: Any | None = None,
+                   compute_dtype: str = "fp32") -> HealthStats:
     """Health scalars from routed outputs — call INSIDE the compiled program.
 
     ``runoff`` is the route output ((T, G) gauge-aggregated, (T, N) full
@@ -105,6 +117,12 @@ def compute_health(runoff: Any, q_prime: Any | None = None,
     full-array reductions (isfinite + masked min/max/sum), fused by XLA into
     the surrounding program — never a second kernel launch worth caring
     about, never a host sync.
+
+    ``compute_dtype="bf16"`` (the routed batch used the mixed-precision ring,
+    ``route(dtype="bf16")``) additionally fills the :class:`HealthStats`
+    ``overflow`` / ``ulp_drift`` counters the training watchdog gates bf16
+    runs on; fp32 batches leave them ``None`` (empty pytree nodes, existing
+    programs unchanged).
     """
     import jax.numpy as jnp
 
@@ -142,8 +160,20 @@ def compute_health(runoff: Any, q_prime: Any | None = None,
         fd = jnp.asarray(final_discharge)
         nonfinite = nonfinite + jnp.sum(~jnp.isfinite(fd)).astype(jnp.int32)
     residual = (out_mass - in_mass) / (jnp.abs(in_mass) + 1e-6)
+    overflow = ulp_drift = None
+    if compute_dtype == "bf16":
+        bf16_max = float(jnp.finfo(jnp.bfloat16).max)
+        overflow = jnp.sum(valid & (jnp.abs(runoff) > bf16_max)).astype(jnp.int32)
+        if q_prime is not None:
+            qp = jnp.asarray(q_prime)
+            overflow = overflow + jnp.sum(
+                _valid(qp) & (jnp.abs(qp) > bf16_max)
+            ).astype(jnp.int32)
+        # |mass_residual| in bf16-epsilon units (see HealthStats docstring)
+        ulp_drift = jnp.abs(residual) / float(jnp.finfo(jnp.bfloat16).eps)
     return HealthStats(
-        nonfinite=nonfinite, q_min=q_min, q_max=q_max, mass_residual=residual
+        nonfinite=nonfinite, q_min=q_min, q_max=q_max, mass_residual=residual,
+        overflow=overflow, ulp_drift=ulp_drift,
     )
 
 
@@ -195,6 +225,14 @@ class HealthConfig:
     #: Gradient global-norm ceiling (DDR_HEALTH_MAX_GRAD_NORM; inf = off;
     #: a non-finite grad norm always violates).
     max_grad_norm: float = math.inf
+    #: bf16 overflow entries tolerated per batch (DDR_HEALTH_MAX_OVERFLOW;
+    #: only evaluated on mixed-precision batches — values past the bf16
+    #: finite max saturate inside a bf16 history ring, so any are wrong).
+    max_overflow: int = 0
+    #: bf16 ulp-drift ceiling (DDR_HEALTH_MAX_ULP_DRIFT; inf = off —
+    #: calibrate from a healthy bf16 run; a non-finite drift always
+    #: violates on mixed-precision batches).
+    max_ulp_drift: float = math.inf
     #: Consecutive violating batches before the watchdog reports *degraded*
     #: (serving flips /readyz to 503 at this point) (DDR_HEALTH_BAD_BATCHES).
     bad_batches: int = 3
@@ -211,6 +249,8 @@ class HealthConfig:
             raise ValueError(f"bad_batches must be >= 1, got {self.bad_batches}")
         if self.max_nonfinite < 0:
             raise ValueError(f"max_nonfinite must be >= 0, got {self.max_nonfinite}")
+        if self.max_overflow < 0:
+            raise ValueError(f"max_overflow must be >= 0, got {self.max_overflow}")
         if self.max_stall_s <= 0:
             raise ValueError(f"max_stall_s must be > 0, got {self.max_stall_s}")
 
@@ -236,6 +276,8 @@ class HealthConfig:
             ("max_discharge", "MAX_DISCHARGE", float),
             ("max_residual", "MAX_RESIDUAL", float),
             ("max_grad_norm", "MAX_GRAD_NORM", float),
+            ("max_overflow", "MAX_OVERFLOW", int),
+            ("max_ulp_drift", "MAX_ULP_DRIFT", float),
             ("bad_batches", "BAD_BATCHES", int),
             ("max_stall_s", "MAX_STALL_S", float),
         ):
@@ -295,6 +337,12 @@ class HealthWatchdog:
             gn = float(stats.grad_norm)
             if not math.isfinite(gn) or gn > cfg.max_grad_norm:
                 reasons.append("grad-norm")
+        if stats.overflow is not None and int(stats.overflow) > cfg.max_overflow:
+            reasons.append("bf16-overflow")
+        if stats.ulp_drift is not None:
+            drift = float(stats.ulp_drift)
+            if not math.isfinite(drift) or drift > cfg.max_ulp_drift:
+                reasons.append("ulp-drift")
         return reasons
 
     def observe(self, stats: HealthStats, **context: Any) -> list[str]:
@@ -330,6 +378,10 @@ class HealthWatchdog:
         }
         if stats.grad_norm is not None:
             payload["grad_norm"] = float(stats.grad_norm)
+        if stats.overflow is not None:
+            payload["overflow"] = int(stats.overflow)
+        if stats.ulp_drift is not None:
+            payload["ulp_drift"] = float(stats.ulp_drift)
         from ddr_tpu.observability.events import get_recorder
         from ddr_tpu.observability.prometheus import event_tee
 

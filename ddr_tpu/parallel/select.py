@@ -42,6 +42,7 @@ import numpy as np
 
 __all__ = [
     "ParallelRouteResult",
+    "resolve_engine_axes",
     "route_parallel",
     "select_for_topology",
     "select_parallel_engine",
@@ -97,6 +98,45 @@ def select_parallel_engine(
     return "stacked-sharded"
 
 
+def resolve_engine_axes(
+    engine: str, kernel: str | None, dtype: str
+) -> tuple[str | None, str]:
+    """The policy's kernel/dtype axes, per engine.
+
+    The ``gspmd`` row dispatches through :func:`ddr_tpu.routing.mc.route`, so
+    it carries the full fused-Pallas-kernel and bf16 axes
+    (:mod:`ddr_tpu.routing.pallas_kernel`) — ``kernel`` passes through
+    UNRESOLVED (validated only): whether pallas is usable depends on the
+    engine the built network actually routes with (a gspmd plan over a
+    non-wavefront-eligible topology runs the step engine, where auto must
+    stay a no-op), so the route itself resolves with that context. The
+    explicit ``shard_map`` engines (sharded-wavefront, stacked-sharded) run
+    their own per-shard schedules that predate the fused kernel —
+    ``kernel=None`` auto-falls back to their existing XLA scans, while an
+    EXPLICIT ``kernel="pallas"`` or a non-fp32 ``dtype`` raises (the same
+    contract as their ``adjoint`` handling: name the missing per-shard
+    variant instead of silently changing semantics).
+    """
+    from ddr_tpu.routing.pallas_kernel import KERNELS, validate_dtype
+
+    validate_dtype(dtype)
+    if kernel not in (None, "auto", *KERNELS):
+        raise ValueError(f"unknown kernel {kernel!r} (use 'pallas', 'xla', or None)")
+    if engine == "gspmd":
+        return kernel, dtype
+    if kernel == "pallas":
+        raise NotImplementedError(
+            f"kernel='pallas' is not implemented for the {engine} engine's "
+            "per-shard schedule; omit kernel (auto) or route via gspmd"
+        )
+    if dtype != "fp32":
+        raise NotImplementedError(
+            f"dtype={dtype!r} is not implemented for the {engine} engine's "
+            "per-shard schedule; use fp32 or route via gspmd"
+        )
+    return "xla", dtype
+
+
 def _mesh_platform(mesh: Any) -> str:
     return mesh.devices.flat[0].platform
 
@@ -135,10 +175,13 @@ def _plan_cache():
     return _PLAN_CACHE
 
 
-def _topology_key(rd: Any, n_shards: int, engine: str, bounds: Any, mesh: Any) -> tuple:
+def _topology_key(
+    rd: Any, n_shards: int, engine: str, bounds: Any, mesh: Any,
+    kernel: str, dtype: str,
+) -> tuple:
     from ddr_tpu.parallel.partition import topology_sha
 
-    return (topology_sha(rd), n_shards, engine, repr(bounds), id(mesh))
+    return (topology_sha(rd), n_shards, engine, repr(bounds), id(mesh), kernel, dtype)
 
 
 def route_parallel(
@@ -150,8 +193,16 @@ def route_parallel(
     q_init: Any = None,
     bounds: Any = None,
     engine: str | None = None,
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ) -> ParallelRouteResult:
     """Route one batch over the mesh with the policy-selected engine.
+
+    ``kernel``/``dtype`` are the fused-Pallas-kernel and mixed-precision axes
+    (:func:`resolve_engine_axes`): honored on the gspmd path, auto-falling
+    back to the per-shard XLA schedules on the explicit shard_map engines
+    (where an explicit ``"pallas"``/``"bf16"`` raises). Both join the plan
+    cache key — a bf16 plan is never served to an fp32 caller.
 
     ``rd``, ``channels``, ``spatial_params``, ``q_prime`` and ``q_init`` are
     all in the batch's ORIGINAL reach order regardless of engine — the function
@@ -183,15 +234,16 @@ def route_parallel(
         engine = select_for_topology(_mesh_platform(mesh), rows, cols, n, n_shards)
     if engine not in ("gspmd", "sharded-wavefront", "stacked-sharded"):
         raise ValueError(f"unknown parallel engine {engine!r}")
+    kernel, dtype = resolve_engine_axes(engine, kernel, dtype)
 
     cache = _plan_cache()
-    key = _topology_key(rd, n_shards, engine, bounds, mesh)
+    key = _topology_key(rd, n_shards, engine, bounds, mesh, kernel or "auto", dtype)
     entry = cache.get(key)
     if entry is not None and entry[0] is mesh:
         plan = entry[1]
         cache.move_to_end(key)
     else:
-        plan = _build_plan(mesh, rd, engine, n_shards, bounds)
+        plan = _build_plan(mesh, rd, engine, n_shards, bounds, kernel, dtype)
         global _PLAN_BUILDS
         _PLAN_BUILDS += 1
         cache[key] = (mesh, plan)
@@ -201,7 +253,10 @@ def route_parallel(
     return ParallelRouteResult(runoff, final, engine)
 
 
-def _build_plan(mesh: Any, rd: Any, engine: str, n_shards: int, bounds: Any) -> Callable:
+def _build_plan(
+    mesh: Any, rd: Any, engine: str, n_shards: int, bounds: Any,
+    kernel: str | None = "xla", dtype: str = "fp32",
+) -> Callable:
     """One reusable routing plan for a topology: the engine layout is built
     once and the routing program is jit-compiled once; repeat calls (chunked
     inference over the same reach set) pay neither again."""
@@ -319,7 +374,10 @@ def _build_plan(mesh: Any, rd: Any, engine: str, n_shards: int, bounds: Any) -> 
     )
 
     def _run_gspmd(ch, sp, qp, qi):
-        runoff = route(network, ch, sp, qp, q_init=qi, bounds=bounds)
+        runoff = route(
+            network, ch, sp, qp, q_init=qi, bounds=bounds,
+            kernel=kernel, dtype=dtype,
+        )
         return runoff.runoff[:, keep], runoff.final_discharge[keep]
 
     fn = jax.jit(_run_gspmd)

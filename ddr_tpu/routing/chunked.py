@@ -367,8 +367,15 @@ def route_chunked(
     dt: float = 3600.0,
     remat_physics: bool = True,
     adjoint: str = "analytic",
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ):
     """Route ``(T, N)`` inflows band-by-band; same contract as :func:`mc.route`.
+
+    ``kernel``/``dtype`` forward to every band's
+    :func:`~ddr_tpu.routing.wavefront.wavefront_route_core` call — the fused
+    Pallas kernel and bf16-compute/fp32-accumulate axes (resolved once here so
+    all bands agree).
 
     All inputs are in ORIGINAL node order; each band gathers its slice into its
     own wf order via ``gidx`` (one gather per band per array). Differentiable.
@@ -387,10 +394,16 @@ def route_chunked(
         celerity,
         muskingum_coefficients,
     )
+    from ddr_tpu.routing.pallas_kernel import resolve_kernel, validate_dtype
     from ddr_tpu.routing.wavefront import wavefront_route_core
 
     if bounds is None:
         bounds = Bounds()
+    auto_kernel = kernel in (None, "auto")
+    kernel = resolve_kernel(kernel)
+    validate_dtype(dtype)
+    if kernel == "pallas" and adjoint != "analytic" and auto_kernel:
+        kernel = "xla"  # auto fallback: pallas has no AD rule (wavefront_route_core)
     T = q_prime.shape[0]
     lb = bounds.discharge
     n_mann = spatial_params["n"]
@@ -433,7 +446,7 @@ def route_chunked(
             net, celerity_fn, coefficients_fn, qp_c, qi_c, lb,
             q_prime_permuted=True,  # qp_c was gathered straight into band-wf order
             remat_physics=remat_physics, x_ext=x_ext, s_ext=s_ext,
-            adjoint=adjoint,
+            adjoint=adjoint, kernel=kernel, dtype=dtype,
         )
         outs.append(runoff_c)
         finals.append(final_c)

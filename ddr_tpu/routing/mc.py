@@ -251,6 +251,8 @@ def route(
     remat_bands: bool = False,
     collect_health: bool = False,
     adjoint: str | None = None,
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ) -> RouteResult:
     """Route lateral inflows through the network over a full time window.
 
@@ -309,12 +311,27 @@ def route(
     A/B comparison). ``None`` auto-selects analytic where supported. The step
     engine already differentiates through its own custom-VJP triangular solver,
     so an explicit ``adjoint`` with ``engine="step"`` raises.
+
+    ``kernel`` selects the WAVEFRONT family's wave-scan implementation:
+    ``"pallas"`` runs the fused TPU kernel
+    (:mod:`ddr_tpu.routing.pallas_kernel`; interpret mode off-TPU, requires
+    the analytic adjoint), ``"xla"`` the ``lax.scan`` path, ``None``
+    auto-selects (pallas on TPU, xla elsewhere). ``dtype="bf16"`` enables
+    bf16-compute/fp32-accumulate routing: the history ring and gathered
+    operands are bfloat16, every reduction accumulates in fp32, and
+    ``collect_health=True`` additionally reports the mixed-precision
+    ``overflow``/``ulp_drift`` counters the training watchdog gates on. The
+    step engine has neither axis (``kernel="pallas"`` or a non-fp32 ``dtype``
+    with ``engine="step"`` raises; ``"xla"`` is a no-op there — the step
+    engine is already a plain XLA schedule).
     """
     from ddr_tpu.routing.chunked import ChunkedNetwork, route_chunked
+    from ddr_tpu.routing.pallas_kernel import validate_dtype
     from ddr_tpu.routing.stacked import StackedChunked, route_stacked
 
     if adjoint not in (None, "analytic", "ad"):
         raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic', 'ad', or None)")
+    validate_dtype(dtype)
 
     def _finish(result: RouteResult) -> RouteResult:
         if not collect_health:
@@ -326,7 +343,8 @@ def route(
         return dataclasses.replace(
             result,
             health=compute_health(
-                result.runoff, q_prime, final_discharge=result.final_discharge
+                result.runoff, q_prime, final_discharge=result.final_discharge,
+                compute_dtype=dtype,
             ),
         )
 
@@ -343,12 +361,12 @@ def route(
                 network, channels, spatial_params, q_prime, q_init=q_init,
                 gauges=gauges, bounds=bounds, dt=dt,
                 remat_physics=remat_physics, remat_bands=remat_bands,
-                adjoint=adjoint or "analytic",
+                adjoint=adjoint or "analytic", kernel=kernel, dtype=dtype,
             ))
         return _finish(route_chunked(
             network, channels, spatial_params, q_prime, q_init=q_init,
             gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
-            adjoint=adjoint or "analytic",
+            adjoint=adjoint or "analytic", kernel=kernel, dtype=dtype,
         ))
 
     n_mann = spatial_params["n"]
@@ -398,6 +416,7 @@ def route(
             network, celerity_fn, coefficients_fn, q_prime, q_init_p,
             bounds.discharge, q_prime_permuted=q_prime_permuted,
             remat_physics=remat_physics, adjoint=resolved,
+            kernel=kernel, dtype=dtype,
         )
         if gauges is not None:
             gauges_p = dataclasses.replace(
@@ -415,6 +434,14 @@ def route(
         raise ValueError(
             "adjoint applies to the wavefront routing family; the step engine "
             "already differentiates through its custom-VJP triangular solver"
+        )
+    # the step engine IS a plain XLA schedule, so kernel=None/"xla" are no-ops
+    # there; only the axes it genuinely lacks raise
+    if kernel == "pallas" or dtype != "fp32":
+        raise ValueError(
+            "kernel='pallas'/dtype='bf16' apply to the wavefront routing "
+            "family; the step engine has no fused-kernel or mixed-precision "
+            "variant"
         )
 
     permuted = network.fused

@@ -116,6 +116,14 @@ class RiverNetwork:
     # ``L`` (see ``wavefront._reverse_stream`` / ``_unskew_reverse``).
     wf_t_idx: jnp.ndarray = dataclasses.field(default_factory=lambda: jnp.zeros(0, jnp.int32))
     wf_t_width: int = dataclasses.field(default=0, metadata={"static": True})
+    # History-ring row count actually NEEDED: max edge level-gap + 2. The ring
+    # only has to cover the longest in-use gap, not the full depth — real river
+    # networks measure g_max << depth (the deep CPU suite: 35 vs span 384), and
+    # the ring is the wave scans' per-iteration carry, so its size IS the
+    # measured ring-copy tax (chunked.auto_cell_budget's cost model) and the
+    # Pallas kernel's VMEM footprint. 0 = unknown (pre-field builds): consumers
+    # fall back to the conservative depth + 2.
+    wf_ring_rows: int = dataclasses.field(default=0, metadata={"static": True})
 
     def upstream_sum(self, x: jnp.ndarray) -> jnp.ndarray:
         """Sparse mat-vec ``N @ x``: sum of upstream values per reach (original order).
@@ -481,6 +489,10 @@ def build_network(
             rows, cols, n, level, in_deg
         )
         wf_t_idx, wf_t_width = _transposed_wavefront_tables(rows, cols, n, level, wf_inv)
+        # largest level gap any edge actually skips (forward and transposed
+        # tables share the edge set, so one bound serves both scans)
+        gap_max = int((level[rows] - level[cols]).max()) if rows.size else 0
+        wf_ring_rows = min(depth, gap_max) + 2
     else:
         wf_perm = wf_inv = wf_idx = np.zeros(0, dtype=np.int64)
         wf_mask = np.zeros(0, dtype=np.float32)
@@ -488,6 +500,7 @@ def build_network(
         wf_level_runs = ()
         wf_t_idx = np.zeros(0, dtype=np.int64)
         wf_t_width = 0
+        wf_ring_rows = 0
 
     return RiverNetwork(
         edge_src=jnp.asarray(cols, dtype=jnp.int32),
@@ -513,4 +526,5 @@ def build_network(
         wavefront=bool(wavefront),
         wf_t_idx=jnp.asarray(wf_t_idx, jnp.int32),
         wf_t_width=int(wf_t_width),
+        wf_ring_rows=int(wf_ring_rows),
     )

@@ -1,0 +1,423 @@
+"""Fused Pallas TPU kernels for the wavefront routing family.
+
+The wave scan (``routing/wavefront._run_wave_scan`` and its stacked band-frame
+twin ``routing/stacked._frame_wave_scan``) is the sequential heart of every
+wavefront-class engine: per wave it rotates the flat history ring, gathers the
+predecessor slots, reduces them per degree bucket, runs the 5-multiply
+Muskingum update, and writes one ring row. On XLA that body is stitched from
+generic gather/scatter/dynamic-slice HLO ops inside ``lax.scan`` — each wave
+pays several op dispatches and (on TPU) a full ring-carry copy whenever XLA's
+copy insertion cannot prove the in-body gather and the row write don't alias
+(the measured ring-copy tax in :func:`ddr_tpu.routing.chunked.auto_cell_budget`'s
+cost model). This module fuses the whole body — and its reverse-time adjoint
+twin (``_analytic_bwd``'s transposed-table sweep) — into ONE kernel invocation
+per wave batch:
+
+* the ring lives in VMEM **scratch** for the kernel's whole lifetime (TPU grid
+  steps run sequentially on a core, so scratch carries state wave to wave) —
+  no per-wave carry copy can exist because the ring is never a carry;
+* the per-wave inputs (the time-skewed q'/external rows, the stacked adjoint
+  streams) arrive as blocked operands (one row per grid step), and the per-wave
+  outputs leave the same way;
+* the gather + bucket reduction + physics chain + ring write happen in one
+  fused body with no HLO op boundaries between them.
+
+This is SURVEY §2.10's "native lower-triangular sparse-solve kernel" — the one
+piece of the reference (CuPy ``spsolve_triangular`` behind a custom
+autograd.Function) the framework still owed natively.
+
+Selection and fallback
+----------------------
+
+``kernel="pallas" | "xla" | None`` on ``mc.route`` / ``wavefront_route_core`` /
+``route_chunked`` / ``route_stacked``:
+
+* ``None`` (auto): ``"pallas"`` on a TPU backend when the Pallas import
+  succeeds, ``"xla"`` everywhere else — existing callers see byte-identical
+  programs;
+* ``"pallas"``: always honored. On a non-TPU backend the kernel runs under
+  ``pl.pallas_call(interpret=True)`` — the REAL kernel body executed by the
+  Pallas interpreter — which is how the tier-1 CPU suite exercises it
+  (slow, only for tests/smoke gates);
+* ``"xla"``: the pre-existing ``lax.scan`` path.
+
+The Pallas path requires the analytic adjoint (``adjoint="analytic"``):
+``pallas_call`` has no JVP rule, so plain AD cannot differentiate through it —
+the custom-VJP pair (forward kernel + reverse-wavefront kernel) IS the
+backward. ``kernel="pallas"`` with ``adjoint="ad"`` raises.
+
+Mixed precision (``dtype="bf16"``)
+----------------------------------
+
+bf16-compute / fp32-accumulate: the history ring is stored in bfloat16, so
+the gather (the per-wave budget on TPU: ~7ns per index, halved bytes) and the
+ring-row write move half the bytes; every reduction — the degree-bucket
+predecessor sums AND the carried previous-timestep inflow sum — upcasts to
+fp32 before accumulating, and the Muskingum physics chain runs in fp32 on the
+upcast operands. Each wave's solve value is rounded to bf16 exactly once (the
+ring store) and the emitted raw series carries those rounded values upcast to
+fp32, so the analytic backward (always fp32) re-gathers exactly what the
+forward's ring gather saw. Training in bf16 is gated by the health watchdog's
+``overflow`` / ``ulp_drift`` counters (``ddr_tpu.observability.health``) and
+by the bench regression gate's dtype pairing
+(``scripts/check_bench_regression.py``). Both the XLA and Pallas paths
+implement the same scheme, so the fuzz suite can pin them against each other
+(tests/routing/test_pallas_kernel.py).
+
+TPU notes (/opt/skills/guides/pallas_guide.md): the grid is 1-D over waves
+(sequential on a core — the recurrence demands it), the ring/inflow state are
+VMEM scratch, per-wave rows are (1, n) blocked VMEM operands, and the flat
+ring gather is a ``jnp.take`` over the VMEM-resident ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "KERNELS",
+    "DTYPES",
+    "pallas_available",
+    "resolve_kernel",
+    "validate_dtype",
+    "ring_dtype",
+    "fused_wave_scan",
+    "fused_reverse_scan",
+]
+
+#: The kernel axis every routing entry point accepts (None = auto).
+KERNELS = ("pallas", "xla")
+
+#: The compute-dtype axis (ring/gather storage; accumulation is always fp32).
+DTYPES = ("fp32", "bf16")
+
+
+@functools.cache
+def pallas_available() -> bool:
+    """Can the Pallas TPU frontend be imported at all?"""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def resolve_kernel(kernel: str | None) -> str:
+    """Resolve the ``kernel`` knob to a concrete implementation.
+
+    ``None`` auto-selects: ``"pallas"`` on a TPU backend with Pallas
+    importable, ``"xla"`` otherwise (the automatic fallback — CPU rounds and
+    jax builds without Pallas keep their exact pre-existing programs). An
+    explicit ``"pallas"`` is always honored (interpret mode off-TPU) and
+    raises only when Pallas cannot even be imported.
+    """
+    if kernel is None or kernel == "auto":
+        return "pallas" if (_on_tpu() and pallas_available()) else "xla"
+    if kernel not in KERNELS:
+        raise ValueError(f"unknown kernel {kernel!r} (use 'pallas', 'xla', or None)")
+    if kernel == "pallas" and not pallas_available():
+        raise ValueError("kernel='pallas' requested but jax.experimental.pallas "
+                         "cannot be imported in this environment")
+    return kernel
+
+
+def validate_dtype(dtype: str) -> str:
+    if dtype not in DTYPES:
+        raise ValueError(f"unknown routing dtype {dtype!r} (use 'fp32' or 'bf16')")
+    return dtype
+
+
+def ring_dtype(compute_dtype: str, acc_dtype) -> Any:
+    """Storage dtype of the history ring for a routing compute dtype."""
+    return jnp.bfloat16 if compute_dtype == "bf16" else acc_dtype
+
+
+def _interpret(interpret: bool | None) -> bool:
+    """Interpret off-TPU (the tier-1 path); compile on the chip."""
+    return (not _on_tpu()) if interpret is None else bool(interpret)
+
+
+def _full_spec(pl, arr):
+    """BlockSpec for an operand the kernel sees whole every wave."""
+    return pl.BlockSpec(arr.shape, lambda w, _nd=arr.ndim: (0,) * _nd)
+
+
+def _row_spec(pl, n):
+    """BlockSpec for a (W, n) operand consumed one wave-row per grid step."""
+    return pl.BlockSpec((1, n), lambda w: (w, 0))
+
+
+def _reduce_gathered(gathered, wf_mask, buckets, n_deg0, lb, clamped, mask_raw):
+    """THE degree-bucket reduction, shared by the kernels and both XLA scans
+    (``wavefront._reduce_buckets`` = ``mask_raw=False``: pad slots already
+    read the ring's zero sentinel, so raw sums need no mask;
+    ``stacked._reduce_buckets_frame`` = ``mask_raw=True``: the frame masks
+    raw sums too). ``gathered`` may carry leading batch axes
+    (``(..., E) -> (..., n)`` — the analytic backwards reduce whole (T, E)
+    residual re-gathers in one call). Accumulates in the gathered dtype —
+    callers upcast bf16 gathers BEFORE reducing."""
+    lead = gathered.shape[:-1]
+    parts = [jnp.zeros(lead + (n_deg0,), gathered.dtype)] if n_deg0 else []
+    off = 0
+    for node_start, node_end, width in buckets:
+        cnt_nodes = node_end - node_start
+        if width == 0:
+            parts.append(jnp.zeros(lead + (cnt_nodes,), gathered.dtype))
+            continue
+        cnt = cnt_nodes * width
+        blk = gathered[..., off : off + cnt].reshape(lead + (cnt_nodes, width))
+        msk = wf_mask[off : off + cnt].reshape(cnt_nodes, width)
+        if clamped:
+            blk = jnp.maximum(blk, lb) * msk
+        elif mask_raw:
+            blk = blk * msk
+        parts.append(blk.sum(axis=-1))
+        off += cnt
+    if not parts:
+        return jnp.zeros(lead + (n_deg0,), gathered.dtype)
+    return jnp.concatenate(parts, axis=-1)
+
+
+def fused_wave_scan(
+    physics,
+    lvl,
+    wf_row,
+    wf_col,
+    wf_mask,
+    buckets,
+    qs,
+    xe=None,
+    se=None,
+    q_init=None,
+    *,
+    T: int,
+    n: int,
+    span: int,
+    lb: float,
+    mask_raw: bool = False,
+    compute_dtype: str = "fp32",
+    interpret: bool | None = None,
+    ring_rows: int | None = None,
+):
+    """The fused forward wave scan: semantics of ``wavefront._run_wave_scan``
+    (``mask_raw=False``) / ``stacked._frame_wave_scan`` (``mask_raw=True``) in
+    one Pallas kernel — returns the raw per-wave solve values ``ys (W, n)``.
+
+    ``physics(q_prev) -> (c1, c2, c3, c4)`` may close over traced per-reach
+    arrays; it is closure-converted here and its captured operands become
+    kernel inputs. ``lvl`` is the per-node wave level (wf order / band-local),
+    ``wf_row``/``wf_col`` the flat gather table split into ring-row-distance
+    (``gap - 1``) and ring column, ``qs``/``xe``/``se`` the pre-skewed wave
+    input rows. ``compute_dtype="bf16"`` stores the ring in bfloat16 and
+    accumulates every reduction in fp32 (module docstring).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    validate_dtype(compute_dtype)
+    acc = qs.dtype
+    ring_dt = ring_dtype(compute_dtype, acc)
+    n_waves = T + span
+    row_len = n + 1
+    if ring_rows is None:  # callers pass max-gap + 2 (network.wf_ring_rows)
+        ring_rows = span + 2
+    n_deg0 = buckets[0][0] if buckets else n
+    has_ext = xe is not None
+    has_init = q_init is not None
+    lb = float(lb)
+    # A band with no intra-band edges has empty gather tables; Pallas rejects
+    # zero-length blocks, so ride a 1-slot dummy (its gathered value is never
+    # consumed: with no buckets the reduction ignores ``gathered`` entirely).
+    if int(wf_row.shape[0]) == 0:
+        assert not buckets, "empty gather tables with non-empty buckets"
+        wf_row = jnp.zeros(1, jnp.int32)
+        wf_col = jnp.zeros(1, jnp.int32)
+        wf_mask = jnp.zeros(1, wf_mask.dtype if wf_mask.ndim else jnp.float32)
+
+    # The physics chain is traced ONCE to a jaxpr whose captured operands
+    # (traced per-reach arrays AND concrete baked-in constants — pallas
+    # kernels may capture neither) become explicit kernel inputs, replayed
+    # inside the kernel with eval_jaxpr. 0-d captures ride as (1,) operands
+    # (Pallas blocks are >= 1-d) and are restored before the replay.
+    closed = jax.make_jaxpr(physics)(jax.ShapeDtypeStruct((n,), acc))
+    phys_consts = [jnp.asarray(c) for c in closed.consts]
+    const_scalar = [c.ndim == 0 for c in phys_consts]
+    phys_ops = [c.reshape(1) if s else c for c, s in zip(phys_consts, const_scalar)]
+    n_consts = len(phys_consts)
+
+    def kernel(*refs):
+        it = iter(refs)
+        qs_r = next(it)
+        xe_r = next(it) if has_ext else None
+        se_r = next(it) if has_ext else None
+        lvl_r, row_r, col_r, mask_r = next(it), next(it), next(it), next(it)
+        qi_r = next(it) if has_init else None
+        const_r = [next(it) for _ in range(n_consts)]
+        ys_r, ring_r, s_r = next(it), next(it), next(it)
+
+        w = pl.program_id(0) + 1  # wave number, 1..W
+
+        @pl.when(w == 1)
+        def _():
+            ring_r[...] = jnp.zeros_like(ring_r)
+            s_r[...] = jnp.zeros_like(s_r)
+
+        lvl_v = lvl_r[...]
+        t_node = w - 1 - lvl_v
+        h1 = jax.lax.rem(w - 1, ring_rows)  # row of wave w - 1's output
+        q_prev_row = ring_r[h1, :][:n].astype(acc)
+        q_prev = jnp.maximum(q_prev_row, lb)  # clamped x_{t-1}[i]
+        consts = [
+            r[...].reshape(()) if s else r[...]
+            for r, s in zip(const_r, const_scalar)
+        ]
+        c1, c2, c3, c4 = jax.core.eval_jaxpr(closed.jaxpr, consts, q_prev)
+
+        rot = h1 - row_r[...]
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        ring_flat = ring_r[...].reshape(-1)
+        gathered = jnp.take(  # THE gather: raw x_t[p] (bf16 in mixed mode)
+            ring_flat, rot * row_len + col_r[...], mode="clip"
+        ).astype(acc)  # fp32 BEFORE any reduction (fp32-accumulate contract)
+        mask_v = mask_r[...]
+        x_pred = _reduce_gathered(gathered, mask_v, buckets, n_deg0, lb, False, mask_raw)
+        s_next = _reduce_gathered(gathered, mask_v, buckets, n_deg0, lb, True, mask_raw)
+
+        q_row = qs_r[0, :]
+        xe_row = xe_r[0, :] if has_ext else jnp.zeros((), acc)
+        se_row = se_r[0, :] if has_ext else jnp.zeros((), acc)
+        x_pred = x_pred + xe_row
+        b_step = c2 * (s_r[...] + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
+        is_hot = t_node == 0
+        b = jnp.where(is_hot, q_row, b_step)  # hotstart: (I - N) q0 = q'_0, raw
+        c1_eff = jnp.where(is_hot, 1.0, c1)
+        y = b + c1_eff * x_pred
+        if has_init:
+            y = jnp.where(is_hot, jnp.maximum(qi_r[...], lb), y)
+        ok = (t_node >= 0) & (t_node <= T - 1)
+        y = jnp.where(ok, y, 0.0)
+        # ONE rounding point in mixed mode: the ring store; the emitted raw
+        # series carries the same rounded values so downstream readers (next
+        # chunks, the analytic backward's re-gathers) see what the ring held.
+        y_store = y.astype(ring_dt)
+        ring_r[jax.lax.rem(w, ring_rows), :] = jnp.concatenate(
+            [y_store, jnp.zeros(1, ring_dt)]
+        )
+        ys_r[0, :] = y_store.astype(acc)
+        s_r[...] = s_next
+
+    operands = [qs]
+    in_specs = [_row_spec(pl, n)]
+    if has_ext:
+        operands += [xe, se]
+        in_specs += [_row_spec(pl, n), _row_spec(pl, n)]
+    operands += [lvl, wf_row, wf_col, wf_mask]
+    in_specs += [_full_spec(pl, a) for a in (lvl, wf_row, wf_col, wf_mask)]
+    if has_init:
+        operands.append(q_init)
+        in_specs.append(_full_spec(pl, q_init))
+    operands += phys_ops
+    in_specs += [_full_spec(pl, c) for c in phys_ops]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_waves,),
+        in_specs=in_specs,
+        out_specs=_row_spec(pl, n),
+        out_shape=jax.ShapeDtypeStruct((n_waves, n), acc),
+        scratch_shapes=[
+            pltpu.VMEM((ring_rows, row_len), ring_dt),
+            pltpu.VMEM((n,), acc),  # carried inflow sum: ALWAYS fp32
+        ],
+        interpret=_interpret(interpret),
+    )(*operands)
+
+
+def fused_reverse_scan(
+    rows_s,
+    t_row,
+    t_col,
+    *,
+    n: int,
+    t_width: int,
+    span: int,
+    interpret: bool | None = None,
+    ring_rows: int | None = None,
+):
+    """The fused analytic reverse-wavefront scan: the adjoint twin of
+    :func:`fused_wave_scan`, shared by ``wavefront._analytic_bwd`` and
+    ``stacked._band_analytic_bwd`` — returns the per-wave ``lam`` rows
+    ``(W, n)``.
+
+    ``rows_s`` is the precomputed reverse stream ``(W, 2n + 2*n*t_width)``
+    whose row per wave concatenates ``[gbar | ow | zce | duce]`` (the
+    transposed-solve cotangent seed, the own-channel push weight, and the
+    per-successor-slot ``c1``/``dmax*c2`` propagation weights — see the
+    wavefront module docstring). The body is the graph-propagation minimum:
+    one transposed gather, two edge-weighted reductions, one ring write. The
+    adjoint always runs fp32 (mixed precision applies to the forward ring)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dtype = rows_s.dtype
+    n_waves = rows_s.shape[0]
+    row_len = n + 1
+    if ring_rows is None:  # callers pass max-gap + 2 (network.wf_ring_rows)
+        ring_rows = span + 2
+    e_t = n * t_width
+    width_all = 2 * n + 2 * e_t
+    assert rows_s.shape[1] == width_all, (rows_s.shape, width_all)
+
+    def kernel(rows_r, trow_r, tcol_r, lam_r, ring_r, gx_r):
+        w = pl.program_id(0) + 1
+
+        @pl.when(w == 1)
+        def _():
+            ring_r[...] = jnp.zeros_like(ring_r)
+            gx_r[...] = jnp.zeros_like(gx_r)
+
+        rows = rows_r[0, :]
+        h1 = jax.lax.rem(w - 1, ring_rows)
+        rot = h1 - trow_r[...]
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        g = jnp.take(  # successors' lam, emitted gap waves earlier
+            ring_r[...].reshape(-1), rot * row_len + tcol_r[...], mode="clip"
+        )
+        zsum = (rows[2 * n : 2 * n + e_t] * g).reshape(n, t_width).sum(axis=1)
+        dusum = (rows[2 * n + e_t :] * g).reshape(n, t_width).sum(axis=1)
+
+        lam = rows[:n] + gx_r[...] + zsum  # transposed same-timestep solve
+        gx_r[...] = rows[n : 2 * n] * lam + dusum
+        ring_r[jax.lax.rem(w, ring_rows), :] = jnp.concatenate(
+            [lam, jnp.zeros(1, dtype)]
+        )
+        lam_r[0, :] = lam
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_waves,),
+        in_specs=[
+            _row_spec(pl, width_all),
+            _full_spec(pl, t_row),
+            _full_spec(pl, t_col),
+        ],
+        out_specs=_row_spec(pl, n),
+        out_shape=jax.ShapeDtypeStruct((n_waves, n), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((ring_rows, row_len), dtype),
+            pltpu.VMEM((n,), dtype),
+        ],
+        interpret=_interpret(interpret),
+    )(rows_s, t_row, t_col)

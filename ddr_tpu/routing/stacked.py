@@ -144,6 +144,11 @@ class StackedChunked:
         default_factory=lambda: jnp.zeros((0, 0), jnp.int32)
     )  # (C, n_cap * t_width)
     t_width: int = dataclasses.field(default=0, metadata={"static": True})
+    # Ring rows actually needed: max LOCAL edge level-gap (over all bands) + 2
+    # — the shared band ring only has to cover the longest in-band gap, not
+    # the whole span (see RiverNetwork.wf_ring_rows). 0 = pre-field builds:
+    # consumers fall back to span_max + 2.
+    ring_rows: int = dataclasses.field(default=0, metadata={"static": True})
 
 
 def build_stacked_chunked(
@@ -281,6 +286,10 @@ def build_stacked_chunked(
         t_col[band[s_node], slot[s_node] * t_width + sseq] = slot[tgt_node]
 
     out_map = band * np.int64(n_cap) + slot
+    gap_max = (
+        int((level[loc_rows] - level[loc_cols]).max()) if loc_rows.size else 0
+    )
+    ring_rows = min(span_max, gap_max) + 2
 
     if (span_max + 2) * row_len >= 2**31:
         raise ValueError(
@@ -310,6 +319,7 @@ def build_stacked_chunked(
         t_row=jnp.asarray(t_row, jnp.int32),
         t_col=jnp.asarray(t_col, jnp.int32),
         t_width=int(t_width),
+        ring_rows=int(ring_rows),
     )
 
 
@@ -325,25 +335,15 @@ def _skew_cols(src: jnp.ndarray, starts: jnp.ndarray, width: int) -> jnp.ndarray
 def _reduce_buckets_frame(gathered, mask_row, buckets, n_cap, lb, clamped):
     """Per-slot sums from the frame's width-profile gather. ``gathered`` may
     carry leading batch axes (``(..., E_cap) -> (..., n_cap)``): the analytic
-    backward reduces whole (T, E_cap) residual re-gathers in one call."""
-    lead = gathered.shape[:-1]
-    parts = []
-    off = 0
-    for node_start, node_end, width in buckets:
-        cnt_nodes = node_end - node_start
-        if width == 0:
-            parts.append(jnp.zeros(lead + (cnt_nodes,), gathered.dtype))
-            continue
-        cnt = cnt_nodes * width
-        blk = gathered[..., off : off + cnt].reshape(lead + (cnt_nodes, width))
-        msk = mask_row[off : off + cnt].reshape(cnt_nodes, width)
-        if clamped:
-            blk = jnp.maximum(blk, lb)
-        parts.append((blk * msk).sum(axis=-1))
-        off += cnt
-    if not parts:
-        return jnp.zeros(lead + (n_cap,), gathered.dtype)
-    return jnp.concatenate(parts, axis=-1)
+    backward reduces whole (T, E_cap) residual re-gathers in one call.
+    Delegates to the ONE shared bucket-walk
+    (:func:`ddr_tpu.routing.pallas_kernel._reduce_gathered`, its
+    ``mask_raw=True`` case: frame buckets start at slot 0, so the degree-0
+    prefix is empty and every sum — raw included — applies the pad mask)."""
+    from ddr_tpu.routing.pallas_kernel import _reduce_gathered
+
+    n_deg0 = buckets[0][0] if buckets else n_cap
+    return _reduce_gathered(gathered, mask_row, buckets, n_deg0, lb, clamped, True)
 
 
 def _physics_frame(q_prev, ln, sl, xs_, twd, ssd, nm, qsp, psp, bounds, dt):
@@ -378,14 +378,32 @@ def _frame_input_skews(qp_c, x_ext, s_ext, lvl, *, T, n_cap, span):
 
 
 def _frame_wave_scan(physics, lvl, wfr, wfc, wfm, qs_sk, xe_sk, se_sk, qi_c, *,
-                     T, n_cap, span, lb, buckets, has_init, dtype):
+                     T, n_cap, span, lb, buckets, has_init, dtype,
+                     kernel="xla", compute_dtype="fp32", ring_rows=None):
     """One band's wave scan in the shared static frame (the stacked analog of
-    ``wavefront._run_wave_scan``); returns the raw per-wave values ``ys``."""
+    ``wavefront._run_wave_scan``); returns the raw per-wave values ``ys``.
+    ``kernel="pallas"`` runs the fused kernel
+    (:mod:`ddr_tpu.routing.pallas_kernel`) with the band's traced tables as
+    kernel operands; ``compute_dtype="bf16"`` stores the band ring in bfloat16
+    with fp32 accumulation (same scheme as the single-ring engine)."""
+    if kernel == "pallas":
+        from ddr_tpu.routing.pallas_kernel import fused_wave_scan
+
+        return fused_wave_scan(
+            physics, lvl, wfr, wfc, wfm, buckets, qs_sk, xe_sk, se_sk,
+            qi_c if has_init else None, T=T, n=n_cap, span=span, lb=lb,
+            mask_raw=True, compute_dtype=compute_dtype, ring_rows=ring_rows,
+        )
+    from ddr_tpu.routing.pallas_kernel import ring_dtype
+
     row_len = n_cap + 1
-    ring_rows = span + 2
+    if ring_rows is None:  # max-gap-sized (StackedChunked.ring_rows)
+        ring_rows = span + 2
     n_waves = T + span
-    ring0 = jnp.zeros(ring_rows * row_len, dtype)
-    s0 = jnp.zeros(n_cap, dtype)
+    ring_dt = ring_dtype(compute_dtype, dtype)
+    up = (lambda a: a.astype(dtype)) if ring_dt != dtype else (lambda a: a)
+    ring0 = jnp.zeros(ring_rows * row_len, ring_dt)
+    s0 = jnp.zeros(n_cap, dtype)  # carried inflow sum: ALWAYS fp32
 
     def body(carry, wave_inputs):
         ring, s_state = carry
@@ -393,12 +411,12 @@ def _frame_wave_scan(physics, lvl, wfr, wfc, wfm, qs_sk, xe_sk, se_sk, qi_c, *,
         t_node = w - 1 - lvl
         h1 = jax.lax.rem(w - 1, ring_rows)
         q_prev = jnp.maximum(
-            jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+            up(jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap]), lb
         )
         c1, c2, c3, c4 = physics(q_prev)
         rot = h1 - wfr
         rot = jnp.where(rot < 0, rot + ring_rows, rot)
-        gathered = ring[rot * row_len + wfc]
+        gathered = up(ring[rot * row_len + wfc])
         x_pred = _reduce_buckets_frame(gathered, wfm, buckets, n_cap, lb, False) + xe_row
         s_next = _reduce_buckets_frame(gathered, wfm, buckets, n_cap, lb, True)
 
@@ -411,11 +429,12 @@ def _frame_wave_scan(physics, lvl, wfr, wfc, wfm, qs_sk, xe_sk, se_sk, qi_c, *,
             y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
         ok = (t_node >= 0) & (t_node <= T - 1)
         y = jnp.where(ok, y, 0.0)
+        y_store = y.astype(ring_dt)  # mixed precision: the ONE rounding point
         h = jax.lax.rem(w, ring_rows)
         ring = jax.lax.dynamic_update_slice(
-            ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]), (h * row_len,)
+            ring, jnp.concatenate([y_store, jnp.zeros(1, ring_dt)]), (h * row_len,)
         )
-        return (ring, s_next), y
+        return (ring, s_next), up(y_store)
 
     waves = jnp.arange(1, n_waves + 1)
     (_, _), ys = jax.lax.scan(body, (ring0, s0), (qs_sk, xe_sk, se_sk, waves))
@@ -443,7 +462,8 @@ def _band_analytic(static, lvl, wfr, wfc, wfm, t_r, t_c,
 
 def _band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_r, t_c,
                        ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c, x_ext, s_ext):
-    (T, n_cap, span, lb, bounds, dt, buckets, t_width, has_init) = static
+    (T, n_cap, span, lb, bounds, dt, buckets, t_width, has_init,
+     kernel, compute_dtype, ring_rows) = static
     qs_sk, xe_sk, se_sk = _frame_input_skews(
         qp_c, x_ext, s_ext, lvl, T=T, n_cap=n_cap, span=span
     )
@@ -456,6 +476,7 @@ def _band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_r, t_c,
         physics, lvl, wfr, wfc, wfm, qs_sk, xe_sk, se_sk, qi_c,
         T=T, n_cap=n_cap, span=span, lb=lb, buckets=buckets,
         has_init=has_init, dtype=qp_c.dtype,
+        kernel=kernel, compute_dtype=compute_dtype, ring_rows=ring_rows,
     )
     raw = _skew_cols(ys, lvl, T)
     res = (raw, qp_c, qi_c, x_ext, s_ext, lvl, wfr, wfc, wfm, t_r, t_c, phys_args)
@@ -465,10 +486,12 @@ def _band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_r, t_c,
 def _band_analytic_bwd(static, res, raw_bar):
     from ddr_tpu.routing.wavefront import _dmax
 
-    (T, n_cap, span, lb, bounds, dt, buckets, t_width, has_init) = static
+    (T, n_cap, span, lb, bounds, dt, buckets, t_width, has_init,
+     kernel, compute_dtype, ring_rows) = static
     raw, qp_c, qi_c, x_ext, s_ext, lvl, wfr, wfc, wfm, t_r, t_c, phys_args = res
     row_len = n_cap + 1
-    ring_rows = span + 2
+    if ring_rows is None:
+        ring_rows = span + 2
     n_waves = T + span
     dtype = raw.dtype
     M = span - lvl
@@ -490,10 +513,15 @@ def _band_analytic_bwd(static, res, raw_bar):
     def phys_batch(q, args):
         return _physics_frame(q, *args, bounds, dt)
 
-    (c1_a, c2_a, c3_a, c4_a), (d1, d2, d3, d4) = jax.jvp(
-        lambda q: phys_batch(q, phys_args),
-        (q_prev_all,), (jnp.ones_like(q_prev_all),),
+    # ONE nonlinear trace serves the whole backward: the linearized physics
+    # yields the primal c's, the tangent d's (one linear eval), and — via its
+    # transpose, evaluated after the reverse scan below — the theta pullback,
+    # instead of a second full chain re-evaluation inside jax.vjp.
+    (c1_a, c2_a, c3_a, c4_a), phys_lin = jax.linearize(
+        phys_batch, q_prev_all, phys_args
     )
+    zero_args = jax.tree_util.tree_map(jnp.zeros_like, phys_args)
+    d1, d2, d3, d4 = phys_lin(jnp.ones_like(q_prev_all), zero_args)
     # Masks, hotstart handling, and the propagation WEIGHTS folded into
     # precomputed streams exactly as in wavefront._analytic_bwd (lam-ring
     # scheme): the ring stores lam alone, the body is one gather + one write
@@ -509,53 +537,72 @@ def _band_analytic_bwd(static, res, raw_bar):
 
     # Per-edge weight streams: flat slot (i, k) carries successor j's weight
     # at slot i's in-flight timestep (pads read the appended zero column).
+    # dm (slot i's clamp subgradient) folds into the inflow-adjoint stream
+    # (``duce = dm ⊗ uce``) exactly as in wavefront._analytic_bwd: one fewer
+    # streamed (W, n_cap) block, one fewer per-wave multiply.
     zce = jnp.concatenate([zc, jnp.zeros((T, 1), dtype)], axis=1)[:, t_c]
     uce = jnp.concatenate([uc, jnp.zeros((T, 1), dtype)], axis=1)[:, t_c]
+    duce = jnp.repeat(dm_all, t_width, axis=1) * uce
 
-    # ONE stacked reverse stream over [gbar | ow | dm | zce | uce] columns.
+    # ONE stacked reverse stream over [gbar | ow | zce | duce] columns,
+    # ``stacked_s[v, j] = core[T-1+span - start_j - v, j]`` (zero outside
+    # [0, T)). The padded buffer is built TRANSPOSED from the start: the only
+    # transposed copy is the small (T, width) core — the naive row-major form
+    # fed `_skew_cols` a (2*span+T+1, width) buffer whose full-size transpose
+    # plus generic-gather fallbacks measured as the LARGEST single slice of
+    # the deep-suite backward (~2/3 of the whole VJP-over-forward gap on
+    # CPU); this form is a memset, one small transpose, and per-row memcpy
+    # slices.
     e_cap_t = n_cap * t_width
-    off = (0, n_cap, 2 * n_cap, 3 * n_cap, 3 * n_cap + e_cap_t)
-    width_all = 3 * n_cap + 2 * e_cap_t
+    off = (0, n_cap, 2 * n_cap, 2 * n_cap + e_cap_t)
+    width_all = 2 * n_cap + 2 * e_cap_t
     lvl_e = jnp.repeat(lvl, t_width)  # per-edge-slot starts (slots node-major)
-    starts_all = jnp.concatenate([lvl, lvl, lvl, lvl_e, lvl_e])
-    z_l = jnp.zeros((span, width_all), dtype)
-    z_r = jnp.zeros((span + 1, width_all), dtype)
-    padded = jnp.concatenate(
-        [z_l, jnp.concatenate([raw_bar, ow, dm_all, zce, uce], axis=1)[::-1], z_r],
-        axis=0,
-    )
-    stacked_s = _skew_cols(padded, starts_all, n_waves)
+    starts_all = jnp.concatenate([lvl, lvl, lvl_e, lvl_e])
+    core = jnp.concatenate([raw_bar, ow, zce, duce], axis=1)
+    padded_t = jnp.zeros((width_all, 2 * span + T + 1), dtype)
+    padded_t = jax.lax.dynamic_update_slice(padded_t, core[::-1].T, (0, span))
+    stacked_s = jax.vmap(
+        lambda row, s0: jax.lax.dynamic_slice(row, (s0,), (n_waves,))
+    )(padded_t, starts_all).T
 
-    ring0 = jnp.zeros(ring_rows * row_len, dtype)
-    gx0 = jnp.zeros(n_cap, dtype)
+    if kernel == "pallas":
+        from ddr_tpu.routing.pallas_kernel import fused_reverse_scan
 
-    def body(carry, wave_inputs):
-        ring, gx = carry
-        rows, w = wave_inputs
-
-        h1 = jax.lax.rem(w - 1, ring_rows)
-        rot = h1 - t_r
-        rot = jnp.where(rot < 0, rot + ring_rows, rot)
-        g = ring[rot * row_len + t_c]
-        zsum = (rows[off[3] : off[4]] * g).reshape(n_cap, t_width).sum(axis=1)
-        usum = (rows[off[4] :] * g).reshape(n_cap, t_width).sum(axis=1)
-
-        lam = rows[: off[1]] + gx + zsum  # zero outside valid region by construction
-        gx_next = rows[off[1] : off[2]] * lam + rows[off[2] : off[3]] * usum
-
-        h = jax.lax.rem(w, ring_rows)
-        ring = jax.lax.dynamic_update_slice(
-            ring, jnp.concatenate([lam, jnp.zeros(1, dtype)]), (h * row_len,)
+        lams = fused_reverse_scan(
+            stacked_s, t_r, t_c, n=n_cap, t_width=t_width, span=span,
+            ring_rows=ring_rows,
         )
-        return (ring, gx_next), lam
+    else:
+        ring0 = jnp.zeros(ring_rows * row_len, dtype)
+        gx0 = jnp.zeros(n_cap, dtype)
 
-    waves = jnp.arange(1, n_waves + 1)
-    (_, _), lams = jax.lax.scan(body, (ring0, gx0), (stacked_s, waves))
+        def body(carry, wave_inputs):
+            ring, gx = carry
+            rows, w = wave_inputs
+
+            h1 = jax.lax.rem(w - 1, ring_rows)
+            rot = h1 - t_r
+            rot = jnp.where(rot < 0, rot + ring_rows, rot)
+            g = ring[rot * row_len + t_c]
+            zsum = (rows[off[2] : off[3]] * g).reshape(n_cap, t_width).sum(axis=1)
+            dusum = (rows[off[3] :] * g).reshape(n_cap, t_width).sum(axis=1)
+
+            lam = rows[: off[1]] + gx + zsum  # zero outside valid region by construction
+            gx_next = rows[off[1] : off[2]] * lam + dusum
+
+            h = jax.lax.rem(w, ring_rows)
+            ring = jax.lax.dynamic_update_slice(
+                ring, jnp.concatenate([lam, jnp.zeros(1, dtype)]), (h * row_len,)
+            )
+            return (ring, gx_next), lam
+
+        waves = jnp.arange(1, n_waves + 1)
+        (_, _), lams = jax.lax.scan(body, (ring0, gx0), (stacked_s, waves))
 
     # --- vectorized adjoint outputs from the un-skewed lam field ---
     lam_all = _skew_cols(lams, M, T)[::-1]  # (T, n_cap), raw incl. t = 0
     lam_th = lam_all.at[0].set(0.0)  # no physics on the hotstart diagonal
-    _, pull = jax.vjp(phys_batch, q_prev_all, phys_args)
+    pull = jax.linear_transpose(phys_lin, q_prev_all, phys_args)
     _, theta_bar = pull(
         (lam_th * xpx, lam_th * s_full, lam_th * q_prev_all, lam_th * qpm1c)
     )
@@ -592,9 +639,18 @@ def route_stacked(
     remat_physics: bool = True,
     remat_bands: bool = False,
     adjoint: str = "analytic",
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ):
     """Route ``(T, N)`` inflows with one scanned band program; same contract as
     :func:`ddr_tpu.routing.mc.route`. All inputs in ORIGINAL node order.
+
+    ``kernel`` selects the band wave-scan implementation (``"pallas"`` = the
+    fused kernel of :mod:`ddr_tpu.routing.pallas_kernel`, interpret mode
+    off-TPU; ``None`` auto-selects) and ``dtype="bf16"`` enables
+    bf16-compute/fp32-accumulate band rings — the same axes as
+    :func:`ddr_tpu.routing.wavefront.wavefront_route_core`. ``kernel="pallas"``
+    requires ``adjoint="analytic"`` (no AD rule through the fused kernel).
 
     ``adjoint="analytic"`` (default) differentiates each band's wave scan with
     the reverse-wavefront custom VJP (:func:`_band_analytic`): residual = the
@@ -614,9 +670,24 @@ def route_stacked(
     analysis predicts. Under the analytic adjoint it is mostly moot (the
     per-wave residual stream it existed to kill is gone). Default off."""
     from ddr_tpu.routing.mc import Bounds, RouteResult
+    from ddr_tpu.routing.pallas_kernel import resolve_kernel, validate_dtype
 
     if adjoint not in ("ad", "analytic"):
         raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic' or 'ad')")
+    auto_kernel = kernel in (None, "auto")
+    kernel = resolve_kernel(kernel)
+    validate_dtype(dtype)
+    if kernel == "pallas" and adjoint != "analytic":
+        # auto-selection falls back to the XLA scan (pallas has no AD rule);
+        # only an EXPLICIT pallas request errors
+        if auto_kernel:
+            kernel = "xla"
+        else:
+            raise ValueError(
+                "kernel='pallas' requires adjoint='analytic': the fused kernel "
+                "has no AD rule — its custom-VJP reverse-wavefront kernel is "
+                "the backward (pass kernel='xla' to differentiate with plain AD)"
+            )
     if bounds is None:
         bounds = Bounds()
     if adjoint == "analytic" and network.t_width <= 0:
@@ -630,7 +701,6 @@ def route_stacked(
     C, n_cap = network.n_chunks, network.n_cap
     span = network.span_max
     row_len = n_cap + 1
-    ring_rows = span + 2
     n_waves = T + span
     B = network.n_boundary
     buckets = network.buckets
@@ -658,6 +728,7 @@ def route_stacked(
     has_init = q_init is not None
     ba_static = (
         T, n_cap, span, lb, bounds, dt, buckets, network.t_width, has_init,
+        kernel, dtype, network.ring_rows or None,
     )
 
     def band_step(bnd, band_in):
@@ -696,6 +767,8 @@ def route_stacked(
                 physics, lvl, wf_row, wf_col, wf_mask, qs_sk, xe_sk, se_sk, qi_c,
                 T=T, n_cap=n_cap, span=span, lb=lb, buckets=buckets,
                 has_init=has_init, dtype=qp_c.dtype,
+                kernel=kernel, compute_dtype=dtype,
+                ring_rows=network.ring_rows or None,
             )
             raw = _skew_cols(ys, lvl, T)  # (T, n_cap), un-skewed
 

@@ -130,19 +130,13 @@ def _skew_by_level_runs(src: jnp.ndarray, runs, start_of, width: int) -> jnp.nda
 def _reduce_buckets(gathered, wf_mask, buckets, n_deg0, lb, clamped):
     """Per-node sums from the flat bucket-concatenated gather; ``gathered`` may
     carry leading batch axes (``(..., E) -> (..., n)``) — the backward pass
-    reduces whole (T, E) residual gathers in one call."""
-    lead = gathered.shape[:-1]
-    parts = [jnp.zeros(lead + (n_deg0,), gathered.dtype)]
-    off = 0
-    for node_start, node_end, width in buckets:
-        cnt = (node_end - node_start) * width
-        blk = gathered[..., off : off + cnt].reshape(lead + (node_end - node_start, width))
-        if clamped:
-            msk = wf_mask[off : off + cnt].reshape(node_end - node_start, width)
-            blk = jnp.maximum(blk, lb) * msk
-        parts.append(blk.sum(axis=-1))
-        off += cnt
-    return jnp.concatenate(parts, axis=-1)
+    reduces whole (T, E) residual gathers in one call. Delegates to the ONE
+    shared bucket-walk (:func:`ddr_tpu.routing.pallas_kernel._reduce_gathered`,
+    its ``mask_raw=False`` case) so the XLA scans and the fused kernels cannot
+    drift apart."""
+    from ddr_tpu.routing.pallas_kernel import _reduce_gathered
+
+    return _reduce_gathered(gathered, wf_mask, buckets, n_deg0, lb, clamped, False)
 
 
 def _dmax(x, lb):
@@ -180,13 +174,28 @@ def _input_skews(qp_p, x_ext, s_ext, runs, depth: int, T: int, n: int):
 
 def _run_wave_scan(
     physics, level_p, wf_idx, wf_mask, buckets, *, T, n, depth,
-    qs, xe, se, has_ext, q_init, discharge_lb,
+    qs, xe, se, has_ext, q_init, discharge_lb, compute_dtype="fp32",
+    ring_rows=None,
 ):
     """The forward wave scan (shared by the AD path and the analytic-adjoint
-    primal): returns the raw per-wave solve values ``ys (W, n)``."""
+    primal): returns the raw per-wave solve values ``ys (W, n)``.
+
+    ``compute_dtype="bf16"`` stores the history ring (and therefore the
+    gathered operands) in bfloat16 while every reduction — the degree-bucket
+    predecessor sums and the carried inflow sum — accumulates in fp32; each
+    wave's solve value is rounded exactly once (the ring store) and the
+    emitted raw series carries those rounded values upcast, so downstream
+    readers and the analytic backward's re-gathers see what the ring held
+    (the same scheme as the fused Pallas kernel —
+    :mod:`ddr_tpu.routing.pallas_kernel`)."""
+    from ddr_tpu.routing.pallas_kernel import ring_dtype
+
     n_waves = T + depth
     row_len = n + 1
     n_deg0 = buckets[0][0] if buckets else n
+    acc = qs.dtype
+    ring_dt = ring_dtype(compute_dtype, acc)
+    up = (lambda a: a.astype(acc)) if ring_dt != acc else (lambda a: a)
 
     # Rotating FLAT ring. Two profiled pathologies shape this:
     # (a) the concatenate-shift form (`ring = concat([y_row, ring[:-1]])`)
@@ -204,12 +213,17 @@ def _run_wave_scan(
     # two vector ops on the edge table. Rows never written (w - d < 1, early
     # waves) land on still-zero ring rows, preserving the zero-history
     # semantics of the shift form bit for bit.
-    ring_rows = depth + 2
+    # The ring only needs to span the longest edge gap actually in the tables
+    # (RiverNetwork.wf_ring_rows), not the full depth: the carry is what every
+    # wave copies, so ring size IS the scan's bandwidth tax. depth + 2 is the
+    # safe ceiling for callers predating the field.
+    if ring_rows is None:
+        ring_rows = depth + 2
     wf_row = wf_idx // row_len  # d - 1, static per slot
     wf_col = wf_idx - wf_row * row_len
 
-    ring0 = jnp.zeros(ring_rows * row_len, qs.dtype)
-    s0 = jnp.zeros(n, qs.dtype)
+    ring0 = jnp.zeros(ring_rows * row_len, ring_dt)
+    s0 = jnp.zeros(n, acc)  # carried inflow sum: ALWAYS fp32 (accumulator)
     t_of_wave = lambda w: w - 1 - level_p  # noqa: E731
 
     def body(carry, wave_inputs):
@@ -221,12 +235,12 @@ def _run_wave_scan(
             xe_row = se_row = 0.0
         t_node = t_of_wave(w)
         h1 = jax.lax.rem(w - 1, ring_rows)  # row of wave w - 1's output
-        q_prev_row = jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n]
+        q_prev_row = up(jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n])
         q_prev = jnp.maximum(q_prev_row, discharge_lb)  # clamped x_{t-1}[i]
         c1, c2, c3, c4 = physics(q_prev)
         rot = h1 - wf_row  # (h1 - (d - 1)) mod R, in two vector ops
         rot = jnp.where(rot < 0, rot + ring_rows, rot)
-        gathered = ring[rot * row_len + wf_col]  # THE gather: raw x_t[p]
+        gathered = up(ring[rot * row_len + wf_col])  # THE gather: raw x_t[p]
         x_pred = _reduce_buckets(gathered, wf_mask, buckets, n_deg0, discharge_lb, False) + xe_row
         s_next = _reduce_buckets(gathered, wf_mask, buckets, n_deg0, discharge_lb, True)
 
@@ -242,11 +256,14 @@ def _run_wave_scan(
         # keeps late-wave garbage finite.
         ok = (t_node >= 0) & (t_node <= T - 1)
         y = jnp.where(ok, y, 0.0)
+        # mixed precision: ONE rounding point (the ring store); the emitted
+        # series carries the rounded value so downstream readers match the ring
+        y_store = y.astype(ring_dt)
         h = jax.lax.rem(w, ring_rows)  # this wave's row
         ring = jax.lax.dynamic_update_slice(
-            ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]), (h * row_len,)
+            ring, jnp.concatenate([y_store, jnp.zeros(1, ring_dt)]), (h * row_len,)
         )
-        return (ring, s_next), y
+        return (ring, s_next), up(y_store)
 
     waves = jnp.arange(1, n_waves + 1)
     xs = (qs, xe, se, waves) if has_ext else (qs, waves)
@@ -309,7 +326,8 @@ def _analytic_route(static, physics_fn, level_p, wf_idx, wf_mask, wf_t_idx,
 
 def _analytic_fwd(static, physics_fn, level_p, wf_idx, wf_mask, wf_t_idx,
                   qp_p, q_init_a, x_ext_a, s_ext_a, phys_consts):
-    (T, n, depth, runs, buckets, t_width, lb, has_init, has_ext) = static
+    (T, n, depth, runs, buckets, t_width, lb, has_init, has_ext,
+     kernel, compute_dtype, ring_rows) = static
     qs, xe, se = _input_skews(
         qp_p, x_ext_a if has_ext else None, s_ext_a if has_ext else None,
         runs, depth, T, n,
@@ -318,11 +336,23 @@ def _analytic_fwd(static, physics_fn, level_p, wf_idx, wf_mask, wf_t_idx,
     def physics(q_prev):
         return physics_fn(q_prev, *phys_consts)
 
-    ys = _run_wave_scan(
-        physics, level_p, wf_idx, wf_mask, buckets, T=T, n=n, depth=depth,
-        qs=qs, xe=xe, se=se, has_ext=has_ext,
-        q_init=q_init_a if has_init else None, discharge_lb=lb,
-    )
+    if kernel == "pallas":
+        from ddr_tpu.routing.pallas_kernel import fused_wave_scan
+
+        row_len = n + 1
+        ys = fused_wave_scan(
+            physics, level_p, wf_idx // row_len, wf_idx % row_len, wf_mask,
+            buckets, qs, xe, se, q_init_a if has_init else None,
+            T=T, n=n, span=depth, lb=lb, mask_raw=False,
+            compute_dtype=compute_dtype, ring_rows=ring_rows,
+        )
+    else:
+        ys = _run_wave_scan(
+            physics, level_p, wf_idx, wf_mask, buckets, T=T, n=n, depth=depth,
+            qs=qs, xe=xe, se=se, has_ext=has_ext,
+            q_init=q_init_a if has_init else None, discharge_lb=lb,
+            compute_dtype=compute_dtype, ring_rows=ring_rows,
+        )
     # Un-skew (static runs): x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L).
     raw = _skew_by_level_runs(ys, runs, lambda L: L, T)
     res = (raw, qp_p, q_init_a, x_ext_a, s_ext_a, phys_consts,
@@ -331,11 +361,13 @@ def _analytic_fwd(static, physics_fn, level_p, wf_idx, wf_mask, wf_t_idx,
 
 
 def _analytic_bwd(static, physics_fn, res, raw_bar):
-    (T, n, depth, runs, buckets, t_width, lb, has_init, has_ext) = static
+    (T, n, depth, runs, buckets, t_width, lb, has_init, has_ext,
+     kernel, compute_dtype, ring_rows) = static
     (raw, qp_p, q_init_a, x_ext_a, s_ext_a, phys_consts,
      level_p, wf_idx, wf_mask, wf_t_idx) = res
     row_len = n + 1
-    ring_rows = depth + 2
+    if ring_rows is None:
+        ring_rows = depth + 2
     n_waves = T + depth
     n_deg0 = buckets[0][0] if buckets else n
     dtype = raw.dtype
@@ -372,10 +404,14 @@ def _analytic_bwd(static, physics_fn, res, raw_bar):
         # lifts it over the T axis without re-tracing the chain per row
         return jax.vmap(lambda qr: physics_fn(qr, *consts))(q)
 
-    (c1_a, c2_a, c3_a, c4_a), (d1, d2, d3, d4) = jax.jvp(
-        lambda q: phys_batch(q, phys_consts),
-        (q_prev_all,), (jnp.ones_like(q_prev_all),),
+    # ONE nonlinear trace serves the whole backward: primal c's, tangent d's
+    # (one linear eval), and — via the transpose, evaluated after the reverse
+    # scan — the theta pullback, instead of a second chain re-eval in jax.vjp.
+    (c1_a, c2_a, c3_a, c4_a), phys_lin = jax.linearize(
+        phys_batch, q_prev_all, tuple(phys_consts)
     )
+    zero_consts = jax.tree_util.tree_map(jnp.zeros_like, tuple(phys_consts))
+    d1, d2, d3, d4 = phys_lin(jnp.ones_like(q_prev_all), zero_consts)
     # Every validity/hotstart mask and per-timestep coefficient is FOLDED INTO
     # precomputed streams (row 0 pinned to the hotstart values, zero-padding
     # outside [0, T-1] from the skew itself), and the propagation WEIGHTS move
@@ -402,57 +438,70 @@ def _analytic_bwd(static, physics_fn, res, raw_bar):
     # Per-edge weight streams: slot (i, k) of the flat (n * t_width) transposed
     # table carries its SUCCESSOR j's weight at node i's in-flight timestep
     # (pad slots point at the appended zero column, killing their reads).
+    # dm (node i's clamp subgradient) is FOLDED into the inflow-adjoint edge
+    # stream up front — ``duce[:, i*tw+k] = dm[:, i] * uce[:, i*tw+k]`` — so
+    # the scan streams one fewer (W, n) block and multiplies once less per
+    # wave: ``gx_next = ow * lam + sum_k duce_k g_k``.
     wf_t_row = wf_t_idx // row_len  # gap - 1 per successor slot
     wf_t_col = wf_t_idx - wf_t_row * row_len
     zce = jnp.concatenate([zc, jnp.zeros((T, 1), dtype)], axis=1)[:, wf_t_col]
     uce = jnp.concatenate([uc, jnp.zeros((T, 1), dtype)], axis=1)[:, wf_t_col]
+    duce = jnp.repeat(dm_all, t_width, axis=1) * uce
 
-    # ONE stacked reverse stream over [gbar | ow | dm | zce | uce] columns
+    # ONE stacked reverse stream over [gbar | ow | zce | duce] columns
     # (edge blocks scale each node run by t_width — slots are node-major).
     w_t = t_width
-    off = (0, n, 2 * n, 3 * n, 3 * n + n * w_t)
+    off = (0, n, 2 * n, 2 * n + n * w_t)
     runs_k = tuple(
-        (s + o, e + o, L) for o in off[:3] for (s, e, L) in runs
+        (s + o, e + o, L) for o in off[:2] for (s, e, L) in runs
     ) + tuple(
-        (o + s * w_t, o + e * w_t, L) for o in off[3:] for (s, e, L) in runs
+        (o + s * w_t, o + e * w_t, L) for o in off[2:] for (s, e, L) in runs
     )
-    width_all = 3 * n + 2 * n * w_t
+    width_all = 2 * n + 2 * n * w_t
     stacked_s = _reverse_stream(
-        jnp.concatenate([raw_bar, ow, dm_all, zce, uce], axis=1),
+        jnp.concatenate([raw_bar, ow, zce, duce], axis=1),
         runs_k, depth, T, width_all, n_waves, 0,
     )
 
-    ring0 = jnp.zeros(ring_rows * row_len, dtype)
-    gx0 = jnp.zeros(n, dtype)
+    if kernel == "pallas":
+        from ddr_tpu.routing.pallas_kernel import fused_reverse_scan
 
-    def body(carry, wave_inputs):
-        ring, gx = carry
-        rows, w = wave_inputs
-
-        # THE gather: successors' lam, emitted gap waves earlier (pad slots
-        # read the ring's always-zero sentinel cell).
-        h1 = jax.lax.rem(w - 1, ring_rows)
-        rot = h1 - wf_t_row
-        rot = jnp.where(rot < 0, rot + ring_rows, rot)
-        g = ring[rot * row_len + wf_t_col]
-        zsum = (rows[off[3] : off[4]] * g).reshape(n, t_width).sum(axis=1)
-        usum = (rows[off[4] :] * g).reshape(n, t_width).sum(axis=1)
-
-        # lam is zero outside the valid (t, L) region with NO masking: the
-        # streamed rows are zero there, gx was pushed zero, and the gathered
-        # ring rows hold zeros (invalid waves write zeros, mirroring the
-        # forward's zero-history convention).
-        lam = rows[: off[1]] + gx + zsum  # transposed same-timestep solve
-        gx_next = rows[off[1] : off[2]] * lam + rows[off[2] : off[3]] * usum
-
-        h = jax.lax.rem(w, ring_rows)
-        ring = jax.lax.dynamic_update_slice(
-            ring, jnp.concatenate([lam, jnp.zeros(1, dtype)]), (h * row_len,)
+        lams = fused_reverse_scan(
+            stacked_s, wf_t_row, wf_t_col, n=n, t_width=t_width, span=depth,
+            ring_rows=ring_rows,
         )
-        return (ring, gx_next), lam
+    else:
+        ring0 = jnp.zeros(ring_rows * row_len, dtype)
+        gx0 = jnp.zeros(n, dtype)
 
-    waves = jnp.arange(1, n_waves + 1)
-    (_, _), lams = jax.lax.scan(body, (ring0, gx0), (stacked_s, waves))
+        def body(carry, wave_inputs):
+            ring, gx = carry
+            rows, w = wave_inputs
+
+            # THE gather: successors' lam, emitted gap waves earlier (pad slots
+            # read the ring's always-zero sentinel cell).
+            h1 = jax.lax.rem(w - 1, ring_rows)
+            rot = h1 - wf_t_row
+            rot = jnp.where(rot < 0, rot + ring_rows, rot)
+            g = ring[rot * row_len + wf_t_col]
+            zsum = (rows[off[2] : off[3]] * g).reshape(n, t_width).sum(axis=1)
+            dusum = (rows[off[3] :] * g).reshape(n, t_width).sum(axis=1)
+
+            # lam is zero outside the valid (t, L) region with NO masking: the
+            # streamed rows are zero there, gx was pushed zero, and the gathered
+            # ring rows hold zeros (invalid waves write zeros, mirroring the
+            # forward's zero-history convention).
+            lam = rows[: off[1]] + gx + zsum  # transposed same-timestep solve
+            gx_next = rows[off[1] : off[2]] * lam + dusum
+
+            h = jax.lax.rem(w, ring_rows)
+            ring = jax.lax.dynamic_update_slice(
+                ring, jnp.concatenate([lam, jnp.zeros(1, dtype)]), (h * row_len,)
+            )
+            return (ring, gx_next), lam
+
+        waves = jnp.arange(1, n_waves + 1)
+        (_, _), lams = jax.lax.scan(body, (ring0, gx0), (stacked_s, waves))
 
     # --- vectorized adjoint outputs from the un-skewed lam field ---
     lam_all = _unskew_reverse(lams, runs, depth, T)  # (T, N), raw incl. t = 0
@@ -460,7 +509,7 @@ def _analytic_bwd(static, physics_fn, res, raw_bar):
     # pullback's reduction over T lands the per-reach const cotangents
     # directly (row 0 zeroed: no physics on the hotstart diagonal).
     lam_th = lam_all.at[0].set(0.0)
-    _, pull = jax.vjp(phys_batch, q_prev_all, phys_consts)
+    pull = jax.linear_transpose(phys_lin, q_prev_all, tuple(phys_consts))
     _, theta_bar = pull(
         (lam_th * xpx, lam_th * s_full, lam_th * q_prev_all, lam_th * qpm1c)
     )
@@ -500,6 +549,8 @@ def wavefront_route_core(
     x_ext: jnp.ndarray | None = None,
     s_ext: jnp.ndarray | None = None,
     adjoint: str = "ad",
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Route timesteps 0..T-1 by wavefront, entirely in wf_perm order.
 
@@ -534,9 +585,36 @@ def wavefront_route_core(
     physics chain by construction, so the flag is inert there. Forward results
     are bitwise-unchanged either way; gradients agree to float-reassociation
     tolerance (XLA fuses the backward programs differently).
+
+    ``kernel`` selects the wave-scan implementation: ``"pallas"`` runs the
+    fused TPU kernel (:mod:`ddr_tpu.routing.pallas_kernel` — interpret mode
+    off-TPU), ``"xla"`` the ``lax.scan`` path, ``None`` auto-selects (pallas
+    on TPU, xla elsewhere). The Pallas kernels have no AD rule, so
+    ``kernel="pallas"`` requires ``adjoint="analytic"`` (the custom-VJP pair
+    IS the backward). ``dtype="bf16"`` enables bf16-compute /
+    fp32-accumulate routing (ring + gathered operands in bfloat16, every
+    reduction in fp32; the analytic adjoint always runs fp32 over the
+    bf16-rounded residual).
     """
+    from ddr_tpu.routing.pallas_kernel import resolve_kernel, validate_dtype
+
     if adjoint not in ("ad", "analytic"):
         raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic' or 'ad')")
+    auto_kernel = kernel in (None, "auto")
+    kernel = resolve_kernel(kernel)
+    validate_dtype(dtype)
+    if kernel == "pallas" and adjoint != "analytic":
+        # the fused kernel has no AD rule — its custom-VJP reverse-wavefront
+        # kernel IS the backward. Auto-selection silently keeps the XLA scan
+        # (the safe fallback); only an EXPLICIT pallas request errors.
+        if auto_kernel:
+            kernel = "xla"
+        else:
+            raise ValueError(
+                "kernel='pallas' requires adjoint='analytic': the fused kernel "
+                "has no AD rule — its custom-VJP reverse-wavefront kernel is "
+                "the backward (pass kernel='xla' to differentiate with plain AD)"
+            )
     T, n = q_prime.shape
     depth = network.depth
     runs = network.wf_level_runs
@@ -560,6 +638,7 @@ def wavefront_route_core(
         static = (
             T, n, depth, runs, network.wf_buckets, network.wf_t_width,
             float(discharge_lb), q_init is not None, x_ext is not None,
+            kernel, dtype, network.wf_ring_rows or None,
         )
         q_init_a = q_init if q_init is not None else jnp.zeros(n, qp_p.dtype)
         x_ext_a = x_ext if x_ext is not None else jnp.zeros((1, n), qp_p.dtype)
@@ -582,7 +661,8 @@ def wavefront_route_core(
     ys = _run_wave_scan(
         physics, level_p, network.wf_idx, network.wf_mask, network.wf_buckets,
         T=T, n=n, depth=depth, qs=qs, xe=xe, se=se, has_ext=x_ext is not None,
-        q_init=q_init, discharge_lb=discharge_lb,
+        q_init=q_init, discharge_lb=discharge_lb, compute_dtype=dtype,
+        ring_rows=network.wf_ring_rows or None,
     )
     # Un-skew (static runs): x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L).
     raw = _skew_by_level_runs(ys, runs, lambda L: L, T)

@@ -126,12 +126,17 @@ def _time_compiled(call, warm_args, reps: int):
 def profile_programs(
     cfg, rd, q_prime, obs_daily, obs_mask, reps: int = 5,
     trace_dir: str | None = None,
+    kernel: str | None = None, dtype: str = "fp32",
 ) -> dict[str, dict[str, Any]]:
     """Card + time the three production programs for one batch.
 
     Returns ``{program: {"card": ProgramCard, "seconds_per_iter": s,
     "reach_timesteps_per_sec": r}}``. Every program is AOT-compiled exactly
     once and the card rides that same compile (no duplicate builds here).
+    ``kernel``/``dtype`` select the routing wave-scan implementation and
+    compute dtype (the fused-Pallas and bf16 axes of
+    :func:`ddr_tpu.routing.mc.route`) for the forward/VJP programs and are
+    stamped on every card.
     ``trace_dir`` wraps ONLY the timed iterations in ``jax.profiler``
     captures (one per program, same log dir) — a deep-topology compile can
     run minutes, and a capture dominated by compiler activity buries the
@@ -157,6 +162,10 @@ def profile_programs(
     from ddr_tpu.scripts.common import build_kan
     from ddr_tpu.training import make_batch_train_step, make_optimizer
 
+    from ddr_tpu.routing.pallas_kernel import resolve_kernel, validate_dtype
+
+    kernel = resolve_kernel(kernel)
+    validate_dtype(dtype)
     p = cfg.params
     bounds = Bounds.from_config(p.attribute_minimums)
     network, channels, gauges = prepare_batch(rd, p.attribute_minimums["slope"])
@@ -176,12 +185,14 @@ def profile_programs(
     # 1. forward route: spatial params + inflow -> gauge runoff
     fwd = jax.jit(
         lambda sp, qp: route(
-            network, channels, sp, qp, gauges=gauges, bounds=bounds
+            network, channels, sp, qp, gauges=gauges, bounds=bounds,
+            kernel=kernel, dtype=dtype,
         ).runoff
     )
     with span("profile/forward-route"):
         card, compiled = build_card(
-            fwd, spatial, q_prime_j, name="forward-route", engine=engine
+            fwd, spatial, q_prime_j, name="forward-route", engine=engine,
+            kernel=kernel, compute_dtype=dtype,
         )
         secs = _timed(lambda a: (a, compiled(*a)), (spatial, q_prime_j))
     out["forward-route"] = {"card": card, "seconds_per_iter": secs}
@@ -189,12 +200,16 @@ def profile_programs(
     # 2. full VJP: the training-path gradient through the routing adjoint
     def loss(sp):
         return route(
-            network, channels, sp, q_prime_j, gauges=gauges, bounds=bounds
+            network, channels, sp, q_prime_j, gauges=gauges, bounds=bounds,
+            kernel=kernel, dtype=dtype,
         ).runoff.mean()
 
     vjp = jax.jit(jax.value_and_grad(loss))
     with span("profile/full-vjp"):
-        card, compiled = build_card(vjp, spatial, name="full-vjp", engine=engine)
+        card, compiled = build_card(
+            vjp, spatial, name="full-vjp", engine=engine,
+            kernel=kernel, compute_dtype=dtype,
+        )
         secs = _timed(lambda a: (a, compiled(*a)), (spatial,))
     out["full-vjp"] = {"card": card, "seconds_per_iter": secs}
 
@@ -211,11 +226,14 @@ def profile_programs(
         tau=p.tau,
         warmup=cfg.experiment.warmup,
         optimizer=optimizer,
+        kernel=kernel,
+        dtype=dtype,
     )
     with span("profile/train-step"):
         card, compiled = build_card(
             step, kan_params, opt_state, network, channels, gauges, attrs,
             q_prime_j, obs_j, mask_j, name="train-step", engine=engine,
+            kernel=kernel, compute_dtype=dtype,
         )
 
         def _step_call(state):
@@ -310,16 +328,21 @@ def run_profile(
     trace_dir: Path | None = None,
     peak_flops: float | None = None,
     depth: int | None = None,
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ) -> dict[str, Any]:
     """Profile one batch's programs, emit their cards as events, and write
     ``profile_report.json`` + ``profile_report.md`` under ``out_dir``."""
     import jax
 
     from ddr_tpu.observability.costs import emit_program_card
+    from ddr_tpu.routing.pallas_kernel import resolve_kernel
 
+    kernel = resolve_kernel(kernel)  # the report records what actually RAN
     programs = profile_programs(
         cfg, rd, q_prime, obs_daily, obs_mask, reps,
         trace_dir=None if trace_dir is None else str(trace_dir),
+        kernel=kernel, dtype=dtype,
     )
     report: dict[str, Any] = {
         "device": str(jax.devices()[0].platform),
@@ -327,6 +350,8 @@ def run_profile(
         "t_hours": int(q_prime.shape[0]),
         "depth": depth,
         "reps": int(reps),
+        "kernel": kernel,
+        "compute_dtype": dtype,
         "peak_flops": peak_flops,
         "programs": {},
     }
@@ -383,6 +408,12 @@ def main(argv: list[str] | None = None) -> int:
                         "(written under <out>/profile_trace)")
     parser.add_argument("--peak-flops", type=float, default=None,
                         help="device peak FLOP/s, adds a %%-of-peak column")
+    parser.add_argument("--kernel", choices=("pallas", "xla"), default=None,
+                        help="routing wave-scan implementation (default: auto "
+                        "— pallas on TPU, xla elsewhere; docs/tpu.md)")
+    parser.add_argument("--dtype", choices=("fp32", "bf16"), default="fp32",
+                        help="routing compute dtype (bf16 = bf16-compute/"
+                        "fp32-accumulate ring; docs/tpu.md)")
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
@@ -416,6 +447,8 @@ def main(argv: list[str] | None = None) -> int:
             trace_dir=(out_dir / "profile_trace") if args.trace else None,
             peak_flops=args.peak_flops,
             depth=depth,
+            kernel=args.kernel,
+            dtype=args.dtype,
         )
     print(render_markdown(report), end="")
     log.info(f"profile report written to {out_dir / 'profile_report.json'}")

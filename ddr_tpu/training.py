@@ -210,9 +210,20 @@ def make_batch_train_step(
     collect_health: bool = False,
     donate: bool = True,
     q_prime_wf_permuted: bool = False,
+    kernel: str | None = None,
+    dtype: str = "fp32",
 ):
     """Like :func:`make_train_step` but with the network/channels/gauges as call-time
     arguments, so one jitted function serves every training batch.
+
+    ``kernel``/``dtype`` are the routing wave-scan implementation and compute
+    dtype (the fused-Pallas and bf16-compute/fp32-accumulate axes of
+    :func:`ddr_tpu.routing.mc.route`). With ``dtype="bf16"`` and
+    ``collect_health=True`` the returned health stats carry the
+    mixed-precision ``overflow``/``ulp_drift`` counters, so the watchdog's
+    ``DDR_HEALTH_MAX_OVERFLOW``/``DDR_HEALTH_MAX_ULP_DRIFT`` gates actually
+    bite on bf16 training runs (docs/tpu.md "Fused Pallas kernel & mixed
+    precision").
 
     ``jax.jit`` caches compilations by the pytrees' shapes and static fields
     (``RiverNetwork.n/depth/n_edges``, ``GaugeIndex.n_gauges``): repeated gauge
@@ -248,6 +259,7 @@ def make_batch_train_step(
             remat_bands=remat_bands and isinstance(network, StackedChunked),
             collect_health=collect_health,
             q_prime_permuted=q_prime_wf_permuted and single_ring_wavefront(network),
+            kernel=kernel, dtype=dtype,
         )
         loss, daily = masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
         if collect_health:

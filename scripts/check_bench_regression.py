@@ -31,7 +31,12 @@ when it DROPS, and the shed/reject/error rates follow the loadtest rules.
 
 Records from different devices are never compared as regressions: a CPU
 fallback round against a TPU round says nothing about the code, so a device
-mismatch downgrades every finding to informational.
+mismatch downgrades every finding to informational. Compute dtype pairs the
+same way: a bf16 bench record (``compute_dtype: "bf16"``, the
+mixed-precision routing ring) is only ever auto-baselined against the latest
+bf16 record and vice versa — records without the field (pre-dtype rounds)
+count as fp32 — and an explicit ``--baseline`` across dtypes downgrades every
+finding to informational, exactly like a device mismatch.
 
 Usage::
 
@@ -129,12 +134,25 @@ def is_loadtest_record(rec: dict) -> bool:
     return rec.get("kind") == "loadtest" or "p50_ms" in rec
 
 
+def record_dtype(rec: dict) -> str:
+    """A record's routing compute dtype; records predating the field are fp32
+    (every pre-dtype round ran the fp32 ring)."""
+    return str(rec.get("compute_dtype") or "fp32")
+
+
 def is_chaos_record(rec: dict) -> bool:
     """Whether a record is a ``ddr chaos`` report (kill-and-resume harness)."""
     return rec.get("kind") == "chaos"
 
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _bench_round_key(p: Path) -> tuple[int, str]:
+    """BENCH_r<NN> ordering: round number, ties by name (shared by the
+    generic and the dtype-paired baseline pickers)."""
+    m = re.match(r"BENCH_r(\d+)", p.name)
+    return (int(m.group(1)) if m else -1, p.name)
 
 
 def latest_baseline(
@@ -150,19 +168,35 @@ def latest_baseline(
     the repo root would otherwise self-select (a record is never its own
     baseline)."""
 
-    def round_of(p: Path) -> tuple[int, str]:
-        m = re.match(r"BENCH_r(\d+)", p.name)
-        return (int(m.group(1)) if m else -1, p.name)
-
     if pattern.startswith(("LOADTEST", "CHAOS")):
         key = lambda p: (p.stat().st_mtime, p.name)  # noqa: E731
     else:
-        key = round_of
+        key = _bench_round_key
     cands = sorted(root.glob(pattern), key=key)
     if exclude is not None:
         resolved = exclude.resolve()
         cands = [p for p in cands if p.resolve() != resolved]
     return cands[-1] if cands else None
+
+
+def latest_bench_baseline(
+    root: Path = REPO_ROOT, dtype: str = "fp32", exclude: Path | None = None
+) -> Path | None:
+    """The highest-round BENCH_r* record of the SAME compute dtype: a bf16
+    round gated against an fp32 baseline (or vice versa) measures the
+    precision knob, not the code — the finding the dtype axis exists to
+    separate. Unparseable candidates are skipped."""
+    cands = sorted(root.glob("BENCH_r*.json"), key=_bench_round_key, reverse=True)
+    resolved = exclude.resolve() if exclude is not None else None
+    for p in cands:
+        if resolved is not None and p.resolve() == resolved:
+            continue
+        try:
+            if record_dtype(load_record(p)) == dtype:
+                return p
+        except (ValueError, json.JSONDecodeError, OSError):
+            continue
+    return None
 
 
 def latest_chaos_baseline(
@@ -226,6 +260,10 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
         and baseline.get("device") is not None
         and fresh["device"] != baseline["device"]
     )
+    # a dtype mismatch (bf16 vs fp32 routing) measures the precision knob,
+    # not the code — downgrade exactly like a device mismatch
+    dtype_mismatch = record_dtype(fresh) != record_dtype(baseline)
+    device_mismatch = device_mismatch or dtype_mismatch
     smaller_is_better = MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS + CHAOS_DOWN_KEYS
     for key in (
         THROUGHPUT_KEYS + SERVING_UP_KEYS + RATIO_KEYS + smaller_is_better
@@ -281,7 +319,19 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
                     "info" if device_mismatch else "regression" if grew else "ok"
                 ),
             })
-    if device_mismatch:
+    if dtype_mismatch:
+        findings.insert(0, {
+            "key": "compute_dtype",
+            "fresh": record_dtype(fresh),
+            "baseline": record_dtype(baseline),
+            "ratio": None,
+            "status": "info",
+        })
+    if (
+        fresh.get("device") is not None
+        and baseline.get("device") is not None
+        and fresh["device"] != baseline["device"]
+    ):
         findings.insert(0, {
             "key": "device",
             "fresh": fresh["device"],
@@ -334,8 +384,10 @@ def main(argv: list[str] | None = None) -> int:
         pattern = "LOADTEST_*.json"
         found = latest_baseline(pattern=pattern, exclude=exclude)
     else:
-        pattern = "BENCH_r*.json"
-        found = latest_baseline(pattern=pattern, exclude=exclude)
+        # bench records pair by compute dtype: a bf16 round never gates
+        # against an fp32 baseline (and vice versa)
+        pattern = f"BENCH_r*.json [compute_dtype={record_dtype(fresh)}]"
+        found = latest_bench_baseline(dtype=record_dtype(fresh), exclude=exclude)
     baseline_path = Path(args.baseline) if args.baseline else found
     if baseline_path is None:
         print(f"check_bench_regression: no {pattern} baseline found", file=sys.stderr)

@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""CI guard: the fused Pallas wavefront kernel must import and run on CPU.
+
+The Pallas kernels (``ddr_tpu/routing/pallas_kernel.py``) compile only on a
+TPU backend, so nothing in an ordinary CPU run would notice bit-rot — an API
+drift in ``jax.experimental.pallas``, a stale table layout after a wavefront
+refactor — until the next chip session fails late. This script closes that
+gap the way ``check_event_schema.py`` closes the event-name gap: it imports
+the Pallas module and runs ONE interpreted wave scan on CPU
+(``pl.pallas_call(interpret=True)`` — the REAL kernel body under the Pallas
+interpreter), checking the fused forward against the XLA ``lax.scan``
+reference on a tiny 3-reach chain. Exit 0 on exact agreement, 1 otherwise.
+
+Run directly (CI) or via the test suite
+(tests/scripts/test_check_pallas_kernel.py):
+
+    JAX_PLATFORMS=cpu python scripts/check_pallas_kernel.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import numpy as np
+
+        from ddr_tpu.routing import pallas_kernel
+    except Exception as e:
+        print(f"check_pallas_kernel: import failed: {e!r}", file=sys.stderr)
+        return 1
+    if not pallas_kernel.pallas_available():
+        print("check_pallas_kernel: jax.experimental.pallas unavailable",
+              file=sys.stderr)
+        return 1
+
+    import jax.numpy as jnp
+
+    from ddr_tpu.routing.network import build_network
+    from ddr_tpu.routing.wavefront import _input_skews, _run_wave_scan
+
+    # 3-reach chain 0 -> 1 -> 2: two waves of real propagation, hotstart row,
+    # and at least one empty-history read per node
+    rows = np.array([1, 2], dtype=np.int64)
+    cols = np.array([0, 1], dtype=np.int64)
+    n, T = 3, 4
+    net = build_network(rows, cols, n)
+    lb = 1e-4
+    rng = np.random.default_rng(0)
+    qp = jnp.asarray(rng.uniform(0.0, 2.0, (T, n)).astype(np.float32))
+    qp_p = qp[:, np.asarray(net.wf_perm)]
+    level_p = net.level[net.wf_perm]
+    ones = jnp.ones(n, jnp.float32)
+
+    def physics(q_prev):
+        # Muskingum-shaped constants with a real q_prev dependence, so the
+        # kernel's physics replay path is exercised without the full chain
+        c = 0.5 + 0.1 * jnp.tanh(q_prev)
+        return 0.3 * c, 0.2 * c, 0.1 * ones, 0.4 * ones
+
+    qs, _, _ = _input_skews(qp_p, None, None, net.wf_level_runs, net.depth, T, n)
+    ys_ref = _run_wave_scan(
+        physics, level_p, net.wf_idx, net.wf_mask, net.wf_buckets,
+        T=T, n=n, depth=net.depth, qs=qs, xe=None, se=None, has_ext=False,
+        q_init=None, discharge_lb=lb,
+    )
+    row_len = n + 1
+    try:
+        ys_pal = pallas_kernel.fused_wave_scan(
+            physics, level_p, net.wf_idx // row_len, net.wf_idx % row_len,
+            net.wf_mask, net.wf_buckets, qs,
+            T=T, n=n, span=net.depth, lb=lb, interpret=True,
+        )
+    except Exception as e:
+        print(f"check_pallas_kernel: interpreted wave scan failed: {e!r}",
+              file=sys.stderr)
+        return 1
+    if not np.allclose(np.asarray(ys_ref), np.asarray(ys_pal), rtol=1e-6, atol=1e-7):
+        print(
+            "check_pallas_kernel: fused kernel diverged from the XLA scan:\n"
+            f"  xla    = {np.asarray(ys_ref).tolist()}\n"
+            f"  pallas = {np.asarray(ys_pal).tolist()}",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_pallas_kernel: fused kernel imports and one interpreted wave "
+          "scan matches the XLA reference")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -114,7 +114,7 @@ class TestRouteParallel:
         mesh, rd, channels, spatial, qp = self._problem(n=64, depth=None, T=2)
         from ddr_tpu.routing.mc import Bounds
 
-        key = _topology_key(rd, N_DEV, "gspmd", Bounds(), mesh)
+        key = _topology_key(rd, N_DEV, "gspmd", Bounds(), mesh, "auto", "fp32")
 
         def poisoned_plan(*a, **k):
             raise AssertionError("stale plan from a recycled mesh id was executed")
@@ -241,3 +241,47 @@ def test_route_parallel_accepts_scalar_spatial(tmp_path):
     res = route_parallel(make_mesh(N_DEV), rd, channels, spatial, qp, engine="gspmd")
     assert res.runoff.shape == (2, 21)
     assert np.isfinite(np.asarray(res.runoff)).all()
+
+
+class TestEngineAxes:
+    """The policy's kernel/dtype axes (resolve_engine_axes): honored on gspmd,
+    auto-fallback on the shard_map engines, explicit pallas/bf16 raises there."""
+
+    def test_gspmd_passes_kernel_through_unresolved(self):
+        """gspmd defers resolution to the route itself: whether pallas is
+        usable depends on the engine the built network actually runs (a
+        non-wavefront-eligible topology routes via the step engine, where
+        auto must stay a no-op)."""
+        from ddr_tpu.parallel.select import resolve_engine_axes
+
+        assert resolve_engine_axes("gspmd", None, "fp32") == (None, "fp32")
+        assert resolve_engine_axes("gspmd", "xla", "bf16") == ("xla", "bf16")
+        assert resolve_engine_axes("gspmd", "pallas", "fp32") == ("pallas", "fp32")
+        import pytest
+
+        with pytest.raises(ValueError, match="kernel"):
+            resolve_engine_axes("gspmd", "cuda", "fp32")
+
+    def test_shard_map_engines_auto_fall_back(self):
+        from ddr_tpu.parallel.select import resolve_engine_axes
+
+        for engine in ("sharded-wavefront", "stacked-sharded"):
+            assert resolve_engine_axes(engine, None, "fp32") == ("xla", "fp32")
+
+    def test_shard_map_engines_reject_explicit_pallas_and_bf16(self):
+        import pytest
+
+        from ddr_tpu.parallel.select import resolve_engine_axes
+
+        with pytest.raises(NotImplementedError, match="pallas"):
+            resolve_engine_axes("sharded-wavefront", "pallas", "fp32")
+        with pytest.raises(NotImplementedError, match="bf16"):
+            resolve_engine_axes("stacked-sharded", None, "bf16")
+
+    def test_bad_dtype_rejected(self):
+        import pytest
+
+        from ddr_tpu.parallel.select import resolve_engine_axes
+
+        with pytest.raises(ValueError, match="dtype"):
+            resolve_engine_axes("gspmd", None, "fp16")

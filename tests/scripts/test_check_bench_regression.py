@@ -127,6 +127,83 @@ class TestCostGrowth:
         assert mod.main([str(fresh), "--baseline", str(base)]) == 0  # warn only
 
 
+class TestDtypePairing:
+    """Records pair by routing compute dtype: a bf16 round never gates against
+    an fp32 baseline (and vice versa); records predating the field are fp32."""
+
+    def test_record_dtype_defaults_to_fp32(self):
+        mod = _load()
+        assert mod.record_dtype({}) == "fp32"
+        assert mod.record_dtype({"compute_dtype": None}) == "fp32"
+        assert mod.record_dtype({"compute_dtype": "bf16"}) == "bf16"
+
+    def test_latest_bench_baseline_pairs_by_dtype(self, tmp_path):
+        mod = _load()
+        r1 = tmp_path / "BENCH_r01.json"
+        r2 = tmp_path / "BENCH_r02.json"
+        r3 = tmp_path / "BENCH_r03.json"
+        r1.write_text(json.dumps({"device": "cpu", "value": 1.0}))  # pre-dtype = fp32
+        r2.write_text(json.dumps(
+            {"device": "cpu", "value": 2.0, "compute_dtype": "bf16"}
+        ))
+        r3.write_text(json.dumps(
+            {"device": "cpu", "value": 3.0, "compute_dtype": "fp32"}
+        ))
+        assert mod.latest_bench_baseline(tmp_path, dtype="fp32") == r3
+        assert mod.latest_bench_baseline(tmp_path, dtype="bf16") == r2
+        # an fp32 fresh record skips the newer bf16 round when r3 is excluded
+        assert mod.latest_bench_baseline(tmp_path, dtype="fp32", exclude=r3) == r1
+        assert mod.latest_bench_baseline(tmp_path, dtype="int8") is None
+
+    def test_latest_bench_baseline_skips_unparseable(self, tmp_path):
+        mod = _load()
+        (tmp_path / "BENCH_r09.json").write_text("not json at all")
+        good = tmp_path / "BENCH_r08.json"
+        good.write_text(json.dumps({"value": 1.0}))
+        assert mod.latest_bench_baseline(tmp_path, dtype="fp32") == good
+
+    def test_dtype_mismatch_downgrades_to_info(self):
+        """An explicit --baseline across dtypes measures the precision knob,
+        not the code — every finding downgrades like a device mismatch."""
+        mod = _load()
+        out = mod.compare(
+            {"device": "cpu", "value": 50.0, "compute_dtype": "bf16"},
+            {"device": "cpu", "value": 100.0},  # implicit fp32
+        )
+        assert all(f["status"] == "info" for f in out)
+        assert out[0]["key"] == "compute_dtype"
+
+    def test_same_dtype_compares_normally(self):
+        mod = _load()
+        out = mod.compare(
+            {"device": "cpu", "value": 50.0, "compute_dtype": "bf16"},
+            {"device": "cpu", "value": 100.0, "compute_dtype": "bf16"},
+        )
+        (f,) = out
+        assert f["key"] == "value" and f["status"] == "regression"
+
+    def test_cli_auto_baseline_selects_by_fresh_dtype(self, tmp_path, monkeypatch):
+        """main() asks the bench-baseline picker for the FRESH record's dtype."""
+        mod = _load()
+        base = tmp_path / "BENCH_r01.json"
+        base.write_text(json.dumps(
+            {"device": "cpu", "value": 100.0, "compute_dtype": "bf16"}
+        ))
+        calls: dict = {}
+
+        def stub(dtype, exclude=None):
+            calls["dtype"] = dtype
+            return base
+
+        monkeypatch.setattr(mod, "latest_bench_baseline", stub)
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(
+            {"device": "cpu", "value": 99.0, "compute_dtype": "bf16"}
+        ))
+        assert mod.main([str(fresh)]) == 0
+        assert calls["dtype"] == "bf16"
+
+
 class TestLoadtestRecords:
     """Serving-latency gating: ``ddr loadtest`` reports compare with the
     opposite polarities (latency/rates warn on GROWTH, throughput/attainment
@@ -352,6 +429,23 @@ def test_end_to_end_against_fresh_bench(tmp_path):
     # the new ratio field rides along whenever both throughputs measured
     if record.get("grad_value"):
         assert record.get("grad_over_forward_ratio")
+    # every measured phase must carry a non-null peak even on CPU (the
+    # compiled program's memory_analysis envelope fills what memory_stats
+    # cannot — BENCH_r05's peak_hbm_gb: null regression class)
+    for key, peak_key in (
+        ("value", "peak_hbm_gb"),
+        ("grad_value", "grad_peak_hbm_gb"),
+        ("deep_value", "deep_peak_hbm_gb"),
+        ("deep_grad_value", "deep_grad_peak_hbm_gb"),
+        ("train_value", "train_peak_hbm_gb"),
+    ):
+        if record.get(key) is not None:
+            assert record.get(peak_key) is not None, (peak_key, record)
+    # the probe-timeout and kernel/dtype axes are always recorded
+    assert record.get("probe_timeout_s") is not None
+    assert record.get("probe_timeout_s") <= 900
+    assert record.get("kernel") == "auto"
+    assert record.get("compute_dtype") == "fp32"
     fresh = tmp_path / "fresh.json"
     fresh.write_text(json.dumps(record) + "\n")
 

@@ -1,0 +1,44 @@
+"""scripts/check_pallas_kernel.py: the fused-kernel smoke gate must pass on a
+clean tree (so Pallas bit-rot fails tier-1 fast) and actually catch breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_pallas_kernel.py"
+
+
+def test_repo_kernel_smokes_clean():
+    """THE CI gate: the Pallas module imports and one interpreted wave scan on
+    CPU matches the XLA reference."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "matches the XLA reference" in proc.stdout
+
+
+def test_gate_fails_on_broken_kernel(tmp_path):
+    """A tree whose pallas module cannot import must fail the gate — copy the
+    script next to a stub package with a broken pallas_kernel."""
+    pkg = tmp_path / "ddr_tpu" / "routing"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "pallas_kernel.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_pallas_kernel.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_pallas_kernel.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr
