@@ -38,8 +38,15 @@ from ddr_tpu.observability.faults import (
     maybe_inject,
     parse_faults,
 )
-from ddr_tpu.observability.health import HealthConfig, HealthStats, HealthWatchdog
+from ddr_tpu.observability.drift import DriftTracker
+from ddr_tpu.observability.health import (
+    HealthConfig,
+    HealthStats,
+    HealthWatchdog,
+    ReachStats,
+)
 from ddr_tpu.observability.preempt import PreemptionHandler
+from ddr_tpu.observability.skill import SkillConfig, SkillTracker
 from ddr_tpu.observability.phases import STEP_PHASES, PhaseTimer, summarize_phases
 from ddr_tpu.observability.prometheus import (
     event_tee,
@@ -104,6 +111,10 @@ __all__ = [
     "HealthConfig",
     "HealthStats",
     "HealthWatchdog",
+    "ReachStats",
+    "SkillConfig",
+    "SkillTracker",
+    "DriftTracker",
     "SloConfig",
     "SloTracker",
     "attainment_from_events",

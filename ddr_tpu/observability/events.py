@@ -76,7 +76,12 @@ __all__ = [
 #: SIGTERM/SIGINT drain + emergency save
 #: (:mod:`ddr_tpu.observability.preempt`); ``chaos`` is one
 #: kill/restart/recovery marker from the ``ddr chaos`` verification harness
-#: (:mod:`ddr_tpu.scripts.chaos`).
+#: (:mod:`ddr_tpu.scripts.chaos`). ``skill`` is one per-gauge hydrologic-skill
+#: update (bounded summary + worst-K gauges,
+#: :mod:`ddr_tpu.observability.skill`); ``drift`` is one parameter-field
+#: distribution snapshot (quantiles, OOB counts, drift-vs-reference index,
+#: :mod:`ddr_tpu.observability.drift`); ``audit`` is one ``ddr audit`` report
+#: marker (:mod:`ddr_tpu.scripts.audit`).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -94,6 +99,9 @@ EVENT_TYPES = (
     "fault",
     "preempt",
     "chaos",
+    "skill",
+    "drift",
+    "audit",
 )
 
 

@@ -44,10 +44,15 @@ log = logging.getLogger(__name__)
 
 __all__ = [
     "HealthStats",
+    "ReachStats",
     "HealthConfig",
     "HealthWatchdog",
     "compute_health",
     "compute_health_host",
+    "assemble_reach_stats",
+    "compute_reach_stats",
+    "compute_band_health",
+    "compute_output_worst",
 ]
 
 
@@ -75,14 +80,65 @@ class HealthStats:
     # which is what DDR_HEALTH_MAX_ULP_DRIFT gates training on.
     overflow: Any = None
     ulp_drift: Any = None
+    # Spatial attribution (the per-band segment reductions of
+    # :func:`compute_band_health`, riding the same compiled program) — None
+    # unless the route was asked for band health. All bounded-size: (B,) per
+    # level-band arrays with B = the requested band count (<= depth + 1), and
+    # (K,) top-K worst-reach selections. ``band_residual`` is the per-band
+    # mass residual with the same caveat as the global one (routed discharge
+    # accumulates downstream, so downstream bands legitimately run out >> in;
+    # the per-band ratio is stable across healthy windows for a fixed
+    # topology, and a solve blow-up moves exactly the bands that host it).
+    band_nonfinite: Any = None  # (B,) int32 non-finite entries per band
+    band_q_min: Any = None  # (B,) min finite discharge per band
+    band_q_max: Any = None  # (B,) max finite discharge per band
+    band_residual: Any = None  # (B,) per-band mass residual
+    band_overflow: Any = None  # (B,) int32 bf16 overflows per band (bf16 only)
+    band_ulp_drift: Any = None  # (B,) |band residual| in bf16 ULPs (bf16 only)
+    # On-device top-K worst-reach selection: indices in the route's ORIGINAL
+    # node order, scored by (non-finite count, then max |discharge|) — the
+    # reaches a human should look at first. For the serving layer the same
+    # fields carry the worst OUTPUT columns (gauges) instead.
+    worst_idx: Any = None  # (K,) int32
+    worst_score: Any = None  # (K,) float32 (see compute_band_health)
 
+
+@dataclasses.dataclass(frozen=True)
+class ReachStats:
+    """Per-reach time-reduced route statistics, ORIGINAL node order, (N,) each.
+
+    The intermediate between an engine's materialized per-reach discharge and
+    the bounded :class:`HealthStats` band fields: every wavefront-family
+    engine already holds its full (T, N) solve values (the step engine
+    accumulates these reductions in its scan carry instead), so reducing over
+    time per reach is a handful of fused (N,) reductions. ``ReachStats``
+    itself never crosses to the host — :func:`compute_band_health` collapses
+    it to (B,)/(K,) before the route returns.
+
+    ``nonfinite`` counts non-finite entries of both the per-reach discharge
+    and the lateral inflow column; ``out_mass``/``in_mass`` are the finite
+    sums whose per-band ratio is the band residual.
+    """
+
+    nonfinite: Any  # (N,) int32
+    q_min: Any  # (N,) min finite discharge over the window
+    q_max: Any  # (N,) max finite discharge over the window
+    out_mass: Any  # (N,) finite discharge sum over the window
+    in_mass: Any  # (N,) finite lateral-inflow sum over the window
+    overflow: Any = None  # (N,) int32 bf16-overflow entries (bf16 batches)
+
+
+_BAND_FIELDS = (
+    "band_nonfinite", "band_q_min", "band_q_max", "band_residual",
+    "band_overflow", "band_ulp_drift", "worst_idx", "worst_score",
+)
 
 _REGISTERED = False
 _REGISTER_LOCK = threading.Lock()
 
 
 def _ensure_registered() -> None:
-    """Register :class:`HealthStats` as a jax pytree dataclass exactly once.
+    """Register the health dataclasses as jax pytrees exactly once.
     Lazy so importing this module never imports jax (package contract)."""
     global _REGISTERED
     if _REGISTERED:
@@ -95,7 +151,13 @@ def _ensure_registered() -> None:
         jax.tree_util.register_dataclass(
             HealthStats,
             data_fields=["nonfinite", "q_min", "q_max", "mass_residual",
-                         "grad_norm", "overflow", "ulp_drift"],
+                         "grad_norm", "overflow", "ulp_drift", *_BAND_FIELDS],
+            meta_fields=[],
+        )
+        jax.tree_util.register_dataclass(
+            ReachStats,
+            data_fields=["nonfinite", "q_min", "q_max", "out_mass", "in_mass",
+                         "overflow"],
             meta_fields=[],
         )
         _REGISTERED = True
@@ -203,6 +265,195 @@ def compute_health_host(runoff: Any, q_prime: Any | None = None) -> HealthStats:
     )
 
 
+# ---------------------------------------------------------------------------
+# Spatial attribution: per-reach time reductions -> per-band segment
+# reductions + on-device top-K worst-reach selection. Everything here runs
+# INSIDE the compiled program (same contract as compute_health): a few fused
+# (N,)/(B,) reductions riding outputs the program already materialized, a
+# bounded pytree of (B,)/(K,) scalars back to the host, zero new programs.
+# ---------------------------------------------------------------------------
+
+
+def compute_reach_stats(
+    runoff: Any,
+    q_prime: Any,
+    compute_dtype: str = "fp32",
+    runoff_inv: Any | None = None,
+    q_prime_inv: Any | None = None,
+) -> ReachStats:
+    """Time-reduce a (T, N) per-reach discharge field + its (T, N) lateral
+    inflow into :class:`ReachStats`. ``runoff_inv``/``q_prime_inv`` map each
+    array's column order back to ORIGINAL node order (the wavefront engines
+    materialize their solves in wf/band order; one (N,) gather each puts every
+    engine's stats on the same axis so band reductions agree across engines).
+    """
+    import jax.numpy as jnp
+
+    _ensure_registered()
+    runoff = jnp.asarray(runoff)
+    qp = jnp.asarray(q_prime)
+    big = jnp.asarray(jnp.finfo(runoff.dtype).max, runoff.dtype)
+
+    finite = jnp.isfinite(runoff)
+    nf = jnp.sum(~finite, axis=0).astype(jnp.int32)
+    q_min = jnp.min(jnp.where(finite, runoff, big), axis=0)
+    q_max = jnp.max(jnp.where(finite, runoff, -big), axis=0)
+    out_mass = jnp.sum(jnp.where(finite, runoff, 0.0), axis=0)
+    qp_finite = jnp.isfinite(qp)
+    nf_qp = jnp.sum(~qp_finite, axis=0).astype(jnp.int32)
+    in_mass = jnp.sum(jnp.where(qp_finite, qp, 0.0), axis=0)
+    overflow = None
+    if compute_dtype == "bf16":
+        bf16_max = float(jnp.finfo(jnp.bfloat16).max)
+        overflow = jnp.sum(jnp.abs(runoff) > bf16_max, axis=0).astype(jnp.int32)
+
+    def _inv(a, inv):
+        return a if inv is None else a[inv]
+
+    nf = _inv(nf, runoff_inv)
+    return ReachStats(
+        nonfinite=nf + _inv(nf_qp, q_prime_inv),
+        q_min=_inv(q_min, runoff_inv),
+        q_max=_inv(q_max, runoff_inv),
+        out_mass=_inv(out_mass, runoff_inv),
+        in_mass=_inv(in_mass, q_prime_inv),
+        overflow=_inv(overflow, runoff_inv) if overflow is not None else None,
+    )
+
+
+def assemble_reach_stats(
+    nonfinite: Any,
+    q_min: Any,
+    q_max: Any,
+    out_mass: Any,
+    q_prime: Any,
+    compute_dtype: str = "fp32",
+    inv: Any | None = None,
+    q_prime_inv: Any | None = None,
+    overflow: Any = None,
+) -> ReachStats:
+    """:class:`ReachStats` from ALREADY-accumulated per-reach reductions —
+    the step engine's scan-carry path, where the full (T, N) field never
+    materializes. The lateral-inflow half is reduced here (``q_prime`` is a
+    program input, always materialized); ``inv``/``q_prime_inv`` re-align the
+    discharge and inflow column orders to original node order as in
+    :func:`compute_reach_stats`. ``compute_dtype`` is accepted for signature
+    symmetry (the step engine has no bf16 variant, so ``overflow`` is
+    normally None)."""
+    import jax.numpy as jnp
+
+    _ensure_registered()
+    qp = jnp.asarray(q_prime)
+    qp_finite = jnp.isfinite(qp)
+    nf_qp = jnp.sum(~qp_finite, axis=0).astype(jnp.int32)
+    in_mass = jnp.sum(jnp.where(qp_finite, qp, 0.0), axis=0)
+
+    def _inv(a, iv):
+        return a if iv is None else a[iv]
+
+    return ReachStats(
+        nonfinite=_inv(jnp.asarray(nonfinite, jnp.int32), inv)
+        + _inv(nf_qp, q_prime_inv),
+        q_min=_inv(q_min, inv),
+        q_max=_inv(q_max, inv),
+        out_mass=_inv(out_mass, inv),
+        in_mass=_inv(in_mass, q_prime_inv),
+        overflow=_inv(overflow, inv) if overflow is not None else None,
+    )
+
+
+#: Worst-reach score offset for non-finite entries: any reach with a NaN/Inf
+#: outranks every finite-but-extreme one (float32-representable, and counts
+#: still order among themselves below the inf threshold).
+_WORST_NONFINITE_WEIGHT = 1e30
+
+
+def _worst_score(nonfinite: Any, q_max: Any) -> Any:
+    """The worst-reach ranking: non-finite count first, |max discharge| as the
+    tiebreak — a reach whose solve exploded to 1e12 ranks just below one that
+    went NaN, and both rank above the healthy mainstem."""
+    import jax.numpy as jnp
+
+    mag = jnp.where(
+        jnp.isfinite(q_max), jnp.abs(q_max), _WORST_NONFINITE_WEIGHT
+    ).astype(jnp.float32)
+    return nonfinite.astype(jnp.float32) * _WORST_NONFINITE_WEIGHT + mag
+
+
+def compute_band_health(
+    reach: ReachStats,
+    band_ids: Any,
+    n_bands: int,
+    top_k: int = 8,
+    compute_dtype: str = "fp32",
+) -> dict[str, Any]:
+    """Collapse :class:`ReachStats` to the bounded :class:`HealthStats` band
+    fields: per-band (``band_ids``: (N,) int32, values in [0, n_bands)) sums /
+    extrema / mass residual, plus the on-device top-K worst-reach selection.
+    Returns the field dict for ``dataclasses.replace`` on a
+    :class:`HealthStats`. ``n_bands``/``top_k`` are static (they size the
+    returned arrays); callers derive band ids from the network's level field
+    so every engine attributes to the same bands.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    band_ids = jnp.asarray(band_ids, jnp.int32)
+    seg_sum = lambda x: jax.ops.segment_sum(x, band_ids, num_segments=n_bands)  # noqa: E731
+    band_nf = seg_sum(reach.nonfinite).astype(jnp.int32)
+    band_q_min = jax.ops.segment_min(reach.q_min, band_ids, num_segments=n_bands)
+    band_q_max = jax.ops.segment_max(reach.q_max, band_ids, num_segments=n_bands)
+    out_b = seg_sum(reach.out_mass)
+    in_b = seg_sum(reach.in_mass)
+    band_residual = (out_b - in_b) / (jnp.abs(in_b) + 1e-6)
+    out: dict[str, Any] = {
+        "band_nonfinite": band_nf,
+        "band_q_min": band_q_min,
+        "band_q_max": band_q_max,
+        "band_residual": band_residual,
+    }
+    if compute_dtype == "bf16" and reach.overflow is not None:
+        out["band_overflow"] = seg_sum(reach.overflow).astype(jnp.int32)
+        out["band_ulp_drift"] = jnp.abs(band_residual) / float(
+            jnp.finfo(jnp.bfloat16).eps
+        )
+    if top_k > 0:
+        k = min(int(top_k), int(reach.q_max.shape[0]))
+        score, idx = jax.lax.top_k(_worst_score(reach.nonfinite, reach.q_max), k)
+        out["worst_idx"] = idx.astype(jnp.int32)
+        out["worst_score"] = score
+    return out
+
+
+def compute_output_worst(
+    values: Any, top_k: int, row_mask: Any | None = None
+) -> tuple[Any, Any]:
+    """Top-K worst OUTPUT columns of a (..., G) field — the serving layer's
+    worst-gauge selection (its output axis is gauges, not reaches). Reduces
+    every leading axis (``row_mask`` drops padded batch rows first), scores
+    columns like :func:`_worst_score`, returns ``(worst_idx, worst_score)``
+    each (K,). Rides the compiled serve program like compute_health does."""
+    import jax
+    import jax.numpy as jnp
+
+    v = jnp.asarray(values)
+    if row_mask is not None:
+        m = jnp.asarray(row_mask, bool).reshape(
+            jnp.asarray(row_mask).shape + (1,) * (v.ndim - jnp.ndim(row_mask))
+        )
+        valid = jnp.broadcast_to(m, v.shape)
+    else:
+        valid = jnp.ones(v.shape, bool)
+    axes = tuple(range(v.ndim - 1))
+    finite = jnp.isfinite(v) & valid
+    nf = jnp.sum(~jnp.isfinite(v) & valid, axis=axes).astype(jnp.int32)
+    big = jnp.asarray(jnp.finfo(v.dtype).max, v.dtype)
+    q_max = jnp.max(jnp.where(finite, v, -big), axis=axes)
+    k = min(int(top_k), int(v.shape[-1]))
+    score, idx = jax.lax.top_k(_worst_score(nf, q_max), k)
+    return idx.astype(jnp.int32), score
+
+
 _ENV_PREFIX = "DDR_HEALTH_"
 _FALSEY = ("0", "false", "no", "off")
 
@@ -243,6 +494,24 @@ class HealthConfig:
     #: with healthy last-known numbers and no new batches. Calibrate to a
     #: few multiples of the expected step cadence.
     max_stall_s: float = math.inf
+    #: Spatial attribution: level-band count for the per-band segment
+    #: reductions (DDR_HEALTH_BANDS; 0 disables — the pre-spatial behavior).
+    #: Bands partition the topology's longest-path levels into this many
+    #: equal-width groups, so a violation localizes to "band 12 of 16" — a
+    #: sub-basin slice — instead of "somewhere". Capped at depth + 1.
+    bands: int = 0
+    #: On-device top-K worst-reach (serving: worst-gauge) selection size
+    #: (DDR_HEALTH_TOPK; 0 disables the selection).
+    top_k: int = 8
+    #: Parameter-field drift-index ceiling per epoch
+    #: (DDR_HEALTH_MAX_PARAM_DRIFT; inf = off). The drift tracker
+    #: (:mod:`ddr_tpu.observability.drift`) flags the watchdog when any KAN
+    #: parameter field's quantile profile moves more than this fraction of
+    #: its reference span — the "parameters blew up between epochs" signal.
+    max_param_drift: float = math.inf
+    #: Out-of-physical-bounds parameter entries tolerated per field per epoch
+    #: (DDR_HEALTH_MAX_PARAM_OOB; inf = off).
+    max_param_oob: float = math.inf
 
     def __post_init__(self) -> None:
         if self.bad_batches < 1:
@@ -253,6 +522,10 @@ class HealthConfig:
             raise ValueError(f"max_overflow must be >= 0, got {self.max_overflow}")
         if self.max_stall_s <= 0:
             raise ValueError(f"max_stall_s must be > 0, got {self.max_stall_s}")
+        if self.bands < 0:
+            raise ValueError(f"bands must be >= 0, got {self.bands}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
 
     @classmethod
     def from_env(cls, environ: dict | None = None, **overrides) -> "HealthConfig":
@@ -280,6 +553,10 @@ class HealthConfig:
             ("max_ulp_drift", "MAX_ULP_DRIFT", float),
             ("bad_batches", "BAD_BATCHES", int),
             ("max_stall_s", "MAX_STALL_S", float),
+            ("bands", "BANDS", int),
+            ("top_k", "TOPK", int),
+            ("max_param_drift", "MAX_PARAM_DRIFT", float),
+            ("max_param_oob", "MAX_PARAM_OOB", float),
         ):
             v = _get(var, cast)
             if v is not None:
@@ -304,7 +581,12 @@ class HealthWatchdog:
         self._consecutive = 0
         self._batches = 0
         self._violations = 0
+        # externally-flagged violations (HealthWatchdog.flag) run on their
+        # own consecutive counter: healthy BATCHES between epoch-end drift
+        # checks must not clear a drifting-parameters streak
+        self._consecutive_flagged = 0
         self._last_reasons: list[str] = []
+        self._last_spatial: dict[str, Any] | None = None
         # staleness clock: starts at construction so a run whose FIRST batch
         # hangs (stuck warmup collective) also trips the stall ceiling
         self._last_observe = time.monotonic()
@@ -343,32 +625,63 @@ class HealthWatchdog:
             drift = float(stats.ulp_drift)
             if not math.isfinite(drift) or drift > cfg.max_ulp_drift:
                 reasons.append("ulp-drift")
+        if stats.band_nonfinite is not None:
+            # the per-reach view can catch non-finites the gauge-aggregated
+            # global stats never see (an exploding UNGAUGED reach)
+            if int(sum(int(v) for v in stats.band_nonfinite)) > cfg.max_nonfinite:
+                if "non-finite" not in reasons:
+                    reasons.append("non-finite")
         return reasons
+
+    @staticmethod
+    def spatial_summary(stats: HealthStats) -> dict[str, Any] | None:
+        """The bounded host-side slice of a batch's spatial attribution —
+        what rides `health` events and /v1/stats. None when the stats carry
+        no band/worst fields (spatial attribution off)."""
+        import numpy as np
+
+        out: dict[str, Any] = {}
+        if stats.band_residual is not None:
+            band_res = np.asarray(stats.band_residual, dtype=np.float64)
+            band_nf = np.asarray(stats.band_nonfinite, dtype=np.int64)
+            finite = np.where(np.isfinite(band_res), np.abs(band_res), np.inf)
+            out["worst_band"] = int(np.argmax(band_nf * 1e30 + finite))
+            out["band_nonfinite"] = [int(v) for v in band_nf]
+            out["band_residual"] = [round(float(v), 6) for v in band_res]
+            out["band_q_max"] = [
+                round(float(v), 4) for v in np.asarray(stats.band_q_max)
+            ]
+            if stats.band_ulp_drift is not None:
+                out["band_ulp_drift"] = [
+                    round(float(v), 3) for v in np.asarray(stats.band_ulp_drift)
+                ]
+        if stats.worst_idx is not None:
+            out["worst_idx"] = [int(v) for v in np.asarray(stats.worst_idx)]
+            out["worst_score"] = [
+                round(float(v), 4) for v in np.asarray(stats.worst_score)
+            ]
+        return out or None
 
     def observe(self, stats: HealthStats, **context: Any) -> list[str]:
         """Threshold one batch's stats; returns the violation reasons (empty =
         healthy). A violating batch emits exactly ONE ``health`` telemetry
-        event (reasons + values + ``context``), bumps the violation counters,
-        and flips ``ddr_health_status`` to 0; a healthy batch resets the
-        consecutive counter and flips the gauge back to 1."""
+        event (reasons + values + spatial attribution + ``context``), bumps
+        the violation counters, and flips ``ddr_health_status`` to 0; a
+        healthy batch resets the consecutive counter and flips the gauge back
+        to 1. Spatial fields (band reductions / worst reaches) are remembered
+        on every batch — healthy or not — so /v1/stats always shows the last
+        known worst-band/worst-gauge slice."""
         if not self.config.enabled:
             return []
         reasons = self.check(stats)
+        spatial = self.spatial_summary(stats)
         with self._lock:
-            self._last_observe = time.monotonic()
-            self._batches += 1
-            if reasons:
-                self._consecutive += 1
-                self._violations += 1
-            else:
-                self._consecutive = 0
-            self._last_reasons = reasons
-            consecutive = self._consecutive
-        self._gauge.set(0.0 if reasons else 1.0)
+            if spatial is not None:
+                self._last_spatial = spatial
+        consecutive = self._note(reasons)
         if not reasons:
             return reasons
         payload = {
-            "reasons": reasons,
             "nonfinite": int(stats.nonfinite),
             "q_min": float(stats.q_min),
             "q_max": float(stats.q_max),
@@ -382,6 +695,60 @@ class HealthWatchdog:
             payload["overflow"] = int(stats.overflow)
         if stats.ulp_drift is not None:
             payload["ulp_drift"] = float(stats.ulp_drift)
+        if spatial is not None:
+            payload.update(spatial)
+        self._report(reasons, payload)
+        return reasons
+
+    def flag(self, reasons: list[str], **context: Any) -> list[str]:
+        """Fold an EXTERNALLY-detected violation (the drift tracker's
+        parameter blow-ups, anything host-side that thresholded outside
+        :meth:`check`) into the same gauge and ``health`` event stream as an
+        in-batch violation — so `bad_batches` consecutive parameter-drift
+        epochs degrade /readyz exactly like solve NaNs do.
+
+        External flags keep their OWN consecutive counter: per-batch
+        :meth:`observe` calls must not reset it (healthy solve batches land
+        between epoch-end drift flags by construction), and a flag must not
+        count as an observed batch. An empty ``reasons`` list CLEARS the
+        flagged run (the external checker's "healthy again" signal) — call
+        it every check, not only on violations."""
+        if not self.config.enabled:
+            return []
+        reasons = list(reasons)
+        with self._lock:
+            if reasons:
+                self._consecutive_flagged += 1
+                self._violations += 1
+                self._last_reasons = reasons
+            else:
+                self._consecutive_flagged = 0
+            consecutive = self._consecutive_flagged
+        if not reasons:
+            return []
+        self._gauge.set(0.0)
+        self._report(reasons, {"consecutive": consecutive, **context})
+        return reasons
+
+    def _note(self, reasons: list[str]) -> int:
+        """Shared counter/gauge bookkeeping for one observation."""
+        with self._lock:
+            self._last_observe = time.monotonic()
+            self._batches += 1
+            if reasons:
+                self._consecutive += 1
+                self._violations += 1
+            else:
+                self._consecutive = 0
+            self._last_reasons = reasons
+            consecutive = self._consecutive
+        self._gauge.set(0.0 if reasons else 1.0)
+        return consecutive
+
+    def _report(self, reasons: list[str], payload: dict[str, Any]) -> None:
+        """Emit the one ``health`` event (or tee it registry-only when no
+        recorder is active) for a violating observation."""
+        payload = {"reasons": reasons, **payload}
         from ddr_tpu.observability.events import get_recorder
         from ddr_tpu.observability.prometheus import event_tee
 
@@ -397,7 +764,6 @@ class HealthWatchdog:
             f"numerical health violation ({', '.join(reasons)}): "
             + " ".join(f"{k}={v}" for k, v in payload.items() if k != "reasons")
         )
-        return reasons
 
     # ---- state ----
 
@@ -426,13 +792,17 @@ class HealthWatchdog:
 
     @property
     def degraded(self) -> bool:
-        """True after ``bad_batches`` consecutive violations OR a wall-clock
-        stall — the serving layer's /readyz -> 503 signal. A single healthy
-        batch clears both."""
+        """True after ``bad_batches`` consecutive violations (in-batch OR
+        externally flagged) or a wall-clock stall — the serving layer's
+        /readyz -> 503 signal. A healthy batch clears the in-batch run; an
+        empty :meth:`flag` call clears the flagged run."""
         if self.stale:
             return True
         with self._lock:
-            return self._consecutive >= self.config.bad_batches
+            return (
+                max(self._consecutive, self._consecutive_flagged)
+                >= self.config.bad_batches
+            )
 
     def status(self) -> dict[str, Any]:
         """Rollup for /v1/stats and run_end summaries."""
@@ -443,8 +813,15 @@ class HealthWatchdog:
                 "batches": self._batches,
                 "violations": self._violations,
                 "consecutive_bad": self._consecutive,
-                "degraded": stale or self._consecutive >= self.config.bad_batches,
+                "consecutive_flagged": self._consecutive_flagged,
+                "degraded": stale
+                or max(self._consecutive, self._consecutive_flagged)
+                >= self.config.bad_batches,
                 "stale": stale,
                 "staleness_s": round(max(0.0, time.monotonic() - self._last_observe), 3),
                 "last_reasons": list(self._last_reasons),
+                # the last observed spatial attribution (worst band / worst
+                # reaches-or-gauges), healthy batches included — the
+                # /v1/stats "where is it worst" slice
+                "spatial": self._last_spatial,
             }

@@ -31,7 +31,10 @@ import time
 from pathlib import Path
 from typing import Any
 
-__all__ = ["main", "load_events", "summarize", "tail", "follow", "detect_stalls"]
+__all__ = [
+    "main", "load_events", "summarize", "tail", "follow", "detect_stalls",
+    "aggregate_spatial_health",
+]
 
 #: Default stall threshold: a run whose newest step/heartbeat is older than
 #: this many times its observed cadence is flagged (a hung collective looks
@@ -223,6 +226,8 @@ def summarize(
     _summarize_serving(by_type, w)
     _summarize_slo(by_type, end, w)
     _summarize_health(by_type, end, w)
+    _summarize_skill(by_type, end, w)
+    _summarize_spatial(by_type, end, w)
 
     evals = by_type.get("eval", [])
     if evals:
@@ -514,6 +519,153 @@ def _summarize_health(by_type: dict[str, list[dict]], end: dict, w) -> None:
     if rollup.get("degraded"):
         w("           DEGRADED at run end "
           f"(consecutive_bad {rollup.get('consecutive_bad')})\n")
+
+
+def _summarize_skill(by_type: dict[str, list[dict]], end: dict, w) -> None:
+    """The hydrologic-skill section: ``skill`` events carry CUMULATIVE
+    per-gauge NSE/KGE/percent-bias summaries (ddr_tpu.observability.skill),
+    so the LAST event (or the run_end rollup when present) is the run's
+    state; worst-K gauges render as a table."""
+    events = by_type.get("skill", [])
+    rollup = (end.get("summary") or {}).get("skill") or {}
+    last = rollup if rollup.get("nse") else (events[-1] if events else None)
+    if not last:
+        return
+    nse = last.get("nse") or {}
+    kge = last.get("kge") or {}
+    pbias = last.get("pbias") or {}
+
+    def _f(v, pct=False):
+        if v is None:
+            return "?"
+        return f"{100 * float(v):.0f}%" if pct else f"{float(v):.3f}"
+
+    w(
+        f"skill    : {last.get('scored', '?')}/{last.get('gauges', '?')} gauges "
+        f"scored — NSE median {_f(nse.get('median'))} "
+        f"(p10 {_f(nse.get('p10'))}, {_f(nse.get('frac_positive'), pct=True)} > 0)"
+        f"   KGE median {_f(kge.get('median'))}"
+        f"   |pbias| median {_f(pbias.get('median_abs'))}\n"
+    )
+    worst = last.get("worst") or []
+    if worst:
+        rows = [
+            [str(g.get("gauge")), _f(g.get("nse")), _f(g.get("kge")),
+             "?" if g.get("pbias") is None else f"{float(g['pbias']):.1f}"]
+            for g in worst
+        ]
+        w("worst gauges (by NSE):\n" + _table(rows, ["gauge", "nse", "kge", "pbias"]) + "\n")
+
+
+def aggregate_spatial_health(
+    health_events: list[dict],
+) -> tuple[dict[int, dict], dict[int, int]]:
+    """Fold ``health`` events' spatial payloads into per-band extrema and a
+    worst-reach frequency map — THE one aggregation both ``ddr metrics
+    summarize`` and ``ddr audit``'s replay mode render (two renderers, one
+    fold, so they cannot disagree about which band is worst).
+
+    Returns ``(bands, reaches)``: ``bands[b]`` holds ``max_abs_residual``,
+    ``nonfinite`` (max per event), ``max_ulp``, ``worst_count`` (how often b
+    was the event's worst band); ``reaches[r]`` counts worst-set appearances.
+    Events without band payloads contribute nothing; malformed values are
+    skipped, never fatal (a run killed mid-write must still aggregate)."""
+    bands: dict[int, dict] = {}
+    reaches: dict[int, int] = {}
+
+    def _slot(b: int) -> dict:
+        return bands.setdefault(
+            b, {"max_abs_residual": 0.0, "nonfinite": 0, "worst_count": 0,
+                "max_ulp": 0.0},
+        )
+
+    for e in health_events:
+        if not e.get("band_residual"):
+            continue
+        for b, v in enumerate(e.get("band_residual") or []):
+            try:
+                slot = _slot(b)
+                slot["max_abs_residual"] = max(slot["max_abs_residual"], abs(float(v)))
+            except (TypeError, ValueError):
+                continue
+        for b, v in enumerate(e.get("band_nonfinite") or []):
+            try:
+                _slot(b)["nonfinite"] = max(_slot(b)["nonfinite"], int(v))
+            except (TypeError, ValueError):
+                continue
+        for b, v in enumerate(e.get("band_ulp_drift") or []):
+            try:
+                _slot(b)["max_ulp"] = max(_slot(b)["max_ulp"], float(v))
+            except (TypeError, ValueError):
+                continue
+        wb = e.get("worst_band")
+        if wb is not None:
+            _slot(int(wb))["worst_count"] += 1
+        for r in e.get("worst_idx") or []:
+            try:
+                reaches[int(r)] = reaches.get(int(r), 0) + 1
+            except (TypeError, ValueError):
+                continue
+    return bands, reaches
+
+
+def _summarize_spatial(by_type: dict[str, list[dict]], end: dict, w) -> None:
+    """The spatial-health section: per-band attribution riding ``health``
+    events (worst band by frequency + residual extrema,
+    ddr_tpu.observability.health band fields) and the last ``drift`` event's
+    per-parameter-field state (ddr_tpu.observability.drift)."""
+    health = [e for e in by_type.get("health", []) if e.get("band_residual")]
+    drifts = by_type.get("drift", [])
+    if not health and not drifts:
+        return
+    if health:
+        bands, reaches = aggregate_spatial_health(health)
+        w(f"spatial  : {len(health)} violating batches carried band attribution\n")
+        ranked = sorted(
+            bands,
+            key=lambda b: (bands[b]["nonfinite"], bands[b]["worst_count"],
+                           bands[b]["max_abs_residual"]),
+            reverse=True,
+        )[:8]
+        rows = [
+            [
+                f"band{b}",
+                str(bands[b]["nonfinite"]),
+                _fmt(bands[b]["max_abs_residual"]),
+                _fmt(bands[b]["max_ulp"]) if bands[b]["max_ulp"] else "-",
+                str(bands[b]["worst_count"]),
+            ]
+            for b in ranked
+        ]
+        if rows:
+            w("worst bands (by non-finite, then |residual|):\n")
+            w(_table(rows, ["band", "nonfinite", "max|resid|", "max ulp", "worst#"]) + "\n")
+        if reaches:
+            top = sorted(reaches.items(), key=lambda kv: -kv[1])[:8]
+            w(
+                "worst reaches: "
+                + ", ".join(f"{r} (x{c})" for r, c in top)
+                + "\n"
+            )
+    if drifts:
+        last = drifts[-1]
+        fields = last.get("fields") or {}
+        parts = []
+        for name, summary in sorted(fields.items()):
+            drift = summary.get("drift")
+            oob = summary.get("oob")
+            seg = f"{name}"
+            if drift is not None:
+                seg += f" drift {float(drift):.4f}"
+            if oob is not None:
+                seg += f" oob {int(oob)}"
+            parts.append(seg)
+        n_viol = sum(1 for e in drifts if e.get("reasons"))
+        w(
+            f"drift    : {len(drifts)} snapshots ({n_viol} violating) — "
+            + "; ".join(parts)
+            + "\n"
+        )
 
 
 def tail(events: list[dict], n: int = 20, out=None) -> int:

@@ -275,6 +275,11 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
     elif event == "health":
         for reason in record.get("reasons") or ["?"]:
             r.get("ddr_health_violations_total").inc(reason=str(reason))
+    # `skill` and `drift` events are NOT mapped here: their trackers
+    # (observability.skill / observability.drift) update the registry
+    # directly at observe time — with per-gauge worst-K removal semantics a
+    # stateless event mapping cannot express — so a tee mapping would
+    # double-count. They still bump ddr_events_total above.
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,7 @@ __all__ = [
     "boundary_buffer_columns",
     "boundary_ext_series",
     "auto_cell_budget",
+    "wave_cost_constants",
     "build_chunked_network",
     "build_routing_network",
     "pack_level_bands",
@@ -103,14 +104,55 @@ def boundary_ext_series(bnd, e_cols, e_tgt, n_out: int, lb: float):
 CHUNK_CELL_BUDGET = 1 << 26
 
 # Measured per-wave cost constants on the attached v5e (docs/tpu.md, "Continental
-# depth"): a wave pays a fixed dispatch/physics cost plus a full ring-buffer copy
+# depth"): a wave pays a fixed dispatch/physics cost plus a ring-buffer copy
 # (XLA's copy insertion cannot prove the in-body ring gather and the row write
 # don't alias, so each scan iteration rewrites the carry; measured ~210 GB/s
 # effective, vs 0.15ns/idx for the gather itself). Small rings make that copy
 # cheap; each extra band costs T extra waves of fixed cost. auto_cell_budget
-# balances the two.
-_WAVE_FIXED_S = 35e-6
-_RING_COPY_BYTES_PER_S = 2.1e11
+# balances the two. These defaults predate the gap-sized ring (the ring now
+# holds max-edge-level-gap + 2 rows, not span + 2 — docs/tpu.md "The gap-sized
+# ring"), so the copy-bandwidth term is due a re-measure on the next chip
+# session: override per deployment via the env knobs below instead of editing
+# literals (`wave_cost_constants`).
+_WAVE_FIXED_S_DEFAULT = 35e-6
+_RING_COPY_BYTES_PER_S_DEFAULT = 2.1e11
+
+
+def wave_cost_constants() -> tuple[float, float]:
+    """``(fixed seconds per wave, ring-copy bytes/s)`` for the wave cost model
+    — the measured v5e defaults, overridable per deployment/chip generation:
+
+    - ``DDR_WAVE_FIXED_US``: fixed per-wave dispatch+physics cost, MICROseconds
+      (default 35);
+    - ``DDR_WAVE_RING_GBPS``: effective scan-carry ring-copy bandwidth, GB/s
+      (default 210).
+
+    Read at band-planning time (host-side builds, never inside jit), so a
+    chip-tuning session sets two env vars and re-runs instead of patching
+    source. Malformed values warn and fall back — a tuning knob must never
+    abort a build."""
+    import logging
+    import os
+
+    fixed = _WAVE_FIXED_S_DEFAULT
+    bw = _RING_COPY_BYTES_PER_S_DEFAULT
+    raw = os.environ.get("DDR_WAVE_FIXED_US")
+    if raw:
+        try:
+            fixed = float(raw) * 1e-6
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                f"ignoring malformed DDR_WAVE_FIXED_US={raw!r} (want a number)"
+            )
+    raw = os.environ.get("DDR_WAVE_RING_GBPS")
+    if raw:
+        try:
+            bw = float(raw) * 1e9
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                f"ignoring malformed DDR_WAVE_RING_GBPS={raw!r} (want a number)"
+            )
+    return fixed, bw
 
 
 def auto_cell_budget(
@@ -120,16 +162,26 @@ def auto_cell_budget(
     max_bands: int = 64,
     cap: int = CHUNK_CELL_BUDGET,
     ring_divisor: int = 1,
+    ring_rows_cap: int | None = None,
 ) -> int:
     """Speed-optimal band ring budget from the measured TPU wave-cost model.
 
     Minimizes ``(C * T + depth) * (fixed + ring_bytes / copy_bw)`` over band
-    count C (uniform-level-width approximation: ``ring(C) ~ (span+1)(span*rho+1)``
+    count C (uniform-level-width approximation: ``ring(C) ~ rows(C)(span*rho+1)``
     with ``span = depth / C``, ``rho = n / depth``). Measured on the chip at
     N=65536/depth=1024/T=240: the default 2^26 memory cap yields 2 bands and
     7.4M rt/s; C=16 (budget 2^18) yields 99.7M rt/s — the ring-copy tax, not
     memory, is what sizes bands. ``max_bands`` caps compile time (the band loop
-    unrolls into the jit program) and host build time.
+    unrolls into the jit program) and host build time. The cost constants come
+    from :func:`wave_cost_constants` (``DDR_WAVE_FIXED_US`` /
+    ``DDR_WAVE_RING_GBPS`` env knobs over the measured v5e defaults).
+
+    ``ring_rows_cap`` prices the GAP-SIZED ring (docs/tpu.md): the engines
+    carry ``max edge level-gap + 2`` rows, not ``span + 2``, so when the
+    caller knows the topology's max gap it passes ``gap_max + 2`` and the
+    model stops overestimating the copy tax on wide-span bands —
+    ``rows(C) = min(span + 1, ring_rows_cap)``. None keeps the conservative
+    span-sized pricing (callers without a layering in hand).
 
     ``ring_divisor`` evaluates the model for a PER-SHARD ring (the
     sharded-chunked router's layout, where each of S shards carries ~1/S of a
@@ -140,17 +192,22 @@ def auto_cell_budget(
     """
     if depth <= 0 or n <= 0:
         return cap
+    wave_fixed_s, ring_copy_bps = wave_cost_constants()
     rho = max(1.0, n / depth)
     best_budget, best_cost = cap, float("inf")
     c = 1
     while c <= max_bands:
         span = max(1, -(-depth // c))
-        ring_cells = (span + 1) * (int(span * rho / ring_divisor) + 1)
-        if ring_cells <= cap:
+        rows = span + 1 if ring_rows_cap is None else min(span + 1, ring_rows_cap)
+        ring_cells = rows * (int(span * rho / ring_divisor) + 1)
+        # the BUDGET handed to the packer stays the span-sized bound (the
+        # packer's invariant); only the copy-tax pricing uses the gap rows
+        budget_cells = (span + 1) * (int(span * rho / ring_divisor) + 1)
+        if budget_cells <= cap:
             waves = c * t_nominal + depth
-            cost = waves * (_WAVE_FIXED_S + ring_cells * 4 / _RING_COPY_BYTES_PER_S)
+            cost = waves * (wave_fixed_s + ring_cells * 4 / ring_copy_bps)
             if cost < best_cost:
-                best_cost, best_budget = cost, ring_cells
+                best_cost, best_budget = cost, budget_cells
         c *= 2
     return max(best_budget, 2)
 
@@ -218,6 +275,12 @@ class ChunkedNetwork:
     n_edges: int = dataclasses.field(metadata={"static": True})
     n_boundary: int = dataclasses.field(metadata={"static": True})
     n_chunks: int = dataclasses.field(metadata={"static": True})
+    # Longest-path level per node, ORIGINAL order — the spatial health
+    # attribution's band axis (ddr_tpu.routing.mc.band_ids). Empty on
+    # pre-field builds: consumers skip band health.
+    level: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.zeros(0, jnp.int32)
+    )
 
 
 def build_chunked_network(
@@ -244,7 +307,8 @@ def build_chunked_network(
     depth = int(level.max()) if n else 0
     counts = np.bincount(level, minlength=depth + 1)
     if cell_budget is None:
-        cell_budget = auto_cell_budget(n, depth)
+        gap_all = int((level[rows] - level[cols]).max()) if rows.size else 0
+        cell_budget = auto_cell_budget(n, depth, ring_rows_cap=gap_all + 2)
     bands = pack_level_bands(counts, cell_budget)
     n_chunks = len(bands)
 
@@ -320,6 +384,7 @@ def build_chunked_network(
         n_edges=int(rows.size),
         n_boundary=int(len(buf_src)),
         n_chunks=n_chunks,
+        level=jnp.asarray(level, jnp.int32),
     )
 
 
@@ -369,8 +434,15 @@ def route_chunked(
     adjoint: str = "analytic",
     kernel: str | None = None,
     dtype: str = "fp32",
+    collect_reach_stats: bool = False,
 ):
     """Route ``(T, N)`` inflows band-by-band; same contract as :func:`mc.route`.
+
+    ``collect_reach_stats=True`` additionally time-reduces the full
+    (materialized) per-reach solve into
+    :class:`~ddr_tpu.observability.health.ReachStats` on
+    ``RouteResult.reach_stats`` — the spatial-health intermediate
+    :func:`mc.route` collapses into per-band stats.
 
     ``kernel``/``dtype`` forward to every band's
     :func:`~ddr_tpu.routing.wavefront.wavefront_route_core` call — the fused
@@ -454,10 +526,17 @@ def route_chunked(
             bnd = jnp.concatenate([bnd, raw_c[:, network.pub_idx[ci]]], axis=1)
 
     final = jnp.concatenate(finals)[network.out_inv]
+    full = jnp.concatenate(outs, axis=1)  # (T, N) in band-concat order
+    reach = None
+    if collect_reach_stats:
+        from ddr_tpu.observability.health import compute_reach_stats
+
+        reach = compute_reach_stats(
+            full, q_prime, compute_dtype=dtype, runoff_inv=network.out_inv
+        )
     if gauges is not None:
         mapped = dataclasses.replace(gauges, flat_idx=network.out_inv[gauges.flat_idx])
-        full = jnp.concatenate(outs, axis=1)
         runoff = jax.vmap(mapped.aggregate)(full)
     else:
-        runoff = jnp.concatenate(outs, axis=1)[:, network.out_inv]
-    return RouteResult(runoff=runoff, final_discharge=final)
+        runoff = full[:, network.out_inv]
+    return RouteResult(runoff=runoff, final_discharge=final, reach_stats=reach)

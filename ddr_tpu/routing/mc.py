@@ -39,6 +39,7 @@ __all__ = [
     "ChannelState",
     "GaugeIndex",
     "RouteResult",
+    "band_ids",
     "denormalize",
     "muskingum_coefficients",
     "celerity",
@@ -48,6 +49,20 @@ __all__ = [
 ]
 
 DT_SECONDS = 3600.0  # hourly routing step, /root/reference/src/ddr/routing/mmc.py:192
+
+
+def band_ids(level: jnp.ndarray, depth: int, n_bands: int) -> tuple[jnp.ndarray, int]:
+    """Level-band id per node for the spatial health attribution: the
+    longest-path levels [0, depth] split into ``min(n_bands, depth + 1)``
+    equal-width bands. The ONE band definition every engine (and ``ddr
+    audit``'s host-side replay) shares, so per-band reductions are comparable
+    across engines and runs. Returns ``(ids (N,) int32, effective band
+    count)`` — the count is static (it sizes the reduced arrays)."""
+    nb = max(1, min(int(n_bands), int(depth) + 1))
+    ids = jnp.minimum(
+        (jnp.asarray(level, jnp.int32) * nb) // (int(depth) + 1), nb - 1
+    )
+    return ids, nb
 
 
 @jax.tree_util.register_dataclass
@@ -118,11 +133,16 @@ class RouteResult:
     ``health``: on-device :class:`~ddr_tpu.observability.health.HealthStats`
     when routed with ``collect_health=True`` (None otherwise — None is an
     empty pytree node, so existing consumers and compiled programs are
-    unaffected)."""
+    unaffected); ``reach_stats``: per-reach time-reduced
+    :class:`~ddr_tpu.observability.health.ReachStats` produced by the engines
+    when the route was asked for band health — an INTERMEDIATE that
+    :func:`route` collapses into the bounded ``health`` band fields and strips
+    before returning (engines called directly may leave it populated)."""
 
     runoff: jnp.ndarray
     final_discharge: jnp.ndarray
     health: Any = None
+    reach_stats: Any = None
 
 
 def denormalize(value: jnp.ndarray, bounds: tuple[float, float], log_space: bool = False) -> jnp.ndarray:
@@ -250,6 +270,8 @@ def route(
     remat_physics: bool = True,
     remat_bands: bool = False,
     collect_health: bool = False,
+    health_bands: int = 0,
+    health_topk: int = 8,
     adjoint: str | None = None,
     kernel: str | None = None,
     dtype: str = "fp32",
@@ -302,6 +324,19 @@ def route(
     returns them as ``RouteResult.health``. They ride the program's existing
     outputs: a few fused reductions, no extra host sync, no second program.
 
+    ``health_bands > 0`` (with ``collect_health``) extends the health stats
+    with SPATIAL ATTRIBUTION: the topology's longest-path levels are split
+    into ``health_bands`` equal-width bands and the per-reach solve values are
+    segment-reduced per band (non-finite counts, discharge extrema, mass
+    residual, and — on bf16 batches — overflow/ulp-drift), plus an on-device
+    top-``health_topk`` worst-reach selection
+    (:func:`ddr_tpu.observability.health.compute_band_health`). Band ids
+    derive from the SAME level field on every engine, so the step, wavefront,
+    chunked, and stacked engines attribute to identical bands; the whole
+    computation is a few more fused reductions riding the same compiled
+    program, returning a bounded (B,)/(K,) pytree — no new jit-cache entries.
+    Both knobs are static (they size the returned arrays).
+
     ``adjoint`` selects the backward pass of the WAVEFRONT routing family
     (single-ring, depth-chunked, stacked): ``"analytic"`` runs the reverse-time
     wavefront sweep over the transposed network
@@ -332,21 +367,42 @@ def route(
     if adjoint not in (None, "analytic", "ad"):
         raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic', 'ad', or None)")
     validate_dtype(dtype)
+    # Spatial attribution (band health) needs the engines to produce per-reach
+    # time reductions; only meaningful networks that carry a level field do
+    # (every network this version builds does — the guard covers pre-field
+    # pickles and degenerate empty graphs).
+    want_spatial = collect_health and health_bands > 0
+
+    def _orig_level(net):
+        """The (N,) ORIGINAL-order longest-path levels, whichever engine
+        topology carries them (StackedChunked's ``level`` is its band frame;
+        the original-order field there is ``orig_level``)."""
+        lvl = getattr(net, "orig_level", None)
+        return net.level if lvl is None else lvl
 
     def _finish(result: RouteResult) -> RouteResult:
         if not collect_health:
-            return result
-        from ddr_tpu.observability.health import compute_health
+            if result.reach_stats is None:
+                return result
+            return dataclasses.replace(result, reach_stats=None)
+        from ddr_tpu.observability.health import compute_band_health, compute_health
 
         # q_prime sums are permutation-invariant, so whichever engine order
         # the local variable ended up in, the residual is identical
-        return dataclasses.replace(
-            result,
-            health=compute_health(
-                result.runoff, q_prime, final_discharge=result.final_discharge,
-                compute_dtype=dtype,
-            ),
+        health = compute_health(
+            result.runoff, q_prime, final_discharge=result.final_discharge,
+            compute_dtype=dtype,
         )
+        if result.reach_stats is not None:
+            ids, nb = band_ids(_orig_level(network), network.depth, health_bands)
+            health = dataclasses.replace(
+                health,
+                **compute_band_health(
+                    result.reach_stats, ids, nb, top_k=health_topk,
+                    compute_dtype=dtype,
+                ),
+            )
+        return dataclasses.replace(result, health=health, reach_stats=None)
 
     if remat_bands and not isinstance(network, StackedChunked):
         raise ValueError("remat_bands is only supported on a StackedChunked")
@@ -356,17 +412,21 @@ def route(
             raise ValueError(f"a {kind} always routes via its banded wavefront")
         if q_prime_permuted:
             raise ValueError(f"q_prime_permuted is not supported on a {kind}")
+        # pre-level-field builds have an empty level array: no band health
+        collect_reach = want_spatial and int(_orig_level(network).shape[0]) == network.n
         if isinstance(network, StackedChunked):
             return _finish(route_stacked(
                 network, channels, spatial_params, q_prime, q_init=q_init,
                 gauges=gauges, bounds=bounds, dt=dt,
                 remat_physics=remat_physics, remat_bands=remat_bands,
                 adjoint=adjoint or "analytic", kernel=kernel, dtype=dtype,
+                collect_reach_stats=collect_reach,
             ))
         return _finish(route_chunked(
             network, channels, spatial_params, q_prime, q_init=q_init,
             gauges=gauges, bounds=bounds, dt=dt, remat_physics=remat_physics,
             adjoint=adjoint or "analytic", kernel=kernel, dtype=dtype,
+            collect_reach_stats=collect_reach,
         ))
 
     n_mann = spatial_params["n"]
@@ -418,6 +478,17 @@ def route(
             remat_physics=remat_physics, adjoint=resolved,
             kernel=kernel, dtype=dtype,
         )
+        reach = None
+        if want_spatial and int(network.level.shape[0]) == network.n:
+            from ddr_tpu.observability.health import compute_reach_stats
+
+            # runoff_p is the engine's full-domain clamped solve in wf order;
+            # one gather each puts the reductions back on the original axis
+            reach = compute_reach_stats(
+                runoff_p, q_prime, compute_dtype=dtype,
+                runoff_inv=network.wf_inv,
+                q_prime_inv=network.wf_inv if q_prime_permuted else None,
+            )
         if gauges is not None:
             gauges_p = dataclasses.replace(
                 gauges, flat_idx=network.wf_inv[gauges.flat_idx]
@@ -426,7 +497,10 @@ def route(
         else:
             runoff = runoff_p[:, network.wf_inv]
         return _finish(
-            RouteResult(runoff=runoff, final_discharge=final_p[network.wf_inv])
+            RouteResult(
+                runoff=runoff, final_discharge=final_p[network.wf_inv],
+                reach_stats=reach,
+            )
         )
     if engine != "step":
         raise ValueError(f"unknown engine {engine!r} (use 'wavefront' or 'step')")
@@ -462,18 +536,68 @@ def route(
     def emit(q):
         return gauges.aggregate(q) if gauges is not None else q
 
-    def body(q_t, q_prime_prev):
-        q_prime_clamp = jnp.maximum(q_prime_prev, bounds.discharge)
-        q_t1 = route_step(
-            network, channels, n_mann, p_spatial, q_spatial, q_t, q_prime_clamp, bounds, dt,
-            permuted=permuted,
-        )
-        return q_t1, emit(q_t1)
+    collect_reach = want_spatial and int(network.level.shape[0]) == network.n
+    reach = None
+    step_inv = network.inv_perm if permuted else None
+    if collect_reach and gauges is not None:
+        # gauge-aggregated output: the full (T, N) field is never
+        # materialized, so the per-reach reductions ride the scan CARRY — four
+        # (N,) accumulators updated per step, same compiled program
+        from ddr_tpu.observability.health import assemble_reach_stats
 
-    q_final, outs = jax.lax.scan(body, q0, q_prime[:-1])
+        big = jnp.asarray(jnp.finfo(q0.dtype).max, q0.dtype)
+
+        def _acc_init(q):
+            fin = jnp.isfinite(q)
+            return ((~fin).astype(jnp.int32), jnp.where(fin, q, big),
+                    jnp.where(fin, q, -big), jnp.where(fin, q, 0.0))
+
+        def _acc_update(acc, q):
+            nf, qmin, qmax, qsum = acc
+            fin = jnp.isfinite(q)
+            return (nf + (~fin).astype(jnp.int32),
+                    jnp.minimum(qmin, jnp.where(fin, q, big)),
+                    jnp.maximum(qmax, jnp.where(fin, q, -big)),
+                    qsum + jnp.where(fin, q, 0.0))
+
+        def body_acc(carry, q_prime_prev):
+            q_t, acc = carry
+            q_prime_clamp = jnp.maximum(q_prime_prev, bounds.discharge)
+            q_t1 = route_step(
+                network, channels, n_mann, p_spatial, q_spatial, q_t,
+                q_prime_clamp, bounds, dt, permuted=permuted,
+            )
+            return (q_t1, _acc_update(acc, q_t1)), emit(q_t1)
+
+        (q_final, acc), outs = jax.lax.scan(
+            body_acc, (q0, _acc_init(q0)), q_prime[:-1]
+        )
+        nf, qmin, qmax, qsum = acc
+        reach = assemble_reach_stats(
+            nf, qmin, qmax, qsum, q_prime, compute_dtype=dtype,
+            inv=step_inv, q_prime_inv=step_inv,
+        )
+    else:
+        def body(q_t, q_prime_prev):
+            q_prime_clamp = jnp.maximum(q_prime_prev, bounds.discharge)
+            q_t1 = route_step(
+                network, channels, n_mann, p_spatial, q_spatial, q_t, q_prime_clamp, bounds, dt,
+                permuted=permuted,
+            )
+            return q_t1, emit(q_t1)
+
+        q_final, outs = jax.lax.scan(body, q0, q_prime[:-1])
     runoff = jnp.concatenate([emit(q0)[None, :], outs], axis=0)
     if permuted:
         q_final = q_final[network.inv_perm]
         if gauges is None:
             runoff = runoff[:, network.inv_perm]
-    return _finish(RouteResult(runoff=runoff, final_discharge=q_final))
+    if collect_reach and gauges is None:
+        from ddr_tpu.observability.health import compute_reach_stats
+
+        # full-domain output already in original order; q_prime may still be
+        # in fused-permuted order — one gather re-aligns its column sums
+        reach = compute_reach_stats(
+            runoff, q_prime, compute_dtype=dtype, q_prime_inv=step_inv
+        )
+    return _finish(RouteResult(runoff=runoff, final_discharge=q_final, reach_stats=reach))

@@ -43,10 +43,9 @@ import numpy as np
 
 from ddr_tpu.routing.chunked import (
     CHUNK_CELL_BUDGET,
-    _RING_COPY_BYTES_PER_S,
-    _WAVE_FIXED_S,
     boundary_buffer_columns,
     pack_level_bands,
+    wave_cost_constants,
 )
 from ddr_tpu.observability import spanned
 from ddr_tpu.routing.network import compute_levels
@@ -61,23 +60,31 @@ __all__ = [
 
 
 def auto_band_count(
-    n: int, depth: int, t_nominal: int = 240, max_bands: int = 256
+    n: int, depth: int, t_nominal: int = 240, max_bands: int = 256,
+    ring_rows_cap: int | None = None,
 ) -> int:
     """Speed-optimal band count from the measured TPU wave-cost model
     (:func:`ddr_tpu.routing.chunked.auto_cell_budget`'s model, solved for C —
     the stacked router compiles O(1) in C, so no compile-driven cap applies
-    below ``max_bands``)."""
+    below ``max_bands``). Cost constants come from
+    :func:`~ddr_tpu.routing.chunked.wave_cost_constants`
+    (``DDR_WAVE_FIXED_US`` / ``DDR_WAVE_RING_GBPS`` env knobs);
+    ``ring_rows_cap`` (``gap_max + 2`` when the caller has the layering in
+    hand) prices the gap-sized ring instead of the conservative span-sized
+    one — see ``auto_cell_budget``."""
     if depth <= 0 or n <= 0:
         return 1
+    wave_fixed_s, ring_copy_bps = wave_cost_constants()
     best_c, best_cost = 1, float("inf")
     c = 1
     while c <= max_bands:
         span = max(1, -(-depth // c))
         nb = max(1, -(-n // c))
-        ring = (span + 1) * (nb + 1)
-        if ring <= CHUNK_CELL_BUDGET:
+        rows = span + 1 if ring_rows_cap is None else min(span + 1, ring_rows_cap)
+        ring = rows * (nb + 1)
+        if (span + 1) * (nb + 1) <= CHUNK_CELL_BUDGET:
             waves = c * t_nominal + depth
-            cost = waves * (_WAVE_FIXED_S + ring * 4 / _RING_COPY_BYTES_PER_S)
+            cost = waves * (wave_fixed_s + ring * 4 / ring_copy_bps)
             if cost < best_cost:
                 best_cost, best_c = cost, c
         c *= 2
@@ -149,6 +156,12 @@ class StackedChunked:
     # the whole span (see RiverNetwork.wf_ring_rows). 0 = pre-field builds:
     # consumers fall back to span_max + 2.
     ring_rows: int = dataclasses.field(default=0, metadata={"static": True})
+    # Longest-path level per node, ORIGINAL order — the spatial health
+    # attribution's band axis (ddr_tpu.routing.mc.band_ids). Empty on
+    # pre-field builds: consumers skip band health.
+    orig_level: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.zeros(0, jnp.int32)
+    )
 
 
 def build_stacked_chunked(
@@ -166,8 +179,11 @@ def build_stacked_chunked(
         level = compute_levels(rows, cols, n)
     depth = int(level.max()) if n else 0
     counts = np.bincount(level, minlength=depth + 1)
+    # the whole graph's max edge level-gap prices the gap-sized ring in the
+    # band cost model (per-band local gaps are <= the global one)
+    gap_all = int((level[rows] - level[cols]).max()) if rows.size else 0
     if cell_budget is None:
-        c_star = auto_band_count(n, depth)
+        c_star = auto_band_count(n, depth, ring_rows_cap=gap_all + 2)
         bands = pack_level_bands_balanced(
             counts, max(1, -(-depth // c_star)), max(1, -(-n // c_star))
         )
@@ -320,6 +336,7 @@ def build_stacked_chunked(
         t_col=jnp.asarray(t_col, jnp.int32),
         t_width=int(t_width),
         ring_rows=int(ring_rows),
+        orig_level=jnp.asarray(level, jnp.int32),
     )
 
 
@@ -641,9 +658,17 @@ def route_stacked(
     adjoint: str = "analytic",
     kernel: str | None = None,
     dtype: str = "fp32",
+    collect_reach_stats: bool = False,
 ):
     """Route ``(T, N)`` inflows with one scanned band program; same contract as
     :func:`ddr_tpu.routing.mc.route`. All inputs in ORIGINAL node order.
+
+    ``collect_reach_stats=True`` additionally time-reduces the materialized
+    per-slot solve into original-order
+    :class:`~ddr_tpu.observability.health.ReachStats` on
+    ``RouteResult.reach_stats`` (sentinel slots drop out of the ``out_map``
+    gather) — the spatial-health intermediate :func:`mc.route` collapses
+    into per-band stats.
 
     ``kernel`` selects the band wave-scan implementation (``"pallas"`` = the
     fused kernel of :mod:`ddr_tpu.routing.pallas_kernel`, interpret mode
@@ -792,9 +817,16 @@ def route_stacked(
     runoff_all = jnp.maximum(raw_all, lb)
     flat = jnp.moveaxis(runoff_all, 0, 1).reshape(T, C * n_cap)
     final = flat[-1, network.out_map]
+    reach = None
+    if collect_reach_stats:
+        from ddr_tpu.observability.health import compute_reach_stats
+
+        reach = compute_reach_stats(
+            flat, q_prime, compute_dtype=dtype, runoff_inv=network.out_map
+        )
     if gauges is not None:
         mapped = dataclasses.replace(gauges, flat_idx=network.out_map[gauges.flat_idx])
         runoff = jax.vmap(mapped.aggregate)(flat)
     else:
         runoff = flat[:, network.out_map]
-    return RouteResult(runoff=runoff, final_discharge=final)
+    return RouteResult(runoff=runoff, final_discharge=final, reach_stats=reach)

@@ -1,0 +1,620 @@
+"""``ddr audit`` — spatial attribution reports: localize bad bands / reaches /
+gauges from run telemetry or a controlled synthetic replay.
+
+The watchdog (PR 3) and the bf16 ulp-drift gate (PR 8) can say "a batch went
+wrong"; this CLI answers *where*:
+
+- **Replay mode** (``ddr audit <run_log-or-dir>``): aggregates a run's
+  ``health`` (per-band attribution payloads), ``skill`` (worst gauges by
+  NSE), and ``drift`` (parameter-field snapshots) events into one JSON +
+  markdown report — worst bands by non-finite/residual, worst reaches by
+  selection frequency, worst gauges by skill, last parameter-field state.
+- **Synthetic mode** (``--synthetic``): builds the synthetic twin basin,
+  routes it clean, injects a per-reach anomaly (one reach's Manning n scaled
+  by ``--perturb-scale``; or run under ``DDR_FAULTS`` for the corruption
+  path), routes again, and attributes the full-domain divergence to level
+  bands and reaches. The report states the injected location AND the
+  localized one; the process exits 1 when localization misses — which makes
+  this the tier-1 smoke gate for the whole spatial-attribution path
+  (scripts/check_audit.py, mirroring check_pallas_kernel's role).
+- **``--dtype-diff``** (with ``--synthetic``): routes the same basin in fp32
+  and bf16 (the PR 8 mixed-precision ring; XLA path off-TPU) and attributes
+  the divergence to the sub-basins producing it — per-band mean/max relative
+  error in bf16-ULP units plus the worst reaches, turning the aggregate
+  ``DDR_HEALTH_MAX_ULP_DRIFT`` gate into an actionable map (docs/tpu.md
+  "bf16-compute / fp32-accumulate").
+
+Reports land as ``audit.json`` + ``audit.md`` under ``--out`` (default: the
+current directory); the markdown also prints to stdout. With telemetry active
+(``DDR_METRICS_DIR``) one ``audit`` event records the report location and
+verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+__all__ = ["main", "synthetic_audit", "dtype_diff_audit", "replay_audit"]
+
+
+# ---------------------------------------------------------------------------
+# Shared report helpers
+# ---------------------------------------------------------------------------
+
+
+def _band_ids_host(level, depth: int, n_bands: int):
+    """Host twin of :func:`ddr_tpu.routing.mc.band_ids` (same formula, numpy)."""
+    import numpy as np
+
+    nb = max(1, min(int(n_bands), int(depth) + 1))
+    ids = np.minimum((np.asarray(level, np.int64) * nb) // (int(depth) + 1), nb - 1)
+    return ids, nb
+
+
+def _md_table(rows: list[list[Any]], header: list[str]) -> str:
+    head = "| " + " | ".join(header) + " |"
+    sep = "|" + "|".join(" --- " for _ in header) + "|"
+    body = ["| " + " | ".join(str(v) for v in r) + " |" for r in rows]
+    return "\n".join([head, sep, *body])
+
+
+def _write_report(report: dict, md: str, out_dir: Path) -> tuple[Path, Path]:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    jpath = out_dir / "audit.json"
+    mpath = out_dir / "audit.md"
+    jpath.write_text(json.dumps(report, indent=2, default=str))
+    mpath.write_text(md)
+    return jpath, mpath
+
+
+def _health_to_dict(health) -> dict[str, Any]:
+    """Host-side JSON slice of a HealthStats (scalars + bounded band fields)."""
+    import numpy as np
+
+    out: dict[str, Any] = {
+        "nonfinite": int(health.nonfinite),
+        "q_min": float(health.q_min),
+        "q_max": float(health.q_max),
+        "mass_residual": float(health.mass_residual),
+    }
+    for field in ("band_nonfinite", "band_residual", "band_q_min", "band_q_max",
+                  "band_overflow", "band_ulp_drift", "worst_idx", "worst_score"):
+        v = getattr(health, field)
+        if v is not None:
+            arr = np.asarray(v)
+            out[field] = [
+                int(x) if arr.dtype.kind in "iu" else round(float(x), 6)
+                for x in arr
+            ]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Synthetic modes
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_route_setup(n: int, t_hours: int, depth: int | None, seed: int):
+    """Build the synthetic basin + routing inputs once for both synthetic
+    modes: (network, channels, spatial_params, q_prime, level, depth)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddr_tpu.geodatazoo.synthetic import make_basin
+    from ddr_tpu.routing.model import prepare_batch
+
+    t = max(48, -(-t_hours // 24) * 24)
+    basin = make_basin(
+        n_segments=n, n_gauges=min(16, max(2, n // 16)),
+        n_days=t // 24, seed=seed, depth=depth,
+    )
+    rd = basin.routing_data
+    network, channels, _ = prepare_batch(rd, slope_min=1e-4)
+    params = {k: jnp.asarray(v, jnp.float32) for k, v in basin.true_params.items()}
+    q_prime = jnp.asarray(basin.q_prime[:t], jnp.float32)
+    from ddr_tpu.routing.network import compute_levels
+
+    level = compute_levels(
+        np.asarray(rd.adjacency_rows, np.int64),
+        np.asarray(rd.adjacency_cols, np.int64),
+        rd.n_segments,
+    )
+    return network, channels, params, q_prime, level, int(level.max()) if n else 0
+
+
+def synthetic_audit(
+    n: int = 256,
+    t_hours: int = 48,
+    depth: int | None = None,
+    bands: int = 8,
+    top_k: int = 8,
+    seed: int = 0,
+    perturb_reach: int | None = None,
+    perturb_scale: float = 50.0,
+) -> dict[str, Any]:
+    """Inject a per-reach parameter anomaly and localize it.
+
+    Routes the synthetic basin clean and with one reach's Manning n scaled by
+    ``perturb_scale``, attributes the full-domain divergence
+    ``sum_t |Q_pert - Q_clean|`` to level bands (the same
+    :func:`~ddr_tpu.routing.mc.band_ids` partition the in-program band health
+    uses) and reaches, and cross-checks against the in-program
+    ``collect_health`` band stats of both routes. ``report["hit"]`` is the
+    verdict: the injected reach's band must be the top divergent band AND the
+    reach must appear in the top-K divergent reaches.
+    """
+    import numpy as np
+
+    from ddr_tpu.routing.mc import route
+
+    rng = np.random.default_rng(seed)
+    network, channels, params, q_prime, level, depth_eff = _synthetic_route_setup(
+        n, t_hours, depth, seed
+    )
+    if perturb_reach is None:
+        # an interior reach (not a headwater outlet) makes the hardest case:
+        # its divergence must beat its own downstream echo
+        perturb_reach = int(rng.integers(0, n))
+    ids, nb = _band_ids_host(level, depth_eff, bands)
+    injected_band = int(ids[perturb_reach])
+
+    clean = route(
+        network, channels, params, q_prime,
+        collect_health=True, health_bands=bands, health_topk=top_k,
+    )
+    pert_params = dict(params)
+    pert_params["n"] = params["n"].at[perturb_reach].multiply(perturb_scale)
+    pert = route(
+        network, channels, pert_params, q_prime,
+        collect_health=True, health_bands=bands, health_topk=top_k,
+    )
+
+    diff = np.abs(np.asarray(pert.runoff) - np.asarray(clean.runoff)).sum(axis=0)
+    band_sum = np.zeros(nb)
+    np.add.at(band_sum, ids, diff)
+    # localization statistic: the band's WORST single reach, not its sum — a
+    # perturbation echoes down every reach below it, so wide downstream bands
+    # accumulate more total |ΔQ| than the (possibly narrow) band hosting the
+    # anomaly, while the single largest divergence stays at/next to the source
+    band_max = np.zeros(nb)
+    np.maximum.at(band_max, ids, diff)
+    order = np.argsort(diff)[::-1][:top_k]
+    worst_reaches = [
+        {"reach": int(r), "band": int(ids[r]), "divergence": round(float(diff[r]), 4)}
+        for r in order
+    ]
+    localized_band = int(np.argmax(band_max))
+    hit_band = localized_band == injected_band
+    hit_reach = int(perturb_reach) in [w["reach"] for w in worst_reaches]
+
+    report = {
+        "mode": "synthetic",
+        "n": int(n),
+        "depth": depth_eff,
+        "bands": nb,
+        "seed": int(seed),
+        "injected": {
+            "reach": int(perturb_reach),
+            "band": injected_band,
+            "param": "n",
+            "scale": float(perturb_scale),
+        },
+        "localized": {
+            "worst_band": localized_band,
+            "band_divergence": [round(float(v), 4) for v in band_max],
+            "band_divergence_sum": [round(float(v), 4) for v in band_sum],
+            "worst_reaches": worst_reaches,
+        },
+        "hit_band": hit_band,
+        "hit_reach": hit_reach,
+        "hit": hit_band and hit_reach,
+        "health_clean": _health_to_dict(clean.health),
+        "health_perturbed": _health_to_dict(pert.health),
+    }
+    return report
+
+
+def _synthetic_md(report: dict) -> str:
+    loc = report["localized"]
+    inj = report["injected"]
+    lines = [
+        "# ddr audit — synthetic anomaly localization",
+        "",
+        f"Basin: N={report['n']}, depth={report['depth']}, "
+        f"{report['bands']} level bands (seed {report['seed']}).",
+        "",
+        f"Injected: reach **{inj['reach']}** (band {inj['band']}) — "
+        f"Manning n x{inj['scale']:g}.",
+        f"Localized: band **{loc['worst_band']}**, worst reach "
+        f"**{loc['worst_reaches'][0]['reach'] if loc['worst_reaches'] else '?'}**.",
+        "",
+        f"**Verdict: {'LOCALIZED' if report['hit'] else 'MISSED'}** "
+        f"(band {'hit' if report['hit_band'] else 'MISS'}, "
+        f"reach {'hit' if report['hit_reach'] else 'MISS'}).",
+        "",
+        "## Divergence by band",
+        "",
+        _md_table(
+            [
+                [b, v, s]
+                for b, (v, s) in enumerate(
+                    zip(loc["band_divergence"], loc["band_divergence_sum"])
+                )
+            ],
+            ["band", "max reach |ΔQ|", "sum |ΔQ|"],
+        ),
+        "",
+        "## Worst reaches",
+        "",
+        _md_table(
+            [[w["reach"], w["band"], w["divergence"]] for w in loc["worst_reaches"]],
+            ["reach", "band", "sum |ΔQ|"],
+        ),
+        "",
+        "## In-program band health (perturbed route)",
+        "",
+        _md_table(
+            [
+                [b, nf, res]
+                for b, (nf, res) in enumerate(zip(
+                    report["health_perturbed"].get("band_nonfinite", []),
+                    report["health_perturbed"].get("band_residual", []),
+                ))
+            ],
+            ["band", "nonfinite", "residual"],
+        ),
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def dtype_diff_audit(
+    n: int = 256,
+    t_hours: int = 48,
+    depth: int | None = None,
+    bands: int = 8,
+    top_k: int = 8,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """fp32-vs-bf16 divergence attribution: route the same basin with the
+    fp32 ring and the bf16-compute/fp32-accumulate ring, and map the relative
+    error (in bf16-ULP units) onto level bands and reaches — the sub-basins
+    where mixed precision actually loses digits."""
+    import numpy as np
+
+    from ddr_tpu.routing.mc import route
+
+    network, channels, params, q_prime, level, depth_eff = _synthetic_route_setup(
+        n, t_hours, depth, seed
+    )
+    ids, nb = _band_ids_host(level, depth_eff, bands)
+    f32 = route(network, channels, params, q_prime)
+    bf16 = route(
+        network, channels, params, q_prime, dtype="bf16",
+        collect_health=True, health_bands=bands, health_topk=top_k,
+    )
+    a = np.asarray(f32.runoff, np.float64)
+    b = np.asarray(bf16.runoff, np.float64)
+    # the SAME unit as HealthStats.ulp_drift: jnp.finfo(bfloat16).eps = 2^-7,
+    # so a band's number here calibrates DDR_HEALTH_MAX_ULP_DRIFT directly
+    eps = 2.0 ** -7
+    rel = np.abs(b - a) / (np.abs(a) + 1e-9)
+    ulp = (rel / eps).mean(axis=0)  # per-reach mean ULP error
+    ulp_max = (rel / eps).max(axis=0)
+    band_mean = np.zeros(nb)
+    band_max = np.zeros(nb)
+    counts = np.bincount(ids, minlength=nb).astype(np.float64)
+    np.add.at(band_mean, ids, ulp)
+    np.maximum.at(band_max, ids, ulp_max)
+    band_mean = band_mean / np.maximum(counts, 1.0)
+    order = np.argsort(ulp)[::-1][:top_k]
+    report = {
+        "mode": "dtype-diff",
+        "n": int(n),
+        "depth": depth_eff,
+        "bands": nb,
+        "seed": int(seed),
+        "band_ulp_mean": [round(float(v), 3) for v in band_mean],
+        "band_ulp_max": [round(float(v), 3) for v in band_max],
+        "worst_reaches": [
+            {
+                "reach": int(r),
+                "band": int(ids[r]),
+                "ulp_mean": round(float(ulp[r]), 3),
+                "ulp_max": round(float(ulp_max[r]), 3),
+            }
+            for r in order
+        ],
+        "health_bf16": _health_to_dict(bf16.health),
+    }
+    return report
+
+
+def _dtype_md(report: dict) -> str:
+    lines = [
+        "# ddr audit — fp32 vs bf16 divergence map",
+        "",
+        f"Basin: N={report['n']}, depth={report['depth']}, "
+        f"{report['bands']} level bands (seed {report['seed']}).",
+        "",
+        "Relative error of the bf16-compute/fp32-accumulate route vs the fp32 "
+        "route, in bf16-ULP units (1 ULP = bf16 eps = 2^-7 relative — the "
+        "same unit as `HealthStats.ulp_drift`, so these numbers calibrate "
+        "`DDR_HEALTH_MAX_ULP_DRIFT` directly). Healthy routes sit "
+        "at O(1-10) mean ULPs; a band far above its neighbours is where the "
+        "ring's rounding compounds (long accumulation chains, confluences).",
+        "",
+        "## Divergence by band",
+        "",
+        _md_table(
+            [
+                [b, m, x]
+                for b, (m, x) in enumerate(
+                    zip(report["band_ulp_mean"], report["band_ulp_max"])
+                )
+            ],
+            ["band", "mean ULP", "max ULP"],
+        ),
+        "",
+        "## Worst reaches",
+        "",
+        _md_table(
+            [
+                [w["reach"], w["band"], w["ulp_mean"], w["ulp_max"]]
+                for w in report["worst_reaches"]
+            ],
+            ["reach", "band", "mean ULP", "max ULP"],
+        ),
+        "",
+    ]
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Replay mode
+# ---------------------------------------------------------------------------
+
+
+def replay_audit(
+    log_path: str | Path, checkpoint: str | Path | None = None, top_k: int = 8
+) -> dict[str, Any]:
+    """Aggregate a run's telemetry into the localization report: worst bands
+    (from `health` events' band payloads), worst reaches (selection
+    frequency), worst gauges (last `skill` event), parameter-field state
+    (last `drift` event), plus checkpoint metadata when one is given."""
+    from ddr_tpu.observability.metrics_cli import (
+        aggregate_spatial_health,
+        load_events,
+    )
+
+    events, bad = load_events(log_path)
+    by_type: dict[str, list[dict]] = {}
+    for e in events:
+        by_type.setdefault(str(e.get("event")), []).append(e)
+    end = (by_type.get("run_end") or [{}])[-1]
+    summary = end.get("summary") or {}
+
+    # the ONE band/reach fold `ddr metrics summarize` renders too
+    bands, reaches = aggregate_spatial_health(by_type.get("health", []))
+
+    skill_events = by_type.get("skill", [])
+    skill_last = summary.get("skill") or (skill_events[-1] if skill_events else {})
+    drift_events = by_type.get("drift", [])
+    drift_last = drift_events[-1] if drift_events else {}
+
+    report: dict[str, Any] = {
+        "mode": "replay",
+        "log": str(log_path),
+        "events": len(events),
+        "corrupt_lines": bad,
+        "status": end.get("status"),
+        "health_violations": len(by_type.get("health", [])),
+        "worst_bands": [
+            {"band": b, **{k: round(v, 6) if isinstance(v, float) else v
+                           for k, v in slot.items()}}
+            for b, slot in sorted(
+                bands.items(),
+                key=lambda kv: (kv[1]["nonfinite"], kv[1]["worst_count"],
+                                kv[1]["max_abs_residual"]),
+                reverse=True,
+            )[:top_k]
+        ],
+        "worst_reaches": [
+            {"reach": r, "flagged": c}
+            for r, c in sorted(reaches.items(), key=lambda kv: -kv[1])[:top_k]
+        ],
+        "skill": {
+            k: skill_last.get(k)
+            for k in ("gauges", "scored", "nse", "kge", "pbias", "worst")
+            if k in skill_last
+        },
+        "drift": {
+            "fields": drift_last.get("fields") or {},
+            "reasons": drift_last.get("reasons") or [],
+            "snapshots": len(drift_events),
+        },
+    }
+    if checkpoint is not None:
+        try:
+            from ddr_tpu.training import load_state
+
+            blob = load_state(checkpoint)
+            report["checkpoint"] = {
+                "path": str(checkpoint),
+                "epoch": blob.get("epoch"),
+                "mini_batch": blob.get("mini_batch"),
+                "arch": blob.get("arch"),
+            }
+        except Exception as e:  # a bad checkpoint should not kill the report
+            report["checkpoint"] = {"path": str(checkpoint), "error": str(e)}
+    return report
+
+
+def _replay_md(report: dict) -> str:
+    lines = [
+        "# ddr audit — run replay",
+        "",
+        f"Log: `{report['log']}` — {report['events']} events "
+        f"({report['corrupt_lines']} corrupt lines), status "
+        f"{report.get('status') or '(no run_end)'}, "
+        f"{report['health_violations']} health violations.",
+        "",
+    ]
+    if report["worst_bands"]:
+        lines += [
+            "## Worst bands",
+            "",
+            _md_table(
+                [
+                    [b["band"], b["nonfinite"], b["max_abs_residual"],
+                     b["max_ulp"], b["worst_count"]]
+                    for b in report["worst_bands"]
+                ],
+                ["band", "nonfinite", "max|residual|", "max ULP", "worst#"],
+            ),
+            "",
+        ]
+    if report["worst_reaches"]:
+        lines += [
+            "## Worst reaches (selection frequency)",
+            "",
+            _md_table(
+                [[r["reach"], r["flagged"]] for r in report["worst_reaches"]],
+                ["reach", "flagged"],
+            ),
+            "",
+        ]
+    skill = report.get("skill") or {}
+    if skill.get("worst"):
+        lines += [
+            "## Worst gauges (by NSE)",
+            "",
+            _md_table(
+                [
+                    [g.get("gauge"), g.get("nse"), g.get("kge"), g.get("pbias")]
+                    for g in skill["worst"]
+                ],
+                ["gauge", "NSE", "KGE", "pbias"],
+            ),
+            "",
+        ]
+    drift = report.get("drift") or {}
+    if drift.get("fields"):
+        lines += [
+            "## Parameter-field state (last drift snapshot)",
+            "",
+            _md_table(
+                [
+                    [name, s.get("drift"), s.get("oob"), s.get("nonfinite"),
+                     (s.get("quantiles") or [None])[len(s.get("quantiles") or []) // 2]]
+                    for name, s in sorted(drift["fields"].items())
+                ],
+                ["field", "drift", "oob", "nonfinite", "median"],
+            ),
+            "",
+        ]
+    ckpt = report.get("checkpoint")
+    if ckpt:
+        lines += [f"Checkpoint: `{ckpt.get('path')}` — "
+                  + (f"epoch {ckpt.get('epoch')} mb {ckpt.get('mini_batch')}"
+                     if "error" not in ckpt else f"unloadable ({ckpt['error']})"),
+                  ""]
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ddr audit",
+        description="Spatial attribution report: localize bad bands / reaches "
+        "/ gauges from run telemetry, or verify localization end-to-end on "
+        "the synthetic twin basin.",
+    )
+    parser.add_argument("log", nargs="?", default=None,
+                        help="run_log .jsonl file or directory (replay mode)")
+    parser.add_argument("--out", default=".",
+                        help="report directory (audit.json + audit.md; default .)")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="route the synthetic basin and localize an "
+                        "injected per-reach anomaly (exit 1 on a miss)")
+    parser.add_argument("--dtype-diff", action="store_true",
+                        help="with --synthetic: attribute fp32-vs-bf16 "
+                        "divergence to bands/reaches instead of injecting")
+    parser.add_argument("--n", type=int, default=256, help="synthetic reach count")
+    parser.add_argument("--t-hours", type=int, default=48,
+                        help="synthetic window, hourly steps (default 48)")
+    parser.add_argument("--depth", type=int, default=None,
+                        help="synthetic longest-path depth (default: shallow)")
+    parser.add_argument("--bands", type=int, default=8,
+                        help="level-band count for attribution (default 8)")
+    parser.add_argument("--topk", type=int, default=8,
+                        help="worst-reach/gauge selection size (default 8)")
+    parser.add_argument("--seed", type=int, default=0, help="synthetic seed")
+    parser.add_argument("--perturb-reach", type=int, default=None,
+                        help="reach to perturb (default: random)")
+    parser.add_argument("--perturb-scale", type=float, default=50.0,
+                        help="Manning-n scale factor of the injected anomaly")
+    parser.add_argument("--checkpoint", default=None,
+                        help="replay mode: checkpoint whose metadata to include")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if not args.synthetic and args.log is None:
+        parser.print_help()
+        return 2
+    if args.dtype_diff and not args.synthetic:
+        print("ddr audit: --dtype-diff requires --synthetic", file=sys.stderr)
+        return 2
+
+    from ddr_tpu.observability import get_recorder, run_telemetry
+
+    rc = 0
+    with run_telemetry(None, "audit"):
+        if args.synthetic and args.dtype_diff:
+            report = dtype_diff_audit(
+                n=args.n, t_hours=args.t_hours, depth=args.depth,
+                bands=args.bands, top_k=args.topk, seed=args.seed,
+            )
+            md = _dtype_md(report)
+        elif args.synthetic:
+            report = synthetic_audit(
+                n=args.n, t_hours=args.t_hours, depth=args.depth,
+                bands=args.bands, top_k=args.topk, seed=args.seed,
+                perturb_reach=args.perturb_reach,
+                perturb_scale=args.perturb_scale,
+            )
+            md = _synthetic_md(report)
+            rc = 0 if report["hit"] else 1
+        else:
+            report = replay_audit(args.log, checkpoint=args.checkpoint,
+                                  top_k=args.topk)
+            md = _replay_md(report)
+        jpath, mpath = _write_report(report, md, Path(args.out))
+        rec = get_recorder()
+        if rec is not None:
+            rec.emit(
+                "audit",
+                mode=report["mode"],
+                report=str(jpath),
+                hit=report.get("hit"),
+                worst_band=(report.get("localized") or {}).get("worst_band"),
+            )
+    sys.stdout.write(md)
+    print(f"\nreport: {jpath}  {mpath}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,7 +41,11 @@ variables. Families with their own reference tables are linked.
   Unset/empty = off. Heterogeneous fleets should pin per-platform paths
   (XLA:CPU serializes host-specialized executables).
 - `DDR_METRICS_DIR`, `DDR_HEARTBEAT_EVERY`, `DDR_METRICS_FLUSH_EVERY`,
-  `DDR_PROM_PORT`, `DDR_HEALTH_*` — observability: see docs/observability.md.
+  `DDR_PROM_PORT`, `DDR_HEALTH_*`, `DDR_SKILL_*` — observability (incl.
+  spatial attribution & hydrologic skill): see docs/observability.md.
+- `DDR_WAVE_FIXED_US`, `DDR_WAVE_RING_GBPS` — wave-cost-model constants for
+  band planning (chip re-calibration knobs): see docs/tpu.md "The gap-sized
+  ring".
 - `DDR_SERVE_*` — serving: see docs/serving.md.
 - `DDR_BENCH_*` — `bench.py`: see `python bench.py --help`.
 """

@@ -72,6 +72,27 @@ def test(cfg: Config, dataset=None, params=None) -> Metrics:
     metrics = Metrics(pred=daily_runoff[:, warmup:], target=daily_obs[:, warmup:])
     log_metrics(metrics, header="Test evaluation")
 
+    # One `skill` event + run_end rollup from the eval battery (the same
+    # bounded per-gauge NSE/KGE/pbias stream the train loop emits per batch),
+    # so `ddr metrics summarize` and `ddr audit` see eval skill without
+    # reopening model_test.zarr.
+    from ddr_tpu.observability import get_recorder
+    from ddr_tpu.observability.skill import SkillConfig, SkillTracker
+
+    rec = get_recorder()
+    skill_cfg = SkillConfig.from_env()
+    if rec is not None and skill_cfg.enabled:
+        try:
+            tracker = SkillTracker(skill_cfg)
+            summary = tracker.observe(
+                daily_runoff[:, warmup:].T, daily_obs[:, warmup:].T, gage_ids,
+                cmd="test",
+            )
+            if summary is not None:
+                rec.merge_summary("skill", tracker.status())
+        except Exception as e:  # telemetry must never fail the evaluation
+            log.warning(f"skill telemetry failed: {e}")
+
     # Evaluation figures straight from the run (the reference defers these to a
     # notebook, /root/reference/scripts/test.py:114): metric CDF + distribution
     # boxes per gauge battery, saved next to the result store.

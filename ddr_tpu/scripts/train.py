@@ -148,6 +148,22 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     rec = get_recorder()
     health_on = health_cfg.enabled and rec is not None
     watchdog = HealthWatchdog(health_cfg) if health_on else None
+    # Hydrologic skill + parameter drift (docs/observability.md "Spatial
+    # attribution & skill"): per-gauge NSE/KGE/percent-bias streamed per
+    # batch (`skill` events + bounded Prometheus mirrors), and per-epoch
+    # KAN-parameter-field distribution snapshots (`drift` events) whose
+    # violations feed the watchdog. Host-side numpy over arrays the loop
+    # already synchronized — nothing touches the compiled step.
+    from ddr_tpu.observability.drift import DriftTracker
+    from ddr_tpu.observability.skill import SkillConfig, SkillTracker
+
+    skill_cfg = SkillConfig.from_env()
+    skill = SkillTracker(skill_cfg) if (skill_cfg.enabled and rec is not None) else None
+    drift = (
+        DriftTracker(cfg.params.parameter_ranges, config=health_cfg, watchdog=watchdog)
+        if rec is not None
+        else None
+    )
 
     par = None
     if cfg.experiment.parallel != "none":
@@ -171,6 +187,10 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             optimizer=optimizer,
             remat_bands=cfg.experiment.remat_bands,
             collect_health=health_on,
+            # spatial attribution: per-level-band reductions + worst-reach
+            # selection ride the same program (DDR_HEALTH_BANDS/TOPK; 0 = off)
+            health_bands=health_cfg.bands if health_on else 0,
+            health_topk=health_cfg.top_k,
             # _prepare pre-permutes q_prime columns on the HOST for single-ring
             # wavefront batches (wf-hoist fast path; one shared predicate)
             q_prime_wf_permuted=True,
@@ -374,6 +394,23 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     # landed — reading them here moves a few scalars, runs
                     # nothing. One `health` event per violating batch.
                     watchdog.observe(hstats, epoch=epoch, batch=i)
+                if skill is not None:
+                    # per-gauge NSE/KGE/percent-bias over the post-warmup
+                    # window (the same rows the loss scores), streamed into
+                    # bounded accumulators -> one `skill` event per batch
+                    try:
+                        w0 = cfg.experiment.warmup
+                        target_skill = np.where(obs_mask, obs_daily, np.nan)
+                        if w0 < daily.shape[0]:
+                            skill.observe(
+                                np.where(np.isfinite(daily), daily, np.nan)[w0:],
+                                target_skill[w0:],
+                                rd.observations.gage_ids,
+                                epoch=epoch,
+                                batch=i,
+                            )
+                    except Exception:
+                        log.exception("skill tracking failed")  # never the loop
                 if par is not None:
                     # compile accounting + program cards OUTSIDE the timing
                     # brackets (a card's duplicate AOT compile must not land
@@ -507,6 +544,30 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     return params, opt_state
                 if max_batches is not None and n_done >= max_batches:
                     return params, opt_state
+            if drift is not None and n_done > 0:
+                # Per-epoch parameter-field drift snapshot: one extra KAN
+                # forward on the last batch's attributes (host-synced, outside
+                # the jitted step), denormalized to physical space. First
+                # epoch's profile becomes the drift reference; violations
+                # (DDR_HEALTH_MAX_PARAM_DRIFT / _MAX_PARAM_OOB) flag the
+                # watchdog like any health violation.
+                try:
+                    from ddr_tpu.routing.model import denormalize_spatial_parameters
+
+                    raw = kan_model.apply(params, jnp.asarray(attrs))
+                    fields = denormalize_spatial_parameters(
+                        raw,
+                        cfg.params.parameter_ranges,
+                        cfg.params.log_space_parameters,
+                        cfg.params.defaults,
+                        int(np.asarray(attrs).shape[0]),
+                    )
+                    drift.observe(
+                        {k: np.atleast_1d(np.asarray(v)) for k, v in fields.items()},
+                        epoch=epoch,
+                    )
+                except Exception:
+                    log.exception("parameter drift tracking failed")  # never the loop
         return params, opt_state
     finally:
         preempt.__exit__(None, None, None)
@@ -530,6 +591,10 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             rec.merge_summary("phases", phase_timer.summary())
             if watchdog is not None:
                 rec.merge_summary("health", watchdog.status())
+            if skill is not None:
+                rec.merge_summary("skill", skill.status())
+            if drift is not None:
+                rec.merge_summary("drift", drift.status())
 
 
 def main(argv: list[str] | None = None) -> int:

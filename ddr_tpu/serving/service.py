@@ -168,6 +168,10 @@ class ForecastService:
         self.health_cfg = health_cfg or HealthConfig.from_env()
         self.watchdog = HealthWatchdog(self.health_cfg)
         self.metrics = declare_serve_metrics()
+        # Optional hydrologic-skill tracker (attached by a data-assimilation
+        # or shadow-eval loop that holds observations — serving itself has
+        # none); when present its rollup rides /v1/stats as the "skill" slice.
+        self._skill: Any = None
         self._warmup_error: str | None = None
         self._networks: dict[str, NetworkEntry] = {}
         # (network, model) -> AOT-compiled program (jitted.lower().compile())
@@ -710,6 +714,20 @@ class ForecastService:
                 # keeps the residual (and q_min) occupancy-independent
                 mask = jnp.arange(q_prime_b.shape[0]) < n_live
                 health = compute_health(runoff_b, q_prime_b, row_mask=mask)
+                if self.health_cfg.top_k > 0:
+                    # worst-GAUGE selection: the serve output axis IS gauges,
+                    # so the top-K worst output columns (non-finite first,
+                    # then extreme discharge) localize a degradation to the
+                    # gauges producing it — a few more reductions fused into
+                    # the same program, surfaced on /v1/stats
+                    from ddr_tpu.observability.health import compute_output_worst
+
+                    widx, wscore = compute_output_worst(
+                        runoff_b, self.health_cfg.top_k, row_mask=mask
+                    )
+                    health = dataclasses.replace(
+                        health, worst_idx=widx, worst_score=wscore
+                    )
             else:
                 health = None
             return runoff_b, health
@@ -933,10 +951,17 @@ class ForecastService:
             "queue": self._batcher.stats(),
             "compiles": {"hits": hits, "misses": misses, **self.tracker.snapshot()},
             "health": self.watchdog.status(),
+            "skill": None if self._skill is None else self._skill.status(),
             "slo": None if self.slo is None else self.slo.status(),
             "models": self.models_info(),
             "networks": self.networks_info(),
         }
+
+    def attach_skill_tracker(self, tracker: Any) -> None:
+        """Attach a :class:`~ddr_tpu.observability.skill.SkillTracker` whose
+        rollup should ride ``/v1/stats`` as the ``skill`` slice (fed by
+        whatever loop holds observations — data assimilation, shadow eval)."""
+        self._skill = tracker
 
     def close(self, drain: bool = True) -> None:
         self.registry.close()

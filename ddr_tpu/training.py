@@ -157,6 +157,8 @@ def make_train_step(
     warmup: int,
     optimizer: optax.GradientTransformation,
     collect_health: bool = False,
+    health_bands: int = 0,
+    health_topk: int = 8,
     donate: bool = True,
 ):
     """Build the jitted train step for one compiled network shape.
@@ -171,7 +173,11 @@ def make_train_step(
 
     ``collect_health`` appends an on-device
     :class:`~ddr_tpu.observability.health.HealthStats` (route health +
-    pre-clip grad norm) as a 5th return — see :func:`_make_step`.
+    pre-clip grad norm) as a 5th return — see :func:`_make_step`;
+    ``health_bands``/``health_topk`` extend it with the per-level-band
+    segment reductions and worst-reach selection
+    (:func:`ddr_tpu.routing.mc.route`'s spatial attribution — static knobs,
+    part of the same compiled program).
 
     ``donate=True`` (default) donates ``params``/``opt_state`` buffers to the
     step (:func:`_make_step`); pass ``False`` for A/B harnesses that feed the
@@ -188,6 +194,7 @@ def make_train_step(
         result = route(
             network, channels, spatial, q_prime, gauges=gauges, bounds=bounds,
             collect_health=collect_health,
+            health_bands=health_bands, health_topk=health_topk,
         )
         loss, daily = masked_l1_daily(result.runoff, obs_daily, obs_mask, tau, warmup)
         if collect_health:
@@ -208,6 +215,8 @@ def make_batch_train_step(
     optimizer: optax.GradientTransformation,
     remat_bands: bool = False,
     collect_health: bool = False,
+    health_bands: int = 0,
+    health_topk: int = 8,
     donate: bool = True,
     q_prime_wf_permuted: bool = False,
     kernel: str | None = None,
@@ -215,6 +224,12 @@ def make_batch_train_step(
 ):
     """Like :func:`make_train_step` but with the network/channels/gauges as call-time
     arguments, so one jitted function serves every training batch.
+
+    ``health_bands``/``health_topk`` (with ``collect_health``) extend the
+    returned health stats with spatial attribution — per-level-band
+    reductions and the worst-reach selection
+    (:func:`ddr_tpu.routing.mc.route`). Static builder knobs: they change
+    what the one program computes, never how many programs there are.
 
     ``kernel``/``dtype`` are the routing wave-scan implementation and compute
     dtype (the fused-Pallas and bf16-compute/fp32-accumulate axes of
@@ -258,6 +273,7 @@ def make_batch_train_step(
             network, channels, spatial, q_prime, gauges=gauges, bounds=bounds,
             remat_bands=remat_bands and isinstance(network, StackedChunked),
             collect_health=collect_health,
+            health_bands=health_bands, health_topk=health_topk,
             q_prime_permuted=q_prime_wf_permuted and single_ring_wavefront(network),
             kernel=kernel, dtype=dtype,
         )

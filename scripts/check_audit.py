@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""CI guard: `ddr audit --synthetic` must localize an injected anomaly.
+
+The spatial-attribution path — per-reach reductions inside the compiled
+route, level-band segment reductions, worst-reach top-K, and the audit CLI's
+host-side divergence attribution — spans routing + observability + scripts,
+so a refactor in any of them could silently break localization without a
+focused unit test noticing the END-TO-END property that matters: an anomaly
+injected at reach R is reported at reach R's band. This script closes that
+gap the way check_pallas_kernel.py closes the kernel-bit-rot gap: it runs one
+tiny synthetic audit on CPU (a 96-reach basin, one reach's Manning n scaled
+50x) and requires the report to hit both the injected band and the injected
+reach. Exit 0 on a hit, 1 otherwise (the audit CLI's own exit contract).
+
+Run directly (CI) or via the test suite (tests/scripts/test_check_audit.py):
+
+    JAX_PLATFORMS=cpu python scripts/check_audit.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from ddr_tpu.scripts.audit import synthetic_audit
+    except Exception as e:
+        print(f"check_audit: import failed: {e!r}", file=sys.stderr)
+        return 1
+    try:
+        report = synthetic_audit(
+            n=96, t_hours=48, bands=6, top_k=5, seed=0, perturb_scale=50.0
+        )
+    except Exception as e:
+        print(f"check_audit: synthetic audit failed: {e!r}", file=sys.stderr)
+        return 1
+    if not report.get("hit"):
+        inj = report.get("injected") or {}
+        loc = report.get("localized") or {}
+        print(
+            "check_audit: localization missed — injected reach "
+            f"{inj.get('reach')} (band {inj.get('band')}), localized band "
+            f"{loc.get('worst_band')}, worst reaches "
+            f"{[w.get('reach') for w in loc.get('worst_reaches', [])]}",
+            file=sys.stderr,
+        )
+        return 1
+    # the report must also serialize (the CLI writes it verbatim)
+    import json
+
+    with tempfile.TemporaryDirectory() as td:
+        (Path(td) / "audit.json").write_text(json.dumps(report))
+    print(
+        "check_audit: synthetic audit localizes the injected anomaly "
+        f"(reach {report['injected']['reach']}, band {report['injected']['band']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,194 @@
+"""DriftTracker: parameter-field distribution snapshots + watchdog coupling.
+
+Quantile/OOB/non-finite summaries, the reference-snapshot drift index, env
+thresholds (DDR_HEALTH_MAX_PARAM_DRIFT / _MAX_PARAM_OOB), `drift` event
+emission, registry gauges, and the flag() path into HealthWatchdog
+degradation.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.drift import DRIFT_QUANTILES, DriftTracker, drift_index
+from ddr_tpu.observability.events import Recorder, activate, deactivate
+from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
+from ddr_tpu.observability.registry import MetricsRegistry
+
+
+def _fields(seed=0, n=200, lo=0.02, hi=0.2):
+    rng = np.random.default_rng(seed)
+    return {"n": rng.uniform(lo, hi, n)}
+
+
+def _tracker(config=None, watchdog=None, registry=None):
+    return DriftTracker(
+        {"n": (0.01, 0.3), "q_spatial": (0.0, 1.0)},
+        config=config or HealthConfig(),
+        registry=registry or MetricsRegistry(),
+        watchdog=watchdog,
+    )
+
+
+class TestSummaries:
+    def test_first_observe_is_reference(self):
+        tr = _tracker()
+        assert tr.observe(_fields(), epoch=1) == []
+        st = tr.status()
+        f = st["fields"]["n"]
+        assert len(f["quantiles"]) == len(DRIFT_QUANTILES)
+        assert f["oob"] == 0 and f["nonfinite"] == 0
+        assert "drift" not in f  # no reference existed when field 1 summarized
+
+    def test_second_observe_reports_drift(self):
+        tr = _tracker()
+        tr.observe(_fields(), epoch=1)
+        tr.observe(_fields(), epoch=2)  # identical distribution
+        assert tr.status()["fields"]["n"]["drift"] == pytest.approx(0.0, abs=1e-9)
+        tr.observe({"n": _fields()["n"] + 0.05}, epoch=3)
+        d = tr.status()["fields"]["n"]["drift"]
+        # shifted by ~0.05 on a ~0.18-wide reference span
+        assert 0.2 < d < 0.4
+
+    def test_oob_and_nonfinite_counts(self):
+        tr = _tracker()
+        vals = np.array([0.05, 0.5, -0.2, np.nan, np.inf, 0.1])
+        tr.observe({"n": vals})
+        f = tr.status()["fields"]["n"]
+        assert f["oob"] == 2  # 0.5 and -0.2 outside [0.01, 0.3]
+        assert f["nonfinite"] == 2
+
+    def test_unknown_field_skips_oob(self):
+        tr = _tracker()
+        tr.observe({"mystery": np.array([1e9, -1e9])})
+        assert "oob" not in tr.status()["fields"]["mystery"]
+
+    def test_set_reference_explicit(self):
+        tr = _tracker()
+        tr.set_reference(_fields())
+        reasons = tr.observe({"n": _fields()["n"] + 10.0})
+        assert tr.status()["fields"]["n"]["drift"] > 10
+
+
+class TestDriftIndex:
+    def test_zero_for_identical(self):
+        q = np.linspace(0.0, 1.0, 9)
+        assert drift_index(q, q) == 0.0
+
+    def test_unit_for_own_width_shift(self):
+        q = np.linspace(0.0, 1.0, 9)
+        assert drift_index(q + 1.0, q) == pytest.approx(1.0)
+
+    def test_degenerate_reference_span(self):
+        q = np.full(9, 2.0)
+        assert np.isfinite(drift_index(q + 1.0, q))
+
+
+class TestThresholdsAndWatchdog:
+    def test_violations_flag_watchdog(self):
+        reg = MetricsRegistry()
+        cfg = HealthConfig(max_param_drift=0.1, bad_batches=2)
+        wd = HealthWatchdog(cfg, registry=reg)
+        tr = _tracker(config=cfg, watchdog=wd, registry=reg)
+        tr.observe(_fields(), epoch=1)
+        r1 = tr.observe({"n": _fields()["n"] + 1.0}, epoch=2)
+        assert r1 == ["param-drift"]
+        assert not wd.degraded  # bad_batches=2: one violation isn't enough
+        tr.observe({"n": _fields()["n"] + 2.0}, epoch=3)
+        assert wd.degraded
+        assert wd.status()["last_reasons"] == ["param-drift"]
+
+    def test_healthy_batches_do_not_clear_flagged_streak(self):
+        """The contract the flag counter exists for: healthy SOLVE batches
+        land between epoch-end drift checks by construction — they must not
+        reset a drifting-parameters streak, and a clean drift check must."""
+        import jax.numpy as jnp
+
+        from ddr_tpu.observability.health import HealthStats
+
+        reg = MetricsRegistry()
+        cfg = HealthConfig(max_param_drift=0.1, bad_batches=2)
+        wd = HealthWatchdog(cfg, registry=reg)
+        tr = _tracker(config=cfg, watchdog=wd, registry=reg)
+        healthy = HealthStats(
+            nonfinite=jnp.asarray(0, jnp.int32), q_min=jnp.asarray(0.1),
+            q_max=jnp.asarray(1.0), mass_residual=jnp.asarray(0.0),
+        )
+        tr.observe(_fields(), epoch=1)  # reference
+        tr.observe({"n": _fields()["n"] + 1.0}, epoch=2)  # drift 1
+        wd.observe(healthy)  # a healthy solve batch in epoch 3...
+        wd.observe(healthy)
+        tr.observe({"n": _fields()["n"] + 2.0}, epoch=3)  # drift 2
+        assert wd.degraded, "healthy batches cleared the drift streak"
+        # a recovered snapshot clears it
+        tr.observe(_fields(), epoch=4)
+        assert not wd.degraded
+        assert wd.status()["consecutive_flagged"] == 0
+
+    def test_oob_threshold(self):
+        cfg = HealthConfig(max_param_oob=0)
+        tr = _tracker(config=cfg)
+        vals = _fields()["n"].copy()
+        vals[0] = 5.0
+        assert tr.observe({"n": vals}) == ["param-oob"]
+
+    def test_nonfinite_always_violates(self):
+        tr = _tracker()
+        vals = _fields()["n"].copy()
+        vals[0] = np.nan
+        assert tr.observe({"n": vals}) == ["param-nonfinite"]
+
+    def test_env_knobs(self):
+        cfg = HealthConfig.from_env({
+            "DDR_HEALTH_MAX_PARAM_DRIFT": "0.25",
+            "DDR_HEALTH_MAX_PARAM_OOB": "3",
+            "DDR_HEALTH_BANDS": "16",
+            "DDR_HEALTH_TOPK": "4",
+        })
+        assert cfg.max_param_drift == 0.25
+        assert cfg.max_param_oob == 3
+        assert cfg.bands == 16 and cfg.top_k == 4
+
+
+class TestEventsAndMetrics:
+    def test_drift_event_emitted(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        try:
+            tr = _tracker()
+            tr.observe(_fields(), epoch=1)
+            tr.observe({"n": _fields()["n"] + 0.05}, epoch=2)
+        finally:
+            deactivate(rec)
+            rec.close()
+        events = [
+            json.loads(line)
+            for line in (tmp_path / "log.jsonl").read_text().splitlines()
+        ]
+        drifts = [e for e in events if e["event"] == "drift"]
+        assert len(drifts) == 2
+        assert drifts[1]["epoch"] == 2
+        assert drifts[1]["fields"]["n"]["drift"] is not None
+        assert drifts[1]["reasons"] == []
+
+    def test_gauges_mirrored(self):
+        reg = MetricsRegistry()
+        tr = _tracker(registry=reg)
+        tr.observe(_fields())
+        tr.observe({"n": _fields()["n"] + 0.05})
+        g = reg.get("ddr_param_drift")
+        assert g.value(param="n") > 0
+        assert reg.get("ddr_param_oob").value(param="n") == 0
+
+    def test_status_counters(self):
+        cfg = HealthConfig(max_param_oob=0)
+        tr = _tracker(config=cfg)
+        tr.observe(_fields())
+        vals = _fields()["n"].copy()
+        vals[0] = 5.0
+        tr.observe({"n": vals})
+        st = tr.status()
+        assert st["observations"] == 2 and st["violations"] == 1

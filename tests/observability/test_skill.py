@@ -1,0 +1,254 @@
+"""SkillTracker: streaming per-gauge NSE/KGE/percent-bias.
+
+Hand-computed references on tiny series (the module's formulas must match the
+textbook definitions and the offline Metrics battery), streaming equivalence
+(two observes == one concatenated observe), degenerate-gauge NaN contracts,
+the bounded `skill` event payload, and — the cardinality-hygiene satellite —
+an exposition test proving the per-gauge worst-K Prometheus series count
+stays bounded (with `_Instrument.remove()` cleanup) under gauge churn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.events import Recorder, activate, deactivate
+from ddr_tpu.observability.prometheus import render_text
+from ddr_tpu.observability.registry import MetricsRegistry
+from ddr_tpu.observability.skill import (
+    SkillConfig,
+    SkillTracker,
+    gauge_skill_from_sums,
+)
+
+
+def _tracker(top_k=3, registry=None, **kw):
+    return SkillTracker(
+        SkillConfig(top_k=top_k, **kw), registry=registry or MetricsRegistry()
+    )
+
+
+def _col(x):
+    return np.asarray(x, dtype=np.float64)[:, None]
+
+
+class TestHandComputed:
+    def test_perfect_prediction(self):
+        tr = _tracker()
+        obs = _col([1.0, 2.0, 3.0, 4.0])
+        tr.observe(obs, obs, ["g"])
+        r = tr.results()["g"]
+        assert r["nse"] == pytest.approx(1.0)
+        assert r["kge"] == pytest.approx(1.0)
+        assert r["pbias"] == pytest.approx(0.0)
+
+    def test_constant_offset(self):
+        # pred = obs + 1: SSE = 4, ovar = 5 -> NSE = 0.2; r = 1, alpha = 1,
+        # beta = 3.5/2.5 = 1.4 -> KGE = 0.6; pbias = 100 * 4 / 10 = 40
+        tr = _tracker()
+        tr.observe(_col([2.0, 3.0, 4.0, 5.0]), _col([1.0, 2.0, 3.0, 4.0]), ["g"])
+        r = tr.results()["g"]
+        assert r["nse"] == pytest.approx(0.2)
+        assert r["kge"] == pytest.approx(0.6)
+        assert r["pbias"] == pytest.approx(40.0)
+
+    def test_mean_prediction_is_zero_nse(self):
+        obs = _col([1.0, 2.0, 3.0])
+        tr = _tracker()
+        tr.observe(np.full_like(obs, 2.0), obs, ["g"])
+        assert tr.results()["g"]["nse"] == pytest.approx(0.0)
+
+    def test_matches_offline_metrics_battery(self):
+        # the validation battery computes the same NSE/KGE definitions
+        from ddr_tpu.validation.metrics import Metrics
+
+        rng = np.random.default_rng(0)
+        obs = rng.uniform(0.5, 3.0, (20, 4))
+        pred = obs + rng.normal(scale=0.3, size=obs.shape)
+        m = Metrics(pred=pred.T, target=obs.T)
+        tr = _tracker()
+        tr.observe(pred, obs, [f"g{i}" for i in range(4)])
+        res = tr.results()
+        for i in range(4):
+            assert res[f"g{i}"]["nse"] == pytest.approx(float(m.nse[i]), rel=1e-9)
+            assert res[f"g{i}"]["kge"] == pytest.approx(float(m.kge[i]), rel=1e-9)
+            assert res[f"g{i}"]["pbias"] == pytest.approx(float(m.pbias[i]), rel=1e-9)
+
+
+class TestStreaming:
+    def test_two_observes_equal_one(self):
+        rng = np.random.default_rng(1)
+        obs = rng.uniform(0.5, 3.0, (12, 3))
+        pred = obs + rng.normal(scale=0.2, size=obs.shape)
+        ids = ["a", "b", "c"]
+        one = _tracker()
+        one.observe(pred, obs, ids)
+        two = _tracker()
+        two.observe(pred[:5], obs[:5], ids)
+        two.observe(pred[5:], obs[5:], ids)
+        for g in ids:
+            assert two.results()[g]["nse"] == pytest.approx(
+                one.results()[g]["nse"], rel=1e-12
+            )
+
+    def test_nan_masking(self):
+        obs = _col([1.0, np.nan, 3.0, 4.0, 5.0])
+        pred = _col([1.5, 2.0, np.nan, 4.5, 5.5])
+        tr = _tracker()
+        tr.observe(pred, obs, ["g"])
+        # only rows 0, 3, 4 are valid pairs
+        assert tr.results()["g"]["n"] == 3
+
+    def test_new_gauges_join_midstream(self):
+        tr = _tracker()
+        tr.observe(_col([1.0, 2.0, 3.0]), _col([1.0, 2.0, 3.0]), ["a"])
+        pred = np.column_stack([[1.0, 2.0, 3.0], [9.0, 9.0, 9.0]])
+        obs = np.column_stack([[1.0, 2.0, 3.0], [1.0, 2.0, 3.0]])
+        tr.observe(pred, obs, ["a", "b"])
+        res = tr.results()
+        assert res["a"]["nse"] == pytest.approx(1.0)
+        assert res["b"]["n"] == 3
+
+
+class TestDegenerate:
+    def test_too_few_samples_is_nan(self):
+        tr = _tracker()
+        tr.observe(_col([1.0]), _col([1.0]), ["g"])
+        assert tr.results()["g"]["nse"] is None
+
+    def test_constant_obs_nse_nan(self):
+        tr = _tracker()
+        tr.observe(_col([1.0, 2.0, 3.0]), _col([2.0, 2.0, 2.0]), ["g"])
+        r = tr.results()["g"]
+        assert r["nse"] is None  # ovar == 0
+        assert r["pbias"] is not None
+
+    def test_disabled_is_noop(self):
+        tr = SkillTracker(
+            SkillConfig(enabled=False), registry=MetricsRegistry()
+        )
+        assert tr.observe(_col([1.0, 2.0]), _col([1.0, 2.0]), ["g"]) is None
+        assert tr.status()["observations"] == 0
+
+
+class TestEventsAndSummary:
+    def test_skill_event_payload_bounded(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        try:
+            tr = _tracker(top_k=2)
+            rng = np.random.default_rng(2)
+            obs = rng.uniform(0.5, 3.0, (10, 30))
+            pred = obs + rng.normal(scale=0.5, size=obs.shape)
+            summary = tr.observe(
+                pred, obs, [f"g{i}" for i in range(30)], epoch=1, batch=0
+            )
+        finally:
+            deactivate(rec)
+            rec.close()
+        assert summary["gauges"] == 30
+        assert len(summary["worst"]) <= 2  # bounded worst set, never 30
+        assert summary["nse"]["median"] is not None
+        import json
+
+        events = [
+            json.loads(line)
+            for line in (tmp_path / "log.jsonl").read_text().splitlines()
+        ]
+        skill = [e for e in events if e["event"] == "skill"]
+        assert len(skill) == 1
+        assert skill[0]["epoch"] == 1
+        assert "worst" in skill[0] and len(skill[0]["worst"]) <= 2
+        # the full per-gauge vector never rides the event
+        assert "nse_values" not in skill[0]
+
+    def test_worst_ordering(self):
+        tr = _tracker(top_k=2)
+        obs = np.tile(_col([1.0, 2.0, 3.0, 4.0]), (1, 3))
+        pred = obs.copy()
+        pred[:, 1] += 5.0  # bad
+        pred[:, 2] += 1.0  # mediocre
+        s = tr.observe(pred, obs, ["good", "bad", "mid"])
+        assert [w["gauge"] for w in s["worst"]] == ["bad", "mid"]
+
+    def test_status_rollup(self):
+        tr = _tracker()
+        tr.observe(_col([1.0, 2.0, 3.0]), _col([1.0, 2.0, 3.0]), ["g"])
+        st = tr.status()
+        assert st["observations"] == 1 and st["gauges"] == 1
+        assert st["nse"]["median"] == pytest.approx(1.0)
+
+
+class TestCardinalityHygiene:
+    def test_worst_series_bounded_under_churn(self):
+        """The satellite contract: per-gauge Prometheus series are capped at
+        worst-K; a gauge leaving the worst set has its series REMOVED."""
+        reg = MetricsRegistry()
+        tr = _tracker(top_k=2, registry=reg)
+        obs = _col([1.0, 2.0, 3.0, 4.0])
+        rng = np.random.default_rng(3)
+        # 20 rounds, each making a DIFFERENT pair of gauges the worst
+        for round_ in range(20):
+            bad_a, bad_b = f"g{round_}", f"g{round_ + 100}"
+            pred = np.column_stack([
+                obs[:, 0] + 10.0 + round_,  # fresh worst gauge
+                obs[:, 0] + 5.0,
+                obs[:, 0],
+            ])
+            o3 = np.tile(obs, (1, 3))
+            tr.observe(pred, o3, [bad_a, bad_b, f"ok{round_}"])
+        metric = reg.get("ddr_skill_worst_nse")
+        assert len(metric.series()) <= 2, "worst-K series cap violated"
+        text = render_text(reg)
+        worst_lines = [
+            line for line in text.splitlines()
+            if line.startswith("ddr_skill_worst_nse{")
+        ]
+        assert len(worst_lines) <= 2
+        # distributions still flow into the bounded-bucket histograms
+        assert "ddr_skill_nse_bucket" in text
+
+    def test_histograms_have_fixed_buckets(self):
+        reg = MetricsRegistry()
+        tr = _tracker(registry=reg)
+        tr.observe(_col([1.0, 2.0, 3.0]), _col([1.0, 2.0, 3.0]), ["g"])
+        hist = reg.get("ddr_skill_nse")
+        from ddr_tpu.observability.skill import SKILL_BUCKETS
+
+        assert hist.buckets == tuple(sorted(SKILL_BUCKETS))
+
+
+class TestConfig:
+    def test_from_env(self):
+        cfg = SkillConfig.from_env(
+            {"DDR_SKILL_TOPK": "4", "DDR_SKILL_MIN_SAMPLES": "3",
+             "DDR_SKILL_ENABLED": "1"}
+        )
+        assert cfg.top_k == 4 and cfg.min_samples == 3 and cfg.enabled
+
+    def test_env_disable(self):
+        assert not SkillConfig.from_env({"DDR_SKILL_ENABLED": "off"}).enabled
+
+    def test_bad_values_raise(self):
+        with pytest.raises(ValueError):
+            SkillConfig(top_k=-1)
+        with pytest.raises(ValueError):
+            SkillConfig(min_samples=1)
+        with pytest.raises(ValueError):
+            SkillConfig.from_env({"DDR_SKILL_TOPK": "lots"})
+
+    def test_shape_mismatch_raises(self):
+        tr = _tracker()
+        with pytest.raises(ValueError):
+            tr.observe(np.zeros((3, 2)), np.zeros((3, 2)), ["only-one"])
+
+
+class TestSums:
+    def test_gauge_skill_from_sums_direct(self):
+        # sums for pred=[2,3,4,5] vs obs=[1,2,3,4]
+        sums = np.array([[4.0, 14.0, 10.0, 54.0, 30.0, 40.0, 4.0]])
+        out = gauge_skill_from_sums(sums)
+        assert out["nse"][0] == pytest.approx(0.2)
+        assert out["kge"][0] == pytest.approx(0.6)
+        assert out["pbias"][0] == pytest.approx(40.0)

@@ -1,0 +1,191 @@
+"""`ddr metrics summarize`: the Skill and Spatial-health sections, and the
+serving `/v1/stats` worst-gauge (spatial) slice."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.metrics_cli import main
+
+
+def _write(path, events):
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    return path
+
+
+def _base(seq=0):
+    return {"t": float(seq), "wall": 100.0 + seq, "host": 0, "pid": 1, "seq": seq}
+
+
+class TestSkillSection:
+    def test_renders_last_skill_event(self, tmp_path, capsys):
+        events = [
+            {"event": "run_start", "cmd": "train", **_base(0)},
+            {"event": "skill", **_base(1), "gauges": 12, "scored": 10,
+             "nse": {"median": 0.1, "p10": -1.0, "p90": 0.5,
+                     "frac_positive": 0.5},
+             "kge": {"median": 0.2, "p10": -0.5},
+             "pbias": {"median_abs": 30.0, "p90_abs": 80.0},
+             "worst": [{"gauge": "early", "nse": -2.0, "kge": -1.0,
+                        "pbias": 90.0}]},
+            {"event": "skill", **_base(2), "gauges": 12, "scored": 11,
+             "nse": {"median": 0.62, "p10": -0.1, "p90": 0.9,
+                     "frac_positive": 0.8},
+             "kge": {"median": 0.55, "p10": 0.0},
+             "pbias": {"median_abs": 11.0, "p90_abs": 35.0},
+             "worst": [{"gauge": "06191500", "nse": -0.31, "kge": 0.05,
+                        "pbias": 44.0}]},
+        ]
+        p = _write(tmp_path / "run_log.train.jsonl", events)
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "skill    : 11/12 gauges scored" in out
+        assert "NSE median 0.620" in out
+        assert "worst gauges (by NSE):" in out
+        assert "06191500" in out
+        assert "early" not in out  # cumulative stream: last event wins
+
+    def test_no_section_without_skill(self, tmp_path, capsys):
+        p = _write(tmp_path / "run_log.train.jsonl",
+                   [{"event": "run_start", "cmd": "train", **_base(0)}])
+        assert main(["summarize", str(p)]) == 0
+        assert "skill    :" not in capsys.readouterr().out
+
+
+class TestSpatialSection:
+    def test_worst_bands_and_drift_render(self, tmp_path, capsys):
+        events = [
+            {"event": "run_start", "cmd": "train", **_base(0)},
+            {"event": "health", **_base(1), "reasons": ["non-finite"],
+             "nonfinite": 4, "q_min": 0.0, "q_max": 9.0, "mass_residual": 1.0,
+             "consecutive": 1, "worst_band": 2,
+             "band_nonfinite": [0, 0, 4, 0],
+             "band_residual": [0.1, 0.2, 7.5, 0.3],
+             "band_q_max": [1.0, 2.0, 9.0, 3.0],
+             "worst_idx": [17, 4]},
+            {"event": "drift", **_base(2), "epoch": 1, "reasons": [],
+             "fields": {"n": {"quantiles": [0.02, 0.1, 0.2], "drift": 0.04,
+                              "oob": 1, "nonfinite": 0, "n": 64}}},
+        ]
+        p = _write(tmp_path / "run_log.train.jsonl", events)
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "spatial  : 1 violating batches carried band attribution" in out
+        assert "worst bands" in out and "band2" in out
+        assert "worst reaches: 17 (x1)" in out
+        assert "drift    : 1 snapshots (0 violating)" in out
+        assert "n drift 0.0400 oob 1" in out
+
+    def test_plain_health_events_skip_spatial(self, tmp_path, capsys):
+        events = [
+            {"event": "run_start", "cmd": "train", **_base(0)},
+            {"event": "health", **_base(1), "reasons": ["non-finite"],
+             "nonfinite": 1, "q_min": 0.0, "q_max": 2.0,
+             "mass_residual": 0.1, "consecutive": 1},
+        ]
+        p = _write(tmp_path / "run_log.train.jsonl", events)
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "health   : 1 violating batches" in out
+        assert "spatial  :" not in out
+
+
+class TestWatchdogSpatialSlice:
+    def test_observe_remembers_spatial_even_when_healthy(self):
+        import jax.numpy as jnp
+
+        from ddr_tpu.observability.health import (
+            HealthConfig,
+            HealthStats,
+            HealthWatchdog,
+        )
+        from ddr_tpu.observability.registry import MetricsRegistry
+
+        wd = HealthWatchdog(HealthConfig(), registry=MetricsRegistry())
+        stats = HealthStats(
+            nonfinite=jnp.asarray(0, jnp.int32),
+            q_min=jnp.asarray(0.1),
+            q_max=jnp.asarray(2.0),
+            mass_residual=jnp.asarray(0.5),
+            band_nonfinite=jnp.asarray([0, 0], jnp.int32),
+            band_q_min=jnp.asarray([0.1, 0.2]),
+            band_q_max=jnp.asarray([2.0, 1.0]),
+            band_residual=jnp.asarray([0.5, 3.0]),
+            worst_idx=jnp.asarray([7, 3], jnp.int32),
+            worst_score=jnp.asarray([2.0, 1.0]),
+        )
+        assert wd.observe(stats) == []  # healthy
+        spatial = wd.status()["spatial"]
+        assert spatial["worst_band"] == 1  # largest |residual|
+        assert spatial["worst_idx"] == [7, 3]
+
+    def test_flag_feeds_counters(self):
+        from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
+        from ddr_tpu.observability.registry import MetricsRegistry
+
+        wd = HealthWatchdog(HealthConfig(bad_batches=1), registry=MetricsRegistry())
+        assert wd.flag(["param-drift"], epoch=3) == ["param-drift"]
+        assert wd.degraded
+        assert wd.status()["violations"] == 1
+        # flag with nothing is a no-op
+        assert wd.flag([]) == []
+
+
+class TestTrainLoopWiring:
+    def test_train_emits_skill_drift_and_band_health(self, tmp_path, monkeypatch):
+        """e2e: a tiny synthetic single-device train run streams `skill`
+        events per batch, one `drift` event per epoch, carries the band
+        knobs into its ONE compiled step (no recompiles on the repeat
+        epoch), and rolls everything up in run_end."""
+        from ddr_tpu.observability import run_telemetry
+        from ddr_tpu.scripts.train import train
+        from ddr_tpu.validation.configs import Config
+
+        monkeypatch.setenv("DDR_HEALTH_BANDS", "4")
+        monkeypatch.setenv("DDR_HEALTH_TOPK", "3")
+        monkeypatch.delenv("DDR_METRICS_DIR", raising=False)
+        cfg = Config(
+            name="spatial_e2e",
+            geodataset="synthetic",
+            mode="training",
+            kan={"input_var_names": [f"a{i}" for i in range(10)]},
+            experiment={
+                "start_time": "1981/10/01",
+                "end_time": "1981/10/10",
+                "rho": 4,
+                "batch_size": 2,
+                "epochs": 2,
+                "warmup": 1,
+                "learning_rate": {1: 0.01},
+                "shuffle": False,
+            },
+            params={"save_path": str(tmp_path)},
+        )
+        with run_telemetry(cfg, "train"):
+            train(cfg, max_batches=4)
+        events = [
+            json.loads(line)
+            for line in (tmp_path / "run_log.train.jsonl").read_text().splitlines()
+        ]
+        by_type: dict[str, list] = {}
+        for e in events:
+            by_type.setdefault(e["event"], []).append(e)
+        skill = by_type.get("skill", [])
+        assert len(skill) == 4  # one per batch
+        assert skill[-1]["gauges"] >= 1
+        assert skill[-1]["nse"]["median"] is not None
+        drifts = by_type.get("drift", [])
+        # one per COMPLETED epoch (max_batches cuts epoch 2 short mid-loop)
+        assert len(drifts) == 1
+        assert drifts[0]["epoch"] == 1
+        assert set(drifts[0]["fields"]) >= {"n", "q_spatial"}
+        end = by_type["run_end"][-1]
+        assert end["status"] == "ok"
+        assert end["summary"]["skill"]["observations"] == 4
+        assert end["summary"]["drift"]["observations"] == 1
+        # band health rode the one compiled step: same program count as the
+        # aggregate-health baseline (epoch 2 repeats epoch 1's topologies)
+        assert end["summary"]["compile"]["single"]["misses"] <= 2

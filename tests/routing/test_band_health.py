@@ -1,0 +1,210 @@
+"""Spatial health attribution: per-band equivalence across engines.
+
+The band reductions (``route(collect_health=True, health_bands=B)``) must be
+an ENGINE-INDEPENDENT property of the topology + inputs: the step engine's
+scan-carry accumulators, the single-ring wavefront's wf-order reductions, and
+the chunked/stacked engines' band-concat reductions all attribute to the SAME
+level bands (``ddr_tpu.routing.mc.band_ids``) and must agree to float
+associativity — on randomized DAGs, with gauges (the scan-carry path) and
+without, under kernel=pallas|xla, and in bf16 (overflow/ulp-drift band
+counters). Plus the PR contract: band health adds ZERO new jit-cache entries
+to a train step (the knobs are build-time statics of the one program).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddr_tpu.routing.mc import GaugeIndex, band_ids, route
+from ddr_tpu.routing.network import build_network
+from tests.routing.test_adjoint import _build, _random_dag, _random_inputs
+
+ENGINES = ("wavefront", "chunked", "stacked")
+
+
+def _spatial_health(network, channels, params, q_prime, **kw):
+    r = route(
+        network, channels, params, q_prime,
+        collect_health=True, health_bands=4, health_topk=5, **kw,
+    )
+    assert r.reach_stats is None, "route must strip the ReachStats intermediate"
+    return r.health
+
+
+def _assert_band_equal(a, b, label):
+    np.testing.assert_array_equal(
+        np.asarray(a.band_nonfinite), np.asarray(b.band_nonfinite), err_msg=label
+    )
+    for field in ("band_residual", "band_q_min", "band_q_max"):
+        x, y = np.asarray(getattr(a, field)), np.asarray(getattr(b, field))
+        scale = max(np.max(np.abs(x)), 1e-8)
+        np.testing.assert_allclose(
+            x, y, rtol=1e-5, atol=1e-5 * scale, err_msg=f"{label}: {field}"
+        )
+    np.testing.assert_array_equal(
+        np.asarray(a.worst_idx), np.asarray(b.worst_idx), err_msg=f"{label}: worst"
+    )
+
+
+class TestBandEquivalenceAcrossEngines:
+    @pytest.mark.parametrize("seed", (0, 1))
+    def test_all_engines_agree_full_domain(self, seed):
+        rng = np.random.default_rng(seed)
+        n, t = 48, 8
+        rows, cols = _random_dag(rng, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, t)
+        ref = None
+        for engine in ENGINES:
+            net = _build(engine, rows, cols, n)
+            h = _spatial_health(net, channels, params, q_prime)
+            if ref is None:
+                ref = h
+            else:
+                _assert_band_equal(h, ref, engine)
+        # the step engine attributes to the same bands
+        net = build_network(rows, cols, n)
+        h = _spatial_health(net, channels, params, q_prime, engine="step")
+        _assert_band_equal(h, ref, "step")
+
+    def test_step_gauge_carry_path_matches(self):
+        """With gauges, the step engine's per-reach stats ride the scan carry
+        — they must equal the wavefront engine's materialized reductions."""
+        rng = np.random.default_rng(2)
+        n, t = 40, 6
+        rows, cols = _random_dag(rng, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, t)
+        gauges = GaugeIndex.from_ragged(
+            [np.array([n - 1]), np.array([n - 2, n - 3])]
+        )
+        net = build_network(rows, cols, n)
+        h_wf = _spatial_health(net, channels, params, q_prime, gauges=gauges)
+        h_step = _spatial_health(
+            net, channels, params, q_prime, gauges=gauges, engine="step"
+        )
+        _assert_band_equal(h_step, h_wf, "step+gauges vs wavefront+gauges")
+
+    @pytest.mark.parametrize("engine", ("wavefront", "stacked"))
+    def test_pallas_matches_xla(self, engine):
+        rng = np.random.default_rng(3)
+        n, t = 48, 8
+        rows, cols = _random_dag(rng, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, t)
+        net = _build(engine, rows, cols, n)
+        h_x = _spatial_health(
+            net, channels, params, q_prime, kernel="xla", adjoint="analytic"
+        )
+        h_p = _spatial_health(
+            net, channels, params, q_prime, kernel="pallas", adjoint="analytic"
+        )
+        _assert_band_equal(h_p, h_x, f"{engine}: pallas vs xla")
+
+    @pytest.mark.parametrize("engine", ("wavefront", "stacked"))
+    def test_bf16_band_counters(self, engine):
+        rng = np.random.default_rng(4)
+        n, t = 48, 8
+        rows, cols = _random_dag(rng, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, t)
+        net = _build(engine, rows, cols, n)
+        h = _spatial_health(net, channels, params, q_prime, dtype="bf16")
+        assert h.band_overflow is not None and h.band_ulp_drift is not None
+        assert np.asarray(h.band_overflow).sum() == 0  # healthy inputs
+        assert np.all(np.isfinite(np.asarray(h.band_ulp_drift)))
+        # fp32 leaves the mixed-precision band fields empty
+        h32 = _spatial_health(net, channels, params, q_prime)
+        assert h32.band_overflow is None and h32.band_ulp_drift is None
+
+
+class TestLocalization:
+    def test_nan_injection_localizes(self):
+        rng = np.random.default_rng(5)
+        n, t = 48, 8
+        rows, cols = _random_dag(rng, n)
+        channels, params, q_prime, _, _ = _random_inputs(rng, n, t)
+        net = build_network(rows, cols, n)
+        bad = 17
+        qp = np.asarray(q_prime).copy()
+        qp[:, bad] = np.nan
+        h = _spatial_health(net, channels, params, jnp.asarray(qp))
+        ids, nb = band_ids(net.level, net.depth, 4)
+        bad_band = int(np.asarray(ids)[bad])
+        band_nf = np.asarray(h.band_nonfinite)
+        assert band_nf[bad_band] > 0
+        assert bad in np.asarray(h.worst_idx)
+        # global stats see the non-finites too (per-reach view)
+        assert int(h.nonfinite) > 0
+
+    def test_band_ids_partition(self):
+        level = jnp.asarray(np.arange(11), jnp.int32)
+        ids, nb = band_ids(level, 10, 4)
+        ids = np.asarray(ids)
+        assert nb == 4
+        assert ids.min() == 0 and ids.max() == nb - 1
+        assert np.all(np.diff(ids) >= 0)  # monotone in level
+        # more bands than levels: one band per level
+        ids2, nb2 = band_ids(level, 10, 64)
+        assert nb2 == 11
+        np.testing.assert_array_equal(np.asarray(ids2), np.arange(11))
+
+
+class TestNoNewJitCacheEntries:
+    def test_train_step_band_health_single_program(self):
+        """The e2e pin: a batch train step built with band health compiles
+        ONCE and repeat batches (same topology) hit the cache — spatial
+        attribution changes what the program computes, never how many
+        programs there are."""
+        import optax
+
+        from ddr_tpu.routing.mc import Bounds, ChannelState
+        from ddr_tpu.training import make_batch_train_step
+
+        rng = np.random.default_rng(6)
+        n, t = 32, 48
+        rows, cols = _random_dag(rng, n)
+        net = build_network(rows, cols, n)
+        channels = ChannelState(
+            length=jnp.asarray(rng.uniform(500, 5000, n), jnp.float32),
+            slope=jnp.asarray(rng.uniform(1e-3, 1e-2, n), jnp.float32),
+            x_storage=jnp.asarray(rng.uniform(0.1, 0.4, n), jnp.float32),
+        )
+        gauges = GaugeIndex.from_ragged([np.array([n - 1])])
+
+        import flax.linen as nn
+
+        class TinyKan(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                out = jax.nn.sigmoid(nn.Dense(2)(x))
+                return {"n": out[:, 0], "q_spatial": out[:, 1]}
+
+        kan = TinyKan()
+        attrs = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+        params = kan.init(jax.random.PRNGKey(0), attrs)
+        optimizer = optax.adam(1e-3)
+        opt_state = optimizer.init(params)
+        step = make_batch_train_step(
+            kan,
+            Bounds(),
+            {"n": [0.01, 0.3], "q_spatial": [0.0, 1.0]},
+            [],
+            {"p_spatial": 21.0},
+            tau=3,
+            warmup=0,
+            optimizer=optimizer,
+            collect_health=True,
+            health_bands=4,
+            health_topk=5,
+            donate=False,
+        )
+        q_prime = jnp.asarray(rng.uniform(0.1, 2.0, (t, n)), jnp.float32)
+        days = t // 24
+        obs = jnp.asarray(rng.uniform(0.5, 2.0, (days - 2 + 1, 1)), jnp.float32)
+        mask = jnp.ones_like(obs, bool)
+        out = step(params, opt_state, net, channels, gauges, attrs, q_prime, obs, mask)
+        assert step._cache_size() == 1
+        assert out[4].band_residual is not None
+        out = step(params, opt_state, net, channels, gauges, attrs, q_prime, obs, mask)
+        assert step._cache_size() == 1, "band health re-traced a repeat batch"
+        assert np.asarray(out[4].worst_idx).shape == (5,)

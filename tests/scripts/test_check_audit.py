@@ -1,0 +1,45 @@
+"""scripts/check_audit.py: the spatial-attribution smoke gate must pass on a
+clean tree (so a localization regression fails tier-1 fast) and actually
+catch breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_audit.py"
+
+
+def test_repo_audit_smokes_clean():
+    """THE CI gate: one tiny synthetic audit on CPU localizes its injected
+    anomaly to the right band and reach."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "localizes the injected anomaly" in proc.stdout
+
+
+def test_gate_fails_on_broken_audit(tmp_path):
+    """A tree whose audit module cannot import must fail the gate — copy the
+    script next to a stub package with a broken scripts/audit.py."""
+    pkg = tmp_path / "ddr_tpu" / "scripts"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "audit.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_audit.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_audit.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr

@@ -1,0 +1,76 @@
+"""Serving worst-gauge attribution: the on-device top-K worst OUTPUT-column
+selection rides the one compiled serve program, lands on the watchdog's
+spatial slice, and surfaces on /v1/stats — with zero additional jit-cache
+entries and bounded size."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.health import HealthConfig
+from ddr_tpu.observability.registry import MetricsRegistry, set_registry
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    set_registry(MetricsRegistry(const_labels={"host": 0}))
+    yield
+    set_registry(None)
+
+
+@pytest.fixture
+def spatial_service(service_factory):
+    def make(**kw):
+        kw.setdefault("n_segments", 24)
+        kw.setdefault("horizon", 8)
+        return service_factory(
+            health_cfg=HealthConfig(bad_batches=2, top_k=3), **kw
+        )
+
+    return make
+
+
+class TestWorstGaugeSlice:
+    def test_stats_spatial_slice_after_traffic(self, spatial_service):
+        svc = spatial_service()
+        hits0, misses0 = svc.tracker.counts()
+        svc.forecast(network="default", t0=0, timeout=60)
+        s = svc.stats()
+        spatial = s["health"]["spatial"]
+        assert spatial is not None
+        # the output axis is gauges: K worst output columns, bounded at top_k
+        assert len(spatial["worst_idx"]) == 3
+        assert len(spatial["worst_score"]) == 3
+        net = svc.networks()["default"]
+        assert all(0 <= i < net.n_outputs for i in spatial["worst_idx"])
+        # zero new jit-cache entries: the selection rode the same program
+        hits1, misses1 = svc.tracker.counts()
+        assert misses1 == misses0
+
+    def test_healthy_slice_updates_without_violations(self, spatial_service):
+        svc = spatial_service()
+        svc.forecast(network="default", t0=0, timeout=60)
+        assert svc.watchdog.status()["violations"] == 0
+        assert svc.stats()["health"]["spatial"] is not None
+
+    def test_topk_zero_disables_selection(self, service_factory):
+        svc = service_factory(
+            n_segments=24, horizon=8,
+            health_cfg=HealthConfig(bad_batches=2, top_k=0),
+        )
+        svc.forecast(network="default", t0=0, timeout=60)
+        assert svc.stats()["health"]["spatial"] is None
+
+    def test_skill_slice_rides_stats_when_attached(self, spatial_service):
+        svc = spatial_service()
+        assert svc.stats()["skill"] is None
+        from ddr_tpu.observability.skill import SkillConfig, SkillTracker
+
+        tracker = SkillTracker(SkillConfig(top_k=2), registry=MetricsRegistry())
+        obs = np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        tracker.observe(obs + 0.5, obs, ["g1", "g2"])
+        svc.attach_skill_tracker(tracker)
+        skill = svc.stats()["skill"]
+        assert skill["gauges"] == 2
+        assert skill["nse"]["median"] is not None
