@@ -28,12 +28,16 @@ reference's stores legible to :class:`ddr_tpu.io.stores.HydroStore` unchanged.
 from __future__ import annotations
 
 import logging
+import os
+import random
 import re
+import time
 from typing import Any, Callable, Iterator
 
 import numpy as np
 import pandas as pd
 
+from ddr_tpu.observability.faults import InjectedFault, maybe_inject
 from ddr_tpu.io.stores import GroupLike, read_array, register_store_backend
 
 log = logging.getLogger(__name__)
@@ -43,6 +47,7 @@ __all__ = [
     "enable_remote_stores",
     "open_icechunk_group",
     "parse_s3_uri",
+    "read_with_retry",
     "set_default_region",
 ]
 
@@ -60,6 +65,91 @@ def set_default_region(region: str) -> None:
     global _DEFAULT_REGION
     if region:
         _DEFAULT_REGION = str(region)
+
+#: Substrings that mark an exception text as a transient store hiccup even when
+#: the raiser used a bare Exception subclass (botocore/icechunk wrap everything).
+_TRANSIENT_MARKERS = (
+    "timed out",
+    "timeout",
+    "connection reset",
+    "connection aborted",
+    "broken pipe",
+    "temporarily unavailable",
+    "slow down",
+    "too many requests",
+    "service unavailable",
+    "internal error",
+    "500",
+    "502",
+    "503",
+    "504",
+)
+
+
+def _retry_config() -> tuple[int, float]:
+    """``(retries, base_backoff_s)`` from ``DDR_IO_RETRIES`` /
+    ``DDR_IO_RETRY_BACKOFF_S`` (defaults 3 and 0.1; malformed values fall back
+    with a warning rather than killing a data load over an env typo)."""
+    retries, backoff = 3, 0.1
+    raw = os.environ.get("DDR_IO_RETRIES")
+    if raw:
+        try:
+            retries = max(0, int(raw))
+        except ValueError:
+            log.warning(f"malformed DDR_IO_RETRIES={raw!r}; using {retries}")
+    raw = os.environ.get("DDR_IO_RETRY_BACKOFF_S")
+    if raw:
+        try:
+            backoff = max(0.0, float(raw))
+        except ValueError:
+            log.warning(f"malformed DDR_IO_RETRY_BACKOFF_S={raw!r}; using {backoff}")
+    return retries, backoff
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Transient = worth retrying: connection/timeout errors, an
+    :class:`InjectedFault` (so ``crash@data.remote_read:n=2`` exercises the
+    retry loop deterministically), a 5xx status attribute, or a message that
+    reads like a store-side hiccup. Anything else (KeyError on a missing
+    variable, a ValueError from CF decoding) re-raises immediately — retrying
+    a deterministic failure just triples the time to the real error."""
+    if isinstance(exc, (ConnectionError, TimeoutError, InjectedFault)):
+        return True
+    status = getattr(exc, "status", None) or getattr(exc, "status_code", None)
+    try:
+        if status is not None and 500 <= int(status) <= 599:
+            return True
+    except (TypeError, ValueError):
+        pass
+    text = str(exc).lower()
+    return any(marker in text for marker in _TRANSIENT_MARKERS)
+
+
+def read_with_retry(fn: Callable[[], Any], what: str) -> Any:
+    """Run ``fn`` (one remote array read) with bounded retry on transient
+    failures: up to ``DDR_IO_RETRIES`` retries (default 3) with exponential
+    backoff starting at ``DDR_IO_RETRY_BACKOFF_S`` (default 0.1s) plus up to
+    25% jitter, so a fleet of readers hitting the same flaky endpoint doesn't
+    retry in lockstep. The ``data.remote_read`` fault site fires before every
+    attempt, INSIDE the try — an injected crash is absorbed and retried like
+    the connection reset it simulates."""
+    retries, backoff = _retry_config()
+    for attempt in range(retries + 1):
+        try:
+            maybe_inject("data.remote_read", what=what, attempt=attempt)
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classified right below
+            if not _is_transient(e) or attempt >= retries:
+                raise
+            delay = backoff * (2**attempt) * (1 + 0.25 * random.random())
+            log.warning(
+                f"transient failure reading {what} "
+                f"(attempt {attempt + 1}/{retries + 1}): {e}; "
+                f"retrying in {delay:.2f}s"
+            )
+            time.sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover - loop always returns/raises
+
 
 #: Coordinate names recognized as the id dimension, in lookup order
 #: (reference stores use divide_id for forcings, gage_id for observations).
@@ -115,7 +205,9 @@ class _TransposedArray:
         # transpose is always freshly read, so both copy=False (no extra copy
         # happens) and copy=True (the data aliases nothing caller-visible)
         # are satisfied without branching.
-        data = read_array(self._arr).T
+        data = read_with_retry(
+            lambda: read_array(self._arr), what="remote variable block"
+        ).T
         return data if dtype is None else data.astype(dtype, copy=False)
 
 
@@ -141,7 +233,10 @@ class XarrayConventionGroup:
                 f"no id coordinate among {ID_COORDS} in remote group; "
                 "not an xarray-convention hydrology store"
             )
-        ids = read_array(group[self._id_dim])
+        ids = read_with_retry(
+            lambda: read_array(group[self._id_dim]),
+            what=f"id coordinate {self._id_dim!r}",
+        )
         self.attrs: dict[str, Any] = dict(getattr(group, "attrs", {}) or {})
         self.attrs["ids"] = [
             i.decode() if isinstance(i, bytes) else i.item() if hasattr(i, "item") else i
@@ -152,7 +247,12 @@ class XarrayConventionGroup:
         if "time" in group:
             time_arr = group["time"]
             units = dict(getattr(time_arr, "attrs", {}) or {}).get("units")
-            times = _decode_cf_time(read_array(time_arr), units)
+            times = _decode_cf_time(
+                read_with_retry(
+                    lambda: read_array(time_arr), what="time coordinate"
+                ),
+                units,
+            )
             if len(times) > 1:
                 # decide cadence from the WHOLE axis, not times[1]-times[0]: a
                 # store with a gap (or mixed cadence) would otherwise be

@@ -81,7 +81,10 @@ __all__ = [
 #: :mod:`ddr_tpu.observability.skill`); ``drift`` is one parameter-field
 #: distribution snapshot (quantiles, OOB counts, drift-vs-reference index,
 #: :mod:`ddr_tpu.observability.drift`); ``audit`` is one ``ddr audit`` report
-#: marker (:mod:`ddr_tpu.scripts.audit`).
+#: marker (:mod:`ddr_tpu.scripts.audit`). ``reshard`` is one elastic-resume
+#: mesh transition: a checkpoint saved under one device layout restored onto
+#: another (``from_mesh``/``to_mesh`` descriptors,
+#: :func:`ddr_tpu.parallel.sharding.reshard_state`).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -102,6 +105,7 @@ EVENT_TYPES = (
     "skill",
     "drift",
     "audit",
+    "reshard",
 )
 
 

@@ -12,6 +12,9 @@ site                where it fires (host side only, never inside jitted code)
                       write and the atomic rename (a crash leaves a ``.tmp``,
                       a corrupt flips bits under an already-computed manifest)
 ``data.load``         the train loop's prefetch-thread forcing read
+``data.remote_read``  :mod:`ddr_tpu.io.remote`, before each remote zarr/store
+                      array read (a crash simulates the transient connection
+                      reset / 5xx / timeout the bounded-retry loop absorbs)
 ``device.step``       the train loop, immediately before the jitted step
 ``serve.execute``     :class:`~ddr_tpu.serving.service.ForecastService`'s
                       batch worker, before the compiled program runs
@@ -80,6 +83,7 @@ __all__ = [
 FAULT_SITES = (
     "checkpoint.write",
     "data.load",
+    "data.remote_read",
     "device.step",
     "serve.execute",
     "registry.reload",

@@ -14,11 +14,15 @@ from ddr_tpu.parallel.pipeline import (
 )
 from ddr_tpu.parallel.sharding import (
     make_mesh,
+    mesh_descriptor,
+    mesh_mismatch,
     reach_sharding,
     replicated,
+    reshard_state,
     shard_channels,
     shard_network,
     sharded_route,
+    state_sharding_specs,
 )
 from ddr_tpu.parallel.wavefront import (
     ShardedWavefront,
@@ -61,9 +65,13 @@ __all__ = [
     "pipelined_route",
     "topological_range_partition",
     "make_mesh",
+    "mesh_descriptor",
+    "mesh_mismatch",
     "reach_sharding",
     "replicated",
+    "reshard_state",
     "shard_channels",
     "shard_network",
     "sharded_route",
+    "state_sharding_specs",
 ]

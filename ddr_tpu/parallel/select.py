@@ -166,6 +166,19 @@ def plan_build_count() -> int:
     return _PLAN_BUILDS
 
 
+def reset_plan_cache() -> None:
+    """Drop every cached routing plan (``plan_build_count`` keeps counting).
+
+    Mesh identity in the cache key means stale entries can never be *served*
+    to a new mesh, but after an elastic reshard (``ddr train`` resuming on a
+    different device layout, a serving process whose device set changed) the
+    old mesh's plans are dead weight holding device buffers and LRU slots —
+    the resume path clears them so plan selection re-runs cleanly for the
+    new mesh."""
+    cache = _plan_cache()
+    cache.clear()
+
+
 def _plan_cache():
     global _PLAN_CACHE
     if _PLAN_CACHE is None:

@@ -16,6 +16,8 @@ code over a DCN-spanning mesh.
 
 from __future__ import annotations
 
+import hashlib
+import logging
 from typing import Any
 
 import jax
@@ -27,13 +29,19 @@ from ddr_tpu.routing.network import RiverNetwork
 
 __all__ = [
     "make_mesh",
+    "mesh_descriptor",
+    "mesh_mismatch",
     "reach_sharding",
     "replicated",
+    "reshard_state",
     "shard_channels",
     "shard_map_compat",
     "shard_network",
     "sharded_route",
+    "state_sharding_specs",
 ]
+
+log = logging.getLogger(__name__)
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -152,3 +160,144 @@ def sharded_route(
             network, channels, spatial_params, q_prime,
             q_init=q_init, gauges=gauges, bounds=bounds,
         )
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint mesh provenance + elastic resharding
+#
+# A checkpoint is only as portable as the metadata describing how it was laid
+# out. ``mesh_descriptor`` is the JSON-plain fingerprint written into every
+# checkpoint manifest/meta; ``state_sharding_specs`` records the per-leaf
+# PartitionSpec at save time; ``reshard_state`` replays those specs under a
+# DIFFERENT mesh at load time — the path that lets a checkpoint saved on an
+# 8-device slice resume on 4 devices (or 1) after capacity loss.
+# ---------------------------------------------------------------------------
+
+
+def mesh_descriptor(mesh: Mesh | None = None) -> dict[str, Any]:
+    """JSON-plain descriptor of a device mesh (or the global device set).
+
+    ``topology`` hashes the ordered ``platform:id`` device list, so two
+    runtimes agree on the hash iff they see the same devices in the same
+    order — the cheap "is this the layout the checkpoint was saved under?"
+    comparison used by :func:`mesh_mismatch`.
+    """
+    if mesh is None:
+        devices = list(jax.devices())
+        axes = ["device"]
+        shape = [len(devices)]
+    else:
+        devices = list(mesh.devices.flat)
+        axes = [str(a) for a in mesh.axis_names]
+        shape = [int(s) for s in mesh.devices.shape]
+    fingerprint = "|".join(f"{d.platform}:{d.id}" for d in devices)
+    return {
+        "axes": axes,
+        "shape": shape,
+        "n_devices": len(devices),
+        "process_count": int(jax.process_count()),
+        "platform": str(devices[0].platform) if devices else "none",
+        "topology": hashlib.sha256(fingerprint.encode()).hexdigest()[:12],
+    }
+
+
+def mesh_mismatch(saved: dict[str, Any] | None, current: dict[str, Any]) -> bool:
+    """True when a checkpoint's saved mesh descriptor names a different device
+    layout than ``current`` (missing provenance compares equal: a pre-provenance
+    checkpoint loads exactly as before)."""
+    if not saved:
+        return False
+    for key in ("n_devices", "process_count", "topology"):
+        if saved.get(key) != current.get(key):
+            return True
+    if list(saved.get("shape") or []) != list(current.get("shape") or []):
+        return True
+    return False
+
+
+def state_sharding_specs(state: Any) -> dict[str, Any]:
+    """Per-leaf sharding provenance for a state pytree, JSON-plain.
+
+    Returns ``{"paths": [keystr, ...], "leaves": [spec-or-None, ...]}`` in
+    ``tree_flatten`` order. A spec is a list over array dims whose entries are
+    mesh axis names (or lists of names, or None for an unsharded dim); ``None``
+    for the whole leaf means unsharded/replicated — which is also what host
+    numpy snapshots record, truthfully, since a full host copy is layout-free.
+    """
+    paths: list[str] = []
+    specs: list[Any] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        paths.append(jax.tree_util.keystr(path))
+        spec = None
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding) and any(p is not None for p in sh.spec):
+            spec = [list(p) if isinstance(p, tuple) else p for p in sh.spec]
+        specs.append(spec)
+    return {"paths": paths, "leaves": specs}
+
+
+def _spec_to_partition(spec: Any, target_mesh: Mesh, shape: tuple) -> P | None:
+    """Translate a saved per-leaf spec onto ``target_mesh``; None when it does
+    not transfer (axis name absent, or the dim no longer divides evenly)."""
+    if not spec:
+        return P()
+    axis_sizes = dict(zip(target_mesh.axis_names, target_mesh.devices.shape))
+    parts: list[Any] = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            parts.append(None)
+            continue
+        names = list(entry) if isinstance(entry, (list, tuple)) else [entry]
+        span = 1
+        for name in names:
+            if name not in axis_sizes:
+                return None
+            span *= axis_sizes[name]
+        if dim >= len(shape) or span == 0 or shape[dim] % span != 0:
+            return None
+        parts.append(tuple(names) if len(names) > 1 else names[0])
+    return P(*parts)
+
+
+def reshard_state(state: Any, target_mesh: Mesh, plan: dict[str, Any] | None = None) -> Any:
+    """Place every leaf of ``state`` onto ``target_mesh`` per the checkpoint's
+    saved sharding ``plan`` (:func:`state_sharding_specs` output).
+
+    This is the elastic-resume loader: ``state`` is whatever the checkpoint
+    restore produced (host numpy from a pickle blob or an untargeted orbax
+    restore, or device arrays still laid out for the OLD mesh) and the result
+    is the same pytree ``device_put`` onto the new layout — sharded→single
+    (``make_mesh(1)``), single→sharded, grown or shrunk meshes alike. Leaves
+    whose saved spec does not transfer (axis missing from the new mesh, dim no
+    longer divisible) fall back to replicated, which is always correct for
+    this repo's replicated params/optimizer state — the spec is a placement
+    hint, never a correctness requirement.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    plan_specs: list[Any] | None = None
+    if plan:
+        candidate = plan.get("leaves") if isinstance(plan, dict) else None
+        if isinstance(candidate, list) and len(candidate) == len(leaves):
+            plan_specs = candidate
+        else:
+            log.warning(
+                "reshard_state: sharding plan has %s entries for %d leaves; "
+                "replicating all leaves",
+                "?" if not isinstance(candidate, list) else len(candidate),
+                len(leaves),
+            )
+    rep = NamedSharding(target_mesh, P())
+    placed = []
+    for i, leaf in enumerate(leaves):
+        spec = plan_specs[i] if plan_specs is not None else None
+        shape = tuple(getattr(leaf, "shape", ()))
+        partition = _spec_to_partition(spec, target_mesh, shape)
+        if partition is None:
+            log.info(
+                "reshard_state: leaf %d spec %r does not transfer to mesh "
+                "%r; replicating", i, spec, tuple(target_mesh.shape.items()),
+            )
+            partition = P()
+        sharding = rep if partition == P() else NamedSharding(target_mesh, partition)
+        placed.append(jax.device_put(leaf, sharding))
+    return jax.tree_util.tree_unflatten(treedef, placed)

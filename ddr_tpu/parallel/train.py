@@ -244,6 +244,12 @@ class ParallelTrainer:
         _, n = parse_device(cfg.device)
         self.mesh = make_mesh(n)
         self.n_shards = int(self.mesh.devices.size)
+        #: JSON-plain descriptor of this run's mesh — what the checkpoint
+        #: layer records as provenance and elastic resume compares a saved
+        #: checkpoint's descriptor against (sharding.mesh_mismatch).
+        from ddr_tpu.parallel.sharding import mesh_descriptor
+
+        self.mesh_desc = mesh_descriptor(self.mesh)
         self.slope_min = cfg.params.attribute_minimums["slope"]
         self.bounds = Bounds.from_config(cfg.params.attribute_minimums)
         # Built-step LRU: each entry retains a compiled XLA executable, and under
@@ -278,6 +284,15 @@ class ParallelTrainer:
             f"multi-chip training: parallel={mode} over {self.n_shards} devices "
             f"({self.platform})"
         )
+
+    def reshard(self, state: Any, plan: dict | None = None) -> Any:
+        """Re-place a restored checkpoint state pytree onto THIS trainer's
+        mesh per the checkpoint's saved per-leaf ``plan``
+        (:func:`ddr_tpu.parallel.sharding.reshard_state`) — the elastic-resume
+        hook for a checkpoint saved under a different device layout."""
+        from ddr_tpu.parallel.sharding import reshard_state
+
+        return reshard_state(state, self.mesh, plan=plan)
 
     @property
     def _gspmd_step(self):

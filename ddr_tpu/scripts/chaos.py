@@ -30,7 +30,14 @@ Usage::
 
     ddr chaos train --kills 1,2 --out runs/chaos
     ddr chaos train --signal term --kills 1          # graceful-preempt drill
+    ddr chaos train --reshard 4:2                    # elastic mesh-change drill
     ddr chaos serve --synthetic --rps 20 --duration 8 --kill-after 2
+
+``--reshard W1:W2`` turns the train drill into an elastic-resume proof: the
+run trains on a virtual ``cpu:W1`` mesh (checkpoints saved through the sharded
+orbax path with mesh provenance), and every post-kill relaunch boots ``cpu:W2``
+— the trainer must detect the mesh change, reshard the checkpoint, log a
+``reshard`` event per resume, and still reproduce the golden trajectory.
 """
 
 from __future__ import annotations
@@ -95,7 +102,25 @@ def _step_losses(events: list[dict]) -> dict[tuple[int, int], float]:
 # ---------------------------------------------------------------------------
 
 
-def _train_cfg_dict(save_path: Path, checkpoint: Path | None, args) -> dict:
+def _parse_reshard(spec: str | None) -> tuple[int, int] | None:
+    """``"W1:W2"`` -> ``(W1, W2)`` device counts, or None when the flag is off."""
+    if not spec:
+        return None
+    parts = str(spec).split(":")
+    try:
+        w1, w2 = (int(p) for p in parts)
+    except ValueError:
+        raise SystemExit(
+            f"--reshard expects W1:W2 device counts (e.g. 4:2), got {spec!r}"
+        ) from None
+    if w1 < 1 or w2 < 1:
+        raise SystemExit(f"--reshard device counts must be >= 1, got {spec!r}")
+    return w1, w2
+
+
+def _train_cfg_dict(
+    save_path: Path, checkpoint: Path | None, args, device: str | None = None
+) -> dict:
     cfg: dict[str, Any] = {
         "name": "chaos",
         "geodataset": "synthetic",
@@ -119,6 +144,12 @@ def _train_cfg_dict(save_path: Path, checkpoint: Path | None, args) -> dict:
     }
     if checkpoint is not None:
         cfg["experiment"]["checkpoint"] = str(checkpoint)
+    if device is not None:
+        # reshard drill: a virtual cpu:N mesh + the auto parallel engine, so
+        # the subprocess trains SPMD on N devices and its checkpoints carry
+        # that mesh's provenance
+        cfg["device"] = device
+        cfg["experiment"]["parallel"] = "auto"
     return cfg
 
 
@@ -164,13 +195,31 @@ def run_chaos_train(args) -> dict[str, Any]:
     env = _subprocess_env(workdir)
     kills = [int(k) for k in str(args.kills).split(",") if k.strip() != ""]
     sig = signal.SIGTERM if args.signal == "term" else signal.SIGKILL
+    reshard = _parse_reshard(getattr(args, "reshard", None))
+    if getattr(args, "tolerance", None) is None:
+        # same-mesh resume replays bit-identically (1e-4 is slack); a resumed
+        # mesh reorders collective reductions, so reshard drift is ~1e-3
+        args.tolerance = 1e-2 if reshard is not None else 1e-4
+    dev_before = dev_after = None
+    if reshard is not None:
+        dev_before, dev_after = (f"cpu:{w}" for w in reshard)
+        # the sharded async orbax path is the thing under drill; an explicit
+        # DDR_CKPT_FORMAT in the caller's environment still wins
+        env.setdefault("DDR_CKPT_FORMAT", "orbax")
+        # both meshes must fit on the host: give the subprocesses enough
+        # virtual CPU devices unless the caller already pinned a count
+        if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+            flag = f"--xla_force_host_platform_device_count={max(reshard)}"
+            env["XLA_FLAGS"] = f"{env.get('XLA_FLAGS', '')} {flag}".strip()
 
     import yaml
 
     # ---- golden: the uninterrupted reference trajectory ----
     golden_dir = workdir / "golden"
     golden_cfg = workdir / "golden.yaml"
-    golden_cfg.write_text(yaml.safe_dump(_train_cfg_dict(golden_dir, None, args)))
+    golden_cfg.write_text(
+        yaml.safe_dump(_train_cfg_dict(golden_dir, None, args, device=dev_before))
+    )
     log.info(f"chaos train: golden run -> {golden_dir}")
     proc = _launch(["train", str(golden_cfg)], env, workdir / "golden.out")
     rc = proc.wait(timeout=args.timeout)
@@ -189,16 +238,39 @@ def run_chaos_train(args) -> dict[str, Any]:
     # newest verified checkpoint (corrupt/torn ones quarantined + skipped)
     chaos_cfg.write_text(
         yaml.safe_dump(
-            _train_cfg_dict(chaos_dir, chaos_dir / "saved_models", args)
+            _train_cfg_dict(
+                chaos_dir, chaos_dir / "saved_models", args, device=dev_before
+            )
         )
     )
+    # reshard drill: the initial chaotic run trains on the BEFORE mesh; every
+    # post-kill relaunch boots the AFTER mesh and must reshard-load the
+    # before-mesh checkpoint (the elastic-resume path under test). Without
+    # --reshard the resume config IS the chaos config.
+    resume_cfg = chaos_cfg
+    if reshard is not None:
+        resume_cfg = workdir / "chaos_resume.yaml"
+        resume_cfg.write_text(
+            yaml.safe_dump(
+                _train_cfg_dict(
+                    chaos_dir, chaos_dir / "saved_models", args, device=dev_after
+                )
+            )
+        )
     chaos_steps: dict[tuple[int, int], float] = {}
     chaos_log = chaos_dir / "run_log.train.jsonl"
     recoveries: list[float] = []
     killed_at: list[int] = []
+    # each relaunch truncates the run log, so reshard events (like steps) must
+    # be harvested WHILE their process lives; (pid, seq) dedupes across polls
+    reshard_markers: set[tuple] = set()
 
     def _max_batch_seen() -> int:
-        steps = _step_losses(_read_jsonl(chaos_log))
+        events = _read_jsonl(chaos_log)
+        for e in events:
+            if e.get("event") == "reshard":
+                reshard_markers.add((e.get("pid"), e.get("seq")))
+        steps = _step_losses(events)
         chaos_steps.update(steps)
         return max((b for _, b in steps), default=-1)
 
@@ -221,9 +293,16 @@ def run_chaos_train(args) -> dict[str, Any]:
         # survivable too (resume replays from the previous checkpoint), just
         # not the scenario this harness pins.
         saved = chaos_dir / "saved_models"
-        _wait_for(
-            lambda: any(saved.glob(f"_*_epoch_*_mb_{kill_batch}.pkl")), proc, 15.0
-        )
+
+        def _ckpt_landed(b: int = kill_batch) -> bool:
+            # pickle blob, or an orbax dir whose meta.json completeness marker
+            # has landed (a meta-less dir is a torn write every scan skips)
+            return any(saved.glob(f"_*_epoch_*_mb_{b}.pkl")) or any(
+                (d / "meta.json").exists()
+                for d in saved.glob(f"_*_epoch_*_mb_{b}.orbax")
+            )
+
+        _wait_for(_ckpt_landed, proc, 15.0)
         t_kill = time.monotonic()
         try:
             proc.send_signal(sig)
@@ -246,7 +325,7 @@ def run_chaos_train(args) -> dict[str, Any]:
         # unambiguous "the NEW process made progress" marker even when it
         # replays a batch whose checkpoint the kill tore)
         proc = _launch(
-            ["train", str(chaos_cfg)], env, workdir / f"chaos_{n + 1}.out"
+            ["train", str(resume_cfg)], env, workdir / f"chaos_{n + 1}.out"
         )
 
         def _resumed(pid: int = proc.pid) -> bool:
@@ -303,9 +382,21 @@ def run_chaos_train(args) -> dict[str, Any]:
             default=0.0,
         )
 
+    # reshard drill: the first relaunch boots a different mesh than the
+    # checkpoint was saved on, so the trainer must have logged a `reshard`
+    # event — zero events means the elastic path silently never engaged
+    for e in _read_jsonl(chaos_log):
+        if e.get("event") == "reshard":
+            reshard_markers.add((e.get("pid"), e.get("seq")))
+    reshard_events = len(reshard_markers)
     passed = (
         not missing and loss_delta <= args.tolerance and params_delta <= args.tolerance
     )
+    if reshard is not None:
+        # only the FIRST resume crosses meshes (later resumes restore
+        # checkpoints the after-mesh processes saved themselves), so the bar
+        # is >= 1, not one per kill
+        passed = passed and reshard_events >= 1
     return {
         "kind": "chaos",
         "schema_version": 1,
@@ -313,6 +404,8 @@ def run_chaos_train(args) -> dict[str, Any]:
         "label": args.label,
         "device": _device_platform(),
         "signal": args.signal,
+        "reshard": f"{reshard[0]}:{reshard[1]}" if reshard is not None else None,
+        "reshard_events": reshard_events if reshard is not None else None,
         "kills": killed_at,
         "steps_golden": len(golden_steps),
         "steps_chaos": len(chaos_steps),
@@ -508,6 +601,11 @@ def render_summary(report: dict[str, Any]) -> str:
         + ("PASSED" if report.get("passed") else "FAILED")
     ]
     if report["mode"] == "train":
+        if report.get("reshard"):
+            lines.append(
+                f"  reshard  {report['reshard']} devices — "
+                f"{report.get('reshard_events')} reshard event(s) logged"
+            )
         lines.append(
             f"  kills    {report.get('kills')} ({report.get('signal')}) — "
             f"{report.get('steps_chaos')}/{report.get('steps_golden')} steps covered, "
@@ -551,11 +649,18 @@ def main(argv: list[str] | None = None) -> int:
                          f"(default {','.join(map(str, DEFAULT_KILLS))})")
     p_train.add_argument("--signal", choices=("kill", "term"), default="kill",
                          help="kill -9 (hard preemption) or SIGTERM (graceful drill)")
+    p_train.add_argument("--reshard", default=None, metavar="W1:W2",
+                         help="elastic-resume drill: train on a cpu:W1 mesh, "
+                         "resume every kill on cpu:W2 (checkpoints saved via the "
+                         "sharded orbax path unless DDR_CKPT_FORMAT overrides)")
     p_train.add_argument("--segments", type=int, default=48,
                          help="synthetic reach count (default 48)")
     p_train.add_argument("--epochs", type=int, default=1)
-    p_train.add_argument("--tolerance", type=float, default=1e-4,
-                         help="max |loss/params delta| vs the golden run (default 1e-4)")
+    p_train.add_argument("--tolerance", type=float, default=None,
+                         help="max |loss/params delta| vs the golden run (default "
+                         "1e-4; 1e-2 with --reshard — a different mesh reorders "
+                         "the gspmd collective reductions, so cross-mesh resume "
+                         "carries inherent ~1e-3 float drift)")
     p_train.add_argument("--timeout", type=float, default=600.0,
                          help="per-subprocess wall ceiling, seconds")
 

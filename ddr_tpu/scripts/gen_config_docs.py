@@ -48,6 +48,10 @@ variables. Families with their own reference tables are linked.
   ring".
 - `DDR_SERVE_*` — serving: see docs/serving.md.
 - `DDR_BENCH_*` — `bench.py`: see `python bench.py --help`.
+- `DDR_CKPT_*` (format/async/retention), `DDR_IO_RETRIES`,
+  `DDR_IO_RETRY_BACKOFF_S`, `DDR_FAULTS` / `DDR_FAULTS_SEED` — robustness:
+  checkpointing, elastic resume & resharding, remote-read retries, fault
+  injection: see docs/robustness.md.
 """
 
 

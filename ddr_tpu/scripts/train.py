@@ -40,11 +40,13 @@ from ddr_tpu.scripts.common import (
 from ddr_tpu.training import (
     AsyncCheckpointWriter,
     async_checkpoint_from_env,
+    checkpoint_format_from_env,
     load_state,
     make_batch_train_step,
     make_optimizer,
     prune_checkpoints_from_env,
     save_state,
+    save_state_orbax,
     set_learning_rate,
 )
 from ddr_tpu.validation.configs import Config
@@ -195,6 +197,52 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             # wavefront batches (wf-hoist fast path; one shared predicate)
             q_prime_wf_permuted=True,
         )
+
+    # Elastic resume (docs/robustness.md "Elastic resume & resharding"): every
+    # checkpoint records the mesh it was saved under; when this run's layout
+    # differs (a preempted slice came back smaller, cpu:8 -> cpu:4 -> 1), the
+    # restored state is re-placed for the CURRENT mesh per the saved per-leaf
+    # plan and the transition is logged as one `reshard` event. Plan/engine
+    # selection re-runs naturally afterwards — select.py keys its caches by
+    # (topology, mesh) — and the old mesh's cached plans are dropped outright.
+    if ckpt is not None and meta.get("mesh"):
+        from ddr_tpu.parallel.select import reset_plan_cache
+        from ddr_tpu.parallel.sharding import (
+            make_mesh,
+            mesh_descriptor,
+            mesh_mismatch,
+            reshard_state,
+        )
+
+        runtime_mesh = par.mesh if par is not None else None
+        runtime_desc = mesh_descriptor(runtime_mesh)
+        mismatch = mesh_mismatch(meta["mesh"], runtime_desc)
+        # A parallel run re-places even on a MATCHING mesh: an orbax restore
+        # lands committed on one device, and gspmd refuses mixed placements.
+        if mismatch or par is not None:
+            state = reshard_state(
+                {"params": params, "opt_state": opt_state},
+                runtime_mesh if runtime_mesh is not None else make_mesh(1),
+                plan=meta.get("sharding"),
+            )
+            params, opt_state = state["params"], state["opt_state"]
+        if mismatch:
+            reset_plan_cache()
+            log.warning(
+                f"checkpoint {ckpt.name} was saved on "
+                f"{meta['mesh'].get('n_devices')} device(s), this run has "
+                f"{runtime_desc['n_devices']}: state resharded for the new mesh"
+            )
+            if rec is not None:
+                rec.emit(
+                    "reshard",
+                    from_mesh=meta["mesh"],
+                    to_mesh=runtime_desc,
+                    epoch=start_epoch,
+                    batch=start_mini_batch,
+                    checkpoint=ckpt.name,
+                )
+
     slope_min = cfg.params.attribute_minimums["slope"]
     n_done = 0
     throughput = Throughput(label="train")
@@ -257,6 +305,12 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
         if (async_checkpoint_from_env() and not multiprocess and is_primary)
         else None
     )
+    # DDR_CKPT_FORMAT=orbax routes single-process saves through the sharded
+    # orbax path (writer-thread commit, meta-last completeness marker) so a
+    # single-controller mesh run writes the directory form elastic resume
+    # reshards from; the multiprocess collective saves below are always orbax.
+    ckpt_fmt = checkpoint_format_from_env()
+    par_mesh = par.mesh if par is not None else None
     # Preemption (SIGTERM, first SIGINT): finish the in-flight batch, drain
     # the checkpoint writer, perform ONE emergency save, exit cleanly — a
     # preempted spot VM resumes from this batch, not the last cadence save.
@@ -268,8 +322,13 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     def _preempt_save(epoch: int, batch: int) -> None:
         if ckpt_writer is not None:
             ckpt_writer.drain()
-        if not multiprocess and is_primary:
-            path = save_state(
+        path = None
+        if multiprocess:
+            # collective emergency save: a preempted slice signals every
+            # process, so they all enter the same orbax save the in-loop
+            # cadence uses — no more meshless primary-only blob that per-host
+            # storage cannot resume from
+            path = save_state_orbax(
                 ckpt_dir,
                 f"{cfg.name}-preempt",
                 epoch,
@@ -278,11 +337,33 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 opt_state,
                 rng_state=loader.state(),
                 arch=kan_arch(cfg),
+                mesh=par_mesh,
             )
+        elif is_primary:
+            save_fn = save_state_orbax if ckpt_fmt == "orbax" else save_state
+            path = save_fn(
+                ckpt_dir,
+                f"{cfg.name}-preempt",
+                epoch,
+                batch,
+                params,
+                opt_state,
+                rng_state=loader.state(),
+                arch=kan_arch(cfg),
+                mesh=par_mesh,
+            )
+        if path is not None:
             log.warning(f"preemption ({preempt.reason}): emergency checkpoint {path}")
         if rec is not None:
+            from ddr_tpu.parallel.sharding import mesh_descriptor
+
             rec.emit(
-                "preempt", reason=preempt.reason, epoch=epoch, batch=batch, step=n_done
+                "preempt",
+                reason=preempt.reason,
+                epoch=epoch,
+                batch=batch,
+                step=n_done,
+                mesh=mesh_descriptor(par_mesh),
             )
 
     # try/finally so the aggregate summary survives every exit path, including the
@@ -458,8 +539,6 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
                     if multiprocess:
                         # collective multi-host checkpoint (all processes call it)
-                        from ddr_tpu.training import save_state_orbax
-
                         with phase_timer.phase("checkpoint", into=phase_s):
                             save_state_orbax(
                                 cfg.params.save_path / "saved_models",
@@ -470,6 +549,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                 opt_state,
                                 rng_state=loader.state(),
                                 arch=kan_arch(cfg),
+                                mesh=par_mesh,
                             )
                     if is_primary:
                         gage_ids = rd.observations.gage_ids
@@ -499,10 +579,17 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             # next device_step. Sync (DDR_CKPT_ASYNC=0): the
                             # whole write bills to this phase, as before.
                             with phase_timer.phase("checkpoint", into=phase_s):
-                                saver = (
-                                    ckpt_writer.save if ckpt_writer is not None
-                                    else save_state
-                                )
+                                if ckpt_fmt == "orbax":
+                                    saver = (
+                                        ckpt_writer.save_orbax
+                                        if ckpt_writer is not None
+                                        else save_state_orbax
+                                    )
+                                else:
+                                    saver = (
+                                        ckpt_writer.save if ckpt_writer is not None
+                                        else save_state
+                                    )
                                 saver(
                                     ckpt_dir,
                                     cfg.name,
@@ -512,6 +599,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                     opt_state,
                                     rng_state=loader.state(),
                                     arch=kan_arch(cfg),
+                                    mesh=par_mesh,
                                 )
                                 if ckpt_writer is None:
                                     prune_checkpoints_from_env(ckpt_dir)

@@ -41,14 +41,31 @@ def device_params(params: Any) -> Any:
     ``register``/``swap_params`` apply this to EVERY params pytree entering the
     registry, so the 'one compile per (network, model) pair' invariant holds
     regardless of which path (in-memory, checkpoint, notebook) supplied the
-    params. No-op without jax."""
+    params. No-op without jax.
+
+    Reshard-on-load: a leaf that arrives still SHARDED across multiple devices
+    (an orbax restore of a training-mesh checkpoint hands back arrays in their
+    saved layout) is pulled to host and re-placed like any numpy leaf — serving
+    params are replicated jit arguments, and a stale training sharding would
+    otherwise compile a second program per layout and pin the old mesh's
+    buffers alive."""
     try:
         import jax.numpy as jnp
     except ImportError:  # jax-free process (registry unit tests): keep as-is
         return params
     import jax
 
-    return jax.tree_util.tree_map(jnp.asarray, params)
+    def _place(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            try:
+                multi_device = len(x.sharding.device_set) > 1
+            except Exception:  # noqa: BLE001 - exotic array types: treat as local
+                multi_device = False
+            if multi_device:
+                return jnp.asarray(jax.device_get(x))
+        return jnp.asarray(x)
+
+    return jax.tree_util.tree_map(_place, params)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -243,6 +260,22 @@ class CheckpointWatcher(threading.Thread):
             t0 = time.perf_counter()
             maybe_inject("registry.reload", path=str(path), model=self._model)
             blob = load_state(path, expected_arch=self._arch)
+            saved_mesh = blob.get("mesh")
+            if saved_mesh:
+                # mesh provenance: the checkpoint may come from ANY training
+                # layout — device_params replicates it for serving either way,
+                # but a cross-mesh load is worth one info line per reload
+                try:
+                    from ddr_tpu.parallel.sharding import mesh_descriptor, mesh_mismatch
+
+                    if mesh_mismatch(saved_mesh, mesh_descriptor()):
+                        log.info(
+                            f"checkpoint {path.name} was saved on "
+                            f"{saved_mesh.get('n_devices')} device(s); "
+                            "resharding params for this serving process"
+                        )
+                except ImportError:  # jax-free process: provenance is advisory
+                    pass
             entry = self._registry.swap_params(
                 self._model, blob["params"], source=str(path)
             )

@@ -314,7 +314,15 @@ class ForecastService:
     def watch_checkpoints(self, name: str, directory, poll_s: float | None = None):
         """Hot-reload ``name`` from the newest checkpoint under ``directory``
         (ServeConfig ``reload_poll_s`` cadence; 0 disables). Each applied
-        reload bumps ``ddr_hot_reloads_total`` and ``ddr_model_version``."""
+        reload bumps ``ddr_hot_reloads_total`` and ``ddr_model_version``.
+
+        Checkpoints saved under ANY training mesh load here: params are
+        replicated jit arguments, so the watcher's ``device_params`` re-places
+        whatever layout the trainer wrote (``registry.device_params``
+        reshard-on-load) — no recompile beyond the usual values-only swap, and
+        half-committed sharded checkpoints (an ``.orbax`` dir missing its
+        ``meta.json`` completeness marker) are skipped by the scan exactly
+        like torn pickle writes."""
         poll = self.serve_cfg.reload_poll_s if poll_s is None else poll_s
         if poll <= 0:
             log.info("checkpoint watching disabled (reload_poll_s <= 0)")

@@ -444,6 +444,18 @@ CHECKPOINT_FORMAT = "ddr-tpu-checkpoint"
 CHECKPOINT_VERSION = 2
 
 
+def _mesh_provenance(mesh: Any) -> dict:
+    """Normalize a ``mesh`` checkpoint argument (a live ``Mesh``, an
+    already-built descriptor dict — e.g. snapshotted on the loop thread for the
+    async writer — or None for "the global device set") into the JSON-plain
+    descriptor recorded in every manifest/meta."""
+    if isinstance(mesh, dict):
+        return mesh
+    from ddr_tpu.parallel.sharding import mesh_descriptor
+
+    return mesh_descriptor(mesh)
+
+
 def save_state(
     save_dir: str | Path,
     name: str,
@@ -453,14 +465,24 @@ def save_state(
     opt_state: Any,
     rng_state: Any = None,
     arch: dict | None = None,
+    mesh: Any = None,
 ) -> Path:
     """Mid-epoch resumable checkpoint (reference validation/utils.py:12-78): model
     params, optimizer state, and data-sampling RNG state, named
     ``_{name}_epoch_{E}_mb_{B}.pkl``. ``arch`` records the architecture
-    hyperparameters the params assume; ``load_state`` cross-checks it."""
+    hyperparameters the params assume; ``load_state`` cross-checks it.
+    ``mesh`` (a Mesh, a prebuilt descriptor dict, or None for the global device
+    set) plus the live leaves' sharding specs are recorded in the blob AND the
+    manifest, so an elastic resume on a different device layout knows what it
+    is resharding *from* (:func:`ddr_tpu.parallel.sharding.reshard_state`)."""
+    from ddr_tpu.parallel.sharding import state_sharding_specs
+
     save_dir = Path(save_dir)
     save_dir.mkdir(parents=True, exist_ok=True)
     path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.pkl"
+    mesh_desc = _mesh_provenance(mesh)
+    # provenance BEFORE device_get: the host copy below is layout-free
+    sharding = state_sharding_specs({"params": params, "opt_state": opt_state})
     blob = {
         "format": CHECKPOINT_FORMAT,
         "version": CHECKPOINT_VERSION,
@@ -470,6 +492,8 @@ def save_state(
         "opt_state": jax.device_get(opt_state),
         "rng_state": rng_state,
         "arch": arch,
+        "mesh": mesh_desc,
+        "sharding": sharding,
     }
     data = pickle.dumps(blob)
     # tmp + atomic rename: concurrent readers (the serving layer's
@@ -490,7 +514,7 @@ def save_state(
         tmp.write_bytes(mutated)
     # manifest BEFORE the blob rename: every complete blob has its manifest,
     # and an orphan manifest beside a leftover .tmp is harmless
-    _write_manifest(path, data)
+    _write_manifest(path, data, mesh=mesh_desc)
     os.replace(tmp, path)
     return path
 
@@ -500,15 +524,19 @@ def _manifest_path(path: Path) -> Path:
     return path.with_name(path.name + ".manifest.json")
 
 
-def _write_manifest(path: Path, data: bytes) -> Path:
+def _write_manifest(path: Path, data: bytes, mesh: dict | None = None) -> Path:
     """Content checksum + byte length beside the blob (atomic rename — the
-    manifest itself must never be observable half-written)."""
+    manifest itself must never be observable half-written). ``mesh`` adds the
+    device-layout provenance so resharding tooling can read it without
+    unpickling the blob."""
     manifest = {
         "format": "ddr-tpu-ckpt-manifest",
         "version": 1,
         "sha256": hashlib.sha256(data).hexdigest(),
         "bytes": len(data),
     }
+    if mesh is not None:
+        manifest["mesh"] = mesh
     mpath = _manifest_path(path)
     tmp = mpath.with_name(mpath.name + ".tmp")
     tmp.write_text(json.dumps(manifest))
@@ -650,6 +678,26 @@ def _validate_meta(blob: Any, path: Path, expected_arch: dict | None) -> dict:
     missing = {"epoch", "mini_batch"} - blob.keys()
     if missing:
         raise ValueError(f"checkpoint {path} missing fields: {sorted(missing)}")
+    # Mesh/sharding provenance is OPTIONAL (pre-provenance checkpoints carry
+    # neither) but must be well-formed when present: a mangled descriptor
+    # would otherwise surface as a confusing failure deep inside
+    # reshard_state, after the arrays were already read.
+    mesh = blob.get("mesh")
+    if mesh is not None and (
+        not isinstance(mesh, dict) or not isinstance(mesh.get("n_devices"), int)
+    ):
+        raise ValueError(
+            f"checkpoint {path} has a malformed mesh descriptor: {mesh!r} "
+            "(want a dict with an integer n_devices)"
+        )
+    sharding = blob.get("sharding")
+    if sharding is not None and (
+        not isinstance(sharding, dict) or not isinstance(sharding.get("leaves"), list)
+    ):
+        raise ValueError(
+            f"checkpoint {path} has a malformed sharding plan (want a dict "
+            "with a leaves list, as state_sharding_specs writes)"
+        )
     saved_arch = blob.get("arch")
     if expected_arch is not None and saved_arch is not None and saved_arch != expected_arch:
         diff = {
@@ -682,6 +730,8 @@ def save_state_orbax(
     opt_state: Any,
     rng_state: Any = None,
     arch: dict | None = None,
+    mesh: Any = None,
+    sharding: dict | None = None,
 ) -> Path:
     """Orbax-backed checkpoint: ``_{name}_epoch_{E}_mb_{B}.orbax/`` holding the
     array pytrees under ``state/`` (orbax StandardCheckpointer — the
@@ -696,11 +746,19 @@ def save_state_orbax(
     # Validate BEFORE the collective array save and on EVERY process: raising
     # on process 0 alone after ckptr.save would leave the other hosts hanging
     # in the completion barrier below.
+    from ddr_tpu.parallel.sharding import state_sharding_specs
+
     _require_json_plain(rng_state, "rng_state")
     save_dir = Path(save_dir).resolve()
     save_dir.mkdir(parents=True, exist_ok=True)
     path = save_dir / f"_{name}_epoch_{epoch}_mb_{mini_batch}.orbax"
     state = {"params": params, "opt_state": opt_state}
+    # provenance from the LIVE leaves, before any globalization rewrites them
+    # (the async writer passes specs it captured on the loop thread instead —
+    # by the time the writer thread runs, only layout-free host copies remain)
+    mesh_desc = _mesh_provenance(mesh)
+    if sharding is None:
+        sharding = state_sharding_specs(state)
     if jax.process_count() > 1:
         # orbax refuses host-local (single-device) arrays in a multi-process
         # setting — replicated leaves (KAN params, optax counters) must become
@@ -739,9 +797,24 @@ def save_state_orbax(
             "mini_batch": mini_batch,
             "rng_state": rng_state,
             "arch": arch,
+            "mesh": mesh_desc,
+            "sharding": sharding,
         }
+        meta_bytes = json.dumps(meta, default=_json_np).encode()
+        # Fault point between the array commit and the completeness marker:
+        # a `crash` here is the torn SHARDED write — state/ exists but
+        # meta.json does not, so every scan quarantines the whole step
+        # (skips the dir) instead of resuming from half a checkpoint.
+        from ddr_tpu.observability.faults import maybe_inject
+
+        mutated = maybe_inject(
+            "checkpoint.write",
+            data=meta_bytes, path=str(path), epoch=epoch, mini_batch=mini_batch,
+        )
+        if mutated is not None:
+            meta_bytes = mutated
         tmp = path / ".meta.json.tmp"
-        tmp.write_text(json.dumps(meta, default=_json_np))
+        tmp.write_bytes(meta_bytes)
         tmp.rename(path / "meta.json")
     if jax.process_count() > 1:
         # Barrier: non-zero processes must not return (and possibly read the
@@ -870,9 +943,14 @@ def load_state_orbax(
             else:
                 # untargeted: restore every leaf as a HOST numpy array (no
                 # device placement, so no topology to mismatch); the tree
-                # structure comes from the checkpoint's own metadata
+                # structure comes from the checkpoint's own metadata (older
+                # orbax wraps the tree in .item_metadata.tree, 0.7 returns it
+                # directly)
                 pt = ocp.PyTreeCheckpointer()
-                meta_tree = pt.metadata(path / "state").item_metadata.tree
+                meta_tree = pt.metadata(path / "state")
+                meta_tree = getattr(
+                    getattr(meta_tree, "item_metadata", meta_tree), "tree", meta_tree
+                )
                 restore_args = jax.tree_util.tree_map(
                     lambda _m: ocp.RestoreArgs(restore_type=_np.ndarray), meta_tree
                 )
@@ -884,7 +962,12 @@ def load_state_orbax(
 
 
 def checkpoint_candidates(save_dir: str | Path) -> list[Path]:
-    """Every COMPLETE checkpoint under ``save_dir``, newest-first by mtime.
+    """Every COMPLETE checkpoint under ``save_dir``, newest-first by the
+    PARSED ``(epoch, mini_batch)`` from the filename, mtime breaking ties only
+    (e.g. a ``-preempt`` emergency blob written after the cadence save of the
+    same step wins its tie). Filesystem timestamps are not training progress:
+    a restored-from-backup directory or clock skew across hosts reorders
+    mtimes freely, and a pure-mtime scan then resumes from the wrong "latest".
 
     ``.tmp`` leftovers (a write the writer never finished), ``.corrupt``
     quarantine renames, and orbax dirs without their ``meta.json``
@@ -908,11 +991,17 @@ def checkpoint_candidates(save_dir: str | Path) -> list[Path]:
         except OSError:  # racing a quarantine/GC rename: treat as gone
             return float("-inf")
 
-    return sorted([*pkls, *orbax], key=_mtime, reverse=True)
+    def _order(p: Path) -> tuple:
+        em = _checkpoint_epoch_mb(p)
+        # off-pattern names (unreachable via the globs above, but defensive)
+        # sort below every parsed checkpoint
+        return (em if em is not None else (-1, -1), _mtime(p))
+
+    return sorted([*pkls, *orbax], key=_order, reverse=True)
 
 
 def latest_checkpoint(save_dir: str | Path) -> Path | None:
-    """Most recent COMPLETE checkpoint by mtime, either format
+    """Most recent COMPLETE checkpoint by (epoch, mini_batch), either format
     (reference train_and_test.py:139-144). Orbax dirs without their meta.json
     completeness marker (a preempted save), ``.tmp`` leftovers, and
     ``.corrupt`` quarantined blobs are skipped, so auto-resume falls back to
@@ -1027,6 +1116,39 @@ def async_checkpoint_from_env() -> bool:
     )
 
 
+def checkpoint_format_from_env() -> str:
+    """``DDR_CKPT_FORMAT``: ``pickle`` (default) or ``orbax`` for the
+    single-process save cadence. ``orbax`` routes the in-loop saves through
+    the sharded orbax path (``AsyncCheckpointWriter.save_orbax`` /
+    :func:`save_state_orbax`) so a single-controller mesh run writes the same
+    directory form — with mesh/sharding provenance — that elastic resume and
+    the ``ddr chaos --reshard`` drill restore from. Multi-process collective
+    saves always use orbax regardless of this knob. A malformed value falls
+    back to pickle: a format knob must never abort training."""
+    raw = os.environ.get("DDR_CKPT_FORMAT", "pickle").strip().lower()
+    if raw not in ("pickle", "orbax"):
+        log.warning(f"ignoring malformed DDR_CKPT_FORMAT={raw!r} (want pickle|orbax)")
+        return "pickle"
+    return raw
+
+
+def _owned_host_snapshot(tree: Any) -> Any:
+    """``jax.device_get`` with guaranteed ownership. On the CPU backend
+    ``device_get`` can return ZERO-COPY numpy views of the live XLA buffer
+    (``x.flags.owndata`` is False); the loop's buffer donation or end-of-run
+    teardown then frees that buffer while the writer thread is still
+    serializing, and the "snapshot" reads recycled memory. Copy any
+    non-owning leaf so the writer owns its bytes outright."""
+    import numpy as _np
+
+    def _own(x: Any) -> Any:
+        if isinstance(x, _np.ndarray) and not x.flags.owndata:
+            return x.copy()
+        return x
+
+    return jax.tree_util.tree_map(_own, jax.device_get(tree))
+
+
 class AsyncCheckpointWriter:
     """Background checkpoint writer: the train loop's ``checkpoint`` phase
     shrinks to a device->host snapshot + enqueue, while serialization and the
@@ -1093,12 +1215,13 @@ class AsyncCheckpointWriter:
             if item is None:
                 self._queue.task_done()
                 return
+            writer_fn = save_state_orbax if item.pop("_fmt", "pickle") == "orbax" else save_state
             try:
                 if self._phase_timer is not None:
                     with self._phase_timer.phase("checkpoint_io"):
-                        save_state(**item)
+                        writer_fn(**item)
                 else:
-                    save_state(**item)
+                    writer_fn(**item)
                 if self._prune_dir is not None:
                     prune_checkpoints_from_env(self._prune_dir)
             except BaseException as e:  # noqa: BLE001 - reported on next save/drain
@@ -1127,6 +1250,7 @@ class AsyncCheckpointWriter:
         opt_state: Any,
         rng_state: Any = None,
         arch: dict | None = None,
+        mesh: Any = None,
     ) -> None:
         """Snapshot now, write later. Same signature as :func:`save_state`."""
         self._raise_pending()
@@ -1138,11 +1262,71 @@ class AsyncCheckpointWriter:
             "epoch": epoch,
             "mini_batch": mini_batch,
             # the snapshot: host copies the writer thread owns outright
-            "params": jax.device_get(params),
-            "opt_state": jax.device_get(opt_state),
+            "params": _owned_host_snapshot(params),
+            "opt_state": _owned_host_snapshot(opt_state),
             "rng_state": rng_state,
             "arch": arch,
+            # provenance resolved NOW: the writer thread must not touch jax
+            # device state that the loop may be mutating
+            "mesh": _mesh_provenance(mesh),
         }
+        self._enqueue(item)
+
+    def save_orbax(
+        self,
+        save_dir: str | Path,
+        name: str,
+        epoch: int,
+        mini_batch: int,
+        params: Any,
+        opt_state: Any,
+        rng_state: Any = None,
+        arch: dict | None = None,
+        mesh: Any = None,
+    ) -> None:
+        """The sharded async path: this host's device_get of the (addressable)
+        shards runs on the calling thread — under a single controller every
+        shard is addressable, so the snapshot is the assembled host value —
+        the orbax array commit and the meta.json completeness marker run on
+        the writer thread, marker LAST. A crash between the array commit and
+        the marker leaves a meta-less ``.orbax`` dir that every scan skips:
+        the whole step is quarantined, preserving the pickle path's torn-write
+        semantics. Per-leaf sharding specs are captured from the LIVE arrays
+        here, so the checkpoint records the training layout even though the
+        writer thread only ever sees host copies.
+
+        Single-controller only: a multi-process collective save must be
+        entered by every process in step order, which a free-running writer
+        thread cannot guarantee — ``save_state_orbax`` stays synchronous
+        there (and ``ddr train`` already routes multiprocess saves that way).
+        """
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "AsyncCheckpointWriter.save_orbax is single-controller only: "
+                "multi-process collective saves must run save_state_orbax "
+                "synchronously on every process"
+            )
+        from ddr_tpu.parallel.sharding import state_sharding_specs
+
+        item = {
+            "_fmt": "orbax",
+            "save_dir": save_dir,
+            "name": name,
+            "epoch": epoch,
+            "mini_batch": mini_batch,
+            "sharding": state_sharding_specs({"params": params, "opt_state": opt_state}),
+            "params": _owned_host_snapshot(params),
+            "opt_state": _owned_host_snapshot(opt_state),
+            "rng_state": rng_state,
+            "arch": arch,
+            "mesh": _mesh_provenance(mesh),
+        }
+        self._enqueue(item)
+
+    def _enqueue(self, item: dict) -> None:
         self._pending_add()
         while True:
             try:

@@ -200,12 +200,18 @@ def latest_bench_baseline(
 
 
 def latest_chaos_baseline(
-    root: Path = REPO_ROOT, mode: str | None = None, exclude: Path | None = None
+    root: Path = REPO_ROOT,
+    mode: str | None = None,
+    exclude: Path | None = None,
+    reshard: bool | None = None,
 ) -> Path | None:
     """The newest CHAOS_* record of the SAME mode (train vs serve — their
     ``recovery_s`` measure different journeys, so cross-mode comparison is
-    noise). Records that fail to parse are skipped; ``mode=None`` degrades to
-    plain newest-by-mtime."""
+    noise) and, when ``reshard`` is given, the same reshard-ness: an elastic
+    mesh-change drill pays a mesh recompile on every resume, so its
+    ``recovery_s`` gated against a plain same-mesh drill (or vice versa) would
+    flag the drill design, not the code. Records that fail to parse are
+    skipped; ``mode=None`` degrades to plain newest-by-mtime."""
     cands = sorted(
         root.glob("CHAOS_*.json"), key=lambda p: (p.stat().st_mtime, p.name),
         reverse=True,
@@ -217,10 +223,14 @@ def latest_chaos_baseline(
         if mode is None:
             return p
         try:
-            if load_record(p).get("mode") == mode:
-                return p
+            rec = load_record(p)
         except (ValueError, json.JSONDecodeError, OSError):
             continue
+        if rec.get("mode") != mode:
+            continue
+        if reshard is not None and bool(rec.get("reshard")) != reshard:
+            continue
+        return p
     return None
 
 
@@ -379,7 +389,10 @@ def main(argv: list[str] | None = None) -> int:
     exclude = Path(args.fresh) if args.fresh else None
     if is_chaos_record(fresh):
         pattern = "CHAOS_*.json"
-        found = latest_chaos_baseline(mode=fresh.get("mode"), exclude=exclude)
+        found = latest_chaos_baseline(
+            mode=fresh.get("mode"), exclude=exclude,
+            reshard=bool(fresh.get("reshard")),
+        )
     elif is_loadtest_record(fresh):
         pattern = "LOADTEST_*.json"
         found = latest_baseline(pattern=pattern, exclude=exclude)

@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""CI guard: a checkpoint saved on one mesh must restore on another.
+
+Elastic resume (``docs/robustness.md``) rests on one mechanical promise:
+``save_state_orbax`` records mesh + per-leaf sharding provenance, and
+``reshard_state`` places the restored leaves onto whatever mesh the new
+runtime has. Nothing in an ordinary single-device test run exercises that
+cross-mesh path, so an orbax API drift or a provenance-schema slip would
+surface only in the (slow) chaos drill. This script closes the gap the way
+``check_pallas_kernel.py`` guards the Pallas kernel: ONE in-process
+round-trip — save on a 2-device virtual cpu mesh (one leaf genuinely
+reach-sharded), restore untargeted, reshard-load onto a 1-device mesh — and
+bitwise-compare every leaf. Exit 0 on exact agreement, 1 otherwise.
+
+Run directly (CI) or via the test suite (tests/scripts/test_check_reshard.py):
+
+    JAX_PLATFORMS=cpu python scripts/check_reshard.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# both env knobs must land BEFORE jax initializes its backend
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+
+def main() -> int:
+    try:
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from ddr_tpu.parallel.sharding import (
+            make_mesh,
+            mesh_descriptor,
+            mesh_mismatch,
+            reach_sharding,
+            reshard_state,
+        )
+        from ddr_tpu.training import load_state, save_state_orbax
+    except Exception as e:
+        print(f"check_reshard: import failed: {e!r}", file=sys.stderr)
+        return 1
+    if len(jax.devices()) < 2:
+        print(
+            f"check_reshard: need 2 virtual cpu devices, have {len(jax.devices())} "
+            "(XLA_FLAGS was pinned before backend init?)",
+            file=sys.stderr,
+        )
+        return 1
+
+    mesh2 = make_mesh(2)
+    rng = np.random.default_rng(0)
+    params = {
+        # genuinely reach-sharded across both devices: the leaf whose layout
+        # the provenance records and the reshard must collapse back down
+        "w": jax.device_put(
+            jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+            reach_sharding(mesh2, rank_1_axis=0, ndim=2),
+        ),
+        "b": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+    }
+    opt_state = {"mu": jax.device_put(
+        jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+        reach_sharding(mesh2, rank_1_axis=0, ndim=2),
+    )}
+
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            ckpt = save_state_orbax(
+                tmp, "reshard_smoke", 1, 0, params, opt_state, mesh=mesh2
+            )
+            blob = load_state(ckpt)
+            if not blob.get("mesh") or not blob.get("sharding"):
+                print(
+                    "check_reshard: checkpoint meta lacks mesh/sharding "
+                    f"provenance (keys: {sorted(blob)})",
+                    file=sys.stderr,
+                )
+                return 1
+            mesh1 = make_mesh(1)
+            if not mesh_mismatch(blob["mesh"], mesh_descriptor(mesh1)):
+                print(
+                    "check_reshard: 2-device provenance compared equal to a "
+                    "1-device mesh — mesh_mismatch is broken",
+                    file=sys.stderr,
+                )
+                return 1
+            restored = reshard_state(
+                {"params": blob["params"], "opt_state": blob["opt_state"]},
+                mesh1,
+                plan=blob.get("sharding"),
+            )
+    except Exception as e:
+        print(f"check_reshard: cross-mesh round-trip failed: {e!r}", file=sys.stderr)
+        return 1
+
+    saved_leaves = jax.tree_util.tree_leaves({"params": params, "opt_state": opt_state})
+    new_leaves = jax.tree_util.tree_leaves(restored)
+    if len(saved_leaves) != len(new_leaves):
+        print(
+            f"check_reshard: leaf count changed across the round-trip "
+            f"({len(saved_leaves)} -> {len(new_leaves)})",
+            file=sys.stderr,
+        )
+        return 1
+    for i, (a, b) in enumerate(zip(saved_leaves, new_leaves)):
+        if len(b.sharding.device_set) != 1:
+            print(
+                f"check_reshard: leaf {i} still spans "
+                f"{len(b.sharding.device_set)} devices after reshard to mesh(1)",
+                file=sys.stderr,
+            )
+            return 1
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            print(f"check_reshard: leaf {i} changed value across the round-trip",
+                  file=sys.stderr)
+            return 1
+    print("check_reshard: save on cpu mesh(2), reshard-load on mesh(1): all "
+          f"{len(new_leaves)} leaves bitwise equal")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

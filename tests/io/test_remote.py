@@ -281,6 +281,88 @@ class TestS3Registration:
         assert remote._DEFAULT_REGION == "us-west-2"
 
 
+class TestReadRetry:
+    """Bounded retry around remote array reads (DDR_IO_RETRIES /
+    DDR_IO_RETRY_BACKOFF_S) with the `data.remote_read` fault site firing
+    before every attempt — an injected crash is the deterministic stand-in
+    for the transient connection reset / 5xx / timeout the loop absorbs."""
+
+    @pytest.fixture(autouse=True)
+    def _disarm_faults(self, monkeypatch):
+        from ddr_tpu.observability import faults
+
+        monkeypatch.setenv("DDR_IO_RETRY_BACKOFF_S", "0.0")  # instant retries
+        yield
+        faults.configure(None)
+
+    def test_transient_faults_absorbed_within_budget(self):
+        from ddr_tpu.observability import faults
+
+        faults.configure("crash@data.remote_read:n=2")
+        calls = []
+        out = remote.read_with_retry(lambda: calls.append(1) or 42, what="x")
+        assert out == 42
+        # the fault fires BEFORE the read: two crashed attempts never reached
+        # the store, the third read it once
+        assert len(calls) == 1
+
+    def test_retry_budget_exhausts_and_reraises(self, monkeypatch):
+        from ddr_tpu.observability import faults
+
+        monkeypatch.setenv("DDR_IO_RETRIES", "1")
+        faults.configure("crash@data.remote_read")  # every attempt fails
+        with pytest.raises(faults.InjectedFault):
+            remote.read_with_retry(lambda: 42, what="x")
+
+    def test_non_transient_raises_immediately(self):
+        calls = []
+
+        def read():
+            calls.append(1)
+            raise KeyError("no such variable")
+
+        with pytest.raises(KeyError):
+            remote.read_with_retry(read, what="x")
+        assert len(calls) == 1  # a deterministic failure is never retried
+
+    def test_transient_classification(self):
+        from ddr_tpu.observability.faults import InjectedFault
+
+        assert remote._is_transient(ConnectionError("reset"))
+        assert remote._is_transient(TimeoutError())
+        assert remote._is_transient(InjectedFault("data.remote_read", "x"))
+        assert remote._is_transient(Exception("503 Service Unavailable"))
+        assert remote._is_transient(Exception("read timed out"))
+
+        class Http(Exception):
+            status = 502
+
+        assert remote._is_transient(Http("bad gateway upstream"))
+        assert not remote._is_transient(Exception("missing variable Qr"))
+        assert not remote._is_transient(ValueError("unsupported CF time units"))
+
+    def test_env_knobs_and_malformed_fallback(self, monkeypatch):
+        monkeypatch.setenv("DDR_IO_RETRIES", "5")
+        monkeypatch.setenv("DDR_IO_RETRY_BACKOFF_S", "0.25")
+        assert remote._retry_config() == (5, 0.25)
+        monkeypatch.setenv("DDR_IO_RETRIES", "lots")
+        monkeypatch.setenv("DDR_IO_RETRY_BACKOFF_S", "fast")
+        assert remote._retry_config() == (3, 0.1)  # defaults, not a crash
+
+    def test_adapter_reads_ride_the_retry_loop(self, tmp_path):
+        """End-to-end: one injected transient failure per read path (id
+        coordinate, time coordinate, transposed variable) and the adapter
+        still materializes everything."""
+        from ddr_tpu.observability import faults
+
+        ids, qr = _xarray_style_store(tmp_path / "ic", transposed=True)
+        faults.configure("crash@data.remote_read:n=1")
+        adapted = XarrayConventionGroup(zarrlite.open_group(tmp_path / "ic"))
+        assert adapted.attrs["ids"] == list(ids)
+        faults.configure("crash@data.remote_read:n=1")
+        np.testing.assert_array_equal(np.asarray(adapted["Qr"]), qr)
+
+
 class TestTimeOrigin:
     def test_daily_off_midnight_raises(self, tmp_path):
         """A daily store starting off-midnight would silently floor every

@@ -78,7 +78,7 @@ class TestRecorderRoundTrip:
         assert set(EVENT_TYPES) == {
             "run_start", "step", "eval", "compile", "heartbeat", "span", "run_end",
             "serve_request", "serve_batch", "serve_shed", "health", "program_card",
-            "slo", "fault", "preempt", "chaos", "skill", "drift", "audit",
+            "slo", "fault", "preempt", "chaos", "skill", "drift", "audit", "reshard",
         }
 
 

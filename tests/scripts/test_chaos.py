@@ -39,6 +39,27 @@ class TestUnits:
         cfg2 = chaos._train_cfg_dict(tmp_path / "g", None, A)
         assert "checkpoint" not in cfg2["experiment"]
 
+    def test_parse_reshard(self):
+        assert chaos._parse_reshard(None) is None
+        assert chaos._parse_reshard("") is None
+        assert chaos._parse_reshard("4:2") == (4, 2)
+        assert chaos._parse_reshard("1:8") == (1, 8)
+        for bad in ("4", "4:2:1", "a:b", "4:0", "0:2", "-1:2"):
+            with pytest.raises(SystemExit):
+                chaos._parse_reshard(bad)
+
+    def test_train_cfg_reshard_adds_device_and_parallel(self, tmp_path):
+        class A:
+            segments, epochs = 32, 1
+
+        cfg = chaos._train_cfg_dict(tmp_path / "r", None, A, device="cpu:4")
+        assert cfg["device"] == "cpu:4"
+        assert cfg["experiment"]["parallel"] == "auto"
+        # without --reshard the config is exactly what it always was
+        cfg2 = chaos._train_cfg_dict(tmp_path / "r", None, A)
+        assert "device" not in cfg2
+        assert "parallel" not in cfg2["experiment"]
+
     def test_subprocess_env_defaults_compile_cache(self, tmp_path, monkeypatch):
         monkeypatch.delenv("DDR_COMPILE_CACHE_DIR", raising=False)
         monkeypatch.setenv("DDR_METRICS_DIR", "/nope")

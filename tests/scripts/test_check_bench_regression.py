@@ -586,3 +586,28 @@ class TestChaosRecords:
         assert mod.latest_chaos_baseline(tmp_path, mode="bogus") is None
         # and mode=None degrades to plain newest
         assert mod.latest_chaos_baseline(tmp_path, mode=None) == train_rec
+
+    def test_chaos_baseline_pairs_by_reshard(self, tmp_path):
+        """An elastic mesh-change drill pays a new-mesh recompile on every
+        resume — its recovery_s must only gate against other reshard drills,
+        and plain train drills against plain ones."""
+        import json as _json
+        import os as _os
+
+        mod = _load()
+        plain = tmp_path / "CHAOS_plain.json"
+        resh = tmp_path / "CHAOS_resh.json"
+        plain.write_text(_json.dumps({"kind": "chaos", "mode": "train",
+                                      "reshard": None}))
+        resh.write_text(_json.dumps({"kind": "chaos", "mode": "train",
+                                     "reshard": "4:2"}))
+        _os.utime(plain, (1_000_000, 1_000_000))
+        _os.utime(resh, (2_000_000, 2_000_000))  # newest overall
+        assert mod.latest_chaos_baseline(
+            tmp_path, mode="train", reshard=False
+        ) == plain
+        assert mod.latest_chaos_baseline(
+            tmp_path, mode="train", reshard=True
+        ) == resh
+        # unspecified reshard keeps the old behavior (plain newest of mode)
+        assert mod.latest_chaos_baseline(tmp_path, mode="train") == resh

@@ -112,6 +112,30 @@ class TestCandidates:
     def test_empty_dir_resumes_fresh(self, tmp_path):
         assert load_latest_state(tmp_path) is None
 
+    def test_ordering_by_parsed_step_not_mtime(self, tmp_path):
+        """Regression: filesystem timestamps are not training progress. A
+        restored-from-backup dir (or cross-host clock skew) can mtime-order
+        checkpoints backwards; resume must still pick the highest
+        (epoch, mini_batch)."""
+        p10 = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        p12 = save_state(tmp_path, "t", 1, 2, PARAMS, OPT)
+        p21 = save_state(tmp_path, "t", 2, 1, PARAMS, OPT)
+        # mtimes exactly inverted vs training order
+        for i, p in enumerate((p21, p12, p10)):
+            os.utime(p, (p.stat().st_atime, 1_000_000 + i))
+        assert checkpoint_candidates(tmp_path) == [p21, p12, p10]
+        assert latest_checkpoint(tmp_path) == p21
+
+    def test_ordering_mtime_breaks_ties_only(self, tmp_path):
+        """Two blobs at the same (epoch, mini_batch) — e.g. a -preempt
+        emergency save after the cadence save — tie on the parsed step and the
+        newer mtime wins."""
+        cadence = save_state(tmp_path, "t", 1, 1, PARAMS, OPT)
+        preempt = save_state(tmp_path, "t-preempt", 1, 1, PARAMS, OPT)
+        os.utime(cadence, (cadence.stat().st_atime, 1_000_000))
+        os.utime(preempt, (preempt.stat().st_atime, 2_000_000))
+        assert latest_checkpoint(tmp_path) == preempt
+
     def test_bitflipped_orbax_dir_falls_back(self, tmp_path):
         from ddr_tpu.training import save_state_orbax
 
