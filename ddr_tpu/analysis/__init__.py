@@ -1,0 +1,23 @@
+"""Pure-AST static analysis for the ddr_tpu tree (``ddr lint``).
+
+Import-free for the target: stdlib + ``ast`` only, never jax — the package
+generalizes ``scripts/check_event_schema.py`` into a rule-based analyzer for
+the hazard classes this repo keeps fixing by hand (trace-time host effects,
+recompile storms, process-salted determinism bugs, lock-discipline slips,
+registry/docs drift). See docs/static_analysis.md for the rule catalog.
+"""
+
+from ddr_tpu.analysis.core import RULES, Finding, Rule, all_rules, register
+from ddr_tpu.analysis.engine import LintError, LintResult, Project, run_lint
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "all_rules",
+    "register",
+    "run_lint",
+    "LintResult",
+    "LintError",
+    "Project",
+]

@@ -1,0 +1,82 @@
+"""The committed findings baseline (``lint_baseline.json``).
+
+A baseline entry is an *accepted* finding with a mandatory one-line
+justification — the lint-time analogue of the bench-regression records: the
+tree is clean MODULO this explicit, reviewed list. Matching is by
+``(rule, path, context)`` — never by line number, so baselined findings
+survive unrelated churn in the same file. ``context: "*"`` matches the whole
+file (for rules whose findings move between functions freely).
+
+``ddr lint --no-baseline`` ignores the file (strict mode); ``ddr lint
+--write-baseline`` regenerates it from the current findings with TODO
+justifications for a human to fill in.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from ddr_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — an internal error (exit 2), not a finding."""
+
+
+class Baseline:
+    def __init__(self, entries: list[dict]) -> None:
+        for e in entries:
+            missing = {"rule", "path", "justification"} - set(e)
+            if missing:
+                raise BaselineError(f"baseline entry {e!r} is missing {sorted(missing)}")
+            if not str(e["justification"]).strip():
+                raise BaselineError(f"baseline entry {e!r} has an empty justification")
+        self.entries = entries
+        self._hits = [0] * len(entries)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.is_file():
+            return cls([])
+        try:
+            doc = json.loads(path.read_text(encoding="utf-8"))
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"unparseable baseline {path}: {e}") from e
+        if not isinstance(doc, dict) or not isinstance(doc.get("entries"), list):
+            raise BaselineError(f"baseline {path} must be {{'version': 1, 'entries': [...]}}")
+        return cls(doc["entries"])
+
+    def matches(self, finding: Finding) -> bool:
+        for i, e in enumerate(self.entries):
+            if e["rule"] != finding.rule or e["path"] != finding.path:
+                continue
+            ctx = e.get("context", "*")
+            if ctx == "*" or ctx == finding.context:
+                self._hits[i] += 1
+                return True
+        return False
+
+    def unused_entries(self) -> list[dict]:
+        """Entries that matched nothing this run — stale accepted findings the
+        report surfaces (informational; tighten the baseline when they age)."""
+        return [e for e, h in zip(self.entries, self._hits) if h == 0]
+
+    @staticmethod
+    def write(path: Path, findings: list[Finding]) -> None:
+        entries = []
+        seen: set[tuple[str, str, str]] = set()
+        for f in sorted(findings):
+            key = (f.rule, f.path, f.context)
+            if key in seen:
+                continue
+            seen.add(key)
+            entries.append({
+                "rule": f.rule,
+                "path": f.path,
+                "context": f.context,
+                "justification": "TODO: justify or fix",
+            })
+        path.write_text(json.dumps({"version": 1, "entries": entries}, indent=2) + "\n")

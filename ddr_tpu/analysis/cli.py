@@ -1,0 +1,115 @@
+"""``ddr lint`` — run the pure-AST analyzer over the tree.
+
+Exit codes follow the ``check_*`` gate convention so CI can distinguish
+"found problems" from "the linter crashed":
+
+- 0: clean (possibly via pragmas/baseline)
+- 1: findings
+- 2: internal error (bad arguments, broken baseline, git unavailable, ...)
+
+Runs in seconds on CPU and never imports jax — ``scripts/check_lint.py``
+enforces that contract in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from ddr_tpu.analysis.baseline import Baseline, BaselineError
+from ddr_tpu.analysis.core import all_rules
+from ddr_tpu.analysis.engine import LintError, run_lint
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ddr lint",
+        description="pure-AST trace-safety / recompile-hazard / consistency analyzer",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to scan "
+                   "(default: the product surface — ddr_tpu/, bench.py, examples/)")
+    p.add_argument("--root", default=".", help="repo root (default: cwd)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="only report findings in files changed vs HEAD (worktree, "
+                   "index, untracked)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: <root>/lint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="strict mode: ignore the baseline, report everything")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline file (with "
+                   "TODO justifications to fill in) and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def _list_rules() -> int:
+    for rule_id, rule in sorted(all_rules().items()):
+        print(f"{rule_id}  {rule.severity:<7}  {rule.name}")
+        print(f"        {rule.rationale}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+    root = Path(args.root).resolve()
+    try:
+        result = run_lint(
+            root,
+            paths=[Path(p) for p in args.paths] or None,
+            rule_ids=[r.strip() for r in args.rules.split(",")] if args.rules else None,
+            changed_only=args.changed_only,
+            baseline_path=Path(args.baseline) if args.baseline else None,
+            use_baseline=not (args.no_baseline or args.write_baseline),
+        )
+    except (LintError, BaselineError) as e:
+        print(f"ddr lint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        out = Path(args.baseline) if args.baseline else root / "lint_baseline.json"
+        Baseline.write(out, result.findings)
+        print(f"ddr lint: wrote {len(result.findings)} finding(s) to {out} — "
+              "fill in the justification fields")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for msg in result.parse_errors:
+            print(f"warning: could not parse {msg}", file=sys.stderr)
+        for e in result.unused_baseline:
+            print(
+                f"note: unused baseline entry {e['rule']} {e['path']} "
+                f"[{e.get('context', '*')}] — fixed? tighten lint_baseline.json",
+                file=sys.stderr,
+            )
+        if result.findings:
+            print(
+                f"ddr lint: {len(result.findings)} finding(s) "
+                f"({result.errors} errors, {result.warnings} warnings) in "
+                f"{result.n_files} files; {result.suppressed_pragma} pragma- and "
+                f"{result.suppressed_baseline} baseline-suppressed "
+                f"[{result.seconds:.2f}s]"
+            )
+        else:
+            print(
+                f"ddr lint: clean — {result.n_files} files, {result.n_rules} rules, "
+                f"{result.suppressed_pragma + result.suppressed_baseline} suppressed "
+                f"({result.suppressed_baseline} baseline) [{result.seconds:.2f}s]"
+            )
+    if result.parse_errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

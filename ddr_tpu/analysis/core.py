@@ -1,0 +1,113 @@
+"""Core datatypes of the ``ddr lint`` analyzer: findings, rules, the registry.
+
+Everything in :mod:`ddr_tpu.analysis` is **deliberately import-free for the
+target tree** — pure ``ast`` over source text, stdlib only, never importing
+jax or any ddr_tpu runtime module (the ``check_event_schema.py`` contract,
+generalized). The analyzer must run in seconds on a box with no accelerator
+stack and must not execute repo code to audit it. ``scripts/check_lint.py``
+enforces the contract by failing if ``jax`` lands in ``sys.modules``.
+
+A rule is a singleton with an ID (``DDR<family><nn>``), a severity, and two
+hooks: :meth:`Rule.check_file` (per parsed source file) and
+:meth:`Rule.finalize` (once, after the whole tree — for cross-file
+consistency checks like docs parity). Rule families:
+
+- ``DDR1xx`` trace safety (host effects inside jit/scan/pallas bodies)
+- ``DDR2xx`` recompile hazards (jit-in-loop, unhashable statics, un-audited
+  jit sites)
+- ``DDR3xx`` determinism / resume safety (salted ``hash()``, wall-clock
+  defaults, unordered-set materialization)
+- ``DDR4xx`` lock discipline (unprotected shared writes in threaded modules)
+- ``DDR5xx`` consistency gates (event schema, env-knob docs parity, fault
+  site names)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Iterable
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ddr_tpu.analysis.engine import Project
+    from ddr_tpu.analysis.source import SourceFile
+
+#: Finding severities, most severe first. ``error`` findings are bugs or
+#: discipline violations; ``warning`` findings are heuristic (the rule can
+#: have false positives and says so in its catalog entry). Both fail the
+#: gate — a warning that is intentional belongs in the baseline with a
+#: justification, not ignored.
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One reported problem, anchored to a file:line.
+
+    ``context`` is the enclosing function/class qualname (``"<module>"`` at
+    top level) — it is the stable half of the baseline key, so baselined
+    findings survive unrelated line-number churn in the same file.
+    """
+
+    path: str  # repo-root-relative posix path
+    line: int
+    rule: str  # e.g. "DDR101"
+    severity: str  # member of SEVERITIES
+    message: str
+    context: str = "<module>"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.severity}: {self.message} [{self.context}]"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class: subclass, set the class attrs, register with ``@register``."""
+
+    id: str = ""
+    name: str = ""  # short kebab-case label for --list-rules
+    severity: str = "error"
+    #: One-line rationale shown by ``ddr lint --list-rules`` and quoted in
+    #: docs/static_analysis.md; cite the historical bug the rule encodes.
+    rationale: str = ""
+
+    def check_file(self, src: "SourceFile", project: "Project") -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: "Project") -> Iterable[Finding]:
+        """Cross-file findings, emitted once after every file was scanned.
+        Skipped when the run was scoped to an explicit file subset (the
+        tree-wide registries would be judging a partial view)."""
+        return ()
+
+    def finding(
+        self, src: "SourceFile", line: int, message: str, context: str = "<module>"
+    ) -> Finding:
+        return Finding(
+            path=src.rel, line=line, rule=self.id, severity=self.severity,
+            message=message, context=context,
+        )
+
+
+#: The live registry: rule id -> singleton instance, populated by the
+#: ``rules`` package at import time.
+RULES: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if not cls.id or not cls.id.startswith("DDR"):
+        raise ValueError(f"rule {cls.__name__} has no DDR<nnn> id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    if cls.id in RULES:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    RULES[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """The registry, importing the rule modules on first use."""
+    if not RULES:
+        import ddr_tpu.analysis.rules  # noqa: F401  (registration side effect)
+    return RULES

@@ -1,0 +1,235 @@
+"""The lint runner: file collection, rule execution, suppression, reporting.
+
+Scan surface matches ``check_event_schema.py``: product code only
+(``ddr_tpu/``, ``bench.py``, ``examples/``) — ``tests/`` is excluded on
+purpose; it contains intentionally-bad snippets that pin failure behaviors.
+
+Suppression layers, innermost first:
+
+1. per-line pragma ``# ddr-lint: disable=DDR301`` (same line as the finding);
+2. the committed baseline (``lint_baseline.json``), matched by
+   ``(rule, path, context)`` with a mandatory justification;
+3. ``--rules`` subsetting (fixture tests run one rule at a time).
+
+Cross-file ``finalize`` checks (event schema totals, knob parity) run only on
+full-tree scans — judging a registry against a partial file list would
+produce phantom findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import time
+from pathlib import Path
+
+from ddr_tpu.analysis.baseline import DEFAULT_BASELINE, Baseline
+from ddr_tpu.analysis.core import Finding, all_rules
+from ddr_tpu.analysis.rules.consistency import (
+    CONFIG_REFERENCE_MD,
+    EVENTS_PY,
+    FAULTS_PY,
+    documented_knobs,
+    registered_events,
+    registered_fault_sites,
+)
+from ddr_tpu.analysis.source import SourceFile
+
+#: Product code scanned by default, relative to the repo root.
+DEFAULT_SCAN = ("ddr_tpu", "bench.py", "examples")
+
+
+class LintError(RuntimeError):
+    """Internal analyzer failure (exit 2) — distinct from findings (exit 1)."""
+
+
+class Project:
+    """Tree-level context handed to every rule: the scanned files plus
+    lazily-parsed registries (event types, fault sites, documented knobs).
+    ``data`` is scratch space for rules that accumulate across files."""
+
+    def __init__(self, root: Path, files: list[SourceFile], full_scan: bool) -> None:
+        self.root = root
+        self.files = files
+        self.full_scan = full_scan
+        self.data: dict = {}
+        self._event_types: tuple | None = None
+        self._fault_sites: tuple | None = None
+        self._documented: tuple | None = None
+
+    def event_types(self):
+        if self._event_types is None:
+            path = self.root / EVENTS_PY
+            self._event_types = (
+                (frozenset(registered_events(path)),) if path.is_file() else (None,)
+            )
+        return self._event_types[0]
+
+    def fault_sites(self):
+        if self._fault_sites is None:
+            self._fault_sites = (registered_fault_sites(self.root / FAULTS_PY),)
+        return self._fault_sites[0]
+
+    def documented_knobs(self):
+        if self._documented is None:
+            path = self.root / CONFIG_REFERENCE_MD
+            self._documented = (
+                (documented_knobs(path.read_text(encoding="utf-8")),)
+                if path.is_file() else (None,)
+            )
+        return self._documented[0]
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # active (post-suppression), sorted
+    suppressed_pragma: int
+    suppressed_baseline: int
+    unused_baseline: list[dict]
+    parse_errors: list[str]
+    n_files: int
+    n_rules: int
+    seconds: float
+
+    @property
+    def errors(self) -> int:
+        return sum(1 for f in self.findings if f.severity == "error")
+
+    @property
+    def warnings(self) -> int:
+        return sum(1 for f in self.findings if f.severity == "warning")
+
+    def as_dict(self) -> dict:
+        return {
+            "findings": [f.as_dict() for f in self.findings],
+            "summary": {
+                "findings": len(self.findings),
+                "errors": self.errors,
+                "warnings": self.warnings,
+                "suppressed_pragma": self.suppressed_pragma,
+                "suppressed_baseline": self.suppressed_baseline,
+                "unused_baseline": self.unused_baseline,
+                "parse_errors": self.parse_errors,
+                "files": self.n_files,
+                "rules": self.n_rules,
+                "seconds": round(self.seconds, 3),
+            },
+        }
+
+
+def collect_files(root: Path, paths: list[Path] | None = None) -> tuple[list[SourceFile], bool]:
+    """``(files, full_scan)`` — full_scan is True when the default product
+    surface was scanned (enables the cross-file finalize checks)."""
+    root = root.resolve()
+    full_scan = not paths
+    targets = [root / rel for rel in DEFAULT_SCAN] if not paths else [Path(p) for p in paths]
+    files: list[SourceFile] = []
+    seen: set[Path] = set()
+    for target in targets:
+        target = target if target.is_absolute() else root / target
+        if target.is_file():
+            candidates = [target]
+        elif target.is_dir():
+            candidates = sorted(p for p in target.rglob("*.py") if "__pycache__" not in p.parts)
+        else:
+            if full_scan:
+                continue  # a fixture root may lack examples/
+            raise LintError(f"no such file or directory: {target}")
+        for p in candidates:
+            p = p.resolve()
+            if p in seen:
+                continue
+            seen.add(p)
+            try:
+                rel = p.relative_to(root).as_posix()
+            except ValueError:
+                rel = p.as_posix()
+            files.append(SourceFile(p, rel))
+    return files, full_scan
+
+
+def changed_files(root: Path) -> set[str]:
+    """Repo-relative posix paths touched vs HEAD (worktree + index + untracked)."""
+    out: set[str] = set()
+    for args in (
+        ("git", "-C", str(root), "diff", "--name-only", "HEAD"),
+        ("git", "-C", str(root), "ls-files", "--others", "--exclude-standard"),
+    ):
+        try:
+            proc = subprocess.run(args, capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise LintError(f"--changed-only needs git: {e}") from e
+        if proc.returncode != 0:
+            raise LintError(f"--changed-only: {' '.join(args[3:])} failed: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines() if line.strip())
+    return out
+
+
+def run_lint(
+    root: Path,
+    paths: list[Path] | None = None,
+    rule_ids: list[str] | None = None,
+    changed_only: bool = False,
+    baseline_path: Path | None = None,
+    use_baseline: bool = True,
+) -> LintResult:
+    t0 = time.monotonic()
+    root = Path(root).resolve()
+    rules = all_rules()
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in rules]
+        if unknown:
+            raise LintError(f"unknown rule id(s): {', '.join(unknown)} (have: {', '.join(sorted(rules))})")
+        active_rules = {k: rules[k] for k in rule_ids}
+    else:
+        active_rules = dict(rules)
+
+    files, full_scan = collect_files(root, paths)
+    project = Project(root, files, full_scan)
+
+    raw: list[Finding] = []
+    parse_errors: list[str] = []
+    for src in files:
+        if src.parse_error is not None:
+            parse_errors.append(f"{src.rel}: {src.parse_error}")
+            continue
+        for rule in active_rules.values():
+            raw.extend(rule.check_file(src, project))
+    if full_scan:
+        for rule in active_rules.values():
+            raw.extend(rule.finalize(project))
+
+    if changed_only:
+        touched = changed_files(root)
+        raw = [f for f in raw if f.path in touched]
+
+    by_rel = {src.rel: src for src in files}
+    suppressed_pragma = 0
+    suppressed_baseline = 0
+    baseline = None
+    if use_baseline:
+        baseline = Baseline.load(Path(baseline_path) if baseline_path else root / DEFAULT_BASELINE)
+    active: list[Finding] = []
+    for f in sorted(set(raw)):
+        src = by_rel.get(f.path)
+        if src is not None and src.suppressed(f.rule, f.line):
+            suppressed_pragma += 1
+            continue
+        if baseline is not None and baseline.matches(f):
+            suppressed_baseline += 1
+            continue
+        active.append(f)
+
+    # Stale-entry reporting needs every finding to have had a chance to match:
+    # a filtered scan (--changed-only, explicit paths) would flag live entries.
+    report_unused = baseline is not None and full_scan and not changed_only
+    return LintResult(
+        findings=active,
+        suppressed_pragma=suppressed_pragma,
+        suppressed_baseline=suppressed_baseline,
+        unused_baseline=baseline.unused_entries() if report_unused else [],
+        parse_errors=parse_errors,
+        n_files=len(files),
+        n_rules=len(active_rules),
+        seconds=time.monotonic() - t0,
+    )

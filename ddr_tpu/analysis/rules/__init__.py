@@ -1,0 +1,11 @@
+"""Rule modules — importing this package populates the registry
+(:data:`ddr_tpu.analysis.core.RULES`). Keep the imports sorted by family so
+``--list-rules`` output is stable."""
+
+from ddr_tpu.analysis.rules import (  # noqa: F401  (registration side effects)
+    consistency,
+    determinism,
+    locks,
+    recompile,
+    trace_safety,
+)

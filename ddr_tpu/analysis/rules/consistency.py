@@ -1,0 +1,363 @@
+"""DDR5xx — cross-file consistency gates.
+
+These rules generalize ``scripts/check_event_schema.py`` (PR 3's AST gate):
+registries that live in ONE file (``EVENT_TYPES``, ``FAULT_SITES``, the
+documented ``DDR_*`` knob inventory) are parsed by AST/text — never imported
+— and every literal use site in the tree is checked against them.
+
+- DDR501: ``*.emit("<name>")`` must name a registered event type (a typo'd
+  event ships silently and never aggregates — the original PR 3 bug).
+- DDR502: every ``DDR_*`` env knob read in code must be documented in
+  ``docs/config_reference.md`` (exactly, or by a ``DDR_FAMILY_*`` prefix
+  entry), and every exact documented knob must still be read somewhere (the
+  62-in-code / 61-documented drift this rule was built to close).
+- DDR503: ``fault_site("...")`` / ``maybe_inject("...")`` literals must match
+  the ``FAULT_SITES`` registry in ``faults.py`` — a typo'd site parses as "no
+  faults planned here" and the chaos drill silently tests nothing.
+
+The helpers (:func:`registered_events`, :func:`emit_call_sites`,
+:func:`check_tree`) are also the implementation behind the
+``scripts/check_event_schema.py`` shim, so its CLI contract and message
+formats are defined here.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Iterable
+
+from ddr_tpu.analysis.core import Finding, Rule, register
+from ddr_tpu.analysis.source import SourceFile, dotted_name
+
+EVENTS_PY = Path("ddr_tpu/observability/events.py")
+FAULTS_PY = Path("ddr_tpu/observability/faults.py")
+CONFIG_REFERENCE_MD = Path("docs/config_reference.md")
+
+EMIT_NAMES = {"emit", "_emit"}
+
+#: A DDR env knob literal: the full env-var name.
+KNOB_RE = re.compile(r"^DDR_[A-Z0-9_]+$")
+#: Doc tokens: ``DDR_FOO`` (exact) or ``DDR_FAMILY_*`` (prefix family).
+DOC_TOKEN_RE = re.compile(r"DDR_[A-Z0-9_]*\*?")
+
+
+# ---------------------------------------------------------------------------
+# registry parsers (pure AST / text — never import the target tree)
+# ---------------------------------------------------------------------------
+
+def _module_tuple_assignment(path: Path, name: str) -> tuple[str, ...] | None:
+    """``NAME = (...)`` from a module, by AST; None when the file is missing."""
+    if not path.is_file():
+        return None
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if name in targets:
+                value = ast.literal_eval(node.value)
+                return tuple(str(v) for v in value)
+    raise SystemExit(f"could not find an {name} assignment in {path}")
+
+
+def registered_events(events_py: Path) -> tuple[str, ...]:
+    """``EVENT_TYPES`` from events.py, by AST (no import, no jax)."""
+    events = _module_tuple_assignment(events_py, "EVENT_TYPES")
+    if events is None:
+        raise SystemExit(f"could not find an EVENT_TYPES assignment in {events_py}")
+    return events
+
+
+def registered_fault_sites(faults_py: Path) -> tuple[str, ...] | None:
+    """``FAULT_SITES`` from faults.py, by AST; None when faults.py is absent
+    (fixture trees)."""
+    return _module_tuple_assignment(faults_py, "FAULT_SITES")
+
+
+def emit_call_sites(path: Path) -> list[tuple[int, str]]:
+    """``(line, literal_event_name)`` for every ``X.emit("name", ...)`` /
+    ``X._emit("name", ...)`` in one file."""
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    except SyntaxError as e:  # a broken file is its own CI failure elsewhere
+        print(f"warning: could not parse {path}: {e}", file=sys.stderr)
+        return []
+    return _emit_sites_from_tree(tree)
+
+
+def _emit_sites_from_tree(tree: ast.AST) -> list[tuple[int, str]]:
+    sites: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in EMIT_NAMES or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            sites.append((node.lineno, first.value))
+    return sites
+
+
+def _is_env_base(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    # ``os.environ`` / any alias ending in .environ / the ``env = os.environ
+    # if environ is None else environ`` local-alias idiom
+    return name == "environ" or name.endswith(".environ") or name in ("env", "_env")
+
+
+def env_knob_reads(tree: ast.AST) -> list[tuple[int, str]]:
+    """``(line, knob)`` for every literal ``DDR_*`` env read in a module:
+    ``os.getenv("K")``, ``os.environ["K"]`` (load context),
+    ``os.environ.get/setdefault/pop("K")``, and the same through an
+    ``environ``/``env`` alias."""
+    out: list[tuple[int, str]] = []
+
+    def knob(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) and KNOB_RE.match(node.value):
+            return node.value
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) and _is_env_base(node.value):
+            k = knob(node.slice)
+            if k:
+                out.append((node.lineno, k))
+        elif isinstance(node, ast.Call) and node.args:
+            fname = dotted_name(node.func)
+            if fname in ("os.getenv", "getenv"):
+                k = knob(node.args[0])
+                if k:
+                    out.append((node.lineno, k))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "setdefault", "pop")
+                and _is_env_base(node.func.value)
+            ):
+                k = knob(node.args[0])
+                if k:
+                    out.append((node.lineno, k))
+    return out
+
+
+def harvest_env_knobs(root: Path, scan=("ddr_tpu", "bench.py", "examples")) -> dict[str, list[tuple[str, int]]]:
+    """Tree-wide knob inventory: knob -> [(relpath, line), ...]. This is THE
+    harvester — ``gen_config_docs`` renders the docs inventory from it and
+    DDR502 checks parity against the rendered result, so the two can never
+    disagree about what counts as a knob."""
+    inventory: dict[str, list[tuple[str, int]]] = {}
+    for rel in scan:
+        target = root / rel
+        files = (
+            [target] if target.is_file()
+            else sorted(p for p in target.rglob("*.py") if "__pycache__" not in p.parts)
+            if target.is_dir() else []
+        )
+        for f in files:
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8"), filename=str(f))
+            except SyntaxError:
+                continue
+            for line, k in env_knob_reads(tree):
+                inventory.setdefault(k, []).append((f.relative_to(root).as_posix(), line))
+    return inventory
+
+
+def documented_knobs(md_text: str) -> tuple[dict[str, int], dict[str, int]]:
+    """Parse docs/config_reference.md into ``(exact, prefixes)`` — token ->
+    first line number. ``DDR_FAMILY_*`` (or a trailing-underscore family
+    head) counts as a prefix; a bare ``DDR_*``/``DDR_`` is ignored as too
+    broad to document anything."""
+    exact: dict[str, int] = {}
+    prefixes: dict[str, int] = {}
+    for lineno, line in enumerate(md_text.splitlines(), start=1):
+        for tok in DOC_TOKEN_RE.findall(line):
+            if tok in ("DDR_", "DDR_*"):
+                continue
+            if tok.endswith("*") or tok.endswith("_"):
+                prefixes.setdefault(tok.rstrip("*"), lineno)
+            else:
+                exact.setdefault(tok, lineno)
+    return exact, prefixes
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+@register
+class UnregisteredEvent(Rule):
+    id = "DDR501"
+    name = "unregistered-event"
+    severity = "error"
+    rationale = (
+        "Recorder.emit deliberately writes unknown event types (with a "
+        "warning) so experiments never lose data — a typo'd name ships "
+        "silently and `ddr metrics summarize` never aggregates it (the PR 3 "
+        "check_event_schema gate, folded in as a rule)."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        events = project.event_types()
+        if events is None or src.tree is None:
+            return
+        sites = _emit_sites_from_tree(src.tree)
+        project.data.setdefault("emit_sites", 0)
+        project.data["emit_sites"] += len(sites)
+        for line, name in sites:
+            if name not in events:
+                yield self.finding(
+                    src, line,
+                    f"emit({name!r}) is not in EVENT_TYPES "
+                    "(ddr_tpu/observability/events.py) — register it (and "
+                    "document it in docs/observability.md) or fix the typo",
+                    context=src.qualname_at(line),
+                )
+
+    def finalize(self, project) -> Iterable[Finding]:
+        if project.event_types() is None:
+            return
+        # zero matches means the matcher rotted, not that the tree is clean
+        if project.data.get("emit_sites", 0) == 0:
+            yield Finding(
+                path=EVENTS_PY.as_posix(), line=1, rule=self.id, severity="error",
+                message="found no emit() call sites at all — matcher broken?",
+            )
+
+
+@register
+class UndocumentedKnob(Rule):
+    id = "DDR502"
+    name = "knob-docs-parity"
+    severity = "error"
+    rationale = (
+        "Every DDR_* env knob read in code must appear in "
+        "docs/config_reference.md (exactly or via a DDR_FAMILY_* entry) and "
+        "vice versa — the reference had drifted to 62 knobs in code vs 61 "
+        "documented when this rule landed. `ddr gen-config-docs` regenerates "
+        "the inventory from the same harvester."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if src.tree is None:
+            return
+        reads = env_knob_reads(src.tree)
+        if reads:
+            project.data.setdefault("knob_sites", []).extend(
+                (k, src, line) for line, k in reads
+            )
+        return ()
+
+    def finalize(self, project) -> Iterable[Finding]:
+        docs = project.documented_knobs()
+        if docs is None:
+            return
+        exact, prefixes = docs
+        sites: list[tuple[str, SourceFile, int]] = project.data.get("knob_sites", [])
+        code_knobs = {k for k, _, _ in sites}
+        reported: set[str] = set()
+        for knob, src, line in sites:
+            covered = knob in exact or any(knob.startswith(p) for p in prefixes)
+            if not covered and knob not in reported:
+                reported.add(knob)
+                yield self.finding(
+                    src, line,
+                    f"env knob {knob} is read here but not documented in "
+                    f"{CONFIG_REFERENCE_MD} — run `ddr gen-config-docs` to "
+                    "regenerate the knob inventory",
+                    context=src.qualname_at(line),
+                )
+        for knob, docline in sorted(exact.items()):
+            if knob not in code_knobs and not any(c.startswith(knob) for c in code_knobs):
+                yield Finding(
+                    path=CONFIG_REFERENCE_MD.as_posix(), line=docline, rule=self.id,
+                    severity=self.severity,
+                    message=(
+                        f"documented env knob {knob} is never read in the tree — "
+                        "stale docs entry (or the read moved behind a constructed "
+                        "name; document the family as DDR_FAMILY_* instead)"
+                    ),
+                )
+
+
+@register
+class UnknownFaultSite(Rule):
+    id = "DDR503"
+    name = "unknown-fault-site"
+    severity = "error"
+    rationale = (
+        "fault_site()/maybe_inject() literals must name a FAULT_SITES entry "
+        "(ddr_tpu/observability/faults.py): a typo'd site resolves to 'no "
+        "faults planned here' and the chaos drill silently tests nothing."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        fsites = project.fault_sites()
+        if fsites is None or src.tree is None:
+            return
+        if src.rel == FAULTS_PY.as_posix():
+            return  # the registry module's own docstrings/resolution logic
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fname = dotted_name(node.func)
+            bare = fname.rsplit(".", 1)[-1] if fname else None
+            if bare not in ("fault_site", "maybe_inject"):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                if first.value not in fsites:
+                    yield self.finding(
+                        src, node.lineno,
+                        f"{bare}({first.value!r}) does not name a registered "
+                        "FAULT_SITES entry "
+                        f"({', '.join(fsites)}) — fix the name or register the site",
+                        context=src.qualname_at(node.lineno),
+                    )
+
+
+# ---------------------------------------------------------------------------
+# scripts/check_event_schema.py compatibility surface
+# ---------------------------------------------------------------------------
+
+#: Product code scanned by the legacy entrypoint (tests/ excluded on purpose:
+#: it emits intentionally-bogus names to pin the warn-but-write behavior).
+SCAN = ("ddr_tpu", "bench.py", "examples")
+
+
+def check_tree(root: Path) -> int:
+    """The original ``check_event_schema.py`` contract, byte-compatible
+    messages included: exit 0 when every literal emit() name in SCAN is
+    registered, 1 otherwise (or when the matcher matched nothing)."""
+    events = set(registered_events(root / EVENTS_PY))
+    offenders: list[str] = []
+    n_sites = 0
+    for rel in SCAN:
+        target = root / rel
+        files = (
+            [target] if target.is_file()
+            else sorted(p for p in target.rglob("*.py") if "__pycache__" not in p.parts)
+        )
+        for f in files:
+            for line, name in emit_call_sites(f):
+                n_sites += 1
+                if name not in events:
+                    offenders.append(
+                        f"{f.relative_to(root)}:{line}: emit({name!r}) is not in "
+                        "EVENT_TYPES (ddr_tpu/observability/events.py) — register "
+                        "it (and document it in docs/observability.md) or fix the typo"
+                    )
+    if offenders:
+        print("\n".join(offenders), file=sys.stderr)
+        return 1
+    if n_sites == 0:
+        # zero matches means the matcher rotted, not that the tree is clean
+        print("error: found no emit() call sites at all — matcher broken?",
+              file=sys.stderr)
+        return 1
+    print(f"ok: {n_sites} emit() call sites, all registered in EVENT_TYPES "
+          f"({len(events)} types)")
+    return 0

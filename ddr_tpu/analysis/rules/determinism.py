@@ -1,0 +1,154 @@
+"""DDR3xx — determinism / resume safety.
+
+Historical bugs this family encodes:
+
+- PR 8 fixed fuzz seeds derived from builtin ``hash()`` on strings: the hash
+  is salted per process (PYTHONHASHSEED), so "the same seed" differed across
+  runs and a failing fuzz case could not be replayed (DDR301).
+- Elastic resume (PR 10) depends on checkpoint metadata being reproducible;
+  a wall-clock default in a dataclass field stamps construction time into
+  state that two resumed processes then disagree on (DDR302).
+- ``list(set(...))`` materializes Python's hash-salted set order; feed that
+  into a jitted constant or a cache key and two processes compile different
+  programs from identical inputs (DDR303).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ddr_tpu.analysis.core import Finding, Rule, register
+from ddr_tpu.analysis.source import SourceFile, dotted_name
+
+_WALLCLOCK = {
+    "time.time", "time.monotonic", "time.time_ns",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+
+@register
+class SaltedHash(Rule):
+    id = "DDR301"
+    name = "salted-hash"
+    severity = "error"
+    rationale = (
+        "builtin hash() on str/bytes is salted per process (PYTHONHASHSEED): "
+        "seeds and cache keys derived from it are irreproducible across runs "
+        "(the PR 8 fuzz-seed bug). Use zlib.crc32 or hashlib."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if src.tree is None:
+            return
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "hash"
+                and len(node.args) == 1
+            ):
+                yield self.finding(
+                    src, node.lineno,
+                    "builtin hash() is process-salted for str/bytes — a seed or "
+                    "cache key built from it differs across runs; use "
+                    "zlib.crc32/hashlib for stable digests",
+                    context=src.qualname(node),
+                )
+
+
+@register
+class WallclockDefault(Rule):
+    id = "DDR302"
+    name = "wallclock-default"
+    severity = "error"
+    rationale = (
+        "A wall-clock call as a class-body default evaluates ONCE at class "
+        "definition (all instances share import time); default_factory=time.time "
+        "stamps construction time into resumable state — either way, two "
+        "processes resuming the same checkpoint disagree."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if src.tree is None:
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    value = None
+                    if isinstance(stmt, ast.Assign):
+                        value = stmt.value
+                    elif isinstance(stmt, ast.AnnAssign):
+                        value = stmt.value
+                    if (
+                        isinstance(value, ast.Call)
+                        and dotted_name(value.func) in _WALLCLOCK
+                    ):
+                        yield self.finding(
+                            src, stmt.lineno,
+                            f"class-body default calls {dotted_name(value.func)}() — "
+                            "evaluated once at class definition and shared by every "
+                            "instance; use default_factory (and prefer an explicit "
+                            "timestamp argument for resumable state)",
+                            context=src.qualname(stmt),
+                        )
+            elif isinstance(node, ast.Call) and dotted_name(node.func) in ("field", "dataclasses.field", "Field"):
+                for kw in node.keywords:
+                    if kw.arg == "default_factory" and dotted_name(kw.value) in _WALLCLOCK:
+                        yield self.finding(
+                            src, node.lineno,
+                            f"default_factory={dotted_name(kw.value)} stamps wall-clock "
+                            "time into a dataclass field — resumed processes disagree "
+                            "on it; pass the timestamp explicitly",
+                            context=src.qualname(node),
+                        )
+
+
+@register
+class UnorderedSetMaterialization(Rule):
+    id = "DDR303"
+    name = "unordered-set-materialization"
+    severity = "warning"
+    rationale = (
+        "list()/tuple() over a set materializes hash-salted iteration order; "
+        "landing that in a jitted constant, shard layout, or cache key makes "
+        "two identical processes build different programs. Wrap in sorted()."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if src.tree is None:
+            return
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("list", "tuple")
+                and len(node.args) == 1
+            ):
+                continue
+            arg = node.args[0]
+            is_set = isinstance(arg, (ast.Set, ast.SetComp)) or (
+                isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id in ("set", "frozenset")
+            )
+            # set arithmetic (a - b, a | b) materialized without sorting
+            is_set = is_set or (
+                isinstance(arg, ast.BinOp)
+                and isinstance(arg.op, (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor))
+                and any(
+                    isinstance(side, ast.Call)
+                    and isinstance(side.func, ast.Name)
+                    and side.func.id in ("set", "frozenset")
+                    for side in (arg.left, arg.right)
+                )
+            )
+            if is_set:
+                yield self.finding(
+                    src, node.lineno,
+                    f"{node.func.id}() over a set materializes unordered, "
+                    "process-salted iteration order — use sorted(...) so the "
+                    "result is stable across runs",
+                    context=src.qualname(node),
+                )

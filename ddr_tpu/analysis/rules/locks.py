@@ -1,0 +1,154 @@
+"""DDR4xx — lock discipline in threaded modules.
+
+The repo's threaded subsystems — micro-batcher, registry watcher, async
+checkpoint writer, metrics registry, SLO tracker — share one convention: a
+``self._lock`` guarding the instance's mutable ``self._*`` state, written
+from a thread target on one side and the public API on the other. PR 10's
+zero-copy ``device_get`` snapshot freed under the async writer thread is the
+motivating bug class: state shared with a thread, touched outside the lock.
+
+DDR401 is a heuristic (hence warning severity): in a module that creates
+threads, for every class that owns a ``threading.Lock``/``RLock``, any
+``self._x`` attribute written BOTH under ``with self._lock`` somewhere AND
+outside any lock block in a different method (``__init__`` excluded —
+construction happens-before thread start) flags the unguarded writes.
+Single-threaded-by-contract writes belong in the baseline with that contract
+as the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ddr_tpu.analysis.core import Finding, Rule, register
+from ddr_tpu.analysis.source import SourceFile, dotted_name
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_MUTATOR_ATTRS = {
+    "append", "extend", "add", "remove", "discard", "pop", "popleft",
+    "appendleft", "clear", "update", "insert", "setdefault", "__setitem__",
+}
+#: Methods that run before threads exist or after they are joined.
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+
+def _module_spawns_threads(src: SourceFile) -> bool:
+    if src.tree is None:
+        return False
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Attribute) and node.attr in ("Thread", "start_new_thread"):
+            return True
+        if isinstance(node, ast.Name) and node.id == "Thread":
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self._x`` -> ``_x`` (private attrs only — public attrs are part of a
+    documented external contract and over-flag)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and node.attr.startswith("_")
+    ):
+        return node.attr
+    return None
+
+
+class _ClassLockAudit:
+    def __init__(self, src: SourceFile, cls: ast.ClassDef) -> None:
+        self.src = src
+        self.cls = cls
+        self.lock_attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if dotted_name(node.value.func) in _LOCK_CTORS:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            self.lock_attrs.add(attr)
+        #: attr -> list of (method_name, line, guarded)
+        self.writes: dict[str, list[tuple[str, int, bool]]] = {}
+
+    def _guarded(self, node: ast.AST, method: ast.AST) -> bool:
+        cur = self.src.parents.get(node)
+        while cur is not None and cur is not self.cls:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    ctx = item.context_expr
+                    attr = _self_attr(ctx)
+                    if attr is None and isinstance(ctx, ast.Call):
+                        attr = _self_attr(ctx.func)  # self._lock.acquire-style cm
+                    if attr in self.lock_attrs:
+                        return True
+            if cur is method:
+                break
+            cur = self.src.parents.get(cur)
+        return False
+
+    def collect(self) -> None:
+        for method in self.cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(method):
+                attr: str | None = None
+                line = getattr(node, "lineno", method.lineno)
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a is None and isinstance(t, ast.Subscript):
+                            a = _self_attr(t.value)
+                        if a is not None:
+                            attr = a
+                elif isinstance(node, ast.AugAssign):
+                    attr = _self_attr(node.target) or (
+                        _self_attr(node.target.value) if isinstance(node.target, ast.Subscript) else None
+                    )
+                elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _MUTATOR_ATTRS:
+                        attr = _self_attr(node.func.value)
+                if attr is None or attr in self.lock_attrs:
+                    continue
+                self.writes.setdefault(attr, []).append(
+                    (method.name, line, self._guarded(node, method))
+                )
+
+
+@register
+class UnguardedSharedWrite(Rule):
+    id = "DDR401"
+    name = "unguarded-shared-write"
+    severity = "warning"
+    rationale = (
+        "In a thread-spawning module, a self._x attribute written both under "
+        "`with self._lock` and outside any lock block is a data race in "
+        "waiting (the PR 10 async-writer buffer-freed-under-thread class); "
+        "guard the write or baseline the documented single-threaded contract."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if src.tree is None or not _module_spawns_threads(src):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            audit = _ClassLockAudit(src, node)
+            if not audit.lock_attrs:
+                continue
+            audit.collect()
+            for attr, sites in audit.writes.items():
+                guarded_somewhere = any(g for _, _, g in sites)
+                if not guarded_somewhere:
+                    continue
+                for method_name, line, guarded in sites:
+                    if guarded or method_name in _EXEMPT_METHODS:
+                        continue
+                    yield self.finding(
+                        src, line,
+                        f"self.{attr} is written under {node.name}'s lock elsewhere "
+                        f"but this write in {method_name}() is outside any "
+                        "`with self._lock` block — racy against the module's threads",
+                        context=f"{src.qualname(node)}.{method_name}",
+                    )

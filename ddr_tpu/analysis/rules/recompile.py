@@ -1,0 +1,183 @@
+"""DDR2xx — recompile hazards: jit-cache misses a bench regression would
+eventually surface, caught at lint time instead.
+
+Historical context: every PR since PR 1 has kept the "zero new jit-cache
+entries in steady state" discipline by convention — CompileTracker counts
+misses per engine, ProgramCards attribute their cost, and the e2e pins
+(`test_recompile`, the serve acceptance tests) assert cache stability. These
+rules make the convention structural.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ddr_tpu.analysis.core import Finding, Rule, register
+from ddr_tpu.analysis.source import SourceFile, dotted_name
+from ddr_tpu.analysis.tracing import is_jit_call
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+def _jit_call_sites(src: SourceFile):
+    """Every ``jax.jit(...)`` / ``jax.pjit(...)`` Call node in the file,
+    including the ``functools.partial(jax.jit, ...)`` decorator idiom (the
+    partial call is the site)."""
+    if src.tree is None:
+        return
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if is_jit_call(node):
+            yield node, node
+        elif dotted_name(node.func) in ("functools.partial", "partial") and node.args:
+            if dotted_name(node.args[0]) in ("jax.jit", "jax.pjit", "jit", "pjit"):
+                yield node, node
+
+
+def _in_loop(src: SourceFile, node: ast.AST) -> bool:
+    for anc in src.ancestors(node):
+        if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # a new function scope resets the loop context: jit at import
+            # time inside a loop is the hazard, a def that happens to be
+            # defined in a loop is judged at its own call sites
+            return False
+    return False
+
+
+@register
+class JitInLoop(Rule):
+    id = "DDR201"
+    name = "jit-in-loop"
+    severity = "error"
+    rationale = (
+        "jax.jit applied to a lambda/locally-defined closure inside a loop "
+        "creates a fresh callable (and compile-cache entry) per iteration — "
+        "the cache never hits and every pass re-pays XLA compile."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        for call, _ in _jit_call_sites(src):
+            if not _in_loop(src, call):
+                continue
+            target = call.args[0] if call.args else None
+            if dotted_name(call.func) in ("functools.partial", "partial"):
+                target = call.args[1] if len(call.args) > 1 else None
+            if isinstance(target, (ast.Lambda, ast.Name)) or target is None:
+                yield self.finding(
+                    src, call.lineno,
+                    "jax.jit inside a loop body: each iteration wraps a fresh "
+                    "callable, so the compile cache can never hit — hoist the "
+                    "jit out of the loop",
+                    context=src.qualname(call),
+                )
+
+
+@register
+class UnhashableStatic(Rule):
+    id = "DDR202"
+    name = "unhashable-static-arg"
+    severity = "error"
+    rationale = (
+        "static_argnums/static_argnames pointing at a parameter with a "
+        "list/dict/set default raises TypeError: unhashable at the first call "
+        "that uses the default — and hashable-but-mutable statics recompile "
+        "on every new object identity."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if src.tree is None:
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            spec = None
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    inner_jit = dotted_name(dec.func) in ("jax.jit", "jax.pjit", "jit", "pjit") or (
+                        dotted_name(dec.func) in ("functools.partial", "partial")
+                        and dec.args
+                        and dotted_name(dec.args[0]) in ("jax.jit", "jax.pjit", "jit", "pjit")
+                    )
+                    if inner_jit:
+                        spec = dec
+                        break
+            if spec is None:
+                continue
+            static_nums: list[int] = []
+            static_names: list[str] = []
+            for kw in spec.keywords:
+                if kw.arg == "static_argnums":
+                    try:
+                        v = ast.literal_eval(kw.value)
+                    except ValueError:
+                        continue
+                    static_nums = [v] if isinstance(v, int) else list(v)
+                elif kw.arg == "static_argnames":
+                    try:
+                        v = ast.literal_eval(kw.value)
+                    except ValueError:
+                        continue
+                    static_names = [v] if isinstance(v, str) else list(v)
+            args = list(node.args.posonlyargs) + list(node.args.args)
+            defaults = list(node.args.defaults)
+            # defaults align to the TAIL of the positional args
+            default_by_name: dict[str, ast.AST] = {}
+            for a, d in zip(args[len(args) - len(defaults):], defaults):
+                default_by_name[a.arg] = d
+            for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+                if d is not None:
+                    default_by_name[a.arg] = d
+            flagged_params: list[str] = []
+            for idx in static_nums:
+                if 0 <= idx < len(args):
+                    flagged_params.append(args[idx].arg)
+            flagged_params += static_names
+            for pname in flagged_params:
+                d = default_by_name.get(pname)
+                if d is not None and isinstance(d, _MUTABLE_LITERALS):
+                    yield self.finding(
+                        src, node.lineno,
+                        f"static argument {pname!r} of jitted {node.name}() has an "
+                        "unhashable (list/dict/set) default — TypeError at the "
+                        "first defaulted call; use a tuple/frozenset",
+                        context=src.qualname(node),
+                    )
+
+
+@register
+class UnauditedJit(Rule):
+    id = "DDR203"
+    name = "unaudited-jit"
+    severity = "warning"
+    rationale = (
+        "New jax.jit/pjit sites in ddr_tpu/ must participate in the "
+        "CompileTracker/ProgramCard auditing discipline (track_jit/build_card) "
+        "so steady-state cache misses stay observable; a module that compiles "
+        "programs nobody audits is where the next silent recompile storm lands."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        if not src.rel.startswith("ddr_tpu/"):
+            return
+        sites = list(_jit_call_sites(src))
+        if not sites:
+            return
+        # module-level participation: referencing track_jit or build_card
+        # anywhere means this module's programs are routed through the
+        # auditing stack (the tracker often wraps at a coarser granularity
+        # than the individual jit call)
+        if src.references("track_jit", "build_card"):
+            return
+        for call, _ in sites:
+            yield self.finding(
+                src, call.lineno,
+                "jax.jit site in a module that never references "
+                "CompileTracker.track_jit or build_card — route the compiled "
+                "program through the auditing stack (see "
+                "docs/observability.md) or baseline with a justification",
+                context=src.qualname(call),
+            )

@@ -1,0 +1,177 @@
+"""DDR1xx — trace safety: host effects inside traced function bodies.
+
+Historical bugs this family encodes:
+
+- PR 9's ``wave_cost_constants``: ``DDR_WAVE_FIXED_US`` must be read at
+  band-*planning* time; a read inside a traced body would burn the value in
+  as a compile-time constant and silently ignore later env changes (DDR103).
+- Host clocks/IO inside jit: a ``time.time()`` or ``open()`` in a scan body
+  runs ONCE at trace time, not per step — the measurement/read it claims to
+  make never happens (DDR101).
+- ``.item()`` / ``float()`` on a traced value forces a device sync and — in
+  scan/cond bodies — a ConcretizationTypeError at trace time (DDR102).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ddr_tpu.analysis.core import Finding, Rule, register
+from ddr_tpu.analysis.source import SourceFile, dotted_name
+from ddr_tpu.analysis.tracing import trace_index
+
+#: Dotted call targets that are host side effects (exact match, or the
+#: ``np.random.*`` family by prefix). ``print`` and ``open`` match as bare
+#: builtins. ``jax.debug.print`` / ``io_callback`` are the sanctioned
+#: alternatives and do not match (different dotted names).
+_HOST_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.process_time",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns", "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "print", "input", "open",
+    "random.random", "random.randint", "random.uniform", "random.normalvariate",
+    "random.choice", "random.shuffle", "random.seed", "random.getrandbits",
+    "os.system", "os.popen", "subprocess.run", "subprocess.Popen", "subprocess.check_output",
+}
+_HOST_PREFIXES = ("np.random.", "numpy.random.", "onp.random.")
+
+#: Env-read shapes for DDR103: ``os.environ.get/[]/setdefault/pop`` and
+#: ``os.getenv``. Matched structurally so ``environ``-aliased imports hit too.
+_ENV_GET_ATTRS = {"get", "setdefault", "pop"}
+
+
+def _is_env_base(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and (name == "environ" or name.endswith(".environ"))
+
+
+def _env_read(node: ast.AST) -> bool:
+    """Call or Subscript that reads the process environment."""
+    if isinstance(node, ast.Subscript) and _is_env_base(node.value):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in ("os.getenv", "getenv"):
+            return True
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ENV_GET_ATTRS
+            and _is_env_base(node.func.value)
+        ):
+            return True
+    return False
+
+
+def _walk_body(func: ast.AST):
+    """Every node of a traced body, including nested defs (they run under the
+    same trace when called)."""
+    yield from ast.walk(func)
+
+
+@register
+class TraceHostEffect(Rule):
+    id = "DDR101"
+    name = "trace-host-effect"
+    severity = "error"
+    rationale = (
+        "Host side effects (clocks, open/print, np.random, subprocess) inside a "
+        "jit/scan/pallas body run once at trace time, not per step — the effect "
+        "the code claims never happens at runtime."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        seen: set[tuple[int, str]] = set()
+        for func, qual, reason in trace_index(src).traced_bodies():
+            for node in _walk_body(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name in _HOST_CALLS or name.startswith(_HOST_PREFIXES):
+                    key = (node.lineno, name)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield self.finding(
+                        src, node.lineno,
+                        f"host side effect {name}() inside traced body ({reason}); "
+                        "runs at trace time only — hoist it out or use "
+                        "jax.debug.print/io_callback",
+                        context=qual,
+                    )
+
+
+@register
+class TraceCoercion(Rule):
+    id = "DDR102"
+    name = "trace-coercion"
+    severity = "warning"
+    rationale = (
+        "`.item()` / float()/int()/bool() on a traced value forces a host sync "
+        "under jit and a ConcretizationTypeError inside scan/cond bodies."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        seen: set[int] = set()
+        for func, qual, reason in trace_index(src).traced_bodies():
+            params = {
+                a.arg
+                for a in (
+                    list(func.args.args) + list(func.args.posonlyargs) + list(func.args.kwonlyargs)
+                )
+            }
+            for node in _walk_body(func):
+                if not isinstance(node, ast.Call) or node.lineno in seen:
+                    continue
+                if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+                    seen.add(node.lineno)
+                    yield self.finding(
+                        src, node.lineno,
+                        f".item() inside traced body ({reason}) forces a device "
+                        "sync / trace-time concretization",
+                        context=qual,
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    seen.add(node.lineno)
+                    yield self.finding(
+                        src, node.lineno,
+                        f"{node.func.id}({node.args[0].id}) coerces a traced "
+                        f"argument to a Python scalar inside a traced body ({reason})",
+                        context=qual,
+                    )
+
+
+@register
+class TraceEnvRead(Rule):
+    id = "DDR103"
+    name = "trace-env-read"
+    severity = "error"
+    rationale = (
+        "os.environ/os.getenv inside a traced body burns the knob in as a "
+        "compile-time constant (the DDR_WAVE_FIXED_US class of bug: env knobs "
+        "must be read at band-planning time, not trace time)."
+    )
+
+    def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
+        seen: set[int] = set()
+        for func, qual, reason in trace_index(src).traced_bodies():
+            for node in _walk_body(func):
+                if isinstance(node, (ast.Call, ast.Subscript)) and _env_read(node):
+                    if node.lineno in seen:
+                        continue
+                    seen.add(node.lineno)
+                    yield self.finding(
+                        src, node.lineno,
+                        f"environment read inside traced body ({reason}); the value "
+                        "becomes a trace-time constant — read it at planning/build "
+                        "time and close over the result",
+                        context=qual,
+                    )

@@ -1,0 +1,149 @@
+"""Parsed-source model shared by every lint rule.
+
+One :class:`SourceFile` per scanned ``.py`` file: raw text, the ``ast`` tree,
+per-line ``# ddr-lint: disable=...`` pragmas, and the derived indexes every
+rule keeps re-needing (parent links, enclosing-scope qualnames, dotted-name
+resolution). All lazy — a rule that only looks at raw lines never pays for
+the tree walk.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+#: Per-line suppression: ``x = hash(k)  # ddr-lint: disable=DDR301`` (several
+#: ids comma-separated). The pragma must sit on the finding's anchor line.
+PRAGMA_RE = re.compile(r"#\s*ddr-lint:\s*disable=([A-Z0-9,\s]+)")
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class SourceFile:
+    def __init__(self, path: Path, rel: str, text: str | None = None) -> None:
+        self.path = path
+        self.rel = rel  # posix, repo-root-relative
+        self.text = path.read_text(encoding="utf-8") if text is None else text
+        self._tree: ast.Module | None = None
+        self._parse_error: SyntaxError | None = None
+        self._parents: dict[ast.AST, ast.AST] | None = None
+        self._scopes: dict[ast.AST, str] | None = None
+        self._pragmas: dict[int, set[str]] | None = None
+
+    # ---- parsing ----
+
+    @property
+    def tree(self) -> ast.Module | None:
+        """The parsed module, or None on a syntax error (reported once by the
+        engine as an internal finding — a broken file is its own CI failure
+        elsewhere, the linter must not crash on it)."""
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=str(self.path))
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> SyntaxError | None:
+        _ = self.tree
+        return self._parse_error
+
+    # ---- derived indexes ----
+
+    @property
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            if self.tree is not None:
+                for parent in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(parent):
+                        self._parents[child] = parent
+        return self._parents
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    @property
+    def scopes(self) -> dict[ast.AST, str]:
+        """node -> qualname of the INNERMOST enclosing function/class scope
+        (``"<module>"`` at top level). The node's own def counts as its scope,
+        so a finding on a ``def`` line attributes to that function."""
+        if self._scopes is None:
+            scopes: dict[ast.AST, str] = {}
+
+            def visit(node: ast.AST, qual: str) -> None:
+                scopes[node] = qual
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                        sep = "." if qual != "<module>" else ""
+                        base = qual if qual != "<module>" else ""
+                        visit(child, f"{base}{sep}{child.name}")
+                    else:
+                        visit(child, qual)
+
+            if self.tree is not None:
+                visit(self.tree, "<module>")
+            self._scopes = scopes
+        return self._scopes
+
+    def qualname(self, node: ast.AST) -> str:
+        return self.scopes.get(node, "<module>")
+
+    def qualname_at(self, line: int) -> str:
+        """Qualname of the innermost def/class whose span contains ``line``."""
+        best: tuple[int, str] | None = None
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    end = node.end_lineno or node.lineno
+                    if node.lineno <= line <= end:
+                        span = end - node.lineno
+                        if best is None or span <= best[0]:
+                            best = (span, self.scopes.get(node, node.name))
+        return best[1] if best else "<module>"
+
+    @property
+    def pragmas(self) -> dict[int, set[str]]:
+        """line number -> rule ids disabled on that line."""
+        if self._pragmas is None:
+            self._pragmas = {}
+            for i, line in enumerate(self.text.splitlines(), start=1):
+                m = PRAGMA_RE.search(line)
+                if m:
+                    ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+                    if ids:
+                        self._pragmas[i] = ids
+        return self._pragmas
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        return rule_id in self.pragmas.get(line, ())
+
+    # ---- cheap text-level reference check ----
+
+    def references(self, *tokens: str) -> bool:
+        """True when the module's AST mentions any token as a Name id or an
+        Attribute attr — the 'does this module participate in discipline X'
+        probe (e.g. ``track_jit`` / ``build_card``)."""
+        if self.tree is None:
+            return False
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name) and node.id in tokens:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in tokens:
+                return True
+        return False

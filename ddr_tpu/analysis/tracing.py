@@ -1,0 +1,178 @@
+"""Static detection of *traced* function bodies.
+
+The trace-safety family (DDR1xx) needs to know which function bodies execute
+under a JAX trace — ``jax.jit`` / ``pjit``, ``lax.scan`` / ``while_loop`` /
+``cond`` bodies, ``pl.pallas_call`` kernels, ``custom_vjp`` fwd/bwd rules —
+because host side effects there either burn in a trace-time constant (the
+``DDR_WAVE_FIXED_US``-read-at-trace-time class of bug) or silently run only
+once at trace time instead of every step.
+
+Detection is per-module and name-based (no imports, so no resolution across
+files): a local ``def`` or ``lambda`` is a **trace root** when it is
+
+- decorated with a jit-like decorator (``@jax.jit``,
+  ``@functools.partial(jax.jit, ...)``, ``@jax.custom_vjp``, ...), or
+- passed by name (or inline) as a function argument to a known trace wrapper
+  (``jax.jit(f)``, ``lax.scan(body, ...)``, ``pl.pallas_call(kernel, ...)``,
+  ``f.defvjp(fwd, bwd)``, ...).
+
+Tracedness then propagates through the module-local call graph: a function
+called by simple name from a traced body is itself traced (one module deep —
+cross-module helpers are out of scope for a pure-AST pass, and in this tree
+the traced helpers live next to their callers).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ddr_tpu.analysis.source import SourceFile, dotted_name
+
+#: Call targets whose function-valued arguments are traced. Matched on the
+#: LAST dotted components so both ``jax.lax.scan`` and ``lax.scan`` (and a
+#: bare ``scan`` import-from) hit. Keys are the bare function name; a set of
+#: allowed full-dotted suffixes guards the ambiguous bare names.
+_TRACE_WRAPPERS: dict[str, tuple[str, ...]] = {
+    "jit": ("jax.jit", "jit"),
+    "pjit": (),
+    "scan": ("jax.lax.scan", "lax.scan"),
+    "while_loop": ("jax.lax.while_loop", "lax.while_loop", "while_loop"),
+    "fori_loop": ("jax.lax.fori_loop", "lax.fori_loop", "fori_loop"),
+    "cond": ("jax.lax.cond", "lax.cond"),
+    "switch": ("jax.lax.switch", "lax.switch"),
+    "associative_scan": ("jax.lax.associative_scan", "lax.associative_scan", "associative_scan"),
+    "map": ("jax.lax.map", "lax.map"),
+    "pallas_call": ("pl.pallas_call", "pallas_call", "pallas.pallas_call"),
+    "vmap": ("jax.vmap", "vmap"),
+    "pmap": ("jax.pmap", "pmap"),
+    "shard_map": ("jax.experimental.shard_map.shard_map", "shard_map"),
+    "grad": ("jax.grad", "grad"),
+    "value_and_grad": ("jax.value_and_grad", "value_and_grad"),
+    "checkpoint": ("jax.checkpoint",),
+    "remat": ("jax.remat", "remat"),
+    "custom_vjp": ("jax.custom_vjp", "custom_vjp"),
+    "custom_jvp": ("jax.custom_jvp", "custom_jvp"),
+    "defvjp": (),  # f.defvjp(fwd, bwd) — attr name is distinctive on its own
+    "defjvp": (),
+}
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit"}
+
+
+def is_trace_wrapper(func: ast.AST) -> bool:
+    """Is this call target a known trace wrapper?"""
+    name = dotted_name(func)
+    if name is None:
+        return False
+    bare = name.rsplit(".", 1)[-1]
+    allowed = _TRACE_WRAPPERS.get(bare)
+    if allowed is None:
+        return False
+    if not allowed:  # attr name alone is distinctive (pjit / defvjp / defjvp)
+        return True
+    return name in allowed
+
+
+def is_jit_call(node: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``jax.pjit(...)`` call (not functools.partial)."""
+    name = dotted_name(node.func)
+    return name in _JIT_NAMES
+
+
+def _partial_jit(node: ast.Call) -> bool:
+    """``functools.partial(jax.jit, ...)`` — the decorator idiom."""
+    name = dotted_name(node.func)
+    if name not in ("functools.partial", "partial") or not node.args:
+        return False
+    return dotted_name(node.args[0]) in _JIT_NAMES
+
+
+def jit_like_decorator(dec: ast.AST) -> bool:
+    """Decorator forms that trace the decorated def."""
+    if dotted_name(dec) in _JIT_NAMES | {"jax.custom_vjp", "custom_vjp", "jax.custom_jvp", "custom_jvp"}:
+        return True
+    if isinstance(dec, ast.Call):
+        if dotted_name(dec.func) in _JIT_NAMES:
+            return True
+        if _partial_jit(dec):
+            return True
+    return False
+
+
+class TraceIndex:
+    """Which defs/lambdas in one module are traced, and why."""
+
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        #: def/lambda node -> reason string ("@jax.jit", "lax.scan arg", ...)
+        self.traced: dict[ast.AST, str] = {}
+        self._defs_by_name: dict[str, list[ast.AST]] = {}
+        self._calls_in: dict[ast.AST, set[str]] = {}
+        if src.tree is not None:
+            self._build()
+
+    # -- construction --
+
+    def _build(self) -> None:
+        tree = self.src.tree
+        assert tree is not None
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+        roots: list[tuple[ast.AST, str]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if jit_like_decorator(dec):
+                        roots.append((node, f"@{dotted_name(dec) or dotted_name(getattr(dec, 'func', dec)) or 'jit'}"))
+            elif isinstance(node, ast.Call) and (is_trace_wrapper(node.func) or _partial_jit(node)):
+                wrapper = dotted_name(node.func) or "trace-wrapper"
+                args = node.args[1:] if _partial_jit(node) else node.args
+                for arg in args:
+                    if isinstance(arg, ast.Lambda):
+                        roots.append((arg, f"{wrapper} arg"))
+                    elif isinstance(arg, ast.Name):
+                        for d in self._defs_by_name.get(arg.id, ()):
+                            roots.append((d, f"{wrapper}({arg.id})"))
+        # propagate through the module-local simple-name call graph
+        pending = list(roots)
+        while pending:
+            node, reason = pending.pop()
+            if node in self.traced:
+                continue
+            self.traced[node] = reason
+            for name in self._called_names(node):
+                for d in self._defs_by_name.get(name, ()):
+                    if d not in self.traced:
+                        pending.append((d, f"called from traced {self._label(node)}"))
+
+    def _label(self, node: ast.AST) -> str:
+        return getattr(node, "name", "<lambda>")
+
+    def _called_names(self, func: ast.AST) -> set[str]:
+        if func not in self._calls_in:
+            names: set[str] = set()
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    names.add(node.func.id)
+            self._calls_in[func] = names
+        return self._calls_in[func]
+
+    # -- queries --
+
+    def traced_bodies(self):
+        """(func_node, qualname, reason) for every traced def/lambda."""
+        for node, reason in self.traced.items():
+            qual = self.src.qualname(node)
+            if isinstance(node, ast.Lambda):
+                qual = f"{qual}.<lambda>" if qual != "<module>" else "<lambda>"
+            yield node, qual, reason
+
+
+def trace_index(src: SourceFile) -> TraceIndex:
+    """Cached per-file TraceIndex (rules in the DDR1xx family share one)."""
+    cached = getattr(src, "_trace_index", None)
+    if cached is None:
+        cached = TraceIndex(src)
+        src._trace_index = cached  # type: ignore[attr-defined]
+    return cached

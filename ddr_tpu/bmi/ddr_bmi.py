@@ -31,6 +31,7 @@ import numpy as np
 import yaml
 
 from ddr_tpu.bmi.config import BmiInitConfig
+from ddr_tpu.observability.recompile import CompileTracker
 
 log = logging.getLogger(__name__)
 
@@ -105,7 +106,13 @@ class DdrBmi:
         self._interpolation: str = "constant"
         self._ngen_dt: int = 3600
 
-        # Compiled engine pieces (filled by initialize)
+        # Compiled engine pieces (filled by initialize). The tracker makes the
+        # BMI's jit cache auditable like every other engine's: ngen's fixed
+        # coupling interval means ONE compile in steady state, so a second
+        # `compile` event mid-run is a recompile storm worth a look (a host
+        # model driving update_until with drifting interval lengths re-pays
+        # XLA compile per distinct n_steps — static_argnums=(3, 4, 5)).
+        self._compile_tracker = CompileTracker()
         self._step_fn: Any = None  # jitted (q_t, q_prime) -> (q_t1, velocity, depth)
         self._hotstart_fn: Any = None  # jitted (q_prime,) -> q0
         self._q_t: Any = None  # (N,) device array, current discharge state
@@ -339,6 +346,10 @@ class DdrBmi:
             not self._cold_started,
         )
         self._cold_started = True
+        self._compile_tracker.track_jit(
+            "bmi.multi_step", self._multi_step_fn,
+            key=f"n_steps={n_steps},linear={use_linear}",
+        )
         self._current_time += n_steps * self._timestep
 
         self._discharge[:] = np.asarray(self._q_t, dtype=np.float32)

@@ -26,6 +26,7 @@ _COMMANDS = {
     "audit": "ddr_tpu.scripts.audit",
     "gen-config-docs": "ddr_tpu.scripts.gen_config_docs",
     "sweep": "ddr_tpu.scripts.sweep",
+    "lint": "ddr_tpu.analysis.cli",
 }
 
 

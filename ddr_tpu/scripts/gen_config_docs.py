@@ -41,8 +41,12 @@ variables. Families with their own reference tables are linked.
   Unset/empty = off. Heterogeneous fleets should pin per-platform paths
   (XLA:CPU serializes host-specialized executables).
 - `DDR_METRICS_DIR`, `DDR_HEARTBEAT_EVERY`, `DDR_METRICS_FLUSH_EVERY`,
-  `DDR_PROM_PORT`, `DDR_HEALTH_*`, `DDR_SKILL_*` — observability (incl.
-  spatial attribution & hydrologic skill): see docs/observability.md.
+  `DDR_PROM_PORT`, `DDR_HEALTH_*`, `DDR_SKILL_*`, `DDR_SLO_*` — observability
+  (incl. spatial attribution & hydrologic skill, SLO burn-rate accounting):
+  see docs/observability.md.
+- `DDR_PROGRAM_CARDS` (compiled-program cost attribution opt-out),
+  `DDR_PROFILE_DIR` (jax.profiler trace capture dir) — cost attribution and
+  profiling: see docs/observability.md.
 - `DDR_WAVE_FIXED_US`, `DDR_WAVE_RING_GBPS` — wave-cost-model constants for
   band planning (chip re-calibration knobs): see docs/tpu.md "The gap-sized
   ring".
@@ -52,7 +56,41 @@ variables. Families with their own reference tables are linked.
   `DDR_IO_RETRY_BACKOFF_S`, `DDR_FAULTS` / `DDR_FAULTS_SEED` — robustness:
   checkpointing, elastic resume & resharding, remote-read retries, fault
   injection: see docs/robustness.md.
+- `DDR_DISTRIBUTED`, `DDR_NUM_PROCESSES`, `DDR_PROCESS_ID`,
+  `DDR_COORDINATOR` — multi-process (multi-host) bootstrap consumed by
+  `ddr_tpu.parallel.distributed` before jax initializes; see docs/tpu.md.
+- `DDR_VERSION` — free-form provenance stamp written into `ddr benchmark` /
+  `ddr test` / `ddr route` / `ddr geometry-predictor` output metadata
+  (default `"dev"`).
 """
+
+KNOB_INVENTORY_HEADER = """### Complete `DDR_*` knob inventory (AST-harvested)
+
+Every `DDR_*` environment variable read by literal name anywhere in the
+product tree (`ddr_tpu/`, `bench.py`, `examples/`), harvested by the same
+pure-AST scanner `ddr lint` rule DDR502 checks parity with — so this list can
+never drift from the code. Knobs read through a constructed prefix
+(`DDR_HEALTH_*`, `DDR_SKILL_*`, `DDR_SLO_*` members) are documented by their
+family entries above.
+"""
+
+
+def knob_inventory_section(root: Path | None = None) -> str:
+    """Render the harvested knob inventory (module paths, no line numbers, so
+    the generated docs stay stable under unrelated edits)."""
+    from ddr_tpu.analysis.rules.consistency import harvest_env_knobs
+
+    root = root or Path(__file__).resolve().parents[2]
+    inventory = harvest_env_knobs(root)
+    lines = [KNOB_INVENTORY_HEADER]
+    for knob in sorted(inventory):
+        modules = sorted({rel for rel, _ in inventory[knob]})
+        shown = ", ".join(f"`{m}`" for m in modules[:4])
+        if len(modules) > 4:
+            shown += f" (+{len(modules) - 4} more)"
+        lines.append(f"- `{knob}` — read by {shown}")
+    lines.append("")
+    return "\n".join(lines)
 
 
 def _schema_type(prop: dict[str, Any], defs: dict[str, Any]) -> str:
@@ -171,6 +209,7 @@ def generate() -> str:
                 emitted.add(def_name)
                 out += _model_section(def_name, def_schema, defs, models.get(def_name))
     out.append(FOOTER)
+    out.append(knob_inventory_section())
     return "\n".join(out)
 
 

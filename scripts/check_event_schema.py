@@ -4,100 +4,47 @@
 ``Recorder.emit`` deliberately *writes* unknown event types (with a warning)
 so experiments never lose data — which means a typo'd or unregistered event
 name ships silently and ``ddr metrics summarize`` / the Prometheus tee just
-never aggregate it. This script closes that gap statically: it AST-parses
-every product source file, collects each ``*.emit("<literal>", ...)`` /
-``*._emit("<literal>", ...)`` call site, and fails if any name is missing
-from ``EVENT_TYPES`` in ddr_tpu/observability/events.py.
+never aggregate it. This gate closes that statically.
 
-Run directly (CI) or via the test suite (tests/scripts/test_check_event_schema.py):
+This script is now a thin shim over ``ddr_tpu.analysis`` (the ``ddr lint``
+analyzer), which folded the check in as rule DDR501 — the implementation and
+message formats live in ``ddr_tpu/analysis/rules/consistency.py``. The CLI
+contract is unchanged: run directly (CI) or via the test suite
+(tests/scripts/test_check_event_schema.py):
 
     python scripts/check_event_schema.py [--root DIR]
 
-Deliberately import-free for the target tree (pure ``ast``): it must run in
-seconds on a box with no jax, and must not execute repo code to audit it.
-Forwarding wrappers (``rec.emit(event, **payload)``) pass a *variable* first
-argument and are skipped — only literals are checkable, and every
-producer-side call site in this tree uses a literal.
+Still deliberately import-free for the *target* tree (pure ``ast``, no jax):
+``ddr_tpu.analysis`` is a stdlib-only package and ``ddr_tpu/__init__.py`` is
+empty, so importing it executes no accelerator code.
 """
 
 from __future__ import annotations
 
 import argparse
-import ast
 import sys
 from pathlib import Path
 
-#: Product code to scan, relative to the repo root. tests/ is excluded on
-#: purpose: it emits intentionally-bogus names to pin the warn-but-write
-#: behavior.
-SCAN = ("ddr_tpu", "bench.py", "examples")
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-EVENTS_PY = Path("ddr_tpu/observability/events.py")
-EMIT_NAMES = {"emit", "_emit"}
+from ddr_tpu.analysis.rules.consistency import (  # noqa: E402
+    EMIT_NAMES,
+    EVENTS_PY,
+    SCAN,
+    check_tree,
+    emit_call_sites,
+    registered_events,
+)
 
-
-def registered_events(events_py: Path) -> tuple[str, ...]:
-    """``EVENT_TYPES`` from events.py, by AST (no import, no jax)."""
-    tree = ast.parse(events_py.read_text(encoding="utf-8"), filename=str(events_py))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-            if "EVENT_TYPES" in targets:
-                value = ast.literal_eval(node.value)
-                return tuple(str(v) for v in value)
-    raise SystemExit(f"could not find an EVENT_TYPES assignment in {events_py}")
-
-
-def emit_call_sites(path: Path) -> list[tuple[int, str]]:
-    """``(line, literal_event_name)`` for every ``X.emit("name", ...)`` /
-    ``X._emit("name", ...)`` in one file."""
-    try:
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-    except SyntaxError as e:  # a broken file is its own CI failure elsewhere
-        print(f"warning: could not parse {path}: {e}", file=sys.stderr)
-        return []
-    sites: list[tuple[int, str]] = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
-            continue
-        if node.func.attr not in EMIT_NAMES or not node.args:
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            sites.append((node.lineno, first.value))
-    return sites
-
-
-def check_tree(root: Path) -> int:
-    events = set(registered_events(root / EVENTS_PY))
-    offenders: list[str] = []
-    n_sites = 0
-    for rel in SCAN:
-        target = root / rel
-        files = (
-            [target] if target.is_file()
-            else sorted(p for p in target.rglob("*.py") if "__pycache__" not in p.parts)
-        )
-        for f in files:
-            for line, name in emit_call_sites(f):
-                n_sites += 1
-                if name not in events:
-                    offenders.append(
-                        f"{f.relative_to(root)}:{line}: emit({name!r}) is not in "
-                        "EVENT_TYPES (ddr_tpu/observability/events.py) — register "
-                        "it (and document it in docs/observability.md) or fix the typo"
-                    )
-    if offenders:
-        print("\n".join(offenders), file=sys.stderr)
-        return 1
-    if n_sites == 0:
-        # zero matches means the matcher rotted, not that the tree is clean
-        print("error: found no emit() call sites at all — matcher broken?",
-              file=sys.stderr)
-        return 1
-    print(f"ok: {n_sites} emit() call sites, all registered in EVENT_TYPES "
-          f"({len(events)} types)")
-    return 0
+__all__ = [
+    "SCAN",
+    "EVENTS_PY",
+    "EMIT_NAMES",
+    "registered_events",
+    "emit_call_sites",
+    "check_tree",
+    "main",
+]
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""CI gate: run ``ddr lint`` (the pure-AST analyzer) over the committed tree.
+
+Sits beside the other ``check_*`` gates (check_event_schema, check_audit,
+check_bench_regression) and follows the same exit-code convention:
+
+- 0: clean (baseline-suppressed findings allowed)
+- 1: findings — real hazards to fix, pragma, or baseline with a justification
+- 2: the linter itself broke (parse errors, bad baseline, jax got imported)
+
+The analyzer's contract is that it never imports jax (it must run in seconds
+on a box with no accelerator stack and must not execute repo code to audit
+it); this gate enforces that by failing hard if ``jax`` shows up in
+``sys.modules`` after the run.
+
+    python scripts/check_lint.py [--root DIR] [lint args...]
+
+Extra arguments are forwarded to ``ddr lint`` (e.g. ``--no-baseline``,
+``--changed-only``, ``--format json``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ddr_tpu.analysis.cli import main as lint_main  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not any(a == "--root" or a.startswith("--root=") for a in argv):
+        argv = ["--root", str(Path(__file__).resolve().parents[1]), *argv]
+    # Snapshot first: some images preload jax from sitecustomize at
+    # interpreter startup — only an import *caused by the analyzer* fails.
+    jax_preloaded = "jax" in sys.modules
+    rc = lint_main(argv)
+    if "jax" in sys.modules and not jax_preloaded:
+        print(
+            "error: the analyzer imported jax — it must stay pure-AST "
+            "(stdlib only); a rule module grew a runtime dependency",
+            file=sys.stderr,
+        )
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

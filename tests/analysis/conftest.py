@@ -1,0 +1,32 @@
+"""Helpers for the ``ddr lint`` analyzer tests: build a throwaway source tree
+and run the engine over it in-process."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from ddr_tpu.analysis.engine import run_lint
+
+
+def write_tree(root: Path, files: dict[str, str]) -> None:
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+
+
+@pytest.fixture
+def lint_tree(tmp_path):
+    """``lint_tree({relpath: source, ...}, rules=[...], **run_lint_kwargs)``
+    — writes the files under a tmp root and full-scans it (fixture roots lack
+    bench.py/examples; the engine skips missing default-surface entries)."""
+
+    def _run(files: dict[str, str], rules: list[str] | None = None, **kw):
+        write_tree(tmp_path, files)
+        return run_lint(tmp_path, rule_ids=rules, **kw)
+
+    _run.root = tmp_path
+    return _run

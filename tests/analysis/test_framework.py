@@ -1,0 +1,324 @@
+"""Suppression-framework and CLI tests: pragmas, the committed baseline,
+--changed-only, output formats, and the exit-code contract (0/1/2)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import textwrap
+
+import pytest
+
+from ddr_tpu.analysis.baseline import Baseline, BaselineError
+from ddr_tpu.analysis.cli import main as lint_main
+from ddr_tpu.analysis.core import all_rules
+from ddr_tpu.analysis.engine import LintError, run_lint
+from tests.analysis.conftest import write_tree
+
+_BAD_HASH = """\
+    def seed_for(name):
+        return hash(name) % 2**31
+"""
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_on_its_line(lint_tree):
+    result = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            def seed_for(name):
+                return hash(name) % 2**31  # ddr-lint: disable=DDR301
+        """},
+        rules=["DDR301"],
+    )
+    assert result.findings == []
+    assert result.suppressed_pragma == 1
+
+
+def test_pragma_is_rule_specific(lint_tree):
+    result = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            def seed_for(name):
+                return hash(name) % 2**31  # ddr-lint: disable=DDR999
+        """},
+        rules=["DDR301"],
+    )
+    assert [f.rule for f in result.findings] == ["DDR301"]
+    assert result.suppressed_pragma == 0
+
+
+def test_pragma_multiple_rules_one_line(lint_tree):
+    result = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            def order(xs):
+                return list(set(xs)), hash(xs[0])  # ddr-lint: disable=DDR301,DDR303
+        """},
+        rules=["DDR301", "DDR303"],
+    )
+    assert result.findings == []
+    assert result.suppressed_pragma == 2
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def _baseline(entries):
+    return json.dumps({"version": 1, "entries": entries})
+
+
+def test_baseline_suppresses_by_rule_path_context(lint_tree):
+    result = lint_tree(
+        {
+            "ddr_tpu/mod.py": _BAD_HASH,
+            "lint_baseline.json": _baseline([{
+                "rule": "DDR301", "path": "ddr_tpu/mod.py",
+                "context": "seed_for", "justification": "legacy seed format",
+            }]),
+        },
+        rules=["DDR301"],
+    )
+    assert result.findings == []
+    assert result.suppressed_baseline == 1
+    assert result.unused_baseline == []
+
+
+def test_baseline_wildcard_context(lint_tree):
+    result = lint_tree(
+        {
+            "ddr_tpu/mod.py": _BAD_HASH,
+            "lint_baseline.json": _baseline([{
+                "rule": "DDR301", "path": "ddr_tpu/mod.py",
+                "context": "*", "justification": "whole-file accepted",
+            }]),
+        },
+        rules=["DDR301"],
+    )
+    assert result.findings == []
+    assert result.suppressed_baseline == 1
+
+
+def test_baseline_survives_line_churn_but_not_context_change(lint_tree):
+    # same finding pushed 20 lines down still matches (keyed on context,
+    # never line); a different enclosing function does not.
+    pad = "# pad\n" * 20
+    result = lint_tree(
+        {
+            "ddr_tpu/mod.py": pad + textwrap.dedent(_BAD_HASH),
+            "lint_baseline.json": _baseline([
+                {"rule": "DDR301", "path": "ddr_tpu/mod.py",
+                 "context": "seed_for", "justification": "legacy"},
+                {"rule": "DDR301", "path": "ddr_tpu/mod.py",
+                 "context": "other_fn", "justification": "stale"},
+            ]),
+        },
+        rules=["DDR301"],
+    )
+    assert result.findings == []
+    assert result.suppressed_baseline == 1
+    assert [e["context"] for e in result.unused_baseline] == ["other_fn"]
+
+
+def test_no_baseline_strict_mode(lint_tree):
+    result = lint_tree(
+        {
+            "ddr_tpu/mod.py": _BAD_HASH,
+            "lint_baseline.json": _baseline([{
+                "rule": "DDR301", "path": "ddr_tpu/mod.py",
+                "context": "*", "justification": "accepted",
+            }]),
+        },
+        rules=["DDR301"],
+        use_baseline=False,
+    )
+    assert [f.rule for f in result.findings] == ["DDR301"]
+
+
+def test_baseline_requires_justification(tmp_path):
+    write_tree(tmp_path, {
+        "ddr_tpu/mod.py": _BAD_HASH,
+        "lint_baseline.json": _baseline(
+            [{"rule": "DDR301", "path": "ddr_tpu/mod.py", "justification": "  "}]
+        ),
+    })
+    with pytest.raises(BaselineError, match="empty justification"):
+        run_lint(tmp_path, rule_ids=["DDR301"])
+
+
+def test_baseline_rejects_malformed_json(tmp_path):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": "X = 1\n", "lint_baseline.json": "{nope"})
+    with pytest.raises(BaselineError, match="unparseable"):
+        run_lint(tmp_path, rule_ids=["DDR301"])
+
+
+def test_write_baseline_dedupes_and_marks_todo(tmp_path):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": _BAD_HASH})
+    result = run_lint(tmp_path, rule_ids=["DDR301"], use_baseline=False)
+    out = tmp_path / "lint_baseline.json"
+    Baseline.write(out, result.findings)
+    doc = json.loads(out.read_text())
+    assert doc["entries"] == [{
+        "rule": "DDR301", "path": "ddr_tpu/mod.py", "context": "seed_for",
+        "justification": "TODO: justify or fix",
+    }]
+
+
+# ---------------------------------------------------------------------------
+# engine behaviors
+# ---------------------------------------------------------------------------
+
+def test_unknown_rule_id_is_internal_error(tmp_path):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": "X = 1\n"})
+    with pytest.raises(LintError, match="unknown rule id"):
+        run_lint(tmp_path, rule_ids=["DDR999"])
+
+
+def test_explicit_missing_path_is_internal_error(tmp_path):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": "X = 1\n"})
+    with pytest.raises(LintError, match="no such file"):
+        run_lint(tmp_path, paths=[tmp_path / "nope.py"])
+
+
+def test_parse_error_reported_not_crashed(lint_tree):
+    result = lint_tree({"ddr_tpu/broken.py": "def f(:\n"}, rules=["DDR301"])
+    assert result.findings == []
+    assert len(result.parse_errors) == 1
+    assert "ddr_tpu/broken.py" in result.parse_errors[0]
+
+
+def test_finalize_rules_skipped_on_partial_scan(tmp_path):
+    """Cross-file registry checks only run on full-tree scans — judging
+    EVENT_TYPES coverage against one file would fire the broken-matcher
+    guard on every clean single-file lint."""
+    write_tree(tmp_path, {
+        "ddr_tpu/observability/events.py": 'EVENT_TYPES = ("epoch",)\n',
+        "ddr_tpu/mod.py": "X = 1\n",
+    })
+    partial = run_lint(tmp_path, paths=[tmp_path / "ddr_tpu/mod.py"], rule_ids=["DDR501"])
+    assert partial.findings == []
+    full = run_lint(tmp_path, rule_ids=["DDR501"])
+    assert [f.rule for f in full.findings] == ["DDR501"]  # zero-sites guard
+
+
+def _git(root, *args):
+    subprocess.run(
+        ["git", "-C", str(root), *args], check=True, capture_output=True,
+        env={"PATH": "/usr/bin:/bin", "HOME": str(root),
+             "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+             "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+    )
+
+
+def test_changed_only_filters_to_touched_files(tmp_path):
+    write_tree(tmp_path, {
+        "ddr_tpu/committed.py": _BAD_HASH,
+        "lint_baseline.json": _baseline([{
+            "rule": "DDR301", "path": "ddr_tpu/committed.py",
+            "context": "*", "justification": "accepted",
+        }]),
+    })
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # committed finding is filtered out; a new untracked bad file reports
+    write_tree(tmp_path, {"ddr_tpu/fresh.py": _BAD_HASH})
+    result = run_lint(tmp_path, rule_ids=["DDR301"], changed_only=True)
+    assert [(f.rule, f.path) for f in result.findings] == [("DDR301", "ddr_tpu/fresh.py")]
+    # the committed file's baseline entry had no chance to match under the
+    # changed-only filter — it must NOT be reported stale
+    assert result.unused_baseline == []
+
+
+def test_changed_only_outside_git_is_internal_error(tmp_path):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": _BAD_HASH})
+    with pytest.raises(LintError, match="--changed-only"):
+        run_lint(tmp_path, rule_ids=["DDR301"], changed_only=True)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_exit_0(tmp_path, capsys):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": "X = 1\n"})
+    assert lint_main(["--root", str(tmp_path)]) == 0
+    assert "ddr lint: clean" in capsys.readouterr().out
+
+
+def test_cli_findings_exit_1_text(tmp_path, capsys):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": _BAD_HASH})
+    assert lint_main(["--root", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "ddr_tpu/mod.py:2: DDR301 error:" in out
+    assert "[seed_for]" in out
+    assert "1 finding(s)" in out
+
+
+def test_cli_parse_error_exit_2(tmp_path, capsys):
+    write_tree(tmp_path, {"ddr_tpu/broken.py": "def f(:\n"})
+    assert lint_main(["--root", str(tmp_path)]) == 2
+    assert "could not parse" in capsys.readouterr().err
+
+
+def test_cli_bad_baseline_exit_2(tmp_path, capsys):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": "X = 1\n", "lint_baseline.json": "{nope"})
+    assert lint_main(["--root", str(tmp_path)]) == 2
+    assert "internal error" in capsys.readouterr().err
+
+
+def test_cli_json_format(tmp_path, capsys):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": _BAD_HASH})
+    assert lint_main(["--root", str(tmp_path), "--format", "json", "--rules", "DDR301"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["errors"] == 1
+    (finding,) = doc["findings"]
+    assert finding["rule"] == "DDR301"
+    assert finding["path"] == "ddr_tpu/mod.py"
+    assert finding["context"] == "seed_for"
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    write_tree(tmp_path, {"ddr_tpu/mod.py": _BAD_HASH})
+    assert lint_main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    baseline = tmp_path / "lint_baseline.json"
+    assert "TODO: justify or fix" in baseline.read_text()
+    capsys.readouterr()
+    # the written baseline suppresses the finding on the next run...
+    assert lint_main(["--root", str(tmp_path)]) == 0
+    assert "1 suppressed (1 baseline)" in capsys.readouterr().out
+    # ...but blanking a justification is an internal error, not a pass
+    doc = json.loads(baseline.read_text())
+    doc["entries"][0]["justification"] = ""
+    baseline.write_text(json.dumps(doc))
+    assert lint_main(["--root", str(tmp_path)]) == 2
+
+
+def test_cli_unused_baseline_note(tmp_path, capsys):
+    write_tree(tmp_path, {
+        "ddr_tpu/mod.py": "X = 1\n",
+        "lint_baseline.json": _baseline([{
+            "rule": "DDR301", "path": "ddr_tpu/gone.py",
+            "context": "*", "justification": "was accepted",
+        }]),
+    })
+    assert lint_main(["--root", str(tmp_path)]) == 0
+    assert "unused baseline entry DDR301 ddr_tpu/gone.py" in capsys.readouterr().err
+
+
+def test_cli_list_rules_covers_catalog(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in all_rules():
+        assert rule_id in out
+
+
+def test_rule_catalog_shape():
+    rules = all_rules()
+    assert len(rules) == 13
+    families = {rid[:4] for rid in rules}
+    assert families == {"DDR1", "DDR2", "DDR3", "DDR4", "DDR5"}
+    for rule in rules.values():
+        assert rule.severity in ("error", "warning")
+        assert rule.rationale

@@ -1,0 +1,490 @@
+"""Fixture-pair tests: every rule ID fires on its bad fixture and stays quiet
+on the corresponding good one. One pair per rule, matching the catalog in
+docs/static_analysis.md."""
+
+from __future__ import annotations
+
+
+def _ids(result):
+    return [(f.rule, f.path) for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# DDR1xx — trace safety
+# ---------------------------------------------------------------------------
+
+def test_ddr101_host_effect_in_jit(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()
+                return x + t0
+        """},
+        rules=["DDR101"],
+    )
+    assert _ids(bad) == [("DDR101", "ddr_tpu/mod.py")]
+    assert "time.time" in bad.findings[0].message
+    assert bad.findings[0].context == "step"
+
+
+def test_ddr101_good_host_effect_outside_trace(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import time
+            import jax
+
+            def train(x):
+                t0 = time.time()   # host code: fine
+                return jax.jit(lambda y: y + 1)(x), time.time() - t0
+        """},
+        rules=["DDR101"],
+    )
+    assert good.findings == []
+
+
+def test_ddr101_propagates_through_local_call_graph(lint_tree):
+    """A helper only ever called from a scan body is itself traced."""
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import time
+            import jax
+
+            def _inner(carry, x):
+                time.sleep(0.1)
+                return carry, x
+
+            def run(xs):
+                return jax.lax.scan(_inner, 0.0, xs)
+        """},
+        rules=["DDR101"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR101"]
+    assert bad.findings[0].context == "_inner"
+
+
+def test_ddr102_item_and_param_coercion(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                a = x.item()
+                b = float(x)
+                return a + b
+        """},
+        rules=["DDR102"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR102", "DDR102"]
+
+
+def test_ddr102_good_no_coercion(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * 2.0
+
+            def host(x):
+                return float(x)   # untraced: fine
+        """},
+        rules=["DDR102"],
+    )
+    assert good.findings == []
+
+
+def test_ddr103_env_read_in_traced_body(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import os
+            import jax
+
+            @jax.jit
+            def step(x):
+                fixed = float(os.environ.get("DDR_WAVE_FIXED_US", "7.0"))
+                return x + fixed
+        """},
+        rules=["DDR103"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR103"]
+    assert "trace-time constant" in bad.findings[0].message
+
+
+def test_ddr103_good_env_read_at_planning_time(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import os
+            import jax
+
+            def make_step():
+                fixed = float(os.environ.get("DDR_WAVE_FIXED_US", "7.0"))
+
+                @jax.jit
+                def step(x):
+                    return x + fixed
+                return step
+        """},
+        rules=["DDR103"],
+    )
+    assert good.findings == []
+
+
+# ---------------------------------------------------------------------------
+# DDR2xx — recompile hazards
+# ---------------------------------------------------------------------------
+
+def test_ddr201_jit_of_lambda_in_loop(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            fns = []
+            for i in range(4):
+                fns.append(jax.jit(lambda x: x + i))
+        """},
+        rules=["DDR201"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR201"]
+
+
+def test_ddr201_good_jit_hoisted(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            step = jax.jit(lambda x: x + 1)
+
+            def run(xs):
+                out = []
+                for x in xs:
+                    out.append(step(x))   # calling a jitted fn in a loop: fine
+                return out
+        """},
+        rules=["DDR201"],
+    )
+    assert good.findings == []
+
+
+def test_ddr202_unhashable_static_default(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            @jax.jit(static_argnames="names")
+            def gather(x, names=["n", "q"]):
+                return x
+        """},
+        rules=["DDR202"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR202"]
+    assert "'names'" in bad.findings[0].message
+
+
+def test_ddr202_good_tuple_default(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            @jax.jit(static_argnames="names")
+            def gather(x, names=("n", "q")):
+                return x
+        """},
+        rules=["DDR202"],
+    )
+    assert good.findings == []
+
+
+def test_ddr203_unaudited_jit_in_product_module(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            def build(fn):
+                return jax.jit(fn)
+        """},
+        rules=["DDR203"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR203"]
+    assert "track_jit" in bad.findings[0].message
+
+
+def test_ddr203_good_module_references_tracker(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import jax
+
+            def build(fn, tracker):
+                compiled = jax.jit(fn)
+                tracker.track_jit("engine", compiled)
+                return compiled
+        """},
+        rules=["DDR203"],
+    )
+    assert good.findings == []
+
+
+def test_ddr203_ignores_non_product_paths(lint_tree):
+    """The auditing discipline applies to ddr_tpu/ only (bench/examples
+    measure compiles on purpose)."""
+    good = lint_tree(
+        {"ddr_tpu/ok.py": "X = 1\n",
+         "bench.py": "import jax\nstep = jax.jit(lambda x: x)\n"},
+        rules=["DDR203"],
+    )
+    assert good.findings == []
+
+
+# ---------------------------------------------------------------------------
+# DDR3xx — determinism / resume safety
+# ---------------------------------------------------------------------------
+
+def test_ddr301_salted_hash(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            def seed_for(name):
+                return hash(name) % 2**31
+        """},
+        rules=["DDR301"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR301"]
+    assert bad.findings[0].context == "seed_for"
+
+
+def test_ddr301_good_crc32(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import zlib
+
+            def seed_for(name):
+                return zlib.crc32(name.encode()) % 2**31
+        """},
+        rules=["DDR301"],
+    )
+    assert good.findings == []
+
+
+def test_ddr302_wallclock_defaults(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import dataclasses
+            import time
+
+            @dataclasses.dataclass
+            class Meta:
+                stamp: float = time.time()
+                created: float = dataclasses.field(default_factory=time.time)
+        """},
+        rules=["DDR302"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR302", "DDR302"]
+
+
+def test_ddr302_good_explicit_timestamp(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import dataclasses
+
+            @dataclasses.dataclass
+            class Meta:
+                stamp: float   # caller passes the timestamp explicitly
+        """},
+        rules=["DDR302"],
+    )
+    assert good.findings == []
+
+
+def test_ddr303_set_materialization(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            def order(xs, ys):
+                a = list(set(xs))
+                b = tuple(set(xs) - set(ys))
+                return a, b
+        """},
+        rules=["DDR303"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR303", "DDR303"]
+
+
+def test_ddr303_good_sorted(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            def order(xs, ys):
+                a = sorted(set(xs))
+                b = tuple(sorted(set(xs) - set(ys)))
+                return a, b
+        """},
+        rules=["DDR303"],
+    )
+    assert good.findings == []
+
+
+# ---------------------------------------------------------------------------
+# DDR4xx — lock discipline
+# ---------------------------------------------------------------------------
+
+_WRITER = """\
+    import threading
+
+    class Writer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            with self._lock:
+                self._pending.append(1)
+
+        def flush(self):
+            {flush_body}
+"""
+
+
+def test_ddr401_write_outside_lock(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/mod.py": _WRITER.format(flush_body="self._pending = []")},
+        rules=["DDR401"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR401"]
+    assert "flush()" in bad.findings[0].message
+    assert bad.findings[0].context == "Writer.flush"
+
+
+def test_ddr401_good_guarded_everywhere(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/mod.py": _WRITER.format(
+            flush_body="with self._lock:\n            self._pending = []")},
+        rules=["DDR401"],
+    )
+    assert good.findings == []
+
+
+def test_ddr401_init_exempt_and_unthreaded_module_skipped(lint_tree):
+    # __init__ writes happen-before thread start; a module with no Thread
+    # reference is out of scope entirely even with the same write pattern.
+    good = lint_tree(
+        {"ddr_tpu/mod.py": """\
+            import threading
+
+            class Plain:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0   # construction: exempt
+        """},
+        rules=["DDR401"],
+    )
+    assert good.findings == []
+
+
+# ---------------------------------------------------------------------------
+# DDR5xx — consistency gates (need registry files in the fixture tree)
+# ---------------------------------------------------------------------------
+
+_EVENTS_PY = 'EVENT_TYPES = ("epoch", "route")\n'
+_FAULTS_PY = 'FAULT_SITES = ("data.load", "device.step")\n'
+
+
+def test_ddr501_unregistered_event(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/observability/events.py": _EVENTS_PY,
+         "ddr_tpu/mod.py": """\
+            def report(rec):
+                rec.emit("epoch", t=1.0)
+                rec.emit("epohc", t=2.0)
+        """},
+        rules=["DDR501"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR501"]
+    assert "'epohc'" in bad.findings[0].message
+
+
+def test_ddr501_good_all_registered(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/observability/events.py": _EVENTS_PY,
+         "ddr_tpu/mod.py": 'def report(rec):\n    rec.emit("epoch")\n'},
+        rules=["DDR501"],
+    )
+    assert good.findings == []
+
+
+def test_ddr501_zero_sites_means_broken_matcher(lint_tree):
+    broken = lint_tree(
+        {"ddr_tpu/observability/events.py": _EVENTS_PY,
+         "ddr_tpu/mod.py": "X = 1\n"},
+        rules=["DDR501"],
+    )
+    assert [f.rule for f in broken.findings] == ["DDR501"]
+    assert "matcher broken" in broken.findings[0].message
+
+
+_DOCS_MD = """\
+    # Configuration reference
+    - `DDR_FOO` — a documented knob.
+    - `DDR_FAM_*` — a documented family.
+    - `DDR_STALE` — documented but never read.
+"""
+
+
+def test_ddr502_both_directions(lint_tree):
+    result = lint_tree(
+        {"docs/config_reference.md": _DOCS_MD,
+         "ddr_tpu/mod.py": """\
+            import os
+            A = os.environ.get("DDR_FOO", "")
+            B = os.getenv("DDR_FAM_X")
+            C = os.environ["DDR_BAR"]
+        """},
+        rules=["DDR502"],
+    )
+    msgs = sorted(f.message for f in result.findings)
+    assert len(msgs) == 2
+    assert any("DDR_BAR" in m and "not documented" in m for m in msgs)
+    assert any("DDR_STALE" in m and "never read" in m for m in msgs)
+    # the stale-docs finding anchors at the docs file, not a source file
+    assert {f.path for f in result.findings} == {"ddr_tpu/mod.py", "docs/config_reference.md"}
+
+
+def test_ddr502_good_parity(lint_tree):
+    good = lint_tree(
+        {"docs/config_reference.md": "- `DDR_FOO`\n- `DDR_FAM_*`\n",
+         "ddr_tpu/mod.py": """\
+            import os
+            A = os.environ.get("DDR_FOO", "")
+            B = os.getenv("DDR_FAM_X")
+        """},
+        rules=["DDR502"],
+    )
+    assert good.findings == []
+
+
+def test_ddr503_unknown_fault_site(lint_tree):
+    bad = lint_tree(
+        {"ddr_tpu/observability/faults.py": _FAULTS_PY,
+         "ddr_tpu/mod.py": """\
+            def load(faults):
+                faults.maybe_inject("data.laod")
+        """},
+        rules=["DDR503"],
+    )
+    assert [f.rule for f in bad.findings] == ["DDR503"]
+    assert "data.laod" in bad.findings[0].message
+
+
+def test_ddr503_good_registered_site(lint_tree):
+    good = lint_tree(
+        {"ddr_tpu/observability/faults.py": _FAULTS_PY,
+         "ddr_tpu/mod.py": """\
+            def load(faults):
+                faults.maybe_inject("data.load")
+        """},
+        rules=["DDR503"],
+    )
+    assert good.findings == []

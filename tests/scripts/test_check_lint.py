@@ -1,0 +1,87 @@
+"""``scripts/check_lint.py`` gate tests: the committed tree lints clean, in
+seconds, without importing jax — and a planted hazard flips the exit code.
+
+All subprocess-based (like the other check_* gate tests): the contract under
+test is the CLI's, not the library's."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_lint.py"
+
+_BAD = "def seed_for(name):\n    return hash(name) % 2**31\n"
+
+
+def _run(*args, cwd=REPO, timeout=60):
+    return subprocess.run(
+        [sys.executable, str(SCRIPT), *args],
+        capture_output=True, text=True, cwd=cwd, timeout=timeout,
+    )
+
+
+def test_committed_tree_is_clean_and_fast():
+    """The acceptance pin: exit 0 on the repo as committed, well under 10s
+    (the analyzer parses ~130 files; jax import alone would blow the wall)."""
+    t0 = time.monotonic()
+    proc = _run()
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ddr lint: clean" in proc.stdout
+    assert elapsed < 10.0, f"gate took {elapsed:.1f}s — the <10s contract broke"
+
+
+def test_analyzer_never_imports_jax():
+    """Pure-AST contract, asserted via sys.modules in a fresh interpreter:
+    after a full-tree run, jax must be absent (ddr_tpu/__init__.py is empty
+    and ddr_tpu.analysis is stdlib-only)."""
+    code = (
+        "import sys; sys.path.insert(0, '.')\n"
+        "from ddr_tpu.analysis.cli import main\n"
+        "rc = main(['--root', '.'])\n"
+        "print('JAX_IMPORTED' if 'jax' in sys.modules else 'JAX_ABSENT')\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-S", "-c", code],  # -S: skip any jax-preloading sitecustomize
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "JAX_ABSENT" in proc.stdout
+
+
+def test_planted_hazard_exits_1(tmp_path):
+    (tmp_path / "ddr_tpu").mkdir()
+    (tmp_path / "ddr_tpu" / "bad.py").write_text(_BAD)
+    proc = _run("--root", str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "DDR301" in proc.stdout
+
+
+def test_malformed_baseline_exits_2(tmp_path):
+    (tmp_path / "ddr_tpu").mkdir()
+    (tmp_path / "ddr_tpu" / "ok.py").write_text("X = 1\n")
+    (tmp_path / "lint_baseline.json").write_text("{nope")
+    proc = _run("--root", str(tmp_path))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "internal error" in proc.stderr
+
+
+def test_forwarded_args_json_strict():
+    """check_lint forwards lint args; strict mode over the committed tree
+    must report only findings the committed baseline justifies."""
+    proc = _run("--no-baseline", "--format", "json")
+    doc = json.loads(proc.stdout)
+    if proc.returncode == 0:
+        assert doc["summary"]["findings"] == 0
+    else:
+        assert proc.returncode == 1
+        baseline = json.loads((REPO / "lint_baseline.json").read_text())
+        allowed = {(e["rule"], e["path"]) for e in baseline["entries"]}
+        for f in doc["findings"]:
+            assert (f["rule"], f["path"]) in allowed, f
