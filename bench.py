@@ -173,6 +173,24 @@ def bench_route(n: int, t_hours: int, depth: int | None = None) -> str:
     return f"{_timed_rate(compiled, q_prime, n, t_hours)}{_card_suffix(compiled)}"
 
 
+def _provenance_suffix(engine: str) -> str:
+    """`` engine_source=<src> tuned_plan=<engine>`` selection-provenance
+    tokens: where the engine decision came from (the auto-tuner's
+    ``policy|scored|probed|cached`` vocabulary — ``policy`` for the
+    single-device eligibility-driven auto-selection this bench runs) and what
+    plan it resolved to, so the regression gate can flag a planner that
+    silently walks a record onto a slower engine."""
+    try:
+        from ddr_tpu.tuning.planner import last_selection
+
+        sel = last_selection()
+        if sel:
+            return f" engine_source={sel['source']} tuned_plan={sel['engine']}"
+    except Exception:  # provenance is best-effort — the rate is the payload
+        pass
+    return f" engine_source=policy tuned_plan={engine}"
+
+
 def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
     """Deep-topology route bench; prints ``"<rate> <engine-label>"`` so the record
     names the engine that ACTUALLY ran (auto-selection may pick the single-ring
@@ -189,7 +207,10 @@ def bench_route_deep(n: int, t_hours: int, depth: int) -> str:
         network, channels, params, qp, gauges=gauges, kernel=kernel, dtype=dtype
     ).runoff)
     compiled = fn.lower(q_prime).compile()
-    return f"{_timed_rate(compiled, q_prime, n, t_hours)} {engine}{_card_suffix(compiled)}"
+    return (
+        f"{_timed_rate(compiled, q_prime, n, t_hours)} {engine}"
+        f"{_card_suffix(compiled)}{_provenance_suffix(engine)}"
+    )
 
 
 def bench_grad(n: int, t_hours: int, depth: int | None = None) -> str:
@@ -321,17 +342,24 @@ def _run_child(code: str, timeout: float, cpu_only: bool) -> tuple[str | None, s
 #: suffix in the parent's JSON.
 _CARD_TOKEN_FIELDS = {"flops": "flops", "bytes": "bytes_accessed", "collectives": "collectives"}
 
+#: Selection-provenance tokens (``_provenance_suffix``): plain strings.
+_STR_TOKENS = ("engine_source", "tuned_plan")
+
 
 def _split_tokens(val: str) -> tuple[str, dict]:
     """Strip the trailing `` key=value`` tokens a bench child appends
-    (``_card_suffix``); returns ``(rest, tokens)`` with ``peak_gb``/``flops``/
-    ``bytes`` parsed as floats and ``collectives`` as its dict. Malformed
-    tokens are dropped (best-effort — the rate is the payload)."""
+    (``_card_suffix`` / ``_provenance_suffix``); returns ``(rest, tokens)``
+    with ``peak_gb``/``flops``/``bytes`` parsed as floats, ``collectives`` as
+    its dict, and the provenance tokens as strings. Malformed tokens are
+    dropped (best-effort — the rate is the payload)."""
     kept, toks = [], {}
     for t in val.split():
         key, sep, raw = t.partition("=")
-        if not sep or key not in ("peak_gb", *_CARD_TOKEN_FIELDS):
+        if not sep or key not in ("peak_gb", *_CARD_TOKEN_FIELDS, *_STR_TOKENS):
             kept.append(t)
+            continue
+        if key in _STR_TOKENS:
+            toks[key] = raw
             continue
         try:
             toks[key] = json.loads(raw) if key == "collectives" else float(raw)
@@ -573,6 +601,11 @@ def main(argv: list[str] | None = None) -> None:
                 out["deep_peak_hbm_gb"] = dtoks.get("peak_gb")
                 _store_card_tokens(out, dtoks, prefix="deep_")
                 rate_str, _, engine = dval.partition(" ")
+                # selection provenance: where the engine decision came from
+                # (auto-tuner source vocabulary) and the plan it resolved to —
+                # check_bench_regression flags a baseline/fresh plan mismatch
+                out["engine_source"] = dtoks.get("engine_source", "policy")
+                out["tuned_plan"] = dtoks.get("tuned_plan", engine or None)
                 out["deep_value"] = round(float(rate_str), 1)
                 out["deep_metric"] = (
                     f"reach-timesteps/sec/chip, deep CONUS-shaped topology "

@@ -23,6 +23,7 @@ _COMMANDS = {
     "benchmark": "ddr_tpu.benchmarks.benchmark",
     "metrics": "ddr_tpu.observability.metrics_cli",
     "profile": "ddr_tpu.scripts.profile",
+    "tune": "ddr_tpu.scripts.tune",
     "audit": "ddr_tpu.scripts.audit",
     "gen-config-docs": "ddr_tpu.scripts.gen_config_docs",
     "sweep": "ddr_tpu.scripts.sweep",

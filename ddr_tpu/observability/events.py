@@ -84,7 +84,10 @@ __all__ = [
 #: marker (:mod:`ddr_tpu.scripts.audit`). ``reshard`` is one elastic-resume
 #: mesh transition: a checkpoint saved under one device layout restored onto
 #: another (``from_mesh``/``to_mesh`` descriptors,
-#: :func:`ddr_tpu.parallel.sharding.reshard_state`).
+#: :func:`ddr_tpu.parallel.sharding.reshard_state`). ``tune`` is one engine
+#: auto-tuner decision: the scored candidate table and the winner with its
+#: provenance (``source`` ∈ policy|scored|probed|cached,
+#: :mod:`ddr_tpu.tuning.planner`).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -106,6 +109,7 @@ EVENT_TYPES = (
     "drift",
     "audit",
     "reshard",
+    "tune",
 )
 
 

@@ -42,10 +42,13 @@ import numpy as np
 
 __all__ = [
     "ParallelRouteResult",
+    "TopologyStats",
     "resolve_engine_axes",
     "route_parallel",
+    "select_engine_tuned",
     "select_for_topology",
     "select_parallel_engine",
+    "topology_stats",
 ]
 
 
@@ -57,22 +60,124 @@ class ParallelRouteResult(NamedTuple):
     engine: str
 
 
-def select_for_topology(
-    platform: str, rows: np.ndarray, cols: np.ndarray, n: int, n_shards: int
-) -> str:
-    """Policy pick straight from a COO adjacency — derives depth/max-in-degree
-    only when the platform row actually consults them (CPU short-circuits to
-    gspmd without the O(E) layering). The one shared entry for the training CLI
-    (``parallel=auto``) and :func:`route_parallel`."""
-    if platform == "cpu":
-        return "gspmd"
+class TopologyStats(NamedTuple):
+    """The selection-relevant derived topology facts (O(E) to compute once)."""
+
+    n: int
+    e: int  # edge count
+    depth: int  # longest-path level count
+    max_in: int  # max in-degree
+
+
+# Derived-stat memo keyed by the caller's topology sha: chunked inference
+# calls route_parallel once per TIME chunk of the same reach set, and before
+# this memo each call re-ran the O(E) Kahn layering just to re-derive the
+# depth the policy already knew. Small and bounded (a process routes a
+# handful of topologies); evicts LRU.
+_TOPO_STATS: "OrderedDict[str, TopologyStats]" = None  # type: ignore[assignment]
+_TOPO_STATS_MAX = 64
+
+
+def topology_stats(
+    rows: np.ndarray, cols: np.ndarray, n: int, cache_key: str | None = None
+) -> TopologyStats:
+    """Depth / max-in-degree of a COO adjacency, memoized by ``cache_key``
+    (the topology sha) so repeated selections over the same reach set skip the
+    O(E) layering."""
+    global _TOPO_STATS
+    if _TOPO_STATS is None:
+        from collections import OrderedDict
+
+        _TOPO_STATS = OrderedDict()
+    if cache_key is not None:
+        hit = _TOPO_STATS.get(cache_key)
+        if hit is not None:
+            _TOPO_STATS.move_to_end(cache_key)
+            return hit
     from ddr_tpu.routing.network import compute_levels
 
     rows = np.asarray(rows)
     level = compute_levels(rows, np.asarray(cols), n)
     depth = int(level.max()) if n else 0
     max_in = int(np.bincount(rows, minlength=n).max()) if len(rows) else 1
-    return select_parallel_engine(platform, n, depth, n_shards, max(1, max_in))
+    stats = TopologyStats(int(n), int(len(rows)), depth, max(1, max_in))
+    if cache_key is not None:
+        _TOPO_STATS[cache_key] = stats
+        if len(_TOPO_STATS) > _TOPO_STATS_MAX:
+            _TOPO_STATS.popitem(last=False)
+    return stats
+
+
+def select_for_topology(
+    platform: str,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    n_shards: int,
+    cache_key: str | None = None,
+) -> str:
+    """Policy pick straight from a COO adjacency — derives depth/max-in-degree
+    only when the platform row actually consults them (CPU short-circuits to
+    gspmd without the O(E) layering; accelerators memoize the derived stats by
+    ``cache_key``, the topology sha). The one shared entry for the training CLI
+    (``parallel=auto``) and :func:`route_parallel`'s ``DDR_AUTOTUNE=off``
+    fallback."""
+    if platform == "cpu":
+        return "gspmd"
+    stats = topology_stats(rows, cols, n, cache_key=cache_key)
+    return select_parallel_engine(platform, n, stats.depth, n_shards, stats.max_in)
+
+
+def select_engine_tuned(
+    platform: str,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    n_shards: int,
+    *,
+    cache_key: str | None = None,
+    mesh_desc: dict[str, Any] | None = None,
+    dtype: str = "fp32",
+    kernel: str | None = None,
+    t_steps: int | None = None,
+    hbm_bytes: int | None = None,
+) -> tuple[str, str]:
+    """The auto paths' selection entry: ``(engine, source)`` via the
+    cost-model planner (:mod:`ddr_tpu.tuning.planner`), with the policy table
+    demoted to the planner's prior and its ``DDR_AUTOTUNE=off`` fallback
+    (byte-identical to the pre-planner behavior, including the cpu
+    short-circuit that never layers the adjacency).
+
+    ``cache_key`` is the topology sha (:func:`ddr_tpu.parallel.partition.topology_sha`)
+    — it keys both the derived-stat memo and the persistent tuning cache;
+    None derives a content sha from the adjacency arrays. ``mesh_desc`` is the
+    JSON-plain mesh descriptor (:func:`ddr_tpu.parallel.sharding.mesh_descriptor`).
+    """
+    from ddr_tpu.tuning.planner import autotune_mode, record_selection
+
+    if autotune_mode() == "off":
+        engine = select_for_topology(
+            platform, rows, cols, n, n_shards, cache_key=cache_key
+        )
+        record_selection(engine, "policy")
+        return engine, "policy"
+    if cache_key is None:
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(np.asarray(rows, dtype=np.int64)).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(cols, dtype=np.int64)).tobytes())
+        h.update(str(int(n)).encode())
+        cache_key = h.hexdigest()
+    stats = topology_stats(rows, cols, n, cache_key=cache_key)
+    from ddr_tpu.tuning.planner import tune_engine
+
+    res = tune_engine(
+        platform, rows, cols, n, stats.depth, stats.max_in, n_shards,
+        topo_sha=cache_key, mesh_desc=mesh_desc, dtype=dtype, kernel=kernel,
+        t_steps=t_steps, hbm_bytes=hbm_bytes,
+    )
+    return res.engine, res.source
 
 
 def select_parallel_engine(
@@ -139,6 +244,17 @@ def resolve_engine_axes(
 
 def _mesh_platform(mesh: Any) -> str:
     return mesh.devices.flat[0].platform
+
+
+def _device_hbm(mesh: Any) -> int | None:
+    """The mesh devices' per-device memory limit where the backend reports one
+    (TPU ``bytes_limit``); None on CPU — the planner skips the HBM prune."""
+    try:
+        stats = mesh.devices.flat[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        return None if limit is None else int(limit)
+    except Exception:
+        return None
 
 
 # Per-topology routing plans: chunked inference calls route_parallel once per
@@ -244,7 +360,16 @@ def route_parallel(
         for k, v in ((k2, jnp.asarray(v2)) for k2, v2 in spatial_params.items())
     }
     if engine is None:
-        engine = select_for_topology(_mesh_platform(mesh), rows, cols, n, n_shards)
+        from ddr_tpu.parallel.partition import topology_sha
+        from ddr_tpu.parallel.sharding import mesh_descriptor
+
+        engine, _source = select_engine_tuned(
+            _mesh_platform(mesh), rows, cols, n, n_shards,
+            cache_key=topology_sha(rd), mesh_desc=mesh_descriptor(mesh),
+            dtype=dtype, kernel=kernel,
+            t_steps=int(np.shape(q_prime)[0]) or None,
+            hbm_bytes=_device_hbm(mesh),
+        )
     if engine not in ("gspmd", "sharded-wavefront", "stacked-sharded"):
         raise ValueError(f"unknown parallel engine {engine!r}")
     kernel, dtype = resolve_engine_axes(engine, kernel, dtype)

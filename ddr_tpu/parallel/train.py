@@ -360,25 +360,30 @@ class ParallelTrainer:
         T = int(q_prime.shape[0])
         mode = self.mode
         if mode == "auto":
-            from ddr_tpu.parallel.select import select_for_topology
+            from ddr_tpu.parallel.partition import topology_sha
+            from ddr_tpu.parallel.select import _device_hbm, select_engine_tuned
 
-            # cpu short-circuits inside the helper (no O(E) layering); on
-            # accelerators the per-topology answer is memoized so recurring
-            # batches skip the re-analysis alongside their cached step
+            # The cost-model planner (ddr_tpu.tuning; DDR_AUTOTUNE=off falls
+            # back to the hand policy, cpu short-circuit included). Memoized
+            # per batch so recurring batches skip the re-analysis alongside
+            # their cached step; the planner additionally memoizes by
+            # topology sha and persists winners in the tuning cache.
             key = _batch_key(rd)
             mode = self._auto_modes.get(key)
             if mode is None:
-                mode = select_for_topology(
+                mode, source = select_engine_tuned(
                     self.platform, rd.adjacency_rows, rd.adjacency_cols,
                     rd.n_segments, self.n_shards,
+                    cache_key=topology_sha(rd), mesh_desc=self.mesh_desc,
+                    t_steps=T, hbm_bytes=_device_hbm(self.mesh),
                 )
                 self._auto_modes[key] = mode
-            if mode not in self._auto_logged:
-                self._auto_logged.add(mode)
-                log.info(
-                    f"parallel=auto selected {mode} "
-                    f"(platform={self.platform}, N={rd.n_segments})"
-                )
+                if mode not in self._auto_logged:
+                    self._auto_logged.add(mode)
+                    log.info(
+                        f"parallel=auto selected {mode} (source={source}, "
+                        f"platform={self.platform}, N={rd.n_segments})"
+                    )
         if mode == "stacked-sharded":
             # The stacked-sharded layout keeps ORIGINAL node order (it carries
             # its own band/shard permutations), so no partition/pad here.

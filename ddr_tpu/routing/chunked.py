@@ -119,23 +119,44 @@ _RING_COPY_BYTES_PER_S_DEFAULT = 2.1e11
 
 
 def wave_cost_constants() -> tuple[float, float]:
-    """``(fixed seconds per wave, ring-copy bytes/s)`` for the wave cost model
-    — the measured v5e defaults, overridable per deployment/chip generation:
+    """``(fixed seconds per wave, ring-copy bytes/s)`` for the wave cost model.
 
-    - ``DDR_WAVE_FIXED_US``: fixed per-wave dispatch+physics cost, MICROseconds
-      (default 35);
-    - ``DDR_WAVE_RING_GBPS``: effective scan-carry ring-copy bandwidth, GB/s
-      (default 210).
+    Precedence, most-explicit first:
+
+    1. ``DDR_WAVE_FIXED_US`` / ``DDR_WAVE_RING_GBPS`` env overrides (fixed
+       per-wave dispatch+physics cost in MICROseconds; effective scan-carry
+       ring-copy bandwidth in GB/s);
+    2. a persisted ``ddr tune --calibrate`` measurement for the current
+       platform (:func:`ddr_tpu.tuning.cache.load_calibration` — constants
+       *measured on this device*, stored in the tuning cache);
+    3. the measured v5e literals (fixed 35 us, 210 GB/s) — which predate the
+       PR 8 gap-sized ring, hence the calibrate path.
 
     Read at band-planning time (host-side builds, never inside jit), so a
-    chip-tuning session sets two env vars and re-runs instead of patching
-    source. Malformed values warn and fall back — a tuning knob must never
-    abort a build."""
+    chip-tuning session runs ``ddr tune --calibrate`` once (or sets two env
+    vars) instead of patching source. Malformed values warn and fall back — a
+    tuning knob must never abort a build."""
     import logging
     import os
+    import sys
 
     fixed = _WAVE_FIXED_S_DEFAULT
     bw = _RING_COPY_BYTES_PER_S_DEFAULT
+    try:
+        from ddr_tpu.tuning.cache import load_calibration
+
+        jax = sys.modules.get("jax")
+        platform = jax.default_backend() if jax is not None else "cpu"
+        cal = load_calibration(platform)
+        if cal:
+            if "wave_fixed_s" in cal:
+                fixed = float(cal["wave_fixed_s"])
+            # an inherited bandwidth is the default re-recorded, not a
+            # measurement — keep whatever the fallback/env chain resolves
+            if "ring_bytes_per_s" in cal and not cal.get("ring_bw_inherited"):
+                bw = float(cal["ring_bytes_per_s"])
+    except Exception as e:  # calibration must never abort a build
+        logging.getLogger(__name__).warning(f"ignoring unreadable calibration: {e}")
     raw = os.environ.get("DDR_WAVE_FIXED_US")
     if raw:
         try:

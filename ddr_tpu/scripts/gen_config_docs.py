@@ -48,8 +48,17 @@ variables. Families with their own reference tables are linked.
   `DDR_PROFILE_DIR` (jax.profiler trace capture dir) — cost attribution and
   profiling: see docs/observability.md.
 - `DDR_WAVE_FIXED_US`, `DDR_WAVE_RING_GBPS` — wave-cost-model constants for
-  band planning (chip re-calibration knobs): see docs/tpu.md "The gap-sized
-  ring".
+  band planning (chip re-calibration knobs; override any stored `ddr tune
+  --calibrate` measurement): see docs/tpu.md "The gap-sized ring".
+- `DDR_AUTOTUNE` — engine auto-tuner mode for `engine=None` /
+  `parallel="auto"` / serving-warmup selection: `score` (default; cost-model
+  scoring over AOT-compiled program cards), `probe` (score, then time the
+  top candidates), `off` (the hand policy table, byte-identical to pre-tuner
+  behavior): see docs/tpu.md "The engine auto-tuner".
+- `DDR_TUNE_CACHE_DIR` — persistent tuning-cache directory for plan and
+  calibration records (default: `$DDR_COMPILE_CACHE_DIR/tuning` when the
+  compile cache is pinned, else no persistence): see docs/tpu.md "The engine
+  auto-tuner".
 - `DDR_SERVE_*` — serving: see docs/serving.md.
 - `DDR_BENCH_*` — `bench.py`: see `python bench.py --help`.
 - `DDR_CKPT_*` (format/async/retention), `DDR_IO_RETRIES`,

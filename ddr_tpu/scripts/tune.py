@@ -1,0 +1,182 @@
+"""``ddr tune`` — pre-tune engine selection and calibrate the cost model.
+
+Runs the cost-model planner (:mod:`ddr_tpu.tuning.planner`) on a topology —
+a config's routing domain or a synthetic basin — OUTSIDE the training/serving
+hot path, so the winner lands in the persistent tuning cache before the fleet
+asks: a pre-tuned replica's first ``route_parallel(engine=None)`` is a cache
+hit with zero card builds. Prints the scored candidate table as markdown plus
+one machine-readable JSON line, and emits a ``tune`` event when telemetry is
+configured (``DDR_METRICS_DIR``).
+
+``--calibrate`` measures the wave-cost constants on the CURRENT device and
+stores them in the tuning cache, where both the planner and
+:func:`ddr_tpu.routing.chunked.wave_cost_constants` prefer them over the
+stale v5e literals (docs/tpu.md "The gap-sized ring" re-measure note).
+
+Usage::
+
+    ddr tune --synthetic --n 65536 --depth 200 --t-hours 240
+    ddr tune config.yaml experiment.rho=10        # the config's domain
+    ddr tune --calibrate                          # measure, store, report
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def _synthetic_rd(n: int, depth: int | None):
+    from ddr_tpu.geodatazoo.synthetic import make_basin
+
+    basin = make_basin(
+        n_segments=n, n_gauges=min(64, max(2, n // 32)), n_days=1, seed=0, depth=depth
+    )
+    return basin.routing_data
+
+
+def _config_rd(config_argv: list[str]):
+    from ddr_tpu.scripts.common import parse_cli
+
+    cfg = parse_cli(config_argv, mode="routing")
+    dataset = cfg.geodataset.get_dataset_class(cfg)
+    return dataset.routing_data
+
+
+def _markdown_table(rows: list[dict[str, Any]], columns: list[str]) -> str:
+    head = "| " + " | ".join(columns) + " |"
+    sep = "|" + "|".join("---" for _ in columns) + "|"
+    body = [
+        "| " + " | ".join(str(r.get(c, "")) for c in columns) + " |" for r in rows
+    ]
+    return "\n".join([head, sep, *body])
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    logging.basicConfig(level=logging.INFO, format="%(name)s %(levelname)s %(message)s")
+    parser = argparse.ArgumentParser(
+        prog="ddr tune",
+        description="Pre-tune engine selection / calibrate the wave cost model.",
+    )
+    parser.add_argument("config", nargs="*", default=[],
+                        help="optional config.yaml [+ overrides] naming the routing domain")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="tune a synthetic basin instead of a config domain")
+    parser.add_argument("--n", type=int, default=4096, help="synthetic reach count")
+    parser.add_argument("--depth", type=int, default=None,
+                        help="synthetic longest-path depth (default: generator's)")
+    parser.add_argument("--t-hours", type=int, default=240,
+                        help="time-window length the structural terms scale with")
+    parser.add_argument("--n-shards", type=int, default=None,
+                        help="mesh size to tune for (default: jax.device_count())")
+    parser.add_argument("--dtype", choices=("fp32", "bf16"), default="fp32")
+    parser.add_argument("--kernel", choices=("pallas", "xla"), default=None)
+    parser.add_argument("--calibrate", action="store_true",
+                        help="measure + store the wave-cost constants on this device")
+    parser.add_argument("--out", default=None, help="also write the JSON report here")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from ddr_tpu.observability.events import run_telemetry
+    from ddr_tpu.tuning.cache import tuning_cache_dir
+    from ddr_tpu.tuning.planner import (
+        autotune_mode,
+        calibrate_device,
+        calibration,
+        tune_single_device,
+    )
+
+    report: dict[str, Any] = {
+        "kind": "tune",
+        "mode": autotune_mode(),
+        "platform": jax.default_backend(),
+        "cache_dir": str(tuning_cache_dir() or ""),
+    }
+
+    with run_telemetry(None, cmd="tune"):
+        if args.calibrate:
+            rec = calibrate_device(store=True)
+            report["calibration"] = rec
+            print("## Wave-cost calibration\n")
+            print(_markdown_table(
+                [{"constant": k, "value": v} for k, v in sorted(rec.items())],
+                ["constant", "value"],
+            ))
+            print()
+
+        if args.config and not args.synthetic:
+            rd = _config_rd(args.config)
+        else:
+            rd = _synthetic_rd(args.n, args.depth)
+
+        from ddr_tpu.parallel.partition import topology_sha
+        from ddr_tpu.parallel.select import select_engine_tuned, topology_stats
+        from ddr_tpu.parallel.sharding import mesh_descriptor
+
+        rows = np.asarray(rd.adjacency_rows)
+        cols = np.asarray(rd.adjacency_cols)
+        n = rd.n_segments
+        n_shards = args.n_shards or jax.device_count()
+        sha = topology_sha(rd)
+        platform = jax.default_backend()
+        stats = topology_stats(rows, cols, n, cache_key=sha)
+        engine, source = select_engine_tuned(
+            platform, rows, cols, n, n_shards,
+            cache_key=sha, mesh_desc=mesh_descriptor(),
+            dtype=args.dtype, kernel=args.kernel, t_steps=args.t_hours,
+        )
+        from ddr_tpu.tuning.planner import last_selection, _TUNE_MEMO  # noqa: F401
+
+        # the planner's full candidate table for the report (memoized — free)
+        cands = []
+        for res in _TUNE_MEMO.values():
+            if res.engine == engine and res.candidates:
+                cands = [c.brief() for c in res.candidates]
+                break
+        report.update(
+            topology=sha[:12], n=int(n), depth=int(stats.depth),
+            max_in=int(stats.max_in), n_shards=int(n_shards),
+            t_hours=int(args.t_hours), dtype=args.dtype,
+            kernel=args.kernel or "auto", engine=engine, source=source,
+            candidates=cands, calibration_constants=calibration(platform),
+        )
+
+        print(f"## Tuned mesh engine — {engine} (source={source})\n")
+        print(f"topology {sha[:12]}: n={n}, depth={stats.depth}, "
+              f"max_in={stats.max_in}, n_shards={n_shards}, "
+              f"platform={platform}, dtype={args.dtype}\n")
+        if cands:
+            print(_markdown_table(
+                cands, ["engine", "feasible", "est_ms", "waves", "reason"]))
+            print()
+
+        single = tune_single_device(
+            n, stats.depth, stats.max_in, t_steps=args.t_hours, platform=platform
+        )
+        report["single_device"] = [c.brief() for c in single]
+        print("## Single-device schedule space (wave cost model)\n")
+        print(_markdown_table(
+            [c.brief() for c in single], ["engine", "feasible", "est_ms", "waves", "reason"]
+        ))
+        print()
+
+    blob = json.dumps(report, sort_keys=True)
+    print(blob)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(blob + "\n")
+        log.info(f"wrote tune report to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -246,13 +246,36 @@ class ForecastService:
             rd, slope_min=self.cfg.params.attribute_minimums["slope"]
         )
         platform = jax.devices()[0].platform
-        mesh_policy = select_for_topology(
-            platform,
-            np.asarray(rd.adjacency_rows),
-            np.asarray(rd.adjacency_cols),
-            rd.n_segments,
-            n_shards=jax.device_count(),
-        )
+        if self._mesh is not None:
+            # Mesh mode dispatches this decision (route_parallel consults the
+            # same planner, so warmup and steady-state agree): the cost-model
+            # auto-tuner scores the engines, with the hand policy as its prior
+            # and the DDR_AUTOTUNE=off fallback.
+            from ddr_tpu.parallel.select import _device_hbm, select_engine_tuned
+            from ddr_tpu.parallel.sharding import mesh_descriptor
+
+            mesh_policy, _source = select_engine_tuned(
+                platform,
+                np.asarray(rd.adjacency_rows),
+                np.asarray(rd.adjacency_cols),
+                rd.n_segments,
+                jax.device_count(),
+                cache_key=topology_sha(rd),
+                mesh_desc=mesh_descriptor(self._mesh),
+                t_steps=int(horizon),
+                hbm_bytes=_device_hbm(self._mesh),
+            )
+        else:
+            # single-host: informational only — the memoized stats still make
+            # repeat registrations of the same topology O(1)
+            mesh_policy = select_for_topology(
+                platform,
+                np.asarray(rd.adjacency_rows),
+                np.asarray(rd.adjacency_cols),
+                rd.n_segments,
+                n_shards=jax.device_count(),
+                cache_key=topology_sha(rd),
+            )
         entry = NetworkEntry(
             name=name,
             rd=rd,

@@ -1,0 +1,55 @@
+"""Cost-model-driven engine auto-tuning (ROADMAP item 5).
+
+``ddr_tpu.tuning`` replaces the last hand-tuned hot-path decision — the
+multi-chip engine policy table in :mod:`ddr_tpu.parallel.select` and the fixed
+wave-cost literals arbitrating the single-chip engines — with one planner that
+*measures* instead of transcribing: candidates are enumerated, pruned with the
+existing eligibility predicates, scored analytically from AOT-compiled
+:class:`~ddr_tpu.observability.costs.ProgramCard` profiles under per-platform
+calibration constants, and the winner is persisted in a JSON tuning cache so
+replicas and resumed runs warm instantly.
+
+Layering contract: :mod:`ddr_tpu.tuning.cache` is importable WITHOUT jax (it
+is consulted by ``bench.py``-adjacent tooling and by
+:func:`ddr_tpu.routing.chunked.wave_cost_constants` at host planning time);
+:mod:`ddr_tpu.tuning.planner` imports jax lazily inside the card-building
+path only.
+"""
+
+from ddr_tpu.tuning.cache import (
+    PLANNER_VERSION,
+    load_calibration,
+    load_plan,
+    plan_key,
+    store_calibration,
+    store_plan,
+    tuning_cache_dir,
+)
+from ddr_tpu.tuning.planner import (
+    Candidate,
+    TuneResult,
+    autotune_mode,
+    card_build_count,
+    last_selection,
+    reset_tune_memo,
+    score_candidates,
+    tune_engine,
+)
+
+__all__ = [
+    "PLANNER_VERSION",
+    "Candidate",
+    "TuneResult",
+    "autotune_mode",
+    "card_build_count",
+    "last_selection",
+    "load_calibration",
+    "load_plan",
+    "plan_key",
+    "reset_tune_memo",
+    "score_candidates",
+    "store_calibration",
+    "store_plan",
+    "tune_engine",
+    "tuning_cache_dir",
+]

@@ -1,0 +1,189 @@
+"""Persistent JSON tuning cache — winners and calibration, shared by replicas.
+
+One file per decision under the tuning cache directory, which resolves
+``DDR_TUNE_CACHE_DIR`` first and then ``$DDR_COMPILE_CACHE_DIR/tuning`` (the
+planner rides the same persistent volume that already holds the XLA executable
+cache, so a fleet that warms one warms both). No directory configured = no
+persistence; the planner still works from its in-process memo.
+
+Entries are keyed by :func:`plan_key` — a sha over (topology sha, mesh
+descriptor, dtype, kernel, planner version). The mesh contributes its
+JSON-plain *descriptor* (axes / shape / platform / device count — what
+:func:`ddr_tpu.parallel.sharding.mesh_descriptor` records into checkpoints),
+deliberately NOT ``id(mesh)`` or the device-id fingerprint: a tuned winner is
+valid for any mesh of the same shape on the same platform, which is exactly
+what lets a restarted replica or a resumed run hit the cache. The planner
+version participates so a scoring-model change invalidates every stale entry
+at once instead of serving decisions scored under the old model.
+
+Writes are atomic (tmp + ``os.replace``) and best-effort; reads tolerate
+corrupt or foreign files — a tuning cache must never abort a run. This module
+is importable WITHOUT jax (package contract; ``wave_cost_constants`` consults
+it from host-side band planning and unit tests run it standalone).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import time
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "PLANNER_VERSION",
+    "load_calibration",
+    "load_plan",
+    "plan_key",
+    "store_calibration",
+    "store_plan",
+    "tuning_cache_dir",
+]
+
+#: Bump when the scoring model / candidate space changes shape: cached winners
+#: scored under an older model stop matching and are re-tuned.
+PLANNER_VERSION = 1
+
+
+def tuning_cache_dir() -> Path | None:
+    """The tuning cache directory, or None when no cache is configured.
+
+    ``DDR_TUNE_CACHE_DIR`` wins; otherwise ``$DDR_COMPILE_CACHE_DIR/tuning``
+    (decisions live next to the XLA executables they describe). The directory
+    is created lazily by the first store, not here — resolving the path must
+    stay side-effect free for read-only callers."""
+    raw = os.environ.get("DDR_TUNE_CACHE_DIR")
+    if raw:
+        return Path(raw)
+    base = os.environ.get("DDR_COMPILE_CACHE_DIR")
+    if base:
+        return Path(base) / "tuning"
+    return None
+
+
+def _mesh_key_fields(mesh_desc: dict[str, Any] | None) -> dict[str, Any]:
+    """The identity-stable slice of a mesh descriptor: axes/shape/platform/
+    device count. The ``topology`` device-id hash and process count are
+    excluded on purpose — they vary across equivalent fleets."""
+    if not mesh_desc:
+        return {}
+    return {
+        "axes": list(mesh_desc.get("axes", [])),
+        "shape": [int(s) for s in mesh_desc.get("shape", [])],
+        "platform": str(mesh_desc.get("platform", "")),
+        "n_devices": int(mesh_desc.get("n_devices", 0)),
+    }
+
+
+def plan_key(
+    topo_sha: str,
+    mesh_desc: dict[str, Any] | None,
+    dtype: str,
+    kernel: str | None,
+    version: int = PLANNER_VERSION,
+) -> str:
+    """Stable cache key for one tuning decision (sha1 of the canonical JSON)."""
+    payload = {
+        "topology": str(topo_sha),
+        "mesh": _mesh_key_fields(mesh_desc),
+        "dtype": str(dtype),
+        "kernel": kernel or "auto",
+        "version": int(version),
+    }
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()
+
+
+def _read_json(path: Path) -> dict[str, Any] | None:
+    try:
+        with path.open("r", encoding="utf-8") as fh:
+            obj = json.load(fh)
+        return obj if isinstance(obj, dict) else None
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        log.warning(f"ignoring unreadable tuning cache entry {path}: {e}")
+        return None
+
+
+def _write_json(path: Path, record: dict[str, Any]) -> Path | None:
+    """Atomic best-effort write: tmp file in the target dir + ``os.replace``
+    (same-filesystem rename; concurrent replicas last-writer-wins on identical
+    content). Any failure logs and returns None — never raises."""
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(record, fh, sort_keys=True, indent=1)
+                fh.write("\n")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+    except OSError as e:
+        log.warning(f"could not persist tuning cache entry {path}: {e}")
+        return None
+
+
+def load_plan(key: str) -> dict[str, Any] | None:
+    """The cached decision record for ``key``, or None (no cache dir, no entry,
+    unreadable entry, or a record from a different planner version)."""
+    base = tuning_cache_dir()
+    if base is None:
+        return None
+    rec = _read_json(base / f"plan_{key}.json")
+    if rec is None:
+        return None
+    if int(rec.get("planner_version", -1)) != PLANNER_VERSION:
+        return None
+    if not isinstance(rec.get("engine"), str):
+        return None
+    return rec
+
+
+def store_plan(key: str, record: dict[str, Any]) -> Path | None:
+    """Persist one decision record (stamped with version + wall time).
+    Returns the path written, or None when no cache dir is configured or the
+    write failed (both non-fatal)."""
+    base = tuning_cache_dir()
+    if base is None:
+        return None
+    rec = dict(record)
+    rec.setdefault("planner_version", PLANNER_VERSION)
+    rec.setdefault("wall", round(time.time(), 3))
+    return _write_json(base / f"plan_{key}.json", rec)
+
+
+def load_calibration(platform: str) -> dict[str, Any] | None:
+    """The stored calibration record for ``platform`` (``ddr tune
+    --calibrate``), or None. Version-checked like plan entries."""
+    base = tuning_cache_dir()
+    if base is None:
+        return None
+    rec = _read_json(base / f"calibration_{platform}.json")
+    if rec is None:
+        return None
+    if int(rec.get("planner_version", -1)) != PLANNER_VERSION:
+        return None
+    return rec
+
+
+def store_calibration(platform: str, record: dict[str, Any]) -> Path | None:
+    """Persist measured calibration constants for ``platform``."""
+    base = tuning_cache_dir()
+    if base is None:
+        return None
+    rec = dict(record)
+    rec.setdefault("planner_version", PLANNER_VERSION)
+    rec.setdefault("wall", round(time.time(), 3))
+    return _write_json(base / f"calibration_{platform}.json", rec)

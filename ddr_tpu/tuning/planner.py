@@ -1,0 +1,773 @@
+"""Compile-and-score plan selection: the engine policy table, earned.
+
+The planner answers one question — *which routing engine should this
+(topology, mesh, dtype, kernel) query run?* — the way ROADMAP item 5 asks for:
+
+1. **Enumerate** the candidate space: ``gspmd`` / ``sharded-wavefront`` /
+   ``stacked-sharded`` for mesh queries (:func:`tune_engine`), and the
+   single-device step / wavefront / stacked-by-band-count schedules for the
+   ``ddr tune`` report (:func:`tune_single_device`).
+2. **Prune** with the EXISTING eligibility predicates — the per-shard
+   :func:`~ddr_tpu.routing.network.single_ring_eligible` ring bound, the
+   engine kernel/dtype axes (:func:`~ddr_tpu.parallel.select.resolve_engine_axes`
+   contract: explicit pallas/bf16 only route via gspmd), and the estimated
+   per-shard peak memory against the device HBM limit when known.
+3. **Score** survivors analytically from one AOT-compiled
+   :class:`~ddr_tpu.observability.costs.ProgramCard` of the topology's routing
+   physics (:func:`~ddr_tpu.observability.costs.build_card` on a single-device
+   step-engine analog — AOT, so scoring never populates the jit dispatch
+   cache): a roofline term ``max(flops/peak_flops, bytes/mem_bw)/n_shards``
+   plus each engine's structural term under per-platform calibration
+   constants — ``gspmd`` pays ``T*depth`` sequential level steps (each with a
+   GSPMD-inserted cross-shard resolve), ``sharded-wavefront`` pays ``T+depth``
+   shard_map waves (one psum each), ``stacked-sharded`` pays ``C*T+depth``
+   waves with ``C = ceil(depth/1024)`` bands.
+4. **Tie-break** (``DDR_AUTOTUNE=probe``) by timing the top candidates'
+   single-device analog programs once; and in every mode the hand policy
+   (:func:`~ddr_tpu.parallel.select.select_parallel_engine`) survives as the
+   planner's PRIOR — a challenger must beat it by :data:`PRIOR_MARGIN` or the
+   prior is retained, so near-ties never flap across replicas.
+5. **Persist** the winner (:mod:`ddr_tpu.tuning.cache`) so the second process
+   — a restarted trainer, a serving replica — selects card-build-free.
+
+``DDR_AUTOTUNE=off`` bypasses all of it: the caller gets exactly the
+hand-written policy table, byte-identical to the pre-planner behavior.
+
+Every decision emits one ``tune`` event (candidates, scores, winner,
+``source`` ∈ ``policy|scored|probed|cached``) through the active Recorder.
+
+All of this runs HOST-SIDE at plan/build time — env reads, cache IO, and
+wall-clock probes never appear inside a traced computation (``ddr lint``
+DDR101–103 hold).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ddr_tpu.tuning import cache as _cache
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "Candidate",
+    "ENGINES",
+    "PRIOR_MARGIN",
+    "TuneResult",
+    "autotune_mode",
+    "calibrate_device",
+    "calibration",
+    "card_build_count",
+    "last_selection",
+    "record_selection",
+    "reset_tune_memo",
+    "score_candidates",
+    "tune_engine",
+    "tune_single_device",
+]
+
+#: The mesh-query candidate space (mirrors route_parallel's engine contract).
+ENGINES = ("gspmd", "sharded-wavefront", "stacked-sharded")
+
+#: A scored challenger must beat the policy prior's estimate by this fraction
+#: or the prior is retained — near-model-ties must not flap the fleet between
+#: engines on calibration noise.
+PRIOR_MARGIN = 0.02
+
+#: Physics cards are built at ``min(T, _T_CARD_MAX)`` timesteps and their
+#: flops/bytes linearly rescaled to the query's T — compile cost is bounded by
+#: the topology, not the window, and the roofline term stays honest.
+_T_CARD_MAX = 24
+
+# Per-platform calibration defaults for the structural cost terms. The cpu
+# row encodes the MULTICHIP_r04 inversion: a shard_map wave on host devices
+# pays ~20 ms of dispatch + psum emulation (5060 ms / ~250 waves in the scale
+# row) while a gspmd inner level step stays ~50 us inside one compiled scan —
+# which is exactly why gspmd won every recorded host-mesh row. The tpu row
+# reuses the measured v5e 35 us wave cost (docs/tpu.md "Continental depth")
+# for both terms: a gspmd level step on an accelerator mesh carries a
+# GSPMD-inserted cross-shard resolve of the same order as a wave's psum.
+# ``ddr tune --calibrate`` overrides these per device via the tuning cache.
+_CALIBRATION_DEFAULTS: dict[str, dict[str, float]] = {
+    "cpu": {"step_s": 5e-5, "wave_s": 2e-2, "flops_per_s": 5e10, "bytes_per_s": 2e10},
+    "tpu": {"step_s": 3.5e-5, "wave_s": 3.5e-5, "flops_per_s": 2e14, "bytes_per_s": 8e11},
+    "gpu": {"step_s": 3.5e-5, "wave_s": 3.5e-5, "flops_per_s": 1e14, "bytes_per_s": 1e12},
+}
+
+#: Refuse candidates whose estimated per-shard peak exceeds this fraction of
+#: the device HBM limit (when the backend reports one).
+_HBM_FRACTION = 0.92
+
+
+@dataclass
+class Candidate:
+    """One enumerated plan with its feasibility verdict and cost estimate."""
+
+    engine: str
+    feasible: bool
+    est_s: float | None = None
+    reason: str = ""  # why pruned (empty when feasible)
+    waves: int = 0  # sequential dependent dispatches (structural term)
+    collectives: int = 0  # estimated collective EXECUTIONS (not HLO ops)
+    probed_s: float | None = None  # measured seconds (probe mode only)
+
+    def brief(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"engine": self.engine, "feasible": self.feasible}
+        if self.est_s is not None:
+            out["est_ms"] = round(self.est_s * 1e3, 3)
+        if self.probed_s is not None:
+            out["probed_ms"] = round(self.probed_s * 1e3, 3)
+        if self.reason:
+            out["reason"] = self.reason
+        if self.waves:
+            out["waves"] = int(self.waves)
+        if self.collectives:
+            out["collectives"] = int(self.collectives)
+        return out
+
+
+@dataclass
+class TuneResult:
+    """One planner decision: the winning engine and how it was reached."""
+
+    engine: str
+    source: str  # policy | scored | probed | cached
+    key: str = ""
+    candidates: list[Candidate] = field(default_factory=list)
+
+    def brief(self) -> dict[str, Any]:
+        return {
+            "engine": self.engine,
+            "source": self.source,
+            "key": self.key[:12],
+            "candidates": [c.brief() for c in self.candidates],
+        }
+
+
+def autotune_mode() -> str:
+    """``DDR_AUTOTUNE`` ∈ ``off`` (hand policy, pre-planner behavior) /
+    ``score`` (default: analytic card scoring) / ``probe`` (scoring plus one
+    short timed tie-break). Malformed values warn and fall back to ``score``
+    — a tuning knob must never abort a run. Read host-side at selection time
+    only (never inside a traced body)."""
+    raw = os.environ.get("DDR_AUTOTUNE", "score").strip().lower()
+    if raw in ("off", "score", "probe"):
+        return raw
+    log.warning(f"ignoring malformed DDR_AUTOTUNE={raw!r} (want off|score|probe)")
+    return "score"
+
+
+def calibration(platform: str) -> dict[str, float]:
+    """The scoring constants for ``platform``: the defaults above, overridden
+    by any persisted ``ddr tune --calibrate`` record for this platform."""
+    cal = dict(_CALIBRATION_DEFAULTS.get(platform, _CALIBRATION_DEFAULTS["tpu"]))
+    rec = _cache.load_calibration(platform)
+    if rec:
+        if "wave_fixed_s" in rec:  # shared with wave_cost_constants()
+            try:
+                cal["wave_s"] = float(rec["wave_fixed_s"])
+            except (TypeError, ValueError):
+                pass
+        for k in ("step_s", "wave_s", "flops_per_s", "bytes_per_s"):
+            if k in rec:
+                try:
+                    cal[k] = float(rec[k])
+                except (TypeError, ValueError):
+                    pass
+    return cal
+
+
+# ---------------------------------------------------------------------------
+# Scoring (pure — unit-testable with synthetic ProgramCards)
+# ---------------------------------------------------------------------------
+
+
+def _axes_feasible(dtype: str, kernel: str | None) -> tuple[bool, str]:
+    """The resolve_engine_axes contract as a predicate: the shard_map engines
+    run fp32 XLA per-shard schedules only."""
+    if kernel == "pallas":
+        return False, "kernel='pallas' routes via gspmd only"
+    if dtype != "fp32":
+        return False, f"dtype={dtype!r} routes via gspmd only"
+    return True, ""
+
+
+def score_candidates(
+    *,
+    platform: str,
+    n: int,
+    depth: int,
+    max_in: int,
+    n_shards: int,
+    t_steps: int,
+    card: Any = None,
+    card_t: int | None = None,
+    cal: dict[str, float] | None = None,
+    dtype: str = "fp32",
+    kernel: str | None = None,
+    hbm_bytes: int | None = None,
+) -> list[Candidate]:
+    """Score the mesh-engine candidate space analytically (no jax needed).
+
+    ``card`` is any object with ``flops`` / ``bytes_accessed`` / ``peak_bytes``
+    attributes (a :class:`~ddr_tpu.observability.costs.ProgramCard`, or a
+    synthetic stand-in in tests) profiling the topology's routing physics at
+    ``card_t`` timesteps; None scores on the structural terms alone. Returns
+    feasible candidates sorted by estimate, then pruned ones.
+    """
+    from ddr_tpu.routing.network import WAVEFRONT_MAX_DEPTH, single_ring_eligible
+
+    cal = cal or calibration(platform)
+    t = max(1, int(t_steps))
+    d = max(1, int(depth))
+    shards = max(1, int(n_shards))
+    n_local = -(-max(1, int(n)) // shards)
+
+    flops = float(getattr(card, "flops", 0.0) or 0.0)
+    bytes_acc = float(getattr(card, "bytes_accessed", 0.0) or 0.0)
+    peak = float(getattr(card, "peak_bytes", 0.0) or 0.0)
+    scale = (t / max(1, int(card_t))) if card_t else 1.0
+    t_comp = (
+        max(flops / cal["flops_per_s"], bytes_acc / cal["bytes_per_s"]) * scale / shards
+    )
+    hbm_ok = hbm_bytes is None or peak <= 0 or peak / shards <= _HBM_FRACTION * hbm_bytes
+    hbm_reason = (
+        ""
+        if hbm_ok
+        else (
+            f"est per-shard peak {peak / shards / 2**30:.2f} GiB exceeds "
+            f"{_HBM_FRACTION:.0%} of HBM ({hbm_bytes / 2**30:.2f} GiB)"
+        )
+    )
+    axes_ok, axes_reason = _axes_feasible(dtype, kernel)
+
+    out: list[Candidate] = []
+
+    # gspmd: the rectangle step engine on the sharded network — T*depth
+    # sequential level steps, each carrying a GSPMD-inserted cross-shard
+    # resolve. Always eligible (it IS the fallback the axes contract names),
+    # modulo the memory envelope.
+    waves = t * d
+    out.append(
+        Candidate(
+            engine="gspmd",
+            feasible=hbm_ok,
+            reason=hbm_reason,
+            est_s=t_comp + waves * cal["step_s"],
+            waves=waves,
+            collectives=waves,
+        )
+    )
+
+    # sharded-wavefront: T+depth shard_map waves, one psum each; the PER-SHARD
+    # ring must be eligible (the policy's own predicate).
+    ring_ok = single_ring_eligible(d, max(1, int(max_in)), n_local)
+    waves = t + d
+    reason = ""
+    if not axes_ok:
+        reason = axes_reason
+    elif not ring_ok:
+        reason = (
+            f"per-shard ring infeasible (depth={d}, max_in={max_in}, "
+            f"n/shard={n_local})"
+        )
+    elif not hbm_ok:
+        reason = hbm_reason
+    out.append(
+        Candidate(
+            engine="sharded-wavefront",
+            feasible=axes_ok and ring_ok and hbm_ok,
+            reason=reason,
+            est_s=t_comp + waves * cal["wave_s"],
+            waves=waves,
+            collectives=waves,
+        )
+    )
+
+    # stacked-sharded: bands bound the per-shard ring; ONE scanned band
+    # program pays C*T+depth waves. Memory-exempt by construction (the band
+    # budget is what bounds the ring).
+    bands = max(1, math.ceil(d / WAVEFRONT_MAX_DEPTH))
+    waves = bands * t + d
+    out.append(
+        Candidate(
+            engine="stacked-sharded",
+            feasible=axes_ok,
+            reason="" if axes_ok else axes_reason,
+            est_s=t_comp + waves * cal["wave_s"],
+            waves=waves,
+            collectives=waves,
+        )
+    )
+
+    out.sort(key=lambda c: (not c.feasible, c.est_s if c.est_s is not None else 1e30))
+    return out
+
+
+def _pick(candidates: list[Candidate], prior: str) -> tuple[Candidate | None, bool]:
+    """The winner under the prior-margin rule. Returns ``(winner, is_prior)``;
+    ``(None, _)`` when nothing is feasible (caller falls back to the policy)."""
+    feasible = [c for c in candidates if c.feasible]
+    if not feasible:
+        return None, False
+    best = min(feasible, key=lambda c: c.est_s)
+    prior_c = next((c for c in feasible if c.engine == prior), None)
+    if (
+        prior_c is not None
+        and best.engine != prior
+        and best.est_s > (1.0 - PRIOR_MARGIN) * prior_c.est_s
+    ):
+        return prior_c, True
+    return best, best.engine == prior
+
+
+# ---------------------------------------------------------------------------
+# Physics cards (AOT — never touches the jit dispatch cache) and probes
+# ---------------------------------------------------------------------------
+
+_CARD_MEMO: dict[tuple, Any] = {}
+_CARD_BUILDS = 0
+
+
+def card_build_count() -> int:
+    """Monotonic count of physics cards this process has AOT-compiled —
+    ``scripts/check_autotune.py`` asserts a warm tuning cache keeps this flat
+    across planner invocations."""
+    return _CARD_BUILDS
+
+
+def _analog_inputs(n: int, t: int, concrete: bool):
+    """The single-device analog program's inputs: ShapeDtypeStructs for AOT
+    card builds, benign concrete arrays for timed probes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddr_tpu.routing.mc import ChannelState
+
+    if concrete:
+        vec = jnp.ones((n,), jnp.float32)
+        half = jnp.full((n,), 0.5, jnp.float32)
+        ch = ChannelState(length=vec, slope=vec * 1e-3, x_storage=half * 0.2)
+        sp = {"n": half * 0.06, "q_spatial": half, "p_spatial": half}
+        qp = jnp.ones((t, n), jnp.float32)
+    else:
+        vec = jax.ShapeDtypeStruct((n,), jnp.float32)
+        ch = ChannelState(length=vec, slope=vec, x_storage=vec)
+        sp = {"n": vec, "q_spatial": vec, "p_spatial": vec}
+        qp = jax.ShapeDtypeStruct((t, n), jnp.float32)
+    return ch, sp, qp
+
+
+def _physics_card(
+    rows: np.ndarray, cols: np.ndarray, n: int, t_card: int, dtype: str, topo_sha: str
+):
+    """AOT-compile the topology's step-engine routing analog on one device and
+    return its ProgramCard (memoized per topology/window/dtype)."""
+    key = (topo_sha, int(t_card), dtype)
+    hit = _CARD_MEMO.get(key)
+    if hit is not None:
+        return hit
+    import jax
+
+    from ddr_tpu.observability.costs import build_card
+    from ddr_tpu.routing.mc import Bounds, route
+    from ddr_tpu.routing.network import build_network
+
+    network = build_network(np.asarray(rows), np.asarray(cols), int(n), fused=False)
+    ch, sp, qp = _analog_inputs(int(n), int(t_card), concrete=False)
+
+    _analog = jax.jit(
+        lambda ch, sp, qp: route(network, ch, sp, qp, bounds=Bounds(), dtype=dtype).runoff
+    )
+
+    card, _ = build_card(
+        _analog, ch, sp, qp, name="tune/route-analog", engine="step",
+        compute_dtype=dtype,
+    )
+    global _CARD_BUILDS
+    _CARD_BUILDS += 1
+    _CARD_MEMO[key] = card
+    return card
+
+
+def _probe_seconds(
+    engine: str, rows: np.ndarray, cols: np.ndarray, n: int, depth: int,
+    max_in: int, t_steps: int, dtype: str,
+) -> float | None:
+    """One short timed run of ``engine``'s single-device analog program (warm
+    call excluded). None when the engine has no cheap analog (stacked) or the
+    analog cannot build — the caller keeps the scored estimate."""
+    import time
+
+    import jax
+
+    from ddr_tpu.routing.mc import Bounds, route
+    from ddr_tpu.routing.network import build_network, single_ring_eligible
+
+    if engine == "gspmd":
+        wavefront = False
+    elif engine == "sharded-wavefront" and single_ring_eligible(depth, max_in, n):
+        wavefront = True
+    else:
+        return None
+    try:
+        network = build_network(
+            np.asarray(rows), np.asarray(cols), int(n),
+            fused=False, wavefront=wavefront,
+        )
+        ch, sp, qp = _analog_inputs(int(n), int(t_steps), concrete=True)
+        fn = jax.jit(
+            lambda sp, qp: route(network, ch, sp, qp, bounds=Bounds(), dtype=dtype).runoff
+        )
+        jax.block_until_ready(fn(sp, qp))  # compile + warm
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(sp, qp))
+        return time.perf_counter() - t0
+    except Exception as e:  # probes are best-effort tie-breaks
+        log.warning(f"tune probe for {engine} failed ({e}); keeping scored estimate")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The planner entry (mesh queries)
+# ---------------------------------------------------------------------------
+
+_TUNE_MEMO: dict[str, TuneResult] = {}
+_LAST_SELECTION: dict[str, Any] | None = None
+
+
+def reset_tune_memo() -> None:
+    """Drop the in-process decision/card memos (tests and check scripts use
+    this to simulate a fresh process against the persistent cache)."""
+    _TUNE_MEMO.clear()
+    _CARD_MEMO.clear()
+
+
+def last_selection() -> dict[str, Any] | None:
+    """The most recent planner decision this process made (``engine`` +
+    ``source``), for provenance stamping (bench records). None before any."""
+    return None if _LAST_SELECTION is None else dict(_LAST_SELECTION)
+
+
+def record_selection(engine: str, source: str) -> None:
+    """Note a selection for :func:`last_selection`. The off-mode path in
+    ``select_engine_tuned`` short-circuits before :func:`tune_engine` (the cpu
+    row must never layer the adjacency), so it records its policy pick here —
+    provenance stamping must not serve a stale earlier decision."""
+    global _LAST_SELECTION
+    _LAST_SELECTION = {"engine": engine, "source": source}
+
+
+def _emit_tune_event(
+    res: TuneResult, *, mode: str, platform: str, n: int, depth: int,
+    max_in: int, n_shards: int, topo_sha: str, dtype: str, kernel: str | None,
+) -> None:
+    try:
+        from ddr_tpu.observability.events import get_recorder
+
+        rec = get_recorder()
+        if rec is None:
+            return
+        rec.emit(
+            "tune",
+            mode=mode,
+            source=res.source,
+            engine=res.engine,
+            key=res.key[:12],
+            topology=str(topo_sha)[:12],
+            platform=platform,
+            n=int(n),
+            depth=int(depth),
+            max_in=int(max_in),
+            n_shards=int(n_shards),
+            dtype=dtype,
+            kernel=kernel or "auto",
+            candidates=[c.brief() for c in res.candidates],
+        )
+    except Exception:  # telemetry must never break selection
+        log.exception("could not emit tune event")
+
+
+def tune_engine(
+    platform: str,
+    rows: Any,
+    cols: Any,
+    n: int,
+    depth: int,
+    max_in: int,
+    n_shards: int,
+    *,
+    topo_sha: str,
+    mesh_desc: dict[str, Any] | None = None,
+    dtype: str = "fp32",
+    kernel: str | None = None,
+    t_steps: int | None = None,
+    hbm_bytes: int | None = None,
+    card: Any = None,
+) -> TuneResult:
+    """Resolve one (topology, mesh, dtype, kernel) query to an engine.
+
+    The decision ladder: in-process memo -> persistent tuning cache
+    (``source="cached"``) -> card scoring (``"scored"``, optionally
+    ``"probed"``) -> the hand policy (``"policy"``: ``DDR_AUTOTUNE=off``, or
+    any scoring failure — the planner degrades to exactly the old behavior,
+    never an error). Fresh decisions are persisted and emitted as a ``tune``
+    event; memo hits are silent (chunked inference asks once per time chunk).
+
+    ``card`` injects a pre-built ProgramCard (tests); ``t_steps`` is the
+    query's time-window length (structural terms scale with it; defaults to
+    24). All host-side.
+    """
+    global _LAST_SELECTION
+    from ddr_tpu.parallel.select import select_parallel_engine
+
+    mode = autotune_mode()
+    t = int(t_steps) if t_steps else 24
+    if mode == "off":
+        engine = select_parallel_engine(platform, n, depth, n_shards, max(1, max_in))
+        res = TuneResult(engine=engine, source="policy")
+        _LAST_SELECTION = {"engine": engine, "source": "policy"}
+        return res
+
+    key = _cache.plan_key(topo_sha, mesh_desc, dtype, kernel)
+    hit = _TUNE_MEMO.get(key)
+    if hit is not None:
+        _LAST_SELECTION = {"engine": hit.engine, "source": hit.source}
+        return hit
+
+    prior = select_parallel_engine(platform, n, depth, n_shards, max(1, max_in))
+
+    stored = _cache.load_plan(key)
+    if stored is not None and stored.get("engine") in ENGINES:
+        res = TuneResult(engine=str(stored["engine"]), source="cached", key=key)
+        _TUNE_MEMO[key] = res
+        _LAST_SELECTION = {"engine": res.engine, "source": "cached"}
+        _emit_tune_event(
+            res, mode=mode, platform=platform, n=n, depth=depth, max_in=max_in,
+            n_shards=n_shards, topo_sha=topo_sha, dtype=dtype, kernel=kernel,
+        )
+        return res
+
+    try:
+        if card is None:
+            card = _physics_card(rows, cols, n, min(t, _T_CARD_MAX), dtype, topo_sha)
+            card_t = min(t, _T_CARD_MAX)
+        else:
+            card_t = t
+        candidates = score_candidates(
+            platform=platform, n=n, depth=depth, max_in=max_in, n_shards=n_shards,
+            t_steps=t, card=card, card_t=card_t, dtype=dtype, kernel=kernel,
+            hbm_bytes=hbm_bytes,
+        )
+        winner, _ = _pick(candidates, prior)
+        if winner is None:
+            res = TuneResult(engine=prior, source="policy", key=key, candidates=candidates)
+        else:
+            source = "scored"
+            if mode == "probe":
+                feasible = [c for c in candidates if c.feasible]
+                top = sorted(feasible, key=lambda c: c.est_s)[:2]
+                for c in top:
+                    c.probed_s = _probe_seconds(
+                        c.engine, rows, cols, n, depth, max_in, t, dtype
+                    )
+                timed = [c for c in top if c.probed_s is not None]
+                if len(timed) == 2:
+                    winner = min(timed, key=lambda c: c.probed_s)
+                    source = "probed"
+            res = TuneResult(
+                engine=winner.engine, source=source, key=key, candidates=candidates
+            )
+            _cache.store_plan(
+                key,
+                {
+                    "engine": res.engine,
+                    "source": res.source,
+                    "topology": str(topo_sha),
+                    "mesh": _cache._mesh_key_fields(mesh_desc),
+                    "platform": platform,
+                    "dtype": dtype,
+                    "kernel": kernel or "auto",
+                    "n": int(n),
+                    "depth": int(depth),
+                    "max_in": int(max_in),
+                    "n_shards": int(n_shards),
+                    "t_steps": t,
+                    "candidates": [c.brief() for c in candidates],
+                },
+            )
+    except Exception as e:
+        log.warning(f"autotune scoring failed ({e}); falling back to the hand policy")
+        res = TuneResult(engine=prior, source="policy", key=key)
+
+    _TUNE_MEMO[key] = res
+    _LAST_SELECTION = {"engine": res.engine, "source": res.source}
+    _emit_tune_event(
+        res, mode=mode, platform=platform, n=n, depth=depth, max_in=max_in,
+        n_shards=n_shards, topo_sha=topo_sha, dtype=dtype, kernel=kernel,
+    )
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Single-device report (`ddr tune`) and device calibration
+# ---------------------------------------------------------------------------
+
+
+def tune_single_device(
+    n: int,
+    depth: int,
+    max_in: int = 4,
+    t_steps: int = 240,
+    platform: str | None = None,
+) -> list[Candidate]:
+    """Score the single-device schedule space — step, wavefront, stacked ×
+    band count — under the (possibly calibrated) wave cost model, for the
+    ``ddr tune`` report. Report-only: ``build_routing_network``'s own
+    eligibility-driven selection stays authoritative at build time; this table
+    is the planner's view of WHY, priced by
+    :func:`~ddr_tpu.routing.chunked.wave_cost_constants` (so a calibrate run
+    reshapes it)."""
+    from ddr_tpu.routing.chunked import wave_cost_constants
+    from ddr_tpu.routing.network import WAVEFRONT_MAX_DEPTH, single_ring_eligible
+
+    if platform is None:
+        import sys
+
+        jax = sys.modules.get("jax")
+        platform = jax.default_backend() if jax is not None else "cpu"
+    cal = calibration(platform)
+    fixed, bw = wave_cost_constants()
+    t = max(1, int(t_steps))
+    d = max(1, int(depth))
+    rho = max(1.0, n / d)  # uniform level width
+    out: list[Candidate] = []
+
+    waves = t * d
+    out.append(
+        Candidate("step", True, est_s=waves * cal["step_s"], waves=waves)
+    )
+
+    ring_bytes = 3 * rho * 4  # gap-sized ring: ~(gap+2) rows of one level
+    eligible = single_ring_eligible(d, max(1, max_in), n)
+    waves = t + d
+    out.append(
+        Candidate(
+            "wavefront",
+            eligible,
+            est_s=waves * (fixed + ring_bytes / bw),
+            reason="" if eligible else f"ring infeasible (depth={d}, max_in={max_in})",
+            waves=waves,
+        )
+    )
+
+    c = 1
+    while c <= 64:
+        span = max(1, -(-d // c))
+        if span <= WAVEFRONT_MAX_DEPTH:
+            band_ring = min(span + 1, 3) * rho * 4 if c > 1 else ring_bytes
+            waves = c * t + d
+            out.append(
+                Candidate(
+                    f"stacked[C={c}]",
+                    True,
+                    est_s=waves * (fixed + band_ring / bw),
+                    waves=waves,
+                )
+            )
+        c *= 2
+    out.sort(key=lambda cand: (not cand.feasible, cand.est_s))
+    return out
+
+
+def _chain_topology(depth: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """A single chain of ``depth`` edges (depth+1 reaches, width-1 levels)."""
+    n = depth + 1
+    return np.arange(1, n, dtype=np.int64), np.arange(0, n - 1, dtype=np.int64), n
+
+
+def _comb_topology(width: int, depth: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """``width`` parallel chains of ``depth`` edges (wide uniform levels)."""
+    n = width * (depth + 1)
+    ids = np.arange(n, dtype=np.int64).reshape(width, depth + 1)
+    rows = ids[:, 1:].ravel()
+    cols = ids[:, :-1].ravel()
+    return rows, cols, n
+
+
+def calibrate_device(store: bool = True, t_steps: int = 16) -> dict[str, Any]:
+    """Measure the wave-cost constants on the CURRENT device and (optionally)
+    persist them for :func:`calibration` / ``wave_cost_constants`` to prefer
+    over the stale v5e literals (``ddr tune --calibrate``).
+
+    Two timed single-device wavefront routes: a chain (width-1 levels — the
+    per-wave ring copy is negligible, so seconds/wave ≈ the fixed dispatch +
+    physics cost) and a wide comb (the residual per-wave time over the fixed
+    cost prices the ring copy). When the wide probe's residual is below
+    measurement noise the ring bandwidth is left at its prior (recorded as
+    ``ring_bw_inherited``) rather than storing an artifact of timer jitter.
+    """
+    import sys
+
+    import jax
+
+    from ddr_tpu.routing.chunked import wave_cost_constants
+
+    platform = jax.default_backend()
+    t = max(4, int(t_steps))
+
+    def _timed_route(rows, cols, n) -> float | None:
+        return _probe_seconds("sharded-wavefront", rows, cols, n, _depth(rows, cols, n), 1, t, "fp32")
+
+    def _depth(rows, cols, n) -> int:
+        from ddr_tpu.routing.network import compute_levels
+
+        level = compute_levels(np.asarray(rows), np.asarray(cols), n)
+        return int(level.max()) if n else 0
+
+    chain_d = 512
+    rows, cols, n = _chain_topology(chain_d)
+    t_chain = _timed_route(rows, cols, n)
+    record: dict[str, Any] = {"platform": platform, "t_steps": t}
+    prior_fixed, prior_bw = wave_cost_constants()
+    if t_chain is None:
+        log.warning("calibration chain probe failed; keeping prior constants")
+        return {"platform": platform, "wave_fixed_s": prior_fixed, "ring_bytes_per_s": prior_bw, "measured": False}
+    waves_chain = t + chain_d
+    fixed = max(1e-7, t_chain / waves_chain)
+    record["wave_fixed_s"] = fixed
+    record["chain_seconds"] = t_chain
+
+    comb_w, comb_d = 2048, 32
+    rows, cols, n = _comb_topology(comb_w, comb_d)
+    t_comb = _timed_route(rows, cols, n)
+    bw = prior_bw
+    inherited = True
+    if t_comb is not None:
+        waves_comb = t + comb_d
+        per_wave = t_comb / waves_comb
+        residual = per_wave - fixed
+        ring_bytes = 3 * comb_w * 4  # gap-sized ring rows x level width x f32
+        if residual > 0.25 * fixed:  # above noise: the copy is measurable
+            bw = ring_bytes / residual
+            inherited = False
+        record["comb_seconds"] = t_comb
+    record["ring_bytes_per_s"] = bw
+    record["ring_bw_inherited"] = inherited
+    if store:
+        path = _cache.store_calibration(platform, record)
+        if path is not None:
+            log.info(f"stored calibration for {platform} at {path}")
+        else:
+            log.warning(
+                "no tuning cache directory configured (DDR_TUNE_CACHE_DIR / "
+                "DDR_COMPILE_CACHE_DIR); calibration not persisted"
+            )
+    record["measured"] = True
+    return record

@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""CI guard: the engine auto-tuner scores, caches, and warms card-build-free.
+
+Runs the cost-model planner (``ddr_tpu.tuning``) on a tiny synthetic topology
+on CPU with ``DDR_AUTOTUNE=score`` against a throwaway tuning cache and
+checks the contract the fleet depends on:
+
+1. the first query SCORES: a winner is chosen (matching the hand policy's cpu
+   row — gspmd), exactly one physics card is AOT-built, and the decision is
+   persisted in the tuning cache;
+2. a second planner invocation with cleared in-process memos (a fresh
+   process, as far as the planner can tell) is a CACHE HIT: ``source ==
+   "cached"``, the same winner, and ZERO new card builds;
+3. ``DDR_AUTOTUNE=off`` returns the hand policy's pick (``source ==
+   "policy"``) without touching the card counter at all.
+
+Exit 0 when all hold, 1 otherwise. Run directly (CI) or via the test suite
+(tests/scripts/test_check_autotune.py):
+
+    JAX_PLATFORMS=cpu python scripts/check_autotune.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _fail(msg: str) -> int:
+    print(f"check_autotune: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["DDR_AUTOTUNE"] = "score"
+    if not os.environ.get("DDR_TUNE_CACHE_DIR"):
+        os.environ["DDR_TUNE_CACHE_DIR"] = tempfile.mkdtemp(prefix="ddr-tune-check-")
+    try:
+        import numpy as np
+
+        from ddr_tpu.parallel.select import select_engine_tuned
+        from ddr_tpu.tuning import planner
+        from ddr_tpu.tuning.cache import tuning_cache_dir
+    except Exception as e:
+        return _fail(f"import failed: {e!r}")
+
+    # a tiny diamond-and-chain topology: depth > 1, max_in = 2
+    rows = np.array([1, 2, 3, 3, 4, 5], dtype=np.int64)
+    cols = np.array([0, 0, 1, 2, 3, 4], dtype=np.int64)
+    n = 6
+    query = dict(
+        cache_key="check-autotune-topology",
+        mesh_desc={"axes": ["reach"], "shape": [1], "platform": "cpu", "n_devices": 1},
+        t_steps=8,
+    )
+
+    # 1. fresh score: winner chosen, one card built, decision persisted
+    builds0 = planner.card_build_count()
+    try:
+        engine, source = select_engine_tuned("cpu", rows, cols, n, 1, **query)
+    except Exception as e:
+        return _fail(f"scoring query raised: {e!r}")
+    if engine != "gspmd":
+        return _fail(f"score-mode winner {engine!r} != the policy's cpu pick 'gspmd'")
+    if source not in ("scored", "probed"):
+        return _fail(f"fresh query source {source!r}, expected scored/probed")
+    if planner.card_build_count() <= builds0:
+        return _fail("scoring built no physics card (the score was structural only)")
+    cache_dir = tuning_cache_dir()
+    plans = list(cache_dir.glob("plan_*.json")) if cache_dir else []
+    if not plans:
+        return _fail(f"no plan entry persisted under {cache_dir}")
+
+    # 2. warm cache, cold process: cache hit, zero card builds
+    planner.reset_tune_memo()
+    builds1 = planner.card_build_count()
+    engine2, source2 = select_engine_tuned("cpu", rows, cols, n, 1, **query)
+    if source2 != "cached":
+        return _fail(f"second invocation source {source2!r}, expected 'cached'")
+    if engine2 != engine:
+        return _fail(f"cached winner {engine2!r} != scored winner {engine!r}")
+    if planner.card_build_count() != builds1:
+        return _fail("cache hit still built a physics card")
+
+    # 3. DDR_AUTOTUNE=off: the hand policy, untouched counter
+    os.environ["DDR_AUTOTUNE"] = "off"
+    try:
+        engine3, source3 = select_engine_tuned("cpu", rows, cols, n, 1, **query)
+    finally:
+        os.environ["DDR_AUTOTUNE"] = "score"
+    if (engine3, source3) != ("gspmd", "policy"):
+        return _fail(f"off-mode returned {(engine3, source3)!r}, expected ('gspmd', 'policy')")
+    if planner.card_build_count() != builds1:
+        return _fail("off mode built a physics card")
+
+    print(
+        "check_autotune: scored winner "
+        f"{engine!r} persisted at {plans[0].name}; warm-cache reselect was "
+        "card-build-free and off-mode matches the hand policy"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -329,6 +329,18 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
                     "info" if device_mismatch else "regression" if grew else "ok"
                 ),
             })
+    # a changed tuned plan is context, not a regression: the auto-tuner picking
+    # a different engine than the baseline round explains throughput movement
+    # (or an intentional cost-model change), so it surfaces info-level
+    fp, bp = fresh.get("tuned_plan"), baseline.get("tuned_plan")
+    if isinstance(fp, str) and isinstance(bp, str) and fp != bp:
+        findings.insert(0, {
+            "key": "tuned_plan",
+            "fresh": fp,
+            "baseline": bp,
+            "ratio": None,
+            "status": "info",
+        })
     if dtype_mismatch:
         findings.insert(0, {
             "key": "compute_dtype",

@@ -285,3 +285,43 @@ class TestEngineAxes:
 
         with pytest.raises(ValueError, match="dtype"):
             resolve_engine_axes("gspmd", None, "fp16")
+
+
+class TestTopologyStatsMemo:
+    """The derived-stat memo (topology_stats): chunked inference asks once per
+    time chunk of the same reach set — the O(E) Kahn layering must run once."""
+
+    def test_memoized_by_cache_key(self, monkeypatch):
+        from ddr_tpu.parallel.select import topology_stats
+        from ddr_tpu.routing import network
+
+        calls = []
+        orig = network.compute_levels
+
+        def spy(rows, cols, n):
+            calls.append(int(n))
+            return orig(rows, cols, n)
+
+        monkeypatch.setattr(network, "compute_levels", spy)
+        rows = np.arange(1, 9, dtype=np.int64)
+        cols = np.arange(0, 8, dtype=np.int64)
+        s1 = topology_stats(rows, cols, 9, cache_key="memo-chain-9")
+        s2 = topology_stats(rows, cols, 9, cache_key="memo-chain-9")
+        assert s1 == s2 == (9, 8, 8, 1)
+        assert len(calls) == 1, "repeat selection re-ran the O(E) layering"
+        topology_stats(rows, cols, 9)  # no key -> nothing to memo under
+        assert len(calls) == 2
+
+    def test_cpu_short_circuit_never_layers(self, monkeypatch):
+        """select_for_topology's cpu row answers without deriving stats at all
+        (the policy doesn't consult depth there)."""
+        from ddr_tpu.parallel.select import select_for_topology
+        from ddr_tpu.routing import network
+
+        def boom(rows, cols, n):  # pragma: no cover - must not run
+            raise AssertionError("cpu row derived topology stats")
+
+        monkeypatch.setattr(network, "compute_levels", boom)
+        rows = np.arange(1, 9, dtype=np.int64)
+        cols = np.arange(0, 8, dtype=np.int64)
+        assert select_for_topology("cpu", rows, cols, 9, 8) == "gspmd"

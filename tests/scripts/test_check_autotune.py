@@ -1,0 +1,64 @@
+"""scripts/check_autotune.py: the auto-tuner CI gate must pass on a clean
+tree (score -> persist -> warm-cache card-build-free reselect -> off-mode
+policy parity) and actually catch a cold cache."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_autotune.py"
+
+
+def _run(tmp_path, **env_overrides):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        DDR_TUNE_CACHE_DIR=str(tmp_path / "tune-cache"),
+        **env_overrides,
+    )
+    return subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+
+
+def test_repo_autotune_gate_passes(tmp_path):
+    """THE CI gate: score a tiny topology, persist the winner, and prove the
+    second (memo-cleared) invocation is a cache hit with zero card builds."""
+    proc = _run(tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "card-build-free" in proc.stdout
+    plans = list((tmp_path / "tune-cache").glob("plan_*.json"))
+    assert len(plans) == 1
+    rec = json.loads(plans[0].read_text())
+    assert rec["engine"] == "gspmd"
+
+
+def test_gate_fails_on_a_poisoned_cache(tmp_path):
+    """A cache entry whose engine contradicts the scorer must fail stage 2
+    (cached winner != scored winner) — the gate is a real check, not a
+    tautology. Poison by pre-seeding the exact plan key the gate queries."""
+    from ddr_tpu.tuning.cache import plan_key
+
+    cache_dir = tmp_path / "tune-cache"
+    cache_dir.mkdir(parents=True)
+    key = plan_key(
+        "check-autotune-topology",
+        {"axes": ["reach"], "shape": [1], "platform": "cpu", "n_devices": 1},
+        "fp32",
+        None,
+    )
+    # planner_version must match or the entry is (correctly) ignored
+    from ddr_tpu.tuning.cache import PLANNER_VERSION
+
+    (cache_dir / f"plan_{key}.json").write_text(json.dumps({
+        "engine": "stacked-sharded", "planner_version": PLANNER_VERSION,
+    }))
+    proc = _run(tmp_path)
+    assert proc.returncode == 1
+    assert "source" in proc.stderr or "winner" in proc.stderr

@@ -63,6 +63,27 @@ class TestCompare:
         base = {"device": "cpu", "grad_value": 5.0, "deep_value": 3.0}
         assert mod.compare(fresh, base) == []
 
+    def test_tuned_plan_drift_is_informational(self):
+        """The auto-tuner picking a different engine than the baseline round is
+        CONTEXT for any throughput movement, never itself a regression."""
+        mod = _load()
+        fresh = {"device": "cpu", "value": 100.0, "tuned_plan": "sharded-wavefront"}
+        base = {"device": "cpu", "value": 100.0, "tuned_plan": "gspmd"}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base)}
+        assert by_key["tuned_plan"]["status"] == "info"
+        assert by_key["tuned_plan"]["fresh"] == "sharded-wavefront"
+        # same plan (or a record predating the field): no finding at all
+        same = mod.compare(
+            {"device": "cpu", "value": 1.0, "tuned_plan": "gspmd"},
+            {"device": "cpu", "value": 1.0, "tuned_plan": "gspmd"},
+        )
+        assert all(f["key"] != "tuned_plan" for f in same)
+        legacy = mod.compare(
+            {"device": "cpu", "value": 1.0, "tuned_plan": "gspmd"},
+            {"device": "cpu", "value": 1.0},
+        )
+        assert all(f["key"] != "tuned_plan" for f in legacy)
+
 
 class TestCostGrowth:
     """The cost-card direction: peak memory and collective counts growing past

@@ -1,0 +1,139 @@
+"""The persistent tuning cache (ddr_tpu/tuning/cache.py): key stability,
+round-trips, version invalidation, and corruption tolerance. Jax-free by
+package contract — this module must import and run without jax."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ddr_tpu.tuning import cache
+
+
+MESH = {"axes": ["reach"], "shape": [8], "platform": "cpu", "n_devices": 8}
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDR_TUNE_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("DDR_COMPILE_CACHE_DIR", raising=False)
+    return tmp_path
+
+
+class TestCacheDir:
+    def test_explicit_dir_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DDR_TUNE_CACHE_DIR", str(tmp_path / "t"))
+        monkeypatch.setenv("DDR_COMPILE_CACHE_DIR", str(tmp_path / "c"))
+        assert cache.tuning_cache_dir() == tmp_path / "t"
+
+    def test_compile_cache_fallback_is_a_subdir(self, tmp_path, monkeypatch):
+        """The planner rides the same persistent volume as the XLA executable
+        cache — a fleet that warms one warms both."""
+        monkeypatch.delenv("DDR_TUNE_CACHE_DIR", raising=False)
+        monkeypatch.setenv("DDR_COMPILE_CACHE_DIR", str(tmp_path))
+        assert cache.tuning_cache_dir() == tmp_path / "tuning"
+
+    def test_unconfigured_means_no_persistence(self, monkeypatch):
+        monkeypatch.delenv("DDR_TUNE_CACHE_DIR", raising=False)
+        monkeypatch.delenv("DDR_COMPILE_CACHE_DIR", raising=False)
+        assert cache.tuning_cache_dir() is None
+        assert cache.load_plan("deadbeef") is None
+        assert cache.store_plan("deadbeef", {"engine": "gspmd"}) is None
+
+    def test_resolving_creates_nothing(self, tmp_path, monkeypatch):
+        """Read-only callers must not mkdir (side-effect-free resolution)."""
+        target = tmp_path / "never-created"
+        monkeypatch.setenv("DDR_TUNE_CACHE_DIR", str(target))
+        cache.tuning_cache_dir()
+        assert cache.load_plan("deadbeef") is None
+        assert not target.exists()
+
+
+class TestPlanKey:
+    def test_mesh_identity_fields_only(self):
+        """The key uses the mesh's content identity (axes/shape/platform/
+        device count), never process identity: the same fleet shape on
+        different device ids — a restarted replica — must hit the cache."""
+        extra = dict(MESH, topology="abc123", process_count=2, device_ids=[3, 1])
+        assert cache.plan_key("t", MESH, "fp32", None) == cache.plan_key(
+            "t", extra, "fp32", None
+        )
+
+    @pytest.mark.parametrize(
+        "other",
+        [
+            dict(MESH, shape=[4], n_devices=4),
+            dict(MESH, platform="tpu"),
+            dict(MESH, axes=["band"]),
+        ],
+    )
+    def test_mesh_shape_changes_the_key(self, other):
+        assert cache.plan_key("t", MESH, "fp32", None) != cache.plan_key(
+            "t", other, "fp32", None
+        )
+
+    def test_every_query_axis_participates(self):
+        base = cache.plan_key("topo-a", MESH, "fp32", None)
+        assert cache.plan_key("topo-b", MESH, "fp32", None) != base
+        assert cache.plan_key("topo-a", MESH, "bf16", None) != base
+        assert cache.plan_key("topo-a", MESH, "fp32", "pallas") != base
+        assert cache.plan_key("topo-a", MESH, "fp32", None, version=99) != base
+
+    def test_kernel_none_is_auto(self):
+        """None and "auto" are the same kernel axis value (route_parallel's
+        contract) — they must not fork the cache."""
+        assert cache.plan_key("t", MESH, "fp32", None) == cache.plan_key(
+            "t", MESH, "fp32", "auto"
+        )
+
+
+class TestPlanRoundTrip:
+    def test_store_then_load(self, cache_dir):
+        key = cache.plan_key("topo", MESH, "fp32", None)
+        path = cache.store_plan(key, {"engine": "sharded-wavefront", "n": 64})
+        assert path is not None and path.exists()
+        rec = cache.load_plan(key)
+        assert rec["engine"] == "sharded-wavefront"
+        assert rec["n"] == 64
+        assert rec["planner_version"] == cache.PLANNER_VERSION
+        assert "wall" in rec
+
+    def test_version_mismatch_invalidates(self, cache_dir):
+        """A scoring-model bump must orphan every stale entry at once."""
+        key = cache.plan_key("topo", MESH, "fp32", None)
+        cache.store_plan(key, {"engine": "gspmd", "planner_version": cache.PLANNER_VERSION + 1})
+        assert cache.load_plan(key) is None
+
+    def test_corrupt_entry_tolerated(self, cache_dir):
+        key = cache.plan_key("topo", MESH, "fp32", None)
+        (cache_dir / f"plan_{key}.json").write_text("{not json")
+        assert cache.load_plan(key) is None
+
+    def test_non_dict_and_engineless_entries_rejected(self, cache_dir):
+        key = cache.plan_key("topo", MESH, "fp32", None)
+        (cache_dir / f"plan_{key}.json").write_text(json.dumps([1, 2]))
+        assert cache.load_plan(key) is None
+        cache.store_plan(key, {"engine": 7})
+        assert cache.load_plan(key) is None
+
+    def test_unwritable_dir_never_raises(self, monkeypatch, tmp_path):
+        """Best-effort persistence: a read-only cache volume degrades to the
+        in-process memo, never to a crash."""
+        blocker = tmp_path / "file"
+        blocker.write_text("")
+        monkeypatch.setenv("DDR_TUNE_CACHE_DIR", str(blocker / "sub"))
+        assert cache.store_plan("k", {"engine": "gspmd"}) is None
+
+
+class TestCalibrationRoundTrip:
+    def test_store_then_load_per_platform(self, cache_dir):
+        cache.store_calibration("tpu", {"wave_fixed_s": 3.1e-5})
+        assert cache.load_calibration("tpu")["wave_fixed_s"] == 3.1e-5
+        assert cache.load_calibration("cpu") is None
+
+    def test_version_mismatch_invalidates(self, cache_dir):
+        cache.store_calibration(
+            "tpu", {"wave_fixed_s": 3.1e-5, "planner_version": cache.PLANNER_VERSION + 1}
+        )
+        assert cache.load_calibration("tpu") is None
