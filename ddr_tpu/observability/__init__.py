@@ -46,6 +46,13 @@ from ddr_tpu.observability.health import (
     ReachStats,
 )
 from ddr_tpu.observability.preempt import PreemptionHandler
+from ddr_tpu.observability.recovery import (
+    RECOVERY_STAGES,
+    ForcingValidator,
+    RecoveryConfig,
+    RecoveryGiveUp,
+    RecoverySupervisor,
+)
 from ddr_tpu.observability.skill import SkillConfig, SkillTracker
 from ddr_tpu.observability.phases import STEP_PHASES, PhaseTimer, summarize_phases
 from ddr_tpu.observability.prometheus import (
@@ -125,4 +132,9 @@ __all__ = [
     "maybe_inject",
     "parse_faults",
     "PreemptionHandler",
+    "RECOVERY_STAGES",
+    "RecoveryConfig",
+    "RecoveryGiveUp",
+    "RecoverySupervisor",
+    "ForcingValidator",
 ]

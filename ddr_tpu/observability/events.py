@@ -87,7 +87,13 @@ __all__ = [
 #: :func:`ddr_tpu.parallel.sharding.reshard_state`). ``tune`` is one engine
 #: auto-tuner decision: the scored candidate table and the winner with its
 #: provenance (``source`` ∈ policy|scored|probed|cached,
-#: :mod:`ddr_tpu.tuning.planner`).
+#: :mod:`ddr_tpu.tuning.planner`). ``recovery`` is one self-healing action the
+#: recovery supervisor took in answer to a watchdog violation (escalation
+#: ladder stage ∈ skip|fp32-reroute|rollback|give-up, with the offending
+#: batch's identity, :mod:`ddr_tpu.observability.recovery`); ``data_anomaly``
+#: is one bounded forcing-validation finding from the ``data_load`` phase scan
+#: (non-finite / out-of-physical-range counts and the
+#: ``DDR_DATA_VALIDATE`` policy applied, same module).
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -110,6 +116,8 @@ EVENT_TYPES = (
     "audit",
     "reshard",
     "tune",
+    "recovery",
+    "data_anomaly",
 )
 
 

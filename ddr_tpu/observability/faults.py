@@ -12,10 +12,18 @@ site                where it fires (host side only, never inside jitted code)
                       write and the atomic rename (a crash leaves a ``.tmp``,
                       a corrupt flips bits under an already-computed manifest)
 ``data.load``         the train loop's prefetch-thread forcing read
+``data.forcings``     the prefetch thread's assembled forcing batch, BEFORE
+                      the ``data_load`` validation scan (a ``nan`` here is the
+                      bad tile the quarantine policy must catch on the host)
 ``data.remote_read``  :mod:`ddr_tpu.io.remote`, before each remote zarr/store
                       array read (a crash simulates the transient connection
                       reset / 5xx / timeout the bounded-retry loop absorbs)
 ``device.step``       the train loop, immediately before the jitted step
+                      (a ``nan`` poisons the step's forcing operand AFTER
+                      validation passed — the storm only the watchdog sees)
+``device.grads``      the train loop, on the host-synchronized gradient norm
+                      right before the watchdog thresholds it (a ``nan``
+                      simulates a non-finite backward pass)
 ``serve.execute``     :class:`~ddr_tpu.serving.service.ForecastService`'s
                       batch worker, before the compiled program runs
 ``registry.reload``   :class:`~ddr_tpu.serving.registry.CheckpointWatcher`,
@@ -29,7 +37,9 @@ site                where it fires (host side only, never inside jitted code)
 Grammar: ``;``-separated clauses of ``action@site[=AT][:k=v,...]``.
 
 - ``action``: ``crash`` (raise :class:`InjectedFault`), ``slow`` (sleep
-  ``ms``), ``corrupt`` (bit-flip the byte payload the site is writing).
+  ``ms``), ``corrupt`` (bit-flip the byte payload the site is writing),
+  ``nan`` (overwrite the float-array payload the site is carrying with
+  non-finites — the nan-storm drill's primitive).
 - ``site``: a registered name or any unambiguous suffix (``step`` resolves to
   ``device.step``, ``write`` to ``checkpoint.write``).
 - ``=AT`` (or ``at=AT``): fire only when the site's context ``step`` — falling
@@ -66,6 +76,7 @@ log = logging.getLogger(__name__)
 __all__ = [
     "FAULT_SITES",
     "FAULT_ACTIONS",
+    "NAN_SITES",
     "InjectedFault",
     "FaultAction",
     "FaultPlan",
@@ -83,20 +94,27 @@ __all__ = [
 FAULT_SITES = (
     "checkpoint.write",
     "data.load",
+    "data.forcings",
     "data.remote_read",
     "device.step",
+    "device.grads",
     "serve.execute",
     "registry.reload",
 )
 
-#: Supported actions: raise / delay / bit-flip.
-FAULT_ACTIONS = ("crash", "slow", "corrupt")
+#: Supported actions: raise / delay / bit-flip / nan-storm.
+FAULT_ACTIONS = ("crash", "slow", "corrupt", "nan")
 
 #: Sites whose invocation carries a byte payload a ``corrupt`` action can
 #: flip. A corrupt clause anywhere else would fire, log, emit a ``fault``
 #: event — and change nothing: exactly the silently-inert plan the parse-time
 #: strictness exists to prevent, so it is rejected up front.
 PAYLOAD_SITES = ("checkpoint.write",)
+
+#: Sites whose invocation carries a float ndarray payload a ``nan`` action can
+#: overwrite with non-finites. Same parse-time strictness as PAYLOAD_SITES: a
+#: ``nan`` clause at a byte/no-payload site would be silently inert.
+NAN_SITES = ("data.forcings", "device.step", "device.grads")
 
 
 class InjectedFault(RuntimeError):
@@ -143,6 +161,11 @@ class FaultAction:
             raise ValueError(
                 f"corrupt@{site} would inject nothing: only "
                 f"{', '.join(PAYLOAD_SITES)} write a byte payload to flip"
+            )
+        if action == "nan" and site not in NAN_SITES:
+            raise ValueError(
+                f"nan@{site} would inject nothing: only "
+                f"{', '.join(NAN_SITES)} carry a float-array payload to poison"
             )
         if p is not None and not 0.0 <= p <= 1.0:
             raise ValueError(f"fault probability must be in [0, 1], got {p}")
@@ -269,6 +292,8 @@ class FaultPoint:
     - ``slow`` sleeps, then execution continues;
     - ``corrupt`` bit-flips the ``data`` bytes (returned; sites that write
       payloads pass them through);
+    - ``nan`` overwrites a float ndarray ``data`` with non-finites (returned
+      as a poisoned copy — the caller's array is never mutated in place);
     - ``crash`` raises :class:`InjectedFault` (evaluated last, so a clause
       list like ``slow;crash`` behaves as written).
 
@@ -278,8 +303,12 @@ class FaultPoint:
     def __init__(self, site: str, actions: list[FaultAction]) -> None:
         self.site = site
         self._actions = actions
+        #: True when any clause needs an ndarray payload — call sites that
+        #: must materialize a host copy to offer one check this first so an
+        #: armed-but-nan-free plan stays payload-free on the hot path.
+        self.wants_array = any(a.action == "nan" for a in actions)
 
-    def __call__(self, data: bytes | None = None, **ctx: Any) -> bytes | None:
+    def __call__(self, data: Any = None, **ctx: Any) -> Any:
         crash: FaultAction | None = None
         for a in self._actions:
             if not a.should_fire(ctx):
@@ -289,6 +318,8 @@ class FaultPoint:
                 time.sleep(a.ms / 1e3)
             elif a.action == "corrupt" and data is not None:
                 data = _flip_bits(data)
+            elif a.action == "nan" and data is not None:
+                data = _poison_array(data)
             elif a.action == "crash":
                 crash = a
         if crash is not None:
@@ -317,6 +348,25 @@ class FaultPoint:
 
 def _plain(v: Any) -> bool:
     return isinstance(v, (bool, int, float, str)) or v is None
+
+
+def _poison_array(arr: Any, every: int = 3) -> Any:
+    """Overwrite every ``every``-th element of a float ndarray with NaN (plus
+    one +inf, so downstream scans see both non-finite kinds) — a deterministic
+    "storm", dense enough that any reduction over the payload goes non-finite.
+    Duck-typed over the ndarray API (``dtype``/``copy``/``flat``) so this
+    module stays import-free of numpy/jax; non-float payloads pass through
+    untouched (there is nothing representable to poison)."""
+    dtype = getattr(arr, "dtype", None)
+    if dtype is None or getattr(dtype, "kind", "") not in ("f", "c"):
+        return arr
+    out = arr.copy()
+    # .flat (not .reshape(-1)) — a reshape of a non-contiguous copy would
+    # detach from ``out`` and the poison would vanish
+    out.flat[:: max(1, int(every))] = float("nan")
+    if out.size:
+        out.flat[0] = float("inf")
+    return out
 
 
 def _flip_bits(data: bytes, every: int = 97) -> bytes:
@@ -370,7 +420,7 @@ def fault_site(site: str) -> FaultPoint | None:
     return active_plan().point(site)
 
 
-def maybe_inject(site: str, data: bytes | None = None, **ctx: Any) -> bytes | None:
+def maybe_inject(site: str, data: Any = None, **ctx: Any) -> Any:
     """One-shot convenience for cold sites (checkpoint writes, reloads) where
     re-resolving per call is fine."""
     point = fault_site(site)

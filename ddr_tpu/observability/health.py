@@ -765,6 +765,23 @@ class HealthWatchdog:
             + " ".join(f"{k}={v}" for k, v in payload.items() if k != "reasons")
         )
 
+    def reset_streaks(self) -> None:
+        """Clear the consecutive-violation streaks (in-batch AND flagged), the
+        last-reasons/spatial memos, and the staleness clock — WITHOUT touching
+        the lifetime ``batches``/``violations`` totals.
+
+        Called on checkpoint restore, mesh reshard, and recovery rollback: the
+        restored state is a different trajectory, so a resumed run must not
+        inherit the crashed run's degraded streak (it used to, and could flip
+        /readyz to 503 on its first perfectly healthy batch)."""
+        with self._lock:
+            self._consecutive = 0
+            self._consecutive_flagged = 0
+            self._last_reasons = []
+            self._last_spatial = None
+            self._last_observe = time.monotonic()
+        self._gauge.set(1.0)
+
     # ---- state ----
 
     @property

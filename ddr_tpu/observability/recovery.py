@@ -1,0 +1,363 @@
+"""Self-healing training: the recovery supervisor and forcing validation.
+
+The numerical-health watchdog (:mod:`ddr_tpu.observability.health`) can
+*detect* a NaN solve, a bf16 overflow, parameter drift, or a stalled step —
+but detection alone is terminal: /readyz flips to 503 and the run keeps
+optimizing on poisoned state until a human intervenes. This module closes the
+loop: every watchdog violation becomes a bounded, deterministic recovery
+action chosen from an **escalation ladder** per violation class
+
+1. ``fp32-reroute`` — re-execute the batch from the pre-step snapshot with
+   the ``dtype="fp32"`` twin program, when the violation is bf16-specific
+   (``bf16-overflow`` / ``ulp-drift``) and the loop built the fp32 twin
+   (``DDR_TRAIN_DTYPE=bf16``). Both programs are built up front, so the
+   re-route adds zero new jit-cache entries on the hot path.
+2. ``skip`` — quarantine the offending batch: restore the pre-step parameter
+   snapshot and move on, recording the batch's identity on the ``recovery``
+   event.
+3. ``rollback`` — restore the last *pinned-good* checkpoint (the marker the
+   checkpoint writer refreshes only when the watchdog was healthy at save
+   time, :func:`ddr_tpu.training.pinned_good_checkpoint`), with optional
+   learning-rate backoff (``DDR_RECOVERY_LR_BACKOFF``).
+4. ``give-up`` — a clean preemption-style emergency save and a
+   :class:`RecoveryGiveUp`, once every ``DDR_RECOVERY_MAX_*`` budget is spent.
+
+The supervisor itself is pure host-side bookkeeping: it never touches jax, so
+it can never add jit-cache entries, and every decision is a deterministic
+function of the violation reasons and the remaining budgets — the same run
+replays the same recoveries.
+
+Forcing validation (:class:`ForcingValidator`) is the data-side half: a
+host-side non-finite / physical-range scan over each forcing batch inside the
+train loop's ``data_load`` phase, with the ``DDR_DATA_VALIDATE`` policy
+(``off`` | ``warn`` | ``quarantine``) deciding whether a bad tile is logged or
+never reaches the device at all. Findings emit a *bounded* ``data_anomaly``
+event stream (first :data:`ForcingValidator.MAX_EVENTS` per run; the rest are
+counted into the run_end rollup).
+
+Knobs (process-level, documented in docs/robustness.md "Self-healing
+training"): ``DDR_RECOVERY_ENABLED`` (default off — recovery snapshots the
+optimizer state before every step, a deliberate opt-in),
+``DDR_RECOVERY_MAX_SKIPS``, ``DDR_RECOVERY_MAX_REROUTES``,
+``DDR_RECOVERY_MAX_ROLLBACKS``, ``DDR_RECOVERY_LR_BACKOFF``,
+``DDR_DATA_VALIDATE``.
+
+Stdlib-only and jax-free (package contract; the validator's scan takes any
+ndarray-duck-typed batch and imports nothing to do it).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "RECOVERY_STAGES",
+    "REROUTE_REASONS",
+    "RecoveryConfig",
+    "RecoveryGiveUp",
+    "RecoverySupervisor",
+    "ForcingValidator",
+]
+
+_FALSEY = ("", "0", "false", "no", "off")
+
+#: The escalation ladder, in order. ``decide`` only ever walks DOWN this list.
+RECOVERY_STAGES = ("fp32-reroute", "skip", "rollback", "give-up")
+
+#: Violation reasons that are artifacts of the bf16 history ring rather than
+#: of the state itself — the only class where re-running the same batch in
+#: fp32 can succeed where the bf16 program failed.
+REROUTE_REASONS = ("bf16-overflow", "ulp-drift")
+
+
+class RecoveryGiveUp(RuntimeError):
+    """Raised by the train loop once the supervisor's budgets are exhausted —
+    after the emergency save landed. A distinct type so callers/tests can tell
+    a deliberate, state-preserving stop from a crash."""
+
+
+@dataclass(frozen=True)
+class RecoveryConfig:
+    """Budgets for the escalation ladder. Defaults < ``DDR_RECOVERY_*``
+    environment < explicit overrides (the HealthConfig convention)."""
+
+    #: Master switch (DDR_RECOVERY_ENABLED; default off). When on, the train
+    #: loop snapshots params/opt_state before every step so stage ``skip``
+    #: can restore them — that copy is the feature's whole steady-state cost.
+    enabled: bool = False
+    #: Per-run quarantined-batch budget (DDR_RECOVERY_MAX_SKIPS).
+    max_skips: int = 4
+    #: Per-run fp32 re-execution budget (DDR_RECOVERY_MAX_REROUTES).
+    max_reroutes: int = 2
+    #: Per-run pinned-good rollback budget (DDR_RECOVERY_MAX_ROLLBACKS).
+    max_rollbacks: int = 1
+    #: Learning-rate multiplier applied on each rollback
+    #: (DDR_RECOVERY_LR_BACKOFF; 1.0 = keep the LR).
+    lr_backoff: float = 0.5
+
+    def __post_init__(self) -> None:
+        for name in ("max_skips", "max_reroutes", "max_rollbacks"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0, got {getattr(self, name)}")
+        if not 0.0 < self.lr_backoff <= 1.0:
+            raise ValueError(f"lr_backoff must be in (0, 1], got {self.lr_backoff}")
+
+    @classmethod
+    def from_env(cls, environ: dict | None = None, **overrides) -> "RecoveryConfig":
+        env = os.environ if environ is None else environ
+
+        def _get(name: str, cast):
+            raw = env.get(name)
+            if raw is None or raw == "":
+                return None
+            try:
+                return cast(raw)
+            except ValueError as e:
+                raise ValueError(f"bad {name}={raw!r}: {e}") from e
+
+        from_env: dict = {}
+        for key, var, cast in (
+            ("enabled", "DDR_RECOVERY_ENABLED",
+             lambda s: s.strip().lower() not in _FALSEY),
+            ("max_skips", "DDR_RECOVERY_MAX_SKIPS", int),
+            ("max_reroutes", "DDR_RECOVERY_MAX_REROUTES", int),
+            ("max_rollbacks", "DDR_RECOVERY_MAX_ROLLBACKS", int),
+            ("lr_backoff", "DDR_RECOVERY_LR_BACKOFF", float),
+        ):
+            v = _get(var, cast)
+            if v is not None:
+                from_env[key] = v
+        from_env.update(overrides)
+        return cls(**from_env)
+
+
+class RecoverySupervisor:
+    """The escalation-ladder state machine the train loop consults.
+
+    Two-phase protocol so the loop can escalate when a stage fails:
+    :meth:`decide` is a pure read of (reasons, budgets) -> stage name;
+    :meth:`record` commits the stage the loop actually executed — spends its
+    budget, remembers the quarantined batch identity, and emits the one
+    ``recovery`` telemetry event. A failed fp32 re-route therefore calls
+    ``decide`` again with ``fp32_available=False`` and walks down the ladder.
+
+    Thread-safe for the same reason the watchdog is, though the train loop
+    drives it from one thread in practice.
+    """
+
+    #: Quarantined-batch identities kept for the run_end rollup (bounded —
+    #: a pathological run must not grow an unbounded list).
+    MAX_QUARANTINE = 64
+
+    def __init__(self, config: RecoveryConfig | None = None) -> None:
+        self.config = config or RecoveryConfig.from_env()
+        self._lock = threading.Lock()
+        self._counts = {stage: 0 for stage in RECOVERY_STAGES}
+        self._quarantined: list[dict[str, Any]] = []
+
+    def decide(
+        self,
+        reasons: list[str],
+        *,
+        fp32_available: bool = False,
+        rollback_available: bool = False,
+    ) -> str:
+        """Pick the next ladder stage for one violating batch (pure: spends
+        nothing — :meth:`record` commits)."""
+        with self._lock:
+            counts = dict(self._counts)
+        cfg = self.config
+        bf16_only = bool(reasons) and all(r in REROUTE_REASONS for r in reasons)
+        if bf16_only and fp32_available and counts["fp32-reroute"] < cfg.max_reroutes:
+            return "fp32-reroute"
+        if counts["skip"] < cfg.max_skips:
+            return "skip"
+        if rollback_available and counts["rollback"] < cfg.max_rollbacks:
+            return "rollback"
+        return "give-up"
+
+    def record(self, stage: str, reasons: list[str], **context: Any) -> None:
+        """Commit one executed stage: spend its budget, quarantine the batch
+        identity (skip stages), emit the ``recovery`` event, log."""
+        if stage not in RECOVERY_STAGES:
+            raise ValueError(f"unknown recovery stage {stage!r}")
+        with self._lock:
+            self._counts[stage] += 1
+            if stage == "skip" and len(self._quarantined) < self.MAX_QUARANTINE:
+                self._quarantined.append(
+                    {k: context[k] for k in ("epoch", "batch") if k in context}
+                )
+        payload = {
+            "stage": stage,
+            "reasons": list(reasons),
+            **{k: v for k, v in context.items() if _plain(v)},
+        }
+        log.warning(
+            "recovery: %s (%s) %s", stage, ", ".join(reasons) or "-",
+            " ".join(f"{k}={v}" for k, v in payload.items()
+                     if k not in ("stage", "reasons")),
+        )
+        try:
+            from ddr_tpu.observability.events import get_recorder
+
+            rec = get_recorder()
+            if rec is not None:
+                rec.emit("recovery", **payload)
+        except Exception:  # telemetry must never mask the recovery itself
+            log.exception("could not record recovery event")
+
+    def count(self, stage: str) -> int:
+        with self._lock:
+            return self._counts[stage]
+
+    @property
+    def recoveries(self) -> int:
+        """Total committed stages (the drill's per-fault floor)."""
+        with self._lock:
+            return sum(self._counts.values())
+
+    def summary(self) -> dict[str, Any]:
+        """Rollup for ``merge_summary("recovery", ...)`` on run_end."""
+        with self._lock:
+            return {
+                "enabled": self.config.enabled,
+                "counts": dict(self._counts),
+                "quarantined": [dict(q) for q in self._quarantined],
+            }
+
+
+# ---------------------------------------------------------------------------
+# Forcing validation (the data_load-phase scan).
+# ---------------------------------------------------------------------------
+
+_POLICIES = ("off", "warn", "quarantine")
+
+
+class ForcingValidator:
+    """Host-side sanity scan over each assembled forcing batch.
+
+    Runs inside the existing ``data_load`` step phase (prefetch thread) so a
+    bad tile is caught before the device ever sees it. :meth:`scan` is pure
+    (safe off the main thread); :meth:`note` — called from the train loop —
+    emits the bounded ``data_anomaly`` event and answers what the policy says
+    to do with the batch (``"warn"``: train on it anyway, ``"quarantine"``:
+    drop it).
+    """
+
+    #: Physical ceiling for a lateral-inflow value (m^3/s). The largest
+    #: observed river discharge on Earth is O(1e5); anything past this is a
+    #: corrupt tile, not hydrology.
+    MAX_RUNOFF = 1.0e7
+    #: Small negative tolerance: spectral/NN runoff generators can undershoot
+    #: zero by numerical noise; genuinely negative inflow is an anomaly.
+    MIN_RUNOFF = -1.0
+    #: ``data_anomaly`` events emitted per run before suppression kicks in
+    #: (suppressed findings still count into the run_end rollup).
+    MAX_EVENTS = 32
+
+    def __init__(self, policy: str | None = None) -> None:
+        if policy is None:
+            policy = os.environ.get("DDR_DATA_VALIDATE", "off")
+        policy = (policy or "off").strip().lower() or "off"
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"bad DDR_DATA_VALIDATE={policy!r} (want one of {', '.join(_POLICIES)})"
+            )
+        self.policy = policy
+        self.enabled = policy != "off"
+        self._lock = threading.Lock()
+        self._batches = 0
+        self._anomalies = 0
+        self._quarantined = 0
+        self._emitted = 0
+        self._suppressed = 0
+
+    def scan(self, q_prime: Any, **identity: Any) -> dict[str, Any] | None:
+        """Scan one forcing batch -> anomaly descriptor, or None when clean
+        (or validation is off). Duck-typed over the ndarray API so this module
+        needs no numpy import; the comparisons below are vectorized C loops
+        either way."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._batches += 1
+        finite = _isfinite(q_prime)
+        n_nonfinite = int(q_prime.size - finite.sum())
+        # range check only over the finite entries (NaN comparisons are False
+        # anyway, but inf > MAX would double-count the non-finites)
+        in_range = (q_prime >= self.MIN_RUNOFF) & (q_prime <= self.MAX_RUNOFF)
+        n_out = int((finite & ~in_range).sum())
+        if not n_nonfinite and not n_out:
+            return None
+        with self._lock:
+            self._anomalies += 1
+        return {
+            "nonfinite": n_nonfinite,
+            "out_of_range": n_out,
+            "size": int(q_prime.size),
+            "policy": self.policy,
+            **{k: v for k, v in identity.items() if _plain(v)},
+        }
+
+    def note(self, anomaly: dict[str, Any]) -> str:
+        """Record one scan finding from the train loop: emit the bounded
+        ``data_anomaly`` event and return the policy's verdict for the batch
+        (``"warn"`` or ``"quarantine"``)."""
+        with self._lock:
+            if self._emitted < self.MAX_EVENTS:
+                self._emitted += 1
+                emit = True
+            else:
+                self._suppressed += 1
+                emit = False
+            if self.policy == "quarantine":
+                self._quarantined += 1
+        log.warning(
+            "forcing anomaly (%s): %s", self.policy,
+            " ".join(f"{k}={v}" for k, v in anomaly.items() if k != "policy"),
+        )
+        if emit:
+            try:
+                from ddr_tpu.observability.events import get_recorder
+
+                rec = get_recorder()
+                if rec is not None:
+                    rec.emit("data_anomaly", **anomaly)
+            except Exception:
+                log.exception("could not record data_anomaly event")
+        return "quarantine" if self.policy == "quarantine" else "warn"
+
+    def summary(self) -> dict[str, Any]:
+        """Rollup for ``merge_summary("data_validate", ...)`` on run_end."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "batches": self._batches,
+                "anomalies": self._anomalies,
+                "quarantined": self._quarantined,
+                "events_suppressed": self._suppressed,
+            }
+
+
+def _isfinite(arr: Any) -> Any:
+    """Elementwise finiteness without importing numpy: finite <=> the value
+    minus itself is 0 (NaN/inf propagate). Works on any ndarray duck type.
+    ``inf - inf`` legitimately hits the invalid-value path, so the expected
+    RuntimeWarning is silenced (stdlib ``warnings``, keeping the module
+    numpy-free)."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        diff = arr - arr
+    return diff == diff
+
+
+def _plain(v: Any) -> bool:
+    return isinstance(v, (bool, int, float, str)) or v is None

@@ -289,10 +289,25 @@ class ParallelTrainer:
         """Re-place a restored checkpoint state pytree onto THIS trainer's
         mesh per the checkpoint's saved per-leaf ``plan``
         (:func:`ddr_tpu.parallel.sharding.reshard_state`) — the elastic-resume
-        hook for a checkpoint saved under a different device layout."""
+        hook for a checkpoint saved under a different device layout, and the
+        recovery supervisor's rollback hook (the pinned-good checkpoint may
+        predate a mesh transition; docs/robustness.md "Self-healing
+        training")."""
         from ddr_tpu.parallel.sharding import reshard_state
 
         return reshard_state(state, self.mesh, plan=plan)
+
+    def snapshot_state(self, params: Any, opt_state: Any) -> tuple[Any, Any]:
+        """Donation-safe copies of ``(params, opt_state)``, each leaf keeping
+        its current sharding — the recovery supervisor's pre-step snapshot.
+        Every built step donates its state arguments, so without this copy a
+        violating update leaves nothing to restore. Device-to-device: no host
+        round-trip, and no effect on any step cache."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: x.copy() if hasattr(x, "copy") else x, (params, opt_state)
+        )
 
     @property
     def _gspmd_step(self):

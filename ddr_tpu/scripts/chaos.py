@@ -61,6 +61,24 @@ log = logging.getLogger(__name__)
 #: worked, resuming twice proves the RESUMED state saves correctly too.
 DEFAULT_KILLS = (1, 2)
 
+#: The ``--nan-storm`` fault plan: one non-finite storm at each of the three
+#: nan sites, each answered by a DIFFERENT layer of the self-healing stack
+#: (docs/robustness.md "Self-healing training"). The synthetic drill config
+#: yields 4 mini-batches per epoch, so with batch 1 quarantined the executed
+#: steps are 0, 1 (mini-batch 2), 2 (mini-batch 3):
+#:
+#: - ``data.forcings`` at prefetch call 1 (mini-batch 1): caught HOST-side by
+#:   the ``DDR_DATA_VALIDATE=quarantine`` scan — the tile never reaches the
+#:   device; the drop is a ladder ``skip``.
+#: - ``device.step`` at executed step 1: the device routes non-finite inflow;
+#:   the watchdog's ``non-finite`` gate trips and the supervisor restores the
+#:   pre-step snapshot.
+#: - ``device.grads`` at executed step 2: the synchronized grad norm goes
+#:   non-finite AFTER the update applied — the snapshot-restore proof.
+DEFAULT_NAN_STORM = (
+    "nan@data.forcings=1:n=1;nan@device.step=1:n=1;nan@device.grads=2:n=1"
+)
+
 
 def _emit_chaos(**payload: Any) -> None:
     from ddr_tpu.observability import get_recorder
@@ -423,6 +441,141 @@ def run_chaos_train(args) -> dict[str, Any]:
     }
 
 
+def run_chaos_nan_storm(args) -> dict[str, Any]:
+    """Self-healing drill (no kills): a golden run, then a faulted twin with
+    ``DDR_FAULTS`` injecting one non-finite storm at each nan site
+    (:data:`DEFAULT_NAN_STORM`). The twin must finish cleanly (rc 0), answer
+    every storm with at least one ``recovery`` event, keep its compile count
+    flat (the recovery fast path may not add jit-cache entries), and land its
+    final params within ``--tolerance`` of the golden run's."""
+    if getattr(args, "reshard", None):
+        raise SystemExit("--nan-storm and --reshard are separate drills")
+    # epoch 1 absorbs the storms; epoch 2 is the clean rejoin the params
+    # comparison scores — one epoch would end the run ON a recovery
+    args.epochs = max(args.epochs, 2)
+    workdir = Path(args.out) / f"chaos_train_{args.label}"
+    workdir.mkdir(parents=True, exist_ok=True)
+    env = _subprocess_env(workdir)
+    # the self-healing stack is armed IDENTICALLY in both runs — recovery on
+    # a clean run must be a numeric no-op, and an identical environment keeps
+    # the golden trajectory an honest reference
+    env["DDR_RECOVERY_ENABLED"] = "1"
+    env["DDR_DATA_VALIDATE"] = "quarantine"
+    env.setdefault("DDR_HEALTH_ENABLED", "1")
+    faults = DEFAULT_NAN_STORM
+    if getattr(args, "tolerance", None) is None:
+        # recovery deliberately DROPS whole updates the golden run applied
+        # (skip-and-quarantine is the feature), so the gate is "rejoined the
+        # golden basin by the end of the clean epoch", not bit-exactness
+        # (measured ~0.065 on the default synthetic config)
+        args.tolerance = 0.1
+
+    import yaml
+
+    # ---- golden: recovery armed, nothing to recover from ----
+    golden_dir = workdir / "golden"
+    golden_cfg = workdir / "golden.yaml"
+    golden_cfg.write_text(yaml.safe_dump(_train_cfg_dict(golden_dir, None, args)))
+    log.info(f"chaos nan-storm: golden run -> {golden_dir}")
+    proc = _launch(["train", str(golden_cfg)], env, workdir / "golden.out")
+    rc = proc.wait(timeout=args.timeout)
+    golden_events = _read_jsonl(golden_dir / "run_log.train.jsonl")
+    golden_steps = _step_losses(golden_events)
+    if rc != 0 or not golden_steps:
+        raise RuntimeError(
+            f"golden training run failed (rc={rc}, {len(golden_steps)} steps) — "
+            f"see {workdir / 'golden.out'}"
+        )
+
+    # ---- the storm: same config + DDR_FAULTS, one process, no kills ----
+    chaos_dir = workdir / "chaos"
+    chaos_cfg = workdir / "chaos.yaml"
+    chaos_cfg.write_text(yaml.safe_dump(_train_cfg_dict(chaos_dir, None, args)))
+    chaos_env = dict(env)
+    chaos_env["DDR_FAULTS"] = faults
+    log.info(f"chaos nan-storm: faulted run -> {chaos_dir} ({faults})")
+    _emit_chaos(mode="train", action="nan-storm", faults=faults)
+    proc = _launch(["train", str(chaos_cfg)], chaos_env, workdir / "chaos_1.out")
+    rc = proc.wait(timeout=args.timeout)
+    events = _read_jsonl(chaos_dir / "run_log.train.jsonl")
+    chaos_steps = _step_losses(events)
+
+    def _count(evts: list[dict], kind: str) -> int:
+        return sum(1 for e in evts if e.get("event") == kind)
+
+    fault_events = _count(events, "fault")
+    recoveries = [e for e in events if e.get("event") == "recovery"]
+    stages: dict[str, int] = {}
+    for e in recoveries:
+        stages[str(e.get("stage"))] = stages.get(str(e.get("stage")), 0) + 1
+    # flat compile count: every jit-cache entry the single-path tracker saw
+    # grow emits one `compile` event — recovery must not add any (quarantine
+    # can only SUBTRACT a batch, so <= is the right bound)
+    compile_golden = _count(golden_events, "compile")
+    compile_chaos = _count(events, "compile")
+
+    import math
+
+    finite_losses = [v for _, v in sorted(chaos_steps.items()) if math.isfinite(v)]
+    final_loss = finite_losses[-1] if finite_losses else None
+
+    from ddr_tpu.training import latest_checkpoint, load_state
+
+    params_delta = float("inf")
+    g_ckpt, c_ckpt = (
+        latest_checkpoint(golden_dir / "saved_models"),
+        latest_checkpoint(chaos_dir / "saved_models"),
+    )
+    if g_ckpt is not None and c_ckpt is not None:
+        import numpy as np
+
+        import jax
+
+        g_leaves = jax.tree_util.tree_leaves(load_state(g_ckpt)["params"])
+        c_leaves = jax.tree_util.tree_leaves(load_state(c_ckpt)["params"])
+        params_delta = max(
+            (float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(g_leaves, c_leaves)),
+            default=0.0,
+        )
+
+    n_clauses = len([c for c in faults.split(";") if c.strip()])
+    passed = (
+        rc == 0
+        and fault_events == n_clauses
+        and len(recoveries) >= fault_events
+        and final_loss is not None
+        and params_delta <= args.tolerance
+        and compile_chaos <= compile_golden
+    )
+    return {
+        "kind": "chaos",
+        "schema_version": 1,
+        "mode": "train",
+        "label": args.label,
+        "device": _device_platform(),
+        "signal": None,
+        "reshard": None,
+        "nan_storm": True,
+        "faults": faults,
+        "fault_events": fault_events,
+        "recovery_events": len(recoveries),
+        "recovery_stages": stages,
+        "rollbacks": stages.get("rollback", 0),
+        "data_anomalies": _count(events, "data_anomaly"),
+        "steps_golden": len(golden_steps),
+        "steps_chaos": len(chaos_steps),
+        "compile_events_golden": compile_golden,
+        "compile_events_chaos": compile_chaos,
+        "final_loss": round(final_loss, 6) if final_loss is not None else None,
+        "params_max_abs_delta": (
+            None if params_delta == float("inf") else round(params_delta, 9)
+        ),
+        "tolerance": args.tolerance,
+        "passed": passed,
+    }
+
+
 def _device_platform() -> str | None:
     jax = sys.modules.get("jax")
     if jax is None:
@@ -601,6 +754,22 @@ def render_summary(report: dict[str, Any]) -> str:
         + ("PASSED" if report.get("passed") else "FAILED")
     ]
     if report["mode"] == "train":
+        if report.get("nan_storm"):
+            lines.append(
+                f"  storm    {report.get('fault_events')} injected fault(s) -> "
+                f"{report.get('recovery_events')} recovery action(s) "
+                f"{report.get('recovery_stages')}"
+            )
+            lines.append(
+                f"  rejoin   params {report.get('params_max_abs_delta')} "
+                f"(tolerance {report.get('tolerance')}), final loss "
+                f"{report.get('final_loss')}"
+            )
+            lines.append(
+                f"  compiles golden {report.get('compile_events_golden')} / "
+                f"chaos {report.get('compile_events_chaos')}"
+            )
+            return "\n".join(lines)
         if report.get("reshard"):
             lines.append(
                 f"  reshard  {report['reshard']} devices — "
@@ -663,6 +832,12 @@ def main(argv: list[str] | None = None) -> int:
                          "carries inherent ~1e-3 float drift)")
     p_train.add_argument("--timeout", type=float, default=600.0,
                          help="per-subprocess wall ceiling, seconds")
+    p_train.add_argument("--nan-storm", action="store_true", dest="nan_storm",
+                         help="self-healing drill instead of kill/resume: inject "
+                         "one non-finite storm at each nan fault site and require "
+                         "a recovery event per storm, a flat compile count, and a "
+                         "final-params rejoin within --tolerance (default 0.1; "
+                         "runs at least 2 epochs so the clean epoch can rejoin)")
 
     p_serve = sub.add_parser("serve", help="kill/restart a serving replica under load")
     p_serve.add_argument("--synthetic", action="store_true",
@@ -707,7 +882,9 @@ def main(argv: list[str] | None = None) -> int:
     from ddr_tpu.observability import run_telemetry
 
     with run_telemetry(None, "chaos", base_dir=str(out_dir), mode=args.mode):
-        if args.mode == "train":
+        if args.mode == "train" and getattr(args, "nan_storm", False):
+            report = run_chaos_nan_storm(args)
+        elif args.mode == "train":
             report = run_chaos_train(args)
         else:
             report = run_chaos_serve(args)

@@ -65,6 +65,11 @@ variables. Families with their own reference tables are linked.
   `DDR_IO_RETRY_BACKOFF_S`, `DDR_FAULTS` / `DDR_FAULTS_SEED` — robustness:
   checkpointing, elastic resume & resharding, remote-read retries, fault
   injection: see docs/robustness.md.
+- `DDR_RECOVERY_*` (enable + skip/reroute/rollback budgets + LR backoff),
+  `DDR_DATA_VALIDATE` (`off` \\| `warn` \\| `quarantine` forcing validation),
+  `DDR_TRAIN_DTYPE` (`fp32` \\| `bf16` train-step routing dtype; `bf16` also
+  builds the fp32 re-route twin when recovery is on) — self-healing training:
+  see docs/robustness.md "Self-healing training".
 - `DDR_DISTRIBUTED`, `DDR_NUM_PROCESSES`, `DDR_PROCESS_ID`,
   `DDR_COORDINATOR` — multi-process (multi-host) bootstrap consumed by
   `ddr_tpu.parallel.distributed` before jax initializes; see docs/tpu.md.

@@ -6,6 +6,7 @@ and Adam update are one compiled XLA program per network shape).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 from pathlib import Path
@@ -18,6 +19,7 @@ from ddr_tpu.geodatazoo.loader import DataLoader, prefetch
 from ddr_tpu.observability import (
     CompileTracker,
     PhaseTimer,
+    RecoveryGiveUp,
     Throughput,
     build_card,
     emit_heartbeat,
@@ -44,6 +46,7 @@ from ddr_tpu.training import (
     load_state,
     make_batch_train_step,
     make_optimizer,
+    pinned_good_checkpoint,
     prune_checkpoints_from_env,
     save_state,
     save_state_orbax,
@@ -166,8 +169,44 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
         if rec is not None
         else None
     )
+    # Self-healing recovery (docs/robustness.md "Self-healing training"):
+    # DDR_RECOVERY_ENABLED turns every watchdog violation into one bounded
+    # escalation-ladder stage (fp32-reroute -> skip -> rollback -> give-up).
+    # The supervisor consumes the watchdog's violation reasons, so it rides
+    # health_on; without a watchdog there is nothing to recover FROM.
+    from ddr_tpu.observability.recovery import (
+        ForcingValidator,
+        RecoveryConfig,
+        RecoverySupervisor,
+    )
+
+    recovery_cfg = RecoveryConfig.from_env()
+    supervisor = (
+        RecoverySupervisor(recovery_cfg)
+        if (recovery_cfg.enabled and watchdog is not None)
+        else None
+    )
+    # Forcing validation (DDR_DATA_VALIDATE=off|warn|quarantine): host-side
+    # non-finite / physical-range scan over every assembled forcing batch in
+    # the data_load phase. Independent of the supervisor — warn-and-train
+    # works standalone; quarantine drops the batch before the device sees it.
+    validator = ForcingValidator()
+    if not validator.enabled:
+        validator = None
+
+    # Training compute dtype (DDR_TRAIN_DTYPE=fp32|bf16): the routing ring's
+    # bf16-compute/fp32-accumulate axis (docs/tpu.md), selectable for
+    # `ddr train` itself. With bf16 AND recovery on, the fp32 TWIN program is
+    # built up front from identical builder kwargs, so a bf16-specific
+    # violation (bf16-overflow / ulp-drift) can re-execute the same batch in
+    # fp32 without adding a single jit-cache entry mid-run.
+    train_dtype = (os.environ.get("DDR_TRAIN_DTYPE", "fp32") or "fp32").strip().lower()
+    if train_dtype not in ("fp32", "bf16"):
+        log.warning(f"ignoring unknown DDR_TRAIN_DTYPE={train_dtype!r} (want fp32|bf16)")
+        train_dtype = "fp32"
 
     par = None
+    step_fp32 = None
     if cfg.experiment.parallel != "none":
         # Multi-chip path (experiment.parallel=gspmd|sharded-wavefront|
         # stacked-sharded over the device/mesh `device` selects): per-batch
@@ -178,12 +217,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
         par = ParallelTrainer(cfg, kan_model, optimizer, collect_health=health_on)
         step = None
     else:
-        step = make_batch_train_step(
-            kan_model,
-            Bounds.from_config(cfg.params.attribute_minimums),
-            cfg.params.parameter_ranges,
-            cfg.params.log_space_parameters,
-            cfg.params.defaults,
+        step_kwargs = dict(
             tau=cfg.params.tau,
             warmup=cfg.experiment.warmup,
             optimizer=optimizer,
@@ -197,6 +231,28 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             # wavefront batches (wf-hoist fast path; one shared predicate)
             q_prime_wf_permuted=True,
         )
+        step = make_batch_train_step(
+            kan_model,
+            Bounds.from_config(cfg.params.attribute_minimums),
+            cfg.params.parameter_ranges,
+            cfg.params.log_space_parameters,
+            cfg.params.defaults,
+            dtype=train_dtype,
+            **step_kwargs,
+        )
+        if train_dtype == "bf16" and supervisor is not None:
+            # the dual-dtype escape hatch: same builder, dtype="fp32" — the
+            # supervisor's stage-1 re-route target (never built on fp32 runs,
+            # where the ladder starts at `skip`)
+            step_fp32 = make_batch_train_step(
+                kan_model,
+                Bounds.from_config(cfg.params.attribute_minimums),
+                cfg.params.parameter_ranges,
+                cfg.params.log_space_parameters,
+                cfg.params.defaults,
+                dtype="fp32",
+                **step_kwargs,
+            )
 
     # Elastic resume (docs/robustness.md "Elastic resume & resharding"): every
     # checkpoint records the mesh it was saved under; when this run's layout
@@ -228,6 +284,12 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             params, opt_state = state["params"], state["opt_state"]
         if mismatch:
             reset_plan_cache()
+            if watchdog is not None:
+                # the consecutive-violation streaks and spatial memo describe
+                # the PREVIOUS incarnation's batches — a resharded resume must
+                # not inherit a half-spent bad_batches budget (or a stale
+                # worst-band slice) across the mesh transition
+                watchdog.reset_streaks()
             log.warning(
                 f"checkpoint {ckpt.name} was saved on "
                 f"{meta['mesh'].get('n_devices')} device(s), this run has "
@@ -254,6 +316,13 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
     inject_data_load = fault_site("data.load")
     inject_device_step = fault_site("device.step")
+    # nan-storm sites (docs/robustness.md): `data.forcings` poisons the
+    # assembled forcing batch BEFORE the data_load validation scan (exercises
+    # the quarantine policy); `device.grads` poisons the host-synchronized
+    # grad norm AFTER the update applied (exercises the snapshot-restore
+    # skip). Both host-side, like every injection point.
+    inject_data_forcings = fault_site("data.forcings")
+    inject_device_grads = fault_site("device.grads")
     # Step-phase wallclock decomposition (docs/observability.md "Cost
     # attribution & profiling"): each loop bucket lands on the step event's
     # `phases` dict and in the run_end rollup; the Prometheus tee exports the
@@ -319,6 +388,13 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     preempt = PreemptionHandler()
     preempt.__enter__()
 
+    def _healthy() -> bool | None:
+        # pinned-good input (docs/robustness.md): the watchdog's degraded flag
+        # AT SAVE-REQUEST TIME decides whether a checkpoint may become the
+        # rollback target / a serving hot-load. None (unknown) without a
+        # watchdog — the pinned-good marker then simply never refreshes.
+        return (not watchdog.degraded) if watchdog is not None else None
+
     def _preempt_save(epoch: int, batch: int) -> None:
         if ckpt_writer is not None:
             ckpt_writer.drain()
@@ -338,6 +414,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 rng_state=loader.state(),
                 arch=kan_arch(cfg),
                 mesh=par_mesh,
+                healthy=_healthy(),
             )
         elif is_primary:
             save_fn = save_state_orbax if ckpt_fmt == "orbax" else save_state
@@ -351,6 +428,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 rng_state=loader.state(),
                 arch=kan_arch(cfg),
                 mesh=par_mesh,
+                healthy=_healthy(),
             )
         if path is not None:
             log.warning(f"preemption ({preempt.reason}): emergency checkpoint {path}")
@@ -365,6 +443,138 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 step=n_done,
                 mesh=mesh_descriptor(par_mesh),
             )
+
+    def _giveup_save(epoch: int, batch: int) -> None:
+        # ladder stage 4: the same drain-then-one-save discipline as a
+        # preemption, under "<name>-giveup" and explicitly healthy=False —
+        # resumable via experiment.checkpoint (a human decision), but never a
+        # rollback target and never hot-loaded by the serving watcher.
+        if ckpt_writer is not None:
+            ckpt_writer.drain()
+        path = None
+        if multiprocess:
+            path = save_state_orbax(
+                ckpt_dir, f"{cfg.name}-giveup", epoch, batch, params, opt_state,
+                rng_state=loader.state(), arch=kan_arch(cfg), mesh=par_mesh,
+                healthy=False,
+            )
+        elif is_primary:
+            save_fn = save_state_orbax if ckpt_fmt == "orbax" else save_state
+            path = save_fn(
+                ckpt_dir, f"{cfg.name}-giveup", epoch, batch, params, opt_state,
+                rng_state=loader.state(), arch=kan_arch(cfg), mesh=par_mesh,
+                healthy=False,
+            )
+        if path is not None:
+            log.error(f"recovery budgets exhausted: emergency checkpoint {path}")
+
+    def _recover(reasons, backup, payload, attrs, obs_daily, obs_mask, out, epoch, batch):
+        """One escalation-ladder pass for a violating batch; returns
+        (params, opt_state, loss, daily, stage).
+
+        Two-phase protocol: ``supervisor.decide`` is a pure read, the stage
+        actually executed is committed with ``supervisor.record`` (budget +
+        quarantine identity + the ``recovery`` event) — so a violating fp32
+        re-run escalates by calling ``decide`` again with
+        ``fp32_available=False`` and walking down the ladder. Raises
+        :class:`RecoveryGiveUp` after the stage-4 emergency save."""
+        from ddr_tpu.observability.recovery import RecoveryGiveUp
+
+        _, _, loss, daily = out
+        b_params, b_opt = backup
+        stage = supervisor.decide(
+            reasons,
+            fp32_available=step_fp32 is not None,
+            rollback_available=pinned_good_checkpoint(ckpt_dir) is not None,
+        )
+        if stage == "fp32-reroute":
+            # re-execute the SAME batch with the fp32 twin from the pre-step
+            # snapshot. The twin donates its state arguments like the primary
+            # program, so it eats fresh COPIES — `backup` must survive for
+            # the skip stage should fp32 violate too.
+            q_prime, network, channels, gauges = payload
+            c_params, c_opt = jax.tree_util.tree_map(
+                lambda x: x.copy() if hasattr(x, "copy") else x, (b_params, b_opt)
+            )
+            p2, o2, loss2, daily2, h2 = step_fp32(
+                c_params, c_opt, network, channels, gauges, attrs, q_prime,
+                jnp.asarray(obs_daily), jnp.asarray(obs_mask),
+            )
+            reroute_reasons = watchdog.check(h2)
+            supervisor.record(
+                "fp32-reroute", reasons, epoch=epoch, batch=batch,
+                outcome="clean" if not reroute_reasons else "violated",
+            )
+            if not reroute_reasons:
+                watchdog.reset_streaks()
+                return p2, o2, float(loss2), np.asarray(daily2), "fp32-reroute"
+            # fp32 violated too: not a precision artifact — walk down
+            reasons = reroute_reasons
+            stage = supervisor.decide(
+                reasons, fp32_available=False,
+                rollback_available=pinned_good_checkpoint(ckpt_dir) is not None,
+            )
+        if stage == "skip":
+            # quarantine the batch: the bad update never happened (the
+            # snapshot predates the step) and the loop moves on
+            supervisor.record("skip", reasons, epoch=epoch, batch=batch, step=n_done)
+            watchdog.reset_streaks()
+            return b_params, b_opt, loss, daily, "skip"
+        if stage == "rollback":
+            pinned = pinned_good_checkpoint(ckpt_dir)
+            try:
+                if pinned.is_dir():
+                    from ddr_tpu.training import load_state_orbax
+
+                    # the pre-step snapshot is the exact structural template
+                    blob = load_state_orbax(
+                        pinned, expected_arch=kan_arch(cfg),
+                        target={"params": b_params, "opt_state": b_opt},
+                    )
+                else:
+                    blob = load_state(pinned, expected_arch=kan_arch(cfg))
+                r_params, r_opt = blob["params"], blob["opt_state"]
+                if par is not None:
+                    # re-place for the current mesh (the pinned checkpoint may
+                    # predate a reshard; gspmd refuses mixed placements)
+                    state = par.reshard(
+                        {"params": r_params, "opt_state": r_opt},
+                        plan=blob.get("sharding"),
+                    )
+                    r_params, r_opt = state["params"], state["opt_state"]
+                else:
+                    # pickle blobs carry numpy leaves; feeding those into the
+                    # jitted step would compile a SECOND cache entry next to
+                    # the device-array one (the device_params lesson) — place
+                    # them before the next dispatch
+                    r_params = jax.tree_util.tree_map(jnp.asarray, r_params)
+                    r_opt = jax.tree_util.tree_map(jnp.asarray, r_opt)
+                backoff = supervisor.config.lr_backoff
+                if backoff < 1.0:
+                    try:
+                        cur = float(np.asarray(r_opt[1].hyperparams["learning_rate"]))
+                        r_opt = set_learning_rate(r_opt, cur * backoff)
+                        log.warning(f"rollback LR backoff: {cur:g} -> {cur * backoff:g}")
+                    except Exception:
+                        log.exception("LR backoff failed; continuing at the restored LR")
+                supervisor.record(
+                    "rollback", reasons, epoch=epoch, batch=batch,
+                    checkpoint=pinned.name, lr_backoff=backoff,
+                )
+                watchdog.reset_streaks()
+                # NO loader rewind: rollback restores STATE, the stream keeps
+                # going — deterministic and bounded, at the cost of the
+                # rolled-past batches contributing once from older params
+                return r_params, r_opt, loss, daily, "rollback"
+            except Exception:
+                log.exception(f"rollback checkpoint {pinned} unloadable; giving up")
+                stage = "give-up"
+        supervisor.record("give-up", reasons, epoch=epoch, batch=batch)
+        _giveup_save(epoch, batch)
+        raise RecoveryGiveUp(
+            f"recovery budgets exhausted at epoch {epoch} mini-batch {batch} "
+            f"({', '.join(reasons)})"
+        )
 
     # try/finally so the aggregate summary survives every exit path, including the
     # KeyboardInterrupt that main() treats as a normal way to end a long run.
@@ -395,12 +605,22 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 # thread's device_step/eval/checkpoint brackets.
                 i, rd = item
                 phase_s: dict[str, float] = {}
+                anomaly = None
                 with phase_timer.phase("data_load", into=phase_s):
                     if inject_data_load is not None:
                         inject_data_load(epoch=epoch, batch=i)
                     q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
                     if rd.flow_scale is not None:
                         q_prime = q_prime * np.asarray(rd.flow_scale, dtype=np.float32)[None, :]
+                    if inject_data_forcings is not None:
+                        # nan-storm site: poison the assembled batch BEFORE
+                        # the validation scan — the drill's proof that a bad
+                        # tile is caught on the host, not on the device
+                        q_prime = inject_data_forcings(q_prime, epoch=epoch, batch=i)
+                    if validator is not None:
+                        # pure scan here (prefetch thread); the policy verdict
+                        # + bounded data_anomaly event land on the main thread
+                        anomaly = validator.scan(q_prime, epoch=epoch, batch=i)
                     obs_daily, obs_mask = daily_observation_targets(rd)
                 with phase_timer.phase("host_prep", into=phase_s):
                     if par is not None:
@@ -418,13 +638,41 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             q_prime = q_prime[:, np.asarray(network.wf_perm)]
                         payload = (jnp.asarray(q_prime), network, channels, gauges)
                         attrs = jnp.asarray(rd.normalized_spatial_attributes)
-                return i, rd, payload, attrs, obs_daily, obs_mask, phase_s
+                return i, rd, payload, attrs, obs_daily, obs_mask, anomaly, phase_s
 
             batch_stream = (
                 map(_prepare, _batches()) if multiprocess
                 else prefetch(_batches(), _prepare)
             )
-            for i, rd, payload, attrs, obs_daily, obs_mask, phase_s in batch_stream:
+            for i, rd, payload, attrs, obs_daily, obs_mask, anomaly, phase_s in batch_stream:
+                if anomaly is not None and validator.note(anomaly) == "quarantine":
+                    # the bad tile never reaches the device. With the
+                    # supervisor on, the drop is a ladder `skip` (bounded, the
+                    # identity on a `recovery` event); exhausting the skip
+                    # budget on garbage data is a give-up — an endlessly bad
+                    # pipeline must not be silently skipped forever.
+                    if supervisor is not None:
+                        from ddr_tpu.observability.recovery import RecoveryGiveUp
+
+                        if supervisor.decide(["data-anomaly"]) == "give-up":
+                            supervisor.record(
+                                "give-up", ["data-anomaly"], epoch=epoch, batch=i
+                            )
+                            _giveup_save(epoch, i)
+                            raise RecoveryGiveUp(
+                                f"skip budget exhausted on quarantined forcings "
+                                f"at epoch {epoch} mini-batch {i}"
+                            )
+                        supervisor.record(
+                            "skip", ["data-anomaly"], epoch=epoch, batch=i,
+                            source="data_load",
+                        )
+                    else:
+                        log.warning(
+                            f"epoch {epoch} mini-batch {i}: forcings quarantined "
+                            "(DDR_DATA_VALIDATE=quarantine); batch dropped"
+                        )
+                    continue
                 if not grids_refit:
                     # pykan-style data refit of the spline grids on the first
                     # EXECUTED mini-batch of the epoch (not literal i == 0, so a
@@ -439,13 +687,37 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
                 n_timesteps = payload.n_timesteps if par is not None else payload[0].shape[0]
                 hstats = None
+                backup = None
+                if supervisor is not None:
+                    # pre-step snapshot — stage `skip`'s restore source. The
+                    # jitted step DONATES params/opt_state, so without a copy
+                    # there is nothing left to restore after a violating
+                    # update. Device-to-device copies: no host round-trip, and
+                    # no new entries in the tracked step's jit cache.
+                    backup = (
+                        par.snapshot_state(params, opt_state)
+                        if par is not None
+                        else jax.tree_util.tree_map(
+                            lambda x: x.copy() if hasattr(x, "copy") else x,
+                            (params, opt_state),
+                        )
+                    )
                 with throughput.batch(rd.n_segments, n_timesteps), phase_timer.phase(
                     "device_step", into=phase_s
                 ):
                     if inject_device_step is not None:
                         # host-side, before dispatch: `step` is the 0-based
-                        # global index of the step about to execute
-                        inject_device_step(step=n_done, epoch=epoch, batch=i)
+                        # global index of the step about to execute. An armed
+                        # nan clause poisons the batch's forcings AFTER
+                        # validation, so the device genuinely routes
+                        # non-finite inflow (-> watchdog "non-finite").
+                        if inject_device_step.wants_array and par is None:
+                            q0 = np.asarray(payload[0])
+                            q1 = inject_device_step(q0, step=n_done, epoch=epoch, batch=i)
+                            if q1 is not q0:
+                                payload = (jnp.asarray(q1), *payload[1:])
+                        else:
+                            inject_device_step(step=n_done, epoch=epoch, batch=i)
                     if par is not None:
                         out = par.step(
                             payload, params, opt_state, obs_daily, obs_mask
@@ -470,12 +742,33 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                         params, opt_state, loss, daily = out
                     loss = float(loss)  # device sync: the timing covers the whole step
                 daily = np.asarray(daily)  # (D-2, G)
+                if inject_device_grads is not None and hstats is not None:
+                    # nan-storm site: poison the host-synchronized pre-clip
+                    # grad norm (the update ALREADY applied — exactly the
+                    # "optimizer consumed a bad gradient" scenario the
+                    # pre-step snapshot unwinds). Host scalar only; no device
+                    # buffer is touched.
+                    if inject_device_grads.wants_array:
+                        g0 = np.asarray(hstats.grad_norm, dtype=np.float32)
+                        g1 = inject_device_grads(g0, step=n_done, epoch=epoch, batch=i)
+                        if g1 is not g0:
+                            hstats = dataclasses.replace(hstats, grad_norm=g1)
+                    else:
+                        inject_device_grads(step=n_done, epoch=epoch, batch=i)
+                recovered = None
+                reasons: list[str] = []
                 if watchdog is not None and hstats is not None:
                     # stats rode the step outputs and the loss sync already
                     # landed — reading them here moves a few scalars, runs
                     # nothing. One `health` event per violating batch.
-                    watchdog.observe(hstats, epoch=epoch, batch=i)
-                if skill is not None:
+                    reasons = watchdog.observe(hstats, epoch=epoch, batch=i)
+                if supervisor is not None and reasons and backup is not None:
+                    params, opt_state, loss, daily, recovered = _recover(
+                        reasons, backup, payload, attrs, obs_daily, obs_mask,
+                        (params, opt_state, loss, daily), epoch, i,
+                    )
+                step_good = recovered in (None, "fp32-reroute")
+                if skill is not None and step_good:
                     # per-gauge NSE/KGE/percent-bias over the post-warmup
                     # window (the same rows the loss scores), streamed into
                     # bounded accumulators -> one `skill` event per batch
@@ -532,66 +825,20 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 # brackets are themselves exception-safe, so a partial
                 # eval/checkpoint timing still lands in the emitted dict.
                 try:
-                    with phase_timer.phase("eval", into=phase_s):
-                        target = np.where(obs_mask, obs_daily, np.nan)
-                        metrics = Metrics(pred=daily.T, target=target.T)
-                        log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
-
-                    if multiprocess:
-                        # collective multi-host checkpoint (all processes call it)
-                        with phase_timer.phase("checkpoint", into=phase_s):
-                            save_state_orbax(
-                                cfg.params.save_path / "saved_models",
-                                cfg.name,
-                                epoch,
-                                i,
-                                params,
-                                opt_state,
-                                rng_state=loader.state(),
-                                arch=kan_arch(cfg),
-                                mesh=par_mesh,
-                            )
-                    if is_primary:
-                        gage_ids = rd.observations.gage_ids
-                        # Legend NSE over the SAME post-warmup window the curve shows
-                        # (plot_time_series trims warmup; the batch `metrics` above
-                        # include it) — reference train.py:135-144's annotation.
-                        w = cfg.experiment.warmup
-                        legend = None
-                        if w < daily.shape[0]:  # an all-warmup window has no score to print
-                            plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
-                            legend = {"nse": float(plotted.nse[0])}
+                    # a skipped/rolled-back batch has NO result worth scoring,
+                    # plotting, or checkpointing — its `daily` is the
+                    # violating solve's output and its params were restored
+                    if step_good:
                         with phase_timer.phase("eval", into=phase_s):
-                            plot_time_series(
-                                daily[:, -1],
-                                target[:, -1],
-                                rd.dates.batch_daily_time_range[1:-1],
-                                gage_ids[-1],
-                                cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
-                                name=cfg.name,
-                                warmup=w,
-                                metrics=legend,
-                            )
-                        if not multiprocess:
-                            # async (default): snapshot + enqueue here; the
-                            # serialize/manifest/rename lands on the writer
-                            # thread's checkpoint_io bucket, overlapping the
-                            # next device_step. Sync (DDR_CKPT_ASYNC=0): the
-                            # whole write bills to this phase, as before.
+                            target = np.where(obs_mask, obs_daily, np.nan)
+                            metrics = Metrics(pred=daily.T, target=target.T)
+                            log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
+
+                        if multiprocess:
+                            # collective multi-host checkpoint (all processes call it)
                             with phase_timer.phase("checkpoint", into=phase_s):
-                                if ckpt_fmt == "orbax":
-                                    saver = (
-                                        ckpt_writer.save_orbax
-                                        if ckpt_writer is not None
-                                        else save_state_orbax
-                                    )
-                                else:
-                                    saver = (
-                                        ckpt_writer.save if ckpt_writer is not None
-                                        else save_state
-                                    )
-                                saver(
-                                    ckpt_dir,
+                                save_state_orbax(
+                                    cfg.params.save_path / "saved_models",
                                     cfg.name,
                                     epoch,
                                     i,
@@ -600,9 +847,61 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                     rng_state=loader.state(),
                                     arch=kan_arch(cfg),
                                     mesh=par_mesh,
+                                    healthy=_healthy(),
                                 )
-                                if ckpt_writer is None:
-                                    prune_checkpoints_from_env(ckpt_dir)
+                        if is_primary:
+                            gage_ids = rd.observations.gage_ids
+                            # Legend NSE over the SAME post-warmup window the curve shows
+                            # (plot_time_series trims warmup; the batch `metrics` above
+                            # include it) — reference train.py:135-144's annotation.
+                            w = cfg.experiment.warmup
+                            legend = None
+                            if w < daily.shape[0]:  # an all-warmup window has no score to print
+                                plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
+                                legend = {"nse": float(plotted.nse[0])}
+                            with phase_timer.phase("eval", into=phase_s):
+                                plot_time_series(
+                                    daily[:, -1],
+                                    target[:, -1],
+                                    rd.dates.batch_daily_time_range[1:-1],
+                                    gage_ids[-1],
+                                    cfg.params.save_path / f"plots/epoch_{epoch}_mb_{i}_validation_plot.png",
+                                    name=cfg.name,
+                                    warmup=w,
+                                    metrics=legend,
+                                )
+                            if not multiprocess:
+                                # async (default): snapshot + enqueue here; the
+                                # serialize/manifest/rename lands on the writer
+                                # thread's checkpoint_io bucket, overlapping the
+                                # next device_step. Sync (DDR_CKPT_ASYNC=0): the
+                                # whole write bills to this phase, as before.
+                                with phase_timer.phase("checkpoint", into=phase_s):
+                                    if ckpt_fmt == "orbax":
+                                        saver = (
+                                            ckpt_writer.save_orbax
+                                            if ckpt_writer is not None
+                                            else save_state_orbax
+                                        )
+                                    else:
+                                        saver = (
+                                            ckpt_writer.save if ckpt_writer is not None
+                                            else save_state
+                                        )
+                                    saver(
+                                        ckpt_dir,
+                                        cfg.name,
+                                        epoch,
+                                        i,
+                                        params,
+                                        opt_state,
+                                        rng_state=loader.state(),
+                                        arch=kan_arch(cfg),
+                                        mesh=par_mesh,
+                                        healthy=_healthy(),
+                                    )
+                                    if ckpt_writer is None:
+                                        prune_checkpoints_from_env(ckpt_dir)
                 finally:
                     if rec is not None:
                         rec.emit(
@@ -616,6 +915,10 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             reach_timesteps_per_sec=round(throughput.last_rate, 1),
                             engine=payload.mode if par is not None else "single",
                             phases=dict(phase_s),
+                            # the recovery event carries the full story; this
+                            # marker just lets a step-stream reader drop
+                            # recovered batches without a join
+                            **({"recovered": recovered} if recovered else {}),
                         )
                 n_done += 1
                 # Per-host liveness: every host emits (each to its own log
@@ -683,6 +986,10 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 rec.merge_summary("skill", skill.status())
             if drift is not None:
                 rec.merge_summary("drift", drift.status())
+            if supervisor is not None:
+                rec.merge_summary("recovery", supervisor.summary())
+            if validator is not None:
+                rec.merge_summary("data_validate", validator.summary())
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -697,6 +1004,12 @@ def main(argv: list[str] | None = None) -> int:
             train(cfg)
     except KeyboardInterrupt:
         log.info("Keyboard interrupt received")
+    except RecoveryGiveUp as e:
+        # state already saved (ladder stage 4 performs the emergency save
+        # before raising); a distinct exit code tells the launcher this is
+        # NOT a transient crash worth relaunching into the same poison
+        log.error(f"self-healing gave up: {e}")
+        return 3
     return 0
 
 

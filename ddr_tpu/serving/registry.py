@@ -212,6 +212,7 @@ class CheckpointWatcher(threading.Thread):
         self._on_reload = on_reload
         self._stop_requested = threading.Event()
         self._last: tuple[str, float] | None = None  # (path, mtime) last loaded
+        self._degraded_seen: set[str] = set()  # degraded files warned about once
 
     def run(self) -> None:  # pragma: no cover - exercised via check_now in tests
         while not self._stop_requested.wait(self._poll_s):
@@ -231,16 +232,30 @@ class CheckpointWatcher(threading.Thread):
     def check_now(self) -> bool:
         """One scan+reload attempt; True when a swap happened.
 
-        ``latest_checkpoint`` already skips ``.tmp`` leftovers, ``.corrupt``
+        The candidate walk already skips ``.tmp`` leftovers, ``.corrupt``
         quarantines, and meta-less orbax dirs, and ``load_state`` verifies the
         integrity manifest — a bit-flipped or torn blob is quarantined on the
-        spot, so the NEXT scan's ``latest_checkpoint`` lands on the previous
-        good checkpoint instead of retrying the bad one every poll tick. Each
-        bad checkpoint warns exactly once (the stamp memo below)."""
-        from ddr_tpu.training import latest_checkpoint
+        spot, so the NEXT scan lands on the previous good checkpoint instead
+        of retrying the bad one every poll tick. Checkpoints whose manifest
+        records ``degraded: true`` (saved while the training watchdog was
+        violating — poisoned state by definition) are never hot-loaded: the
+        scan walks back to the newest checkpoint saved healthy, warning once
+        per degraded file. Each bad checkpoint warns exactly once (the stamp
+        memo below)."""
+        from ddr_tpu.training import checkpoint_candidates, checkpoint_degraded
 
+        path = None
         try:
-            path = latest_checkpoint(self._dir)
+            for cand in checkpoint_candidates(self._dir):
+                if checkpoint_degraded(cand) is not True:
+                    path = cand
+                    break
+                if str(cand) not in self._degraded_seen:
+                    self._degraded_seen.add(str(cand))
+                    log.warning(
+                        f"checkpoint {cand.name} was saved while training was "
+                        "degraded; not hot-loading it"
+                    )
         except OSError as e:
             log.warning(f"checkpoint watch on {self._dir}: {e}")
             return False

@@ -60,6 +60,9 @@ __all__ = [
     "prune_checkpoints_from_env",
     "quarantine_checkpoint",
     "verify_checkpoint",
+    "mark_pinned_good",
+    "pinned_good_checkpoint",
+    "checkpoint_degraded",
 ]
 
 
@@ -466,6 +469,7 @@ def save_state(
     rng_state: Any = None,
     arch: dict | None = None,
     mesh: Any = None,
+    healthy: bool | None = None,
 ) -> Path:
     """Mid-epoch resumable checkpoint (reference validation/utils.py:12-78): model
     params, optimizer state, and data-sampling RNG state, named
@@ -474,7 +478,14 @@ def save_state(
     ``mesh`` (a Mesh, a prebuilt descriptor dict, or None for the global device
     set) plus the live leaves' sharding specs are recorded in the blob AND the
     manifest, so an elastic resume on a different device layout knows what it
-    is resharding *from* (:func:`ddr_tpu.parallel.sharding.reshard_state`)."""
+    is resharding *from* (:func:`ddr_tpu.parallel.sharding.reshard_state`).
+
+    ``healthy`` is the watchdog's verdict AT SAVE-REQUEST time (None = no
+    watchdog): it lands as ``degraded`` in blob and manifest — readable
+    without unpickling — and ``healthy=True`` refreshes the directory's
+    pinned-good marker (:func:`pinned_good_checkpoint`), the restore point the
+    recovery supervisor rolls back to and the only checkpoints serving's
+    hot-reload watcher will pick up."""
     from ddr_tpu.parallel.sharding import state_sharding_specs
 
     save_dir = Path(save_dir)
@@ -495,6 +506,8 @@ def save_state(
         "mesh": mesh_desc,
         "sharding": sharding,
     }
+    if healthy is not None:
+        blob["degraded"] = not healthy
     data = pickle.dumps(blob)
     # tmp + atomic rename: concurrent readers (the serving layer's
     # CheckpointWatcher polls this directory) must never observe a
@@ -514,8 +527,11 @@ def save_state(
         tmp.write_bytes(mutated)
     # manifest BEFORE the blob rename: every complete blob has its manifest,
     # and an orphan manifest beside a leftover .tmp is harmless
-    _write_manifest(path, data, mesh=mesh_desc)
+    degraded = None if healthy is None else not healthy
+    _write_manifest(path, data, mesh=mesh_desc, degraded=degraded)
     os.replace(tmp, path)
+    if healthy:
+        mark_pinned_good(save_dir, path)
     return path
 
 
@@ -524,11 +540,14 @@ def _manifest_path(path: Path) -> Path:
     return path.with_name(path.name + ".manifest.json")
 
 
-def _write_manifest(path: Path, data: bytes, mesh: dict | None = None) -> Path:
+def _write_manifest(
+    path: Path, data: bytes, mesh: dict | None = None, degraded: bool | None = None
+) -> Path:
     """Content checksum + byte length beside the blob (atomic rename — the
     manifest itself must never be observable half-written). ``mesh`` adds the
     device-layout provenance so resharding tooling can read it without
-    unpickling the blob."""
+    unpickling the blob; ``degraded`` records the watchdog verdict the same
+    way (the serving watcher's skip check)."""
     manifest = {
         "format": "ddr-tpu-ckpt-manifest",
         "version": 1,
@@ -537,6 +556,8 @@ def _write_manifest(path: Path, data: bytes, mesh: dict | None = None) -> Path:
     }
     if mesh is not None:
         manifest["mesh"] = mesh
+    if degraded is not None:
+        manifest["degraded"] = bool(degraded)
     mpath = _manifest_path(path)
     tmp = mpath.with_name(mpath.name + ".tmp")
     tmp.write_text(json.dumps(manifest))
@@ -732,6 +753,7 @@ def save_state_orbax(
     arch: dict | None = None,
     mesh: Any = None,
     sharding: dict | None = None,
+    healthy: bool | None = None,
 ) -> Path:
     """Orbax-backed checkpoint: ``_{name}_epoch_{E}_mb_{B}.orbax/`` holding the
     array pytrees under ``state/`` (orbax StandardCheckpointer — the
@@ -800,6 +822,8 @@ def save_state_orbax(
             "mesh": mesh_desc,
             "sharding": sharding,
         }
+        if healthy is not None:
+            meta["degraded"] = not healthy
         meta_bytes = json.dumps(meta, default=_json_np).encode()
         # Fault point between the array commit and the completeness marker:
         # a `crash` here is the torn SHARDED write — state/ exists but
@@ -816,6 +840,8 @@ def save_state_orbax(
         tmp = path / ".meta.json.tmp"
         tmp.write_bytes(meta_bytes)
         tmp.rename(path / "meta.json")
+        if healthy:
+            mark_pinned_good(save_dir, path)
     if jax.process_count() > 1:
         # Barrier: non-zero processes must not return (and possibly read the
         # checkpoint back) before process 0's completeness marker lands.
@@ -1010,6 +1036,80 @@ def latest_checkpoint(save_dir: str | Path) -> Path | None:
     return cands[0] if cands else None
 
 
+# ---------------------------------------------------------------------------
+# Pinned-good marker: the last checkpoint saved while the watchdog was healthy.
+# ---------------------------------------------------------------------------
+
+
+def _pinned_good_path(save_dir: str | Path) -> Path:
+    """The directory-level pointer file: ``_pinned_good.json``."""
+    return Path(save_dir) / "_pinned_good.json"
+
+
+def mark_pinned_good(save_dir: str | Path, path: str | Path) -> Path:
+    """Refresh the pinned-good marker to ``path`` (atomic rename — the pointer
+    must never be observable half-written). Called by the save functions —
+    including from the async writer thread — only when the watchdog was
+    healthy at save-request time."""
+    path = Path(path)
+    em = _checkpoint_epoch_mb(path) or (-1, -1)
+    pointer = {
+        "format": "ddr-tpu-pinned-good",
+        "version": 1,
+        "path": path.name,  # directory-relative: the dir may move hosts
+        "epoch": em[0],
+        "mini_batch": em[1],
+    }
+    ppath = _pinned_good_path(save_dir)
+    tmp = ppath.with_name(ppath.name + ".tmp")
+    tmp.write_text(json.dumps(pointer))
+    os.replace(tmp, ppath)
+    return ppath
+
+
+def pinned_good_checkpoint(save_dir: str | Path) -> Path | None:
+    """The last checkpoint saved while the watchdog was healthy — the recovery
+    supervisor's rollback target and the hot-reload watcher's preference.
+    Resolution order: the ``_pinned_good.json`` pointer (if its target still
+    exists), else the newest candidate whose manifest/meta does NOT record
+    ``degraded: true`` (pre-marker checkpoints carry no verdict and count as
+    good — the historical behavior). ``None`` when nothing qualifies."""
+    save_dir = Path(save_dir)
+    ppath = _pinned_good_path(save_dir)
+    if ppath.exists():
+        try:
+            pointer = json.loads(ppath.read_text())
+            target = save_dir / str(pointer.get("path", ""))
+            if pointer.get("path") and target.exists():
+                return target
+            log.warning(
+                f"pinned-good pointer names missing checkpoint "
+                f"{pointer.get('path')!r}; falling back to a manifest scan"
+            )
+        except (json.JSONDecodeError, OSError) as e:
+            log.warning(f"unreadable pinned-good pointer {ppath}: {e}")
+    for cand in checkpoint_candidates(save_dir):
+        if checkpoint_degraded(cand) is not True:
+            return cand
+    return None
+
+
+def checkpoint_degraded(path: str | Path) -> bool | None:
+    """The watchdog verdict recorded at save time, WITHOUT unpickling:
+    ``True``/``False`` from the manifest (pickle) or meta.json (orbax),
+    ``None`` when the checkpoint predates the marker or the sidecar is
+    unreadable (callers treat unknown as not-degraded — the historical
+    behavior for every pre-marker checkpoint)."""
+    path = Path(path)
+    sidecar = path / "meta.json" if path.is_dir() else _manifest_path(path)
+    try:
+        meta = json.loads(sidecar.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    flag = meta.get("degraded")
+    return bool(flag) if flag is not None else None
+
+
 def load_latest_state(
     save_dir: str | Path, expected_arch: dict | None = None
 ) -> tuple[dict, Path] | None:
@@ -1048,11 +1148,17 @@ def prune_checkpoints(
     ``keep_every_epoch`` the newest checkpoint of EVERY epoch also survives,
     so a long run keeps one restore point per epoch plus a dense recent
     window. Manifests go with their blobs; ``.corrupt`` quarantines are never
-    touched (they are evidence, not state). Returns the deleted paths."""
+    touched (they are evidence, not state), and the pinned-good checkpoint
+    (:func:`pinned_good_checkpoint` — the recovery supervisor's rollback
+    target) always survives: GC deleting the only known-healthy restore point
+    would turn the next rollback into a give-up. Returns the deleted paths."""
     if keep_last <= 0:
         return []
     cands = checkpoint_candidates(save_dir)  # newest-first
     keep = set(cands[:keep_last])
+    pinned = pinned_good_checkpoint(save_dir)
+    if pinned is not None:
+        keep.add(pinned)
     if keep_every_epoch:
         best_per_epoch: dict[int, Path] = {}
         for p in cands:  # newest-first: first hit per epoch wins
@@ -1251,8 +1357,13 @@ class AsyncCheckpointWriter:
         rng_state: Any = None,
         arch: dict | None = None,
         mesh: Any = None,
+        healthy: bool | None = None,
     ) -> None:
-        """Snapshot now, write later. Same signature as :func:`save_state`."""
+        """Snapshot now, write later. Same signature as :func:`save_state` —
+        including ``healthy``, evaluated by the CALLER at save-request time
+        (the watchdog verdict must describe the snapshotted state, not
+        whatever the run looks like when the writer thread catches up); the
+        writer refreshes the pinned-good marker only after the blob landed."""
         self._raise_pending()
         if self._closed:
             raise RuntimeError("AsyncCheckpointWriter is closed")
@@ -1269,6 +1380,7 @@ class AsyncCheckpointWriter:
             # provenance resolved NOW: the writer thread must not touch jax
             # device state that the loop may be mutating
             "mesh": _mesh_provenance(mesh),
+            "healthy": healthy,
         }
         self._enqueue(item)
 
@@ -1283,6 +1395,7 @@ class AsyncCheckpointWriter:
         rng_state: Any = None,
         arch: dict | None = None,
         mesh: Any = None,
+        healthy: bool | None = None,
     ) -> None:
         """The sharded async path: this host's device_get of the (addressable)
         shards runs on the calling thread — under a single controller every
@@ -1323,6 +1436,7 @@ class AsyncCheckpointWriter:
             "rng_state": rng_state,
             "arch": arch,
             "mesh": _mesh_provenance(mesh),
+            "healthy": healthy,
         }
         self._enqueue(item)
 

@@ -126,6 +126,13 @@ CHAOS_DOWN_KEYS = (
     "mean_recovery_s",
     "loss_delta",
     "params_max_abs_delta",
+    # nan-storm (self-healing) records: the fault plan is fixed, so MORE
+    # recovery actions per storm is churn, a rollback where a skip used to
+    # suffice is an escalation regression, and any growth in the chaotic
+    # run's compile count means recovery left the jit-cache fast path
+    "recovery_events",
+    "rollbacks",
+    "compile_events_chaos",
 )
 
 
@@ -204,14 +211,18 @@ def latest_chaos_baseline(
     mode: str | None = None,
     exclude: Path | None = None,
     reshard: bool | None = None,
+    nan_storm: bool | None = None,
 ) -> Path | None:
     """The newest CHAOS_* record of the SAME mode (train vs serve — their
     ``recovery_s`` measure different journeys, so cross-mode comparison is
     noise) and, when ``reshard`` is given, the same reshard-ness: an elastic
     mesh-change drill pays a mesh recompile on every resume, so its
     ``recovery_s`` gated against a plain same-mesh drill (or vice versa) would
-    flag the drill design, not the code. Records that fail to parse are
-    skipped; ``mode=None`` degrades to plain newest-by-mtime."""
+    flag the drill design, not the code. ``nan_storm`` pairs the same way: a
+    self-healing drill measures recovery-ladder fidelity (fault/recovery
+    counts, basin-rejoin delta), not kill/resume exactness, so the two
+    families never gate each other. Records that fail to parse are skipped;
+    ``mode=None`` degrades to plain newest-by-mtime."""
     cands = sorted(
         root.glob("CHAOS_*.json"), key=lambda p: (p.stat().st_mtime, p.name),
         reverse=True,
@@ -229,6 +240,8 @@ def latest_chaos_baseline(
         if rec.get("mode") != mode:
             continue
         if reshard is not None and bool(rec.get("reshard")) != reshard:
+            continue
+        if nan_storm is not None and bool(rec.get("nan_storm")) != nan_storm:
             continue
         return p
     return None
@@ -404,6 +417,7 @@ def main(argv: list[str] | None = None) -> int:
         found = latest_chaos_baseline(
             mode=fresh.get("mode"), exclude=exclude,
             reshard=bool(fresh.get("reshard")),
+            nan_storm=bool(fresh.get("nan_storm")),
         )
     elif is_loadtest_record(fresh):
         pattern = "LOADTEST_*.json"

@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""CI guard: the self-healing ladder answers an injected NaN storm.
+
+Self-healing training (``docs/robustness.md``) rests on a chain of small
+contracts: a ``nan`` fault clause poisons a step payload, the watchdog's
+pure ``check`` turns the poison into violation reasons, the recovery
+supervisor's ``decide``/``record`` two-phase turns the reasons into a
+bounded ladder stage, a ``recovery`` event lands in the run log, and the
+restored pre-step snapshot keeps the model state finite. The full drill
+(``ddr chaos train --nan-storm``) proves this end-to-end but is slow; this
+script closes the tier-1 gap the way ``check_reshard.py`` guards elastic
+resume: ONE in-process miniature basin loop with the REAL fault plan,
+watchdog, supervisor, and event recorder — no jax, no subprocesses.
+
+Asserts: exactly one ``fault`` and one ``recovery`` event (stage ``skip``,
+batch quarantined), the poisoned update is discarded (final state bitwise
+equals a fault-free run that skips that step), the watchdog never latches
+degraded, and an exhausted skip budget escalates to ``give-up``. Exit 0 on
+agreement, 1 otherwise.
+
+Run directly (CI) or via the test suite (tests/scripts/test_check_recovery.py):
+
+    python scripts/check_recovery.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: Deterministic mini-loop geometry: 5 steps, the fault plan poisons the
+#: payload of step 2 (0-based) exactly once.
+N_STEPS = 5
+POISONED_STEP = 2
+
+
+def _basin_loop(
+    poison_plan: str | None, supervisor=None, watchdog=None, skip_steps=()
+) -> tuple[list[float], "object"]:
+    """A toy routing loop mirroring the train loop's recovery wiring:
+    backup -> step -> inject -> health-check -> (maybe) recover."""
+    import numpy as np
+
+    from ddr_tpu.observability.faults import configure, fault_site
+    from ddr_tpu.observability.health import HealthStats
+
+    configure(poison_plan)
+    inject = fault_site("device.step")
+
+    x = np.linspace(0.5, 1.5, 8).astype(np.float32)  # the "model state"
+    losses: list[float] = []
+    for step in range(N_STEPS):
+        if step in skip_steps:
+            continue
+        backup = x.copy()
+        q = (x * x).astype(np.float32)  # the "routed discharge"
+        if inject is not None and inject.wants_array:
+            q2 = inject(q, step=step)
+            if q2 is not None:
+                q = q2
+        loss = float(np.mean(q))
+        grad = (2.0 * x * np.sign(q.sum())).astype(np.float32)
+        x = x - np.float32(0.05) * grad  # the "optimizer update"
+        stats = HealthStats(
+            nonfinite=int(np.sum(~np.isfinite(q))),
+            q_min=float(np.min(q[np.isfinite(q)], initial=0.0)),
+            q_max=float(np.max(q[np.isfinite(q)], initial=0.0)),
+            mass_residual=0.0,
+            grad_norm=float(np.sqrt(np.sum(grad * grad))),
+        )
+        reasons = watchdog.observe(stats, step=step) if watchdog is not None else []
+        if supervisor is not None and reasons:
+            stage = supervisor.decide(reasons)
+            supervisor.record(stage, reasons, step=step, epoch=1, batch=step)
+            if stage == "skip":
+                x = backup  # discard the poisoned update
+                watchdog.reset_streaks()
+                loss = float("nan")
+        losses.append(loss)
+    configure(None)  # disarm: never leak a plan into the host process
+    return losses, x
+
+
+def main() -> int:
+    try:
+        import math
+
+        import numpy as np
+
+        from ddr_tpu.observability import (
+            RecoveryConfig,
+            RecoverySupervisor,
+            run_telemetry,
+        )
+        from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
+    except Exception as e:
+        print(f"check_recovery: import failed: {e!r}", file=sys.stderr)
+        return 1
+
+    plan = f"nan@device.step={POISONED_STEP}:n=1"
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            watchdog = HealthWatchdog(HealthConfig.from_env(environ={}))
+            supervisor = RecoverySupervisor(RecoveryConfig(enabled=True))
+            with run_telemetry(None, "check_recovery", base_dir=tmp):
+                losses, x_final = _basin_loop(plan, supervisor, watchdog)
+            logs = list(Path(tmp).glob("**/run_log.*.jsonl"))
+            if len(logs) != 1:
+                print(f"check_recovery: expected one run log, found {logs}",
+                      file=sys.stderr)
+                return 1
+            events = [json.loads(ln) for ln in
+                      logs[0].read_text().splitlines() if ln.strip()]
+    except Exception as e:
+        print(f"check_recovery: faulted loop failed: {e!r}", file=sys.stderr)
+        return 1
+
+    faults = [e for e in events if e.get("event") == "fault"]
+    recoveries = [e for e in events if e.get("event") == "recovery"]
+    if len(faults) != 1 or len(recoveries) != 1:
+        print(
+            f"check_recovery: expected 1 fault + 1 recovery event, got "
+            f"{len(faults)} + {len(recoveries)}",
+            file=sys.stderr,
+        )
+        return 1
+    if recoveries[0].get("stage") != "skip":
+        print(f"check_recovery: expected a skip recovery, got {recoveries[0]}",
+              file=sys.stderr)
+        return 1
+    if supervisor.count("skip") != 1 or not supervisor.summary()["quarantined"]:
+        print(f"check_recovery: supervisor ledger wrong: {supervisor.summary()}",
+              file=sys.stderr)
+        return 1
+    if watchdog.degraded:
+        print("check_recovery: watchdog latched degraded through a recovery",
+              file=sys.stderr)
+        return 1
+    if not math.isnan(losses[POISONED_STEP]) or not all(
+        math.isfinite(v) for i, v in enumerate(losses) if i != POISONED_STEP
+    ):
+        print(f"check_recovery: loss trajectory wrong: {losses}", file=sys.stderr)
+        return 1
+
+    # the restore contract: the faulted run must land bitwise on the
+    # trajectory that simply never took the poisoned step
+    _, x_ref = _basin_loop(None, skip_steps=(POISONED_STEP,))
+    if not np.array_equal(x_final, x_ref):
+        print("check_recovery: recovered state diverged from the skip-step "
+              f"reference (max delta {np.max(np.abs(x_final - x_ref))})",
+              file=sys.stderr)
+        return 1
+
+    # bounded budgets: with the skip budget spent and nothing else available
+    # the ladder must escalate to give-up, never loop
+    tight = RecoverySupervisor(RecoveryConfig(enabled=True, max_skips=1))
+    first = tight.decide(["non-finite"])
+    tight.record(first, ["non-finite"], step=0)
+    second = tight.decide(["non-finite"])
+    if first != "skip" or second != "give-up":
+        print(f"check_recovery: ladder escalation wrong: {first} -> {second}",
+              file=sys.stderr)
+        return 1
+
+    print("check_recovery: nan storm -> 1 fault, 1 skip recovery, quarantine "
+          "ledger + bitwise restore + bounded give-up all hold")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -173,6 +173,71 @@ class TestActions:
         assert time.perf_counter() - t0 >= 0.02  # the delay still happened
 
 
+class TestNanAction:
+    """The ``nan`` action: parse-time site strictness, poisoned-copy
+    semantics, and the wants_array fast-path contract."""
+
+    @pytest.mark.parametrize("site", faults.NAN_SITES)
+    def test_parses_at_every_nan_site(self, site):
+        (a,) = parse_faults(f"nan@{site}:n=1")
+        assert a.action == "nan" and a.site == site
+
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            "nan@checkpoint.write",  # byte payload, not a float ndarray
+            "nan@data.load",  # no payload at all
+            "nan@serve.execute",
+            "nan@registry.reload",
+        ],
+    )
+    def test_nan_outside_array_sites_raises(self, spec):
+        """A nan clause at a payload-free site would fire, log — and change
+        nothing: exactly the silently-inert plan parse-time strictness
+        exists to prevent."""
+        with pytest.raises(ValueError):
+            parse_faults(spec)
+
+    def test_poisons_a_copy_never_in_place(self):
+        np = pytest.importorskip("numpy")
+        plan = FaultPlan(parse_faults("nan@device.step=0:n=1"))
+        p = plan.point("device.step")
+        q0 = np.ones((4, 5), dtype=np.float32)
+        q1 = p(q0, step=0)
+        assert q1 is not q0
+        assert np.all(np.isfinite(q0))  # caller's array untouched
+        assert np.sum(~np.isfinite(q1)) > 0
+
+    def test_non_contiguous_input_still_poisoned(self):
+        """Regression guard for the copy-then-flat poisoning: a strided view
+        (a transposed forcing tile) must come back poisoned too."""
+        np = pytest.importorskip("numpy")
+        plan = FaultPlan(parse_faults("nan@data.forcings:n=1"))
+        q = np.arange(24, dtype=np.float32).reshape(4, 6).T
+        out = plan.point("data.forcings")(q)
+        assert out.shape == q.shape
+        assert np.sum(~np.isfinite(out)) > 0
+
+    def test_wants_array_only_for_nan_clauses(self):
+        """Call sites materialize a host copy only when a nan clause is
+        armed — a crash/slow plan must keep the hot path payload-free."""
+        nan_point = FaultPlan(parse_faults("nan@device.step")).point("device.step")
+        crash_point = FaultPlan(parse_faults("crash@device.step=99")).point(
+            "device.step"
+        )
+        assert nan_point.wants_array is True
+        assert crash_point.wants_array is False
+
+    def test_unmatched_step_returns_input_unchanged(self):
+        """Identity is the armed-but-not-firing signal (`q1 is q0`): the
+        train loop uses it to skip re-device-putting the payload."""
+        np = pytest.importorskip("numpy")
+        plan = FaultPlan(parse_faults("nan@device.step=7:n=1"))
+        p = plan.point("device.step")
+        q0 = np.ones(3, dtype=np.float32)
+        assert p(q0, step=3) is q0
+
+
 class TestProcessPlan:
     def test_configure_and_fault_site(self):
         faults.configure("crash@serve.execute:n=1")

@@ -225,6 +225,20 @@ class TestWatchdog:
         assert w.observe(_stats(nonfinite=99)) == []
         assert w.status()["batches"] == 0
 
+    def test_reset_streaks_clears_degraded_but_keeps_totals(self):
+        """Checkpoint restore / reshard / recovery: the restored state is a
+        different trajectory — the streak resets, the lifetime totals don't
+        (a resumed run must not flip /readyz 503 on a healthy first batch)."""
+        w = HealthWatchdog(HealthConfig(bad_batches=2))
+        w.observe(_stats(nonfinite=1))
+        w.observe(_stats(nonfinite=1))
+        assert w.degraded
+        w.reset_streaks()
+        assert not w.degraded and w.consecutive_bad == 0
+        status = w.status()
+        assert status["batches"] == 2 and status["violations"] == 2
+        assert status["last_reasons"] == []
+
     def test_one_event_per_violating_batch(self, tmp_path):
         rec = Recorder(tmp_path / "log.jsonl")
         activate(rec)

@@ -1,0 +1,235 @@
+"""Recovery supervisor + forcing validator: the escalation-ladder matrix,
+bounded budgets, the two-phase decide/record protocol, and the data-side
+policy machine — the host-side contracts self-healing training rests on."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.events import Recorder, activate, deactivate
+from ddr_tpu.observability.recovery import (
+    RECOVERY_STAGES,
+    REROUTE_REASONS,
+    ForcingValidator,
+    RecoveryConfig,
+    RecoveryGiveUp,
+    RecoverySupervisor,
+)
+
+
+def _sup(**overrides) -> RecoverySupervisor:
+    return RecoverySupervisor(RecoveryConfig(enabled=True, **overrides))
+
+
+class TestRecoveryConfig:
+    def test_defaults_are_off(self):
+        """Recovery snapshots state before every step — a deliberate opt-in,
+        never ambient."""
+        assert RecoveryConfig().enabled is False
+        assert RecoveryConfig.from_env(environ={}).enabled is False
+
+    def test_from_env_reads_every_knob(self):
+        cfg = RecoveryConfig.from_env(environ={
+            "DDR_RECOVERY_ENABLED": "1",
+            "DDR_RECOVERY_MAX_SKIPS": "7",
+            "DDR_RECOVERY_MAX_REROUTES": "5",
+            "DDR_RECOVERY_MAX_ROLLBACKS": "2",
+            "DDR_RECOVERY_LR_BACKOFF": "0.25",
+        })
+        assert cfg == RecoveryConfig(
+            enabled=True, max_skips=7, max_reroutes=5, max_rollbacks=2,
+            lr_backoff=0.25,
+        )
+
+    def test_overrides_beat_env(self):
+        cfg = RecoveryConfig.from_env(
+            environ={"DDR_RECOVERY_MAX_SKIPS": "7"}, max_skips=1
+        )
+        assert cfg.max_skips == 1
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            {"max_skips": -1},
+            {"max_reroutes": -1},
+            {"max_rollbacks": -1},
+            {"lr_backoff": 0.0},
+            {"lr_backoff": 1.5},
+        ],
+    )
+    def test_bad_values_raise(self, kwargs):
+        with pytest.raises(ValueError):
+            RecoveryConfig(**kwargs)
+
+    def test_bad_env_value_raises_with_var_name(self):
+        with pytest.raises(ValueError, match="DDR_RECOVERY_MAX_SKIPS"):
+            RecoveryConfig.from_env(environ={"DDR_RECOVERY_MAX_SKIPS": "many"})
+
+
+class TestLadderOrder:
+    """decide() walks DOWN the ladder, never up, and each rung has a gate."""
+
+    def test_bf16_reasons_reroute_first(self):
+        sup = _sup()
+        for reason in REROUTE_REASONS:
+            assert sup.decide([reason], fp32_available=True) == "fp32-reroute"
+        assert sup.decide(list(REROUTE_REASONS), fp32_available=True) == "fp32-reroute"
+
+    def test_mixed_reasons_never_reroute(self):
+        """A batch that is ALSO non-finite has poisoned state — re-running it
+        in fp32 reproduces the poison, so the ladder goes straight to skip."""
+        sup = _sup()
+        assert sup.decide(
+            ["bf16-overflow", "non-finite"], fp32_available=True
+        ) == "skip"
+
+    def test_no_fp32_twin_means_no_reroute(self):
+        sup = _sup()
+        assert sup.decide(["bf16-overflow"], fp32_available=False) == "skip"
+
+    def test_skip_budget_exhausted_falls_to_rollback(self):
+        sup = _sup(max_skips=1)
+        assert sup.decide(["non-finite"]) == "skip"
+        sup.record("skip", ["non-finite"], epoch=1, batch=0)
+        assert sup.decide(["non-finite"], rollback_available=True) == "rollback"
+
+    def test_rollback_needs_a_pinned_checkpoint(self):
+        sup = _sup(max_skips=0)
+        assert sup.decide(["non-finite"], rollback_available=False) == "give-up"
+
+    def test_full_escalation_sequence(self):
+        """The whole ladder, one violating batch at a time: reroute x2,
+        skip x1, rollback x1, then give-up — each committed stage closes
+        its own rung."""
+        sup = _sup(max_skips=1, max_reroutes=2, max_rollbacks=1)
+        seen = []
+        for _ in range(5):
+            stage = sup.decide(
+                ["bf16-overflow"], fp32_available=True, rollback_available=True
+            )
+            seen.append(stage)
+            sup.record(stage, ["bf16-overflow"], epoch=1, batch=len(seen))
+        assert seen == ["fp32-reroute", "fp32-reroute", "skip", "rollback", "give-up"]
+        assert seen[-1] == RECOVERY_STAGES[-1]
+
+    def test_decide_is_pure(self):
+        """decide() spends nothing — only record() commits a budget."""
+        sup = _sup(max_skips=1)
+        for _ in range(5):
+            assert sup.decide(["non-finite"]) == "skip"
+        assert sup.count("skip") == 0
+
+
+class TestRecord:
+    def test_unknown_stage_raises(self):
+        with pytest.raises(ValueError):
+            _sup().record("retry-harder", ["non-finite"])
+
+    def test_skip_quarantines_batch_identity(self):
+        sup = _sup()
+        sup.record("skip", ["non-finite"], epoch=2, batch=5, step=13)
+        assert sup.summary()["quarantined"] == [{"epoch": 2, "batch": 5}]
+
+    def test_quarantine_ledger_is_bounded(self):
+        sup = _sup(max_skips=10_000)
+        for i in range(RecoverySupervisor.MAX_QUARANTINE + 10):
+            sup.record("skip", ["non-finite"], epoch=1, batch=i)
+        assert len(sup.summary()["quarantined"]) == RecoverySupervisor.MAX_QUARANTINE
+        assert sup.count("skip") == RecoverySupervisor.MAX_QUARANTINE + 10
+
+    def test_emits_recovery_event(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        try:
+            _sup().record(
+                "rollback", ["grad-norm"], epoch=3, batch=1,
+                checkpoint="chaos-pinned", lr_backoff=0.5,
+            )
+        finally:
+            deactivate(rec)
+            rec.close()
+        events = [json.loads(ln) for ln in
+                  (tmp_path / "log.jsonl").read_text().splitlines()]
+        (ev,) = [e for e in events if e["event"] == "recovery"]
+        assert ev["stage"] == "rollback"
+        assert ev["reasons"] == ["grad-norm"]
+        assert ev["checkpoint"] == "chaos-pinned"
+        assert ev["lr_backoff"] == 0.5
+
+    def test_recoveries_totals_and_summary(self):
+        sup = _sup()
+        sup.record("skip", ["non-finite"], epoch=1, batch=0)
+        sup.record("fp32-reroute", ["ulp-drift"], epoch=1, batch=1)
+        assert sup.recoveries == 2
+        assert sup.summary()["counts"]["skip"] == 1
+        assert sup.summary()["enabled"] is True
+
+    def test_give_up_is_a_distinct_type(self):
+        """Callers must be able to tell a deliberate state-preserving stop
+        from a crash (the CLI maps it to its own exit code)."""
+        assert issubclass(RecoveryGiveUp, RuntimeError)
+        assert RecoveryGiveUp is not RuntimeError
+
+
+class TestForcingValidator:
+    def test_off_policy_scans_nothing(self):
+        v = ForcingValidator("off")
+        assert not v.enabled
+        assert v.scan(np.full(8, np.nan)) is None
+
+    def test_env_policy_and_typo_rejected(self, monkeypatch):
+        monkeypatch.setenv("DDR_DATA_VALIDATE", "warn")
+        assert ForcingValidator().policy == "warn"
+        with pytest.raises(ValueError, match="DDR_DATA_VALIDATE"):
+            ForcingValidator("quarantine-ish")
+
+    def test_clean_batch_is_none(self):
+        v = ForcingValidator("warn")
+        assert v.scan(np.ones((4, 6), dtype=np.float32)) is None
+        assert v.summary()["batches"] == 1
+        assert v.summary()["anomalies"] == 0
+
+    def test_nonfinite_and_range_counted_separately(self):
+        v = ForcingValidator("warn")
+        q = np.ones(10, dtype=np.float32)
+        q[0] = np.nan
+        q[1] = np.inf  # counts as non-finite, NOT out-of-range
+        q[2] = -5.0  # below MIN_RUNOFF
+        q[3] = 1e9  # above MAX_RUNOFF
+        anomaly = v.scan(q, epoch=1, batch=4)
+        assert anomaly["nonfinite"] == 2
+        assert anomaly["out_of_range"] == 2
+        assert anomaly["size"] == 10
+        assert anomaly["batch"] == 4
+
+    def test_note_returns_policy_verdict(self):
+        warn, quarantine = ForcingValidator("warn"), ForcingValidator("quarantine")
+        a = {"nonfinite": 1, "out_of_range": 0, "size": 4, "policy": "warn"}
+        assert warn.note(a) == "warn"
+        assert quarantine.note(dict(a, policy="quarantine")) == "quarantine"
+        assert quarantine.summary()["quarantined"] == 1
+        assert warn.summary()["quarantined"] == 0
+
+    def test_events_are_bounded(self, tmp_path):
+        """MAX_EVENTS data_anomaly emissions, then suppression — the rollup
+        still counts every finding."""
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        v = ForcingValidator("warn")
+        try:
+            for i in range(ForcingValidator.MAX_EVENTS + 5):
+                v.note({"nonfinite": 1, "out_of_range": 0, "size": 4,
+                        "policy": "warn", "batch": i})
+        finally:
+            deactivate(rec)
+            rec.close()
+        events = [json.loads(ln) for ln in
+                  (tmp_path / "log.jsonl").read_text().splitlines()]
+        assert (
+            len([e for e in events if e["event"] == "data_anomaly"])
+            == ForcingValidator.MAX_EVENTS
+        )
+        assert v.summary()["events_suppressed"] == 5

@@ -1,0 +1,44 @@
+"""scripts/check_recovery.py: the self-healing smoke gate must pass on a
+clean tree (so recovery-ladder bit-rot fails tier-1 fast) and actually catch
+breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_recovery.py"
+
+
+def test_repo_recovery_smokes_clean():
+    """THE CI gate: a nan fault clause through the real watchdog + supervisor
+    yields one recovery event, a bitwise restore, and a bounded give-up."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bounded give-up" in proc.stdout
+
+
+def test_gate_fails_on_broken_recovery_module(tmp_path):
+    """A tree whose recovery module cannot import must fail the gate — copy
+    the script next to a stub package with a broken observability.recovery."""
+    pkg = tmp_path / "ddr_tpu" / "observability"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_recovery.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_recovery.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr

@@ -205,6 +205,70 @@ class TestPrune:
         assert len(checkpoint_candidates(tmp_path)) == 1
 
 
+class TestPinnedGood:
+    """The pinned-good marker: the rollback target the recovery supervisor
+    restores — refreshed only when the watchdog was healthy at save time."""
+
+    def test_healthy_save_refreshes_pointer(self, tmp_path):
+        from ddr_tpu.training import checkpoint_degraded, pinned_good_checkpoint
+
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT, healthy=True)
+        assert pinned_good_checkpoint(tmp_path) == good
+        assert checkpoint_degraded(good) is False
+        # a later DEGRADED save must NOT move the pin — rolling back to
+        # poisoned state is the exact failure the marker exists to prevent
+        bad = save_state(tmp_path, "t", 1, 1, {"w": 2 * PARAMS["w"]}, OPT,
+                         healthy=False)
+        assert checkpoint_degraded(bad) is True
+        assert pinned_good_checkpoint(tmp_path) == good
+        assert latest_checkpoint(tmp_path) == bad  # resume still takes newest
+
+    def test_no_verdict_checkpoints_count_as_good(self, tmp_path):
+        """Pre-marker checkpoints carry no verdict: the historical behavior
+        (everything is a rollback candidate) must survive."""
+        from ddr_tpu.training import checkpoint_degraded, pinned_good_checkpoint
+
+        p = save_state(tmp_path, "t", 1, 0, PARAMS, OPT)
+        assert checkpoint_degraded(p) is None
+        assert pinned_good_checkpoint(tmp_path) == p
+
+    def test_stale_pointer_falls_back_to_manifest_scan(self, tmp_path):
+        from ddr_tpu.training import pinned_good_checkpoint
+
+        import os as _os
+
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT, healthy=True)
+        gone = save_state(tmp_path, "t", 1, 1, PARAMS, OPT, healthy=True)
+        _os.utime(good, (good.stat().st_atime, 1_000_000))
+        bad = save_state(tmp_path, "t", 2, 0, PARAMS, OPT, healthy=False)
+        _os.utime(bad, (bad.stat().st_atime, 3_000_000))
+        # the pointer's target vanishes (pruned by an external GC)
+        gone.unlink()
+        gone.with_name(gone.name + ".manifest.json").unlink()
+        # fallback scan: newest NON-degraded candidate, not the degraded newest
+        assert pinned_good_checkpoint(tmp_path) == good
+
+    def test_nothing_qualifies_is_none(self, tmp_path):
+        from ddr_tpu.training import pinned_good_checkpoint
+
+        assert pinned_good_checkpoint(tmp_path) is None
+        save_state(tmp_path, "t", 1, 0, PARAMS, OPT, healthy=False)
+        assert pinned_good_checkpoint(tmp_path) is None
+
+    def test_prune_never_deletes_the_pinned_checkpoint(self, tmp_path):
+        from ddr_tpu.training import pinned_good_checkpoint
+
+        pinned = save_state(tmp_path, "t", 1, 0, PARAMS, OPT, healthy=True)
+        os.utime(pinned, (pinned.stat().st_atime, 1_000_000))
+        for i, (epoch, mb) in enumerate([(1, 1), (1, 2), (2, 0), (2, 1)]):
+            p = save_state(tmp_path, "t", epoch, mb, PARAMS, OPT, healthy=False)
+            os.utime(p, (p.stat().st_atime, 2_000_000 + i))
+        deleted = prune_checkpoints(tmp_path, keep_last=1, keep_every_epoch=False)
+        assert pinned not in deleted
+        assert pinned in checkpoint_candidates(tmp_path)
+        assert pinned_good_checkpoint(tmp_path) == pinned
+
+
 class TestAsyncWriter:
     def test_save_lands_after_drain(self, tmp_path):
         w = AsyncCheckpointWriter()
@@ -301,6 +365,30 @@ class TestServingWatcher:
             assert watcher.check_now() is False
             assert watcher.check_now() is False
         warnings = [r for r in caplog.records if "not loadable" in r.message]
+        assert len(warnings) == 1
+
+    def test_degraded_newest_is_never_hot_loaded(self, tmp_path, caplog):
+        import logging
+
+        from ddr_tpu.serving.registry import CheckpointWatcher
+
+        reg = self._registry()
+        good = save_state(tmp_path, "t", 1, 0, PARAMS, OPT, healthy=True)
+        os.utime(good, (good.stat().st_atime, 1_000_000))
+        bad = save_state(tmp_path, "t", 1, 1, {"w": 9 * PARAMS["w"]}, OPT,
+                         healthy=False)
+        os.utime(bad, (bad.stat().st_atime, 2_000_000))
+        watcher = CheckpointWatcher(
+            registry=reg, name="m", directory=tmp_path, expected_arch=None
+        )
+        with caplog.at_level(logging.WARNING, logger="ddr_tpu.serving.registry"):
+            assert watcher.check_now() is True
+            watcher.check_now()
+        entry = reg.get("m")
+        assert entry.source == str(good)  # the healthy save won, not the newest
+        np.testing.assert_array_equal(np.asarray(entry.params["w"]), PARAMS["w"])
+        # once-per-file warning discipline, same as every other bad checkpoint
+        warnings = [r for r in caplog.records if "degraded" in r.message]
         assert len(warnings) == 1
 
     def test_reload_fault_injection_keeps_old_params(self, tmp_path):

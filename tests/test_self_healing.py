@@ -1,0 +1,148 @@
+"""Self-healing training e2e (synthetic basin, real train loop): an injected
+NaN batch is skipped by the recovery supervisor, the loss trajectory rejoins,
+and quarantined forcings never reach the device."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from ddr_tpu.observability import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.configure(None)
+
+
+def _cfg(tmp_path, **exp):
+    from ddr_tpu.validation.configs import Config
+
+    return Config(**{
+        "name": "heal",
+        "geodataset": "synthetic",
+        "mode": "training",
+        "kan": {"input_var_names": [f"a{i}" for i in range(10)]},
+        "experiment": {
+            "start_time": "1981/10/01",
+            "end_time": "1981/10/20",
+            "rho": 8,
+            "batch_size": 1,
+            "epochs": 1,
+            "warmup": 1,
+            "learning_rate": {1: 0.01},
+            "shuffle": False,
+            **exp,
+        },
+        "params": {"save_path": str(tmp_path)},
+    })
+
+
+def _events(run_dir):
+    return [
+        json.loads(line)
+        for line in (run_dir / "run_log.train.jsonl").read_text().splitlines()
+    ]
+
+
+@pytest.mark.slow
+def test_nan_batch_is_skipped_and_loss_rejoins(tmp_path, monkeypatch):
+    """nan@device.step poisons one step payload; the supervisor skips the
+    batch (restoring the pre-step snapshot), the step event carries the
+    ``recovered`` marker, training finishes on a finite loss near the
+    fault-free trajectory, and the run_end rollup records the quarantine."""
+    from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.train import train
+
+    monkeypatch.setenv("DDR_HEALTH_ENABLED", "1")
+    monkeypatch.setenv("DDR_RECOVERY_ENABLED", "1")
+    monkeypatch.setenv("DDR_CKPT_ASYNC", "0")
+
+    golden = tmp_path / "golden"
+    with run_telemetry(_cfg(golden, epochs=2), "train", base_dir=str(golden)):
+        g_params, _ = train(_cfg(golden, epochs=2))
+    golden_losses = [
+        e["loss"] for e in _events(golden) if e["event"] == "step"
+    ]
+    assert all(math.isfinite(v) for v in golden_losses)
+
+    run = tmp_path / "faulted"
+    faults.configure("nan@device.step=1:n=1")
+    try:
+        with run_telemetry(_cfg(run, epochs=2), "train", base_dir=str(run)):
+            f_params, _ = train(_cfg(run, epochs=2))
+    finally:
+        faults.configure(None)
+    events = _events(run)
+
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    assert [e["stage"] for e in recoveries] == ["skip"]
+    assert recoveries[0]["batch"] == 1
+
+    steps = [e for e in events if e["event"] == "step"]
+    assert len(steps) == len(golden_losses)
+    assert steps[1].get("recovered") == "skip"
+    # the skipped batch reports no usable loss; every executed one is finite
+    assert not math.isfinite(steps[1]["loss"])
+    others = [e["loss"] for i, e in enumerate(steps) if i != 1]
+    assert all(math.isfinite(v) for v in others)
+    # rejoin: one dropped update, then a clean epoch — the run lands back in
+    # the golden basin (the same gate the chaos drill applies to its params)
+    import jax
+    import numpy as np
+
+    deltas = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_params), jax.tree_util.tree_leaves(f_params)
+        )
+    ]
+    assert max(deltas) < 0.1
+
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["summary"]["recovery"]["counts"]["skip"] == 1
+    assert run_end["summary"]["recovery"]["quarantined"] == [
+        {"epoch": 1, "batch": 1}
+    ]
+
+
+@pytest.mark.slow
+def test_quarantined_forcings_never_reach_the_device(tmp_path, monkeypatch):
+    """nan@data.forcings + DDR_DATA_VALIDATE=quarantine: the poisoned batch is
+    dropped at the data_load phase — one data_anomaly event, one skip
+    recovery, one FEWER executed step, and no health violation (the device
+    never saw the poison)."""
+    from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.scripts.train import train
+
+    monkeypatch.setenv("DDR_HEALTH_ENABLED", "1")
+    monkeypatch.setenv("DDR_RECOVERY_ENABLED", "1")
+    monkeypatch.setenv("DDR_DATA_VALIDATE", "quarantine")
+    monkeypatch.setenv("DDR_CKPT_ASYNC", "0")
+
+    run = tmp_path / "run"
+    faults.configure("nan@data.forcings=1:n=1")
+    try:
+        with run_telemetry(_cfg(run), "train", base_dir=str(run)):
+            train(_cfg(run))
+    finally:
+        faults.configure(None)
+    events = _events(run)
+
+    anomalies = [e for e in events if e["event"] == "data_anomaly"]
+    assert len(anomalies) == 1
+    assert anomalies[0]["nonfinite"] > 0
+    assert [e["stage"] for e in events if e["event"] == "recovery"] == ["skip"]
+    # the device never executed the poisoned batch: no health violation, and
+    # the epoch is one step short
+    assert not [e for e in events if e["event"] == "health"]
+    steps = [e for e in events if e["event"] == "step"]
+    assert all(math.isfinite(e["loss"]) for e in steps)
+
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["summary"]["data_validate"]["policy"] == "quarantine"
+    assert run_end["summary"]["data_validate"]["quarantined"] == 1
+    assert run_end["summary"]["data_validate"]["anomalies"] == 1
