@@ -70,6 +70,25 @@ def registered_events(events_py: Path) -> tuple[str, ...]:
     return events
 
 
+def schema_version_constant(events_py: Path) -> int | None:
+    """``SCHEMA_VERSION`` from events.py, by AST: the integer every
+    ``run_start`` is stamped with so mixed-version fleets stay readable.
+    Returns None when missing or non-integer (a lint finding, not a crash)."""
+    if not events_py.is_file():
+        return None
+    tree = ast.parse(events_py.read_text(encoding="utf-8"), filename=str(events_py))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "SCHEMA_VERSION" in targets:
+                try:
+                    value = ast.literal_eval(node.value)
+                except ValueError:
+                    return None
+                return value if isinstance(value, int) else None
+    return None
+
+
 def registered_fault_sites(faults_py: Path) -> tuple[str, ...] | None:
     """``FAULT_SITES`` from faults.py, by AST; None when faults.py is absent
     (fixture trees)."""
@@ -197,7 +216,10 @@ class UnregisteredEvent(Rule):
         "Recorder.emit deliberately writes unknown event types (with a "
         "warning) so experiments never lose data — a typo'd name ships "
         "silently and `ddr metrics summarize` never aggregates it (the PR 3 "
-        "check_event_schema gate, folded in as a rule)."
+        "check_event_schema gate, folded in as a rule). Readers tolerate AND "
+        "report what they don't know (summarize's schema line), which only "
+        "works while run_start carries the integer SCHEMA_VERSION stamp — "
+        "this rule also pins that constant's existence."
     )
 
     def check_file(self, src: SourceFile, project) -> Iterable[Finding]:
@@ -225,6 +247,17 @@ class UnregisteredEvent(Rule):
             yield Finding(
                 path=EVENTS_PY.as_posix(), line=1, rule=self.id, severity="error",
                 message="found no emit() call sites at all — matcher broken?",
+            )
+        # tolerate-and-report only works against a versioned writer: losing
+        # the run_start schema stamp breaks mixed-version fleets silently
+        if schema_version_constant(project.root / EVENTS_PY) is None:
+            yield Finding(
+                path=EVENTS_PY.as_posix(), line=1, rule=self.id, severity="error",
+                message=(
+                    "events.py no longer defines an integer SCHEMA_VERSION — "
+                    "run_start must stamp the schema version so readers can "
+                    "tolerate-and-report unknown events/fields across versions"
+                ),
             )
 
 

@@ -22,6 +22,7 @@ _COMMANDS = {
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",
     "metrics": "ddr_tpu.observability.metrics_cli",
+    "obs": "ddr_tpu.observability.obs_cli",
     "profile": "ddr_tpu.scripts.profile",
     "tune": "ddr_tpu.scripts.tune",
     "audit": "ddr_tpu.scripts.audit",

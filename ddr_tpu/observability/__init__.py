@@ -7,6 +7,24 @@ jax is consulted lazily and only when already loaded. See docs/observability.md
 for the event schema, the live-metrics endpoint table, and worked examples.
 """
 
+# NOTE on the `trace` name: the trace-context MODULE (ddr_tpu.observability
+# .trace) is imported first, then `from .spans import trace` below rebinds the
+# package attribute `trace` to the profiler context manager — the long-standing
+# public name (`from ddr_tpu.observability import trace`). Trace-context
+# symbols are re-exported individually (SpanContext, step_context, ...); code
+# that needs the module imports its symbols directly
+# (`from ddr_tpu.observability.trace import ...`), which resolves via
+# sys.modules and never consults the shadowed package attribute.
+from ddr_tpu.observability.trace import (
+    SpanContext,
+    adopt_trace_id,
+    derive_id,
+    new_span_id,
+    new_trace_id,
+    run_trace_seed,
+    step_context,
+    trace_enabled,
+)
 from ddr_tpu.observability.costs import (
     COLLECTIVE_OPS,
     ProgramCard,
@@ -103,6 +121,14 @@ __all__ = [
     "spanned",
     "trace",
     "trace_active",
+    "SpanContext",
+    "adopt_trace_id",
+    "derive_id",
+    "new_span_id",
+    "new_trace_id",
+    "run_trace_seed",
+    "step_context",
+    "trace_enabled",
     "profile_dir_from_env",
     "ProfilerBusyError",
     "capture_profile",

@@ -44,7 +44,9 @@ log = logging.getLogger(__name__)
 
 __all__ = [
     "EVENT_TYPES",
+    "SCHEMA_VERSION",
     "Recorder",
+    "max_log_bytes_from_env",
     "get_recorder",
     "activate",
     "deactivate",
@@ -94,6 +96,16 @@ __all__ = [
 #: is one bounded forcing-validation finding from the ``data_load`` phase scan
 #: (non-finite / out-of-physical-range counts and the
 #: ``DDR_DATA_VALIDATE`` policy applied, same module).
+#: Version of the event schema, stamped on every ``run_start`` so readers of
+#: FEDERATED logs (a fleet mixes replica versions during a rollout) can tell
+#: which vocabulary each file speaks. Bump when an event type is added or an
+#: existing field changes meaning; readers tolerate-and-report unknown types
+#: and fields rather than failing (``ddr metrics summarize``'s schema line,
+#: ``ddr lint`` rule DDR501). History: 1 = pre-trace schema; 2 = trace-context
+#: ids (``trace_id``/``span_id``/``parent_id``) on span/step/serve events,
+#: ``schema_version``/``prom_port`` on ``run_start``.
+SCHEMA_VERSION = 2
+
 EVENT_TYPES = (
     "run_start",
     "step",
@@ -134,6 +146,31 @@ def flush_every_from_env() -> int:
     except ValueError:
         log.warning(f"ignoring malformed DDR_METRICS_FLUSH_EVERY={raw!r} (want an integer)")
         return 1
+
+
+#: Rotation geometry: an over-budget log is split into this many pieces — the
+#: first segment (it holds ``run_start``) plus the newest few plus the active
+#: file — so the on-disk total stays ≈ ``DDR_METRICS_MAX_MB`` while both ends
+#: of the run survive.
+_ROTATE_SEGMENTS = 5
+
+
+def max_log_bytes_from_env() -> int | None:
+    """``DDR_METRICS_MAX_MB`` -> run-log size bound in bytes (None = unbounded,
+    the original behavior). Fractional values work (tests rotate kilobytes);
+    malformed or non-positive values disable the bound — a telemetry knob must
+    never abort a run."""
+    raw = os.environ.get("DDR_METRICS_MAX_MB")
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        log.warning(f"ignoring malformed DDR_METRICS_MAX_MB={raw!r} (want a number)")
+        return None
+    if mb <= 0:
+        return None
+    return int(mb * 1024 * 1024)
 
 
 def metrics_dir_from_env() -> str | None:
@@ -183,6 +220,7 @@ class Recorder:
         n_hosts: int = 1,
         tags: dict[str, Any] | None = None,
         flush_every: int | None = None,
+        max_bytes: int | None = None,
     ) -> None:
         self.path = Path(path)
         self.host = int(host)
@@ -207,6 +245,22 @@ class Recorder:
         # prometheus tee rides here); hook failures are logged, never raised —
         # observability must not break the data path.
         self._hooks: list[Any] = []
+        # Size-bounded rotation (DDR_METRICS_MAX_MB): when the ACTIVE file
+        # crosses its per-segment share, it is renamed to the next numbered
+        # `<stem>.seg<N>.jsonl` and a fresh active file opens; pruning keeps
+        # the first segment (run_start lives there) and the newest few, so an
+        # unbounded serve/health stream can no longer fill the disk while the
+        # run's two bookends always survive. None = unbounded (the default).
+        self._max_bytes = max_log_bytes_from_env() if max_bytes is None else (
+            int(max_bytes) if max_bytes else None
+        )
+        self._seg_bytes = (
+            max(4096, self._max_bytes // _ROTATE_SEGMENTS)
+            if self._max_bytes
+            else None
+        )
+        self._seg_n = 0
+        self._written = 0
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = self.path.open("w", encoding="utf-8")
 
@@ -276,11 +330,16 @@ class Recorder:
             rec.update(payload)
             self._seq += 1
             self._counts[event] = self._counts.get(event, 0) + 1
-            self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+            line = json.dumps(rec, default=_json_default) + "\n"
+            self._fh.write(line)
             self._unflushed += 1
             if self._unflushed >= self._flush_every:
                 self._fh.flush()
                 self._unflushed = 0
+            if self._seg_bytes is not None:
+                self._written += len(line)
+                if self._written >= self._seg_bytes:
+                    self._rotate()
             hooks = list(self._hooks)
         for hook in hooks:
             try:
@@ -288,13 +347,68 @@ class Recorder:
             except Exception:
                 log.exception(f"telemetry emit hook {hook!r} failed")
 
-    def record_span(self, path: str, seconds: float) -> None:
-        """Aggregate one finished span and emit its ``span`` event."""
+    def record_span(self, path: str, seconds: float, ctx: Any = None) -> None:
+        """Aggregate one finished span and emit its ``span`` event. ``ctx`` (a
+        :class:`~ddr_tpu.observability.trace.SpanContext`) attaches the trace
+        ids plus the emitting thread's name — the per-thread track label the
+        Perfetto export renders (``MainThread``, ``ddr-prefetch``,
+        ``ddr-ckpt-writer``, …)."""
         with self._lock:
             agg = self._spans.setdefault(path, [0, 0.0])
             agg[0] += 1
             agg[1] += seconds
-        self.emit("span", name=path, seconds=round(seconds, 6))
+        extra: dict[str, Any] = {}
+        if ctx is not None:
+            extra = ctx.ids()
+            extra["thread"] = threading.current_thread().name
+        self.emit("span", name=path, seconds=round(seconds, 6), **extra)
+
+    # ---- rotation (call sites hold self._lock) ----
+
+    def _rotate(self) -> None:
+        """Rename the active file to the next numbered segment and start a
+        fresh one. Best-effort: any filesystem refusal disables rotation for
+        the rest of the run rather than losing events."""
+        try:
+            self._fh.flush()
+            self._fh.close()
+            self._seg_n += 1
+            seg = self.path.with_name(
+                f"{self.path.stem}.seg{self._seg_n}{self.path.suffix}"
+            )
+            os.replace(self.path, seg)
+            self._fh = self.path.open("w", encoding="utf-8")
+            self._written = 0
+            self._prune_segments()
+        except OSError:
+            log.exception("run-log rotation failed; disabling rotation")
+            self._seg_bytes = None
+            if self._fh.closed:  # keep writing somewhere, whatever happened
+                self._fh = self.path.open("a", encoding="utf-8")
+
+    def _segment_paths(self) -> list[tuple[int, Path]]:
+        """This log's rotated segments as ``(N, path)``, ordered by N."""
+        out: list[tuple[int, Path]] = []
+        prefix = f"{self.path.stem}.seg"
+        for p in self.path.parent.glob(f"{prefix}*{self.path.suffix}"):
+            num = p.name[len(prefix):-len(self.path.suffix)]
+            if num.isdigit():
+                out.append((int(num), p))
+        return sorted(out)
+
+    def _prune_segments(self) -> None:
+        """Bound disk: keep the FIRST segment (it carries ``run_start``) and
+        the newest ``_ROTATE_SEGMENTS - 2``; with the active file that totals
+        ~``DDR_METRICS_MAX_MB``. Middle segments are deleted oldest-first."""
+        segs = self._segment_paths()
+        keep_tail = _ROTATE_SEGMENTS - 2
+        if len(segs) <= keep_tail + 1:
+            return
+        for _, p in segs[1:-keep_tail]:
+            try:
+                p.unlink()
+            except OSError:  # a reader may have it open; try again next time
+                pass
 
     def merge_summary(self, key: str, value: Any) -> None:
         """Attach an extra rollup (e.g. compile-tracker counts) to ``run_end``."""
@@ -319,6 +433,9 @@ class Recorder:
         with self._lock:
             if self._closed:
                 return
+            # the terminal event must stay in the ACTIVE file (readers find
+            # run_end by looking at the newest piece) — never rotate it out
+            self._seg_bytes = None
             self.emit(
                 "run_end",
                 status=status,
@@ -385,9 +502,14 @@ def run_telemetry(
     """
     # The scrape endpoint is orthogonal to the run log: DDR_PROM_PORT starts
     # the background /metrics exporter even when no log directory resolves.
+    # The RESOLVED port rides run_start (DDR_PROM_PORT=0 binds an ephemeral
+    # one), so chaos/loadtest harnesses and the federation scraper can
+    # discover it from the log instead of racing on fixed ports.
     from ddr_tpu.observability.prometheus import maybe_start_exporter_from_env
 
-    maybe_start_exporter_from_env()
+    exporter = maybe_start_exporter_from_env()
+    if exporter is not None:
+        log.info(f"prometheus exporter serving /metrics at {exporter.url}")
     base = base_dir or metrics_dir_from_env()
     if base is None and cfg is not None:
         base = getattr(getattr(cfg, "params", None), "save_path", None)
@@ -398,7 +520,15 @@ def run_telemetry(
     activate(rec)
     info = _cfg_summary(cfg)
     info.update(run_info)
-    rec.emit("run_start", cmd=cmd, n_hosts=rec.n_hosts, **info)
+    if exporter is not None:
+        info.setdefault("prom_port", int(exporter.server_address[1]))
+    rec.emit(
+        "run_start",
+        cmd=cmd,
+        schema_version=SCHEMA_VERSION,
+        n_hosts=rec.n_hosts,
+        **info,
+    )
     status = "ok"
     try:
         yield rec

@@ -14,18 +14,26 @@ Reads the event stream written by :mod:`ddr_tpu.observability.events`
 - ``tail --follow [-i SECONDS]``: keep polling the log and print new events
   as they land (the serve/loadtest live view) — corrupt or half-written
   lines are skipped, a truncated/rotated file restarts from its top, and
-  Ctrl-C exits cleanly.
+  Ctrl-C exits cleanly;
+- ``trace <log-or-dir> --out trace.json``: export the run as a Chrome/
+  Perfetto trace — one process track per host (clock-aligned via each
+  host's monotonic/wall offset), duration slices for spans/steps/requests/
+  batches, instants for faults/recoveries/heartbeats, and flow arrows
+  stitching one ``trace_id`` across hosts and a ``serve_batch`` to its
+  member requests. Open the file at https://ui.perfetto.dev.
 
 Pointing either command at a directory merges every ``*.jsonl`` inside (the
-multi-host case; ``--follow`` follows the most recently modified file).
-Corrupt lines are skipped and counted, never fatal — a run killed mid-write
-must still summarize.
+multi-host case). ``--follow`` on a directory interleaves ALL logs live —
+primary plus per-host sidecars — prefixing each line with its source
+``host<K>``. Corrupt lines are skipped and counted, never fatal — a run
+killed mid-write must still summarize.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 from pathlib import Path
@@ -33,7 +41,7 @@ from typing import Any
 
 __all__ = [
     "main", "load_events", "summarize", "tail", "follow", "detect_stalls",
-    "aggregate_spatial_health",
+    "aggregate_spatial_health", "perfetto_trace",
 ]
 
 #: Default stall threshold: a run whose newest step/heartbeat is older than
@@ -54,14 +62,29 @@ _FOLLOW_INIT_TAIL_BYTES = 1 << 20
 _FOLLOW_FP_BYTES = 128
 
 
+def _rotation_segments(f: Path) -> list[Path]:
+    """The numbered rotation segments of one active log (``DDR_METRICS_MAX_MB``
+    renames ``run_log.x.jsonl`` to ``run_log.x.segN.jsonl``), oldest first —
+    readers of a size-bounded log must see the whole surviving history, not
+    just the active tail."""
+    segs = []
+    for cand in f.parent.glob(f"{f.stem}.seg*{f.suffix}"):
+        digits = cand.name[len(f.stem) + 4 : -len(f.suffix)]
+        if digits.isdigit():
+            segs.append((int(digits), cand))
+    return [p for _, p in sorted(segs)]
+
+
 def load_events(path: str | Path) -> tuple[list[dict], int]:
     """``(events, n_corrupt_lines)`` from one JSONL file or a directory of them.
 
     Multi-file reads merge on wall-clock (then sequence) order; single files
-    keep their native order.
+    keep their native order. A file that was size-rotated
+    (``DDR_METRICS_MAX_MB``) is read together with its ``.segN`` segments,
+    oldest segment first.
     """
     p = Path(path)
-    files = sorted(p.glob("*.jsonl")) if p.is_dir() else [p]
+    files = sorted(p.glob("*.jsonl")) if p.is_dir() else [*_rotation_segments(p), p]
     if not files:
         raise FileNotFoundError(f"no .jsonl run logs under {p}")
     events: list[dict] = []
@@ -195,6 +218,28 @@ def summarize(
     w(f"events   : {len(events)} total — {counts}")
     w(f" ({bad} corrupt lines skipped)\n" if bad else "\n")
 
+    # schema line: a reader must keep summarizing logs written by newer (or
+    # older) code — unknown event types are reported, never fatal
+    from ddr_tpu.observability.events import EVENT_TYPES, SCHEMA_VERSION
+
+    vers = sorted({
+        int(e["schema_version"])
+        for e in by_type.get("run_start", [])
+        if isinstance(e.get("schema_version"), int)
+    })
+    unknown = sorted(k for k in by_type if k not in EVENT_TYPES)
+    if vers or unknown:
+        line = "schema   : " + (
+            "v" + "/".join(str(v) for v in vers) if vers else "(unversioned run_start)"
+        )
+        if vers and any(v != SCHEMA_VERSION for v in vers):
+            line += f" (reader is v{SCHEMA_VERSION})"
+        if unknown:
+            line += "   unknown event types: " + ", ".join(
+                f"{k} ({len(by_type[k])})" for k in unknown
+            )
+        w(line + "\n")
+
     for s in detect_stalls(events, now=now, factor=stall_factor):
         w(
             f"STALL?   : host{s['host']} last {s['last_event']} {s['age_s']:.0f}s ago "
@@ -228,6 +273,7 @@ def summarize(
     _summarize_health(by_type, end, w)
     _summarize_skill(by_type, end, w)
     _summarize_spatial(by_type, end, w)
+    _summarize_fleet(by_type, w)
 
     evals = by_type.get("eval", [])
     if evals:
@@ -423,6 +469,75 @@ def _summarize_serving(by_type: dict[str, list[dict]], w) -> None:
             + ", ".join(f"{k} {v}" for k, v in sorted(reasons.items()))
             + "\n"
         )
+
+
+def _summarize_fleet(by_type: dict[str, list[dict]], w) -> None:
+    """The fleet rollup (multi-host/multi-replica runs): the cross-host
+    aggregates an operator asks first — per-host progress and liveness, which
+    host is worst (furthest behind the fleet's newest event), recovery totals
+    per host, and fleet-wide SLO attainment when serve logs are merged in.
+    Shown only when the merged stream spans ≥2 hosts (single-host runs already
+    have the heartbeat table)."""
+    per: dict[int, dict[str, Any]] = {}
+    for name, evs in by_type.items():
+        for e in evs:
+            h = int(e.get("host", 0))
+            s = per.setdefault(h, {
+                "steps": 0, "beats": 0, "recov": 0, "good": 0, "served": 0,
+                "last_wall": None, "last_event": "?",
+            })
+            if name == "step":
+                s["steps"] += 1
+            elif name == "heartbeat":
+                s["beats"] += 1
+            elif name == "recovery":
+                s["recov"] += 1
+            elif name == "serve_request":
+                ok = e.get("slo_ok")
+                if ok is None:
+                    ok = e.get("status") == "ok"
+                s["served"] += 1
+                s["good"] += bool(ok)
+            wall = e.get("wall")
+            if wall is not None and (
+                s["last_wall"] is None or float(wall) > s["last_wall"]
+            ):
+                s["last_wall"] = float(wall)
+                s["last_event"] = name
+    if len(per) < 2:
+        return
+    newest = max(s["last_wall"] for s in per.values() if s["last_wall"] is not None)
+    rows = []
+    for h, s in sorted(per.items()):
+        behind = newest - s["last_wall"] if s["last_wall"] is not None else None
+        att = f"{100 * s['good'] / s['served']:.1f}%" if s["served"] else "-"
+        rows.append([
+            f"host{h}", str(s["steps"]), str(s["beats"]), str(s["recov"]),
+            att, s["last_event"],
+            f"-{behind:.1f}s" if behind is not None else "?",
+        ])
+    # the worst host lags the fleet's newest event the most; ties go to the
+    # host with the least progress
+    worst_h, worst_s = max(
+        per.items(),
+        key=lambda kv: (
+            (newest - kv[1]["last_wall"]) if kv[1]["last_wall"] is not None else float("inf"),
+            -kv[1]["steps"],
+        ),
+    )
+    served = sum(s["served"] for s in per.values())
+    good = sum(s["good"] for s in per.values())
+    recov = sum(s["recov"] for s in per.values())
+    line = f"fleet    : {len(per)} hosts   worst host{worst_h}"
+    if worst_s["last_wall"] is not None:
+        line += f" ({newest - worst_s['last_wall']:.1f}s behind)"
+    if served:
+        line += f"   aggregate slo {100 * good / served:.2f}% ({good}/{served} good)"
+    if recov:
+        line += f"   recoveries {recov}"
+    w(line + "\n")
+    w(_table(rows, ["host", "steps", "beats", "recov", "slo", "last event",
+                    "lag"]) + "\n")
 
 
 def _summarize_slo(by_type: dict[str, list[dict]], end: dict, w) -> None:
@@ -668,22 +783,192 @@ def _summarize_spatial(by_type: dict[str, list[dict]], end: dict, w) -> None:
         )
 
 
+def _format_event(e: dict) -> str:
+    """One event as one compact ``tail`` line (no trailing newline)."""
+    payload = " ".join(
+        f"{k}={json.dumps(v, default=str) if isinstance(v, (dict, list)) else v}"
+        for k, v in e.items()
+        if k not in _ENVELOPE
+    )
+    return (
+        f"[{float(e.get('t', 0.0)):10.3f}s] host{e.get('host', 0)} "
+        f"{e.get('event', '?'):<10} {payload}"
+    ).rstrip()
+
+
 def tail(events: list[dict], n: int = 20, out=None) -> int:
     out = out or sys.stdout
     if not events:
         out.write("no events found\n")
         return 1
     for e in events[-n:]:
-        payload = " ".join(
-            f"{k}={json.dumps(v, default=str) if isinstance(v, (dict, list)) else v}"
-            for k, v in e.items()
-            if k not in _ENVELOPE
-        )
-        out.write(
-            f"[{float(e.get('t', 0.0)):10.3f}s] host{e.get('host', 0)} "
-            f"{e.get('event', '?'):<10} {payload}\n".rstrip() + "\n"
-        )
+        out.write(_format_event(e) + "\n")
     return 0
+
+
+# --- Perfetto / Chrome trace export -----------------------------------------
+
+#: Duration-slice sources: event type -> the field holding the slice duration
+#: in seconds. These events are emitted at slice END, so start = emit − dur.
+_TRACE_DUR_FIELDS = {
+    "span": "seconds",
+    "step": "seconds",
+    "eval": "seconds",
+    "serve_batch": "seconds",
+    "serve_request": "latency_s",
+}
+
+
+def _flow_int(key: str) -> int:
+    """A stable positive flow id from a trace id (hex prefix when possible;
+    adopted non-hex ids and composite keys fall back to a checksum)."""
+    try:
+        return (int(str(key)[:12], 16) & 0x7FFFFFFF) or 1
+    except ValueError:
+        import zlib
+
+        return (zlib.crc32(str(key).encode("utf-8")) & 0x7FFFFFFF) or 1
+
+
+def _slice_name(e: dict) -> str:
+    kind = str(e.get("event"))
+    if kind == "span":
+        return str(e.get("name", "span"))
+    if kind == "step":
+        epoch, i = e.get("epoch"), e.get("i", e.get("step"))
+        if epoch is not None or i is not None:
+            return f"step {epoch if epoch is not None else '?'}:{i if i is not None else '?'}"
+        return "step"
+    if kind == "serve_request":
+        return f"request {e.get('request_id', '?')}"
+    if kind == "serve_batch":
+        return f"batch[{e.get('size', '?')}] {e.get('network') or ''}".rstrip()
+    return kind
+
+
+def perfetto_trace(events: list[dict]) -> dict:
+    """Render a merged event stream as one Chrome/Perfetto trace dict.
+
+    Layout: one *process* track per host (``pid`` = host index), one *thread*
+    track per (host, emitting thread) — span events stamp ``thread``, all
+    other events render on ``main``. Duration events (span / step / eval /
+    serve_request / serve_batch) are logged at their END, so slices start at
+    ``emit − duration``; everything else becomes a thread-scoped instant.
+
+    Cross-host alignment: each host's monotonic ``t`` is mapped onto the
+    shared wall clock via that host's median ``wall − t`` offset, preferring
+    heartbeat samples (they are emitted on a timer, not under load), which
+    cancels per-host process-start skew without trusting any single sample.
+
+    Flow arrows stitch (a) one ``trace_id`` appearing on ≥2 hosts — the fleet
+    view of one training step — and (b) each ``serve_batch`` slice to its
+    member request slices (the ``members`` id list stamped by the batcher).
+    The returned ``traceEvents`` list is metadata-first, then globally
+    ts-sorted; open the JSON at https://ui.perfetto.dev.
+    """
+    samples: dict[int, list[float]] = {}
+    beats: dict[int, list[float]] = {}
+    for e in events:
+        if e.get("wall") is None or e.get("t") is None:
+            continue
+        h = int(e.get("host", 0))
+        d = float(e["wall"]) - float(e["t"])
+        samples.setdefault(h, []).append(d)
+        if e.get("event") == "heartbeat":
+            beats.setdefault(h, []).append(d)
+    offsets = {h: _median(beats.get(h) or vals) for h, vals in samples.items()}
+    usable = [
+        e for e in events
+        if e.get("t") is not None and int(e.get("host", 0)) in offsets
+    ]
+    if not usable:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def _abs(e: dict) -> float:
+        return offsets[int(e.get("host", 0))] + float(e["t"])
+
+    base = min(_abs(e) for e in usable)
+    meta: list[dict] = []
+    tids: dict[tuple[int, str], int] = {}
+    for h in sorted(offsets):
+        meta.append({"ph": "M", "name": "process_name", "pid": h,
+                     "args": {"name": f"host{h}"}})
+        meta.append({"ph": "M", "name": "process_sort_index", "pid": h,
+                     "args": {"sort_index": h}})
+
+    def _tid(h: int, thread: str) -> int:
+        key = (h, thread)
+        if key not in tids:
+            tids[key] = sum(1 for k in tids if k[0] == h) + 1
+            meta.append({"ph": "M", "name": "thread_name", "pid": h,
+                         "tid": tids[key], "args": {"name": thread}})
+        return tids[key]
+
+    body: list[dict] = []
+    by_trace: dict[str, list[dict]] = {}
+    req_by_trace: dict[str, dict] = {}
+    batch_links: list[tuple[dict, list[str]]] = []
+    for e in usable:
+        kind = str(e.get("event"))
+        h = int(e.get("host", 0))
+        tid = _tid(h, str(e.get("thread") or "main"))
+        end_us = round((_abs(e) - base) * 1e6)
+        args = {k: v for k, v in e.items() if k not in _ENVELOPE}
+        dur_field = _TRACE_DUR_FIELDS.get(kind)
+        dur_s = e.get(dur_field) if dur_field else None
+        if dur_s is not None:
+            dur_us = max(1, round(float(dur_s) * 1e6))
+            rec = {"ph": "X", "name": _slice_name(e), "cat": kind, "pid": h,
+                   "tid": tid, "ts": max(0, end_us - dur_us), "dur": dur_us,
+                   "args": args}
+            body.append(rec)
+            trace_id = e.get("trace_id")
+            if trace_id:
+                by_trace.setdefault(str(trace_id), []).append(rec)
+                if kind == "serve_request":
+                    req_by_trace[str(trace_id)] = rec
+            if kind == "serve_batch" and e.get("members"):
+                batch_links.append((rec, [
+                    str(m["trace_id"]) for m in e["members"]
+                    if isinstance(m, dict) and m.get("trace_id")
+                ]))
+        else:
+            body.append({"ph": "i", "name": _slice_name(e), "cat": kind,
+                         "pid": h, "tid": tid, "ts": end_us, "s": "t",
+                         "args": args})
+
+    # (a) one trace id on ≥2 host tracks: arrows follow the step across the
+    # fleet (same-host spans already nest visually under their step slice)
+    for trace_id, recs in sorted(by_trace.items()):
+        if len({r["pid"] for r in recs}) < 2:
+            continue
+        recs = sorted(recs, key=lambda r: (r["ts"], r["pid"], r["tid"]))
+        fid = _flow_int(trace_id)
+        for i, r in enumerate(recs):
+            ph = "s" if i == 0 else ("f" if i == len(recs) - 1 else "t")
+            ev = {"ph": ph, "id": fid, "name": "trace", "cat": "trace",
+                  "pid": r["pid"], "tid": r["tid"], "ts": r["ts"]}
+            if ph == "f":
+                ev["bp"] = "e"  # bind to the enclosing slice, not the next one
+            body.append(ev)
+    # (b) batch -> member requests: one short flow per member, namespaced by
+    # the pair so it cannot collide with a member's own cross-host flow id
+    for batch_rec, member_ids in batch_links:
+        batch_tid = str(batch_rec["args"].get("trace_id", ""))
+        for mid in member_ids:
+            req = req_by_trace.get(mid)
+            if req is None:
+                continue
+            fid = _flow_int(f"{batch_tid}->{mid}")
+            body.append({"ph": "s", "id": fid, "name": "batch-member",
+                         "cat": "serve", "pid": req["pid"], "tid": req["tid"],
+                         "ts": req["ts"]})
+            body.append({"ph": "f", "bp": "e", "id": fid, "name": "batch-member",
+                         "cat": "serve", "pid": batch_rec["pid"],
+                         "tid": batch_rec["tid"], "ts": batch_rec["ts"]})
+
+    body.sort(key=lambda ev: (ev["ts"], ev.get("pid", 0), ev.get("tid", 0)))
+    return {"traceEvents": meta + body, "displayTimeUnit": "ms"}
 
 
 def _parse_event_line(raw: bytes) -> dict | None:
@@ -701,6 +986,124 @@ def _parse_event_line(raw: bytes) -> dict | None:
     return ev if isinstance(ev, dict) else None
 
 
+class _FileCursor:
+    """Incremental reader of one JSONL log for ``follow``: a byte offset plus
+    a head-of-file fingerprint (JSONL appends never rewrite the head, so a
+    changed head means a new file even when inode numbers recycle). Truncation
+    and recreation restart from the new content's top; a partial trailing line
+    stays buffered in the FILE — we rewind over it and re-read from its offset
+    next poll, so torn writes render exactly once."""
+
+    def __init__(self, path: Path, label: str = "") -> None:
+        self.path = path
+        self.label = label
+        self.pos = 0
+        self.head = b""
+
+    def bootstrap(self) -> list[dict]:
+        """Back-read a bounded tail of an existing file (raises OSError when
+        missing) — only the last events matter at startup, and a gigabyte
+        run_log must not stall or OOM the follow. Leaves the cursor at EOF."""
+        st = self.path.stat()
+        with self.path.open("rb") as fh:
+            self.head = fh.read(_FOLLOW_FP_BYTES)  # recreation fingerprint
+            size = st.st_size
+            if size > _FOLLOW_INIT_TAIL_BYTES:
+                fh.seek(size - _FOLLOW_INIT_TAIL_BYTES)
+                fh.readline()  # drop the line the seek cut in half
+                data = fh.read()
+            else:
+                data = self.head + fh.read()
+            self.pos = fh.tell()
+        lines = data.split(b"\n")
+        carry = lines.pop()  # partial trailing line: render once complete
+        self.pos -= len(carry)
+        return [ev for ev in (_parse_event_line(ln) for ln in lines) if ev]
+
+    def poll(self) -> list[dict] | None:
+        """New complete events since the last poll; ``None`` when the file is
+        currently unreadable (rotated away — keep polling for its return)."""
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return None
+        if size < self.pos:
+            self.pos = 0  # truncated in place: the new content is the run
+        if size == self.pos:
+            return []
+        try:
+            with self.path.open("rb") as fh:
+                if self.head and fh.read(len(self.head)) != self.head:
+                    # recreated under the same name (a new run, or rotation
+                    # moving content to a .segN sibling) — caught by the head
+                    # fingerprint even when the new file is already LARGER
+                    # than our offset: restart from its top
+                    self.pos = 0
+                if self.pos == 0:
+                    fh.seek(0)
+                    self.head = fh.read(_FOLLOW_FP_BYTES)
+                fh.seek(self.pos)
+                chunk = fh.read()
+        except OSError:
+            return None
+        self.pos += len(chunk)
+        *complete, carry = chunk.split(b"\n")
+        self.pos -= len(carry)
+        return [ev for ev in (_parse_event_line(ln) for ln in complete) if ev]
+
+
+class _StallWatch:
+    """The live twin of ``summarize``'s post-hoc stall check: once the stream
+    has shown enough events to know its cadence, a silence longer than
+    ``factor`` times that cadence prints one ``STALL?`` line (repeated only
+    after events resume and stop again). A ``run_end`` disarms it: a finished
+    run is quiet on purpose. Only the LIVE stream counts — the back-read
+    history's stamps are the writer's past."""
+
+    def __init__(self, out, factor: float, run_ended: bool) -> None:
+        self.out = out
+        self.factor = factor
+        self.run_ended = run_ended
+        self.intervals: list[float] = []
+        self.last_arrival = time.monotonic()
+        self.warned = False
+
+    def saw(self, new_events: list[dict]) -> None:
+        now_m = time.monotonic()
+        self.intervals.append(now_m - self.last_arrival)
+        del self.intervals[:-32]  # a bounded window tracks cadence drift
+        self.last_arrival = now_m
+        self.warned = False
+        self.run_ended = self.run_ended or any(
+            e.get("event") == "run_end" for e in new_events
+        )
+
+    def check(self) -> None:
+        if self.warned or self.run_ended or len(self.intervals) < 2:
+            return
+        cadence = _median(self.intervals)
+        age = time.monotonic() - self.last_arrival
+        if cadence > 0 and age > self.factor * cadence:
+            self.out.write(
+                f"STALL?   : no events for {age:.1f}s — {age / cadence:.0f}x the "
+                f"~{cadence:.1f}s cadence (hung collective or dead run?)\n"
+            )
+            if hasattr(self.out, "flush"):
+                self.out.flush()
+            self.warned = True
+
+
+def _host_label(name: str) -> str:
+    """A source label for interleaved directory follows: the ``.host<K>``
+    sidecar suffix when present, else ``host0`` (the primary's log)."""
+    m = re.search(r"\.host(\d+)\.(?:seg\d+\.)?jsonl$", name)
+    return f"host{m.group(1)}" if m else "host0"
+
+
+def _merge_key(e: dict) -> tuple:
+    return (e.get("wall", 0.0), e.get("host", 0), e.get("seq", 0))
+
+
 def follow(
     path: str | Path,
     n: int = 20,
@@ -709,126 +1112,112 @@ def follow(
     max_polls: int | None = None,
     stall_factor: float = STALL_FACTOR,
 ) -> int:
-    """Poll-based live follow of one run log: print the last ``n`` existing
+    """Poll-based live follow of a run log: print the last ``n`` existing
     events, then every new complete line as it lands (``tail -f``, but
-    schema-aware and corrupt-line tolerant). A directory follows its most
-    recently modified ``*.jsonl``. Truncation/recreation (a new run reusing
-    the log name) restarts from the new file's top. Ctrl-C exits cleanly with
-    status 0; ``max_polls`` bounds the loop for tests (None = forever).
-
-    Stall watch: once the live stream has shown enough events to know its
-    cadence, a silence longer than ``stall_factor`` times that cadence prints
-    one ``STALL?`` line (repeated only after events resume and stop again) —
-    the live twin of ``summarize``'s post-hoc check. A ``run_end`` disarms it:
-    a finished run is quiet on purpose."""
+    schema-aware and corrupt-line tolerant). A directory interleaves EVERY
+    ``*.jsonl`` inside — the primary log plus per-host sidecars — prefixing
+    each line with its source ``host<K>`` and merging each poll's batch in
+    wall-clock order; sidecars appearing mid-run are picked up. Truncation/
+    recreation (a new run reusing the log name) restarts from the new file's
+    top. Ctrl-C exits cleanly with status 0; ``max_polls`` bounds the loop
+    for tests (None = forever). See :class:`_StallWatch` for the silence
+    warning."""
     out = out or sys.stdout
     p = Path(path)
     if p.is_dir():
-        cands = sorted(p.glob("*.jsonl"))
-        if not cands:
-            raise FileNotFoundError(f"no .jsonl run logs under {p}")
-        p = max(cands, key=lambda f: f.stat().st_mtime)
-        out.write(f"following {p}\n")
-    # only the LAST n events matter at startup: back-read a bounded tail, not
-    # a multi-day log (a gigabyte run_log must not stall or OOM the follow)
-    st = p.stat()  # raises FileNotFoundError on a missing file
-    with p.open("rb") as fh:
-        head = fh.read(_FOLLOW_FP_BYTES)  # recreation fingerprint
-        size = st.st_size
-        if size > _FOLLOW_INIT_TAIL_BYTES:
-            fh.seek(size - _FOLLOW_INIT_TAIL_BYTES)
-            fh.readline()  # drop the line the seek cut in half
-            data = fh.read()
-        else:
-            data = head + fh.read()
-        pos = fh.tell()
-    lines = data.split(b"\n")
-    carry = lines.pop()  # partial trailing line: render once its newline lands
-    pos -= len(carry)
-    existing = [ev for ev in (_parse_event_line(ln) for ln in lines) if ev]
+        return _follow_dir(
+            p, n=n, interval=interval, out=out, max_polls=max_polls,
+            stall_factor=stall_factor,
+        )
+    cur = _FileCursor(p)
+    existing = cur.bootstrap()  # raises FileNotFoundError on a missing file
     if existing:
         tail(existing, n=n, out=out)
     if hasattr(out, "flush"):
         out.flush()
-    # stall-watch state: inter-event arrival cadence of the LIVE stream (the
-    # back-read history doesn't count — its stamps are the writer's past)
-    intervals: list[float] = []
-    last_arrival = time.monotonic()
-    stall_warned = False
-    run_ended = any(ev.get("event") == "run_end" for ev in existing)
-
-    def _saw_events(new_events: list[dict]) -> None:
-        nonlocal last_arrival, stall_warned, run_ended
-        now_m = time.monotonic()
-        intervals.append(now_m - last_arrival)
-        del intervals[:-32]  # a bounded window tracks cadence drift
-        last_arrival = now_m
-        stall_warned = False
-        run_ended = run_ended or any(e.get("event") == "run_end" for e in new_events)
-
-    def _check_stall() -> None:
-        nonlocal stall_warned
-        if stall_warned or run_ended or len(intervals) < 2:
-            return
-        cadence = _median(intervals)
-        age = time.monotonic() - last_arrival
-        if cadence > 0 and age > stall_factor * cadence:
-            out.write(
-                f"STALL?   : no events for {age:.1f}s — {age / cadence:.0f}x the "
-                f"~{cadence:.1f}s cadence (hung collective or dead run?)\n"
-            )
-            if hasattr(out, "flush"):
-                out.flush()
-            stall_warned = True
-
+    watch = _StallWatch(
+        out, stall_factor, any(e.get("event") == "run_end" for e in existing)
+    )
     polls = 0
     try:
         while max_polls is None or polls < max_polls:
             polls += 1
             time.sleep(max(0.0, interval))
-            try:
-                size = p.stat().st_size
-            except OSError:
-                _check_stall()
-                continue  # rotated away; keep polling for its return
-            if size < pos:
-                pos = 0  # truncated in place: the new content is the run
-            if size == pos:
-                _check_stall()
-                continue
-            try:
-                with p.open("rb") as fh:
-                    if head and fh.read(len(head)) != head:
-                        # recreated under the same name (a new run) — caught
-                        # by the head fingerprint even when the new file is
-                        # already LARGER than our offset: restart from its top
-                        pos = 0
-                    if pos == 0:
-                        fh.seek(0)
-                        head = fh.read(_FOLLOW_FP_BYTES)
-                    fh.seek(pos)
-                    chunk = fh.read()
-            except OSError:
-                continue
-            pos += len(chunk)
-            *complete, carry = chunk.split(b"\n")
-            # a partial line stays buffered in the FILE (we re-read from its
-            # offset next poll), so rewind over it rather than carrying state
-            pos -= len(carry)
-            printed: list[dict] = []
-            for raw in complete:
-                ev = _parse_event_line(raw)
-                if ev is not None:
-                    tail([ev], n=1, out=out)
-                    printed.append(ev)
+            printed = cur.poll() or []
+            for ev in printed:
+                out.write(_format_event(ev) + "\n")
             if printed:
-                _saw_events(printed)
+                watch.saw(printed)
             else:
-                _check_stall()
+                watch.check()
             if hasattr(out, "flush"):
                 out.flush()
     except KeyboardInterrupt:
         pass  # the documented exit path of a follow loop
+    return 0
+
+
+def _follow_dir(
+    p: Path,
+    n: int,
+    interval: float,
+    out,
+    max_polls: int | None,
+    stall_factor: float,
+) -> int:
+    """The directory arm of :func:`follow`: one cursor per ``*.jsonl``,
+    re-globbed every poll so per-host sidecars created mid-run join the
+    interleave from their first byte."""
+    cursors: dict[str, _FileCursor] = {}
+
+    def _scan() -> list[_FileCursor]:
+        for f in sorted(p.glob("*.jsonl")):
+            if f.name not in cursors:
+                cursors[f.name] = _FileCursor(f, label=_host_label(f.name))
+        return [cursors[name] for name in sorted(cursors)]
+
+    live = _scan()
+    if not live:
+        raise FileNotFoundError(f"no .jsonl run logs under {p}")
+    out.write(
+        "following " + ", ".join(f"{c.label}:{c.path.name}" for c in live) + "\n"
+    )
+    existing: list[tuple[dict, str]] = []
+    for c in live:
+        try:
+            existing.extend((e, c.label) for e in c.bootstrap())
+        except OSError:
+            continue  # raced a deletion; its cursor starts at the top
+    existing.sort(key=lambda pair: _merge_key(pair[0]))
+    for ev, label in existing[-n:]:
+        out.write(f"{label}| {_format_event(ev)}\n")
+    if hasattr(out, "flush"):
+        out.flush()
+    watch = _StallWatch(
+        out, stall_factor,
+        any(e.get("event") == "run_end" for e, _ in existing),
+    )
+    polls = 0
+    try:
+        while max_polls is None or polls < max_polls:
+            polls += 1
+            time.sleep(max(0.0, interval))
+            batch: list[tuple[dict, str]] = []
+            for c in _scan():
+                batch.extend((e, c.label) for e in c.poll() or [])
+            # one poll's harvest interleaves on the shared wall clock — the
+            # same order a post-hoc merged load would show
+            batch.sort(key=lambda pair: _merge_key(pair[0]))
+            for ev, label in batch:
+                out.write(f"{label}| {_format_event(ev)}\n")
+            if batch:
+                watch.saw([e for e, _ in batch])
+            else:
+                watch.check()
+            if hasattr(out, "flush"):
+                out.flush()
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -863,6 +1252,15 @@ def main(argv: list[str] | None = None) -> int:
         help="--follow: warn when the live stream goes silent for FACTOR x its "
         f"observed cadence (default {STALL_FACTOR:g})",
     )
+    p_trace = sub.add_parser(
+        "trace",
+        help="export the run as a Chrome/Perfetto trace (ui.perfetto.dev)",
+    )
+    p_trace.add_argument("log", help="run_log .jsonl file, or a directory of them")
+    p_trace.add_argument(
+        "--out", default="trace.json",
+        help="output path for the trace JSON (default trace.json)",
+    )
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:  # argparse exits for --help (0) and usage errors (2)
@@ -886,6 +1284,19 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if args.command == "summarize":
         return summarize(events, bad, stall_factor=args.stall_factor)
+    if args.command == "trace":
+        doc = perfetto_trace(events)
+        te = doc["traceEvents"]
+        Path(args.out).write_text(json.dumps(doc), encoding="utf-8")
+        n_slices = sum(1 for ev in te if ev.get("ph") == "X")
+        n_flows = sum(1 for ev in te if ev.get("ph") in ("s", "t", "f"))
+        hosts = sorted({ev["pid"] for ev in te if "pid" in ev})
+        print(
+            f"wrote {args.out}: {len(te)} trace events "
+            f"({n_slices} slices, {n_flows} flow points) across "
+            f"{len(hosts)} host track(s) — open at https://ui.perfetto.dev"
+        )
+        return 0
     return tail(events, n=args.n)
 
 

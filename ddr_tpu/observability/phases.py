@@ -49,9 +49,22 @@ class PhaseTimer:
         self._totals: dict[str, list[float]] = {}  # name -> [count, seconds]
 
     @contextmanager
-    def phase(self, name: str, into: dict[str, float] | None = None) -> Iterator[None]:
+    def phase(
+        self,
+        name: str,
+        into: dict[str, float] | None = None,
+        ctx: Any = None,
+    ) -> Iterator[None]:
         """Time a region; add its seconds to the run totals and (when given)
-        to the caller's per-step ``into`` dict. Exception-safe."""
+        to the caller's per-step ``into`` dict. Exception-safe.
+
+        ``ctx`` (a :class:`~ddr_tpu.observability.trace.SpanContext`, normally
+        the step's deterministic root) additionally emits one ``span`` event
+        named ``phase/<name>`` as a CHILD of that context — this is how the
+        phase buckets land on the merged Perfetto timeline with resolvable
+        parents even when they ran on the prefetch or checkpoint-writer
+        thread, where the ambient thread-local trace cannot follow. Without
+        ``ctx`` (or without an active recorder) nothing extra is emitted."""
         t0 = time.perf_counter()
         try:
             yield
@@ -63,6 +76,19 @@ class PhaseTimer:
                 agg[1] += dt
             if into is not None:
                 into[name] = round(into.get(name, 0.0) + dt, 6)
+            if ctx is not None:
+                from ddr_tpu.observability.events import get_recorder
+
+                rec = get_recorder()
+                if rec is not None:
+                    child = ctx.child()
+                    rec.emit(
+                        "span",
+                        name=f"phase/{name}",
+                        seconds=round(dt, 6),
+                        thread=threading.current_thread().name,
+                        **child.ids(),
+                    )
 
     def totals(self) -> dict[str, dict[str, float]]:
         """``{phase: {count, seconds}}`` run totals so far."""

@@ -83,10 +83,19 @@ def _labels_str(names: tuple[str, ...], values: tuple[str, ...], const: dict,
     return "{" + body + "}"
 
 
-def render_text(registry: MetricsRegistry | None = None) -> str:
-    """The whole registry in Prometheus text exposition format 0.0.4."""
+def render_text(
+    registry: MetricsRegistry | None = None,
+    extra_labels: dict | None = None,
+) -> str:
+    """The whole registry in Prometheus text exposition format 0.0.4.
+
+    ``extra_labels`` stamps every emitted series with the given label pairs —
+    the federation path uses it to expose the LOCAL registry as
+    ``replica="self"`` alongside scraped peers, through the exact renderer a
+    real replica would have answered with."""
     registry = registry or get_registry()
     const = registry.const_labels
+    base = dict(extra_labels or {})
     out: list[str] = []
     for metric in registry.collect():
         if metric.help:
@@ -98,17 +107,19 @@ def render_text(registry: MetricsRegistry | None = None) -> str:
                 cum = 0
                 for bound, n in zip(metric.buckets, state["buckets"]):
                     cum += n
-                    lab = _labels_str(metric.labels, key, const, {"le": _fmt(bound)})
+                    lab = _labels_str(
+                        metric.labels, key, const, {**base, "le": _fmt(bound)}
+                    )
                     out.append(f"{metric.name}_bucket{lab} {cum}")
                 cum += state["buckets"][-1]
-                lab = _labels_str(metric.labels, key, const, {"le": "+Inf"})
+                lab = _labels_str(metric.labels, key, const, {**base, "le": "+Inf"})
                 out.append(f"{metric.name}_bucket{lab} {cum}")
-                plain = _labels_str(metric.labels, key, const)
+                plain = _labels_str(metric.labels, key, const, base or None)
                 out.append(f"{metric.name}_sum{plain} {_fmt(state['sum'])}")
                 out.append(f"{metric.name}_count{plain} {state['count']}")
         else:
             for key, value in sorted(series.items()):
-                lab = _labels_str(metric.labels, key, const)
+                lab = _labels_str(metric.labels, key, const, base or None)
                 out.append(f"{metric.name}{lab} {_fmt(value)}")
     return "\n".join(out) + "\n"
 
@@ -347,9 +358,13 @@ def start_exporter(
 
 
 def maybe_start_exporter_from_env() -> MetricsHTTPServer | None:
-    """Start the exporter iff ``DDR_PROM_PORT`` is set to a valid port; a
-    malformed value or an unbindable port logs and returns None — a metrics
-    knob must never take the run down."""
+    """Start the exporter iff ``DDR_PROM_PORT`` is set to a valid port;
+    ``DDR_PROM_PORT=0`` binds an EPHEMERAL port (the resolved port shows in
+    the returned server's ``url``/``server_address`` and is stamped as
+    ``prom_port`` on the ``run_start`` event, so harnesses and the federation
+    scraper discover it instead of racing on fixed ports). A malformed value
+    or an unbindable port logs and returns None — a metrics knob must never
+    take the run down."""
     raw = os.environ.get("DDR_PROM_PORT")
     if not raw:
         return None

@@ -236,6 +236,22 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def series_count(self) -> int:
+        """Exposition sample lines this registry currently exports — what the
+        federation cardinality cap (``DDR_FEDERATE_MAX_SERIES``) counts, so a
+        replica can be sized against the fleet budget before it is scraped.
+        Histogram series render as ``len(buckets)+1`` bucket lines plus
+        ``_sum`` and ``_count``."""
+        with self._lock:
+            n = 0
+            for metric in self._metrics.values():
+                per_series = (
+                    len(metric.buckets) + 3  # buckets + +Inf + _sum + _count
+                    if isinstance(metric, Histogram) else 1
+                )
+                n += per_series * len(metric._series)
+            return n
+
     def reset(self) -> None:
         """Drop every instrument AND series (tests; production never resets —
         Prometheus counters are cumulative by contract)."""

@@ -152,16 +152,49 @@ def _stack() -> list[str]:
 
 
 @contextmanager
-def span(name: str, emit: bool = True) -> Iterator[None]:
+def span(name: str, emit: bool = True, parent: "object | None" = None) -> Iterator[None]:
     """Time a named region; nest freely (the recorded path is ``outer/inner``).
 
     Exception-safe: the nesting stack unwinds and the timing is recorded on
     every exit path. Emission goes to the active recorder only (``emit=False``
     keeps the profiler annotations but skips the JSONL event).
+
+    Trace context (:mod:`ddr_tpu.observability.trace`): while ``DDR_TRACE`` is
+    on and a recorder is active, the span joins the ambient trace — child of
+    the innermost enclosing span on this thread, or of the explicit ``parent``
+    :class:`~ddr_tpu.observability.trace.SpanContext` (the cross-thread hook:
+    thread-locals don't follow work onto prefetch/writer threads, so the loop
+    hands the step's context over explicitly). A span with neither starts its
+    own trace. The emitted ``span`` event then carries
+    ``trace_id``/``span_id``/``parent_id``; with ``DDR_TRACE=0`` nothing is
+    minted and the event is exactly the pre-trace shape.
     """
     stack = _stack()
     path = "/".join((*stack, name))
     stack.append(name)
+    span_ctx = None
+    if emit:
+        # direct symbol imports: the package attribute `trace` is the profiler
+        # context manager, so the trace-context MODULE must be addressed by
+        # its dotted path (ddr_tpu/observability/__init__.py explains)
+        from ddr_tpu.observability.events import get_recorder
+        from ddr_tpu.observability.trace import (
+            SpanContext,
+            current,
+            new_span_id,
+            new_trace_id,
+            push,
+            trace_enabled,
+        )
+
+        if trace_enabled() and get_recorder() is not None:
+            up = parent if parent is not None else current()
+            span_ctx = (
+                up.child()
+                if up is not None
+                else SpanContext(new_trace_id(), new_span_id())
+            )
+            push(span_ctx)
     t0 = time.perf_counter()
     try:
         with ExitStack() as ctx:
@@ -179,13 +212,17 @@ def span(name: str, emit: bool = True) -> Iterator[None]:
             yield
     finally:
         stack.pop()
+        if span_ctx is not None:
+            from ddr_tpu.observability.trace import pop as _ctx_pop
+
+            _ctx_pop()
         dt = time.perf_counter() - t0
         if emit:
             from ddr_tpu.observability.events import get_recorder
 
             rec = get_recorder()
             if rec is not None:
-                rec.record_span(path, dt)
+                rec.record_span(path, dt, ctx=span_ctx)
 
 
 def spanned(name: str) -> Callable:

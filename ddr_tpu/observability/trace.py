@@ -1,0 +1,207 @@
+"""Cross-host trace context: the ids that let one logical operation be followed
+across threads, processes, and replicas.
+
+The run log already records *what* happened (``step``/``span``/``serve_*``
+events) and *where* (the ``host`` envelope field); what it cannot answer is
+"which events belong to the same logical operation" — the question every
+multi-host straggler hunt and every serving-path latency investigation starts
+with. This module mints the three ids that make events joinable:
+
+- ``trace_id`` — one logical operation end to end (one training step across
+  every host; one forecast request from HTTP admission to reply);
+- ``span_id`` — one timed region inside a trace;
+- ``parent_id`` — the enclosing span, so a merged log reconstructs the tree.
+
+:class:`SpanContext` is the immutable carrier; a thread-local stack makes the
+current context ambient for same-thread nesting (``spans.span`` pushes/pops
+it), and explicit passing covers the cross-thread hops (prefetch thread,
+checkpoint writer, micro-batcher) where thread-locals cannot follow.
+
+**Multi-host agreement without collectives**: hosts of one ``jax.distributed``
+run already execute the same step sequence in lockstep, so
+:func:`step_context` derives the step's ``trace_id``/root ``span_id``
+*deterministically* from ``(run id, step index)`` — every host stamps the same
+ids on step ``n`` without exchanging a byte. The run id comes from
+``DDR_RUN_ID`` when the launcher sets one, else from the run's own identity
+(:func:`run_trace_seed`), which is identical across hosts by construction
+(same config, same save_path).
+
+Tracing is ON by default and host-side only — ids are minted outside jit, ride
+existing events, and add zero jit-cache entries. ``DDR_TRACE=0`` turns every
+mint site into a None (the events simply carry no ids), which is the control
+arm of the overhead acceptance check. Stdlib-only and jax-free (package
+contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import re
+import threading
+import uuid
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+__all__ = [
+    "SpanContext",
+    "trace_enabled",
+    "new_trace_id",
+    "new_span_id",
+    "derive_id",
+    "adopt_trace_id",
+    "current",
+    "push",
+    "pop",
+    "context",
+    "run_trace_seed",
+    "step_context",
+]
+
+_tls = threading.local()
+
+#: Supplied trace ids (the ``X-DDR-Trace-Id`` header) are sanitized to visible
+#: ASCII and capped — same discipline as ``make_request_id`` — so a hostile or
+#: confused client cannot inject control characters into the run log.
+_TRACE_ID_STRIP = re.compile(r"[^\x21-\x7e]")
+_TRACE_ID_MAX = 64
+
+
+def trace_enabled() -> bool:
+    """Master switch: ``DDR_TRACE`` (default on; ``0``/``false``/``no``/``off``
+    disables every mint site — events then carry no ids at all)."""
+    return os.environ.get("DDR_TRACE", "1").strip().lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """One span's identity within a trace. Immutable; derive children with
+    :meth:`child` rather than mutating."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+
+    def child(self, span_id: str | None = None) -> "SpanContext":
+        """A new span under this one: same trace, this span as parent."""
+        return SpanContext(
+            trace_id=self.trace_id,
+            span_id=span_id or new_span_id(),
+            parent_id=self.span_id,
+        )
+
+    def ids(self) -> dict[str, str]:
+        """The event-payload slice: ``trace_id``/``span_id`` (+``parent_id``
+        when this span has one) — what emit sites splat into events."""
+        out = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id is not None:
+            out["parent_id"] = self.parent_id
+        return out
+
+
+def new_trace_id() -> str:
+    """A fresh random 16-hex trace id (one logical operation)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    """A fresh random 12-hex span id (one region within a trace)."""
+    return uuid.uuid4().hex[:12]
+
+
+def derive_id(*parts: Any, length: int = 16) -> str:
+    """Deterministic id from ``parts`` — the multi-host agreement primitive:
+    every host hashing the same parts mints the same id, no collectives."""
+    h = hashlib.sha1("|".join(str(p) for p in parts).encode("utf-8"))
+    return h.hexdigest()[:length]
+
+
+def adopt_trace_id(supplied: Any = None) -> str:
+    """Sanitize a caller-supplied trace id (HTTP header / client kwarg), or
+    mint a fresh one when nothing usable was supplied."""
+    if supplied:
+        cleaned = _TRACE_ID_STRIP.sub("", str(supplied))[:_TRACE_ID_MAX]
+        if cleaned:
+            return cleaned
+    return new_trace_id()
+
+
+# ---------------------------------------------------------------------------
+# Ambient context: a thread-local stack (same-thread nesting only — pass
+# contexts explicitly across threads).
+# ---------------------------------------------------------------------------
+
+
+def _stack() -> list[SpanContext]:
+    s = getattr(_tls, "ctx", None)
+    if s is None:
+        s = _tls.ctx = []
+    return s
+
+
+def current() -> SpanContext | None:
+    """The innermost active context on THIS thread (None outside any span)."""
+    s = _stack()
+    return s[-1] if s else None
+
+
+def push(ctx: SpanContext) -> None:
+    _stack().append(ctx)
+
+
+def pop() -> None:
+    s = _stack()
+    if s:
+        s.pop()
+
+
+@contextmanager
+def context(ctx: SpanContext | None) -> Iterator[SpanContext | None]:
+    """Make ``ctx`` the ambient context for the body (None = no-op) — the
+    cross-thread re-entry point: a worker thread handed a context enters it
+    here and same-thread ``span()`` nesting works as usual below it."""
+    if ctx is None:
+        yield None
+        return
+    push(ctx)
+    try:
+        yield ctx
+    finally:
+        pop()
+
+
+# ---------------------------------------------------------------------------
+# Run / step identity: the deterministic multi-host scheme.
+# ---------------------------------------------------------------------------
+
+
+def run_trace_seed(cfg: Any = None) -> str:
+    """The run-identity string every host agrees on: ``DDR_RUN_ID`` when the
+    launcher set one, else the config's ``name`` + ``save_path`` (identical
+    across hosts of one launch by construction), else a bare constant —
+    single-process runs don't need cross-host agreement anyway."""
+    rid = os.environ.get("DDR_RUN_ID")
+    if rid:
+        return str(rid)
+    if cfg is not None:
+        name = getattr(cfg, "name", None)
+        save = getattr(getattr(cfg, "params", None), "save_path", None)
+        if name is not None or save is not None:
+            return f"{name}:{save}"
+    return "run"
+
+
+def step_context(seed: str, step: Any) -> SpanContext | None:
+    """The root context of training step ``step``: trace and root-span ids
+    derived from ``(seed, step)``, so every host of a multi-process run stamps
+    the SAME ids on the same step via its already-synchronized step counter
+    (``step`` may be an int or an ``"epoch:batch"`` composite — anything the
+    hosts agree on) — the merged timeline joins host tracks on ``trace_id``
+    for free. Returns None when tracing is off."""
+    if not trace_enabled():
+        return None
+    trace_id = derive_id("step", seed, step)
+    return SpanContext(trace_id=trace_id, span_id=derive_id("root", trace_id, length=12))

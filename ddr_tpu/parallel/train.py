@@ -351,13 +351,16 @@ class ParallelTrainer:
 
     # ---- host-side batch preparation (prefetch-thread safe) ----
 
-    def prepare(self, rd: RoutingData, q_prime: np.ndarray) -> PreparedBatch:
+    def prepare(self, rd: RoutingData, q_prime: np.ndarray, ctx=None) -> PreparedBatch:
         """Batch -> sharded device inputs + the step to run.
 
         ``q_prime`` is the already-flow-scaled (T, N) lateral inflow in the
-        batch's original reach order.
+        batch's original reach order. ``ctx`` (the step's
+        :class:`~ddr_tpu.observability.trace.SpanContext`) parents the
+        ``prepare`` span — prepare runs on the prefetch thread, where the
+        ambient trace can't follow.
         """
-        with span("prepare"):
+        with span("prepare", parent=ctx):
             return self._prepare(rd, q_prime)
 
     def _prepare(self, rd: RoutingData, q_prime: np.ndarray) -> PreparedBatch:
@@ -510,7 +513,7 @@ class ParallelTrainer:
 
     # ---- device step ----
 
-    def step(self, prep: PreparedBatch, params, opt_state, obs_daily, obs_mask):
+    def step(self, prep: PreparedBatch, params, opt_state, obs_daily, obs_mask, ctx=None):
         """Run one training step; same returns as ``make_batch_train_step``:
         ``(params, opt_state, loss, daily)``.
 
@@ -524,7 +527,7 @@ class ParallelTrainer:
 
         obs_daily = jnp.asarray(obs_daily)
         obs_mask = jnp.asarray(obs_mask)
-        with self.mesh, span(f"step-{prep.mode}"):
+        with self.mesh, span(f"step-{prep.mode}", parent=ctx):
             if prep.mode == "gspmd":
                 return self._gspmd_step(
                     params,

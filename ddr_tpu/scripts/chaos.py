@@ -88,6 +88,24 @@ def _emit_chaos(**payload: Any) -> None:
         rec.emit("chaos", **payload)
 
 
+def _export_trace(log_dir: Path, out: Path) -> str | None:
+    """Best-effort merged Perfetto export of a drill's run logs — the
+    post-mortem timeline ("where did the kill land, what stalled after it")
+    rides the report for free; never fails the drill."""
+    try:
+        from ddr_tpu.observability.metrics_cli import load_events, perfetto_trace
+
+        doc = perfetto_trace(load_events(log_dir))
+        if not doc["traceEvents"]:
+            return None
+        out.write_text(json.dumps(doc), encoding="utf-8")
+        log.info(f"drill timeline written to {out} — open at https://ui.perfetto.dev")
+        return str(out)
+    except Exception as e:  # noqa: BLE001 - a post-mortem nicety, never fatal
+        log.debug(f"perfetto export of {log_dir} skipped: {e}")
+        return None
+
+
 def _read_jsonl(path: Path) -> list[dict]:
     """Best-effort JSONL parse (a log mid-write has a torn last line)."""
     if not path.exists():
@@ -436,6 +454,7 @@ def run_chaos_train(args) -> dict[str, Any]:
         "mean_recovery_s": (
             round(sum(recoveries) / len(recoveries), 3) if recoveries else None
         ),
+        "trace": _export_trace(chaos_dir, workdir / "chaos_trace.json"),
         "tolerance": args.tolerance,
         "passed": passed,
     }
@@ -571,6 +590,7 @@ def run_chaos_nan_storm(args) -> dict[str, Any]:
         "params_max_abs_delta": (
             None if params_delta == float("inf") else round(params_delta, 9)
         ),
+        "trace": _export_trace(chaos_dir, workdir / "chaos_trace.json"),
         "tolerance": args.tolerance,
         "passed": passed,
     }

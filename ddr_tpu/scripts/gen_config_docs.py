@@ -43,7 +43,21 @@ variables. Families with their own reference tables are linked.
 - `DDR_METRICS_DIR`, `DDR_HEARTBEAT_EVERY`, `DDR_METRICS_FLUSH_EVERY`,
   `DDR_PROM_PORT`, `DDR_HEALTH_*`, `DDR_SKILL_*`, `DDR_SLO_*` — observability
   (incl. spatial attribution & hydrologic skill, SLO burn-rate accounting):
-  see docs/observability.md.
+  see docs/observability.md. `DDR_PROM_PORT=0` binds an ephemeral port; the
+  resolved port is logged and stamped as `prom_port` on `run_start`.
+- `DDR_METRICS_MAX_MB` — run-log size bound: the active
+  `run_log.<cmd>.jsonl` rotates into numbered `.segN` segments and pruning
+  keeps the first segment (`run_start`) plus the newest few. Unset =
+  unbounded: see docs/observability.md "Run-log rotation".
+- `DDR_TRACE` (default on; `0` disables every id mint site), `DDR_RUN_ID`
+  (the cross-host run identity trace ids derive from; falls back to the
+  run's `name:save_path`) — fleet trace propagation: see
+  docs/observability.md "Fleet observability".
+- `DDR_FEDERATE_REPLICAS` (comma-separated `label=url` scrape targets for
+  `ddr obs federate` and `/metrics?federated=1`),
+  `DDR_FEDERATE_MAX_SERIES` (hard cardinality cap on the federated page,
+  default 2000) — metrics federation: see docs/observability.md "Fleet
+  observability".
 - `DDR_PROGRAM_CARDS` (compiled-program cost attribution opt-out),
   `DDR_PROFILE_DIR` (jax.profiler trace capture dir) — cost attribution and
   profiling: see docs/observability.md.

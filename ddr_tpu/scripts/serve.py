@@ -69,9 +69,23 @@ def build_service(
 
 
 def serve(cfg: Config, serve_cfg: ServeConfig | None = None) -> int:
+    from ddr_tpu.observability.federate import replicas_from_env
+    from ddr_tpu.observability.trace import trace_enabled
     from ddr_tpu.serving.http_api import serve_http
 
     service = build_service(cfg, serve_cfg)
+    # fleet surface, stated once at startup so an operator reading the boot
+    # log knows what this replica will answer for
+    if trace_enabled():
+        log.info("trace propagation on: X-DDR-Trace-Id adopted/minted per request")
+    else:
+        log.info("trace propagation OFF (DDR_TRACE=0): responses carry no trace ids")
+    replicas = replicas_from_env()
+    if replicas:
+        log.info(
+            f"/metrics?federated=1 federates {len(replicas)} replica(s): "
+            + ", ".join(label for label, _ in replicas)
+        )
     try:
         serve_http(service, block=True)
     except KeyboardInterrupt:

@@ -328,6 +328,15 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     # `phases` dict and in the run_end rollup; the Prometheus tee exports the
     # same numbers as ddr_phase_seconds histograms.
     phase_timer = PhaseTimer()
+    # Cross-host trace identity (docs/observability.md "Fleet observability"):
+    # each executed batch is one trace, its ids derived deterministically from
+    # (run seed, epoch, batch) — every host of a jax.distributed run walks the
+    # same seeded loader in lockstep, so all hosts stamp the SAME trace_id on
+    # the same step with zero collectives. DDR_TRACE=0 turns every mint site
+    # into None and the events carry no ids (the overhead control arm).
+    from ddr_tpu.observability.trace import run_trace_seed, step_context
+
+    trace_seed = run_trace_seed(cfg)
     # Telemetry (active when main() opened a run log; None-guarded otherwise):
     # step/compile/heartbeat events per docs/observability.md. The parallel
     # trainer owns its own tracker (its LRU emits the compile events); the
@@ -606,7 +615,11 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 i, rd = item
                 phase_s: dict[str, float] = {}
                 anomaly = None
-                with phase_timer.phase("data_load", into=phase_s):
+                # Same deterministic ids the main thread derives for this
+                # batch — the prefetch thread runs a batch ahead, so the ctx
+                # is recomputed here rather than handed across.
+                ctx = step_context(trace_seed, f"{epoch}:{i}")
+                with phase_timer.phase("data_load", into=phase_s, ctx=ctx):
                     if inject_data_load is not None:
                         inject_data_load(epoch=epoch, batch=i)
                     q_prime = np.asarray(flow(routing_dataclass=rd), dtype=np.float32)
@@ -622,9 +635,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                         # + bounded data_anomaly event land on the main thread
                         anomaly = validator.scan(q_prime, epoch=epoch, batch=i)
                     obs_daily, obs_mask = daily_observation_targets(rd)
-                with phase_timer.phase("host_prep", into=phase_s):
+                with phase_timer.phase("host_prep", into=phase_s, ctx=ctx):
                     if par is not None:
-                        payload = par.prepare(rd, q_prime)
+                        payload = par.prepare(rd, q_prime, ctx=ctx)
                         attrs = rd.normalized_spatial_attributes
                     else:
                         network, channels, gauges = prepare_batch(rd, slope_min)
@@ -645,6 +658,12 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 else prefetch(_batches(), _prepare)
             )
             for i, rd, payload, attrs, obs_daily, obs_mask, anomaly, phase_s in batch_stream:
+                # This batch's trace root (same ids the prefetch thread used
+                # for data_load/host_prep — deterministic derivation, not a
+                # handoff). None with DDR_TRACE=0.
+                step_ctx = step_context(trace_seed, f"{epoch}:{i}")
+                if ckpt_writer is not None:
+                    ckpt_writer.trace_ctx = step_ctx
                 if anomaly is not None and validator.note(anomaly) == "quarantine":
                     # the bad tile never reaches the device. With the
                     # supervisor on, the drop is a ladder `skip` (bounded, the
@@ -703,7 +722,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                         )
                     )
                 with throughput.batch(rd.n_segments, n_timesteps), phase_timer.phase(
-                    "device_step", into=phase_s
+                    "device_step", into=phase_s, ctx=step_ctx
                 ):
                     if inject_device_step is not None:
                         # host-side, before dispatch: `step` is the 0-based
@@ -720,11 +739,12 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             inject_device_step(step=n_done, epoch=epoch, batch=i)
                     if par is not None:
                         out = par.step(
-                            payload, params, opt_state, obs_daily, obs_mask
+                            payload, params, opt_state, obs_daily, obs_mask,
+                            ctx=step_ctx,
                         )
                     else:
                         q_prime, network, channels, gauges = payload
-                        with span("step-single"):
+                        with span("step-single", parent=step_ctx):
                             out = step(
                                 params,
                                 opt_state,
@@ -829,14 +849,14 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                     # plotting, or checkpointing — its `daily` is the
                     # violating solve's output and its params were restored
                     if step_good:
-                        with phase_timer.phase("eval", into=phase_s):
+                        with phase_timer.phase("eval", into=phase_s, ctx=step_ctx):
                             target = np.where(obs_mask, obs_daily, np.nan)
                             metrics = Metrics(pred=daily.T, target=target.T)
                             log_metrics(metrics, header=f"epoch {epoch} mini-batch {i}")
 
                         if multiprocess:
                             # collective multi-host checkpoint (all processes call it)
-                            with phase_timer.phase("checkpoint", into=phase_s):
+                            with phase_timer.phase("checkpoint", into=phase_s, ctx=step_ctx):
                                 save_state_orbax(
                                     cfg.params.save_path / "saved_models",
                                     cfg.name,
@@ -859,7 +879,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             if w < daily.shape[0]:  # an all-warmup window has no score to print
                                 plotted = Metrics(pred=daily[w:, -1][None], target=target[w:, -1][None])
                                 legend = {"nse": float(plotted.nse[0])}
-                            with phase_timer.phase("eval", into=phase_s):
+                            with phase_timer.phase("eval", into=phase_s, ctx=step_ctx):
                                 plot_time_series(
                                     daily[:, -1],
                                     target[:, -1],
@@ -876,7 +896,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                 # thread's checkpoint_io bucket, overlapping the
                                 # next device_step. Sync (DDR_CKPT_ASYNC=0): the
                                 # whole write bills to this phase, as before.
-                                with phase_timer.phase("checkpoint", into=phase_s):
+                                with phase_timer.phase("checkpoint", into=phase_s, ctx=step_ctx):
                                     if ckpt_fmt == "orbax":
                                         saver = (
                                             ckpt_writer.save_orbax
@@ -919,6 +939,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             # marker just lets a step-stream reader drop
                             # recovered batches without a join
                             **({"recovered": recovered} if recovered else {}),
+                            # the step IS its trace's root span: same ids on
+                            # every host's step event for this (epoch, batch)
+                            **(step_ctx.ids() if step_ctx is not None else {}),
                         )
                 n_done += 1
                 # Per-host liveness: every host emits (each to its own log

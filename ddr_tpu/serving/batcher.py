@@ -53,15 +53,21 @@ class QueueFullError(RuntimeError):
 
 class RequestShedError(RuntimeError):
     """Set on a request's future when it is shed (queue-full victim or expired
-    deadline); carries the machine-readable reason and the victim's request id
-    (when the submitter stamped one in ``meta``) for error-body echo."""
+    deadline); carries the machine-readable reason and the victim's request
+    and trace ids (when the submitter stamped them in ``meta``) for error-body
+    echo — a shed reply must still be joinable to its distributed trace."""
 
     def __init__(
-        self, reason: str, message: str, request_id: str | None = None
+        self,
+        reason: str,
+        message: str,
+        request_id: str | None = None,
+        trace_id: str | None = None,
     ) -> None:
         super().__init__(message)
         self.reason = reason
         self.request_id = request_id
+        self.trace_id = trace_id
 
 
 @dataclasses.dataclass
@@ -195,6 +201,7 @@ class MicroBatcher:
             reason,
             f"request shed ({reason})",
             request_id=req.meta.get("request_id"),
+            trace_id=req.meta.get("trace_id"),
         )
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(err)

@@ -17,6 +17,10 @@ Endpoints (all JSON unless noted):
 - ``GET /metrics`` — Prometheus text exposition of the live registry
   (request latency histogram, occupancy, queue depth, sheds, compiles,
   hot-reloads, ``ddr_health_status``; docs/observability.md has the table);
+  ``GET /metrics?federated=1`` answers for the FLEET instead: the replicas in
+  ``DDR_FEDERATE_REPLICAS`` are scraped and re-exposed with ``replica``
+  labels (this process's own registry rides along as ``replica="self"``),
+  under the ``DDR_FEDERATE_MAX_SERIES`` cardinality cap;
 - ``GET /v1/models`` / ``GET /v1/networks`` / ``GET /v1/stats`` — registry,
   domains, and queue/compile/latency/health counters (the two slices are
   computed alone — no full stats snapshot per poll);
@@ -24,13 +28,17 @@ Endpoints (all JSON unless noted):
   [[...]], "t0"?: int, "gauges"?: [int], "deadline_ms"?: num}``; answers
   ``{"runoff": [[...]], "version": int, "engine": str, "request_id": str,
   "queue_s": num, "execute_s": num, ...}``. Request tracing: a caller-supplied
-  ``X-DDR-Request-Id`` header is sanitized and adopted as the request's trace
-  id (else one is minted at admission); EVERY forecast-path response — success,
+  ``X-DDR-Request-Id`` header is sanitized and adopted as the request's id
+  (else one is minted at admission); EVERY forecast-path response — success,
   400/404 validation, 429 rejection, 503 shed — echoes it in the
   ``X-DDR-Request-Id`` header and carries ``request_id`` in the JSON body, and
   shed/reject bodies additionally carry a machine-readable ``reason``
   (``queue-full``, ``deadline``, ``timeout``) so clients can branch without
-  parsing prose;
+  parsing prose. ``X-DDR-Trace-Id`` rides the same contract for the
+  DISTRIBUTED trace id (adopted or minted, echoed as header + body
+  ``trace_id``) — the id that follows one operation across services and onto
+  the request's ``serve_request``/``serve_shed`` events; request ids are per
+  hop. ``DDR_TRACE=0`` suppresses trace ids entirely;
 - ``POST /v1/profile?seconds=N`` — start an on-demand ``jax.profiler``
   capture of live traffic into ``DDR_METRICS_DIR`` (fallbacks: the active
   run-log directory, then a tmpdir); answers 202 with the trace dir, 409
@@ -53,6 +61,7 @@ from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from ddr_tpu.observability.trace import adopt_trace_id, trace_enabled
 from ddr_tpu.serving.batcher import QueueFullError, RequestShedError
 from ddr_tpu.serving.service import ForecastService, make_request_id
 
@@ -109,7 +118,25 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             from ddr_tpu.observability.prometheus import CONTENT_TYPE, render_text
 
-            self._send_text(200, render_text(svc.metrics), CONTENT_TYPE)
+            query = parse_qs(urlsplit(self.path).query)
+            if query.get("federated", ["0"])[0] not in ("", "0", "false"):
+                # fleet view: scrape the replicas this one knows about
+                # (DDR_FEDERATE_REPLICAS) and fold the LOCAL registry in as
+                # replica="self" — any replica can answer for its fleet
+                from ddr_tpu.observability.federate import (
+                    federate_text,
+                    replicas_from_env,
+                )
+
+                self._send_text(
+                    200,
+                    federate_text(
+                        replicas_from_env(), local=("self", svc.metrics)
+                    ),
+                    CONTENT_TYPE,
+                )
+            else:
+                self._send_text(200, render_text(svc.metrics), CONTENT_TYPE)
         elif path == "/v1/stats":
             self._send(200, svc.stats())
         elif path == "/v1/models":
@@ -147,18 +174,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route for {self.path}"})
             return
         svc = self.server.service
-        # the trace id exists from the first byte: a caller-supplied
-        # X-DDR-Request-Id is adopted (sanitized), else minted here, and every
-        # response on this path — including validation/reject/shed errors —
-        # echoes it (header + body), so the edge can always join its logs to
-        # the server's serve_request events
+        # ids exist from the first byte: a caller-supplied X-DDR-Request-Id is
+        # adopted (sanitized), else minted here, and every response on this
+        # path — including validation/reject/shed errors — echoes it (header +
+        # body), so the edge can always join its logs to the server's
+        # serve_request events. X-DDR-Trace-Id works the same way (adopted or
+        # minted; suppressed entirely under DDR_TRACE=0) and is the id that
+        # follows the request ACROSS services — request ids are per hop.
         rid = make_request_id(self.headers.get("X-DDR-Request-Id"))
+        tid = (
+            adopt_trace_id(self.headers.get("X-DDR-Trace-Id"))
+            if trace_enabled()
+            else None
+        )
 
         def send(code: int, payload: dict, headers: dict | None = None) -> None:
             payload.setdefault("request_id", rid)
-            self._send(
-                code, payload, headers={"X-DDR-Request-Id": rid, **(headers or {})}
-            )
+            hdrs = {"X-DDR-Request-Id": rid, **(headers or {})}
+            if tid is not None:
+                payload.setdefault("trace_id", tid)
+                hdrs.setdefault("X-DDR-Trace-Id", tid)
+            self._send(code, payload, headers=hdrs)
 
         if not svc.ready:
             send(503, {"error": "service is warming up", "status": "warming"})
@@ -189,6 +225,7 @@ class _Handler(BaseHTTPRequestHandler):
                 gauges=body.get("gauges"),
                 deadline_s=None if deadline_ms is None else float(deadline_ms) / 1e3,
                 request_id=rid,
+                trace_id=tid,
             )
         except QueueFullError as e:
             send(

@@ -59,6 +59,13 @@ import numpy as np
 
 from ddr_tpu.observability import CompileTracker, get_recorder, span
 from ddr_tpu.observability.health import HealthConfig, HealthWatchdog
+from ddr_tpu.observability.trace import (
+    SpanContext,
+    adopt_trace_id,
+    new_span_id,
+    new_trace_id,
+    trace_enabled,
+)
 from ddr_tpu.observability.prometheus import declare_serve_metrics, event_tee
 from ddr_tpu.observability.slo import SloConfig, SloTracker
 from ddr_tpu.serving.batcher import (
@@ -94,6 +101,12 @@ def make_request_id(supplied: Any = None) -> str:
         if rid:
             return rid
     return uuid.uuid4().hex[:16]
+
+
+def _trace_fields(req: "ForecastRequest") -> dict:
+    """The trace-id slice of a request's meta (empty when tracing was off at
+    admission) — splatted into every event that terminal-states the request."""
+    return {k: req.meta[k] for k in ("trace_id", "span_id") if k in req.meta}
 
 
 @dataclasses.dataclass
@@ -421,6 +434,7 @@ class ForecastService:
         gauges: Any | None = None,
         deadline_s: float | None = None,
         request_id: str | None = None,
+        trace_id: str | None = None,
     ) -> Future:
         """Admit one forecast request; returns its Future.
 
@@ -430,8 +444,12 @@ class ForecastService:
         (gauge indices when the network has a gauge set, reach indices
         otherwise; default all). ``request_id`` propagates a caller's trace id
         (sanitized); omitted, one is minted — either way it rides every event
-        and the result dict. Invalid requests raise immediately — validation
-        failures are the caller's bug, not load."""
+        and the result dict. ``trace_id`` adopts a caller's distributed-trace
+        id (the HTTP front reads ``X-DDR-Trace-Id``); with tracing on
+        (``DDR_TRACE``, default) the request becomes the root span of that
+        trace and every one of its events carries ``trace_id``/``span_id``.
+        Invalid requests raise immediately — validation failures are the
+        caller's bug, not load."""
         net = self._networks.get(network)
         if net is None:
             raise ValueError(f"unknown network {network!r}")
@@ -472,11 +490,18 @@ class ForecastService:
             self.serve_cfg.deadline_s if deadline_s is None else float(deadline_s)
         )
         rid = make_request_id(request_id)
+        meta = {"network": network, "model": model, "request_id": rid}
+        if trace_enabled():
+            # the request root span: adopt the caller's trace id (or mint) —
+            # the batch worker later flow-links the serve_batch span to these
+            # ids, so one request is followable admission -> batch -> reply
+            meta["trace_id"] = adopt_trace_id(trace_id)
+            meta["span_id"] = new_span_id()
         req = ForecastRequest(
             key=(network, model),
             payload={"q_prime": qp, "gauges": gauge_sel},
             deadline=deadline,
-            meta={"network": network, "model": model, "request_id": rid},
+            meta=meta,
         )
         try:
             self._batcher.submit(req)
@@ -490,6 +515,7 @@ class ForecastService:
                 model=model,
                 request_id=rid,
                 age_s=0.0,
+                **_trace_fields(req),
             )
             self._emit(
                 "serve_request",
@@ -498,6 +524,7 @@ class ForecastService:
                 model=model,
                 request_id=rid,
                 latency_s=0.0,
+                **_trace_fields(req),
                 # None, not 0.0: a rejected arrival never queued, and a flood
                 # of zeros would deflate the queue-wait histogram exactly when
                 # its percentiles are the overload signal
@@ -537,6 +564,7 @@ class ForecastService:
                     latency_s=round(now - r.admitted, 6),
                     queue_s=self._queue_seconds(r),
                     slo_ok=False,
+                    **_trace_fields(r),
                 )
                 self._observe_slo(False)
             raise
@@ -569,6 +597,13 @@ class ForecastService:
             runoff = self._run_batch(net, entry, qp, n_live=len(reqs))
             seconds = time.perf_counter() - t0
         now = time.monotonic()
+        # The batch span: its own trace (a batch outlives no single request),
+        # flow-linked to every member request's root span via `members` — the
+        # Perfetto export renders these as flow arrows batch -> requests.
+        batch_ctx = (
+            SpanContext(new_trace_id(), new_span_id()) if trace_enabled() else None
+        )
+        members = [ids for ids in (_trace_fields(r) for r in reqs) if ids]
         # All telemetry is written BEFORE any future resolves: a client that
         # reads the run log right after its result must find its own events.
         self._emit(
@@ -581,6 +616,8 @@ class ForecastService:
             seconds=round(seconds, 6),
             version=entry.version,
             queue_depth=reqs[0].meta.get("queue_depth"),
+            **(batch_ctx.ids() if batch_ctx is not None else {}),
+            **({"members": members} if batch_ctx is not None and members else {}),
         )
         outs = []
         exec_s = round(seconds, 6)
@@ -604,6 +641,7 @@ class ForecastService:
                 version=entry.version,
                 n_gauges=int(out.shape[1]),
                 slo_ok=good,
+                **_trace_fields(r),
             )
             self._observe_slo(good)
         for r, out in zip(reqs, outs):
@@ -618,6 +656,7 @@ class ForecastService:
                         "request_id": r.meta.get("request_id"),
                         "queue_s": self._queue_seconds(r),
                         "execute_s": exec_s,
+                        **_trace_fields(r),
                     }
                 )
 
@@ -847,6 +886,7 @@ class ForecastService:
             model=req.meta.get("model"),
             request_id=req.meta.get("request_id"),
             age_s=round(req.age(), 6),
+            **_trace_fields(req),
         )
         self._emit(
             "serve_request",
@@ -857,6 +897,7 @@ class ForecastService:
             latency_s=round(req.age(), 6),
             queue_s=self._queue_seconds(req),
             slo_ok=False,
+            **_trace_fields(req),
         )
         self._observe_slo(False)
 

@@ -1295,6 +1295,11 @@ class AsyncCheckpointWriter:
         self._phase_timer = phase_timer
         self._prune_dir = prune_dir
         self._closed = False
+        # The loop stamps the current step's SpanContext here before each
+        # save; the snapshot carries it to the writer thread so the
+        # checkpoint_io phase-span joins that step's trace (thread-locals
+        # don't follow work across the queue).
+        self.trace_ctx: Any = None
         self._thread = threading.Thread(
             target=self._run, name="ddr-ckpt-writer", daemon=True
         )
@@ -1322,9 +1327,10 @@ class AsyncCheckpointWriter:
                 self._queue.task_done()
                 return
             writer_fn = save_state_orbax if item.pop("_fmt", "pickle") == "orbax" else save_state
+            ctx = item.pop("_ctx", None)
             try:
                 if self._phase_timer is not None:
-                    with self._phase_timer.phase("checkpoint_io"):
+                    with self._phase_timer.phase("checkpoint_io", ctx=ctx):
                         writer_fn(**item)
                 else:
                     writer_fn(**item)
@@ -1382,6 +1388,8 @@ class AsyncCheckpointWriter:
             "mesh": _mesh_provenance(mesh),
             "healthy": healthy,
         }
+        if self.trace_ctx is not None:
+            item["_ctx"] = self.trace_ctx
         self._enqueue(item)
 
     def save_orbax(
@@ -1438,6 +1446,8 @@ class AsyncCheckpointWriter:
             "mesh": _mesh_provenance(mesh),
             "healthy": healthy,
         }
+        if self.trace_ctx is not None:
+            item["_ctx"] = self.trace_ctx
         self._enqueue(item)
 
     def _enqueue(self, item: dict) -> None:

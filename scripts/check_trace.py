@@ -1,0 +1,190 @@
+#!/usr/bin/env python3
+"""CI guard: the fleet trace plane holds end-to-end on a synthetic 2-process run.
+
+Cross-host tracing (``docs/observability.md`` "Fleet observability") rests on
+a chain of small contracts: every host derives the SAME ``trace_id``/root
+``span_id`` for step ``n`` with zero collectives
+(``trace.step_context`` — sha1 over ``(run id, step)``), child spans stamp
+``parent_id`` links, each host writes its own JSONL sidecar, and
+``ddr metrics trace`` merges the files into one Perfetto timeline with one
+process track per host. This script drives that chain the way
+``check_recovery.py`` drives the self-healing ladder: a miniature run — host0
+written in THIS process, host1 written by a genuinely separate spawned
+process — then the merged export, then structural assertions:
+
+- the export is valid JSON in Chrome trace-event form;
+- timestamps are monotone within every (pid, tid) track;
+- every non-root span's ``parent_id`` resolves to a ``span_id`` emitted on
+  the same trace (the ``step`` event anchors the root span);
+- at least one step's ``trace_id`` appears on BOTH host tracks, stitched by
+  flow events.
+
+Exit 0 when every contract holds, 1 otherwise. Run directly (CI) or via the
+test suite (tests/scripts/test_check_trace.py):
+
+    python scripts/check_trace.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_STEPS = 3
+SEED = "check-trace-gate"
+
+
+def _write_host_log(dirpath: str, host: int) -> None:
+    """One host's miniature run: run_start, then per step a child phase span
+    plus the step event carrying the deterministic root-span ids."""
+    from ddr_tpu.observability.events import SCHEMA_VERSION, Recorder
+    from ddr_tpu.observability.trace import step_context
+
+    name = (
+        "run_log.check_trace.jsonl"
+        if host == 0
+        else f"run_log.check_trace.host{host}.jsonl"
+    )
+    rec = Recorder(Path(dirpath) / name, host=host, n_hosts=2)
+    rec.emit(
+        "run_start", cmd="check_trace", name="trace-gate",
+        schema_version=SCHEMA_VERSION,
+    )
+    for i in range(N_STEPS):
+        ctx = step_context(SEED, f"0:{i}")
+        child = ctx.child()
+        rec.emit(
+            "span", name="phase/device_step", seconds=0.01,
+            thread="MainThread", **child.ids(),
+        )
+        rec.emit("step", i=i, epoch=0, seconds=0.02, loss=1.0, **ctx.ids())
+    rec.close()
+
+
+def _check(events: list[dict], doc: dict) -> list[str]:
+    """Every structural contract the merged export must satisfy; returns the
+    list of violations (empty = pass)."""
+    problems: list[str] = []
+
+    # parent resolution over the RAW events: a span's parent_id must be some
+    # emitted span_id of the same trace — the step event IS the root anchor
+    anchors: dict[str, set[str]] = {}
+    for e in events:
+        if e.get("trace_id") and e.get("span_id"):
+            anchors.setdefault(str(e["trace_id"]), set()).add(str(e["span_id"]))
+    n_links = 0
+    for e in events:
+        pid = e.get("parent_id")
+        if pid is None:
+            continue
+        n_links += 1
+        if str(pid) not in anchors.get(str(e.get("trace_id")), set()):
+            problems.append(
+                f"unresolved parent_id {pid!r} on {e.get('event')} "
+                f"(trace {e.get('trace_id')!r})"
+            )
+    if n_links < N_STEPS * 2:
+        problems.append(
+            f"expected ≥{N_STEPS * 2} parent links (one phase span per step "
+            f"per host), saw {n_links}"
+        )
+
+    te = doc.get("traceEvents")
+    if not isinstance(te, list) or not te:
+        return problems + ["export has no traceEvents"]
+    body = [ev for ev in te if ev.get("ph") != "M"]
+
+    # monotone timestamps within every (pid, tid) track
+    last: dict[tuple, float] = {}
+    for ev in body:
+        key = (ev.get("pid"), ev.get("tid"))
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"bad ts on {ev}")
+            continue
+        if ts < last.get(key, float("-inf")):
+            problems.append(f"non-monotone ts on track {key}: {ev}")
+        last[key] = ts
+
+    # one step trace id on BOTH host tracks, with flow stitching
+    slices = [ev for ev in body if ev.get("ph") == "X"]
+    per_trace_pids: dict[str, set[int]] = {}
+    for s in slices:
+        tid = (s.get("args") or {}).get("trace_id")
+        if tid:
+            per_trace_pids.setdefault(str(tid), set()).add(int(s["pid"]))
+    crossed = [t for t, pids in per_trace_pids.items() if len(pids) >= 2]
+    if len(crossed) < N_STEPS:
+        problems.append(
+            f"expected {N_STEPS} step trace ids spanning both host tracks, "
+            f"saw {len(crossed)} ({sorted(per_trace_pids)!r})"
+        )
+    flow_phs = {ev["ph"] for ev in body if ev.get("ph") in ("s", "t", "f")}
+    if not {"s", "f"} <= flow_phs:
+        problems.append(f"missing cross-host flow start/finish events: {flow_phs}")
+    pids = {ev.get("pid") for ev in body}
+    if not {0, 1} <= pids:
+        problems.append(f"expected host tracks pid 0 and 1, saw {sorted(pids)}")
+    return problems
+
+
+def main() -> int:
+    try:
+        from ddr_tpu.observability.metrics_cli import load_events, perfetto_trace
+    except Exception as e:
+        print(f"check_trace: import failed: {e!r}", file=sys.stderr)
+        return 1
+
+    os.environ["DDR_TRACE"] = "1"  # the gate tests the enabled arm
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            _write_host_log(tmp, host=0)
+            # host1 runs in a real second process: same seed, zero shared
+            # state — exactly the multi-host "agreement without collectives"
+            # contract the trace ids promise
+            proc = subprocess.run(
+                [sys.executable, __file__, "--emit-host", "1", tmp],
+                capture_output=True, text=True, timeout=120,
+                env=dict(os.environ, DDR_TRACE="1", JAX_PLATFORMS="cpu"),
+            )
+            if proc.returncode != 0:
+                print(
+                    f"check_trace: host1 writer process failed:\n{proc.stderr}",
+                    file=sys.stderr,
+                )
+                return 1
+            events, bad = load_events(tmp)
+            if bad:
+                print(f"check_trace: {bad} corrupt lines", file=sys.stderr)
+                return 1
+            doc = json.loads(json.dumps(perfetto_trace(events)))
+    except Exception as e:
+        print(f"check_trace: synthetic run failed: {e!r}", file=sys.stderr)
+        return 1
+
+    problems = _check(events, doc)
+    if problems:
+        for p in problems:
+            print(f"check_trace: {p}", file=sys.stderr)
+        return 1
+    n_slices = sum(1 for ev in doc["traceEvents"] if ev.get("ph") == "X")
+    print(
+        f"check_trace: 2-process run -> merged Perfetto export holds "
+        f"({n_slices} slices, {N_STEPS} step traces on both host tracks, "
+        "all parent ids resolve, tracks monotone)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--emit-host":
+        _write_host_log(sys.argv[3], host=int(sys.argv[2]))
+        raise SystemExit(0)
+    raise SystemExit(main())

@@ -193,7 +193,8 @@ def test_finalize_rules_skipped_on_partial_scan(tmp_path):
     EVENT_TYPES coverage against one file would fire the broken-matcher
     guard on every clean single-file lint."""
     write_tree(tmp_path, {
-        "ddr_tpu/observability/events.py": 'EVENT_TYPES = ("epoch",)\n',
+        "ddr_tpu/observability/events.py":
+            'SCHEMA_VERSION = 2\nEVENT_TYPES = ("epoch",)\n',
         "ddr_tpu/mod.py": "X = 1\n",
     })
     partial = run_lint(tmp_path, paths=[tmp_path / "ddr_tpu/mod.py"], rule_ids=["DDR501"])

@@ -388,7 +388,7 @@ def test_ddr401_init_exempt_and_unthreaded_module_skipped(lint_tree):
 # DDR5xx — consistency gates (need registry files in the fixture tree)
 # ---------------------------------------------------------------------------
 
-_EVENTS_PY = 'EVENT_TYPES = ("epoch", "route")\n'
+_EVENTS_PY = 'SCHEMA_VERSION = 2\nEVENT_TYPES = ("epoch", "route")\n'
 _FAULTS_PY = 'FAULT_SITES = ("data.load", "device.step")\n'
 
 
@@ -413,6 +413,18 @@ def test_ddr501_good_all_registered(lint_tree):
         rules=["DDR501"],
     )
     assert good.findings == []
+
+
+def test_ddr501_missing_schema_version_flagged(lint_tree):
+    """Dropping the run_start version stamp breaks mixed-version readers
+    silently — losing the constant is a lint error in its own right."""
+    result = lint_tree(
+        {"ddr_tpu/observability/events.py": 'EVENT_TYPES = ("epoch",)\n',
+         "ddr_tpu/mod.py": 'def report(rec):\n    rec.emit("epoch")\n'},
+        rules=["DDR501"],
+    )
+    assert [f.rule for f in result.findings] == ["DDR501"]
+    assert "SCHEMA_VERSION" in result.findings[0].message
 
 
 def test_ddr501_zero_sites_means_broken_matcher(lint_tree):

@@ -1,0 +1,209 @@
+"""Federation tests: replica-spec parsing, label injection, the cardinality
+cap, and the end-to-end path — two LIVE synthetic replicas scraped into one
+fleet exposition by ``ddr obs federate``."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ddr_tpu.observability.federate import (
+    DEFAULT_MAX_SERIES,
+    federate_text,
+    inject_label,
+    max_series_from_env,
+    parse_replicas,
+    replicas_from_env,
+)
+from ddr_tpu.observability.prometheus import CONTENT_TYPE, render_text
+from ddr_tpu.observability.registry import MetricsRegistry
+
+
+class TestParseReplicas:
+    def test_label_url_pairs(self):
+        got = parse_replicas("a=http://h1:9100/metrics, b=https://h2/m")
+        assert got == [
+            ("a", "http://h1:9100/metrics"),
+            ("b", "https://h2/m"),
+        ]
+
+    def test_bare_authority_gets_scheme_path_and_label(self):
+        assert parse_replicas("h1:9100") == [("h1:9100", "http://h1:9100/metrics")]
+
+    def test_bare_url_keeps_its_path(self):
+        assert parse_replicas("http://h1:9100/custom") == [
+            ("h1:9100", "http://h1:9100/custom")
+        ]
+
+    def test_empty_entries_skipped_and_labels_sanitized(self):
+        got = parse_replicas(',,a"b\\c=h:1,')
+        assert got == [("abc", "http://h:1/metrics")]
+
+    def test_empty_spec(self):
+        assert parse_replicas("") == []
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.delenv("DDR_FEDERATE_REPLICAS", raising=False)
+        assert replicas_from_env() == []
+        monkeypatch.setenv("DDR_FEDERATE_REPLICAS", "a=h:1,b=h:2")
+        assert [lab for lab, _ in replicas_from_env()] == ["a", "b"]
+
+
+class TestMaxSeries:
+    def test_default_and_valid(self, monkeypatch):
+        monkeypatch.delenv("DDR_FEDERATE_MAX_SERIES", raising=False)
+        assert max_series_from_env() == DEFAULT_MAX_SERIES
+        monkeypatch.setenv("DDR_FEDERATE_MAX_SERIES", "17")
+        assert max_series_from_env() == 17
+
+    @pytest.mark.parametrize("bad", ["banana", "", "0", "-5"])
+    def test_malformed_or_nonpositive_falls_back(self, monkeypatch, bad):
+        monkeypatch.setenv("DDR_FEDERATE_MAX_SERIES", bad)
+        assert max_series_from_env() == DEFAULT_MAX_SERIES
+
+
+class TestInjectLabel:
+    def test_unlabeled_sample(self):
+        assert inject_label("up 1", "replica", "a") == 'up{replica="a"} 1'
+
+    def test_labeled_sample_prepends(self):
+        got = inject_label('m{x="1"} 2 123', "replica", "a")
+        assert got == 'm{replica="a",x="1"} 2 123'
+
+    def test_value_is_escaped(self):
+        got = inject_label("up 1", "replica", 'we"ird\\lab')
+        assert got == 'up{replica="we\\"ird\\\\lab"} 1'
+
+    def test_garbage_is_none(self):
+        assert inject_label("# HELP up help", "replica", "a") is None
+        assert inject_label("not a sample line at all!", "replica", "a") is None
+
+
+def _registry(name_prefix: str, n: int = 1) -> MetricsRegistry:
+    reg = MetricsRegistry(const_labels={"host": 0})
+    for i in range(n):
+        reg.counter(f"{name_prefix}_total_{i}", help="synthetic").inc(i + 1)
+    return reg
+
+
+class TestFederateText:
+    def test_local_registry_folds_in_without_network(self):
+        reg = _registry("ddr_local")
+        text = federate_text([], local=("self", reg))
+        assert 'ddr_federate_up{replica="self"} 1' in text
+        assert "ddr_federate_dropped_series 0" in text
+        assert 'ddr_local_total_0{replica="self",host="0"} 1' in text
+
+    def test_dead_replica_is_up_zero_not_fatal(self):
+        # a port that was bound then closed: connection refused, fast
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        text = federate_text(
+            [("dead", f"http://127.0.0.1:{port}/metrics")], timeout=0.5
+        )
+        assert 'ddr_federate_up{replica="dead"} 0' in text
+
+    def test_cap_drops_overflow_and_reports(self):
+        reg = _registry("ddr_cap", n=5)
+        text = federate_text([], max_series=2, local=("self", reg))
+        samples = [
+            ln for ln in text.splitlines()
+            if ln.startswith("ddr_cap_total_") and not ln.startswith("#")
+        ]
+        assert len(samples) == 2
+        assert "ddr_federate_dropped_series 3" in text
+        # liveness never counts against the cap
+        assert 'ddr_federate_up{replica="self"} 1' in text
+
+    def test_histogram_children_stay_under_family_header(self):
+        reg = MetricsRegistry()
+        reg.histogram("ddr_lat_seconds", buckets=(0.1, 1.0)).observe(0.5)
+        text = federate_text([], local=("self", reg))
+        lines = text.splitlines()
+        type_at = lines.index("# TYPE ddr_lat_seconds histogram")
+        assert lines.count("# TYPE ddr_lat_seconds histogram") == 1
+        # bucket/sum/count samples follow their single family header
+        children = [ln for ln in lines if ln.startswith("ddr_lat_seconds_")]
+        assert len(children) == 5  # 2 buckets + +Inf + _sum + _count
+        assert all(lines.index(ln) > type_at for ln in children)
+        assert reg.series_count() == 5  # what the cap counts for this registry
+
+
+def _serve_registry(reg: MetricsRegistry) -> ThreadingHTTPServer:
+    """A live replica: one ThreadingHTTPServer whose every GET answers with
+    the registry's current exposition."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # noqa: A002 - http.server API
+            pass
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            body = render_text(reg).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestLiveFleet:
+    """Two live synthetic replicas -> one fleet page, via both consumption
+    paths: ``ddr obs federate --once`` and the standing aggregator."""
+
+    @pytest.fixture
+    def fleet(self):
+        srvs = [_serve_registry(_registry(f"ddr_rep{i}")) for i in range(2)]
+        urls = [f"http://127.0.0.1:{s.server_address[1]}/metrics" for s in srvs]
+        yield urls
+        for s in srvs:
+            s.shutdown()
+            s.server_close()
+
+    def test_obs_federate_once_merges_both_replicas(self, fleet, capsys):
+        from ddr_tpu.observability.obs_cli import main
+
+        rc = main(
+            ["federate", "--replicas", f"a={fleet[0]},b={fleet[1]}", "--once"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert 'ddr_federate_up{replica="a"} 1' in out
+        assert 'ddr_federate_up{replica="b"} 1' in out
+        assert 'ddr_rep0_total_0{replica="a",host="0"} 1' in out
+        assert 'ddr_rep1_total_0{replica="b",host="0"} 1' in out
+
+    def test_standing_aggregator_scrapes_on_demand(self, fleet):
+        from ddr_tpu.observability.obs_cli import serve_federation
+
+        agg = serve_federation(
+            parse_replicas(f"a={fleet[0]},b={fleet[1]}"), host="127.0.0.1", port=0
+        )
+        try:
+            with urllib.request.urlopen(agg.url, timeout=10) as resp:
+                assert resp.status == 200
+                assert "version=0.0.4" in resp.headers["Content-Type"]
+                body = resp.read().decode()
+            assert 'ddr_federate_up{replica="a"} 1' in body
+            assert 'ddr_federate_up{replica="b"} 1' in body
+            assert 'ddr_rep0_total_0{replica="a"' in body
+        finally:
+            agg.shutdown()
+            agg.server_close()
+
+    def test_no_targets_is_an_error(self, monkeypatch, capsys):
+        from ddr_tpu.observability.obs_cli import main
+
+        monkeypatch.delenv("DDR_FEDERATE_REPLICAS", raising=False)
+        assert main(["federate", "--once"]) == 2
+        assert "no federation targets" in capsys.readouterr().err

@@ -321,19 +321,63 @@ class TestFollow:
         assert "name=second-bigger" in out
         assert out.count("heartbeat") == 8
 
-    def test_directory_follows_most_recent_jsonl(self, tmp_path, monkeypatch):
-        import os
+    def test_directory_interleaves_host_sidecars(self, tmp_path, monkeypatch):
+        """Following a run DIRECTORY merges the primary log and every
+        per-host sidecar live, host<K>-prefixed, in wall-clock order."""
+        prim = tmp_path / "run_log.train.jsonl"
+        prim.write_text(json.dumps(
+            {"event": "run_start", "t": 0.0, "wall": 100.0, "host": 0,
+             "pid": 1, "seq": 0, "cmd": "train", "name": "fleet"}) + "\n")
+        side = tmp_path / "run_log.train.host1.jsonl"
+        side.write_text(json.dumps(
+            {"event": "heartbeat", "t": 0.5, "wall": 100.5, "host": 1,
+             "pid": 2, "seq": 0, "step": 0, "devices": []}) + "\n")
+        later = {"event": "heartbeat", "t": 2.0, "wall": 102.0, "host": 1,
+                 "pid": 2, "seq": 1, "step": 1, "devices": []}
+        earlier = {"event": "step", "t": 1.5, "wall": 101.5, "host": 0,
+                   "pid": 1, "seq": 1, "i": 0, "seconds": 0.1}
 
-        old = _write_golden(tmp_path / "run_log.train.jsonl")
-        os.utime(old, (1, 1))
-        live = tmp_path / "run_log.serve.jsonl"
-        live.write_text(json.dumps(
-            {"event": "run_start", "t": 0.0, "wall": 300.0, "host": 0,
-             "pid": 3, "seq": 0, "cmd": "serve", "name": "live"}) + "\n")
-        rc, out = self._run_follow(monkeypatch, tmp_path, [lambda: None])
+        def append_both():
+            # written sidecar-first: the printed order must follow wall, not
+            # file enumeration
+            with side.open("a") as f:
+                f.write(json.dumps(later) + "\n")
+            with prim.open("a") as f:
+                f.write(json.dumps(earlier) + "\n")
+
+        rc, out = self._run_follow(monkeypatch, tmp_path, [append_both])
         assert rc == 0
-        assert f"following {live}" in out
-        assert "name=live" in out
+        lines = out.strip().splitlines()
+        assert "following" in lines[0]
+        assert any(ln.startswith("host0| ") and "run_start" in ln for ln in lines)
+        assert any(ln.startswith("host1| ") and "heartbeat" in ln for ln in lines)
+        # wall order across files: host0's t=1.5 step before host1's t=2.0 beat
+        assert lines[-2].startswith("host0| ") and "step" in lines[-2]
+        assert lines[-1].startswith("host1| ") and "step=1" in lines[-1]
+
+    def test_directory_picks_up_sidecar_created_mid_run(
+        self, tmp_path, monkeypatch
+    ):
+        prim = tmp_path / "run_log.train.jsonl"
+        prim.write_text(json.dumps(
+            {"event": "run_start", "t": 0.0, "wall": 100.0, "host": 0,
+             "pid": 1, "seq": 0, "cmd": "train", "name": "fleet"}) + "\n")
+        side = tmp_path / "run_log.train.host3.jsonl"
+
+        def create_sidecar():
+            side.write_text(json.dumps(
+                {"event": "heartbeat", "t": 1.0, "wall": 101.0, "host": 3,
+                 "pid": 2, "seq": 0, "step": 0, "devices": []}) + "\n")
+
+        rc, out = self._run_follow(
+            monkeypatch, tmp_path, [create_sidecar, lambda: None]
+        )
+        assert rc == 0
+        # the new sidecar's FIRST event is printed, from its first byte
+        assert any(
+            ln.startswith("host3| ") and "heartbeat" in ln
+            for ln in out.strip().splitlines()
+        )
 
     def test_ctrl_c_exits_zero(self, tmp_path, monkeypatch):
         p = _write_golden(tmp_path / "run_log.serve.jsonl")

@@ -74,9 +74,34 @@ def test_train_dry_run_produces_complete_run_log(tmp_path, monkeypatch):
 
     # Repeated-topology epoch 2 (shuffle=False): ZERO recompiles — all misses
     # land in epoch 1 (≤ 2 batches), and every epoch-2 step is a cache hit.
+    # Tracing is ON here (DDR_TRACE defaults on), so this same bound is the
+    # zero-new-jit-cache-entries proof for trace propagation.
     compile_summary = end["summary"]["compile"]["gspmd"]
     assert compile_summary["misses"] == len(compiles) <= 2
     assert compile_summary["hits"] == len(steps) - compile_summary["misses"] >= 2
+
+    # Trace propagation: every step is its own trace root with deterministic
+    # ids (any host of this run would stamp the same), and the phase spans
+    # emitted inside the step link back to it as children.
+    from ddr_tpu.observability.trace import run_trace_seed, step_context
+
+    seed = run_trace_seed(cfg)
+    step_ids = set()
+    for s in steps:
+        want = step_context(seed, f"{s['epoch']}:{s['batch']}")
+        assert s["trace_id"] == want.trace_id and s["span_id"] == want.span_id
+        assert "parent_id" not in s  # the step IS the trace root
+        step_ids.add(s["trace_id"])
+    assert len(step_ids) == 4
+    child_spans = [
+        e for e in by_type.get("span", []) if e.get("trace_id") in step_ids
+    ]
+    assert child_spans, "no phase spans joined their step's trace"
+    # every child's parent resolves within its own trace (root or sibling)
+    known: dict[str, set] = {s["trace_id"]: {s["span_id"]} for s in steps}
+    for c in child_spans:
+        known[c["trace_id"]].add(c["span_id"])
+    assert all(c["parent_id"] in known[c["trace_id"]] for c in child_spans)
 
     # And the CLI renders it without error.
     from ddr_tpu.observability.metrics_cli import main as metrics_main

@@ -74,7 +74,7 @@ class TestSpanNesting:
     def test_thread_local_stacks(self, rec):
         paths = []
         orig = rec.record_span
-        rec.record_span = lambda p, s: (paths.append(p), orig(p, s))
+        rec.record_span = lambda p, s, **kw: (paths.append(p), orig(p, s, **kw))
 
         def worker():
             with span("thread-span"):

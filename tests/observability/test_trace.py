@@ -1,0 +1,143 @@
+"""Trace-context tests: id minting/derivation, the DDR_TRACE master switch,
+the thread-local ambient stack, and the deterministic multi-host step scheme."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from ddr_tpu.observability.trace import (
+    SpanContext,
+    adopt_trace_id,
+    context,
+    current,
+    derive_id,
+    new_span_id,
+    new_trace_id,
+    pop,
+    push,
+    run_trace_seed,
+    step_context,
+    trace_enabled,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("DDR_TRACE", raising=False)
+    monkeypatch.delenv("DDR_RUN_ID", raising=False)
+
+
+class TestSwitch:
+    def test_default_on(self):
+        assert trace_enabled() is True
+
+    @pytest.mark.parametrize("off", ["0", "false", "no", "off", " OFF ", "No"])
+    def test_off_spellings(self, monkeypatch, off):
+        monkeypatch.setenv("DDR_TRACE", off)
+        assert trace_enabled() is False
+
+    @pytest.mark.parametrize("on", ["1", "true", "yes", "on", "anything"])
+    def test_on_spellings(self, monkeypatch, on):
+        monkeypatch.setenv("DDR_TRACE", on)
+        assert trace_enabled() is True
+
+
+class TestIds:
+    def test_mint_shapes(self):
+        tid, sid = new_trace_id(), new_span_id()
+        assert len(tid) == 16 and len(sid) == 12
+        int(tid, 16), int(sid, 16)  # hex or raise
+        assert new_trace_id() != tid  # random, not sticky
+
+    def test_derive_is_deterministic_and_part_sensitive(self):
+        a = derive_id("step", "run-1", 7)
+        assert a == derive_id("step", "run-1", 7)
+        assert a != derive_id("step", "run-1", 8)
+        assert a != derive_id("step", "run-2", 7)
+        assert len(a) == 16 and len(derive_id("x", length=12)) == 12
+
+    def test_adopt_sanitizes_caps_and_mints(self):
+        assert adopt_trace_id("edge-abc") == "edge-abc"
+        # control chars and whitespace are stripped, the rest survives
+        assert adopt_trace_id("ok\tid\x01junk") == "okidjunk"
+        assert len(adopt_trace_id("x" * 200)) == 64
+        # nothing usable -> a fresh mint
+        assert len(adopt_trace_id(None)) == 16
+        assert len(adopt_trace_id("\x01\x02")) == 16
+
+
+class TestSpanContext:
+    def test_child_keeps_trace_and_links_parent(self):
+        root = SpanContext("t" * 16, "s" * 12)
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_id == root.span_id
+        assert kid.span_id != root.span_id and len(kid.span_id) == 12
+        named = root.child(span_id="abc123")
+        assert named.span_id == "abc123"
+
+    def test_ids_omits_absent_parent(self):
+        root = SpanContext("t" * 16, "s" * 12)
+        assert root.ids() == {"trace_id": "t" * 16, "span_id": "s" * 12}
+        kid = root.child(span_id="k" * 12)
+        assert kid.ids()["parent_id"] == root.span_id
+
+
+class TestAmbientStack:
+    def test_push_pop_and_context_manager(self):
+        assert current() is None
+        a = SpanContext(new_trace_id(), new_span_id())
+        push(a)
+        try:
+            assert current() is a
+            with context(a.child()) as b:
+                assert current() is b and b.parent_id == a.span_id
+            assert current() is a
+        finally:
+            pop()
+        assert current() is None
+        pop()  # underflow is a no-op, not an error
+
+    def test_context_none_is_noop(self):
+        with context(None) as got:
+            assert got is None and current() is None
+
+    def test_stack_is_thread_local(self):
+        push(SpanContext(new_trace_id(), new_span_id()))
+        try:
+            seen: list = []
+            t = threading.Thread(target=lambda: seen.append(current()))
+            t.start()
+            t.join()
+            assert seen == [None]  # the other thread sees its own empty stack
+        finally:
+            pop()
+
+
+class TestStepScheme:
+    def test_seed_precedence(self, monkeypatch):
+        class P:
+            save_path = "/runs/x"
+
+        class Cfg:
+            name = "basin"
+            params = P()
+
+        assert run_trace_seed(None) == "run"
+        assert run_trace_seed(Cfg()) == "basin:/runs/x"
+        monkeypatch.setenv("DDR_RUN_ID", "launcher-7")
+        assert run_trace_seed(Cfg()) == "launcher-7"  # env wins over config
+
+    def test_hosts_agree_without_collectives(self):
+        # two "hosts" derive the same step context from the shared seed alone
+        a = step_context("basin:/runs/x", "3:12")
+        b = step_context("basin:/runs/x", "3:12")
+        assert a == b
+        assert a.parent_id is None  # the step IS the trace root
+        assert step_context("basin:/runs/x", "3:13").trace_id != a.trace_id
+
+    def test_none_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("DDR_TRACE", "0")
+        assert step_context("seed", 1) is None

@@ -1,0 +1,45 @@
+"""scripts/check_trace.py: the fleet-trace smoke gate must pass on a clean
+tree (so cross-host id/export bit-rot fails tier-1 fast) and actually catch
+breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_trace.py"
+
+
+def test_repo_trace_gate_clean():
+    """THE CI gate: a 2-process synthetic run merges into a Perfetto export
+    with one step trace on both host tracks and fully resolvable parents."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "both host tracks" in proc.stdout
+    assert "all parent ids resolve" in proc.stdout
+
+
+def test_gate_fails_on_broken_observability_module(tmp_path):
+    """A tree whose observability package cannot import must fail the gate —
+    copy the script next to a stub package with a broken __init__."""
+    pkg = tmp_path / "ddr_tpu" / "observability"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_trace.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_trace.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr

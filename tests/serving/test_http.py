@@ -101,6 +101,26 @@ class TestProbes:
         assert "# TYPE ddr_request_latency_seconds histogram" in body
         assert "ddr_health_status" in body
 
+    def test_metrics_federated_view_folds_local_registry(self, server, monkeypatch):
+        """``?federated=1`` answers for the fleet: with no configured replicas
+        the page still carries the local registry as ``replica="self"`` plus
+        the federation meta-series (up + dropped counter)."""
+        import urllib.request
+
+        monkeypatch.delenv("DDR_FEDERATE_REPLICAS", raising=False)
+        srv, _ = server
+        with urllib.request.urlopen(
+            srv.url + "/metrics?federated=1", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert 'ddr_federate_up{replica="self"} 1' in body
+        assert "ddr_federate_dropped_series 0" in body
+        # local samples are re-labeled, not just listed: health gauge gains
+        # replica="self" as its first label
+        assert 'ddr_health_status{replica="self"' in body
+
 
 def _post_raw(url, path, data=b""):
     import urllib.error
@@ -145,11 +165,15 @@ class TestProfileEndpoint:
         assert code == 400 and "PROFILE_MAX_SECONDS" in body["error"]
 
 
-def _post_traced(url, body: dict, request_id: str | None = None):
+def _post_traced(
+    url, body: dict, request_id: str | None = None, trace_id: str | None = None
+):
     """POST /v1/forecast returning (code, body, response headers)."""
     headers = {"Content-Type": "application/json"}
     if request_id is not None:
         headers["X-DDR-Request-Id"] = request_id
+    if trace_id is not None:
+        headers["X-DDR-Trace-Id"] = trace_id
     req = urllib.request.Request(
         url + "/v1/forecast", data=json.dumps(body).encode(),
         headers=headers, method="POST",
@@ -266,6 +290,52 @@ class TestRequestTracing:
         assert code == 503
         assert body["reason"] == "timeout"
         assert body["request_id"]
+
+
+class TestDistributedTrace:
+    """The cross-service trace contract: ``X-DDR-Trace-Id`` is adopted (or
+    minted) at the edge, echoed on every response, and suppressed entirely
+    under ``DDR_TRACE=0`` — request ids are per hop, trace ids follow the
+    operation across services."""
+
+    def test_supplied_trace_id_adopted_on_success_and_error(self, server):
+        srv, _ = server
+        code, body, hdrs = _post_traced(
+            srv.url, {"network": "default", "t0": 0},
+            trace_id="edgetrace00aa11bb",
+        )
+        assert code == 200
+        assert body["trace_id"] == "edgetrace00aa11bb"
+        assert hdrs["X-DDR-Trace-Id"] == "edgetrace00aa11bb"
+        # trace and request ids are distinct dimensions
+        assert body["request_id"] != body["trace_id"]
+        # error responses carry it just the same
+        code, body, hdrs = _post_traced(
+            srv.url, {"network": "nope"}, trace_id="errtrace1234"
+        )
+        assert code == 404
+        assert body["trace_id"] == "errtrace1234"
+        assert hdrs["X-DDR-Trace-Id"] == "errtrace1234"
+
+    def test_minted_trace_id_when_absent(self, server):
+        srv, _ = server
+        code, body, hdrs = _post_traced(srv.url, {"network": "default", "t0": 0})
+        assert code == 200
+        assert body["trace_id"] == hdrs["X-DDR-Trace-Id"]
+        assert len(body["trace_id"]) == 16
+        int(body["trace_id"], 16)  # hex or raise
+
+    def test_trace_suppressed_when_disabled(self, server, monkeypatch):
+        monkeypatch.setenv("DDR_TRACE", "0")
+        srv, _ = server
+        code, body, hdrs = _post_traced(
+            srv.url, {"network": "default", "t0": 0}, trace_id="ignored-id"
+        )
+        assert code == 200
+        assert "trace_id" not in body
+        assert "X-DDR-Trace-Id" not in hdrs
+        # the per-hop request id is unaffected by the trace switch
+        assert body["request_id"] == hdrs["X-DDR-Request-Id"]
 
 
 class TestForecastPost:

@@ -235,6 +235,35 @@ class TestRequestTracing:
         (batch,) = events_of(recorder, "serve_batch")
         assert req["execute_s"] == batch["seconds"]
 
+    def test_batch_span_links_member_request_spans(self, service_factory, recorder):
+        """One batch span flow-links >=2 member request spans: the serve_batch
+        event owns its OWN trace (a batch outlives no single request) and its
+        ``members`` list carries every member request's root-span ids."""
+        # default max_batch=4 keeps the compiled-program shape shared with the
+        # rest of the module (no fresh XLA build); the long coalescing window
+        # is what guarantees the three submits land in one batch
+        svc = service_factory(n_segments=32, horizon=8, n_days=2, batch_wait_s=0.25)
+        futs = [svc.submit(network="default", t0=t0) for t0 in range(3)]
+        outs = [f.result(timeout=30) for f in futs]
+        assert all(len(o["trace_id"]) == 16 for o in outs)
+
+        reqs = events_of(recorder, "serve_request")
+        assert len(reqs) == 3
+        batches = [b for b in events_of(recorder, "serve_batch") if b["size"] >= 2]
+        assert batches, "expected at least one multi-request batch"
+        batch = max(batches, key=lambda b: b["size"])
+        # the batch span is its own trace, disjoint from every member's
+        assert len(batch["trace_id"]) == 16 and len(batch["span_id"]) == 12
+        member_ids = {m["trace_id"] for m in batch["members"]}
+        assert len(batch["members"]) >= 2
+        assert batch["trace_id"] not in member_ids
+        # every member id resolves to a serve_request root span AND to the
+        # trace id the caller got back — the flow link is closed end to end
+        req_ids = {r["trace_id"] for r in reqs}
+        out_ids = {o["trace_id"] for o in outs}
+        assert member_ids <= req_ids
+        assert member_ids <= out_ids
+
     def test_queue_full_rejection_stamps_id_and_spends_budget(
         self, service_factory, recorder, monkeypatch
     ):
