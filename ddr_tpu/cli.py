@@ -16,6 +16,7 @@ _COMMANDS = {
     "route": "ddr_tpu.scripts.router",
     "train-and-test": "ddr_tpu.scripts.train_and_test",
     "serve": "ddr_tpu.scripts.serve",
+    "fleet": "ddr_tpu.scripts.fleet",
     "loadtest": "ddr_tpu.scripts.loadtest",
     "chaos": "ddr_tpu.scripts.chaos",
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",

@@ -1,0 +1,51 @@
+"""Fleet tier: replica groups, compiled ensemble forecasts, canary promotion.
+
+Everything above a single :class:`~ddr_tpu.serving.service.ForecastService`
+lives here (docs/serving.md "Fleet tier"):
+
+- :mod:`ddr_tpu.fleet.group` — :class:`ReplicaGroup`: N data-parallel
+  replicas (in-process or ``ddr serve`` subprocesses) sharing one persistent
+  compile cache, auto-registered with the federation plane;
+- :mod:`ddr_tpu.fleet.router` — :class:`Router`: least-queue-depth dispatch
+  with health-aware ejection and background re-probe;
+- :mod:`ddr_tpu.fleet.ensemble` — :class:`EnsembleRunner`: E-member ensemble
+  forecasts from ONE compiled program per (network, model, E);
+- :mod:`ddr_tpu.fleet.canary` — :class:`CanaryController`: skill-gated
+  promotion state machine over the model registry's hot-reload arms.
+
+Imports are kept lazy-friendly: the serving layer reaches in with function-
+local imports (no cycle), and importing :mod:`ddr_tpu.fleet` pulls no jax.
+"""
+
+from ddr_tpu.fleet.canary import STATES, CanaryController
+from ddr_tpu.fleet.config import FLEET_MODES, FleetConfig, fleet_identity
+from ddr_tpu.fleet.ensemble import (
+    DEFAULT_PERCENTILES,
+    EnsembleRunner,
+    member_forcing,
+    perturbation_seed,
+)
+from ddr_tpu.fleet.group import ReplicaGroup
+from ddr_tpu.fleet.router import (
+    HttpReplica,
+    InProcessReplica,
+    NoHealthyReplicaError,
+    Router,
+)
+
+__all__ = [
+    "CanaryController",
+    "DEFAULT_PERCENTILES",
+    "EnsembleRunner",
+    "FLEET_MODES",
+    "FleetConfig",
+    "HttpReplica",
+    "InProcessReplica",
+    "NoHealthyReplicaError",
+    "ReplicaGroup",
+    "Router",
+    "STATES",
+    "fleet_identity",
+    "member_forcing",
+    "perturbation_seed",
+]

@@ -1,0 +1,253 @@
+"""Skill-gated canary promotion: weighted request split between a ``stable``
+and a ``candidate`` model, with a bounded state machine deciding the rollout.
+
+The candidate rides the existing :class:`~ddr_tpu.serving.registry.ModelRegistry`
+hot-reload machinery — promotion is a TRAFFIC decision, not a deploy: both
+models are registered (and kept warm) on the same service, and the controller
+only chooses which arm answers each request. Evidence is hydrologic skill:
+observation-carrying requests feed per-arm
+:class:`~ddr_tpu.observability.skill.SkillTracker` instances, and the arms'
+median NSE is what the state machine compares.
+
+States (strictly forward, two terminal states — the machine is bounded):
+
+- ``shadow``: every request is answered by stable; observation-carrying
+  requests ALSO run the candidate on the same inputs (shadow traffic) so it
+  accrues skill without user exposure;
+- ``canary``: a deterministic ``weight`` fraction of requests (hashed from
+  the request id — the same request always lands on the same arm) is answered
+  by the candidate;
+- ``promoted``: the candidate answers everything (terminal);
+- ``rolled-back``: stable answers everything (terminal) — entered from any
+  live state when the candidate's median NSE regresses more than ``margin``
+  below stable's, or when the service's numerical-health watchdog degrades
+  while candidate traffic is live.
+
+Every transition is one ``canary`` event (docs/observability.md) carrying the
+per-arm skill evidence that forced it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+__all__ = ["CanaryController", "STATES"]
+
+#: The bounded state machine; the last two are terminal.
+STATES = ("shadow", "canary", "promoted", "rolled-back")
+
+
+def _arm_fraction(request_id: str) -> float:
+    """Deterministic [0, 1) split coordinate for one request id (stable hash,
+    not ``hash()`` — arm routing must not depend on PYTHONHASHSEED)."""
+    digest = hashlib.sha1(f"arm|{request_id}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") / 2**32
+
+
+class CanaryController:
+    """Route requests between two registered models and decide promotion."""
+
+    def __init__(
+        self,
+        service: Any,
+        stable: str = "default",
+        candidate: str = "candidate",
+        fleet_cfg: Any = None,
+        weight: float | None = None,
+        min_obs: int | None = None,
+        margin: float | None = None,
+    ) -> None:
+        from ddr_tpu.fleet.config import FleetConfig
+        from ddr_tpu.observability.registry import MetricsRegistry
+        from ddr_tpu.observability.skill import SkillConfig, SkillTracker
+
+        cfg = fleet_cfg or FleetConfig.from_env()
+        self._svc = service
+        self.stable = str(stable)
+        self.candidate = str(candidate)
+        if self.stable == self.candidate:
+            raise ValueError("stable and candidate must be different models")
+        service.registry.get(self.stable)  # raise early on unknown models
+        service.registry.get(self.candidate)
+        self.weight = cfg.canary_weight if weight is None else float(weight)
+        self.min_obs = cfg.canary_min_obs if min_obs is None else int(min_obs)
+        self.margin = cfg.canary_margin if margin is None else float(margin)
+        if not 0.0 < self.weight <= 1.0:
+            raise ValueError(f"weight must be in (0, 1], got {self.weight}")
+        # per-arm trackers get PRIVATE registries: the arms' skill
+        # distributions must not mix with each other (or with the service's
+        # ddr_skill_* series) — the canary event carries the comparison
+        skill_cfg = SkillConfig.from_env(enabled=True)
+        self._trackers = {
+            "stable": SkillTracker(skill_cfg, registry=MetricsRegistry()),
+            "candidate": SkillTracker(skill_cfg, registry=MetricsRegistry()),
+        }
+        self._lock = threading.Lock()
+        self._state = "shadow"
+        self._canary_entry_obs = 0  # candidate obs count when canary started
+        self._transitions: list[dict] = []
+
+    # ---- routing ----
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def arm_for(self, request_id: str) -> str:
+        """Which arm answers this request id in the CURRENT state."""
+        state = self.state
+        if state == "promoted":
+            return "candidate"
+        if state == "canary" and _arm_fraction(request_id) < self.weight:
+            return "candidate"
+        return "stable"  # shadow / rolled-back / the stable canary fraction
+
+    def handle(
+        self,
+        observations: Any | None = None,
+        gauge_ids: Any | None = None,
+        timeout: float | None = None,
+        **request: Any,
+    ) -> dict:
+        """One routed forecast. ``observations`` (a ``(T, G)`` array matching
+        the response's gauge columns, NaN = missing) makes this request
+        skill-bearing: the serving arm's tracker is fed, in ``shadow`` the
+        candidate additionally runs the same inputs as shadow traffic, and
+        the state machine re-evaluates. The result dict gains ``arm`` and
+        ``canary_state``."""
+        from ddr_tpu.serving.service import make_request_id
+
+        rid = make_request_id(request.pop("request_id", None))
+        arm = self.arm_for(rid)
+        model = self.candidate if arm == "candidate" else self.stable
+        result = self._svc.forecast(
+            timeout=timeout, model=model, request_id=rid, **request
+        )
+        if observations is not None:
+            obs = np.asarray(observations, dtype=np.float64)
+            self.observe(arm, result["runoff"], obs, gauge_ids)
+            if self.state == "shadow":
+                # shadow traffic: the candidate sees the same inputs, scored
+                # against the same observations, invisible to the caller
+                shadow = self._svc.forecast(
+                    timeout=timeout, model=self.candidate,
+                    request_id=f"{rid}-shadow", **request,
+                )
+                self.observe("candidate", shadow["runoff"], obs, gauge_ids)
+            self.evaluate()
+        out = dict(result)
+        out["arm"] = arm
+        out["canary_state"] = self.state
+        return out
+
+    def observe(
+        self, arm: str, pred: Any, obs: Any, gauge_ids: Any | None = None
+    ) -> None:
+        """Feed one arm's tracker directly (the shadow-eval / replay path —
+        anything that holds matched predictions and observations)."""
+        tracker = self._trackers[arm]
+        pred = np.atleast_2d(np.asarray(pred, dtype=np.float64))
+        if gauge_ids is None:
+            gauge_ids = [str(i) for i in range(pred.shape[1])]
+        tracker.observe(pred, obs, gauge_ids, arm=arm)
+
+    # ---- the state machine ----
+
+    def _evidence(self) -> dict:
+        rollup = {}
+        for arm, tracker in self._trackers.items():
+            status = tracker.status()
+            rollup[arm] = {
+                "observations": int(status.get("observations", 0)),
+                "nse_median": (status.get("nse") or {}).get("median"),
+            }
+        return rollup
+
+    def evaluate(self) -> str:
+        """Re-run the promotion decision; returns the (possibly new) state.
+
+        Transition rules, evaluated on skill evidence once BOTH arms carry at
+        least ``min_obs`` observations: a candidate median NSE more than
+        ``margin`` below stable's rolls back (from shadow or canary); parity
+        or better advances shadow -> canary; canary -> promoted after the
+        candidate accrues ``min_obs`` MORE observations while actually taking
+        weighted traffic (shadow evidence alone never promotes). A degraded
+        health watchdog rolls back from any live state regardless of skill —
+        numerics failing under candidate traffic is not a skill question."""
+        evidence = self._evidence()
+        with self._lock:
+            state = self._state
+            if state in ("promoted", "rolled-back"):
+                return state
+            if self._svc.watchdog.degraded:
+                return self._transition_locked(
+                    "rolled-back", "watchdog-degraded", evidence
+                )
+            cand, stab = evidence["candidate"], evidence["stable"]
+            if min(cand["observations"], stab["observations"]) < self.min_obs:
+                return state
+            c_nse, s_nse = cand["nse_median"], stab["nse_median"]
+            if c_nse is None or s_nse is None:
+                return state
+            if c_nse < s_nse - self.margin:
+                return self._transition_locked(
+                    "rolled-back", "skill-regression", evidence
+                )
+            if state == "shadow":
+                self._canary_entry_obs = cand["observations"]
+                return self._transition_locked("canary", "skill-parity", evidence)
+            if cand["observations"] - self._canary_entry_obs >= self.min_obs:
+                return self._transition_locked(
+                    "promoted", "skill-confirmed", evidence
+                )
+            return state
+
+    def _transition_locked(self, to: str, reason: str, evidence: dict) -> str:
+        """One state-machine edge (caller holds the lock): record it and emit
+        the ``canary`` event. Emission happens inline — the recorder path is
+        non-blocking and a transition must never be observable before its
+        event exists."""
+        record = {
+            "state_from": self._state,
+            "state_to": to,
+            "reason": reason,
+            "weight": self.weight,
+            "stable_model": self.stable,
+            "candidate_model": self.candidate,
+            "stable_obs": evidence["stable"]["observations"],
+            "candidate_obs": evidence["candidate"]["observations"],
+            "stable_nse": evidence["stable"]["nse_median"],
+            "candidate_nse": evidence["candidate"]["nse_median"],
+        }
+        self._state = to
+        self._transitions.append(record)
+        log.info(
+            f"canary {record['state_from']} -> {to} ({reason}): "
+            f"candidate nse {record['candidate_nse']} vs "
+            f"stable {record['stable_nse']}"
+        )
+        self._svc._emit("canary", **record)
+        return to
+
+    def status(self) -> dict:
+        """Controller rollup: state, knobs, per-arm evidence, transition log."""
+        evidence = self._evidence()
+        with self._lock:
+            return {
+                "state": self._state,
+                "stable": self.stable,
+                "candidate": self.candidate,
+                "weight": self.weight,
+                "min_obs": self.min_obs,
+                "margin": self.margin,
+                "arms": evidence,
+                "transitions": list(self._transitions),
+            }

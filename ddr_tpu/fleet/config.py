@@ -1,0 +1,155 @@
+"""Fleet-tier configuration: replica groups, ensemble and canary knobs.
+
+Same convention as :class:`~ddr_tpu.serving.config.ServeConfig`: one frozen
+dataclass, every knob ``DDR_FLEET_*`` env-overridable (documented in
+docs/serving.md "Fleet tier" and docs/config_reference.md), construction
+order defaults < environment < explicit keyword overrides.
+
+Three ``DDR_FLEET_*`` variables are *identity*, not knobs: ``DDR_FLEET_GROUP``
+(the group label), ``DDR_FLEET_REPLICA`` (this replica's index) and
+``DDR_FLEET_ROUTER`` (the front door's address) are stamped into each
+subprocess replica's environment by :class:`~ddr_tpu.fleet.group.ReplicaGroup`
+so the replica's boot log, ``/v1/stats`` and telemetry are attributable to
+its place in the fleet (:func:`fleet_identity`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = ["FLEET_MODES", "FleetConfig", "fleet_identity"]
+
+#: How a replica group runs its members: ``inprocess`` constructs N
+#: :class:`~ddr_tpu.serving.service.ForecastService` instances in this
+#: process (tests, single-host groups over device-mesh slices);
+#: ``subprocess`` launches N ``ddr serve`` workers on distinct ports (the
+#: production shape — each replica is independently killable).
+FLEET_MODES = ("inprocess", "subprocess")
+
+_ENV_PREFIX = "DDR_FLEET_"
+
+
+def fleet_identity(environ: dict | None = None) -> dict | None:
+    """This process's place in a replica group, or None outside a fleet:
+    ``{"group", "replica", "router"}`` from the ``DDR_FLEET_GROUP`` /
+    ``DDR_FLEET_REPLICA`` / ``DDR_FLEET_ROUTER`` identity variables the group
+    stamps into each worker's environment. Rides the ``ddr serve`` boot log
+    and the ``fleet`` slice of ``/v1/stats``."""
+    env = os.environ if environ is None else environ
+    group = env.get("DDR_FLEET_GROUP")
+    if not group:
+        return None
+    out: dict = {"group": group}
+    replica = env.get("DDR_FLEET_REPLICA")
+    if replica is not None:
+        try:
+            out["replica"] = int(replica)
+        except ValueError:
+            out["replica"] = replica
+    router = env.get("DDR_FLEET_ROUTER")
+    if router:
+        out["router"] = router
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Replica-group / ensemble / canary knobs (env var in parentheses)."""
+
+    #: Replica count for a booted group (DDR_FLEET_REPLICAS).
+    replicas: int = 2
+    #: Group label stamped on every replica's identity (DDR_FLEET_GROUP).
+    group: str = "fleet"
+    #: One of :data:`FLEET_MODES` (DDR_FLEET_MODE).
+    mode: str = "inprocess"
+    #: First subprocess replica port; replica ``i`` binds ``base_port + i``.
+    #: 0 = a free ephemeral port per replica (DDR_FLEET_BASE_PORT).
+    base_port: int = 0
+    #: Router health-probe cadence, seconds (DDR_FLEET_PROBE_MS, ms).
+    probe_s: float = 1.0
+    #: Consecutive failed probes (or dispatch transport errors) before a
+    #: replica is ejected from rotation (DDR_FLEET_EJECT_AFTER). Ejected
+    #: replicas keep being re-probed and rejoin on the first success.
+    eject_after: int = 2
+    #: Ceiling on ensemble ``members`` per request — E is a compile key, so
+    #: an unbounded E is a jit-cache-growth footgun
+    #: (DDR_FLEET_ENSEMBLE_MAX_MEMBERS).
+    ensemble_max_members: int = 64
+    #: Lognormal spread of the per-member forcing perturbation
+    #: (DDR_FLEET_ENSEMBLE_SIGMA): member forcing = forcing *
+    #: exp(sigma * N(0,1)), deterministic per (request id, seed, member).
+    ensemble_sigma: float = 0.1
+    #: Canary traffic weight in the ``canary`` state — the fraction of
+    #: routed requests the candidate arm answers (DDR_FLEET_CANARY_WEIGHT).
+    canary_weight: float = 0.1
+    #: Minimum per-arm skill observations before a promotion/rollback
+    #: decision is allowed (DDR_FLEET_CANARY_MIN_OBS).
+    canary_min_obs: int = 4
+    #: Median-NSE margin: the candidate must stay within this of the stable
+    #: arm to advance, and falling more than this below it rolls back
+    #: (DDR_FLEET_CANARY_MARGIN).
+    canary_margin: float = 0.05
+
+    def __post_init__(self) -> None:
+        if self.mode not in FLEET_MODES:
+            raise ValueError(
+                f"mode must be one of {FLEET_MODES}, got {self.mode!r}"
+            )
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.eject_after < 1:
+            raise ValueError(f"eject_after must be >= 1, got {self.eject_after}")
+        if self.probe_s <= 0:
+            raise ValueError(f"probe_s must be > 0, got {self.probe_s}")
+        if self.ensemble_max_members < 1:
+            raise ValueError(
+                f"ensemble_max_members must be >= 1, got {self.ensemble_max_members}"
+            )
+        if self.ensemble_sigma < 0:
+            raise ValueError(
+                f"ensemble_sigma must be >= 0, got {self.ensemble_sigma}"
+            )
+        if not 0.0 < self.canary_weight <= 1.0:
+            raise ValueError(
+                f"canary_weight must be in (0, 1], got {self.canary_weight}"
+            )
+        if self.canary_min_obs < 1:
+            raise ValueError(
+                f"canary_min_obs must be >= 1, got {self.canary_min_obs}"
+            )
+
+    @classmethod
+    def from_env(cls, environ: dict | None = None, **overrides) -> "FleetConfig":
+        """Defaults < ``DDR_FLEET_*`` environment < explicit ``overrides``."""
+        env = os.environ if environ is None else environ
+
+        def _get(name: str, cast, scale: float = 1.0):
+            raw = env.get(_ENV_PREFIX + name)
+            if raw is None or raw == "":
+                return None
+            try:
+                v = cast(raw)
+            except ValueError as e:
+                raise ValueError(f"bad {_ENV_PREFIX}{name}={raw!r}: {e}") from e
+            return v * scale if scale != 1.0 else v
+
+        from_env: dict = {}
+        for key, var, cast, scale in (
+            ("replicas", "REPLICAS", int, 1.0),
+            ("group", "GROUP", str, 1.0),
+            ("mode", "MODE", str, 1.0),
+            ("base_port", "BASE_PORT", int, 1.0),
+            ("probe_s", "PROBE_MS", float, 1e-3),
+            ("eject_after", "EJECT_AFTER", int, 1.0),
+            ("ensemble_max_members", "ENSEMBLE_MAX_MEMBERS", int, 1.0),
+            ("ensemble_sigma", "ENSEMBLE_SIGMA", float, 1.0),
+            ("canary_weight", "CANARY_WEIGHT", float, 1.0),
+            ("canary_min_obs", "CANARY_MIN_OBS", int, 1.0),
+            ("canary_margin", "CANARY_MARGIN", float, 1.0),
+        ):
+            v = _get(var, cast, scale)
+            if v is not None:
+                from_env[key] = v
+        from_env.update(overrides)
+        return cls(**from_env)

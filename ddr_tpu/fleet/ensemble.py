@@ -1,0 +1,326 @@
+"""Compiled ensemble forecasts: ONE E-member program per (network, model, E).
+
+Operational flood forecasting is ensemble-first, and on this stack an
+E-member ensemble is just one more ``vmap`` axis over the service's existing
+serve program: the KAN runs once, the member axis perturbs the forcing window
+with deterministic per-member lognormal noise (seeded from the request id, so
+the same request always yields the same members — reproducible percentiles),
+and the routed ``(E, T, G)`` stack reduces to percentile hydrographs plus
+worst-gauge attribution through the existing
+:func:`~ddr_tpu.observability.health.compute_output_worst` top-K machinery —
+all fused into the SAME compiled program.
+
+Compile discipline matches the serving layer exactly: ``E`` joins
+``(network, model)`` in the compile key, the program is built AOT
+(``jit(...).lower(...).compile()`` via ``build_card`` — it cannot silently
+re-trace), every build is a :class:`CompileTracker` miss with its
+:class:`ProgramCard`, every reuse a hit. Percentile values themselves stay
+host-side (``np.percentile`` over the returned member stack), so they never
+enter the compile key — any percentile list is free against the one program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "DEFAULT_PERCENTILES",
+    "EnsembleRunner",
+    "member_forcing",
+    "perturbation_seed",
+]
+
+#: Percentiles returned when a request doesn't name its own.
+DEFAULT_PERCENTILES = (10.0, 50.0, 90.0)
+
+
+def perturbation_seed(request_id: str, seed: int = 0) -> int:
+    """The 31-bit PRNG seed every member key folds from: a stable hash of
+    ``(request_id, seed)``. Deterministic across processes and sessions (no
+    ``PYTHONHASHSEED`` dependence), so a replayed request id reproduces its
+    ensemble exactly."""
+    digest = hashlib.sha1(f"{request_id}|{int(seed)}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") & 0x7FFFFFFF
+
+
+def member_forcing(
+    q_prime: np.ndarray,
+    request_id: str,
+    seed: int,
+    member: int,
+    sigma: float,
+) -> np.ndarray:
+    """Member ``member``'s perturbed forcing window, computed OUTSIDE the
+    compiled program — the offline twin of the in-program perturbation (same
+    PRNG, same op order), so tests can route members one at a time through
+    the plain serve path and compare percentiles against the fused program."""
+    import jax
+
+    qp = np.asarray(q_prime, dtype=np.float32)
+    if sigma == 0.0:
+        return qp
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(perturbation_seed(request_id, seed)), int(member)
+    )
+    noise = np.exp(
+        np.float32(sigma) * np.asarray(jax.random.normal(key, qp.shape), np.float32)
+    )
+    return qp * noise
+
+
+class EnsembleRunner:
+    """Per-service cache of compiled E-member programs.
+
+    Held lazily by :class:`~ddr_tpu.serving.service.ForecastService`
+    (``service.ensemble_forecast``); thread-safe — builds happen under a lock,
+    execution does not (compiled executables are reentrant)."""
+
+    def __init__(self, service: Any, fleet_cfg: Any = None) -> None:
+        from ddr_tpu.fleet.config import FleetConfig
+
+        self._svc = service
+        self.fleet_cfg = fleet_cfg or FleetConfig.from_env()
+        self._lock = threading.Lock()
+        # (network, model, E) -> AOT executable
+        self._fns: dict[tuple[str, str, int], Any] = {}
+
+    # ---- request path ----
+
+    def forecast(
+        self,
+        network: str,
+        model: str = "default",
+        q_prime: Any | None = None,
+        t0: int | None = None,
+        gauges: Any | None = None,
+        members: int = 8,
+        percentiles: Any | None = None,
+        seed: int = 0,
+        request_id: str | None = None,
+        trace_id: str | None = None,
+        return_members: bool = False,
+    ) -> dict:
+        """One ensemble forecast; same request fields as ``submit`` plus the
+        ensemble triple. Synchronous: an E-member request is already a full
+        batch of device work, so it runs on the caller's thread instead of
+        occupying E slots of the micro-batcher."""
+        from ddr_tpu.observability.trace import (
+            adopt_trace_id,
+            new_span_id,
+            trace_enabled,
+        )
+        from ddr_tpu.serving.service import make_request_id
+
+        svc = self._svc
+        net = svc._networks.get(network)
+        if net is None:
+            raise ValueError(f"unknown network {network!r}")
+        entry = svc.registry.get(model)  # one snapshot for all members
+        E = int(members)
+        if not 1 <= E <= self.fleet_cfg.ensemble_max_members:
+            raise ValueError(
+                f"members must be in [1, {self.fleet_cfg.ensemble_max_members}]"
+                f", got {members}"
+            )
+        qs = tuple(
+            float(p) for p in (DEFAULT_PERCENTILES if percentiles is None else percentiles)
+        )
+        if not qs or any(not 0.0 <= p <= 100.0 for p in qs):
+            raise ValueError(f"percentiles must be in [0, 100], got {qs!r}")
+        qp = self._window(net, network, q_prime, t0)
+        gauge_sel = self._gauge_selection(net, network, gauges)
+        rid = make_request_id(request_id)
+        trace: dict = {}
+        if trace_enabled():
+            trace = {"trace_id": adopt_trace_id(trace_id), "span_id": new_span_id()}
+
+        t_start = time.perf_counter()
+        fn = self._ensemble_fn(net, entry, E)
+        import jax
+
+        base_seed = np.uint32(perturbation_seed(rid, seed))
+        runoff_d, widx, wscore = fn(entry.params, qp, base_seed)
+        runoff_e = np.asarray(jax.block_until_ready(runoff_d))  # (E, T, G)
+        seconds = time.perf_counter() - t_start
+
+        if gauge_sel is not None:
+            runoff_e = runoff_e[:, :, gauge_sel]
+        # host-side percentiles: any requested list against the ONE program
+        bands = np.percentile(runoff_e, qs, axis=0)  # (P, T, G)
+        svc._emit(
+            "serve_request",
+            status="ok",
+            network=network,
+            model=model,
+            request_id=rid,
+            latency_s=round(seconds, 6),
+            execute_s=round(seconds, 6),
+            version=entry.version,
+            ensemble_members=E,
+            n_gauges=int(runoff_e.shape[2]),
+            slo_ok=True,
+            **trace,
+        )
+        out = {
+            "network": network,
+            "model": model,
+            "version": entry.version,
+            "engine": f"{svc._engine_label(net)}:ensemble{E}",
+            "request_id": rid,
+            "members": E,
+            "seed": int(seed),
+            "percentiles": list(qs),
+            # (P, T, G): one hydrograph band per requested percentile
+            "runoff": bands,
+            "mean": runoff_e.mean(axis=0),
+            "worst": {
+                "gauges": np.asarray(widx).astype(int).tolist(),
+                "scores": [round(float(s), 6) for s in np.asarray(wscore)],
+            },
+            "execute_s": round(seconds, 6),
+            **trace,
+        }
+        if return_members:
+            out["member_runoff"] = runoff_e
+        return out
+
+    # ---- validation (mirrors ForecastService.submit) ----
+
+    @staticmethod
+    def _window(net: Any, network: str, q_prime: Any | None, t0: int | None) -> np.ndarray:
+        if q_prime is not None and t0 is not None:
+            raise ValueError("pass q_prime or t0, not both")
+        if q_prime is not None:
+            qp = np.asarray(q_prime, dtype=np.float32)
+            if qp.shape != (net.horizon, net.n_segments):
+                raise ValueError(
+                    f"q_prime must be ({net.horizon}, {net.n_segments}), got {qp.shape}"
+                )
+            return qp
+        if net.forcing is None:
+            raise ValueError(
+                f"network {network!r} has no registered forcing; requests "
+                "must carry q_prime"
+            )
+        start = 0 if t0 is None else int(t0)
+        if not 0 <= start <= len(net.forcing) - net.horizon:
+            raise ValueError(
+                f"t0={start} out of range for forcing of {len(net.forcing)} "
+                f"hourly steps and horizon {net.horizon}"
+            )
+        return net.forcing[start : start + net.horizon]
+
+    @staticmethod
+    def _gauge_selection(net: Any, network: str, gauges: Any | None):
+        if gauges is None:
+            return None
+        sel = np.asarray(gauges, dtype=np.int64).ravel()
+        if sel.size == 0:
+            raise ValueError("gauges must be a non-empty index list (or omitted)")
+        if sel.min() < 0 or sel.max() >= net.n_outputs:
+            raise ValueError(
+                f"gauge index out of range [0, {net.n_outputs}) for "
+                f"network {network!r}"
+            )
+        return sel
+
+    # ---- the one compiled program per (network, model, E) ----
+
+    def _ensemble_fn(self, net: Any, entry: Any, E: int):
+        """The (network, model, E) triple's AOT program:
+        ``(kan_params, q_prime, base_seed) -> ((E, T, G) member runoff,
+        worst_idx, worst_score)``. Same structure as the service's serve
+        program with one extra vmap axis — the KAN and the denormalization
+        run ONCE, the member axis only perturbs and routes."""
+        svc = self._svc
+        cache_key = (net.name, entry.name, E)
+        fn = self._fns.get(cache_key)
+        pair = f"{net.name}/{entry.name}:ensemble{E}"
+        if fn is not None:
+            svc.tracker.hit(pair)
+            return fn
+        with self._lock:
+            fn = self._fns.get(cache_key)
+            if fn is not None:
+                svc.tracker.hit(pair)
+                return fn
+            t0 = time.perf_counter()
+            import jax
+            import jax.numpy as jnp
+
+            from ddr_tpu.observability.costs import build_card
+            from ddr_tpu.observability.health import compute_output_worst
+            from ddr_tpu.routing.mc import Bounds, route
+            from ddr_tpu.routing.model import denormalize_spatial_parameters
+
+            attrs = jnp.asarray(net.rd.normalized_spatial_attributes)
+            scale = (
+                None
+                if net.rd.flow_scale is None
+                else jnp.asarray(net.rd.flow_scale, jnp.float32)
+            )
+            bounds = Bounds.from_config(svc.cfg.params.attribute_minimums)
+            p = svc.cfg.params
+            kan_model, network, channels, gauges = (
+                entry.kan_model, net.network, net.channels, net.gauge_index,
+            )
+            n = net.n_segments
+            sigma = np.float32(self.fleet_cfg.ensemble_sigma)
+            top_k = min(max(1, svc.health_cfg.top_k or 8), net.n_outputs)
+
+            def _ensemble(kan_params, q_prime, base_seed):
+                # (T, N), uint32 -> ((E, T, G), (K,), (K,))
+                raw = kan_model.apply(kan_params, attrs)
+                phys = denormalize_spatial_parameters(
+                    raw, p.parameter_ranges, p.log_space_parameters, p.defaults, n
+                )
+                base_key = jax.random.PRNGKey(base_seed)
+
+                def one_member(m):
+                    # the EXACT op order member_forcing() replays offline
+                    key = jax.random.fold_in(base_key, m)
+                    qp = q_prime
+                    if sigma > 0.0:
+                        qp = qp * jnp.exp(
+                            sigma * jax.random.normal(key, q_prime.shape)
+                        )
+                    if scale is not None:
+                        qp = qp * scale[None, :]
+                    return route(
+                        network, channels, phys, qp, gauges=gauges, bounds=bounds
+                    ).runoff
+
+                runoff_e = jax.vmap(one_member)(jnp.arange(E))
+                # worst-gauge attribution over ALL members: a gauge that goes
+                # non-finite or extreme in any member is flood-forecasting
+                # signal, not noise
+                widx, wscore = compute_output_worst(runoff_e, top_k)
+                return runoff_e, widx, wscore
+
+            card, compiled = build_card(
+                jax.jit(_ensemble),
+                entry.params,
+                jax.ShapeDtypeStruct((net.horizon, n), np.float32),
+                jax.ShapeDtypeStruct((), np.uint32),
+                name=f"ensemble/{net.name}/{entry.name}/E{E}",
+                engine=f"{net.engine}:ensemble",
+            )
+            svc.tracker.miss(
+                pair, key=net.topology_key,
+                seconds=round(time.perf_counter() - t0, 4),
+                cache_entries=len(self._fns) + 1, source="aot", card=card,
+            )
+            self._fns[cache_key] = compiled
+            log.info(
+                f"compiled ensemble program ({net.name}, {entry.name}, E={E}) "
+                f"in {time.perf_counter() - t0:.2f}s"
+            )
+            return compiled

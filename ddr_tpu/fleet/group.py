@@ -1,0 +1,317 @@
+"""ReplicaGroup: N data-parallel forecast replicas behind one router.
+
+Two member shapes, one group surface:
+
+- ``inprocess``: N :class:`~ddr_tpu.serving.service.ForecastService` instances
+  built in THIS process by a caller-supplied ``builder(index)`` (tests,
+  single-host groups over device-mesh slices). Optionally each is fronted by
+  its own HTTP server (``http=True``) so the group is scrapeable/federatable.
+- ``subprocess``: N ``ddr serve`` workers launched on distinct ports (the
+  production shape — each replica is independently killable). Every worker
+  shares the parent's persistent compile cache (``DDR_COMPILE_CACHE_DIR``) so
+  replicas 2..N warm from replica 1's compiles, and gets its fleet identity
+  (``DDR_FLEET_GROUP`` / ``DDR_FLEET_REPLICA`` / ``DDR_FLEET_ROUTER``)
+  stamped into its environment — boot logs, ``/v1/stats`` and telemetry
+  attribute themselves to their slot in the group.
+
+At boot the group auto-populates ``DDR_FEDERATE_REPLICAS`` with every
+addressable member, so the PR-16 federation plane (``GET
+/metrics?federated=1`` on any replica, ``ddr metrics federate``) sees the
+whole group without hand-maintained target lists. The previous value is
+restored on :meth:`close` — booting a group must not permanently hijack the
+process's federation view.
+
+Dispatch goes through :class:`~ddr_tpu.fleet.router.Router` (least queue
+depth, health-aware ejection); :meth:`kill_replica` / :meth:`restart_replica`
+are the chaos-drill surface (``ddr chaos serve --kill-replica``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from ddr_tpu.fleet.config import FleetConfig
+from ddr_tpu.fleet.router import HttpReplica, InProcessReplica, Router
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ReplicaGroup"]
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ReplicaGroup:
+    """N forecast replicas, one front door. See the module docstring."""
+
+    def __init__(
+        self,
+        fleet_cfg: FleetConfig | None = None,
+        builder: Callable[[int], Any] | None = None,
+        serve_args: list[str] | None = None,
+        workdir: str | Path | None = None,
+        http: bool = False,
+        boot_timeout: float = 300.0,
+        client_timeout: float = 30.0,
+        extra_env: dict[str, str] | None = None,
+    ) -> None:
+        """``builder(index) -> ForecastService`` powers ``inprocess`` mode
+        (required there; each call must return a warmed or warmable service);
+        ``serve_args`` is the ``ddr serve`` argv tail (typically the config
+        path) for ``subprocess`` mode. ``http=True`` fronts each in-process
+        replica with its own HTTP server so the group is federatable.
+        ``extra_env`` is stamped into every subprocess replica's environment
+        (serve knobs like ``DDR_SERVE_MAX_BATCH``)."""
+        self.cfg = fleet_cfg or FleetConfig.from_env()
+        self._builder = builder
+        self._serve_args = list(serve_args or [])
+        self._extra_env = dict(extra_env or {})
+        self._http = bool(http)
+        self._boot_timeout = float(boot_timeout)
+        self._client_timeout = float(client_timeout)
+        self._workdir = Path(
+            workdir or tempfile.mkdtemp(prefix=f"ddr-fleet-{self.cfg.group}-")
+        )
+        self._lock = threading.Lock()
+        self._procs: dict[int, subprocess.Popen | None] = {}
+        self._ports: dict[int, int] = {}
+        self._boot_counts: dict[int, int] = {}
+        self._servers: list[Any] = []  # in-process HTTP fronts
+        self._prev_federate: str | None = None
+        self._published = False
+        self.replicas: list[Any] = []
+        self.router: Router | None = None
+        if self.cfg.mode == "inprocess" and builder is None:
+            raise ValueError("inprocess mode needs a builder(index) callable")
+        if self.cfg.mode == "subprocess" and not self._serve_args:
+            raise ValueError(
+                "subprocess mode needs serve_args (the `ddr serve` argv tail)"
+            )
+
+    # ---- boot ----
+
+    def boot(self) -> "ReplicaGroup":
+        """Build/launch every replica, wait for readiness, publish the
+        federation target list, start the router. Returns self."""
+        t0 = time.perf_counter()
+        if self.cfg.mode == "inprocess":
+            self._boot_inprocess()
+        else:
+            self._boot_subprocess()
+        self._publish_federation()
+        self.router = Router(
+            self.replicas,
+            probe_s=self.cfg.probe_s,
+            eject_after=self.cfg.eject_after,
+        )
+        log.info(
+            f"fleet group {self.cfg.group!r} up: {len(self.replicas)} "
+            f"{self.cfg.mode} replica(s) in {time.perf_counter() - t0:.1f}s"
+        )
+        return self
+
+    def _boot_inprocess(self) -> None:
+        for i in range(self.cfg.replicas):
+            service = self._builder(i)
+            replica = InProcessReplica(service, i, name=self._name(i))
+            if self._http:
+                from ddr_tpu.serving.http_api import serve_http
+
+                server = serve_http(service, host="127.0.0.1", port=0)
+                self._servers.append(server)
+                replica.url = server.url
+            self.replicas.append(replica)
+
+    def _replica_env(self, index: int, port: int) -> dict[str, str]:
+        env = dict(os.environ)
+        # all replicas warm from ONE persistent compile cache: replica 0's
+        # cold compile is everyone else's (and every restart's) warm start
+        env.setdefault(
+            "DDR_COMPILE_CACHE_DIR", str(self._workdir / "xla_cache")
+        )
+        env.pop("DDR_METRICS_DIR", None)  # workers log under their own dirs
+        env.update(self._extra_env)
+        env.update({
+            "DDR_SERVE_HOST": "127.0.0.1",
+            "DDR_SERVE_PORT": str(port),
+            "DDR_FLEET_GROUP": self.cfg.group,
+            "DDR_FLEET_REPLICA": str(index),
+            "DDR_FLEET_ROUTER": f"local:{os.getpid()}",
+        })
+        # every worker carries the WHOLE group's target list, so a federated
+        # scrape (`GET /metrics?federated=1`) of any surviving member reports
+        # ddr_federate_up for all of them — dead ones included
+        targets = self._federation_targets()
+        if targets:
+            env["DDR_FEDERATE_REPLICAS"] = ",".join(targets)
+        return env
+
+    def _federation_targets(self) -> list[str]:
+        if self.cfg.mode == "subprocess":
+            return [
+                f"{self._name(i)}=http://127.0.0.1:{self._ports[i]}/metrics"
+                for i in sorted(self._ports)
+            ]
+        return [f"{r.name}={r.url}/metrics" for r in self.replicas if r.url]
+
+    def _launch_one(self, index: int) -> HttpReplica:
+        port = self._ports.setdefault(
+            index, self.cfg.base_port + index if self.cfg.base_port else _free_port()
+        )
+        attempt = self._boot_counts.get(index, 0) + 1
+        self._boot_counts[index] = attempt
+        log_path = self._workdir / f"replica_{index}_boot{attempt}.out"
+        with log_path.open("ab") as fh:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ddr_tpu.cli", "serve", *self._serve_args],
+                stdout=fh, stderr=subprocess.STDOUT,
+                env=self._replica_env(index, port),
+            )
+        with self._lock:
+            self._procs[index] = proc
+        return HttpReplica(
+            f"http://127.0.0.1:{port}", index, name=self._name(index),
+            timeout=self._client_timeout,
+        )
+
+    def _boot_subprocess(self) -> None:
+        # allocate every port up front: the federation target list must be
+        # complete before the FIRST worker's environment is stamped
+        for i in range(self.cfg.replicas):
+            self._ports.setdefault(
+                i, self.cfg.base_port + i if self.cfg.base_port else _free_port()
+            )
+        self.replicas = [self._launch_one(i) for i in range(self.cfg.replicas)]
+        deadline = time.monotonic() + self._boot_timeout
+        for replica in self.replicas:
+            while not replica.ready():
+                proc = self._procs.get(replica.index)
+                if proc is not None and proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {replica.name} exited rc={proc.returncode} "
+                        f"during boot — see {self._workdir}"
+                    )
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"replica {replica.name} not ready within "
+                        f"{self._boot_timeout}s — see {self._workdir}"
+                    )
+                time.sleep(0.25)
+
+    def _name(self, index: int) -> str:
+        return f"{self.cfg.group}-r{index}"
+
+    def _publish_federation(self) -> None:
+        """Auto-populate ``DDR_FEDERATE_REPLICAS`` with every addressable
+        member (in-process replicas without an HTTP front have no scrape
+        URL and are skipped)."""
+        targets = self._federation_targets()
+        if not targets:
+            return
+        self._prev_federate = os.environ.get("DDR_FEDERATE_REPLICAS")
+        self._published = True
+        os.environ["DDR_FEDERATE_REPLICAS"] = ",".join(targets)
+        log.info(f"federation targets published: {len(targets)} replica(s)")
+
+    # ---- dispatch (the front door) ----
+
+    def forecast(self, **kwargs) -> dict:
+        if self.router is None:
+            raise RuntimeError("group not booted — call boot() first")
+        return self.router.forecast(**kwargs)
+
+    def ensemble(self, **kwargs) -> dict:
+        if self.router is None:
+            raise RuntimeError("group not booted — call boot() first")
+        return self.router.ensemble(**kwargs)
+
+    # ---- chaos surface ----
+
+    def kill_replica(self, index: int) -> None:
+        """SIGKILL a subprocess replica (or down an in-process one). The
+        router's probes/dispatch discover the death — this method does NOT
+        pre-announce it; discovery is what the drill measures."""
+        replica = self.replicas[index]
+        if self.cfg.mode == "inprocess":
+            replica.kill()
+        else:
+            with self._lock:
+                proc = self._procs.get(index)
+            if proc is not None:
+                proc.kill()
+                proc.wait()
+        log.info(f"replica {replica.name} killed")
+
+    def restart_replica(self, index: int) -> None:
+        """Bring a killed replica back on its original port/slot; the
+        router's prober re-admits it on the first successful probe."""
+        if self.cfg.mode == "inprocess":
+            self.replicas[index].revive()
+            return
+        replica = self._launch_one(index)
+        # same name + same port: swap the client into the router's existing
+        # slot rather than re-registering (the router keys state by name)
+        self.replicas[index].client = replica.client
+        log.info(f"replica {self.replicas[index].name} restarting")
+
+    # ---- inspection / lifecycle ----
+
+    def describe(self) -> dict:
+        return {
+            "group": self.cfg.group,
+            "mode": self.cfg.mode,
+            "replicas": len(self.replicas),
+            "workdir": str(self._workdir),
+            "federation": os.environ.get("DDR_FEDERATE_REPLICAS"),
+            "router": None if self.router is None else self.router.status(),
+        }
+
+    def close(self) -> None:
+        if self.router is not None:
+            self.router.close()
+        for server in self._servers:
+            try:
+                server.shutdown()
+            except Exception:
+                pass
+        if self.cfg.mode == "inprocess":
+            for replica in self.replicas:
+                try:
+                    replica.service.close(drain=False)
+                except Exception:
+                    log.exception(f"closing {replica.name} failed")
+        else:
+            with self._lock:
+                procs = list(self._procs.values())
+            for proc in procs:
+                if proc is None or proc.poll() is not None:
+                    continue
+                proc.terminate()
+            for proc in procs:
+                if proc is None:
+                    continue
+                try:
+                    proc.wait(timeout=15.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        # restore the federation view we hijacked at boot
+        if self._published:
+            if self._prev_federate is None:
+                os.environ.pop("DDR_FEDERATE_REPLICAS", None)
+            else:
+                os.environ["DDR_FEDERATE_REPLICAS"] = self._prev_federate
+            self._published = False

@@ -95,7 +95,10 @@ __all__ = [
 #: batch's identity, :mod:`ddr_tpu.observability.recovery`); ``data_anomaly``
 #: is one bounded forcing-validation finding from the ``data_load`` phase scan
 #: (non-finite / out-of-physical-range counts and the
-#: ``DDR_DATA_VALIDATE`` policy applied, same module).
+#: ``DDR_DATA_VALIDATE`` policy applied, same module). ``canary`` is one
+#: canary-controller state transition (shadow → canary@w% → promoted, or an
+#: auto-rollback, with the per-arm skill evidence that forced it,
+#: :mod:`ddr_tpu.fleet.canary`).
 #: Version of the event schema, stamped on every ``run_start`` so readers of
 #: FEDERATED logs (a fleet mixes replica versions during a rollout) can tell
 #: which vocabulary each file speaks. Bump when an event type is added or an
@@ -103,8 +106,9 @@ __all__ = [
 #: and fields rather than failing (``ddr metrics summarize``'s schema line,
 #: ``ddr lint`` rule DDR501). History: 1 = pre-trace schema; 2 = trace-context
 #: ids (``trace_id``/``span_id``/``parent_id``) on span/step/serve events,
-#: ``schema_version``/``prom_port`` on ``run_start``.
-SCHEMA_VERSION = 2
+#: ``schema_version``/``prom_port`` on ``run_start``; 3 = the ``canary``
+#: event (fleet tier) and a ``priority`` field on serve_request/serve_shed.
+SCHEMA_VERSION = 3
 
 EVENT_TYPES = (
     "run_start",
@@ -130,6 +134,7 @@ EVENT_TYPES = (
     "tune",
     "recovery",
     "data_anomaly",
+    "canary",
 )
 
 

@@ -461,14 +461,31 @@ def _summarize_serving(by_type: dict[str, list[dict]], w) -> None:
         w(line + "\n")
     if sheds:
         reasons: dict[str, int] = {}
+        by_class: dict[str, int] = {}
         for e in sheds:
             r = str(e.get("reason", "?"))
             reasons[r] = reasons.get(r, 0) + 1
+            c = str(e.get("priority") or "?")
+            by_class[c] = by_class.get(c, 0) + 1
         w(
             f"sheds    : {len(sheds)} — "
             + ", ".join(f"{k} {v}" for k, v in sorted(reasons.items()))
             + "\n"
         )
+        if any(c != "?" for c in by_class):
+            # which tier paid for the overload: sheds should concentrate in
+            # the lowest classes (strict priority's whole promise); pre-v3
+            # archives have no priority field and skip this line
+            order = {"interactive": 0, "batch": 1, "bulk": 2}
+            w(
+                "           by class: "
+                + ", ".join(
+                    f"{k} {v}" for k, v in sorted(
+                        by_class.items(), key=lambda kv: order.get(kv[0], 9)
+                    )
+                )
+                + "\n"
+            )
 
 
 def _summarize_fleet(by_type: dict[str, list[dict]], w) -> None:

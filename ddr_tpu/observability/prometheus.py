@@ -168,6 +168,11 @@ def declare_serve_metrics(registry: MetricsRegistry | None = None) -> MetricsReg
     if not qd.series():
         qd.set(0.0)
     r.counter("ddr_sheds_total", "Shed/rejected requests by reason", labels=("reason",))
+    # the priority-class split of the same decisions: which tier paid for the
+    # overload (interactive/batch/bulk). Kept as a second counter so existing
+    # reason-only dashboards keep their series names.
+    r.counter("ddr_serve_shed_total", "Shed/rejected requests by reason and "
+              "priority class", labels=("reason", "priority"))
     r.counter("ddr_compiles_total", "Step/plan-cache compile misses", labels=("engine",))
     r.counter("ddr_hot_reloads_total", "Checkpoint hot-reloads applied", labels=("model",))
     r.gauge("ddr_model_version", "Current params version per model", labels=("model",))
@@ -282,7 +287,11 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
         if record.get("queue_depth") is not None:
             r.get("ddr_queue_depth").set(_get(record, "queue_depth"))
     elif event == "serve_shed":
-        r.get("ddr_sheds_total").inc(reason=str(record.get("reason", "?")))
+        reason = str(record.get("reason", "?"))
+        r.get("ddr_sheds_total").inc(reason=reason)
+        r.get("ddr_serve_shed_total").inc(
+            reason=reason, priority=str(record.get("priority", "batch"))
+        )
     elif event == "health":
         for reason in record.get("reasons") or ["?"]:
             r.get("ddr_health_violations_total").inc(reason=str(reason))

@@ -763,6 +763,179 @@ def run_chaos_serve(args) -> dict[str, Any]:
     return report
 
 
+def run_chaos_serve_fleet(args) -> dict[str, Any]:
+    """``--kill-replica``: boot a 2-replica fleet group, SIGKILL one member
+    under open-loop load, and require (a) the router ejects it and reroutes,
+    (b) the client-visible error rate stays bounded, (c) the SURVIVOR's
+    federated scrape reports ``ddr_federate_up 0`` for the dead member, and
+    (d) the member is re-admitted after restart. Returns the record."""
+    if not args.synthetic:
+        raise SystemExit("ddr chaos serve --kill-replica needs --synthetic")
+    workdir = Path(args.out) / f"chaos_fleet_{args.label}"
+    workdir.mkdir(parents=True, exist_ok=True)
+
+    import urllib.request
+
+    import yaml
+
+    from ddr_tpu.fleet.config import FleetConfig
+    from ddr_tpu.fleet.group import ReplicaGroup
+    from ddr_tpu.fleet.router import NoHealthyReplicaError
+    from ddr_tpu.scripts.loadtest import Outcome, build_report, run_open_loop
+
+    cfg_path = workdir / "serve.yaml"
+    cfg_path.write_text(yaml.safe_dump(_serve_cfg_dict(workdir / "run", args)))
+    fleet_cfg = FleetConfig.from_env(
+        replicas=2, mode="subprocess", group="chaos", probe_s=0.25,
+    )
+    group = ReplicaGroup(
+        fleet_cfg,
+        serve_args=[str(cfg_path)],
+        workdir=workdir,
+        boot_timeout=args.boot_timeout,
+        extra_env={
+            "DDR_SERVE_HORIZON_HOURS": str(args.horizon),
+            "DDR_SERVE_MAX_BATCH": "4",
+        },
+    )
+    victim, survivor = 1, 0
+
+    def _replica_row(index: int) -> dict[str, Any]:
+        return group.router.status()["replicas"][index]
+
+    def _federated_up() -> dict[str, str]:
+        """Scrape the SURVIVOR federated; {replica_label: '0'|'1'}."""
+        url = f"{group.replicas[survivor].url}/metrics?federated=1"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            text = resp.read().decode("utf-8", "replace")
+        up: dict[str, str] = {}
+        for line in text.splitlines():
+            if line.startswith("ddr_federate_up{"):
+                label = line.split('replica="', 1)[1].split('"', 1)[0]
+                up[label] = line.rsplit(" ", 1)[1]
+        return up
+
+    timeline: list[tuple[float, Any]] = []
+    tl_lock = threading.Lock()
+
+    def fire(i: int) -> Outcome:
+        start = time.monotonic()
+        try:
+            group.forecast(
+                network="default", model="default", t0=i % 24,
+                request_id=f"cf-{i}",
+            )
+            o = Outcome("ok", time.monotonic() - start)
+        except NoHealthyReplicaError:
+            o = Outcome("error:unroutable", time.monotonic() - start)
+        except Exception as e:  # noqa: BLE001 - an error is a data point here
+            o = Outcome(f"error:{type(e).__name__}", time.monotonic() - start)
+        with tl_lock:
+            timeline.append((time.monotonic(), o))
+        return o
+
+    try:
+        group.boot()
+        load_done: dict[str, Any] = {}
+
+        def _load() -> None:
+            outcomes, wall, offered = run_open_loop(
+                fire, args.rps, args.duration, seed=args.seed,
+                max_inflight=args.max_inflight,
+            )
+            load_done.update(outcomes=outcomes, wall=wall, offered=offered)
+
+        loader = threading.Thread(target=_load, name="ddr-chaos-fleet-load")
+        loader.start()
+        time.sleep(max(0.0, args.kill_after))
+        t_kill = time.monotonic()
+        group.kill_replica(victim)
+        _emit_chaos(
+            mode="serve", action="kill", signal="kill", at_s=args.kill_after,
+            fleet=True, replica=victim,
+        )
+        ejected = _wait_for(
+            lambda: bool(_replica_row(victim)["ejected"]), None, 30.0, poll_s=0.1
+        )
+        eject_s = time.monotonic() - t_kill
+        fed_up = _federated_up() if ejected else {}
+        dead_label = group.replicas[victim].name
+        live_label = group.replicas[survivor].name
+        federation_saw_dead = (
+            fed_up.get(dead_label) == "0" and fed_up.get(live_label) == "1"
+        )
+        log.info(
+            f"chaos fleet: eject {'ok' if ejected else 'TIMEOUT'} in "
+            f"{eject_s:.2f}s; federated scrape sees {fed_up}"
+        )
+
+        group.restart_replica(victim)
+        readmitted = _wait_for(
+            lambda: not _replica_row(victim)["ejected"], None,
+            args.boot_timeout, poll_s=0.25,
+        )
+        t_ready = time.monotonic()
+        recovery_s = t_ready - t_kill
+        _emit_chaos(
+            mode="serve", fleet=True, replica=victim,
+            action="recovered" if readmitted else "recovery-timeout",
+            recovery_s=round(recovery_s, 3),
+        )
+        loader.join(timeout=args.duration + args.boot_timeout + 60.0)
+        if readmitted and not any(t >= t_ready for t, _ in timeline):
+            # the load window closed before re-admission: probe burst so the
+            # verdict still has post-restart evidence (timeline-only)
+            for i in range(10):
+                fire(10_000 + i)
+        router_status = group.router.status()
+    finally:
+        group.close()
+
+    outcomes = load_done.get("outcomes") or [o for _, o in timeline]
+    wall = load_done.get("wall") or max(args.duration, 1e-9)
+    offered = load_done.get("offered") or len(outcomes)
+    report = build_report(
+        outcomes, wall, offered,
+        mode="open", target="fleet:router", device=_device_platform(),
+        rps_target=args.rps, duration_s=args.duration, seed=args.seed,
+    )
+    post = [o for t, o in timeline if t >= t_ready]
+    post_att = (
+        round(sum(1 for o in post if o.ok) / len(post), 6) if post else None
+    )
+    error_rate = float(report.get("error_rate") or 0.0)
+    report.update({
+        "kind": "chaos",
+        "mode": "serve",
+        "fleet": True,
+        "label": args.label,
+        "replicas": 2,
+        "killed_replica": victim,
+        "kill_after_s": args.kill_after,
+        "eject_s": round(eject_s, 3),
+        "ejected": bool(ejected),
+        "federate_up": fed_up,
+        "federation_saw_dead": bool(federation_saw_dead),
+        "recovery_s": round(recovery_s, 3),
+        "recovered": bool(readmitted),
+        "dispatched": {
+            r["name"]: r["dispatched"] for r in router_status["replicas"]
+        },
+        "post_restart_requests": len(post),
+        "post_restart_attainment": post_att,
+        "passed": bool(
+            ejected
+            and federation_saw_dead
+            and readmitted
+            and error_rate <= 0.2
+            and post
+            and post_att
+            and post_att > 0.5
+        ),
+    })
+    return report
+
+
 # ---------------------------------------------------------------------------
 # CLI.
 # ---------------------------------------------------------------------------
@@ -805,6 +978,27 @@ def render_summary(report: dict[str, Any]) -> str:
             f"{report.get('params_max_abs_delta')}  (tolerance {report.get('tolerance')})"
         )
         lines.append(f"  recovery max {report.get('recovery_s')}s")
+    elif report.get("fleet"):
+        lines.append(
+            f"  fleet    killed replica {report.get('killed_replica')} of "
+            f"{report.get('replicas')}: ejected in {report.get('eject_s')}s, "
+            f"re-admitted in {report.get('recovery_s')}s"
+        )
+        lines.append(
+            f"  federate survivor scrape saw the dead member: "
+            f"{report.get('federation_saw_dead')} ({report.get('federate_up')})"
+        )
+        lines.append(
+            f"  traffic  {report.get('requests')} requests through the router, "
+            f"ok {report.get('ok')}, errors {report.get('errors')} "
+            f"(rate {report.get('error_rate')})"
+        )
+        att = report.get("post_restart_attainment")
+        lines.append(
+            "  post-restart attainment "
+            + ("-" if att is None else f"{100 * att:.2f}%")
+            + f" over {report.get('post_restart_requests')} requests"
+        )
     else:
         lines.append(
             f"  recovery {report.get('recovery_s')}s after SIGKILL at "
@@ -872,6 +1066,12 @@ def main(argv: list[str] | None = None) -> int:
                          help="load window, seconds (default 10)")
     p_serve.add_argument("--kill-after", type=float, default=3.0,
                          help="SIGKILL the replica this many seconds into the load")
+    p_serve.add_argument("--kill-replica", action="store_true", dest="kill_replica",
+                         help="fleet drill: boot a 2-replica group behind the "
+                         "router, SIGKILL one member under load, require "
+                         "ejection + bounded error rate + ddr_federate_up 0 "
+                         "on the survivor's federated scrape + re-admission "
+                         "after restart")
     p_serve.add_argument("--max-inflight", type=int, default=32)
     p_serve.add_argument("--seed", type=int, default=0)
     p_serve.add_argument("--boot-timeout", type=float, default=300.0,
@@ -906,6 +1106,8 @@ def main(argv: list[str] | None = None) -> int:
             report = run_chaos_nan_storm(args)
         elif args.mode == "train":
             report = run_chaos_train(args)
+        elif getattr(args, "kill_replica", False):
+            report = run_chaos_serve_fleet(args)
         else:
             report = run_chaos_serve(args)
         _emit_chaos(mode=args.mode, action="report", passed=report["passed"])

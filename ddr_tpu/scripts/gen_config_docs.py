@@ -74,6 +74,13 @@ variables. Families with their own reference tables are linked.
   compile cache is pinned, else no persistence): see docs/tpu.md "The engine
   auto-tuner".
 - `DDR_SERVE_*` — serving: see docs/serving.md.
+- `DDR_FLEET_*` (replica count/group label/deploy mode/base port, router
+  probe cadence + ejection threshold, ensemble member cap + perturbation
+  sigma, canary traffic weight/evidence floor/skill margin) plus the
+  per-replica identity stamps `DDR_FLEET_GROUP` / `DDR_FLEET_REPLICA` /
+  `DDR_FLEET_ROUTER` — the fleet tier (`ddr fleet`, replica groups, compiled
+  ensemble forecasts, skill-gated canary promotion): see docs/serving.md
+  "Fleet tier".
 - `DDR_BENCH_*` — `bench.py`: see `python bench.py --help`.
 - `DDR_CKPT_*` (format/async/retention), `DDR_IO_RETRIES`,
   `DDR_IO_RETRY_BACKOFF_S`, `DDR_FAULTS` / `DDR_FAULTS_SEED` — robustness:

@@ -67,10 +67,53 @@ class Outcome:
     latency_s: float
     queue_s: float | None = None  # server-reported queue wait (ok only)
     execute_s: float | None = None  # server-reported device time (ok only)
+    priority: str | None = None  # the class the request was fired under
 
     @property
     def ok(self) -> bool:
         return self.status == "ok"
+
+
+def parse_priority_mix(spec: str | None) -> list[tuple[str, float]] | None:
+    """``"interactive=0.6,batch=0.3,bulk=0.1"`` -> normalized (class, weight)
+    list, validated against the serving tier's priority classes. None/empty
+    spec -> None (all requests ride the default class)."""
+    if not spec:
+        return None
+    from ddr_tpu.serving.config import priority_rank
+
+    mix: list[tuple[str, float]] = []
+    for part in spec.split(","):
+        name, _, raw_w = part.partition("=")
+        name = name.strip()
+        priority_rank(name)  # raises on unknown class names
+        try:
+            weight = float(raw_w) if raw_w.strip() else 1.0
+        except ValueError as e:
+            raise ValueError(f"bad priority weight in {part!r}: {e}") from e
+        if weight < 0:
+            raise ValueError(f"priority weight must be >= 0, got {part!r}")
+        mix.append((name, weight))
+    total = sum(w for _, w in mix)
+    if total <= 0:
+        raise ValueError(f"priority mix {spec!r} sums to zero")
+    return [(name, w / total) for name, w in mix]
+
+
+def priority_for(
+    i: int, mix: list[tuple[str, float]] | None, seed: int = 0
+) -> str | None:
+    """Request ``i``'s class under the mix — deterministic per (seed, i), so
+    a replayed run fires the identical class sequence."""
+    if not mix:
+        return None
+    frac = random.Random((seed, i)).random()
+    acc = 0.0
+    for name, weight in mix:
+        acc += weight
+        if frac < acc:
+            return name
+    return mix[-1][0]
 
 
 # ---------------------------------------------------------------------------
@@ -89,11 +132,17 @@ class InProcessDriver:
         model: str = "default",
         t0_span: int | None = None,
         deadline_ms: float | None = None,
+        priority_mix: list[tuple[str, float]] | None = None,
+        ensemble: int = 0,
+        seed: int = 0,
     ) -> None:
         self.service = service
         self.network = network
         self.model = model
         self.deadline_ms = deadline_ms
+        self.priority_mix = priority_mix
+        self.ensemble = int(ensemble)
+        self.seed = int(seed)
         net = service.networks()[network]
         if t0_span is None:
             t0_span = (
@@ -107,30 +156,140 @@ class InProcessDriver:
     def fire(self, i: int) -> Outcome:
         from ddr_tpu.serving import QueueFullError, RequestShedError
 
+        prio = priority_for(i, self.priority_mix, self.seed)
         start = time.monotonic()
         try:
-            out = self.service.forecast(
-                network=self.network,
-                model=self.model,
-                t0=i % self.t0_span,
-                deadline_s=None if self.deadline_ms is None else self.deadline_ms / 1e3,
-                request_id=f"lt-{i}",
-                timeout=self._wait_s,
-            )
+            if self.ensemble > 0:
+                # synchronous: an E-member request IS a batch of device work
+                out = self.service.ensemble_forecast(
+                    network=self.network,
+                    model=self.model,
+                    t0=i % self.t0_span,
+                    members=self.ensemble,
+                    request_id=f"lt-{i}",
+                )
+            else:
+                out = self.service.forecast(
+                    network=self.network,
+                    model=self.model,
+                    t0=i % self.t0_span,
+                    deadline_s=None if self.deadline_ms is None else self.deadline_ms / 1e3,
+                    request_id=f"lt-{i}",
+                    timeout=self._wait_s,
+                    priority=prio,
+                )
         except QueueFullError:
-            return Outcome("rejected", time.monotonic() - start)
+            return Outcome("rejected", time.monotonic() - start, priority=prio)
         except RequestShedError as e:
-            return Outcome(f"shed:{e.reason}", time.monotonic() - start)
+            return Outcome(f"shed:{e.reason}", time.monotonic() - start, priority=prio)
         except FutureTimeoutError:
-            return Outcome("error:timeout", time.monotonic() - start)
+            return Outcome("error:timeout", time.monotonic() - start, priority=prio)
         except Exception as e:  # noqa: BLE001 - an error is a data point here
-            return Outcome(f"error:{type(e).__name__}", time.monotonic() - start)
+            return Outcome(
+                f"error:{type(e).__name__}", time.monotonic() - start, priority=prio
+            )
         return Outcome(
-            "ok", time.monotonic() - start, out.get("queue_s"), out.get("execute_s")
+            "ok", time.monotonic() - start, out.get("queue_s"), out.get("execute_s"),
+            priority=prio,
         )
 
     def stats(self) -> dict:
         return self.service.stats()
+
+
+class FleetDriver:
+    """Drive an in-process :class:`~ddr_tpu.fleet.group.ReplicaGroup` through
+    its front-door router (``--fleet N``) — the N-replica scaling proof runs
+    the same generators and report as the single-service path, so a fleet
+    record and a single-replica record are directly comparable."""
+
+    def __init__(
+        self,
+        group: Any,
+        network: str = "default",
+        model: str = "default",
+        t0_span: int | None = None,
+        deadline_ms: float | None = None,
+        priority_mix: list[tuple[str, float]] | None = None,
+        ensemble: int = 0,
+        seed: int = 0,
+    ) -> None:
+        self.group = group
+        self.network = network
+        self.model = model
+        self.deadline_ms = deadline_ms
+        self.priority_mix = priority_mix
+        self.ensemble = int(ensemble)
+        self.seed = int(seed)
+        svc = group.replicas[0].service
+        net = svc.networks()[network]
+        if t0_span is None:
+            t0_span = (
+                1 if net.forcing is None
+                else max(1, len(net.forcing) - net.horizon + 1)
+            )
+        self.t0_span = max(1, int(t0_span))
+        deadline_s = svc.serve_cfg.deadline_s if deadline_ms is None else deadline_ms / 1e3
+        self._wait_s = deadline_s + 5.0
+
+    def fire(self, i: int) -> Outcome:
+        from ddr_tpu.fleet.router import NoHealthyReplicaError
+        from ddr_tpu.serving import QueueFullError, RequestShedError
+
+        prio = priority_for(i, self.priority_mix, self.seed)
+        start = time.monotonic()
+        try:
+            if self.ensemble > 0:
+                out = self.group.ensemble(
+                    network=self.network,
+                    model=self.model,
+                    t0=i % self.t0_span,
+                    members=self.ensemble,
+                    request_id=f"lt-{i}",
+                )
+            else:
+                out = self.group.forecast(
+                    network=self.network,
+                    model=self.model,
+                    t0=i % self.t0_span,
+                    deadline_s=None if self.deadline_ms is None else self.deadline_ms / 1e3,
+                    request_id=f"lt-{i}",
+                    timeout=self._wait_s,
+                    priority=prio,
+                )
+        except QueueFullError:
+            return Outcome("rejected", time.monotonic() - start, priority=prio)
+        except RequestShedError as e:
+            return Outcome(f"shed:{e.reason}", time.monotonic() - start, priority=prio)
+        except NoHealthyReplicaError:
+            return Outcome("error:unroutable", time.monotonic() - start, priority=prio)
+        except FutureTimeoutError:
+            return Outcome("error:timeout", time.monotonic() - start, priority=prio)
+        except Exception as e:  # noqa: BLE001 - an error is a data point here
+            return Outcome(
+                f"error:{type(e).__name__}", time.monotonic() - start, priority=prio
+            )
+        return Outcome(
+            "ok", time.monotonic() - start, out.get("queue_s"), out.get("execute_s"),
+            priority=prio,
+        )
+
+    def stats(self) -> dict:
+        """Group-wide rollup in the single-service stats shape: queue counters
+        sum across replicas (batch occupancy in the report stays meaningful —
+        N half-full replicas ARE half-full capacity), config from replica 0."""
+        merged: dict[str, Any] = {"queue": {}, "replicas": len(self.group.replicas)}
+        for r in self.group.replicas:
+            try:
+                stats = r.stats()
+            except Exception:  # a dead replica must not void the measured run
+                continue
+            if not merged.get("config"):
+                merged["config"] = stats.get("config") or {}
+            for k, v in (stats.get("queue") or {}).items():
+                if isinstance(v, (int, float)):
+                    merged["queue"][k] = merged["queue"].get(k, 0) + v
+        return merged
 
 
 class HttpDriver:
@@ -145,6 +304,9 @@ class HttpDriver:
         t0_span: int = 24,
         deadline_ms: float | None = None,
         timeout_s: float = 60.0,
+        priority_mix: list[tuple[str, float]] | None = None,
+        ensemble: int = 0,
+        seed: int = 0,
     ) -> None:
         from ddr_tpu.serving.client import HttpForecastClient
 
@@ -153,8 +315,12 @@ class HttpDriver:
         self.model = model
         self.t0_span = max(1, int(t0_span))
         self.deadline_ms = deadline_ms
+        self.priority_mix = priority_mix
+        self.ensemble = int(ensemble)
+        self.seed = int(seed)
 
     def fire(self, i: int) -> Outcome:
+        prio = priority_for(i, self.priority_mix, self.seed)
         start = time.monotonic()
         try:
             code, body = self.client.forecast_response(
@@ -163,18 +329,26 @@ class HttpDriver:
                 t0=i % self.t0_span,
                 deadline_ms=self.deadline_ms,
                 request_id=f"lt-{i}",
+                priority=prio,
+                ensemble=(
+                    {"members": self.ensemble} if self.ensemble > 0 else None
+                ),
             )
         except Exception as e:  # URLError, socket timeouts, connection resets
-            return Outcome(f"error:{type(e).__name__}", time.monotonic() - start)
+            return Outcome(
+                f"error:{type(e).__name__}", time.monotonic() - start, priority=prio
+            )
         lat = time.monotonic() - start
         if code == 200:
-            return Outcome("ok", lat, body.get("queue_s"), body.get("execute_s"))
+            return Outcome(
+                "ok", lat, body.get("queue_s"), body.get("execute_s"), priority=prio
+            )
         if code == 429:
-            return Outcome("rejected", lat)
+            return Outcome("rejected", lat, priority=prio)
         reason = body.get("reason")
         if code == 503 and reason:
-            return Outcome(f"shed:{reason}", lat)
-        return Outcome(f"error:http-{code}", lat)
+            return Outcome(f"shed:{reason}", lat, priority=prio)
+        return Outcome(f"error:http-{code}", lat, priority=prio)
 
     def stats(self) -> dict:
         try:
@@ -348,6 +522,33 @@ def build_report(
         ),
     }
 
+    # per-class slice under --priority-mix: strict-priority extraction and
+    # lowest-class-first shedding should show up HERE (interactive low p99,
+    # drops pooling in bulk), not need a log replay to see
+    by_priority: dict[str, dict[str, Any]] = {}
+    for o in outcomes:
+        if o.priority is None:
+            continue
+        d = by_priority.setdefault(
+            o.priority, {"requests": 0, "ok": 0, "dropped": 0, "_lat": []}
+        )
+        d["requests"] += 1
+        if o.ok:
+            d["ok"] += 1
+            d["_lat"].append(o.latency_s)
+        elif o.status == "rejected" or o.status.startswith("shed:"):
+            d["dropped"] += 1
+    if by_priority:
+        report["by_priority"] = {
+            cls: {
+                "requests": d["requests"],
+                "ok": d["ok"],
+                "dropped": d["dropped"],
+                **_quantile_fields(d.pop("_lat"), ""),
+            }
+            for cls, d in sorted(by_priority.items())
+        }
+
     # batch occupancy from the service's own counters (the delta over the run)
     mean_size = occupancy = None
     try:
@@ -415,6 +616,13 @@ def render_summary(report: dict[str, Any]) -> str:
     if report["errors"]:
         drops.append(f"errors {report['errors']}")
     lines.append("  drops    " + (", ".join(drops) if drops else "none"))
+    for cls, d in sorted((report.get("by_priority") or {}).items()):
+        p99 = d.get("p99_ms")
+        lines.append(
+            f"  class    {cls}: {d['requests']} requests, ok {d['ok']}, "
+            f"dropped {d['dropped']}, p99 "
+            + ("-" if p99 is None else f"{p99:.1f}ms")
+        )
     att = report.get("slo_attainment")
     target = report.get("slo_target")
     slo_line = "  slo      " + ("-" if att is None else f"attainment {100 * att:.2f}%")
@@ -503,11 +711,16 @@ def run_loadtest(driver, args_ns) -> dict[str, Any]:
             device = str(jax.devices()[0].platform)
         except Exception:
             device = None
+    fleet_n = int(getattr(args_ns, "fleet", 0) or 0)
     return build_report(
         outcomes, wall, offered,
         stats_before=stats_before, stats_after=stats_after,
         mode=args_ns.mode,
-        target=args_ns.url or ("synthetic" if args_ns.synthetic else "config"),
+        target=args_ns.url or (
+            f"fleet:{fleet_n}" if fleet_n > 1
+            else "synthetic" if args_ns.synthetic else "config"
+        ),
+        fleet=fleet_n if fleet_n > 1 else None,
         device=device,
         rps_target=args_ns.rps if args_ns.mode == "open" else None,
         clients=args_ns.clients if args_ns.mode == "closed" else None,
@@ -516,6 +729,8 @@ def run_loadtest(driver, args_ns) -> dict[str, Any]:
         model=args_ns.model,
         deadline_ms=args_ns.deadline_ms,
         seed=args_ns.seed,
+        priority_mix=getattr(args_ns, "priority_mix", None),
+        ensemble_members=getattr(args_ns, "ensemble", 0) or None,
     )
 
 
@@ -555,6 +770,17 @@ def main(argv: list[str] | None = None) -> int:
                         "(default: the registered forcing's full span; 24 for --url)")
     parser.add_argument("--max-inflight", type=int, default=64,
                         help="open-loop in-flight request cap (default 64)")
+    parser.add_argument("--priority-mix", default=None, dest="priority_mix",
+                        help='fire requests across priority classes, e.g. '
+                        '"interactive=0.6,batch=0.3,bulk=0.1" (weights '
+                        "normalize; the report gains a by_priority slice)")
+    parser.add_argument("--ensemble", type=int, default=0,
+                        help="fire E-member ensemble requests instead of "
+                        "scalar forecasts (default 0 = off)")
+    parser.add_argument("--fleet", type=int, default=0,
+                        help="drive an in-process N-replica group through the "
+                        "fleet router instead of one service (synthetic "
+                        "target only; default 0 = off)")
     parser.add_argument("--seed", type=int, default=0,
                         help="arrival-process RNG seed (default 0)")
     parser.add_argument("--label", default=None,
@@ -577,13 +803,38 @@ def main(argv: list[str] | None = None) -> int:
     label = args.label or time.strftime("%Y%m%d-%H%M%S")
 
     service = None
+    group = None
     cfg = None
     try:
-        if args.url:
+        mix = parse_priority_mix(args.priority_mix)
+        if args.fleet > 1:
+            if args.url:
+                log.error("--fleet boots its own in-process group; drop --url")
+                return 2
+            from ddr_tpu.fleet.config import FleetConfig
+            from ddr_tpu.fleet.group import ReplicaGroup
+            from ddr_tpu.scripts.common import apply_compile_cache_env
+
+            apply_compile_cache_env()
+            group = ReplicaGroup(
+                FleetConfig.from_env(replicas=args.fleet, mode="inprocess"),
+                builder=lambda i: build_synthetic_service(
+                    args.n, args.horizon, save_path=str(out_dir)
+                )[0],
+                workdir=out_dir,
+            )
+            group.boot()
+            driver = FleetDriver(
+                group, network=args.network, model=args.model,
+                t0_span=args.t0_span, deadline_ms=args.deadline_ms,
+                priority_mix=mix, ensemble=args.ensemble, seed=args.seed,
+            )
+        elif args.url:
             driver = HttpDriver(
                 args.url, network=args.network, model=args.model,
                 t0_span=24 if args.t0_span is None else args.t0_span,
                 deadline_ms=args.deadline_ms,
+                priority_mix=mix, ensemble=args.ensemble, seed=args.seed,
             )
         else:
             from ddr_tpu.scripts.common import apply_compile_cache_env
@@ -605,6 +856,7 @@ def main(argv: list[str] | None = None) -> int:
             driver = InProcessDriver(
                 service, network=args.network, model=args.model,
                 t0_span=args.t0_span, deadline_ms=args.deadline_ms,
+                priority_mix=mix, ensemble=args.ensemble, seed=args.seed,
             )
         with run_telemetry(cfg, "loadtest", mode=args.mode):
             try:
@@ -615,9 +867,14 @@ def main(argv: list[str] | None = None) -> int:
                 if service is not None:
                     service.close(drain=False)
                     service = None
+                if group is not None:
+                    group.close()
+                    group = None
     finally:
         if service is not None:  # construction failed before the run
             service.close(drain=False)
+        if group is not None:
+            group.close()
 
     path = out_dir / f"LOADTEST_{label}.json"
     path.write_text(json.dumps(report, indent=2) + "\n")

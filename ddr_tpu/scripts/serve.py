@@ -86,6 +86,16 @@ def serve(cfg: Config, serve_cfg: ServeConfig | None = None) -> int:
             f"/metrics?federated=1 federates {len(replicas)} replica(s): "
             + ", ".join(label for label, _ in replicas)
         )
+    from ddr_tpu.fleet.config import fleet_identity
+
+    identity = fleet_identity()
+    if identity is not None:
+        log.info(
+            f"fleet identity: group {identity['group']!r} replica "
+            f"{identity.get('replica', '?')} (router "
+            f"{identity.get('router', 'unknown')}) — /v1/stats carries this "
+            "under \"fleet\""
+        )
     try:
         serve_http(service, block=True)
     except KeyboardInterrupt:

@@ -17,11 +17,15 @@ Scheduling policy:
   queued request with the EARLIEST deadline (the one already most likely to
   miss it — ties by oldest admission; no-deadline requests are never preferred
   victims, and an arrival whose own deadline is the earliest is rejected
-  instead of admitted);
-- the worker takes the queue head, holds its batch open up to ``batch_wait_s``
-  for more requests with the SAME batch key (network, model), caps at
-  ``max_batch``, and preserves FIFO order across keys — a burst on network A
-  cannot starve a lone request on network B beyond one batch;
+  instead of admitted). Victims are chosen LOWEST priority class first
+  (``bulk`` before ``batch`` before ``interactive``) — under overload the
+  best-effort tier pays before the user-facing one;
+- the worker takes the highest-priority queued request as the batch head
+  (FIFO within a class), holds its batch open up to ``batch_wait_s`` for more
+  requests with the SAME batch key (network, model), caps at ``max_batch``
+  filling strict-priority-first, and otherwise preserves FIFO order across
+  keys — a burst on network A cannot starve a lone request on network B
+  beyond one batch;
 - requests whose deadline passed while queued are shed at extraction time,
   never executed: a late answer to a forecast request is a wrong answer;
 - ``execute`` failures fail that batch's requests individually; the worker
@@ -82,6 +86,7 @@ class ForecastRequest:
     admitted: float = 0.0  # monotonic seconds, stamped by admit()
     extracted: float = 0.0  # monotonic seconds, stamped at batch extraction
     deadline: float | None = None  # monotonic seconds, None = no deadline
+    priority: str = "batch"  # one of config.PRIORITIES; validated by submit()
 
     def age(self, now: float | None = None) -> float:
         return (time.monotonic() if now is None else now) - self.admitted
@@ -119,6 +124,9 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._stopping = False
         self._stats = {"admitted": 0, "served": 0, "shed": 0, "rejected": 0, "batches": 0}
+        #: per-(reason, priority) shed counts — the observable half of the
+        #: priority classes (`ddr_serve_shed_total{reason,priority}`)
+        self._shed_by: dict[tuple[str, str], int] = {}
         self._worker = threading.Thread(
             target=self._loop, name="ddr-serve-batcher", daemon=True
         )
@@ -130,7 +138,11 @@ class MicroBatcher:
         """Admit one request, applying backpressure; returns ``req`` with its
         admission timestamp set. Raises :class:`QueueFullError` under
         reject-new; under shed-oldest the queue head's future is failed
-        instead and the arrival is admitted."""
+        instead and the arrival is admitted. Shed victims come from the
+        LOWEST priority class present in the queue."""
+        from ddr_tpu.serving.config import priority_rank
+
+        rank = priority_rank(req.priority)  # validates the class name too
         victim: ForecastRequest | None = None
         with self._cond:
             if self._stopping:
@@ -143,30 +155,37 @@ class MicroBatcher:
                     )
                 if self.backpressure == "shed-oldest":
                     victim = self._q.pop(0)
-                else:  # shed-by-deadline: earliest deadline loses, not oldest
+                else:  # shed-by-deadline: lowest class loses first, then
+                    # earliest deadline within it (never oldest admission)
                     idx = min(
                         range(len(self._q)),
                         key=lambda i: (
+                            -priority_rank(self._q[i].priority),
                             self._q[i].deadline is None,  # no deadline sorts last
                             self._q[i].deadline or 0.0,
                             self._q[i].admitted,
                         ),
                     )
                     cand = self._q[idx]
-                    if req.deadline is not None and (
-                        cand.deadline is None or req.deadline < cand.deadline
-                    ):
-                        # the arrival itself is the most-doomed request: reject
-                        # it rather than admit-then-shed (keeps the 429 at the
-                        # edge, where the caller can back off)
+                    cand_rank = priority_rank(cand.priority)
+                    doomed = rank > cand_rank or (
+                        rank == cand_rank
+                        and req.deadline is not None
+                        and (cand.deadline is None or req.deadline < cand.deadline)
+                    )
+                    if doomed:
+                        # the arrival itself is the most-doomed request (lower
+                        # class than every queued one, or same class with the
+                        # earliest deadline): reject it rather than
+                        # admit-then-shed (keeps the 429 at the edge, where
+                        # the caller can back off)
                         self._stats["rejected"] += 1
                         raise QueueFullError(
                             f"queue at capacity ({self.queue_cap}) and the "
-                            "arriving request holds the earliest deadline; "
-                            "request rejected"
+                            "arriving request is the preferred shed victim "
+                            "(lowest class, earliest deadline); request rejected"
                         )
                     victim = self._q.pop(idx)
-                self._stats["shed"] += 1
             req.admitted = time.monotonic()
             self._q.append(req)
             self._stats["admitted"] += 1
@@ -190,13 +209,19 @@ class MicroBatcher:
                 (victims if predicate(r) else survivors).append(r)
             if victims:
                 self._q = survivors
-                self._stats["shed"] += len(victims)
                 self._cond.notify_all()
         for r in victims:
             self._fail_shed(r, reason)
         return len(victims)
 
     def _fail_shed(self, req: ForecastRequest, reason: str) -> None:
+        # ALL shed accounting lives here (total + per-(reason, priority)), so
+        # every shed path — backpressure victim, deadline expiry, purge,
+        # shutdown — counts identically. Callers must not hold the lock.
+        with self._cond:
+            self._stats["shed"] += 1
+            by = (reason, req.priority)
+            self._shed_by[by] = self._shed_by.get(by, 0) + 1
         err = RequestShedError(
             reason,
             f"request shed ({reason})",
@@ -214,14 +239,23 @@ class MicroBatcher:
     # ---- worker ----
 
     def _loop(self) -> None:
+        from ddr_tpu.serving.config import priority_rank
+
         while True:
             with self._cond:
                 while not self._q and not self._stopping:
                     self._cond.wait()
                 if self._stopping and not self._q:
                     return
-                head = self._q[0]
-                key = head.key
+                # strict-priority head: the highest class queued goes first
+                # (FIFO within a class — min() takes the earliest index on
+                # rank ties), so an interactive arrival never waits behind a
+                # bulk backlog for more than the in-flight batch
+                head = min(
+                    range(len(self._q)),
+                    key=lambda i: (priority_rank(self._q[i].priority), i),
+                )
+                key = self._q[head].key
                 # Hold the head's batch open for co-batchable arrivals, but
                 # never past batch_wait_s from NOW (the head may have queued
                 # behind earlier batches for longer than the window already).
@@ -232,13 +266,15 @@ class MicroBatcher:
                     and time.monotonic() < hold_until
                 ):
                     self._cond.wait(timeout=max(0.0, hold_until - time.monotonic()))
-                batch: list[ForecastRequest] = []
-                rest: list[ForecastRequest] = []
-                for r in self._q:
-                    if r.key == key and len(batch) < self.max_batch:
-                        batch.append(r)
-                    else:
-                        rest.append(r)
+                # extraction is strict-priority too: same-key requests board
+                # highest-class-first (FIFO within a class) up to max_batch
+                matching = sorted(
+                    (i for i, r in enumerate(self._q) if r.key == key),
+                    key=lambda i: (priority_rank(self._q[i].priority), i),
+                )
+                chosen = set(matching[: self.max_batch])
+                batch = [self._q[i] for i in sorted(chosen)]
+                rest = [r for i, r in enumerate(self._q) if i not in chosen]
                 self._q = rest
                 depth = len(rest)
                 self._cond.notify_all()
@@ -251,8 +287,6 @@ class MicroBatcher:
                 # whole story of why it died)
                 r.extracted = now
                 if r.deadline is not None and now > r.deadline:
-                    with self._cond:
-                        self._stats["shed"] += 1
                     self._fail_shed(r, "deadline")
                 else:
                     live.append(r)
@@ -273,10 +307,15 @@ class MicroBatcher:
 
     # ---- lifecycle / inspection ----
 
-    def stats(self) -> dict[str, int]:
+    def stats(self) -> dict:
         with self._cond:
-            out = dict(self._stats)
+            out: dict = dict(self._stats)
             out["depth"] = len(self._q)
+            # JSON-friendly per-class split: {"reason/priority": count}
+            out["shed_by_class"] = {
+                f"{reason}/{priority}": n
+                for (reason, priority), n in sorted(self._shed_by.items())
+            }
             return out
 
     def close(self, drain: bool = True) -> None:
@@ -290,7 +329,5 @@ class MicroBatcher:
                 self._q = []
             self._cond.notify_all()
         for r in backlog:
-            with self._cond:
-                self._stats["shed"] += 1
             self._fail_shed(r, "queue-full")
         self._worker.join(timeout=10.0)

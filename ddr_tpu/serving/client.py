@@ -63,6 +63,11 @@ class ForecastClient:
         ``(horizon, n_gauges)``."""
         return self._service.forecast(timeout=timeout, **kwargs)
 
+    def ensemble_forecast(self, **kwargs) -> dict:
+        """E-member ensemble forecast (fleet tier); ``runoff`` comes back as
+        the ``(percentiles, horizon, n_gauges)`` band stack."""
+        return self._service.ensemble_forecast(**kwargs)
+
     def healthy(self) -> bool:
         return True  # in-process: alive iff we are
 
@@ -143,6 +148,8 @@ class HttpForecastClient:
         gauges: list[int] | None = None,
         deadline_ms: float | None = None,
         request_id: str | None = None,
+        priority: str | None = None,
+        ensemble: dict | None = None,
     ) -> tuple[int, dict]:
         """POST /v1/forecast; returns ``(status_code, body)`` without raising
         on HTTP errors — the load-generation path, where a 429/503 is a data
@@ -151,7 +158,11 @@ class HttpForecastClient:
         ``X-DDR-Request-Id`` header and is echoed back by the server. With
         ``retries > 0`` on the client, retryable outcomes (429/503,
         connection reset/refused) are re-sent per the class docstring; the
-        returned pair is the LAST attempt's."""
+        returned pair is the LAST attempt's. ``priority`` names the request's
+        class (``interactive``/``batch``/``bulk``); ``ensemble`` (e.g.
+        ``{"members": 16, "percentiles": [10, 50, 90], "seed": 0}``) turns the
+        request into an E-member ensemble forecast — the body's ``runoff``
+        comes back ``(P, T, G)``."""
         body: dict[str, Any] = {"network": network, "model": model}
         if q_prime is not None:
             body["q_prime"] = np.asarray(q_prime, dtype=np.float32).tolist()
@@ -161,6 +172,10 @@ class HttpForecastClient:
             body["gauges"] = [int(g) for g in gauges]
         if deadline_ms is not None:
             body["deadline_ms"] = float(deadline_ms)
+        if priority is not None:
+            body["priority"] = str(priority)
+        if ensemble is not None:
+            body["ensemble"] = dict(ensemble)
         if request_id is None and self.retries > 0:
             # the retry chain must share one trace id; mint it client-side
             request_id = make_request_id()
@@ -230,17 +245,23 @@ class HttpForecastClient:
         gauges: list[int] | None = None,
         deadline_ms: float | None = None,
         request_id: str | None = None,
+        priority: str | None = None,
+        ensemble: dict | None = None,
     ) -> dict:
         """POST /v1/forecast; raises RuntimeError with the server's error body
-        on any non-200. ``runoff`` comes back as a numpy array. Same explicit
-        signature as before request tracing — positional ``model`` callers
-        and kwarg typos keep failing at the call site, not inside the wire
-        layer."""
+        on any non-200. ``runoff`` comes back as a numpy array — ``(T, G)``,
+        or the ``(P, T, G)`` percentile bands when ``ensemble`` is set. Same
+        explicit signature as before request tracing — positional ``model``
+        callers and kwarg typos keep failing at the call site, not inside the
+        wire layer."""
         code, out = self.forecast_response(
             network, model=model, q_prime=q_prime, t0=t0, gauges=gauges,
             deadline_ms=deadline_ms, request_id=request_id,
+            priority=priority, ensemble=ensemble,
         )
         if code != 200:
             raise RuntimeError(f"forecast failed ({code}): {out.get('error', out)}")
         out["runoff"] = np.asarray(out["runoff"], dtype=np.float32)
+        if "mean" in out:  # ensemble responses carry the member mean too
+            out["mean"] = np.asarray(out["mean"], dtype=np.float32)
         return out

@@ -13,7 +13,29 @@ from __future__ import annotations
 import dataclasses
 import os
 
-__all__ = ["BACKPRESSURE_POLICIES", "ServeConfig"]
+__all__ = ["BACKPRESSURE_POLICIES", "PRIORITIES", "ServeConfig", "priority_rank"]
+
+#: Request priority classes, HIGHEST first. ``interactive`` is the
+#: user-facing tier (a person is waiting on the hydrograph), ``batch`` the
+#: default work tier, ``bulk`` the best-effort backfill tier. Extraction is
+#: strict-priority (a queued interactive request always boards the next
+#: compatible batch before any bulk request), and shed-by-deadline victims
+#: are chosen lowest-class-first — under overload, bulk pays first.
+PRIORITIES = ("interactive", "batch", "bulk")
+
+#: The default class for requests that don't name one.
+DEFAULT_PRIORITY = "batch"
+
+
+def priority_rank(priority: str) -> int:
+    """0 for the highest class; raises ``ValueError`` on an unknown name so
+    caller typos fail at admission, never inside the scheduler."""
+    try:
+        return PRIORITIES.index(priority)
+    except ValueError:
+        raise ValueError(
+            f"unknown priority {priority!r}; expected one of {PRIORITIES}"
+        ) from None
 
 #: Accepted ``backpressure`` values: what happens when the request queue is at
 #: ``queue_cap`` and another request arrives.

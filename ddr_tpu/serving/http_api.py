@@ -25,9 +25,16 @@ Endpoints (all JSON unless noted):
   domains, and queue/compile/latency/health counters (the two slices are
   computed alone — no full stats snapshot per poll);
 - ``POST /v1/forecast`` — body ``{"network": str, "model"?: str, "q_prime"?:
-  [[...]], "t0"?: int, "gauges"?: [int], "deadline_ms"?: num}``; answers
+  [[...]], "t0"?: int, "gauges"?: [int], "deadline_ms"?: num, "priority"?:
+  "interactive"|"batch"|"bulk"}``; answers
   ``{"runoff": [[...]], "version": int, "engine": str, "request_id": str,
-  "queue_s": num, "execute_s": num, ...}``. Request tracing: a caller-supplied
+  "queue_s": num, "execute_s": num, ...}``. With an ``"ensemble":
+  {"members": int, "percentiles"?: [num], "seed"?: int}`` object the request
+  becomes an E-member ensemble forecast (fleet tier,
+  :mod:`ddr_tpu.fleet.ensemble`): it runs synchronously on the connection
+  thread through ONE compiled E-member program and answers percentile
+  hydrographs (``runoff`` is ``(P, T, G)``, plus ``mean`` and ``worst``
+  gauge attribution) instead of a single trace. Request tracing: a caller-supplied
   ``X-DDR-Request-Id`` header is sanitized and adopted as the request's id
   (else one is minted at admission); EVERY forecast-path response — success,
   400/404 validation, 429 rejection, 503 shed — echoes it in the
@@ -216,6 +223,10 @@ class _Handler(BaseHTTPRequestHandler):
             send(400, {"error": 'body must be an object with "network"'})
             return
         deadline_ms = body.get("deadline_ms")
+        ensemble = body.get("ensemble")
+        if ensemble is not None:
+            self._post_ensemble(svc, body, ensemble, rid, tid, send)
+            return
         try:
             fut = svc.submit(
                 network=str(body["network"]),
@@ -226,6 +237,7 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_s=None if deadline_ms is None else float(deadline_ms) / 1e3,
                 request_id=rid,
                 trace_id=tid,
+                priority=body.get("priority"),
             )
         except QueueFullError as e:
             send(
@@ -263,6 +275,50 @@ class _Handler(BaseHTTPRequestHandler):
             return
         result = dict(result)
         result["runoff"] = np.asarray(result["runoff"]).tolist()
+        send(200, result)
+
+    @staticmethod
+    def _post_ensemble(
+        svc: ForecastService, body: dict, ensemble: Any, rid: str,
+        tid: str | None, send: Any,
+    ) -> None:
+        """The ``"ensemble"`` branch of POST /v1/forecast: synchronous on the
+        connection thread (an E-member request is a full batch of device work
+        — it does not ride the micro-batcher), same error mapping as the
+        scalar path."""
+        if not isinstance(ensemble, dict):
+            send(400, {"error": '"ensemble" must be an object'})
+            return
+        try:
+            result = svc.ensemble_forecast(
+                network=str(body["network"]),
+                model=str(body.get("model", "default")),
+                q_prime=body.get("q_prime"),
+                t0=body.get("t0"),
+                gauges=body.get("gauges"),
+                members=int(ensemble.get("members", 8)),
+                percentiles=ensemble.get("percentiles"),
+                seed=int(ensemble.get("seed", 0)),
+                request_id=rid,
+                trace_id=tid,
+            )
+        except KeyError as e:
+            send(404, {"error": f"unknown model {e}"})
+            return
+        except ValueError as e:
+            code = 404 if "unknown network" in str(e) else 400
+            send(code, {"error": str(e)})
+            return
+        except TypeError as e:
+            send(400, {"error": f"malformed request value: {e}"})
+            return
+        except Exception as e:
+            send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        result = dict(result)
+        result["runoff"] = np.asarray(result["runoff"]).tolist()  # (P, T, G)
+        result["mean"] = np.asarray(result["mean"]).tolist()
+        result.pop("member_runoff", None)
         send(200, result)
 
     def _post_profile(self) -> None:

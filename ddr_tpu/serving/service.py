@@ -74,7 +74,7 @@ from ddr_tpu.serving.batcher import (
     QueueFullError,
     RequestShedError,
 )
-from ddr_tpu.serving.config import ServeConfig
+from ddr_tpu.serving.config import DEFAULT_PRIORITY, ServeConfig, priority_rank
 from ddr_tpu.serving.registry import ModelRegistry
 
 log = logging.getLogger(__name__)
@@ -185,6 +185,10 @@ class ForecastService:
         # or shadow-eval loop that holds observations — serving itself has
         # none); when present its rollup rides /v1/stats as the "skill" slice.
         self._skill: Any = None
+        # Lazy per-service ensemble runner (fleet tier): built on the first
+        # ensemble request, holds ONE compiled E-member program per
+        # (network, model, E) — :mod:`ddr_tpu.fleet.ensemble`.
+        self._ensembles: Any = None
         self._warmup_error: str | None = None
         self._networks: dict[str, NetworkEntry] = {}
         # (network, model) -> AOT-compiled program (jitted.lower().compile())
@@ -435,6 +439,7 @@ class ForecastService:
         deadline_s: float | None = None,
         request_id: str | None = None,
         trace_id: str | None = None,
+        priority: str | None = None,
     ) -> Future:
         """Admit one forecast request; returns its Future.
 
@@ -448,6 +453,9 @@ class ForecastService:
         id (the HTTP front reads ``X-DDR-Trace-Id``); with tracing on
         (``DDR_TRACE``, default) the request becomes the root span of that
         trace and every one of its events carries ``trace_id``/``span_id``.
+        ``priority`` names the request's class (``interactive``/``batch``/
+        ``bulk``, default ``batch``): extraction is strict-priority and shed
+        victims are chosen lowest-class-first (docs/serving.md "Fleet tier").
         Invalid requests raise immediately — validation failures are the
         caller's bug, not load."""
         net = self._networks.get(network)
@@ -489,6 +497,8 @@ class ForecastService:
         deadline = time.monotonic() + (
             self.serve_cfg.deadline_s if deadline_s is None else float(deadline_s)
         )
+        prio = DEFAULT_PRIORITY if priority is None else str(priority)
+        priority_rank(prio)  # unknown class names are the caller's bug
         rid = make_request_id(request_id)
         meta = {"network": network, "model": model, "request_id": rid}
         if trace_enabled():
@@ -502,6 +512,7 @@ class ForecastService:
             payload={"q_prime": qp, "gauges": gauge_sel},
             deadline=deadline,
             meta=meta,
+            priority=prio,
         )
         try:
             self._batcher.submit(req)
@@ -514,6 +525,7 @@ class ForecastService:
                 network=network,
                 model=model,
                 request_id=rid,
+                priority=prio,
                 age_s=0.0,
                 **_trace_fields(req),
             )
@@ -523,6 +535,7 @@ class ForecastService:
                 network=network,
                 model=model,
                 request_id=rid,
+                priority=prio,
                 latency_s=0.0,
                 **_trace_fields(req),
                 # None, not 0.0: a rejected arrival never queued, and a flood
@@ -539,6 +552,22 @@ class ForecastService:
         """Blocking convenience wrapper over :meth:`submit` (the in-process
         client path)."""
         return self.submit(**kwargs).result(timeout=timeout)
+
+    def ensemble_forecast(self, **kwargs) -> dict:
+        """One E-member ensemble forecast (fleet tier,
+        :mod:`ddr_tpu.fleet.ensemble`): percentile hydrographs + worst-gauge
+        attribution from ONE compiled program per (network, model, E).
+        Accepts the :meth:`submit` request fields plus ``members``,
+        ``percentiles`` and ``seed``; runs synchronously on the caller's
+        thread (an ensemble request IS a full batch of work — it does not
+        ride the micro-batcher's slot)."""
+        from ddr_tpu.fleet.ensemble import EnsembleRunner
+
+        with self._lock:
+            if self._ensembles is None:
+                self._ensembles = EnsembleRunner(self)
+            runner = self._ensembles
+        return runner.forecast(**kwargs)
 
     # ---- execution (batcher worker thread) ----
 
@@ -885,6 +914,7 @@ class ForecastService:
             network=req.meta.get("network"),
             model=req.meta.get("model"),
             request_id=req.meta.get("request_id"),
+            priority=req.priority,
             age_s=round(req.age(), 6),
             **_trace_fields(req),
         )
@@ -894,6 +924,7 @@ class ForecastService:
             network=req.meta.get("network"),
             model=req.meta.get("model"),
             request_id=req.meta.get("request_id"),
+            priority=req.priority,
             latency_s=round(req.age(), 6),
             queue_s=self._queue_seconds(req),
             slo_ok=False,
@@ -1009,10 +1040,16 @@ class ForecastService:
         batching knobs consumers need to interpret the counters (``ddr
         loadtest`` derives batch occupancy from served/batches/max_batch)."""
         self._slo_sweep()  # idle replicas resolve stale alerts via polling
+        from ddr_tpu.fleet.config import fleet_identity
+
         hits, misses = self.tracker.counts()
         return {
             "ready": self._ready,
             "warmup_error": self._warmup_error,
+            # who this replica is in its group (None outside a fleet), so
+            # loadtest/chaos records and federated series are attributable
+            # without grepping ports
+            "fleet": fleet_identity(),
             "config": {
                 "max_batch": self.serve_cfg.max_batch,
                 "queue_cap": self.serve_cfg.queue_cap,

@@ -186,6 +186,34 @@ def latest_baseline(
     return cands[-1] if cands else None
 
 
+def latest_loadtest_baseline(
+    root: Path = REPO_ROOT,
+    exclude: Path | None = None,
+    fleet: bool | None = None,
+) -> Path | None:
+    """The newest LOADTEST_* record (by mtime) of the same fleet-ness: an
+    N-replica router record's throughput and occupancy are group aggregates,
+    so gating a single-service record against one (or vice versa) measures
+    the deployment shape, not the code. ``fleet=None`` degrades to plain
+    newest; unparseable candidates are skipped."""
+    cands = sorted(
+        root.glob("LOADTEST_*.json"),
+        key=lambda p: (p.stat().st_mtime, p.name), reverse=True,
+    )
+    resolved = exclude.resolve() if exclude is not None else None
+    for p in cands:
+        if resolved is not None and p.resolve() == resolved:
+            continue
+        if fleet is None:
+            return p
+        try:
+            if bool(load_record(p).get("fleet")) == fleet:
+                return p
+        except (ValueError, json.JSONDecodeError, OSError):
+            continue
+    return None
+
+
 def latest_bench_baseline(
     root: Path = REPO_ROOT, dtype: str = "fp32", exclude: Path | None = None
 ) -> Path | None:
@@ -212,6 +240,7 @@ def latest_chaos_baseline(
     exclude: Path | None = None,
     reshard: bool | None = None,
     nan_storm: bool | None = None,
+    fleet: bool | None = None,
 ) -> Path | None:
     """The newest CHAOS_* record of the SAME mode (train vs serve — their
     ``recovery_s`` measure different journeys, so cross-mode comparison is
@@ -221,8 +250,11 @@ def latest_chaos_baseline(
     flag the drill design, not the code. ``nan_storm`` pairs the same way: a
     self-healing drill measures recovery-ladder fidelity (fault/recovery
     counts, basin-rejoin delta), not kill/resume exactness, so the two
-    families never gate each other. Records that fail to parse are skipped;
-    ``mode=None`` degrades to plain newest-by-mtime."""
+    families never gate each other. ``fleet`` splits the serve family the
+    same way: a 2-replica router drill's recovery_s is re-admission latency
+    (the survivor keeps serving), not single-replica restart latency.
+    Records that fail to parse are skipped; ``mode=None`` degrades to plain
+    newest-by-mtime."""
     cands = sorted(
         root.glob("CHAOS_*.json"), key=lambda p: (p.stat().st_mtime, p.name),
         reverse=True,
@@ -242,6 +274,8 @@ def latest_chaos_baseline(
         if reshard is not None and bool(rec.get("reshard")) != reshard:
             continue
         if nan_storm is not None and bool(rec.get("nan_storm")) != nan_storm:
+            continue
+        if fleet is not None and bool(rec.get("fleet")) != fleet:
             continue
         return p
     return None
@@ -418,10 +452,13 @@ def main(argv: list[str] | None = None) -> int:
             mode=fresh.get("mode"), exclude=exclude,
             reshard=bool(fresh.get("reshard")),
             nan_storm=bool(fresh.get("nan_storm")),
+            fleet=bool(fresh.get("fleet")),
         )
     elif is_loadtest_record(fresh):
         pattern = "LOADTEST_*.json"
-        found = latest_baseline(pattern=pattern, exclude=exclude)
+        found = latest_loadtest_baseline(
+            exclude=exclude, fleet=bool(fresh.get("fleet"))
+        )
     else:
         # bench records pair by compute dtype: a bf16 round never gates
         # against an fp32 baseline (and vice versa)

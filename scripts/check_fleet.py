@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""CI guard: the fleet tier holds end-to-end on a 2-replica in-process group.
+
+The fleet tier (``docs/serving.md`` "Fleet tier") rests on a chain of small
+contracts: a :class:`~ddr_tpu.fleet.group.ReplicaGroup` boots N replicas
+behind the least-queue-depth router; ensemble forecasts are served from ONE
+compiled E-member program per (network, model, E) with deterministic
+per-request member perturbations and percentile bands that bracket the mean;
+killing a replica ejects it from rotation without an error storm and a
+revived replica is re-admitted by the prober; and the canary controller
+promotes a skill-par candidate through shadow -> canary -> promoted on
+per-arm skill evidence. This script drives that chain the way
+``check_trace.py`` drives the trace plane: a miniature 2-replica group over a
+synthetic basin on cpu, then structural assertions. Exit 0 when every
+contract holds, 1 otherwise. Run directly (CI) or via the test suite
+(tests/scripts/test_check_fleet.py):
+
+    python scripts/check_fleet.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_SEGMENTS = 24
+HORIZON = 8
+MEMBERS = 4
+
+
+def _wait_until(predicate, timeout_s: float = 10.0, poll_s: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+def _ensemble_misses(service) -> int:
+    """Total compile-tracker misses across this service's ensemble engines."""
+    return sum(
+        eng["misses"]
+        for label, eng in service.tracker.engines.items()
+        if ":ensemble" in label
+    )
+
+
+def _check(group, cfg) -> list[str]:
+    """Every fleet contract; returns the list of violations (empty = pass)."""
+    import numpy as np
+
+    problems: list[str] = []
+    svc0 = group.replicas[0].service
+
+    # ---- routed scalar traffic: both replicas serve through the front door
+    for i in range(6):
+        out = group.forecast(network="default", t0=i, request_id=f"cf-{i}")
+        if "runoff" not in out:
+            problems.append(f"routed forecast {i} returned no runoff")
+    status = group.router.status()
+    if sum(r["dispatched"] for r in status["replicas"]) < 6:
+        problems.append(f"router dispatched fewer requests than sent: {status}")
+
+    # ---- ensemble: bands bracket the mean, deterministic per request id,
+    # and E is ONE compiled program however many requests ride it
+    ens = svc0.ensemble_forecast(
+        network="default", members=MEMBERS, request_id="cf-ens-0"
+    )
+    runoff = np.asarray(ens["runoff"])  # (P, T, G) percentile hydrographs
+    if runoff.ndim != 3 or runoff.shape[0] != len(ens["percentiles"]):
+        problems.append(f"ensemble runoff shape {runoff.shape} != (P, T, G)")
+    if not np.all(np.diff(runoff, axis=0) >= -1e-6):
+        problems.append("percentile bands are not monotone across P")
+    if not np.all(np.isfinite(np.asarray(ens["mean"]))):
+        problems.append("ensemble mean is not finite")
+    again = svc0.ensemble_forecast(
+        network="default", members=MEMBERS, request_id="cf-ens-0"
+    )
+    if not np.array_equal(np.asarray(ens["runoff"]), np.asarray(again["runoff"])):
+        problems.append("same request id produced different ensemble members")
+    for i in range(3):  # fresh ids: perturbations differ, the PROGRAM must not
+        svc0.ensemble_forecast(
+            network="default", members=MEMBERS, request_id=f"cf-ens-{i + 1}"
+        )
+    misses = _ensemble_misses(svc0)
+    if misses != 1:
+        problems.append(
+            f"expected exactly 1 compiled {MEMBERS}-member program, "
+            f"tracker saw {misses} misses"
+        )
+
+    # ---- ejection: kill replica 1, router must eject and keep serving
+    group.kill_replica(1)
+    r1 = group.replicas[1].name
+    if not _wait_until(lambda: r1 not in group.router.healthy()):
+        problems.append(f"replica {r1} was never ejected after kill")
+    for i in range(4):  # traffic keeps flowing through the survivor
+        try:
+            group.forecast(network="default", t0=i, request_id=f"cf-post-{i}")
+        except Exception as e:  # noqa: BLE001 - any error here is the finding
+            problems.append(f"routed forecast failed with a dead replica: {e!r}")
+            break
+    group.restart_replica(1)
+    if not _wait_until(lambda: r1 in group.router.healthy()):
+        problems.append(f"replica {r1} was never re-admitted after revive")
+
+    # ---- canary: skill-par candidate promotes shadow -> canary -> promoted
+    from ddr_tpu.fleet.canary import CanaryController
+
+    controller = CanaryController(svc0, fleet_cfg=cfg)
+    obs = np.asarray(
+        svc0.forecast(network="default", t0=0, request_id="cf-ref")["runoff"]
+    )
+    for i in range(2 * cfg.canary_min_obs + 2):
+        controller.handle(
+            network="default", t0=0, request_id=f"cf-canary-{i}",
+            observations=obs,
+        )
+        if controller.state == "promoted":
+            break
+    if controller.state != "promoted":
+        problems.append(
+            f"canary never promoted a skill-par candidate: state "
+            f"{controller.state!r}, evidence {controller.status()!r}"
+        )
+    reasons = [t["reason"] for t in controller.status()["transitions"]]
+    if reasons != ["skill-parity", "skill-confirmed"]:
+        problems.append(f"unexpected canary transition reasons: {reasons}")
+    if sorted(group.router.healthy()) != sorted(r.name for r in group.replicas):
+        problems.append(
+            f"whole group should be back in rotation at the end, healthy = "
+            f"{group.router.healthy()}"
+        )
+    return problems
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from ddr_tpu.fleet.config import FleetConfig
+        from ddr_tpu.fleet.group import ReplicaGroup
+        from ddr_tpu.scripts.loadtest import build_synthetic_service
+    except Exception as e:
+        print(f"check_fleet: import failed: {e!r}", file=sys.stderr)
+        return 1
+
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            # canary_weight=1.0: in the canary state ALL traffic goes to the
+            # candidate, so the confirmation window fills deterministically
+            cfg = FleetConfig.from_env(
+                replicas=2, mode="inprocess", probe_s=0.05, eject_after=2,
+                canary_weight=1.0, canary_min_obs=2,
+            )
+            def builder(i: int):
+                service = build_synthetic_service(
+                    N_SEGMENTS, HORIZON, save_path=str(Path(tmp) / f"r{i}")
+                )[0]
+                # the canary candidate rides every replica, registered and
+                # warmed BEFORE the router probes readiness — registering a
+                # pair on a live replica drops it from rotation until warmup
+                entry = service.registry.get("default")
+                service.register_model(
+                    "candidate", entry.kan_model, entry.params, arch=entry.arch
+                )
+                service.warmup()
+                return service
+
+            group = ReplicaGroup(cfg, builder=builder)
+            group.boot()
+            try:
+                problems = _check(group, cfg)
+            finally:
+                group.close()
+    except Exception as e:
+        print(f"check_fleet: synthetic group run failed: {e!r}", file=sys.stderr)
+        return 1
+
+    if problems:
+        for p in problems:
+            print(f"check_fleet: {p}", file=sys.stderr)
+        return 1
+    print(
+        "check_fleet: 2-replica group holds (router dispatch + ejection + "
+        f"re-admission, one compiled {MEMBERS}-member ensemble program, "
+        "deterministic members, canary promoted shadow->canary->promoted)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

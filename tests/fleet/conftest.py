@@ -1,0 +1,60 @@
+"""Fleet-tier fixtures: synthetic-basin services (reusing the serving-layer
+helpers) plus an active telemetry recorder for canary-event read-back."""
+
+from __future__ import annotations
+
+import pytest
+
+from ddr_tpu.geodatazoo.synthetic import make_basin
+from ddr_tpu.observability import Recorder, activate, deactivate
+from ddr_tpu.serving import ForecastService, ServeConfig
+from tests.serving.conftest import events_of, make_cfg  # noqa: F401 (re-export)
+
+
+@pytest.fixture
+def service_factory(tmp_path):
+    """Build a warmed ForecastService over a fresh synthetic basin; closed at
+    teardown regardless of test outcome. ``candidate=True`` additionally
+    registers the default model under the name ``"candidate"`` (the canary
+    tests' second arm), warmed alongside the stable pair."""
+    created: list[ForecastService] = []
+
+    def make(
+        n_segments: int = 24,
+        horizon: int = 8,
+        n_days: int = 3,
+        warmup: bool = True,
+        candidate: bool = False,
+        **serve_kw,
+    ) -> ForecastService:
+        from ddr_tpu.scripts.common import build_kan, kan_arch
+
+        cfg = make_cfg(tmp_path)
+        basin = make_basin(n_segments=n_segments, n_gauges=4, n_days=n_days, seed=1)
+        kan_model, params = build_kan(cfg)
+        serve_kw.setdefault("max_batch", 4)
+        serve_kw.setdefault("batch_wait_s", 0.002)
+        svc = ForecastService(cfg, ServeConfig(horizon_hours=horizon, **serve_kw))
+        svc.register_network("default", basin.routing_data, forcing=basin.q_prime)
+        svc.register_model("default", kan_model, params, arch=kan_arch(cfg))
+        if candidate:
+            svc.register_model("candidate", kan_model, params, arch=kan_arch(cfg))
+        if warmup:
+            svc.warmup()
+        created.append(svc)
+        return svc
+
+    yield make
+    for svc in created:
+        svc.close(drain=False)
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """An ACTIVE Recorder; yields the log path for read-back via events_of."""
+    path = tmp_path / "run_log.fleet.jsonl"
+    rec = Recorder(path)
+    activate(rec)
+    yield path
+    deactivate(rec)
+    rec.close()

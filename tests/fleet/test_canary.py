@@ -1,0 +1,143 @@
+"""Canary state machine: deterministic arm routing, auto-promotion on skill
+parity, auto-rollback on skill regression and watchdog degradation, and the
+``canary`` event emitted on every transition."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.fleet.canary import STATES, CanaryController, _arm_fraction
+from ddr_tpu.fleet.config import FleetConfig
+from tests.fleet.conftest import events_of
+
+
+def _cfg(**kw) -> FleetConfig:
+    kw.setdefault("canary_min_obs", 2)
+    kw.setdefault("canary_weight", 1.0)
+    return FleetConfig.from_env(environ={}, **kw)
+
+
+def _controller(service_factory, **kw) -> CanaryController:
+    svc = service_factory(candidate=True)
+    return CanaryController(svc, fleet_cfg=_cfg(), **kw)
+
+
+def _obs_like(svc) -> np.ndarray:
+    return np.asarray(
+        svc.forecast(network="default", t0=0, request_id="canary-ref")["runoff"]
+    )
+
+
+class TestRouting:
+    def test_arm_fraction_is_stable(self):
+        assert _arm_fraction("req-1") == _arm_fraction("req-1")
+        assert 0.0 <= _arm_fraction("req-1") < 1.0
+
+    def test_states_vocabulary(self):
+        assert STATES == ("shadow", "canary", "promoted", "rolled-back")
+
+    def test_shadow_serves_stable(self, service_factory):
+        c = _controller(service_factory)
+        assert c.arm_for("any-id") == "stable"
+
+    def test_validation(self, service_factory):
+        svc = service_factory(candidate=True)
+        with pytest.raises(ValueError, match="different"):
+            CanaryController(svc, stable="default", candidate="default")
+        with pytest.raises(KeyError):
+            CanaryController(svc, candidate="missing")
+
+
+class TestPromotion:
+    def test_skill_par_candidate_promotes(self, service_factory, recorder):
+        """The happy path: shadow evidence at parity -> canary, confirmation
+        window under weighted traffic -> promoted; one canary event per edge."""
+        c = _controller(service_factory)
+        obs = _obs_like(c._svc)
+        states_seen = []
+        for i in range(8):
+            out = c.handle(
+                network="default", t0=0, request_id=f"p-{i}", observations=obs
+            )
+            states_seen.append(out["canary_state"])
+            if out["canary_state"] == "promoted":
+                break
+        assert c.state == "promoted"
+        assert c.arm_for("whatever") == "candidate"
+        reasons = [t["reason"] for t in c.status()["transitions"]]
+        assert reasons == ["skill-parity", "skill-confirmed"]
+        events = events_of(recorder, "canary")
+        assert [(e["state_from"], e["state_to"]) for e in events] == [
+            ("shadow", "canary"), ("canary", "promoted"),
+        ]
+        for e in events:  # every transition carries its per-arm evidence
+            assert e["stable_obs"] >= 2 and e["candidate_obs"] >= 2
+            assert e["candidate_nse"] is not None
+
+    def test_promotion_needs_fresh_canary_evidence(self, service_factory):
+        """Shadow evidence alone never promotes: entering canary snapshots the
+        candidate's count and demands min_obs MORE under weighted traffic."""
+        c = _controller(service_factory)
+        obs = _obs_like(c._svc)
+        for i in range(2):
+            c.handle(network="default", t0=0, request_id=f"f-{i}", observations=obs)
+        assert c.state == "canary"  # parity reached, not yet promoted
+        assert c.evaluate() == "canary"  # re-evaluating without traffic: no edge
+
+
+class TestRollback:
+    def test_skill_regression_rolls_back(self, service_factory, recorder):
+        """A candidate scoring far below stable on the same observations must
+        roll back from shadow — before ever taking user traffic."""
+        c = _controller(service_factory)
+        obs = _obs_like(c._svc)
+        good = obs
+        bad = obs + 10.0 * (1.0 + np.abs(obs))  # hopeless predictions
+        for _ in range(2):
+            c.observe("stable", good, obs)
+            c.observe("candidate", bad, obs)
+        assert c.evaluate() == "rolled-back"
+        assert c.arm_for("any") == "stable"
+        (event,) = events_of(recorder, "canary")
+        assert event["reason"] == "skill-regression"
+        assert event["candidate_nse"] < event["stable_nse"]
+
+    def test_watchdog_degradation_rolls_back(self, service_factory, monkeypatch):
+        c = _controller(service_factory)
+        monkeypatch.setattr(
+            type(c._svc.watchdog), "degraded", property(lambda self: True)
+        )
+        assert c.evaluate() == "rolled-back"
+        assert c.status()["transitions"][0]["reason"] == "watchdog-degraded"
+
+    def test_terminal_states_are_sticky(self, service_factory):
+        c = _controller(service_factory)
+        obs = _obs_like(c._svc)
+        bad = obs + 10.0 * (1.0 + np.abs(obs))
+        for _ in range(2):
+            c.observe("stable", obs, obs)
+            c.observe("candidate", bad, obs)
+        assert c.evaluate() == "rolled-back"
+        # more (now excellent) evidence cannot resurrect a rolled-back canary
+        for _ in range(4):
+            c.observe("candidate", obs, obs)
+        assert c.evaluate() == "rolled-back"
+        assert len(c.status()["transitions"]) == 1
+
+
+class TestWeightedSplit:
+    def test_canary_weight_splits_traffic_deterministically(self, service_factory):
+        svc = service_factory(candidate=True)
+        c = CanaryController(
+            svc, fleet_cfg=FleetConfig.from_env(
+                environ={}, canary_weight=0.5, canary_min_obs=2
+            )
+        )
+        obs = _obs_like(svc)
+        for i in range(2):  # parity -> canary
+            c.handle(network="default", t0=0, request_id=f"w-{i}", observations=obs)
+        assert c.state == "canary"
+        arms = {rid: c.arm_for(rid) for rid in (f"split-{i}" for i in range(64))}
+        assert set(arms.values()) == {"stable", "candidate"}  # both arms live
+        assert all(c.arm_for(rid) == arm for rid, arm in arms.items())  # sticky

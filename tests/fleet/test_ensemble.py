@@ -1,0 +1,136 @@
+"""Ensemble numerics: the fused E-member program must agree with an offline
+loop of single-member forecasts, members must be deterministic per request id,
+and E must stay ONE compiled program however many requests ride it."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ddr_tpu.fleet.ensemble import (
+    DEFAULT_PERCENTILES,
+    member_forcing,
+    perturbation_seed,
+)
+
+MEMBERS = 5
+RID = "ens-numerics-1"
+
+
+def _ensemble_misses(svc) -> dict[str, int]:
+    return {
+        label: eng["misses"]
+        for label, eng in svc.tracker.engines.items()
+        if ":ensemble" in label
+    }
+
+
+class TestPerturbations:
+    def test_seed_is_stable_and_31_bit(self):
+        a = perturbation_seed("req-1", 0)
+        assert a == perturbation_seed("req-1", 0)
+        assert 0 <= a < 2**31
+        assert a != perturbation_seed("req-2", 0)
+        assert a != perturbation_seed("req-1", 1)
+
+    def test_member_forcing_deterministic_and_distinct(self):
+        qp = np.ones((6, 4), np.float32)
+        m0 = member_forcing(qp, "req-1", 0, member=0, sigma=0.1)
+        assert np.array_equal(m0, member_forcing(qp, "req-1", 0, 0, 0.1))
+        m1 = member_forcing(qp, "req-1", 0, member=1, sigma=0.1)
+        assert not np.array_equal(m0, m1)
+
+    def test_sigma_zero_is_identity(self):
+        qp = np.random.default_rng(0).random((6, 4)).astype(np.float32)
+        assert np.array_equal(member_forcing(qp, "r", 0, 3, 0.0), qp)
+
+
+class TestEnsembleNumerics:
+    def test_percentiles_match_offline_member_loop(self, service_factory):
+        """The fused program's bands == np.percentile over members routed one
+        at a time through the PLAIN serve path with member_forcing windows."""
+        svc = service_factory()
+        out = svc.ensemble_forecast(
+            network="default", t0=0, members=MEMBERS, request_id=RID,
+            return_members=True,
+        )
+        sigma = svc._ensembles.fleet_cfg.ensemble_sigma
+        net = svc.networks()["default"]
+        window = np.asarray(net.forcing[: net.horizon])
+        offline = np.stack([
+            svc.forecast(
+                network="default",
+                q_prime=member_forcing(window, RID, 0, m, sigma),
+                request_id=f"{RID}-offline-{m}",
+            )["runoff"]
+            for m in range(MEMBERS)
+        ])  # (E, T, G)
+        assert np.max(np.abs(np.asarray(out["member_runoff"]) - offline)) < 1e-6
+        bands = np.percentile(offline, out["percentiles"], axis=0)
+        assert np.max(np.abs(np.asarray(out["runoff"]) - bands)) < 1e-6
+        assert np.max(np.abs(np.asarray(out["mean"]) - offline.mean(axis=0))) < 1e-6
+
+    def test_same_request_id_reproduces_members(self, service_factory):
+        svc = service_factory()
+        a = svc.ensemble_forecast(
+            network="default", t0=0, members=3, request_id="rep",
+            return_members=True,
+        )
+        b = svc.ensemble_forecast(
+            network="default", t0=0, members=3, request_id="rep",
+            return_members=True,
+        )
+        assert np.array_equal(a["member_runoff"], b["member_runoff"])
+        c = svc.ensemble_forecast(
+            network="default", t0=0, members=3, request_id="other",
+            return_members=True,
+        )
+        assert not np.array_equal(a["member_runoff"], c["member_runoff"])
+
+    def test_result_surface(self, service_factory):
+        svc = service_factory()
+        out = svc.ensemble_forecast(network="default", t0=0, members=3)
+        assert out["percentiles"] == list(DEFAULT_PERCENTILES)
+        runoff = np.asarray(out["runoff"])
+        assert runoff.shape[0] == len(DEFAULT_PERCENTILES)
+        assert np.all(np.diff(runoff, axis=0) >= -1e-6)  # bands are ordered
+        assert out["engine"].endswith(":ensemble3")
+        assert len(out["worst"]["gauges"]) == len(out["worst"]["scores"])
+        assert "member_runoff" not in out  # only on return_members=True
+
+
+class TestCompilePin:
+    def test_one_program_per_network_model_E(self, service_factory):
+        """The e2e pin: N requests at one E = exactly one compile; a second E
+        adds exactly one more; reuse counts hits."""
+        svc = service_factory()
+        for i in range(3):
+            svc.ensemble_forecast(
+                network="default", t0=0, members=4, request_id=f"pin-{i}"
+            )
+        misses = _ensemble_misses(svc)
+        assert sum(misses.values()) == 1, misses
+        svc.ensemble_forecast(network="default", t0=0, members=8, request_id="pin-8")
+        misses = _ensemble_misses(svc)
+        assert sum(misses.values()) == 2, misses
+        pair4 = "default/default:ensemble4"
+        assert svc.tracker.engines[pair4]["hits"] >= 2
+
+    def test_members_cap_enforced(self, service_factory, monkeypatch):
+        monkeypatch.delenv("DDR_FLEET_ENSEMBLE_MAX_MEMBERS", raising=False)
+        svc = service_factory()
+        with pytest.raises(ValueError, match="members"):
+            svc.ensemble_forecast(network="default", t0=0, members=65)
+        with pytest.raises(ValueError, match="members"):
+            svc.ensemble_forecast(network="default", t0=0, members=0)
+
+    def test_validation_mirrors_submit(self, service_factory):
+        svc = service_factory()
+        with pytest.raises(ValueError, match="unknown network"):
+            svc.ensemble_forecast(network="nope", t0=0, members=2)
+        with pytest.raises(KeyError):
+            svc.ensemble_forecast(network="default", model="nope", t0=0, members=2)
+        with pytest.raises(ValueError, match="percentiles"):
+            svc.ensemble_forecast(
+                network="default", t0=0, members=2, percentiles=[150.0]
+            )

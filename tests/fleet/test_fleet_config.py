@@ -1,0 +1,76 @@
+"""FleetConfig env parsing/validation and the fleet_identity contract."""
+
+from __future__ import annotations
+
+import pytest
+
+from ddr_tpu.fleet.config import FLEET_MODES, FleetConfig, fleet_identity
+
+
+class TestFromEnv:
+    def test_defaults(self):
+        cfg = FleetConfig.from_env(environ={})
+        assert cfg.replicas == 2
+        assert cfg.mode == "inprocess"
+        assert cfg.group == "fleet"
+        assert cfg.probe_s == 1.0
+        assert cfg.eject_after == 2
+
+    def test_env_overrides_defaults(self):
+        cfg = FleetConfig.from_env(environ={
+            "DDR_FLEET_REPLICAS": "4",
+            "DDR_FLEET_MODE": "subprocess",
+            "DDR_FLEET_PROBE_MS": "250",
+            "DDR_FLEET_ENSEMBLE_SIGMA": "0.3",
+        })
+        assert cfg.replicas == 4
+        assert cfg.mode == "subprocess"
+        assert cfg.probe_s == pytest.approx(0.25)  # PROBE_MS is milliseconds
+        assert cfg.ensemble_sigma == pytest.approx(0.3)
+
+    def test_explicit_overrides_beat_env(self):
+        cfg = FleetConfig.from_env(
+            environ={"DDR_FLEET_REPLICAS": "4"}, replicas=3
+        )
+        assert cfg.replicas == 3
+
+    def test_bad_env_value_names_the_variable(self):
+        with pytest.raises(ValueError, match="DDR_FLEET_REPLICAS"):
+            FleetConfig.from_env(environ={"DDR_FLEET_REPLICAS": "many"})
+
+    @pytest.mark.parametrize("kw", [
+        {"mode": "threads"},
+        {"replicas": 0},
+        {"eject_after": 0},
+        {"probe_s": 0.0},
+        {"ensemble_max_members": 0},
+        {"ensemble_sigma": -0.1},
+        {"canary_weight": 0.0},
+        {"canary_weight": 1.5},
+        {"canary_min_obs": 0},
+    ])
+    def test_validation_rejects(self, kw):
+        with pytest.raises(ValueError):
+            FleetConfig(**kw)
+
+    def test_modes_vocabulary(self):
+        assert FLEET_MODES == ("inprocess", "subprocess")
+
+
+class TestFleetIdentity:
+    def test_absent_outside_a_fleet(self):
+        assert fleet_identity(environ={}) is None
+
+    def test_full_identity(self):
+        ident = fleet_identity(environ={
+            "DDR_FLEET_GROUP": "prod",
+            "DDR_FLEET_REPLICA": "3",
+            "DDR_FLEET_ROUTER": "local:123",
+        })
+        assert ident == {"group": "prod", "replica": 3, "router": "local:123"}
+
+    def test_non_integer_replica_kept_verbatim(self):
+        ident = fleet_identity(environ={
+            "DDR_FLEET_GROUP": "g", "DDR_FLEET_REPLICA": "blue",
+        })
+        assert ident["replica"] == "blue"

@@ -1,0 +1,106 @@
+"""ReplicaGroup lifecycle: in-process boot behind the router, federation
+publish/restore, the kill/restart chaos surface, and construction guards."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from ddr_tpu.fleet.config import FleetConfig
+from ddr_tpu.fleet.group import ReplicaGroup
+
+
+def _cfg(**kw) -> FleetConfig:
+    kw.setdefault("replicas", 2)
+    kw.setdefault("mode", "inprocess")
+    kw.setdefault("probe_s", 0.05)
+    return FleetConfig.from_env(environ={}, **kw)
+
+
+def _wait(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestConstruction:
+    def test_inprocess_requires_builder(self):
+        with pytest.raises(ValueError, match="builder"):
+            ReplicaGroup(_cfg())
+
+    def test_subprocess_requires_serve_args(self):
+        with pytest.raises(ValueError, match="serve_args"):
+            ReplicaGroup(_cfg(mode="subprocess"))
+
+    def test_dispatch_before_boot_raises(self, service_factory):
+        group = ReplicaGroup(_cfg(replicas=1), builder=lambda i: service_factory())
+        with pytest.raises(RuntimeError, match="boot"):
+            group.forecast(network="default", t0=0)
+
+
+class TestInProcessGroup:
+    def test_boot_dispatch_kill_restart(self, service_factory, tmp_path):
+        group = ReplicaGroup(
+            _cfg(), builder=lambda i: service_factory(), workdir=tmp_path
+        )
+        group.boot()
+        try:
+            for i in range(4):
+                out = group.forecast(network="default", t0=i, request_id=f"g-{i}")
+                assert "runoff" in out
+            ens = group.ensemble(network="default", t0=0, members=3)
+            assert len(ens["percentiles"]) == 3
+
+            group.kill_replica(1)
+            assert _wait(lambda: group.router.healthy() == ["fleet-r0"])
+            # traffic keeps flowing through the survivor
+            group.forecast(network="default", t0=0, request_id="g-post")
+            group.restart_replica(1)
+            assert _wait(
+                lambda: group.router.healthy() == ["fleet-r0", "fleet-r1"]
+            )
+            desc = group.describe()
+            assert desc["mode"] == "inprocess"
+            assert desc["replicas"] == 2
+        finally:
+            group.close()
+
+    def test_no_federation_without_http_fronts(self, service_factory, tmp_path,
+                                               monkeypatch):
+        monkeypatch.delenv("DDR_FEDERATE_REPLICAS", raising=False)
+        group = ReplicaGroup(
+            _cfg(replicas=1), builder=lambda i: service_factory(),
+            workdir=tmp_path,
+        )
+        group.boot()
+        try:
+            # in-process replicas with no HTTP front have no scrape URL:
+            # nothing to federate, env stays untouched
+            assert "DDR_FEDERATE_REPLICAS" not in os.environ
+        finally:
+            group.close()
+
+    def test_http_fronts_publish_and_restore_federation(
+        self, service_factory, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("DDR_FEDERATE_REPLICAS", "prior=http://x/metrics")
+        group = ReplicaGroup(
+            _cfg(replicas=1), builder=lambda i: service_factory(),
+            workdir=tmp_path, http=True,
+        )
+        group.boot()
+        try:
+            published = os.environ["DDR_FEDERATE_REPLICAS"]
+            assert published != "prior=http://x/metrics"
+            assert published.startswith("fleet-r0=http://")
+            assert published.endswith("/metrics")
+            assert group.replicas[0].url is not None
+        finally:
+            group.close()
+        # the pre-boot federation view is restored on close
+        assert os.environ["DDR_FEDERATE_REPLICAS"] == "prior=http://x/metrics"

@@ -1,0 +1,181 @@
+"""Router dispatch/ejection semantics on fake replicas — no jax, no service:
+the router is duck-typed, so these pin the health state machine in isolation."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ddr_tpu.fleet.router import NoHealthyReplicaError, Router
+
+
+class FakeReplica:
+    """Scriptable stand-in: set ``up=False`` for transport death, ``depth``
+    for queue pressure, ``app_error`` to raise an application error."""
+
+    def __init__(self, index: int, depth: int = 0):
+        self.index = index
+        self.name = f"r{index}"
+        self.url = None
+        self.up = True
+        self.queue_depth = depth
+        self.app_error: Exception | None = None
+        self.calls = 0
+
+    def ready(self) -> bool:
+        return self.up
+
+    def depth(self) -> int:
+        if not self.up:
+            raise ConnectionError(f"{self.name} is down")
+        return self.queue_depth
+
+    def forecast(self, **kw) -> dict:
+        self.calls += 1
+        if not self.up:
+            raise ConnectionError(f"{self.name} is down")
+        if self.app_error is not None:
+            raise self.app_error
+        return {"replica": self.name, **kw}
+
+    def ensemble(self, **kw) -> dict:
+        return self.forecast(**kw)
+
+
+def make_router(*replicas, probe_s: float = 30.0, eject_after: int = 2):
+    """probe_s defaults long so dispatch-path behavior is tested without the
+    prober racing the assertions."""
+    return Router(list(replicas), probe_s=probe_s, eject_after=eject_after)
+
+
+class TestDispatch:
+    def test_picks_least_loaded(self):
+        a, b = FakeReplica(0, depth=5), FakeReplica(1, depth=0)
+        r = make_router(a, b)
+        try:
+            # the prober has not run: seed probed depth by hand
+            r._probed_depth["r0"], r._probed_depth["r1"] = 5, 0
+            out = r.forecast(x=1)
+            assert out["replica"] == "r1"
+            assert b.calls == 1 and a.calls == 0
+        finally:
+            r.close()
+
+    def test_ties_break_by_index(self):
+        a, b = FakeReplica(0), FakeReplica(1)
+        r = make_router(a, b)
+        try:
+            assert r.forecast()["replica"] == "r0"
+        finally:
+            r.close()
+
+    def test_transport_failure_reroutes_and_ejects(self):
+        a, b = FakeReplica(0), FakeReplica(1)
+        a.up = False
+        r = make_router(a, b, eject_after=1)
+        try:
+            out = r.forecast()
+            assert out["replica"] == "r1"  # caller never saw the death
+            assert r.healthy() == ["r1"]
+        finally:
+            r.close()
+
+    def test_application_errors_propagate(self):
+        a, b = FakeReplica(0), FakeReplica(1)
+        a.app_error = ValueError("unknown network 'x'")
+        r = make_router(a, b)
+        try:
+            with pytest.raises(ValueError, match="unknown network"):
+                r.forecast()
+            # an application error is the caller's answer, not health signal
+            assert r.healthy() == ["r0", "r1"]
+        finally:
+            r.close()
+
+    def test_all_dead_raises_unroutable(self):
+        a, b = FakeReplica(0), FakeReplica(1)
+        a.up = b.up = False
+        r = make_router(a, b, eject_after=1)
+        try:
+            with pytest.raises(NoHealthyReplicaError):
+                r.forecast()
+            assert r.status()["unroutable_errors"] == 1
+        finally:
+            r.close()
+
+    def test_ejection_needs_consecutive_failures(self):
+        a, b = FakeReplica(0), FakeReplica(1)
+        r = make_router(a, b, eject_after=2)
+        try:
+            a.up = False
+            r.forecast()  # failure 1 -> rerouted, not ejected yet
+            assert r.healthy() == ["r0", "r1"]
+            a.up = True
+            r._probed_depth["r0"] = 0  # make r0 preferred again
+            r.forecast()  # success resets the streak
+            a.up = False
+            r.forecast()
+            assert r.healthy() == ["r0", "r1"]  # streak is 1 again, not 2
+        finally:
+            r.close()
+
+
+class TestProber:
+    def test_probe_ejects_and_readmits(self):
+        a, b = FakeReplica(0), FakeReplica(1)
+        r = make_router(a, b, probe_s=0.02, eject_after=2)
+        try:
+            a.up = False
+            deadline = time.monotonic() + 5.0
+            while "r0" in r.healthy() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert r.healthy() == ["r1"]
+            a.up = True
+            deadline = time.monotonic() + 5.0
+            while "r0" not in r.healthy() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert r.healthy() == ["r0", "r1"]
+            row = r.status()["replicas"][0]
+            assert row["consecutive_failures"] == 0
+        finally:
+            r.close()
+
+    def test_probe_updates_depth(self):
+        a = FakeReplica(0, depth=7)
+        r = make_router(a, probe_s=0.02)
+        try:
+            deadline = time.monotonic() + 5.0
+            while (
+                r.status()["replicas"][0]["last_probed_depth"] != 7
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert r.status()["replicas"][0]["last_probed_depth"] == 7
+        finally:
+            r.close()
+
+
+class TestLifecycle:
+    def test_needs_at_least_one_replica(self):
+        with pytest.raises(ValueError):
+            Router([])
+
+    def test_status_shape_and_dispatch_counts(self):
+        a = FakeReplica(0)
+        r = make_router(a)
+        try:
+            r.forecast()
+            r.ensemble()
+            row = r.status()["replicas"][0]
+            assert row["name"] == "r0"
+            assert row["dispatched"] == 2
+            assert row["inflight"] == 0
+            assert row["ejected"] is False
+        finally:
+            r.close()
+
+    def test_close_stops_prober(self):
+        r = make_router(FakeReplica(0), probe_s=0.02)
+        r.close()
+        assert not r._prober.is_alive()

@@ -87,6 +87,22 @@ class TestUnits:
         out = chaos.render_summary(serve_rep)
         assert "FAILED" in out and "recovery 9.9s" in out
 
+    def test_render_summary_fleet_branch(self):
+        fleet_rep = {
+            "mode": "serve", "fleet": True, "label": "z", "passed": True,
+            "killed_replica": 1, "replicas": 2, "eject_s": 0.4,
+            "recovery_s": 6.2, "federation_saw_dead": True,
+            "federate_up": {"chaos-r0": "1", "chaos-r1": "0"},
+            "requests": 85, "ok": 85, "errors": 0, "error_rate": 0.0,
+            "post_restart_attainment": 1.0, "post_restart_requests": 12,
+        }
+        out = chaos.render_summary(fleet_rep)
+        assert "PASSED" in out
+        assert "killed replica 1 of 2" in out
+        assert "re-admitted in 6.2s" in out
+        assert "survivor scrape saw the dead member: True" in out
+        assert "post-restart attainment 100.00%" in out
+
     def test_cli_requires_mode_and_serve_requires_synthetic(self, capsys, tmp_path):
         assert chaos.main([]) == 2
         with pytest.raises(SystemExit):

@@ -632,3 +632,54 @@ class TestChaosRecords:
         ) == resh
         # unspecified reshard keeps the old behavior (plain newest of mode)
         assert mod.latest_chaos_baseline(tmp_path, mode="train") == resh
+
+
+class TestFleetChaosPairing:
+    """A 2-replica router drill's recovery_s is re-admission latency while the
+    survivor keeps serving — a different quantity from single-replica restart
+    latency, so fleet records must only gate against fleet records."""
+
+    def test_chaos_baseline_pairs_by_fleet(self, tmp_path):
+        mod = _load()
+        plain = tmp_path / "CHAOS_plain_serve.json"
+        fleet = tmp_path / "CHAOS_fleet_serve.json"
+        plain.write_text(json.dumps({"kind": "chaos", "mode": "serve"}))
+        fleet.write_text(json.dumps(
+            {"kind": "chaos", "mode": "serve", "fleet": True}
+        ))
+        os.utime(plain, (1_000_000, 1_000_000))
+        os.utime(fleet, (2_000_000, 2_000_000))  # newest overall
+        assert mod.latest_chaos_baseline(
+            tmp_path, mode="serve", fleet=False
+        ) == plain
+        assert mod.latest_chaos_baseline(
+            tmp_path, mode="serve", fleet=True
+        ) == fleet
+        # unspecified keeps the old behavior: plain newest of the mode
+        assert mod.latest_chaos_baseline(tmp_path, mode="serve") == fleet
+
+    def test_repo_fleet_record_is_loadable(self):
+        rec = json.loads((REPO / "CHAOS_r04_serve_fleet.json").read_text())
+        assert rec["kind"] == "chaos" and rec["fleet"] is True
+        assert rec["passed"] is True
+        assert rec["federation_saw_dead"] is True
+
+    def test_loadtest_baseline_pairs_by_fleet(self, tmp_path):
+        """--fleet N records' throughput is a group aggregate: single-service
+        records never gate against them (and vice versa)."""
+        mod = _load()
+        single = tmp_path / "LOADTEST_single.json"
+        fleet = tmp_path / "LOADTEST_fleet2.json"
+        single.write_text(json.dumps({"kind": "loadtest", "p50_ms": 10.0}))
+        fleet.write_text(json.dumps(
+            {"kind": "loadtest", "p50_ms": 10.0, "fleet": 2}
+        ))
+        os.utime(single, (1_000_000, 1_000_000))
+        os.utime(fleet, (2_000_000, 2_000_000))  # newest overall
+        assert mod.latest_loadtest_baseline(tmp_path, fleet=False) == single
+        assert mod.latest_loadtest_baseline(tmp_path, fleet=True) == fleet
+        assert mod.latest_loadtest_baseline(tmp_path) == fleet  # plain newest
+        # the fresh record never self-selects
+        assert mod.latest_loadtest_baseline(
+            tmp_path, exclude=fleet, fleet=True
+        ) is None

@@ -1,0 +1,46 @@
+"""scripts/check_fleet.py: the fleet-tier smoke gate must pass on a clean
+tree (so router/ensemble/canary bit-rot fails tier-1 fast) and actually
+catch breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_fleet.py"
+
+
+def test_repo_fleet_gate_clean():
+    """THE CI gate: a 2-replica in-process group serves routed + ensemble
+    traffic, survives a replica kill, and promotes a skill-par canary."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ejection + re-admission" in proc.stdout
+    assert "one compiled 4-member ensemble program" in proc.stdout
+    assert "canary promoted shadow->canary->promoted" in proc.stdout
+
+
+def test_gate_fails_on_broken_fleet_module(tmp_path):
+    """A tree whose fleet package cannot import must fail the gate — copy the
+    script next to a stub package with a broken __init__."""
+    pkg = tmp_path / "ddr_tpu" / "fleet"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_fleet.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_fleet.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr

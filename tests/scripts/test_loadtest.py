@@ -265,3 +265,139 @@ def test_synthetic_loadtest_end_to_end(tmp_path, monkeypatch):
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "throughput_rps" in proc.stdout
+
+
+class TestPriorityMix:
+    def test_parse_normalizes_weights(self):
+        from ddr_tpu.scripts.loadtest import parse_priority_mix
+
+        mix = parse_priority_mix("interactive=3,bulk=1")
+        assert mix == [("interactive", 0.75), ("bulk", 0.25)]
+        # bare class names weigh 1.0 each
+        assert parse_priority_mix("batch,bulk") == [("batch", 0.5), ("bulk", 0.5)]
+        assert parse_priority_mix(None) is None
+        assert parse_priority_mix("") is None
+
+    def test_parse_rejects_bad_specs(self):
+        from ddr_tpu.scripts.loadtest import parse_priority_mix
+
+        with pytest.raises(ValueError, match="unknown priority"):
+            parse_priority_mix("vip=1")
+        with pytest.raises(ValueError, match="weight"):
+            parse_priority_mix("batch=heavy")
+        with pytest.raises(ValueError, match=">= 0"):
+            parse_priority_mix("batch=-1")
+        with pytest.raises(ValueError, match="zero"):
+            parse_priority_mix("batch=0,bulk=0")
+
+    def test_priority_for_is_deterministic_and_covers_mix(self):
+        from ddr_tpu.scripts.loadtest import parse_priority_mix, priority_for
+
+        mix = parse_priority_mix("interactive=0.5,bulk=0.5")
+        picks = [priority_for(i, mix, seed=7) for i in range(64)]
+        assert picks == [priority_for(i, mix, seed=7) for i in range(64)]
+        assert set(picks) == {"interactive", "bulk"}  # both classes fired
+        assert priority_for(0, None) is None
+
+    def test_report_gains_by_priority_slice(self):
+        outcomes = [
+            Outcome("ok", 0.010, priority="interactive"),
+            Outcome("ok", 0.020, priority="interactive"),
+            Outcome("ok", 0.050, priority="bulk"),
+            Outcome("shed:queue-full", 0.001, priority="bulk"),
+            Outcome("rejected", 0.001, priority="bulk"),
+        ]
+        rep = build_report(outcomes, wall_s=1.0, offered=5)
+        by = rep["by_priority"]
+        assert by["interactive"]["requests"] == 2
+        assert by["interactive"]["dropped"] == 0
+        assert by["bulk"] == {
+            "requests": 3, "ok": 1, "dropped": 2,
+            "p50_ms": pytest.approx(50.0), "p95_ms": pytest.approx(50.0),
+            "p99_ms": pytest.approx(50.0),
+        }
+        # sheds concentrate in the lowest class — visible in the summary
+        assert "class    bulk: 3 requests" in render_summary(rep)
+        # classless runs keep the old report shape
+        assert "by_priority" not in build_report(
+            [_ok()], wall_s=1.0, offered=1
+        )
+
+
+class TestFleetDriver:
+    """--fleet plumbing against a fake group — the real 2-replica path runs
+    in tests/fleet/; here we pin the Outcome mapping and the stats rollup."""
+
+    class _FakeNet:
+        forcing = None
+        horizon = 8
+
+    class _FakeSvc:
+        class serve_cfg:
+            deadline_s = 30.0
+
+        def networks(self):
+            return {"default": TestFleetDriver._FakeNet()}
+
+    class _FakeReplica:
+        def __init__(self, queue):
+            self.service = TestFleetDriver._FakeSvc()
+            self._queue = queue
+
+        def stats(self):
+            return {"queue": self._queue, "config": {"max_batch": 4}}
+
+    class _FakeGroup:
+        def __init__(self):
+            self.replicas = [
+                TestFleetDriver._FakeReplica({"served": 10, "batches": 5}),
+                TestFleetDriver._FakeReplica({"served": 6, "batches": 2}),
+            ]
+            self.calls = []
+            self.raise_unroutable = False
+
+        def forecast(self, **kw):
+            from ddr_tpu.fleet.router import NoHealthyReplicaError
+
+            if self.raise_unroutable:
+                raise NoHealthyReplicaError("all dead")
+            self.calls.append(kw)
+            return {"queue_s": 0.001, "execute_s": 0.004}
+
+        def ensemble(self, **kw):
+            self.calls.append(kw)
+            return {}
+
+    def _driver(self, group, **kw):
+        from ddr_tpu.scripts.loadtest import FleetDriver
+
+        return FleetDriver(group, **kw)
+
+    def test_ok_outcome_and_request_shape(self):
+        group = self._FakeGroup()
+        out = self._driver(group).fire(3)
+        assert out.status == "ok"
+        assert out.queue_s == 0.001 and out.execute_s == 0.004
+        assert group.calls[0]["request_id"] == "lt-3"
+        assert group.calls[0]["network"] == "default"
+
+    def test_unroutable_group_is_an_error_datapoint(self):
+        group = self._FakeGroup()
+        group.raise_unroutable = True
+        assert self._driver(group).fire(0).status == "error:unroutable"
+
+    def test_ensemble_requests_ride_the_group(self):
+        group = self._FakeGroup()
+        assert self._driver(group, ensemble=4).fire(0).status == "ok"
+        assert group.calls[0]["members"] == 4
+
+    def test_stats_sum_queues_across_replicas(self):
+        stats = self._driver(self._FakeGroup()).stats()
+        assert stats["queue"] == {"served": 16, "batches": 7}
+        assert stats["config"]["max_batch"] == 4
+        assert stats["replicas"] == 2
+
+    def test_fleet_record_carries_fleet_meta(self):
+        rep = build_report([_ok()], wall_s=1.0, offered=1, fleet=2,
+                           target="fleet:2")
+        assert rep["fleet"] == 2 and rep["target"] == "fleet:2"

@@ -382,3 +382,109 @@ class TestServeConfig:
             ServeConfig.from_env(environ={"DDR_SERVE_MAX_BATCH": "many"})
         with pytest.raises(ValueError, match="max_batch"):
             ServeConfig(max_batch=0)
+
+
+def _preq(payload, priority, key="net", deadline_s: float | None = 30.0):
+    r = _req(key=key, payload=payload, deadline_s=deadline_s)
+    r.priority = priority
+    return r
+
+
+class TestPriorityClasses:
+    """Strict-priority scheduling: interactive boards before bulk, the shed
+    victim under shed-by-deadline is the LOWEST class queued, and every shed
+    is accounted per (reason, priority)."""
+
+    def test_unknown_priority_rejected_at_submit(self):
+        b = MicroBatcher(_RecordingExecutor(), max_batch=1)
+        try:
+            with pytest.raises(ValueError, match="unknown priority"):
+                b.submit(_preq("x", "vip"))
+        finally:
+            b.close()
+
+    def test_extraction_boards_highest_class_first(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        # max_batch=1 makes extraction order directly observable: one batch
+        # per request, in the exact order the scheduler chose them
+        b = MicroBatcher(ex, max_batch=1, batch_wait_s=0.0)
+        try:
+            blocker = b.submit(_preq("blocker", "batch"))
+            t0 = time.monotonic()
+            while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.002)
+            # queue order: bulk, bulk, interactive — the interactive arrival
+            # must board the next batch ahead of both earlier bulk requests
+            order = []
+            for payload, cls in (
+                ("bk0", "bulk"), ("bk1", "bulk"), ("it", "interactive")
+            ):
+                r = b.submit(_preq(payload, cls))
+                r.future.add_done_callback(lambda f: order.append(f.result()))
+            ex.gate.set()
+            b.close(drain=True)
+            assert order == ["it", "bk0", "bk1"]  # FIFO within a class
+        finally:
+            b.close()
+
+    def test_shed_by_deadline_victims_lowest_class_first(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(
+            ex, max_batch=1, queue_cap=2, batch_wait_s=0.0,
+            backpressure="shed-by-deadline",
+        )
+        try:
+            r_exec = b.submit(_preq("executing", "batch"))
+            t0 = time.monotonic()
+            while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.002)
+            # interactive has the EARLIEST deadline, but class outranks
+            # deadline: the bulk request pays first
+            it = b.submit(_preq("it", "interactive", deadline_s=1.0))
+            bk = b.submit(_preq("bk", "bulk", deadline_s=60.0))
+            b.submit(_preq("newest", "batch", deadline_s=30.0))
+            with pytest.raises(RequestShedError) as ei:
+                bk.future.result(timeout=5)
+            assert ei.value.reason == "queue-full"
+            ex.gate.set()
+            assert r_exec.future.result(timeout=5) == "executing"
+            assert it.future.result(timeout=5) == "it"
+        finally:
+            b.close()
+
+    def test_bulk_arrival_into_higher_class_queue_is_rejected(self):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(
+            ex, max_batch=1, queue_cap=1, batch_wait_s=0.0,
+            backpressure="shed-by-deadline",
+        )
+        try:
+            b.submit(_preq("executing", "batch"))
+            t0 = time.monotonic()
+            while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.002)
+            queued = b.submit(_preq("q", "interactive"))
+            # the arriving bulk request is itself the preferred victim: 429
+            # at the edge, never admit-then-shed
+            with pytest.raises(QueueFullError, match="lowest class"):
+                b.submit(_preq("doomed", "bulk"))
+            assert b.stats()["rejected"] == 1 and b.stats()["shed"] == 0
+            ex.gate.set()
+            assert queued.future.result(timeout=5) == "q"
+        finally:
+            b.close()
+
+    def test_shed_by_class_accounting(self):
+        b = MicroBatcher(_RecordingExecutor(), max_batch=1)
+        b.close()  # no worker races: account sheds directly
+        b._fail_shed(_preq("a", "bulk"), "queue-full")
+        b._fail_shed(_preq("b", "bulk"), "queue-full")
+        b._fail_shed(_preq("c", "interactive"), "deadline")
+        stats = b.stats()
+        assert stats["shed"] == 3
+        assert stats["shed_by_class"] == {
+            "deadline/interactive": 1, "queue-full/bulk": 2,
+        }
