@@ -93,6 +93,13 @@ class CanaryController:
         self._state = "shadow"
         self._canary_entry_obs = 0  # candidate obs count when canary started
         self._transitions: list[dict] = []
+        self._shadow_failures = 0
+        self._shadow_fail_counter = service.metrics.counter(
+            "ddr_canary_shadow_failures_total",
+            "Shadow-arm forecasts dropped because the candidate errored "
+            "(the stable answer was still returned)",
+            labels=("model",),
+        )
 
     # ---- routing ----
 
@@ -136,12 +143,27 @@ class CanaryController:
             self.observe(arm, result["runoff"], obs, gauge_ids)
             if self.state == "shadow":
                 # shadow traffic: the candidate sees the same inputs, scored
-                # against the same observations, invisible to the caller
-                shadow = self._svc.forecast(
-                    timeout=timeout, model=self.candidate,
-                    request_id=f"{rid}-shadow", **request,
-                )
-                self.observe("candidate", shadow["runoff"], obs, gauge_ids)
+                # against the same observations, invisible to the caller —
+                # INCLUDING its failures. Shadow doubles observation-carrying
+                # traffic, so under overload the extra forecast is the one
+                # most likely to be shed/rejected; the stable arm already
+                # answered, and that answer must not be lost to the copy.
+                try:
+                    shadow = self._svc.forecast(
+                        timeout=timeout, model=self.candidate,
+                        request_id=f"{rid}-shadow", **request,
+                    )
+                    self.observe("candidate", shadow["runoff"], obs, gauge_ids)
+                except Exception as e:
+                    with self._lock:
+                        self._shadow_failures += 1
+                    self._shadow_fail_counter.inc(model=self.candidate)
+                    log.warning(
+                        f"shadow forecast for candidate {self.candidate!r} "
+                        f"dropped ({type(e).__name__}: {e}); the candidate "
+                        "loses one observation, the caller keeps the stable "
+                        "answer"
+                    )
             self.evaluate()
         out = dict(result)
         out["arm"] = arm
@@ -249,5 +271,6 @@ class CanaryController:
                 "min_obs": self.min_obs,
                 "margin": self.margin,
                 "arms": evidence,
+                "shadow_failures": self._shadow_failures,
                 "transitions": list(self._transitions),
             }

@@ -47,11 +47,20 @@ __all__ = ["ReplicaGroup"]
 
 
 def _free_port() -> int:
+    """Ask the kernel for an ephemeral port. Inherently racy: the probe
+    socket closes before the ``ddr serve`` worker binds, so on a contended
+    host another process can claim the port in between — the boot path
+    tolerates that by relaunching the group on fresh ports (bounded)."""
     import socket
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+class _ReplicaExitedDuringBoot(RuntimeError):
+    """A subprocess replica died before reporting ready — on ephemeral ports
+    the likely cause is the allocation/bind race, so boot retries it."""
 
 
 class ReplicaGroup:
@@ -188,19 +197,42 @@ class ReplicaGroup:
         )
 
     def _boot_subprocess(self) -> None:
-        # allocate every port up front: the federation target list must be
-        # complete before the FIRST worker's environment is stamped
-        for i in range(self.cfg.replicas):
-            self._ports.setdefault(
-                i, self.cfg.base_port + i if self.cfg.base_port else _free_port()
-            )
-        self.replicas = [self._launch_one(i) for i in range(self.cfg.replicas)]
+        # _free_port() allocation races the worker's bind (see its docstring):
+        # a worker that dies during boot on ephemeral ports gets the WHOLE
+        # group relaunched on freshly allocated ports — per-replica
+        # reallocation would strand the federation target list already
+        # stamped into the other workers' environments. With base_port the
+        # operator owns the range, so a collision surfaces as the error it is.
+        attempts = 1 if self.cfg.base_port else 3
+        for attempt in range(1, attempts + 1):
+            # allocate every port up front: the federation target list must
+            # be complete before the FIRST worker's environment is stamped
+            for i in range(self.cfg.replicas):
+                self._ports.setdefault(
+                    i, self.cfg.base_port + i if self.cfg.base_port else _free_port()
+                )
+            self.replicas = [self._launch_one(i) for i in range(self.cfg.replicas)]
+            try:
+                self._await_ready()
+                return
+            except _ReplicaExitedDuringBoot as e:
+                if attempt == attempts:
+                    raise
+                log.warning(
+                    f"{e}; relaunching the group on fresh ports "
+                    f"(attempt {attempt + 1}/{attempts})"
+                )
+                self._kill_all_procs()
+                self._ports.clear()
+                self.replicas = []
+
+    def _await_ready(self) -> None:
         deadline = time.monotonic() + self._boot_timeout
         for replica in self.replicas:
             while not replica.ready():
                 proc = self._procs.get(replica.index)
                 if proc is not None and proc.poll() is not None:
-                    raise RuntimeError(
+                    raise _ReplicaExitedDuringBoot(
                         f"replica {replica.name} exited rc={proc.returncode} "
                         f"during boot — see {self._workdir}"
                     )
@@ -210,6 +242,16 @@ class ReplicaGroup:
                         f"{self._boot_timeout}s — see {self._workdir}"
                     )
                 time.sleep(0.25)
+
+    def _kill_all_procs(self) -> None:
+        with self._lock:
+            procs = [p for p in self._procs.values() if p is not None]
+            self._procs.clear()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            proc.wait()
 
     def _name(self, index: int) -> str:
         return f"{self.cfg.group}-r{index}"

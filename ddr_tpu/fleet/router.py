@@ -102,8 +102,29 @@ class HttpReplica:
     def forecast(self, **kwargs) -> dict:
         return self.client.forecast(**kwargs)
 
-    def ensemble(self, **kwargs) -> dict:
-        return self.client.forecast(**kwargs)
+    def ensemble(
+        self,
+        members: int = 8,
+        percentiles: Any | None = None,
+        seed: int = 0,
+        **kwargs,
+    ) -> dict:
+        # the wire shape is the scalar forecast body plus an "ensemble"
+        # object — HttpForecastClient.forecast has no members/percentiles/
+        # seed parameters, so the triple must be folded into that object
+        # (forwarding it raw would TypeError, and omitting it would silently
+        # run a scalar forecast)
+        return self.client.forecast(
+            **kwargs,
+            ensemble={
+                "members": int(members),
+                "percentiles": (
+                    None if percentiles is None
+                    else [float(p) for p in percentiles]
+                ),
+                "seed": int(seed),
+            },
+        )
 
     def stats(self) -> dict:
         return self.client.stats()

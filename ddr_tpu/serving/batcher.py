@@ -137,9 +137,12 @@ class MicroBatcher:
     def submit(self, req: ForecastRequest) -> ForecastRequest:
         """Admit one request, applying backpressure; returns ``req`` with its
         admission timestamp set. Raises :class:`QueueFullError` under
-        reject-new; under shed-oldest the queue head's future is failed
+        reject-new; under shed-oldest the oldest queued request is failed
         instead and the arrival is admitted. Shed victims come from the
-        LOWEST priority class present in the queue."""
+        LOWEST priority class present (arrival included — an arrival below
+        every queued class is rejected at the edge rather than admitted by
+        shedding higher-class work): shed-oldest takes the oldest admission
+        within that class, shed-by-deadline the earliest deadline."""
         from ddr_tpu.serving.config import priority_rank
 
         rank = priority_rank(req.priority)  # validates the class name too
@@ -154,7 +157,21 @@ class MicroBatcher:
                         f"queue at capacity ({self.queue_cap}); request rejected"
                     )
                 if self.backpressure == "shed-oldest":
-                    victim = self._q.pop(0)
+                    # oldest WITHIN the lowest class present — "oldest" must
+                    # never shed an interactive request while bulk work sits
+                    # in the queue
+                    worst = max(priority_rank(r.priority) for r in self._q)
+                    if rank > worst:
+                        self._stats["rejected"] += 1
+                        raise QueueFullError(
+                            f"queue at capacity ({self.queue_cap}) and the "
+                            "arriving request is below every queued class; "
+                            "request rejected"
+                        )
+                    victim = self._q.pop(next(
+                        i for i, r in enumerate(self._q)
+                        if priority_rank(r.priority) == worst
+                    ))
                 else:  # shed-by-deadline: lowest class loses first, then
                     # earliest deadline within it (never oldest admission)
                     idx = min(

@@ -126,6 +126,36 @@ class TestRollback:
         assert len(c.status()["transitions"]) == 1
 
 
+class TestShadowFailures:
+    def test_shadow_failure_keeps_stable_answer(self, service_factory,
+                                                monkeypatch):
+        """Shadow traffic is invisible to the caller INCLUDING its failures:
+        a shed/rejected shadow forecast costs the candidate one observation,
+        never the stable answer the caller already earned."""
+        from ddr_tpu.serving.batcher import QueueFullError
+
+        c = _controller(service_factory)
+        obs = _obs_like(c._svc)
+        real_forecast = c._svc.forecast
+
+        def overloaded(**kw):
+            if str(kw.get("request_id", "")).endswith("-shadow"):
+                raise QueueFullError("queue at capacity; request rejected")
+            return real_forecast(**kw)
+
+        monkeypatch.setattr(c._svc, "forecast", overloaded)
+        out = c.handle(
+            network="default", t0=0, request_id="sf-1", observations=obs
+        )
+        assert out["arm"] == "stable" and "runoff" in out
+        status = c.status()
+        assert status["shadow_failures"] == 1
+        assert status["arms"]["stable"]["observations"] == 1
+        assert status["arms"]["candidate"]["observations"] == 0
+        counter = c._svc.metrics.get("ddr_canary_shadow_failures_total")
+        assert counter.value(model="candidate") == 1.0
+
+
 class TestWeightedSplit:
     def test_canary_weight_splits_traffic_deterministically(self, service_factory):
         svc = service_factory(candidate=True)

@@ -6,6 +6,7 @@ from __future__ import annotations
 import os
 import time
 
+import numpy as np
 import pytest
 
 from ddr_tpu.fleet.config import FleetConfig
@@ -84,6 +85,41 @@ class TestInProcessGroup:
             assert "DDR_FEDERATE_REPLICAS" not in os.environ
         finally:
             group.close()
+
+    def test_ensemble_through_http_replica(self, service_factory):
+        """The subprocess-group dispatch shape: an ensemble request routed to
+        an :class:`HttpReplica` must cross the wire as the scalar body plus an
+        ``"ensemble"`` object and come back as (P, T, G) percentile bands — a
+        scalar (T, G) response here is the silent-downgrade bug."""
+        from ddr_tpu.fleet.router import HttpReplica, Router
+        from ddr_tpu.serving.http_api import serve_http
+
+        svc = service_factory()
+        server = serve_http(svc, host="127.0.0.1", port=0)
+        router = None
+        try:
+            router = Router([HttpReplica(server.url, 0)], probe_s=30.0)
+            out = router.ensemble(
+                network="default", t0=0, members=3,
+                percentiles=[10, 50, 90], seed=7,
+            )
+            assert out["members"] == 3
+            assert out["percentiles"] == [10.0, 50.0, 90.0]
+            runoff = np.asarray(out["runoff"])
+            assert runoff.ndim == 3 and runoff.shape[0] == 3  # (P, T, G)
+            # numeric parity with the in-process path on the same request id
+            local = svc.ensemble_forecast(
+                network="default", t0=0, members=3,
+                percentiles=[10, 50, 90], seed=7,
+                request_id=out["request_id"],
+            )
+            np.testing.assert_allclose(
+                runoff, np.asarray(local["runoff"]), rtol=1e-6
+            )
+        finally:
+            if router is not None:
+                router.close()
+            server.shutdown()
 
     def test_http_fronts_publish_and_restore_federation(
         self, service_factory, tmp_path, monkeypatch

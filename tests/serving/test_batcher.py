@@ -39,9 +39,12 @@ class _RecordingExecutor:
                 r.future.set_result(r.payload)
 
 
-def _req(key="net", payload=0, deadline_s: float | None = 30.0) -> ForecastRequest:
+def _req(key="net", payload=0, deadline_s: float | None = 30.0,
+         priority: str = "batch") -> ForecastRequest:
     deadline = None if deadline_s is None else time.monotonic() + deadline_s
-    return ForecastRequest(key=key, payload=payload, deadline=deadline)
+    return ForecastRequest(
+        key=key, payload=payload, deadline=deadline, priority=priority
+    )
 
 
 class TestCoalescing:
@@ -231,6 +234,63 @@ class TestShedByDeadline:
         try:
             with pytest.raises(QueueFullError, match="earliest deadline"):
                 b.submit(_req(payload="doomed", deadline_s=1.0))
+            assert b.stats()["rejected"] == 1
+            assert b.stats()["shed"] == 0
+            ex.gate.set()
+            assert q0.future.result(timeout=5) == "q0"
+        finally:
+            b.close()
+
+
+class TestShedOldestPriorities:
+    """shed-oldest is class-aware: the victim is the OLDEST admission within
+    the lowest priority class present — an interactive queue head must never
+    be shed while bulk work sits behind it."""
+
+    def _full_queue(self, *priorities):
+        ex = _RecordingExecutor()
+        ex.gate = threading.Event()
+        b = MicroBatcher(
+            ex, max_batch=1, queue_cap=len(priorities), batch_wait_s=0.0,
+            backpressure="shed-oldest",
+        )
+        r_exec = b.submit(_req(payload="executing"))
+        t0 = time.monotonic()
+        while b.stats()["depth"] != 0 and time.monotonic() - t0 < 5:
+            time.sleep(0.002)
+        queued = [
+            b.submit(_req(payload=f"q{i}", priority=p))
+            for i, p in enumerate(priorities)
+        ]
+        return ex, b, r_exec, queued
+
+    def test_interactive_head_survives_queued_bulk(self):
+        # admission order: q0 interactive (the head), q1 bulk, q2 bulk — a
+        # plain pop(0) would shed the interactive request; the victim must be
+        # q1, the oldest of the lowest class present
+        ex, b, r_exec, (q0, q1, q2) = self._full_queue(
+            "interactive", "bulk", "bulk"
+        )
+        try:
+            newest = b.submit(_req(payload="newest", priority="batch"))
+            with pytest.raises(RequestShedError) as ei:
+                q1.future.result(timeout=5)
+            assert ei.value.reason == "queue-full"
+            ex.gate.set()
+            assert q0.future.result(timeout=5) == "q0"
+            assert q2.future.result(timeout=5) == "q2"
+            assert newest.future.result(timeout=5) == "newest"
+            assert b.stats()["shed"] == 1
+        finally:
+            b.close()
+
+    def test_arrival_below_every_queued_class_is_rejected(self):
+        # symmetric with shed-by-deadline: when the arrival IS the lowest
+        # class present, reject it at the edge rather than shed queued work
+        ex, b, r_exec, (q0,) = self._full_queue("interactive")
+        try:
+            with pytest.raises(QueueFullError, match="below every queued"):
+                b.submit(_req(payload="doomed", priority="bulk"))
             assert b.stats()["rejected"] == 1
             assert b.stats()["shed"] == 0
             ex.gate.set()
