@@ -19,6 +19,7 @@ _COMMANDS = {
     "fleet": "ddr_tpu.scripts.fleet",
     "loadtest": "ddr_tpu.scripts.loadtest",
     "chaos": "ddr_tpu.scripts.chaos",
+    "verify": "ddr_tpu.scripts.verify",
     "summed-q-prime": "ddr_tpu.scripts.summed_q_prime",
     "geometry-predictor": "ddr_tpu.scripts.geometry_predictor",
     "benchmark": "ddr_tpu.benchmarks.benchmark",

@@ -63,10 +63,15 @@ class CanaryController:
         weight: float | None = None,
         min_obs: int | None = None,
         margin: float | None = None,
+        min_samples: int | None = None,
     ) -> None:
         from ddr_tpu.fleet.config import FleetConfig
         from ddr_tpu.observability.registry import MetricsRegistry
         from ddr_tpu.observability.skill import SkillConfig, SkillTracker
+        from ddr_tpu.observability.verification import (
+            VerificationScorer,
+            VerifyConfig,
+        )
 
         cfg = fleet_cfg or FleetConfig.from_env()
         self._svc = service
@@ -79,6 +84,9 @@ class CanaryController:
         self.weight = cfg.canary_weight if weight is None else float(weight)
         self.min_obs = cfg.canary_min_obs if min_obs is None else int(min_obs)
         self.margin = cfg.canary_margin if margin is None else float(margin)
+        self.min_samples = (
+            cfg.canary_min_samples if min_samples is None else int(min_samples)
+        )
         if not 0.0 < self.weight <= 1.0:
             raise ValueError(f"weight must be in (0, 1], got {self.weight}")
         # per-arm trackers get PRIVATE registries: the arms' skill
@@ -89,6 +97,16 @@ class CanaryController:
             "stable": SkillTracker(skill_cfg, registry=MetricsRegistry()),
             "candidate": SkillTracker(skill_cfg, registry=MetricsRegistry()),
         }
+        # per-arm verification scorers (same privacy rule): ensemble arms
+        # accrue CRPS evidence through observe_ensemble, and when both arms
+        # carry enough MATCHED samples the state machine compares proper
+        # scores instead of point-metric NSE
+        verify_cfg = VerifyConfig.from_env(enabled=True)
+        self._scorers = {
+            "stable": VerificationScorer(verify_cfg, registry=MetricsRegistry()),
+            "candidate": VerificationScorer(verify_cfg, registry=MetricsRegistry()),
+        }
+        self._ens_obs = {"stable": 0, "candidate": 0}
         self._lock = threading.Lock()
         self._state = "shadow"
         self._canary_entry_obs = 0  # candidate obs count when canary started
@@ -181,29 +199,74 @@ class CanaryController:
             gauge_ids = [str(i) for i in range(pred.shape[1])]
         tracker.observe(pred, obs, gauge_ids, arm=arm)
 
+    def observe_ensemble(
+        self,
+        arm: str,
+        members: Any,
+        obs: Any,
+        gauge_ids: Any | None = None,
+        lead_h: Any | None = None,
+    ) -> None:
+        """Feed one arm's verification scorer: an ``(E, T, G)`` member stack
+        matched against ``(T, G)`` observations (NaN = missing). This is the
+        CRPS evidence path for ensemble arms — once both arms hold
+        ``min_samples`` matched samples, :meth:`evaluate` compares proper
+        scores instead of median NSE. ``lead_h`` defaults to hourly steps
+        1..T (a forecast issued now, verified over its horizon)."""
+        scorer = self._scorers[arm]
+        members = np.asarray(members, dtype=np.float64)
+        if members.ndim == 2:
+            members = members[None, :, :]
+        obs = np.atleast_2d(np.asarray(obs, dtype=np.float64))
+        _E, T, G = members.shape
+        if gauge_ids is None:
+            gauge_ids = [str(i) for i in range(G)]
+        if lead_h is None:
+            lead_h = np.arange(1, T + 1, dtype=np.float64)
+        scorer.update(members, obs, lead_h, gauge_ids)
+        with self._lock:
+            self._ens_obs[arm] += 1
+
     # ---- the state machine ----
 
     def _evidence(self) -> dict:
         rollup = {}
         for arm, tracker in self._trackers.items():
             status = tracker.status()
+            sc_status = self._scorers[arm].status()
+            scores = sc_status.get("scores") or {}
+            with self._lock:
+                ens_obs = self._ens_obs[arm]
             rollup[arm] = {
-                "observations": int(status.get("observations", 0)),
+                # batches seen (skill-bearing requests + ensemble joins) —
+                # the min_obs cadence gate
+                "observations": int(status.get("observations", 0)) + ens_obs,
+                # scored (pred, obs) pairs — the DDR_CANARY_MIN_SAMPLES floor
+                "samples": int(status.get("samples", 0)),
+                "matched_samples": int(sc_status.get("samples", 0)),
                 "nse_median": (status.get("nse") or {}).get("median"),
+                "crps_mean": scores.get("crps"),
             }
         return rollup
 
     def evaluate(self) -> str:
         """Re-run the promotion decision; returns the (possibly new) state.
 
-        Transition rules, evaluated on skill evidence once BOTH arms carry at
-        least ``min_obs`` observations: a candidate median NSE more than
-        ``margin`` below stable's rolls back (from shadow or canary); parity
-        or better advances shadow -> canary; canary -> promoted after the
-        candidate accrues ``min_obs`` MORE observations while actually taking
-        weighted traffic (shadow evidence alone never promotes). A degraded
-        health watchdog rolls back from any live state regardless of skill —
-        numerics failing under candidate traffic is not a skill question."""
+        Transition rules, evaluated once BOTH arms carry at least ``min_obs``
+        observation batches AND at least ``min_samples`` scored (pred, obs)
+        pairs (``DDR_CANARY_MIN_SAMPLES`` — skill samples + matched
+        verification samples; a transition must never fire off a near-empty
+        window). Evidence preference: when both arms hold ``min_samples``
+        MATCHED verification samples, the comparison is mean CRPS (the proper
+        score — ensemble arms are judged as distributions); otherwise median
+        NSE. A candidate worse than stable by more than ``margin`` (relative
+        for CRPS, absolute for NSE) rolls back; parity or better advances
+        shadow -> canary; canary -> promoted after the candidate accrues
+        ``min_obs`` MORE observations while actually taking weighted traffic
+        (shadow evidence alone never promotes). A degraded health watchdog
+        rolls back from any live state regardless of skill and regardless of
+        the sample floor — numerics failing under candidate traffic is a
+        safety stop, not an evidence question."""
         evidence = self._evidence()
         with self._lock:
             state = self._state
@@ -216,20 +279,40 @@ class CanaryController:
             cand, stab = evidence["candidate"], evidence["stable"]
             if min(cand["observations"], stab["observations"]) < self.min_obs:
                 return state
-            c_nse, s_nse = cand["nse_median"], stab["nse_median"]
-            if c_nse is None or s_nse is None:
+            if min(
+                cand["samples"] + cand["matched_samples"],
+                stab["samples"] + stab["matched_samples"],
+            ) < self.min_samples:
                 return state
-            if c_nse < s_nse - self.margin:
-                return self._transition_locked(
-                    "rolled-back", "skill-regression", evidence
-                )
+            c_crps, s_crps = cand["crps_mean"], stab["crps_mean"]
+            use_crps = (
+                c_crps is not None
+                and s_crps is not None
+                and min(cand["matched_samples"], stab["matched_samples"])
+                >= self.min_samples
+            )
+            if use_crps:
+                # CRPS is smaller-is-better and scale-bearing (discharge
+                # units), so the margin is RELATIVE
+                if c_crps > s_crps * (1.0 + self.margin):
+                    return self._transition_locked(
+                        "rolled-back", "crps-regression", evidence
+                    )
+                parity, confirmed = "crps-parity", "crps-confirmed"
+            else:
+                c_nse, s_nse = cand["nse_median"], stab["nse_median"]
+                if c_nse is None or s_nse is None:
+                    return state
+                if c_nse < s_nse - self.margin:
+                    return self._transition_locked(
+                        "rolled-back", "skill-regression", evidence
+                    )
+                parity, confirmed = "skill-parity", "skill-confirmed"
             if state == "shadow":
                 self._canary_entry_obs = cand["observations"]
-                return self._transition_locked("canary", "skill-parity", evidence)
+                return self._transition_locked("canary", parity, evidence)
             if cand["observations"] - self._canary_entry_obs >= self.min_obs:
-                return self._transition_locked(
-                    "promoted", "skill-confirmed", evidence
-                )
+                return self._transition_locked("promoted", confirmed, evidence)
             return state
 
     def _transition_locked(self, to: str, reason: str, evidence: dict) -> str:
@@ -246,8 +329,14 @@ class CanaryController:
             "candidate_model": self.candidate,
             "stable_obs": evidence["stable"]["observations"],
             "candidate_obs": evidence["candidate"]["observations"],
+            "stable_samples": evidence["stable"]["samples"],
+            "candidate_samples": evidence["candidate"]["samples"],
+            "stable_matched": evidence["stable"]["matched_samples"],
+            "candidate_matched": evidence["candidate"]["matched_samples"],
             "stable_nse": evidence["stable"]["nse_median"],
             "candidate_nse": evidence["candidate"]["nse_median"],
+            "stable_crps": evidence["stable"]["crps_mean"],
+            "candidate_crps": evidence["candidate"]["crps_mean"],
         }
         self._state = to
         self._transitions.append(record)
@@ -269,6 +358,7 @@ class CanaryController:
                 "candidate": self.candidate,
                 "weight": self.weight,
                 "min_obs": self.min_obs,
+                "min_samples": self.min_samples,
                 "margin": self.margin,
                 "arms": evidence,
                 "shadow_failures": self._shadow_failures,

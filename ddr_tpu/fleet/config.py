@@ -90,6 +90,12 @@ class FleetConfig:
     #: arm to advance, and falling more than this below it rolls back
     #: (DDR_FLEET_CANARY_MARGIN).
     canary_margin: float = 0.05
+    #: Minimum per-arm MATCHED verification samples (scored (pred, obs)
+    #: pairs, not batch counts) before any FORWARD canary transition —
+    #: shadow -> canary or canary -> promoted — may fire; safety rollbacks
+    #: stay ungated (DDR_CANARY_MIN_SAMPLES — not DDR_FLEET_-prefixed: the
+    #: floor belongs to the verification contract, not the group topology).
+    canary_min_samples: int = 8
 
     def __post_init__(self) -> None:
         if self.mode not in FLEET_MODES:
@@ -117,6 +123,10 @@ class FleetConfig:
         if self.canary_min_obs < 1:
             raise ValueError(
                 f"canary_min_obs must be >= 1, got {self.canary_min_obs}"
+            )
+        if self.canary_min_samples < 0:
+            raise ValueError(
+                f"canary_min_samples must be >= 0, got {self.canary_min_samples}"
             )
 
     @classmethod
@@ -151,5 +161,13 @@ class FleetConfig:
             v = _get(var, cast, scale)
             if v is not None:
                 from_env[key] = v
+        raw = env.get("DDR_CANARY_MIN_SAMPLES")
+        if raw not in (None, ""):
+            try:
+                from_env["canary_min_samples"] = int(raw)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad DDR_CANARY_MIN_SAMPLES={raw!r}: {e}"
+                ) from e
         from_env.update(overrides)
         return cls(**from_env)

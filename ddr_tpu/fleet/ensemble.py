@@ -25,6 +25,7 @@ import hashlib
 import logging
 import threading
 import time
+import warnings
 from typing import Any
 
 import numpy as np
@@ -35,11 +36,40 @@ __all__ = [
     "DEFAULT_PERCENTILES",
     "EnsembleRunner",
     "member_forcing",
+    "percentile_bands",
     "perturbation_seed",
 ]
 
 #: Percentiles returned when a request doesn't name its own.
 DEFAULT_PERCENTILES = (10.0, 50.0, 90.0)
+
+
+def percentile_bands(
+    runoff_e: np.ndarray, qs: tuple[float, ...]
+) -> tuple[np.ndarray, int]:
+    """Percentile hydrographs over the member axis, tolerant of broken
+    members: ``(E, T, G)`` -> ``((P, T, G) bands, nonfinite member count)``.
+
+    A single member that went non-finite (a perturbation that blew up the
+    routing numerics) must degrade ONE member, not poison every band the way
+    plain ``np.percentile`` does — non-finite values are masked to NaN and
+    the bands computed with ``np.nanpercentile`` over the surviving members
+    per (t, g) cell. A member counts as non-finite when ANY of its cells is
+    (the count is the response's ``ensemble_nonfinite_members`` field); a
+    cell with no finite member at all yields a NaN band value, which the
+    health watchdog already surfaces."""
+    runoff_e = np.asarray(runoff_e)
+    finite = np.isfinite(runoff_e)
+    n_bad = int(runoff_e.shape[0] - finite.all(axis=(1, 2)).sum())
+    if n_bad == 0:
+        return np.percentile(runoff_e, qs, axis=0), 0
+    masked = np.where(finite, runoff_e, np.nan)
+    with warnings.catch_warnings():
+        # all-NaN cells are a legitimate degenerate outcome here (every
+        # member broke at that cell) — NaN bands, not a warning storm
+        warnings.simplefilter("ignore", category=RuntimeWarning)
+        bands = np.nanpercentile(masked, qs, axis=0)
+    return bands, n_bad
 
 
 def perturbation_seed(request_id: str, seed: int = 0) -> int:
@@ -154,7 +184,9 @@ class EnsembleRunner:
         if gauge_sel is not None:
             runoff_e = runoff_e[:, :, gauge_sel]
         # host-side percentiles: any requested list against the ONE program
-        bands = np.percentile(runoff_e, qs, axis=0)  # (P, T, G)
+        # (NaN-member tolerant — a broken member degrades itself, not the
+        # whole band)
+        bands, n_nonfinite = percentile_bands(runoff_e, qs)  # (P, T, G)
         svc._emit(
             "serve_request",
             status="ok",
@@ -167,7 +199,12 @@ class EnsembleRunner:
             ensemble_members=E,
             n_gauges=int(runoff_e.shape[2]),
             slo_ok=True,
+            # bounded note, present only when members actually broke
+            **({"ensemble_nonfinite_members": n_nonfinite} if n_nonfinite else {}),
             **trace,
+        )
+        valid_times = self._feed_verifier(
+            network, model, rid, t0, q_prime, gauge_sel, runoff_e
         )
         out = {
             "network": network,
@@ -186,11 +223,52 @@ class EnsembleRunner:
                 "scores": [round(float(s), 6) for s in np.asarray(wscore)],
             },
             "execute_s": round(seconds, 6),
+            "ensemble_nonfinite_members": n_nonfinite,
+            **({"valid_times": valid_times} if valid_times is not None else {}),
             **trace,
         }
         if return_members:
             out["member_runoff"] = runoff_e
         return out
+
+    def _feed_verifier(
+        self,
+        network: str,
+        model: str,
+        rid: str,
+        t0: int | None,
+        q_prime: Any | None,
+        gauge_sel: Any | None,
+        runoff_e: np.ndarray,
+    ) -> list[int] | None:
+        """Record the full ``(E, T, G)`` member stack with the service's
+        attached verification ledger (docs/serving.md "/v1/observe" has the
+        valid-hour convention — ``t0`` windows key off the forcing timeline,
+        ``q_prime`` payloads off the wall clock). Returns the valid hours the
+        response advertises, or None without a verifier. Never raises —
+        verification must not fail a forecast that already computed."""
+        verifier = getattr(self._svc, "_verifier", None)
+        if verifier is None:
+            return None
+        try:
+            issue = (
+                int(time.time() // 3600)
+                if q_prime is not None
+                else (0 if t0 is None else int(t0))
+            )
+            valid = [issue + 1 + i for i in range(int(runoff_e.shape[1]))]
+            gids = (
+                [str(int(g)) for g in gauge_sel]
+                if gauge_sel is not None
+                else [str(j) for j in range(int(runoff_e.shape[2]))]
+            )
+            verifier.record_forecast(
+                network, model, rid, issue, valid, gids, runoff_e
+            )
+            return valid
+        except Exception:
+            log.exception("ensemble verification ledger feed failed")
+            return None
 
     # ---- validation (mirrors ForecastService.submit) ----
 

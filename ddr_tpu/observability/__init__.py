@@ -72,6 +72,13 @@ from ddr_tpu.observability.recovery import (
     RecoverySupervisor,
 )
 from ddr_tpu.observability.skill import SkillConfig, SkillTracker
+from ddr_tpu.observability.verification import (
+    ForecastLedger,
+    VerificationScorer,
+    VerifyConfig,
+    brier_score,
+    crps_ensemble,
+)
 from ddr_tpu.observability.phases import STEP_PHASES, PhaseTimer, summarize_phases
 from ddr_tpu.observability.prometheus import (
     event_tee,
@@ -147,6 +154,11 @@ __all__ = [
     "ReachStats",
     "SkillConfig",
     "SkillTracker",
+    "ForecastLedger",
+    "VerificationScorer",
+    "VerifyConfig",
+    "brier_score",
+    "crps_ensemble",
     "DriftTracker",
     "SloConfig",
     "SloTracker",

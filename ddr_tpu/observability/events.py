@@ -98,7 +98,11 @@ __all__ = [
 #: ``DDR_DATA_VALIDATE`` policy applied, same module). ``canary`` is one
 #: canary-controller state transition (shadow → canary@w% → promoted, or an
 #: auto-rollback, with the per-arm skill evidence that forced it,
-#: :mod:`ddr_tpu.fleet.canary`).
+#: :mod:`ddr_tpu.fleet.canary`). ``verify`` is one forecast–observation join
+#: batch from the verification ledger (join counters + the bounded streaming
+#: scorer rollup: CRPS / Brier-with-reliability-decomposition / rank-histogram
+#: flatness / spread–skill by lead-time bin and worst-K gauges,
+#: :mod:`ddr_tpu.observability.verification`).
 #: Version of the event schema, stamped on every ``run_start`` so readers of
 #: FEDERATED logs (a fleet mixes replica versions during a rollout) can tell
 #: which vocabulary each file speaks. Bump when an event type is added or an
@@ -107,8 +111,10 @@ __all__ = [
 #: ``ddr lint`` rule DDR501). History: 1 = pre-trace schema; 2 = trace-context
 #: ids (``trace_id``/``span_id``/``parent_id``) on span/step/serve events,
 #: ``schema_version``/``prom_port`` on ``run_start``; 3 = the ``canary``
-#: event (fleet tier) and a ``priority`` field on serve_request/serve_shed.
-SCHEMA_VERSION = 3
+#: event (fleet tier) and a ``priority`` field on serve_request/serve_shed;
+#: 4 = the ``verify`` event (forecast verification plane) and
+#: ``matched_samples``/CRPS evidence fields on ``canary``.
+SCHEMA_VERSION = 4
 
 EVENT_TYPES = (
     "run_start",
@@ -135,6 +141,7 @@ EVENT_TYPES = (
     "recovery",
     "data_anomaly",
     "canary",
+    "verify",
 )
 
 

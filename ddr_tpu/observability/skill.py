@@ -265,6 +265,29 @@ class SkillTracker:
         self._emit(summary, context)
         return summary
 
+    def merge(self, other: "SkillTracker") -> None:
+        """Fold another tracker's running sums into this one, exactly: the
+        merged state equals a single tracker that had seen both streams
+        (the sums are plain per-gauge additions, so the fold is lossless).
+        Used by canary gating and ``ddr verify`` replay to combine per-arm /
+        per-replica trackers. The merged distribution is NOT re-mirrored into
+        the registry here — folding is a read-side aggregation; call sites
+        that want fresh metrics keep feeding :meth:`observe`."""
+        if other is self:
+            raise ValueError("cannot merge a tracker into itself")
+        with other._lock:
+            other_sums = other._sums.copy()
+            other_index = dict(other._gauges)
+            other_obs = other._observations
+        ids = [None] * len(other_index)
+        for name, row in other_index.items():
+            ids[row] = name
+        with self._lock:
+            if ids:
+                rows = self._rows_for(ids)
+                np.add.at(self._sums, rows, other_sums)
+            self._observations += other_obs
+
     # ---- reporting ----
 
     def _summarize(
@@ -355,6 +378,7 @@ class SkillTracker:
             return {
                 "enabled": self.config.enabled,
                 "observations": self._observations,
+                "samples": int(self._sums[:, 0].sum()),
                 "gauges": len(self._gauges),
                 **({} if self._last_summary is None else dict(self._last_summary)),
             }

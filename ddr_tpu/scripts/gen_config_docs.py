@@ -81,6 +81,16 @@ variables. Families with their own reference tables are linked.
   `DDR_FLEET_ROUTER` — the fleet tier (`ddr fleet`, replica groups, compiled
   ensemble forecasts, skill-gated canary promotion): see docs/serving.md
   "Fleet tier".
+- `DDR_VERIFY_*` (master switch, flood-threshold tokens, lead-time bin
+  edges, forecast-ledger cap, worst-gauge set size, per-gauge minimum
+  samples, climatology buffer size + percentile floor) — the forecast
+  verification plane (streaming CRPS/Brier/rank-histogram scoring, the
+  forecast–observation ledger behind `/v1/observe` and `ddr verify`): see
+  docs/observability.md "Forecast verification".
+- `DDR_CANARY_MIN_SAMPLES` — minimum per-arm MATCHED verification samples
+  before any forward canary transition (deliberately not `DDR_FLEET_`-
+  prefixed: the floor belongs to the verification contract, not the group
+  topology): see docs/serving.md "Fleet tier".
 - `DDR_BENCH_*` — `bench.py`: see `python bench.py --help`.
 - `DDR_CKPT_*` (format/async/retention), `DDR_IO_RETRIES`,
   `DDR_IO_RETRY_BACKOFF_S`, `DDR_FAULTS` / `DDR_FAULTS_SEED` — robustness:

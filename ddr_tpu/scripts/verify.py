@@ -1,0 +1,441 @@
+"""``ddr verify`` — forecast-verification reporting and self-test.
+
+Three modes against the verification plane
+(:mod:`ddr_tpu.observability.verification`):
+
+- ``--synthetic`` — self-test over a synthetic basin: issue E-member ensemble
+  forecasts against a known truth process (the unperturbed deterministic
+  forecast for the same window), join observations through the ledger, and
+  assert the scorers ORDER a sharp ensemble above a deliberately degraded one
+  (members biased x1.5) — CRPS is a proper score, so a broken scorer that
+  cannot rank them is an exit-1 failure, not a report footnote. Also pins the
+  jit cache: the whole join is host-side, so a compile during verification is
+  a regression.
+- ``--url`` — live mode: read a running service's ``/v1/stats`` verification
+  slice (the service must have a ledger attached via
+  ``ForecastService.attach_verifier``).
+- ``<logdir>`` — replay mode: fold the last ``verify`` event of every
+  ``run_log*.jsonl`` under a directory into one fleet-wide rollup (events
+  carry cumulative scorer summaries, so last-per-file + sample-weighted
+  merging is exact for the overall means).
+
+Every mode writes ``VERIFY_<label>.json`` (kind ``verify`` — gated by
+``scripts/check_bench_regression.py``: CRPS/Brier warn on growth, matched
+samples on drop) plus a ``VERIFY_<label>.md`` summary, prints the human
+summary, and leaves the raw record as the last machine-parseable stdout line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+__all__ = ["main", "render_verify_summary", "replay_dir", "run_synthetic"]
+
+#: Degraded-arm bias: members scaled by this factor. Far enough from truth
+#: that CRPS must rank it below the sharp arm on any reasonable basin.
+DEGRADE_FACTOR = 1.5
+
+
+def _device_label() -> str | None:
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return str(jax.devices()[0].platform)
+    except Exception:
+        return None
+
+
+def _mean_brier(thresholds: dict[str, Any]) -> float | None:
+    """One scalar Brier for the regression gate: the sample-weighted mean
+    over the scored thresholds (a gate key must be a number, not a dict)."""
+    num = den = 0.0
+    for entry in (thresholds or {}).values():
+        n = entry.get("n", 0)
+        if n and entry.get("brier") is not None:
+            num += entry["brier"] * n
+            den += n
+    return round(num / den, 6) if den else None
+
+
+def _scores_to_record(scores: dict[str, Any]) -> dict[str, Any]:
+    """The report fields shared by every mode, from one scorer summary."""
+    return {
+        "matched_samples": int(scores.get("samples", 0)),
+        "nonfinite_samples": int(scores.get("nonfinite_samples", 0)),
+        "crps": scores.get("crps"),
+        "brier": _mean_brier(scores.get("thresholds")),
+        "spread_skill": scores.get("spread_skill"),
+        "by_lead": scores.get("by_lead", {}),
+        "thresholds": scores.get("thresholds", {}),
+        "rank_histogram": scores.get("rank_histogram"),
+        "worst": scores.get("worst", []),
+    }
+
+
+# ---------------------------------------------------------------------------
+# synthetic self-test
+# ---------------------------------------------------------------------------
+
+
+def run_synthetic(service: Any, args: Any) -> dict[str, Any]:
+    """Issue ensembles against a known truth, join through the ledger, and
+    score a degraded twin on the identical observations. Attaches the
+    service's :class:`ForecastLedger` itself — AFTER the truth pass, so the
+    deterministic truth forecasts (the observation source) are never ledgered
+    as zero-error forecasts that would dilute the sharp arm's CRPS."""
+    from ddr_tpu.observability.registry import MetricsRegistry
+    from ddr_tpu.observability.verification import ForecastLedger
+
+    net = service._networks["default"]
+    t0_span = max(1, len(net.forcing) - net.horizon)
+    truths: dict[int, np.ndarray] = {}
+    for k in range(args.requests):
+        t0 = k % t0_span
+        if t0 not in truths:
+            truths[t0] = np.asarray(
+                service.forecast(
+                    network="default", t0=t0, request_id=f"verify-truth-{t0}"
+                )["runoff"]
+            )
+    ledger = ForecastLedger()
+    service.attach_verifier(ledger)
+    # the degraded arm is a PRIVATE ledger (own registry): its scores exist
+    # only for the ordering assertion, never for the exported series
+    degraded = ForecastLedger(ledger.config, registry=MetricsRegistry())
+    # compile-cache pin: everything from here on is host-side bookkeeping —
+    # ensemble programs are compiled now (first E-member request), and the
+    # JOIN must add zero entries
+    outs = []
+    for k in range(args.requests):
+        t0 = k % t0_span
+        out = service.ensemble_forecast(
+            network="default",
+            t0=t0,
+            members=args.members,
+            request_id=f"verify-ens-{k}",
+            return_members=True,
+        )
+        out["_t0"] = t0
+        outs.append(out)
+        degraded.record_forecast(
+            "default",
+            "degraded",
+            out["request_id"],
+            int(t0),
+            out["valid_times"],
+            [str(g) for g in range(out["member_runoff"].shape[2])],
+            np.asarray(out["member_runoff"]) * DEGRADE_FACTOR,
+        )
+    _hits, misses_before = service.tracker.counts()
+    for out in outs:
+        t0 = out["_t0"]
+        truth = truths[t0]
+        obs = {
+            str(g): [
+                (vh, float(truth[i, g]))
+                for i, vh in enumerate(out["valid_times"])
+            ]
+            for g in range(truth.shape[1])
+        }
+        ledger.observe("default", obs, source="synthetic")
+        degraded.observe("default", obs, source="synthetic-degraded")
+    _hits, misses_after = service.tracker.counts()
+
+    sharp = ledger.scorer.summary()
+    degraded_scores = degraded.scorer.summary()
+    status = ledger.status()
+    record = {
+        "kind": "verify",
+        "mode": "synthetic",
+        "requests": args.requests,
+        "members": args.members,
+        "n_segments": args.n,
+        "horizon": args.horizon,
+        **_scores_to_record(sharp),
+        "crps_degraded": degraded_scores.get("crps"),
+        "ordering_ok": (
+            sharp.get("crps") is not None
+            and degraded_scores.get("crps") is not None
+            and sharp["crps"] < degraded_scores["crps"]
+        ),
+        "unmatched_obs": status["unmatched_obs"],
+        "duplicate_obs": status["duplicate_obs"],
+        "evicted": status["evicted"],
+        "jit_misses_during_join": int(misses_after - misses_before),
+    }
+    return record
+
+
+# ---------------------------------------------------------------------------
+# live + replay
+# ---------------------------------------------------------------------------
+
+
+def run_live(url: str) -> dict[str, Any] | None:
+    """One ``/v1/stats`` read of a running service's verification slice."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url.rstrip('/')}/v1/stats", timeout=10) as r:
+        stats = json.loads(r.read())
+    verification = stats.get("verification")
+    if not verification:
+        return None
+    scorer = verification.get("scorer") or {}
+    record = {
+        "kind": "verify",
+        "mode": "live",
+        "target": url,
+        **_scores_to_record(scorer.get("scores") or {}),
+        "unmatched_obs": verification.get("unmatched_obs", 0),
+        "duplicate_obs": verification.get("duplicate_obs", 0),
+        "evicted": verification.get("evicted", 0),
+    }
+    return record
+
+
+def replay_dir(logdir: Path) -> dict[str, Any] | None:
+    """Fold the LAST ``verify`` event of every run log under ``logdir`` into
+    one rollup. Events carry cumulative scorer summaries, so the fold is one
+    sample-weighted mean per score across files (exact for the means; the
+    rank histogram and worst set are per-file shapes and are dropped)."""
+    lasts: list[dict] = []
+    files = sorted(logdir.glob("run_log*.jsonl"))
+    for path in files:
+        last = None
+        try:
+            with path.open() as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if ev.get("event") == "verify":
+                        last = ev
+        except OSError as e:
+            log.warning(f"skipping unreadable {path}: {e}")
+            continue
+        if last is not None:
+            lasts.append(last)
+    if not lasts:
+        return None
+
+    def _wmean(pairs: list[tuple[float, float]]) -> float | None:
+        num = sum(v * w for v, w in pairs)
+        den = sum(w for _, w in pairs)
+        return round(num / den, 6) if den else None
+
+    samples = sum(int(ev.get("samples", 0)) for ev in lasts)
+    crps = _wmean([
+        (ev["crps"], ev.get("samples", 0))
+        for ev in lasts
+        if ev.get("crps") is not None
+    ])
+    spread = _wmean([
+        (ev["spread_skill"], ev.get("samples", 0))
+        for ev in lasts
+        if ev.get("spread_skill") is not None
+    ])
+    briers = [
+        (b, ev.get("samples", 0))
+        for ev in lasts
+        for b in [_mean_brier(ev.get("thresholds"))]
+        if b is not None
+    ]
+    # lead-bin fold: weighted by each file's per-bin n
+    by_lead: dict[str, dict[str, float]] = {}
+    for ev in lasts:
+        for label, entry in (ev.get("by_lead") or {}).items():
+            acc = by_lead.setdefault(label, {"n": 0, "crps_num": 0.0})
+            acc["n"] += entry.get("n", 0)
+            if entry.get("crps") is not None:
+                acc["crps_num"] += entry["crps"] * entry.get("n", 0)
+    return {
+        "kind": "verify",
+        "mode": "replay",
+        "target": str(logdir),
+        "files": len(lasts),
+        "matched_samples": samples,
+        "crps": crps,
+        "brier": _wmean(briers),
+        "spread_skill": spread,
+        "by_lead": {
+            label: {
+                "n": int(acc["n"]),
+                "crps": round(acc["crps_num"] / acc["n"], 6) if acc["n"] else None,
+            }
+            for label, acc in by_lead.items()
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+
+def render_verify_summary(report: dict[str, Any]) -> str:
+    """Markdown summary for terminals and VERIFY_<label>.md."""
+    lines = [
+        f"## ddr verify — {report.get('mode')} "
+        f"({report.get('label', 'unlabeled')})",
+        "",
+        "| metric | value |",
+        "|---|---|",
+        f"| matched samples | {report.get('matched_samples', 0)} |",
+        f"| CRPS (fair, mean) | {report.get('crps')} |",
+        f"| Brier (weighted mean) | {report.get('brier')} |",
+        f"| spread–skill | {report.get('spread_skill')} |",
+    ]
+    if report.get("mode") == "synthetic":
+        lines += [
+            f"| CRPS degraded arm | {report.get('crps_degraded')} |",
+            f"| ordering (sharp < degraded) | "
+            f"{'OK' if report.get('ordering_ok') else 'FAILED'} |",
+            f"| jit misses during join | "
+            f"{report.get('jit_misses_during_join')} |",
+        ]
+    by_lead = report.get("by_lead") or {}
+    if by_lead:
+        lines += ["", "| lead bin | n | CRPS |", "|---|---|---|"]
+        for label, entry in by_lead.items():
+            lines.append(f"| {label} | {entry.get('n')} | {entry.get('crps')} |")
+    thresholds = report.get("thresholds") or {}
+    scored = {k: v for k, v in thresholds.items() if v.get("n")}
+    if scored:
+        lines += ["", "| threshold | n | Brier | REL | RES | base rate |",
+                  "|---|---|---|---|---|---|"]
+        for label, t in scored.items():
+            lines.append(
+                f"| {label} | {t['n']} | {t.get('brier')} | "
+                f"{t.get('reliability')} | {t.get('resolution')} | "
+                f"{t.get('base_rate')} |"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ddr verify",
+        description="Forecast-verification reporting: synthetic self-test, "
+        "live /v1/stats read, or run-log replay; writes a VERIFY_*.json "
+        "record check_bench_regression.py can gate on.",
+    )
+    parser.add_argument("logdir", nargs="?", default=None,
+                        help="replay mode: fold verify events from the run "
+                        "logs under this directory")
+    parser.add_argument("--url", default=None,
+                        help="live mode: read this service's /v1/stats "
+                        "verification slice")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="self-test over a synthetic basin (asserts CRPS "
+                        "orders a sharp ensemble above a degraded one)")
+    parser.add_argument("--n", type=int, default=64,
+                        help="synthetic reach count (default 64)")
+    parser.add_argument("--horizon", type=int, default=24,
+                        help="synthetic forecast horizon, hours (default 24)")
+    parser.add_argument("--members", type=int, default=8,
+                        help="synthetic ensemble size (default 8)")
+    parser.add_argument("--requests", type=int, default=6,
+                        help="synthetic ensemble forecasts to issue (default 6)")
+    parser.add_argument("--label", default=None,
+                        help="report name suffix (VERIFY_<label>.json; "
+                        "default: a timestamp)")
+    parser.add_argument("--out", default=None,
+                        help="report directory (default: DDR_METRICS_DIR or .)")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    if not args.synthetic and not args.url and not args.logdir:
+        parser.print_usage()
+        log.error("pick a mode: --synthetic, --url, or a run-log directory")
+        return 2
+
+    out_dir = Path(args.out or os.environ.get("DDR_METRICS_DIR") or ".")
+    out_dir.mkdir(parents=True, exist_ok=True)
+    label = args.label or time.strftime("%Y%m%d-%H%M%S")
+
+    rc = 0
+    if args.synthetic:
+        from ddr_tpu.observability import run_telemetry
+        from ddr_tpu.scripts.common import apply_compile_cache_env
+        from ddr_tpu.scripts.loadtest import build_synthetic_service
+
+        apply_compile_cache_env()
+        service, cfg = build_synthetic_service(
+            args.n, args.horizon, save_path=str(out_dir)
+        )
+        try:
+            with run_telemetry(cfg, "verify", mode="synthetic"):
+                try:
+                    report = run_synthetic(service, args)
+                finally:
+                    service.close(drain=False)
+                    service = None
+        finally:
+            if service is not None:
+                service.close(drain=False)
+        if not report["ordering_ok"]:
+            log.error(
+                "CRPS ordering FAILED: sharp %s vs degraded %s",
+                report.get("crps"), report.get("crps_degraded"),
+            )
+            rc = 1
+        if report["jit_misses_during_join"]:
+            log.error(
+                "the observation join compiled %d new programs — the "
+                "verification plane must be host-side",
+                report["jit_misses_during_join"],
+            )
+            rc = 1
+        if not report["matched_samples"]:
+            log.error("no forecast–observation pairs matched")
+            rc = 1
+    elif args.url:
+        report = run_live(args.url)
+        if report is None:
+            log.error(
+                f"{args.url} exposes no verification slice (is a ledger "
+                "attached via attach_verifier?)"
+            )
+            return 1
+    else:
+        report = replay_dir(Path(args.logdir))
+        if report is None:
+            log.error(f"no verify events found under {args.logdir}")
+            return 1
+
+    report["label"] = label
+    report["device"] = _device_label()
+    path = out_dir / f"VERIFY_{label}.json"
+    path.write_text(json.dumps(report, indent=2) + "\n")
+    (out_dir / f"VERIFY_{label}.md").write_text(
+        render_verify_summary(report) + "\n"
+    )
+    log.info(f"verify report written to {path}")
+    print(render_verify_summary(report))
+    print(json.dumps(report))  # last stdout line stays machine-parseable
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,6 +46,12 @@ Endpoints (all JSON unless noted):
   ``trace_id``) — the id that follows one operation across services and onto
   the request's ``serve_request``/``serve_shed`` events; request ids are per
   hop. ``DDR_TRACE=0`` suppresses trace ids entirely;
+- ``POST /v1/observe`` — ingest gauge observations for the forecast
+  verification ledger (body ``{"network": str, "observations": [{"gauge":
+  str|int, "times": [int hours], "values": [num]}, ...]}``; answers the join
+  stats; 404 unless a ledger is attached via
+  :meth:`ForecastService.attach_verifier` — docs/serving.md has the
+  valid-hour convention);
 - ``POST /v1/profile?seconds=N`` — start an on-demand ``jax.profiler``
   capture of live traffic into ``DDR_METRICS_DIR`` (fallbacks: the active
   run-log directory, then a tmpdir); answers 202 with the trace dir, 409
@@ -176,6 +182,9 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlsplit(self.path).path
         if path == "/v1/profile":
             self._post_profile()
+            return
+        if path == "/v1/observe":
+            self._post_observe()
             return
         if path != "/v1/forecast":
             self._send(404, {"error": f"no route for {self.path}"})
@@ -320,6 +329,52 @@ class _Handler(BaseHTTPRequestHandler):
         result["mean"] = np.asarray(result["mean"]).tolist()
         result.pop("member_runoff", None)
         send(200, result)
+
+    def _post_observe(self) -> None:
+        """``POST /v1/observe``: ingest gauge observations for the delayed
+        forecast–observation join (docs/serving.md). Body ``{"network": str,
+        "observations": [{"gauge": str|int, "times": [int hours],
+        "values": [num]}, ...]}``; answers the join stats (``matched`` /
+        ``unmatched`` / ``duplicates``). 404 when no verification ledger is
+        attached — observation ingestion is opt-in, not a default route."""
+        svc = self.server.service
+        verifier = getattr(svc, "verifier", None)
+        if verifier is None:
+            self._send(404, {"error": "no verification ledger attached "
+                                      "(service.attach_verifier)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            self._send(400, {"error": "bad Content-Length"})
+            return
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._send(400, {"error": f"body must be 1..{MAX_BODY_BYTES} bytes"})
+            return
+        try:
+            body = json.loads(self.rfile.read(length))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            self._send(400, {"error": f"invalid JSON body: {e}"})
+            return
+        if (
+            not isinstance(body, dict)
+            or "network" not in body
+            or not isinstance(body.get("observations"), list)
+        ):
+            self._send(400, {"error": 'body must be an object with "network" '
+                                      'and an "observations" list'})
+            return
+        try:
+            stats = verifier.observe(
+                str(body["network"]), body["observations"], source="http"
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            self._send(400, {"error": f"malformed observations: {e}"})
+            return
+        except Exception as e:
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, stats)
 
     def _post_profile(self) -> None:
         """``POST /v1/profile?seconds=N``: capture a ``jax.profiler`` trace of

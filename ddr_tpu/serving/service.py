@@ -185,6 +185,12 @@ class ForecastService:
         # or shadow-eval loop that holds observations — serving itself has
         # none); when present its rollup rides /v1/stats as the "skill" slice.
         self._skill: Any = None
+        # Optional forecast-verification ledger
+        # (:class:`~ddr_tpu.observability.verification.ForecastLedger`,
+        # attached via :meth:`attach_verifier`): every issued forecast —
+        # single and ensemble — is recorded for the delayed observation join,
+        # and the rollup rides /v1/stats as the "verification" slice.
+        self._verifier: Any = None
         # Lazy per-service ensemble runner (fleet tier): built on the first
         # ensemble request, holds ONE compiled E-member program per
         # (network, model, E) — :mod:`ddr_tpu.fleet.ensemble`.
@@ -501,6 +507,11 @@ class ForecastService:
         priority_rank(prio)  # unknown class names are the caller's bug
         rid = make_request_id(request_id)
         meta = {"network": network, "model": model, "request_id": rid}
+        if q_prime is None:
+            # the verification ledger keys the forecast's valid times off the
+            # issue hour (docs/serving.md "/v1/observe"); q_prime payloads
+            # carry no timeline, so they bucket against the wall clock instead
+            meta["t0"] = start
         if trace_enabled():
             # the request root span: adopt the caller's trace id (or mint) —
             # the batch worker later flow-links the serve_batch span to these
@@ -673,7 +684,14 @@ class ForecastService:
                 **_trace_fields(r),
             )
             self._observe_slo(good)
-        for r, out in zip(reqs, outs):
+        # the verification ledger is fed BEFORE any future resolves, same
+        # discipline as the events above: a client that posts observations
+        # right after its result must find its forecast joinable
+        valids = [
+            self._feed_verifier(network_name, model_name, r, out)
+            for r, out in zip(reqs, outs)
+        ]
+        for r, out, vt in zip(reqs, outs, valids):
             if r.future.set_running_or_notify_cancel():
                 r.future.set_result(
                     {
@@ -685,9 +703,39 @@ class ForecastService:
                         "request_id": r.meta.get("request_id"),
                         "queue_s": self._queue_seconds(r),
                         "execute_s": exec_s,
+                        **({"valid_times": vt} if vt is not None else {}),
                         **_trace_fields(r),
                     }
                 )
+
+    def _feed_verifier(
+        self, network: str, model: str, r: ForecastRequest, out: np.ndarray
+    ) -> list[int] | None:
+        """Record one issued deterministic forecast with the attached ledger
+        (a 1-member ensemble — CRPS degenerates to MAE). Returns the integer
+        valid hours the result advertises, or None when no verifier is
+        attached. Never raises: verification is observability, and a ledger
+        bug must not fail a request that already computed."""
+        if self._verifier is None:
+            return None
+        try:
+            t0 = r.meta.get("t0")
+            issue = int(t0) if t0 is not None else int(time.time() // 3600)
+            valid = [issue + 1 + i for i in range(int(out.shape[0]))]
+            sel = r.payload["gauges"]
+            gids = (
+                [str(int(g)) for g in sel]
+                if sel is not None
+                else [str(j) for j in range(int(out.shape[1]))]
+            )
+            self._verifier.record_forecast(
+                network, model, r.meta.get("request_id"), issue, valid, gids,
+                np.asarray(out)[None, :, :],
+            )
+            return valid
+        except Exception:
+            log.exception("verification ledger feed failed")
+            return None
 
     def _run_batch(
         self,
@@ -1061,6 +1109,9 @@ class ForecastService:
             "compiles": {"hits": hits, "misses": misses, **self.tracker.snapshot()},
             "health": self.watchdog.status(),
             "skill": None if self._skill is None else self._skill.status(),
+            "verification": (
+                None if self._verifier is None else self._verifier.status()
+            ),
             "slo": None if self.slo is None else self.slo.status(),
             "models": self.models_info(),
             "networks": self.networks_info(),
@@ -1071,6 +1122,18 @@ class ForecastService:
         rollup should ride ``/v1/stats`` as the ``skill`` slice (fed by
         whatever loop holds observations — data assimilation, shadow eval)."""
         self._skill = tracker
+
+    def attach_verifier(self, ledger: Any) -> None:
+        """Attach a :class:`~ddr_tpu.observability.verification.ForecastLedger`:
+        every forecast issued from here on (single and ensemble) is recorded
+        for the delayed observation join (``POST /v1/observe``), results gain
+        ``valid_times``, and the ledger's rollup rides ``/v1/stats`` as the
+        ``verification`` slice."""
+        self._verifier = ledger
+
+    @property
+    def verifier(self) -> Any:
+        return self._verifier
 
     def close(self, drain: bool = True) -> None:
         self.registry.close()

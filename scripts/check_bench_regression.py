@@ -29,6 +29,15 @@ resume-fidelity deltas (``recovery_s``, ``loss_delta``,
 ``params_max_abs_delta``) warn when they GROW, ``post_restart_attainment``
 when it DROPS, and the shed/reject/error rates follow the loadtest rules.
 
+``ddr verify`` reports (``kind: "verify"``, written as ``VERIFY_*.json``)
+gate against the latest committed VERIFY record of the same mode: the
+probabilistic scores (``crps``, ``brier``) warn when they GROW (smaller is
+sharper) and ``matched_samples`` when it DROPS — a verification round that
+scores worse or joins fewer forecast–observation pairs is a forecast-quality
+regression. ``crps_degraded`` (the deliberately-biased control arm) and
+``spread_skill`` (ideal is 1.0, neither direction is "better") are never
+flagged.
+
 Records from different devices are never compared as regressions: a CPU
 fallback round against a TPU round says nothing about the code, so a device
 mismatch downgrades every finding to informational. Compute dtype pairs the
@@ -43,6 +52,7 @@ Usage::
     python scripts/check_bench_regression.py fresh.json          # vs latest BENCH_*
     python scripts/check_bench_regression.py fresh.json --baseline BENCH_r05.json
     python scripts/check_bench_regression.py LOADTEST_x.json     # vs latest LOADTEST_*
+    python scripts/check_bench_regression.py VERIFY_x.json       # vs latest VERIFY_*
     python scripts/check_bench_regression.py --run               # run bench.py first
     python scripts/check_bench_regression.py fresh.json --strict # exit 1 on regression
 
@@ -135,6 +145,17 @@ CHAOS_DOWN_KEYS = (
     "compile_events_chaos",
 )
 
+#: ``ddr verify`` report fields where SMALLER is better: the probabilistic
+#: scores of the LIVE arm. The degraded control arm's CRPS and the
+#: spread–skill ratio (ideal 1.0 — movement in either direction is
+#: calibration drift, not a monotone regression) are deliberately absent.
+VERIFY_DOWN_KEYS = ("crps", "brier")
+
+#: ``ddr verify`` fields where BIGGER is better: a round that joins fewer
+#: forecast–observation pairs gates like a throughput drop — less evidence
+#: is a verification-plane regression even when the scores held.
+VERIFY_UP_KEYS = ("matched_samples",)
+
 
 def is_loadtest_record(rec: dict) -> bool:
     """Whether a record is a ``ddr loadtest`` report (vs a bench.py record)."""
@@ -150,6 +171,11 @@ def record_dtype(rec: dict) -> str:
 def is_chaos_record(rec: dict) -> bool:
     """Whether a record is a ``ddr chaos`` report (kill-and-resume harness)."""
     return rec.get("kind") == "chaos"
+
+
+def is_verify_record(rec: dict) -> bool:
+    """Whether a record is a ``ddr verify`` report (verification plane)."""
+    return rec.get("kind") == "verify"
 
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -281,6 +307,34 @@ def latest_chaos_baseline(
     return None
 
 
+def latest_verify_baseline(
+    root: Path = REPO_ROOT,
+    mode: str | None = None,
+    exclude: Path | None = None,
+) -> Path | None:
+    """The newest VERIFY_* record (by mtime, labels are free-form) of the
+    SAME mode: a ``--synthetic`` self-test's CRPS comes from a deterministic
+    toy basin, a live/replay round's from real observations — gating one
+    against the other measures the data source, not the code. ``mode=None``
+    degrades to plain newest; unparseable candidates are skipped."""
+    cands = sorted(
+        root.glob("VERIFY_*.json"),
+        key=lambda p: (p.stat().st_mtime, p.name), reverse=True,
+    )
+    resolved = exclude.resolve() if exclude is not None else None
+    for p in cands:
+        if resolved is not None and p.resolve() == resolved:
+            continue
+        if mode is None:
+            return p
+        try:
+            if load_record(p).get("mode") == mode:
+                return p
+        except (ValueError, json.JSONDecodeError, OSError):
+            continue
+    return None
+
+
 def load_record(path: Path) -> dict:
     """A bench record, in either stored form.
 
@@ -321,9 +375,13 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
     # not the code — downgrade exactly like a device mismatch
     dtype_mismatch = record_dtype(fresh) != record_dtype(baseline)
     device_mismatch = device_mismatch or dtype_mismatch
-    smaller_is_better = MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS + CHAOS_DOWN_KEYS
+    smaller_is_better = (
+        MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS + CHAOS_DOWN_KEYS
+        + VERIFY_DOWN_KEYS
+    )
     for key in (
-        THROUGHPUT_KEYS + SERVING_UP_KEYS + RATIO_KEYS + smaller_is_better
+        THROUGHPUT_KEYS + SERVING_UP_KEYS + VERIFY_UP_KEYS + RATIO_KEYS
+        + smaller_is_better
     ):
         f, b = fresh.get(key), baseline.get(key)
         if not isinstance(f, (int, float)) or not isinstance(b, (int, float)):
@@ -454,6 +512,9 @@ def main(argv: list[str] | None = None) -> int:
             nan_storm=bool(fresh.get("nan_storm")),
             fleet=bool(fresh.get("fleet")),
         )
+    elif is_verify_record(fresh):
+        pattern = f"VERIFY_*.json [mode={fresh.get('mode')}]"
+        found = latest_verify_baseline(mode=fresh.get("mode"), exclude=exclude)
     elif is_loadtest_record(fresh):
         pattern = "LOADTEST_*.json"
         found = latest_loadtest_baseline(

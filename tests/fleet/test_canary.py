@@ -171,3 +171,87 @@ class TestWeightedSplit:
         arms = {rid: c.arm_for(rid) for rid in (f"split-{i}" for i in range(64))}
         assert set(arms.values()) == {"stable", "candidate"}  # both arms live
         assert all(c.arm_for(rid) == arm for rid, arm in arms.items())  # sticky
+
+
+class TestVerificationEvidence:
+    """The CRPS evidence path: the `DDR_CANARY_MIN_SAMPLES` floor gates every
+    evidence-based transition, and once both arms hold enough MATCHED
+    verification samples the decision compares proper scores, not NSE."""
+
+    def _ensemble_evidence(self, c, obs, sharp=True, arm="candidate"):
+        # (E, T, G) members around the truth: sharp = tight, degraded = biased
+        rng = np.random.default_rng(0 if sharp else 1)
+        spread = 0.01 if sharp else 0.0
+        bias = 1.0 if sharp else 2.0
+        members = obs[None, :, :] * bias + rng.normal(
+            0.0, spread, size=(4,) + obs.shape
+        )
+        c.observe_ensemble(arm, members, obs)
+
+    def test_min_samples_floor_holds_transitions(self, service_factory):
+        svc = service_factory(candidate=True)
+        c = CanaryController(svc, fleet_cfg=_cfg(), min_samples=1000)
+        obs = _obs_like(svc)
+        for arm in ("stable", "candidate"):
+            c.observe(arm, obs, obs)
+            c.observe(arm, obs, obs)  # 64 samples/arm: parity, but < floor
+        assert c.evaluate() == "shadow"
+        assert c.status()["min_samples"] == 1000
+        # the identical evidence clears a realistic floor immediately
+        c2 = CanaryController(svc, fleet_cfg=_cfg(), min_samples=8)
+        for arm in ("stable", "candidate"):
+            c2.observe(arm, obs, obs)
+            c2.observe(arm, obs, obs)
+        assert c2.evaluate() == "canary"
+
+    def test_watchdog_rollback_ignores_sample_floor(self, service_factory,
+                                                    monkeypatch):
+        svc = service_factory(candidate=True)
+        c = CanaryController(svc, fleet_cfg=_cfg(), min_samples=1000)
+        monkeypatch.setattr(type(svc.watchdog), "degraded", property(
+            lambda self: True
+        ))
+        assert c.evaluate() == "rolled-back"  # safety beats statistics
+
+    def test_crps_regression_rolls_back(self, service_factory, recorder):
+        svc = service_factory(candidate=True)
+        c = CanaryController(svc, fleet_cfg=_cfg(), min_samples=8)
+        obs = _obs_like(svc)
+        self._ensemble_evidence(c, obs, sharp=True, arm="stable")
+        self._ensemble_evidence(c, obs, sharp=False, arm="candidate")
+        for arm in ("stable", "candidate"):  # satisfy the min_obs cadence
+            c.observe(arm, obs, obs)
+        assert c.evaluate() == "rolled-back"
+        (t,) = c.status()["transitions"]
+        assert t["reason"] == "crps-regression"
+        assert t["candidate_crps"] > t["stable_crps"]
+        assert t["stable_matched"] == t["candidate_matched"] == obs.size
+        (e,) = events_of(recorder, "canary")
+        assert e["reason"] == "crps-regression"
+        assert e["candidate_crps"] is not None
+
+    def test_crps_parity_promotes_with_crps_reasons(self, service_factory):
+        svc = service_factory(candidate=True)
+        c = CanaryController(svc, fleet_cfg=_cfg(), min_samples=8)
+        obs = _obs_like(svc)
+        for arm in ("stable", "candidate"):
+            self._ensemble_evidence(c, obs, sharp=True, arm=arm)
+            c.observe(arm, obs, obs)
+        assert c.evaluate() == "canary"
+        # fresh canary-state evidence for the confirmation window
+        self._ensemble_evidence(c, obs, sharp=True, arm="candidate")
+        self._ensemble_evidence(c, obs, sharp=True, arm="candidate")
+        assert c.evaluate() == "promoted"
+        reasons = [t["reason"] for t in c.status()["transitions"]]
+        assert reasons == ["crps-parity", "crps-confirmed"]
+
+    def test_status_reports_per_arm_matched_counts(self, service_factory):
+        svc = service_factory(candidate=True)
+        c = CanaryController(svc, fleet_cfg=_cfg())
+        obs = _obs_like(svc)
+        self._ensemble_evidence(c, obs, arm="candidate")
+        arms = c.status()["arms"]
+        assert arms["candidate"]["matched_samples"] == obs.size
+        assert arms["candidate"]["observations"] == 1  # the ensemble join
+        assert arms["stable"]["matched_samples"] == 0
+        assert arms["stable"]["crps_mean"] is None

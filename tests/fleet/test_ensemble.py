@@ -10,6 +10,7 @@ import pytest
 from ddr_tpu.fleet.ensemble import (
     DEFAULT_PERCENTILES,
     member_forcing,
+    percentile_bands,
     perturbation_seed,
 )
 
@@ -134,3 +135,70 @@ class TestCompilePin:
             svc.ensemble_forecast(
                 network="default", t0=0, members=2, percentiles=[150.0]
             )
+
+
+class TestNanAwareBands:
+    """percentile_bands: one broken member degrades one member — it must
+    never poison every band the way plain np.percentile does."""
+
+    def test_clean_stack_matches_plain_percentile(self):
+        rng = np.random.default_rng(2)
+        stack = rng.gamma(2.0, 1.0, size=(5, 4, 3))
+        bands, n_bad = percentile_bands(stack, (10.0, 50.0, 90.0))
+        assert n_bad == 0
+        np.testing.assert_allclose(
+            bands, np.percentile(stack, (10.0, 50.0, 90.0), axis=0)
+        )
+
+    def test_one_nan_member_is_masked_not_poisonous(self):
+        rng = np.random.default_rng(3)
+        stack = rng.gamma(2.0, 1.0, size=(5, 4, 3))
+        stack[2, 1, 1] = np.nan  # ONE cell of ONE member
+        bands, n_bad = percentile_bands(stack, (50.0,))
+        assert n_bad == 1
+        assert np.isfinite(bands).all()  # survivors carry every cell
+        # untouched cells still use all five members
+        np.testing.assert_allclose(
+            bands[0, 0, 0], np.percentile(stack[:, 0, 0], 50.0)
+        )
+        # the poisoned cell falls back to the four finite members
+        np.testing.assert_allclose(
+            bands[0, 1, 1],
+            np.percentile(np.delete(stack[:, 1, 1], 2), 50.0),
+        )
+
+    def test_inf_counts_like_nan(self):
+        stack = np.ones((3, 2, 2))
+        stack[0, 0, 0] = np.inf
+        stack[1, 1, 1] = -np.inf
+        _, n_bad = percentile_bands(stack, (50.0,))
+        assert n_bad == 2
+
+    def test_all_members_broken_cell_yields_nan_band(self):
+        stack = np.ones((2, 1, 2))
+        stack[:, 0, 0] = np.nan  # every member broke at this cell
+        bands, n_bad = percentile_bands(stack, (50.0,))
+        assert n_bad == 2
+        assert np.isnan(bands[0, 0, 0]) and bands[0, 0, 1] == 1.0
+
+    def test_nonfinite_count_rides_response_and_event(self, service_factory,
+                                                      monkeypatch):
+        svc = service_factory()
+        runner_out = svc.ensemble_forecast(network="default", t0=0, members=3)
+        assert runner_out["ensemble_nonfinite_members"] == 0
+        # break one member's device output and re-serve
+        import ddr_tpu.fleet.ensemble as ens_mod
+
+        real = ens_mod.percentile_bands
+
+        def poisoned(stack, qs):
+            stack = np.asarray(stack).copy()
+            stack[0, 0, 0] = np.nan
+            return real(stack, qs)
+
+        monkeypatch.setattr(ens_mod, "percentile_bands", poisoned)
+        out = svc.ensemble_forecast(
+            network="default", t0=0, members=3, request_id="nan-ens"
+        )
+        assert out["ensemble_nonfinite_members"] == 1
+        assert np.isfinite(np.asarray(out["runoff"])).all()

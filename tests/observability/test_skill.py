@@ -252,3 +252,36 @@ class TestSums:
         assert out["nse"][0] == pytest.approx(0.2)
         assert out["kge"][0] == pytest.approx(0.6)
         assert out["pbias"][0] == pytest.approx(40.0)
+
+
+class TestMerge:
+    def test_merge_equals_combined_stream(self):
+        """merge(other) is lossless: the folded tracker's per-gauge results
+        equal one tracker that saw both streams, including partially
+        overlapping gauge sets."""
+        rng = np.random.default_rng(5)
+        a, b, both = _tracker(), _tracker(), _tracker()
+        for tr_part, gauges in ((a, ["g0", "g1"]), (b, ["g1", "g2"])):
+            pred = rng.gamma(2.0, 1.0, size=(6, 2))
+            obs = rng.gamma(2.0, 1.0, size=(6, 2))
+            tr_part.observe(pred, obs, gauges)
+            both.observe(pred, obs, gauges)
+        a.merge(b)
+        ra, rb = a.results(), both.results()
+        assert set(ra) == set(rb) == {"g0", "g1", "g2"}
+        for g in rb:
+            for k in ("nse", "kge", "pbias"):
+                assert ra[g][k] == pytest.approx(rb[g][k], abs=1e-12)
+        assert a.status()["samples"] == both.status()["samples"]
+
+    def test_merge_self_raises(self):
+        tr = _tracker()
+        with pytest.raises(ValueError, match="itself"):
+            tr.merge(tr)
+
+    def test_merge_empty_other_is_noop(self):
+        tr = _tracker()
+        tr.observe(_col([1.0, 2.0]), _col([1.0, 2.0]), ["g"])
+        before = tr.results()["g"]["nse"]
+        tr.merge(_tracker())
+        assert tr.results()["g"]["nse"] == before

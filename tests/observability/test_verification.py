@@ -1,0 +1,416 @@
+"""The forecast verification plane: streaming CRPS / Brier / rank scorers
+and the forecast–observation ledger.
+
+Hand-computed references on tiny ensembles (the closed-form fair-CRPS cases,
+ties-low ranks, Murphy's Brier identity), streaming-vs-offline equivalence to
+1e-9 over multi-update random data, lead-bin boundary routing, climatology
+priors-only threshold resolution, ledger join semantics (duplicates,
+out-of-order, eviction), the bounded ``verify`` event, and the worst-K
+exposition cardinality under gauge churn.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ddr_tpu.observability.events import Recorder, activate, deactivate
+from ddr_tpu.observability.prometheus import render_text
+from ddr_tpu.observability.registry import MetricsRegistry
+from ddr_tpu.observability.verification import (
+    ForecastLedger,
+    VerificationScorer,
+    VerifyConfig,
+    brier_score,
+    crps_ensemble,
+    lead_bin_index,
+    lead_bin_labels,
+    parse_thresholds,
+    rank_of_obs,
+)
+
+
+def _scorer(registry=None, **kw):
+    kw.setdefault("thresholds", ("1.0",))
+    return VerificationScorer(
+        VerifyConfig(**kw), registry=registry or MetricsRegistry()
+    )
+
+
+def _crps_brute(members, obs, fair=True):
+    """O(E²) textbook estimator: mean|x−y| − Σ_{i,j}|x_i−x_j| / (2D)."""
+    m = np.asarray(members, dtype=np.float64)
+    E = m.shape[0]
+    term1 = np.mean(np.abs(m - np.asarray(obs, dtype=np.float64)[None]), axis=0)
+    if E == 1:
+        return term1
+    pair = np.abs(m[:, None] - m[None, :]).sum(axis=(0, 1))
+    denom = E * (E - 1) if fair else E * E
+    return term1 - pair / (2.0 * denom)
+
+
+class TestReferenceScorers:
+    def test_closed_form_two_members(self):
+        # members {0, 2}, obs 1: term1 = 1, pair term = 2/D.
+        # standard D=4 -> 1 - 0.5 = 0.5; fair D=2 -> 1 - 1 = 0.0
+        m = np.array([[0.0], [2.0]])
+        o = np.array([1.0])
+        assert crps_ensemble(m, o, fair=False)[0] == pytest.approx(0.5, abs=1e-12)
+        assert crps_ensemble(m, o, fair=True)[0] == pytest.approx(0.0, abs=1e-12)
+
+    def test_single_member_is_mae(self):
+        m = np.array([[3.0, -1.0]])
+        o = np.array([1.0, 1.0])
+        for fair in (True, False):
+            np.testing.assert_allclose(
+                crps_ensemble(m, o, fair=fair), [2.0, 2.0], atol=1e-12
+            )
+
+    def test_matches_brute_force_pairwise(self):
+        rng = np.random.default_rng(7)
+        m = rng.gamma(2.0, 1.5, size=(9, 40))
+        o = rng.gamma(2.0, 1.5, size=40)
+        for fair in (True, False):
+            np.testing.assert_allclose(
+                crps_ensemble(m, o, fair=fair), _crps_brute(m, o, fair=fair),
+                atol=1e-9,
+            )
+
+    def test_perfect_sharp_ensemble_scores_zero(self):
+        o = np.array([2.5, 0.1])
+        m = np.tile(o, (4, 1))
+        assert crps_ensemble(m, o, fair=True) == pytest.approx([0.0, 0.0])
+
+    def test_rank_of_obs_ties_low(self):
+        m = np.array([[1.0, 1.0], [2.0, 1.0], [3.0, 1.0]])
+        # obs 2.5: two members below -> rank 2; obs 1.0 ties all -> rank 0
+        np.testing.assert_array_equal(
+            rank_of_obs(m, np.array([2.5, 1.0])), [2, 0]
+        )
+
+    def test_lead_bin_boundaries(self):
+        edges = (6.0, 24.0, 72.0)
+        assert lead_bin_labels(edges) == ("0-6h", "6-24h", "24-72h", "72h+")
+        leads = np.array([0.0, 5.999, 6.0, 23.9, 24.0, 71.9, 72.0, 500.0])
+        # a lead exactly AT an edge opens the next bin (half-open upper bounds)
+        np.testing.assert_array_equal(
+            lead_bin_index(leads, edges), [0, 0, 1, 1, 2, 2, 3, 3]
+        )
+
+    def test_parse_thresholds(self):
+        assert parse_thresholds("p90, 2.5") == (
+            ("p90", "pct", 90.0), ("2.5", "abs", 2.5)
+        )
+        with pytest.raises(ValueError, match="bad threshold token"):
+            parse_thresholds("flood")
+        with pytest.raises(ValueError, match="must be in"):
+            parse_thresholds("p100")
+        with pytest.raises(ValueError, match="finite"):
+            parse_thresholds("-1.0")
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_thresholds("p90,p90")
+
+
+class TestVerifyConfig:
+    def test_env_and_override_precedence(self):
+        env = {
+            "DDR_VERIFY_THRESHOLDS": "p75,3.0",
+            "DDR_VERIFY_LEAD_BINS": "12,48",
+            "DDR_VERIFY_TOPK": "3",
+            "DDR_VERIFY_ENABLED": "0",
+        }
+        cfg = VerifyConfig.from_env(environ=env, top_k=5)
+        assert cfg.thresholds == ("p75", "3.0")
+        assert cfg.lead_bins_h == (12.0, 48.0)
+        assert cfg.top_k == 5  # explicit override beats env
+        assert cfg.enabled is False
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="strictly increasing"):
+            VerifyConfig(lead_bins_h=(24.0, 6.0))
+        with pytest.raises(ValueError, match="ledger_cap"):
+            VerifyConfig(ledger_cap=0)
+        with pytest.raises(ValueError, match="min_clim"):
+            VerifyConfig(clim_samples=4, min_clim=8)
+        with pytest.raises(ValueError, match="bad threshold token"):
+            VerifyConfig(thresholds=("flood",))
+        with pytest.raises(ValueError, match="bad DDR_VERIFY_LEAD_BINS"):
+            VerifyConfig.from_env(environ={"DDR_VERIFY_LEAD_BINS": "6,x"})
+
+
+class TestStreamingScorer:
+    def test_streaming_matches_offline_to_1e9(self):
+        """Many small updates == one offline pass: the raw running sums
+        reproduce the reference estimators exactly (1e-9), independent of
+        the 6-decimal rounding the bounded event payload applies."""
+        rng = np.random.default_rng(3)
+        sc = _scorer(thresholds=("1.0",), lead_bins_h=(6.0, 24.0))
+        E, chunks, S = 5, 7, 12
+        all_m, all_o = [], []
+        for _ in range(chunks):
+            m = rng.gamma(2.0, 1.0, size=(E, S))
+            o = rng.gamma(2.0, 1.0, size=S)
+            lead = rng.uniform(0.0, 48.0, size=S)
+            sc.update_samples(m, o, lead, [f"g{i % 3}" for i in range(S)])
+            all_m.append(m)
+            all_o.append(o)
+        m = np.concatenate(all_m, axis=1)
+        o = np.concatenate(all_o)
+        n = sc._bin_sums[:, 0].sum()
+        assert n == m.shape[1]
+        ref_crps = crps_ensemble(m, o, fair=True)
+        assert sc._bin_sums[:, 1].sum() / n == pytest.approx(
+            ref_crps.mean(), abs=1e-9
+        )
+        acc = sc._brier["1.0"]
+        p = (m > 1.0).mean(axis=0)
+        ob = (o > 1.0).astype(float)
+        assert acc["sse"].sum() / acc["n"].sum() == pytest.approx(
+            brier_score(p, ob), abs=1e-9
+        )
+        # spread–skill from the same sums: fair member variance over mean RMSE
+        ens_var = m.var(axis=0, ddof=1) * (E + 1.0) / E
+        rmse = np.sqrt(np.mean((m.mean(axis=0) - o) ** 2))
+        assert sc.summary()["spread_skill"] == pytest.approx(
+            np.sqrt(ens_var.mean()) / rmse, abs=1e-4
+        )
+
+    def test_murphy_identity_with_one_p_per_bin(self):
+        """With every probability bin holding a single distinct forecast p,
+        the binned decomposition is exact: BS = REL − RES + UNC."""
+        sc = _scorer(thresholds=("1.0",), min_samples=1)
+        E = 10
+        # p = k/10 for k=0..9 -> ten distinct bins; obs alternates outcome
+        for k in range(10):
+            members = np.array([2.0] * k + [0.0] * (E - k), dtype=float)
+            obs = 3.0 if k % 2 else 0.5  # exceeds threshold on odd k
+            sc.update_samples(members[:, None], [obs], [1.0], [f"g{k}"])
+        t = sc.summary()["thresholds"]["1.0"]
+        assert t["n"] == 10
+        assert t["brier"] == pytest.approx(
+            t["reliability"] - t["resolution"] + t["uncertainty"], abs=3e-6
+        )
+        assert t["base_rate"] == pytest.approx(0.5)
+
+    def test_rank_histogram_and_flatness(self):
+        sc = _scorer()
+        m = np.array([[0.0, 0.0, 0.0], [1.0, 1.0, 1.0]])  # E=2
+        # obs below both / between / above both -> ranks 0, 1, 2
+        sc.update_samples(m, [-1.0, 0.5, 2.0], [1.0, 1.0, 1.0], list("abc"))
+        rh = sc.summary()["rank_histogram"]
+        assert rh["members"] == 2
+        assert rh["counts"] == [1, 1, 1]
+        assert rh["flatness"] == pytest.approx(0.0)  # perfectly flat
+
+    def test_lead_bin_routing(self):
+        sc = _scorer(lead_bins_h=(6.0, 24.0))
+        m = np.zeros((2, 3))
+        sc.update_samples(m, [0.0, 0.0, 0.0], [5.9, 6.0, 24.0], list("xyz"))
+        by = sc.summary()["by_lead"]
+        assert [by[k]["n"] for k in ("0-6h", "6-24h", "24h+")] == [1, 1, 1]
+
+    def test_nonfinite_samples_counted_and_skipped(self):
+        sc = _scorer()
+        m = np.array([[1.0, np.nan, 1.0], [2.0, 2.0, 2.0]])
+        obs = [1.5, 1.5, np.inf]
+        assert sc.update_samples(m, obs, [1.0] * 3, list("abc")) == 1
+        s = sc.summary()
+        assert s["samples"] == 1 and s["nonfinite_samples"] == 2
+
+    def test_update_flattens_like_update_samples(self):
+        rng = np.random.default_rng(11)
+        E, T, G = 3, 4, 2
+        m = rng.gamma(2.0, 1.0, size=(E, T, G))
+        o = rng.gamma(2.0, 1.0, size=(T, G))
+        lead = np.arange(1.0, T + 1)
+        a = _scorer()
+        a.update(m, o, lead, ["g0", "g1"])
+        b = _scorer()
+        b.update_samples(
+            m.reshape(E, T * G), o.reshape(T * G), np.repeat(lead, G),
+            [g for _ in range(T) for g in ("g0", "g1")],
+        )
+        np.testing.assert_allclose(a._bin_sums, b._bin_sums, atol=1e-12)
+        assert a.summary() == b.summary()
+
+    def test_climatology_thresholds_are_priors_only(self):
+        """A pNN threshold resolves from observations STRICTLY BEFORE the
+        scored batch: the first batch (no priors) contributes no Brier
+        samples, the second scores against the first batch's percentile."""
+        sc = _scorer(thresholds=("p50",), clim_samples=8, min_clim=2)
+        m = np.zeros((1, 4))
+        first = [1.0, 2.0, 3.0, 4.0]
+        sc.update_samples(m, first, [1.0] * 4, ["g"] * 4)
+        assert sc.summary()["thresholds"]["p50"]["n"] == 0  # no priors yet
+        second = [10.0, 0.0, 10.0, 0.0]
+        sc.update_samples(m, second, [1.0] * 4, ["g"] * 4)
+        t = sc.summary()["thresholds"]["p50"]
+        assert t["n"] == 4
+        # threshold = median of FIRST batch only (2.5): p=0 members, obs
+        # exceeds twice -> BS = 0.5, base rate 0.5
+        assert t["base_rate"] == pytest.approx(0.5)
+        assert t["brier"] == pytest.approx(0.5)
+
+    def test_worst_gauges_floor_and_order(self):
+        sc = _scorer(min_samples=2, top_k=2)
+        m = np.array([[0.0]])
+        for gauge, err, times in (("a", 5.0, 2), ("b", 1.0, 2), ("c", 9.0, 1)):
+            for _ in range(times):
+                sc.update_samples(m, [err], [1.0], [gauge])
+        worst = sc.worst_gauges()
+        # c is worst but below the sample floor; order is mean-CRPS descending
+        assert [w["gauge"] for w in worst] == ["a", "b"]
+        assert worst[0]["crps"] == pytest.approx(5.0)
+
+    def test_worst_k_exposition_cardinality_under_churn(self):
+        reg = MetricsRegistry()
+        sc = _scorer(registry=reg, min_samples=1, top_k=3)
+        m = np.array([[0.0]])
+        for wave in range(6):
+            gauges = [f"g{wave}_{i}" for i in range(4)]
+            errs = [float(10 + wave + i) for i in range(4)]
+            sc.update_samples(
+                np.tile(m, (1, 4)), errs, [1.0] * 4, gauges
+            )
+        text = render_text(reg)
+        rows = [
+            ln for ln in text.splitlines()
+            if ln.startswith("ddr_verify_worst_crps{")
+        ]
+        assert len(rows) == 3  # capped at top_k; stale gauges removed
+
+    def test_disabled_scorer_is_inert(self):
+        sc = _scorer(enabled=False)
+        assert sc.update_samples(np.zeros((1, 2)), [1.0, 1.0], [1.0, 1.0],
+                                 ["a", "b"]) == 0
+        assert sc.status()["samples"] == 0
+
+
+class TestForecastLedger:
+    def _ledger(self, **kw):
+        kw.setdefault("thresholds", ("1.0",))
+        return ForecastLedger(VerifyConfig(**kw), registry=MetricsRegistry())
+
+    def test_join_scores_reference_crps(self):
+        led = self._ledger()
+        members = np.array(
+            [[[0.0, 1.0]], [[2.0, 3.0]]]  # (E=2, T=1, G=2)
+        )
+        led.record_forecast("net", "m", "r1", 100, [101], ["a", "b"], members)
+        out = led.observe("net", {"a": [(101, 1.0)], "b": [(101, 2.0)]})
+        assert out["matched"] == 2 and out["unmatched"] == 0
+        ref = crps_ensemble(members[:, 0, :], np.array([1.0, 2.0]), fair=True)
+        assert led.scorer.summary()["crps"] == pytest.approx(
+            ref.mean(), abs=1e-6
+        )
+        # lead = valid - issue = 1h -> first bin
+        assert led.scorer.summary()["by_lead"]["0-6h"]["n"] == 2
+
+    def test_duplicate_and_unmatched_accounting(self):
+        led = self._ledger()
+        led.record_forecast(
+            "net", "m", "r1", 0, [1], ["a"], np.zeros((1, 1, 1))
+        )
+        assert led.observe("net", {"a": [(1, 0.5)]})["matched"] == 1
+        again = led.observe("net", {"a": [(1, 0.5)], "b": [(1, 0.5)]})
+        assert again["matched"] == 0
+        assert again["duplicates"] == 1  # recently matched key re-observed
+        assert again["unmatched"] == 1  # nothing ever pending for gauge b
+        assert led.scorer.status()["samples"] == 1  # never rescored
+        st = led.status()
+        assert st["duplicate_obs"] == 1 and st["unmatched_obs"] == 1
+
+    def test_out_of_order_joins(self):
+        """Observations arrive latest-valid-hour first; every pending cell
+        still matches, each at its own lead time."""
+        led = self._ledger()
+        led.record_forecast(
+            "net", "m", "r1", 0, [1, 2, 3], ["a"], np.zeros((2, 3, 1))
+        )
+        assert led.observe("net", {"a": [(3, 0.0)]})["matched"] == 1
+        assert led.observe("net", {"a": [(2, 0.0), (1, 0.0)]})["matched"] == 2
+        assert led.scorer.status()["samples"] == 3
+
+    def test_multiple_forecasts_one_observation(self):
+        """Overlapping issues (a 1-member and a 3-member forecast claiming
+        the same valid hour) both score on the single observation."""
+        led = self._ledger()
+        led.record_forecast("net", "m", "r1", 0, [2], ["a"], np.zeros((1, 1, 1)))
+        led.record_forecast("net", "m", "r2", 1, [2], ["a"], np.ones((3, 1, 1)))
+        out = led.observe("net", {"a": [(2, 0.5)]})
+        assert out["matched"] == 2
+        # E=1 at lead 2h scored MAE 0.5; E=3 at lead 1h
+        assert led.scorer.status()["samples"] == 2
+
+    def test_deterministic_oldest_eviction(self):
+        led = self._ledger(ledger_cap=3)
+        led.record_forecast(
+            "net", "m", "r1", 0, [1, 2, 3, 4, 5], ["a"],
+            np.zeros((1, 5, 1)),
+        )
+        assert led.status()["evicted"] == 2  # hours 1 and 2 dropped
+        assert led.observe("net", {"a": [(1, 0.0), (2, 0.0)]})["unmatched"] == 2
+        assert led.observe("net", {"a": [(3, 0.0)]})["matched"] == 1
+
+    def test_http_list_form_and_validation(self):
+        led = self._ledger()
+        led.record_forecast(
+            "net", "m", "r1", 0, [1, 2], ["a"], np.zeros((1, 2, 1))
+        )
+        out = led.observe(
+            "net", [{"gauge": "a", "times": [1, 2], "values": [0.1, 0.2]}]
+        )
+        assert out["matched"] == 2
+        with pytest.raises(ValueError, match="times"):
+            led.observe("net", [{"gauge": "a", "times": [1], "values": []}])
+
+    def test_two_t_g_member_layout_accepted(self):
+        led = self._ledger()
+        led.record_forecast(  # (T, G) single-forecast shorthand -> (1, T, G)
+            "net", "m", "r1", 0, [1], ["a", "b"], np.array([[0.5, 1.5]])
+        )
+        assert led.observe(
+            "net", {"a": [(1, 0.5)], "b": [(1, 1.5)]}
+        )["matched"] == 2
+        assert led.scorer.summary()["crps"] == pytest.approx(0.0)
+
+    def test_one_bounded_verify_event_per_join(self, tmp_path):
+        rec = Recorder(tmp_path / "log.jsonl")
+        activate(rec)
+        try:
+            led = self._ledger()
+            led.record_forecast(
+                "net", "m", "r1", 0, [1], ["a"], np.zeros((2, 1, 1))
+            )
+            led.observe("net", {"a": [(1, 0.2)]}, source="test")
+            led.observe("net", {"a": [(1, 0.2)]})  # all-duplicate join
+        finally:
+            deactivate(rec)
+            rec.close()
+        events = [
+            json.loads(ln)
+            for ln in (tmp_path / "log.jsonl").read_text().splitlines()
+            if '"verify"' in ln
+        ]
+        events = [e for e in events if e.get("event") == "verify"]
+        assert len(events) == 2  # exactly one per observe() call
+        first = events[0]
+        assert first["matched"] == 1 and first["source"] == "test"
+        assert first["crps"] is not None
+        assert set(first["by_lead"]) <= set(lead_bin_labels(
+            VerifyConfig().lead_bins_h
+        ))
+        assert len(json.dumps(first)) < 4096  # bounded payload
+        assert events[1]["duplicates"] == 1
+
+    def test_status_rollup_shape(self):
+        led = self._ledger()
+        led.record_forecast(
+            "net", "m", "r1", 0, [1, 2], ["a"], np.zeros((1, 2, 1))
+        )
+        st = led.status()
+        assert st["forecasts"] == 1 and st["cells_pending"] == 2
+        assert st["scorer"]["enabled"] is True
+        assert st["scorer"]["samples"] == 0

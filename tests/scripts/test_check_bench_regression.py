@@ -350,6 +350,85 @@ class TestLoadtestRecords:
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+class TestVerifyRecords:
+    """Verification gating: ``ddr verify`` reports pair against the VERIFY_*
+    history by mode — the scores (crps/brier) warn on GROWTH, the matched
+    evidence count on DROP, and the degraded control arm is never flagged."""
+
+    def test_is_verify_record(self):
+        mod = _load()
+        assert mod.is_verify_record({"kind": "verify"})
+        assert not mod.is_verify_record({"kind": "loadtest"})
+        assert not mod.is_verify_record({"value": 100.0})
+
+    def test_score_growth_flags(self):
+        mod = _load()
+        fresh = {"device": "cpu", "crps": 0.30, "brier": 0.055}
+        base = {"device": "cpu", "crps": 0.20, "brier": 0.050}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["crps"]["status"] == "regression"  # +50% > +20%
+        assert by_key["brier"]["status"] == "ok"  # +10% <= +20%
+
+    def test_score_shrink_is_ok(self):
+        mod = _load()
+        (f,) = mod.compare(
+            {"device": "cpu", "crps": 0.10}, {"device": "cpu", "crps": 0.20}
+        )
+        assert f["status"] == "ok"  # sharper is the good direction
+
+    def test_matched_sample_drop_flags(self):
+        mod = _load()
+        fresh = {"device": "cpu", "matched_samples": 60}
+        base = {"device": "cpu", "matched_samples": 128}
+        (f,) = mod.compare(fresh, base, threshold=0.2)
+        assert f["status"] == "regression"  # less evidence, -53%
+
+    def test_control_arm_and_spread_skill_never_flag(self):
+        mod = _load()
+        fresh = {"device": "cpu", "crps_degraded": 9.0, "spread_skill": 2.0}
+        base = {"device": "cpu", "crps_degraded": 1.0, "spread_skill": 1.0}
+        assert mod.compare(fresh, base, threshold=0.2) == []
+
+    def test_latest_verify_baseline_pairs_by_mode(self, tmp_path):
+        mod = _load()
+        old = tmp_path / "VERIFY_syn.json"
+        old.write_text(json.dumps({"kind": "verify", "mode": "synthetic"}))
+        newer = tmp_path / "VERIFY_live.json"
+        newer.write_text(json.dumps({"kind": "verify", "mode": "live"}))
+        os.utime(old, (1_000_000, 1_000_000))
+        os.utime(newer, (2_000_000, 2_000_000))
+        assert mod.latest_verify_baseline(tmp_path, mode="synthetic") == old
+        assert mod.latest_verify_baseline(tmp_path, mode="live") == newer
+        assert mod.latest_verify_baseline(tmp_path) == newer  # plain newest
+        # a fresh record never self-selects as its own baseline
+        assert mod.latest_verify_baseline(
+            tmp_path, mode="live", exclude=newer
+        ) is None
+
+    def test_cli_gates_verify_record(self, tmp_path):
+        rec = {"kind": "verify", "mode": "synthetic", "device": "cpu",
+               "crps": 0.02, "brier": 0.04, "matched_samples": 128}
+        fresh = tmp_path / "VERIFY_fresh.json"
+        fresh.write_text(json.dumps(
+            dict(rec, crps=0.09, matched_samples=40)) + "\n")
+        base = tmp_path / "VERIFY_base.json"
+        base.write_text(json.dumps(rec) + "\n")
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "WARNING" in proc.stderr
+        # self-comparison is always clean
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(fresh),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 class TestLoadRecord:
     def test_unwraps_driver_wrapper(self, tmp_path):
         """The committed BENCH_r*.json form: pretty-printed {n,cmd,rc,tail,

@@ -1,0 +1,47 @@
+"""scripts/check_verify.py: the verification-plane smoke gate must pass on a
+clean tree (so ledger/scorer/HTTP-join bit-rot fails tier-1 fast) and
+actually catch breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_verify.py"
+
+
+def test_repo_verify_gate_clean():
+    """THE CI gate: forecasts ledgered over HTTP, /v1/observe joins + scores
+    them (streaming == offline CRPS, sharp < degraded), the verify event /
+    stats slice / ddr_verify_* series appear, and zero programs compile."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "verification plane holds" in proc.stdout
+    assert "streaming CRPS == offline reference" in proc.stdout
+    assert "zero new jit-cache entries" in proc.stdout
+
+
+def test_gate_fails_on_broken_verification_module(tmp_path):
+    """A tree whose verification module cannot import must fail the gate —
+    copy the script next to a stub package with a broken module."""
+    pkg = tmp_path / "ddr_tpu" / "observability"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_verify.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_verify.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr
