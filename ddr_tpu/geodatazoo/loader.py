@@ -1,11 +1,13 @@
 """Minimal batching loader — the torch ``DataLoader(RandomSampler, collate_fn)``
 replacement (reference /root/reference/scripts/train.py:41-52).
 
-Data prep is host-side NumPy and single-threaded by design (the reference also runs
-``num_workers=0``); the loader's one extra feature is deterministic, checkpointable
-shuffling: the sampling RNG is an explicit ``np.random.Generator`` whose state can be
-saved/restored for mid-epoch resume (reference validation/utils.py:12-78 saves the
-DataLoader generator state for the same reason).
+Data prep is host-side NumPy; sampling stays deterministic and checkpointable:
+the RNG is an explicit ``np.random.Generator`` whose state can be saved/restored
+for mid-epoch resume (reference validation/utils.py:12-78 saves the DataLoader
+generator state for the same reason). :func:`prefetch` is the overlap layer the
+torch reference gets from ``DataLoader(num_workers=...)`` — an ordered
+``ahead``-deep worker pool in front of the training loop that preserves exactly
+that determinism (items are prepared and yielded in iteration order).
 """
 
 from __future__ import annotations
@@ -20,27 +22,37 @@ __all__ = ["DataLoader", "prefetch"]
 def prefetch(
     iterable: Iterable[Any], prepare: Callable[[Any], Any], ahead: int = 1
 ) -> Iterator[Any]:
-    """Map ``prepare`` over ``iterable`` in a background thread, staying up to
-    ``ahead`` prepared items in front of the consumer.
+    """Map ``prepare`` over ``iterable`` in a pool of ``ahead`` background
+    threads, staying up to ``ahead`` prepared items in front of the consumer.
 
     The TPU-idiomatic input pipeline move the torch reference gets from
-    ``DataLoader(num_workers=...)``: while the device executes step t, the host
-    thread builds batch t+1's graph schedules and device uploads
+    ``DataLoader(num_workers=...)``: while the device executes step t, host
+    threads build batches t+1..t+ahead's graph schedules and device uploads
     (``prepare_batch`` is pure host NumPy + ``device_put``, both thread-safe
     and GIL-releasing), so host prep hides behind device time instead of
-    serializing with it. At most ``ahead + 1`` items are prepared/in-flight
-    beyond the one being consumed (``ahead`` waiting + one the worker is
-    filling). Exceptions in ``prepare`` surface at the consuming ``next()``.
+    serializing with it. ``ahead`` sizes BOTH the lookahead window and the
+    worker pool (``experiment.prefetch_ahead``): up to ``ahead + 1`` items are
+    prepared/in-flight beyond the one being consumed, prepared CONCURRENTLY
+    when prep is slower than the device step. Delivery stays ordered and
+    deterministic regardless of worker interleaving — items are yielded in
+    submission order, the source iterable is only ever pulled from the
+    consumer thread, and ``prepare`` receives items in iteration order.
+    Exceptions in ``prepare`` surface at the consuming ``next()`` for the
+    item that failed.
 
     REQUIREMENT on the source iterable: items must not share mutable state
     with one another — the fill loop pulls item k+1 from ``iterable`` while
-    item k is still being prepared/consumed. The geodatazoo datasets satisfy
-    this by handing every batch a ``Dates.snapshot()`` and a fresh
-    RoutingData (see ``BaseGeoDataset.collate_fn``).
+    item k is still being prepared/consumed (and with ``ahead > 1`` several
+    items are prepared simultaneously, so ``prepare`` itself must be
+    reentrant). The geodatazoo datasets satisfy this by handing every batch a
+    ``Dates.snapshot()`` and a fresh RoutingData (see
+    ``BaseGeoDataset.collate_fn``); ``ParallelTrainer.prepare`` is
+    prefetch-thread safe by contract.
     """
     from concurrent.futures import ThreadPoolExecutor
 
-    pool = ThreadPoolExecutor(max_workers=1)
+    ahead = max(1, int(ahead))
+    pool = ThreadPoolExecutor(max_workers=ahead)
     try:
         pending: list = []
         it = iter(iterable)

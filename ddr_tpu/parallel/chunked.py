@@ -198,9 +198,13 @@ def route_chunked_sharded(
     Returns ``(runoff (T, N), final (N,))`` in original order. Differentiable.
 
     ``adjoint`` forwards to each band's
-    :func:`~ddr_tpu.parallel.wavefront.sharded_wavefront_route` — ``"ad"`` only
-    this round (the analytic reverse-wavefront adjoint is single-chip; see that
-    function's docstring for the transfer plan).
+    :func:`~ddr_tpu.parallel.wavefront.sharded_wavefront_route` — ``"ad"``
+    differentiates the wave scans with plain AD, ``"analytic"`` runs each
+    band's sharded reverse-wavefront adjoint (transposed tables + the
+    swapped-role boundary psum). The band loop and the published boundary
+    series stay on outer AD either way, so reverse mode walks bands in
+    reverse order and the series' cotangents flow upstream through each
+    band's ``x_ext``/``s_ext`` adjoints.
     """
     from ddr_tpu.parallel.wavefront import sharded_wavefront_route
     from ddr_tpu.routing.mc import Bounds, ChannelState

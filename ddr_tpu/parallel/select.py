@@ -45,6 +45,7 @@ __all__ = [
     "TopologyStats",
     "resolve_engine_axes",
     "route_parallel",
+    "select_adjoint_tuned",
     "select_engine_tuned",
     "select_for_topology",
     "select_parallel_engine",
@@ -175,6 +176,49 @@ def select_engine_tuned(
     res = tune_engine(
         platform, rows, cols, n, stats.depth, stats.max_in, n_shards,
         topo_sha=cache_key, mesh_desc=mesh_desc, dtype=dtype, kernel=kernel,
+        t_steps=t_steps, hbm_bytes=hbm_bytes,
+    )
+    return res.engine, res.source
+
+
+def select_adjoint_tuned(
+    platform: str,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    n_shards: int,
+    *,
+    cache_key: str | None = None,
+    mesh_desc: dict[str, Any] | None = None,
+    dtype: str = "fp32",
+    t_steps: int | None = None,
+    hbm_bytes: int | None = None,
+) -> tuple[str, str]:
+    """``adjoint="auto"``'s selection entry: ``(adjoint, source)`` via the
+    cost-model planner's grad-analog cards (:func:`ddr_tpu.tuning.planner.tune_adjoint`).
+
+    Mirrors :func:`select_engine_tuned`: ``DDR_AUTOTUNE=off`` short-circuits
+    to the hand prior (``analytic``, the measured single-chip winner) without
+    layering the adjacency; otherwise the topology stats are derived/memoized
+    by ``cache_key`` (the topology sha) and the planner's ladder — memo,
+    persistent cache, grad-card scoring, prior fallback — decides.
+    """
+    from ddr_tpu.tuning.planner import autotune_mode, tune_adjoint
+
+    if autotune_mode() == "off":
+        return "analytic", "policy"
+    if cache_key is None:
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(np.asarray(rows, dtype=np.int64)).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(cols, dtype=np.int64)).tobytes())
+        h.update(str(int(n)).encode())
+        cache_key = h.hexdigest()
+    stats = topology_stats(rows, cols, n, cache_key=cache_key)
+    res = tune_adjoint(
+        platform, rows, cols, n, stats.depth, stats.max_in, n_shards,
+        topo_sha=cache_key, mesh_desc=mesh_desc, dtype=dtype,
         t_steps=t_steps, hbm_bytes=hbm_bytes,
     )
     return res.engine, res.source

@@ -23,13 +23,32 @@ wavefront:
   band's columns (the :func:`ddr_tpu.routing.chunked.boundary_ext_series`
   contract, sentinel-safe).
 
-Differentiable end to end; semantics match :func:`ddr_tpu.routing.mc.route`
+Differentiable end to end, two ways (``adjoint``):
+
+* ``"ad"`` — standard JAX AD through the band scan and each band's wave scan;
+* ``"analytic"`` — each band step runs the analytic reverse-wavefront band
+  adjoint (the sharded instance of :func:`ddr_tpu.routing.stacked._band_analytic`,
+  fused with :mod:`ddr_tpu.parallel.wavefront`'s reversed boundary psum): the
+  frame carries SHARDED transposed successor tables (``StackedSharded.t_idx``),
+  the reverse sweep re-uses the ``hb_out``/``hb_tgt``/``hb_gap`` tables with the
+  publisher/consumer roles SWAPPED — the ``hb_tgt`` owner publishes the
+  weight-premultiplied adjoint pair ``(c1_eff * lam, c2 * lam)`` and the
+  ``hb_out`` owner consumes it ``gap`` waves later, so the adjoint boundary
+  history re-psums toward LOWER shards (one psum of width 2 * B_cap per wave).
+  The band scan, its boundary-buffer carry, and the publish psum stay on plain
+  AD: reverse mode walks the bands in reverse order and the published series'
+  cotangents flow upstream through ``x_ext``/``s_ext``, exactly like the
+  single-chip stacked router. ``remat_bands`` composes (the ``custom_vjp``
+  sits inside the checkpointed band step).
+
+Semantics match :func:`ddr_tpu.routing.mc.route`
 (reference loop: /root/reference/src/ddr/routing/mmc.py:365-443).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -42,7 +61,14 @@ from ddr_tpu.parallel.sharding import shard_map_compat
 
 from ddr_tpu.routing.chunked import boundary_buffer_columns
 from ddr_tpu.routing.network import compute_levels
-from ddr_tpu.routing.stacked import auto_band_count, pack_level_bands_balanced
+from ddr_tpu.routing.stacked import (
+    _frame_input_skews,
+    _physics_frame,
+    _reduce_buckets_frame,
+    _skew_cols,
+    auto_band_count,
+    pack_level_bands_balanced,
+)
 
 __all__ = ["StackedSharded", "build_stacked_sharded", "route_stacked_sharded"]
 
@@ -65,7 +91,16 @@ _EAGER_REMAT_WARNED = False
 class StackedSharded:
     """Band-and-shard-uniform stacked frame. Sharded arrays lead with S; band
     arrays lead with C. Sentinels: local slots use ``n_cap_s``, boundary-buffer
-    columns use ``n_boundary``, gather slots use the ring's zero sentinel."""
+    columns use ``n_boundary``, gather slots use the ring's zero sentinel.
+
+    ``t_idx (S, C, n_cap_s * t_width)`` is the analytic band adjoint's
+    transposed (successor) table: per local SOURCE slot, its same-shard
+    in-band successors in the flat adjoint-ring encoding
+    ``(gap - 1) * (n_cap_s + 1) + tgt_slot``; pad slots hold ``n_cap_s`` (the
+    ring's always-zero sentinel column, so no mask is needed). Cross-shard
+    intra-band successors ride the reversed boundary psum instead (the
+    ``hb_out``/``hb_tgt`` role swap). ``t_width = 0`` marks a layout built
+    before the analytic adjoint landed (``adjoint="analytic"`` then raises)."""
 
     gidx: jnp.ndarray  # (S, C, n_cap_s) original id, sentinel n
     level: jnp.ndarray  # (S, C, n_cap_s) band-local level, 0 on sentinels
@@ -88,6 +123,8 @@ class StackedSharded:
     n_boundary: int = dataclasses.field(metadata={"static": True})
     n_bands: int = dataclasses.field(metadata={"static": True})
     n_shards: int = dataclasses.field(metadata={"static": True})
+    t_idx: jnp.ndarray | None = None
+    t_width: int = dataclasses.field(default=0, metadata={"static": True})
 
 
 def build_stacked_sharded(
@@ -189,6 +226,23 @@ def build_stacked_sharded(
         wf_col[shard[t_node], band[t_node], base + seq] = slot[g_cols[es]]
         wf_mask[shard[t_node], band[t_node], base + seq] = 1.0
 
+    # transposed (successor) table: the analytic band adjoint's reverse-wave
+    # gather, flat (gap - 1, col) ring encoding per same-shard source slot;
+    # cross-shard successors ride the reversed hist psum (hb_* role swap)
+    odeg = np.zeros(n, dtype=np.int64)
+    np.add.at(odeg, g_cols, 1)
+    t_width = max(1, int(odeg.max()) if g_cols.size else 1)
+    t_idx = np.full((S, C, n_cap_s * t_width), n_cap_s, dtype=np.int64)
+    if g_cols.size:
+        skey = grp[g_cols] * np.int64(n_cap_s) + slot[g_cols]
+        ss = np.argsort(skey, kind="stable")
+        sk = skey[ss]
+        sseq = np.arange(len(sk)) - np.searchsorted(sk, sk)
+        s_node, t_succ = g_cols[ss], g_rows[ss]
+        t_idx[shard[s_node], band[s_node], slot[s_node] * t_width + sseq] = (
+            (level[t_succ] - level[s_node] - 1) * np.int64(row_len) + slot[t_succ]
+        )
+
     # intra-band cross-shard (hist) tables
     hb_cnt = np.bincount(band[h_rows], minlength=C) if h_rows.size else np.zeros(C, int)
     B_cap = max(1, int(hb_cnt.max()) if C else 1)
@@ -253,7 +307,310 @@ def build_stacked_sharded(
         n_boundary=int(B_total),
         n_bands=C,
         n_shards=S,
+        t_idx=jnp.asarray(t_idx, jnp.int32),
+        t_width=int(t_width),
     )
+
+
+def _sband_wave_scan(physics, lvl, wfr, wfc, wfm, hbo, hbt, hbg,
+                     qs_sk, xe_sk, se_sk, qi_c, *,
+                     T, n_cap, span, lb, buckets, B_cap, has_init, dtype,
+                     axis_name):
+    """One band's forward wave scan on one shard (shared by the AD path and
+    the analytic-adjoint primal): the stacked analog of
+    :func:`ddr_tpu.parallel.wavefront._shard_wave_scan` — the frame's bucket
+    reduce for local edges plus one boundary psum per wave for intra-band
+    cross-shard edges. Returns the raw per-wave values ``ys (W, n_cap)``."""
+    row_len = n_cap + 1
+    ring_rows = span + 2
+    hist_rows = span + 1
+    n_waves = T + span
+    ar_b = jnp.arange(B_cap)
+    ring0 = jnp.zeros(ring_rows * row_len, dtype)
+    hist0 = jnp.zeros(hist_rows * B_cap, dtype)
+    s0 = jnp.zeros(n_cap, dtype)
+
+    def body(carry, wave_inputs):
+        ring, hist, s_state = carry
+        q_row, xe_row, se_row, w = wave_inputs
+        t_node = w - 1 - lvl
+        h1 = jax.lax.rem(w - 1, ring_rows)
+        q_prev = jnp.maximum(
+            jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+        )
+        c1, c2, c3, c4 = physics(q_prev)
+        rot = h1 - wfr
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        gathered = ring[rot * row_len + wfc]
+        x_local = _reduce_buckets_frame(gathered, wfm, buckets, n_cap, lb, False) + xe_row
+        s_local = _reduce_buckets_frame(gathered, wfm, buckets, n_cap, lb, True)
+
+        hb1 = jax.lax.rem(w - 1, hist_rows)
+        hrot = hb1 - (hbg - 1)
+        hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
+        x_b = hist[hrot * B_cap + ar_b]
+        own_t = hbt < n_cap
+        x_bnd = (
+            jnp.zeros(row_len, dtype)
+            .at[hbt].add(jnp.where(own_t, x_b, 0.0))[:n_cap]
+        )
+        s_bnd = (
+            jnp.zeros(row_len, dtype)
+            .at[hbt].add(jnp.where(own_t, jnp.maximum(x_b, lb), 0.0))[:n_cap]
+        )
+        x_pred = x_local + x_bnd
+
+        b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
+        is_hot = t_node == 0
+        b = jnp.where(is_hot, q_row, b_step)
+        c1_eff = jnp.where(is_hot, 1.0, c1)
+        y = b + c1_eff * x_pred
+        if has_init:
+            y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
+        ok = (t_node >= 0) & (t_node <= T - 1)
+        y = jnp.where(ok, y, 0.0)
+
+        v_out = jnp.where(
+            hbo < n_cap, jnp.concatenate([y, jnp.zeros(1, y.dtype)])[hbo], 0.0
+        )
+        hist = jax.lax.dynamic_update_slice(
+            hist, jax.lax.psum(v_out, axis_name),
+            (jax.lax.rem(w, hist_rows) * B_cap,),
+        )
+        ring = jax.lax.dynamic_update_slice(
+            ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]),
+            (jax.lax.rem(w, ring_rows) * row_len,),
+        )
+        return (ring, hist, s_local + s_bnd), y
+
+    waves = jnp.arange(1, n_waves + 1)
+    (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), (qs_sk, xe_sk, se_sk, waves))
+    return ys
+
+
+# ---------------------------------------------------------------------------
+# Analytic reverse-wavefront adjoint of one SHARDED band step — the band-frame
+# instance of ddr_tpu.parallel.wavefront._sharded_analytic (which documents
+# the two-ring premultiplied scheme) fused with the stacked frame's bucket
+# reduces: reverse time tau = T-1-t, reverse level M(i) = span - lvl(i),
+# transposed per-shard successor tables (StackedSharded.t_idx), TWO adjoint
+# rings (z = c1_eff*lam, u = c2*lam) and one reversed boundary psum of width
+# 2*B_cap per wave over the swapped hb_tgt -> hb_out roles. Residual = raw
+# band values + ONE psum'd (T, B_cap) boundary series. The band scan's
+# boundary-buffer carry stays on plain AD, so reverse mode walks bands in
+# reverse order and the published series' cotangents flow upstream through
+# x_ext/s_ext — exactly like routing.stacked._band_analytic.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _sharded_band_analytic(static, lvl, wfr, wfc, wfm, t_ix, hbo, hbt, hbg,
+                           ln, sl, xs_, twd, ssd, nm, qsp, psp,
+                           qp_c, qi_c, x_ext, s_ext):
+    """One band step's wave scan with the analytic adjoint (runs INSIDE the
+    shard_map body; psums bind the mesh axis). Returns the RAW (T, n_cap)
+    solve values — the clamp and the publish psum stay outside on standard AD
+    so the subgradients match the AD path exactly."""
+    return _sharded_band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_ix,
+                                      hbo, hbt, hbg, ln, sl, xs_, twd, ssd,
+                                      nm, qsp, psp, qp_c, qi_c, x_ext, s_ext)[0]
+
+
+def _sharded_band_analytic_fwd(static, lvl, wfr, wfc, wfm, t_ix, hbo, hbt, hbg,
+                               ln, sl, xs_, twd, ssd, nm, qsp, psp,
+                               qp_c, qi_c, x_ext, s_ext):
+    (T, n_cap, span, lb, bounds, dt, buckets, t_width, B_cap, has_init,
+     axis_name) = static
+    qs_sk, xe_sk, se_sk = _frame_input_skews(
+        qp_c, x_ext, s_ext, lvl, T=T, n_cap=n_cap, span=span
+    )
+    phys_args = (ln, sl, xs_, twd, ssd, nm, qsp, psp)
+
+    def physics(q_prev):
+        return _physics_frame(q_prev, *phys_args, bounds, dt)
+
+    ys = _sband_wave_scan(
+        physics, lvl, wfr, wfc, wfm, hbo, hbt, hbg, qs_sk, xe_sk, se_sk, qi_c,
+        T=T, n_cap=n_cap, span=span, lb=lb, buckets=buckets, B_cap=B_cap,
+        has_init=has_init, dtype=qp_c.dtype, axis_name=axis_name,
+    )
+    raw = _skew_cols(ys, lvl, T)
+    # The backward's only cross-shard residual: every hist edge's RAW source
+    # series, replicated by one psum (each slot owned by one shard).
+    raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), raw.dtype)], axis=1)
+    hb_series = jax.lax.psum(
+        jnp.where(hbo[None, :] < n_cap, raw_pad[:, hbo], 0.0), axis_name
+    )  # (T, B_cap)
+    res = (raw, hb_series, qp_c, qi_c, x_ext, s_ext,
+           lvl, wfr, wfc, wfm, t_ix, hbo, hbt, hbg, phys_args)
+    return raw, res
+
+
+def _sharded_band_analytic_bwd(static, res, raw_bar):
+    from ddr_tpu.routing.wavefront import _dmax
+
+    (T, n_cap, span, lb, bounds, dt, buckets, t_width, B_cap, has_init,
+     axis_name) = static
+    (raw, hb_series, qp_c, qi_c, x_ext, s_ext,
+     lvl, wfr, wfc, wfm, t_ix, hbo, hbt, hbg, phys_args) = res
+    row_len = n_cap + 1
+    ring_rows = span + 2
+    hist_rows = span + 1
+    n_waves = T + span
+    dtype = raw.dtype
+    M = span - lvl
+    ar_b = jnp.arange(B_cap)
+
+    # --- everything t-separable hoisted out of the reverse scan (the
+    # routing.stacked._band_analytic_bwd move): operands re-gathered from
+    # ``raw`` + ``hb_series`` as big (T, n_cap) vectorized passes. ---
+    raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), dtype)], axis=1)
+    nx = _reduce_buckets_frame(raw_pad[:, wfc], wfm, buckets, n_cap, lb, False)
+    prev_pad = jnp.concatenate([jnp.zeros((1, row_len), dtype), raw_pad[:-1]], axis=0)
+    s_loc = _reduce_buckets_frame(prev_pad[:, wfc], wfm, buckets, n_cap, lb, True)
+
+    # Boundary operands re-scattered from the replicated series (clamp
+    # per-edge BEFORE the scatter, matching the forward's s_bnd).
+    own_tgt = hbt < n_cap
+    own_src = hbo < n_cap
+    x_bnd = (
+        jnp.zeros((T, row_len), dtype)
+        .at[:, hbt].add(jnp.where(own_tgt, hb_series, 0.0))[:, :n_cap]
+    )
+    prev_b = jnp.concatenate([jnp.zeros((1, B_cap), dtype), hb_series[:-1]], axis=0)
+    s_bnd = (
+        jnp.zeros((T, row_len), dtype)
+        .at[:, hbt].add(jnp.where(own_tgt, jnp.maximum(prev_b, lb), 0.0))[:, :n_cap]
+    )
+    xpx = nx + x_bnd + x_ext
+    s_full = s_loc + s_bnd + s_ext
+
+    q_prev_all = jnp.maximum(prev_pad[:, :n_cap], lb)
+    qpm1_all = jnp.concatenate([jnp.zeros((1, n_cap), dtype), qp_c[:-1]], axis=0)
+    qpm1c = jnp.maximum(qpm1_all, lb)
+
+    def phys_batch(q, args):
+        return _physics_frame(q, *args, bounds, dt)
+
+    # ONE nonlinear trace serves the whole backward: the linearized physics
+    # yields the primal c's, the tangent d's (one linear eval), and — via its
+    # transpose, evaluated after the reverse scan — the theta pullback.
+    (c1_a, c2_a, c3_a, c4_a), phys_lin = jax.linearize(
+        phys_batch, q_prev_all, phys_args
+    )
+    zero_args = jax.tree_util.tree_map(jnp.zeros_like, phys_args)
+    d1, d2, d3, d4 = phys_lin(jnp.ones_like(q_prev_all), zero_args)
+
+    # The five per-node streams of parallel.wavefront._sharded_analytic_bwd
+    # (zc / uc / ow / dm semantics documented there); dm stays its OWN stream
+    # because boundary u values arrive premultiplied WITHOUT the consumer's dm.
+    zero_row = jnp.zeros((1, n_cap), dtype)
+    hot_row = zero_row if has_init else jnp.ones((1, n_cap), dtype)
+    zc = jnp.concatenate([hot_row, c1_a[1:]], axis=0)
+    uc = jnp.concatenate([zero_row, c2_a[1:]], axis=0)
+    own_coef = d1 * xpx + d2 * s_full + d3 * q_prev_all + d4 * qpm1c + c3_a
+    dm_all = _dmax(prev_pad[:, :n_cap], lb).at[0].set(0.0)
+    ow = dm_all * own_coef
+
+    # ONE stacked reverse stream over [gbar | ow | zc | uc | dm], built
+    # transposed from the start (the routing.stacked._band_analytic_bwd trick).
+    width_all = 5 * n_cap
+    starts_all = jnp.tile(lvl, 5)
+    core = jnp.concatenate([raw_bar, ow, zc, uc, dm_all], axis=1)
+    padded_t = jnp.zeros((width_all, 2 * span + T + 1), dtype)
+    padded_t = jax.lax.dynamic_update_slice(padded_t, core[::-1].T, (0, span))
+    stacked_s = jax.vmap(
+        lambda row, s0: jax.lax.dynamic_slice(row, (s0,), (n_waves,))
+    )(padded_t, starts_all).T  # (W, 5*n_cap)
+
+    t_row = t_ix // row_len  # gap - 1 per successor slot
+    t_col = t_ix - t_row * row_len
+
+    ring_z0 = jnp.zeros(ring_rows * row_len, dtype)
+    ring_u0 = jnp.zeros(ring_rows * row_len, dtype)
+    hist0 = jnp.zeros(hist_rows * 2 * B_cap, dtype)
+    gx0 = jnp.zeros(n_cap, dtype)
+
+    def body(carry, wave_inputs):
+        ring_z, ring_u, hist, gx = carry
+        rows, w = wave_inputs
+        gbar_row = rows[:n_cap]
+        ow_row = rows[n_cap : 2 * n_cap]
+        zc_row = rows[2 * n_cap : 3 * n_cap]
+        uc_row = rows[3 * n_cap : 4 * n_cap]
+        dm_row = rows[4 * n_cap :]
+
+        # Local transposed gathers: successors' premultiplied (z, u), emitted
+        # gap waves earlier (pad slots read the always-zero sentinel column).
+        h1 = jax.lax.rem(w - 1, ring_rows)
+        rot = h1 - t_row
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        flat = rot * row_len + t_col
+        zsum = ring_z[flat].reshape(n_cap, t_width).sum(axis=1)
+        usum = ring_u[flat].reshape(n_cap, t_width).sum(axis=1)
+
+        # Reversed boundary exchange: forward hist timing verbatim, roles
+        # swapped — the hb_tgt owner publishes, the hb_out owner consumes.
+        hb1 = jax.lax.rem(w - 1, hist_rows)
+        hrot = hb1 - (hbg - 1)
+        hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
+        hz = hist[hrot * (2 * B_cap) + ar_b]
+        hu = hist[hrot * (2 * B_cap) + B_cap + ar_b]
+        hz_s = (
+            jnp.zeros(row_len, dtype).at[hbo].add(jnp.where(own_src, hz, 0.0))[:n_cap]
+        )
+        hu_s = (
+            jnp.zeros(row_len, dtype).at[hbo].add(jnp.where(own_src, hu, 0.0))[:n_cap]
+        )
+
+        lam = gbar_row + gx + zsum + hz_s  # transposed same-timestep solve
+        z = zc_row * lam
+        u = uc_row * lam
+        gx_next = ow_row * lam + dm_row * (usum + hu_s)
+
+        z_pad = jnp.concatenate([z, jnp.zeros(1, dtype)])
+        u_pad = jnp.concatenate([u, jnp.zeros(1, dtype)])
+        pz = jnp.where(own_tgt, z_pad[hbt], 0.0)
+        pu = jnp.where(own_tgt, u_pad[hbt], 0.0)
+        hist = jax.lax.dynamic_update_slice(
+            hist,
+            jax.lax.psum(jnp.concatenate([pz, pu]), axis_name),
+            (jax.lax.rem(w, hist_rows) * (2 * B_cap),),
+        )
+        h = jax.lax.rem(w, ring_rows)
+        ring_z = jax.lax.dynamic_update_slice(ring_z, z_pad, (h * row_len,))
+        ring_u = jax.lax.dynamic_update_slice(ring_u, u_pad, (h * row_len,))
+        return (ring_z, ring_u, hist, gx_next), lam
+
+    waves = jnp.arange(1, n_waves + 1)
+    (_, _, _, _), lams = jax.lax.scan(
+        body, (ring_z0, ring_u0, hist0, gx0), (stacked_s, waves)
+    )
+
+    # --- vectorized adjoint outputs from the un-skewed lam field ---
+    lam_all = _skew_cols(lams, M, T)[::-1]  # (T, n_cap), raw incl. t = 0
+    lam_th = lam_all.at[0].set(0.0)  # no physics on the hotstart diagonal
+    pull = jax.linear_transpose(phys_lin, q_prev_all, phys_args)
+    _, theta_bar = pull(
+        (lam_th * xpx, lam_th * s_full, lam_th * q_prev_all, lam_th * qpm1c)
+    )
+
+    z_un = zc * lam_all  # x_ext adjoint; row 0 = hotstart q'_0 term
+    qp_coef = jnp.concatenate([zero_row, (c4_a * _dmax(qpm1_all, lb))[1:]], axis=0)
+    qp_bar = jnp.concatenate([(qp_coef * lam_all)[1:], zero_row], axis=0)
+    qp_bar = qp_bar.at[0].add(z_un[0])
+    s_ext_bar = uc * lam_all
+    q_init_bar = _dmax(qi_c, lb) * lam_all[0] if has_init else jnp.zeros_like(qi_c)
+
+    f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)  # noqa: E731
+    (ln_b, sl_b, xs_b, twd_b, ssd_b, nm_b, qsp_b, psp_b) = theta_bar
+    return (f0(lvl), f0(wfr), f0(wfc), jnp.zeros_like(wfm), f0(t_ix),
+            f0(hbo), f0(hbt), f0(hbg),
+            ln_b, sl_b, xs_b, twd_b, ssd_b, nm_b, qsp_b, psp_b,
+            qp_bar, q_init_bar, z_un, s_ext_bar)
+
+
+_sharded_band_analytic.defvjp(_sharded_band_analytic_fwd, _sharded_band_analytic_bwd)
 
 
 def route_stacked_sharded(
@@ -274,43 +631,47 @@ def route_stacked_sharded(
     scanned band program. Returns ``(runoff (T, N), final (N,))`` in original
     order. Differentiable end to end.
 
-    ``adjoint``: ``"ad"`` only this round — the single-chip stacked router's
-    analytic band adjoint (:func:`ddr_tpu.routing.stacked._band_analytic`)
-    transfers once the frame carries SHARDED transposed tables and the
-    reverse sweep re-psums the adjoint boundary history toward lower shards;
-    ``"analytic"`` raises ``NotImplementedError`` naming that plan instead of
-    silently measuring the wrong backward.
+    ``adjoint`` selects the backward pass: ``"ad"`` differentiates the band
+    scan with standard JAX AD; ``"analytic"`` runs each band step through the
+    analytic reverse-wavefront band adjoint (module docstring) — same
+    gradients to float associativity, clamp subgradients included, at a
+    fraction of the backward cost. Needs a layout built by this version
+    (``t_width > 0``); stale layouts raise. The analytic path ignores
+    ``remat_physics`` (its backward never differentiates the wave scan).
 
     ``remat_bands`` checkpoints each whole band step (wave scan + boundary
     psum) exactly like the single-chip stacked router: the backward replays a
     band's forward — collectives included — instead of streaming per-wave
-    residuals. Same trade, same default-off; the chip capture plan decides."""
-    from ddr_tpu.routing.mc import Bounds, ChannelState, celerity, muskingum_coefficients
+    residuals. Composes with both adjoints (the analytic ``custom_vjp`` sits
+    inside the checkpointed step). Same trade, same default-off; the chip
+    capture plan decides."""
+    from ddr_tpu.routing.mc import Bounds
 
-    if adjoint != "ad":
-        if adjoint == "analytic":
-            raise NotImplementedError(
-                "the sharded stacked router differentiates by AD this round; "
-                "the analytic band adjoint needs sharded transposed tables + "
-                "the reversed boundary psum — pass adjoint='ad' here, or use "
-                "the single-chip stacked router for analytic"
-            )
-        raise ValueError(f"unknown adjoint {adjoint!r} (use 'ad')")
+    if adjoint not in ("ad", "analytic"):
+        raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic' or 'ad')")
+    if adjoint == "analytic" and layout.t_width <= 0:
+        raise ValueError(
+            "adjoint='analytic' needs the layout's transposed successor "
+            "tables (t_idx); rebuild it with build_stacked_sharded from "
+            "this version or pass adjoint='ad'"
+        )
     if bounds is None:
         bounds = Bounds()
     T = q_prime.shape[0]
-    lb = bounds.discharge
+    lb = float(bounds.discharge)
     S, C = layout.n_shards, layout.n_bands
     n_cap = layout.n_cap_s
     span = layout.span_max
     row_len = n_cap + 1
-    ring_rows = span + 2
-    hist_rows = span + 1
-    n_waves = T + span
     B = layout.n_boundary
     B_cap = layout.hb_gap.shape[1]
     buckets = layout.buckets
     has_init = q_init is not None
+    t_idx_in = layout.t_idx
+    if t_idx_in is None:  # stale layout, AD path: constant in_specs need an array
+        t_idx_in = jnp.zeros((S, C, 1), jnp.int32)
+    static = (T, n_cap, span, lb, bounds, float(dt), buckets,
+              layout.t_width, B_cap, has_init, axis_name)
 
     g = layout.gidx  # (S, C, n_cap)
     pad0 = lambda a: jnp.concatenate([a, jnp.zeros(1, a.dtype)])  # noqa: E731
@@ -332,46 +693,20 @@ def route_stacked_sharded(
         pad0(q_init)[g] if has_init else jnp.zeros((S, C, n_cap), q_prime.dtype)
     )
 
-    def reduce_buckets(gathered, mask_row, clamped):
-        parts = []
-        off = 0
-        for node_start, node_end, width in buckets:
-            cnt_nodes = node_end - node_start
-            if width == 0:
-                parts.append(jnp.zeros(cnt_nodes, gathered.dtype))
-                continue
-            cnt = cnt_nodes * width
-            blk = gathered[off : off + cnt].reshape(cnt_nodes, width)
-            msk = mask_row[off : off + cnt].reshape(blk.shape)
-            if clamped:
-                blk = jnp.maximum(blk, lb)
-            parts.append((blk * msk).sum(axis=1))
-            off += cnt
-        return jnp.concatenate(parts) if parts else jnp.zeros(n_cap, gathered.dtype)
-
-    def _skew_cols(src, starts, width):
-        sl = jax.vmap(lambda col, s0: jax.lax.dynamic_slice(col, (s0,), (width,)))(
-            src.T, starts
-        )
-        return sl.T
-
-    def shard_fn(lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, hbg_r, exc_r, ext_a,
-                 pbs_a, pbc_r, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a,
-                 qp_a, qi_a):
+    def shard_fn(lvl_a, wfr_a, wfc_a, wfm_a, tix_a, hbo_a, hbt_a, hbg_r, exc_r,
+                 ext_a, pbs_a, pbc_r, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a,
+                 qsp_a, psp_a, qp_a, qi_a):
         # drop the leading per-shard axis shard_map leaves on sharded operands
-        (lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, ext_a, pbs_a, ln_a, sl_a, xs_a,
-         twd_a, ssd_a, nm_a, qsp_a, psp_a, qp_a, qi_a) = (
-            x[0] for x in (lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, ext_a, pbs_a,
-                           ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a,
-                           qp_a, qi_a)
+        (lvl_a, wfr_a, wfc_a, wfm_a, tix_a, hbo_a, hbt_a, ext_a, pbs_a, ln_a,
+         sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a, qp_a, qi_a) = (
+            x[0] for x in (lvl_a, wfr_a, wfc_a, wfm_a, tix_a, hbo_a, hbt_a,
+                           ext_a, pbs_a, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a,
+                           qsp_a, psp_a, qp_a, qi_a)
         )
-        ar_b = jnp.arange(B_cap)
 
         def band_step(bnd, band_in):
-            (lvl, wfr, wfc, wfm, hbo, hbt, hbg, exc, ext, pbs, pbc,
+            (lvl, wfr, wfc, wfm, tix, hbo, hbt, hbg, exc, ext, pbs, pbc,
              ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c) = band_in
-            ch = ChannelState(length=ln, slope=sl, x_storage=xs_,
-                              top_width_data=twd, side_slope_data=ssd)
 
             gath = bnd[:, exc]  # (T, X_cap)
             x_ext = jnp.zeros((T, row_len), bnd.dtype).at[:, ext].add(gath)[:, :n_cap]
@@ -381,88 +716,32 @@ def route_stacked_sharded(
                 .at[:, ext].add(jnp.maximum(prev[:, exc], lb))[:, :n_cap]
             )
 
-            right_edge = qp_c[T - 2 : T - 1] if T >= 2 else qp_c[:1]
-            padded = jnp.concatenate(
-                [
-                    jnp.broadcast_to(qp_c[0], (span + 1, n_cap)),
-                    qp_c[: T - 1],
-                    jnp.broadcast_to(right_edge[0], (span, n_cap)),
-                ],
-                axis=0,
-            )
-            qs_sk = _skew_cols(padded, span - lvl, n_waves)
-            zpad = jnp.zeros((span, n_cap), bnd.dtype)
-            xe_sk = _skew_cols(jnp.concatenate([zpad, x_ext, zpad], 0), span - lvl, n_waves)
-            se_sk = _skew_cols(jnp.concatenate([zpad, s_ext, zpad], 0), span - lvl, n_waves)
-
-            def physics(q_prev):
-                c = celerity(q_prev, nm, psp, qsp, ch, bounds)[0]
-                return muskingum_coefficients(ch.length, c, ch.x_storage, dt)
-
-            if remat_physics:
-                physics = jax.checkpoint(physics)
-
-            ring0 = jnp.zeros(ring_rows * row_len, qp_c.dtype)
-            hist0 = jnp.zeros(hist_rows * B_cap, qp_c.dtype)
-            s0 = jnp.zeros(n_cap, qp_c.dtype)
-
-            def body(carry, wave_inputs):
-                ring, hist, s_state = carry
-                q_row, xe_row, se_row, w = wave_inputs
-                t_node = w - 1 - lvl
-                h1 = jax.lax.rem(w - 1, ring_rows)
-                q_prev = jnp.maximum(
-                    jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:n_cap], lb
+            if adjoint == "analytic":
+                raw = _sharded_band_analytic(
+                    static, lvl, wfr, wfc, wfm, tix, hbo, hbt, hbg,
+                    ln, sl, xs_, twd, ssd, nm, qsp, psp, qp_c, qi_c,
+                    x_ext, s_ext,
                 )
-                c1, c2, c3, c4 = physics(q_prev)
-                rot = h1 - wfr
-                rot = jnp.where(rot < 0, rot + ring_rows, rot)
-                gathered = ring[rot * row_len + wfc]
-                x_local = reduce_buckets(gathered, wfm, clamped=False) + xe_row
-                s_local = reduce_buckets(gathered, wfm, clamped=True)
-
-                hb1 = jax.lax.rem(w - 1, hist_rows)
-                hrot = hb1 - (hbg - 1)
-                hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
-                x_b = hist[hrot * B_cap + ar_b]
-                own_t = hbt < n_cap
-                x_bnd = (
-                    jnp.zeros(row_len, qp_c.dtype)
-                    .at[hbt].add(jnp.where(own_t, x_b, 0.0))[:n_cap]
+            else:
+                qs_sk, xe_sk, se_sk = _frame_input_skews(
+                    qp_c, x_ext, s_ext, lvl, T=T, n_cap=n_cap, span=span
                 )
-                s_bnd = (
-                    jnp.zeros(row_len, qp_c.dtype)
-                    .at[hbt].add(jnp.where(own_t, jnp.maximum(x_b, lb), 0.0))[:n_cap]
-                )
-                x_pred = x_local + x_bnd
 
-                b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
-                is_hot = t_node == 0
-                b = jnp.where(is_hot, q_row, b_step)
-                c1_eff = jnp.where(is_hot, 1.0, c1)
-                y = b + c1_eff * x_pred
-                if has_init:
-                    y = jnp.where(is_hot, jnp.maximum(qi_c, lb), y)
-                ok = (t_node >= 0) & (t_node <= T - 1)
-                y = jnp.where(ok, y, 0.0)
+                def physics(q_prev):
+                    return _physics_frame(q_prev, ln, sl, xs_, twd, ssd, nm,
+                                          qsp, psp, bounds, dt)
 
-                v_out = jnp.where(
-                    hbo < n_cap, jnp.concatenate([y, jnp.zeros(1, y.dtype)])[hbo], 0.0
+                if remat_physics:
+                    physics = jax.checkpoint(physics)
+                ys = _sband_wave_scan(
+                    physics, lvl, wfr, wfc, wfm, hbo, hbt, hbg,
+                    qs_sk, xe_sk, se_sk, qi_c,
+                    T=T, n_cap=n_cap, span=span, lb=lb, buckets=buckets,
+                    B_cap=B_cap, has_init=has_init, dtype=qp_c.dtype,
+                    axis_name=axis_name,
                 )
-                hist = jax.lax.dynamic_update_slice(
-                    hist, jax.lax.psum(v_out, axis_name),
-                    (jax.lax.rem(w, hist_rows) * B_cap,),
-                )
-                ring = jax.lax.dynamic_update_slice(
-                    ring, jnp.concatenate([y, jnp.zeros(1, y.dtype)]),
-                    (jax.lax.rem(w, ring_rows) * row_len,),
-                )
-                return (ring, hist, s_local + s_bnd), y
+                raw = _skew_cols(ys, lvl, T)  # (T, n_cap)
 
-            waves = jnp.arange(1, n_waves + 1)
-            (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), (qs_sk, xe_sk, se_sk, waves))
-
-            raw = _skew_cols(ys, lvl, T)  # (T, n_cap)
             raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), raw.dtype)], axis=1)
             pub_local = jnp.where(pbs[None, :] < n_cap, raw_pad[:, pbs], 0.0)
             pub_full = jax.lax.psum(pub_local, axis_name)  # (T, P_cap), replicated
@@ -470,9 +749,9 @@ def route_stacked_sharded(
             return bnd, raw
 
         band_xs = (
-            lvl_a, wfr_a, wfc_a, wfm_a, hbo_a, hbt_a, hbg_r, exc_r, ext_a,
-            pbs_a, pbc_r, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a, psp_a,
-            qp_a, qi_a,
+            lvl_a, wfr_a, wfc_a, wfm_a, tix_a, hbo_a, hbt_a, hbg_r, exc_r,
+            ext_a, pbs_a, pbc_r, ln_a, sl_a, xs_a, twd_a, ssd_a, nm_a, qsp_a,
+            psp_a, qp_a, qi_a,
         )
         bnd0 = jnp.zeros((T, B + 1), q_prime.dtype)
         step_fn = jax.checkpoint(band_step) if remat_bands else band_step
@@ -485,7 +764,7 @@ def route_stacked_sharded(
         shard_fn,
         mesh=mesh,
         in_specs=(
-            shard, shard, shard, shard, shard, shard, rep, rep, shard,
+            shard, shard, shard, shard, shard, shard, shard, rep, rep, shard,
             shard, rep, shard, shard, shard, shard, shard, shard, shard, shard,
             shard, shard,
         ),
@@ -516,7 +795,7 @@ def route_stacked_sharded(
             except TypeError:  # pragma: no cover - non-weakrefable layout type
                 pass
     raw_all = fn(
-        layout.level, layout.wf_row, layout.wf_col, layout.wf_mask,
+        layout.level, layout.wf_row, layout.wf_col, layout.wf_mask, t_idx_in,
         layout.hb_out, layout.hb_tgt, layout.hb_gap, layout.ext_cols,
         layout.ext_tgt, layout.pub_src, layout.pub_col,
         length_s, slope_s, xst_s, twd_s, ssd_s, nm_s, qs_s, ps_s, qp_s, qi_s,

@@ -276,6 +276,9 @@ class ParallelTrainer:
         self._gspmd_step_cached = None
         self._auto_logged: set[str] = set()
         self._auto_modes: dict[str, str] = {}
+        # Per-batch adjoint resolution memo (experiment.adjoint="auto"): the
+        # planner's grad-card ladder runs once per distinct topology.
+        self._auto_adjoints: dict[str, str] = {}
         # Per-(engine, topo_key) ProgramCards: built once per distinct program
         # (the AOT rebuild a card costs — costs.py's cost note), re-emitted on
         # LRU-eviction rebuilds so every `compile` event has its card.
@@ -349,6 +352,36 @@ class ParallelTrainer:
         )
         return step, True
 
+    def _resolve_adjoint(self, rd: RoutingData, T: int) -> str:
+        """``experiment.adjoint`` for this batch: explicit values pass
+        through; ``"auto"`` asks the planner's grad-analog-card ladder once
+        per distinct topology (:func:`~ddr_tpu.parallel.select.select_adjoint_tuned`;
+        ``DDR_AUTOTUNE=off`` short-circuits to the analytic hand prior)."""
+        adj = self.cfg.experiment.adjoint
+        if adj != "auto":
+            return adj
+        from ddr_tpu.parallel.partition import topology_sha
+        from ddr_tpu.parallel.select import _device_hbm, select_adjoint_tuned
+
+        key = _batch_key(rd)
+        hit = self._auto_adjoints.get(key)
+        if hit is not None:
+            return hit
+        adj, source = select_adjoint_tuned(
+            self.platform, rd.adjacency_rows, rd.adjacency_cols, rd.n_segments,
+            self.n_shards, cache_key=topology_sha(rd), mesh_desc=self.mesh_desc,
+            t_steps=T, hbm_bytes=_device_hbm(self.mesh),
+        )
+        self._auto_adjoints[key] = adj
+        tag = f"adjoint:{adj}"
+        if tag not in self._auto_logged:
+            self._auto_logged.add(tag)
+            log.info(
+                f"adjoint=auto selected {adj} (source={source}, "
+                f"platform={self.platform}, N={rd.n_segments})"
+            )
+        return adj
+
     # ---- host-side batch preparation (prefetch-thread safe) ----
 
     def prepare(self, rd: RoutingData, q_prime: np.ndarray, ctx=None) -> PreparedBatch:
@@ -421,6 +454,7 @@ class ParallelTrainer:
                     gauges,
                     self.bounds,
                     remat_bands=self.cfg.experiment.remat_bands,
+                    adjoint=self._resolve_adjoint(rd, T),
                     **self._builder_kw,
                 )
 
@@ -468,6 +502,7 @@ class ParallelTrainer:
                     channels,
                     gauges,
                     self.bounds,
+                    adjoint=self._resolve_adjoint(rd_p, T),
                     **self._builder_kw,
                 )
 

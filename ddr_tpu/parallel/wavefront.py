@@ -17,11 +17,34 @@ pipeline as :mod:`ddr_tpu.parallel.pipeline`, but with ``T + depth`` global step
 instead of ``(T + S) x local_depth`` sequential solve levels.
 
 Unlike the per-timestep pipelined router (forward-only), this engine is
-DIFFERENTIABLE with standard JAX AD: the body is gathers/scatters/psum inside a
-``lax.scan`` under ``shard_map`` — gradient parity with the single-program route is
-pinned in tests/parallel/test_sharded_wavefront.py. The hotstart solve
-``(I - N) q0 = q'_0`` rides in-band as the t = 0 diagonal (c1 = 1, b = q'_0), so no
-separate distributed triangular solve is needed.
+DIFFERENTIABLE, two ways (``adjoint``):
+
+* ``"ad"`` — standard JAX AD through the wave scan: the body is
+  gathers/scatters/psum inside a ``lax.scan`` under ``shard_map``.
+* ``"analytic"`` — the single-chip analytic reverse-wavefront adjoint
+  (:mod:`ddr_tpu.routing.wavefront`), sharded. The transposed solve
+  ``lam = g + N^T (c1 * lam)`` walks the SAME wave machinery in reverse time
+  (tau = T-1-t, reverse level M(i) = depth - L(i), wave v = tau + M + 1) over
+  per-shard transposed successor tables (``ShardedWavefront.t_idx``), and the
+  boundary exchange is the forward's psum with the publisher/consumer roles
+  SWAPPED: each wave, the shard owning a boundary edge's forward TARGET
+  publishes the weight-premultiplied adjoint pair ``(c1_eff * lam, c2 * lam)``
+  and the shard owning its forward SOURCE consumes it ``gap`` waves later from
+  the same short replicated history — the adjoint flows to LOWER shards over
+  the unchanged ``bnd_out``/``bnd_tgt``/``bnd_gap`` tables, one psum (width
+  2B) per wave. Because the published values arrive premultiplied, the local
+  reverse scan carries TWO adjoint rings (``z = c1_eff * lam`` and
+  ``u = c2 * lam``) instead of per-edge weight streams, so the per-wave body
+  stays at two gathers + one psum + a handful of streamed multiplies. The
+  forward residual is the raw local (T, n_local) solve values plus ONE
+  psum'd replicated (T, B) boundary series; everything else (Muskingum chain,
+  operand sums) is recomputed or re-gathered vectorized, exactly like the
+  single-chip backward. Gradient parity with AD and with the single-chip
+  analytic route is pinned in tests/parallel/test_sharded_wavefront.py.
+
+The hotstart solve ``(I - N) q0 = q'_0`` rides in-band as the t = 0 diagonal
+(c1 = 1, b = q'_0), so no separate distributed triangular solve is needed —
+in both directions (the reverse sweep's t = 0 row keeps ``c1_eff = 1``).
 
 Semantics match :func:`ddr_tpu.routing.mc.route` on partitioned-order inputs
 (reference loop: /root/reference/src/ddr/routing/mmc.py:365-443): ``runoff[0]`` is
@@ -32,6 +55,7 @@ after each timestep's full solve.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -66,8 +90,20 @@ class ShardedWavefront:
     bnd_out, bnd_tgt:
         (S, B) local source index of boundary edge e if this shard owns it /
         local target index if this shard consumes it; ``n_local`` otherwise.
+        The analytic adjoint reuses the SAME tables with the roles swapped:
+        the ``bnd_tgt`` owner publishes, the ``bnd_out`` owner consumes.
     bnd_gap:
-        (B,) replicated global level gap of each boundary edge (>= 1).
+        (B,) replicated global level gap of each boundary edge (>= 1) — also
+        the reverse-wave gap (M(src) - M(tgt) equals L(tgt) - L(src)).
+    t_idx:
+        (S, n_local, U_t) transposed (successor) table for the analytic
+        adjoint's reverse-wave gather, same flat ring encoding as ``pred_idx``:
+        slot for local edge i -> j is ``(gap - 1) * (n_local + 1) + j_local``;
+        pad slots hold ``n_local`` (always-zero sentinel column, so no mask is
+        needed). ``None`` on layouts built before the analytic adjoint landed.
+    t_width:
+        static U_t (max local out-degree); 0 marks a stale ``t_idx``-less
+        layout (``adjoint="analytic"`` then raises).
     """
 
     level: jnp.ndarray
@@ -80,6 +116,8 @@ class ShardedWavefront:
     n_local: int = dataclasses.field(metadata={"static": True})
     n_boundary: int = dataclasses.field(metadata={"static": True})
     depth: int = dataclasses.field(metadata={"static": True})
+    t_idx: jnp.ndarray | None = None
+    t_width: int = dataclasses.field(default=0, metadata={"static": True})
 
 
 def build_sharded_wavefront(
@@ -128,6 +166,21 @@ def build_sharded_wavefront(
     )
     pred_mask[l_shard[order], t_sorted % n_local, slot] = 1.0
 
+    # Transposed (successor) table: the analytic adjoint's reverse-wave gather.
+    # Per local SOURCE, its same-shard successors — the same flat (gap-1, col)
+    # ring encoding, so the reverse scan rotates it identically. Cross-shard
+    # successors ride the reversed boundary psum instead (bnd_* role swap).
+    out_deg_local = np.zeros(n, dtype=np.int64)
+    np.add.at(out_deg_local, l_src, 1)
+    U_t = max(1, int(out_deg_local.max()) if len(l_src) else 1)
+    t_idx = np.full((n_shards, n_local, U_t), n_local, dtype=np.int64)
+    order_s = np.argsort(l_src, kind="stable")
+    s_sorted = l_src[order_s]
+    slot_s = np.arange(len(s_sorted)) - np.searchsorted(s_sorted, s_sorted)
+    t_idx[l_shard[order_s], s_sorted % n_local, slot_s] = (
+        (gaps_l[order_s] - 1) * row_len + l_tgt[order_s] % n_local
+    )
+
     b_src, b_tgt = cols[~local], rows[~local]
     b_ss, b_ts = src_shard[~local], tgt_shard[~local]
     n_boundary = max(1, len(b_src))
@@ -150,7 +203,408 @@ def build_sharded_wavefront(
         n_local=n_local,
         n_boundary=n_boundary,
         depth=depth,
+        t_idx=jnp.asarray(t_idx, jnp.int32),
+        t_width=int(U_t),
     )
+
+
+def _shard_physics(q_prev, ln, sl, xs_, twd, ssd, nm, qsp, psp, bounds, dt):
+    """The per-wave elementwise physics chain on one shard's local arrays —
+    module-level and argument-explicit so the analytic adjoint can linearize
+    it directly (the sharded sibling of ``routing.stacked._physics_frame``;
+    argument order matches it: ``qsp`` = q_spatial, ``psp`` = p_spatial)."""
+    ch = ChannelState(length=ln, slope=sl, x_storage=xs_,
+                      top_width_data=twd, side_slope_data=ssd)
+    c = celerity(q_prev, nm, psp, qsp, ch, bounds)[0]
+    return muskingum_coefficients(ln, c, xs_, dt)
+
+
+def _shard_input_skews(qp, xe, se, level, *, T, nl, D, has_ext):
+    """The per-shard forward wave-input skews (dynamic per-node starts).
+
+    Wave w hands reach i ``q'[clip(t-1, 0, T-2)]`` with t = w - 1 - L(i); the
+    same row serves the t = 0 hotstart (q'_0, raw). Padded col c maps to q'
+    index clip(c - (D+1), 0, T-2); node i's slice starts at D - L(i) so row
+    w-1 lands on index w - 2 - L(i). External series skew to exact t (zeros
+    outside [0, T-1])."""
+    n_waves = T + D
+    qp_loc = qp.T  # (nl, T)
+    right_edge = qp_loc[:, T - 2 : T - 1] if T >= 2 else qp_loc[:, :1]
+    padded = jnp.concatenate(
+        [
+            jnp.repeat(qp_loc[:, :1], D + 1, axis=1),
+            qp_loc[:, : T - 1],
+            jnp.repeat(right_edge, D + 1, axis=1),
+        ],
+        axis=1,
+    )
+    qs = jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(row, (s,), (n_waves,))
+    )(padded, D - level).T  # (W, nl)
+    if not has_ext:
+        return qs, None, None
+
+    def _skew_ext(ext_loc):  # (T, nl) -> (W, nl)
+        z = jnp.zeros((nl, D), ext_loc.dtype)
+        padded_e = jnp.concatenate([z, ext_loc.T, z], axis=1)
+        return jax.vmap(
+            lambda row, s: jax.lax.dynamic_slice(row, (s,), (n_waves,))
+        )(padded_e, D - level).T
+
+    return qs, _skew_ext(xe), _skew_ext(se)
+
+
+def _shard_wave_scan(
+    physics, level, pred_idx, pred_mask, bnd_out, bnd_tgt, bnd_gap,
+    qs, xe_s, se_s, qi, *, T, nl, B, D, lb, has_init, has_ext, axis_name,
+):
+    """The forward wave scan of one shard (shared by the AD path and the
+    analytic-adjoint primal): returns the raw per-wave solve values ``ys
+    (W, nl)``. One boundary psum per wave; the gathered predecessor values
+    serve both the same-timestep solve sum (raw) and the NEXT wave's
+    previous-timestep inflow sum (clamped), carried in ``s_state``."""
+    n_waves = T + D
+    # Rotating FLAT buffers (same rationale as wavefront_route_core: the
+    # concatenate-shift lowers to a full copy-through-scratch of the carry
+    # every wave, and a 2-D carry read flat forces a layout-copy besides).
+    # Wave w writes ring row ``w % R`` / hist row ``w % R_h``; a value from
+    # wave w - d lives at row ``(w - d) % R``. Unwritten rows stay zero,
+    # preserving the shift form's zero-history semantics bitwise.
+    row_len = nl + 1
+    ring_rows = D + 2
+    hist_rows = D + 1
+    flat_idx = pred_idx.reshape(-1)
+    pr_row = flat_idx // row_len  # gap - 1, static per slot
+    pr_col = flat_idx - pr_row * row_len
+    mask = pred_mask
+    ar_b = jnp.arange(B)
+
+    ring0 = jnp.zeros(ring_rows * row_len, qs.dtype)
+    hist0 = jnp.zeros(hist_rows * B, qs.dtype)
+    s0 = jnp.zeros(nl, qs.dtype)
+
+    def body(carry, wave_inputs):
+        ring, hist, s_state = carry
+        if has_ext:
+            q_row, xe_row, se_row, w = wave_inputs
+        else:
+            q_row, w = wave_inputs
+            xe_row = se_row = 0.0
+        t_node = w - 1 - level
+        h1 = jax.lax.rem(w - 1, ring_rows)  # ring row of wave w - 1's output
+        q_prev_row = jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:nl]
+        q_prev = jnp.maximum(q_prev_row, lb)
+        c1, c2, c3, c4 = physics(q_prev)
+
+        rot = h1 - pr_row  # (h1 - (gap - 1)) mod R, in two vector ops
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        g = ring[rot * row_len + pr_col].reshape(nl, -1)  # raw x_t[p], local preds
+        x_local = (g * mask).sum(axis=1) + xe_row  # ext joins the same-t solve
+        s_local = (jnp.maximum(g, lb) * mask).sum(axis=1)
+
+        # Boundary reads: edge e's source published x_t[src] gap waves before the
+        # target's wave -> the hist row written at wave w - gap. The clamped
+        # previous-timestep inflow the target needs NEXT wave is the clamp of
+        # this same read (mirroring how the local path reuses its solve
+        # gather), carried via s_state.
+        hb1 = jax.lax.rem(w - 1, hist_rows)
+        hrot = hb1 - (bnd_gap - 1)
+        hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
+        x_b = hist[hrot * B + ar_b]
+        s_b = jnp.maximum(x_b, lb)
+        own = bnd_tgt < nl
+        x_bnd = (
+            jnp.zeros(nl + 1, qs.dtype).at[bnd_tgt].add(jnp.where(own, x_b, 0.0))[:nl]
+        )
+        s_bnd = (
+            jnp.zeros(nl + 1, qs.dtype).at[bnd_tgt].add(jnp.where(own, s_b, 0.0))[:nl]
+        )
+        x_pred = x_local + x_bnd
+
+        # se_row joins at CONSUMPTION time (this wave's inflow term), exactly
+        # like wavefront_route_core: s_ext[t] is the clamped external sum at
+        # the node's own previous timestep.
+        b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, lb)
+        is_hot = t_node == 0
+        c1_eff = jnp.where(is_hot, 1.0, c1)
+        b_eff = jnp.where(is_hot, q_row, b_step)  # hotstart: b = q'_0, raw
+        y = b_eff + c1_eff * x_pred
+        if has_init:
+            y = jnp.where(is_hot, jnp.maximum(qi, lb), y)
+        ok = (t_node >= 0) & (t_node <= T - 1)
+        y = jnp.where(ok, y, 0.0)
+
+        v_out = jnp.where(
+            bnd_out < nl, jnp.concatenate([y, jnp.zeros(1, y.dtype)])[bnd_out], 0.0
+        )
+        hist = jax.lax.dynamic_update_slice(
+            hist, jax.lax.psum(v_out, axis_name), (jax.lax.rem(w, hist_rows) * B,)
+        )
+        ring = jax.lax.dynamic_update_slice(
+            ring,
+            jnp.concatenate([y, jnp.zeros(1, y.dtype)]),
+            (jax.lax.rem(w, ring_rows) * row_len,),
+        )
+        return (ring, hist, s_local + s_bnd), y  # RAW; clamp after un-skew
+
+    waves = jnp.arange(1, n_waves + 1)
+    xs = (qs, xe_s, se_s, waves) if has_ext else (qs, waves)
+    (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), xs)
+    return ys
+
+
+# ---------------------------------------------------------------------------
+# Analytic reverse-wavefront adjoint of one shard's route — the sharded
+# instance of the math documented in ddr_tpu.routing.wavefront: reverse time
+# tau = T-1-t, reverse level M(i) = depth - L(i), transposed per-shard gather
+# tables (ShardedWavefront.t_idx). TWO adjoint rings carry the propagations
+# (z = c1_eff*lam solve adjoint, u = c2*lam inflow adjoint) instead of
+# per-edge weight streams: boundary successors live on OTHER shards, whose
+# c1/c2 the consumer cannot stream — so the publisher premultiplies, the one
+# per-wave psum carries the ready-to-sum (z, u) pair over the swapped
+# bnd_tgt -> bnd_out roles, and local edges use the identical premultiplied
+# scheme through the rings (sentinel columns read zero; no masks, no extra
+# weight gathers). Residual = raw local solve values + ONE psum'd (T, B)
+# boundary series (the cross-shard operands the backward must re-gather).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _sharded_analytic(static, level, pred_idx, pred_mask, t_idx,
+                      bnd_out, bnd_tgt, bnd_gap,
+                      ln, sl, xs_, twd, ssd, nm, qsp, psp, qp, qi, xe, se):
+    """One shard's wavefront route with the analytic reverse-wavefront adjoint
+    (runs INSIDE the shard_map body; psums bind the mesh axis). Returns the
+    RAW (T, n_local) solve values — the clamp stays outside on standard AD so
+    its subgradient matches the AD path exactly."""
+    return _sharded_analytic_fwd(static, level, pred_idx, pred_mask, t_idx,
+                                 bnd_out, bnd_tgt, bnd_gap,
+                                 ln, sl, xs_, twd, ssd, nm, qsp, psp,
+                                 qp, qi, xe, se)[0]
+
+
+def _sharded_analytic_fwd(static, level, pred_idx, pred_mask, t_idx,
+                          bnd_out, bnd_tgt, bnd_gap,
+                          ln, sl, xs_, twd, ssd, nm, qsp, psp, qp, qi, xe, se):
+    (T, nl, B, D, lb, bounds, dt, has_init, has_ext, axis_name) = static
+    qs, xe_s, se_s = _shard_input_skews(qp, xe, se, level, T=T, nl=nl, D=D,
+                                        has_ext=has_ext)
+    phys_args = (ln, sl, xs_, twd, ssd, nm, qsp, psp)
+
+    def physics(q_prev):
+        return _shard_physics(q_prev, *phys_args, bounds, dt)
+
+    ys = _shard_wave_scan(
+        physics, level, pred_idx, pred_mask, bnd_out, bnd_tgt, bnd_gap,
+        qs, xe_s, se_s, qi, T=T, nl=nl, B=B, D=D, lb=lb,
+        has_init=has_init, has_ext=has_ext, axis_name=axis_name,
+    )
+    # Un-skew: x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L(i)).
+    raw = jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(row, (s,), (T,))
+    )(ys.T, level).T  # (T, nl)
+    # The backward's only cross-shard residual: every boundary edge's RAW
+    # source series, replicated by one psum (each slot owned by one shard).
+    raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), raw.dtype)], axis=1)
+    bnd_series = jax.lax.psum(
+        jnp.where(bnd_out < nl, raw_pad[:, bnd_out], 0.0), axis_name
+    )  # (T, B)
+    res = (raw, bnd_series, qp, qi, xe, se,
+           level, pred_idx, pred_mask, t_idx, bnd_out, bnd_tgt, bnd_gap, phys_args)
+    return raw, res
+
+
+def _sharded_analytic_bwd(static, res, raw_bar):
+    from ddr_tpu.routing.stacked import _skew_cols
+    from ddr_tpu.routing.wavefront import _dmax
+
+    (T, nl, B, D, lb, bounds, dt, has_init, has_ext, axis_name) = static
+    (raw, bnd_series, qp, qi, xe, se,
+     level, pred_idx, pred_mask, t_idx, bnd_out, bnd_tgt, bnd_gap, phys_args) = res
+    row_len = nl + 1
+    ring_rows = D + 2
+    hist_rows = D + 1
+    n_waves = T + D
+    dtype = raw.dtype
+    M = D - level
+    ar_b = jnp.arange(B)
+    U = pred_idx.shape[1]
+    t_width = t_idx.shape[1]
+
+    # --- everything t-separable hoisted out of the reverse scan (the same
+    # move as wavefront._analytic_bwd): the backward's operands all live in
+    # ``raw`` + ``bnd_series``, so the physics chain, its q_prev-derivative,
+    # and the operand sums evaluate as big (T, nl) vectorized passes, leaving
+    # the sequential scan the graph-propagation minimum. ---
+    flat_idx = pred_idx.reshape(-1)
+    pr_col = flat_idx - (flat_idx // row_len) * row_len
+    raw_pad = jnp.concatenate([raw, jnp.zeros((T, 1), dtype)], axis=1)
+    nx = (raw_pad[:, pr_col].reshape(T, nl, U) * pred_mask).sum(axis=2)
+    prev_pad = jnp.concatenate([jnp.zeros((1, row_len), dtype), raw_pad[:-1]], axis=0)
+    s_loc = (
+        jnp.maximum(prev_pad[:, pr_col], lb).reshape(T, nl, U) * pred_mask
+    ).sum(axis=2)
+
+    # Boundary operands re-scattered from the replicated series (clamp
+    # per-edge BEFORE the scatter, matching the forward's s_b).
+    own_tgt = bnd_tgt < nl
+    own_src = bnd_out < nl
+    x_bnd = (
+        jnp.zeros((T, row_len), dtype)
+        .at[:, bnd_tgt].add(jnp.where(own_tgt, bnd_series, 0.0))[:, :nl]
+    )
+    prev_b = jnp.concatenate([jnp.zeros((1, B), dtype), bnd_series[:-1]], axis=0)
+    s_bnd = (
+        jnp.zeros((T, row_len), dtype)
+        .at[:, bnd_tgt].add(jnp.where(own_tgt, jnp.maximum(prev_b, lb), 0.0))[:, :nl]
+    )
+    xpx = nx + x_bnd  # c1's solve operand: N x_t incl. boundary (+ ext)
+    s_full = s_loc + s_bnd  # c2's operand: clamped prev-timestep inflow sum
+    if has_ext:
+        xpx = xpx + xe
+        s_full = s_full + se
+
+    q_prev_all = jnp.maximum(prev_pad[:, :nl], lb)  # (T, nl): max(x_{t-1}, lb)
+    qpm1_all = jnp.concatenate([jnp.zeros((1, nl), dtype), qp[:-1]], axis=0)
+    qpm1c = jnp.maximum(qpm1_all, lb)
+
+    def phys_batch(q, args):
+        return _shard_physics(q, *args, bounds, dt)
+
+    # ONE nonlinear trace serves the whole backward: the linearized physics
+    # yields the primal c's, the tangent d's (one linear eval), and — via its
+    # transpose, evaluated after the reverse scan — the theta pullback.
+    (c1_a, c2_a, c3_a, c4_a), phys_lin = jax.linearize(
+        phys_batch, q_prev_all, phys_args
+    )
+    zero_args = jax.tree_util.tree_map(jnp.zeros_like, phys_args)
+    d1, d2, d3, d4 = phys_lin(jnp.ones_like(q_prev_all), zero_args)
+    # Masks, hotstart handling, and per-timestep coefficients folded into
+    # precomputed per-node streams (wavefront._analytic_bwd's scheme, minus
+    # the per-edge streams the two-ring design replaces):
+    #   zc: transposed-solve weight — c1 for t >= 1, hotstart c1_eff = 1 at
+    #       t = 0 (0 with q_init: x_0 is a leaf, nothing propagates);
+    #   uc: prev-timestep inflow weight — c2, zero at t = 0;
+    #   ow: own-channel push dmax(x_{t-1}) * (sum_k dc_k * op_k + c3);
+    #   dm: dmax(x_{t-1}), the consumer-side inflow clamp subgradient (zero
+    #       row 0: no t = -1) — stays its OWN stream here because boundary u
+    #       values arrive premultiplied WITHOUT the consumer's dm.
+    zero_row = jnp.zeros((1, nl), dtype)
+    hot_row = zero_row if has_init else jnp.ones((1, nl), dtype)
+    zc = jnp.concatenate([hot_row, c1_a[1:]], axis=0)
+    uc = jnp.concatenate([zero_row, c2_a[1:]], axis=0)
+    own_coef = d1 * xpx + d2 * s_full + d3 * q_prev_all + d4 * qpm1c + c3_a
+    dm_all = _dmax(prev_pad[:, :nl], lb).at[0].set(0.0)
+    ow = dm_all * own_coef
+
+    # ONE stacked reverse stream over the five per-node blocks
+    # [gbar | ow | zc | uc | dm]: row v-1 hands node i block[t, i] with
+    # t = T - v + M(i), zeros outside [0, T) — built transposed from the
+    # start so the only transposed copy is the small (T, 5*nl) core
+    # (the routing.stacked._band_analytic_bwd trick).
+    width_all = 5 * nl
+    starts_all = jnp.tile(level, 5)
+    core = jnp.concatenate([raw_bar, ow, zc, uc, dm_all], axis=1)
+    padded_t = jnp.zeros((width_all, 2 * D + T + 1), dtype)
+    padded_t = jax.lax.dynamic_update_slice(padded_t, core[::-1].T, (0, D))
+    stacked_s = jax.vmap(
+        lambda row, s0: jax.lax.dynamic_slice(row, (s0,), (n_waves,))
+    )(padded_t, starts_all).T  # (W, 5*nl)
+
+    t_flat = t_idx.reshape(-1)
+    t_row = t_flat // row_len  # gap - 1 per successor slot
+    t_col = t_flat - t_row * row_len
+
+    ring_z0 = jnp.zeros(ring_rows * row_len, dtype)
+    ring_u0 = jnp.zeros(ring_rows * row_len, dtype)
+    hist0 = jnp.zeros(hist_rows * 2 * B, dtype)
+    gx0 = jnp.zeros(nl, dtype)
+
+    def body(carry, wave_inputs):
+        ring_z, ring_u, hist, gx = carry
+        rows, w = wave_inputs
+        gbar_row = rows[:nl]
+        ow_row = rows[nl : 2 * nl]
+        zc_row = rows[2 * nl : 3 * nl]
+        uc_row = rows[3 * nl : 4 * nl]
+        dm_row = rows[4 * nl :]
+
+        # Local transposed gathers: successors' premultiplied (z, u), emitted
+        # gap waves earlier (pad slots read the always-zero sentinel column —
+        # invalid waves wrote zeros, mirroring the forward convention).
+        h1 = jax.lax.rem(w - 1, ring_rows)
+        rot = h1 - t_row
+        rot = jnp.where(rot < 0, rot + ring_rows, rot)
+        flat = rot * row_len + t_col
+        zsum = ring_z[flat].reshape(nl, t_width).sum(axis=1)
+        usum = ring_u[flat].reshape(nl, t_width).sum(axis=1)
+
+        # Reversed boundary exchange: the forward's hist timing verbatim, but
+        # the PUBLISHER is the bnd_tgt owner and the CONSUMER the bnd_out
+        # owner — edge e's target published (z, u) at ITS wave for timestep t,
+        # gap waves before the source's reverse wave for the same t.
+        hb1 = jax.lax.rem(w - 1, hist_rows)
+        hrot = hb1 - (bnd_gap - 1)
+        hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
+        hz = hist[hrot * (2 * B) + ar_b]
+        hu = hist[hrot * (2 * B) + B + ar_b]
+        hz_s = (
+            jnp.zeros(row_len, dtype).at[bnd_out].add(jnp.where(own_src, hz, 0.0))[:nl]
+        )
+        hu_s = (
+            jnp.zeros(row_len, dtype).at[bnd_out].add(jnp.where(own_src, hu, 0.0))[:nl]
+        )
+
+        lam = gbar_row + gx + zsum + hz_s  # transposed same-timestep solve
+        z = zc_row * lam
+        u = uc_row * lam
+        gx_next = ow_row * lam + dm_row * (usum + hu_s)
+
+        z_pad = jnp.concatenate([z, jnp.zeros(1, dtype)])
+        u_pad = jnp.concatenate([u, jnp.zeros(1, dtype)])
+        pz = jnp.where(own_tgt, z_pad[bnd_tgt], 0.0)
+        pu = jnp.where(own_tgt, u_pad[bnd_tgt], 0.0)
+        hist = jax.lax.dynamic_update_slice(
+            hist,
+            jax.lax.psum(jnp.concatenate([pz, pu]), axis_name),
+            (jax.lax.rem(w, hist_rows) * (2 * B),),
+        )
+        h = jax.lax.rem(w, ring_rows)
+        ring_z = jax.lax.dynamic_update_slice(ring_z, z_pad, (h * row_len,))
+        ring_u = jax.lax.dynamic_update_slice(ring_u, u_pad, (h * row_len,))
+        return (ring_z, ring_u, hist, gx_next), lam
+
+    waves = jnp.arange(1, n_waves + 1)
+    (_, _, _, _), lams = jax.lax.scan(
+        body, (ring_z0, ring_u0, hist0, gx0), (stacked_s, waves)
+    )
+
+    # --- vectorized adjoint outputs from the un-skewed lam field ---
+    lam_all = _skew_cols(lams, M, T)[::-1]  # (T, nl), raw incl. t = 0
+    lam_th = lam_all.at[0].set(0.0)  # no physics on the hotstart diagonal
+    pull = jax.linear_transpose(phys_lin, q_prev_all, phys_args)
+    _, theta_bar = pull(
+        (lam_th * xpx, lam_th * s_full, lam_th * q_prev_all, lam_th * qpm1c)
+    )
+
+    z_un = zc * lam_all  # x_ext adjoint; row 0 = hotstart q'_0 term
+    qp_coef = jnp.concatenate([zero_row, (c4_a * _dmax(qpm1_all, lb))[1:]], axis=0)
+    qp_bar = jnp.concatenate([(qp_coef * lam_all)[1:], zero_row], axis=0)
+    qp_bar = qp_bar.at[0].add(z_un[0])
+
+    x_ext_bar = z_un if has_ext else jnp.zeros_like(xe)
+    s_ext_bar = uc * lam_all if has_ext else jnp.zeros_like(se)
+    q_init_bar = _dmax(qi, lb) * lam_all[0] if has_init else jnp.zeros_like(qi)
+
+    f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)  # noqa: E731
+    (ln_b, sl_b, xs_b, twd_b, ssd_b, nm_b, qsp_b, psp_b) = theta_bar
+    return (f0(level), f0(pred_idx), jnp.zeros_like(pred_mask), f0(t_idx),
+            f0(bnd_out), f0(bnd_tgt), f0(bnd_gap),
+            ln_b, sl_b, xs_b, twd_b, ssd_b, nm_b, qsp_b, psp_b,
+            qp_bar, q_init_bar, x_ext_bar, s_ext_bar)
+
+
+_sharded_analytic.defvjp(_sharded_analytic_fwd, _sharded_analytic_bwd)
 
 
 def sharded_wavefront_route(
@@ -172,15 +626,13 @@ def sharded_wavefront_route(
 
     All per-reach inputs must be in partitioned order. Differentiable end to end.
 
-    ``adjoint``: the sharded wave body currently differentiates by standard AD
-    only (``"ad"``). The single-chip engines' analytic reverse-wavefront custom
-    VJP (:mod:`ddr_tpu.routing.wavefront`) transfers structurally — the
-    transposed sweep's boundary exchange is the forward's psum with
-    publisher/consumer roles (``bnd_out``/``bnd_tgt``) swapped and the adjoint
-    flowing to LOWER shards — but the sharded transposed tables are not built
-    yet, so ``"analytic"`` raises ``NotImplementedError`` naming the plan
-    rather than silently falling back (an A/B harness must know which backward
-    it measured).
+    ``adjoint`` selects the backward pass: ``"ad"`` differentiates the wave
+    scan with standard JAX AD; ``"analytic"`` runs the reverse-time transposed
+    sweep with the swapped-role boundary psum (module docstring) — same
+    gradients to float associativity, including the clamp subgradients, at a
+    fraction of the backward cost (the residual is the raw solve values plus
+    one (T, B) boundary series instead of AD's per-wave ring saves). Needs a
+    schedule built by this version (``t_width > 0``); stale layouts raise.
 
     ``x_ext``/``s_ext`` inject predecessor sums living OUTSIDE this network —
     the sharded-chunked router's upstream bands (same contract as
@@ -191,18 +643,16 @@ def sharded_wavefront_route(
     ``return_raw=True`` appends the pre-clamp solve values (T, N) — what a
     downstream band's ``x_ext`` must read.
     """
-    if adjoint != "ad":
-        if adjoint == "analytic":
-            raise NotImplementedError(
-                "the sharded wavefront differentiates by AD this round; the "
-                "analytic reverse-wavefront adjoint (ddr_tpu.routing.wavefront) "
-                "needs sharded transposed tables + the reversed boundary psum "
-                "— pass adjoint='ad' here, or route single-chip for analytic"
-            )
-        raise ValueError(f"unknown adjoint {adjoint!r} (use 'ad')")
+    if adjoint not in ("ad", "analytic"):
+        raise ValueError(f"unknown adjoint {adjoint!r} (use 'analytic' or 'ad')")
+    if adjoint == "analytic" and schedule.t_width <= 0:
+        raise ValueError(
+            "adjoint='analytic' needs the schedule's transposed successor "
+            "tables (t_idx); rebuild it with build_sharded_wavefront from "
+            "this version or pass adjoint='ad'"
+        )
     T = q_prime.shape[0]
     S, nl, B, D = schedule.n_shards, schedule.n_local, schedule.n_boundary, schedule.depth
-    n_waves = T + D
     has_init = q_init is not None
     if not has_init:
         q_init = jnp.zeros(q_prime.shape[1], q_prime.dtype)
@@ -218,138 +668,44 @@ def sharded_wavefront_route(
     nan = jnp.full_like(channels.length, jnp.nan)
     twd_in = channels.top_width_data if channels.top_width_data is not None else nan
     ssd_in = channels.side_slope_data if channels.side_slope_data is not None else nan
+    t_idx_in = schedule.t_idx
+    if t_idx_in is None:  # stale layout, AD path: constant in_specs need an array
+        t_idx_in = jnp.zeros((S, 1, 1), jnp.int32)
+    lb = float(bounds.discharge)
+    static = (T, nl, B, D, lb, bounds, float(dt), has_init, has_ext, axis_name)
 
-    def shard_fn(level, pred_idx, pred_mask, bnd_out, bnd_tgt, bnd_gap,
+    def shard_fn(level, pred_idx, pred_mask, t_idx, bnd_out, bnd_tgt, bnd_gap,
                  length, slope, x_st, twd, ssd, n_c, p_c, q_c, qp, qi, xe, se):
-        level, pred_idx, pred_mask = level[0], pred_idx[0], pred_mask[0]
+        level, pred_idx, pred_mask, t_idx = level[0], pred_idx[0], pred_mask[0], t_idx[0]
         bnd_out, bnd_tgt = bnd_out[0], bnd_tgt[0]
-        ch = ChannelState(
-            length=length, slope=slope, x_storage=x_st,
-            top_width_data=twd, side_slope_data=ssd,
-        )
-        # Rotating FLAT buffers (same rationale as wavefront_route_core: the
-        # concatenate-shift lowers to a full copy-through-scratch of the carry
-        # every wave, and a 2-D carry read flat forces a layout-copy besides).
-        # Wave w writes ring row ``w % R`` / hist row ``w % R_h``; a value from
-        # wave w - d lives at row ``(w - d) % R``. Unwritten rows stay zero,
-        # preserving the shift form's zero-history semantics bitwise.
-        row_len = nl + 1
-        ring_rows = D + 2
-        hist_rows = D + 1
-        flat_idx = pred_idx.reshape(-1)
-        pr_row = flat_idx // row_len  # gap - 1, static per slot
-        pr_col = flat_idx - pr_row * row_len
-        mask = pred_mask
-        ar_b = jnp.arange(B)
-
-        # Input skew (local): wave w hands reach i q'[clip(t-1, 0, T-2)] with
-        # t = w - 1 - L(i); the same row serves the t = 0 hotstart (q'_0, raw).
-        # Padded col c maps to q' index clip(c - (D+1), 0, T-2); node i's slice
-        # starts at D - L(i) so row w-1 lands on index w - 2 - L(i).
-        qp_loc = qp.T  # (nl, T)
-        right_edge = qp_loc[:, T - 2 : T - 1] if T >= 2 else qp_loc[:, :1]
-        padded = jnp.concatenate(
-            [
-                jnp.repeat(qp_loc[:, :1], D + 1, axis=1),
-                qp_loc[:, : T - 1],
-                jnp.repeat(right_edge, D + 1, axis=1),
-            ],
-            axis=1,
-        )
-        qs = jax.vmap(
-            lambda row, s: jax.lax.dynamic_slice(row, (s,), (n_waves,))
-        )(padded, D - level).T  # (W, nl)
-
-        if has_ext:
-            # ext skew: wave w hands reach i ext[t, i] with t = w - 1 - L(i)
-            # exactly, zeros outside [0, T-1] (see wavefront_route_core).
-            def _skew_ext(ext_loc):  # (T, nl) -> (W, nl)
-                z = jnp.zeros((nl, D), ext_loc.dtype)
-                padded_e = jnp.concatenate([z, ext_loc.T, z], axis=1)
-                return jax.vmap(
-                    lambda row, s: jax.lax.dynamic_slice(row, (s,), (n_waves,))
-                )(padded_e, D - level).T
-
-            xe_s = _skew_ext(xe)
-            se_s = _skew_ext(se)
-
-        ring0 = jnp.zeros(ring_rows * row_len, qp.dtype)
-        hist0 = jnp.zeros(hist_rows * B, qp.dtype)
-        s0 = jnp.zeros(nl, qp.dtype)
-
-        def body(carry, wave_inputs):
-            ring, hist, s_state = carry
-            if has_ext:
-                q_row, xe_row, se_row, w = wave_inputs
-            else:
-                q_row, w = wave_inputs
-                xe_row = se_row = 0.0
-            t_node = w - 1 - level
-            h1 = jax.lax.rem(w - 1, ring_rows)  # ring row of wave w - 1's output
-            q_prev_row = jax.lax.dynamic_slice(ring, (h1 * row_len,), (row_len,))[:nl]
-            q_prev = jnp.maximum(q_prev_row, bounds.discharge)
-            c, _, _ = celerity(q_prev, n_c, p_c, q_c, ch, bounds)
-            c1, c2, c3, c4 = muskingum_coefficients(ch.length, c, ch.x_storage, dt)
-
-            rot = h1 - pr_row  # (h1 - (gap - 1)) mod R, in two vector ops
-            rot = jnp.where(rot < 0, rot + ring_rows, rot)
-            g = ring[rot * row_len + pr_col].reshape(nl, -1)  # raw x_t[p], local preds
-            x_local = (g * mask).sum(axis=1) + xe_row  # ext joins the same-t solve
-            s_local = (jnp.maximum(g, bounds.discharge) * mask).sum(axis=1)
-
-            # Boundary reads: edge e's source published x_t[src] gap waves before the
-            # target's wave -> the hist row written at wave w - gap. The clamped
-            # previous-timestep inflow the target needs NEXT wave is the clamp of
-            # this same read (mirroring how the local path reuses its solve
-            # gather), carried via s_state.
-            hb1 = jax.lax.rem(w - 1, hist_rows)
-            hrot = hb1 - (bnd_gap - 1)
-            hrot = jnp.where(hrot < 0, hrot + hist_rows, hrot)
-            x_b = hist[hrot * B + ar_b]
-            s_b = jnp.maximum(x_b, bounds.discharge)
-            own = bnd_tgt < nl
-            x_bnd = (
-                jnp.zeros(nl + 1, qp.dtype).at[bnd_tgt].add(jnp.where(own, x_b, 0.0))[:nl]
+        if adjoint == "analytic":
+            # argument order follows _shard_physics: qsp = q_spatial BEFORE
+            # psp = p_spatial (the routing.stacked._physics_frame convention)
+            raw = _sharded_analytic(
+                static, level, pred_idx, pred_mask, t_idx, bnd_out, bnd_tgt,
+                bnd_gap, length, slope, x_st, twd, ssd, n_c, q_c, p_c,
+                qp, qi, xe, se,
             )
-            s_bnd = (
-                jnp.zeros(nl + 1, qp.dtype).at[bnd_tgt].add(jnp.where(own, s_b, 0.0))[:nl]
+        else:
+            qs, xe_s, se_s = _shard_input_skews(
+                qp, xe, se, level, T=T, nl=nl, D=D, has_ext=has_ext
             )
-            x_pred = x_local + x_bnd
 
-            # se_row joins at CONSUMPTION time (this wave's inflow term), exactly
-            # like wavefront_route_core: s_ext[t] is the clamped external sum at
-            # the node's own previous timestep.
-            b_step = c2 * (s_state + se_row) + c3 * q_prev + c4 * jnp.maximum(q_row, bounds.discharge)
-            is_hot = t_node == 0
-            c1_eff = jnp.where(is_hot, 1.0, c1)
-            b_eff = jnp.where(is_hot, q_row, b_step)  # hotstart: b = q'_0, raw
-            y = b_eff + c1_eff * x_pred
-            if has_init:
-                y = jnp.where(is_hot, jnp.maximum(qi, bounds.discharge), y)
-            ok = (t_node >= 0) & (t_node <= T - 1)
-            y = jnp.where(ok, y, 0.0)
+            def physics(q_prev):
+                return _shard_physics(
+                    q_prev, length, slope, x_st, twd, ssd, n_c, q_c, p_c,
+                    bounds, dt,
+                )
 
-            v_out = jnp.where(
-                bnd_out < nl, jnp.concatenate([y, jnp.zeros(1, y.dtype)])[bnd_out], 0.0
+            ys = _shard_wave_scan(
+                physics, level, pred_idx, pred_mask, bnd_out, bnd_tgt, bnd_gap,
+                qs, xe_s, se_s, qi, T=T, nl=nl, B=B, D=D, lb=lb,
+                has_init=has_init, has_ext=has_ext, axis_name=axis_name,
             )
-            hist = jax.lax.dynamic_update_slice(
-                hist, jax.lax.psum(v_out, axis_name), (jax.lax.rem(w, hist_rows) * B,)
-            )
-            ring = jax.lax.dynamic_update_slice(
-                ring,
-                jnp.concatenate([y, jnp.zeros(1, y.dtype)]),
-                (jax.lax.rem(w, ring_rows) * row_len,),
-            )
-            return (ring, hist, s_local + s_bnd), y  # RAW; clamp after un-skew
-
-        waves = jnp.arange(1, n_waves + 1)
-        xs = (qs, xe_s, se_s, waves) if has_ext else (qs, waves)
-        (_, _, _), ys = jax.lax.scan(body, (ring0, hist0, s0), xs)
-
-        # Un-skew: x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L(i)).
-        raw = jax.vmap(
-            lambda row, s: jax.lax.dynamic_slice(row, (s,), (T,))
-        )(ys.T, level).T  # (T, nl)
+            # Un-skew: x_t[i] was emitted at wave t + L(i) + 1 (ys row t + L(i)).
+            raw = jax.vmap(
+                lambda row, s: jax.lax.dynamic_slice(row, (s,), (T,))
+            )(ys.T, level).T  # (T, nl)
         routed = jnp.maximum(raw, bounds.discharge)
         if return_raw:
             return routed, routed[-1], raw
@@ -362,7 +718,7 @@ def sharded_wavefront_route(
         shard_fn,
         mesh=mesh,
         in_specs=(
-            shard, shard, shard, shard, shard, rep,  # schedule
+            shard, shard, shard, shard, shard, shard, rep,  # schedule (+ transposed)
             shard, shard, shard, shard, shard,  # channel arrays
             shard, shard, shard,  # spatial params
             P(None, axis_name), shard,  # q_prime, q_init
@@ -372,7 +728,7 @@ def sharded_wavefront_route(
         check_vma=False,
     )
     return fn(
-        schedule.level, schedule.pred_idx, schedule.pred_mask,
+        schedule.level, schedule.pred_idx, schedule.pred_mask, t_idx_in,
         schedule.bnd_out, schedule.bnd_tgt, schedule.bnd_gap,
         channels.length, channels.slope, channels.x_storage, twd_in, ssd_in,
         spatial_params["n"], spatial_params["p_spatial"], spatial_params["q_spatial"],

@@ -655,7 +655,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
 
             batch_stream = (
                 map(_prepare, _batches()) if multiprocess
-                else prefetch(_batches(), _prepare)
+                else prefetch(
+                    _batches(), _prepare, ahead=cfg.experiment.prefetch_ahead
+                )
             )
             for i, rd, payload, attrs, obs_daily, obs_mask, anomaly, phase_s in batch_stream:
                 # This batch's trace root (same ids the prefetch thread used

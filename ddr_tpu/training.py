@@ -327,6 +327,7 @@ def make_sharded_train_step(
     tau: int,
     warmup: int,
     optimizer: optax.GradientTransformation,
+    adjoint: str = "ad",
     collect_health: bool = False,
     donate: bool = True,
 ):
@@ -346,6 +347,12 @@ def make_sharded_train_step(
     from the topological-range-partitioned adjacency; ``channels``/``gauges`` and
     every per-reach call-time array must be in the same partitioned order.
     Loss/windowing semantics match :func:`make_train_step` exactly.
+
+    ``adjoint`` picks the routing backward: ``"ad"`` (jax AD of the forward
+    waves) or ``"analytic"`` (the transposed-table reverse sweep — requires a
+    ``schedule`` built with transposed tables; grad parity with AD is pinned
+    in tests). ``adjoint="auto"`` is resolved BEFORE this builder
+    (:func:`ddr_tpu.parallel.select.select_adjoint_tuned`).
     """
     from ddr_tpu.parallel.wavefront import sharded_wavefront_route
 
@@ -358,7 +365,7 @@ def make_sharded_train_step(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
         runoff, _ = sharded_wavefront_route(
-            mesh, schedule, channels, spatial, q_prime, bounds=bounds
+            mesh, schedule, channels, spatial, q_prime, bounds=bounds, adjoint=adjoint
         )
         loss, daily = masked_l1_daily(
             jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup
@@ -387,6 +394,7 @@ def make_sharded_chunked_train_step(
     warmup: int,
     optimizer: optax.GradientTransformation,
     remat_bands: bool = False,
+    adjoint: str = "ad",
     collect_health: bool = False,
     donate: bool = True,
 ):
@@ -406,6 +414,9 @@ def make_sharded_chunked_train_step(
     ``remat_bands`` (``experiment.remat_bands``) applies band-level backward
     checkpointing on a :class:`StackedSharded` layout; the layout is fixed at
     builder time, so requesting it with a chunked layout raises immediately.
+    ``adjoint`` (``"ad"``/``"analytic"``) picks the per-band routing backward
+    on either layout and composes with ``remat_bands``; ``"auto"`` is resolved
+    before this builder (:func:`ddr_tpu.parallel.select.select_adjoint_tuned`).
     """
     from ddr_tpu.parallel.chunked import route_chunked_sharded
     from ddr_tpu.parallel.stacked import StackedSharded, route_stacked_sharded
@@ -426,7 +437,10 @@ def make_sharded_chunked_train_step(
             raw, parameter_ranges, log_space_parameters, defaults, n_segments
         )
         kw = {"remat_bands": remat_bands} if stacked else {}
-        runoff, _ = router(mesh, layout, channels, spatial, q_prime, bounds=bounds, **kw)
+        runoff, _ = router(
+            mesh, layout, channels, spatial, q_prime, bounds=bounds,
+            adjoint=adjoint, **kw,
+        )
         loss, daily = masked_l1_daily(
             jax.vmap(gauges.aggregate)(runoff), obs_daily, obs_mask, tau, warmup
         )

@@ -67,13 +67,20 @@ __all__ = [
     "last_selection",
     "record_selection",
     "reset_tune_memo",
+    "ADJOINTS",
+    "score_adjoints",
     "score_candidates",
+    "tune_adjoint",
     "tune_engine",
     "tune_single_device",
 ]
 
 #: The mesh-query candidate space (mirrors route_parallel's engine contract).
 ENGINES = ("gspmd", "sharded-wavefront", "stacked-sharded")
+
+#: The backward-pass candidate space (mirrors the sharded routers' ``adjoint``
+#: contract): the analytic reverse-wavefront sweep vs jax AD of the forward.
+ADJOINTS = ("analytic", "ad")
 
 #: A scored challenger must beat the policy prior's estimate by this fraction
 #: or the prior is retained — near-model-ties must not flap the fleet between
@@ -310,6 +317,72 @@ def score_candidates(
     return out
 
 
+def score_adjoints(
+    *,
+    platform: str,
+    n: int,
+    depth: int,
+    n_shards: int,
+    t_steps: int,
+    card_analytic: Any = None,
+    card_ad: Any = None,
+    card_t: int | None = None,
+    cal: dict[str, float] | None = None,
+    hbm_bytes: int | None = None,
+) -> list[Candidate]:
+    """Score the backward-pass candidate space (``analytic`` vs ``ad``).
+
+    Both adjoints pay the same STRUCTURAL bill — a forward sweep plus one
+    reverse sweep of ``T + depth`` waves each (the analytic backward re-psums
+    the transposed boundary tables wave-for-wave; AD transposes the forward
+    scan wave-for-wave) — so the decision rides entirely on the grad-analog
+    ProgramCards: AD's backward streams the saved forward residuals back
+    through memory while the analytic sweep recomputes coefficients from the
+    O(n) channel state, and the cards' flops/bytes expose exactly that gap.
+    ``card_*`` is any object with ``flops`` / ``bytes_accessed`` /
+    ``peak_bytes`` (a ProgramCard or a synthetic stand-in in tests) profiling
+    ``value_and_grad`` of the routing physics under that adjoint at ``card_t``
+    timesteps.
+    """
+    cal = cal or calibration(platform)
+    t = max(1, int(t_steps))
+    d = max(1, int(depth))
+    shards = max(1, int(n_shards))
+    waves = 2 * (t + d)
+    scale = (t / max(1, int(card_t))) if card_t else 1.0
+
+    out: list[Candidate] = []
+    for adj, card in (("analytic", card_analytic), ("ad", card_ad)):
+        flops = float(getattr(card, "flops", 0.0) or 0.0)
+        bytes_acc = float(getattr(card, "bytes_accessed", 0.0) or 0.0)
+        peak = float(getattr(card, "peak_bytes", 0.0) or 0.0)
+        t_comp = (
+            max(flops / cal["flops_per_s"], bytes_acc / cal["bytes_per_s"])
+            * scale
+            / shards
+        )
+        hbm_ok = (
+            hbm_bytes is None or peak <= 0 or peak / shards <= _HBM_FRACTION * hbm_bytes
+        )
+        out.append(
+            Candidate(
+                engine=adj,
+                feasible=hbm_ok,
+                reason=""
+                if hbm_ok
+                else (
+                    f"est per-shard peak {peak / shards / 2**30:.2f} GiB exceeds "
+                    f"{_HBM_FRACTION:.0%} of HBM ({hbm_bytes / 2**30:.2f} GiB)"
+                ),
+                est_s=t_comp + waves * cal["wave_s"],
+                waves=waves,
+                collectives=waves,
+            )
+        )
+    out.sort(key=lambda c: (not c.feasible, c.est_s if c.est_s is not None else 1e30))
+    return out
+
+
 def _pick(candidates: list[Candidate], prior: str) -> tuple[Candidate | None, bool]:
     """The winner under the prior-margin rule. Returns ``(winner, is_prior)``;
     ``(None, _)`` when nothing is feasible (caller falls back to the policy)."""
@@ -388,6 +461,52 @@ def _physics_card(
 
     card, _ = build_card(
         _analog, ch, sp, qp, name="tune/route-analog", engine="step",
+        compute_dtype=dtype,
+    )
+    global _CARD_BUILDS
+    _CARD_BUILDS += 1
+    _CARD_MEMO[key] = card
+    return card
+
+
+def _grad_card(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    t_card: int,
+    dtype: str,
+    topo_sha: str,
+    adjoint: str,
+):
+    """AOT-compile ``value_and_grad`` of the topology's wavefront routing
+    analog under ``adjoint`` ∈ :data:`ADJOINTS` and return its ProgramCard
+    (memoized per topology/window/dtype/adjoint). This is the backward-pass
+    pricing artifact: the same single-device program the sharded routers run
+    per shard, differentiated the way training differentiates it (w.r.t. the
+    spatial parameters), so the card's flops/bytes carry the AD-residual vs
+    analytic-recompute difference the planner is asked to price."""
+    key = (topo_sha, int(t_card), dtype, f"grad:{adjoint}")
+    hit = _CARD_MEMO.get(key)
+    if hit is not None:
+        return hit
+    import jax
+
+    from ddr_tpu.observability.costs import build_card
+    from ddr_tpu.routing.mc import Bounds, route
+    from ddr_tpu.routing.network import build_network
+
+    network = build_network(
+        np.asarray(rows), np.asarray(cols), int(n), fused=False, wavefront=True
+    )
+    ch, sp, qp = _analog_inputs(int(n), int(t_card), concrete=False)
+
+    def _loss(ch, sp, qp):
+        out = route(network, ch, sp, qp, bounds=Bounds(), dtype=dtype, adjoint=adjoint)
+        return (out.runoff * out.runoff).mean()
+
+    _analog = jax.jit(jax.value_and_grad(_loss, argnums=1))
+    card, _ = build_card(
+        _analog, ch, sp, qp, name=f"tune/grad-analog-{adjoint}", engine="wavefront",
         compute_dtype=dtype,
     )
     global _CARD_BUILDS
@@ -611,6 +730,109 @@ def tune_engine(
     _emit_tune_event(
         res, mode=mode, platform=platform, n=n, depth=depth, max_in=max_in,
         n_shards=n_shards, topo_sha=topo_sha, dtype=dtype, kernel=kernel,
+    )
+    return res
+
+
+def tune_adjoint(
+    platform: str,
+    rows: Any,
+    cols: Any,
+    n: int,
+    depth: int,
+    max_in: int,
+    n_shards: int,
+    *,
+    topo_sha: str,
+    mesh_desc: dict[str, Any] | None = None,
+    dtype: str = "fp32",
+    t_steps: int | None = None,
+    hbm_bytes: int | None = None,
+    card_analytic: Any = None,
+    card_ad: Any = None,
+) -> TuneResult:
+    """Resolve one (topology, mesh, dtype) query to a backward pass.
+
+    The sharded routers' ``adjoint="auto"`` entry: the same decision ladder as
+    :func:`tune_engine` (in-process memo -> persistent cache -> grad-analog
+    card scoring -> the hand prior on any failure), but over :data:`ADJOINTS`
+    and keyed under the reserved ``kernel="adjoint"`` namespace slot so
+    adjoint records never collide with engine records for the same topology.
+
+    The hand prior is ``analytic`` — the measured single-chip winner
+    (BENCH_r06: ~2.4x the AD train step) and :func:`ddr_tpu.routing.mc.route`'s
+    own auto-resolution whenever transposed tables exist — so a platform must
+    beat it by :data:`PRIOR_MARGIN` on the card model for AD to be selected.
+    ``card_analytic``/``card_ad`` inject pre-built ProgramCards (tests).
+    All host-side.
+    """
+    mode = autotune_mode()
+    t = int(t_steps) if t_steps else 24
+    prior = "analytic"
+    if mode == "off":
+        return TuneResult(engine=prior, source="policy")
+
+    key = _cache.plan_key(topo_sha, mesh_desc, dtype, "adjoint")
+    hit = _TUNE_MEMO.get(key)
+    if hit is not None:
+        return hit
+
+    stored = _cache.load_plan(key)
+    if stored is not None and stored.get("engine") in ADJOINTS:
+        res = TuneResult(engine=str(stored["engine"]), source="cached", key=key)
+        _TUNE_MEMO[key] = res
+        _emit_tune_event(
+            res, mode=mode, platform=platform, n=n, depth=depth, max_in=max_in,
+            n_shards=n_shards, topo_sha=topo_sha, dtype=dtype, kernel="adjoint",
+        )
+        return res
+
+    try:
+        t_card = min(t, _T_CARD_MAX)
+        if card_analytic is None:
+            card_analytic = _grad_card(rows, cols, n, t_card, dtype, topo_sha, "analytic")
+        if card_ad is None:
+            card_ad = _grad_card(rows, cols, n, t_card, dtype, topo_sha, "ad")
+        candidates = score_adjoints(
+            platform=platform, n=n, depth=depth, n_shards=n_shards, t_steps=t,
+            card_analytic=card_analytic, card_ad=card_ad, card_t=t_card,
+            hbm_bytes=hbm_bytes,
+        )
+        winner, _ = _pick(candidates, prior)
+        if winner is None:
+            res = TuneResult(engine=prior, source="policy", key=key, candidates=candidates)
+        else:
+            res = TuneResult(
+                engine=winner.engine, source="scored", key=key, candidates=candidates
+            )
+            _cache.store_plan(
+                key,
+                {
+                    "engine": res.engine,
+                    "source": res.source,
+                    "topology": str(topo_sha),
+                    "mesh": _cache._mesh_key_fields(mesh_desc),
+                    "platform": platform,
+                    "dtype": dtype,
+                    "kernel": "adjoint",
+                    "n": int(n),
+                    "depth": int(depth),
+                    "max_in": int(max_in),
+                    "n_shards": int(n_shards),
+                    "t_steps": t,
+                    "candidates": [c.brief() for c in candidates],
+                },
+            )
+    except Exception as e:
+        log.warning(
+            f"adjoint autotune scoring failed ({e}); falling back to '{prior}'"
+        )
+        res = TuneResult(engine=prior, source="policy", key=key)
+
+    _TUNE_MEMO[key] = res
+    _emit_tune_event(
+        res, mode=mode, platform=platform, n=n, depth=depth, max_in=max_in,
+        n_shards=n_shards, topo_sha=topo_sha, dtype=dtype, kernel="adjoint",
     )
     return res
 

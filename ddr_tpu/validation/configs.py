@@ -163,6 +163,26 @@ class ExperimentConfig(BaseModel):
             "only meaningful when the batch topology auto-selects the stacked engine"
         ),
     )
+    adjoint: str = Field(
+        default="auto",
+        description=(
+            "Routing backward for the sharded engines: 'analytic' (transposed-"
+            "table reverse-wavefront sweep, the measured single-chip winner), "
+            "'ad' (jax AD of the forward waves), or 'auto' (the tuning planner "
+            "prices both from grad-analog ProgramCards per platform, "
+            "ddr_tpu.tuning.planner.tune_adjoint). Ignored by the 'none'/'gspmd' "
+            "paths, whose single-program route resolves its own adjoint"
+        ),
+    )
+    prefetch_ahead: int = Field(
+        default=1,
+        ge=1,
+        description=(
+            "Batches the host-side prefetch pool prepares ahead of the device "
+            "step (ddr_tpu.geodatazoo.loader.prefetch ahead=N: N workers, "
+            "ordered, deterministic); 1 = the old single-worker overlap"
+        ),
+    )
     test_start_time: str | None = Field(
         default=None, description="Evaluation period start for train-and-test (default 1995/10/01)"
     )
@@ -185,6 +205,15 @@ class ExperimentConfig(BaseModel):
         if v not in PARALLEL_MODES:
             raise ValueError(
                 f"experiment.parallel must be one of {PARALLEL_MODES}, got {v!r}"
+            )
+        return v
+
+    @field_validator("adjoint")
+    @classmethod
+    def _adjoint_known(cls, v: str) -> str:
+        if v not in ("auto", "analytic", "ad"):
+            raise ValueError(
+                f"experiment.adjoint must be 'auto', 'analytic' or 'ad', got {v!r}"
             )
         return v
 

@@ -38,6 +38,21 @@ regression. ``crps_degraded`` (the deliberately-biased control arm) and
 ``spread_skill`` (ideal is 1.0, neither direction is "better") are never
 flagged.
 
+``dryrun_multichip`` records (``MULTICHIP_r*.json`` — the driver's
+``{n_devices, rc, ok, tail}`` wrappers around the dryrun's stdout) gate against
+the previous MULTICHIP round by round number: every timed scale-phase entry
+(``<name>=<N>ms (<R>M rt/s)`` in the tail) is parsed into a ``<name>_ms`` field
+that warns when it GROWS past the threshold. Two gates are *intra-record* —
+they hold against the fresh record alone, no baseline needed: the sharded
+analytic-adjoint train step must beat the AD train step on the same mesh
+(``sharded_wavefront_train_analytic_ms < sharded_wavefront_train_ms``), and
+the analytic-vs-AD gradient parity printed by the small phase must stay within
+``GRAD_PARITY_MAX`` (the tolerance the parity tests pin). A virtual 8-device
+CPU mesh's wall times scale with the host's real core count, so records
+carrying ``host_nproc`` pair it like a device axis — cross-host-size rounds
+(including one declared vs one undeclared host) downgrade to informational;
+the intra-record gates hold regardless, they never leave the fresh record.
+
 Records from different devices are never compared as regressions: a CPU
 fallback round against a TPU round says nothing about the code, so a device
 mismatch downgrades every finding to informational. Compute dtype pairs the
@@ -53,6 +68,7 @@ Usage::
     python scripts/check_bench_regression.py fresh.json --baseline BENCH_r05.json
     python scripts/check_bench_regression.py LOADTEST_x.json     # vs latest LOADTEST_*
     python scripts/check_bench_regression.py VERIFY_x.json       # vs latest VERIFY_*
+    python scripts/check_bench_regression.py MULTICHIP_r06.json  # vs previous round
     python scripts/check_bench_regression.py --run               # run bench.py first
     python scripts/check_bench_regression.py fresh.json --strict # exit 1 on regression
 
@@ -155,6 +171,91 @@ VERIFY_DOWN_KEYS = ("crps", "brier")
 #: forecast–observation pairs gates like a throughput drop — less evidence
 #: is a verification-plane regression even when the scores held.
 VERIFY_UP_KEYS = ("matched_samples",)
+
+
+#: Timed scale-phase entries of a MULTICHIP dryrun record (milliseconds —
+#: SMALLER is better; growth past the threshold warns like latency). Parsed
+#: out of the record's ``tail`` text by :func:`parse_multichip`.
+MULTICHIP_STEP_KEYS = (
+    "gspmd_step_ms",
+    "pipelined_step_ms",
+    "sharded_wavefront_ms",
+    "sharded_wavefront_train_ms",
+    "sharded_wavefront_train_analytic_ms",
+)
+
+#: Ceiling for the sharded analytic-vs-AD gradient parity a MULTICHIP dryrun
+#: prints — the same relative tolerance the grad-parity tests pin
+#: (tests/parallel/test_sharded_analytic_adjoint.py).
+GRAD_PARITY_MAX = 1e-5
+
+
+def is_multichip_record(rec: dict) -> bool:
+    """Whether a record is a ``dryrun_multichip`` wrapper (MULTICHIP_r*)."""
+    return rec.get("kind") == "multichip" or (
+        "n_devices" in rec and "tail" in rec
+    )
+
+
+def parse_multichip(rec: dict) -> dict:
+    """Flatten a MULTICHIP record's ``tail`` stdout into numeric fields.
+
+    The dryrun prints one scale line — ``<name>=<N>ms (<R>M rt/s)`` per timed
+    entry — and the small phase prints ``analytic adjoint grad parity <X> vs
+    AD``. Both become flat fields (``<name>_ms``, ``analytic_grad_parity``) so
+    the generic :func:`compare` and the intra-record gates can see them.
+    Entries absent from older rounds simply don't appear (compare skips
+    missing keys).
+    """
+    out = {
+        k: rec.get(k) for k in ("n_devices", "rc", "ok", "device") if k in rec
+    }
+    # a virtual 8-device CPU mesh's wall times scale with the HOST's real
+    # core count, so rounds that declare it pair like a device axis: records
+    # from differently-sized hosts downgrade to info exactly like a CPU round
+    # vs a TPU round (rounds predating the field just compare normally)
+    if "host_nproc" in rec and "device" not in rec:
+        out["device"] = f"cpu-host{rec['host_nproc']}"
+    tail = str(rec.get("tail") or "")
+    for m in re.finditer(r"(\w+)=(\d+(?:\.\d+)?)ms \((\d+(?:\.\d+)?)M rt/s\)", tail):
+        out[f"{m.group(1)}_ms"] = float(m.group(2))
+    m = re.search(r"analytic adjoint grad parity ([0-9.eE+-]+) vs AD", tail)
+    if m:
+        out["analytic_grad_parity"] = float(m.group(1))
+    return out
+
+
+def multichip_self_check(parsed: dict) -> list[dict]:
+    """Intra-record MULTICHIP gates — they hold with no baseline at all.
+
+    The analytic adjoint exists to be FASTER than AD on the same mesh (the
+    whole point of the transposed-table backward), so a round where the
+    analytic train step is not strictly quicker than the AD train step it was
+    timed next to is a regression regardless of history; likewise a gradient
+    parity past :data:`GRAD_PARITY_MAX` means the backward is no longer the
+    same math. Findings use the same shape as :func:`compare`.
+    """
+    findings: list[dict] = []
+    an = parsed.get("sharded_wavefront_train_analytic_ms")
+    ad = parsed.get("sharded_wavefront_train_ms")
+    if isinstance(an, (int, float)) and isinstance(ad, (int, float)) and ad:
+        findings.append({
+            "key": "analytic_vs_ad_train_step",
+            "fresh": an,
+            "baseline": ad,
+            "ratio": round(an / ad, 3),
+            "status": "ok" if an < ad else "regression",
+        })
+    gp = parsed.get("analytic_grad_parity")
+    if isinstance(gp, (int, float)):
+        findings.append({
+            "key": "analytic_grad_parity",
+            "fresh": gp,
+            "baseline": GRAD_PARITY_MAX,
+            "ratio": None,
+            "status": "ok" if gp <= GRAD_PARITY_MAX else "regression",
+        })
+    return findings
 
 
 def is_loadtest_record(rec: dict) -> bool:
@@ -335,6 +436,24 @@ def latest_verify_baseline(
     return None
 
 
+def latest_multichip_baseline(
+    root: Path = REPO_ROOT, exclude: Path | None = None
+) -> Path | None:
+    """The highest-round MULTICHIP_r* record (round number, ties by name —
+    the same ordering discipline as BENCH rounds; the dryrun records are a
+    numbered history, not free-form labels)."""
+
+    def key(p: Path) -> tuple[int, str]:
+        m = re.match(r"MULTICHIP_r(\d+)", p.name)
+        return (int(m.group(1)) if m else -1, p.name)
+
+    cands = sorted(root.glob("MULTICHIP_r*.json"), key=key)
+    if exclude is not None:
+        resolved = exclude.resolve()
+        cands = [p for p in cands if p.resolve() != resolved]
+    return cands[-1] if cands else None
+
+
 def load_record(path: Path) -> dict:
     """A bench record, in either stored form.
 
@@ -377,7 +496,7 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2) -> list[dict]:
     device_mismatch = device_mismatch or dtype_mismatch
     smaller_is_better = (
         MEMORY_KEYS + LATENCY_KEYS + RATE_KEYS + CHAOS_DOWN_KEYS
-        + VERIFY_DOWN_KEYS
+        + VERIFY_DOWN_KEYS + MULTICHIP_STEP_KEYS
     )
     for key in (
         THROUGHPUT_KEYS + SERVING_UP_KEYS + VERIFY_UP_KEYS + RATIO_KEYS
@@ -504,7 +623,17 @@ def main(argv: list[str] | None = None) -> int:
     # chaos additionally pairs by MODE — a train-resume recovery_s against a
     # serve-replica one is noise
     exclude = Path(args.fresh) if args.fresh else None
-    if is_chaos_record(fresh):
+    multichip = is_multichip_record(fresh)
+    self_findings: list[dict] = []
+    if multichip:
+        # multichip dryrun wrappers carry their numbers in stdout text; the
+        # analytic-beats-AD and grad-parity gates are intra-record, so they
+        # hold even for the first round with no earlier baseline
+        fresh = parse_multichip(fresh)
+        self_findings = multichip_self_check(fresh)
+        pattern = "MULTICHIP_r*.json"
+        found = latest_multichip_baseline(exclude=exclude)
+    elif is_chaos_record(fresh):
         pattern = "CHAOS_*.json"
         found = latest_chaos_baseline(
             mode=fresh.get("mode"), exclude=exclude,
@@ -526,18 +655,32 @@ def main(argv: list[str] | None = None) -> int:
         pattern = f"BENCH_r*.json [compute_dtype={record_dtype(fresh)}]"
         found = latest_bench_baseline(dtype=record_dtype(fresh), exclude=exclude)
     baseline_path = Path(args.baseline) if args.baseline else found
-    if baseline_path is None:
+    if baseline_path is None and not self_findings:
         print(f"check_bench_regression: no {pattern} baseline found", file=sys.stderr)
         return 0
-    baseline = load_record(baseline_path)
-
-    findings = compare(fresh, baseline, args.threshold)
+    if baseline_path is None:
+        findings = self_findings
+        baseline_name = "(intra-record gates)"
+    else:
+        baseline = load_record(baseline_path)
+        if multichip:
+            baseline = parse_multichip(baseline)
+            # exactly one round declaring its host size means the other's wall
+            # times are not comparable (the field exists precisely because a
+            # differently-sized host recorded them) — pair as a mismatch
+            # rather than guessing a default; rounds that BOTH predate the
+            # field still gate against each other normally
+            if ("device" in fresh) != ("device" in baseline):
+                target = baseline if "device" not in baseline else fresh
+                target["device"] = "undeclared-host"
+        findings = self_findings + compare(fresh, baseline, args.threshold)
+        baseline_name = baseline_path.name
     if not findings:
-        print(f"no comparable fields between fresh record and {baseline_path.name}")
+        print(f"no comparable fields between fresh record and {baseline_name}")
         return 0
 
     width = max(len(f["key"]) for f in findings)
-    print(f"fresh vs {baseline_path.name} (warn below {1 - args.threshold:.0%}):")
+    print(f"fresh vs {baseline_name} (warn below {1 - args.threshold:.0%}):")
     regressions = 0
     for f in findings:
         mark = {"ok": " ", "info": "i", "regression": "!"}[f["status"]]
@@ -551,7 +694,7 @@ def main(argv: list[str] | None = None) -> int:
             )
             print(
                 f"check_bench_regression: WARNING: {f['key']} {change} "
-                f"{baseline_path.name}",
+                f"{baseline_name}",
                 file=sys.stderr,
             )
     return 1 if (args.strict and regressions) else 0

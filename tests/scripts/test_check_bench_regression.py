@@ -429,6 +429,144 @@ class TestVerifyRecords:
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+class TestMultichipRecords:
+    """Multichip dryrun gating: MULTICHIP_r* wrappers parse their numbers out
+    of the dryrun's stdout ``tail``; the analytic-beats-AD train-step gate and
+    the grad-parity ceiling hold intra-record (no baseline needed), while the
+    per-entry step times gate against the previous round like latency."""
+
+    TAIL = (
+        "dryrun_multichip OK: 8 devices, N=128 reaches in topological-range "
+        "shards, one GSPMD train step (loss=0.9108) + sharded-wavefront train "
+        "step (loss=0.9108, grad parity 1.81e-07 vs single-program, analytic "
+        "adjoint grad parity 2.75e-07 vs AD) + pipelined wavefront route\n"
+        "scale dryrun N=8192 T=48 on 8 virtual devices: gspmd_step=212ms "
+        "(1.9M rt/s), sharded_wavefront_train=402ms (1.0M rt/s), "
+        "sharded_wavefront_train_analytic=171ms (2.3M rt/s)\n"
+    )
+
+    def test_is_multichip_record(self):
+        mod = _load()
+        assert mod.is_multichip_record({"n_devices": 8, "tail": "..."})
+        assert mod.is_multichip_record({"kind": "multichip"})
+        assert not mod.is_multichip_record({"kind": "loadtest"})
+        assert not mod.is_multichip_record({"value": 100.0})
+
+    def test_parse_multichip_extracts_timings_and_parity(self):
+        parsed = _load().parse_multichip({"n_devices": 8, "tail": self.TAIL})
+        assert parsed["gspmd_step_ms"] == 212.0
+        assert parsed["sharded_wavefront_train_ms"] == 402.0
+        assert parsed["sharded_wavefront_train_analytic_ms"] == 171.0
+        assert parsed["analytic_grad_parity"] == pytest.approx(2.75e-07)
+
+    def test_analytic_beating_ad_is_ok(self):
+        mod = _load()
+        by_key = {f["key"]: f for f in mod.multichip_self_check(
+            {"sharded_wavefront_train_ms": 402.0,
+             "sharded_wavefront_train_analytic_ms": 171.0,
+             "analytic_grad_parity": 2.75e-07})}
+        assert by_key["analytic_vs_ad_train_step"]["status"] == "ok"
+        assert by_key["analytic_grad_parity"]["status"] == "ok"
+
+    def test_analytic_slower_than_ad_flags(self):
+        """The whole point of the transposed-table backward: a round where
+        the analytic step stops beating AD regresses with NO baseline."""
+        mod = _load()
+        by_key = {f["key"]: f for f in mod.multichip_self_check(
+            {"sharded_wavefront_train_ms": 402.0,
+             "sharded_wavefront_train_analytic_ms": 450.0})}
+        assert by_key["analytic_vs_ad_train_step"]["status"] == "regression"
+
+    def test_grad_parity_past_tolerance_flags(self):
+        mod = _load()
+        by_key = {f["key"]: f for f in mod.multichip_self_check(
+            {"analytic_grad_parity": 3e-05})}
+        assert by_key["analytic_grad_parity"]["status"] == "regression"
+
+    def test_step_time_growth_gates_against_previous_round(self):
+        mod = _load()
+        fresh = {"sharded_wavefront_train_analytic_ms": 300.0,
+                 "gspmd_step_ms": 215.0}
+        base = {"sharded_wavefront_train_analytic_ms": 171.0,
+                "gspmd_step_ms": 212.0}
+        by_key = {f["key"]: f for f in mod.compare(fresh, base, threshold=0.2)}
+        assert by_key["sharded_wavefront_train_analytic_ms"]["status"] == "regression"
+        assert by_key["gspmd_step_ms"]["status"] == "ok"
+
+    def test_latest_multichip_baseline_picks_highest_round(self, tmp_path):
+        mod = _load()
+        for name in ("MULTICHIP_r01.json", "MULTICHIP_r06.json", "MULTICHIP_r03.json"):
+            (tmp_path / name).write_text("{}")
+        picked = mod.latest_multichip_baseline(tmp_path)
+        assert picked.name == "MULTICHIP_r06.json"
+        # a fresh record never self-selects as its own baseline
+        assert mod.latest_multichip_baseline(
+            tmp_path, exclude=picked
+        ).name == "MULTICHIP_r03.json"
+
+    def test_repo_multichip_round_passes_own_gates(self):
+        """The committed latest MULTICHIP round must parse and hold its own
+        intra-record gates — the acceptance shape this kind exists for."""
+        mod = _load()
+        latest = mod.latest_multichip_baseline()
+        assert latest is not None
+        parsed = mod.parse_multichip(mod.load_record(latest))
+        assert parsed.get("sharded_wavefront_train_ms")
+        checks = mod.multichip_self_check(parsed)
+        assert all(f["status"] == "ok" for f in checks)
+
+    def test_host_size_mismatch_downgrades_step_times(self, tmp_path):
+        """A 1-core host's wall times vs an undeclared (driver) host measure
+        the machine, not the code — times go informational, but the
+        intra-record analytic-vs-AD gate still holds (it never leaves the
+        fresh record)."""
+        rec = {"n_devices": 8, "host_nproc": 1, "rc": 0, "ok": True,
+               "tail": self.TAIL}
+        fresh = tmp_path / "MULTICHIP_r07.json"
+        fresh.write_text(json.dumps(rec, indent=2))
+        base = tmp_path / "MULTICHIP_r06.json"
+        slow_tail = self.TAIL.replace("gspmd_step=212ms", "gspmd_step=20ms")
+        base.write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True, "tail": slow_tail},
+            indent=2))
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "undeclared-host" in proc.stdout
+        assert "analytic_vs_ad_train_step" in proc.stdout
+
+    def test_cli_gates_multichip_record(self, tmp_path):
+        rec = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+               "tail": self.TAIL}
+        fresh = tmp_path / "MULTICHIP_r07.json"
+        fresh.write_text(json.dumps(rec, indent=2))
+        base = tmp_path / "MULTICHIP_r06.json"
+        base.write_text(json.dumps(rec, indent=2))
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(fresh), "--baseline", str(base),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "analytic_vs_ad_train_step" in proc.stdout
+        # an analytic step slower than AD fails strict even vs itself
+        bad = dict(rec, tail=self.TAIL.replace(
+            "sharded_wavefront_train_analytic=171ms (2.3M rt/s)",
+            "sharded_wavefront_train_analytic=460ms (0.9M rt/s)"))
+        badp = tmp_path / "MULTICHIP_r08.json"
+        badp.write_text(json.dumps(bad, indent=2))
+        proc = subprocess.run(
+            [sys.executable, str(SCRIPT), str(badp), "--baseline", str(base),
+             "--strict"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "analytic_vs_ad_train_step" in proc.stderr
+
+
 class TestLoadRecord:
     def test_unwraps_driver_wrapper(self, tmp_path):
         """The committed BENCH_r*.json form: pretty-printed {n,cmd,rc,tail,
