@@ -159,6 +159,21 @@ class Router:
         self._probed_depth = {r.name: 0 for r in self.replicas}
         self._dispatched = {r.name: 0 for r in self.replicas}
         self._errors = 0
+        # Performance sentinel over the probed queue depths: one detector per
+        # replica (signal "<name>.queue_depth"), fed at probe cadence, so one
+        # replica falling behind its peers fires a fleet-scoped anomaly while
+        # the group as a whole still looks healthy. Lazy import keeps the
+        # fleet package importable without the observability extras wired.
+        self._sentinel = None
+        try:
+            from ddr_tpu.observability.sentinel import Sentinel, SentinelConfig
+
+            cfg = SentinelConfig.from_env()
+            if cfg.enabled:
+                self._sentinel = Sentinel(cfg, scope="fleet")
+        except Exception:
+            log.exception("fleet sentinel disabled (bad DDR_SENTINEL_* config)")
+        self._probes = 0
         self._prober = threading.Thread(
             target=self._probe_loop, name="ddr-fleet-prober", daemon=True
         )
@@ -274,6 +289,17 @@ class Router:
                     self._mark_success(replica)
                 else:
                     self._mark_failure(replica)
+                if self._sentinel is not None and ok:
+                    try:
+                        self._sentinel.observe(
+                            f"{replica.name}.queue_depth",
+                            float(depth),
+                            step=self._probes,
+                            direction="high",
+                        )
+                    except Exception:
+                        log.exception("fleet sentinel observe failed")
+            self._probes += 1
 
     # ---- inspection / lifecycle ----
 
@@ -298,6 +324,9 @@ class Router:
                     for r in self.replicas
                 ],
                 "unroutable_errors": self._errors,
+                "anomalies": (
+                    None if self._sentinel is None else self._sentinel.status()
+                ),
             }
 
     def close(self) -> None:

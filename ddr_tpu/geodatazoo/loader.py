@@ -16,11 +16,43 @@ from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
 
-__all__ = ["DataLoader", "prefetch"]
+__all__ = ["DataLoader", "PrefetchStats", "prefetch"]
+
+
+class PrefetchStats:
+    """Live occupancy of one :func:`prefetch` pool (the stats hook the train
+    loop samples onto ``heartbeat`` events / the ``ddr_prefetch_depth``
+    gauge).
+
+    ``depth()`` counts batches that are PREPARED and waiting for the consumer
+    — sustained 0 while the loop runs means every ``next()`` blocks on host
+    prep (a data-bound pipeline; raise ``experiment.prefetch_ahead``);
+    ``in_flight()`` counts everything submitted and not yet consumed
+    (prepared + still preparing). Both are None when no pool is attached
+    (multiprocess mode prepares inline). Reads are snapshot-copies of the
+    pool's pending list, safe from any thread; one instance can be re-armed
+    across epochs (each ``prefetch`` call re-attaches it).
+    """
+
+    def __init__(self) -> None:
+        self._pending: list | None = None
+
+    def depth(self) -> int | None:
+        pending = self._pending
+        if pending is None:
+            return None
+        return sum(1 for f in list(pending) if f.done())
+
+    def in_flight(self) -> int | None:
+        pending = self._pending
+        return None if pending is None else len(pending)
 
 
 def prefetch(
-    iterable: Iterable[Any], prepare: Callable[[Any], Any], ahead: int = 1
+    iterable: Iterable[Any],
+    prepare: Callable[[Any], Any],
+    ahead: int = 1,
+    stats: PrefetchStats | None = None,
 ) -> Iterator[Any]:
     """Map ``prepare`` over ``iterable`` in a pool of ``ahead`` background
     threads, staying up to ``ahead`` prepared items in front of the consumer.
@@ -48,6 +80,11 @@ def prefetch(
     ``Dates.snapshot()`` and a fresh RoutingData (see
     ``BaseGeoDataset.collate_fn``); ``ParallelTrainer.prepare`` is
     prefetch-thread safe by contract.
+
+    ``stats`` (a :class:`PrefetchStats`) attaches the live occupancy hook:
+    while this generator runs, ``stats.depth()`` reports how many prepared
+    batches are waiting — the number the train loop samples onto heartbeats
+    and the ``ddr_prefetch_depth`` gauge.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -55,6 +92,8 @@ def prefetch(
     pool = ThreadPoolExecutor(max_workers=ahead)
     try:
         pending: list = []
+        if stats is not None:
+            stats._pending = pending  # occupancy hook (PrefetchStats)
         it = iter(iterable)
         try:
             while len(pending) <= ahead:
@@ -74,6 +113,8 @@ def prefetch(
         # a prepare error must not block for one full host-prep latency on a
         # batch nobody will consume: drop queued work and return immediately
         # (an already-RUNNING prepare still finishes in its thread, harmlessly).
+        if stats is not None:
+            stats._pending = None  # pool gone; depth reads None, not stale
         pool.shutdown(wait=False, cancel_futures=True)
 
 

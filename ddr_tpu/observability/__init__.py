@@ -71,6 +71,17 @@ from ddr_tpu.observability.recovery import (
     RecoveryGiveUp,
     RecoverySupervisor,
 )
+from ddr_tpu.observability.sentinel import (
+    BOTTLENECK_CLASSES,
+    SENTINEL_SIGNALS,
+    BottleneckAttributor,
+    EwmaCusumDetector,
+    Sentinel,
+    SentinelConfig,
+    attribute_steps,
+    classify_step,
+    render_attribution,
+)
 from ddr_tpu.observability.skill import SkillConfig, SkillTracker
 from ddr_tpu.observability.verification import (
     ForecastLedger,
@@ -154,6 +165,15 @@ __all__ = [
     "ReachStats",
     "SkillConfig",
     "SkillTracker",
+    "BOTTLENECK_CLASSES",
+    "SENTINEL_SIGNALS",
+    "BottleneckAttributor",
+    "EwmaCusumDetector",
+    "Sentinel",
+    "SentinelConfig",
+    "attribute_steps",
+    "classify_step",
+    "render_attribution",
     "ForecastLedger",
     "VerificationScorer",
     "VerifyConfig",

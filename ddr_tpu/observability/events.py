@@ -102,7 +102,12 @@ __all__ = [
 #: batch from the verification ledger (join counters + the bounded streaming
 #: scorer rollup: CRPS / Brier-with-reliability-decomposition / rank-histogram
 #: flatness / spread–skill by lead-time bin and worst-K gauges,
-#: :mod:`ddr_tpu.observability.verification`).
+#: :mod:`ddr_tpu.observability.verification`). ``anomaly`` is one performance
+#: sentinel episode *transition* (firing/resolved) from the streaming
+#: EWMA+CUSUM detectors over the run's own signals — phase seconds, step
+#: cadence, throughput, serving queue depth/shed rate/p99, heartbeat gaps,
+#: compile rate (:mod:`ddr_tpu.observability.sentinel`); bounded per run by
+#: ``DDR_SENTINEL_MAX_EVENTS``.
 #: Version of the event schema, stamped on every ``run_start`` so readers of
 #: FEDERATED logs (a fleet mixes replica versions during a rollout) can tell
 #: which vocabulary each file speaks. Bump when an event type is added or an
@@ -113,8 +118,10 @@ __all__ = [
 #: ``schema_version``/``prom_port`` on ``run_start``; 3 = the ``canary``
 #: event (fleet tier) and a ``priority`` field on serve_request/serve_shed;
 #: 4 = the ``verify`` event (forecast verification plane) and
-#: ``matched_samples``/CRPS evidence fields on ``canary``.
-SCHEMA_VERSION = 4
+#: ``matched_samples``/CRPS evidence fields on ``canary``; 5 = the ``anomaly``
+#: event (performance sentinel) plus ``loop_s`` on ``step`` and
+#: ``prefetch_depth`` on ``heartbeat``.
+SCHEMA_VERSION = 5
 
 EVENT_TYPES = (
     "run_start",
@@ -142,6 +149,7 @@ EVENT_TYPES = (
     "data_anomaly",
     "canary",
     "verify",
+    "anomaly",
 )
 
 
@@ -299,13 +307,18 @@ class Recorder:
             h, n = host_layout()
             host = h if host is None else host
             n_hosts = n if n_hosts is None else n_hosts
-            try:  # the one shared primary-process predicate (scripts/common.py)
-                from ddr_tpu.scripts.common import is_primary_process
+            # the one shared primary-process predicate (scripts/common.py) —
+            # only consulted when jax is already loaded: importing it pulls in
+            # jax, and a jax-free recorder (bench.py's parent, the stdlib-only
+            # check gates) already resolved (0, 1) via host_layout above
+            if "jax" in sys.modules:
+                try:
+                    from ddr_tpu.scripts.common import is_primary_process
 
-                if is_primary_process():
-                    host = 0
-            except Exception:
-                pass
+                    if is_primary_process():
+                        host = 0
+                except Exception:
+                    pass
         name = (
             f"run_log.{cmd}.jsonl" if host == 0 else f"run_log.{cmd}.host{host}.jsonl"
         )

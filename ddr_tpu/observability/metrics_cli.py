@@ -267,6 +267,7 @@ def summarize(
             w(f"loss curve: {pts}\n")
 
     _summarize_phases(by_type, w)
+    _summarize_anomalies(by_type, end, w)
     _summarize_program_cards(by_type, w)
     _summarize_serving(by_type, w)
     _summarize_slo(by_type, end, w)
@@ -339,6 +340,7 @@ def _summarize_phases(by_type: dict[str, list[dict]], w) -> None:
     from ddr_tpu.observability.phases import summarize_phases
 
     agg = summarize_phases(by_type.get("step", []))
+    overlap = agg.pop("_overlap", None)  # reserved key, not a phase row
     if not agg:
         return
     rows = [
@@ -348,6 +350,60 @@ def _summarize_phases(by_type: dict[str, list[dict]], w) -> None:
     ]
     w("where time went (step phases, % of phase time):\n")
     w(_table(rows, ["phase", "share", "total_s", "mean_ms"]) + "\n")
+    if overlap:
+        w(
+            f"overlap  : device busy {100 * overlap['busy_frac']:.1f}% of loop "
+            f"wall ({overlap['idle_s']:.3f}s idle of {overlap['loop_s']:.3f}s "
+            f"over {int(overlap['count'])} steps)\n"
+        )
+
+
+def _summarize_anomalies(by_type: dict[str, list[dict]], end: dict, w) -> None:
+    """The performance-sentinel section: one row per ``anomaly`` episode
+    transition (signal, scope, state, baseline vs observed, onset step), plus
+    the run's pipeline verdict from the ``run_end`` summary (sentinel
+    bottleneck attribution — see docs/observability.md)."""
+    anomalies = by_type.get("anomaly", [])
+    if anomalies:
+        rows = []
+        for e in anomalies:
+            base, obs = e.get("baseline"), e.get("observed")
+            rows.append([
+                str(e.get("signal", "?")),
+                str(e.get("scope", "-")),
+                str(e.get("state", "?")),
+                _fmt(float(base)) if base is not None else "-",
+                _fmt(float(obs)) if obs is not None else "-",
+                str(e.get("onset_step", "-")),
+                str(e.get("step", "-")),
+            ])
+        firing = sum(1 for e in anomalies if e.get("state") == "firing")
+        w(f"anomalies: {firing} episode(s), {len(anomalies)} transition(s)\n")
+        w(_table(rows, ["signal", "scope", "state", "baseline", "observed",
+                        "onset", "step"]) + "\n")
+    pipeline = (end.get("summary") or {}).get("pipeline") or {}
+    verdict = pipeline.get("verdict")
+    if verdict:
+        classes = pipeline.get("classes") or {}
+        counts = "  ".join(
+            f"{k}={v}" for k, v in sorted(classes.items(), key=lambda kv: -kv[1])
+        )
+        w(f"pipeline verdict: {verdict}  ({counts})\n")
+        overlap = pipeline.get("overlap")
+        if isinstance(overlap, dict):
+            try:
+                busy = 100.0 * float(overlap.get("busy_frac", 0.0))
+                idle = float(overlap.get("idle_s", 0.0))
+                n = int(overlap.get("steps") or overlap.get("count") or 0)
+            except (TypeError, ValueError):
+                pass  # hand-edited log: skip the line, keep the verdict
+            else:
+                w(
+                    f"  device busy {busy:.1f}% of loop wall "
+                    f"({idle:.3f}s idle over {n} steps)\n"
+                )
+        for rec in pipeline.get("recommendations") or []:
+            w(f"  - {rec}\n")
 
 
 def _summarize_program_cards(by_type: dict[str, list[dict]], w) -> None:

@@ -13,6 +13,15 @@ labels (:mod:`ddr_tpu.observability.federate`):
 Targets default to ``DDR_FEDERATE_REPLICAS`` when ``--replicas`` is omitted;
 the cardinality cap is ``DDR_FEDERATE_MAX_SERIES`` (see
 docs/observability.md "Fleet observability"). Stdlib-only and jax-free.
+
+``ddr obs bottleneck <run_log-or-dir>`` replays a run log's ``step`` events
+through the performance sentinel's critical-path model
+(:func:`ddr_tpu.observability.sentinel.attribute_steps`): each step is
+classified data-/host-/checkpoint-/device-bound, the per-class counts and
+stage seconds are tabulated, and the modal class becomes the pipeline verdict
+with concrete knob recommendations (e.g. a data-bound run suggests raising
+``experiment.prefetch_ahead``). Works on any schema version — steps without
+``loop_s`` fall back to largest-bucket attribution.
 """
 
 from __future__ import annotations
@@ -135,7 +144,47 @@ def main(argv: Sequence[str] | None = None) -> int:
         default=2.0,
         help="per-replica scrape timeout in seconds (default 2)",
     )
+    bot = sub.add_parser(
+        "bottleneck",
+        help="replay a run log into a pipeline bottleneck attribution table",
+    )
+    bot.add_argument(
+        "path", help="run_log.*.jsonl file (or a directory containing one)"
+    )
+    bot.add_argument(
+        "--idle-frac",
+        type=float,
+        default=0.25,
+        help="device idle share of loop wall below which a step counts as "
+        "device-bound (default 0.25)",
+    )
     args = parser.parse_args(argv)
+
+    if args.command == "bottleneck":
+        from ddr_tpu.observability.metrics_cli import load_events
+        from ddr_tpu.observability.sentinel import (
+            attribute_steps,
+            render_attribution,
+        )
+
+        try:
+            events, bad = load_events(args.path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read {args.path}: {e}", file=sys.stderr)
+            return 2
+        if bad:
+            print(f"skipped {bad} malformed line(s)", file=sys.stderr)
+        steps = [e for e in events if e.get("event") == "step"]
+        if not steps:
+            print(
+                f"no step events in {args.path}; nothing to attribute",
+                file=sys.stderr,
+            )
+            return 1
+        sys.stdout.write(
+            render_attribution(attribute_steps(steps, idle_frac=args.idle_frac))
+        )
+        return 0
 
     if args.command == "federate":
         replicas = (

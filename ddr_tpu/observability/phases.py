@@ -115,8 +115,19 @@ class PhaseTimer:
 def summarize_phases(step_events: list[dict]) -> dict[str, dict[str, float]]:
     """Aggregate the ``phases`` dicts attached to ``step`` events into
     ``{phase: {count, seconds, share}}`` — the "Where time went" table's data
-    (shared by ``ddr metrics summarize`` and its tests)."""
+    (shared by ``ddr metrics summarize`` and its tests).
+
+    When steps additionally carry ``loop_s`` (the full loop-iteration wall the
+    train loop records since schema v5), the result gains one reserved
+    ``"_overlap"`` entry reporting overlap efficiency — device busy fraction
+    of the loop wall and total device idle — which phase shares alone cannot
+    express (prefetch phases overlap the device step). Renderers iterating
+    phases should skip keys starting with ``_``.
+    """
     agg: dict[str, list[float]] = {}
+    loop_steps = 0
+    loop_s = 0.0
+    device_s = 0.0
     for e in step_events:
         phases = e.get("phases")
         if not isinstance(phases, dict):
@@ -129,8 +140,19 @@ def summarize_phases(step_events: list[dict]) -> dict[str, dict[str, float]]:
             a = agg.setdefault(str(name), [0, 0.0])
             a[0] += 1
             a[1] += s
+        try:
+            loop = float(e["loop_s"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if loop > 0:
+            loop_steps += 1
+            loop_s += loop
+            try:
+                device_s += float(phases.get("device_step", 0.0))
+            except (TypeError, ValueError):
+                pass
     denom = sum(s for _, s in agg.values())
-    return {
+    out: dict[str, dict[str, float]] = {
         name: {
             "count": int(c),
             "seconds": round(s, 6),
@@ -138,3 +160,12 @@ def summarize_phases(step_events: list[dict]) -> dict[str, dict[str, float]]:
         }
         for name, (c, s) in sorted(agg.items(), key=lambda kv: -kv[1][1])
     }
+    if loop_steps:
+        out["_overlap"] = {
+            "count": loop_steps,
+            "loop_s": round(loop_s, 6),
+            "device_s": round(device_s, 6),
+            "busy_frac": round(device_s / loop_s, 4) if loop_s > 0 else 0.0,
+            "idle_s": round(max(0.0, loop_s - device_s), 6),
+        }
+    return out

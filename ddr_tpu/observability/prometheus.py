@@ -249,6 +249,14 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
                   labels=("engine",)).inc(engine=str(record.get("engine", "?")))
     elif event == "heartbeat":
         r.counter("ddr_heartbeats_total", "Liveness heartbeats").inc()
+        if record.get("prefetch_depth") is not None:
+            # prefetch-pool occupancy sampled onto heartbeats (geodatazoo
+            # loader): 0 sustained = the pool is starved (data-bound loop)
+            r.gauge(
+                "ddr_prefetch_depth",
+                "Prepared batches waiting in the training prefetch pool at "
+                "the last heartbeat",
+            ).set(_get(record, "prefetch_depth"))
     elif event == "serve_request":
         status = str(record.get("status", "?"))
         network = str(record.get("network", "?"))
@@ -295,6 +303,24 @@ def event_tee(record: dict, registry: MetricsRegistry | None = None) -> None:
     elif event == "health":
         for reason in record.get("reasons") or ["?"]:
             r.get("ddr_health_violations_total").inc(reason=str(reason))
+    elif event == "anomaly":
+        # performance-sentinel episode transitions (observability.sentinel):
+        # the counter counts episodes (firing edges only), the gauge tracks
+        # which signals are degraded RIGHT NOW
+        signal = str(record.get("signal", "?"))
+        state = str(record.get("state", "?"))
+        if state == "firing":
+            r.counter(
+                "ddr_anomalies_total",
+                "Performance-anomaly episodes by signal",
+                labels=("signal",),
+            ).inc(signal=signal)
+        r.gauge(
+            "ddr_anomaly_active",
+            "Whether a performance anomaly is currently firing per signal "
+            "(1 firing, 0 resolved)",
+            labels=("signal",),
+        ).set(1.0 if state == "firing" else 0.0, signal=signal)
     # `skill` and `drift` events are NOT mapped here: their trackers
     # (observability.skill / observability.drift) update the registry
     # directly at observe time — with per-gauge worst-K removal semantics a

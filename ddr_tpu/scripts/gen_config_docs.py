@@ -81,6 +81,12 @@ variables. Families with their own reference tables are linked.
   `DDR_FLEET_ROUTER` — the fleet tier (`ddr fleet`, replica groups, compiled
   ensemble forecasts, skill-gated canary promotion): see docs/serving.md
   "Fleet tier".
+- `DDR_SENTINEL_*` (master switch, detector warmup/EWMA/CUSUM/hysteresis
+  tuning, per-run anomaly event budget, bottleneck idle threshold, serving
+  sweep cadence, watchdog flagging) — the runtime performance sentinel:
+  streaming anomaly detection over the run's own step/serving signals plus
+  pipeline bottleneck attribution (`ddr obs bottleneck`): see
+  docs/observability.md "Performance sentinel & bottleneck attribution".
 - `DDR_VERIFY_*` (master switch, flood-threshold tokens, lead-time bin
   edges, forecast-ledger cap, worst-gauge set size, per-gauge minimum
   samples, climatology buffer size + percentile floor) — the forecast
@@ -115,8 +121,8 @@ Every `DDR_*` environment variable read by literal name anywhere in the
 product tree (`ddr_tpu/`, `bench.py`, `examples/`), harvested by the same
 pure-AST scanner `ddr lint` rule DDR502 checks parity with — so this list can
 never drift from the code. Knobs read through a constructed prefix
-(`DDR_HEALTH_*`, `DDR_SKILL_*`, `DDR_SLO_*` members) are documented by their
-family entries above.
+(`DDR_HEALTH_*`, `DDR_SKILL_*`, `DDR_SLO_*`, `DDR_SENTINEL_*` members) are
+documented by their family entries above.
 """
 
 

@@ -9,13 +9,14 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ddr_tpu.geodatazoo.loader import DataLoader, prefetch
+from ddr_tpu.geodatazoo.loader import DataLoader, PrefetchStats, prefetch
 from ddr_tpu.observability import (
     CompileTracker,
     PhaseTimer,
@@ -328,6 +329,22 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
     # `phases` dict and in the run_end rollup; the Prometheus tee exports the
     # same numbers as ddr_phase_seconds histograms.
     phase_timer = PhaseTimer()
+    # Performance sentinel (docs/observability.md "Performance sentinel &
+    # bottleneck attribution"): streaming EWMA+CUSUM anomaly detection over
+    # this run's own signals, plus the per-step critical-path classification
+    # that becomes the run_end "pipeline" verdict. Host-side arithmetic over
+    # scalars the loop already synchronized — zero jit-cache entries.
+    from ddr_tpu.observability.sentinel import Sentinel, SentinelConfig
+
+    try:
+        sentinel_cfg = SentinelConfig.from_env()
+    except ValueError as e:
+        log.warning(f"ignoring malformed DDR_SENTINEL_* config: {e}")
+        sentinel_cfg = SentinelConfig(enabled=False)
+    sentinel = Sentinel(sentinel_cfg, scope="train") if sentinel_cfg.enabled else None
+    # Prefetch-pool occupancy hook (geodatazoo.loader.PrefetchStats): sampled
+    # onto heartbeats + the ddr_prefetch_depth gauge. Re-armed per epoch.
+    prefetch_stats = PrefetchStats()
     # Cross-host trace identity (docs/observability.md "Fleet observability"):
     # each executed batch is one trace, its ids derived deterministically from
     # (run seed, epoch, batch) — every host of a jax.distributed run walks the
@@ -656,9 +673,15 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
             batch_stream = (
                 map(_prepare, _batches()) if multiprocess
                 else prefetch(
-                    _batches(), _prepare, ahead=cfg.experiment.prefetch_ahead
+                    _batches(), _prepare, ahead=cfg.experiment.prefetch_ahead,
+                    stats=prefetch_stats,
                 )
             )
+            # loop wall clock: each iteration's full wall (device step + every
+            # host bucket + whatever is untimed) lands as `loop_s` on the step
+            # event, so device idle (`loop_s - device_step`) is computable
+            # even though data_load/host_prep overlap in the prefetch thread
+            loop_t0 = time.perf_counter()
             for i, rd, payload, attrs, obs_daily, obs_mask, anomaly, phase_s in batch_stream:
                 # This batch's trace root (same ids the prefetch thread used
                 # for data_load/host_prep — deterministic derivation, not a
@@ -925,6 +948,9 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                                     if ckpt_writer is None:
                                         prune_checkpoints_from_env(ckpt_dir)
                 finally:
+                    loop_now = time.perf_counter()
+                    loop_s = round(loop_now - loop_t0, 6)
+                    loop_t0 = loop_now
                     if rec is not None:
                         rec.emit(
                             "step",
@@ -937,6 +963,7 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             reach_timesteps_per_sec=round(throughput.last_rate, 1),
                             engine=payload.mode if par is not None else "single",
                             phases=dict(phase_s),
+                            loop_s=loop_s,
                             # the recovery event carries the full story; this
                             # marker just lets a step-stream reader drop
                             # recovered batches without a join
@@ -945,13 +972,34 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                             # every host's step event for this (epoch, batch)
                             **(step_ctx.ids() if step_ctx is not None else {}),
                         )
+                    if sentinel is not None:
+                        try:
+                            sentinel.observe_step(
+                                n_done + 1,
+                                phases=phase_s,
+                                loop_s=loop_s,
+                                seconds=throughput.last_seconds,
+                                rate=throughput.last_rate,
+                                compiles=tracker.counts()[1],
+                            )
+                        except Exception:
+                            log.exception("sentinel observe failed")  # never the loop
                 n_done += 1
                 # Per-host liveness: every host emits (each to its own log
                 # file), so a straggler/stalled host is visible from the run
                 # telemetry alone. First executed batch always beats, then
                 # every DDR_HEARTBEAT_EVERY-th (0 disables).
                 if heartbeat_every and (n_done == 1 or n_done % heartbeat_every == 0):
-                    emit_heartbeat(rec, epoch=epoch, batch=i, step=n_done)
+                    depth = prefetch_stats.depth()
+                    emit_heartbeat(
+                        rec, epoch=epoch, batch=i, step=n_done,
+                        **({"prefetch_depth": depth} if depth is not None else {}),
+                    )
+                    if sentinel is not None:
+                        try:
+                            sentinel.observe_heartbeat(step=n_done)
+                        except Exception:
+                            log.exception("sentinel heartbeat observe failed")
                 if preempt.requested:
                     # batch i completed and updated params — save exactly that
                     # state once (drain + emergency checkpoint), then exit
@@ -1015,6 +1063,12 @@ def train(cfg: Config, dataset=None, max_batches: int | None = None):
                 rec.merge_summary("recovery", supervisor.summary())
             if validator is not None:
                 rec.merge_summary("data_validate", validator.summary())
+            if sentinel is not None:
+                # the per-run pipeline verdict (critical-path rollup) + the
+                # detector states ride run_end, so `ddr metrics summarize`
+                # and `ddr obs bottleneck` agree on the diagnosis
+                rec.merge_summary("pipeline", sentinel.pipeline_summary())
+                rec.merge_summary("sentinel", sentinel.status())
 
 
 def main(argv: list[str] | None = None) -> int:

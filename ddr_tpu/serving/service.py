@@ -67,6 +67,7 @@ from ddr_tpu.observability.trace import (
     trace_enabled,
 )
 from ddr_tpu.observability.prometheus import declare_serve_metrics, event_tee
+from ddr_tpu.observability.sentinel import Sentinel, SentinelConfig
 from ddr_tpu.observability.slo import SloConfig, SloTracker
 from ddr_tpu.serving.batcher import (
     ForecastRequest,
@@ -181,6 +182,31 @@ class ForecastService:
         self.health_cfg = health_cfg or HealthConfig.from_env()
         self.watchdog = HealthWatchdog(self.health_cfg)
         self.metrics = declare_serve_metrics()
+        # Performance sentinel (docs/observability.md "Performance sentinel &
+        # bottleneck attribution"): streaming anomaly detection over this
+        # replica's queue depth / shed rate / p99 latency, sampled once per
+        # DDR_SENTINEL_SWEEP_S rather than per request. Sustained anomalies
+        # ride /v1/stats as the "sentinel" slice and — opt-in via
+        # DDR_SENTINEL_FLAG_WATCHDOG — flag the health watchdog, degrading
+        # /readyz like a numerical violation streak would.
+        try:
+            _sent_cfg = SentinelConfig.from_env()
+        except ValueError:
+            log.exception("ignoring malformed DDR_SENTINEL_* config")
+            _sent_cfg = SentinelConfig(enabled=False)
+        self.sentinel: Sentinel | None = (
+            Sentinel(_sent_cfg, scope="serve", registry=self.metrics,
+                     emit=self._emit)
+            if _sent_cfg.enabled
+            else None
+        )
+        self._sent_lock = threading.Lock()
+        self._sent_last_sweep = time.monotonic()
+        self._sent_last_shed = 0.0
+        self._sent_sweeps = 0
+        self._sent_flag_streak = 0
+        self._sent_flagged = False
+        self._sent_lat: list[float] = []  # bounded latency window (see sweep)
         # Optional hydrologic-skill tracker (attached by a data-assimilation
         # or shadow-eval loop that holds observations — serving itself has
         # none); when present its rollup rides /v1/stats as the "skill" slice.
@@ -684,6 +710,10 @@ class ForecastService:
                 **_trace_fields(r),
             )
             self._observe_slo(good)
+            if self.sentinel is not None:
+                with self._sent_lock:
+                    self._sent_lat.append(now - r.admitted)
+        self._sentinel_sweep()
         # the verification ledger is fed BEFORE any future resolves, same
         # discipline as the events above: a client that posts observations
         # right after its result must find its forecast joinable
@@ -979,6 +1009,10 @@ class ForecastService:
             **_trace_fields(req),
         )
         self._observe_slo(False)
+        if self.sentinel is not None:
+            with self._sent_lock:
+                self._sent_lat.append(req.age())
+        self._sentinel_sweep()
 
     # ---- SLO accounting ----
 
@@ -1032,6 +1066,72 @@ class ForecastService:
                 self._emit("slo", **change)
         except Exception:
             log.exception("SLO accounting failed")
+
+    def _sentinel_sweep(self, force: bool = False) -> dict | None:
+        """Feed one sample of the serving signals — queue depth, shed rate,
+        p99 latency over the recent window — into the performance sentinel's
+        detectors. Time-gated to one sample per ``DDR_SENTINEL_SWEEP_S``
+        (detector baselines assume roughly even sampling; per-request feeding
+        would tie the sample rate to traffic), and run from both the batch
+        worker (traffic) and :meth:`stats` (polling), so detectors keep
+        sampling — and anomalies can resolve — on an idle replica.
+
+        With ``DDR_SENTINEL_FLAG_WATCHDOG=1``, ``DDR_SENTINEL_FLAG_AFTER``
+        consecutive sweeps with any anomaly active flag the health watchdog
+        (``anomaly:<signal>`` reasons), degrading ``/readyz`` exactly like a
+        numerical violation streak; the flag clears on the first all-quiet
+        sweep. Returns the sentinel status slice for :meth:`stats`, or None
+        when disabled. Guarded: sentinel bookkeeping must never fail a
+        request."""
+        s = self.sentinel
+        if s is None:
+            return None
+        try:
+            now = time.monotonic()
+            bstats = self._batcher.stats()
+            with self._sent_lock:
+                dt = now - self._sent_last_sweep
+                if not force and dt < s.config.sweep_s:
+                    return s.status()
+                self._sent_last_sweep = now
+                self._sent_sweeps += 1
+                lat = sorted(self._sent_lat)
+                del self._sent_lat[:]
+                shed = float(bstats.get("shed", 0))
+                shed_rate = (
+                    max(0.0, shed - self._sent_last_shed) / dt if dt > 0 else 0.0
+                )
+                self._sent_last_shed = shed
+            step = self._sent_sweeps
+            s.observe("queue_depth", float(bstats.get("depth", 0)), step=step)
+            s.observe("shed_rate", shed_rate, step=step)
+            if lat:
+                idx = min(len(lat) - 1, int(0.99 * len(lat)))
+                s.observe("serve_p99_s", lat[idx], step=step)
+            active = s.active()
+            cfg = s.config
+            if cfg.flag_watchdog:
+                with self._sent_lock:
+                    if active:
+                        self._sent_flag_streak += 1
+                    else:
+                        self._sent_flag_streak = 0
+                    streak = self._sent_flag_streak
+                    flagged = self._sent_flagged
+                    should_flag = streak >= cfg.flag_after
+                    self._sent_flagged = should_flag
+                if should_flag:
+                    self.watchdog.flag(
+                        [f"anomaly:{sig}" for sig in active],
+                        source="sentinel",
+                        sweeps=streak,
+                    )
+                elif flagged:
+                    self.watchdog.flag([])
+            return s.status()
+        except Exception:
+            log.exception("sentinel sweep failed")
+            return None
 
     def _emit(self, event: str, **payload) -> None:
         rec = get_recorder()
@@ -1088,6 +1188,7 @@ class ForecastService:
         batching knobs consumers need to interpret the counters (``ddr
         loadtest`` derives batch occupancy from served/batches/max_batch)."""
         self._slo_sweep()  # idle replicas resolve stale alerts via polling
+        sentinel = self._sentinel_sweep()  # ditto for anomaly episodes
         from ddr_tpu.fleet.config import fleet_identity
 
         hits, misses = self.tracker.counts()
@@ -1108,6 +1209,7 @@ class ForecastService:
             "queue": self._batcher.stats(),
             "compiles": {"hits": hits, "misses": misses, **self.tracker.snapshot()},
             "health": self.watchdog.status(),
+            "sentinel": sentinel,
             "skill": None if self._skill is None else self._skill.status(),
             "verification": (
                 None if self._verifier is None else self._verifier.status()

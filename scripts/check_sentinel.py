@@ -1,0 +1,224 @@
+#!/usr/bin/env python3
+"""CI guard: the performance sentinel detects an injected data-load slowdown
+and attributes it to the right pipeline stage — and stays silent on a clean
+twin.
+
+The sentinel (``docs/observability.md`` "Performance sentinel & bottleneck
+attribution") rests on a chain of small contracts: the train loop records
+per-step phase seconds and ``loop_s``, the EWMA+CUSUM detectors calibrate on
+the run's own warmup and fire once per episode, a bounded ``anomaly`` event
+lands in the run log, and the critical-path classifier rolls per-step classes
+into a pipeline verdict on ``run_end``. This script closes the tier-1 gap the
+way ``check_recovery.py`` guards the recovery ladder: ONE in-process
+miniature loop with the REAL fault plan (``slow@data.load``), sentinel,
+attribution, and event recorder — no jax, no subprocesses, zero jit-cache
+entries by construction.
+
+Asserts: the faulted run fires a ``data_load`` anomaly within a bounded
+number of steps of arming (onset at/after the arming step), its ``run_end``
+pipeline verdict is ``data_bound`` and ``ddr obs bottleneck`` renders the
+same verdict from the log alone; the clean twin writes ZERO anomaly events
+and verdicts ``device_bound``; jax was never imported. Exit 0 on agreement,
+1 otherwise.
+
+Run directly (CI) or via the test suite (tests/scripts/test_check_sentinel.py):
+
+    python scripts/check_sentinel.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import tempfile
+from pathlib import Path
+
+# runnable from anywhere: the package root is the script's grandparent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: Deterministic mini-loop geometry: the fault arms at step ARM_STEP (1-based)
+#: and every later data load eats the injected delay. The faulted segment is
+#: the majority of the run, so the modal per-step class — the pipeline
+#: verdict — must flip to data_bound.
+N_STEPS = 30
+ARM_STEP = 13
+#: The injected slowdown (the docs' example plan). 200 ms against a ~1 ms
+#: baseline is a >50 sigma excursion even under heavy CI jitter.
+FAULT_PLAN = "slow@data.load:p=1,ms=200"
+#: Detection must land within this many steps of arming.
+DETECT_WITHIN = 8
+#: Baseline sleeps: device-dominant so the clean loop is device_bound.
+DATA_S = 0.001
+DEVICE_S = 0.010
+
+
+def _toy_loop(faulted: bool, base_dir: str) -> list[dict]:
+    """A miniature train loop mirroring scripts/train.py's sentinel wiring:
+    time the data-load bracket (with the REAL ``data.load`` fault site
+    inside), time the device step, emit a ``step`` event with phases +
+    ``loop_s``, feed the sentinel, and merge its rollups into ``run_end``.
+    Returns the run log's parsed events."""
+    from ddr_tpu.observability import run_telemetry
+    from ddr_tpu.observability.faults import configure, fault_site
+    from ddr_tpu.observability.sentinel import Sentinel, SentinelConfig
+
+    # explicit config: generous sigma floor + threshold so scheduler jitter
+    # on loaded CI hosts cannot fire, while a 200x excursion still fires on
+    # its first smoothed sample
+    cfg = SentinelConfig(
+        warmup=10,
+        ewma_alpha=0.5,
+        cusum_k=0.5,
+        cusum_h=12.0,
+        hysteresis=3,
+        min_sigma_frac=0.5,
+    )
+    configure(None)  # start disarmed; the plan arms mid-run below
+    try:
+        with run_telemetry(None, "check_sentinel", base_dir=base_dir) as rec:
+            sentinel = Sentinel(cfg, scope="train")
+            loop_t0 = time.perf_counter()
+            for step in range(1, N_STEPS + 1):
+                if faulted and step == ARM_STEP:
+                    configure(FAULT_PLAN)
+                phases: dict[str, float] = {}
+                t0 = time.perf_counter()
+                time.sleep(DATA_S)
+                inject = fault_site("data.load")
+                if inject is not None:
+                    inject(step=step)
+                phases["data_load"] = round(time.perf_counter() - t0, 6)
+                t0 = time.perf_counter()
+                time.sleep(DEVICE_S)
+                device_s = round(time.perf_counter() - t0, 6)
+                phases["device_step"] = device_s
+                loop_now = time.perf_counter()
+                loop_s = round(loop_now - loop_t0, 6)
+                loop_t0 = loop_now
+                rec.emit(
+                    "step", epoch=1, batch=step, seconds=device_s,
+                    phases=phases, loop_s=loop_s,
+                )
+                sentinel.observe_step(
+                    step, phases=phases, loop_s=loop_s, seconds=device_s,
+                )
+            rec.merge_summary("pipeline", sentinel.pipeline_summary())
+            rec.merge_summary("sentinel", sentinel.status())
+    finally:
+        configure(None)  # disarm: never leak a plan into the host process
+    logs = list(Path(base_dir).glob("**/run_log.*.jsonl"))
+    if len(logs) != 1:
+        raise AssertionError(f"expected one run log, found {logs}")
+    return [
+        json.loads(ln) for ln in logs[0].read_text().splitlines() if ln.strip()
+    ], logs[0]
+
+
+def main() -> int:
+    try:
+        from ddr_tpu.observability import obs_cli  # noqa: F401  (CLI replay)
+    except Exception as e:
+        print(f"check_sentinel: import failed: {e!r}", file=sys.stderr)
+        return 1
+
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            events, log_path = _toy_loop(faulted=True, base_dir=tmp)
+
+            anomalies = [e for e in events if e.get("event") == "anomaly"]
+            firing = [
+                e for e in anomalies
+                if e.get("state") == "firing" and e.get("signal") == "data_load"
+            ]
+            if not firing:
+                print(
+                    f"check_sentinel: no data_load anomaly fired "
+                    f"(anomalies: {anomalies})",
+                    file=sys.stderr,
+                )
+                return 1
+            first = firing[0]
+            if not (ARM_STEP <= first["step"] <= ARM_STEP + DETECT_WITHIN):
+                print(
+                    f"check_sentinel: detection out of bounds: fired at step "
+                    f"{first['step']}, armed at {ARM_STEP}",
+                    file=sys.stderr,
+                )
+                return 1
+            if not (ARM_STEP <= first["onset_step"] <= first["step"]):
+                print(
+                    f"check_sentinel: onset_step {first['onset_step']} not in "
+                    f"[{ARM_STEP}, {first['step']}]",
+                    file=sys.stderr,
+                )
+                return 1
+
+            ends = [e for e in events if e.get("event") == "run_end"]
+            pipeline = (ends[-1].get("summary") or {}).get("pipeline") or {}
+            if pipeline.get("verdict") != "data_bound":
+                print(
+                    f"check_sentinel: faulted verdict "
+                    f"{pipeline.get('verdict')!r}, wanted data_bound "
+                    f"({pipeline.get('classes')})",
+                    file=sys.stderr,
+                )
+                return 1
+
+            # the offline replay must reach the same verdict from the log alone
+            import contextlib
+            import io
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = obs_cli.main(["bottleneck", str(log_path)])
+            if rc != 0 or "pipeline verdict : data_bound" not in buf.getvalue():
+                print(
+                    f"check_sentinel: ddr obs bottleneck rc={rc}, output:\n"
+                    f"{buf.getvalue()}",
+                    file=sys.stderr,
+                )
+                return 1
+
+        # the clean twin: identical loop, no plan — silence is the contract
+        with tempfile.TemporaryDirectory() as tmp:
+            events, _ = _toy_loop(faulted=False, base_dir=tmp)
+            anomalies = [e for e in events if e.get("event") == "anomaly"]
+            if anomalies:
+                print(
+                    f"check_sentinel: clean twin fired {len(anomalies)} "
+                    f"anomaly transition(s): {anomalies}",
+                    file=sys.stderr,
+                )
+                return 1
+            ends = [e for e in events if e.get("event") == "run_end"]
+            pipeline = (ends[-1].get("summary") or {}).get("pipeline") or {}
+            if pipeline.get("verdict") != "device_bound":
+                print(
+                    f"check_sentinel: clean verdict "
+                    f"{pipeline.get('verdict')!r}, wanted device_bound "
+                    f"({pipeline.get('classes')})",
+                    file=sys.stderr,
+                )
+                return 1
+    except Exception as e:
+        print(f"check_sentinel: loop failed: {e!r}", file=sys.stderr)
+        return 1
+
+    # the zero-jit-cache-entries proof: the whole drill ran jax-free, so it
+    # cannot have added a compiled program anywhere
+    if "jax" in sys.modules:
+        print("check_sentinel: jax was imported — the sentinel must stay "
+              "host-side", file=sys.stderr)
+        return 1
+
+    print(
+        "check_sentinel: slow@data.load -> data_load anomaly within "
+        f"{DETECT_WITHIN} steps + data_bound verdict (CLI replay agrees); "
+        "clean twin silent and device_bound; jax never imported"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

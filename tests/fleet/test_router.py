@@ -179,3 +179,37 @@ class TestLifecycle:
         r = make_router(FakeReplica(0), probe_s=0.02)
         r.close()
         assert not r._prober.is_alive()
+
+
+class TestSentinelWiring:
+    """The fleet-scoped performance sentinel: one queue-depth detector per
+    replica, fed at probe cadence, surfaced on status()."""
+
+    def test_status_carries_per_replica_depth_detectors(self, monkeypatch):
+        monkeypatch.setenv("DDR_SENTINEL_WARMUP", "3")
+        a, b = FakeReplica(0, depth=1), FakeReplica(1, depth=1)
+        r = make_router(a, b, probe_s=0.02)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                anomalies = r.status()["anomalies"]
+                if anomalies and {"r0.queue_depth", "r1.queue_depth"} <= set(
+                    anomalies["signals"]
+                ):
+                    break
+                time.sleep(0.01)
+            anomalies = r.status()["anomalies"]
+            assert anomalies is not None and anomalies["scope"] == "fleet"
+            assert {"r0.queue_depth", "r1.queue_depth"} <= set(
+                anomalies["signals"]
+            )
+        finally:
+            r.close()
+
+    def test_sentinel_disabled_yields_none(self, monkeypatch):
+        monkeypatch.setenv("DDR_SENTINEL_ENABLED", "0")
+        r = make_router(FakeReplica(0))
+        try:
+            assert r.status()["anomalies"] is None
+        finally:
+            r.close()

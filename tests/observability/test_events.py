@@ -79,7 +79,7 @@ class TestRecorderRoundTrip:
             "run_start", "step", "eval", "compile", "heartbeat", "span", "run_end",
             "serve_request", "serve_batch", "serve_shed", "health", "program_card",
             "slo", "fault", "preempt", "chaos", "skill", "drift", "audit", "reshard",
-            "tune", "recovery", "data_anomaly", "canary", "verify",
+            "tune", "recovery", "data_anomaly", "canary", "verify", "anomaly",
         }
 
 

@@ -164,6 +164,60 @@ class TestSummarize:
         assert main(["summarize", str(p)]) == 0
         assert "where time went" not in capsys.readouterr().out
 
+    def test_overlap_line_renders_with_loop_s(self, tmp_path, capsys):
+        """Steps carrying `loop_s` (schema v5) add the overlap-efficiency
+        line under the phase table."""
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        events = [json.loads(l) for l in p.read_text().splitlines()]
+        for e in events:
+            if e["event"] == "step":
+                e["phases"] = {"device_step": 0.4, "eval": 0.1}
+                e["loop_s"] = 0.5
+        p.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "overlap  : device busy 80.0% of loop wall" in out
+        assert "_overlap" not in out  # reserved key never rendered as a phase
+
+    def test_anomaly_section_and_pipeline_verdict_render(self, tmp_path, capsys):
+        """`anomaly` events tabulate (signal/state/baseline→observed/onset)
+        and the run_end summary's sentinel rollup prints the pipeline
+        verdict + recommendations."""
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        events = [json.loads(l) for l in p.read_text().splitlines()]
+        for e in events:
+            if e["event"] == "run_end":
+                e.setdefault("summary", {})["pipeline"] = {
+                    "steps": 4, "classes": {"data_bound": 3, "device_bound": 1},
+                    "verdict": "data_bound",
+                    "overlap": {"steps": 4, "loop_s": 2.0, "device_s": 0.5,
+                                "busy_frac": 0.25, "idle_s": 1.5},
+                    "recommendations": ["raise experiment.prefetch_ahead"],
+                }
+        p.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        with p.open("a") as f:
+            f.write(json.dumps({
+                "event": "anomaly", "t": 2.0, "wall": 102.0, "host": 0,
+                "pid": 1, "seq": 60, "signal": "data_load", "scope": "train",
+                "state": "firing", "side": "high", "baseline": 0.01,
+                "observed": 0.21, "sigma": 0.002, "onset_step": 12,
+                "step": 14, "episodes": 1,
+            }) + "\n")
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "anomalies: 1 episode(s), 1 transition(s)" in out
+        assert "data_load" in out and "firing" in out
+        assert "pipeline verdict: data_bound  (data_bound=3  device_bound=1)" in out
+        assert "device busy 25.0% of loop wall" in out
+        assert "- raise experiment.prefetch_ahead" in out
+
+    def test_no_anomaly_section_without_events(self, tmp_path, capsys):
+        p = _write_golden(tmp_path / "run_log.train.jsonl")
+        assert main(["summarize", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "anomalies:" not in out
+        assert "pipeline verdict" not in out
+
     def test_program_cost_table_renders(self, tmp_path, capsys):
         """program_card events render one row per distinct program; a re-emit
         for the same (name, engine, key) doesn't duplicate the row."""

@@ -91,6 +91,29 @@ class TestSummarizePhases:
     def test_empty(self):
         assert summarize_phases([]) == {}
 
+    def test_overlap_entry_from_loop_s(self):
+        """Steps carrying ``loop_s`` (schema v5) gain the reserved
+        ``_overlap`` rollup — device busy fraction of the loop wall — which
+        phase shares alone cannot express."""
+        events = [
+            {"phases": {"device_step": 0.08, "data_load": 0.05}, "loop_s": 0.1},
+            {"phases": {"device_step": 0.02}, "loop_s": 0.1},
+            {"phases": {"device_step": 1.0}},  # no loop_s: excluded from overlap
+        ]
+        agg = summarize_phases(events)
+        ov = agg["_overlap"]
+        assert ov["count"] == 2
+        assert ov["loop_s"] == pytest.approx(0.2)
+        assert ov["device_s"] == pytest.approx(0.1)
+        assert ov["busy_frac"] == pytest.approx(0.5)
+        assert ov["idle_s"] == pytest.approx(0.1)
+        # phase rows are unaffected by the reserved entry
+        assert agg["device_step"]["count"] == 3
+
+    def test_no_overlap_entry_without_loop_s(self):
+        agg = summarize_phases([{"phases": {"device_step": 1.0}}])
+        assert "_overlap" not in agg
+
 
 class TestPrometheusTee:
     def test_step_phases_feed_histogram(self):

@@ -1,0 +1,298 @@
+"""observability.sentinel: streaming change-point detection (EWMA + CUSUM
+with warmup and hysteresis), the overlap-aware bottleneck classifier, and the
+Sentinel's bounded anomaly emission — the contracts docs/observability.md
+"Performance sentinel & bottleneck attribution" promises."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ddr_tpu.observability.events import EVENT_TYPES, run_telemetry
+from ddr_tpu.observability.prometheus import event_tee
+from ddr_tpu.observability.registry import MetricsRegistry
+from ddr_tpu.observability.sentinel import (
+    BOTTLENECK_CLASSES,
+    BottleneckAttributor,
+    EwmaCusumDetector,
+    Sentinel,
+    SentinelConfig,
+    attribute_steps,
+    classify_step,
+    render_attribution,
+)
+
+#: A config tuned so the fixtures below are deterministic: short warmup,
+#: unsmoothed residuals, tight threshold.
+CFG = SentinelConfig(
+    warmup=10, ewma_alpha=1.0, cusum_k=0.5, cusum_h=5.0, hysteresis=3,
+    min_sigma_frac=0.1,
+)
+
+
+def _feed(det, values, start=0):
+    """Feed a value sequence; return the transitions [(step, state), ...]."""
+    out = []
+    for i, v in enumerate(values, start=start):
+        tr = det.observe(v, step=i)
+        if tr is not None:
+            out.append((i, tr))
+    return out
+
+
+class TestDetectorFixtures:
+    def test_warmup_is_silent_even_on_wild_samples(self):
+        det = EwmaCusumDetector("x", CFG)
+        # anything goes during calibration — it IS the baseline
+        assert _feed(det, [1.0, 100.0, 1.0, 50.0, 1.0, 1.0, 2.0, 1.0, 1.0]) == []
+        assert det.snapshot()["warming_up"] is True
+
+    def test_step_change_fires_exactly_once_per_episode(self):
+        det = EwmaCusumDetector("x", CFG)
+        values = [1.0] * 10 + [10.0] * 20  # calibrate on 1.0, then a level shift
+        transitions = _feed(det, values)
+        assert [t["state"] for _, t in transitions] == ["firing"]
+        step, t = transitions[0]
+        assert t["side"] == "high"
+        assert t["baseline"] == pytest.approx(1.0)
+        assert t["observed"] == pytest.approx(10.0)
+        # onset is the first shifted sample, which precedes the crossing
+        assert t["onset_step"] == 10
+        assert t["onset_step"] <= step
+        assert det.firing and det.episodes == 1
+
+    def test_drop_fires_low_side_only_with_low_direction(self):
+        det = EwmaCusumDetector("throughput", CFG, direction="low")
+        # throughput collapse fires...
+        drops = _feed(det, [100.0] * 10 + [10.0] * 10)
+        assert [t["state"] for _, t in drops] == ["firing"]
+        assert drops[0][1]["side"] == "low"
+        # ...but a throughput IMPROVEMENT on a fresh detector never does
+        det2 = EwmaCusumDetector("throughput", CFG, direction="low")
+        assert _feed(det2, [100.0] * 10 + [1000.0] * 30) == []
+
+    def test_ramp_fires_once_and_resolves_after_hysteresis(self):
+        det = EwmaCusumDetector("x", CFG)
+        ramp = [float(v) for v in range(10)]  # noisy-ish rising warmup
+        values = ramp + [20.0, 30.0, 40.0, 50.0, 60.0, 70.0, 80.0]
+        transitions = _feed(det, values)
+        assert [t["state"] for _, t in transitions] == ["firing"]
+        # back in band: needs `hysteresis` consecutive calm samples
+        base = det.config.hysteresis
+        back = _feed(det, [4.5] * (base + 1), start=100)
+        assert [t["state"] for _, t in back] == ["resolved"]
+        assert not det.firing
+        # a second excursion is a NEW episode (fires again, episodes == 2)
+        again = _feed(det, [80.0] * 10, start=200)
+        assert [t["state"] for _, t in again] == ["firing"]
+        assert det.episodes == 2
+
+    def test_hysteresis_no_flap_on_boundary_oscillation(self):
+        det = EwmaCusumDetector("x", CFG)
+        _feed(det, [1.0] * 10 + [50.0] * 5)  # now firing
+        assert det.firing
+        # oscillate: calm, calm, SPIKE, calm, calm, SPIKE ... — the in-band
+        # run never reaches `hysteresis`, so no resolved/firing flapping
+        osc = [1.0, 1.0, 50.0] * 6
+        assert _feed(det, osc, start=50) == []
+        assert det.firing
+
+    def test_near_constant_warmup_gets_sigma_floor(self):
+        det = EwmaCusumDetector("x", CFG)
+        _feed(det, [2.0] * 10)  # zero variance; floor = 0.1 * 2.0
+        assert det.snapshot()["sigma"] == pytest.approx(0.2)
+        # jitter inside the floor never fires
+        assert _feed(det, [2.01, 1.99, 2.02, 1.98] * 10, start=10) == []
+
+    def test_nonfinite_and_garbage_samples_are_dropped(self):
+        det = EwmaCusumDetector("x", CFG)
+        for bad in (float("nan"), float("inf"), "bogus", None):
+            assert det.observe(bad) is None
+        assert det.snapshot()["samples"] == 0
+
+
+class TestClassifier:
+    def test_overlap_aware_device_bound_despite_big_host_buckets(self):
+        # prefetch overlap: host buckets are LARGE but the device was kept
+        # busy — loop wall barely exceeds device time
+        phases = {"data_load": 0.09, "host_prep": 0.05, "device_step": 0.10}
+        assert classify_step(phases, loop_s=0.11) == "device_bound"
+
+    def test_idle_loop_attributes_to_largest_host_bucket(self):
+        phases = {"data_load": 0.08, "host_prep": 0.01, "device_step": 0.02}
+        assert classify_step(phases, loop_s=0.10) == "data_bound"
+        phases = {"data_load": 0.01, "eval": 0.08, "device_step": 0.02}
+        assert classify_step(phases, loop_s=0.10) == "host_bound"
+        phases = {"checkpoint": 0.2, "device_step": 0.02}
+        assert classify_step(phases, loop_s=0.25) == "checkpoint_bound"
+
+    def test_without_loop_s_largest_bucket_wins_device_on_ties(self):
+        assert classify_step({"data_load": 0.2, "device_step": 0.1}) == "data_bound"
+        assert classify_step({"data_load": 0.1, "device_step": 0.1}) == "device_bound"
+        assert classify_step({}) == "unknown"
+        assert classify_step(None) == "unknown"
+
+    def test_idle_frac_threshold_is_respected(self):
+        phases = {"data_load": 0.05, "device_step": 0.06}
+        # idle = 0.04 of 0.10 loop: bound by the knob
+        assert classify_step(phases, loop_s=0.10, idle_frac=0.5) == "device_bound"
+        assert classify_step(phases, loop_s=0.10, idle_frac=0.25) == "data_bound"
+
+
+class TestAttributor:
+    def test_modal_verdict_with_stage_seconds_and_overlap(self):
+        attr = BottleneckAttributor()
+        for _ in range(7):
+            attr.add({"data_load": 0.08, "device_step": 0.02}, loop_s=0.1)
+        for _ in range(3):
+            attr.add({"data_load": 0.001, "device_step": 0.098}, loop_s=0.1)
+        s = attr.summary()
+        assert s["steps"] == 10
+        assert s["classes"] == {"data_bound": 7, "device_bound": 3}
+        assert s["verdict"] == "data_bound"
+        assert s["stage_seconds"]["data_load"] == pytest.approx(0.563)
+        assert s["overlap"]["steps"] == 10
+        assert s["overlap"]["busy_frac"] == pytest.approx(0.434)
+        assert any("prefetch_ahead" in r for r in s["recommendations"])
+
+    def test_verdict_tiebreak_prefers_actionable_class(self):
+        attr = BottleneckAttributor()
+        attr.add({"data_load": 0.2, "device_step": 0.01}, loop_s=0.25)
+        attr.add({"data_load": 0.001, "device_step": 0.24}, loop_s=0.25)
+        # 1-1 tie: data_bound (earlier in BOTTLENECK_CLASSES) wins
+        assert BOTTLENECK_CLASSES.index("data_bound") < BOTTLENECK_CLASSES.index(
+            "device_bound"
+        )
+        assert attr.summary()["verdict"] == "data_bound"
+
+    def test_unknown_only_when_nothing_classified(self):
+        attr = BottleneckAttributor()
+        attr.add({}, None)
+        assert attr.summary()["verdict"] == "unknown"
+        attr.add({"device_step": 0.1}, loop_s=0.1)
+        assert attr.summary()["verdict"] == "device_bound"
+
+    def test_attribute_steps_replays_events_and_renders(self):
+        events = [
+            {"event": "step", "phases": {"data_load": 0.09, "device_step": 0.01},
+             "loop_s": 0.1},
+            {"event": "step", "no_phases_here": True},
+        ] * 3
+        result = attribute_steps(events)
+        assert result["steps"] == 3  # phase-less events skipped
+        text = render_attribution(result)
+        assert "pipeline verdict : data_bound" in text
+        assert "steps classified : 3" in text
+        assert "device busy" in text
+        assert "  - raise experiment.prefetch_ahead" in text
+
+
+class TestSentinel:
+    def test_anomaly_event_reaches_run_log_with_scope(self, tmp_path):
+        with run_telemetry(None, "sentinel_test", base_dir=str(tmp_path)):
+            s = Sentinel(CFG, scope="train", registry=MetricsRegistry())
+            for i in range(10):
+                s.observe("data_load", 0.01, step=i)
+            for i in range(10, 20):
+                s.observe("data_load", 0.5, step=i)
+            assert s.active() == ["data_load"]
+        log = next(tmp_path.glob("run_log.*.jsonl"))
+        events = [json.loads(ln) for ln in log.read_text().splitlines()]
+        anomalies = [e for e in events if e["event"] == "anomaly"]
+        assert len(anomalies) == 1
+        a = anomalies[0]
+        assert a["signal"] == "data_load" and a["state"] == "firing"
+        assert a["scope"] == "train" and a["onset_step"] == 10
+
+    def test_max_events_budget_suppresses_log_but_not_gauges(self):
+        reg = MetricsRegistry()
+        cfg = SentinelConfig(
+            warmup=2, ewma_alpha=1.0, cusum_h=2.0, hysteresis=1, max_events=1
+        )
+        emitted = []
+        s = Sentinel(cfg, registry=reg, emit=lambda ev, **kw: emitted.append(kw))
+        for i in range(2):
+            s.observe("a", 1.0, step=i)
+            s.observe("b", 1.0, step=i)
+        s.observe("a", 100.0, step=2)  # episode 1: within budget
+        s.observe("b", 100.0, step=2)  # episode 2: over budget
+        assert len(emitted) == 1 and emitted[0]["signal"] == "a"
+        st = s.status()
+        assert st["events"] == 1 and st["suppressed"] == 1
+        assert sorted(st["active"]) == ["a", "b"]
+        # the over-budget transition still reached the registry directly
+        assert reg.get("ddr_anomaly_active").value(signal="b") == 1.0
+        assert reg.get("ddr_anomalies_total").value(signal="b") == 1.0
+
+    def test_observe_step_feeds_phases_cadence_and_compile_deltas(self):
+        s = Sentinel(
+            SentinelConfig(warmup=5, ewma_alpha=1.0, cusum_h=3.0, hysteresis=1),
+            registry=MetricsRegistry(),
+            emit=lambda ev, **kw: None,
+        )
+        for i in range(1, 7):
+            s.observe_step(
+                i, phases={"data_load": 0.01, "device_step": 0.02},
+                loop_s=0.022, seconds=0.02, rate=100.0, compiles=3,
+            )
+        snap = s.status()["signals"]
+        # compile_rate sees DELTAS of the cumulative count: constant 3 -> 0.0
+        assert snap["compile_rate"]["samples"] == 5
+        assert {"data_load", "device_step", "step_seconds", "throughput"} <= set(snap)
+        # a late recompile storm fires the compile_rate detector
+        out = []
+        for i in range(7, 15):
+            out += s.observe_step(i, compiles=3 + (i - 6) * 4)
+        assert any(t["signal"] == "compile_rate" for t in out)
+        assert s.pipeline_summary()["verdict"] == "device_bound"
+
+    def test_disabled_sentinel_is_inert(self):
+        s = Sentinel(SentinelConfig(enabled=False), registry=MetricsRegistry())
+        for i in range(50):
+            assert s.observe("x", 1000.0 * (i % 2), step=i) is None
+        assert s.observe_step(1, phases={"data_load": 9.9}) == []
+        assert s.status()["signals"] == {}
+
+
+class TestConfigAndTee:
+    def test_from_env_precedence_and_falsey(self):
+        cfg = SentinelConfig.from_env(environ={
+            "DDR_SENTINEL_WARMUP": "7",
+            "DDR_SENTINEL_CUSUM_H": "3.5",
+            "DDR_SENTINEL_ENABLED": "off",
+            "DDR_SENTINEL_FLAG_WATCHDOG": "1",
+        }, cusum_h=9.0)
+        assert cfg.warmup == 7
+        assert cfg.cusum_h == 9.0  # explicit override beats env
+        assert cfg.enabled is False and cfg.flag_watchdog is True
+
+    def test_from_env_rejects_garbage_and_bad_ranges(self):
+        with pytest.raises(ValueError, match="DDR_SENTINEL_WARMUP"):
+            SentinelConfig.from_env(environ={"DDR_SENTINEL_WARMUP": "soon"})
+        with pytest.raises(ValueError, match="warmup"):
+            SentinelConfig(warmup=1)
+        with pytest.raises(ValueError, match="idle_frac"):
+            SentinelConfig(idle_frac=1.0)
+        with pytest.raises(ValueError, match="ewma_alpha"):
+            SentinelConfig(ewma_alpha=0.0)
+
+    def test_anomaly_is_a_schema_event_type(self):
+        assert "anomaly" in EVENT_TYPES
+
+    def test_tee_counts_episodes_and_tracks_active_gauge(self):
+        r = MetricsRegistry()
+        fire = {"event": "anomaly", "signal": "data_load", "state": "firing"}
+        event_tee(fire, r)
+        event_tee(fire, r)
+        event_tee({"event": "anomaly", "signal": "data_load",
+                   "state": "resolved"}, r)
+        assert r.get("ddr_anomalies_total").value(signal="data_load") == 2.0
+        assert r.get("ddr_anomaly_active").value(signal="data_load") == 0.0
+
+    def test_tee_heartbeat_prefetch_depth_gauge(self):
+        r = MetricsRegistry()
+        event_tee({"event": "heartbeat", "prefetch_depth": 3}, r)
+        assert r.get("ddr_prefetch_depth").value() == 3.0
+        event_tee({"event": "heartbeat"}, r)  # no depth: gauge untouched
+        assert r.get("ddr_prefetch_depth").value() == 3.0

@@ -1,0 +1,47 @@
+"""scripts/check_sentinel.py: the performance-sentinel smoke gate must pass
+on a clean tree (so detector/attribution bit-rot fails tier-1 fast) and
+actually catch breakage."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+SCRIPT = REPO / "scripts" / "check_sentinel.py"
+
+
+def test_repo_sentinel_smokes_clean():
+    """THE CI gate: an injected slow@data.load fires a data_load anomaly
+    within a bounded number of steps and flips the pipeline verdict to
+    data_bound, while the clean twin stays silent — all without importing
+    jax (the zero-jit-cache-entries proof)."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean twin silent" in proc.stdout
+    assert "jax never imported" in proc.stdout
+
+
+def test_gate_fails_on_broken_sentinel_module(tmp_path):
+    """A tree whose observability package cannot import must fail the gate —
+    copy the script next to a stub package with a broken __init__."""
+    pkg = tmp_path / "ddr_tpu" / "observability"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ddr_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("raise RuntimeError('bit-rot')\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "check_sentinel.py").write_text(SCRIPT.read_text())
+    proc = subprocess.run(
+        [sys.executable, str(scripts / "check_sentinel.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1
+    assert "import failed" in proc.stderr

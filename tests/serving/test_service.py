@@ -206,6 +206,58 @@ class TestStats:
         assert slo["alerting"] is False
 
 
+class TestSentinelWiring:
+    """The performance sentinel's serving hookup: queue/latency signals feed
+    per-sweep, the rollup rides /v1/stats, and the whole thing adds zero
+    compiled programs."""
+
+    def test_stats_carries_sentinel_signals_without_new_compiles(
+        self, service_factory, monkeypatch
+    ):
+        monkeypatch.setenv("DDR_SENTINEL_SWEEP_S", "0")  # sweep every batch
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        hits0, misses0 = svc.tracker.counts()
+        for t0 in range(3):
+            svc.forecast(network="default", t0=t0, timeout=30)
+        s = svc.stats()
+        sent = s["sentinel"]
+        assert sent is not None and sent["scope"] == "serve"
+        assert sent["active"] == []  # healthy traffic: nothing firing
+        # every sweep observed depth + shed rate; served requests fed p99
+        assert {"queue_depth", "shed_rate", "serve_p99_s"} <= set(sent["signals"])
+        assert sent["signals"]["serve_p99_s"]["samples"] >= 1
+        # the compile-count pin: sentinel sweeps are host-side arithmetic
+        hits1, misses1 = svc.tracker.counts()
+        assert misses1 == misses0
+
+    def test_sustained_anomaly_surfaces_on_stats(
+        self, service_factory, monkeypatch
+    ):
+        monkeypatch.setenv("DDR_SENTINEL_SWEEP_S", "0")
+        monkeypatch.setenv("DDR_SENTINEL_WARMUP", "2")
+        monkeypatch.setenv("DDR_SENTINEL_EWMA_ALPHA", "1.0")
+        monkeypatch.setenv("DDR_SENTINEL_CUSUM_H", "2.0")
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        for i in range(2):
+            svc.sentinel.observe("queue_depth", 0.0, step=i)
+        svc.sentinel.observe("queue_depth", 500.0, step=3)
+        assert "queue_depth" in svc.stats()["sentinel"]["active"]
+
+    def test_sentinel_disabled_via_env(self, service_factory, monkeypatch):
+        monkeypatch.setenv("DDR_SENTINEL_ENABLED", "0")
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        assert svc.sentinel is None
+        assert svc.stats()["sentinel"] is None
+
+    def test_malformed_sentinel_env_disables_not_crashes(
+        self, service_factory, monkeypatch
+    ):
+        monkeypatch.setenv("DDR_SENTINEL_WARMUP", "soon")
+        svc = service_factory(n_segments=32, horizon=8, n_days=2)
+        assert svc.sentinel is None
+        assert svc.stats()["sentinel"] is None
+
+
 class TestRequestTracing:
     """The lifecycle decomposition on the in-process path: request ids ride
     results + events, latency splits into queue/execute, SLO accounting sees
